@@ -1,0 +1,30 @@
+"""opentsdb_tpu — a TPU-native time-series aggregation framework.
+
+A from-scratch rebuild of OpenTSDB 2.4.1's capability surface (reference:
+/root/reference, pure Java) with the query-time numeric pipeline executed as
+batched JAX/XLA segment-reduction kernels instead of per-datapoint iterator
+stacks (reference: src/core/AggregationIterator.java, src/core/Downsampler.java).
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+  utils/     Config (tsd.* keys), DateTime grammar        (ref: src/utils/)
+  uid/       name<->UID dictionaries                      (ref: src/uid/UniqueId.java)
+  storage/   columnar chunked series store                (ref: HBase schema, src/core/RowSeq.java)
+  ops/       JAX kernels: downsample/aggregate/rate/lerp  (ref: src/core/Aggregators.java etc.)
+  core/      TSDB facade, datapoint model                 (ref: src/core/TSDB.java)
+  models/    query object model (TSQuery/TSSubQuery/pojo) (ref: src/core/TSQuery.java)
+  query/     tag filters, planner, expressions            (ref: src/query/, src/core/TsdbQuery.java)
+  parallel/  device mesh, shard_map pipelines             (ref: src/core/SaltScanner.java fan-out)
+  tsd/       HTTP + telnet API surface                    (ref: src/tsd/)
+  rollup/    rollup config/ingest/read                    (ref: src/rollup/)
+  meta/      annotations, TSMeta/UIDMeta                  (ref: src/meta/)
+  search/    lookup + search plugin                       (ref: src/search/)
+  tree/      hierarchical namespace                       (ref: src/tree/)
+  auth/      authentication/authorization SPIs            (ref: src/auth/)
+  stats/     StatsCollector / QueryStats                  (ref: src/stats/)
+  tools/     CLI: fsck/import/scan/uid/query              (ref: src/tools/)
+"""
+
+__version__ = "3.0.0-tpu"
+
+SHORT_VERSION = "3.0.0"

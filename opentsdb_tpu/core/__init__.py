@@ -1,0 +1,3 @@
+from opentsdb_tpu.core.tsdb import TSDB
+
+__all__ = ["TSDB"]

@@ -1,0 +1,263 @@
+"""TSDB facade: write path, UID administration, query entry.
+
+Reference behavior: /root/reference/src/core/TSDB.java (:87) — the god object
+owning the storage client, the three UID dictionaries (:297-302), plugins and
+the write path `addPoint` (:1051-1136) with timestamp/tag validation (:1313).
+The HBase client + row-key codec are replaced by the columnar MemStore; the
+3-byte UID scheme, validation rules, and second/millisecond timestamp
+heuristic (Const.SECOND_MASK: ts >= 2^32 means milliseconds) are kept.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from opentsdb_tpu import __version__, SHORT_VERSION
+from opentsdb_tpu.storage import MemStore
+from opentsdb_tpu.storage.memstore import Annotation, SeriesKey, MAX_NUM_TAGS
+from opentsdb_tpu.uid import (UniqueId, UniqueIdType, NoSuchUniqueName)
+from opentsdb_tpu.utils.config import Config
+
+SECOND_MASK = 0xFFFFFFFF00000000  # Const.java:19 — set bits mean milliseconds
+
+
+def normalize_timestamp_ms(timestamp: int | float) -> int:
+    """Seconds-or-milliseconds heuristic (TSDB.addPointInternal).
+
+    Values below 2^32 are treated as Unix seconds, larger as milliseconds.
+    """
+    ts = int(timestamp)
+    if ts < 0:
+        raise ValueError(
+            "The timestamp must be positive and within the extent of a "
+            "64-bit integer: %s" % timestamp)
+    if ts & SECOND_MASK:
+        return ts
+    return ts * 1000
+
+
+class TSDB:
+    """The top-level handle: storage + UID dictionaries + write/query APIs."""
+
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        self.metrics = UniqueId(
+            UniqueIdType.METRIC,
+            width=self.config.get_int("tsd.storage.uid.width.metric"),
+            random_ids=self.config.get_bool("tsd.core.uid.random_metrics"))
+        self.tag_names = UniqueId(
+            UniqueIdType.TAGK,
+            width=self.config.get_int("tsd.storage.uid.width.tagk"))
+        self.tag_values = UniqueId(
+            UniqueIdType.TAGV,
+            width=self.config.get_int("tsd.storage.uid.width.tagv"))
+        self.store = MemStore(
+            salt_buckets=self.config.salt_buckets,
+            fix_duplicates=self.config.fix_duplicates)
+        self.rollup_config = None   # set by rollup.RollupConfig.from_config
+        self.rollup_store: dict = {}
+        self.histogram_manager = None
+        self.rt_publisher = None    # RTPublisher plugin
+        self.storage_exception_handler = None
+        self.search_plugin = None
+        self.write_filter = None    # WriteableDataPointFilterPlugin
+        self.authentication = None
+        self.startup_plugin = None
+        self.mode = self.config.get_string("tsd.mode")  # rw / ro / wo
+        self.start_time = time.time()
+        self._stats_lock = threading.Lock()
+        self.datapoints_added = 0
+        self.illegal_arguments = 0
+        self.unknown_metrics = 0
+
+    # ------------------------------------------------------------------ #
+    # Write path (TSDB.addPoint :1051)                                   #
+    # ------------------------------------------------------------------ #
+
+    def check_timestamp_and_tags(self, metric: str, timestamp: int | float,
+                                 value, tags: dict[str, str]) -> None:
+        """Validation rules of TSDB.checkTimestampAndTags (:1313)."""
+        if not tags:
+            raise ValueError(
+                "Need at least one tag (metric=%s, ts=%s)" % (metric, timestamp))
+        if len(tags) > MAX_NUM_TAGS:
+            raise ValueError(
+                "Too many tags: %d maximum allowed: %d" %
+                (len(tags), MAX_NUM_TAGS))
+        if int(timestamp) < 0:
+            raise ValueError("Invalid timestamp: %s" % timestamp)
+
+    def add_point(self, metric: str, timestamp: int | float, value,
+                  tags: dict[str, str]) -> None:
+        """Store one datapoint; value may be int, float, or numeric string."""
+        if self.mode == "ro":
+            raise RuntimeError("TSD is in read-only mode, writes rejected")
+        is_int, num = parse_value(value)
+        self.check_timestamp_and_tags(metric, timestamp, num, tags)
+        if self.write_filter is not None and not self.write_filter.allow(
+                metric, timestamp, num, tags):
+            return
+        ts_ms = normalize_timestamp_ms(timestamp)
+        key = self._series_key(metric, tags, create=True)
+        self.store.add_point(key, ts_ms, num, is_int)
+        with self._stats_lock:
+            self.datapoints_added += 1
+        if self.rt_publisher is not None:
+            self.rt_publisher.publish_data_point(metric, ts_ms, num, tags,
+                                                 key.tsuid())
+
+    def _series_key(self, metric: str, tags: dict[str, str],
+                    create: bool) -> SeriesKey:
+        if create:
+            if self.config.auto_metric:
+                metric_uid = self.metrics.get_or_create_id(metric)
+            else:
+                try:
+                    metric_uid = self.metrics.get_id(metric)
+                except NoSuchUniqueName:
+                    with self._stats_lock:
+                        self.unknown_metrics += 1
+                    raise
+            auto_tagk = self.config.get_bool("tsd.core.auto_create_tagks")
+            auto_tagv = self.config.get_bool("tsd.core.auto_create_tagvs")
+            uid_tags = {}
+            for k, v in tags.items():
+                ku = (self.tag_names.get_or_create_id(k) if auto_tagk
+                      else self.tag_names.get_id(k))
+                vu = (self.tag_values.get_or_create_id(v) if auto_tagv
+                      else self.tag_values.get_id(v))
+                uid_tags[ku] = vu
+        else:
+            metric_uid = self.metrics.get_id(metric)
+            uid_tags = {self.tag_names.get_id(k): self.tag_values.get_id(v)
+                        for k, v in tags.items()}
+        return SeriesKey.make(metric_uid, uid_tags)
+
+    # ------------------------------------------------------------------ #
+    # Read helpers                                                       #
+    # ------------------------------------------------------------------ #
+
+    def resolve_key_tags(self, key: SeriesKey) -> dict[str, str]:
+        """UID tag pairs -> {tagk_name: tagv_name}."""
+        return {self.tag_names.get_name(k): self.tag_values.get_name(v)
+                for k, v in key.tags}
+
+    def tsuid(self, key: SeriesKey) -> str:
+        """Hex TSUID honoring the configured UID byte widths."""
+        return key.tsuid(self.metrics.width, self.tag_names.width,
+                         self.tag_values.width)
+
+    def new_query_runner(self):
+        from opentsdb_tpu.query.planner import QueryRunner
+        return QueryRunner(self)
+
+    # ------------------------------------------------------------------ #
+    # UID admin (TSDB.assignUid :1901, renameUid :1968, suggest :1825)   #
+    # ------------------------------------------------------------------ #
+
+    def uid_table(self, kind: str) -> UniqueId:
+        t = UniqueIdType.from_string(kind)
+        return {UniqueIdType.METRIC: self.metrics,
+                UniqueIdType.TAGK: self.tag_names,
+                UniqueIdType.TAGV: self.tag_values}[t]
+
+    def assign_uid(self, kind: str, name: str) -> int:
+        table = self.uid_table(kind)
+        if table.has_name(name):
+            raise ValueError("Name already exists with UID: %s"
+                             % table.uid_to_hex(table.get_id(name)))
+        return table.get_or_create_id(name)
+
+    def rename_uid(self, kind: str, old_name: str, new_name: str) -> None:
+        self.uid_table(kind).rename(old_name, new_name)
+
+    def delete_uid(self, kind: str, name: str) -> int:
+        return self.uid_table(kind).delete(name)
+
+    def suggest_metrics(self, prefix: str = "", max_results: int = 25):
+        return self.metrics.suggest(prefix, max_results)
+
+    def suggest_tagk(self, prefix: str = "", max_results: int = 25):
+        return self.tag_names.suggest(prefix, max_results)
+
+    def suggest_tagv(self, prefix: str = "", max_results: int = 25):
+        return self.tag_values.suggest(prefix, max_results)
+
+    # ------------------------------------------------------------------ #
+    # Annotations                                                        #
+    # ------------------------------------------------------------------ #
+
+    def add_annotation(self, note: Annotation) -> None:
+        self.store.add_annotation(note)
+
+    # ------------------------------------------------------------------ #
+    # Stats (TSDB.collectStats :785)                                     #
+    # ------------------------------------------------------------------ #
+
+    def collect_stats(self) -> dict[str, float]:
+        now = time.time()
+        return {
+            "tsd.uid.cache-hit metrics": self.metrics.cache_hits,
+            "tsd.uid.cache-miss metrics": self.metrics.cache_misses,
+            "tsd.uid.ids-used metrics": len(self.metrics),
+            "tsd.uid.cache-hit tagk": self.tag_names.cache_hits,
+            "tsd.uid.cache-miss tagk": self.tag_names.cache_misses,
+            "tsd.uid.ids-used tagk": len(self.tag_names),
+            "tsd.uid.cache-hit tagv": self.tag_values.cache_hits,
+            "tsd.uid.cache-miss tagv": self.tag_values.cache_misses,
+            "tsd.uid.ids-used tagv": len(self.tag_values),
+            "tsd.datapoints.added": self.datapoints_added,
+            "tsd.storage.series": self.store.num_series,
+            "tsd.storage.datapoints": self.store.total_datapoints,
+            "tsd.storage.bytes": self.store.total_bytes,
+            "tsd.compaction.count": self.store.compaction_queue.compactions,
+            "tsd.uptime": now - self.start_time,
+        }
+
+    @staticmethod
+    def version() -> str:
+        return __version__
+
+    @staticmethod
+    def short_version() -> str:
+        return SHORT_VERSION
+
+    def flush(self) -> None:
+        self.store.compaction_queue.flush()
+
+    def shutdown(self) -> None:
+        self.flush()
+
+
+def parse_value(value) -> tuple[bool, int | float]:
+    """Classify a put value as integer or float (Tags.parseLong / fixFloat).
+
+    Strings follow the telnet `put` rules: "42" is an integer, "42.0" and
+    "4e2" are floats.  Integers stay exact Python ints (Java-long parity up
+    to 2^63); NaN/Infinity are rejected like the reference
+    (TSDB.addPointInternal IllegalArgumentException).
+    """
+    import math
+    if isinstance(value, bool):
+        raise ValueError("Invalid value: %r" % value)
+    if isinstance(value, int):
+        return True, value
+    if isinstance(value, float):
+        if math.isnan(value) or math.isinf(value):
+            raise ValueError("Invalid value: %r" % value)
+        return False, value
+    text = str(value).strip()
+    if not text:
+        raise ValueError("Empty value")
+    try:
+        return True, int(text)
+    except ValueError:
+        pass
+    try:
+        out = float(text)
+    except ValueError:
+        raise ValueError("Invalid value: %r" % value)
+    if math.isnan(out) or math.isinf(out):
+        raise ValueError("Invalid value: %r" % value)
+    return False, out

@@ -1,0 +1,8 @@
+from opentsdb_tpu.models.tsquery import (
+    TSQuery, TSSubQuery, DownsamplingSpecification, parse_m_subquery,
+    parse_tsuid_subquery, parse_rate_options, parse_percentiles)
+
+__all__ = [
+    "TSQuery", "TSSubQuery", "DownsamplingSpecification", "parse_m_subquery",
+    "parse_tsuid_subquery", "parse_rate_options", "parse_percentiles",
+]

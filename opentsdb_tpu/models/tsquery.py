@@ -1,0 +1,329 @@
+"""Query object model: TSQuery / TSSubQuery / downsampling spec + URI grammar.
+
+Reference behavior: /root/reference/src/core/TSQuery.java (:47-112 fields,
+validateAndSetQuery), TSSubQuery.java (:50-104), and the URI parsers in
+src/tsd/QueryRpc.java (parseQuery :521, parseMTypeSubQuery :638 — grammar
+``agg:[interval-agg[-fill][c]:][rate[{counter[,max[,reset]]}]:][percentiles[..]:]
+[explicit_tags:]metric{groupby}{filters}`` — parseRateOptions :762,
+parsePercentiles :902) and DownsamplingSpecification.java (spec string
+"interval-function[-fill_policy]", trailing 'c' = calendar alignment).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from opentsdb_tpu.ops.rate import RateOptions
+from opentsdb_tpu.utils import datetime_util as DT
+from opentsdb_tpu.query.filters import TagVFilter, parse_metric_with_filters
+
+_FILL_POLICIES = ("none", "zero", "nan", "null", "scalar")
+
+
+@dataclass
+class DownsamplingSpecification:
+    """Parsed downsample spec (DownsamplingSpecification.java:116-191)."""
+    interval_ms: int
+    function: str
+    fill_policy: str = "none"
+    fill_value: float = 0.0
+    string_interval: str | None = None
+    use_calendar: bool = False
+    run_all: bool = False
+    timezone: str = "UTC"
+
+    @staticmethod
+    def parse(spec: str) -> "DownsamplingSpecification":
+        if not spec:
+            raise ValueError("Downsampling specifier cannot be empty")
+        parts = spec.split("-")
+        if len(parts) < 2:
+            raise ValueError(
+                "Invalid downsampling specifier '%s': must provide at least "
+                "interval and function" % spec)
+        if len(parts) > 3:
+            raise ValueError(
+                "Invalid downsampling specifier '%s': must consist of interval, "
+                "function, and optional fill policy" % spec)
+
+        run_all = False
+        use_calendar = False
+        interval_ms = 0
+        raw_interval = parts[0]
+        if "all" in raw_interval:
+            run_all = True
+            string_interval = raw_interval
+        elif raw_interval.endswith("c"):
+            string_interval = raw_interval[:-1]
+            interval_ms = DT.parse_duration(string_interval)
+            use_calendar = True
+        else:
+            string_interval = raw_interval
+            interval_ms = DT.parse_duration(raw_interval)
+
+        function = parts[1]
+        from opentsdb_tpu.ops.aggregators import AGGREGATORS
+        if function not in AGGREGATORS:
+            raise ValueError("No such downsampling function: " + function)
+        if function == "none":
+            raise ValueError("cannot use the NONE aggregator for downsampling")
+
+        fill_policy = "none"
+        fill_value = 0.0
+        if len(parts) == 3:
+            fp = parts[2]
+            if fp not in _FILL_POLICIES:
+                raise ValueError("No such fill policy: '%s': must be one of: %s"
+                                 % (fp, " ".join(_FILL_POLICIES)))
+            fill_policy = fp
+        return DownsamplingSpecification(
+            interval_ms=interval_ms, function=function, fill_policy=fill_policy,
+            fill_value=fill_value, string_interval=string_interval,
+            use_calendar=use_calendar, run_all=run_all)
+
+    @property
+    def calendar_unit(self) -> str:
+        return DT.get_duration_units(self.string_interval)
+
+    @property
+    def calendar_interval(self) -> int:
+        return DT.get_duration_interval(self.string_interval)
+
+
+@dataclass
+class TSSubQuery:
+    """One sub query: aggregator + metric/tsuids + transforms (TSSubQuery.java)."""
+    aggregator: str = "sum"
+    metric: str | None = None
+    tsuids: list[str] | None = None
+    downsample: str | None = None
+    rate: bool = False
+    rate_options: RateOptions = field(default_factory=RateOptions)
+    filters: list[TagVFilter] = field(default_factory=list)
+    explicit_tags: bool = False
+    pre_aggregate: bool = False
+    rollup_usage: str | None = None
+    percentiles: list[float] | None = None
+    show_histogram_buckets: bool = False
+    index: int = 0
+    # filled by validate()
+    downsample_spec: DownsamplingSpecification | None = None
+
+    def validate(self) -> None:
+        if not self.aggregator:
+            raise ValueError("Missing the aggregation function")
+        from opentsdb_tpu.ops.aggregators import AGGREGATORS
+        if self.aggregator not in AGGREGATORS:
+            raise ValueError("No such aggregator: " + self.aggregator)
+        if not self.metric and not self.tsuids:
+            raise ValueError(
+                "Missing the metric or tsuids, provide at least one")
+        if self.downsample:
+            self.downsample_spec = DownsamplingSpecification.parse(
+                self.downsample)
+
+    @property
+    def fill_policy(self) -> str:
+        if self.downsample_spec is None:
+            return "none"
+        return self.downsample_spec.fill_policy
+
+    def group_by_tags(self) -> list[str]:
+        return sorted({f.tagk for f in self.filters if f.group_by})
+
+    def to_json(self) -> dict:
+        out = {
+            "aggregator": self.aggregator,
+            "metric": self.metric,
+            "tsuids": self.tsuids,
+            "downsample": self.downsample,
+            "rate": self.rate,
+            "filters": [f.to_json() for f in self.filters],
+            "explicitTags": self.explicit_tags,
+            "index": self.index,
+            "rateOptions": ({
+                "counter": self.rate_options.counter,
+                "counterMax": self.rate_options.counter_max,
+                "resetValue": self.rate_options.reset_value,
+                "dropResets": self.rate_options.drop_resets,
+            } if self.rate else None),
+            "tags": {f.tagk: f.spec_string() for f in self.filters
+                     if f.group_by},
+        }
+        return out
+
+    def dedup_key(self):
+        return (self.aggregator, self.metric,
+                tuple(self.tsuids or ()), self.downsample, self.rate,
+                self.rate_options, tuple((f.tagk, f.type, f.filter,
+                                          f.group_by) for f in self.filters),
+                self.explicit_tags)
+
+
+@dataclass
+class TSQuery:
+    """Top-level /api/query body (TSQuery.java)."""
+    start: str | int | None = None
+    end: str | int | None = None
+    timezone: str | None = None
+    queries: list[TSSubQuery] = field(default_factory=list)
+    padding: bool = False
+    no_annotations: bool = False
+    global_annotations: bool = False
+    show_tsuids: bool = False
+    ms_resolution: bool = False
+    show_query: bool = False
+    show_stats: bool = False
+    show_summary: bool = False
+    delete: bool = False
+    use_calendar: bool = False
+    # resolved by validate()
+    start_time: int = 0
+    end_time: int = 0
+
+    def validate(self, now_ms: int | None = None) -> None:
+        """validateAndSetQuery (TSQuery.java:112): resolve times, sub queries."""
+        if self.start is None or self.start == "":
+            raise ValueError("Missing start time")
+        self.start_time = DT.parse_datetime_string(str(self.start),
+                                                   self.timezone, now_ms)
+        if self.end is None or self.end == "":
+            self.end_time = (now_ms if now_ms is not None
+                             else DT.current_time_millis())
+        else:
+            self.end_time = DT.parse_datetime_string(str(self.end),
+                                                     self.timezone, now_ms)
+        if self.end_time <= self.start_time:
+            raise ValueError(
+                "End time [%d] must be greater than the start time [%d]"
+                % (self.end_time, self.start_time))
+        if not self.queries:
+            raise ValueError("Missing sub queries")
+        seen = set()
+        deduped = []
+        for i, sub in enumerate(self.queries):
+            sub.validate()
+            key = sub.dedup_key()
+            if key in seen:
+                continue
+            seen.add(key)
+            deduped.append(sub)
+        self.queries = deduped
+        for i, sub in enumerate(self.queries):
+            sub.index = i
+            if sub.downsample_spec is not None:
+                if self.timezone:
+                    sub.downsample_spec.timezone = self.timezone
+                if self.use_calendar:
+                    sub.downsample_spec.use_calendar = True
+
+
+def parse_rate_options(spec: str) -> RateOptions:
+    """Parse "rate{counter[,max[,reset]]}" (QueryRpc.parseRateOptions :762)."""
+    if len(spec) == 4:  # bare "rate"
+        return RateOptions()
+    if len(spec) < 6 or "{" not in spec or not spec.endswith("}"):
+        raise ValueError("Invalid rate options specification: " + spec)
+    inner = spec[5:-1]
+    parts = inner.split(",")
+    if len(parts) < 1 or len(parts) > 3:
+        raise ValueError(
+            "Incorrect number of values in rate options specification, must "
+            "be counter[,counter max value,reset value], received: %d parts"
+            % len(parts))
+    kind = parts[0].strip().lower()
+    if kind not in ("counter", "dropcounter", ""):
+        raise ValueError("Invalid rate counter type: " + parts[0])
+    counter = kind in ("counter", "dropcounter")
+    drop = kind == "dropcounter"
+    counter_max = RateOptions().counter_max
+    reset = 0
+    if len(parts) >= 2 and parts[1].strip():
+        counter_max = int(parts[1])
+    if len(parts) >= 3 and parts[2].strip():
+        reset = int(parts[2])
+    return RateOptions(counter, counter_max, reset, drop)
+
+
+def parse_percentiles(spec: str) -> list[float]:
+    """Parse "percentiles[99,99.9]" (QueryRpc.parsePercentiles :902)."""
+    bracket = spec.index("[")
+    if not spec.endswith("]"):
+        raise ValueError("Invalid percentiles specification: " + spec)
+    inner = spec[bracket + 1:-1]
+    out = []
+    for part in inner.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        value = float(part)
+        if not 0 < value <= 100:
+            raise ValueError("Invalid percentile value: " + part)
+        out.append(value)
+    if not out:
+        raise ValueError("No percentiles specified: " + spec)
+    return out
+
+
+def parse_m_subquery(query_string: str) -> TSSubQuery:
+    """Parse one m= parameter (QueryRpc.parseMTypeSubQuery :638)."""
+    if not query_string:
+        raise ValueError("The query string was empty")
+    parts = query_string.split(":")
+    n = len(parts)
+    if n < 2 or n > 5:
+        raise ValueError(
+            "Invalid parameter m=%s (%s :-separated parts)"
+            % (query_string, "not enough" if n < 2 else "too many"))
+    sub = TSSubQuery()
+    sub.aggregator = parts[0]
+    filters: list[TagVFilter] = []
+    sub.metric = parse_metric_with_filters(parts[-1], filters)
+    sub.filters = filters
+    for x in range(1, n - 1):
+        part = parts[x]
+        low = part.lower()
+        if low.startswith("rate"):
+            sub.rate = True
+            if "{" in part:
+                sub.rate_options = parse_rate_options(part)
+        elif part and part[0].isdigit():
+            sub.downsample = part
+        elif low == "pre-agg":
+            sub.pre_aggregate = True
+        elif low.startswith("rollup_"):
+            sub.rollup_usage = part.upper()
+        elif low.startswith("percentiles"):
+            sub.percentiles = parse_percentiles(part)
+        elif low.startswith("show-histogram-buckets"):
+            sub.show_histogram_buckets = True
+        elif low.startswith("explicit_tags"):
+            sub.explicit_tags = True
+    return sub
+
+
+def parse_tsuid_subquery(query_string: str) -> TSSubQuery:
+    """Parse one tsuid= parameter (QueryRpc.parseTsuidTypeSubQuery :700)."""
+    if not query_string:
+        raise ValueError("The tsuid query string was empty")
+    parts = query_string.split(":")
+    n = len(parts)
+    if n < 2 or n > 5:
+        raise ValueError("Invalid parameter tsuid=%s" % query_string)
+    sub = TSSubQuery()
+    sub.aggregator = parts[0]
+    sub.tsuids = [t for t in parts[-1].split(",") if t]
+    for x in range(1, n - 1):
+        part = parts[x]
+        low = part.lower()
+        if low.startswith("rate"):
+            sub.rate = True
+            if "{" in part:
+                sub.rate_options = parse_rate_options(part)
+        elif part and part[0].isdigit():
+            sub.downsample = part
+        elif low.startswith("percentiles"):
+            sub.percentiles = parse_percentiles(part)
+        elif low.startswith("show-histogram-buckets"):
+            sub.show_histogram_buckets = True
+    return sub

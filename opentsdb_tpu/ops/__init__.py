@@ -1,0 +1,26 @@
+"""JAX/XLA kernels for the query-time numeric pipeline.
+
+This package replaces the reference's per-datapoint iterator stack
+(src/core/Aggregators.java, Downsampler.java, RateSpan.java,
+AggregationIterator.java) with batched, jit-compiled array kernels:
+
+  aggregators.py  registry + masked cross-series reductions
+  downsample.py   windowed segment-reductions over [series, time] batches
+  rate.py         first-difference / counter-rate kernels
+  union_agg.py    LERP-at-union-timestamps cross-series merge
+  percentile.py   sort-based percentile selection (LEGACY/R-3/R-7)
+  pipeline.py     fused end-to-end query kernels (jit entry points)
+
+float64/int64 precision is enabled process-wide to match the reference's
+Java double/long arithmetic; kernels themselves are dtype-polymorphic so the
+TPU fast path can run float32 batches.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from opentsdb_tpu.ops import aggregators  # noqa: E402
+from opentsdb_tpu.ops.aggregators import AGGREGATORS, get_agg, agg_names  # noqa: E402
+
+__all__ = ["aggregators", "AGGREGATORS", "get_agg", "agg_names"]

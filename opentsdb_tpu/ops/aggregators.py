@@ -1,0 +1,239 @@
+"""Aggregator registry and masked cross-series reduction kernels.
+
+Reference behavior: /root/reference/src/core/Aggregators.java — the named
+aggregation functions with their interpolation policies (:38 Interpolation
+enum, registry :175-203), and Aggregator.java's runLong/runDouble contracts:
+double reductions skip NaN inputs; long reductions use Java integer division
+for avg (Aggregators.java:378) and truncate stddev (:522).
+
+The reference reduces with virtual-call iterators, one value at a time; here
+each aggregator is a vectorized masked reduction over the series axis of a
+[series, time] batch, so a whole group-by bucket reduces in one XLA op.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from opentsdb_tpu.ops.percentile import (
+    masked_percentile,
+    EST_LEGACY,
+    EST_R3,
+    EST_R7,
+)
+
+# Interpolation policies (Aggregators.java:38-44).
+LERP = "lerp"
+ZIM = "zim"     # zero if missing
+MAX_IF_MISSING = "max"
+MIN_IF_MISSING = "min"
+PREV = "prev"
+
+_F64_MAX = jnp.finfo(jnp.float64).max
+_I64_MAX = jnp.iinfo(jnp.int64).max
+_I64_MIN = jnp.iinfo(jnp.int64).min
+
+
+def _where(mask, v, fill):
+    return jnp.where(mask, v, jnp.asarray(fill, dtype=v.dtype))
+
+
+def _valid(values, mask):
+    """Participating AND non-NaN, the double-path skip rule."""
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        return mask & ~jnp.isnan(values)
+    return mask
+
+
+def _nan_if_empty(result, count, dtype):
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return jnp.where(count > 0, result, jnp.asarray(jnp.nan, dtype))
+    return result
+
+
+# --- reduction kernels over axis 0 of (values[S, T], mask[S, T]) ---
+
+def _sum(values, mask):
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    return _nan_if_empty(_where(ok, values, 0).sum(axis=0), n, values.dtype)
+
+
+def _squaresum(values, mask):
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    sq = _where(ok, values, 0)
+    return _nan_if_empty((sq * sq).sum(axis=0), n, values.dtype)
+
+
+def _min(values, mask):
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        out = _where(ok, values, jnp.inf).min(axis=0)
+    else:
+        out = _where(ok, values, _I64_MAX).min(axis=0)
+    return _nan_if_empty(out, n, values.dtype)
+
+
+def _max(values, mask):
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        out = _where(ok, values, -jnp.inf).max(axis=0)
+    else:
+        out = _where(ok, values, _I64_MIN).max(axis=0)
+    return _nan_if_empty(out, n, values.dtype)
+
+
+def _avg(values, mask):
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    total = _where(ok, values, 0).sum(axis=0)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        return jnp.where(n > 0, total / jnp.maximum(n, 1), jnp.nan)
+    # Java long division truncates toward zero (Aggregators.java:378).
+    return lax.div(total, jnp.maximum(n, 1).astype(total.dtype))
+
+
+def _count(values, mask):
+    # runDouble counts non-NaN values; runLong counts everything (:620-646).
+    return _valid(values, mask).sum(axis=0).astype(
+        values.dtype if jnp.issubdtype(values.dtype, jnp.floating)
+        else jnp.int64)
+
+
+def _dev(values, mask):
+    """Welford stddev (Aggregators.java:498): sqrt(M2/(n-1)), 0 when n<2."""
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    vf = values.astype(jnp.float64)
+    total = _where(ok, vf, 0).sum(axis=0)
+    mean = total / jnp.maximum(n, 1)
+    centered = _where(ok, vf - mean, 0)
+    m2 = (centered * centered).sum(axis=0)
+    var = m2 / jnp.maximum(n - 1, 1)
+    out = jnp.where(n >= 2, jnp.sqrt(var), 0.0)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        return jnp.where(n > 0, out, jnp.nan)
+    return out.astype(values.dtype)  # (long) cast truncation (:522)
+
+
+def _mult(values, mask):
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    return _nan_if_empty(_where(ok, values, 1).prod(axis=0), n, values.dtype)
+
+
+def _first_ordered(values, mask):
+    """First participating value in series order (Aggregators.First :810)."""
+    ok = _valid(values, mask)
+    idx = jnp.argmax(ok, axis=0)
+    out = jnp.take_along_axis(values, idx[None, :], axis=0)[0]
+    return _nan_if_empty(out, ok.sum(axis=0), values.dtype)
+
+
+def _last_ordered(values, mask):
+    ok = _valid(values, mask)
+    s = ok.shape[0]
+    rev_idx = jnp.argmax(ok[::-1], axis=0)
+    idx = s - 1 - rev_idx
+    out = jnp.take_along_axis(values, idx[None, :], axis=0)[0]
+    return _nan_if_empty(out, ok.sum(axis=0), values.dtype)
+
+
+def _diff(values, mask):
+    """last - first in iteration order; 0 with a single value (:576-617)."""
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    first = _first_ordered(values, mask)
+    last = _last_ordered(values, mask)
+    zero = jnp.asarray(0, values.dtype)
+    out = jnp.where(n >= 2, last - first, zero)
+    return _nan_if_empty(out, n, values.dtype)
+
+
+def _median(values, mask):
+    """Upper median: sorted[n // 2] (Aggregators.Median :397-431)."""
+    ok = _valid(values, mask)
+    n = ok.sum(axis=0)
+    big = jnp.inf if jnp.issubdtype(values.dtype, jnp.floating) else _I64_MAX
+    sorted_vals = jnp.sort(_where(ok, values, big), axis=0)
+    idx = jnp.clip(n // 2, 0, values.shape[0] - 1)
+    out = jnp.take_along_axis(sorted_vals, idx[None, :], axis=0)[0]
+    return _nan_if_empty(out, n, values.dtype)
+
+
+def _none_agg(values, mask):
+    # Pipeline guarantees a single series reaches "none" (QueryRpc enforces it).
+    return _first_ordered(values, mask)
+
+
+def _percentile_agg(values, mask, q, estimation):
+    ok = _valid(values, mask)
+    out = masked_percentile(values.astype(jnp.float64), ok, q, estimation,
+                            axis=0)
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        return out
+    return out.astype(values.dtype)  # (long) cast (Aggregators.java:685)
+
+
+@dataclass(frozen=True)
+class Aggregator:
+    """A named aggregation function + its missing-value interpolation policy."""
+    name: str
+    interpolation: str
+    reduce: callable  # (values[S, T], mask[S, T]) -> [T]
+
+    def __repr__(self) -> str:
+        return "Aggregator(%s)" % self.name
+
+
+def _make_registry() -> dict[str, Aggregator]:
+    reg = {
+        "sum": Aggregator("sum", LERP, _sum),
+        "pfsum": Aggregator("pfsum", PREV, _sum),
+        "min": Aggregator("min", LERP, _min),
+        "max": Aggregator("max", LERP, _max),
+        "avg": Aggregator("avg", LERP, _avg),
+        "median": Aggregator("median", LERP, _median),
+        "none": Aggregator("none", ZIM, _none_agg),
+        "mult": Aggregator("mult", LERP, _mult),
+        "dev": Aggregator("dev", LERP, _dev),
+        "diff": Aggregator("diff", LERP, _diff),
+        "count": Aggregator("count", ZIM, _count),
+        "zimsum": Aggregator("zimsum", ZIM, _sum),
+        "mimmin": Aggregator("mimmin", MAX_IF_MISSING, _min),
+        "mimmax": Aggregator("mimmax", MIN_IF_MISSING, _max),
+        "first": Aggregator("first", ZIM, _first_ordered),
+        "last": Aggregator("last", ZIM, _last_ordered),
+        "squareSum": Aggregator("squareSum", ZIM, _squaresum),
+    }
+    percentiles = [99.9, 99.0, 95.0, 90.0, 75.0, 50.0]
+    names = ["999", "99", "95", "90", "75", "50"]
+    for q, n in zip(percentiles, names):
+        reg["p" + n] = Aggregator(
+            "p" + n, LERP, partial(_percentile_agg, q=q, estimation=EST_LEGACY))
+        reg["ep%sr3" % n] = Aggregator(
+            "ep%sr3" % n, LERP, partial(_percentile_agg, q=q, estimation=EST_R3))
+        reg["ep%sr7" % n] = Aggregator(
+            "ep%sr7" % n, LERP, partial(_percentile_agg, q=q, estimation=EST_R7))
+    return reg
+
+
+AGGREGATORS: dict[str, Aggregator] = _make_registry()
+
+
+def get_agg(name: str) -> Aggregator:
+    agg = AGGREGATORS.get(name)
+    if agg is None:
+        raise KeyError("No such aggregator: " + name)
+    return agg
+
+
+def agg_names() -> list[str]:
+    return sorted(AGGREGATORS.keys())

@@ -1,0 +1,279 @@
+"""Windowed downsampling as segment reductions over [series, time] batches.
+
+Reference behavior: /root/reference/src/core/Downsampler.java (ValuesInInterval
+:292 — per-interval reduce with runDouble semantics, interval start as the
+output timestamp :437-449, epoch-aligned ts - ts % interval :452),
+DownsamplingSpecification.java (spec grammar "1h-avg[-fill][c]"), and
+FillingDownsampler.java (emit empty intervals under non-NONE fill policies).
+Downsampled values are always doubles (Downsampler.java:257).
+
+TPU-first design: instead of an iterator per span, every series row maps its
+timestamps to window ids; one flattened `segment_sum`-family reduction
+computes all (series x window) cells at once.
+
+Compile-stability: only the window *count* and interval are static — the
+window origin (query start), calendar edges, and live window count are traced
+operands, so a dashboard re-issuing the same query over a sliding time range
+hits the jit cache.  Calendar windows arrive as a precomputed edge array
+(host computes timezone math, device does searchsorted) — SURVEY.md §7 hard
+part (d).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opentsdb_tpu.ops.percentile import (
+    segment_percentile, EST_LEGACY, EST_R3, EST_R7)
+
+# Fill policies (FillPolicy.java:22-27).
+FILL_NONE = "none"
+FILL_ZERO = "zero"
+FILL_NAN = "nan"
+FILL_NULL = "null"     # NaN internally; serializer emits nulls
+FILL_SCALAR = "scalar"
+
+_I64_MAX = np.iinfo(np.int64).max
+
+
+def pad_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """Static window shape: kind + padded count (+ interval for fixed grids).
+
+    The traced counterpart is a dict of device scalars/arrays built by the
+    host-side planners below; together they describe the same windows the
+    reference's ValuesInInterval walked.
+    """
+    kind: str           # "fixed" | "edges" | "all"
+    count: int          # padded number of windows, static
+    interval_ms: int = 0  # fixed grids only
+
+
+@dataclass(frozen=True)
+class FixedWindows:
+    """Host plan: epoch-aligned fixed-interval windows over [start, end]."""
+    interval_ms: int
+    first_window_ms: int
+    count: int  # real (unpadded) count
+
+    @staticmethod
+    def for_range(start_ms: int, end_ms: int, interval_ms: int) -> "FixedWindows":
+        first = start_ms - (start_ms % interval_ms)
+        last = end_ms - (end_ms % interval_ms)
+        count = int((last - first) // interval_ms) + 1
+        return FixedWindows(interval_ms, first, count)
+
+    def split(self, pad: bool = True) -> tuple[WindowSpec, dict]:
+        padded = pad_pow2(self.count) if pad else self.count
+        return (WindowSpec("fixed", padded, self.interval_ms),
+                {"first": jnp.asarray(self.first_window_ms, jnp.int64),
+                 "nwin": jnp.asarray(self.count, jnp.int32)})
+
+
+@dataclass(frozen=True)
+class EdgeWindows:
+    """Host plan: calendar windows from precomputed edges[W+1]."""
+    edges: tuple  # ints; window w spans [edges[w], edges[w+1])
+
+    @property
+    def count(self) -> int:
+        return len(self.edges) - 1
+
+    def split(self, pad: bool = True) -> tuple[WindowSpec, dict]:
+        w = self.count
+        padded = pad_pow2(w) if pad else w
+        edges = np.full(padded + 1, _I64_MAX, dtype=np.int64)
+        edges[:w + 1] = self.edges
+        return (WindowSpec("edges", padded),
+                {"edges": jnp.asarray(edges),
+                 "nwin": jnp.asarray(w, jnp.int32)})
+
+
+@dataclass(frozen=True)
+class AllWindow:
+    """Host plan: the "0all" run-all window spanning [query_start, query_end)."""
+    query_start_ms: int
+    query_end_ms: int
+
+    @property
+    def count(self) -> int:
+        return 1
+
+    def split(self, pad: bool = True) -> tuple[WindowSpec, dict]:
+        return (WindowSpec("all", 1),
+                {"qstart": jnp.asarray(self.query_start_ms, jnp.int64),
+                 "qend": jnp.asarray(self.query_end_ms, jnp.int64),
+                 "nwin": jnp.asarray(1, jnp.int32)})
+
+
+def window_ids(ts, spec: WindowSpec, wargs: dict):
+    """Window index per point; negative / >= count means outside any window."""
+    if spec.kind == "fixed":
+        return ((ts - wargs["first"]) // spec.interval_ms).astype(jnp.int64)
+    if spec.kind == "edges":
+        edges = wargs["edges"]
+        return jnp.searchsorted(edges, ts, side="right").astype(jnp.int64) - 1
+    if spec.kind == "all":
+        inside = (ts >= wargs["qstart"]) & (ts < wargs["qend"])
+        return jnp.where(inside, 0, -1).astype(jnp.int64)
+    raise ValueError("Unknown window kind: " + spec.kind)
+
+
+def window_timestamps(spec: WindowSpec, wargs: dict):
+    """Representative (start-of-interval) timestamp per window [count]."""
+    if spec.kind == "fixed":
+        return wargs["first"] + jnp.arange(spec.count, dtype=jnp.int64) \
+            * spec.interval_ms
+    if spec.kind == "edges":
+        return wargs["edges"][:spec.count]
+    if spec.kind == "all":
+        return wargs["qstart"][None]
+    raise ValueError("Unknown window kind: " + spec.kind)
+
+
+def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
+               fill_policy: str = FILL_NONE, fill_value: float = 0.0):
+    """Downsample a [S, N] batch into (window_ts[W], values[S, W], mask[S, W]).
+
+    `agg_name` follows the runDouble contract (NaN inputs skipped); output is
+    always float (Downsampler.java:257).  With FILL_NONE empty windows are
+    masked out; other policies emit every live window with the fill applied.
+    """
+    s, n = ts.shape
+    w = spec.count
+    num = s * w + 1
+    fdtype = val.dtype if jnp.issubdtype(val.dtype, jnp.floating) else jnp.float64
+    vf = val.astype(fdtype)
+    nwin = wargs["nwin"]
+
+    win = window_ids(ts, spec, wargs)
+    valid = mask & (win >= 0) & (win < nwin.astype(win.dtype))
+    rows = jnp.arange(s, dtype=jnp.int64)[:, None]
+    seg = jnp.where(valid, rows * w + jnp.clip(win, 0, w - 1), s * w)
+    seg = seg.reshape(-1)
+    ok = valid.reshape(-1) & ~jnp.isnan(vf.reshape(-1))
+    seg = jnp.where(ok, seg, s * w)
+    flat_v = jnp.where(ok, vf.reshape(-1), 0)
+
+    def segsum(data):
+        return jax.ops.segment_sum(data, seg, num_segments=num)[:-1]
+
+    counts = segsum(ok.astype(jnp.int32))
+    count_grid = counts.reshape(s, w)
+    live = jnp.arange(w, dtype=jnp.int32)[None, :] < nwin
+    out_mask = (count_grid > 0) & live
+
+    if agg_name in ("sum", "zimsum", "pfsum"):
+        out = segsum(flat_v).reshape(s, w)
+    elif agg_name == "count":
+        out = count_grid.astype(fdtype)
+    elif agg_name == "squareSum":
+        out = segsum(flat_v * flat_v).reshape(s, w)
+    elif agg_name in ("min", "mimmin"):
+        out = jax.ops.segment_min(
+            jnp.where(ok, vf.reshape(-1), jnp.inf), seg, num_segments=num
+        )[:-1].reshape(s, w)
+    elif agg_name in ("max", "mimmax"):
+        out = jax.ops.segment_max(
+            jnp.where(ok, vf.reshape(-1), -jnp.inf), seg, num_segments=num
+        )[:-1].reshape(s, w)
+    elif agg_name == "avg":
+        total = segsum(flat_v).reshape(s, w)
+        out = total / jnp.maximum(count_grid, 1)
+    elif agg_name == "dev":
+        # Two-pass: mean per window, then centered second moment — avoids the
+        # catastrophic cancellation of sumsq - n*mean^2 at large magnitudes
+        # (matches the reference's Welford numerics, Aggregators.java:498).
+        total = segsum(flat_v).reshape(s, w)
+        cnt = jnp.maximum(count_grid, 1)
+        mean = total / cnt
+        mean_per_point = mean.reshape(-1)[jnp.clip(seg, 0, s * w - 1)]
+        centered = jnp.where(ok, vf.reshape(-1) - mean_per_point, 0.0)
+        m2 = segsum(centered * centered).reshape(s, w)
+        out = jnp.where(count_grid >= 2,
+                        jnp.sqrt(m2 / jnp.maximum(count_grid - 1, 1)), 0.0)
+    elif agg_name == "mult":
+        out = jax.ops.segment_prod(
+            jnp.where(ok, vf.reshape(-1), 1.0), seg, num_segments=num
+        )[:-1].reshape(s, w)
+    elif agg_name in ("first", "last", "diff"):
+        pos = jnp.arange(s * n, dtype=jnp.int64)
+        first_idx = jax.ops.segment_min(jnp.where(ok, pos, _I64_MAX), seg,
+                                        num_segments=num)[:-1]
+        last_idx = jax.ops.segment_max(jnp.where(ok, pos, -1), seg,
+                                       num_segments=num)[:-1]
+        flat_vals = vf.reshape(-1)
+        first_v = flat_vals[jnp.clip(first_idx, 0, s * n - 1)].reshape(s, w)
+        last_v = flat_vals[jnp.clip(last_idx, 0, s * n - 1)].reshape(s, w)
+        if agg_name == "first":
+            out = first_v
+        elif agg_name == "last":
+            out = last_v
+        else:
+            out = jnp.where(count_grid >= 2, last_v - first_v, 0.0)
+    elif agg_name == "median" or agg_name.startswith(("p", "ep")):
+        # Sort (segment, value) pairs so each window is a sorted contiguous run.
+        sort_v = jnp.where(ok, vf.reshape(-1), jnp.inf)
+        order = jnp.lexsort((sort_v, seg))
+        sorted_v = sort_v[order]
+        sorted_seg = seg[order]
+        seg_starts = jnp.searchsorted(sorted_seg, jnp.arange(s * w))
+        if agg_name == "median":
+            top = max(s * n - 1, 0)
+            idx = jnp.clip(seg_starts + counts // 2, 0, top)
+            out = jnp.where(counts > 0, sorted_v[idx], jnp.nan).reshape(s, w)
+        else:
+            q, est = parse_percentile_name(agg_name)
+            out = segment_percentile(sorted_v, seg_starts, counts, q,
+                                     est).reshape(s, w)
+    else:
+        raise KeyError("No such downsampling function: " + agg_name)
+
+    wts = window_timestamps(spec, wargs)
+
+    if fill_policy == FILL_NONE:
+        out = jnp.where(out_mask, out, jnp.nan)
+        return wts, out, out_mask
+    if fill_policy == FILL_ZERO:
+        fill = jnp.asarray(0.0, fdtype)
+    elif fill_policy in (FILL_NAN, FILL_NULL):
+        fill = jnp.asarray(jnp.nan, fdtype)
+    elif fill_policy == FILL_SCALAR:
+        fill = jnp.asarray(fill_value, fdtype)
+    else:
+        raise ValueError("Unrecognized fill policy: " + fill_policy)
+    out = jnp.where(out_mask, out, fill)
+    return wts, out, jnp.broadcast_to(live, out_mask.shape)
+
+
+def parse_percentile_name(name: str) -> tuple[float, str]:
+    """"p99" -> (99.0, legacy); "ep999r3" -> (99.9, r_3); "ep50r7" -> (50.0, r_7)."""
+    est = EST_LEGACY
+    digits = name
+    if name.startswith("ep"):
+        if name.endswith("r3"):
+            est = EST_R3
+        elif name.endswith("r7"):
+            est = EST_R7
+        else:
+            raise KeyError("No such aggregator: " + name)
+        digits = name[2:-2]
+    elif name.startswith("p"):
+        digits = name[1:]
+    if digits == "999":
+        return 99.9, est
+    q = float(digits)
+    if not 0 < q <= 100:
+        raise KeyError("Invalid percentile: " + name)
+    return q, est

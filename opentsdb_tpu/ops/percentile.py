@@ -1,0 +1,113 @@
+"""Sort-based percentile kernels (LEGACY / R-3 / R-7 estimation).
+
+Reference behavior: Aggregators.PercentileAgg
+(/root/reference/src/core/Aggregators.java:657-708) delegates to Apache
+commons-math3 `Percentile`.  Its default ("LEGACY") estimation uses
+pos = p*(n+1)/100 with linear interpolation between order statistics; the
+`ep*r3`/`ep*r7` variants use Hyndman-Fan types R-3 and R-7.
+
+The iterator-based reference gathers values into a resizable array per output
+timestamp; here whole [series, time] batches are sorted on the reduction axis
+once and order statistics gathered vectorially — the non-associative kernel
+flagged by SURVEY.md §7 hard part (b).  Cross-chip, the planner gathers each
+group to its owner shard before selection.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+EST_LEGACY = "legacy"
+EST_R3 = "r_3"
+EST_R7 = "r_7"
+
+
+def _gather_axis0(sorted_vals, idx):
+    """sorted_vals[idx[t], t] for 0-based idx[T] along axis 0."""
+    idx = jnp.clip(idx, 0, sorted_vals.shape[0] - 1)
+    return jnp.take_along_axis(sorted_vals, idx[None, :], axis=0)[0]
+
+
+def masked_percentile(values, mask, q: float, estimation: str = EST_LEGACY,
+                      axis: int = 0):
+    """Percentile q (0..100] of masked values along `axis` (axis 0 supported).
+
+    Masked-out slots are sorted to +inf so valid values occupy the first n
+    positions of each column; empty columns yield NaN.
+    """
+    if axis != 0:
+        raise ValueError("masked_percentile reduces axis 0")
+    n = mask.sum(axis=0)
+    sorted_vals = jnp.sort(jnp.where(mask, values, jnp.inf), axis=0)
+    nf = n.astype(jnp.float64)
+
+    if estimation == EST_LEGACY:
+        # commons-math3 Percentile default: pos = p*(n+1)/100 (1-based);
+        # pos < 1 -> min, pos >= n -> max, else lerp between floor/ceil stats.
+        pos = q * (nf + 1.0) / 100.0
+        fpos = jnp.floor(pos)
+        d = pos - fpos
+        k = fpos.astype(jnp.int64)  # 1-based lower index
+        lower = _gather_axis0(sorted_vals, k - 1)
+        upper = _gather_axis0(sorted_vals, k)
+        mid = lower + d * (upper - lower)
+        out = jnp.where(pos < 1.0, _gather_axis0(sorted_vals, jnp.zeros_like(k)),
+                        jnp.where(pos >= nf, _gather_axis0(sorted_vals, n - 1),
+                                  mid))
+    elif estimation == EST_R3:
+        # R-3: h = n*p/100; index = ceil(h - 0.5) (round half down), 1-based.
+        h = nf * q / 100.0
+        k = jnp.ceil(h - 0.5).astype(jnp.int64)
+        k = jnp.clip(k, 1, jnp.maximum(n, 1))
+        out = _gather_axis0(sorted_vals, k - 1)
+    elif estimation == EST_R7:
+        # R-7: h = (n-1)*p/100 + 1; lerp between floor(h) and floor(h)+1.
+        h = (nf - 1.0) * q / 100.0 + 1.0
+        fh = jnp.floor(h)
+        k = fh.astype(jnp.int64)
+        lower = _gather_axis0(sorted_vals, k - 1)
+        upper = _gather_axis0(sorted_vals, jnp.minimum(k, n - 1))
+        out = lower + (h - fh) * (upper - lower)
+    else:
+        raise ValueError("Unknown estimation type: " + estimation)
+
+    return jnp.where(n > 0, out, jnp.nan)
+
+
+def segment_percentile(sorted_values, seg_starts, seg_counts, q: float,
+                       estimation: str = EST_LEGACY):
+    """Percentile per segment of a flat array pre-sorted within segments.
+
+    `sorted_values[f]` holds all window values, each window's run sorted
+    ascending; window w occupies [seg_starts[w], seg_starts[w]+seg_counts[w]).
+    Used by the downsample percentile path where windows are contiguous runs.
+    """
+    n = seg_counts
+    nf = n.astype(jnp.float64)
+    top = jnp.maximum(len(sorted_values) - 1, 0)
+
+    def at(one_based_idx):
+        idx = seg_starts + jnp.clip(one_based_idx - 1, 0, jnp.maximum(n - 1, 0))
+        return sorted_values[jnp.clip(idx, 0, top)]
+
+    if estimation == EST_LEGACY:
+        pos = q * (nf + 1.0) / 100.0
+        fpos = jnp.floor(pos)
+        d = pos - fpos
+        k = fpos.astype(jnp.int64)
+        mid = at(k) + d * (at(k + 1) - at(k))
+        out = jnp.where(pos < 1.0, at(jnp.ones_like(k)),
+                        jnp.where(pos >= nf, at(n), mid))
+    elif estimation == EST_R3:
+        h = nf * q / 100.0
+        k = jnp.clip(jnp.ceil(h - 0.5).astype(jnp.int64), 1, jnp.maximum(n, 1))
+        out = at(k)
+    elif estimation == EST_R7:
+        h = (nf - 1.0) * q / 100.0 + 1.0
+        fh = jnp.floor(h)
+        k = fh.astype(jnp.int64)
+        out = at(k) + (h - fh) * (at(k + 1) - at(k))
+    else:
+        raise ValueError("Unknown estimation type: " + estimation)
+
+    return jnp.where(n > 0, out, jnp.nan)

@@ -1,0 +1,103 @@
+"""Fused query pipeline: downsample -> rate -> cross-series aggregation.
+
+Composes the kernels in the reference's iterator-chain order
+(AggregationIterator.create :253-380 wires Span -> Downsampler -> RateSpan ->
+merge) as one jit-compiled function per static pipeline spec.  XLA fuses the
+stages.  Compile churn is bounded: batch shapes and window counts pad to
+powers of two, and time-range-dependent values (window origin, calendar
+edges) are traced operands, so repeated dashboard queries hit the jit cache.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opentsdb_tpu.ops.aggregators import get_agg, Aggregator, PREV
+from opentsdb_tpu.ops.downsample import (
+    downsample, WindowSpec, FixedWindows, EdgeWindows, AllWindow,
+    window_timestamps, pad_pow2, FILL_NONE)
+from opentsdb_tpu.ops.rate import rate, RateOptions
+from opentsdb_tpu.ops.union_agg import union_aggregate, grid_aggregate
+
+PAD_TS = np.iinfo(np.int64).max
+
+
+@dataclass(frozen=True)
+class DownsampleStep:
+    """Static downsample config; traced window args travel separately."""
+    function: str
+    window_spec: WindowSpec
+    fill_policy: str = FILL_NONE
+    fill_value: float = 0.0
+
+
+@dataclass(frozen=True)
+class PipelineSpec:
+    """Static (hashable) description of one group's numeric pipeline."""
+    aggregator: str
+    downsample: DownsampleStep | None = None
+    rate: RateOptions | None = None
+    int_mode: bool = False  # Java long arithmetic end-to-end
+
+
+def _pipeline(spec: PipelineSpec, ts, val, mask, wargs):
+    agg = get_agg(spec.aggregator)
+    if spec.rate is not None:
+        # Rates never LERP across series: a missing rate contributes the
+        # previous rate value (AggregationIterator.java:744-752).
+        agg = Aggregator(agg.name, PREV, agg.reduce)
+    if spec.downsample is not None:
+        step = spec.downsample
+        wts, v, m = downsample(ts, val, mask, step.function, step.window_spec,
+                               wargs, step.fill_policy, step.fill_value)
+        grid = jnp.asarray(wts)
+        if spec.rate is not None:
+            grid_b = jnp.broadcast_to(grid[None, :], v.shape)
+            _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
+        return grid_aggregate(grid, v, m, agg, int_mode=False)
+    if spec.rate is not None:
+        work_ts, work_val, work_mask = rate(ts, val, mask, spec.rate,
+                                            all_int=spec.int_mode)
+        return union_aggregate(work_ts, work_val, work_mask, agg,
+                               int_mode=False)
+    return union_aggregate(ts, val, mask, agg, int_mode=spec.int_mode)
+
+
+_jitted = jax.jit(_pipeline, static_argnums=0)
+
+
+def run_pipeline(spec: PipelineSpec, ts, val, mask, wargs: dict | None = None):
+    """Execute the pipeline; returns (out_ts, out_val, out_mask) on device."""
+    return _jitted(spec, ts, val, mask, wargs or {})
+
+
+def build_batch(windows: list, pad_to_pow2: bool = True):
+    """Pack per-series (ts, fval, ival, is_int) windows into padded arrays.
+
+    Returns (ts[S, N], val[S, N], mask[S, N], all_int).  When every series is
+    integer-typed, `val` is an exact int64 array (Java-long-exact above 2^53);
+    otherwise float64.  Padding timestamps are int64 max so rows stay sorted;
+    shapes pad to powers of two to bound jit recompiles (SURVEY.md §7 (c)).
+    """
+    s = len(windows)
+    n_max = max((len(w[0]) for w in windows), default=0)
+    n = pad_pow2(max(n_max, 1)) if pad_to_pow2 else max(n_max, 1)
+    all_int = s > 0
+    for w in windows:
+        isint = w[3]
+        if len(w[0]) and not bool(np.all(isint)):
+            all_int = False
+            break
+    ts = np.full((s, n), PAD_TS, dtype=np.int64)
+    mask = np.zeros((s, n), dtype=bool)
+    val = np.zeros((s, n), dtype=np.int64 if all_int else np.float64)
+    for i, (t, fv, iv, isint) in enumerate(windows):
+        k = len(t)
+        ts[i, :k] = t
+        val[i, :k] = iv if all_int else fv
+        mask[i, :k] = True
+    return ts, val, mask, all_int

@@ -1,0 +1,98 @@
+"""Rate-of-change kernels with counter rollover handling.
+
+Reference behavior: /root/reference/src/core/RateSpan.java (populateNextRate
+:121 — per-second dv/dt between adjacent points, long arithmetic when both
+values are integers, counter rollover diff = counter_max - prev + next,
+reset_value spike suppression -> 0, drop_resets skips negative diffs) and
+RateOptions.java (:27).  Rates are emitted at the timestamp of the latter
+point; the first point of a span yields no output, matching how
+AggregationIterator consumes the synthetic time-zero rate as interpolation
+state only (AggregationIterator.java:448-459).
+
+Vectorized form: for each row of a [S, N] sorted batch, the "previous valid
+point" is found with a prefix-max scan over masked positions, so gaps from
+FILL_NONE downsampling are skipped exactly like the iterator would.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+LONG_MAX = 2**63 - 1
+
+
+@dataclass(frozen=True)
+class RateOptions:
+    """Counter options (RateOptions.java:27-62).
+
+    Parsing of the "rate{counter[,max[,reset]]}" URI form lives in
+    models.tsquery.parse_rate_options.
+    """
+    counter: bool = False
+    counter_max: int = LONG_MAX
+    reset_value: int = 0
+    drop_resets: bool = False
+
+
+def _prev_valid_index(mask):
+    """prev[k] = largest j < k with mask[j], else -1; per row, via cummax."""
+    s, n = mask.shape
+    pos = jnp.where(mask, jnp.arange(n, dtype=jnp.int64)[None, :], -1)
+    running = lax.associative_scan(jnp.maximum, pos, axis=1)
+    prev = jnp.concatenate(
+        [jnp.full((s, 1), -1, dtype=jnp.int64), running[:, :-1]], axis=1)
+    return prev
+
+
+def rate(ts, val, mask, options: RateOptions, all_int: bool = False):
+    """Compute rates over a [S, N] sorted batch.
+
+    Returns (ts, rate_values[S, N] float, mask[S, N]): slot k holds the rate
+    between point k and its previous valid point, masked off for first points
+    (and dropped resets).  Timestamps are unchanged (rate sits at the latter
+    point's timestamp).
+    """
+    s, n = ts.shape
+    prev = _prev_valid_index(mask)
+    has_prev = prev >= 0
+    safe_prev = jnp.clip(prev, 0, n - 1)
+    prev_ts = jnp.take_along_axis(ts, safe_prev, axis=1)
+    prev_val = jnp.take_along_axis(val, safe_prev, axis=1)
+
+    dt_sec = (ts - prev_ts).astype(jnp.float64) / 1000.0
+    dt_sec = jnp.where(dt_sec == 0, jnp.inf, dt_sec)
+
+    if all_int:
+        # Long-typed difference first, then divide — avoids double rounding
+        # of large longs (RateSpan.java:140-147).
+        diff = (val.astype(jnp.int64) - prev_val.astype(jnp.int64)).astype(
+            jnp.float64)
+        rolled = (jnp.asarray(options.counter_max, jnp.int64)
+                  - prev_val.astype(jnp.int64)
+                  + val.astype(jnp.int64)).astype(jnp.float64)
+    else:
+        diff = val.astype(jnp.float64) - prev_val.astype(jnp.float64)
+        rolled = (jnp.asarray(options.counter_max, jnp.float64)
+                  - prev_val.astype(jnp.float64) + val.astype(jnp.float64))
+
+    out_mask = mask & has_prev
+    if options.counter:
+        negative = diff < 0
+        if options.drop_resets:
+            out = diff / dt_sec
+            out_mask = out_mask & ~negative
+        else:
+            roll_rate = rolled / dt_sec
+            suppressed = (options.reset_value > 0) & (
+                roll_rate > options.reset_value)
+            out = jnp.where(negative,
+                            jnp.where(suppressed, 0.0, roll_rate),
+                            diff / dt_sec)
+    else:
+        out = diff / dt_sec
+
+    out = jnp.where(out_mask, out, jnp.nan)
+    return ts, out, out_mask

@@ -1,0 +1,310 @@
+"""Tag-value filters with the reference's dynamic registry and URI grammar.
+
+Reference behavior: /root/reference/src/query/filter/TagVFilter.java (:70 —
+abstract filter + registry :75-104, getFilter :199, mapToFilters/tagsToFilters
+:306-360, stripParentheses :226) and the concrete filters:
+TagVLiteralOrFilter (pipe-separated exact values, i-variant case-insensitive),
+TagVNotLiteralOrFilter, TagVRegexFilter (java regex, full match),
+TagVWildcardFilter ('*' glob, i-variant), TagVNotKeyFilter (series must lack
+the tag key).  Filters marked group_by split results per tag value.
+
+These run host-side against resolved tag value strings — the role the
+reference's post-scan filter pass played (SaltScanner.java:700-740);
+literal filters are additionally compiled to UID sets by the planner so
+the hot path can prune series without string resolution.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+
+class TagVFilter:
+    """Base tag-value filter."""
+
+    TYPE = "base"
+    POST_SCAN = True
+
+    def __init__(self, tagk: str, filter_str: str):
+        if not tagk:
+            raise ValueError("Tagk cannot be null or empty")
+        if filter_str is None or filter_str == "":
+            raise ValueError("Filter cannot be null or empty")
+        self.tagk = tagk
+        self.filter = filter_str
+        self.group_by = False
+
+    @property
+    def type(self) -> str:
+        return self.TYPE
+
+    def match(self, tags: dict[str, str]) -> bool:
+        """Whether a series' resolved {tagk: tagv} map passes this filter."""
+        raise NotImplementedError
+
+    def literal_values(self) -> set[str] | None:
+        """The exact tag values this filter accepts, when enumerable."""
+        return None
+
+    def spec_string(self) -> str:
+        return "%s(%s)" % (self.type, self.filter)
+
+    def to_json(self) -> dict:
+        return {
+            "tagk": self.tagk,
+            "filter": self.filter,
+            "type": self.type,
+            "group_by": self.group_by,
+        }
+
+    def __repr__(self) -> str:
+        return "%s(%s=%s,group_by=%s)" % (
+            type(self).__name__, self.tagk, self.filter, self.group_by)
+
+
+class TagVLiteralOrFilter(TagVFilter):
+    """literal_or: case-sensitive pipe-separated exact values."""
+
+    TYPE = "literal_or"
+    CASE_INSENSITIVE = False
+
+    def __init__(self, tagk: str, filter_str: str):
+        super().__init__(tagk, filter_str)
+        values = [v for v in filter_str.split("|") if v]
+        if not values:
+            raise ValueError("No values in literal filter: " + filter_str)
+        if self.CASE_INSENSITIVE:
+            self._values = {v.lower() for v in values}
+        else:
+            self._values = set(values)
+
+    def match(self, tags: dict[str, str]) -> bool:
+        value = tags.get(self.tagk)
+        if value is None:
+            return False
+        return (value.lower() if self.CASE_INSENSITIVE else value) in self._values
+
+    def literal_values(self) -> set[str] | None:
+        return None if self.CASE_INSENSITIVE else set(self._values)
+
+
+class TagVILiteralOrFilter(TagVLiteralOrFilter):
+    TYPE = "iliteral_or"
+    CASE_INSENSITIVE = True
+
+
+class TagVNotLiteralOrFilter(TagVLiteralOrFilter):
+    """not_literal_or: excludes listed values; series WITHOUT the tag key
+    pass (TagVNotLiteralOrFilter.java:80-83)."""
+
+    TYPE = "not_literal_or"
+
+    def match(self, tags: dict[str, str]) -> bool:
+        value = tags.get(self.tagk)
+        if value is None:
+            return True
+        return (value.lower() if self.CASE_INSENSITIVE
+                else value) not in self._values
+
+    def literal_values(self) -> set[str] | None:
+        return None
+
+
+class TagVNotILiteralOrFilter(TagVNotLiteralOrFilter):
+    TYPE = "not_iliteral_or"
+    CASE_INSENSITIVE = True
+
+
+class TagVRegexFilter(TagVFilter):
+    """regexp: full-match java-style regex (TagVRegexFilter)."""
+
+    TYPE = "regexp"
+
+    def __init__(self, tagk: str, filter_str: str):
+        super().__init__(tagk, filter_str)
+        try:
+            self._pattern = re.compile(filter_str)
+        except re.error as e:
+            raise ValueError("Invalid regular expression: %s (%s)"
+                             % (filter_str, e))
+
+    def match(self, tags: dict[str, str]) -> bool:
+        value = tags.get(self.tagk)
+        if value is None:
+            return False
+        return self._pattern.fullmatch(value) is not None
+
+
+class TagVWildcardFilter(TagVFilter):
+    """wildcard: '*' glob; matches_all when the filter is just '*'."""
+
+    TYPE = "wildcard"
+    CASE_INSENSITIVE = False
+
+    def __init__(self, tagk: str, filter_str: str):
+        super().__init__(tagk, filter_str)
+        if "*" not in filter_str:
+            raise ValueError(
+                "Filter must contain an asterisk: " + filter_str)
+        actual = filter_str.lower() if self.CASE_INSENSITIVE else filter_str
+        self.matches_all = set(actual) == {"*"}
+        components = [c for c in actual.split("*")]
+        pattern = ".*".join(re.escape(c) for c in components)
+        self._pattern = re.compile("^" + pattern + "$")
+
+    def match(self, tags: dict[str, str]) -> bool:
+        value = tags.get(self.tagk)
+        if value is None:
+            return False
+        if self.matches_all:
+            return True
+        if self.CASE_INSENSITIVE:
+            value = value.lower()
+        return self._pattern.match(value) is not None
+
+
+class TagVIWildcardFilter(TagVWildcardFilter):
+    TYPE = "iwildcard"
+    CASE_INSENSITIVE = True
+
+
+class TagVNotKeyFilter(TagVFilter):
+    """not_key: matches series that do NOT carry the tag key at all."""
+
+    TYPE = "not_key"
+
+    def __init__(self, tagk: str, filter_str: str):
+        # The reference requires an empty filter value (TagVNotKeyFilter).
+        if filter_str and filter_str != " ":
+            raise ValueError(
+                "The filter value must be null or empty for not_key")
+        if not tagk:
+            raise ValueError("Tagk cannot be null or empty")
+        self.tagk = tagk
+        self.filter = ""
+        self.group_by = False
+
+    def match(self, tags: dict[str, str]) -> bool:
+        return self.tagk not in tags
+
+
+FILTER_TYPES: dict[str, type[TagVFilter]] = {
+    cls.TYPE: cls for cls in (
+        TagVLiteralOrFilter, TagVILiteralOrFilter, TagVNotLiteralOrFilter,
+        TagVNotILiteralOrFilter, TagVRegexFilter, TagVWildcardFilter,
+        TagVIWildcardFilter, TagVNotKeyFilter)
+}
+
+
+def build_filter(tagk: str, type_name: str, filter_str: str,
+                 group_by: bool = False) -> TagVFilter:
+    cls = FILTER_TYPES.get(type_name)
+    if cls is None:
+        raise ValueError("Could not find a filter of type: " + type_name)
+    out = cls(tagk, filter_str)
+    out.group_by = group_by
+    return out
+
+
+def strip_parentheses(filter_str: str) -> str:
+    """"regexp(foo.*)" -> "foo.*" (TagVFilter.stripParentheses :226)."""
+    if not filter_str:
+        raise ValueError("Filter string cannot be null or empty")
+    if not filter_str.endswith(")"):
+        raise ValueError("Filter must end with a ')': " + filter_str)
+    start = filter_str.find("(")
+    if start < 0:
+        raise ValueError("Filter must include a '(': " + filter_str)
+    return filter_str[start + 1:-1]
+
+
+def get_filter(tagk: str, filter_str: str) -> TagVFilter | None:
+    """URI value -> filter; None means plain literal/group-by marker
+    (TagVFilter.getFilter :199)."""
+    if not tagk:
+        raise ValueError("Tagk cannot be null or empty")
+    if not filter_str:
+        raise ValueError("Filter cannot be null or empty")
+    if filter_str == "*":
+        return None  # group-by-all marker
+    paren = filter_str.find("(")
+    if paren > -1:
+        prefix = filter_str[:paren].lower()
+        return build_filter(tagk, prefix, strip_parentheses(filter_str))
+    if "*" in filter_str:
+        return TagVWildcardFilter(tagk, filter_str)
+    return None  # plain literal
+
+
+def tags_to_filters(tag_map: dict[str, str],
+                    filters: list[TagVFilter]) -> None:
+    """First-brace group ({tag=value}): create group_by filters
+    (TagVFilter.tagsToFilters :306)."""
+    _map_to_filters(tag_map, filters, group_by=True)
+
+
+def map_to_filters(tag_map: dict[str, str], filters: list[TagVFilter],
+                   group_by: bool = False) -> None:
+    """Second-brace group: non-grouping filters (TagVFilter.mapToFilters :318)."""
+    _map_to_filters(tag_map, filters, group_by=group_by)
+
+
+def _map_to_filters(tag_map: dict[str, str], filters: list[TagVFilter],
+                    group_by: bool) -> None:
+    for tagk, value in tag_map.items():
+        parsed = get_filter(tagk, value)
+        if parsed is None:
+            if value == "*":
+                parsed = TagVWildcardFilter(tagk, "*")
+            else:
+                parsed = TagVLiteralOrFilter(tagk, value)
+        parsed.group_by = group_by
+        filters.append(parsed)
+
+
+def _parse_tag(tag_map: dict[str, str], tag: str) -> None:
+    """"k=v" -> map entry (Tags.parse)."""
+    if "=" not in tag:
+        raise ValueError("invalid tag: " + tag)
+    key, _, value = tag.partition("=")
+    if not key or not value:
+        raise ValueError("invalid tag: " + tag)
+    if key in tag_map and tag_map[key] != value:
+        raise ValueError("duplicate tag: %s, tags=%s" % (tag, tag_map))
+    tag_map[key] = value
+
+
+def parse_metric_with_filters(metric: str,
+                              filters: list[TagVFilter]) -> str:
+    """"metric{groupby}{filters}" -> metric name, filters filled
+    (Tags.parseWithMetricAndFilters :220)."""
+    if not metric:
+        raise ValueError("Metric cannot be null or empty")
+    if filters is None:
+        raise ValueError("Filters cannot be null")
+    curly = metric.find("{")
+    if curly < 0:
+        return metric
+    if not metric.endswith("}"):
+        raise ValueError("Missing '}' at the end of: " + metric)
+    if curly == len(metric) - 2:  # "foo{}"
+        return metric[:-2]
+    close = metric.find("}")
+    # Optional second brace group: non-grouping filters.
+    if close != len(metric) - 1:
+        filter_bracket = metric.rfind("{")
+        for part in metric[filter_bracket + 1:-1].split(","):
+            if not part:
+                break
+            tag_map: dict[str, str] = {}
+            _parse_tag(tag_map, part)
+            map_to_filters(tag_map, filters, group_by=False)
+    # First brace group: group-by filters.
+    for tag in metric[curly + 1:close].split(","):
+        if not tag and close != len(metric) - 1:
+            break
+        tag_map = {}
+        _parse_tag(tag_map, tag)
+        tags_to_filters(tag_map, filters)
+    return metric[:curly]

@@ -1,0 +1,241 @@
+"""Query planner/executor: TSQuery -> series selection -> TPU kernels -> results.
+
+Reference behavior: /root/reference/src/core/TsdbQuery.java — UID resolution
+(configureFromQuery :490), tag-filter evaluation + group-by discovery
+(findGroupBys :675, GroupByAndAggregateCB :981-1114), span windowing, and the
+SpanGroup tag intersection rules (SpanGroup.computeTags :348: keys with one
+distinct value stay `tags`, conflicting keys become `aggregateTags`).
+
+The per-datapoint iterator merge is replaced by ops.pipeline: each group-by
+bucket becomes one padded [series, time] batch pushed through jit-compiled
+downsample/rate/union kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+from opentsdb_tpu.ops.downsample import FixedWindows, EdgeWindows, AllWindow
+from opentsdb_tpu.ops.pipeline import (
+    PipelineSpec, DownsampleStep, run_pipeline, build_batch)
+from opentsdb_tpu.storage.memstore import Series, SeriesKey
+from opentsdb_tpu.utils import datetime_util as DT
+
+
+@dataclass
+class QueryResult:
+    """One output object of /api/query (HttpJsonSerializer.java:742-815)."""
+    metric: str
+    tags: dict[str, str]
+    aggregate_tags: list[str]
+    tsuids: list[str]
+    dps: list[tuple[int, object]]  # (ts_ms, value) value int or float or NaN
+    annotations: list = field(default_factory=list)
+    global_annotations: list = field(default_factory=list)
+    index: int = 0
+
+    def to_json(self, ms_resolution: bool = False, show_tsuids: bool = False,
+                fill_policy: str = "none", show_query: bool = False,
+                sub_query: TSSubQuery | None = None,
+                no_annotations: bool = False,
+                global_annotations: bool = False) -> dict:
+        dps = {}
+        for ts_ms, value in self.dps:
+            key = str(ts_ms if ms_resolution else ts_ms // 1000)
+            if isinstance(value, float) and value != value:  # NaN
+                dps[key] = None if fill_policy == "null" else float("nan")
+            else:
+                dps[key] = value
+        out = {
+            "metric": self.metric,
+            "tags": self.tags,
+            "aggregateTags": self.aggregate_tags,
+        }
+        if show_query and sub_query is not None:
+            out["query"] = sub_query.to_json()
+        if show_tsuids:
+            out["tsuids"] = sorted(self.tsuids)
+        if not no_annotations and self.annotations:
+            out["annotations"] = [a.to_json() for a in self.annotations]
+        if global_annotations and self.global_annotations:
+            out["globalAnnotations"] = [a.to_json()
+                                        for a in self.global_annotations]
+        out["dps"] = dps
+        return out
+
+
+class QueryRunner:
+    """Executes TSQueries against a TSDB."""
+
+    def __init__(self, tsdb):
+        self.tsdb = tsdb
+
+    # -- series selection ------------------------------------------------
+
+    def _resolve_series(self, sub: TSSubQuery) -> list[tuple[Series, dict]]:
+        """All series matching the sub query, with resolved tag maps."""
+        tsdb = self.tsdb
+        if sub.tsuids:
+            wanted = {t.upper() for t in sub.tsuids}
+            out = []
+            for series in tsdb.store.all_series():
+                if tsdb.tsuid(series.key) in wanted:
+                    out.append((series, tsdb.resolve_key_tags(series.key)))
+            return out
+
+        metric_uid = tsdb.metrics.get_id(sub.metric)
+        candidates = tsdb.store.series_for_metric(metric_uid)
+        out = []
+        filter_tagks = {f.tagk for f in sub.filters}
+        for series in candidates:
+            tags = tsdb.resolve_key_tags(series.key)
+            if sub.explicit_tags and set(tags) != filter_tagks:
+                continue
+            if all(f.match(tags) for f in sub.filters):
+                out.append((series, tags))
+        return out
+
+    @staticmethod
+    def _group(series_tags: list[tuple[Series, dict]], sub: TSSubQuery):
+        """Group-by bucketing (TsdbQuery.GroupByAndAggregateCB :981)."""
+        group_tagks = sub.group_by_tags()
+        if sub.aggregator == "none":
+            # NONE: no aggregation, each series is its own group.
+            return {("__series__", i): [st]
+                    for i, st in enumerate(series_tags)}
+        if not group_tagks:
+            return {(): series_tags} if series_tags else {}
+        groups: dict[tuple, list] = {}
+        for series, tags in series_tags:
+            key_vals = tuple(tags.get(k) for k in group_tagks)
+            if any(v is None for v in key_vals):
+                continue  # series lacks a group-by tag -> excluded
+            groups.setdefault(key_vals, []).append((series, tags))
+        return groups
+
+    @staticmethod
+    def _compute_tags(members: list[tuple[Series, dict]]):
+        """SpanGroup.computeTags (:348): single-valued keys -> tags,
+        conflicting keys -> aggregateTags."""
+        tag_set: dict[str, str] = {}
+        discards: set[str] = set()
+        for _, tags in members:
+            for k, v in tags.items():
+                if k in discards:
+                    continue
+                if k not in tag_set:
+                    tag_set[k] = v
+                elif tag_set[k] != v:
+                    discards.add(k)
+                    tag_set.pop(k)
+        return tag_set, sorted(discards)
+
+    # -- execution -------------------------------------------------------
+
+    def _windows_for(self, sub: TSSubQuery, query: TSQuery):
+        spec = sub.downsample_spec
+        if spec is None:
+            return None
+        if spec.run_all:
+            return AllWindow(query.start_time, query.end_time)
+        if spec.use_calendar:
+            edges = DT.calendar_window_edges(
+                query.start_time, query.end_time, spec.calendar_interval,
+                spec.calendar_unit, spec.timezone)
+            return EdgeWindows(tuple(edges))
+        return FixedWindows.for_range(query.start_time, query.end_time,
+                                      spec.interval_ms)
+
+    def run_sub(self, query: TSQuery, sub: TSSubQuery) -> list[QueryResult]:
+        tsdb = self.tsdb
+        series_tags = self._resolve_series(sub)
+        groups = self._group(series_tags, sub)
+        windows = self._windows_for(sub, query)
+
+        if windows is not None:
+            window_spec, wargs = windows.split()
+        else:
+            window_spec, wargs = None, None
+
+        results = []
+        for group_key in sorted(groups, key=lambda k: tuple(map(str, k))):
+            members = groups[group_key]
+            batch_windows = [
+                s.window(query.start_time, query.end_time,
+                         tsdb.config.fix_duplicates)
+                for s, _ in members]
+            ts, val, mask, all_int = build_batch(batch_windows)
+            int_mode = all_int and sub.downsample_spec is None
+            spec = PipelineSpec(
+                aggregator=sub.aggregator,
+                downsample=(DownsampleStep(
+                    sub.downsample_spec.function, window_spec,
+                    sub.downsample_spec.fill_policy,
+                    sub.downsample_spec.fill_value)
+                    if sub.downsample_spec is not None else None),
+                rate=sub.rate_options if sub.rate else None,
+                int_mode=int_mode)
+            out_ts, out_val, out_mask = run_pipeline(spec, ts, val, mask,
+                                                     wargs)
+
+            dps = extract_dps(np.asarray(out_ts), np.asarray(out_val),
+                              np.asarray(out_mask), query.start_time,
+                              query.end_time,
+                              int_mode and not sub.rate,
+                              keep_nans=sub.fill_policy != "none")
+
+            group_tags, agg_tags = self._compute_tags(members)
+            tsuids = [tsdb.tsuid(s.key) for s, _ in members]
+            annotations = []
+            if not query.no_annotations:
+                for t in tsuids:
+                    annotations.extend(tsdb.store.get_annotations(
+                        t, query.start_time, query.end_time))
+            global_notes = (tsdb.store.get_annotations(
+                "", query.start_time, query.end_time)
+                if query.global_annotations else [])
+            results.append(QueryResult(
+                metric=sub.metric or (
+                    tsdb.metrics.get_name(members[0][0].key.metric)
+                    if members else ""),
+                tags=group_tags,
+                aggregate_tags=agg_tags,
+                tsuids=tsuids,
+                dps=dps,
+                annotations=annotations,
+                global_annotations=global_notes,
+                index=sub.index,
+            ))
+        return results
+
+    def run(self, query: TSQuery) -> list[QueryResult]:
+        out = []
+        for sub in query.queries:
+            out.extend(self.run_sub(query, sub))
+        return out
+
+
+def extract_dps(out_ts: np.ndarray, out_val: np.ndarray, out_mask: np.ndarray,
+                start_ms: int, end_ms: int, int_mode: bool,
+                keep_nans: bool = False) -> list[tuple[int, object]]:
+    """Device output -> (ts_ms, python value) pairs trimmed to the query range.
+
+    The serializer-level trim mirrors HttpJsonSerializer (:848-852): points
+    outside [start, end] are dropped.  NaNs survive only under fill policies
+    that emit them.
+    """
+    ts = out_ts.ravel()
+    val = out_val.ravel()
+    mask = out_mask.ravel()
+    keep = mask & (ts >= start_ms) & (ts <= end_ms)
+    if not keep_nans:
+        with np.errstate(invalid="ignore"):
+            keep = keep & ~np.isnan(val.astype(np.float64))
+    ts = ts[keep]
+    val = val[keep]
+    if int_mode and not np.issubdtype(val.dtype, np.floating):
+        return [(int(t), int(v)) for t, v in zip(ts, val)]
+    return [(int(t), float(v)) for t, v in zip(ts, val)]

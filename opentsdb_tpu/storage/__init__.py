@@ -1,0 +1,8 @@
+from opentsdb_tpu.storage.memstore import (
+    MemStore,
+    Series,
+    SeriesKey,
+    CompactionQueue,
+)
+
+__all__ = ["MemStore", "Series", "SeriesKey", "CompactionQueue"]

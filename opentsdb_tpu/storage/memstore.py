@@ -1,0 +1,418 @@
+"""Columnar, chunk-aligned in-memory series store — the storage engine.
+
+This plays the role HBase + the row-key/qualifier codec played for the
+reference (schema contract: SURVEY.md §2.6; RowSeq/Span assembly:
+/root/reference/src/core/RowSeq.java, Span.java).  Design differences are
+deliberate and TPU-first:
+
+  * Series are identified by (metric_uid, sorted (tagk,tagv) uid pairs) —
+    the same logical row-key identity, without byte-encoded rows.
+  * Data is columnar per series: int64 ms timestamps, float64 values and an
+    int-ness bitmask in growable numpy buffers, so query assembly is a zero-
+    copy slice + pad into device batches instead of per-cell decoding.
+  * Out-of-order and duplicate points are normalized lazily at read time
+    (sort + last-write-wins dedup), the job CompactionQueue.java (:340) and
+    AppendDataPoints.java did at the storage layer.
+  * A salt-equivalent shard id (hash of the series key, RowKey.java:141) is
+    precomputed per series for mesh sharding.
+
+Annotations (qualifier prefix 0x01, src/meta/Annotation.java:86) are stored
+side-band per series key, collected during query assembly exactly like
+SaltScanner collects them per row (SaltScanner.java:425-448).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Sequence
+
+import numpy as np
+
+MAX_NUM_TAGS = 8        # Const.java:28
+CHUNK_SPAN_MS = 3_600_000  # Const.java:95 — 3600s row span, kept for layout
+
+
+@dataclass(frozen=True)
+class SeriesKey:
+    """Logical identity of one time series: metric UID + sorted tag UID pairs."""
+    metric: int
+    tags: tuple[tuple[int, int], ...]  # sorted (tagk_uid, tagv_uid)
+
+    @staticmethod
+    def make(metric: int, tags: dict[int, int]) -> "SeriesKey":
+        return SeriesKey(metric, tuple(sorted(tags.items())))
+
+    def tsuid(self, metric_width: int = 3, tagk_width: int = 3,
+              tagv_width: int = 3) -> str:
+        """Hex TSUID: metric + tagk/tagv pairs (UniqueId.getTSUIDFromKey)."""
+        out = [self.metric.to_bytes(metric_width, "big").hex()]
+        for k, v in self.tags:
+            out.append(k.to_bytes(tagk_width, "big").hex())
+            out.append(v.to_bytes(tagv_width, "big").hex())
+        return "".join(out).upper()
+
+    def salt(self, buckets: int = 20) -> int:
+        """Deterministic shard id, the salt-bucket equivalent (RowKey.java:141)."""
+        h = zlib.crc32(repr((self.metric, self.tags)).encode())
+        return h % buckets
+
+
+class Series:
+    """One series' columnar data: growable timestamp/value/int-ness arrays.
+
+    Values live in parallel float64 + int64 buffers: the int64 side keeps
+    Java-long exactness above 2^53 for integer points (the reference stores
+    VLE-encoded longs, Internal.vleEncodeLong :963); the float side feeds the
+    TPU float pipeline without a per-query cast.
+    """
+
+    __slots__ = ("key", "_ts", "_val", "_ival", "_isint", "_n", "_sorted",
+                 "_lock", "shard")
+
+    INITIAL_CAPACITY = 64
+
+    def __init__(self, key: SeriesKey, shard: int = 0):
+        self.key = key
+        self.shard = shard
+        self._ts = np.empty(self.INITIAL_CAPACITY, dtype=np.int64)
+        self._val = np.empty(self.INITIAL_CAPACITY, dtype=np.float64)
+        self._ival = np.zeros(self.INITIAL_CAPACITY, dtype=np.int64)
+        self._isint = np.empty(self.INITIAL_CAPACITY, dtype=bool)
+        self._n = 0
+        self._sorted = True
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def dirty(self) -> bool:
+        return not self._sorted
+
+    def _grow(self, need: int) -> None:
+        new_cap = max(need, len(self._ts) * 2, self.INITIAL_CAPACITY)
+        self._ts = np.resize(self._ts, new_cap)
+        self._val = np.resize(self._val, new_cap)
+        self._ival = np.resize(self._ival, new_cap)
+        self._isint = np.resize(self._isint, new_cap)
+
+    def append(self, ts_ms: int, value, is_int: bool) -> None:
+        with self._lock:
+            if self._n == len(self._ts):
+                self._grow(self._n + 1)
+            if self._sorted and self._n and ts_ms <= self._ts[self._n - 1]:
+                self._sorted = False
+            self._ts[self._n] = ts_ms
+            self._val[self._n] = float(value)
+            self._ival[self._n] = int(value) if is_int else 0
+            self._isint[self._n] = is_int
+            self._n += 1
+
+    def append_batch(self, ts_ms: np.ndarray, values: np.ndarray,
+                     is_int: np.ndarray | bool) -> None:
+        """Bulk ingest (TextImporter-style); arrays must be 1-D, same length."""
+        m = len(ts_ms)
+        if m == 0:
+            return
+        with self._lock:
+            need = self._n + m
+            if need > len(self._ts):
+                self._grow(need)
+            self._ts[self._n:need] = ts_ms
+            self._val[self._n:need] = values
+            if np.issubdtype(np.asarray(values).dtype, np.integer):
+                self._ival[self._n:need] = values
+            else:
+                self._ival[self._n:need] = 0
+            if np.isscalar(is_int) or isinstance(is_int, bool):
+                self._isint[self._n:need] = bool(is_int)
+            else:
+                self._isint[self._n:need] = is_int
+            incoming_sorted = bool(m == 1 or bool(np.all(np.diff(ts_ms) > 0)))
+            if self._sorted and (not incoming_sorted or
+                                 (self._n and ts_ms[0] <= self._ts[self._n - 1])):
+                self._sorted = False
+            self._n = need
+
+    def normalize(self, fix_duplicates: bool = True) -> None:
+        """Sort by timestamp, resolving duplicates last-write-wins.
+
+        The read-time equivalent of compaction's heap-merge + dedup
+        (CompactionQueue.java:499 mergeDatapoints, policy
+        tsd.storage.fix_duplicates).  With fix_duplicates False, duplicate
+        timestamps raise like the reference's IllegalDataException.
+        """
+        with self._lock:
+            if self._sorted:
+                self._dedup_sorted(fix_duplicates)
+                return
+            n = self._n
+            # stable sort keeps insertion order within equal timestamps, so the
+            # last write for a timestamp is the last element of its run.
+            order = np.argsort(self._ts[:n], kind="stable")
+            self._ts[:n] = self._ts[:n][order]
+            self._val[:n] = self._val[:n][order]
+            self._ival[:n] = self._ival[:n][order]
+            self._isint[:n] = self._isint[:n][order]
+            self._sorted = True
+            self._dedup_sorted(fix_duplicates)
+
+    def _dedup_sorted(self, fix_duplicates: bool) -> None:
+        n = self._n
+        if n < 2:
+            return
+        ts = self._ts[:n]
+        dup = ts[1:] == ts[:-1]
+        if not dup.any():
+            return
+        if not fix_duplicates:
+            idx = int(np.argmax(dup))
+            raise ValueError(
+                "Duplicate timestamp %d in series %s (set "
+                "tsd.storage.fix_duplicates=true to resolve)"
+                % (int(ts[idx]), self.key))
+        keep = np.ones(n, dtype=bool)
+        keep[:-1] = ~dup  # keep the LAST point of each duplicate run
+        m = int(keep.sum())
+        self._ts[:m] = ts[keep]
+        self._val[:m] = self._val[:n][keep]
+        self._ival[:m] = self._ival[:n][keep]
+        self._isint[:m] = self._isint[:n][keep]
+        self._n = m
+
+    def window(self, start_ms: int, end_ms: int, fix_duplicates: bool = True
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Return copies of (ts, float_vals, int_vals, is_int) for
+        start_ms <= ts <= end_ms.
+
+        Copies, not views: normalize() mutates the buffers in place and a
+        background compaction flush may run while a query thread reads.
+        """
+        self.normalize(fix_duplicates)
+        with self._lock:
+            n = self._n
+            lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
+            hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))
+            return (self._ts[lo:hi].copy(), self._val[lo:hi].copy(),
+                    self._ival[lo:hi].copy(), self._isint[lo:hi].copy())
+
+    def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Copies of the full (ts, float_vals, int_vals, is_int) columns."""
+        with self._lock:
+            n = self._n
+            return (self._ts[:n].copy(), self._val[:n].copy(),
+                    self._ival[:n].copy(), self._isint[:n].copy())
+
+    @property
+    def size_bytes(self) -> int:
+        return self._n * (8 + 8 + 8 + 1)
+
+
+@dataclass
+class Annotation:
+    """A note attached to a timespan, per-TSUID or global (meta/Annotation.java)."""
+    start_time: int
+    end_time: int = 0
+    tsuid: str = ""
+    description: str = ""
+    notes: str = ""
+    custom: dict[str, str] | None = None
+
+    def to_json(self) -> dict:
+        out = {
+            "tsuid": self.tsuid,
+            "startTime": self.start_time,
+            "endTime": self.end_time,
+            "description": self.description,
+            "notes": self.notes,
+            "custom": self.custom,
+        }
+        if not self.tsuid:
+            out.pop("tsuid")
+        return out
+
+
+class CompactionQueue:
+    """Tracks dirty (out-of-order) series and normalizes them in the background.
+
+    Reference behavior: CompactionQueue.java (:57, flush :127) — a queue of
+    dirty rows flushed by a background thread.  Here "compaction" is the
+    sort+dedup normalization pass; data is already columnar.
+    """
+
+    def __init__(self, fix_duplicates: bool = True):
+        self._dirty: dict[SeriesKey, Series] = {}
+        self._lock = threading.Lock()
+        self.fix_duplicates = fix_duplicates
+        self.compactions = 0
+
+    def add(self, series: Series) -> None:
+        with self._lock:
+            self._dirty[series.key] = series
+
+    def flush(self, max_flushes: int | None = None) -> int:
+        with self._lock:
+            items = list(self._dirty.items())[:max_flushes]
+            for key, _ in items:
+                self._dirty.pop(key, None)
+        for _, series in items:
+            series.normalize(self.fix_duplicates)
+            self.compactions += 1
+        return len(items)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dirty)
+
+
+class MemStore:
+    """The series store: keyed columnar series + tag inverted index.
+
+    Query-side role of SaltScanner/MultiGetQuery + the tsdb table: find series
+    for a metric and tag constraints, hand back columnar windows.
+    """
+
+    def __init__(self, salt_buckets: int = 20, fix_duplicates: bool = True):
+        self.salt_buckets = salt_buckets
+        self.fix_duplicates = fix_duplicates
+        self._series: dict[SeriesKey, Series] = {}
+        self._by_metric: dict[int, set[SeriesKey]] = {}
+        self._lock = threading.RLock()
+        self.compaction_queue = CompactionQueue(fix_duplicates)
+        # annotations: tsuid-keyed and global (empty-key) lists
+        self._annotations: dict[str, list[Annotation]] = {}
+        self.datapoints_added = 0
+
+    # -- write path --
+
+    def get_or_create_series(self, key: SeriesKey) -> Series:
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = Series(key, shard=key.salt(self.salt_buckets))
+                self._series[key] = series
+                self._by_metric.setdefault(key.metric, set()).add(key)
+            return series
+
+    def add_point(self, key: SeriesKey, ts_ms: int, value: float,
+                  is_int: bool) -> None:
+        series = self.get_or_create_series(key)
+        series.append(ts_ms, value, is_int)
+        if series.dirty:
+            self.compaction_queue.add(series)
+        self.datapoints_added += 1
+
+    def add_batch(self, key: SeriesKey, ts_ms: np.ndarray, values: np.ndarray,
+                  is_int: np.ndarray | bool) -> None:
+        series = self.get_or_create_series(key)
+        series.append_batch(ts_ms, values, is_int)
+        if series.dirty:
+            self.compaction_queue.add(series)
+        self.datapoints_added += len(ts_ms)
+
+    # -- read path --
+
+    def series_for_metric(self, metric: int) -> list[Series]:
+        with self._lock:
+            keys = self._by_metric.get(metric, set())
+            return [self._series[k] for k in keys]
+
+    def select(self, metric: int,
+               predicate: Callable[[SeriesKey], bool] | None = None) -> list[Series]:
+        """All series of a metric passing a key predicate (tag-filter hook)."""
+        out = []
+        with self._lock:
+            for key in self._by_metric.get(metric, ()):
+                if predicate is None or predicate(key):
+                    out.append(self._series[key])
+        return out
+
+    def get_series(self, key: SeriesKey) -> Series | None:
+        with self._lock:
+            return self._series.get(key)
+
+    def all_series(self) -> list[Series]:
+        with self._lock:
+            return list(self._series.values())
+
+    # -- annotations --
+
+    def add_annotation(self, note: Annotation) -> None:
+        with self._lock:
+            self._annotations.setdefault(note.tsuid, []).append(note)
+
+    def get_annotations(self, tsuid: str, start_ms: int, end_ms: int,
+                        include_global: bool = False) -> list[Annotation]:
+        out = []
+        with self._lock:
+            pools: list[list[Annotation]] = [self._annotations.get(tsuid, [])]
+            if include_global and tsuid != "":
+                pools.append(self._annotations.get("", []))
+            for pool in pools:
+                for note in pool:
+                    if start_ms <= note.start_time <= end_ms:
+                        out.append(note)
+        out.sort(key=lambda a: a.start_time)
+        return out
+
+    def delete_annotation(self, tsuid: str, start_time: int) -> bool:
+        with self._lock:
+            pool = self._annotations.get(tsuid, [])
+            before = len(pool)
+            self._annotations[tsuid] = [a for a in pool
+                                        if a.start_time != start_time]
+            return len(self._annotations[tsuid]) != before
+
+    def delete_annotation_range(self, tsuids: Sequence[str] | None,
+                                start_ms: int, end_ms: int,
+                                global_notes: bool = False) -> int:
+        deleted = 0
+        with self._lock:
+            keys: Iterable[str]
+            if global_notes:
+                keys = [""]
+            elif tsuids:
+                keys = tsuids
+            else:
+                keys = list(self._annotations.keys())
+            for key in keys:
+                pool = self._annotations.get(key, [])
+                kept = [a for a in pool
+                        if not (start_ms <= a.start_time <= end_ms)]
+                deleted += len(pool) - len(kept)
+                self._annotations[key] = kept
+        return deleted
+
+    # -- stats / admin --
+
+    @property
+    def num_series(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    @property
+    def total_datapoints(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._series.values())
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(s.size_bytes for s in self._series.values())
+
+    def drop_caches(self) -> None:
+        pass  # no separate cache layer; present for /api/dropcaches parity
+
+    def delete_series(self, key: SeriesKey) -> bool:
+        with self._lock:
+            series = self._series.pop(key, None)
+            if series is None:
+                return False
+            keys = self._by_metric.get(key.metric)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    self._by_metric.pop(key.metric, None)
+            return True

@@ -1,0 +1,12 @@
+from opentsdb_tpu.uid.unique_id import (
+    UniqueId,
+    UniqueIdType,
+    NoSuchUniqueId,
+    NoSuchUniqueName,
+    FailedToAssignUniqueIdException,
+)
+
+__all__ = [
+    "UniqueId", "UniqueIdType", "NoSuchUniqueId", "NoSuchUniqueName",
+    "FailedToAssignUniqueIdException",
+]

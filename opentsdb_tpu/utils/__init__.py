@@ -1,0 +1,4 @@
+from opentsdb_tpu.utils.config import Config
+from opentsdb_tpu.utils import datetime_util as DateTime
+
+__all__ = ["Config", "DateTime"]

@@ -1,0 +1,233 @@
+"""Flat `tsd.*` properties configuration with typed getters.
+
+Reference behavior: /root/reference/src/utils/Config.java (:53, setDefaults :560)
+— a properties file of tsd.* keys with hardcoded defaults, typed accessors, and
+hot access from every layer.  TPU additions live under the `tsd.tpu.*` prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+# Defaults mirror Config.setDefaults (Config.java:560-659) plus TPU-native keys.
+DEFAULTS: dict[str, str] = {
+    "tsd.mode": "rw",
+    "tsd.no_diediedie": "false",
+    "tsd.network.bind": "0.0.0.0",
+    "tsd.network.port": "",
+    "tsd.network.worker_threads": "",
+    "tsd.network.async_io": "true",
+    "tsd.network.tcp_no_delay": "true",
+    "tsd.network.keep_alive": "true",
+    "tsd.network.reuse_address": "true",
+    "tsd.core.authentication.enable": "false",
+    "tsd.core.authentication.plugin": "",
+    "tsd.core.auto_create_metrics": "false",
+    "tsd.core.auto_create_tagks": "true",
+    "tsd.core.auto_create_tagvs": "true",
+    "tsd.core.connections.limit": "0",
+    "tsd.core.enable_api": "true",
+    "tsd.core.enable_ui": "true",
+    "tsd.core.histograms.config": "",
+    "tsd.core.meta.enable_realtime_ts": "false",
+    "tsd.core.meta.enable_realtime_uid": "false",
+    "tsd.core.meta.enable_tsuid_incrementing": "false",
+    "tsd.core.meta.enable_tsuid_tracking": "false",
+    "tsd.core.meta.cache.enable": "false",
+    "tsd.core.meta.cache.plugin": "",
+    "tsd.core.plugin_path": "",
+    "tsd.core.response.async": "true",
+    "tsd.core.socket.timeout": "0",
+    "tsd.core.tree.enable_processing": "false",
+    "tsd.core.preload_uid_cache": "false",
+    "tsd.core.preload_uid_cache.max_entries": "300000",
+    "tsd.core.storage_exception_handler.enable": "false",
+    "tsd.core.storage_exception_handler.plugin": "",
+    "tsd.core.uid.random_metrics": "false",
+    "tsd.core.bulk.allow_out_of_order_timestamps": "false",
+    "tsd.core.timezone": "UTC",
+    "tsd.query.filter.expansion_limit": "4096",
+    "tsd.query.skip_unresolved_tagvs": "false",
+    "tsd.query.allow_simultaneous_duplicates": "true",
+    "tsd.query.enable_fuzzy_filter": "true",
+    "tsd.query.limits.bytes.default": "0",
+    "tsd.query.limits.bytes.allow_override": "false",
+    "tsd.query.limits.data_points.default": "0",
+    "tsd.query.limits.data_points.allow_override": "false",
+    "tsd.query.limits.overrides.config": "",
+    "tsd.query.limits.overrides.interval": "60000",
+    "tsd.query.multi_get.enable": "false",
+    "tsd.query.multi_get.limit": "131072",
+    "tsd.query.multi_get.batch_size": "1024",
+    "tsd.query.multi_get.concurrent": "20",
+    "tsd.query.multi_get.get_all_salts": "false",
+    "tsd.query.timeout": "0",
+    "tsd.rpc.plugins": "",
+    "tsd.rpc.telnet.return_errors": "true",
+    "tsd.rollups.enable": "false",
+    "tsd.rollups.config": "",
+    "tsd.rollups.tag_raw": "false",
+    "tsd.rollups.agg_tag_key": "_aggregate",
+    "tsd.rollups.raw_agg_tag_value": "RAW",
+    "tsd.rollups.block_derived": "true",
+    "tsd.rollups.split_query.enable": "false",
+    "tsd.rtpublisher.enable": "false",
+    "tsd.rtpublisher.plugin": "",
+    "tsd.search.enable": "false",
+    "tsd.search.plugin": "",
+    "tsd.stats.canonical": "false",
+    "tsd.startup.enable": "false",
+    "tsd.startup.plugin": "",
+    "tsd.storage.fix_duplicates": "false",
+    "tsd.storage.flush_interval": "1000",
+    "tsd.storage.data_table": "tsdb",
+    "tsd.storage.uid_table": "tsdb-uid",
+    "tsd.storage.tree_table": "tsdb-tree",
+    "tsd.storage.meta_table": "tsdb-meta",
+    "tsd.storage.enable_appends": "false",
+    "tsd.storage.repair_appends": "false",
+    "tsd.storage.enable_compaction": "true",
+    "tsd.storage.compaction.flush_interval": "10",
+    "tsd.storage.compaction.min_flush_threshold": "100",
+    "tsd.storage.compaction.max_concurrent_flushes": "10000",
+    "tsd.storage.compaction.flush_speed": "2",
+    "tsd.storage.salt.width": "0",
+    "tsd.storage.salt.buckets": "20",
+    "tsd.storage.uid.width.metric": "3",
+    "tsd.storage.uid.width.tagk": "3",
+    "tsd.storage.uid.width.tagv": "3",
+    "tsd.storage.max_tags": "8",
+    "tsd.storage.directory": "",
+    "tsd.timeseriesfilter.enable": "false",
+    "tsd.timeseriesfilter.plugin": "",
+    "tsd.uid.use_mode": "false",
+    "tsd.uid.lru.enable": "false",
+    "tsd.uid.lru.name.size": "5000000",
+    "tsd.uid.lru.id.size": "5000000",
+    "tsd.uidfilter.enable": "false",
+    "tsd.uidfilter.plugin": "",
+    "tsd.core.stats_with_port": "false",
+    "tsd.http.show_stack_trace": "true",
+    "tsd.http.query.allow_delete": "false",
+    "tsd.http.header_tag": "",
+    "tsd.http.request.enable_chunked": "true",
+    "tsd.http.request.max_chunk": "1048576",
+    "tsd.http.request.cors_domains": "",
+    "tsd.http.request.cors_headers": (
+        "Authorization, Content-Type, Accept, Origin, User-Agent, DNT, "
+        "Cache-Control, X-Mx-ReqToken, Keep-Alive, X-Requested-With, "
+        "If-Modified-Since"),
+    "tsd.http.cachedir": "",
+    "tsd.http.staticroot": "",
+    # --- TPU-native knobs (no reference equivalent) ---
+    "tsd.tpu.enable": "true",
+    "tsd.tpu.mesh.shards": "0",            # 0 = use all visible devices
+    "tsd.tpu.batch.max_series": "4096",
+    "tsd.tpu.batch.pad_pow2": "true",
+    "tsd.tpu.precision.x64": "true",
+}
+
+_SECRET_MARKERS = ("pass", "key", "secret", "token")
+
+
+class Config:
+    """Typed accessor over a flat key->string map, file- and dict-loadable."""
+
+    def __init__(self, properties: dict[str, Any] | None = None,
+                 config_file: str | None = None, auto_load: bool = False):
+        self._map: dict[str, str] = dict(DEFAULTS)
+        self.config_location: str | None = None
+        if auto_load and config_file is None:
+            for candidate in ("./opentsdb.conf", "/etc/opentsdb/opentsdb.conf"):
+                if os.path.isfile(candidate):
+                    config_file = candidate
+                    break
+        if config_file:
+            self.load_file(config_file)
+        if properties:
+            for k, v in properties.items():
+                self._map[k] = self._stringify(v)
+
+    @staticmethod
+    def _stringify(value: Any) -> str:
+        if isinstance(value, bool):
+            return "true" if value else "false"
+        return str(value)
+
+    def load_file(self, path: str) -> None:
+        with open(path, "r") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#") or line.startswith("!"):
+                    continue
+                if "=" not in line:
+                    continue
+                key, _, value = line.partition("=")
+                self._map[key.strip()] = value.strip()
+        self.config_location = path
+
+    # -- typed getters (Config.java getString/getInt/getBoolean...) --
+
+    def has_property(self, key: str) -> bool:
+        return key in self._map
+
+    def get_string(self, key: str) -> str:
+        if key not in self._map:
+            raise KeyError(key)
+        return self._map[key]
+
+    def get_int(self, key: str) -> int:
+        return int(self.get_string(key))
+
+    def get_float(self, key: str) -> float:
+        return float(self.get_string(key))
+
+    def get_bool(self, key: str) -> bool:
+        value = self.get_string(key).strip().lower()
+        return value in ("1", "true", "yes")
+
+    def get_directory_name(self, key: str) -> str:
+        path = self.get_string(key)
+        if path and not path.endswith(os.sep):
+            path += os.sep
+        return path
+
+    def override_config(self, key: str, value: Any) -> None:
+        self._map[key] = self._stringify(value)
+
+    def as_map(self, obfuscate: bool = True) -> dict[str, str]:
+        """Full config dump for /api/config; secrets hidden like the reference."""
+        out = {}
+        for key, value in sorted(self._map.items()):
+            if obfuscate and any(m in key.lower() for m in _SECRET_MARKERS):
+                out[key] = "********"
+            else:
+                out[key] = value
+        return out
+
+    def dump_json(self) -> str:
+        return json.dumps(self.as_map(), indent=2)
+
+    # -- convenience flags used on hot paths --
+
+    @property
+    def auto_metric(self) -> bool:
+        return self.get_bool("tsd.core.auto_create_metrics")
+
+    @property
+    def enable_compactions(self) -> bool:
+        return self.get_bool("tsd.storage.enable_compaction")
+
+    @property
+    def fix_duplicates(self) -> bool:
+        return self.get_bool("tsd.storage.fix_duplicates")
+
+    @property
+    def salt_width(self) -> int:
+        return self.get_int("tsd.storage.salt.width")
+
+    @property
+    def salt_buckets(self) -> int:
+        return self.get_int("tsd.storage.salt.buckets")

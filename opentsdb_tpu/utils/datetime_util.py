@@ -1,0 +1,302 @@
+"""Date/time parsing helpers reproducing OpenTSDB's query time grammar.
+
+Reference behavior: /root/reference/src/utils/DateTime.java
+  - parseDateTimeString (:76): relative ("1h-ago"), absolute ("yyyy/MM/dd[-HH:mm[:ss]]"),
+    unix seconds / milliseconds / dotted "<sec>.<ms>" forms, "now", bare "<n>ms".
+  - parseDuration (:187): ms/s/m/h/d/w/n(30d)/y(365d) suffixes -> milliseconds.
+  - previousInterval (:421): calendar-aligned interval starts honoring timezones.
+"""
+
+from __future__ import annotations
+
+import calendar as _calendar
+import datetime as _dt
+import re
+import time as _time
+from zoneinfo import ZoneInfo, available_timezones
+
+UTC_ID = "UTC"
+
+_TZ_CACHE: dict[str, ZoneInfo] = {}
+_AVAILABLE: set[str] | None = None
+
+
+def timezone(name: str | None) -> ZoneInfo:
+    """Look up a timezone, raising on unknown names (unlike the JDK's GMT trap)."""
+    global _AVAILABLE
+    if name is None or name == "":
+        name = UTC_ID
+    tz = _TZ_CACHE.get(name)
+    if tz is None:
+        if _AVAILABLE is None:
+            _AVAILABLE = available_timezones()
+        if name not in _AVAILABLE:
+            raise ValueError("Invalid timezone name: " + name)
+        tz = ZoneInfo(name)
+        _TZ_CACHE[name] = tz
+    return tz
+
+
+# Duration unit -> seconds multiplier (DateTime.java:216-226).
+_DURATION_MULTIPLIERS = {
+    "s": 1,
+    "m": 60,
+    "h": 3600,
+    "d": 3600 * 24,
+    "w": 3600 * 24 * 7,
+    "n": 3600 * 24 * 30,   # month, averaged
+    "y": 3600 * 24 * 365,  # year, ignoring leap years like the reference
+}
+
+_LONG_MAX = 2**63 - 1
+
+
+def parse_duration(duration: str) -> int:
+    """Parse "10m"/"3h"/"500ms" into milliseconds (DateTime.parseDuration :187)."""
+    if not duration:
+        raise ValueError("Cannot parse null or empty duration")
+    unit = 0
+    while unit < len(duration) and duration[unit].isdigit():
+        unit += 1
+    if unit >= len(duration):
+        raise ValueError("Invalid duration, must have an integer and unit: " + duration)
+    if unit == 0:
+        raise ValueError("Invalid duration (number): " + duration)
+    interval = int(duration[:unit])
+    if interval <= 0:
+        raise ValueError("Zero or negative duration: " + duration)
+    suffix = duration.lower()[-1]
+    if suffix == "s" and len(duration) >= 2 and duration[-2].lower() == "m":
+        return interval  # milliseconds
+    mult = _DURATION_MULTIPLIERS.get(suffix)
+    if mult is None:
+        raise ValueError("Invalid duration (suffix): " + duration)
+    result = interval * mult * 1000
+    if result > _LONG_MAX:
+        raise ValueError("Duration must be < Long.MAX_VALUE ms: " + duration)
+    return result
+
+
+def get_duration_units(duration: str) -> str:
+    """Return the unit suffix of a duration string (DateTime.getDurationUnits :241)."""
+    if not duration:
+        raise ValueError("Duration cannot be null or empty")
+    unit = 0
+    while unit < len(duration) and duration[unit].isdigit():
+        unit += 1
+    units = duration[unit:].lower()
+    if units in ("ms", "s", "m", "h", "d", "w", "n", "y"):
+        return units
+    raise ValueError("Invalid units in the duration: " + units)
+
+
+def get_duration_interval(duration: str) -> int:
+    """Return the numeric prefix of a duration string (DateTime.getDurationInterval :268)."""
+    if not duration:
+        raise ValueError("Duration cannot be null or empty")
+    if "." in duration:
+        raise ValueError("Floating point intervals are not supported")
+    unit = 0
+    while unit < len(duration) and duration[unit].isdigit():
+        unit += 1
+    if unit == 0:
+        raise ValueError("Invalid duration (number): " + duration)
+    interval = int(duration[:unit])
+    if interval <= 0:
+        raise ValueError("Zero or negative duration: " + duration)
+    return interval
+
+
+def is_relative_date(value: str) -> bool:
+    return value.lower().endswith("-ago")
+
+
+_DOTTED_MS_RE = re.compile(r"^[0-9]{10}\.[0-9]{1,3}$")
+_BARE_MS_RE = re.compile(r"^[0-9]+ms$")
+
+
+def parse_datetime_string(datetime_str: str | None, tz: str | None = None,
+                          now_ms: int | None = None) -> int:
+    """Parse a query time string into epoch milliseconds.
+
+    Mirrors DateTime.parseDateTimeString (:76): returns -1 for empty input;
+    supports "now", "<dur>-ago", slash-dated absolute strings, unix seconds
+    (<= 10 digits -> x1000), unix ms, and "<sec>.<ms>".
+    """
+    if datetime_str is None or datetime_str == "":
+        return -1
+    if _BARE_MS_RE.match(datetime_str):
+        return int(datetime_str[:-2])
+    lower = datetime_str.lower()
+    if lower == "now":
+        return now_ms if now_ms is not None else int(_time.time() * 1000)
+    if lower.endswith("-ago"):
+        interval = parse_duration(datetime_str[:-4])
+        base = now_ms if now_ms is not None else int(_time.time() * 1000)
+        return base - interval
+    if "/" in datetime_str or ":" in datetime_str:
+        fmt: str
+        n = len(datetime_str)
+        if n == 10:
+            fmt = "%Y/%m/%d"
+        elif n == 16:
+            fmt = "%Y/%m/%d-%H:%M" if "-" in datetime_str else "%Y/%m/%d %H:%M"
+        elif n == 19:
+            fmt = "%Y/%m/%d-%H:%M:%S" if "-" in datetime_str else "%Y/%m/%d %H:%M:%S"
+        else:
+            raise ValueError("Invalid absolute date: " + datetime_str)
+        try:
+            naive = _dt.datetime.strptime(datetime_str, fmt)
+        except ValueError as e:
+            raise ValueError("Invalid date: %s. %s" % (datetime_str, e))
+        aware = naive.replace(tzinfo=timezone(tz))
+        return int(aware.timestamp() * 1000)
+    # Numeric forms.
+    contains_dot = "." in datetime_str
+    if contains_dot:
+        if not _DOTTED_MS_RE.match(datetime_str):
+            raise ValueError(
+                "Invalid time: " + datetime_str + ". Millisecond timestamps must "
+                "be in the format <seconds>.<ms> where the milliseconds are "
+                "limited to 3 digits")
+        value = int(datetime_str.replace(".", ""))
+    else:
+        try:
+            value = int(datetime_str)
+        except ValueError as e:
+            raise ValueError("Invalid time: %s. %s" % (datetime_str, e))
+    if value < 0:
+        raise ValueError("Invalid time: " + datetime_str +
+                         ". Negative timestamps are not supported.")
+    if len(datetime_str) <= 10:
+        value *= 1000
+    return value
+
+
+# Calendar units for downsampling, keyed by duration suffix
+# (DateTime.unitsToCalendarType equivalent).
+_CAL_UNITS = ("ms", "s", "m", "h", "d", "w", "n", "y")
+
+
+def previous_interval(ts_ms: int, interval: int, unit: str,
+                      tz: str | ZoneInfo | None = None) -> int:
+    """Snap ts_ms down to the start of its calendar-aligned interval.
+
+    Mirrors DateTime.previousInterval (:421): pick a base boundary — the top
+    of the parent unit when the interval divides it, otherwise the top of the
+    next-larger unit (e.g. 45m tiles from midnight, 23s from the top of the
+    hour) — then step forward by the interval until passing ts and back off
+    one step.  Weeks start on Sunday (java.util.Calendar default) and step as
+    7*interval days; months/years always tile from the top of the year.
+    """
+    if ts_ms < 0:
+        raise ValueError("Timestamp cannot be less than zero")
+    if interval < 1:
+        raise ValueError("Interval must be greater than zero")
+    if unit not in _CAL_UNITS:
+        raise ValueError("Invalid unit: " + unit)
+    zone = tz if isinstance(tz, ZoneInfo) else timezone(tz)
+    when = _dt.datetime.fromtimestamp(ts_ms / 1000.0, zone)
+
+    def _start_of(trunc_unit: str) -> _dt.datetime:
+        if trunc_unit == "s":
+            return when.replace(microsecond=0)
+        if trunc_unit == "m":
+            return when.replace(second=0, microsecond=0)
+        if trunc_unit == "h":
+            return when.replace(minute=0, second=0, microsecond=0)
+        if trunc_unit == "d":
+            return when.replace(hour=0, minute=0, second=0, microsecond=0)
+        if trunc_unit == "n":
+            return when.replace(day=1, hour=0, minute=0, second=0,
+                                microsecond=0)
+        # "y"
+        return when.replace(month=1, day=1, hour=0, minute=0, second=0,
+                            microsecond=0)
+
+    step_unit = unit
+    step_interval = interval
+    if unit == "ms":
+        base = _start_of("s") if 1000 % interval == 0 else _start_of("m")
+    elif unit == "s":
+        base = _start_of("m") if 60 % interval == 0 else _start_of("h")
+    elif unit == "m":
+        base = _start_of("h") if 60 % interval == 0 else _start_of("d")
+    elif unit == "h":
+        base = _start_of("d") if 24 % interval == 0 else _start_of("n")
+    elif unit == "d":
+        base = _start_of("n") if interval == 1 else _start_of("y")
+    elif unit == "w":
+        day = _start_of("d") if interval <= 2 else _start_of("y")
+        # Snap back to the first day of the week (Sunday).
+        days_since_sunday = (day.weekday() + 1) % 7
+        base = day - _dt.timedelta(days=days_since_sunday)
+        step_unit = "d"
+        step_interval = 7 * interval
+    else:  # "n" / "y"
+        base = _start_of("y")
+
+    base_ms = int(base.timestamp() * 1000)
+    if base_ms == ts_ms:
+        return base_ms
+    prev = base_ms
+    current = base_ms
+    while current <= ts_ms:
+        prev = current
+        current = add_calendar_interval(current, step_interval, step_unit, zone)
+    return prev
+
+
+def add_calendar_interval(start_ms: int, interval: int, unit: str,
+                          tz: str | ZoneInfo | None = None) -> int:
+    """Advance a calendar interval start by one interval (Calendar.add semantics).
+
+    Weeks advance as 7*interval days (Downsampler.java:338-341).
+    Month arithmetic clamps the day-of-month like java.util.Calendar.
+    """
+    zone = tz if isinstance(tz, ZoneInfo) else timezone(tz)
+    when = _dt.datetime.fromtimestamp(start_ms / 1000.0, zone)
+    if unit == "ms":
+        out = when + _dt.timedelta(milliseconds=interval)
+    elif unit == "s":
+        out = when + _dt.timedelta(seconds=interval)
+    elif unit == "m":
+        out = when + _dt.timedelta(minutes=interval)
+    elif unit == "h":
+        out = when + _dt.timedelta(hours=interval)
+    elif unit == "d":
+        out = when + _dt.timedelta(days=interval)
+    elif unit == "w":
+        out = when + _dt.timedelta(days=7 * interval)
+    elif unit == "n":
+        month_index = when.month - 1 + interval
+        year = when.year + month_index // 12
+        month = month_index % 12 + 1
+        day = min(when.day, _calendar.monthrange(year, month)[1])
+        out = when.replace(year=year, month=month, day=day)
+    elif unit == "y":
+        year = when.year + interval
+        day = min(when.day, _calendar.monthrange(year, when.month)[1])
+        out = when.replace(year=year, day=day)
+    else:
+        raise ValueError("Invalid unit: " + unit)
+    return int(out.timestamp() * 1000)
+
+
+def calendar_window_edges(start_ms: int, end_ms: int, interval: int, unit: str,
+                          tz: str | None = None) -> list[int]:
+    """Precompute calendar window start edges covering [start_ms, end_ms].
+
+    Host-side helper for the TPU downsample kernels: calendar math cannot run
+    inside jit, so edges are materialized here and turned into segment IDs on
+    device (SURVEY.md §7 hard part (d)).
+    """
+    zone = timezone(tz)
+    edges = [previous_interval(start_ms, interval, unit, zone)]
+    while edges[-1] <= end_ms:
+        edges.append(add_calendar_interval(edges[-1], interval, unit, zone))
+    return edges
+
+
+def current_time_millis() -> int:
+    return int(_time.time() * 1000)

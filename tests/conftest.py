@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX loads.
+
+Mirrors the reference's test stance (SURVEY.md §4): deterministic in-memory
+storage + golden-value numeric tests, with multi-chip sharding validated on a
+virtual device mesh (the driver separately dry-runs the real multi-chip path).
+"""
+
+import os
+
+# Force the CPU platform even when the ambient environment points JAX at a
+# real accelerator (JAX_PLATFORMS=axon + sitecustomize pre-imports jax, so a
+# plain env setdefault is too late).  The accelerator tunnel is exclusive;
+# tests must never contend for it.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Persistent compile cache: kernel tests compile many small shapes; cache
+# them across pytest runs so the suite stays fast.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_pytest_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,136 @@
+"""DateTime grammar tests (reference: test/utils/TestDateTime.java)."""
+
+import pytest
+
+from opentsdb_tpu.utils import datetime_util as DT
+
+
+class TestParseDuration:
+    def test_milliseconds(self):
+        assert DT.parse_duration("500ms") == 500
+
+    def test_seconds(self):
+        assert DT.parse_duration("30s") == 30_000
+
+    def test_minutes(self):
+        assert DT.parse_duration("10m") == 600_000
+
+    def test_hours(self):
+        assert DT.parse_duration("2h") == 7_200_000
+
+    def test_days(self):
+        assert DT.parse_duration("1d") == 86_400_000
+
+    def test_weeks(self):
+        assert DT.parse_duration("2w") == 2 * 7 * 86_400_000
+
+    def test_months(self):
+        assert DT.parse_duration("1n") == 30 * 86_400_000
+
+    def test_years(self):
+        assert DT.parse_duration("1y") == 365 * 86_400_000
+
+    def test_invalid_suffix(self):
+        with pytest.raises(ValueError):
+            DT.parse_duration("1x")
+
+    def test_no_number(self):
+        with pytest.raises(ValueError):
+            DT.parse_duration("h")
+
+    def test_zero(self):
+        with pytest.raises(ValueError):
+            DT.parse_duration("0m")
+
+    def test_empty(self):
+        with pytest.raises(ValueError):
+            DT.parse_duration("")
+
+
+class TestParseDateTimeString:
+    NOW = 1_500_000_000_000
+
+    def test_empty_returns_minus_one(self):
+        assert DT.parse_datetime_string("", None) == -1
+        assert DT.parse_datetime_string(None, None) == -1
+
+    def test_now(self):
+        assert DT.parse_datetime_string("now", None, now_ms=self.NOW) == self.NOW
+
+    def test_relative(self):
+        out = DT.parse_datetime_string("1h-ago", None, now_ms=self.NOW)
+        assert out == self.NOW - 3_600_000
+
+    def test_unix_seconds(self):
+        assert DT.parse_datetime_string("1355961600", None) == 1_355_961_600_000
+
+    def test_unix_ms(self):
+        assert DT.parse_datetime_string("1355961600000", None) == 1_355_961_600_000
+
+    def test_dotted_ms(self):
+        assert DT.parse_datetime_string("1355961600.123", None) == 1_355_961_600_123
+
+    def test_dotted_ms_invalid(self):
+        with pytest.raises(ValueError):
+            DT.parse_datetime_string("135596160.12", None)
+
+    def test_bare_ms(self):
+        assert DT.parse_datetime_string("1355961600500ms", None) == 1_355_961_600_500
+
+    def test_absolute_date(self):
+        # 2015/06/01 00:00 UTC
+        assert DT.parse_datetime_string("2015/06/01", "UTC") == 1_433_116_800_000
+
+    def test_absolute_datetime(self):
+        out = DT.parse_datetime_string("2015/06/01-12:30:15", "UTC")
+        assert out == 1_433_116_800_000 + (12 * 3600 + 30 * 60 + 15) * 1000
+
+    def test_absolute_datetime_space(self):
+        out = DT.parse_datetime_string("2015/06/01 12:30", "UTC")
+        assert out == 1_433_116_800_000 + (12 * 3600 + 30 * 60) * 1000
+
+    def test_timezone(self):
+        utc = DT.parse_datetime_string("2015/06/01", "UTC")
+        denver = DT.parse_datetime_string("2015/06/01", "America/Denver")
+        assert denver - utc == 6 * 3_600_000  # MDT is UTC-6
+
+    def test_invalid_timezone(self):
+        with pytest.raises(ValueError):
+            DT.timezone("NotATimezone")
+
+
+class TestCalendarIntervals:
+    def test_hour_snap(self):
+        ts = DT.parse_datetime_string("2015/06/01-12:30:15", "UTC")
+        snapped = DT.previous_interval(ts, 1, "h", "UTC")
+        assert snapped == DT.parse_datetime_string("2015/06/01-12:00:00", "UTC")
+
+    def test_day_snap_timezone(self):
+        ts = DT.parse_datetime_string("2015/06/01-02:30:00", "UTC")
+        # In Denver (UTC-6), 02:30 UTC is the previous day 20:30.
+        snapped = DT.previous_interval(ts, 1, "d", "America/Denver")
+        assert snapped == DT.parse_datetime_string("2015/05/31-06:00:00", "UTC")
+
+    def test_week_starts_sunday(self):
+        # 2015/06/03 was a Wednesday; week starts Sunday 2015/05/31.
+        ts = DT.parse_datetime_string("2015/06/03", "UTC")
+        snapped = DT.previous_interval(ts, 1, "w", "UTC")
+        assert snapped == DT.parse_datetime_string("2015/05/31", "UTC")
+
+    def test_month_snap(self):
+        ts = DT.parse_datetime_string("2015/06/20", "UTC")
+        snapped = DT.previous_interval(ts, 1, "n", "UTC")
+        assert snapped == DT.parse_datetime_string("2015/06/01", "UTC")
+
+    def test_edges_cover_range(self):
+        start = DT.parse_datetime_string("2015/06/01", "UTC")
+        end = DT.parse_datetime_string("2015/06/04", "UTC")
+        edges = DT.calendar_window_edges(start, end, 1, "d", "UTC")
+        assert edges[0] == start
+        assert edges[-1] > end
+        assert len(edges) == 5  # 4 day windows + closing edge
+
+    def test_month_add_clamps_day(self):
+        jan31 = DT.parse_datetime_string("2015/01/31", "UTC")
+        feb = DT.add_calendar_interval(jan31, 1, "n", "UTC")
+        assert feb == DT.parse_datetime_string("2015/02/28", "UTC")

@@ -1,0 +1,168 @@
+"""End-to-end query tests: ingest -> TSQuery -> planner -> results.
+
+Models the reference's TestTsdbQueryQueries/TestTsdbQueryDownsample pattern
+(write through a fake store, assert end-to-end datapoint values).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.utils.config import Config
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    # Two hosts, 10 points each at 10s spacing starting at t=1356998400 (sec).
+    base = 1_356_998_400
+    for i in range(10):
+        t.add_point("sys.cpu.user", base + i * 10, i, {"host": "web01"})
+        t.add_point("sys.cpu.user", base + i * 10, i * 10, {"host": "web02"})
+    return t
+
+
+BASE_MS = 1_356_998_400_000
+
+
+def run_query(tsdb, m, start="1356998400", end="1356998500", **kw):
+    q = TSQuery(start=start, end=end, queries=[parse_m_subquery(m)], **kw)
+    q.validate()
+    return tsdb.new_query_runner().run(q)
+
+
+class TestEndToEnd:
+    def test_sum_two_hosts(self, tsdb):
+        results = run_query(tsdb, "sum:sys.cpu.user")
+        assert len(results) == 1
+        r = results[0]
+        assert r.metric == "sys.cpu.user"
+        assert r.tags == {}  # host differs -> aggregated
+        assert r.aggregate_tags == ["host"]
+        assert len(r.dps) == 10
+        # Values: i + 10i = 11i, integers (both series int).
+        for i, (ts, v) in enumerate(r.dps):
+            assert ts == BASE_MS + i * 10_000
+            assert v == 11 * i
+            assert isinstance(v, int)
+
+    def test_groupby_host(self, tsdb):
+        results = run_query(tsdb, "sum:sys.cpu.user{host=*}")
+        assert len(results) == 2
+        by_host = {r.tags["host"]: r for r in results}
+        assert set(by_host) == {"web01", "web02"}
+        assert [v for _, v in by_host["web01"].dps] == list(range(10))
+        assert [v for _, v in by_host["web02"].dps] == [i * 10 for i in range(10)]
+        assert by_host["web01"].aggregate_tags == []
+
+    def test_literal_filter(self, tsdb):
+        results = run_query(tsdb, "sum:sys.cpu.user{host=web02}")
+        assert len(results) == 1
+        assert results[0].tags == {"host": "web02"}
+        assert [v for _, v in results[0].dps] == [i * 10 for i in range(10)]
+
+    def test_downsample_avg(self, tsdb):
+        results = run_query(tsdb, "sum:30s-avg:sys.cpu.user{host=web01}")
+        r = results[0]
+        # Windows of 3 points each: avg(0,1,2)=1, avg(3,4,5)=4, avg(6,7,8)=7,
+        # avg(9)=9.
+        assert [v for _, v in r.dps] == [1.0, 4.0, 7.0, 9.0]
+        assert [ts for ts, _ in r.dps] == [BASE_MS, BASE_MS + 30_000,
+                                           BASE_MS + 60_000, BASE_MS + 90_000]
+
+    def test_downsample_then_aggregate(self, tsdb):
+        results = run_query(tsdb, "sum:30s-sum:sys.cpu.user")
+        r = results[0]
+        # web01 windows: 3,12,21,9; web02: 30,120,210,90; summed: 33,132,231,99
+        assert [v for _, v in r.dps] == [33.0, 132.0, 231.0, 99.0]
+
+    def test_rate(self, tsdb):
+        results = run_query(tsdb, "sum:rate:sys.cpu.user{host=web02}")
+        r = results[0]
+        # dv/dt = 10 per 10s = 1.0, starting from the 2nd point.
+        assert len(r.dps) == 9
+        assert all(abs(v - 1.0) < 1e-9 for _, v in r.dps)
+
+    def test_none_agg_series_split(self, tsdb):
+        results = run_query(tsdb, "none:sys.cpu.user")
+        assert len(results) == 2  # one result per series, no aggregation
+
+    def test_end_time_filters(self, tsdb):
+        results = run_query(tsdb, "sum:sys.cpu.user{host=web01}",
+                            start="1356998400", end="1356998430")
+        assert [v for _, v in results[0].dps] == [0, 1, 2, 3]
+
+    def test_ms_resolution_json(self, tsdb):
+        results = run_query(tsdb, "sum:sys.cpu.user{host=web01}")
+        js = results[0].to_json(ms_resolution=False)
+        assert js["dps"][str(BASE_MS // 1000)] == 0
+        js_ms = results[0].to_json(ms_resolution=True)
+        assert js_ms["dps"][str(BASE_MS)] == 0
+
+    def test_unknown_metric_raises(self, tsdb):
+        from opentsdb_tpu.uid import NoSuchUniqueName
+        with pytest.raises(NoSuchUniqueName):
+            run_query(tsdb, "sum:no.such.metric")
+
+    def test_regexp_filter(self, tsdb):
+        results = run_query(tsdb, "sum:sys.cpu.user{host=regexp(web0[2-9])}")
+        assert len(results) == 1
+        assert [v for _, v in results[0].dps] == [i * 10 for i in range(10)]
+
+    def test_wildcard_groupby_excludes_missing(self, tsdb):
+        tsdb.add_point("sys.cpu.user", 1_356_998_400, 5, {"dc": "lga"})
+        results = run_query(tsdb, "sum:sys.cpu.user{host=*}")
+        assert len(results) == 2  # dc-only series has no host tag
+
+    def test_tsuid_query(self, tsdb):
+        from opentsdb_tpu.models import parse_tsuid_subquery
+        series = tsdb.store.series_for_metric(tsdb.metrics.get_id("sys.cpu.user"))
+        tsuid = series[0].key.tsuid()
+        q = TSQuery(start="1356998400", end="1356998500",
+                    queries=[parse_tsuid_subquery("sum:" + tsuid)])
+        q.validate()
+        results = tsdb.new_query_runner().run(q)
+        assert len(results) == 1
+        assert len(results[0].dps) == 10
+
+    def test_fill_policy_nan_emits_all_windows(self, tsdb):
+        results = run_query(tsdb, "sum:60s-sum-nan:sys.cpu.user{host=web01}",
+                            start="1356998400", end="1356998520")
+        r = results[0]
+        assert len(r.dps) == 3  # 0-60, 60-120, 120-180 windows
+        assert np.isnan(r.dps[2][1])  # no data after 1356998490
+
+
+class TestWritePath:
+    def test_no_tags_rejected(self, tsdb):
+        with pytest.raises(ValueError):
+            tsdb.add_point("sys.cpu.user", 1_356_998_400, 1, {})
+
+    def test_too_many_tags_rejected(self, tsdb):
+        tags = {"t%d" % i: "v" for i in range(9)}
+        with pytest.raises(ValueError):
+            tsdb.add_point("sys.cpu.user", 1_356_998_400, 1, tags)
+
+    def test_string_values(self, tsdb):
+        tsdb.add_point("sys.cpu.user", 1_356_998_401, "42", {"host": "web09"})
+        tsdb.add_point("sys.cpu.user", 1_356_998_402, "4.5", {"host": "web09"})
+        results = run_query(tsdb, "sum:sys.cpu.user{host=web09}")
+        assert results[0].dps == [(1_356_998_401_000, 42.0),
+                                  (1_356_998_402_000, 4.5)]
+
+    def test_nan_value_rejected(self, tsdb):
+        with pytest.raises(ValueError):
+            tsdb.add_point("sys.cpu.user", 1_356_998_400, float("nan"),
+                           {"host": "web01"})
+
+    def test_ms_timestamps(self, tsdb):
+        tsdb.add_point("sys.cpu.user", 1_356_998_400_500, 7, {"host": "web09"})
+        results = run_query(tsdb, "sum:sys.cpu.user{host=web09}")
+        assert results[0].dps == [(1_356_998_400_500, 7)]
+
+    def test_readonly_mode(self):
+        t = TSDB(Config({"tsd.mode": "ro",
+                         "tsd.core.auto_create_metrics": True}))
+        with pytest.raises(RuntimeError):
+            t.add_point("m", 1_356_998_400, 1, {"host": "a"})

@@ -1,0 +1,68 @@
+"""Regression tests for code-review findings on the core slice."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.query.filters import build_filter
+from opentsdb_tpu.utils import datetime_util as DT
+
+
+class TestFilterSemantics:
+    def test_not_literal_or_missing_key_passes(self):
+        # TagVNotLiteralOrFilter.java:80-83 — absent tag key means included.
+        f = build_filter("host", "not_literal_or", "web01")
+        assert f.match({"dc": "east"}) is True
+        assert f.match({"host": "web01"}) is False
+        assert f.match({"host": "web02"}) is True
+
+    def test_not_iliteral_case_insensitive(self):
+        f = build_filter("host", "not_iliteral_or", "WEB01")
+        assert f.match({"host": "web01"}) is False
+        assert f.match({}) is True
+
+
+class TestLongExactness:
+    def test_int64_roundtrip_above_2_53(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.utils.config import Config
+        big = (1 << 60) + 1
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        tsdb.add_point("counter.metric", 1_356_998_400, big, {"host": "a"})
+        q = TSQuery(start="1356998300", end="1356998500",
+                    queries=[parse_m_subquery("sum:counter.metric")])
+        q.validate()
+        results = tsdb.new_query_runner().run(q)
+        assert results[0].dps == [(1_356_998_400_000, big)]
+
+
+class TestCalendarNonDividing:
+    def test_45m_tiles_from_midnight(self):
+        # DateTime.previousInterval: 60 % 45 != 0 -> base is top of day.
+        # 01:10 UTC -> window start 00:45, not 01:00.
+        ts = DT.parse_datetime_string("2015/06/01-01:10:00", "UTC")
+        snapped = DT.previous_interval(ts, 45, "m", "UTC")
+        assert snapped == DT.parse_datetime_string("2015/06/01-00:45:00", "UTC")
+
+    def test_23s_tiles_from_top_of_hour(self):
+        ts = DT.parse_datetime_string("2015/06/01-01:00:50", "UTC")
+        snapped = DT.previous_interval(ts, 23, "s", "UTC")
+        # 0, 23, 46, 69... -> 46s is the last boundary <= 50s.
+        assert snapped == DT.parse_datetime_string("2015/06/01-01:00:46", "UTC")
+
+    def test_dividing_interval_unchanged(self):
+        ts = DT.parse_datetime_string("2015/06/01-12:31:00", "UTC")
+        snapped = DT.previous_interval(ts, 15, "m", "UTC")
+        assert snapped == DT.parse_datetime_string("2015/06/01-12:30:00", "UTC")
+
+
+class TestTsuidWidths:
+    def test_configured_widths_respected(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                            "tsd.storage.uid.width.metric": 4}))
+        tsdb.add_point("m", 1_356_998_400, 1, {"host": "a"})
+        series = tsdb.store.all_series()[0]
+        # 4-byte metric + 3-byte tagk + 3-byte tagv = 20 hex chars.
+        assert len(tsdb.tsuid(series.key)) == 20

@@ -1,0 +1,191 @@
+"""UID dictionary + columnar store tests (reference: test/uid/TestUniqueId.java,
+test/core/TestRowSeq.java behaviors re-expressed for the columnar engine)."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.uid import (UniqueId, UniqueIdType, NoSuchUniqueName,
+                              NoSuchUniqueId, FailedToAssignUniqueIdException)
+from opentsdb_tpu.storage import MemStore, Series, SeriesKey
+
+
+class TestUniqueId:
+    def test_assign_and_lookup(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        a = uid.get_or_create_id("sys.cpu.user")
+        b = uid.get_or_create_id("sys.cpu.sys")
+        assert a == 1 and b == 2
+        assert uid.get_id("sys.cpu.user") == a
+        assert uid.get_name(b) == "sys.cpu.sys"
+
+    def test_idempotent_assignment(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        assert uid.get_or_create_id("m") == uid.get_or_create_id("m")
+
+    def test_missing_name_raises(self):
+        uid = UniqueId(UniqueIdType.TAGK)
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("nope")
+
+    def test_missing_id_raises(self):
+        uid = UniqueId(UniqueIdType.TAGV)
+        with pytest.raises(NoSuchUniqueId):
+            uid.get_name(42)
+
+    def test_width_exhaustion(self):
+        uid = UniqueId(UniqueIdType.METRIC, width=1)
+        for i in range(255):
+            uid.get_or_create_id("m%d" % i)
+        with pytest.raises(FailedToAssignUniqueIdException):
+            uid.get_or_create_id("one-too-many")
+
+    def test_suggest_sorted_prefix_capped(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        for i in range(30):
+            uid.get_or_create_id("sys.cpu.%02d" % i)
+        uid.get_or_create_id("other.metric")
+        out = uid.suggest("sys.")
+        assert len(out) == 25  # MAX_SUGGESTIONS (UniqueId.java:89)
+        assert out == sorted(out)
+        assert all(n.startswith("sys.") for n in out)
+
+    def test_rename(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        a = uid.get_or_create_id("old")
+        uid.rename("old", "new")
+        assert uid.get_id("new") == a
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("old")
+
+    def test_rename_collision(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        uid.get_or_create_id("a")
+        uid.get_or_create_id("b")
+        with pytest.raises(ValueError):
+            uid.rename("a", "b")
+
+    def test_delete(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        uid.get_or_create_id("gone")
+        uid.delete("gone")
+        with pytest.raises(NoSuchUniqueName):
+            uid.get_id("gone")
+
+    def test_invalid_chars(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        with pytest.raises(ValueError):
+            uid.get_or_create_id("bad name with spaces")
+
+    def test_random_mode(self):
+        uid = UniqueId(UniqueIdType.METRIC, random_ids=True)
+        a = uid.get_or_create_id("m1")
+        assert 1 <= a <= uid.max_possible_id
+        assert uid.get_name(a) == "m1"
+
+    def test_uid_hex_roundtrip(self):
+        uid = UniqueId(UniqueIdType.METRIC)
+        a = uid.get_or_create_id("m")
+        assert uid.hex_to_uid(uid.uid_to_hex(a)) == a
+        assert uid.uid_to_hex(a) == "000001"
+
+
+_TAGKS = {"host": 1, "dc": 2, "owner": 3}
+
+
+def _key(metric=1, **tags):
+    return SeriesKey.make(metric, {_TAGKS[k]: v for k, v in tags.items()})
+
+
+class TestSeries:
+    def test_append_and_window(self):
+        s = Series(_key(host=1))
+        for i in range(10):
+            s.append(1000 * i, float(i), True)
+        ts, val, ival, isint = s.window(2000, 5000)
+        assert list(ts) == [2000, 3000, 4000, 5000]
+        assert list(val) == [2.0, 3.0, 4.0, 5.0]
+        assert isint.all()
+
+    def test_out_of_order_normalized(self):
+        s = Series(_key(host=1))
+        for t in (5000, 1000, 3000, 2000, 4000):
+            s.append(t, float(t), False)
+        assert s.dirty
+        ts, val, _, _ = s.window(0, 10_000)
+        assert list(ts) == [1000, 2000, 3000, 4000, 5000]
+        assert list(val) == [1000.0, 2000.0, 3000.0, 4000.0, 5000.0]
+
+    def test_duplicate_last_write_wins(self):
+        s = Series(_key(host=1))
+        s.append(1000, 1.0, False)
+        s.append(1000, 2.0, False)
+        ts, val, _, _ = s.window(0, 10_000, fix_duplicates=True)
+        assert list(ts) == [1000]
+        assert list(val) == [2.0]
+
+    def test_duplicate_strict_raises(self):
+        s = Series(_key(host=1))
+        s.append(1000, 1.0, False)
+        s.append(1000, 2.0, False)
+        with pytest.raises(ValueError):
+            s.window(0, 10_000, fix_duplicates=False)
+
+    def test_batch_append_growth(self):
+        s = Series(_key(host=1))
+        ts = np.arange(0, 1_000_000, 1000, dtype=np.int64)
+        s.append_batch(ts, np.ones(len(ts)), True)
+        assert len(s) == len(ts)
+        w_ts, w_val, _, _ = s.window(0, 2**62)
+        assert len(w_ts) == len(ts)
+
+
+class TestMemStore:
+    def test_add_and_select(self):
+        store = MemStore()
+        k1 = _key(metric=1, host=10)
+        k2 = _key(metric=1, host=11)
+        k3 = _key(metric=2, host=10)
+        for k in (k1, k2, k3):
+            store.add_point(k, 1000, 1.0, True)
+        assert store.num_series == 3
+        assert {s.key for s in store.series_for_metric(1)} == {k1, k2}
+        only_h10 = store.select(1, lambda key: (1, 10) in key.tags)
+        assert [s.key for s in only_h10] == [k1]
+
+    def test_tsuid_format(self):
+        k = SeriesKey.make(1, {2: 3})
+        assert k.tsuid() == "000001000002000003"
+
+    def test_shard_stability(self):
+        k = _key(metric=1, host=10)
+        assert k.salt(20) == k.salt(20)
+        assert 0 <= k.salt(20) < 20
+
+    def test_annotations(self):
+        from opentsdb_tpu.storage.memstore import Annotation
+        store = MemStore()
+        store.add_annotation(Annotation(start_time=1000, tsuid="AB", description="d"))
+        store.add_annotation(Annotation(start_time=2000, tsuid="", description="g"))
+        notes = store.get_annotations("AB", 0, 5000)
+        assert len(notes) == 1 and notes[0].description == "d"
+        both = store.get_annotations("AB", 0, 5000, include_global=True)
+        assert len(both) == 2
+
+    def test_compaction_queue_flush(self):
+        store = MemStore()
+        k = _key(metric=1, host=1)
+        store.add_point(k, 2000, 1.0, True)
+        store.add_point(k, 1000, 2.0, True)  # out of order -> dirty
+        assert len(store.compaction_queue) == 1
+        flushed = store.compaction_queue.flush()
+        assert flushed == 1
+        series = store.get_series(k)
+        assert not series.dirty
+
+    def test_delete_series(self):
+        store = MemStore()
+        k = _key(metric=1, host=1)
+        store.add_point(k, 1000, 1.0, True)
+        assert store.delete_series(k)
+        assert store.num_series == 0
+        assert not store.delete_series(k)

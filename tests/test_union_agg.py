@@ -1,0 +1,144 @@
+"""Golden-value tests for the union-timestamp aggregation kernel.
+
+Semantics mirror /root/reference/src/core/AggregationIterator.java and
+test/core/TestAggregationIterator.java: output at the union of timestamps,
+LERP (with Java long division in pure-int groups), ZIM/MAX/MIN sentinel
+policies, and series participating only within their [first, last] range.
+"""
+
+import numpy as np
+
+from opentsdb_tpu.ops.aggregators import get_agg
+from opentsdb_tpu.ops.union_agg import union_aggregate, grid_aggregate
+from tests.kernel_utils import batch, collect
+
+
+def run(series, agg_name, int_mode=False):
+    ts, val, mask = batch(series)
+    u, out, umask = union_aggregate(ts, val, mask, get_agg(agg_name),
+                                    int_mode=int_mode)
+    return collect(u, out, umask)
+
+
+class TestAlignedSeries:
+    def test_sum_two_aligned(self):
+        out = run([([1000, 2000, 3000], [1, 2, 3]),
+                   ([1000, 2000, 3000], [10, 20, 30])], "sum")
+        assert out == [(1000, 11.0), (2000, 22.0), (3000, 33.0)]
+
+    def test_min_max_avg(self):
+        series = [([1000, 2000], [1, 4]), ([1000, 2000], [3, 2])]
+        assert run(series, "min") == [(1000, 1.0), (2000, 2.0)]
+        assert run(series, "max") == [(1000, 3.0), (2000, 4.0)]
+        assert run(series, "avg") == [(1000, 2.0), (2000, 3.0)]
+
+    def test_single_series_passthrough(self):
+        out = run([([1000, 2000, 3000], [5, 6, 7])], "sum")
+        assert out == [(1000, 5.0), (2000, 6.0), (3000, 7.0)]
+
+
+class TestLerp:
+    def test_lerp_float(self):
+        # Series B has no point at t=2000; lerp between (1000,10) and (3000,30).
+        out = run([([2000], [100.0]),
+                   ([1000, 3000], [10.0, 30.0])], "sum")
+        # Union = {1000, 2000, 3000}. At 1000 and 3000 only B is in range for A?
+        # A's range is [2000,2000] so A only contributes at 2000.
+        assert out == [(1000, 10.0), (2000, 120.0), (3000, 30.0)]
+
+    def test_lerp_int_truncating_division(self):
+        # Java: y0 + (x-x0)*(y1-y0)/(x1-x0) with long division.
+        # Series B at t=1000 has 1, at t=4000 has 2. At x=2000:
+        # 1 + (1000*1)/3000 = 1 + 0 = 1 (truncated).
+        out = run([([2000], [10]),
+                   ([1000, 4000], [1, 2])], "sum", int_mode=True)
+        vals = dict(out)
+        assert vals[2000] == 11.0  # 10 + 1, not 10 + 1.333
+
+    def test_out_of_range_excluded(self):
+        # Series A covers [1000,2000], B covers [3000,4000]; no overlap:
+        # each timestamp aggregates only the in-range series.
+        out = run([([1000, 2000], [1, 2]),
+                   ([3000, 4000], [10, 20])], "sum")
+        assert out == [(1000, 1.0), (2000, 2.0), (3000, 10.0), (4000, 20.0)]
+
+    def test_empty_series_ignored(self):
+        out = run([([1000], [5.0]), ([], [])], "sum")
+        assert out == [(1000, 5.0)]
+
+
+class TestPolicies:
+    def test_zimsum_fills_zero(self):
+        out = run([([1000, 3000], [1, 3]),
+                   ([2000], [10])], "zimsum")
+        # At 2000: series A in range but missing -> 0; B -> 10; sum = 10.
+        assert out == [(1000, 1.0), (2000, 10.0), (3000, 3.0)]
+
+    def test_mimmin_ignores_missing(self):
+        out = run([([1000, 3000], [5, 7]),
+                   ([2000], [10])], "mimmin")
+        # At 2000: A missing -> +MAX sentinel loses min; result 10.
+        assert out == [(1000, 5.0), (2000, 10.0), (3000, 7.0)]
+
+    def test_mimmax_ignores_missing(self):
+        out = run([([1000, 3000], [5, 7]),
+                   ([2000], [1])], "mimmax")
+        assert out == [(1000, 5.0), (2000, 1.0), (3000, 7.0)]
+
+    def test_count_zim_quirk(self):
+        # COUNT uses ZIM: a series missing-but-in-range contributes a zero
+        # value that still gets counted (Aggregators.java:108-113 warning).
+        out = run([([1000, 3000], [1, 3]),
+                   ([2000], [10])], "count")
+        assert out == [(1000, 1.0), (2000, 2.0), (3000, 1.0)]
+
+
+class TestMoreAggregators:
+    def test_dev_across_series(self):
+        out = run([([1000], [2.0]), ([1000], [4.0]), ([1000], [6.0])], "dev")
+        assert len(out) == 1
+        np.testing.assert_allclose(out[0][1], 2.0)  # stddev of 2,4,6
+
+    def test_median_upper(self):
+        out = run([([1000], [1.0]), ([1000], [2.0]),
+                   ([1000], [3.0]), ([1000], [4.0])], "median")
+        assert out == [(1000, 3.0)]  # sorted[n//2] = upper median
+
+    def test_mult(self):
+        out = run([([1000], [3.0]), ([1000], [4.0])], "mult")
+        assert out == [(1000, 12.0)]
+
+    def test_p99_legacy(self):
+        vals = [float(i) for i in range(1, 101)]
+        series = [([1000], [v]) for v in vals]
+        out = run(series, "p99")
+        # commons-math legacy: pos = 99*(101)/100 = 99.99 ->
+        # lower=sorted[98]=99, d=0.99 -> 99 + .99*(100-99) = 99.99
+        np.testing.assert_allclose(out[0][1], 99.99)
+
+    def test_squaresum(self):
+        out = run([([1000], [3.0]), ([1000], [4.0])], "squareSum")
+        assert out == [(1000, 25.0)]
+
+
+class TestGridFastPath:
+    def test_matches_union_on_grid(self):
+        rng = np.random.default_rng(0)
+        grid = np.arange(0, 10_000, 1000, dtype=np.int64)
+        s = 5
+        val = rng.normal(size=(s, len(grid)))
+        mask = rng.random((s, len(grid))) > 0.3
+        # Ensure each row has at least two valid points.
+        mask[:, 0] = True
+        mask[:, -1] = True
+        for agg in ("sum", "avg", "min", "max", "zimsum", "mimmin", "mimmax",
+                    "count", "dev", "mult"):
+            gts, gout, gmask = grid_aggregate(grid, val, mask, get_agg(agg))
+            # Build the equivalent ragged series and run the general kernel.
+            series = [(grid[mask[i]].tolist(), val[i][mask[i]].tolist())
+                      for i in range(s)]
+            got = run(series, agg)
+            want = collect(gts, gout, gmask)
+            np.testing.assert_allclose(
+                [v for _, v in got], [v for _, v in want], rtol=1e-12,
+                err_msg=agg)
