@@ -27,11 +27,39 @@ from jax import lax
 
 from opentsdb_tpu.ops.aggregators import (
     Aggregator, LERP, ZIM, MAX_IF_MISSING, MIN_IF_MISSING, PREV)
+from opentsdb_tpu.ops.rate import _prev_valid_index
 
 _PAD = jnp.iinfo(jnp.int64).max
 _F64_MAX = jnp.finfo(jnp.float64).max
 _I64_MAX = jnp.iinfo(jnp.int64).max
 _I64_MIN = jnp.iinfo(jnp.int64).min
+
+
+def interpolate(policy: str, int_mode: bool, x, x0, y0, x1, y1, exemplar):
+    """Missing-point substitute per interpolation policy at timestamps x.
+
+    The vectorized form of AggregationIterator.nextLongValue (:682) /
+    nextDoubleValue (:735): LERP between the bracketing points (Java
+    truncating long division in int mode), ZIM -> 0, MAX/MIN -> type
+    sentinels, PREV -> previous value.  `exemplar` fixes the output
+    shape/dtype for the constant policies.
+    """
+    if policy == LERP:
+        if int_mode:
+            dx = jnp.maximum(x1 - x0, 1)
+            return y0 + lax.div((x - x0) * (y1 - y0), dx)
+        dx = (x1 - x0).astype(jnp.float64)
+        dx = jnp.where(dx == 0, 1.0, dx)
+        return y0 + (x - x0).astype(jnp.float64) * (y1 - y0) / dx
+    if policy == ZIM:
+        return jnp.zeros_like(exemplar)
+    if policy == MAX_IF_MISSING:
+        return jnp.full_like(exemplar, _I64_MAX if int_mode else _F64_MAX)
+    if policy == MIN_IF_MISSING:
+        return jnp.full_like(exemplar, _I64_MIN if int_mode else -_F64_MAX)
+    if policy == PREV:
+        return y0
+    raise ValueError("Invalid interpolation: " + policy)
 
 
 def union_timestamps(ts, mask):
@@ -74,27 +102,7 @@ def _series_contribution(ts_row, val_row, mask_row, u, policy: str,
 
     in_range = nonempty & (u >= first_ts) & (u <= last_ts)
 
-    if policy == LERP:
-        if int_mode:
-            # Java long lerp: y0 + (x-x0)*(y1-y0)/(x1-x0), truncating division
-            # (AggregationIterator.java:707).
-            dx = jnp.maximum(x1 - x0, 1)
-            interp = y0 + lax.div((u - x0) * (y1 - y0), dx)
-        else:
-            dx = (x1 - x0).astype(jnp.float64)
-            dx = jnp.where(dx == 0, 1.0, dx)
-            interp = y0 + (u - x0).astype(jnp.float64) * (y1 - y0) / dx
-    elif policy == ZIM:
-        interp = jnp.zeros_like(v_exact)
-    elif policy == MAX_IF_MISSING:
-        interp = jnp.full_like(v_exact, _I64_MAX if int_mode else _F64_MAX)
-    elif policy == MIN_IF_MISSING:
-        interp = jnp.full_like(v_exact, _I64_MIN if int_mode else -_F64_MAX)
-    elif policy == PREV:
-        interp = y0
-    else:
-        raise ValueError("Invalid interpolation: " + policy)
-
+    interp = interpolate(policy, int_mode, u, x0, y0, x1, y1, v_exact)
     contrib = jnp.where(exact, v_exact, interp)
     return contrib, in_range
 
@@ -132,14 +140,6 @@ def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False):
     return u, out, u_mask
 
 
-def _prev_valid(mask):
-    n = mask.shape[1]
-    pos = jnp.where(mask, jnp.arange(n, dtype=jnp.int64)[None, :], -1)
-    running = lax.associative_scan(jnp.maximum, pos, axis=1)
-    return jnp.concatenate(
-        [jnp.full((mask.shape[0], 1), -1, jnp.int64), running[:, :-1]], axis=1)
-
-
 def _next_valid(mask):
     n = mask.shape[1]
     big = jnp.asarray(n, jnp.int64)
@@ -160,7 +160,7 @@ def grid_aggregate(grid_ts, val, mask, agg: Aggregator, int_mode: bool = False):
     any_mask = mask.any(axis=0)
     work_val = val if not int_mode else val.astype(jnp.int64)
 
-    prev_i = _prev_valid(mask)
+    prev_i = _prev_valid_index(mask)
     next_i = _next_valid(mask)
     has_prev = prev_i >= 0
     has_next = next_i < w
@@ -175,25 +175,8 @@ def grid_aggregate(grid_ts, val, mask, agg: Aggregator, int_mode: bool = False):
 
     in_range = has_prev & has_next | mask
 
-    if agg.interpolation == LERP:
-        if int_mode:
-            dx = jnp.maximum(x1 - x0, 1)
-            interp = y0 + lax.div((x - x0) * (y1 - y0), dx)
-        else:
-            dx = (x1 - x0).astype(jnp.float64)
-            dx = jnp.where(dx == 0, 1.0, dx)
-            interp = y0 + (x - x0).astype(jnp.float64) * (y1 - y0) / dx
-    elif agg.interpolation == ZIM:
-        interp = jnp.zeros_like(work_val)
-    elif agg.interpolation == MAX_IF_MISSING:
-        interp = jnp.full_like(work_val, _I64_MAX if int_mode else _F64_MAX)
-    elif agg.interpolation == MIN_IF_MISSING:
-        interp = jnp.full_like(work_val, _I64_MIN if int_mode else -_F64_MAX)
-    elif agg.interpolation == PREV:
-        interp = y0
-    else:
-        raise ValueError("Invalid interpolation: " + agg.interpolation)
-
+    interp = interpolate(agg.interpolation, int_mode, x, x0, y0, x1, y1,
+                         work_val)
     contrib = jnp.where(mask, work_val, interp)
     out = agg.reduce(contrib, in_range)
     return grid_ts, out, any_mask

@@ -1,0 +1,224 @@
+"""shard_map query kernels: cross-chip downsample + group-by aggregation.
+
+Reference behavior being re-expressed (not translated): the group-by
+aggregation fan-out of TsdbQuery.GroupByAndAggregateCB
+(/root/reference/src/core/TsdbQuery.java:981-1114) over the salt-bucket
+scatter/gather of SaltScanner (/root/reference/src/core/SaltScanner.java:269).
+Each HBase salt bucket scanned concurrently becomes a series shard owned by
+one chip; the TreeMap merge of per-bucket results becomes XLA collectives:
+window moments (count/sum/sumsq/min/max) are computed per chip with segment
+reductions, then combined over ICI with `psum`/`pmax`/`pmin` inside
+`shard_map`.  The time axis is additionally sharded (sequence parallelism)
+— window moments are associative over time, so time shards combine with the
+same collectives, no halo exchange needed.
+
+Aggregators with non-decomposable moments (percentiles/median/first/last/mult)
+fall back to the single-device path; a mergeable-sketch percentile is the
+planned round-2 extension (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from opentsdb_tpu.ops.downsample import (
+    WindowSpec, window_ids, window_timestamps)
+from opentsdb_tpu.parallel.mesh import AXIS_SERIES, AXIS_TIME
+
+_BOTH = (AXIS_SERIES, AXIS_TIME)
+
+# Cross-chip aggregators expressible as psum/pmax/pmin-combinable moments.
+SHARDED_AGGS = frozenset({
+    "sum", "zimsum", "count", "avg", "min", "mimmin", "max", "mimmax",
+    "dev", "squareSum"})
+
+
+def _group_moments(ts, val, mask, gid, num_groups: int, spec: WindowSpec,
+                   wargs: dict):
+    """Per-chip (count, sum, min, max) over (group, window) cells + helpers.
+
+    Returns (seg, ok_flat, flat_v, count, total) with count/total already
+    psum-combined across the mesh; min/max are computed lazily by callers.
+    """
+    s, n = ts.shape
+    w = spec.count
+    num = num_groups * w + 1
+    nwin = wargs["nwin"]
+
+    win = window_ids(ts, spec, wargs)
+    valid = mask & (win >= 0) & (win < nwin.astype(win.dtype))
+    vf = val.astype(jnp.float64)
+    ok = valid & ~jnp.isnan(vf)
+    seg = jnp.where(ok, gid[:, None].astype(jnp.int64) * w
+                    + jnp.clip(win, 0, w - 1), num_groups * w)
+    seg = seg.reshape(-1)
+    ok_flat = ok.reshape(-1)
+    flat_v = jnp.where(ok_flat, vf.reshape(-1), 0.0)
+
+    count = jax.ops.segment_sum(ok_flat.astype(jnp.int64), seg,
+                                num_segments=num)[:-1]
+    total = jax.ops.segment_sum(flat_v, seg, num_segments=num)[:-1]
+    count = lax.psum(count, _BOTH)
+    total = lax.psum(total, _BOTH)
+    return seg, ok_flat, flat_v, count, total, num
+
+
+def _finish(agg_name, seg, ok_flat, flat_v, count, total, num,
+            num_groups, w):
+    """Combine cross-chip moments into the final [G, W] aggregate."""
+    g = num_groups
+    cnt = count.reshape(g, w)
+    tot = total.reshape(g, w)
+    safe = jnp.maximum(cnt, 1)
+
+    if agg_name in ("sum", "zimsum"):
+        out = tot
+    elif agg_name == "count":
+        out = cnt.astype(jnp.float64)
+    elif agg_name == "avg":
+        out = tot / safe
+    elif agg_name == "squareSum":
+        sq = jax.ops.segment_sum(flat_v * flat_v, seg, num_segments=num)[:-1]
+        out = lax.psum(sq, _BOTH).reshape(g, w)
+    elif agg_name in ("min", "mimmin"):
+        lo = jax.ops.segment_min(jnp.where(ok_flat, flat_v, jnp.inf), seg,
+                                 num_segments=num)[:-1]
+        out = lax.pmin(lo, _BOTH).reshape(g, w)
+    elif agg_name in ("max", "mimmax"):
+        hi = jax.ops.segment_max(jnp.where(ok_flat, flat_v, -jnp.inf), seg,
+                                 num_segments=num)[:-1]
+        out = lax.pmax(hi, _BOTH).reshape(g, w)
+    elif agg_name == "dev":
+        # Second pass with the *global* mean (ICI round-trip already paid by
+        # the psum of count/total): numerically the two-pass scheme the
+        # reference's Welford loop approximates (Aggregators.java:498).
+        mean = (tot / safe).reshape(-1)
+        mean_pp = mean[jnp.clip(seg, 0, g * w - 1)]
+        centered = jnp.where(ok_flat, flat_v - mean_pp, 0.0)
+        m2 = jax.ops.segment_sum(centered * centered, seg,
+                                 num_segments=num)[:-1]
+        m2 = lax.psum(m2, _BOTH).reshape(g, w)
+        out = jnp.where(cnt >= 2, jnp.sqrt(m2 / jnp.maximum(cnt - 1, 1)), 0.0)
+    else:
+        raise KeyError("Aggregator %r has no cross-chip decomposition; "
+                       "use the single-device path" % agg_name)
+    return out, cnt
+
+
+def sharded_group_downsample(mesh: Mesh, agg_name: str, spec: WindowSpec,
+                             num_groups: int):
+    """Build the jitted sharded step: [S,N] batch -> [G,W] group aggregates.
+
+    fn(ts, val, mask, gid, wargs) with ts/val/mask sharded (series, time),
+    gid sharded (series,); returns replicated
+    (window_ts[W], out[G, W], out_mask[G, W]).
+    """
+    if agg_name not in SHARDED_AGGS:
+        raise KeyError("Aggregator %r has no cross-chip decomposition"
+                       % agg_name)
+    w = spec.count
+
+    def step(ts, val, mask, gid, wargs):
+        seg, ok_flat, flat_v, count, total, num = _group_moments(
+            ts, val, mask, gid, num_groups, spec, wargs)
+        out, cnt = _finish(agg_name, seg, ok_flat, flat_v, count, total,
+                           num, num_groups, w)
+        live = jnp.arange(w, dtype=jnp.int64)[None, :] \
+            < wargs["nwin"].astype(jnp.int64)
+        out_mask = (cnt > 0) & live
+        out = jnp.where(out_mask, out, jnp.nan)
+        wts = window_timestamps(spec, wargs)
+        return wts, out, out_mask
+
+    mapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(AXIS_SERIES, AXIS_TIME), P(AXIS_SERIES, AXIS_TIME),
+                  P(AXIS_SERIES, AXIS_TIME), P(AXIS_SERIES), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def sharded_rollup(mesh: Mesh, spec: WindowSpec):
+    """Build the sharded offline rollup pass (BASELINE config 5).
+
+    fn(ts, val, mask, wargs) -> per-series (window_ts[W], sum[S,W],
+    count[S,W], min[S,W], max[S,W]) with the series axis still sharded on
+    the way out (out_specs keep P(series)) — each chip materializes the
+    rollup rows for the series it owns, the write-path analog of
+    TSDB.addAggregatePoint (/root/reference/src/core/TSDB.java:1359-1457)
+    batched over every interval at once.  Time shards combine with psum /
+    pmin / pmax over the time axis only.
+    """
+    w = spec.count
+
+    def step(ts, val, mask, wargs):
+        s, n = ts.shape
+        num = s * w + 1
+        nwin = wargs["nwin"]
+        win = window_ids(ts, spec, wargs)
+        valid = mask & (win >= 0) & (win < nwin.astype(win.dtype))
+        vf = val.astype(jnp.float64)
+        ok = valid & ~jnp.isnan(vf)
+        rows = jnp.arange(s, dtype=jnp.int64)[:, None]
+        seg = jnp.where(ok, rows * w + jnp.clip(win, 0, w - 1),
+                        s * w).reshape(-1)
+        okf = ok.reshape(-1)
+        flat = jnp.where(okf, vf.reshape(-1), 0.0)
+
+        cnt = jax.ops.segment_sum(okf.astype(jnp.int64), seg,
+                                  num_segments=num)[:-1]
+        tot = jax.ops.segment_sum(flat, seg, num_segments=num)[:-1]
+        lo = jax.ops.segment_min(jnp.where(okf, flat, jnp.inf), seg,
+                                 num_segments=num)[:-1]
+        hi = jax.ops.segment_max(jnp.where(okf, flat, -jnp.inf), seg,
+                                 num_segments=num)[:-1]
+        cnt = lax.psum(cnt, AXIS_TIME).reshape(s, w)
+        tot = lax.psum(tot, AXIS_TIME).reshape(s, w)
+        lo = lax.pmin(lo, AXIS_TIME).reshape(s, w)
+        hi = lax.pmax(hi, AXIS_TIME).reshape(s, w)
+        wts = window_timestamps(spec, wargs)
+        return wts, tot, cnt, lo, hi
+
+    mapped = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(AXIS_SERIES, AXIS_TIME), P(AXIS_SERIES, AXIS_TIME),
+                  P(AXIS_SERIES, AXIS_TIME), P()),
+        out_specs=(P(), P(AXIS_SERIES), P(AXIS_SERIES), P(AXIS_SERIES),
+                   P(AXIS_SERIES)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def shard_series(mesh: Mesh, ts: np.ndarray, val: np.ndarray,
+                 mask: np.ndarray, gid: np.ndarray):
+    """Pad a host batch to mesh-divisible shape and device_put with shardings.
+
+    Pads S up to a multiple of the series-axis size and N to the time-axis
+    size (padding rows have mask False / group 0), then places each array
+    with its NamedSharding so the jitted shard_map consumes it zero-copy.
+    """
+    n_s = mesh.shape[AXIS_SERIES]
+    n_t = mesh.shape[AXIS_TIME]
+    s, n = ts.shape
+    s_pad = -(-s // n_s) * n_s
+    n_pad = -(-n // n_t) * n_t
+    if (s_pad, n_pad) != (s, n):
+        pad_ts = np.full((s_pad, n_pad), np.iinfo(np.int64).max, np.int64)
+        pad_val = np.zeros((s_pad, n_pad), val.dtype)
+        pad_mask = np.zeros((s_pad, n_pad), bool)
+        pad_gid = np.zeros(s_pad, gid.dtype)
+        pad_ts[:s, :n] = ts
+        pad_val[:s, :n] = val
+        pad_mask[:s, :n] = mask
+        pad_gid[:s] = gid
+        ts, val, mask, gid = pad_ts, pad_val, pad_mask, pad_gid
+    data_sh = NamedSharding(mesh, P(AXIS_SERIES, AXIS_TIME))
+    gid_sh = NamedSharding(mesh, P(AXIS_SERIES))
+    return (jax.device_put(ts, data_sh), jax.device_put(val, data_sh),
+            jax.device_put(mask, data_sh), jax.device_put(gid, gid_sh))

@@ -22,7 +22,10 @@ from opentsdb_tpu.ops.downsample import FixedWindows, EdgeWindows, AllWindow
 from opentsdb_tpu.ops.pipeline import (
     PipelineSpec, DownsampleStep, run_pipeline, build_batch)
 from opentsdb_tpu.storage.memstore import Series, SeriesKey
+from opentsdb_tpu.uid import NoSuchUniqueName
 from opentsdb_tpu.utils import datetime_util as DT
+
+_NO_MATCH = object()  # sentinel: a literal filter can never match
 
 
 @dataclass
@@ -88,14 +91,52 @@ class QueryRunner:
 
         metric_uid = tsdb.metrics.get_id(sub.metric)
         candidates = tsdb.store.series_for_metric(metric_uid)
+        uid_constraints = self._literal_uid_constraints(sub.filters)
+        if uid_constraints is _NO_MATCH:
+            return []
         out = []
         filter_tagks = {f.tagk for f in sub.filters}
         for series in candidates:
+            if uid_constraints:
+                key_tags = dict(series.key.tags)
+                if any(key_tags.get(ku) not in vuids
+                       for ku, vuids in uid_constraints):
+                    continue
             tags = tsdb.resolve_key_tags(series.key)
             if sub.explicit_tags and set(tags) != filter_tagks:
                 continue
             if all(f.match(tags) for f in sub.filters):
                 out.append((series, tags))
+        return out
+
+    def _literal_uid_constraints(self, filters):
+        """Compile literal filters to (tagk_uid, tagv_uid_set) pre-filters.
+
+        The UID-space pruning role of the reference's in-scan row regex
+        (TsdbQuery.createAndSetFilter :1683): series failing a literal_or
+        constraint are skipped before any UID->string resolution.  Returns
+        _NO_MATCH when a constraint cannot match anything (unknown tagk, or
+        no listed value exists in the tagv dictionary).
+        """
+        tsdb = self.tsdb
+        out = []
+        for f in filters:
+            values = f.literal_values()
+            if values is None:
+                continue
+            try:
+                ku = tsdb.tag_names.get_id(f.tagk)
+            except NoSuchUniqueName:
+                return _NO_MATCH
+            vuids = set()
+            for v in values:
+                try:
+                    vuids.add(tsdb.tag_values.get_id(v))
+                except NoSuchUniqueName:
+                    pass
+            if not vuids:
+                return _NO_MATCH
+            out.append((ku, vuids))
         return out
 
     @staticmethod
@@ -160,6 +201,11 @@ class QueryRunner:
         else:
             window_spec, wargs = None, None
 
+        # Query-scoped, not group-scoped: fetch once outside the group loop.
+        global_notes = (tsdb.store.get_annotations(
+            "", query.start_time, query.end_time)
+            if query.global_annotations else [])
+
         results = []
         for group_key in sorted(groups, key=lambda k: tuple(map(str, k))):
             members = groups[group_key]
@@ -194,9 +240,6 @@ class QueryRunner:
                 for t in tsuids:
                     annotations.extend(tsdb.store.get_annotations(
                         t, query.start_time, query.end_time))
-            global_notes = (tsdb.store.get_annotations(
-                "", query.start_time, query.end_time)
-                if query.global_annotations else [])
             results.append(QueryResult(
                 metric=sub.metric or (
                     tsdb.metrics.get_name(members[0][0].key.metric)

@@ -116,20 +116,25 @@ class Series:
         m = len(ts_ms)
         if m == 0:
             return
+        values = np.asarray(values)
+        if np.isscalar(is_int) or isinstance(is_int, bool):
+            isint = np.full(m, bool(is_int))
+        else:
+            isint = np.asarray(is_int, dtype=bool)
+        if np.issubdtype(values.dtype, np.integer):
+            ival = values
+        else:
+            # Float-typed arrays may still carry integer points; the int
+            # column must hold their exact values wherever isint is set.
+            ival = np.where(isint, values.astype(np.int64), 0)
         with self._lock:
             need = self._n + m
             if need > len(self._ts):
                 self._grow(need)
             self._ts[self._n:need] = ts_ms
             self._val[self._n:need] = values
-            if np.issubdtype(np.asarray(values).dtype, np.integer):
-                self._ival[self._n:need] = values
-            else:
-                self._ival[self._n:need] = 0
-            if np.isscalar(is_int) or isinstance(is_int, bool):
-                self._isint[self._n:need] = bool(is_int)
-            else:
-                self._isint[self._n:need] = is_int
+            self._ival[self._n:need] = ival
+            self._isint[self._n:need] = isint
             incoming_sorted = bool(m == 1 or bool(np.all(np.diff(ts_ms) > 0)))
             if self._sorted and (not incoming_sorted or
                                  (self._n and ts_ms[0] <= self._ts[self._n - 1])):
@@ -145,19 +150,23 @@ class Series:
         timestamps raise like the reference's IllegalDataException.
         """
         with self._lock:
-            if self._sorted:
-                self._dedup_sorted(fix_duplicates)
-                return
-            n = self._n
-            # stable sort keeps insertion order within equal timestamps, so the
-            # last write for a timestamp is the last element of its run.
-            order = np.argsort(self._ts[:n], kind="stable")
-            self._ts[:n] = self._ts[:n][order]
-            self._val[:n] = self._val[:n][order]
-            self._ival[:n] = self._ival[:n][order]
-            self._isint[:n] = self._isint[:n][order]
-            self._sorted = True
-            self._dedup_sorted(fix_duplicates)
+            self._normalize_locked(fix_duplicates)
+
+    def _normalize_locked(self, fix_duplicates: bool) -> None:
+        # _sorted means strictly increasing (append flags <=-ties as dirty),
+        # so a sorted series has no duplicates either — nothing to do.
+        if self._sorted:
+            return
+        n = self._n
+        # stable sort keeps insertion order within equal timestamps, so the
+        # last write for a timestamp is the last element of its run.
+        order = np.argsort(self._ts[:n], kind="stable")
+        self._ts[:n] = self._ts[:n][order]
+        self._val[:n] = self._val[:n][order]
+        self._ival[:n] = self._ival[:n][order]
+        self._isint[:n] = self._isint[:n][order]
+        self._sorted = True
+        self._dedup_sorted(fix_duplicates)
 
     def _dedup_sorted(self, fix_duplicates: bool) -> None:
         n = self._n
@@ -189,9 +198,11 @@ class Series:
 
         Copies, not views: normalize() mutates the buffers in place and a
         background compaction flush may run while a query thread reads.
+        Normalization and the binary search happen under one lock hold so a
+        concurrent out-of-order append cannot invalidate the sort mid-read.
         """
-        self.normalize(fix_duplicates)
         with self._lock:
+            self._normalize_locked(fix_duplicates)
             n = self._n
             lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
             hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))

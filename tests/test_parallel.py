@@ -1,0 +1,150 @@
+"""Sharded (multi-chip) kernels vs numpy reference on a virtual 8-CPU mesh.
+
+Mirrors the reference's salted-vs-unsalted duplicate suites (SURVEY.md §4:
+TestSaltScannerSalted etc.): the same aggregation answers must come back no
+matter how the data is sharded.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops.downsample import FixedWindows
+from opentsdb_tpu.parallel import (
+    make_mesh, mesh_shape_for, sharded_group_downsample, sharded_rollup,
+    shard_series, SHARDED_AGGS)
+
+S, N = 16, 256
+G = 4
+START = 1_356_998_400_000  # 2013-01-01
+INTERVAL = 60_000
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must fake 8 CPU devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def batch():
+    r = np.random.default_rng(7)
+    # Strictly increasing per row: cumulative offsets avoid duplicate ts.
+    ts = START + np.cumsum(r.integers(1_000, 30_000, size=(S, N)),
+                           axis=1).astype(np.int64)
+    val = r.normal(100.0, 25.0, size=(S, N))
+    mask = r.random((S, N)) < 0.9
+    gid = (np.arange(S) % G).astype(np.int64)
+    return ts, val, mask, gid
+
+
+def numpy_group_downsample(ts, val, mask, gid, agg, windows):
+    w = windows.count
+    out = np.full((G, w), np.nan)
+    counts = np.zeros((G, w), dtype=np.int64)
+    buckets = {}
+    win = (ts - windows.first_window_ms) // windows.interval_ms
+    for s in range(S):
+        for i in range(N):
+            if not mask[s, i]:
+                continue
+            k = int(win[s, i])
+            if not 0 <= k < w:
+                continue
+            buckets.setdefault((gid[s], k), []).append(val[s, i])
+    for (g, k), vs in buckets.items():
+        vs = np.asarray(vs)
+        counts[g, k] = len(vs)
+        if agg == "sum":
+            out[g, k] = vs.sum()
+        elif agg == "count":
+            out[g, k] = len(vs)
+        elif agg == "avg":
+            out[g, k] = vs.mean()
+        elif agg == "min":
+            out[g, k] = vs.min()
+        elif agg == "max":
+            out[g, k] = vs.max()
+        elif agg == "dev":
+            out[g, k] = vs.std(ddof=1) if len(vs) >= 2 else 0.0
+        elif agg == "squareSum":
+            out[g, k] = (vs * vs).sum()
+    return out, counts
+
+
+@pytest.mark.parametrize("agg", ["sum", "count", "avg", "min", "max", "dev",
+                                 "squareSum"])
+def test_sharded_group_downsample_matches_numpy(mesh, batch, agg):
+    ts, val, mask, gid = batch
+    windows = FixedWindows.for_range(int(ts[mask].min()), int(ts[mask].max()),
+                                     INTERVAL)
+    spec, wargs = windows.split()
+    fn = sharded_group_downsample(mesh, agg, spec, G)
+    d_ts, d_val, d_mask, d_gid = shard_series(mesh, ts, val, mask, gid)
+    wts, out, out_mask = jax.device_get(fn(d_ts, d_val, d_mask, d_gid, wargs))
+
+    expect, counts = numpy_group_downsample(ts, val, mask, gid, agg, windows)
+    w = windows.count
+    np.testing.assert_array_equal(np.asarray(out_mask)[:, :w] != 0,
+                                  counts > 0)
+    got = np.asarray(out)[:, :w]
+    live = counts > 0
+    np.testing.assert_allclose(got[live], expect[live], rtol=1e-9, atol=1e-9)
+
+
+def test_sharded_matches_any_mesh_shape(batch):
+    """Same answers on 8x1, 4x2, 2x4 meshes — sharding-invariance."""
+    ts, val, mask, gid = batch
+    windows = FixedWindows.for_range(int(ts[mask].min()), int(ts[mask].max()),
+                                     INTERVAL)
+    spec, wargs = windows.split()
+    outs = []
+    for shape in [(8, 1), (4, 2), (2, 4)]:
+        mesh = make_mesh(8, shape=shape)
+        fn = sharded_group_downsample(mesh, "avg", spec, G)
+        args = shard_series(mesh, ts, val, mask, gid)
+        _, out, _ = jax.device_get(fn(*args, wargs))
+        outs.append(np.asarray(out))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-12, equal_nan=True)
+    np.testing.assert_allclose(outs[0], outs[2], rtol=1e-12, equal_nan=True)
+
+
+def test_sharded_rollup(mesh, batch):
+    ts, val, mask, _ = batch
+    windows = FixedWindows.for_range(int(ts[mask].min()), int(ts[mask].max()),
+                                     3_600_000)
+    spec, wargs = windows.split()
+    fn = sharded_rollup(mesh, spec)
+    gid = np.zeros(S, dtype=np.int64)
+    d_ts, d_val, d_mask, _ = shard_series(mesh, ts, val, mask, gid)
+    wts, tot, cnt, lo, hi = jax.device_get(fn(d_ts, d_val, d_mask, wargs))
+
+    w = windows.count
+    win = (ts - windows.first_window_ms) // windows.interval_ms
+    for s in range(S):
+        for k in range(w):
+            sel = mask[s] & (win[s] == k)
+            assert int(np.asarray(cnt)[s, k]) == int(sel.sum())
+            if sel.any():
+                np.testing.assert_allclose(np.asarray(tot)[s, k],
+                                           val[s][sel].sum(), rtol=1e-9)
+                np.testing.assert_allclose(np.asarray(lo)[s, k],
+                                           val[s][sel].min(), rtol=1e-12)
+                np.testing.assert_allclose(np.asarray(hi)[s, k],
+                                           val[s][sel].max(), rtol=1e-12)
+
+
+def test_mesh_shape_for():
+    assert mesh_shape_for(1) == (1, 1)
+    assert mesh_shape_for(2) == (2, 1)
+    assert mesh_shape_for(4) == (2, 2)
+    assert mesh_shape_for(8) == (4, 2)
+    s, t = mesh_shape_for(16)
+    assert s * t == 16
+
+
+def test_unsupported_agg_raises(mesh):
+    spec, _ = FixedWindows.for_range(0, 10_000, 1000).split()
+    with pytest.raises(KeyError):
+        sharded_group_downsample(mesh, "p99", spec, 2)
+    assert "p99" not in SHARDED_AGGS

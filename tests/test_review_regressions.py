@@ -66,3 +66,41 @@ class TestTsuidWidths:
         series = tsdb.store.all_series()[0]
         # 4-byte metric + 3-byte tagk + 3-byte tagv = 20 hex chars.
         assert len(tsdb.tsuid(series.key)) == 20
+
+
+class TestAppendBatchIntFlag:
+    def test_float_dtype_with_int_flag_keeps_values(self):
+        """Float-typed arrays of integral points must not zero the int column."""
+        import numpy as np
+        from opentsdb_tpu.storage.memstore import Series, SeriesKey
+        s = Series(SeriesKey.make(1, {1: 1}))
+        s.append_batch(np.array([1000, 2000], dtype=np.int64),
+                       np.array([7.0, 9.0]), True)
+        ts, fv, iv, isint = s.arrays()
+        assert iv.tolist() == [7, 9]
+        assert isint.all()
+
+    def test_mixed_int_flags(self):
+        import numpy as np
+        from opentsdb_tpu.storage.memstore import Series, SeriesKey
+        s = Series(SeriesKey.make(1, {1: 1}))
+        s.append_batch(np.array([1000, 2000], dtype=np.int64),
+                       np.array([7.0, 9.5]),
+                       np.array([True, False]))
+        ts, fv, iv, isint = s.arrays()
+        assert iv.tolist() == [7, 0]
+        assert fv.tolist() == [7.0, 9.5]
+        assert isint.tolist() == [True, False]
+
+
+class TestLiteralUidPruning:
+    def test_unknown_tag_value_literal_returns_empty(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.utils.config import Config
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        tsdb.add_point("m", 1_356_998_400, 1, {"host": "a"})
+        q = TSQuery(start="1356998300", end="1356998500",
+                    queries=[parse_m_subquery("sum:m{host=zzz}")])
+        q.validate()
+        assert tsdb.new_query_runner().run(q) == []
