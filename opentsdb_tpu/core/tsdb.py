@@ -55,8 +55,17 @@ class TSDB:
         self.store = MemStore(
             salt_buckets=self.config.salt_buckets,
             fix_duplicates=self.config.fix_duplicates)
-        self.rollup_config = None   # set by rollup.RollupConfig.from_config
-        self.rollup_store: dict = {}
+        from opentsdb_tpu.rollup import RollupConfig, RollupStore
+        self.rollup_config = RollupConfig.from_config(self.config)
+        self.rollup_store = (
+            RollupStore(self.rollup_config, self.config.salt_buckets)
+            if self.rollup_config is not None else None)
+        self.agg_tag_key = self.config.get_string("tsd.rollups.agg_tag_key")
+        self.raw_agg_tag_value = self.config.get_string(
+            "tsd.rollups.raw_agg_tag_value")
+        self.tag_raw_data = self.config.get_bool("tsd.rollups.tag_raw")
+        self.rollups_block_derived = self.config.get_bool(
+            "tsd.rollups.block_derived")
         self.histogram_manager = None
         self.rt_publisher = None    # RTPublisher plugin
         self.storage_exception_handler = None
@@ -99,6 +108,11 @@ class TSDB:
                 metric, timestamp, num, tags):
             return
         ts_ms = normalize_timestamp_ms(timestamp)
+        if self.rollup_store is not None and self.tag_raw_data:
+            # tsd.rollups.tag_raw: mark raw series with the agg tag so they
+            # coexist with pre-aggregates (TSDB.addPointInternal :1471-1480).
+            tags = dict(tags)
+            tags[self.agg_tag_key] = self.raw_agg_tag_value
         key = self._series_key(metric, tags, create=True)
         self.store.add_point(key, ts_ms, num, is_int)
         with self._stats_lock:
@@ -133,6 +147,69 @@ class TSDB:
             uid_tags = {self.tag_names.get_id(k): self.tag_values.get_id(v)
                         for k, v in tags.items()}
         return SeriesKey.make(metric_uid, uid_tags)
+
+    # ------------------------------------------------------------------ #
+    # Rollup write path (TSDB.addAggregatePoint :1359-1457)              #
+    # ------------------------------------------------------------------ #
+
+    def add_aggregate_point(self, metric: str, timestamp: int | float, value,
+                            tags: dict[str, str], is_groupby: bool,
+                            interval: str | None, rollup_aggregator: str | None,
+                            groupby_aggregator: str | None = None) -> None:
+        """Store one rolled-up and/or pre-aggregated datapoint.
+
+        Reference behavior (TSDB.addAggregatePointInternal): with `interval`
+        the value goes to that interval's rollup lane under
+        `rollup_aggregator`; with `is_groupby` it goes to a pre-agg lane and
+        the aggregate tag (tsd.rollups.agg_tag_key) is forced to the
+        uppercased group-by aggregator.  NaN/Inf floats are rejected.
+        """
+        if self.rollup_store is None:
+            raise RuntimeError("Rollups are not enabled "
+                               "(tsd.rollups.enable=false)")
+        if self.mode == "ro":
+            raise RuntimeError("TSD is in read-only mode, writes rejected")
+        is_int, num = parse_value(value)
+        if interval:
+            # Raises NoSuchRollupForInterval for unconfigured intervals.
+            self.rollup_config.get_rollup_interval(interval)
+            if not rollup_aggregator:
+                raise ValueError("Missing rollup aggregator for interval %s"
+                                 % interval)
+            if (self.rollups_block_derived
+                    and rollup_aggregator.upper() in ("AVG", "DEV")):
+                # tsd.rollups.block_derived (TSDB.java:1562-1569)
+                raise ValueError(
+                    "Derived rollup aggregations are not allowed: %s"
+                    % rollup_aggregator)
+            self.rollup_config.get_id_for_aggregator(rollup_aggregator)
+        elif not is_groupby:
+            raise ValueError(
+                "Either an interval or the groupby flag is required")
+        tags = dict(tags)
+        if is_groupby:
+            if not groupby_aggregator:
+                raise ValueError("Missing group-by aggregator")
+            from opentsdb_tpu.ops.aggregators import AGGREGATORS
+            if groupby_aggregator.lower() not in AGGREGATORS:
+                raise ValueError("Invalid group by aggregator: %s"
+                                 % groupby_aggregator)
+            if (self.rollups_block_derived
+                    and groupby_aggregator.upper() in ("AVG", "DEV")):
+                # TSDB.java:1543-1550
+                raise ValueError(
+                    "Derived group by aggregations are not allowed: %s"
+                    % groupby_aggregator)
+            tags[self.agg_tag_key] = groupby_aggregator.upper()
+        self.check_timestamp_and_tags(metric, timestamp, num, tags)
+        ts_ms = normalize_timestamp_ms(timestamp)
+        key = self._series_key(metric, tags, create=True)
+        lane_agg = (rollup_aggregator if interval else groupby_aggregator)
+        self.rollup_store.add_point(
+            key, interval or "", lane_agg.lower(), ts_ms, num, is_int,
+            pre_agg=is_groupby)
+        with self._stats_lock:
+            self.datapoints_added += 1
 
     # ------------------------------------------------------------------ #
     # Read helpers                                                       #

@@ -241,10 +241,24 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
         raise KeyError("No such downsampling function: " + agg_name)
 
     wts = window_timestamps(spec, wargs)
+    out, out_mask = apply_fill(out, out_mask, live, fill_policy, fill_value,
+                               fdtype)
+    return wts, out, out_mask
 
+
+def apply_fill(out, out_mask, live, fill_policy: str, fill_value: float,
+               fdtype=None):
+    """Fill empty live windows per FillPolicy (FillingDownsampler semantics).
+
+    `out_mask` marks windows holding data; `live` marks windows inside the
+    query range.  Returns (values, mask) — under FILL_NONE empty windows stay
+    masked out; other policies substitute a fill value and expose every live
+    window.  Shared by the raw downsample above and the rollup-avg pipeline.
+    """
+    if fdtype is None:
+        fdtype = out.dtype
     if fill_policy == FILL_NONE:
-        out = jnp.where(out_mask, out, jnp.nan)
-        return wts, out, out_mask
+        return jnp.where(out_mask, out, jnp.nan), out_mask
     if fill_policy == FILL_ZERO:
         fill = jnp.asarray(0.0, fdtype)
     elif fill_policy in (FILL_NAN, FILL_NULL):
@@ -254,7 +268,7 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
     else:
         raise ValueError("Unrecognized fill policy: " + fill_policy)
     out = jnp.where(out_mask, out, fill)
-    return wts, out, jnp.broadcast_to(live, out_mask.shape)
+    return out, jnp.broadcast_to(live, out_mask.shape)
 
 
 def parse_percentile_name(name: str) -> tuple[float, str]:

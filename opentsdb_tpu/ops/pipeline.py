@@ -18,7 +18,7 @@ import numpy as np
 
 from opentsdb_tpu.ops.aggregators import get_agg, Aggregator, PREV
 from opentsdb_tpu.ops.downsample import (
-    downsample, WindowSpec, FixedWindows, EdgeWindows, AllWindow,
+    downsample, apply_fill, WindowSpec, FixedWindows, EdgeWindows, AllWindow,
     window_timestamps, pad_pow2, FILL_NONE)
 from opentsdb_tpu.ops.rate import rate, RateOptions
 from opentsdb_tpu.ops.union_agg import union_aggregate, grid_aggregate
@@ -73,6 +73,47 @@ _jitted = jax.jit(_pipeline, static_argnums=0)
 def run_pipeline(spec: PipelineSpec, ts, val, mask, wargs: dict | None = None):
     """Execute the pipeline; returns (out_ts, out_val, out_mask) on device."""
     return _jitted(spec, ts, val, mask, wargs or {})
+
+
+def _rollup_avg_pipeline(spec: PipelineSpec, ts_s, val_s, mask_s,
+                         ts_c, val_c, mask_c, wargs):
+    """Rollup-average read: sum lane / count lane, then the normal tail.
+
+    Reference behavior: Downsampler.java:155-210 — when reading an `avg`
+    rollup the downsampler consumes paired sum and count cells and divides.
+    Here both lanes downsample with segment-sum, the per-window quotient
+    becomes the per-series value, then rate/fill/cross-series aggregation
+    proceed exactly like the raw pipeline.
+    """
+    step = spec.downsample
+    wts, sums, msum = downsample(ts_s, val_s, mask_s, "sum", step.window_spec,
+                                 wargs, FILL_NONE)
+    _, cnts, mcnt = downsample(ts_c, val_c, mask_c, "sum", step.window_spec,
+                               wargs, FILL_NONE)
+    ok = msum & mcnt & (cnts > 0)
+    v = jnp.where(ok, sums / jnp.where(ok, cnts, 1.0), jnp.nan)
+    # Fill policy over empty live windows (FillingDownsampler semantics).
+    nwin = wargs["nwin"]
+    live = jnp.arange(v.shape[-1]) < nwin
+    v, m = apply_fill(v, ok, live[None, :], step.fill_policy,
+                      step.fill_value)
+    grid = jnp.asarray(wts)
+    agg = get_agg(spec.aggregator)
+    if spec.rate is not None:
+        agg = Aggregator(agg.name, PREV, agg.reduce)
+        grid_b = jnp.broadcast_to(grid[None, :], v.shape)
+        _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
+    return grid_aggregate(grid, v, m, agg, int_mode=False)
+
+
+_jitted_rollup_avg = jax.jit(_rollup_avg_pipeline, static_argnums=0)
+
+
+def run_rollup_avg_pipeline(spec: PipelineSpec, ts_s, val_s, mask_s,
+                            ts_c, val_c, mask_c, wargs: dict | None = None):
+    """Execute the rollup-avg pipeline (sum lane + count lane batches)."""
+    return _jitted_rollup_avg(spec, ts_s, val_s, mask_s, ts_c, val_c, mask_c,
+                              wargs or {})
 
 
 def build_batch(windows: list, pad_to_pow2: bool = True):

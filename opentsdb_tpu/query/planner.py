@@ -20,12 +20,44 @@ import numpy as np
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
 from opentsdb_tpu.ops.downsample import FixedWindows, EdgeWindows, AllWindow
 from opentsdb_tpu.ops.pipeline import (
-    PipelineSpec, DownsampleStep, run_pipeline, build_batch)
+    PipelineSpec, DownsampleStep, run_pipeline, run_rollup_avg_pipeline,
+    build_batch)
+from opentsdb_tpu.rollup.config import NoSuchRollupForInterval, RollupQuery
 from opentsdb_tpu.storage.memstore import Series, SeriesKey
 from opentsdb_tpu.uid import NoSuchUniqueName
 from opentsdb_tpu.utils import datetime_util as DT
 
 _NO_MATCH = object()  # sentinel: a literal filter can never match
+
+# Downsample function -> (rollup lane, function applied over lane cells).
+# Counts re-reduce with SUM; min/max/sum re-reduce with themselves
+# (RollupUtils qualifiers hold one aggregator's cells per lane).
+_ROLLUP_LANES = {
+    "sum": ("sum", "sum"),
+    "zimsum": ("sum", "zimsum"),
+    "count": ("count", "sum"),
+    "min": ("min", "min"),
+    "mimmin": ("min", "mimmin"),
+    "max": ("max", "max"),
+    "mimmax": ("max", "mimmax"),
+}
+
+
+@dataclass
+class Segment:
+    """One data-source slice of a sub query's time range.
+
+    The split-rollup machinery (SplitRollupQuery.java) reduced to data: a
+    rollup table serves [start, boundary) under its SLA, raw data serves the
+    blackout tail.  kind: "raw" | "rollup" | "rollup_avg".
+    """
+    kind: str
+    start_ms: int
+    end_ms: int
+    lane: object = None        # MemStore: rollup lane (sum lane for rollup_avg)
+    count_lane: object = None  # MemStore: count lane for rollup_avg
+    ds_function: str | None = None   # downsample fn override over lane cells
+    rollup_query: RollupQuery | None = None
 
 
 @dataclass
@@ -78,19 +110,22 @@ class QueryRunner:
 
     # -- series selection ------------------------------------------------
 
-    def _resolve_series(self, sub: TSSubQuery) -> list[tuple[Series, dict]]:
+    def _resolve_series(self, sub: TSSubQuery, store=None
+                        ) -> list[tuple[Series, dict]]:
         """All series matching the sub query, with resolved tag maps."""
         tsdb = self.tsdb
+        if store is None:
+            store = tsdb.store
         if sub.tsuids:
             wanted = {t.upper() for t in sub.tsuids}
             out = []
-            for series in tsdb.store.all_series():
+            for series in store.all_series():
                 if tsdb.tsuid(series.key) in wanted:
                     out.append((series, tsdb.resolve_key_tags(series.key)))
             return out
 
         metric_uid = tsdb.metrics.get_id(sub.metric)
-        candidates = tsdb.store.series_for_metric(metric_uid)
+        candidates = store.series_for_metric(metric_uid)
         uid_constraints = self._literal_uid_constraints(sub.filters)
         if uid_constraints is _NO_MATCH:
             return []
@@ -190,9 +225,110 @@ class QueryRunner:
         return FixedWindows.for_range(query.start_time, query.end_time,
                                       spec.interval_ms)
 
-    def run_sub(self, query: TSQuery, sub: TSSubQuery) -> list[QueryResult]:
+    # -- rollup source selection (TsdbQuery.transformDownSamplerToRollupQuery
+    #    :1733, ROLLUP_USAGE :197, SplitRollupQuery) ----------------------
+
+    def _rollup_candidates(self, sub: TSSubQuery):
+        """Rollup intervals able to serve this sub query, best first."""
         tsdb = self.tsdb
-        series_tags = self._resolve_series(sub)
+        ds = sub.downsample_spec
+        usage = (sub.rollup_usage or "ROLLUP_NOFALLBACK").upper()
+        if (tsdb.rollup_config is None or tsdb.rollup_store is None
+                or ds is None or ds.run_all or ds.use_calendar
+                or ds.interval_ms <= 0 or usage == "ROLLUP_RAW"
+                or sub.tsuids):
+            return [], usage
+        if ds.function != "avg" and ds.function not in _ROLLUP_LANES:
+            return [], usage
+        try:
+            matches = tsdb.rollup_config.get_best_matches_ms(ds.interval_ms)
+        except (NoSuchRollupForInterval, ValueError):
+            return [], usage
+        matches = [m for m in matches if not m.default_interval]
+        if not matches:
+            return [], usage
+        if usage == "ROLLUP_NOFALLBACK":
+            matches = matches[:1]
+        return matches, usage
+
+    def _segment_for_interval(self, sub: TSSubQuery, interval,
+                              start_ms: int, end_ms: int) -> Segment | None:
+        """A rollup Segment over [start, end] if the lanes hold data."""
+        tsdb = self.tsdb
+        ds = sub.downsample_spec
+        try:
+            metric_uid = tsdb.metrics.get_id(sub.metric)
+        except NoSuchUniqueName:
+            return None
+        pre = sub.pre_aggregate
+        if ds.function == "avg":
+            sum_lane = tsdb.rollup_store.peek_lane(interval.interval, "sum",
+                                                   pre)
+            cnt_lane = tsdb.rollup_store.peek_lane(interval.interval, "count",
+                                                   pre)
+            if (sum_lane is None or cnt_lane is None
+                    or not sum_lane.series_for_metric(metric_uid)
+                    or not cnt_lane.series_for_metric(metric_uid)):
+                return None
+            rq = RollupQuery(interval, "avg", ds.interval_ms, sub.aggregator)
+            return Segment("rollup_avg", start_ms, end_ms, lane=sum_lane,
+                           count_lane=cnt_lane, ds_function="sum",
+                           rollup_query=rq)
+        lane_agg, ds_fn = _ROLLUP_LANES[ds.function]
+        lane = tsdb.rollup_store.peek_lane(interval.interval, lane_agg, pre)
+        if lane is None or not lane.series_for_metric(metric_uid):
+            return None
+        rq = RollupQuery(interval, ds.function, ds.interval_ms,
+                         sub.aggregator)
+        return Segment("rollup", start_ms, end_ms, lane=lane,
+                       ds_function=ds_fn, rollup_query=rq)
+
+    def _plan_segments(self, query: TSQuery, sub: TSSubQuery) -> list[Segment]:
+        start_ms, end_ms = query.start_time, query.end_time
+        raw = Segment("raw", start_ms, end_ms)
+        candidates, usage = self._rollup_candidates(sub)
+        chosen = None
+        for interval in candidates:
+            chosen = self._segment_for_interval(sub, interval, start_ms,
+                                                end_ms)
+            if chosen is not None:
+                break
+        if chosen is None:
+            if not candidates or usage == "ROLLUP_FALLBACK_RAW":
+                return [raw]
+            # NOFALLBACK/FALLBACK with empty rollup lanes -> empty result,
+            # never a silent raw scan (ROLLUP_USAGE :197-201).
+            return []
+        rq = chosen.rollup_query
+        tsdb = self.tsdb
+        if (tsdb.config.get_bool("tsd.rollups.split_query.enable")
+                and rq.rollup_interval.delay_sla_ms > 0):
+            now_ms = DT.current_time_millis()
+            boundary = rq.last_guaranteed_ms(now_ms)
+            ds = sub.downsample_spec
+            # Align down to the downsample grid so no window spans sources.
+            boundary -= boundary % ds.interval_ms
+            if boundary <= start_ms:
+                return [raw]            # whole range is blacked out
+            if boundary <= end_ms:
+                chosen.end_ms = boundary - 1
+                return [chosen,
+                        Segment("raw", boundary, end_ms)]
+        return [chosen]
+
+    # -- segment execution ----------------------------------------------
+
+    def _run_segment(self, query: TSQuery, sub: TSSubQuery, seg: Segment,
+                     global_notes: list) -> dict[tuple, QueryResult]:
+        tsdb = self.tsdb
+        if seg.kind == "raw":
+            store = tsdb.store
+            if sub.pre_aggregate and tsdb.rollup_store is not None:
+                pre = tsdb.rollup_store.peek_lane("", sub.aggregator, True)
+                store = pre if pre is not None else store
+        else:
+            store = seg.lane
+        series_tags = self._resolve_series(sub, store)
         groups = self._group(series_tags, sub)
         windows = self._windows_for(sub, query)
 
@@ -201,35 +337,47 @@ class QueryRunner:
         else:
             window_spec, wargs = None, None
 
-        # Query-scoped, not group-scoped: fetch once outside the group loop.
-        global_notes = (tsdb.store.get_annotations(
-            "", query.start_time, query.end_time)
-            if query.global_annotations else [])
-
-        results = []
+        results: dict[tuple, QueryResult] = {}
         for group_key in sorted(groups, key=lambda k: tuple(map(str, k))):
             members = groups[group_key]
             batch_windows = [
-                s.window(query.start_time, query.end_time,
+                s.window(seg.start_ms, seg.end_ms,
                          tsdb.config.fix_duplicates)
                 for s, _ in members]
             ts, val, mask, all_int = build_batch(batch_windows)
-            int_mode = all_int and sub.downsample_spec is None
+            int_mode = (all_int and sub.downsample_spec is None
+                        and seg.kind == "raw")
+            ds = sub.downsample_spec
             spec = PipelineSpec(
                 aggregator=sub.aggregator,
                 downsample=(DownsampleStep(
-                    sub.downsample_spec.function, window_spec,
-                    sub.downsample_spec.fill_policy,
-                    sub.downsample_spec.fill_value)
-                    if sub.downsample_spec is not None else None),
+                    seg.ds_function or ds.function, window_spec,
+                    ds.fill_policy, ds.fill_value)
+                    if ds is not None else None),
                 rate=sub.rate_options if sub.rate else None,
                 int_mode=int_mode)
-            out_ts, out_val, out_mask = run_pipeline(spec, ts, val, mask,
-                                                     wargs)
+            if seg.kind == "rollup_avg":
+                cnt_windows = []
+                for s, _ in members:
+                    cs = seg.count_lane.get_series(s.key)
+                    if cs is None:
+                        cnt_windows.append(
+                            (np.empty(0, np.int64), np.empty(0, np.float64),
+                             np.empty(0, np.int64), np.empty(0, bool)))
+                    else:
+                        cnt_windows.append(cs.window(
+                            seg.start_ms, seg.end_ms,
+                            tsdb.config.fix_duplicates))
+                tc, vc, mc, _ = build_batch(cnt_windows)
+                out_ts, out_val, out_mask = run_rollup_avg_pipeline(
+                    spec, ts, val, mask, tc, vc, mc, wargs)
+            else:
+                out_ts, out_val, out_mask = run_pipeline(spec, ts, val, mask,
+                                                         wargs)
 
             dps = extract_dps(np.asarray(out_ts), np.asarray(out_val),
-                              np.asarray(out_mask), query.start_time,
-                              query.end_time,
+                              np.asarray(out_mask), seg.start_ms,
+                              seg.end_ms,
                               int_mode and not sub.rate,
                               keep_nans=sub.fill_policy != "none")
 
@@ -240,7 +388,7 @@ class QueryRunner:
                 for t in tsuids:
                     annotations.extend(tsdb.store.get_annotations(
                         t, query.start_time, query.end_time))
-            results.append(QueryResult(
+            results[tuple(map(str, group_key))] = QueryResult(
                 metric=sub.metric or (
                     tsdb.metrics.get_name(members[0][0].key.metric)
                     if members else ""),
@@ -251,8 +399,36 @@ class QueryRunner:
                 annotations=annotations,
                 global_annotations=global_notes,
                 index=sub.index,
-            ))
+            )
         return results
+
+    def run_sub(self, query: TSQuery, sub: TSSubQuery) -> list[QueryResult]:
+        segments = self._plan_segments(query, sub)
+        # Query-scoped: fetch once, shared by every segment and group.
+        global_notes = (self.tsdb.store.get_annotations(
+            "", query.start_time, query.end_time)
+            if query.global_annotations else [])
+        merged: dict[tuple, QueryResult] = {}
+        for seg in segments:
+            for gk, qr in self._run_segment(query, sub, seg,
+                                            global_notes).items():
+                cur = merged.get(gk)
+                if cur is None:
+                    merged[gk] = qr
+                    continue
+                # Split stitch (SplitRollupSpanGroup): segments are time-
+                # disjoint, so concatenation in segment order is sorted.
+                cur.dps = cur.dps + qr.dps
+                new_tsuids = [t for t in qr.tsuids if t not in cur.tsuids]
+                cur.tsuids.extend(new_tsuids)
+                seen_notes = {id(a) for a in cur.annotations}
+                cur.annotations.extend(
+                    a for a in qr.annotations if id(a) not in seen_notes)
+                cur.tags = {k: v for k, v in cur.tags.items()
+                            if qr.tags.get(k) == v}
+                cur.aggregate_tags = sorted(
+                    set(cur.aggregate_tags) | set(qr.aggregate_tags))
+        return [merged[k] for k in sorted(merged)]
 
     def run(self, query: TSQuery) -> list[QueryResult]:
         out = []

@@ -1,0 +1,15 @@
+"""Rollup / pre-aggregation subsystem.
+
+Reference behavior: /root/reference/src/rollup/ — RollupConfig.java (interval
+registry + aggregation-ID map), RollupInterval.java (interval/table schema),
+RollupQuery.java (query-time state + blackout SLA), RollupUtils.java
+(qualifier codec, replaced here by columnar per-aggregator stores).
+"""
+
+from opentsdb_tpu.rollup.config import (
+    RollupInterval, RollupConfig, RollupQuery,
+    NoSuchRollupForInterval, NoSuchRollupForTable)
+from opentsdb_tpu.rollup.store import RollupStore
+
+__all__ = ["RollupInterval", "RollupConfig", "RollupQuery", "RollupStore",
+           "NoSuchRollupForInterval", "NoSuchRollupForTable"]

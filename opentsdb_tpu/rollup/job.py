@@ -1,0 +1,92 @@
+"""Offline rollup builder: raw store -> per-interval rollup lanes on the mesh.
+
+The batch analog of feeding TSDB.addAggregatePoint from an external rollup
+pipeline (/root/reference/src/core/TSDB.java:1359-1457): scan every raw
+series, compute sum/count/min/max per rollup window on the device mesh
+(parallel.sharded.sharded_rollup — series sharded across chips, time shards
+combined with psum/pmin/pmax over ICI), then write the window cells into the
+RollupStore lanes.  BASELINE config 5's 1B-point pass is this function over a
+larger mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from opentsdb_tpu.ops.downsample import FixedWindows
+from opentsdb_tpu.ops.pipeline import build_batch, PAD_TS
+from opentsdb_tpu.parallel.mesh import make_mesh
+from opentsdb_tpu.parallel.sharded import sharded_rollup, shard_series
+
+
+def run_rollup_job(tsdb, intervals: list[str] | None = None,
+                   start_ms: int | None = None, end_ms: int | None = None,
+                   mesh=None, batch_series: int = 1024) -> dict[str, int]:
+    """Roll every raw series up into the given intervals; returns counts.
+
+    Writes sum/count/min/max lanes for each interval so any supported
+    downsample function (and avg via sum+count) can be served from rollups.
+    """
+    if tsdb.rollup_store is None:
+        raise RuntimeError("Rollups are not enabled")
+    if intervals is None:
+        intervals = [ri.interval for ri in tsdb.rollup_config.intervals
+                     if not ri.default_interval]
+    if mesh is None:
+        mesh = make_mesh()
+    all_series = tsdb.store.all_series()
+    if not all_series:
+        return {i: 0 for i in intervals}
+
+    if start_ms is None or end_ms is None:
+        lo, hi = None, None
+        for s in all_series:
+            ts, _, _, _ = s.arrays()
+            if len(ts):
+                lo = int(ts.min()) if lo is None else min(lo, int(ts.min()))
+                hi = int(ts.max()) if hi is None else max(hi, int(ts.max()))
+        if lo is None:
+            return {i: 0 for i in intervals}
+        start_ms = lo if start_ms is None else start_ms
+        end_ms = hi if end_ms is None else end_ms
+
+    written: dict[str, int] = {}
+    for interval in intervals:
+        ri = tsdb.rollup_config.get_rollup_interval(interval)
+        plan = FixedWindows.for_range(start_ms, end_ms, ri.interval_ms)
+        spec, wargs = plan.split()
+        step = sharded_rollup(mesh, spec)
+        count = 0
+        for base in range(0, len(all_series), batch_series):
+            chunk = all_series[base:base + batch_series]
+            windows = [s.window(start_ms, end_ms, True) for s in chunk]
+            ts, val, mask, _ = build_batch(windows)
+            val = val.astype(np.float64)
+            gid = np.zeros(ts.shape[0], np.int32)
+            ts_d, val_d, mask_d, _ = shard_series(mesh, ts, val, mask, gid)
+            wts, tot, cnt, lo, hi = step(ts_d, val_d, mask_d, wargs)
+            wts = np.asarray(wts)
+            tot = np.asarray(tot)[:len(chunk)]
+            cnt = np.asarray(cnt)[:len(chunk)]
+            lo = np.asarray(lo)[:len(chunk)]
+            hi = np.asarray(hi)[:len(chunk)]
+            nwin = plan.count
+            live = (wts[:nwin] != PAD_TS)
+            for i, series in enumerate(chunk):
+                has = (cnt[i, :nwin] > 0) & live
+                if not has.any():
+                    continue
+                w = wts[:nwin][has]
+                key = series.key
+                lanes = tsdb.rollup_store
+                lanes.lane(interval, "sum").add_batch(
+                    key, w, tot[i, :nwin][has], False)
+                lanes.lane(interval, "count").add_batch(
+                    key, w, cnt[i, :nwin][has].astype(np.int64), True)
+                lanes.lane(interval, "min").add_batch(
+                    key, w, lo[i, :nwin][has], False)
+                lanes.lane(interval, "max").add_batch(
+                    key, w, hi[i, :nwin][has], False)
+                count += int(has.sum())
+        written[interval] = count
+    return written

@@ -1,0 +1,81 @@
+"""Columnar rollup storage: one MemStore per (interval, aggregator) lane.
+
+Reference behavior: rollup tables tsdb-rollup-<interval> keyed by the same
+row-key schema with "agg:offset" qualifiers (RollupUtils.buildRollupQualifier,
+/root/reference/src/rollup/RollupUtils.java:120-178) plus pre-agg "-agg"
+tables.  The columnar rebuild drops the qualifier codec: each (interval,
+aggregator) pair is its own MemStore keyed by the same SeriesKey, so a query
+for `1h sum` is a plain store lookup and avg reads pair the sum and count
+lanes (Downsampler.java:155-210 rollup branch).
+
+Pre-aggregates (is_groupby, TSDB.addAggregatePointInternal) land in a
+per-interval pre-agg lane set; interval-less pre-aggs use the reference's
+"default table" convention and are stored under the raw interval "".
+"""
+
+from __future__ import annotations
+
+import threading
+
+from opentsdb_tpu.rollup.config import RollupConfig, ROLLUP_AGGS
+from opentsdb_tpu.storage.memstore import MemStore, SeriesKey
+
+
+class RollupStore:
+    """All rollup + pre-agg lanes for one TSDB."""
+
+    def __init__(self, config: RollupConfig, salt_buckets: int = 20):
+        self.config = config
+        self.salt_buckets = salt_buckets
+        self._lanes: dict[tuple[str, str, bool], MemStore] = {}
+        self._lock = threading.Lock()
+
+    def lane(self, interval: str, aggregator: str,
+             pre_agg: bool = False) -> MemStore:
+        """The MemStore holding `aggregator` cells of `interval` rollups."""
+        aggregator = aggregator.lower()
+        # Temporal rollup lanes must map to a configured aggregation id
+        # (RollupUtils qualifier codec); pre-agg lanes accept any group-by
+        # aggregator the registry knows (TSDB.java:1536-1542).
+        if not pre_agg and aggregator not in self.config.aggregation_ids:
+            raise ValueError("No ID for aggregator: %s" % aggregator)
+        key = (interval, aggregator, pre_agg)
+        with self._lock:
+            store = self._lanes.get(key)
+            if store is None:
+                store = MemStore(salt_buckets=self.salt_buckets)
+                self._lanes[key] = store
+            return store
+
+    def peek_lane(self, interval: str, aggregator: str,
+                  pre_agg: bool = False) -> MemStore | None:
+        with self._lock:
+            return self._lanes.get((interval, aggregator.lower(), pre_agg))
+
+    def add_point(self, key: SeriesKey, interval: str, aggregator: str,
+                  ts_ms: int, value, is_int: bool,
+                  pre_agg: bool = False) -> None:
+        self.lane(interval, aggregator, pre_agg).add_point(
+            key, ts_ms, value, is_int)
+
+    def lanes(self) -> list[tuple[str, str, bool]]:
+        with self._lock:
+            return sorted(self._lanes)
+
+    @property
+    def total_datapoints(self) -> int:
+        with self._lock:
+            return sum(s.total_datapoints for s in self._lanes.values())
+
+    def collect_stats(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        with self._lock:
+            for (interval, agg, pre), store in self._lanes.items():
+                name = "tsd.rollup.datapoints interval=%s agg=%s%s" % (
+                    interval or "preagg", agg, " preagg" if pre else "")
+                out[name] = store.total_datapoints
+        return out
+
+    @staticmethod
+    def supported_aggs() -> tuple[str, ...]:
+        return ROLLUP_AGGS

@@ -1,0 +1,297 @@
+"""Rollup subsystem tests: config registry, ingest, rollup-aware reads,
+fallback policies, blackout split, and the offline rollup job.
+
+Models the reference's TestRollupConfig/TestRollupInterval/
+TestTsdbQueryRollup patterns (write rollup cells, assert query-path values).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.rollup import (
+    RollupConfig, RollupInterval, RollupQuery, NoSuchRollupForInterval)
+from opentsdb_tpu.rollup.job import run_rollup_job
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400          # seconds, top of an hour
+BASE_MS = BASE * 1000
+
+
+def make_tsdb(**extra):
+    props = {"tsd.core.auto_create_metrics": True,
+             "tsd.rollups.enable": True}
+    props.update(extra)
+    return TSDB(Config(props))
+
+
+def run_query(tsdb, m, start=str(BASE), end=str(BASE + 7200), **kw):
+    q = TSQuery(start=start, end=end, queries=[parse_m_subquery(m)], **kw)
+    q.validate()
+    return tsdb.new_query_runner().run(q)
+
+
+class TestRollupConfig:
+    def test_default_intervals(self):
+        tsdb = make_tsdb()
+        names = [i.interval for i in tsdb.rollup_config.intervals]
+        assert names == ["1m", "1h", "1d"]
+
+    def test_get_interval(self):
+        cfg = RollupConfig(intervals=[
+            RollupInterval("10m", "t-10m", "t-10m-agg")])
+        assert cfg.get_rollup_interval("10m").table == "t-10m"
+        with pytest.raises(NoSuchRollupForInterval):
+            cfg.get_rollup_interval("5m")
+
+    def test_best_matches_order(self):
+        cfg = RollupConfig(intervals=[
+            RollupInterval("1m", "a", "a2"),
+            RollupInterval("10m", "b", "b2"),
+            RollupInterval("1h", "c", "c2")])
+        # 1 day divides by all three -> widest first.
+        matches = cfg.get_best_matches(86400)
+        assert [m.interval for m in matches] == ["1h", "10m", "1m"]
+        # 30 minutes -> 10m and 1m only.
+        matches = cfg.get_best_matches(1800)
+        assert [m.interval for m in matches] == ["10m", "1m"]
+        with pytest.raises(NoSuchRollupForInterval):
+            cfg.get_best_matches(7)
+
+    def test_aggregation_ids(self):
+        cfg = RollupConfig()
+        assert cfg.get_id_for_aggregator("SUM") == 0
+        assert cfg.get_aggregator_for_id(1) == "count"
+        with pytest.raises(ValueError):
+            cfg.get_id_for_aggregator("p99")
+
+    def test_from_json(self):
+        cfg = RollupConfig.from_json(
+            '{"aggregationIds": {"sum": 0, "max": 1}, "intervals": '
+            '[{"interval": "1h", "table": "tsdb-1h", '
+            '"preAggregationTable": "tsdb-1h-agg", "delaySla": 3600000}]}')
+        ri = cfg.get_rollup_interval("1h")
+        assert ri.delay_sla_ms == 3_600_000
+        assert cfg.get_id_for_aggregator("max") == 1
+
+    def test_sub_second_interval_no_crash(self):
+        # A 500ms rollup interval must not divide-by-zero the second-based
+        # lookup, and ms math must reject 1500ms vs 1s-style mismatches.
+        cfg = RollupConfig(intervals=[
+            RollupInterval("500ms", "a", "a2"),
+            RollupInterval("1s", "b", "b2")])
+        matches = cfg.get_best_matches_ms(1500)
+        assert [m.interval for m in matches] == ["500ms"]
+        matches = cfg.get_best_matches_ms(2000)
+        assert [m.interval for m in matches] == ["1s", "500ms"]
+
+    def test_blackout(self):
+        ri = RollupInterval("1h", "t", "t2", delay_sla_ms=3_600_000)
+        rq = RollupQuery(ri, "sum", 3_600_000)
+        now = BASE_MS + 10 * 3_600_000
+        assert rq.is_in_blackout(now - 1000, now)
+        assert not rq.is_in_blackout(now - 2 * 3_600_000, now)
+
+
+class TestRollupIngest:
+    def test_add_aggregate_point(self):
+        tsdb = make_tsdb()
+        tsdb.add_aggregate_point("sys.cpu", BASE, 42, {"host": "a"},
+                                 False, "1h", "sum")
+        lane = tsdb.rollup_store.peek_lane("1h", "sum")
+        assert lane is not None and lane.total_datapoints == 1
+
+    def test_requires_interval_or_groupby(self):
+        tsdb = make_tsdb()
+        with pytest.raises(ValueError):
+            tsdb.add_aggregate_point("sys.cpu", BASE, 1, {"h": "a"},
+                                     False, None, "sum")
+
+    def test_unknown_interval_rejected(self):
+        tsdb = make_tsdb()
+        with pytest.raises(NoSuchRollupForInterval):
+            tsdb.add_aggregate_point("sys.cpu", BASE, 1, {"h": "a"},
+                                     False, "7m", "sum")
+
+    def test_groupby_adds_agg_tag(self):
+        tsdb = make_tsdb()
+        tsdb.add_aggregate_point("sys.cpu", BASE, 5, {"host": "a"},
+                                 True, None, None, "sum")
+        lane = tsdb.rollup_store.peek_lane("", "sum", True)
+        series = lane.all_series()
+        assert len(series) == 1
+        tags = tsdb.resolve_key_tags(series[0].key)
+        assert tags["_aggregate"] == "SUM"
+
+    def test_block_derived(self):
+        tsdb = make_tsdb()  # tsd.rollups.block_derived defaults true
+        with pytest.raises(ValueError, match="Derived rollup"):
+            tsdb.add_aggregate_point("m", BASE, 1, {"h": "a"}, False,
+                                     "1h", "avg")
+        with pytest.raises(ValueError, match="Derived group by"):
+            tsdb.add_aggregate_point("m", BASE, 1, {"h": "a"}, True,
+                                     None, None, "dev")
+        ok = make_tsdb(**{"tsd.rollups.block_derived": False})
+        ok.add_aggregate_point("m", BASE, 1, {"h": "a"}, True,
+                               None, None, "dev")
+
+    def test_tag_raw(self):
+        tsdb = make_tsdb(**{"tsd.rollups.tag_raw": True})
+        tsdb.add_point("m", BASE, 1, {"host": "a"})
+        series = tsdb.store.all_series()
+        assert len(series) == 1
+        assert tsdb.resolve_key_tags(series[0].key)["_aggregate"] == "RAW"
+
+    def test_disabled_raises(self):
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        with pytest.raises(RuntimeError):
+            tsdb.add_aggregate_point("m", BASE, 1, {"h": "a"}, False,
+                                     "1h", "sum")
+
+
+class TestRollupRead:
+    """Rollup-aware query path (TsdbQuery.transformDownSamplerToRollupQuery)."""
+
+    def _seed_rollups(self, tsdb, hours=4):
+        # 1h sum/count cells for one series: hour i has sum=10*i, count=5.
+        for i in range(hours):
+            ts = BASE + i * 3600
+            tsdb.add_aggregate_point("sys.cpu", ts, 10 * i, {"host": "a"},
+                                     False, "1h", "sum")
+            tsdb.add_aggregate_point("sys.cpu", ts, 5, {"host": "a"},
+                                     False, "1h", "count")
+            tsdb.add_aggregate_point("sys.cpu", ts, i, {"host": "a"},
+                                     False, "1h", "min")
+            tsdb.add_aggregate_point("sys.cpu", ts, 100 + i, {"host": "a"},
+                                     False, "1h", "max")
+
+    def test_sum_served_from_rollups(self):
+        tsdb = make_tsdb()
+        self._seed_rollups(tsdb)
+        res = run_query(tsdb, "sum:1h-sum:sys.cpu",
+                        end=str(BASE + 4 * 3600))
+        assert len(res) == 1
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS + 3_600_000] == 10.0
+        assert vals[BASE_MS + 2 * 3_600_000] == 20.0
+
+    def test_avg_pairs_sum_and_count(self):
+        tsdb = make_tsdb()
+        self._seed_rollups(tsdb)
+        res = run_query(tsdb, "sum:1h-avg:sys.cpu",
+                        end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        # avg of hour i = 10*i / 5 = 2*i
+        assert vals[BASE_MS + 3_600_000] == 2.0
+        assert vals[BASE_MS + 3 * 3_600_000] == 6.0
+
+    def test_min_max_lanes(self):
+        tsdb = make_tsdb()
+        self._seed_rollups(tsdb)
+        res = run_query(tsdb, "sum:1h-min:sys.cpu", end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS + 2 * 3_600_000] == 2.0
+        res = run_query(tsdb, "sum:1h-max:sys.cpu", end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS + 2 * 3_600_000] == 102.0
+
+    def test_coarser_downsample_re_reduces(self):
+        # 2h-sum over 1h rollup cells: windows pair up.
+        tsdb = make_tsdb()
+        self._seed_rollups(tsdb)
+        res = run_query(tsdb, "sum:2h-sum:sys.cpu", end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS] == 10.0            # hours 0+1
+        assert vals[BASE_MS + 2 * 3_600_000] == 50.0  # hours 2+3
+
+    def test_rollup_raw_usage_scans_raw(self):
+        tsdb = make_tsdb()
+        self._seed_rollups(tsdb)
+        # Raw data differs from the rollup cells; ROLLUP_RAW must use it.
+        for i in range(4):
+            tsdb.add_point("sys.cpu", BASE + i * 3600, 1000, {"host": "a"})
+        res = run_query(tsdb, "sum:1h-sum:rollup_raw:sys.cpu",
+                        end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS] == 1000
+
+    def test_nofallback_empty_when_no_rollups(self):
+        tsdb = make_tsdb()
+        for i in range(4):
+            tsdb.add_point("sys.cpu", BASE + i * 3600, 7, {"host": "a"})
+        res = run_query(tsdb, "sum:1h-sum:rollup_nofallback:sys.cpu",
+                        end=str(BASE + 4 * 3600))
+        assert res == []
+
+    def test_fallback_raw_scans_raw_when_empty(self):
+        tsdb = make_tsdb()
+        for i in range(4):
+            tsdb.add_point("sys.cpu", BASE + i * 3600, 7, {"host": "a"})
+        res = run_query(tsdb, "sum:1h-sum:rollup_fallback_raw:sys.cpu",
+                        end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS] == 7
+
+    def test_unsupported_function_scans_raw(self):
+        tsdb = make_tsdb()
+        self._seed_rollups(tsdb)
+        for i in range(4):
+            tsdb.add_point("sys.cpu", BASE + i * 3600, 3, {"host": "a"})
+        res = run_query(tsdb, "sum:1h-dev:sys.cpu", end=str(BASE + 4 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS] == 0.0  # stddev of a single point
+
+
+class TestBlackoutSplit:
+    def test_split_serves_recent_from_raw(self):
+        import opentsdb_tpu.utils.datetime_util as DT
+        now_ms = DT.current_time_millis()
+        hour_ms = 3_600_000
+        cur_hour = now_ms - now_ms % hour_ms
+        cfg = ('{"aggregationIds": {"sum": 0, "count": 1, "min": 2, '
+               '"max": 3}, "intervals": [{"interval": "1h", "table": "r1h", '
+               '"preAggregationTable": "r1hp", "delaySla": %d}]}'
+               % (2 * hour_ms))
+        tsdb = make_tsdb(**{"tsd.rollups.config": cfg,
+                            "tsd.rollups.split_query.enable": True})
+        # Rollups exist for older hours; raw data covers the blackout tail.
+        for i in range(6, 2, -1):
+            tsdb.add_aggregate_point("m", (cur_hour - i * hour_ms) // 1000,
+                                     50, {"h": "a"}, False, "1h", "sum")
+        for i in range(2 * 3600 // 60):
+            tsdb.add_point("m", (cur_hour - 2 * hour_ms) // 1000 + i * 60,
+                           1, {"h": "a"})
+        res = run_query(tsdb, "sum:1h-sum:m",
+                        start=str((cur_hour - 6 * hour_ms) // 1000),
+                        end=str(now_ms // 1000))
+        assert len(res) == 1
+        vals = {t: v for t, v in res[0].dps}
+        # Old hours from the rollup lane...
+        assert vals[cur_hour - 5 * hour_ms] == 50.0
+        # ...blackout hours (last 2h) summed from raw minute points.
+        assert vals[cur_hour - 2 * hour_ms] == 60
+        assert cur_hour - hour_ms in vals
+
+
+class TestRollupJob:
+    def test_job_populates_lanes_and_serves_avg(self):
+        tsdb = make_tsdb()
+        # Raw: 60 minute-points per hour over 3 hours, value = minute index.
+        for h in range(3):
+            for m in range(60):
+                tsdb.add_point("job.metric", BASE + h * 3600 + m * 60,
+                               m, {"host": "x"})
+        written = run_rollup_job(tsdb, intervals=["1h"])
+        assert written["1h"] == 3
+        res = run_query(tsdb, "sum:1h-avg:job.metric",
+                        end=str(BASE + 3 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        # avg of 0..59 = 29.5 for every hour
+        assert vals[BASE_MS] == pytest.approx(29.5)
+        assert vals[BASE_MS + 2 * 3_600_000] == pytest.approx(29.5)
+        # sum lane agrees with raw sum
+        res = run_query(tsdb, "sum:1h-sum:job.metric",
+                        end=str(BASE + 3 * 3600))
+        vals = {t: v for t, v in res[0].dps}
+        assert vals[BASE_MS] == pytest.approx(sum(range(60)))
