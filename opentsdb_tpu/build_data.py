@@ -1,0 +1,62 @@
+"""Build metadata (the BuildData equivalent the reference generates at
+compile time and serves from /version and /api/version)."""
+
+from __future__ import annotations
+
+import socket
+
+from opentsdb_tpu import __version__
+
+VERSION = __version__
+SHORT_REVISION = "unknown"
+FULL_REVISION = "unknown"
+TIMESTAMP = 0
+REPO_STATUS = "MODIFIED"
+USER = "tsdb"
+HOST = socket.gethostname()
+REPO = "opentsdb_tpu"
+BRANCH = "main"
+
+
+def _load_git():
+    """Best-effort git metadata; falls back to the static defaults."""
+    global SHORT_REVISION, FULL_REVISION
+    import os
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        rev = subprocess.run(
+            ["git", "-C", root, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=2)
+        if rev.returncode == 0:
+            FULL_REVISION = rev.stdout.strip()
+            SHORT_REVISION = FULL_REVISION[:7]
+    except Exception:
+        pass
+
+
+_load_git()
+
+
+def version_map() -> dict[str, str]:
+    """The /api/version payload (RpcManager.java:660-669)."""
+    return {
+        "version": VERSION,
+        "short_revision": SHORT_REVISION,
+        "full_revision": FULL_REVISION,
+        "timestamp": str(TIMESTAMP),
+        "repo_status": REPO_STATUS,
+        "user": USER,
+        "host": HOST,
+        "repo": REPO,
+        "branch": BRANCH,
+    }
+
+
+def revision_string() -> str:
+    return "opentsdb_tpu %s built from revision %s (%s)" % (
+        VERSION, SHORT_REVISION, REPO_STATUS)
+
+
+def build_string() -> str:
+    return "Built on %s by %s@%s" % (TIMESTAMP, USER, HOST)

@@ -149,6 +149,27 @@ class TSDB:
         return SeriesKey.make(metric_uid, uid_tags)
 
     # ------------------------------------------------------------------ #
+    # Histogram write path (TSDB.addHistogramPoint :1171)                #
+    # ------------------------------------------------------------------ #
+
+    def add_histogram_point_raw(self, metric: str, timestamp: int | float,
+                                codec_id: int, payload: str,
+                                tags: dict[str, str]) -> None:
+        if self.histogram_manager is None:
+            raise ValueError("histograms are not configured "
+                             "(tsd.core.histograms.config)")
+        raise NotImplementedError("histogram ingest mounts with the "
+                                  "histogram subsystem")
+
+    def add_histogram_point_json(self, metric: str, timestamp: int | float,
+                                 dp: dict, tags: dict[str, str]) -> None:
+        if self.histogram_manager is None:
+            raise ValueError("histograms are not configured "
+                             "(tsd.core.histograms.config)")
+        raise NotImplementedError("histogram ingest mounts with the "
+                                  "histogram subsystem")
+
+    # ------------------------------------------------------------------ #
     # Rollup write path (TSDB.addAggregatePoint :1359-1457)              #
     # ------------------------------------------------------------------ #
 

@@ -345,6 +345,10 @@ class QueryRunner:
                          tsdb.config.fix_duplicates)
                 for s, _ in members]
             ts, val, mask, all_int = build_batch(batch_windows)
+            if not mask.any():
+                # No datapoints in range -> no SpanGroup at all (the scanner
+                # returns no spans, TsdbQuery.findSpans -> empty group map).
+                continue
             int_mode = (all_int and sub.downsample_spec is None
                         and seg.kind == "raw")
             ds = sub.downsample_spec

@@ -1,0 +1,14 @@
+"""Stats / telemetry subsystem.
+
+Reference behavior: /root/reference/src/stats/ — StatsCollector.java (:35,
+push-style emitter with host/global tags), QueryStats.java (:58, per-query
+lifecycle telemetry + running/completed registry served at
+/api/stats/query), Histogram.java (exponential-bucket latency histogram).
+"""
+
+from opentsdb_tpu.stats.collector import StatsCollector
+from opentsdb_tpu.stats.query_stats import QueryStats, QueryStatsRegistry
+from opentsdb_tpu.stats.histogram import LatencyHistogram
+
+__all__ = ["StatsCollector", "QueryStats", "QueryStatsRegistry",
+           "LatencyHistogram"]

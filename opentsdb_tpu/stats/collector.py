@@ -1,0 +1,79 @@
+"""Push-style stats emitter (StatsCollector.java:35).
+
+A collector visits every subsystem, receives `record(name, value, xtratag)`
+calls, and buffers them as datapoint dicts tagged with the host (and any
+extra tags pushed onto the context stack).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+
+class StatsCollector:
+    """Collects `tsd.*` internal metrics as {metric, timestamp, value, tags}."""
+
+    def __init__(self, prefix: str = "tsd", use_host_tag: bool = True):
+        self.prefix = prefix
+        self.records: list[dict] = []
+        self._extra_tags: dict[str, str] = {}
+        if use_host_tag:
+            self._extra_tags["host"] = socket.gethostname()
+
+    def add_extra_tag(self, name: str, value: str) -> None:
+        self._extra_tags[name] = value
+
+    def clear_extra_tag(self, name: str) -> None:
+        self._extra_tags.pop(name, None)
+
+    def record(self, name: str, value, xtratag: str | None = None) -> None:
+        """One datapoint; `xtratag` is a "tag=value" literal like the
+        reference's (StatsCollector.record :118)."""
+        tags = dict(self._extra_tags)
+        if xtratag:
+            if "=" not in xtratag:
+                raise ValueError("invalid xtratag: %s (multiple '=' signs "
+                                 "or none)" % xtratag)
+            k, v = xtratag.split("=", 1)
+            tags[k] = v
+        self.records.append({
+            "metric": "%s.%s" % (self.prefix, name),
+            "timestamp": int(time.time()),
+            "value": float(value) if isinstance(value, float) else int(value),
+            "tags": tags,
+        })
+
+    def record_map(self, stats: dict[str, float]) -> None:
+        """Record a {"name tag=v tag2=v2": value} map (TSDB.collectStats
+        output shape: name plus optional space-separated xtratag)."""
+        for key, value in stats.items():
+            parts = key.split(" ")
+            name = parts[0]
+            tags = dict(self._extra_tags)
+            for p in parts[1:]:
+                if "=" in p:
+                    k, v = p.split("=", 1)
+                    tags[k] = v
+                else:
+                    # bare suffix like "metrics" -> kind tag (TSDB uses
+                    # "tsd.uid.cache-hit metrics" style keys)
+                    tags["kind"] = p
+            self.records.append({
+                "metric": "%s.%s" % (self.prefix, name.removeprefix("tsd.")),
+                "timestamp": int(time.time()),
+                "value": value,
+                "tags": tags,
+            })
+
+    def emit_ascii(self) -> str:
+        """Telnet `stats` format: `metric timestamp value tag=v ...` lines."""
+        lines = []
+        for r in self.records:
+            tags = " ".join("%s=%s" % kv for kv in sorted(r["tags"].items()))
+            value = r["value"]
+            if isinstance(value, float) and value.is_integer():
+                value = int(value)
+            lines.append("%s %d %s%s" % (r["metric"], r["timestamp"], value,
+                                         (" " + tags) if tags else ""))
+        return "\n".join(lines) + ("\n" if lines else "")

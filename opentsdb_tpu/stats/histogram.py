@@ -1,0 +1,67 @@
+"""Exponential-bucket latency histogram (stats/Histogram.java).
+
+Reference semantics: linear buckets of `interval` up to `cutoff`, then
+buckets whose width doubles per step, a fixed total bucket count, with
+percentile lookup by cumulative count.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class LatencyHistogram:
+    def __init__(self, num_buckets: int = 16, interval: int = 2,
+                 cutoff: int = 16):
+        if num_buckets < 1:
+            raise ValueError("num_buckets must be >= 1")
+        self.interval = interval
+        self.cutoff = cutoff
+        self.buckets = [0] * num_buckets
+        self._lock = threading.Lock()
+
+    def _bucket_index(self, value: int) -> int:
+        if value < self.cutoff:
+            idx = value // self.interval
+        else:
+            # doubling-width region
+            idx = self.cutoff // self.interval
+            width = self.interval * 2
+            floor = self.cutoff
+            while value >= floor + width and idx < len(self.buckets) - 1:
+                floor += width
+                width *= 2
+                idx += 1
+        return min(idx, len(self.buckets) - 1)
+
+    def add(self, value: int) -> None:
+        if value < 0:
+            raise ValueError("negative value: %d" % value)
+        with self._lock:
+            self.buckets[self._bucket_index(value)] += 1
+
+    def percentile(self, p: int) -> int:
+        """Upper bound of the bucket holding the p-th percentile count."""
+        if not 0 < p <= 100:
+            raise ValueError("invalid percentile: %d" % p)
+        with self._lock:
+            total = sum(self.buckets)
+            if total == 0:
+                return 0
+            threshold = total * p / 100.0
+            seen = 0
+            floor = 0
+            width = self.interval
+            for i, count in enumerate(self.buckets):
+                seen += count
+                ceiling = floor + width
+                if seen >= threshold:
+                    return ceiling
+                floor = ceiling
+                if floor >= self.cutoff:
+                    width *= 2
+            return floor
+
+    def print_ascii(self) -> str:
+        with self._lock:
+            return " ".join(str(c) for c in self.buckets)

@@ -1,0 +1,120 @@
+"""Per-query telemetry + running/completed query registry.
+
+Reference behavior: /root/reference/src/stats/QueryStats.java (:58) — each
+/api/query execution registers itself, marks named pipeline milestones
+(QueryStat enum :132), and lands in a completed ring buffer served by
+/api/stats/query (getRunningAndCompleteStats :398).  Duplicate in-flight
+queries are rejected (executed :228).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import itertools
+
+COMPLETED_KEEP = 60
+
+
+class DuplicateQueryException(RuntimeError):
+    def __init__(self):
+        super().__init__("Query is already executing for endpoint: /api/query")
+
+
+class QueryStats:
+    """Telemetry for one query execution."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, remote: str, query_json: dict | None,
+                 headers: dict | None = None):
+        self.query_id = next(QueryStats._ids)
+        self.remote = remote
+        self.query = query_json or {}
+        self.headers = dict(headers or {})
+        self.executed = 1
+        self.start = time.time()
+        self.end: float | None = None
+        self.http_status = 200
+        self.exception: str | None = None
+        self.stats: dict[str, float] = {}
+
+    def mark(self, stat: str, value_ms: float | None = None) -> None:
+        """Record a milestone duration (QueryStats.markSerializationSuccessful
+        and friends); default value is elapsed-so-far."""
+        if value_ms is None:
+            value_ms = (time.time() - self.start) * 1000.0
+        self.stats[stat] = value_ms
+
+    def done(self, status: int = 200, exception: str | None = None) -> None:
+        self.end = time.time()
+        self.http_status = status
+        self.exception = exception
+
+    def elapsed_ms(self) -> float:
+        return ((self.end or time.time()) - self.start) * 1000.0
+
+    def hash_key(self) -> tuple:
+        def freeze(o):
+            if isinstance(o, dict):
+                return tuple(sorted((k, freeze(v)) for k, v in o.items()))
+            if isinstance(o, list):
+                return tuple(freeze(v) for v in o)
+            return o
+        return (self.remote.split(":")[0], freeze(self.query))
+
+    def to_json(self, running: bool = False) -> dict:
+        out = {
+            "queryId": self.query_id,
+            "remote": self.remote,
+            "queryStart": int(self.start * 1000),
+            "executed": self.executed,
+            "user": self.headers.get("x-user", ""),
+            "query": self.query,
+            "stats": {k: round(v, 3) for k, v in self.stats.items()},
+        }
+        if running:
+            out["elapsed"] = round(self.elapsed_ms(), 3)
+        else:
+            out["elapsed"] = round(self.elapsed_ms(), 3)
+            out["httpResponse"] = self.http_status
+            if self.exception:
+                out["exception"] = self.exception
+        return out
+
+
+class QueryStatsRegistry:
+    """Running + completed query registries (QueryStats statics)."""
+
+    def __init__(self, keep: int = COMPLETED_KEEP):
+        self.keep = keep
+        self._running: dict[tuple, QueryStats] = {}
+        self._completed: list[QueryStats] = []
+        self._lock = threading.Lock()
+
+    def start(self, qs: QueryStats) -> None:
+        key = qs.hash_key()
+        with self._lock:
+            existing = self._running.get(key)
+            if existing is not None:
+                existing.executed += 1
+                raise DuplicateQueryException()
+            self._running[key] = qs
+
+    def finish(self, qs: QueryStats, status: int = 200,
+               exception: str | None = None) -> None:
+        qs.done(status, exception)
+        with self._lock:
+            self._running.pop(qs.hash_key(), None)
+            self._completed.append(qs)
+            if len(self._completed) > self.keep:
+                self._completed = self._completed[-self.keep:]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "running": [q.to_json(running=True)
+                            for q in self._running.values()],
+                "completed": [q.to_json()
+                              for q in reversed(self._completed)],
+            }

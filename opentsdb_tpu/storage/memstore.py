@@ -216,6 +216,26 @@ class Series:
             return (self._ts[:n].copy(), self._val[:n].copy(),
                     self._ival[:n].copy(), self._isint[:n].copy())
 
+    def delete_range(self, start_ms: int, end_ms: int,
+                     fix_duplicates: bool = True) -> int:
+        """Remove points with start_ms <= ts <= end_ms (query delete flag,
+        TsdbQuery.setDelete / scanner DeleteRequest path)."""
+        with self._lock:
+            self._normalize_locked(fix_duplicates)
+            n = self._n
+            lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
+            hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))
+            removed = hi - lo
+            if removed <= 0:
+                return 0
+            keep = n - hi
+            self._ts[lo:lo + keep] = self._ts[hi:n]
+            self._val[lo:lo + keep] = self._val[hi:n]
+            self._ival[lo:lo + keep] = self._ival[hi:n]
+            self._isint[lo:lo + keep] = self._isint[hi:n]
+            self._n = n - removed
+            return removed
+
     @property
     def size_bytes(self) -> int:
         return self._n * (8 + 8 + 8 + 1)

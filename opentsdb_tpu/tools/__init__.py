@@ -1,0 +1,1 @@
+"""CLI tools (the src/tools layer: TSDMain, importers, fsck, uid admin)."""

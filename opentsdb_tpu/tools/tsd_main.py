@@ -1,0 +1,91 @@
+"""Daemon entry point: `python -m opentsdb_tpu.tools.tsd_main`.
+
+Reference behavior: /root/reference/src/tools/TSDMain.java (:71) — parse
+flags + config, build the TSDB, load plugins, bind the server, serve until
+shutdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tsdb tsd", description="Start the TSD (time series daemon)")
+    p.add_argument("--port", type=int, default=None,
+                   help="TCP port to listen on (tsd.network.port)")
+    p.add_argument("--bind", default=None,
+                   help="Address to bind to (tsd.network.bind)")
+    p.add_argument("--config", default=None,
+                   help="Path to a configuration file")
+    p.add_argument("--mode", default=None, choices=["rw", "ro", "wo"],
+                   help="Operation mode (tsd.mode)")
+    p.add_argument("--auto-metric", action="store_true", default=None,
+                   help="Automatically add metrics (tsd.core.auto_create_metrics)")
+    p.add_argument("--staticroot", default=None,
+                   help="Web root for static files (tsd.http.staticroot)")
+    p.add_argument("--cachedir", default=None,
+                   help="Directory for temporary files (tsd.http.cachedir)")
+    p.add_argument("--worker-threads", type=int, default=8,
+                   help="Responder thread pool size")
+    p.add_argument("--verbose", action="store_true",
+                   help="Print more logging messages")
+    return p
+
+
+def make_tsdb_from_args(args) -> "TSDB":
+    from opentsdb_tpu.core import TSDB
+    from opentsdb_tpu.utils.config import Config
+    config = Config()
+    if args.config:
+        config.load_file(args.config)
+    if args.mode:
+        config.override_config("tsd.mode", args.mode)
+    if args.auto_metric:
+        config.override_config("tsd.core.auto_create_metrics", "true")
+    if args.staticroot:
+        config.override_config("tsd.http.staticroot", args.staticroot)
+    if args.cachedir:
+        config.override_config("tsd.http.cachedir", args.cachedir)
+    if args.port is not None:
+        config.override_config("tsd.network.port", str(args.port))
+    if args.bind:
+        config.override_config("tsd.network.bind", args.bind)
+    return TSDB(config)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s [%(threadName)s] "
+               "%(name)s: %(message)s")
+    tsdb = make_tsdb_from_args(args)
+    port_cfg = tsdb.config.get_string("tsd.network.port")
+    if not port_cfg:
+        print("Missing network port (--port or tsd.network.port)",
+              file=sys.stderr)
+        return 1
+    from opentsdb_tpu.tsd.server import TSDServer
+    server = TSDServer(
+        tsdb, port=int(port_cfg),
+        bind=tsdb.config.get_string("tsd.network.bind") or "0.0.0.0",
+        worker_threads=args.worker_threads)
+
+    async def run():
+        await server.start()
+        await server.serve_forever()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+"""Network/API server layer.
+
+Reference behavior: /root/reference/src/tsd/ — the Netty 3 pipeline
+(PipelineFactory.java:44 first-byte HTTP/telnet sniff), RpcManager route
+table (RpcManager.java:251-364) and per-endpoint Rpc handlers.  Rebuilt on
+asyncio: one port serves both the line-oriented telnet protocol and
+HTTP/1.1, handlers run on a worker thread pool so device compute never
+blocks the event loop.
+"""
+
+from opentsdb_tpu.tsd.http import (
+    HttpRequest, HttpResponse, HttpQuery, BadRequestError)
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.tsd.server import TSDServer
+
+__all__ = ["HttpRequest", "HttpResponse", "HttpQuery", "BadRequestError",
+           "RpcManager", "TSDServer"]

@@ -1,0 +1,323 @@
+"""Admin/observability RPC handlers: stats, version, config, aggregators,
+serializers, dropcaches, logs, home page, static files.
+
+Reference behavior: /root/reference/src/tsd/RpcManager.java (:585-740
+builtin handlers: Version, ListAggregators, HomePage, Serializers, Help,
+Exit, DieDieDie), StatsRpc.java (:86-97 threads/jvm/query/region_clients
+sub-endpoints), DropCachesRpc.java, LogsRpc.java (:85 in-memory ring
+buffer), StaticFileRpc.java.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import sys
+import threading
+import time
+
+from opentsdb_tpu import build_data
+from opentsdb_tpu.ops.aggregators import agg_names
+from opentsdb_tpu.stats import StatsCollector
+from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
+from opentsdb_tpu.tsd.rpcs import HttpRpc, TelnetRpc, allowed_methods
+from opentsdb_tpu.tsd.serializers import SERIALIZERS
+
+
+class VersionRpc(TelnetRpc, HttpRpc):
+    def execute_telnet(self, tsdb, conn, words) -> str:
+        return build_data.revision_string() + "\n" + \
+            build_data.build_string() + "\n"
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET", "POST")
+        version = build_data.version_map()
+        if query.api_version > 0:
+            query.send_reply(query.serializer.format_version_v1(version))
+        elif query.request.uri.endswith("json"):
+            query.send_reply(version)
+        else:
+            query.send_reply(build_data.revision_string() + "\n"
+                             + build_data.build_string() + "\n",
+                             content_type="text/plain")
+
+
+class ListAggregators(HttpRpc):
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET", "POST")
+        names = agg_names()
+        if query.api_version > 0:
+            query.send_reply(query.serializer.format_aggregators_v1(names))
+        else:
+            query.send_reply(names)
+
+
+class SerializersRpc(HttpRpc):
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET", "POST")
+        descriptors = [cls.descriptor() for cls in SERIALIZERS.values()]
+        query.send_reply(
+            query.serializer.format_serializers_v1(descriptors))
+
+
+class ShowConfig(HttpRpc):
+    """/api/config + /api/config/filters."""
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET", "POST")
+        sub = query.api_subpath()
+        if sub and sub[0] == "filters":
+            from opentsdb_tpu.query.filters import FILTER_TYPES
+            out = {}
+            for name, cls in sorted(FILTER_TYPES.items()):
+                out[name] = {
+                    "examples": getattr(cls, "examples", ""),
+                    "description": (cls.__doc__ or "").strip(),
+                }
+            query.send_reply(out)
+            return
+        query.send_reply(query.serializer.format_config_v1(
+            tsdb.config.as_map(obfuscate=True)))
+
+
+class DropCachesRpc(TelnetRpc, HttpRpc):
+    def _drop(self, tsdb) -> None:
+        tsdb.store.drop_caches()
+        # UID cachs are authoritative dictionaries here (no backing store),
+        # so unlike UniqueId.dropCaches they must NOT be emptied.
+
+    def execute_telnet(self, tsdb, conn, words) -> str:
+        self._drop(tsdb)
+        return "Caches dropped.\n"
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET", "POST")
+        self._drop(tsdb)
+        if query.api_version > 0:
+            query.send_reply(query.serializer.format_dropcaches_v1(
+                {"status": "200", "message": "Caches dropped"}))
+        else:
+            query.send_reply("Caches dropped.\n", content_type="text/plain")
+
+
+class StatsRpc(TelnetRpc, HttpRpc):
+    """/api/stats (+/query, /jvm, /threads, /region_clients) + telnet stats."""
+
+    def __init__(self, stats_registry=None, server=None):
+        self.stats_registry = stats_registry
+        self.server = server
+        self.rpc_manager = None   # set by RpcManager after construction
+
+    def _collect(self, tsdb) -> StatsCollector:
+        collector = StatsCollector(
+            "tsd", use_host_tag=True)
+        collector.record_map(tsdb.collect_stats())
+        if tsdb.rollup_store is not None:
+            collector.record_map(tsdb.rollup_store.collect_stats())
+        if self.rpc_manager is not None:
+            for rpc in getattr(self.rpc_manager, "ingest_rpcs", []):
+                rpc.collect_stats(collector)
+        if self.server is not None:
+            self.server.collect_stats(collector)
+        return collector
+
+    def execute_telnet(self, tsdb, conn, words) -> str:
+        return self._collect(tsdb).emit_ascii()
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        sub = query.api_subpath()
+        endpoint = sub[0] if sub else ""
+        if endpoint == "query":
+            if self.stats_registry is None:
+                raise BadRequestError("Query stats are not enabled",
+                                      status=404)
+            query.send_reply(query.serializer.format_query_stats_v1(
+                self.stats_registry.snapshot()))
+            return
+        if endpoint == "threads":
+            query.send_reply(self._threads())
+            return
+        if endpoint == "jvm":
+            query.send_reply(self._runtime())
+            return
+        if endpoint == "region_clients":
+            # No region servers: the storage engine is in-process.
+            query.send_reply([])
+            return
+        collector = self._collect(tsdb)
+        if query.api_version > 0:
+            query.send_reply(
+                query.serializer.format_stats_v1(collector.records))
+        else:
+            query.send_reply(collector.emit_ascii(),
+                             content_type="text/plain")
+
+    @staticmethod
+    def _threads() -> list[dict]:
+        out = []
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            out.append({
+                "threadID": t.ident,
+                "name": t.name,
+                "state": "RUNNABLE" if t.is_alive() else "TERMINATED",
+                "daemon": t.daemon,
+                "stack": ([ "%s:%d" % (frame.f_code.co_filename,
+                                       frame.f_lineno)] if frame else []),
+            })
+        return out
+
+    @staticmethod
+    def _runtime() -> dict:
+        """Process runtime stats (the JVM-stats analog for CPython)."""
+        import resource
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "runtime": {
+                "implementation": sys.implementation.name,
+                "version": sys.version,
+                "pid": os.getpid(),
+            },
+            "memory": {
+                "maxRSSKb": usage.ru_maxrss,
+            },
+            "os": {
+                "systemLoadAverage": os.getloadavg()[0],
+            },
+            "gc": {
+                "collections": sum(
+                    g["collections"]
+                    for g in __import__("gc").get_stats()),
+            },
+        }
+
+
+class LogBuffer(logging.Handler):
+    """In-memory ring of recent log lines (LogsRpc.LogIterator :85)."""
+
+    def __init__(self, capacity: int = 1024):
+        super().__init__()
+        self.ring = collections.deque(maxlen=capacity)
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s [%(threadName)s] "
+            "%(name)s: %(message)s"))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.ring.append(self.format(record))
+        except Exception:
+            pass
+
+
+_LOG_BUFFER = LogBuffer()
+logging.getLogger().addHandler(_LOG_BUFFER)
+
+
+class LogsRpc(HttpRpc):
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        lines = list(_LOG_BUFFER.ring)[::-1]  # newest first, like LogsRpc
+        if query.has_query_string_param("json"):
+            query.send_reply(lines)
+        else:
+            query.send_reply("\n".join(lines) + "\n",
+                             content_type="text/plain")
+
+
+class HomePage(HttpRpc):
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        body = ("<!DOCTYPE html><html><head><title>OpenTSDB-TPU</title>"
+                "</head><body><h1>OpenTSDB-TPU</h1>"
+                "<div id=queryuimain></div>"
+                "<p><a href=\"/api/version\">version</a> | "
+                "<a href=\"/api/aggregators\">aggregators</a> | "
+                "<a href=\"/api/stats\">stats</a> | "
+                "<a href=\"/q\">query UI</a></p>"
+                "<noscript>You must have JavaScript enabled.</noscript>"
+                "</body></html>")
+        query.send_reply(body, content_type="text/html; charset=UTF-8")
+
+
+class StaticFileRpc(HttpRpc):
+    """/s/<file> from tsd.http.staticroot (StaticFileRpc.java)."""
+
+    CONTENT_TYPES = {
+        ".html": "text/html; charset=UTF-8",
+        ".js": "text/javascript",
+        ".css": "text/css",
+        ".png": "image/png",
+        ".gif": "image/gif",
+        ".ico": "image/x-icon",
+        ".svg": "image/svg+xml",
+        ".json": "application/json",
+    }
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        root = tsdb.config.get_string("tsd.http.staticroot")
+        if not root:
+            raise BadRequestError("tsd.http.staticroot is not configured",
+                                  status=404)
+        parts = query.path.split("/")
+        rel = "/".join(parts[1:]) if parts[0] == "s" else query.path
+        path = os.path.realpath(os.path.join(root, rel))
+        if not path.startswith(os.path.realpath(root) + os.sep):
+            raise BadRequestError("Malformed path", status=403)
+        if not os.path.isfile(path):
+            raise BadRequestError("File not found", status=404)
+        with open(path, "rb") as fh:
+            body = fh.read()
+        ext = os.path.splitext(path)[1].lower()
+        ctype = self.CONTENT_TYPES.get(ext, "application/octet-stream")
+        query.send_reply(body, content_type=ctype)
+
+
+class SearchRpc(HttpRpc):
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        try:
+            from opentsdb_tpu.search.rpc import handle_search
+        except ImportError:
+            raise BadRequestError("Search is not available", status=501)
+        handle_search(tsdb, query)
+
+
+class TreeRpc(HttpRpc):
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        try:
+            from opentsdb_tpu.tree.rpc import handle_tree
+        except ImportError:
+            raise BadRequestError("Tree support is not available",
+                                  status=501)
+        handle_tree(tsdb, query)
+
+
+class HelpRpc(TelnetRpc):
+    def __init__(self, commands):
+        self.commands = commands
+
+    def execute_telnet(self, tsdb, conn, words) -> str:
+        return ("available commands: "
+                + " ".join(sorted(self.commands())) + "\n")
+
+
+class ExitRpc(TelnetRpc):
+    def execute_telnet(self, tsdb, conn, words) -> str | None:
+        conn.close_after_write = True
+        return "exiting\n"
+
+
+class DieDieDie(TelnetRpc, HttpRpc):
+    """Graceful shutdown trigger."""
+
+    def __init__(self, shutdown_cb):
+        self.shutdown_cb = shutdown_cb
+
+    def execute_telnet(self, tsdb, conn, words) -> str:
+        conn.close_after_write = True
+        self.shutdown_cb()
+        return "Cleaning up and exiting now.\n"
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        query.send_reply("Cleaning up and exiting now.\n",
+                         content_type="text/plain")
+        self.shutdown_cb()

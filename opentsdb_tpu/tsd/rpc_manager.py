@@ -1,0 +1,185 @@
+"""RPC route table: command/path -> handler, per operation mode.
+
+Reference behavior: /root/reference/src/tsd/RpcManager.java (:251-364
+initializeBuiltinRpcs — the authoritative route list per READWRITE/READONLY/
+WRITEONLY mode with tsd.core.enable_api / enable_ui / no_diediedie gates)
+and RpcHandler.java dispatch.
+"""
+
+from __future__ import annotations
+
+from opentsdb_tpu.stats.query_stats import QueryStatsRegistry
+from opentsdb_tpu.tsd import admin_rpcs, rpcs
+from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery, HttpRequest
+from opentsdb_tpu.tsd.serializers import serializer_for
+
+
+class RpcManager:
+    """Builds and owns the telnet + HTTP route tables."""
+
+    def __init__(self, tsdb, server=None, shutdown_cb=None):
+        self.tsdb = tsdb
+        self.server = server
+        self.shutdown_cb = shutdown_cb or (lambda: None)
+        self.query_stats = QueryStatsRegistry()
+        self.telnet_commands: dict[str, rpcs.TelnetRpc] = {}
+        self.http_commands: dict[str, rpcs.HttpRpc] = {}
+        self._initialize_builtin_rpcs()
+        self.telnet_plugins: dict[str, rpcs.TelnetRpc] = {}
+        self.http_plugins: dict[str, rpcs.HttpRpc] = {}
+
+    def _initialize_builtin_rpcs(self) -> None:
+        cfg = self.tsdb.config
+        mode = self.tsdb.mode             # rw / ro / wo
+        enable_api = cfg.get_bool("tsd.core.enable_api")
+        enable_ui = cfg.get_bool("tsd.core.enable_ui")
+        enable_die = not cfg.get_bool("tsd.no_diediedie")
+
+        telnet = self.telnet_commands
+        http = self.http_commands
+
+        stats = admin_rpcs.StatsRpc(self.query_stats, self.server)
+        aggregators = admin_rpcs.ListAggregators()
+        dropcaches = admin_rpcs.DropCachesRpc()
+        version = admin_rpcs.VersionRpc()
+
+        telnet["stats"] = stats
+        telnet["dropcaches"] = dropcaches
+        telnet["version"] = version
+        telnet["exit"] = admin_rpcs.ExitRpc()
+        telnet["help"] = admin_rpcs.HelpRpc(lambda: self.telnet_commands)
+
+        if enable_ui:
+            http["aggregators"] = aggregators
+            http["logs"] = admin_rpcs.LogsRpc()
+            http["stats"] = stats
+            http["version"] = version
+        if enable_api:
+            http["api/aggregators"] = aggregators
+            http["api/config"] = admin_rpcs.ShowConfig()
+            http["api/dropcaches"] = dropcaches
+            http["api/stats"] = stats
+            http["api/version"] = version
+            http["api/serializers"] = admin_rpcs.SerializersRpc()
+
+        put = rpcs.PutDataPointRpc()
+        rollups = rpcs.RollupDataPointRpc()
+        histos = rpcs.HistogramDataPointRpc()
+        suggest = rpcs.SuggestRpc()
+        annotation = rpcs.AnnotationRpc()
+        staticfile = admin_rpcs.StaticFileRpc()
+        self.put_rpc = put
+        self.ingest_rpcs = [put, rollups, histos]
+        stats.rpc_manager = self
+
+        writes = mode in ("rw", "wo")
+        reads = mode in ("rw", "ro")
+
+        if writes:
+            telnet["put"] = put
+            telnet["rollup"] = rollups
+            telnet["histogram"] = histos
+            if enable_api:
+                http["api/annotation"] = annotation
+                http["api/annotations"] = annotation
+                http["api/put"] = put
+                http["api/rollup"] = rollups
+                http["api/histogram"] = histos
+                http["api/tree"] = admin_rpcs.TreeRpc()
+                http["api/uid"] = rpcs.UniqueIdRpc()
+        if reads:
+            if enable_ui:
+                http[""] = admin_rpcs.HomePage()
+                http["s"] = staticfile
+                http["favicon.ico"] = staticfile
+                http["suggest"] = suggest
+                try:
+                    from opentsdb_tpu.tsd.graph import GraphHandler
+                    http["q"] = GraphHandler()
+                except ImportError:
+                    pass
+            if enable_api:
+                http["api/query"] = rpcs.QueryRpc(self.query_stats)
+                http["api/search"] = admin_rpcs.SearchRpc()
+                http["api/suggest"] = suggest
+                http.setdefault("api/uid", rpcs.UniqueIdRpc())
+                http.setdefault("api/annotation", annotation)
+                http.setdefault("api/annotations", annotation)
+
+        if enable_die:
+            die = admin_rpcs.DieDieDie(self.shutdown_cb)
+            telnet["diediedie"] = die
+            if enable_ui:
+                http["diediedie"] = die
+
+    # -- plugin registration (RpcManager.initializeRpcPlugins analog) --
+
+    def register_telnet_plugin(self, command: str, handler) -> None:
+        if command in self.telnet_commands:
+            raise ValueError("Duplicate telnet command: %s" % command)
+        self.telnet_commands[command] = handler
+
+    def register_http_plugin(self, route: str, handler) -> None:
+        route = route.strip("/")
+        if route in self.http_plugins:
+            raise ValueError("Duplicate HTTP plugin route: %s" % route)
+        self.http_plugins[route] = handler
+
+    # -- dispatch (RpcHandler.messageReceived :125) --
+
+    def handle_telnet(self, conn, line: str) -> str | None:
+        words = line.split()
+        if not words:
+            return None
+        handler = self.telnet_commands.get(words[0])
+        if handler is None:
+            return "unknown command: %s.  Try `help'.\n" % words[0]
+        return handler.execute_telnet(self.tsdb, conn, words)
+
+    def handle_http(self, request: HttpRequest,
+                    remote: str = "unknown") -> "HttpQuery":
+        query = HttpQuery(self.tsdb, request, remote)
+        try:
+            query.serializer = serializer_for(query)
+            # plugin routes live under /plugin/<route>
+            parts = query.path.split("/")
+            if parts and parts[0] == "plugin":
+                # Longest registered prefix wins (HttpRpcPlugin routes may
+                # span several path segments).
+                plugin = None
+                for depth in range(len(parts) - 1, 0, -1):
+                    plugin = self.http_plugins.get("/".join(parts[1:depth + 1]))
+                    if plugin is not None:
+                        break
+                if plugin is None:
+                    raise BadRequestError("No plugin at route", status=404)
+                plugin.execute_http(self.tsdb, query)
+            else:
+                handler = self.http_commands.get(query.base_route())
+                if handler is None:
+                    raise BadRequestError(
+                        "Page not found", status=404,
+                        details="The requested page [%s] was not found"
+                                % request.path)
+                handler.execute_http(self.tsdb, query)
+            if query.response is None:
+                raise RuntimeError("handler sent no response")
+        except Exception as e:  # uniform error envelope
+            query.send_error(e)
+        self._apply_cors(query)
+        return query
+
+    def _apply_cors(self, query: HttpQuery) -> None:
+        """tsd.http.request.cors_domains handling (RpcHandler :249-320)."""
+        origin = query.request.header("origin")
+        if not origin or query.response is None:
+            return
+        domains = self.tsdb.config.get_string(
+            "tsd.http.request.cors_domains").strip()
+        if not domains:
+            return
+        allowed = {d.strip().lower() for d in domains.split(",") if d.strip()}
+        if "*" in allowed or origin.lower() in allowed:
+            query.response.headers["Access-Control-Allow-Origin"] = origin
+            query.response.headers["Access-Control-Allow-Methods"] = \
+                "GET, POST, PUT, DELETE"

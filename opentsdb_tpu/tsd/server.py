@@ -1,0 +1,252 @@
+"""Asyncio server: one port, telnet line protocol + HTTP/1.1, sniffed from
+the first bytes of each connection.
+
+Reference behavior: /root/reference/src/tsd/PipelineFactory.java (:44) —
+ConnectionManager -> DetectHttpOrRpc (:134, first-byte sniff: ASCII letters
+'A'-'Z' mean an HTTP verb, anything else is the telnet line protocol) ->
+framing -> timeout -> RpcHandler — and ConnectionManager.java (:37-41
+connection limit).
+
+Handlers run on a bounded thread pool (the "OpenTSDB Responder" analog,
+RpcResponder.java) so jit-compiled query work never blocks the accept loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from opentsdb_tpu.tsd.http import (
+    BadRequestError, HttpQuery, HttpResponse, parse_http_head)
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+
+LOG = logging.getLogger("tsd.server")
+
+MAX_REQUEST_BYTES = 64 * 1024 * 1024   # HttpRequestDecoder aggregator cap
+MAX_TELNET_LINE = 1024 * 1024
+
+
+class ConnectionRefused(Exception):
+    pass
+
+
+class TelnetConn:
+    """Handler-facing handle on one telnet connection."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.close_after_write = False
+
+
+class TSDServer:
+    """The daemon: TSDB + RpcManager + asyncio socket server."""
+
+    def __init__(self, tsdb, port: int = 4242, bind: str = "0.0.0.0",
+                 worker_threads: int = 8):
+        self.tsdb = tsdb
+        self.port = port
+        self.bind = bind
+        self.rpc_manager = RpcManager(tsdb, server=self,
+                                      shutdown_cb=self.request_shutdown)
+        self.connections_established = 0
+        self.connections_rejected = 0
+        self.exceptions_caught = 0
+        self.telnet_rpcs = 0
+        self.http_rpcs = 0
+        self._open_connections = 0
+        self._conn_lock = threading.Lock()
+        self.max_connections = tsdb.config.get_int(
+            "tsd.core.connections.limit")
+        self.idle_timeout = tsdb.config.get_int(
+            "tsd.network.keep_alive_timeout") if tsdb.config.has_property(
+            "tsd.network.keep_alive_timeout") else 300
+        self._executor = ThreadPoolExecutor(
+            max_workers=worker_threads, thread_name_prefix="tsd-responder")
+        self._server: asyncio.AbstractServer | None = None
+        self._shutdown_event: asyncio.Event | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    # -- lifecycle --
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._shutdown_event = asyncio.Event()
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.bind, self.port)
+        LOG.info("Ready to serve on %s:%d", self.bind, self.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        assert self._shutdown_event is not None
+        await self._shutdown_event.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self._executor.shutdown(wait=False)
+        self.tsdb.shutdown()
+        LOG.info("Server shut down")
+
+    def request_shutdown(self) -> None:
+        """Thread-safe shutdown trigger (diediedie).
+
+        Runs on a responder worker thread, so the server loop captured in
+        start() is the only safe way back onto the event loop.
+        """
+        if self._shutdown_event is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(self._shutdown_event.set)
+
+    # -- connection handling --
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        with self._conn_lock:
+            if self.max_connections and \
+                    self._open_connections >= self.max_connections:
+                self.connections_rejected += 1
+                writer.close()
+                return
+            self._open_connections += 1
+            self.connections_established += 1
+        peer = writer.get_extra_info("peername")
+        remote = "%s:%s" % (peer[0], peer[1]) if peer else "unknown"
+        try:
+            # First-byte sniff (DetectHttpOrRpc :134): HTTP verbs start with
+            # an uppercase ASCII letter; telnet commands are lowercase.
+            first = await asyncio.wait_for(reader.read(1),
+                                           timeout=self.idle_timeout)
+            if not first:
+                return
+            if b"A" <= first <= b"Z":
+                await self._serve_http(first, reader, writer, remote)
+            else:
+                await self._serve_telnet(first, reader, writer, remote)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception:
+            self.exceptions_caught += 1
+            LOG.exception("Unhandled connection error from %s", remote)
+        finally:
+            with self._conn_lock:
+                self._open_connections -= 1
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    # -- telnet path --
+
+    async def _serve_telnet(self, first: bytes, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter,
+                            remote: str) -> None:
+        conn = TelnetConn(writer)
+        buffer = first
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                line = await asyncio.wait_for(reader.readline(),
+                                              timeout=self.idle_timeout)
+            except asyncio.TimeoutError:
+                return
+            data = buffer + line
+            buffer = b""
+            if len(data) > MAX_TELNET_LINE:
+                writer.write(b"error: line too long\n")
+                return
+            if not line and not data:
+                return
+            text = data.decode("utf-8", "replace").strip("\r\n")
+            if not text:
+                if not line:
+                    return
+                continue
+            self.telnet_rpcs += 1
+            reply = await loop.run_in_executor(
+                self._executor, self.rpc_manager.handle_telnet, conn, text)
+            if reply:
+                writer.write(reply.encode())
+                await writer.drain()
+            if conn.close_after_write or not line:
+                return
+
+    # -- HTTP path --
+
+    async def _serve_http(self, first: bytes, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter,
+                          remote: str) -> None:
+        loop = asyncio.get_running_loop()
+        buffer = first
+        while True:
+            head = parse_http_head(buffer)
+            while head is None:
+                chunk = await asyncio.wait_for(reader.read(65536),
+                                               timeout=self.idle_timeout)
+                if not chunk:
+                    return
+                buffer += chunk
+                if len(buffer) > MAX_REQUEST_BYTES:
+                    writer.write(HttpResponse(status=413).to_bytes(False))
+                    return
+                head = parse_http_head(buffer)
+            request, offset = head
+            length = int(request.headers.get("content-length", "0") or 0)
+            if length > MAX_REQUEST_BYTES:
+                writer.write(HttpResponse(status=413).to_bytes(False))
+                return
+            body = buffer[offset:offset + length]
+            while len(body) < length:
+                chunk = await asyncio.wait_for(reader.read(65536),
+                                               timeout=self.idle_timeout)
+                if not chunk:
+                    return
+                body += chunk
+            request.body = body[:length]
+            # Bytes past the body begin the next pipelined request: they sit
+            # in `buffer` when the whole body arrived up front, or in `body`
+            # when the completion loop over-read.  Exactly one is non-empty.
+            buffer = buffer[offset + length:] + body[length:]
+
+            self.http_rpcs += 1
+            query = await loop.run_in_executor(
+                self._executor, self.rpc_manager.handle_http, request,
+                remote)
+            keep_alive = (request.version != "HTTP/1.0"
+                          and (request.header("connection") or "").lower()
+                          != "close")
+            response = query.response or HttpResponse(status=500)
+            writer.write(response.to_bytes(keep_alive))
+            await writer.drain()
+            if not keep_alive:
+                return
+            if not buffer:
+                try:
+                    chunk = await asyncio.wait_for(
+                        reader.read(65536), timeout=self.idle_timeout)
+                except asyncio.TimeoutError:
+                    return
+                if not chunk:
+                    return
+                buffer = chunk
+
+    # -- stats (ConnectionManager.collectStats :89) --
+
+    def collect_stats(self, collector) -> None:
+        collector.record("connectionmgr.connections",
+                         self.connections_established, "type=total")
+        with self._conn_lock:
+            collector.record("connectionmgr.connections",
+                             self._open_connections, "type=open")
+        collector.record("connectionmgr.connections",
+                         self.connections_rejected, "type=rejected")
+        collector.record("connectionmgr.exceptions", self.exceptions_caught)
+        collector.record("rpc.received", self.telnet_rpcs, "type=telnet")
+        collector.record("rpc.received", self.http_rpcs, "type=http")

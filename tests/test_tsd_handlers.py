@@ -1,0 +1,385 @@
+"""HTTP/telnet handler tests over fabricated requests (the NettyMocks
+pattern: drive RpcManager.handle_http/handle_telnet without sockets).
+
+Models /root/reference/test/tsd/TestPutRpc, TestQueryRpc, TestSuggestRpc,
+TestAnnotationRpc, TestUniqueIdRpc, TestRpcManager coverage.
+"""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+class FakeConn:
+    def __init__(self):
+        self.close_after_write = False
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                     "tsd.rollups.enable": True,
+                     "tsd.http.query.allow_delete": True}))
+    for i in range(10):
+        t.add_point("sys.cpu.user", BASE + i * 10, i, {"host": "web01"})
+        t.add_point("sys.cpu.user", BASE + i * 10, i * 2, {"host": "web02"})
+    return t
+
+
+@pytest.fixture
+def manager(tsdb):
+    return RpcManager(tsdb)
+
+
+def http(manager, method, uri, body=None):
+    data = b""
+    if body is not None:
+        data = json.dumps(body).encode() if not isinstance(body, bytes) \
+            else body
+    q = manager.handle_http(
+        HttpRequest(method=method, uri=uri, body=data,
+                    headers={"content-type": "application/json"}),
+        remote="127.0.0.1:55")
+    return q.response
+
+
+def jbody(response):
+    return json.loads(response.body)
+
+
+class TestTelnet:
+    def test_put(self, manager, tsdb):
+        out = manager.handle_telnet(
+            FakeConn(), "put sys.cpu.user %d 99 host=web03" % BASE)
+        assert out is None  # silent success
+        assert tsdb.store.num_series == 3
+
+    def test_put_bad_value(self, manager):
+        out = manager.handle_telnet(
+            FakeConn(), "put sys.cpu.user %d notanum host=a" % BASE)
+        assert out.startswith("put:")
+
+    def test_put_missing_tags(self, manager):
+        out = manager.handle_telnet(FakeConn(),
+                                    "put sys.cpu.user %d 1" % BASE)
+        assert "not enough arguments" in out
+
+    def test_unknown_command(self, manager):
+        out = manager.handle_telnet(FakeConn(), "frobnicate")
+        assert "unknown command" in out
+
+    def test_version(self, manager):
+        out = manager.handle_telnet(FakeConn(), "version")
+        assert "opentsdb_tpu" in out
+
+    def test_stats(self, manager):
+        out = manager.handle_telnet(FakeConn(), "stats")
+        assert "tsd.uid.cache-hit" in out
+
+    def test_help(self, manager):
+        out = manager.handle_telnet(FakeConn(), "help")
+        assert "put" in out and "version" in out
+
+    def test_exit_sets_close(self, manager):
+        conn = FakeConn()
+        manager.handle_telnet(conn, "exit")
+        assert conn.close_after_write
+
+    def test_rollup(self, manager, tsdb):
+        out = manager.handle_telnet(
+            FakeConn(), "rollup 1h-sum sys.cpu.user %d 500 host=web01"
+                        % BASE)
+        assert out is None
+        lane = tsdb.rollup_store.peek_lane("1h", "sum")
+        assert lane.total_datapoints == 1
+
+    def test_dropcaches(self, manager):
+        assert "dropped" in manager.handle_telnet(FakeConn(), "dropcaches")
+
+
+class TestHttpPut:
+    def test_put_single(self, manager, tsdb):
+        r = http(manager, "POST", "/api/put", {
+            "metric": "new.metric", "timestamp": BASE, "value": 1,
+            "tags": {"host": "a"}})
+        assert r.status == 204
+        assert tsdb.metrics.has_name("new.metric")
+
+    def test_put_list_details(self, manager):
+        r = http(manager, "POST", "/api/put?details", [
+            {"metric": "m1", "timestamp": BASE, "value": 1,
+             "tags": {"h": "a"}},
+            {"metric": "m2", "timestamp": -5, "value": 2,
+             "tags": {"h": "a"}},
+        ])
+        body = jbody(r)
+        assert body["success"] == 1 and body["failed"] == 1
+        assert r.status == 400
+        assert len(body["errors"]) == 1
+
+    def test_put_summary(self, manager):
+        r = http(manager, "POST", "/api/put?summary", [
+            {"metric": "m1", "timestamp": BASE, "value": 1,
+             "tags": {"h": "a"}}])
+        body = jbody(r)
+        assert body == {"success": 1, "failed": 0}
+
+    def test_put_get_rejected(self, manager):
+        r = http(manager, "GET", "/api/put")
+        assert r.status == 405
+
+    def test_put_empty(self, manager):
+        r = http(manager, "POST", "/api/put", [])
+        assert r.status == 400
+
+    def test_rollup_http(self, manager, tsdb):
+        r = http(manager, "POST", "/api/rollup", {
+            "metric": "sys.cpu.user", "timestamp": BASE, "value": 42,
+            "tags": {"host": "web01"}, "interval": "1h",
+            "aggregator": "sum"})
+        assert r.status == 204
+        assert tsdb.rollup_store.peek_lane("1h", "sum").total_datapoints == 1
+
+
+class TestHttpQuery:
+    def test_get_query(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d&m=sum:sys.cpu.user"
+                 % (BASE, BASE + 100))
+        body = jbody(r)
+        assert r.status == 200
+        assert len(body) == 1
+        assert body[0]["metric"] == "sys.cpu.user"
+        assert body[0]["aggregateTags"] == ["host"]
+        assert body[0]["dps"]["%d" % BASE] == 0
+        assert body[0]["dps"]["%d" % (BASE + 10)] == 3  # 1 + 2
+
+    def test_post_query(self, manager):
+        r = http(manager, "POST", "/api/query", {
+            "start": BASE, "end": BASE + 100,
+            "queries": [{"aggregator": "sum", "metric": "sys.cpu.user",
+                         "filters": [{"tagk": "host", "type": "wildcard",
+                                      "filter": "*", "groupBy": True}]}]})
+        body = jbody(r)
+        assert len(body) == 2
+        hosts = {b["tags"]["host"] for b in body}
+        assert hosts == {"web01", "web02"}
+
+    def test_query_v1_path(self, manager):
+        r = http(manager, "GET",
+                 "/api/v1/query?start=%d&end=%d&m=sum:sys.cpu.user"
+                 % (BASE, BASE + 100))
+        assert r.status == 200
+
+    def test_query_missing_start(self, manager):
+        r = http(manager, "GET", "/api/query?m=sum:sys.cpu.user")
+        assert r.status == 400
+        assert "start" in jbody(r)["error"]["message"]
+
+    def test_query_unknown_metric(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&m=sum:no.such.metric" % BASE)
+        assert r.status == 404
+
+    def test_query_delete(self, manager, tsdb):
+        r = http(manager, "DELETE",
+                 "/api/query?start=%d&end=%d&m=sum:sys.cpu.user{host=web01}"
+                 % (BASE, BASE + 100))
+        assert r.status == 200
+        # web01's points are gone; web02 remains
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d&m=sum:sys.cpu.user{host=*}"
+                 % (BASE, BASE + 100))
+        body = jbody(r)
+        assert len(body) == 1
+        assert body[0]["tags"]["host"] == "web02"
+
+    def test_query_last(self, manager):
+        r = http(manager, "GET",
+                 "/api/query/last?timeseries=sys.cpu.user{host=web01}"
+                 "&resolve")
+        body = jbody(r)
+        assert len(body) == 1
+        assert body[0]["timestamp"] == (BASE + 90) * 1000
+        assert body[0]["value"] == "9"
+        assert body[0]["tags"] == {"host": "web01"}
+
+    def test_show_summary(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d&m=sum:sys.cpu.user&show_summary"
+                 % (BASE, BASE + 100))
+        body = jbody(r)
+        assert "statsSummary" in body[-1]
+
+
+class TestAdminEndpoints:
+    def test_version(self, manager):
+        body = jbody(http(manager, "GET", "/api/version"))
+        assert body["version"] == "3.0.0-tpu"
+        assert "host" in body and "repo_status" in body
+
+    def test_aggregators(self, manager):
+        body = jbody(http(manager, "GET", "/api/aggregators"))
+        assert "sum" in body and "p99" in body and "mimmax" in body
+
+    def test_config(self, manager):
+        body = jbody(http(manager, "GET", "/api/config"))
+        assert body["tsd.mode"] == "rw"
+
+    def test_config_filters(self, manager):
+        body = jbody(http(manager, "GET", "/api/config/filters"))
+        assert "literal_or" in body and "regexp" in body
+
+    def test_serializers(self, manager):
+        body = jbody(http(manager, "GET", "/api/serializers"))
+        assert body[0]["serializer"] == "json"
+
+    def test_stats(self, manager):
+        body = jbody(http(manager, "GET", "/api/stats"))
+        metrics = {r["metric"] for r in body}
+        assert "tsd.datapoints.added" in metrics
+
+    def test_stats_query(self, manager):
+        http(manager, "GET",
+             "/api/query?start=%d&end=%d&m=sum:sys.cpu.user"
+             % (BASE, BASE + 100))
+        body = jbody(http(manager, "GET", "/api/stats/query"))
+        assert len(body["completed"]) == 1
+        assert body["completed"][0]["httpResponse"] == 200
+
+    def test_stats_jvm(self, manager):
+        body = jbody(http(manager, "GET", "/api/stats/jvm"))
+        assert body["runtime"]["implementation"] == "cpython"
+
+    def test_dropcaches(self, manager):
+        body = jbody(http(manager, "GET", "/api/dropcaches"))
+        assert body["status"] == "200"
+
+    def test_suggest(self, manager):
+        body = jbody(http(manager, "GET", "/api/suggest?type=metrics&q=sys"))
+        assert body == ["sys.cpu.user"]
+
+    def test_suggest_tagv(self, manager):
+        body = jbody(http(manager, "GET", "/api/suggest?type=tagv&q=web"))
+        assert body == ["web01", "web02"]
+
+    def test_suggest_bad_type(self, manager):
+        r = http(manager, "GET", "/api/suggest?type=bogus")
+        assert r.status == 400
+
+    def test_home_page(self, manager):
+        r = http(manager, "GET", "/")
+        assert r.status == 200
+        assert b"OpenTSDB" in r.body
+
+    def test_not_found(self, manager):
+        r = http(manager, "GET", "/api/nosuch")
+        assert r.status == 404
+
+    def test_jsonp(self, manager):
+        r = http(manager, "GET", "/api/version?jsonp=cb")
+        assert r.body.startswith(b"cb(")
+
+    def test_cors(self, tsdb):
+        tsdb.config.override_config("tsd.http.request.cors_domains", "*")
+        manager = RpcManager(tsdb)
+        q = manager.handle_http(HttpRequest(
+            method="GET", uri="/api/version",
+            headers={"origin": "http://x.example"}))
+        assert q.response.headers[
+            "Access-Control-Allow-Origin"] == "http://x.example"
+
+
+class TestUidEndpoints:
+    def test_assign(self, manager, tsdb):
+        r = http(manager, "POST", "/api/uid/assign",
+                 {"metric": ["new.metric.a", "new.metric.b"]})
+        body = jbody(r)
+        assert r.status == 200
+        assert set(body["metric"]) == {"new.metric.a", "new.metric.b"}
+        assert body["metric_errors"] == {}
+
+    def test_assign_conflict(self, manager):
+        r = http(manager, "POST", "/api/uid/assign",
+                 {"metric": ["sys.cpu.user"]})
+        body = jbody(r)
+        assert r.status == 400
+        assert "sys.cpu.user" in body["metric_errors"]
+
+    def test_assign_query_string(self, manager):
+        r = http(manager, "GET", "/api/uid/assign?tagk=newtag")
+        body = jbody(r)
+        assert "newtag" in body["tagk"]
+
+    def test_rename(self, manager, tsdb):
+        r = http(manager, "POST", "/api/uid/rename",
+                 {"metric": "sys.cpu.user", "name": "sys.cpu.renamed"})
+        assert jbody(r)["result"] == "true"
+        assert tsdb.metrics.has_name("sys.cpu.renamed")
+
+    def test_rename_missing_name(self, manager):
+        r = http(manager, "POST", "/api/uid/rename",
+                 {"metric": "sys.cpu.user"})
+        assert r.status == 400
+
+
+class TestAnnotationEndpoints:
+    def test_crud(self, manager, tsdb):
+        # create
+        r = http(manager, "POST", "/api/annotation", {
+            "startTime": BASE * 1000, "description": "deploy",
+            "notes": "v1.2"})
+        assert jbody(r)["description"] == "deploy"
+        # read
+        r = http(manager, "GET",
+                 "/api/annotation?start_time=%d" % (BASE * 1000))
+        assert jbody(r)["notes"] == "v1.2"
+        # update
+        r = http(manager, "POST", "/api/annotation", {
+            "startTime": BASE * 1000, "description": "deploy",
+            "notes": "v1.3"})
+        assert jbody(r)["notes"] == "v1.3"
+        # delete
+        r = http(manager, "DELETE",
+                 "/api/annotation?start_time=%d" % (BASE * 1000))
+        assert r.status == 204
+        r = http(manager, "GET",
+                 "/api/annotation?start_time=%d" % (BASE * 1000))
+        assert r.status == 404
+
+    def test_bulk(self, manager):
+        r = http(manager, "POST", "/api/annotation/bulk", [
+            {"startTime": 1000, "description": "a"},
+            {"startTime": 2000, "description": "b"}])
+        assert len(jbody(r)) == 2
+        r = http(manager, "POST", "/api/annotations", b'''[
+            {"startTime": 3000, "description": "c"}]''')
+        assert len(jbody(r)) == 1
+
+
+class TestModes:
+    def test_readonly_has_no_put(self):
+        t = TSDB(Config({"tsd.mode": "ro"}))
+        m = RpcManager(t)
+        assert "put" not in m.telnet_commands
+        assert "api/put" not in m.http_commands
+        assert "api/query" in m.http_commands
+
+    def test_writeonly_has_no_query(self):
+        t = TSDB(Config({"tsd.mode": "wo"}))
+        m = RpcManager(t)
+        assert "put" in m.telnet_commands
+        assert "api/query" not in m.http_commands
+
+    def test_api_disabled(self):
+        t = TSDB(Config({"tsd.core.enable_api": False}))
+        m = RpcManager(t)
+        assert "api/query" not in m.http_commands
+        assert "version" in m.http_commands  # UI still on

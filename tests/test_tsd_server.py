@@ -1,0 +1,144 @@
+"""Live-socket integration tests: real asyncio server, HTTP + telnet on one
+port (the PipelineFactory first-byte sniff in action)."""
+
+import asyncio
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.tsd.server import TSDServer
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture(scope="module")
+def server():
+    """A TSDServer running in a daemon thread on an ephemeral port."""
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    srv = TSDServer(tsdb, port=0, bind="127.0.0.1", worker_threads=2)
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        async def main():
+            await srv.start()
+            holder["port"] = srv._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await srv.serve_forever()
+        asyncio.run(main())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    srv.test_port = holder["port"]
+    yield srv
+    holder["loop"].call_soon_threadsafe(srv._shutdown_event.set)
+    t.join(5)
+
+
+def telnet(server, *lines, read_reply=True):
+    with socket.create_connection(("127.0.0.1", server.test_port),
+                                  timeout=10) as s:
+        s.sendall(("".join(l + "\n" for l in lines)).encode())
+        s.settimeout(1.0)
+        out = b""
+        if read_reply:
+            try:
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    out += chunk
+            except socket.timeout:
+                pass
+        return out.decode()
+
+
+def http_request(server, method, path, body=None, headers=None):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", server.test_port,
+                                      timeout=30)
+    try:
+        payload = json.dumps(body) if body is not None else None
+        conn.request(method, path, body=payload,
+                     headers=headers or {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, data
+    finally:
+        conn.close()
+
+
+class TestIntegration:
+    def test_http_version(self, server):
+        status, data = http_request(server, "GET", "/api/version")
+        assert status == 200
+        assert json.loads(data)["version"] == "3.0.0-tpu"
+
+    def test_telnet_version(self, server):
+        out = telnet(server, "version")
+        assert "opentsdb_tpu" in out
+
+    def test_telnet_put_then_http_query(self, server):
+        out = telnet(server, *[
+            "put it.metric %d %d host=a" % (BASE + i * 10, i)
+            for i in range(5)])
+        assert out == ""  # silent success
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            status, data = http_request(
+                server, "GET",
+                "/api/query?start=%d&end=%d&m=sum:it.metric"
+                % (BASE, BASE + 100))
+            if status == 200:
+                break
+            time.sleep(0.1)
+        assert status == 200
+        dps = json.loads(data)[0]["dps"]
+        assert dps["%d" % (BASE + 40)] == 4
+
+    def test_http_put(self, server):
+        status, _ = http_request(server, "POST", "/api/put", {
+            "metric": "http.metric", "timestamp": BASE, "value": 7,
+            "tags": {"host": "x"}})
+        assert status == 204
+        status, data = http_request(
+            server, "GET",
+            "/api/query?start=%d&end=%d&m=sum:http.metric"
+            % (BASE - 10, BASE + 10))
+        assert json.loads(data)[0]["dps"]["%d" % BASE] == 7
+
+    def test_http_404(self, server):
+        status, data = http_request(server, "GET", "/api/bogus")
+        assert status == 404
+
+    def test_keep_alive_two_requests(self, server):
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", server.test_port,
+                                          timeout=30)
+        try:
+            conn.request("GET", "/api/version")
+            r1 = conn.getresponse()
+            r1.read()
+            conn.request("GET", "/api/aggregators")
+            r2 = conn.getresponse()
+            assert r1.status == 200 and r2.status == 200
+            assert b"sum" in r2.read()
+        finally:
+            conn.close()
+
+    def test_telnet_stats_and_help(self, server):
+        out = telnet(server, "help")
+        assert "available commands" in out
+        out = telnet(server, "stats")
+        assert "tsd.connectionmgr.connections" in out
+
+    def test_telnet_bad_put_reports(self, server):
+        out = telnet(server, "put only.metric")
+        assert "put:" in out
