@@ -1,0 +1,21 @@
+"""Expression engines.
+
+Reference behavior: /root/reference/src/query/expression/ — the gexp
+function DSL (/api/query/gexp, ExpressionFactory.java:26-60 registry) and
+the 2.3 expression pipeline (/api/query/exp, ExpressionIterator.java +
+QueryExecutor.java) with JEXL arithmetic replaced by a safe vectorized
+evaluator (no arbitrary code execution).
+
+These engines run host-side on the *aggregated* output series (small, one
+point per output step) — the device pipeline has already reduced the raw
+data, so numpy is the right tool here; shipping these few KB back to the
+TPU would cost more in transfers than it saves.
+"""
+
+from opentsdb_tpu.expression.series import SeriesResult
+from opentsdb_tpu.expression.arith import compile_expression
+from opentsdb_tpu.expression.gexp import (
+    parse_gexp, evaluate_tree, GEXP_FUNCTIONS)
+
+__all__ = ["SeriesResult", "compile_expression", "parse_gexp",
+           "evaluate_tree", "GEXP_FUNCTIONS"]

@@ -1,0 +1,186 @@
+"""Safe arithmetic expression compiler for /api/query/exp.
+
+Replaces the reference's Apache JEXL 2.1.1 engine
+(/root/reference/src/query/expression/ExpressionIterator.java:77) and the
+JavaCC syntax checker (/root/reference/src/parser.jj) with a small
+recursive-descent parser producing a closure over numpy arrays — same
+operator set (+ - * / % arithmetic, comparison and && || ! logic, parens),
+none of JEXL's arbitrary-method-call surface.
+
+Comparison/logic operators return 1.0/0.0 like JEXL-over-doubles did.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+\.\d+|\d+\.|\.\d+|\d+)
+    | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<op>&&|\|\||==|!=|>=|<=|>|<|[-+*/%()!,])
+    )""", re.VERBOSE)
+
+
+class ExpressionSyntaxError(ValueError):
+    pass
+
+
+def tokenize(text: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ExpressionSyntaxError(
+                "Unexpected character %r in expression at offset %d"
+                % (text[pos], pos))
+        if m.group("num") is not None:
+            out.append(("num", m.group("num")))
+        elif m.group("name") is not None:
+            out.append(("name", m.group("name")))
+        else:
+            out.append(("op", m.group("op")))
+        pos = m.end()
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    """Precedence-climbing parser -> nested closures of (env) -> ndarray."""
+
+    LEVELS = [
+        ("||",),
+        ("&&",),
+        ("==", "!="),
+        (">", "<", ">=", "<="),
+        ("+", "-"),
+        ("*", "/", "%"),
+    ]
+
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.tokens = tokens
+        self.pos = 0
+        self.variables: set[str] = set()
+
+    def peek(self) -> tuple[str, str]:
+        return self.tokens[self.pos]
+
+    def next(self) -> tuple[str, str]:
+        tok = self.tokens[self.pos]
+        self.pos += 1
+        return tok
+
+    def parse(self):
+        fn = self._binary(0)
+        kind, val = self.peek()
+        if kind != "end":
+            raise ExpressionSyntaxError("Trailing input at token %r" % val)
+        return fn
+
+    def _binary(self, level: int):
+        if level == len(self.LEVELS):
+            return self._unary()
+        ops = self.LEVELS[level]
+        left = self._binary(level + 1)
+        while True:
+            kind, val = self.peek()
+            if kind != "op" or val not in ops:
+                return left
+            self.next()
+            right = self._binary(level + 1)
+            left = _make_binop(val, left, right)
+
+    def _unary(self):
+        kind, val = self.peek()
+        if kind == "op" and val == "-":
+            self.next()
+            inner = self._unary()
+            return lambda env: -inner(env)
+        if kind == "op" and val == "!":
+            self.next()
+            inner = self._unary()
+            return lambda env: (inner(env) == 0).astype(np.float64)
+        return self._atom()
+
+    def _atom(self):
+        kind, val = self.next()
+        if kind == "num":
+            const = float(val)
+            return lambda env: const
+        if kind == "name":
+            self.variables.add(val)
+            name = val
+            return lambda env: env[name]
+        if kind == "op" and val == "(":
+            inner = self._binary(0)
+            kind, val = self.next()
+            if val != ")":
+                raise ExpressionSyntaxError("Expected ')', got %r" % val)
+            return inner
+        raise ExpressionSyntaxError("Unexpected token %r" % (val or kind))
+
+
+def _make_binop(op: str, left, right):
+    if op == "+":
+        return lambda env: left(env) + right(env)
+    if op == "-":
+        return lambda env: left(env) - right(env)
+    if op == "*":
+        return lambda env: left(env) * right(env)
+    if op == "/":
+        def div(env):
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return np.divide(left(env), right(env))
+        return div
+    if op == "%":
+        def mod(env):
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return np.mod(left(env), right(env))
+        return mod
+    if op == "==":
+        return lambda env: (left(env) == right(env)).astype(np.float64)
+    if op == "!=":
+        return lambda env: (left(env) != right(env)).astype(np.float64)
+    if op == ">":
+        return lambda env: (left(env) > right(env)).astype(np.float64)
+    if op == "<":
+        return lambda env: (left(env) < right(env)).astype(np.float64)
+    if op == ">=":
+        return lambda env: (left(env) >= right(env)).astype(np.float64)
+    if op == "<=":
+        return lambda env: (left(env) <= right(env)).astype(np.float64)
+    if op == "&&":
+        return lambda env: (
+            (left(env) != 0) & (right(env) != 0)).astype(np.float64)
+    if op == "||":
+        return lambda env: (
+            (left(env) != 0) | (right(env) != 0)).astype(np.float64)
+    raise ExpressionSyntaxError("Unknown operator: " + op)
+
+
+class CompiledExpression:
+    """expr text -> callable(env: {var: ndarray}) -> ndarray."""
+
+    def __init__(self, text: str):
+        parser = _Parser(tokenize(text))
+        self._fn = parser.parse()
+        self.text = text
+        self.variables = frozenset(parser.variables)
+
+    def __call__(self, env: dict) -> np.ndarray:
+        missing = self.variables - set(env)
+        if missing:
+            raise KeyError("Expression '%s' references unknown variables: %s"
+                           % (self.text, ", ".join(sorted(missing))))
+        return np.asarray(self._fn(env), dtype=np.float64)
+
+
+def compile_expression(text: str) -> CompiledExpression:
+    if not text or not text.strip():
+        raise ExpressionSyntaxError("Missing expression")
+    return CompiledExpression(text)

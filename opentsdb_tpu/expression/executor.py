@@ -1,0 +1,340 @@
+"""/api/query/exp: the 2.3 expression pipeline over the pojo query DSL.
+
+Reference behavior: /root/reference/src/query/pojo/ (Query :35-50 {name,
+time, filters, metrics, expressions, outputs}, Metric :34-49, Expression,
+Join, Output) and /root/reference/src/tsd/QueryExecutor.java (:224 execute,
+:482 serialize — output array of {id, alias?, dps: [[ts, v per series]],
+dpsMeta, meta}) + ExpressionIterator.java (variable series joined across
+metrics by tags: INTERSECTION default / UNION, arithmetic per timestamp).
+
+The JEXL engine is replaced by arith.compile_expression; join + evaluation
+are vectorized over [series, time] matrices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from opentsdb_tpu.expression.arith import compile_expression
+from opentsdb_tpu.expression.series import SeriesResult, union_grid, align
+from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+from opentsdb_tpu.ops.rate import RateOptions
+from opentsdb_tpu.query.filters import build_filter
+
+
+@dataclass
+class PojoQuery:
+    """Validated /api/query/exp body."""
+    start: str
+    end: str | None
+    aggregator: str
+    downsampler: str | None
+    metrics: list[dict]
+    expressions: list[dict]
+    outputs: list[dict]
+    filters: dict[str, list]         # id -> list[TagVFilter]
+    filter_tags: dict[str, set]      # id -> explicit group-by tagks
+    rate: bool = False
+    rate_options: RateOptions = field(default_factory=RateOptions)
+
+    @staticmethod
+    def parse(body: dict) -> "PojoQuery":
+        from opentsdb_tpu.tsd.http import BadRequestError
+        if not isinstance(body, dict):
+            raise BadRequestError("Unparseable data content")
+        time_spec = body.get("time")
+        if not time_spec:
+            raise BadRequestError("Missing the time component")
+        if not time_spec.get("start"):
+            raise BadRequestError("missing or empty start")
+        if not time_spec.get("aggregator"):
+            raise BadRequestError("missing or empty aggregator")
+        metrics = body.get("metrics") or []
+        if not metrics:
+            raise BadRequestError("Missing the metrics component")
+        ids = set()
+        for m in metrics:
+            if not m.get("id"):
+                raise BadRequestError("Missing metric id")
+            if not m.get("metric"):
+                raise BadRequestError("Missing metric name for id %s"
+                                      % m["id"])
+            if m["id"] in ids:
+                raise BadRequestError("Duplicate metric id: %s" % m["id"])
+            ids.add(m["id"])
+        filters: dict[str, list] = {}
+        filter_tags: dict[str, set] = {}
+        for f in body.get("filters") or []:
+            fid = f.get("id")
+            if not fid:
+                raise BadRequestError("Missing filter id")
+            flist = []
+            tagks = set()
+            for t in f.get("tags") or []:
+                flist.append(build_filter(
+                    t["tagk"], t.get("type", "literal_or"),
+                    t.get("filter", ""), group_by=bool(t.get("groupBy",
+                                                             True))))
+                tagks.add(t["tagk"])
+            filters[fid] = flist
+            filter_tags[fid] = tagks
+        expressions = body.get("expressions") or []
+        for e in expressions:
+            if not e.get("id"):
+                raise BadRequestError("Missing expression id")
+            if not e.get("expr"):
+                raise BadRequestError("Missing expression for id %s"
+                                      % e["id"])
+            if e["id"] in ids:
+                raise BadRequestError(
+                    "Duplicate id between metric and expression: %s"
+                    % e["id"])
+        ds = time_spec.get("downsampler")
+        downsampler = None
+        if ds:
+            downsampler = "%s-%s" % (ds["interval"], ds["aggregator"])
+            if ds.get("fillPolicy"):
+                policy = ds["fillPolicy"]
+                if isinstance(policy, dict):
+                    policy = policy.get("policy", "none")
+                downsampler += "-" + policy
+        rate = bool(time_spec.get("rate", False))
+        ro = time_spec.get("rateOptions") or {}
+        return PojoQuery(
+            start=str(time_spec["start"]),
+            end=(str(time_spec["end"]) if time_spec.get("end") else None),
+            aggregator=time_spec["aggregator"],
+            downsampler=downsampler,
+            metrics=metrics,
+            expressions=expressions,
+            outputs=body.get("outputs") or [],
+            filters=filters,
+            filter_tags=filter_tags,
+            rate=rate,
+            rate_options=RateOptions(
+                counter=bool(ro.get("counter", False)),
+                counter_max=int(ro.get("counterMax",
+                                       RateOptions().counter_max)),
+                reset_value=int(ro.get("resetValue", 0)),
+                drop_resets=bool(ro.get("dropResets", False))))
+
+
+class QueryExecutor:
+    """Runs a PojoQuery: metrics -> variable matrices -> expressions."""
+
+    def __init__(self, tsdb, pojo: PojoQuery):
+        self.tsdb = tsdb
+        self.pojo = pojo
+
+    def _build_ts_query(self) -> TSQuery:
+        q = TSQuery(start=self.pojo.start, end=self.pojo.end)
+        for i, m in enumerate(self.pojo.metrics):
+            sub = TSSubQuery(
+                aggregator=m.get("aggregator") or self.pojo.aggregator,
+                metric=m["metric"],
+                downsample=m.get("downsample") or self.pojo.downsampler,
+                rate=self.pojo.rate,
+                rate_options=self.pojo.rate_options,
+                index=i)
+            fid = m.get("filter")
+            if fid:
+                if fid not in self.pojo.filters:
+                    raise ValueError("No filter defined with id: %s" % fid)
+                import copy
+                sub.filters = copy.deepcopy(self.pojo.filters[fid])
+            q.queries.append(sub)
+        return q
+
+    def execute(self) -> dict:
+        pojo = self.pojo
+        ts_query = self._build_ts_query()
+        ts_query.validate()
+        runner = self.tsdb.new_query_runner()
+
+        # metric id -> list[SeriesResult] (one per group-by bucket)
+        results: dict[str, list[SeriesResult]] = {
+            m["id"]: [] for m in pojo.metrics}
+        id_by_index = {i: m["id"] for i, m in enumerate(pojo.metrics)}
+        fills: dict[str, float] = {}
+        for m in pojo.metrics:
+            fp = m.get("fillPolicy") or {}
+            if isinstance(fp, str):
+                fp = {"policy": fp}
+            policy = fp.get("policy", "nan")
+            if policy == "zero":
+                fills[m["id"]] = 0.0
+            elif policy == "scalar":
+                fills[m["id"]] = float(fp.get("value", 0.0))
+            else:
+                fills[m["id"]] = np.nan
+        for qr in runner.run(ts_query):
+            results[id_by_index[qr.index]].append(
+                SeriesResult.from_query_result(qr))
+
+        outputs = pojo.outputs
+        if not outputs:
+            source = pojo.expressions if pojo.expressions else pojo.metrics
+            outputs = [{"id": e["id"]} for e in source]
+
+        exprs = {e["id"]: e for e in pojo.expressions}
+        out_objs = []
+        for output in outputs:
+            oid = output.get("id")
+            if oid in exprs:
+                out_objs.append(self._serialize_expression(
+                    exprs[oid], output, results, fills))
+            elif oid in results:
+                out_objs.append(self._serialize_metric(
+                    oid, output, results[oid]))
+        return {"outputs": out_objs, "query": self._echo_query()}
+
+    # -- joins (VariableIterator: INTERSECTION / UNION by tags) --
+
+    @staticmethod
+    def _join_key(series: SeriesResult, tagks: set | None) -> tuple:
+        if tagks:
+            return tuple(sorted((k, v) for k, v in series.tags.items()
+                                if k in tagks))
+        return tuple(sorted(series.tags.items()))
+
+    def _join(self, var_ids: list[str],
+              results: dict[str, list[SeriesResult]],
+              join_spec: dict) -> list[dict]:
+        """Match series across variables by tag identity; returns a list of
+        {var_id: SeriesResult} sets."""
+        operator = (join_spec.get("operator") or "intersection").lower()
+        use_keys = bool(join_spec.get("useQueryTags", False))
+        tagks = None
+        keyed: dict[str, dict[tuple, SeriesResult]] = {}
+        for vid in var_ids:
+            keyed[vid] = {}
+            for s in results.get(vid, []):
+                keyed[vid][self._join_key(s, tagks)] = s
+        all_keys: set = set()
+        for vid in var_ids:
+            all_keys.update(keyed[vid])
+        joined = []
+        for key in sorted(all_keys):
+            sets = {vid: keyed[vid].get(key) for vid in var_ids}
+            if operator == "intersection" and any(
+                    v is None for v in sets.values()):
+                continue
+            joined.append(sets)
+        return joined
+
+    def _serialize_expression(self, expr: dict, output: dict,
+                              results: dict[str, list[SeriesResult]],
+                              fills: dict[str, float]) -> dict:
+        compiled = compile_expression(expr["expr"])
+        var_ids = [v for v in compiled.variables if v in results]
+        join_spec = expr.get("join") or {}
+        joined = self._join(var_ids, results, join_spec)
+        fill_policy = expr.get("fillPolicy") or {}
+        if isinstance(fill_policy, str):
+            fill_policy = {"policy": fill_policy}
+        expr_fill = fill_policy.get("policy")
+
+        # Union grid across every participating series.
+        participating = [s for sets in joined for s in sets.values()
+                         if s is not None]
+        grid = union_grid(participating)
+        columns = []
+        metas = []
+        for idx, sets in enumerate(joined):
+            env = {}
+            for vid in var_ids:
+                s = sets.get(vid)
+                fill = fills.get(vid, np.nan)
+                if expr_fill == "zero":
+                    fill = 0.0
+                if s is None:
+                    env[vid] = np.full(len(grid), fill)
+                else:
+                    env[vid] = align([s], grid, fill=fill)[0]
+            columns.append(compiled(env))
+            tags = {}
+            for s in sets.values():
+                if s is not None:
+                    tags.update(s.tags)
+            metas.append({
+                "index": idx,
+                "metrics": sorted({s.label for s in sets.values()
+                                   if s is not None}),
+                "commonTags": tags,
+                "aggregatedTags": sorted({t for s in sets.values()
+                                          if s is not None
+                                          for t in s.agg_tags}),
+            })
+        dps = []
+        for j, t in enumerate(grid.tolist()):
+            row = [t] + [self._num(col[j]) for col in columns]
+            dps.append(row)
+        return {
+            "id": expr["id"],
+            "alias": output.get("alias"),
+            "dps": dps,
+            "dpsMeta": {
+                "firstTimestamp": int(grid[0]) if len(grid) else 0,
+                "lastTimestamp": int(grid[-1]) if len(grid) else 0,
+                "setCount": len(grid),
+                "series": len(columns),
+            },
+            "meta": metas,
+        }
+
+    def _serialize_metric(self, oid: str, output: dict,
+                          series: list[SeriesResult]) -> dict:
+        grid = union_grid(series)
+        mat = align(series, grid, fill=np.nan)
+        dps = []
+        for j, t in enumerate(grid.tolist()):
+            dps.append([t] + [self._num(mat[i, j])
+                              for i in range(len(series))])
+        return {
+            "id": oid,
+            "alias": output.get("alias"),
+            "dps": dps,
+            "dpsMeta": {
+                "firstTimestamp": int(grid[0]) if len(grid) else 0,
+                "lastTimestamp": int(grid[-1]) if len(grid) else 0,
+                "setCount": len(grid),
+                "series": len(series),
+            },
+            "meta": [{
+                "index": i,
+                "metrics": [s.label],
+                "commonTags": s.tags,
+                "aggregatedTags": s.agg_tags,
+            } for i, s in enumerate(series)],
+        }
+
+    @staticmethod
+    def _num(v: float):
+        v = float(v)
+        if np.isnan(v):
+            return None
+        if np.isfinite(v) and v == int(v) and abs(v) < 2 ** 53:
+            return int(v)
+        return v
+
+    def _echo_query(self) -> dict:
+        return {
+            "name": None,
+            "time": {"start": self.pojo.start, "end": self.pojo.end,
+                     "aggregator": self.pojo.aggregator,
+                     "downsampler": self.pojo.downsampler},
+            "metrics": self.pojo.metrics,
+            "expressions": self.pojo.expressions,
+            "outputs": self.pojo.outputs,
+        }
+
+
+def handle_exp_query(tsdb, query) -> None:
+    """POST /api/query/exp (QueryRpc.handleExpressionQuery :330)."""
+    from opentsdb_tpu.tsd.rpcs import allowed_methods
+    allowed_methods(query, "POST")
+    pojo = PojoQuery.parse(query.json_body())
+    executor = QueryExecutor(tsdb, pojo)
+    query.send_reply(executor.execute())

@@ -1,0 +1,462 @@
+"""Graphite-style expression functions + /api/query/gexp handler.
+
+Reference behavior: /root/reference/src/query/expression/ —
+ExpressionFactory.java (:31-60: alias, scale, absolute, movingAverage,
+highestCurrent, highestMax, shift/timeShift, firstDiff, divideSeries/divide,
+sumSeries/sum, diffSeries/difference, multiplySeries/multiply),
+Expressions.java/ExpressionReader.java (paren parser collecting m-subquery
+args), and QueryRpc.java:330 (gexp executes handleQuery with expression
+post-processing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from opentsdb_tpu.expression.series import SeriesResult, union_grid, align
+from opentsdb_tpu.utils import datetime_util as DT
+
+
+@dataclass
+class ExpressionTree:
+    """One parsed gexp call: function + args (subtrees, metric refs,
+    literal params)."""
+    func: str
+    args: list = field(default_factory=list)   # ExpressionTree | MetricRef | str
+
+    def metric_queries(self) -> list[str]:
+        out = []
+        for a in self.args:
+            if isinstance(a, MetricRef):
+                out.append(a.query)
+            elif isinstance(a, ExpressionTree):
+                out.extend(a.metric_queries())
+        return out
+
+    def to_string(self) -> str:
+        parts = []
+        for a in self.args:
+            if isinstance(a, ExpressionTree):
+                parts.append(a.to_string())
+            elif isinstance(a, MetricRef):
+                parts.append(a.query)
+            else:
+                parts.append(str(a))
+        return "%s(%s)" % (self.func, ",".join(parts))
+
+
+@dataclass
+class MetricRef:
+    query: str    # an m-subquery string like "sum:proc.stat.cpu{host=*}"
+
+
+def parse_gexp(expression: str) -> ExpressionTree:
+    """Parse a nested function-call expression (ExpressionReader)."""
+    if not expression or "(" not in expression or ")" not in expression:
+        raise ValueError("Invalid Expression: %s" % expression)
+    text = expression.strip()
+    tree, pos = _parse_call(text, 0)
+    if text[pos:].strip():
+        raise ValueError("Trailing input in expression: %s" % text[pos:])
+    return tree
+
+
+def _parse_call(text: str, pos: int) -> tuple[ExpressionTree, int]:
+    start = pos
+    while pos < len(text) and (text[pos].isalnum() or text[pos] == "_"):
+        pos += 1
+    name = text[start:pos].strip()
+    if not name:
+        raise ValueError("Missing function name at offset %d" % start)
+    if name not in GEXP_FUNCTIONS:
+        raise ValueError("Unknown function: %s" % name)
+    while pos < len(text) and text[pos].isspace():
+        pos += 1
+    if pos >= len(text) or text[pos] != "(":
+        raise ValueError("Expected '(' after %s" % name)
+    pos += 1
+    tree = ExpressionTree(func=name)
+    while True:
+        while pos < len(text) and text[pos].isspace():
+            pos += 1
+        if pos >= len(text):
+            raise ValueError("Unbalanced parentheses in: %s" % text)
+        if text[pos] == ")":
+            return tree, pos + 1
+        arg, pos = _parse_arg(text, pos)
+        tree.args.append(arg)
+        while pos < len(text) and text[pos].isspace():
+            pos += 1
+        if pos < len(text) and text[pos] == ",":
+            pos += 1
+
+def _parse_arg(text: str, pos: int):
+    # A nested call starts with a known function name followed by '('.
+    probe = pos
+    while probe < len(text) and (text[probe].isalnum() or text[probe] == "_"):
+        probe += 1
+    word = text[pos:probe]
+    rest = probe
+    while rest < len(text) and text[rest].isspace():
+        rest += 1
+    if word in GEXP_FUNCTIONS and rest < len(text) and text[rest] == "(":
+        return _parse_call(text, pos)
+    # Otherwise scan to the matching ',' or ')' at depth 0 ('{' guards
+    # filter braces, quotes guard string params).
+    depth = 0
+    out = []
+    quote = None
+    while pos < len(text):
+        c = text[pos]
+        if quote:
+            if c == quote:
+                quote = None
+            else:
+                out.append(c)
+            pos += 1
+            continue
+        if c in "'\"":
+            quote = c
+            pos += 1
+            continue
+        if c in "({":
+            depth += 1
+        elif c in ")}":
+            if depth == 0 and c == ")":
+                break
+            depth -= 1
+        elif c == "," and depth == 0:
+            break
+        out.append(c)
+        pos += 1
+    token = "".join(out).strip()
+    if not token:
+        raise ValueError("Empty parameter at offset %d" % pos)
+    if _is_literal(token):
+        return token, pos
+    return MetricRef(token), pos
+
+
+def _is_literal(token: str) -> bool:
+    if ":" in token:    # m-subquery "agg:metric"
+        return False
+    try:
+        float(token)
+        return True
+    except ValueError:
+        pass
+    # duration strings ('10min') and alias text arrive as literals
+    return True
+
+
+# --------------------------------------------------------------------- #
+# Function implementations: list[list[SeriesResult]] per metric arg      #
+# --------------------------------------------------------------------- #
+
+
+def _need_series(args, func):
+    if not args or not isinstance(args[0], list):
+        raise ValueError("%s needs at least one metric query" % func)
+
+
+def f_scale(args) -> list[SeriesResult]:
+    _need_series(args, "scale")
+    if len(args) < 2:
+        raise ValueError("Scale factor not specified")
+    factor = float(args[1])
+    return [s.copy_with(label="scale(%s,%s)" % (s.label, args[1]),
+                        values=s.values * factor) for s in args[0]]
+
+
+def f_absolute(args) -> list[SeriesResult]:
+    _need_series(args, "absolute")
+    return [s.copy_with(label="absolute(%s)" % s.label,
+                        values=np.abs(s.values)) for s in args[0]]
+
+
+def f_alias(args) -> list[SeriesResult]:
+    _need_series(args, "alias")
+    if len(args) < 2:
+        raise ValueError("Missing the alias")
+    template = str(args[1])
+    out = []
+    for s in args[0]:
+        label = template
+        for k, v in s.tags.items():
+            label = label.replace("@" + k, v)
+        out.append(s.copy_with(label=label))
+    return out
+
+
+def f_moving_average(args) -> list[SeriesResult]:
+    """movingAverage(m, N) points or movingAverage(m, '10min') time window
+    (MovingAverage.java)."""
+    _need_series(args, "movingAverage")
+    if len(args) < 2:
+        raise ValueError("Missing moving average window size")
+    param = str(args[1]).strip("'\"")
+    is_time = not param.isdigit()
+    window_ms = 0
+    window_n = 0
+    if is_time:
+        unit = "".join(ch for ch in param if not ch.isdigit())
+        count = "".join(ch for ch in param if ch.isdigit())
+        if not count or unit not in ("s", "sec", "m", "min", "h", "hr", "d",
+                                     "day", "w", "week"):
+            raise ValueError("Invalid moving window parameter: " + param)
+        canonical = {"sec": "s", "min": "m", "hr": "h", "day": "d",
+                     "week": "w"}.get(unit, unit)
+        window_ms = DT.parse_duration(count + canonical)
+    else:
+        window_n = int(param)
+        if window_n <= 0:
+            raise ValueError("Moving average window must be an integer "
+                             "greater than zero")
+    out = []
+    for s in args[0]:
+        vals = np.full_like(s.values, np.nan)
+        for i in range(len(s.values)):
+            if is_time:
+                lo = np.searchsorted(s.ts, s.ts[i] - window_ms, side="right")
+            else:
+                lo = max(0, i - window_n + 1)
+            window = s.values[lo:i + 1]
+            if len(window):
+                vals[i] = float(np.mean(window))
+        out.append(s.copy_with(label="movingAverage(%s,%s)"
+                               % (s.label, param), values=vals))
+    return out
+
+
+def _top_n(args, key_fn, func) -> list[SeriesResult]:
+    _need_series(args, func)
+    if len(args) < 2:
+        raise ValueError("Missing the top-n parameter")
+    n = int(args[1])
+    if n < 1:
+        raise ValueError("Invalid parameter, n must be greater than zero: %d"
+                         % n)
+    scored = [(key_fn(s), i, s) for i, s in enumerate(args[0])
+              if len(s.values)]
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    return [s.copy_with(label="%s(%s,%d)" % (func, s.label, n))
+            for _, _, s in scored[:n]]
+
+
+def f_highest_current(args) -> list[SeriesResult]:
+    return _top_n(args, lambda s: float(s.values[-1]), "highestCurrent")
+
+
+def f_highest_max(args) -> list[SeriesResult]:
+    return _top_n(args, lambda s: float(np.nanmax(s.values)), "highestMax")
+
+
+def f_time_shift(args) -> list[SeriesResult]:
+    """shift(m, '10min'): move each point's timestamp forward by the
+    interval (TimeShift.java: 'increase timestamps by timeshift')."""
+    _need_series(args, "timeShift")
+    if len(args) < 2:
+        raise ValueError("Need amount of timeshift to perform timeshift")
+    param = str(args[1]).strip("'\"")
+    unit = "".join(ch for ch in param if not ch.isdigit())
+    count = "".join(ch for ch in param if ch.isdigit())
+    canonical = {"sec": "s", "min": "m", "hr": "h", "day": "d",
+                 "week": "w"}.get(unit, unit)
+    try:
+        shift_ms = DT.parse_duration(count + canonical)
+    except Exception:
+        raise ValueError("Invalid timeshift='" + param + "'")
+    if shift_ms <= 0:
+        raise ValueError("timeshift <= 0")
+    return [s.copy_with(label="timeShift(%s,%s)" % (s.label, param),
+                        ts=s.ts + shift_ms) for s in args[0]]
+
+
+def f_first_diff(args) -> list[SeriesResult]:
+    """firstDiff(m): v[i] - v[i-1], first point 0 (FirstDifference.java)."""
+    _need_series(args, "firstDiff")
+    out = []
+    for s in args[0]:
+        vals = np.zeros_like(s.values)
+        if len(s.values) > 1:
+            vals[1:] = s.values[1:] - s.values[:-1]
+        out.append(s.copy_with(label="firstDiff(%s)" % s.label, values=vals))
+    return out
+
+
+def _merge_all(args) -> list[SeriesResult]:
+    series = []
+    for a in args:
+        if isinstance(a, list):
+            series.extend(a)
+    return series
+
+
+def f_sum_series(args) -> list[SeriesResult]:
+    """sumSeries: all input series -> one series on the union grid; a
+    missing point contributes 0 (SumSeries via zimsum-style merge)."""
+    series = _merge_all(args)
+    if not series:
+        raise ValueError("sumSeries needs at least one metric query")
+    grid = union_grid(series)
+    mat = align(series, grid, fill=np.nan)
+    vals = np.nansum(mat, axis=0)
+    label = "sumSeries(%s)" % ",".join(s.label for s in series[:3])
+    return [SeriesResult(label, _common_tags(series),
+                         _agg_tags(series), grid, vals)]
+
+
+def f_diff_series(args) -> list[SeriesResult]:
+    """diffSeries(a, b, ...): first minus the rest (DiffSeries.java)."""
+    series = _merge_all(args)
+    if len(series) < 1:
+        raise ValueError("diffSeries needs at least one metric query")
+    grid = union_grid(series)
+    mat = align(series, grid, fill=np.nan)
+    vals = np.where(np.isnan(mat[0]), 0.0, mat[0])
+    rest = mat[1:]
+    vals = vals - np.nansum(rest, axis=0)
+    label = "difference(%s)" % ",".join(s.label for s in series[:3])
+    return [SeriesResult(label, _common_tags(series),
+                         _agg_tags(series), grid, vals)]
+
+
+def f_multiply_series(args) -> list[SeriesResult]:
+    series = _merge_all(args)
+    if not series:
+        raise ValueError("multiplySeries needs at least one metric query")
+    grid = union_grid(series)
+    mat = align(series, grid, fill=np.nan)
+    vals = np.nanprod(mat, axis=0)
+    label = "multiplySeries(%s)" % ",".join(s.label for s in series[:3])
+    return [SeriesResult(label, _common_tags(series),
+                         _agg_tags(series), grid, vals)]
+
+
+def f_divide_series(args) -> list[SeriesResult]:
+    """divideSeries(numerator, denominator) (DivideSeries.java: exactly
+    two series; x/0 and missing -> NaN)."""
+    series = _merge_all(args)
+    if len(series) != 2:
+        raise ValueError("divideSeries expects exactly 2 series, got %d"
+                         % len(series))
+    grid = union_grid(series)
+    mat = align(series, grid, fill=np.nan)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        vals = mat[0] / mat[1]
+    label = "divideSeries(%s,%s)" % (series[0].label, series[1].label)
+    return [SeriesResult(label, _common_tags(series),
+                         _agg_tags(series), grid, vals)]
+
+
+def _common_tags(series) -> dict[str, str]:
+    out: dict[str, str] = {}
+    discard = set()
+    for s in series:
+        for k, v in s.tags.items():
+            if k in discard:
+                continue
+            if out.setdefault(k, v) != v:
+                out.pop(k)
+                discard.add(k)
+    return out
+
+
+def _agg_tags(series) -> list[str]:
+    tags = set()
+    for s in series:
+        tags.update(s.agg_tags)
+    return sorted(tags)
+
+
+GEXP_FUNCTIONS = {
+    "alias": f_alias,
+    "scale": f_scale,
+    "absolute": f_absolute,
+    "movingAverage": f_moving_average,
+    "highestCurrent": f_highest_current,
+    "highestMax": f_highest_max,
+    "shift": f_time_shift,
+    "timeShift": f_time_shift,
+    "firstDiff": f_first_diff,
+    "divideSeries": f_divide_series,
+    "divide": f_divide_series,
+    "sumSeries": f_sum_series,
+    "sum": f_sum_series,
+    "diffSeries": f_diff_series,
+    "difference": f_diff_series,
+    "multiplySeries": f_multiply_series,
+    "multiply": f_multiply_series,
+}
+
+
+def evaluate_tree(tree: ExpressionTree,
+                  metric_results: dict[str, list[SeriesResult]]
+                  ) -> list[SeriesResult]:
+    """Bottom-up evaluation; metric args resolve from metric_results."""
+    args = []
+    for a in tree.args:
+        if isinstance(a, ExpressionTree):
+            args.append(evaluate_tree(a, metric_results))
+        elif isinstance(a, MetricRef):
+            args.append(metric_results[a.query])
+        else:
+            args.append(a)
+    return GEXP_FUNCTIONS[tree.func](args)
+
+
+# --------------------------------------------------------------------- #
+# /api/query/gexp endpoint                                               #
+# --------------------------------------------------------------------- #
+
+
+def handle_gexp_query(tsdb, query) -> None:
+    """GET /api/query/gexp?start=...&exp=scale(sum:m,10) (QueryRpc :330)."""
+    from opentsdb_tpu.models.tsquery import TSQuery, parse_m_subquery
+    from opentsdb_tpu.tsd.http import BadRequestError
+    from opentsdb_tpu.tsd.rpcs import allowed_methods
+    allowed_methods(query, "GET", "POST")
+    exprs = query.get_query_string_params("exp")
+    if not exprs and query.request.body:
+        body = query.json_body()
+        exprs = body.get("expressions") or []
+        if isinstance(exprs, str):
+            exprs = [exprs]
+    if not exprs:
+        raise BadRequestError.missing_parameter("exp")
+    trees = [parse_gexp(e) for e in exprs]
+
+    metric_queries: list[str] = []
+    for t in trees:
+        metric_queries.extend(t.metric_queries())
+    if not metric_queries:
+        raise BadRequestError("No metric queries found in the expressions")
+
+    ts_query = TSQuery(
+        start=query.required_query_string_param("start"),
+        end=query.get_query_string_param("end"),
+        timezone=query.get_query_string_param("tz"),
+        ms_resolution=query.has_query_string_param("ms"))
+    seen = {}
+    for mq in metric_queries:
+        if mq not in seen:
+            sub = parse_m_subquery(mq)
+            sub.index = len(seen)
+            seen[mq] = sub.index
+            ts_query.queries.append(sub)
+    ts_query.validate()
+    runner = tsdb.new_query_runner()
+
+    metric_results: dict[str, list[SeriesResult]] = {m: [] for m in seen}
+    by_index = {i: m for m, i in seen.items()}
+    for qr in runner.run(ts_query):
+        metric_results[by_index[qr.index]].append(
+            SeriesResult.from_query_result(qr))
+
+    out = []
+    for tree in trees:
+        for s in evaluate_tree(tree, metric_results):
+            out.append(s.to_query_json(ts_query.ms_resolution))
+    query.send_reply(out)

@@ -1,0 +1,76 @@
+"""Series containers + time alignment for the expression engines.
+
+Reference behavior: PostAggregatedDataPoints.java (function outputs wrap
+aggregated series) and TimeSyncedIterator.java (zip N series onto common
+timestamps, missing values filled per NumericFillPolicy).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class SeriesResult:
+    """One aggregated output series flowing through expression functions."""
+    label: str                      # metric name / expression label
+    tags: dict[str, str]
+    agg_tags: list[str]
+    ts: np.ndarray                  # int64 ms, sorted
+    values: np.ndarray              # float64
+
+    @staticmethod
+    def from_query_result(qr) -> "SeriesResult":
+        if qr.dps:
+            ts = np.array([t for t, _ in qr.dps], dtype=np.int64)
+            vals = np.array([float(v) for _, v in qr.dps], dtype=np.float64)
+        else:
+            ts = np.empty(0, np.int64)
+            vals = np.empty(0, np.float64)
+        return SeriesResult(label=qr.metric, tags=dict(qr.tags),
+                            agg_tags=list(qr.aggregate_tags),
+                            ts=ts, values=vals)
+
+    def to_query_json(self, ms_resolution: bool = False) -> dict:
+        dps = {}
+        for t, v in zip(self.ts.tolist(), self.values.tolist()):
+            key = str(t if ms_resolution else t // 1000)
+            if np.isfinite(v) and v == int(v) and abs(v) < 2 ** 53:
+                dps[key] = int(v)
+            else:
+                # NaN/Infinity serialize as bare literals, matching the
+                # reference's Jackson writeNumber behavior.
+                dps[key] = v
+        return {"metric": self.label, "tags": self.tags,
+                "aggregateTags": self.agg_tags, "dps": dps}
+
+    def copy_with(self, label: str | None = None,
+                  ts: np.ndarray | None = None,
+                  values: np.ndarray | None = None) -> "SeriesResult":
+        return SeriesResult(
+            label=label if label is not None else self.label,
+            tags=dict(self.tags), agg_tags=list(self.agg_tags),
+            ts=self.ts if ts is None else ts,
+            values=self.values if values is None else values)
+
+
+def union_grid(series: list[SeriesResult]) -> np.ndarray:
+    """Union of all timestamps across series (AggregationIterator's
+    union-of-timestamps stance, applied host-side)."""
+    if not series:
+        return np.empty(0, np.int64)
+    return np.unique(np.concatenate([s.ts for s in series]))
+
+
+def align(series: list[SeriesResult], grid: np.ndarray,
+          fill: float = np.nan) -> np.ndarray:
+    """[S, len(grid)] value matrix; timestamps a series lacks get `fill`."""
+    out = np.full((len(series), len(grid)), fill, dtype=np.float64)
+    for i, s in enumerate(series):
+        if len(s.ts) == 0:
+            continue
+        idx = np.searchsorted(grid, s.ts)
+        out[i, idx] = s.values
+    return out

@@ -212,7 +212,19 @@ class LogBuffer(logging.Handler):
 
 
 _LOG_BUFFER = LogBuffer()
-logging.getLogger().addHandler(_LOG_BUFFER)
+_LOG_BUFFER_INSTALLED = False
+
+
+def install_log_buffer() -> None:
+    """Attach the /logs ring buffer to the root logger.
+
+    Called by server startup, NOT at import time — importing the package
+    must not mutate the host program's logging configuration.
+    """
+    global _LOG_BUFFER_INSTALLED
+    if not _LOG_BUFFER_INSTALLED:
+        logging.getLogger().addHandler(_LOG_BUFFER)
+        _LOG_BUFFER_INSTALLED = True
 
 
 class LogsRpc(HttpRpc):

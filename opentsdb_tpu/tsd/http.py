@@ -192,14 +192,11 @@ class HttpQuery:
     def send_error(self, exc: Exception) -> None:
         """Standard error envelope {error: {code, message, details,
         trace?}} (HttpJsonSerializer.formatErrorV1)."""
+        status = error_status(exc)
         if isinstance(exc, BadRequestError):
-            status, message, details = exc.status, exc.message, exc.details
-        elif isinstance(exc, (LookupError, KeyError)):
-            status, message, details = 404, str(exc), ""
-        elif isinstance(exc, ValueError):
-            status, message, details = 400, str(exc), ""
+            message, details = exc.message, exc.details
         else:
-            status, message, details = 500, str(exc) or repr(exc), ""
+            message, details = str(exc) or repr(exc), ""
         err = {"code": status, "message": message}
         if details:
             err["details"] = details
@@ -209,6 +206,19 @@ class HttpQuery:
 
     def elapsed_ms(self) -> float:
         return (time.time() - self.start_time) * 1000.0
+
+
+def error_status(exc: Exception) -> int:
+    """HTTP status for an exception: name-lookup misses are 404, user input
+    errors 400 (KeyError from malformed bodies included), the rest 500."""
+    from opentsdb_tpu.uid import NoSuchUniqueName, NoSuchUniqueId
+    if isinstance(exc, BadRequestError):
+        return exc.status
+    if isinstance(exc, (NoSuchUniqueName, NoSuchUniqueId)):
+        return 404
+    if isinstance(exc, (ValueError, KeyError, IndexError, TypeError)):
+        return 400
+    return 500
 
 
 def parse_http_head(data: bytes) -> tuple[HttpRequest, int] | None:

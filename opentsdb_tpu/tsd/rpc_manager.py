@@ -139,6 +139,11 @@ class RpcManager:
     def handle_http(self, request: HttpRequest,
                     remote: str = "unknown") -> "HttpQuery":
         query = HttpQuery(self.tsdb, request, remote)
+        if request.method == "OPTIONS":
+            # CORS preflight (RpcHandler.java:204-223): 200 + allow headers
+            # when the origin is whitelisted, 400 otherwise.
+            if self._preflight(query):
+                return query
         try:
             query.serializer = serializer_for(query)
             # plugin routes live under /plugin/<route>
@@ -169,17 +174,34 @@ class RpcManager:
         self._apply_cors(query)
         return query
 
-    def _apply_cors(self, query: HttpQuery) -> None:
-        """tsd.http.request.cors_domains handling (RpcHandler :249-320)."""
-        origin = query.request.header("origin")
-        if not origin or query.response is None:
-            return
+    def _origin_allowed(self, origin: str | None) -> bool:
+        if not origin:
+            return False
         domains = self.tsdb.config.get_string(
             "tsd.http.request.cors_domains").strip()
         if not domains:
-            return
+            return False
         allowed = {d.strip().lower() for d in domains.split(",") if d.strip()}
-        if "*" in allowed or origin.lower() in allowed:
-            query.response.headers["Access-Control-Allow-Origin"] = origin
-            query.response.headers["Access-Control-Allow-Methods"] = \
-                "GET, POST, PUT, DELETE"
+        return "*" in allowed or origin.lower() in allowed
+
+    def _preflight(self, query: HttpQuery) -> bool:
+        """OPTIONS preflight; returns True when this produced the response."""
+        origin = query.request.header("origin")
+        if not self._origin_allowed(origin):
+            return False
+        query.send_status_only(200)
+        self._apply_cors(query)
+        return True
+
+    def _apply_cors(self, query: HttpQuery) -> None:
+        """tsd.http.request.cors_domains handling (RpcHandler :249-320)."""
+        origin = query.request.header("origin")
+        if query.response is None or not self._origin_allowed(origin):
+            return
+        query.response.headers["Access-Control-Allow-Origin"] = origin
+        query.response.headers["Access-Control-Allow-Methods"] = \
+            "GET, POST, PUT, DELETE"
+        headers = self.tsdb.config.get_string(
+            "tsd.http.request.cors_headers").strip()
+        if headers:
+            query.response.headers["Access-Control-Allow-Headers"] = headers

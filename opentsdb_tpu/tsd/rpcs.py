@@ -191,7 +191,7 @@ class RollupDataPointRpc(PutDataPointRpc):
 
     def import_telnet_point(self, tsdb, words: list[str]) -> None:
         if len(words) < 6:
-            raise ValueError("not enough arguments (need least 7, got %d)"
+            raise ValueError("not enough arguments (need least 5, got %d)"
                              % (len(words) - 1))
         interval_agg = words[1]
         if not interval_agg:
@@ -339,7 +339,8 @@ class QueryRpc(HttpRpc):
                 self.stats_registry.finish(qs, 200)
         except Exception as e:
             if qs is not None and self.stats_registry is not None:
-                self.stats_registry.finish(qs, 400, str(e))
+                from opentsdb_tpu.tsd.http import error_status
+                self.stats_registry.finish(qs, error_status(e), str(e))
             raise
 
     def _delete(self, tsdb, ts_query: TSQuery) -> int:

@@ -49,6 +49,8 @@ class TSDServer:
         self.tsdb = tsdb
         self.port = port
         self.bind = bind
+        from opentsdb_tpu.tsd.admin_rpcs import install_log_buffer
+        install_log_buffer()
         self.rpc_manager = RpcManager(tsdb, server=self,
                                       shutdown_cb=self.request_shutdown)
         self.connections_established = 0
@@ -75,7 +77,8 @@ class TSDServer:
         self._loop = asyncio.get_running_loop()
         self._shutdown_event = asyncio.Event()
         self._server = await asyncio.start_server(
-            self._handle_connection, self.bind, self.port)
+            self._handle_connection, self.bind, self.port,
+            limit=MAX_TELNET_LINE)
         LOG.info("Ready to serve on %s:%d", self.bind, self.port)
 
     async def serve_forever(self) -> None:
@@ -157,6 +160,11 @@ class TSDServer:
                                               timeout=self.idle_timeout)
             except asyncio.TimeoutError:
                 return
+            except ValueError:
+                # StreamReader limit (MAX_TELNET_LINE) exceeded.
+                writer.write(b"error: line too long\n")
+                await writer.drain()
+                return
             data = buffer + line
             buffer = b""
             if len(data) > MAX_TELNET_LINE:
@@ -203,12 +211,14 @@ class TSDServer:
                 writer.write(HttpResponse(status=413).to_bytes(False))
                 return
             body = buffer[offset:offset + length]
-            while len(body) < length:
-                chunk = await asyncio.wait_for(reader.read(65536),
-                                               timeout=self.idle_timeout)
-                if not chunk:
+            if len(body) < length:
+                # One exact read instead of quadratic += accumulation.
+                try:
+                    body += await asyncio.wait_for(
+                        reader.readexactly(length - len(body)),
+                        timeout=self.idle_timeout)
+                except asyncio.IncompleteReadError:
                     return
-                body += chunk
             request.body = body[:length]
             # Bytes past the body begin the next pipelined request: they sit
             # in `buffer` when the whole body arrived up front, or in `body`

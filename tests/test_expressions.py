@@ -1,0 +1,269 @@
+"""Expression engine tests: gexp functions, the safe arithmetic compiler,
+and the /api/query/exp executor.
+
+Models /root/reference/test/query/expression/ coverage (TestScale,
+TestAlias, TestHighestMax, TestMovingAverage, TestTimeShift,
+TestSumSeries, TestDivideSeries, TestExpressionIterator)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.expression.arith import (
+    compile_expression, ExpressionSyntaxError)
+from opentsdb_tpu.expression.gexp import parse_gexp, MetricRef
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    for i in range(10):
+        t.add_point("sys.cpu", BASE + i * 10, i, {"host": "web01"})
+        t.add_point("sys.cpu", BASE + i * 10, i * 10, {"host": "web02"})
+        t.add_point("sys.mem", BASE + i * 10, 100 + i, {"host": "web01"})
+    return t
+
+
+@pytest.fixture
+def manager(tsdb):
+    return RpcManager(tsdb)
+
+
+def gexp(manager, expr, start=BASE, end=BASE + 100):
+    q = manager.handle_http(HttpRequest(
+        method="GET",
+        uri="/api/query/gexp?start=%d&end=%d&exp=%s" % (start, end, expr)))
+    return q.response.status, json.loads(q.response.body)
+
+
+class TestArith:
+    def env(self, **kw):
+        return {k: np.asarray(v, dtype=np.float64) for k, v in kw.items()}
+
+    def test_basic_ops(self):
+        e = compile_expression("a + b * 2")
+        out = e(self.env(a=[1, 2], b=[10, 20]))
+        assert out.tolist() == [21.0, 42.0]
+
+    def test_parens_and_unary(self):
+        e = compile_expression("-(a + 1) / 2")
+        assert e(self.env(a=[3]))[0] == -2.0
+
+    def test_division_by_zero_nan(self):
+        e = compile_expression("a / b")
+        out = e(self.env(a=[1.0], b=[0.0]))
+        assert np.isinf(out[0]) or np.isnan(out[0])
+
+    def test_comparison_and_logic(self):
+        e = compile_expression("(a > 2) && (b < 5)")
+        out = e(self.env(a=[1, 3], b=[1, 1]))
+        assert out.tolist() == [0.0, 1.0]
+
+    def test_modulo(self):
+        e = compile_expression("a % 3")
+        assert compile_expression("a % 3")(self.env(a=[7]))[0] == 1.0
+
+    def test_variables_discovered(self):
+        e = compile_expression("x + y / z")
+        assert e.variables == {"x", "y", "z"}
+
+    def test_no_arbitrary_code(self):
+        with pytest.raises(ExpressionSyntaxError):
+            compile_expression("__import__('os').system('x')")
+        with pytest.raises(ExpressionSyntaxError):
+            compile_expression("a..b")
+
+    def test_missing_variable_raises(self):
+        e = compile_expression("a + b")
+        with pytest.raises(KeyError):
+            e(self.env(a=[1]))
+
+
+class TestGexpParser:
+    def test_simple(self):
+        t = parse_gexp("scale(sum:sys.cpu,10)")
+        assert t.func == "scale"
+        assert isinstance(t.args[0], MetricRef)
+        assert t.args[0].query == "sum:sys.cpu"
+        assert t.args[1] == "10"
+
+    def test_nested(self):
+        t = parse_gexp("scale(absolute(sum:sys.cpu{host=*}),-1)")
+        assert t.func == "scale"
+        assert t.args[0].func == "absolute"
+        assert t.args[0].args[0].query == "sum:sys.cpu{host=*}"
+        assert t.metric_queries() == ["sum:sys.cpu{host=*}"]
+
+    def test_filter_commas_preserved(self):
+        t = parse_gexp("sumSeries(sum:sys.cpu{host=a,dc=b})")
+        assert t.args[0].query == "sum:sys.cpu{host=a,dc=b}"
+
+    def test_unknown_function(self):
+        with pytest.raises(ValueError, match="Unknown function"):
+            parse_gexp("nosuchfn(sum:sys.cpu)")
+
+    def test_unbalanced(self):
+        with pytest.raises(ValueError):
+            parse_gexp("scale(sum:sys.cpu")
+
+
+class TestGexpEndpoint:
+    def test_scale(self, manager):
+        status, body = gexp(manager, "scale(sum:sys.cpu{host=web01},10)")
+        assert status == 200
+        assert len(body) == 1
+        assert body[0]["dps"][str(BASE + 10)] == 10.0
+        assert "scale(" in body[0]["metric"]
+
+    def test_absolute(self, manager):
+        status, body = gexp(manager,
+                            "absolute(scale(sum:sys.cpu{host=web01},-1))")
+        assert body[0]["dps"][str(BASE + 30)] == 3.0
+
+    def test_alias(self, manager):
+        status, body = gexp(
+            manager, "alias(sum:sys.cpu{host=web01},cpu on @host)")
+        assert body[0]["metric"] == "cpu on web01"
+
+    def test_sum_series(self, manager):
+        status, body = gexp(manager, "sumSeries(sum:sys.cpu{host=*})")
+        assert len(body) == 1
+        assert body[0]["dps"][str(BASE + 20)] == 22.0  # web01 2 + web02 20
+
+    def test_divide_series(self, manager):
+        status, body = gexp(
+            manager, "divideSeries(sum:sys.mem{host=web01},"
+                     "sum:sys.cpu{host=web01})")
+        assert status == 200
+        assert body[0]["dps"][str(BASE + 10)] == 101.0 / 1.0
+        # x/0 at BASE emits an Infinity literal like the reference
+        assert body[0]["dps"][str(BASE)] == float("inf")
+
+    def test_diff_series(self, manager):
+        status, body = gexp(
+            manager, "diffSeries(sum:sys.mem{host=web01},"
+                     "sum:sys.cpu{host=web01})")
+        assert body[0]["dps"][str(BASE + 20)] == 100.0
+
+    def test_highest_max(self, manager):
+        status, body = gexp(manager, "highestMax(sum:sys.cpu{host=*},1)")
+        assert len(body) == 1
+        assert body[0]["tags"]["host"] == "web02"
+
+    def test_highest_current(self, manager):
+        status, body = gexp(manager, "highestCurrent(sum:sys.cpu{host=*},2)")
+        assert len(body) == 2
+        assert body[0]["tags"]["host"] == "web02"  # 90 > 9
+
+    def test_moving_average_points(self, manager):
+        status, body = gexp(manager, "movingAverage(sum:sys.cpu{host=web01},3)")
+        dps = body[0]["dps"]
+        assert dps[str(BASE + 40)] == pytest.approx((2 + 3 + 4) / 3)
+
+    def test_moving_average_time(self, manager):
+        status, body = gexp(manager,
+                            "movingAverage(sum:sys.cpu{host=web01},'30sec')")
+        dps = body[0]["dps"]
+        # window (t-30s, t]: points at t, t-10, t-20
+        assert dps[str(BASE + 40)] == pytest.approx((2 + 3 + 4) / 3)
+
+    def test_time_shift(self, manager):
+        status, body = gexp(manager,
+                            "timeShift(sum:sys.cpu{host=web01},'10sec')",
+                            end=BASE + 200)
+        dps = body[0]["dps"]
+        assert dps[str(BASE + 20)] == 1.0  # value from BASE+10 shifted
+
+    def test_first_diff(self, manager):
+        status, body = gexp(manager, "firstDiff(sum:sys.cpu{host=web02})")
+        dps = body[0]["dps"]
+        assert dps[str(BASE + 30)] == 10.0
+
+    def test_missing_exp(self, manager):
+        q = manager.handle_http(HttpRequest(
+            method="GET", uri="/api/query/gexp?start=%d" % BASE))
+        assert q.response.status == 400
+
+
+class TestExpEndpoint:
+    def post_exp(self, manager, body):
+        q = manager.handle_http(HttpRequest(
+            method="POST", uri="/api/query/exp",
+            body=json.dumps(body).encode(),
+            headers={"content-type": "application/json"}))
+        return q.response.status, json.loads(q.response.body)
+
+    def base_query(self, **kw):
+        body = {
+            "time": {"start": str(BASE), "end": str(BASE + 100),
+                     "aggregator": "sum"},
+            "filters": [{"id": "f1", "tags": [
+                {"tagk": "host", "type": "wildcard", "filter": "*",
+                 "groupBy": True}]}],
+            "metrics": [
+                {"id": "a", "metric": "sys.cpu", "filter": "f1"},
+                {"id": "b", "metric": "sys.mem", "filter": "f1"}],
+            "expressions": [{"id": "e", "expr": "a + b"}],
+        }
+        body.update(kw)
+        return body
+
+    def test_basic_expression(self, manager):
+        status, out = self.post_exp(manager, self.base_query())
+        assert status == 200
+        assert len(out["outputs"]) == 1
+        e = out["outputs"][0]
+        assert e["id"] == "e"
+        # intersection join: only web01 has both sys.cpu and sys.mem
+        assert e["dpsMeta"]["series"] == 1
+        row = e["dps"][1]
+        assert row[0] == (BASE + 10) * 1000
+        assert row[1] == 1 + 101
+
+    def test_union_join_fills(self, manager):
+        body = self.base_query()
+        body["expressions"] = [{"id": "e", "expr": "a + b",
+                                "join": {"operator": "union"},
+                                "fillPolicy": {"policy": "zero"}}]
+        status, out = self.post_exp(manager, body)
+        e = out["outputs"][0]
+        assert e["dpsMeta"]["series"] == 2  # web01 joined + web02 solo
+        # web02 row: a=10*i, b missing -> 0
+        by_series = e["dps"][2]  # ts BASE+20: [ts, web01, web02]
+        assert by_series[1] == 2 + 102
+        assert by_series[2] == 20
+
+    def test_metric_only_output(self, manager):
+        body = self.base_query()
+        body.pop("expressions")
+        status, out = self.post_exp(manager, body)
+        ids = {o["id"] for o in out["outputs"]}
+        assert ids == {"a", "b"}
+
+    def test_outputs_selection(self, manager):
+        body = self.base_query(outputs=[{"id": "e", "alias": "the sum"}])
+        status, out = self.post_exp(manager, body)
+        assert out["outputs"][0]["alias"] == "the sum"
+
+    def test_missing_time(self, manager):
+        status, out = self.post_exp(manager, {"metrics": []})
+        assert status == 400
+
+    def test_arithmetic_with_constants(self, manager):
+        body = self.base_query()
+        body["expressions"] = [{"id": "e", "expr": "a * 2 + 1"}]
+        status, out = self.post_exp(manager, body)
+        e = out["outputs"][0]
+        assert e["dps"][1][1] == 1 * 2 + 1
+
+    def test_get_rejected(self, manager):
+        q = manager.handle_http(HttpRequest(
+            method="GET", uri="/api/query/exp"))
+        assert q.response.status == 405

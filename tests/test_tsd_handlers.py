@@ -296,6 +296,25 @@ class TestAdminEndpoints:
         assert q.response.headers[
             "Access-Control-Allow-Origin"] == "http://x.example"
 
+    def test_cors_preflight(self, tsdb):
+        tsdb.config.override_config("tsd.http.request.cors_domains", "*")
+        manager = RpcManager(tsdb)
+        q = manager.handle_http(HttpRequest(
+            method="OPTIONS", uri="/api/put",
+            headers={"origin": "http://x.example"}))
+        assert q.response.status == 200
+        assert q.response.headers[
+            "Access-Control-Allow-Origin"] == "http://x.example"
+        assert "Authorization" in q.response.headers[
+            "Access-Control-Allow-Headers"]
+
+    def test_malformed_body_is_400_not_404(self, manager):
+        r = http(manager, "POST", "/api/query", {
+            "start": BASE, "queries": [{
+                "aggregator": "sum", "metric": "sys.cpu.user",
+                "filters": [{"type": "wildcard", "filter": "*"}]}]})
+        assert r.status == 400  # missing "tagk" is user error, not 404
+
 
 class TestUidEndpoints:
     def test_assign(self, manager, tsdb):
