@@ -66,7 +66,12 @@ class TSDB:
         self.tag_raw_data = self.config.get_bool("tsd.rollups.tag_raw")
         self.rollups_block_derived = self.config.get_bool(
             "tsd.rollups.block_derived")
-        self.histogram_manager = None
+        from opentsdb_tpu.histogram import (HistogramCodecManager,
+                                            HistogramStore)
+        self.histogram_manager = HistogramCodecManager.from_config(
+            self.config)
+        self.histogram_store = (HistogramStore()
+                                if self.histogram_manager else None)
         self.rt_publisher = None    # RTPublisher plugin
         self.storage_exception_handler = None
         self.search_plugin = None
@@ -155,19 +160,59 @@ class TSDB:
     def add_histogram_point_raw(self, metric: str, timestamp: int | float,
                                 codec_id: int, payload: str,
                                 tags: dict[str, str]) -> None:
+        """Base64 binary histogram ingest (telnet `histogram`,
+        HistogramPojo.getBytes)."""
         if self.histogram_manager is None:
             raise ValueError("histograms are not configured "
                              "(tsd.core.histograms.config)")
-        raise NotImplementedError("histogram ingest mounts with the "
-                                  "histogram subsystem")
+        import base64
+        codec = self.histogram_manager.get_codec(codec_id)
+        hist = codec.decode(base64.b64decode(payload), includes_id=False)
+        self._store_histogram(metric, timestamp, hist, tags)
 
     def add_histogram_point_json(self, metric: str, timestamp: int | float,
                                  dp: dict, tags: dict[str, str]) -> None:
+        """JSON histogram ingest (POST /api/histogram, HistogramPojo):
+        either base64 `value` or explicit `buckets` {"lo,hi": count}."""
         if self.histogram_manager is None:
             raise ValueError("histograms are not configured "
                              "(tsd.core.histograms.config)")
-        raise NotImplementedError("histogram ingest mounts with the "
-                                  "histogram subsystem")
+        from opentsdb_tpu.histogram import SimpleHistogram
+        codec_id = int(dp.get("id", 0))
+        self.histogram_manager.get_codec(codec_id)  # validate the id
+        if dp.get("value"):
+            hist = SimpleHistogram.from_base64(str(dp["value"]),
+                                               include_id=False)
+            hist.id = codec_id
+        elif dp.get("buckets"):
+            hist = SimpleHistogram.from_pojo(dp, codec_id)
+        else:
+            raise ValueError("Missing histogram value or buckets")
+        self._store_histogram(metric, timestamp, hist, tags)
+
+    def _store_histogram(self, metric: str, timestamp: int | float, hist,
+                         tags: dict[str, str]) -> None:
+        if self.mode == "ro":
+            raise RuntimeError("TSD is in read-only mode, writes rejected")
+        self.check_timestamp_and_tags(metric, timestamp, None, tags)
+        if self.write_filter is not None:
+            # WriteableDataPointFilterPlugin gate (TSDB.java:1301-1306,
+            # allowHistogramPoint; filters without a histogram hook use the
+            # scalar gate).
+            allow = getattr(self.write_filter, "allow_histogram",
+                            self.write_filter.allow)
+            if not allow(metric, timestamp, hist, tags):
+                return
+        ts_ms = normalize_timestamp_ms(timestamp)
+        key = self._series_key(metric, tags, create=True)
+        self.histogram_store.add_point(key, ts_ms, hist)
+        with self._stats_lock:
+            self.datapoints_added += 1
+        if self.rt_publisher is not None:
+            publish = getattr(self.rt_publisher, "publish_histogram_point",
+                              None)
+            if publish is not None:
+                publish(metric, ts_ms, hist, tags, key.tsuid())
 
     # ------------------------------------------------------------------ #
     # Rollup write path (TSDB.addAggregatePoint :1359-1457)              #

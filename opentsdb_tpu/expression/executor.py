@@ -89,8 +89,8 @@ class PojoQuery:
                                       % e["id"])
             if e["id"] in ids:
                 raise BadRequestError(
-                    "Duplicate id between metric and expression: %s"
-                    % e["id"])
+                    "Duplicate expression/metric id: %s" % e["id"])
+            ids.add(e["id"])
         ds = time_spec.get("downsampler")
         downsampler = None
         if ds:
@@ -201,12 +201,18 @@ class QueryExecutor:
 
     def _join(self, var_ids: list[str],
               results: dict[str, list[SeriesResult]],
-              join_spec: dict) -> list[dict]:
+              join_spec: dict,
+              query_tagks: set | None = None) -> list[dict]:
         """Match series across variables by tag identity; returns a list of
-        {var_id: SeriesResult} sets."""
+        {var_id: SeriesResult} sets.
+
+        With useQueryTags (Join.java), only the tag keys named in the
+        metrics' filters participate in the join key, so series carrying
+        differing extra tags still pair up.
+        """
         operator = (join_spec.get("operator") or "intersection").lower()
         use_keys = bool(join_spec.get("useQueryTags", False))
-        tagks = None
+        tagks = query_tagks if use_keys else None
         keyed: dict[str, dict[tuple, SeriesResult]] = {}
         for vid in var_ids:
             keyed[vid] = {}
@@ -230,7 +236,12 @@ class QueryExecutor:
         compiled = compile_expression(expr["expr"])
         var_ids = [v for v in compiled.variables if v in results]
         join_spec = expr.get("join") or {}
-        joined = self._join(var_ids, results, join_spec)
+        query_tagks: set = set()
+        for m in self.pojo.metrics:
+            if m["id"] in var_ids and m.get("filter"):
+                query_tagks |= self.pojo.filter_tags.get(m["filter"], set())
+        joined = self._join(var_ids, results, join_spec,
+                            query_tagks or None)
         fill_policy = expr.get("fillPolicy") or {}
         if isinstance(fill_policy, str):
             fill_policy = {"policy": fill_policy}

@@ -296,13 +296,14 @@ def _merge_all(args) -> list[SeriesResult]:
 
 def f_sum_series(args) -> list[SeriesResult]:
     """sumSeries: all input series -> one series on the union grid; a
-    missing point contributes 0 (SumSeries via zimsum-style merge)."""
+    missing point contributes 0 (TimeSyncedIterator's default
+    FillPolicy.ZERO, TimeSyncedIterator.java:74)."""
     series = _merge_all(args)
     if not series:
         raise ValueError("sumSeries needs at least one metric query")
     grid = union_grid(series)
-    mat = align(series, grid, fill=np.nan)
-    vals = np.nansum(mat, axis=0)
+    mat = align(series, grid, fill=0.0)
+    vals = np.sum(mat, axis=0)
     label = "sumSeries(%s)" % ",".join(s.label for s in series[:3])
     return [SeriesResult(label, _common_tags(series),
                          _agg_tags(series), grid, vals)]
@@ -314,22 +315,22 @@ def f_diff_series(args) -> list[SeriesResult]:
     if len(series) < 1:
         raise ValueError("diffSeries needs at least one metric query")
     grid = union_grid(series)
-    mat = align(series, grid, fill=np.nan)
-    vals = np.where(np.isnan(mat[0]), 0.0, mat[0])
-    rest = mat[1:]
-    vals = vals - np.nansum(rest, axis=0)
+    mat = align(series, grid, fill=0.0)
+    vals = mat[0] - np.sum(mat[1:], axis=0)
     label = "difference(%s)" % ",".join(s.label for s in series[:3])
     return [SeriesResult(label, _common_tags(series),
                          _agg_tags(series), grid, vals)]
 
 
 def f_multiply_series(args) -> list[SeriesResult]:
+    """multiplySeries: missing points fill 0, so the product at a
+    partially-covered timestamp is 0 (UNION join + FillPolicy.ZERO)."""
     series = _merge_all(args)
     if not series:
         raise ValueError("multiplySeries needs at least one metric query")
     grid = union_grid(series)
-    mat = align(series, grid, fill=np.nan)
-    vals = np.nanprod(mat, axis=0)
+    mat = align(series, grid, fill=0.0)
+    vals = np.prod(mat, axis=0)
     label = "multiplySeries(%s)" % ",".join(s.label for s in series[:3])
     return [SeriesResult(label, _common_tags(series),
                          _agg_tags(series), grid, vals)]
@@ -343,7 +344,7 @@ def f_divide_series(args) -> list[SeriesResult]:
         raise ValueError("divideSeries expects exactly 2 series, got %d"
                          % len(series))
     grid = union_grid(series)
-    mat = align(series, grid, fill=np.nan)
+    mat = align(series, grid, fill=0.0)
     with np.errstate(divide="ignore", invalid="ignore"):
         vals = mat[0] / mat[1]
     label = "divideSeries(%s,%s)" % (series[0].label, series[1].label)
@@ -352,20 +353,13 @@ def f_divide_series(args) -> list[SeriesResult]:
 
 
 def _common_tags(series) -> dict[str, str]:
-    out: dict[str, str] = {}
-    discard = set()
-    for s in series:
-        for k, v in s.tags.items():
-            if k in discard:
-                continue
-            if out.setdefault(k, v) != v:
-                out.pop(k)
-                discard.add(k)
-    return out
+    from opentsdb_tpu.expression.series import compute_tags
+    return compute_tags([s.tags for s in series])[0]
 
 
 def _agg_tags(series) -> list[str]:
-    tags = set()
+    from opentsdb_tpu.expression.series import compute_tags
+    tags = set(compute_tags([s.tags for s in series])[1])
     for s in series:
         tags.update(s.agg_tags)
     return sorted(tags)

@@ -56,6 +56,24 @@ class SeriesResult:
             values=self.values if values is None else values)
 
 
+def compute_tags(tag_maps: list[dict]) -> tuple[dict, list]:
+    """SpanGroup.computeTags (:348): keys holding one distinct value across
+    all maps stay tags, conflicting keys become aggregate tags.  The single
+    implementation shared by the planner, gexp, and the exp executor."""
+    tag_set: dict[str, str] = {}
+    discards: set[str] = set()
+    for tags in tag_maps:
+        for k, v in tags.items():
+            if k in discards:
+                continue
+            if k not in tag_set:
+                tag_set[k] = v
+            elif tag_set[k] != v:
+                discards.add(k)
+                tag_set.pop(k)
+    return tag_set, sorted(discards)
+
+
 def union_grid(series: list[SeriesResult]) -> np.ndarray:
     """Union of all timestamps across series (AggregationIterator's
     union-of-timestamps stance, applied host-side)."""

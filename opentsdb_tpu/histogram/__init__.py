@@ -1,0 +1,16 @@
+"""Histogram / sketch subsystem.
+
+Reference behavior: /root/reference/src/core/ histogram stack (17 files) —
+SimpleHistogram.java (bucket codec + midpoint percentile rule),
+HistogramCodecManager.java (codec registry from tsd.core.histograms.config),
+HistogramSpan/SpanGroup/AggregationIterator/Downsampler (read path merging
+bucket counts), HistogramPojo.java (JSON ingest shape), and the
+DataPoints adaptors labeling percentile outputs `metric_pct_<p>` and bucket
+outputs `metric_bucket_...`.
+"""
+
+from opentsdb_tpu.histogram.simple import SimpleHistogram
+from opentsdb_tpu.histogram.codec import HistogramCodecManager
+from opentsdb_tpu.histogram.store import HistogramStore
+
+__all__ = ["SimpleHistogram", "HistogramCodecManager", "HistogramStore"]

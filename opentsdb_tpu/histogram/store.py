@@ -1,0 +1,158 @@
+"""Columnar histogram storage + the vectorized percentile read path.
+
+Reference behavior: the histogram Span/RowSeq/SpanGroup/Downsampler stack
+(/root/reference/src/core/HistogramSpan.java, HistogramSpanGroup.java:67,
+HistogramDownsampler.java, HistogramAggregationIterator.java) — assemble
+per-series histogram sequences, merge across series at shared timestamps,
+and answer percentile queries.
+
+TPU-first transform: a group's histograms become a dense [T, B] bucket-count
+matrix over the union of bucket bounds; downsampling is a segment-sum over
+window ids, the percentile rule (cumulative share -> bucket midpoint,
+SimpleHistogram.percentile) is one vectorized cumsum + argmax per window —
+replacing the per-datapoint iterator merges.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from opentsdb_tpu.histogram.simple import SimpleHistogram
+from opentsdb_tpu.storage.memstore import SeriesKey
+
+
+class HistogramSeries:
+    """One series' histogram points: parallel (ts, histogram) lists."""
+
+    def __init__(self, key: SeriesKey):
+        self.key = key
+        self._ts: list[int] = []
+        self._hists: list[SimpleHistogram] = []
+        self._sorted = True
+        self._lock = threading.Lock()
+
+    def append(self, ts_ms: int, hist: SimpleHistogram) -> None:
+        with self._lock:
+            if self._ts and ts_ms < self._ts[-1]:
+                self._sorted = False
+            self._ts.append(ts_ms)
+            self._hists.append(hist)
+
+    def window(self, start_ms: int, end_ms: int
+               ) -> list[tuple[int, SimpleHistogram]]:
+        with self._lock:
+            if not self._sorted:
+                order = np.argsort(np.asarray(self._ts, dtype=np.int64),
+                                   kind="stable")
+                self._ts = [self._ts[i] for i in order]
+                self._hists = [self._hists[i] for i in order]
+                self._sorted = True
+            lo = int(np.searchsorted(np.asarray(self._ts), start_ms, "left"))
+            hi = int(np.searchsorted(np.asarray(self._ts), end_ms, "right"))
+            return list(zip(self._ts[lo:hi], self._hists[lo:hi]))
+
+    def __len__(self) -> int:
+        return len(self._ts)
+
+
+class HistogramStore:
+    """All histogram series, keyed like the scalar MemStore."""
+
+    def __init__(self):
+        self._series: dict[SeriesKey, HistogramSeries] = {}
+        self._by_metric: dict[int, set[SeriesKey]] = {}
+        self._lock = threading.Lock()
+        self.datapoints_added = 0
+
+    def add_point(self, key: SeriesKey, ts_ms: int,
+                  hist: SimpleHistogram) -> None:
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = HistogramSeries(key)
+                self._series[key] = series
+                self._by_metric.setdefault(key.metric, set()).add(key)
+            self.datapoints_added += 1
+        series.append(ts_ms, hist)
+
+    def series_for_metric(self, metric: int) -> list[HistogramSeries]:
+        with self._lock:
+            return [self._series[k]
+                    for k in self._by_metric.get(metric, ())]
+
+    def all_series(self) -> list[HistogramSeries]:
+        with self._lock:
+            return list(self._series.values())
+
+    @property
+    def num_series(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+
+# --------------------------------------------------------------------- #
+# Vectorized merge + percentile kernels                                  #
+# --------------------------------------------------------------------- #
+
+
+def merge_group(points: list[tuple[int, SimpleHistogram]]
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(ts[T], counts[T, B], bounds[B, 2]) over the union of bucket bounds.
+
+    Points sharing a timestamp (across series of one group) accumulate —
+    the HistogramAggregationIterator SUM merge.
+    """
+    bounds_set = sorted({b for _, h in points for b in h.buckets})
+    bounds_idx = {b: i for i, b in enumerate(bounds_set)}
+    ts_sorted = sorted({t for t, _ in points})
+    ts_idx = {t: i for i, t in enumerate(ts_sorted)}
+    counts = np.zeros((len(ts_sorted), len(bounds_set)), dtype=np.int64)
+    for t, h in points:
+        row = ts_idx[t]
+        for b, c in h.buckets.items():
+            counts[row, bounds_idx[b]] += c
+    bounds = np.asarray(bounds_set, dtype=np.float64).reshape(-1, 2) \
+        if bounds_set else np.zeros((0, 2))
+    return (np.asarray(ts_sorted, dtype=np.int64), counts, bounds)
+
+
+def downsample_counts(ts: np.ndarray, counts: np.ndarray,
+                      interval_ms: int) -> tuple[np.ndarray, np.ndarray]:
+    """Sum bucket counts per epoch-aligned window (HistogramDownsampler)."""
+    if len(ts) == 0:
+        return ts, counts
+    win = ts - ts % interval_ms
+    edges, inverse = np.unique(win, return_inverse=True)
+    out = np.zeros((len(edges), counts.shape[1]), dtype=np.int64)
+    np.add.at(out, inverse, counts)
+    return edges, out
+
+
+def percentiles_of(counts: np.ndarray, bounds: np.ndarray,
+                   percs: list[float]) -> np.ndarray:
+    """[T, B] counts -> [P, T] percentile values (midpoint rule).
+
+    Vectorized SimpleHistogram.percentile: cumulative share along the
+    sorted-bucket axis, first bucket reaching p, midpoint of its bounds.
+    """
+    t, b = counts.shape
+    out = np.zeros((len(percs), t), dtype=np.float64)
+    if b == 0 or t == 0:
+        return out
+    cum = np.cumsum(counts, axis=1)
+    total = cum[:, -1]
+    mid = (bounds[:, 0] + bounds[:, 1]) / 2.0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        share = cum * 100.0 / total[:, None]
+    for i, p in enumerate(percs):
+        if p < 1.0 or p > 100.0:
+            out[i, :] = -1.0
+            continue
+        hit = share >= p
+        idx = np.argmax(hit, axis=1)
+        vals = mid[idx]
+        vals = np.where(total > 0, vals, 0.0)
+        out[i, :] = vals
+    return out

@@ -196,18 +196,8 @@ class QueryRunner:
     def _compute_tags(members: list[tuple[Series, dict]]):
         """SpanGroup.computeTags (:348): single-valued keys -> tags,
         conflicting keys -> aggregateTags."""
-        tag_set: dict[str, str] = {}
-        discards: set[str] = set()
-        for _, tags in members:
-            for k, v in tags.items():
-                if k in discards:
-                    continue
-                if k not in tag_set:
-                    tag_set[k] = v
-                elif tag_set[k] != v:
-                    discards.add(k)
-                    tag_set.pop(k)
-        return tag_set, sorted(discards)
+        from opentsdb_tpu.expression.series import compute_tags
+        return compute_tags([tags for _, tags in members])
 
     # -- execution -------------------------------------------------------
 
@@ -406,7 +396,72 @@ class QueryRunner:
             )
         return results
 
+    # -- histogram queries (TsdbQuery.isHistogramQuery :806-812 routes
+    #    percentiles/show_histogram_buckets to runHistogramAsync :788) ----
+
+    def _run_histogram_sub(self, query: TSQuery, sub: TSSubQuery
+                           ) -> list[QueryResult]:
+        from opentsdb_tpu.histogram.store import (
+            merge_group, downsample_counts, percentiles_of)
+        tsdb = self.tsdb
+        if tsdb.histogram_store is None:
+            raise ValueError("histograms are not configured "
+                             "(tsd.core.histograms.config)")
+        metric_uid = tsdb.metrics.get_id(sub.metric)
+        filter_tagks = {f.tagk for f in sub.filters}
+        matched = []
+        for series in tsdb.histogram_store.series_for_metric(metric_uid):
+            tags = tsdb.resolve_key_tags(series.key)
+            if sub.explicit_tags and set(tags) != filter_tagks:
+                continue
+            if all(f.match(tags) for f in sub.filters):
+                matched.append((series, tags))
+        groups = self._group(matched, sub)
+        results = []
+        for group_key in sorted(groups, key=lambda k: tuple(map(str, k))):
+            members = groups[group_key]
+            points = []
+            for series, _ in members:
+                points.extend(series.window(query.start_time,
+                                            query.end_time))
+            if not points:
+                continue
+            ts, counts, bounds = merge_group(points)
+            if sub.downsample_spec is not None and \
+                    sub.downsample_spec.interval_ms > 0:
+                ts, counts = downsample_counts(
+                    ts, counts, sub.downsample_spec.interval_ms)
+            group_tags, agg_tags = self._compute_tags(members)
+            tsuids = [tsdb.tsuid(s.key) for s, _ in members]
+            if sub.percentiles:
+                values = percentiles_of(counts, bounds, sub.percentiles)
+                for i, p in enumerate(sub.percentiles):
+                    # metric_pct_<p> naming per the DataPoints adaptor
+                    # (HistogramDataPointsToDataPointsAdaptor.java:42-44).
+                    results.append(QueryResult(
+                        metric="%s_pct_%s" % (sub.metric, _fmt_pct(p)),
+                        tags=dict(group_tags),
+                        aggregate_tags=list(agg_tags),
+                        tsuids=list(tsuids),
+                        dps=[(int(t), float(v))
+                             for t, v in zip(ts, values[i])],
+                        index=sub.index))
+            if sub.show_histogram_buckets:
+                for b in range(counts.shape[1]):
+                    lo, hi = bounds[b]
+                    results.append(QueryResult(
+                        metric="%s_bucket_%g_%g" % (sub.metric, lo, hi),
+                        tags=dict(group_tags),
+                        aggregate_tags=list(agg_tags),
+                        tsuids=list(tsuids),
+                        dps=[(int(t), int(c))
+                             for t, c in zip(ts, counts[:, b])],
+                        index=sub.index))
+        return results
+
     def run_sub(self, query: TSQuery, sub: TSSubQuery) -> list[QueryResult]:
+        if sub.percentiles or sub.show_histogram_buckets:
+            return self._run_histogram_sub(query, sub)
         segments = self._plan_segments(query, sub)
         # Query-scoped: fetch once, shared by every segment and group.
         global_notes = (self.tsdb.store.get_annotations(
@@ -439,6 +494,11 @@ class QueryRunner:
         for sub in query.queries:
             out.extend(self.run_sub(query, sub))
         return out
+
+
+def _fmt_pct(p: float) -> str:
+    """Float.toString parity: 99 -> "99.0", 99.9 -> "99.9"."""
+    return "%s" % float(p)
 
 
 def extract_dps(out_ts: np.ndarray, out_val: np.ndarray, out_mask: np.ndarray,

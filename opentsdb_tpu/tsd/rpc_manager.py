@@ -141,8 +141,14 @@ class RpcManager:
         query = HttpQuery(self.tsdb, request, remote)
         if request.method == "OPTIONS":
             # CORS preflight (RpcHandler.java:204-223): 200 + allow headers
-            # when the origin is whitelisted, 400 otherwise.
+            # when the origin is whitelisted, 400 without dispatching
+            # otherwise; no-Origin OPTIONS falls through to a 405.
             if self._preflight(query):
+                return query
+            if query.request.header("origin"):
+                query.send_error(BadRequestError(
+                    "CORS domain not allowed",
+                    details="Origin is not in tsd.http.request.cors_domains"))
                 return query
         try:
             query.serializer = serializer_for(query)

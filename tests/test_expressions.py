@@ -240,6 +240,41 @@ class TestExpEndpoint:
         assert by_series[1] == 2 + 102
         assert by_series[2] == 20
 
+    def test_use_query_tags_join(self, tsdb, manager):
+        # sys.disk carries an extra tag; full-tag join finds no match,
+        # useQueryTags joins on {host} only (Join.java useQueryTags).
+        for i in range(10):
+            tsdb.add_point("sys.disk", BASE + i * 10, 5,
+                           {"host": "web01", "disk": "sda"})
+        body = self.base_query()
+        body["metrics"] = [
+            {"id": "a", "metric": "sys.cpu", "filter": "f1"},
+            {"id": "b", "metric": "sys.disk", "filter": "f1"}]
+        body["expressions"] = [{"id": "e", "expr": "a + b",
+                                "join": {"operator": "intersection",
+                                         "useQueryTags": True}}]
+        status, out = self.post_exp(manager, body)
+        e = out["outputs"][0]
+        assert e["dpsMeta"]["series"] == 1
+        assert e["dps"][1][1] == 1 + 5
+
+    def test_duplicate_expression_id_rejected(self, manager):
+        body = self.base_query()
+        body["expressions"] = [{"id": "e", "expr": "a"},
+                               {"id": "e", "expr": "b"}]
+        status, out = self.post_exp(manager, body)
+        assert status == 400
+
+    def test_multiply_series_missing_is_zero(self, tsdb, manager):
+        # sys.part only covers BASE..BASE+20; beyond that product must be 0.
+        for i in range(3):
+            tsdb.add_point("sys.part", BASE + i * 10, 2, {"host": "web01"})
+        status, body = gexp(
+            manager, "multiplySeries(sum:sys.cpu{host=web01},"
+                     "sum:sys.part{host=web01})")
+        assert body[0]["dps"][str(BASE + 10)] == 2.0   # 1 * 2
+        assert body[0]["dps"][str(BASE + 50)] == 0.0   # 5 * missing(0)
+
     def test_metric_only_output(self, manager):
         body = self.base_query()
         body.pop("expressions")

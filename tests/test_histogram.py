@@ -1,0 +1,211 @@
+"""Histogram subsystem tests: codec round trips, percentile math, ingest
+via TSDB/telnet/HTTP, and the percentile query path.
+
+Models /root/reference/test/core/TestSimpleHistogram + the histogram
+query-path tests (TestTsdbQueryHistograms)."""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.histogram import SimpleHistogram, HistogramCodecManager
+from opentsdb_tpu.histogram.store import (
+    merge_group, downsample_counts, percentiles_of)
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+HIST_CONFIG = '{"SimpleHistogramDecoder": 0}'
+
+
+def make_hist(counts: dict[tuple[float, float], int],
+              under=0, over=0) -> SimpleHistogram:
+    h = SimpleHistogram(0)
+    for (lo, hi), c in counts.items():
+        h.add_bucket(lo, hi, c)
+    h.underflow = under
+    h.overflow = over
+    return h
+
+
+@pytest.fixture
+def tsdb():
+    return TSDB(Config({"tsd.core.auto_create_metrics": True,
+                        "tsd.core.histograms.config": HIST_CONFIG}))
+
+
+class TestSimpleHistogram:
+    def test_percentile_midpoint_rule(self):
+        # SimpleHistogram.percentile returns the midpoint of the first
+        # bucket whose cumulative share reaches p.
+        h = make_hist({(0, 10): 50, (10, 20): 40, (20, 30): 10})
+        assert h.percentile(50) == 5.0     # 50% inside first bucket
+        assert h.percentile(90) == 15.0
+        assert h.percentile(99) == 25.0
+        assert h.percentile(0.5) == -1.0   # out of range
+        assert h.percentile(101) == -1.0
+
+    def test_empty(self):
+        assert SimpleHistogram().percentile(50) == 0.0
+
+    def test_aggregate(self):
+        a = make_hist({(0, 1): 1, (1, 2): 2}, under=1)
+        b = make_hist({(1, 2): 3, (2, 4): 5}, over=2)
+        a.aggregate(b)
+        assert a.buckets == {(0, 1): 1, (1, 2): 5, (2, 4): 5}
+        assert a.underflow == 1 and a.overflow == 2
+
+    def test_binary_round_trip(self):
+        h = make_hist({(0.0, 1.5): 7, (1.5, 3.0): 1 << 40}, under=3, over=9)
+        h.id = 0
+        raw = h.to_bytes(include_id=True)
+        back = SimpleHistogram.from_bytes(raw, include_id=True)
+        assert back == h
+
+    def test_base64_round_trip(self):
+        h = make_hist({(5, 10): 123})
+        assert SimpleHistogram.from_base64(h.to_base64()) == h
+
+    def test_pojo_round_trip(self):
+        h = SimpleHistogram.from_pojo(
+            {"buckets": {"0,5": 2, "5,10": 8}, "underflow": 1})
+        assert h.buckets == {(0.0, 5.0): 2, (5.0, 10.0): 8}
+        assert h.to_json()["buckets"] == {"0,5": 2, "5,10": 8}
+
+    def test_codec_manager(self):
+        mgr = HistogramCodecManager(HIST_CONFIG)
+        codec = mgr.get_codec(0)
+        h = make_hist({(0, 1): 4})
+        assert codec.decode(codec.encode(h, include_id=False),
+                            includes_id=False).buckets == h.buckets
+        with pytest.raises(ValueError):
+            mgr.get_codec(7)
+
+    def test_codec_manager_bad_decoder(self):
+        with pytest.raises(ValueError, match="Unable to find"):
+            HistogramCodecManager('{"NoSuchDecoder": 1}')
+
+
+class TestKernels:
+    def test_merge_group_sums_shared_timestamps(self):
+        pts = [(1000, make_hist({(0, 1): 1})),
+               (1000, make_hist({(0, 1): 2, (1, 2): 3})),
+               (2000, make_hist({(1, 2): 5}))]
+        ts, counts, bounds = merge_group(pts)
+        assert ts.tolist() == [1000, 2000]
+        assert counts.tolist() == [[3, 3], [0, 5]]
+        assert bounds.tolist() == [[0, 1], [1, 2]]
+
+    def test_downsample_counts(self):
+        import numpy as np
+        ts = np.array([0, 500, 1000, 1500], dtype=np.int64)
+        counts = np.array([[1], [2], [3], [4]])
+        wts, out = downsample_counts(ts, counts, 1000)
+        assert wts.tolist() == [0, 1000]
+        assert out.tolist() == [[3], [7]]
+
+    def test_percentiles_vectorized_matches_scalar(self):
+        import numpy as np
+        h = make_hist({(0, 10): 50, (10, 20): 40, (20, 30): 10})
+        ts, counts, bounds = merge_group([(0, h)])
+        out = percentiles_of(counts, bounds, [50.0, 90.0, 99.0])
+        assert out[:, 0].tolist() == [h.percentile(50), h.percentile(90),
+                                      h.percentile(99)]
+
+
+class TestIngestAndQuery:
+    def _seed(self, tsdb, hours=2):
+        for i in range(hours * 4):
+            # latency histogram every 15 min: p50-ish mass around 10-20
+            h = {"buckets": {"0,10": 30, "10,20": 50, "20,100": 20}}
+            tsdb.add_histogram_point_json(
+                "svc.latency", BASE + i * 900, h, {"host": "web01"})
+
+    def test_percentile_query(self, tsdb):
+        self._seed(tsdb)
+        sub = parse_m_subquery("sum:percentiles[50,99]:svc.latency")
+        q = TSQuery(start=str(BASE), end=str(BASE + 7200), queries=[sub])
+        q.validate()
+        results = tsdb.new_query_runner().run(q)
+        by_metric = {r.metric: r for r in results}
+        assert set(by_metric) == {"svc.latency_pct_50.0",
+                                  "svc.latency_pct_99.0"}
+        p50 = by_metric["svc.latency_pct_50.0"].dps
+        assert p50[0][1] == 15.0   # (10+20)/2
+        p99 = by_metric["svc.latency_pct_99.0"].dps
+        assert p99[0][1] == 60.0   # (20+100)/2
+
+    def test_histogram_downsample(self, tsdb):
+        self._seed(tsdb)
+        sub = parse_m_subquery("sum:1h-sum:percentiles[50]:svc.latency")
+        q = TSQuery(start=str(BASE), end=str(BASE + 7200), queries=[sub])
+        q.validate()
+        results = tsdb.new_query_runner().run(q)
+        assert len(results[0].dps) == 2  # two 1h windows
+
+    def test_show_buckets(self, tsdb):
+        self._seed(tsdb, hours=1)
+        sub = parse_m_subquery("sum:show-histogram-buckets:svc.latency")
+        q = TSQuery(start=str(BASE), end=str(BASE + 3600), queries=[sub])
+        q.validate()
+        results = tsdb.new_query_runner().run(q)
+        metrics = {r.metric for r in results}
+        assert "svc.latency_bucket_0_10" in metrics
+        by_metric = {r.metric: r for r in results}
+        assert by_metric["svc.latency_bucket_10_20"].dps[0][1] == 50
+
+    def test_raw_base64_ingest(self, tsdb):
+        h = make_hist({(1, 2): 10})
+        tsdb.add_histogram_point_raw(
+            "raw.metric", BASE, 0, h.to_base64(include_id=False),
+            {"h": "a"})
+        assert tsdb.histogram_store.num_series == 1
+
+    def test_not_configured(self):
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        with pytest.raises(ValueError, match="not configured"):
+            t.add_histogram_point_json("m", BASE, {"buckets": {"0,1": 1}},
+                                       {"h": "a"})
+
+
+class TestHttpSurface:
+    @pytest.fixture
+    def manager(self, tsdb):
+        return RpcManager(tsdb)
+
+    def http(self, manager, method, uri, body=None):
+        data = json.dumps(body).encode() if body is not None else b""
+        q = manager.handle_http(HttpRequest(
+            method=method, uri=uri, body=data,
+            headers={"content-type": "application/json"}))
+        return q.response
+
+    def test_http_histogram_put(self, manager, tsdb):
+        r = self.http(manager, "POST", "/api/histogram", {
+            "metric": "h.m", "timestamp": BASE,
+            "buckets": {"0,5": 3, "5,10": 7}, "tags": {"host": "a"}})
+        assert r.status == 204
+        assert tsdb.histogram_store.num_series == 1
+
+    def test_telnet_histogram(self, manager, tsdb):
+        h = make_hist({(0, 5): 3})
+        class Conn: close_after_write = False
+        out = manager.handle_telnet(
+            Conn(), "histogram 0 t.m %d %s host=a"
+                    % (BASE, h.to_base64(include_id=False)))
+        assert out is None
+        assert tsdb.histogram_store.num_series == 1
+
+    def test_query_endpoint_percentiles(self, manager, tsdb):
+        self.http(manager, "POST", "/api/histogram", {
+            "metric": "q.m", "timestamp": BASE,
+            "buckets": {"0,10": 90, "10,20": 10}, "tags": {"host": "a"}})
+        r = self.http(manager, "GET",
+                      "/api/query?start=%d&end=%d&m=sum:percentiles[90]:q.m"
+                      % (BASE - 10, BASE + 10))
+        body = json.loads(r.body)
+        assert body[0]["metric"] == "q.m_pct_90.0"
+        assert body[0]["dps"][str(BASE)] == 5.0
