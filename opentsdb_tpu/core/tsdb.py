@@ -72,9 +72,28 @@ class TSDB:
             self.config)
         self.histogram_store = (HistogramStore()
                                 if self.histogram_manager else None)
+        from opentsdb_tpu.meta import MetaStore
+        self.meta_store = MetaStore()
         self.rt_publisher = None    # RTPublisher plugin
         self.storage_exception_handler = None
         self.search_plugin = None
+        if self.config.get_bool("tsd.search.enable"):
+            from opentsdb_tpu.search import MemorySearchPlugin
+            self.search_plugin = MemorySearchPlugin()
+            self.search_plugin.initialize(self)
+        self.enable_tsuid_tracking = (
+            self.config.get_bool("tsd.core.meta.enable_tsuid_tracking")
+            or self.config.get_bool(
+                "tsd.core.meta.enable_tsuid_incrementing"))
+        self.enable_realtime_ts = self.config.get_bool(
+            "tsd.core.meta.enable_realtime_ts")
+        self.enable_realtime_uid = self.config.get_bool(
+            "tsd.core.meta.enable_realtime_uid")
+        if self.enable_realtime_uid:
+            for kind, table in (("metric", self.metrics),
+                                ("tagk", self.tag_names),
+                                ("tagv", self.tag_values)):
+                table.on_create = self._make_uid_meta_hook(kind, table)
         self.write_filter = None    # WriteableDataPointFilterPlugin
         self.authentication = None
         self.startup_plugin = None
@@ -122,6 +141,7 @@ class TSDB:
         self.store.add_point(key, ts_ms, num, is_int)
         with self._stats_lock:
             self.datapoints_added += 1
+        self._track_meta(key, ts_ms)
         if self.rt_publisher is not None:
             self.rt_publisher.publish_data_point(metric, ts_ms, num, tags,
                                                  key.tsuid())
@@ -208,6 +228,7 @@ class TSDB:
         self.histogram_store.add_point(key, ts_ms, hist)
         with self._stats_lock:
             self.datapoints_added += 1
+        self._track_meta(key, ts_ms)
         if self.rt_publisher is not None:
             publish = getattr(self.rt_publisher, "publish_histogram_point",
                               None)
@@ -331,8 +352,32 @@ class TSDB:
     # Annotations                                                        #
     # ------------------------------------------------------------------ #
 
+    def _track_meta(self, key, ts_ms: int) -> None:
+        """TSMeta maintenance on the write path (TSDB.java:1259-1285):
+        counters only under enable_tsuid_tracking; realtime_ts creates and
+        indexes the TSMeta once per new series (TSMeta.storeIfNecessary)."""
+        if not (self.enable_tsuid_tracking or self.enable_realtime_ts):
+            return
+        tsuid = self.tsuid(key)
+        created = self.meta_store.record_datapoint(
+            tsuid, ts_ms, count=self.enable_tsuid_tracking)
+        if created and self.enable_realtime_ts \
+                and self.search_plugin is not None:
+            from opentsdb_tpu.meta.rpc import resolve_tsmeta
+            self.search_plugin.index_tsmeta(resolve_tsmeta(self, tsuid))
+
+    def _make_uid_meta_hook(self, kind: str, table):
+        def hook(name: str, uid: int) -> None:
+            meta = self.meta_store.ensure_uidmeta(
+                kind, table.uid_to_hex(uid), name)
+            if self.search_plugin is not None:
+                self.search_plugin.index_uidmeta(meta)
+        return hook
+
     def add_annotation(self, note: Annotation) -> None:
         self.store.add_annotation(note)
+        if self.search_plugin is not None:
+            self.search_plugin.index_annotation(note)
 
     # ------------------------------------------------------------------ #
     # Stats (TSDB.collectStats :785)                                     #

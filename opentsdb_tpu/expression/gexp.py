@@ -337,8 +337,10 @@ def f_multiply_series(args) -> list[SeriesResult]:
 
 
 def f_divide_series(args) -> list[SeriesResult]:
-    """divideSeries(numerator, denominator) (DivideSeries.java: exactly
-    two series; x/0 and missing -> NaN)."""
+    """divideSeries(numerator, denominator) (DivideSeries.java: exactly two
+    series, UNION join with TimeSyncedIterator's default FillPolicy.ZERO —
+    a missing denominator point therefore divides by 0 and yields the
+    Infinity the reference's JEXL double division produces)."""
     series = _merge_all(args)
     if len(series) != 2:
         raise ValueError("divideSeries expects exactly 2 series, got %d"

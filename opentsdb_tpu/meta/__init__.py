@@ -1,0 +1,11 @@
+"""Metadata subsystem: UIDMeta, TSMeta, meta store + HTTP handlers.
+
+Reference behavior: /root/reference/src/meta/ — UIDMeta.java (:81-112
+fields), TSMeta.java (:91-142 fields + CAS counters under
+tsd.core.meta.enable_tsuid_tracking), TSUIDQuery.java (last-point/meta
+lookups), MetaDataCache.java (SPI).
+"""
+
+from opentsdb_tpu.meta.objects import UIDMeta, TSMeta, MetaStore
+
+__all__ = ["UIDMeta", "TSMeta", "MetaStore"]

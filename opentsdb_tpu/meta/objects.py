@@ -1,0 +1,180 @@
+"""UIDMeta / TSMeta objects and the in-memory meta table.
+
+Reference behavior: /root/reference/src/meta/UIDMeta.java (fields :81-112,
+user-editable set via `changed` map — display_name, description, notes,
+custom; `name`/`uid`/`type`/`created` are system-controlled) and
+TSMeta.java (fields :91-142; counters last_received/total_dps maintained on
+write when tsd.core.meta.enable_tsuid_tracking).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+# Fields a PUT/POST may modify (UIDMeta.syncMeta / TSMeta.syncMeta).
+UIDMETA_EDITABLE = ("display_name", "description", "notes", "custom")
+TSMETA_EDITABLE = ("display_name", "description", "notes", "custom",
+                   "units", "data_type", "retention", "max", "min")
+
+
+@dataclass
+class UIDMeta:
+    uid: str = ""
+    type: str = ""          # METRIC / TAGK / TAGV
+    name: str = ""
+    display_name: str = ""
+    description: str = ""
+    notes: str = ""
+    created: int = 0
+    custom: dict | None = None
+
+    def to_json(self) -> dict:
+        return {
+            "uid": self.uid,
+            "type": self.type.upper(),
+            "name": self.name,
+            "displayName": self.display_name,
+            "description": self.description,
+            "notes": self.notes,
+            "created": self.created,
+            "custom": self.custom,
+        }
+
+    def update_from(self, body: dict) -> None:
+        for json_key, attr in (("displayName", "display_name"),
+                               ("description", "description"),
+                               ("notes", "notes"), ("custom", "custom")):
+            if json_key in body:
+                setattr(self, attr, body[json_key])
+
+
+@dataclass
+class TSMeta:
+    tsuid: str = ""
+    display_name: str = ""
+    description: str = ""
+    notes: str = ""
+    created: int = 0
+    custom: dict | None = None
+    units: str = ""
+    data_type: str = ""
+    retention: int = 0
+    max: float = float("nan")
+    min: float = float("nan")
+    last_received: int = 0
+    total_dps: int = 0
+    # resolved views (metric + tag UIDMeta objects)
+    metric: UIDMeta | None = None
+    tags: list[UIDMeta] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        out = {
+            "tsuid": self.tsuid,
+            "displayName": self.display_name,
+            "description": self.description,
+            "notes": self.notes,
+            "created": self.created,
+            "custom": self.custom,
+            "units": self.units,
+            "dataType": self.data_type,
+            "retention": self.retention,
+            "max": self.max,
+            "min": self.min,
+            "lastReceived": self.last_received,
+            "totalDatapoints": self.total_dps,
+        }
+        if self.metric is not None:
+            out["metric"] = self.metric.to_json()
+        out["tags"] = [t.to_json() for t in self.tags]
+        return out
+
+    def update_from(self, body: dict) -> None:
+        mapping = (("displayName", "display_name"),
+                   ("description", "description"), ("notes", "notes"),
+                   ("custom", "custom"), ("units", "units"),
+                   ("dataType", "data_type"), ("retention", "retention"),
+                   ("max", "max"), ("min", "min"))
+        for json_key, attr in mapping:
+            if json_key in body:
+                setattr(self, attr, body[json_key])
+
+
+class MetaStore:
+    """In-memory tsdb-meta table: UIDMeta by (type, uid), TSMeta by tsuid."""
+
+    def __init__(self):
+        self._uidmeta: dict[tuple[str, str], UIDMeta] = {}
+        self._tsmeta: dict[str, TSMeta] = {}
+        self._lock = threading.Lock()
+
+    # -- UIDMeta --
+
+    def get_uidmeta(self, kind: str, uid: str) -> UIDMeta | None:
+        with self._lock:
+            return self._uidmeta.get((kind.lower(), uid.upper()))
+
+    def ensure_uidmeta(self, kind: str, uid: str, name: str) -> UIDMeta:
+        with self._lock:
+            key = (kind.lower(), uid.upper())
+            meta = self._uidmeta.get(key)
+            if meta is None:
+                meta = UIDMeta(uid=uid.upper(), type=kind.lower(),
+                               name=name, created=int(time.time()))
+                self._uidmeta[key] = meta
+            return meta
+
+    def delete_uidmeta(self, kind: str, uid: str) -> bool:
+        with self._lock:
+            return self._uidmeta.pop((kind.lower(), uid.upper()),
+                                     None) is not None
+
+    def all_uidmeta(self) -> list[UIDMeta]:
+        with self._lock:
+            return list(self._uidmeta.values())
+
+    # -- TSMeta --
+
+    def get_tsmeta(self, tsuid: str) -> TSMeta | None:
+        with self._lock:
+            return self._tsmeta.get(tsuid.upper())
+
+    def ensure_tsmeta(self, tsuid: str) -> TSMeta:
+        with self._lock:
+            meta = self._tsmeta.get(tsuid.upper())
+            if meta is None:
+                meta = TSMeta(tsuid=tsuid.upper(),
+                              created=int(time.time()))
+                self._tsmeta[tsuid.upper()] = meta
+            return meta
+
+    def record_datapoint(self, tsuid: str, ts_ms: int,
+                         count: bool = True) -> bool:
+        """Ensure the TSMeta row and (optionally) bump the counters.
+
+        Returns True when this call created the TSMeta — the
+        TSMeta.storeIfNecessary signal realtime indexing keys off.  Counters
+        last_received/total_dps only move under
+        tsd.core.meta.enable_tsuid_tracking (TSMeta.incrementAndGetCounter).
+        """
+        key = tsuid.upper()
+        with self._lock:
+            meta = self._tsmeta.get(key)
+            created = meta is None
+            if created:
+                meta = TSMeta(tsuid=key, created=int(time.time()))
+                self._tsmeta[key] = meta
+            if count:
+                meta.last_received = max(meta.last_received, ts_ms // 1000)
+                meta.total_dps += 1
+        return created
+
+    def delete_tsmeta(self, tsuid: str) -> bool:
+        with self._lock:
+            return self._tsmeta.pop(tsuid.upper(), None) is not None
+
+    def all_tsmeta(self) -> list[TSMeta]:
+        with self._lock:
+            return list(self._tsmeta.values())

@@ -1,0 +1,173 @@
+"""/api/uid/uidmeta and /api/uid/tsmeta handlers.
+
+Reference behavior: /root/reference/src/tsd/UniqueIdRpc.java —
+handleUIDMeta (:~200: GET by uid+type, POST/PUT sync editable fields,
+DELETE) and handleTSMeta (:~300: GET by tsuid or metric query `m`,
+POST/PUT, DELETE; `method_override` query param honored).
+"""
+
+from __future__ import annotations
+
+from opentsdb_tpu.meta.objects import TSMeta, UIDMeta
+from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
+from opentsdb_tpu.uid import NoSuchUniqueId, NoSuchUniqueName, UniqueIdType
+
+
+def _effective_method(query: HttpQuery) -> str:
+    override = query.get_query_string_param("method_override")
+    return (override or query.method).upper()
+
+
+def _resolve_uidmeta(tsdb, kind: str, uid: str) -> UIDMeta:
+    """Existing meta, or a default one synthesized from the UID table
+    (UIDMeta.getUIDMeta returns defaults when no storage row exists)."""
+    table = tsdb.uid_table(kind)
+    name = table.get_name(table.hex_to_uid(uid))  # raises NoSuchUniqueId
+    meta = tsdb.meta_store.get_uidmeta(kind, uid)
+    if meta is None:
+        meta = UIDMeta(uid=uid.upper(), type=kind.lower(), name=name)
+    return meta
+
+
+def handle_uidmeta(tsdb, query: HttpQuery) -> None:
+    method = _effective_method(query)
+    if method == "GET":
+        uid = query.required_query_string_param("uid")
+        kind = query.required_query_string_param("type")
+        UniqueIdType.from_string(kind)
+        try:
+            meta = _resolve_uidmeta(tsdb, kind, uid)
+        except NoSuchUniqueId:
+            raise BadRequestError(
+                "Could not find the requested UID", status=404,
+                details="No such UID %s of type %s" % (uid, kind))
+        query.send_reply(meta.to_json())
+        return
+    if method in ("POST", "PUT"):
+        body = query.json_body() if query.request.body else {
+            "uid": query.get_query_string_param("uid"),
+            "type": query.get_query_string_param("type"),
+            "displayName": query.get_query_string_param("display_name"),
+            "description": query.get_query_string_param("description"),
+            "notes": query.get_query_string_param("notes"),
+        }
+        uid = body.get("uid")
+        kind = body.get("type")
+        if not uid or not kind:
+            raise BadRequestError("Missing UID or type")
+        table = tsdb.uid_table(kind)
+        try:
+            name = table.get_name(table.hex_to_uid(uid))
+        except NoSuchUniqueId:
+            raise BadRequestError(
+                "Could not find the requested UID", status=404)
+        meta = tsdb.meta_store.ensure_uidmeta(kind, uid, name)
+        if method == "PUT":
+            # full overwrite of the editable fields
+            meta.display_name = meta.description = meta.notes = ""
+            meta.custom = None
+        meta.update_from({k: v for k, v in body.items() if v is not None})
+        if tsdb.search_plugin is not None:
+            tsdb.search_plugin.index_uidmeta(meta)
+        query.send_reply(meta.to_json())
+        return
+    if method == "DELETE":
+        uid = query.required_query_string_param("uid")
+        kind = query.required_query_string_param("type")
+        tsdb.meta_store.delete_uidmeta(kind, uid)
+        if tsdb.search_plugin is not None:
+            tsdb.search_plugin.delete_uidmeta(kind, uid)
+        query.send_status_only(204)
+        return
+    raise BadRequestError("Method not allowed", status=405)
+
+
+def resolve_tsmeta(tsdb, tsuid: str) -> TSMeta:
+    """TSMeta with metric/tag UIDMeta views resolved (TSMeta.getTSMeta)."""
+    from opentsdb_tpu.storage.memstore import SeriesKey
+    meta = tsdb.meta_store.get_tsmeta(tsuid)
+    if meta is None:
+        meta = TSMeta(tsuid=tsuid.upper())
+    mw = tsdb.metrics.width * 2
+    kw = tsdb.tag_names.width * 2
+    vw = tsdb.tag_values.width * 2
+    metric_uid = tsuid[:mw]
+    meta.metric = _resolve_uidmeta(tsdb, "metric", metric_uid)
+    meta.tags = []
+    pos = mw
+    while pos < len(tsuid):
+        meta.tags.append(_resolve_uidmeta(tsdb, "tagk",
+                                          tsuid[pos:pos + kw]))
+        pos += kw
+        meta.tags.append(_resolve_uidmeta(tsdb, "tagv",
+                                          tsuid[pos:pos + vw]))
+        pos += vw
+    return meta
+
+
+def handle_tsmeta(tsdb, query: HttpQuery) -> None:
+    method = _effective_method(query)
+    if method == "GET":
+        tsuids = []
+        if query.has_query_string_param("tsuid"):
+            tsuids = [query.required_query_string_param("tsuid")]
+        elif query.has_query_string_param("m"):
+            # metric query form: every matching series' TSMeta
+            from opentsdb_tpu.query.filters import parse_metric_with_filters
+            filters: list = []
+            metric = parse_metric_with_filters(
+                query.required_query_string_param("m"), filters)
+            try:
+                metric_uid = tsdb.metrics.get_id(metric)
+            except NoSuchUniqueName:
+                raise BadRequestError("Could not find the requested "
+                                      "metric", status=404)
+            for series in tsdb.store.series_for_metric(metric_uid):
+                tags = tsdb.resolve_key_tags(series.key)
+                if all(f.match(tags) for f in filters):
+                    tsuids.append(tsdb.tsuid(series.key))
+        else:
+            raise BadRequestError.missing_parameter("tsuid or m")
+        out = []
+        for t in tsuids:
+            try:
+                out.append(resolve_tsmeta(tsdb, t).to_json())
+            except NoSuchUniqueId:
+                raise BadRequestError(
+                    "Could not find one or more UIDs in the TSUID",
+                    status=404, details="tsuid: " + t)
+        if query.has_query_string_param("tsuid"):
+            query.send_reply(out[0] if out else {})
+        else:
+            query.send_reply(out)
+        return
+    if method in ("POST", "PUT"):
+        body = query.json_body() if query.request.body else {
+            "tsuid": query.get_query_string_param("tsuid"),
+            "displayName": query.get_query_string_param("display_name"),
+            "description": query.get_query_string_param("description"),
+            "notes": query.get_query_string_param("notes"),
+        }
+        tsuid = body.get("tsuid")
+        if not tsuid:
+            raise BadRequestError("Missing TSUID")
+        meta = tsdb.meta_store.ensure_tsmeta(tsuid)
+        if method == "PUT":
+            meta.display_name = meta.description = meta.notes = ""
+            meta.custom = None
+            meta.units = meta.data_type = ""
+            meta.retention = 0
+        meta.update_from({k: v for k, v in body.items() if v is not None})
+        resolved = resolve_tsmeta(tsdb, tsuid)
+        if tsdb.search_plugin is not None:
+            tsdb.search_plugin.index_tsmeta(resolved)
+        query.send_reply(resolved.to_json())
+        return
+    if method == "DELETE":
+        tsuid = query.required_query_string_param("tsuid")
+        tsdb.meta_store.delete_tsmeta(tsuid)
+        if tsdb.search_plugin is not None:
+            tsdb.search_plugin.delete_tsmeta(tsuid)
+        query.send_status_only(204)
+        return
+    raise BadRequestError("Method not allowed", status=405)

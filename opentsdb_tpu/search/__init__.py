@@ -1,0 +1,14 @@
+"""Search subsystem: plugin SPI, query pojo, time-series lookup.
+
+Reference behavior: /root/reference/src/search/ — SearchPlugin.java (SPI:
+index/delete TSMeta/UIDMeta/Annotation + executeSearch), SearchQuery.java
+(TSMETA/TSMETA_SUMMARY/TSUIDS/UIDMETA/ANNOTATION/LOOKUP types),
+TimeSeriesLookup.java (storage-native series lookup by metric/tag pairs).
+"""
+
+from opentsdb_tpu.search.plugin import SearchPlugin, MemorySearchPlugin
+from opentsdb_tpu.search.query import SearchQuery, parse_search_type
+from opentsdb_tpu.search.lookup import TimeSeriesLookup
+
+__all__ = ["SearchPlugin", "MemorySearchPlugin", "SearchQuery",
+           "parse_search_type", "TimeSeriesLookup"]

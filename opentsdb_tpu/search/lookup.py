@@ -1,0 +1,99 @@
+"""Storage-native time-series lookup (/api/search/lookup, `tsdb search`).
+
+Reference behavior: /root/reference/src/search/TimeSeriesLookup.java — find
+series matching a metric and/or tag pairs by scanning the meta/data tables;
+`*` or missing values wildcard.  Here the store's series index answers
+directly.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LookupQuery:
+    metric: str | None = None             # None or "*" = any
+    tags: list[tuple[str | None, str | None]] = field(default_factory=list)
+    limit: int = 25
+    start_index: int = 0
+    use_meta: bool = False
+
+    @staticmethod
+    def parse(m_param: str) -> "LookupQuery":
+        """`m=metric{tagk=tagv,...}` with * wildcards (SearchRpc :84-100)."""
+        out = LookupQuery()
+        spec = m_param.strip()
+        if "{" in spec:
+            if not spec.endswith("}"):
+                raise ValueError("Missing '}' in lookup query: " + spec)
+            metric_part, tag_part = spec[:-1].split("{", 1)
+            for pair in tag_part.split(","):
+                if not pair:
+                    continue
+                if "=" not in pair:
+                    raise ValueError("Invalid tag pair: " + pair)
+                k, v = pair.split("=", 1)
+                out.tags.append((k if k not in ("", "*") else None,
+                                 v if v not in ("", "*") else None))
+        else:
+            metric_part = spec
+        out.metric = metric_part if metric_part not in ("", "*") else None
+        return out
+
+
+class TimeSeriesLookup:
+    def __init__(self, tsdb, query: LookupQuery):
+        self.tsdb = tsdb
+        self.query = query
+
+    def lookup(self) -> dict:
+        start = time.time()
+        tsdb = self.tsdb
+        q = self.query
+        if q.metric is not None:
+            metric_uid = tsdb.metrics.get_id(q.metric)   # may raise 404able
+            candidates = tsdb.store.series_for_metric(metric_uid)
+        else:
+            candidates = tsdb.store.all_series()
+        results = []
+        for series in candidates:
+            tags = tsdb.resolve_key_tags(series.key)
+            if not self._tags_match(tags, q.tags):
+                continue
+            results.append({
+                "tsuid": tsdb.tsuid(series.key),
+                "metric": tsdb.metrics.get_name(series.key.metric),
+                "tags": tags,
+            })
+        results.sort(key=lambda r: (r["metric"], r["tsuid"]))
+        total = len(results)
+        page = results[q.start_index:q.start_index + q.limit] \
+            if q.limit else results[q.start_index:]
+        return {
+            "type": "LOOKUP",
+            "metric": q.metric or "*",
+            "tags": [{"key": k or "*", "value": v or "*"}
+                     for k, v in q.tags],
+            "limit": q.limit,
+            "startIndex": q.start_index,
+            "totalResults": total,
+            "results": page,
+            "time": round((time.time() - start) * 1000.0, 3),
+        }
+
+    @staticmethod
+    def _tags_match(tags: dict[str, str],
+                    constraints: list[tuple[str | None, str | None]]) -> bool:
+        for k, v in constraints:
+            if k is not None and v is not None:
+                if tags.get(k) != v:
+                    return False
+            elif k is not None:
+                if k not in tags:
+                    return False
+            elif v is not None:
+                if v not in tags.values():
+                    return False
+        return True

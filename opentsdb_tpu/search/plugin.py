@@ -1,0 +1,158 @@
+"""SearchPlugin SPI + a working in-memory implementation.
+
+Reference behavior: /root/reference/src/search/SearchPlugin.java — the SPI
+the TSD notifies on meta/annotation changes and delegates /api/search to.
+The reference ships no bundled implementation (operators install
+elasticsearch plugins); here MemorySearchPlugin provides substring search
+over indexed documents so /api/search works out of the box, and stands as
+the SPI reference implementation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class SearchPlugin:
+    """SPI surface (SearchPlugin.java)."""
+
+    def initialize(self, tsdb) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def version(self) -> str:
+        return "3.0.0"
+
+    def collect_stats(self, collector) -> None:
+        pass
+
+    def index_tsmeta(self, meta) -> None:
+        raise NotImplementedError
+
+    def delete_tsmeta(self, tsuid: str) -> None:
+        raise NotImplementedError
+
+    def index_uidmeta(self, meta) -> None:
+        raise NotImplementedError
+
+    def delete_uidmeta(self, kind_or_meta, uid: str | None = None) -> None:
+        raise NotImplementedError
+
+    def index_annotation(self, note) -> None:
+        raise NotImplementedError
+
+    def delete_annotation(self, note) -> None:
+        raise NotImplementedError
+
+    def execute_search(self, search_query):
+        raise NotImplementedError
+
+
+class MemorySearchPlugin(SearchPlugin):
+    """Substring-matching in-memory index."""
+
+    def __init__(self):
+        self._tsmeta: dict[str, object] = {}
+        self._uidmeta: dict[tuple[str, str], object] = {}
+        self._annotations: list = []
+        self._lock = threading.Lock()
+
+    # -- indexing --
+
+    def index_tsmeta(self, meta) -> None:
+        with self._lock:
+            self._tsmeta[meta.tsuid] = meta
+
+    def delete_tsmeta(self, tsuid: str) -> None:
+        with self._lock:
+            self._tsmeta.pop(tsuid.upper(), None)
+
+    def index_uidmeta(self, meta) -> None:
+        with self._lock:
+            self._uidmeta[(meta.type.lower(), meta.uid)] = meta
+
+    def delete_uidmeta(self, kind_or_meta, uid: str | None = None) -> None:
+        if uid is None:
+            kind, uid = kind_or_meta.type, kind_or_meta.uid
+        else:
+            kind = kind_or_meta
+        with self._lock:
+            self._uidmeta.pop((kind.lower(), uid.upper()), None)
+
+    def index_annotation(self, note) -> None:
+        with self._lock:
+            self._annotations = [
+                a for a in self._annotations
+                if not (a.tsuid == note.tsuid
+                        and a.start_time == note.start_time)]
+            self._annotations.append(note)
+
+    def delete_annotation(self, note) -> None:
+        with self._lock:
+            self._annotations = [
+                a for a in self._annotations
+                if not (a.tsuid == note.tsuid
+                        and a.start_time == note.start_time)]
+
+    # -- search --
+
+    @staticmethod
+    def _matches(needle: str, *haystacks) -> bool:
+        if not needle:
+            return True
+        needle = needle.lower()
+        return any(needle in (h or "").lower() for h in haystacks)
+
+    def execute_search(self, search_query):
+        start = time.time()
+        q = search_query.query
+        stype = search_query.type
+        hits: list = []
+        with self._lock:
+            if stype in ("TSMETA", "TSMETA_SUMMARY", "TSUIDS"):
+                for meta in self._tsmeta.values():
+                    names = [meta.tsuid, meta.display_name, meta.description,
+                             meta.notes]
+                    if meta.metric is not None:
+                        names.append(meta.metric.name)
+                    names.extend(t.name for t in meta.tags)
+                    if self._matches(q, *names):
+                        hits.append(meta)
+                if stype == "TSMETA":
+                    results = [m.to_json() for m in hits]
+                elif stype == "TSUIDS":
+                    results = [m.tsuid for m in hits]
+                else:   # TSMETA_SUMMARY
+                    results = []
+                    for m in hits:
+                        summary = {"tsuid": m.tsuid}
+                        if m.metric is not None:
+                            summary["metric"] = m.metric.name
+                        tags = {}
+                        for i in range(0, len(m.tags) - 1, 2):
+                            tags[m.tags[i].name] = m.tags[i + 1].name
+                        summary["tags"] = tags
+                        results.append(summary)
+            elif stype == "UIDMETA":
+                for meta in self._uidmeta.values():
+                    if self._matches(q, meta.name, meta.uid,
+                                     meta.display_name, meta.description,
+                                     meta.notes):
+                        hits.append(meta)
+                results = [m.to_json() for m in hits]
+            elif stype == "ANNOTATION":
+                for note in self._annotations:
+                    if self._matches(q, note.description, note.notes,
+                                     note.tsuid):
+                        hits.append(note)
+                results = [n.to_json() for n in hits]
+            else:
+                raise ValueError("Unsupported search type: " + stype)
+        search_query.total_results = len(results)
+        lo = search_query.start_index
+        search_query.results = results[lo:lo + search_query.limit]
+        search_query.time_ms = (time.time() - start) * 1000.0
+        return search_query

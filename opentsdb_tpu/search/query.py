@@ -1,0 +1,47 @@
+"""SearchQuery pojo (SearchQuery.java:44-60, parseSearchType :160-178)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+SEARCH_TYPES = ("TSMETA", "TSMETA_SUMMARY", "TSUIDS", "UIDMETA",
+                "ANNOTATION", "LOOKUP")
+
+
+def parse_search_type(endpoint: str) -> str:
+    normalized = endpoint.strip().upper()
+    if normalized in SEARCH_TYPES:
+        return normalized
+    raise ValueError("Unknown search type: " + endpoint)
+
+
+@dataclass
+class SearchQuery:
+    type: str = "TSMETA"
+    query: str = ""
+    limit: int = 25
+    start_index: int = 0
+    total_results: int = 0
+    results: list = field(default_factory=list)
+    time_ms: float = 0.0
+
+    @staticmethod
+    def from_json(body: dict, search_type: str) -> "SearchQuery":
+        return SearchQuery(
+            type=search_type,
+            query=body.get("query", ""),
+            limit=int(body.get("limit", 25)),
+            start_index=int(body.get("startIndex", 0)))
+
+    def to_json(self) -> dict:
+        return {
+            "type": self.type,
+            "query": self.query,
+            "limit": self.limit,
+            "startIndex": self.start_index,
+            "metric": None,
+            "tags": None,
+            "totalResults": self.total_results,
+            "results": self.results,
+            "time": round(self.time_ms, 3),
+        }

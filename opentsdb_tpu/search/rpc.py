@@ -1,0 +1,66 @@
+"""/api/search/<type> handler (SearchRpc.java:52-130)."""
+
+from __future__ import annotations
+
+from opentsdb_tpu.search.lookup import LookupQuery, TimeSeriesLookup
+from opentsdb_tpu.search.query import SearchQuery, parse_search_type
+from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
+from opentsdb_tpu.uid import NoSuchUniqueName
+
+
+def handle_search(tsdb, query: HttpQuery) -> None:
+    sub = query.api_subpath()
+    endpoint = sub[0] if sub else ""
+    try:
+        stype = parse_search_type(endpoint)
+    except ValueError:
+        raise BadRequestError(
+            "Unknown search endpoint: %s" % endpoint, status=404,
+            details="Try one of tsmeta, tsmeta_summary, tsuids, uidmeta, "
+                    "annotation or lookup")
+    if stype == "LOOKUP":
+        return _handle_lookup(tsdb, query)
+    if tsdb.search_plugin is None:
+        raise BadRequestError(
+            "Searching is not enabled on this TSD", status=501,
+            details="Set tsd.search.enable and tsd.search.plugin")
+    if query.method == "POST" and query.request.body:
+        body = query.serializer.parse_search_query_v1()
+        sq = SearchQuery.from_json(body, stype)
+    else:
+        sq = SearchQuery(
+            type=stype,
+            query=query.get_query_string_param("query") or "",
+            limit=int(query.get_query_string_param("limit") or 25),
+            start_index=int(query.get_query_string_param("start_index")
+                            or 0))
+    result = tsdb.search_plugin.execute_search(sq)
+    query.send_reply(query.serializer.format_search_results_v1(
+        result.to_json()))
+
+
+def _handle_lookup(tsdb, query: HttpQuery) -> None:
+    if query.method == "POST" and query.request.body:
+        body = query.json_body()
+        lq = LookupQuery()
+        lq.metric = body.get("metric")
+        if lq.metric in ("", "*"):
+            lq.metric = None
+        for t in body.get("tags") or []:
+            k = t.get("key")
+            v = t.get("value")
+            lq.tags.append((k if k not in (None, "", "*") else None,
+                            v if v not in (None, "", "*") else None))
+        lq.limit = int(body.get("limit", 25))
+        lq.start_index = int(body.get("startIndex", 0))
+        lq.use_meta = bool(body.get("useMeta", False))
+    else:
+        m = query.required_query_string_param("m")
+        lq = LookupQuery.parse(m)
+        lq.limit = int(query.get_query_string_param("limit") or 25)
+        lq.start_index = int(query.get_query_string_param("start_index")
+                             or 0)
+    try:
+        query.send_reply(TimeSeriesLookup(tsdb, lq).lookup())
+    except NoSuchUniqueName as e:
+        raise BadRequestError(str(e), status=404)

@@ -81,6 +81,7 @@ class UniqueId:
         self.cache_misses = 0
         self.assigned = 0
         self._id_filter = None  # UniqueIdFilterPlugin hook
+        self.on_create = None   # callable(name, uid) on new assignment
 
     @property
     def max_possible_id(self) -> int:
@@ -145,7 +146,11 @@ class UniqueId:
             self._name_to_id[name] = uid
             self._id_to_name[uid] = name
             self.assigned += 1
-            return uid
+        # Outside the lock: realtime-UID meta hook (UniqueIdAllocator's
+        # UIDMeta.storeNew callback under tsd.core.meta.enable_realtime_uid).
+        if self.on_create is not None:
+            self.on_create(name, uid)
+        return uid
 
     # -- admin (UniqueId.suggest :971, rename :1095, deleteAsync :1212) --
 

@@ -1,0 +1,213 @@
+"""Meta + search subsystem tests: UIDMeta/TSMeta CRUD, realtime tracking,
+the search plugin SPI, and /api/search endpoints incl. lookup.
+
+Models /root/reference/test/meta/TestUIDMeta, TestTSMeta and
+/root/reference/test/tsd/TestSearchRpc coverage."""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.search import MemorySearchPlugin, SearchQuery
+from opentsdb_tpu.search.lookup import LookupQuery
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                     "tsd.search.enable": True,
+                     "tsd.core.meta.enable_tsuid_tracking": True,
+                     "tsd.core.meta.enable_realtime_uid": True}))
+    for i in range(5):
+        t.add_point("sys.cpu.user", BASE + i * 10, i,
+                    {"host": "web01", "dc": "lga"})
+        t.add_point("sys.cpu.sys", BASE + i * 10, i, {"host": "web02"})
+    return t
+
+
+@pytest.fixture
+def manager(tsdb):
+    return RpcManager(tsdb)
+
+
+def http(manager, method, uri, body=None):
+    data = json.dumps(body).encode() if body is not None else b""
+    q = manager.handle_http(HttpRequest(
+        method=method, uri=uri, body=data,
+        headers={"content-type": "application/json"}))
+    return q.response
+
+
+def jbody(r):
+    return json.loads(r.body)
+
+
+class TestMetaTracking:
+    def test_tsuid_counters(self, tsdb):
+        series = tsdb.store.all_series()
+        tsuid = tsdb.tsuid(series[0].key)
+        meta = tsdb.meta_store.get_tsmeta(tsuid)
+        assert meta is not None
+        assert meta.total_dps == 5
+        assert meta.last_received == BASE + 40
+
+    def test_realtime_uid_meta(self, tsdb):
+        uid = tsdb.metrics.uid_to_hex(tsdb.metrics.get_id("sys.cpu.user"))
+        meta = tsdb.meta_store.get_uidmeta("metric", uid)
+        assert meta is not None and meta.name == "sys.cpu.user"
+        assert meta.created > 0
+
+
+class TestUidMetaEndpoints:
+    def test_get_default_meta(self, manager, tsdb):
+        uid = tsdb.metrics.uid_to_hex(tsdb.metrics.get_id("sys.cpu.user"))
+        r = http(manager, "GET",
+                 "/api/uid/uidmeta?uid=%s&type=metric" % uid)
+        body = jbody(r)
+        assert body["name"] == "sys.cpu.user"
+        assert body["type"] == "METRIC"
+
+    def test_post_and_get(self, manager, tsdb):
+        uid = tsdb.metrics.uid_to_hex(tsdb.metrics.get_id("sys.cpu.user"))
+        r = http(manager, "POST", "/api/uid/uidmeta", {
+            "uid": uid, "type": "metric", "displayName": "CPU User",
+            "description": "User-space CPU"})
+        assert jbody(r)["displayName"] == "CPU User"
+        r = http(manager, "GET",
+                 "/api/uid/uidmeta?uid=%s&type=metric" % uid)
+        assert jbody(r)["description"] == "User-space CPU"
+
+    def test_unknown_uid_404(self, manager):
+        r = http(manager, "GET", "/api/uid/uidmeta?uid=FFFFFF&type=metric")
+        assert r.status == 404
+
+    def test_delete(self, manager, tsdb):
+        uid = tsdb.metrics.uid_to_hex(tsdb.metrics.get_id("sys.cpu.user"))
+        http(manager, "POST", "/api/uid/uidmeta",
+             {"uid": uid, "type": "metric", "notes": "x"})
+        r = http(manager, "DELETE",
+                 "/api/uid/uidmeta?uid=%s&type=metric" % uid)
+        assert r.status == 204
+
+
+class TestTsMetaEndpoints:
+    def test_get_by_tsuid(self, manager, tsdb):
+        tsuid = tsdb.tsuid(tsdb.store.all_series()[0].key)
+        r = http(manager, "GET", "/api/uid/tsmeta?tsuid=%s" % tsuid)
+        body = jbody(r)
+        assert body["tsuid"] == tsuid
+        assert body["metric"]["name"] in ("sys.cpu.user", "sys.cpu.sys")
+        assert body["totalDatapoints"] == 5
+        # tags list alternates tagk/tagv UIDMeta entries
+        kinds = [t["type"] for t in body["tags"]]
+        assert kinds[0] == "TAGK" and kinds[1] == "TAGV"
+
+    def test_get_by_metric_query(self, manager):
+        r = http(manager, "GET", "/api/uid/tsmeta?m=sys.cpu.user")
+        body = jbody(r)
+        assert len(body) == 1
+        assert body[0]["metric"]["name"] == "sys.cpu.user"
+
+    def test_post_updates(self, manager, tsdb):
+        tsuid = tsdb.tsuid(tsdb.store.all_series()[0].key)
+        r = http(manager, "POST", "/api/uid/tsmeta", {
+            "tsuid": tsuid, "description": "a series", "units": "ms"})
+        body = jbody(r)
+        assert body["description"] == "a series"
+        assert body["units"] == "ms"
+
+
+class TestSearchPlugin:
+    def test_uidmeta_search(self, tsdb):
+        sq = tsdb.search_plugin.execute_search(
+            SearchQuery(type="UIDMETA", query="cpu"))
+        names = {r["name"] for r in sq.results}
+        assert "sys.cpu.user" in names and "sys.cpu.sys" in names
+
+    def test_annotation_index(self, tsdb):
+        from opentsdb_tpu.storage.memstore import Annotation
+        tsdb.add_annotation(Annotation(start_time=BASE * 1000,
+                                       description="deploy v2"))
+        sq = tsdb.search_plugin.execute_search(
+            SearchQuery(type="ANNOTATION", query="deploy"))
+        assert sq.total_results == 1
+
+    def test_limit_and_start_index(self, tsdb):
+        sq = tsdb.search_plugin.execute_search(
+            SearchQuery(type="UIDMETA", query="", limit=2))
+        assert len(sq.results) == 2
+        assert sq.total_results >= 4
+
+
+class TestSearchEndpoints:
+    def test_uidmeta_endpoint(self, manager):
+        r = http(manager, "GET", "/api/search/uidmeta?query=cpu")
+        body = jbody(r)
+        assert body["type"] == "UIDMETA"
+        assert body["totalResults"] >= 2
+
+    def test_tsmeta_endpoint(self, manager, tsdb):
+        tsuid = tsdb.tsuid(tsdb.store.all_series()[0].key)
+        http(manager, "POST", "/api/uid/tsmeta",
+             {"tsuid": tsuid, "description": "indexed"})
+        r = http(manager, "GET", "/api/search/tsmeta?query=indexed")
+        assert jbody(r)["totalResults"] == 1
+
+    def test_unknown_type_404(self, manager):
+        r = http(manager, "GET", "/api/search/bogus")
+        assert r.status == 404
+
+    def test_lookup_by_metric(self, manager):
+        r = http(manager, "GET", "/api/search/lookup?m=sys.cpu.user")
+        body = jbody(r)
+        assert body["type"] == "LOOKUP"
+        assert body["totalResults"] == 1
+        assert body["results"][0]["tags"]["host"] == "web01"
+
+    def test_lookup_by_tag_wildcard(self, manager):
+        r = http(manager, "GET", "/api/search/lookup?m=*{host=web02}")
+        body = jbody(r)
+        assert body["totalResults"] == 1
+        assert body["results"][0]["metric"] == "sys.cpu.sys"
+
+    def test_lookup_tagk_only(self, manager):
+        r = http(manager, "GET", "/api/search/lookup?m=*{dc=*}")
+        body = jbody(r)
+        assert body["totalResults"] == 1
+        assert body["results"][0]["metric"] == "sys.cpu.user"
+
+    def test_lookup_post(self, manager):
+        r = http(manager, "POST", "/api/search/lookup", {
+            "metric": "sys.cpu.sys",
+            "tags": [{"key": "host", "value": "*"}]})
+        assert jbody(r)["totalResults"] == 1
+
+    def test_lookup_unknown_metric(self, manager):
+        r = http(manager, "GET", "/api/search/lookup?m=no.such")
+        assert r.status == 404
+
+
+class TestLookupQueryParse:
+    def test_parse_forms(self):
+        q = LookupQuery.parse("sys.cpu{host=web01,dc=*}")
+        assert q.metric == "sys.cpu"
+        assert q.tags == [("host", "web01"), ("dc", None)]
+        q = LookupQuery.parse("*{*=lga}")
+        assert q.metric is None
+        assert q.tags == [(None, "lga")]
+
+    def test_search_disabled(self):
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        m = RpcManager(t)
+        r = http(m, "GET", "/api/search/tsmeta?query=x")
+        assert r.status == 501
+        # lookup works without a search plugin (storage-native)
+        t.add_point("m1", BASE, 1, {"h": "a"})
+        r = http(m, "GET", "/api/search/lookup?m=m1")
+        assert r.status == 200
