@@ -73,7 +73,11 @@ class TSDB:
         self.histogram_store = (HistogramStore()
                                 if self.histogram_manager else None)
         from opentsdb_tpu.meta import MetaStore
+        from opentsdb_tpu.tree import TreeStore
         self.meta_store = MetaStore()
+        self.tree_store = TreeStore()
+        self.tree_processing = self.config.get_bool(
+            "tsd.core.tree.enable_processing")
         self.rt_publisher = None    # RTPublisher plugin
         self.storage_exception_handler = None
         self.search_plugin = None
@@ -356,15 +360,29 @@ class TSDB:
         """TSMeta maintenance on the write path (TSDB.java:1259-1285):
         counters only under enable_tsuid_tracking; realtime_ts creates and
         indexes the TSMeta once per new series (TSMeta.storeIfNecessary)."""
-        if not (self.enable_tsuid_tracking or self.enable_realtime_ts):
+        if not (self.enable_tsuid_tracking or self.enable_realtime_ts
+                or self.tree_processing):
             return
         tsuid = self.tsuid(key)
         created = self.meta_store.record_datapoint(
             tsuid, ts_ms, count=self.enable_tsuid_tracking)
-        if created and self.enable_realtime_ts \
-                and self.search_plugin is not None:
+        if created and (self.tree_processing or (
+                self.enable_realtime_ts
+                and self.search_plugin is not None)):
             from opentsdb_tpu.meta.rpc import resolve_tsmeta
-            self.search_plugin.index_tsmeta(resolve_tsmeta(self, tsuid))
+            meta = resolve_tsmeta(self, tsuid)
+            if self.enable_realtime_ts and self.search_plugin is not None:
+                self.search_plugin.index_tsmeta(meta)
+            if self.tree_processing:
+                # Realtime tree materialization (TSMeta.storeIfNecessary ->
+                # TreeBuilder.processAllTrees when
+                # tsd.core.tree.enable_processing).
+                for tree in self.tree_store.all_trees():
+                    if tree.enabled:
+                        self.tree_store.process_tsmeta(
+                            tree, meta,
+                            metric=self.metrics.get_name(key.metric),
+                            tags=self.resolve_key_tags(key))
 
     def _make_uid_meta_hook(self, kind: str, table):
         def hook(name: str, uid: int) -> None:

@@ -13,11 +13,6 @@ from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
 from opentsdb_tpu.uid import NoSuchUniqueId, NoSuchUniqueName, UniqueIdType
 
 
-def _effective_method(query: HttpQuery) -> str:
-    override = query.get_query_string_param("method_override")
-    return (override or query.method).upper()
-
-
 def _resolve_uidmeta(tsdb, kind: str, uid: str) -> UIDMeta:
     """Existing meta, or a default one synthesized from the UID table
     (UIDMeta.getUIDMeta returns defaults when no storage row exists)."""
@@ -30,7 +25,7 @@ def _resolve_uidmeta(tsdb, kind: str, uid: str) -> UIDMeta:
 
 
 def handle_uidmeta(tsdb, query: HttpQuery) -> None:
-    method = _effective_method(query)
+    method = query.effective_method()
     if method == "GET":
         uid = query.required_query_string_param("uid")
         kind = query.required_query_string_param("type")
@@ -83,11 +78,17 @@ def handle_uidmeta(tsdb, query: HttpQuery) -> None:
 
 
 def resolve_tsmeta(tsdb, tsuid: str) -> TSMeta:
-    """TSMeta with metric/tag UIDMeta views resolved (TSMeta.getTSMeta)."""
-    from opentsdb_tpu.storage.memstore import SeriesKey
-    meta = tsdb.meta_store.get_tsmeta(tsuid)
-    if meta is None:
+    """TSMeta with metric/tag UIDMeta views resolved (TSMeta.getTSMeta).
+
+    Returns a transient copy — the stored TSMeta is shared across requests
+    and must not be mutated outside the MetaStore lock.
+    """
+    import dataclasses
+    stored = tsdb.meta_store.get_tsmeta(tsuid)
+    if stored is None:
         meta = TSMeta(tsuid=tsuid.upper())
+    else:
+        meta = dataclasses.replace(stored, metric=None, tags=[])
     mw = tsdb.metrics.width * 2
     kw = tsdb.tag_names.width * 2
     vw = tsdb.tag_values.width * 2
@@ -106,7 +107,7 @@ def resolve_tsmeta(tsdb, tsuid: str) -> TSMeta:
 
 
 def handle_tsmeta(tsdb, query: HttpQuery) -> None:
-    method = _effective_method(query)
+    method = query.effective_method()
     if method == "GET":
         tsuids = []
         if query.has_query_string_param("tsuid"):
@@ -151,6 +152,15 @@ def handle_tsmeta(tsdb, query: HttpQuery) -> None:
         tsuid = body.get("tsuid")
         if not tsuid:
             raise BadRequestError("Missing TSUID")
+        # Validate every UID in the TSUID BEFORE creating the store row,
+        # or a typo'd TSUID would leave a garbage TSMeta that suppresses
+        # later realtime indexing of the real series.
+        try:
+            resolve_tsmeta(tsdb, tsuid)
+        except NoSuchUniqueId:
+            raise BadRequestError(
+                "Could not find one or more UIDs in the TSUID",
+                status=404, details="tsuid: " + str(tsuid))
         meta = tsdb.meta_store.ensure_tsmeta(tsuid)
         if method == "PUT":
             meta.display_name = meta.description = meta.notes = ""

@@ -18,7 +18,9 @@ class LookupQuery:
     tags: list[tuple[str | None, str | None]] = field(default_factory=list)
     limit: int = 25
     start_index: int = 0
-    use_meta: bool = False
+    # The reference's useMeta flag picked the meta table over a data-table
+    # scan; here the series index IS the lookup source, so the flag has no
+    # analog and is not modeled.
 
     @staticmethod
     def parse(m_param: str) -> "LookupQuery":

@@ -53,7 +53,6 @@ def _handle_lookup(tsdb, query: HttpQuery) -> None:
                             v if v not in (None, "", "*") else None))
         lq.limit = int(body.get("limit", 25))
         lq.start_index = int(body.get("startIndex", 0))
-        lq.use_meta = bool(body.get("useMeta", False))
     else:
         m = query.required_query_string_param("m")
         lq = LookupQuery.parse(m)

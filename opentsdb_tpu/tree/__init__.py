@@ -1,0 +1,14 @@
+"""Tree subsystem: hierarchical namespace materialization.
+
+Reference behavior: /root/reference/src/tree/ — Tree.java (definition + CAS
+persistence, strict_match/enabled/store_failures flags), TreeRule.java
+(:60-65 rule types METRIC/METRIC_CUSTOM/TAGK/TAGK_CUSTOM/TAGV_CUSTOM with
+regex/separator/display_format), TreeBuilder.java (ordered rule levels
+applied to a TSMeta producing Branch/Leaf rows), Branch.java/Leaf.java.
+"""
+
+from opentsdb_tpu.tree.objects import Tree, TreeRule, Branch, Leaf
+from opentsdb_tpu.tree.builder import TreeBuilder
+from opentsdb_tpu.tree.store import TreeStore
+
+__all__ = ["Tree", "TreeRule", "Branch", "Leaf", "TreeBuilder", "TreeStore"]

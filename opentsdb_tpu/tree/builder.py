@@ -1,0 +1,173 @@
+"""TreeBuilder: apply a tree's ordered rule levels to one TSMeta.
+
+Reference behavior: /root/reference/src/tree/TreeBuilder.java —
+processRuleset (:596: rules on a level are OR'd, first match wins; split
+rules consume one depth level per split element before the rule index
+advances), parseMetricRule/parseTagkRule/parse*CustomRule (:740-925),
+processParsedValue/processSplit/processRegexRule (:926-1050), and
+setCurrentName's display_format tokens {ovalue} {value} {tsuid} {tag_name}.
+
+The recursion is flattened: the walk produces the branch path top-down; the
+deepest element becomes the leaf under its parent branch
+(processRuleset's roll-back-and-attach tail).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from opentsdb_tpu.tree.objects import Branch, Leaf, Tree, TreeRule
+
+
+@dataclass
+class BuildResult:
+    path: list[str] = field(default_factory=list)   # branch path + leaf name
+    not_matched: list[str] = field(default_factory=list)
+    messages: list[str] = field(default_factory=list)
+
+
+class TreeBuilder:
+    def __init__(self, tree: Tree, test_mode: bool = False):
+        self.tree = tree
+        self.test_mode = test_mode
+
+    def build_path(self, meta) -> BuildResult:
+        """Walk the rule levels over a resolved TSMeta (meta.rpc
+        .resolve_tsmeta shape: .tsuid, .metric UIDMeta, .tags [k,v,...])."""
+        result = BuildResult()
+        levels = self.tree.rule_levels()
+        level_idx = 0
+        splits: list[str] | None = None
+        split_idx = 0
+        split_rule: TreeRule | None = None
+        split_original = ""
+        while level_idx < len(levels):
+            name = None
+            if splits is not None:
+                # still consuming split elements of the previous rule
+                if split_idx < len(splits):
+                    name = self._format(split_rule, split_original,
+                                        splits[split_idx], meta)
+                    split_idx += 1
+                    if split_idx >= len(splits):
+                        splits = None
+                        level_idx += 1
+                    if name:
+                        result.path.append(name)
+                    continue
+                splits = None
+            matched_rule = None
+            for rule in levels[level_idx]:
+                value = self._parse_source(rule, meta, result)
+                if value is None:
+                    continue
+                if rule.compiled_regex() is not None:
+                    name = self._apply_regex(rule, value, result)
+                elif rule.separator:
+                    # Java String.split takes a regex, so "\\." means a
+                    # literal dot (processSplit :962).
+                    import re as _re
+                    splits = [s for s in _re.split(rule.separator, value)]
+                    split_original = value
+                    split_rule = rule
+                    if not splits:
+                        splits = None
+                        continue
+                    name = self._format(rule, value, splits[0], meta)
+                    split_idx = 1
+                    if split_idx >= len(splits):
+                        splits = None
+                else:
+                    name = self._format(rule, value, value, meta)
+                if name:
+                    matched_rule = rule
+                    break
+                splits = None
+            if name:
+                result.path.append(name)
+                result.messages.append(
+                    "Depth [%d] matched rule %s" % (len(result.path),
+                                                    _rid(matched_rule)))
+            else:
+                last = levels[level_idx][-1]
+                result.not_matched.append(_rid(last))
+                result.messages.append(
+                    "No match on level %d (%s)" % (last.level, _rid(last)))
+            if splits is None or split_idx >= len(splits):
+                splits = None
+                level_idx += 1
+        return result
+
+    # -- value sources per rule type (parse*Rule :740-925) --
+
+    def _parse_source(self, rule: TreeRule, meta, result: BuildResult
+                      ) -> str | None:
+        t = rule.type.upper()
+        if t == "METRIC":
+            return meta.metric.name if meta.metric else None
+        if t == "METRIC_CUSTOM":
+            custom = (meta.metric.custom or {}) if meta.metric else {}
+            return custom.get(rule.custom_field) or None
+        if t == "TAGK":
+            return self._tag_value(meta, rule.field)
+        if t == "TAGK_CUSTOM":
+            for uidmeta in meta.tags:
+                if uidmeta.type.lower() == "tagk" \
+                        and uidmeta.name == rule.field:
+                    return (uidmeta.custom or {}).get(rule.custom_field) \
+                        or None
+            return None
+        if t == "TAGV_CUSTOM":
+            for uidmeta in meta.tags:
+                if uidmeta.type.lower() == "tagv" \
+                        and uidmeta.name == rule.field:
+                    return (uidmeta.custom or {}).get(rule.custom_field) \
+                        or None
+            return None
+        raise ValueError("Unknown rule type: " + rule.type)
+
+    @staticmethod
+    def _tag_value(meta, tagk: str) -> str | None:
+        """The [tagk, tagv, ...] pair walk of parseTagkRule (:760)."""
+        found = False
+        for uidmeta in meta.tags:
+            if uidmeta.type.lower() == "tagk" and uidmeta.name == tagk:
+                found = True
+            elif uidmeta.type.lower() == "tagv" and found:
+                return uidmeta.name or None
+        return None
+
+    def _apply_regex(self, rule: TreeRule, value: str,
+                     result: BuildResult) -> str | None:
+        m = rule.compiled_regex().search(value)
+        if not m:
+            return None
+        if m.lastindex is None or m.lastindex < rule.regex_group_idx + 1:
+            result.messages.append(
+                "Regex group index [%d] out of bounds for rule %s"
+                % (rule.regex_group_idx, _rid(rule)))
+            return None
+        extracted = m.group(rule.regex_group_idx + 1)
+        if not extracted:
+            return None
+        return self._format(rule, value, extracted, None) or None
+
+    @staticmethod
+    def _format(rule: TreeRule, original: str, extracted: str,
+                meta) -> str:
+        """setCurrentName display_format tokens (:1060-1090)."""
+        fmt = rule.display_format
+        if not fmt:
+            return extracted
+        fmt = fmt.replace("{ovalue}", original)
+        fmt = fmt.replace("{value}", extracted)
+        if meta is not None and "{tsuid}" in fmt:
+            fmt = fmt.replace("{tsuid}", meta.tsuid)
+        fmt = fmt.replace("{tag_name}", rule.field or "")
+        return fmt
+
+
+def _rid(rule: TreeRule | None) -> str:
+    if rule is None:
+        return "?"
+    return "[%d:%d:%s]" % (rule.level, rule.order, rule.type)

@@ -1,0 +1,213 @@
+"""Tree / TreeRule / Branch / Leaf objects.
+
+Reference behavior: Tree.java (fields + flags), TreeRule.java (:76-115
+fields, validateRule :542 — regex XOR-ish constraints, custom rules need
+field+custom_field), Branch.java (display name + path + leaves + child
+branches; branch ids are hex path hashes — here crc32-based, deterministic
+but not byte-identical to the reference's hash).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import zlib
+from dataclasses import dataclass, field
+
+RULE_TYPES = ("METRIC", "METRIC_CUSTOM", "TAGK", "TAGK_CUSTOM",
+              "TAGV_CUSTOM")
+
+
+@dataclass
+class TreeRule:
+    type: str = ""
+    tree_id: int = 0
+    level: int = 0
+    order: int = 0
+    field: str = ""
+    custom_field: str = ""
+    regex: str = ""
+    separator: str = ""
+    regex_group_idx: int = 0
+    display_format: str = ""
+    description: str = ""
+    notes: str = ""
+
+    def __post_init__(self):
+        self._compiled = re.compile(self.regex) if self.regex else None
+
+    def compiled_regex(self):
+        return self._compiled
+
+    def validate(self) -> None:
+        """TreeRule.validateRule (:542)."""
+        if self.type.upper() not in RULE_TYPES:
+            raise ValueError("Invalid rule type: %s" % self.type)
+        t = self.type.upper()
+        if t in ("TAGK", "TAGK_CUSTOM", "TAGV_CUSTOM") and not self.field:
+            raise ValueError(
+                "Missing field name required for " + t + " rule")
+        if t in ("METRIC_CUSTOM", "TAGK_CUSTOM", "TAGV_CUSTOM") \
+                and not self.custom_field:
+            raise ValueError(
+                "Missing custom field name required for " + t + " rule")
+        if self.regex and self.regex_group_idx < 0:
+            raise ValueError(
+                "Invalid regex group index. Cannot be less than 0")
+
+    @staticmethod
+    def from_json(body: dict) -> "TreeRule":
+        rule = TreeRule(
+            type=str(body.get("type", "")).upper(),
+            tree_id=int(body.get("treeId", body.get("tree_id", 0))),
+            level=int(body.get("level", 0)),
+            order=int(body.get("order", 0)),
+            field=body.get("field", "") or "",
+            custom_field=body.get("customField",
+                                  body.get("custom_field", "")) or "",
+            regex=body.get("regex", "") or "",
+            separator=body.get("separator", "") or "",
+            regex_group_idx=int(body.get("regexGroupIdx",
+                                         body.get("regex_group_idx", 0))),
+            display_format=body.get("displayFormat",
+                                    body.get("display_format", "")) or "",
+            description=body.get("description", "") or "",
+            notes=body.get("notes", "") or "")
+        return rule
+
+    def to_json(self) -> dict:
+        return {
+            "type": self.type.upper(),
+            "treeId": self.tree_id,
+            "level": self.level,
+            "order": self.order,
+            "field": self.field,
+            "customField": self.custom_field,
+            "regex": self.regex,
+            "separator": self.separator,
+            "regexGroupIdx": self.regex_group_idx,
+            "displayFormat": self.display_format,
+            "description": self.description,
+            "notes": self.notes,
+        }
+
+
+@dataclass
+class Tree:
+    tree_id: int = 0
+    name: str = ""
+    description: str = ""
+    notes: str = ""
+    strict_match: bool = False
+    enabled: bool = False
+    store_failures: bool = False
+    created: int = field(default_factory=lambda: int(time.time()))
+    # level -> order -> rule
+    rules: dict[int, dict[int, TreeRule]] = field(default_factory=dict)
+    collisions: dict[str, str] = field(default_factory=dict)
+    not_matched: dict[str, str] = field(default_factory=dict)
+
+    def add_rule(self, rule: TreeRule) -> None:
+        rule.validate()
+        rule.tree_id = self.tree_id
+        self.rules.setdefault(rule.level, {})[rule.order] = rule
+
+    def delete_rule(self, level: int, order: int) -> bool:
+        lvl = self.rules.get(level)
+        if lvl is None or order not in lvl:
+            return False
+        del lvl[order]
+        if not lvl:
+            del self.rules[level]
+        return True
+
+    def rule_levels(self) -> list[list[TreeRule]]:
+        return [[self.rules[lvl][o] for o in sorted(self.rules[lvl])]
+                for lvl in sorted(self.rules)]
+
+    def update_from(self, body: dict) -> None:
+        for json_key, attr in (("name", "name"),
+                               ("description", "description"),
+                               ("notes", "notes"),
+                               ("strictMatch", "strict_match"),
+                               ("enabled", "enabled"),
+                               ("storeFailures", "store_failures")):
+            if json_key in body:
+                setattr(self, attr, body[json_key])
+
+    def to_json(self, include_rules: bool = True) -> dict:
+        out = {
+            "treeId": self.tree_id,
+            "name": self.name,
+            "description": self.description,
+            "notes": self.notes,
+            "strictMatch": self.strict_match,
+            "enabled": self.enabled,
+            "storeFailures": self.store_failures,
+            "created": self.created,
+        }
+        if include_rules:
+            out["rules"] = [r.to_json()
+                            for level in self.rule_levels() for r in level]
+        return out
+
+
+def branch_id(tree_id: int, path: tuple[str, ...]) -> str:
+    """Deterministic hex branch id: 4 hex digits of tree id + 8 per path
+    element (Branch.compileBranchId analog; crc32, not the reference hash)."""
+    out = ["%04x" % tree_id]
+    for name in path:
+        out.append("%08x" % zlib.crc32(name.encode()))
+    return "".join(out)
+
+
+@dataclass
+class Leaf:
+    display_name: str
+    tsuid: str
+    metric: str = ""
+    tags: dict[str, str] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "displayName": self.display_name,
+            "tsuid": self.tsuid,
+            "metric": self.metric,
+            "tags": self.tags,
+        }
+
+
+@dataclass
+class Branch:
+    tree_id: int
+    path: tuple[str, ...] = ()          # path INCLUDING this branch's name
+    leaves: dict[str, Leaf] = field(default_factory=dict)
+    children: set[tuple[str, ...]] = field(default_factory=set)
+
+    @property
+    def display_name(self) -> str:
+        return self.path[-1] if self.path else ""
+
+    @property
+    def depth(self) -> int:
+        return len(self.path)
+
+    @property
+    def branch_id(self) -> str:
+        return branch_id(self.tree_id, self.path)
+
+    def to_json(self, child_branches: list["Branch"] | None = None) -> dict:
+        out = {
+            "treeId": self.tree_id,
+            "branchId": self.branch_id,
+            "displayName": self.display_name or "ROOT",
+            "depth": self.depth,
+            "path": {str(i + 1): name for i, name in enumerate(self.path)},
+            "leaves": ([leaf.to_json()
+                        for _, leaf in sorted(self.leaves.items())]
+                       or None),
+        }
+        if child_branches is not None:
+            out["branches"] = ([b.to_json() for b in child_branches]
+                               or None)
+        return out

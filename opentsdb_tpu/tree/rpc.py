@@ -1,0 +1,228 @@
+"""/api/tree endpoints (TreeRpc.java:~60-300).
+
+Routes: /api/tree (CRUD + list), /api/tree/branch (?branch=<id> or
+?treeid=<id> for the root), /api/tree/rule (single rule CRUD by
+treeid/level/order), /api/tree/rules (bulk replace), /api/tree/test
+(?treeid&tsuids= dry-run with messages), /api/tree/collisions,
+/api/tree/not_matched.  A non-standard POST /api/tree/rebuild runs the
+TreeSync pass inline (the reference does this via the `tsdb uid treesync`
+CLI).
+"""
+
+from __future__ import annotations
+
+from opentsdb_tpu.tree.builder import TreeBuilder
+from opentsdb_tpu.tree.objects import Tree, TreeRule
+from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
+from opentsdb_tpu.uid import NoSuchUniqueId
+
+
+def _require_tree(tsdb, tree_id) -> Tree:
+    try:
+        tree_id = int(tree_id)
+    except (TypeError, ValueError):
+        raise BadRequestError("Unable to parse the tree id")
+    tree = tsdb.tree_store.get_tree(tree_id)
+    if tree is None:
+        raise BadRequestError("Unable to locate tree: %s" % tree_id,
+                              status=404)
+    return tree
+
+
+def handle_tree(tsdb, query: HttpQuery) -> None:
+    sub = query.api_subpath()
+    endpoint = sub[0] if sub else ""
+    if endpoint == "":
+        return _tree_crud(tsdb, query)
+    if endpoint == "branch":
+        return _branch(tsdb, query)
+    if endpoint == "rule":
+        return _rule(tsdb, query)
+    if endpoint == "rules":
+        return _rules(tsdb, query)
+    if endpoint == "test":
+        return _test(tsdb, query)
+    if endpoint == "collisions":
+        return _collisions(tsdb, query, "collisions")
+    if endpoint == "not_matched":
+        return _collisions(tsdb, query, "not_matched")
+    if endpoint == "rebuild":
+        return _rebuild(tsdb, query)
+    raise BadRequestError("Unknown tree endpoint: %s" % endpoint,
+                          status=404)
+
+
+def _body_or_params(query: HttpQuery, *names: str) -> dict:
+    if query.request.body:
+        return query.json_body()
+    out = {}
+    for name in names:
+        v = query.get_query_string_param(name)
+        if v is not None:
+            out[name] = v
+    return out
+
+
+def _tree_crud(tsdb, query: HttpQuery) -> None:
+    method = query.effective_method()
+    if method == "GET":
+        tree_id = query.get_query_string_param("treeid") or \
+            query.get_query_string_param("treeId")
+        if tree_id:
+            query.send_reply(_require_tree(tsdb, tree_id).to_json())
+        else:
+            query.send_reply([t.to_json()
+                              for t in tsdb.tree_store.all_trees()])
+        return
+    if method in ("POST", "PUT"):
+        body = _body_or_params(query, "treeid", "name", "description",
+                               "notes", "strictMatch", "enabled",
+                               "storeFailures")
+        tree_id = body.get("treeId", body.get("treeid"))
+        if tree_id:   # edit
+            tree = _require_tree(tsdb, tree_id)
+            if method == "PUT":
+                tree.name = tree.description = tree.notes = ""
+                tree.strict_match = tree.enabled = False
+                tree.store_failures = False
+            tree.update_from(body)
+            query.send_reply(tree.to_json())
+            return
+        if not body.get("name"):
+            raise BadRequestError("Missing tree name")
+        tree = Tree()
+        tree.update_from(body)
+        tsdb.tree_store.create_tree(tree)
+        query.send_reply(tree.to_json())
+        return
+    if method == "DELETE":
+        body = _body_or_params(query, "treeid", "definition")
+        tree_id = body.get("treeId", body.get("treeid"))
+        definition = str(body.get("definition", "false")).lower() == "true"
+        tree = _require_tree(tsdb, tree_id)
+        tsdb.tree_store.delete_tree(tree.tree_id, definition)
+        query.send_status_only(204)
+        return
+    raise BadRequestError("Method not allowed", status=405)
+
+
+def _branch(tsdb, query: HttpQuery) -> None:
+    if query.method != "GET":
+        raise BadRequestError("Method not allowed", status=405)
+    branch_id = query.get_query_string_param("branch")
+    if branch_id:
+        branch = tsdb.tree_store.get_branch_by_id(branch_id)
+    else:
+        tree = _require_tree(
+            tsdb, query.required_query_string_param("treeid"))
+        branch = tsdb.tree_store.get_branch(tree.tree_id, ())
+    if branch is None:
+        raise BadRequestError("Unable to locate branch", status=404)
+    children = tsdb.tree_store.children_of(branch)
+    query.send_reply(branch.to_json(child_branches=children))
+
+
+def _rule(tsdb, query: HttpQuery) -> None:
+    method = query.effective_method()
+    body = _body_or_params(query, "treeid", "level", "order", "type",
+                           "field", "custom_field", "regex", "separator",
+                           "regex_group_idx", "display_format",
+                           "description", "notes")
+    tree = _require_tree(tsdb, body.get("treeId", body.get("treeid")))
+    level = int(body.get("level", 0))
+    order = int(body.get("order", 0))
+    if method == "GET":
+        rule = tree.rules.get(level, {}).get(order)
+        if rule is None:
+            raise BadRequestError("Unable to locate rule", status=404)
+        query.send_reply(rule.to_json())
+        return
+    if method in ("POST", "PUT"):
+        rule = TreeRule.from_json(body)
+        rule.level, rule.order = level, order
+        tree.add_rule(rule)
+        query.send_reply(rule.to_json())
+        return
+    if method == "DELETE":
+        if not tree.delete_rule(level, order):
+            raise BadRequestError("Unable to locate rule", status=404)
+        query.send_status_only(204)
+        return
+    raise BadRequestError("Method not allowed", status=405)
+
+
+def _rules(tsdb, query: HttpQuery) -> None:
+    method = query.effective_method()
+    if method not in ("POST", "PUT", "DELETE"):
+        raise BadRequestError("Method not allowed", status=405)
+    if method == "DELETE":
+        tree = _require_tree(
+            tsdb, query.required_query_string_param("treeid"))
+        tree.rules.clear()
+        query.send_status_only(204)
+        return
+    rules = query.json_body()
+    if not isinstance(rules, list) or not rules:
+        raise BadRequestError("Missing tree rules")
+    tree_ids = {int(r.get("treeId", r.get("tree_id", 0))) for r in rules}
+    if len(tree_ids) != 1:
+        raise BadRequestError(
+            "All rules must belong to the same tree")
+    tree = _require_tree(tsdb, tree_ids.pop())
+    if method == "PUT":
+        tree.rules.clear()
+    for r in rules:
+        tree.add_rule(TreeRule.from_json(r))
+    query.send_status_only(204)
+
+
+def _test(tsdb, query: HttpQuery) -> None:
+    from opentsdb_tpu.meta.rpc import resolve_tsmeta
+    body = _body_or_params(query, "treeid", "tsuids")
+    tree = _require_tree(tsdb, body.get("treeId", body.get("treeid")))
+    tsuids = body.get("tsuids")
+    if isinstance(tsuids, str):
+        tsuids = tsuids.split(",")
+    if not tsuids:
+        raise BadRequestError.missing_parameter("tsuids")
+    results = {}
+    for tsuid in tsuids:
+        entry: dict = {"tsuid": tsuid}
+        try:
+            meta = resolve_tsmeta(tsdb, tsuid)
+        except (NoSuchUniqueId, ValueError) as e:
+            entry["messages"] = ["Unable to locate TSUID meta data: %s" % e]
+            entry["branch"] = None
+            results[tsuid] = entry
+            continue
+        result = TreeBuilder(tree, test_mode=True).build_path(meta)
+        entry["messages"] = result.messages
+        entry["meta"] = meta.to_json()
+        entry["branch"] = {
+            "path": result.path,
+            "notMatched": result.not_matched,
+        }
+        results[tsuid] = entry
+    query.send_reply(results)
+
+
+def _collisions(tsdb, query: HttpQuery, kind: str) -> None:
+    if query.method != "GET":
+        raise BadRequestError("Method not allowed", status=405)
+    tree = _require_tree(
+        tsdb, query.required_query_string_param("treeid"))
+    data = tree.collisions if kind == "collisions" else tree.not_matched
+    tsuids = query.get_query_string_param("tsuids")
+    if tsuids:
+        wanted = {t.strip().upper() for t in tsuids.split(",")}
+        data = {k: v for k, v in data.items() if k.upper() in wanted}
+    query.send_reply(data)
+
+
+def _rebuild(tsdb, query: HttpQuery) -> None:
+    if query.method != "POST":
+        raise BadRequestError("Method not allowed", status=405)
+    tree = _require_tree(
+        tsdb, query.required_query_string_param("treeid"))
+    count = tsdb.tree_store.rebuild(tsdb, tree)
+    query.send_reply({"treeId": tree.tree_id, "leaves": count})

@@ -1,0 +1,135 @@
+"""TreeStore: trees, materialized branches, collision/not-matched records.
+
+Reference behavior: Tree.java persistence into the tsdb-tree table (trees by
+id with CAS, collision/not-matched rows under store_failures) and
+TreeBuilder's branch/leaf writes; TreeSync (src/tools/TreeSync.java) rebuilds
+a tree from every TSMeta.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from opentsdb_tpu.tree.builder import TreeBuilder
+from opentsdb_tpu.tree.objects import Branch, Leaf, Tree
+
+MAX_TREES = 65535
+
+
+class TreeStore:
+    def __init__(self):
+        self._trees: dict[int, Tree] = {}
+        # (tree_id, path tuple) -> Branch
+        self._branches: dict[tuple[int, tuple[str, ...]], Branch] = {}
+        self._lock = threading.Lock()
+
+    # -- tree CRUD (Tree.createNewTree / storeTree / deleteTree) --
+
+    def create_tree(self, tree: Tree) -> int:
+        with self._lock:
+            tree_id = max(self._trees, default=0) + 1
+            if tree_id > MAX_TREES:
+                raise ValueError("Exhausted all possible tree IDs")
+            tree.tree_id = tree_id
+            self._trees[tree_id] = tree
+            self._branches[(tree_id, ())] = Branch(tree_id, ())
+            return tree_id
+
+    def get_tree(self, tree_id: int) -> Tree | None:
+        with self._lock:
+            return self._trees.get(tree_id)
+
+    def all_trees(self) -> list[Tree]:
+        with self._lock:
+            return [self._trees[i] for i in sorted(self._trees)]
+
+    def delete_tree(self, tree_id: int, definition: bool = True) -> bool:
+        """Drop branches (+ the definition unless definition=False, the
+        ?definition=false 'data only' flavor of TreeRpc delete)."""
+        with self._lock:
+            if tree_id not in self._trees:
+                return False
+            for key in [k for k in self._branches if k[0] == tree_id]:
+                del self._branches[key]
+            tree = self._trees[tree_id]
+            tree.collisions.clear()
+            tree.not_matched.clear()
+            if definition:
+                del self._trees[tree_id]
+            else:
+                self._branches[(tree_id, ())] = Branch(tree_id, ())
+            return True
+
+    # -- branches --
+
+    def get_branch(self, tree_id: int, path: tuple[str, ...]
+                   ) -> Branch | None:
+        with self._lock:
+            return self._branches.get((tree_id, path))
+
+    def get_branch_by_id(self, hex_id: str) -> Branch | None:
+        with self._lock:
+            for branch in self._branches.values():
+                if branch.branch_id == hex_id.lower():
+                    return branch
+        return None
+
+    def children_of(self, branch: Branch) -> list[Branch]:
+        with self._lock:
+            return [self._branches[(branch.tree_id, p)]
+                    for p in sorted(branch.children)
+                    if (branch.tree_id, p) in self._branches]
+
+    # -- processing (TreeBuilder.processTimeseriesMeta) --
+
+    def process_tsmeta(self, tree: Tree, meta,
+                       metric: str = "", tags: dict | None = None) -> bool:
+        """Apply the tree's rules to one resolved TSMeta; returns True when
+        a leaf was stored."""
+        result = TreeBuilder(tree).build_path(meta)
+        if result.not_matched and tree.strict_match:
+            if tree.store_failures:
+                tree.not_matched[meta.tsuid] = "; ".join(result.not_matched)
+            return False
+        if not result.path:
+            if tree.store_failures:
+                tree.not_matched[meta.tsuid] = "no rules matched"
+            return False
+        leaf_name = result.path[-1]
+        parent_path = tuple(result.path[:-1])
+        with self._lock:
+            # materialize the branch chain from the root down
+            for depth in range(len(parent_path) + 1):
+                path = tuple(parent_path[:depth])
+                key = (tree.tree_id, path)
+                if key not in self._branches:
+                    self._branches[key] = Branch(tree.tree_id, path)
+                if depth < len(parent_path):
+                    self._branches[key].children.add(
+                        tuple(parent_path[:depth + 1]))
+            parent = self._branches[(tree.tree_id, parent_path)]
+            existing = parent.leaves.get(leaf_name)
+            if existing is not None and existing.tsuid != meta.tsuid:
+                # Leaf collision (Branch.addLeaf + Tree.addCollision)
+                if tree.store_failures:
+                    tree.collisions[meta.tsuid] = existing.tsuid
+                return False
+            parent.leaves[leaf_name] = Leaf(leaf_name, meta.tsuid,
+                                            metric=metric,
+                                            tags=dict(tags or {}))
+        return True
+
+    def rebuild(self, tsdb, tree: Tree) -> int:
+        """TreeSync: run every known series through the tree."""
+        from opentsdb_tpu.meta.rpc import resolve_tsmeta
+        self.delete_tree(tree.tree_id, definition=False)
+        count = 0
+        for series in tsdb.store.all_series():
+            tsuid = tsdb.tsuid(series.key)
+            meta = resolve_tsmeta(tsdb, tsuid)
+            if self.process_tsmeta(
+                    tree, meta,
+                    metric=tsdb.metrics.get_name(series.key.metric),
+                    tags=tsdb.resolve_key_tags(series.key)):
+                count += 1
+        return count

@@ -207,6 +207,12 @@ class HttpQuery:
     def elapsed_ms(self) -> float:
         return (time.time() - self.start_time) * 1000.0
 
+    def effective_method(self) -> str:
+        """HTTP method honoring the method_override query param
+        (HttpQuery.getAPIMethod)."""
+        override = self.get_query_string_param("method_override")
+        return (override or self.method).upper()
+
 
 def error_status(exc: Exception) -> int:
     """HTTP status for an exception: name-lookup misses are 404, user input

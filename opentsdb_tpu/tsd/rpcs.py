@@ -591,6 +591,9 @@ class AnnotationRpc(HttpRpc):
             raise BadRequestError("Missing start time")
         tsuid = (params.get("tsuid") or "").upper()
         if tsdb.store.delete_annotation(tsuid, int(start)):
+            if tsdb.search_plugin is not None:
+                tsdb.search_plugin.delete_annotation(
+                    Annotation(start_time=int(start), tsuid=tsuid))
             query.send_status_only(204)
         else:
             raise BadRequestError(
@@ -623,9 +626,27 @@ class AnnotationRpc(HttpRpc):
                 raise BadRequestError("Missing start time")
             end_ms = int(end) if end not in (None, "") else int(
                 time.time() * 1000)
+            norm_tsuids = [t.upper() for t in tsuids] if tsuids else None
+            if tsdb.search_plugin is not None:
+                # De-index the victims before the store forgets them.
+                pools = norm_tsuids if norm_tsuids else ([""]
+                                                         if global_notes
+                                                         else None)
+                victims = []
+                if pools is None:
+                    for s in tsdb.store.all_series():
+                        victims.extend(tsdb.store.get_annotations(
+                            tsdb.tsuid(s.key), int(start), end_ms))
+                    victims.extend(tsdb.store.get_annotations(
+                        "", int(start), end_ms))
+                else:
+                    for t in pools:
+                        victims.extend(tsdb.store.get_annotations(
+                            t, int(start), end_ms))
+                for note in victims:
+                    tsdb.search_plugin.delete_annotation(note)
             count = tsdb.store.delete_annotation_range(
-                [t.upper() for t in tsuids] if tsuids else None,
-                int(start), end_ms, global_notes)
+                norm_tsuids, int(start), end_ms, global_notes)
             query.send_reply({"totalDeleted": count})
         else:
             raise BadRequestError("Method not allowed", status=405)

@@ -1,0 +1,259 @@
+"""Tree subsystem tests: rule validation, builder semantics (levels, OR'd
+orders, regex, splits, display formats), store materialization,
+collisions/not-matched, and /api/tree endpoints.
+
+Models /root/reference/test/tree/TestTree, TestTreeRule, TestTreeBuilder
+and /root/reference/test/tsd/TestTreeRpc coverage."""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.meta.objects import TSMeta, UIDMeta
+from opentsdb_tpu.tree import Tree, TreeBuilder, TreeRule, TreeStore
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+def make_meta(metric="sys.cpu.user", tags=None, tsuid="0101",
+              metric_custom=None):
+    tags = tags or {"host": "web01.lga.net"}
+    meta = TSMeta(tsuid=tsuid)
+    meta.metric = UIDMeta(uid="000001", type="metric", name=metric,
+                          custom=metric_custom)
+    meta.tags = []
+    for k, v in tags.items():
+        meta.tags.append(UIDMeta(type="tagk", name=k))
+        meta.tags.append(UIDMeta(type="tagv", name=v))
+    return meta
+
+
+def make_tree(*rules, strict=False, store_failures=True) -> Tree:
+    tree = Tree(tree_id=1, name="test", strict_match=strict,
+                store_failures=store_failures, enabled=True)
+    for r in rules:
+        tree.add_rule(r)
+    return tree
+
+
+class TestRuleValidation:
+    def test_types(self):
+        with pytest.raises(ValueError, match="Invalid rule type"):
+            TreeRule(type="BOGUS").validate()
+        with pytest.raises(ValueError, match="field name"):
+            TreeRule(type="TAGK").validate()
+        with pytest.raises(ValueError, match="custom field"):
+            TreeRule(type="METRIC_CUSTOM").validate()
+        TreeRule(type="METRIC").validate()
+        TreeRule(type="TAGK", field="host").validate()
+
+    def test_json_round_trip(self):
+        r = TreeRule.from_json({"type": "tagk", "field": "host",
+                                "level": 2, "order": 1,
+                                "displayFormat": "{value}"})
+        assert r.type == "TAGK" and r.level == 2
+        assert r.to_json()["displayFormat"] == "{value}"
+
+
+class TestBuilder:
+    def test_metric_rule(self):
+        tree = make_tree(TreeRule(type="METRIC", level=0))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["sys.cpu.user"]
+
+    def test_tagk_rule(self):
+        tree = make_tree(TreeRule(type="TAGK", field="host", level=0))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["web01.lga.net"]
+
+    def test_levels_stack(self):
+        tree = make_tree(
+            TreeRule(type="TAGK", field="dc", level=0),
+            TreeRule(type="METRIC", level=1))
+        meta = make_meta(tags={"dc": "lga", "host": "web01"})
+        result = TreeBuilder(tree).build_path(meta)
+        assert result.path == ["lga", "sys.cpu.user"]
+
+    def test_orders_are_ored(self):
+        # first order misses (no such tag), second matches
+        tree = make_tree(
+            TreeRule(type="TAGK", field="nosuch", level=0, order=0),
+            TreeRule(type="TAGK", field="host", level=0, order=1))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["web01.lga.net"]
+        assert result.not_matched == []
+
+    def test_no_match_recorded(self):
+        tree = make_tree(
+            TreeRule(type="TAGK", field="nosuch", level=0),
+            TreeRule(type="METRIC", level=1))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["sys.cpu.user"]
+        assert len(result.not_matched) == 1
+
+    def test_regex_extraction(self):
+        tree = make_tree(TreeRule(
+            type="TAGK", field="host", level=0,
+            regex=r"^(\w+)\.(\w+)\.", regex_group_idx=1))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["lga"]
+
+    def test_regex_no_match(self):
+        tree = make_tree(TreeRule(
+            type="TAGK", field="host", level=0, regex=r"^(\d+)$"))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == []
+
+    def test_split_rule_consumes_levels(self):
+        # metric "sys.cpu.user" split on '.' -> three depth levels
+        tree = make_tree(TreeRule(type="METRIC", separator=r"\.", level=0))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["sys", "cpu", "user"]
+
+    def test_split_then_next_level(self):
+        tree = make_tree(
+            TreeRule(type="METRIC", separator=r"\.", level=0),
+            TreeRule(type="TAGK", field="host", level=1))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["sys", "cpu", "user", "web01.lga.net"]
+
+    def test_display_format(self):
+        tree = make_tree(TreeRule(
+            type="TAGK", field="host", level=0,
+            display_format="{tag_name}: {value}"))
+        result = TreeBuilder(tree).build_path(make_meta())
+        assert result.path == ["host: web01.lga.net"]
+
+    def test_metric_custom_rule(self):
+        tree = make_tree(TreeRule(type="METRIC_CUSTOM", level=0,
+                                  custom_field="owner"))
+        meta = make_meta(metric_custom={"owner": "team-x"})
+        result = TreeBuilder(tree).build_path(meta)
+        assert result.path == ["team-x"]
+
+
+class TestStore:
+    def test_materialize_and_collide(self):
+        store = TreeStore()
+        tree = make_tree(
+            TreeRule(type="TAGK", field="dc", level=0),
+            TreeRule(type="METRIC", level=1))
+        store.create_tree(tree)
+        m1 = make_meta(tags={"dc": "lga", "host": "a"}, tsuid="AA")
+        m2 = make_meta(tags={"dc": "lga", "host": "b"}, tsuid="BB")
+        assert store.process_tsmeta(tree, m1)
+        # same path + same leaf name but different tsuid -> collision
+        assert not store.process_tsmeta(tree, m2)
+        assert tree.collisions == {"BB": "AA"}
+        root = store.get_branch(tree.tree_id, ())
+        assert store.children_of(root)[0].display_name == "lga"
+        branch = store.get_branch(tree.tree_id, ("lga",))
+        assert "sys.cpu.user" in branch.leaves
+
+    def test_strict_match(self):
+        store = TreeStore()
+        tree = make_tree(
+            TreeRule(type="TAGK", field="nosuch", level=0),
+            TreeRule(type="METRIC", level=1),
+            strict=True)
+        store.create_tree(tree)
+        assert not store.process_tsmeta(tree, make_meta(tsuid="CC"))
+        assert "CC" in tree.not_matched
+
+    def test_branch_id_lookup(self):
+        store = TreeStore()
+        tree = make_tree(TreeRule(type="METRIC", level=0))
+        store.create_tree(tree)
+        store.process_tsmeta(tree, make_meta(tsuid="DD"))
+        root = store.get_branch(tree.tree_id, ())
+        assert store.get_branch_by_id(root.branch_id) is root
+
+
+class TestTreeEndpoints:
+    @pytest.fixture
+    def manager(self):
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        for i in range(3):
+            t.add_point("sys.cpu.user", BASE + i, i,
+                        {"host": "web0%d" % i, "dc": "lga"})
+        return RpcManager(t)
+
+    def http(self, manager, method, uri, body=None):
+        data = json.dumps(body).encode() if body is not None else b""
+        q = manager.handle_http(HttpRequest(
+            method=method, uri=uri, body=data,
+            headers={"content-type": "application/json"}))
+        return q.response
+
+    def test_full_lifecycle(self, manager):
+        # create tree
+        r = self.http(manager, "POST", "/api/tree",
+                      {"name": "Host Tree", "enabled": True})
+        body = json.loads(r.body)
+        tree_id = body["treeId"]
+        assert tree_id == 1
+        # add rules
+        r = self.http(manager, "POST", "/api/tree/rules", [
+            {"treeId": tree_id, "level": 0, "order": 0, "type": "TAGK",
+             "field": "dc"},
+            {"treeId": tree_id, "level": 1, "order": 0, "type": "METRIC"}])
+        assert r.status == 204
+        # rebuild from existing series
+        r = self.http(manager, "POST",
+                      "/api/tree/rebuild?treeid=%d" % tree_id)
+        body = json.loads(r.body)
+        assert body["leaves"] >= 1
+        # browse root branch
+        r = self.http(manager, "GET", "/api/tree/branch?treeid=%d" % tree_id)
+        body = json.loads(r.body)
+        assert body["displayName"] == "ROOT"
+        assert body["branches"][0]["displayName"] == "lga"
+        # walk into the child branch by id
+        child_id = body["branches"][0]["branchId"]
+        r = self.http(manager, "GET", "/api/tree/branch?branch=" + child_id)
+        body = json.loads(r.body)
+        assert body["leaves"][0]["displayName"] == "sys.cpu.user"
+        # single rule fetch
+        r = self.http(manager, "GET",
+                      "/api/tree/rule?treeid=%d&level=0&order=0" % tree_id)
+        assert json.loads(r.body)["type"] == "TAGK"
+        # tree listing
+        r = self.http(manager, "GET", "/api/tree")
+        assert len(json.loads(r.body)) == 1
+        # default delete clears data but keeps the definition
+        # (TreeRpc delete: definition param defaults false)
+        r = self.http(manager, "DELETE", "/api/tree?treeid=%d" % tree_id)
+        assert r.status == 204
+        r = self.http(manager, "GET", "/api/tree?treeid=%d" % tree_id)
+        assert r.status == 200
+        # definition=true removes the tree entirely
+        r = self.http(manager, "DELETE",
+                      "/api/tree?treeid=%d&definition=true" % tree_id)
+        assert r.status == 204
+        r = self.http(manager, "GET", "/api/tree?treeid=%d" % tree_id)
+        assert r.status == 404
+
+    def test_test_endpoint(self, manager):
+        self.http(manager, "POST", "/api/tree", {"name": "T"})
+        self.http(manager, "POST", "/api/tree/rule",
+                  {"treeId": 1, "level": 0, "order": 0, "type": "METRIC"})
+        tsdb = manager.tsdb
+        tsuid = tsdb.tsuid(tsdb.store.all_series()[0].key)
+        r = self.http(manager, "GET",
+                      "/api/tree/test?treeid=1&tsuids=%s" % tsuid)
+        body = json.loads(r.body)
+        assert body[tsuid]["branch"]["path"] == ["sys.cpu.user"]
+
+    def test_realtime_processing(self):
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                         "tsd.core.tree.enable_processing": True}))
+        tree = Tree(name="rt", enabled=True)
+        t.tree_store.create_tree(tree)
+        tree.add_rule(TreeRule(type="METRIC", level=0, tree_id=1))
+        t.add_point("rt.metric", BASE, 1, {"h": "a"})
+        root = t.tree_store.get_branch(1, ())
+        assert "rt.metric" in root.leaves
