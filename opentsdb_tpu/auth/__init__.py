@@ -1,0 +1,17 @@
+"""Authentication / authorization subsystem.
+
+Reference behavior: /root/reference/src/auth/ — Authentication.java (:36
+SPI: authenticateTelnet/authenticateHTTP/authorization), Authorization.java,
+AuthState.java (:31 SUCCESS/UNAUTHORIZED/FORBIDDEN/REDIRECTED/ERROR),
+Permissions.java (:25), Roles.java, AllowAllAuthenticatingAuthorizer.java
+(:36 the bundled allow-everything impl), AuthenticationChannelHandler.java
+(:50 first-message auth on new connections, telnet `auth` command,
+AUTH_SUCCESS/AUTH_FAIL replies).
+"""
+
+from opentsdb_tpu.auth.core import (
+    AuthState, AuthStatus, Authentication, Authorization, Permissions,
+    Roles, AllowAllAuthenticatingAuthorizer)
+
+__all__ = ["AuthState", "AuthStatus", "Authentication", "Authorization",
+           "Permissions", "Roles", "AllowAllAuthenticatingAuthorizer"]

@@ -1,0 +1,143 @@
+"""Auth SPIs + the bundled allow-all implementation."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class AuthStatus(enum.Enum):
+    """AuthState.AuthStatus (:31)."""
+    SUCCESS = "SUCCESS"
+    UNAUTHORIZED = "UNAUTHORIZED"
+    FORBIDDEN = "FORBIDDEN"
+    REDIRECTED = "REDIRECTED"
+    ERROR = "ERROR"
+
+
+class Permissions(enum.Enum):
+    """Permissions.java:25."""
+    TELNET_PUT = "TELNET_PUT"
+    HTTP_PUT = "HTTP_PUT"
+    HTTP_QUERY = "HTTP_QUERY"
+    CREATE_TAGK = "CREATE_TAGK"
+    CREATE_TAGV = "CREATE_TAGV"
+    CREATE_METRIC = "CREATE_METRIC"
+
+
+class Roles:
+    """A named permission grant set (Roles.java)."""
+
+    def __init__(self, permissions: set[Permissions] | None = None):
+        self.permissions: set[Permissions] = set(permissions or ())
+
+    def grant(self, *permissions: Permissions) -> None:
+        self.permissions.update(permissions)
+
+    def revoke(self, *permissions: Permissions) -> None:
+        self.permissions.difference_update(permissions)
+
+    def has_permission(self, permission: Permissions) -> bool:
+        return permission in self.permissions
+
+
+@dataclass
+class AuthState:
+    """AuthState.java: the outcome of an authentication attempt."""
+    user: str = ""
+    status: AuthStatus = AuthStatus.ERROR
+    message: str = ""
+    token: bytes | None = None
+    roles: Roles = field(default_factory=Roles)
+
+
+class Authentication:
+    """SPI (Authentication.java:36)."""
+
+    def initialize(self, tsdb) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def version(self) -> str:
+        return "3.0.0"
+
+    def collect_stats(self, collector) -> None:
+        pass
+
+    def authenticate_telnet(self, conn, command: list[str]) -> AuthState:
+        raise NotImplementedError
+
+    def authenticate_http(self, conn, request) -> AuthState:
+        raise NotImplementedError
+
+    def authorization(self) -> "Authorization | None":
+        return None
+
+    def is_ready(self, tsdb, conn) -> bool:
+        """Whether the channel has already authenticated
+        (Authentication.isReady :127)."""
+        state = getattr(conn, "auth_state", None)
+        if state is None:
+            return False
+        return state.status == AuthStatus.SUCCESS
+
+
+class Authorization:
+    """SPI (Authorization.java)."""
+
+    def allow_query(self, state: AuthState, query) -> AuthState:
+        raise NotImplementedError
+
+    def has_role(self, state: AuthState, role: str) -> AuthState:
+        raise NotImplementedError
+
+    def has_permission(self, state: AuthState,
+                       permission: Permissions) -> AuthState:
+        raise NotImplementedError
+
+
+class AllowAllAuthenticatingAuthorizer(Authentication, Authorization):
+    """Grants everything (AllowAllAuthenticatingAuthorizer.java:36)."""
+
+    GUEST_MESSAGE = "Guest User allowed by AllowAllAuthenticatingAuthorizer"
+
+    def __init__(self):
+        self.telnet_allowed = 0
+        self.http_allowed = 0
+        self.queries_allowed = 0
+
+    def _guest(self) -> AuthState:
+        roles = Roles(set(Permissions))
+        return AuthState(user="guest", status=AuthStatus.SUCCESS,
+                         message=self.GUEST_MESSAGE, roles=roles)
+
+    def authenticate_telnet(self, conn, command: list[str]) -> AuthState:
+        self.telnet_allowed += 1
+        return self._guest()
+
+    def authenticate_http(self, conn, request) -> AuthState:
+        self.http_allowed += 1
+        return self._guest()
+
+    def authorization(self) -> Authorization:
+        return self
+
+    def allow_query(self, state: AuthState, query) -> AuthState:
+        self.queries_allowed += 1
+        return state
+
+    def has_role(self, state: AuthState, role: str) -> AuthState:
+        return state
+
+    def has_permission(self, state: AuthState,
+                       permission: Permissions) -> AuthState:
+        return state
+
+    def collect_stats(self, collector) -> None:
+        collector.record("authentication.telnet.allowed",
+                         self.telnet_allowed)
+        collector.record("authentication.http.allowed", self.http_allowed)
+        collector.record("authorization.queries.allowed",
+                         self.queries_allowed)

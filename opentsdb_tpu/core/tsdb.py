@@ -80,11 +80,7 @@ class TSDB:
             "tsd.core.tree.enable_processing")
         self.rt_publisher = None    # RTPublisher plugin
         self.storage_exception_handler = None
-        self.search_plugin = None
-        if self.config.get_bool("tsd.search.enable"):
-            from opentsdb_tpu.search import MemorySearchPlugin
-            self.search_plugin = MemorySearchPlugin()
-            self.search_plugin.initialize(self)
+        self.search_plugin = None   # wired by plugins.initialize_plugins
         self.enable_tsuid_tracking = (
             self.config.get_bool("tsd.core.meta.enable_tsuid_tracking")
             or self.config.get_bool(
@@ -102,6 +98,8 @@ class TSDB:
         self.authentication = None
         self.startup_plugin = None
         self.mode = self.config.get_string("tsd.mode")  # rw / ro / wo
+        from opentsdb_tpu.plugins import initialize_plugins
+        initialize_plugins(self)
         self.start_time = time.time()
         self._stats_lock = threading.Lock()
         self.datapoints_added = 0

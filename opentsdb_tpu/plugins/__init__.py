@@ -1,0 +1,22 @@
+"""Plugin SPIs + the loader.
+
+Reference behavior: plugin interfaces scattered across the reference
+(SURVEY.md §2 layer 10): RTPublisher.java (realtime datapoint fanout),
+StorageExceptionHandler.java (failed-write spillway), RpcPlugin.java /
+HttpRpcPlugin.java (extra protocol endpoints),
+WriteableDataPointFilterPlugin.java (write gate), UniqueIdFilterPlugin.java
+(UID assignment gate), StartupPlugin.java, MetaDataCache.java — loaded via
+PluginLoader.java + ServiceLoader.  Python loading resolves dotted
+`module:Class` (or `module.Class`) paths from config.
+"""
+
+from opentsdb_tpu.plugins.spi import (
+    RTPublisher, StorageExceptionHandler, RpcPlugin, HttpRpcPlugin,
+    WriteableDataPointFilterPlugin, UniqueIdFilterPlugin, StartupPlugin,
+    MetaDataCache)
+from opentsdb_tpu.plugins.loader import load_plugin, initialize_plugins
+
+__all__ = ["RTPublisher", "StorageExceptionHandler", "RpcPlugin",
+           "HttpRpcPlugin", "WriteableDataPointFilterPlugin",
+           "UniqueIdFilterPlugin", "StartupPlugin", "MetaDataCache",
+           "load_plugin", "initialize_plugins"]

@@ -1,0 +1,126 @@
+"""Plugin loading + TSDB.initializePlugins equivalent.
+
+Reference behavior: PluginLoader.java (jar scanning + ServiceLoader lookup;
+here: dotted-path import) and TSDB.initializePlugins (:422 — loads auth,
+startup, RTPublisher, SEH, search, write filters, UID filters from their
+tsd.* config keys, failing fast on misconfiguration).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import sys
+
+LOG = logging.getLogger("plugins")
+
+
+def load_plugin(path: str, expected_type: type | None = None):
+    """Instantiate `package.module:Class` (or `package.module.Class`)."""
+    if not path:
+        raise ValueError("Empty plugin path")
+    if ":" in path:
+        module_name, class_name = path.split(":", 1)
+    else:
+        module_name, _, class_name = path.rpartition(".")
+        if not module_name:
+            raise ValueError("Invalid plugin path: %s" % path)
+    plugin_dir = None
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as e:
+        raise ValueError("Unable to locate plugin module: %s (%s)"
+                         % (module_name, e))
+    cls = getattr(module, class_name, None)
+    if cls is None:
+        raise ValueError("Unable to locate plugin class: %s" % path)
+    instance = cls()
+    if expected_type is not None and not isinstance(instance,
+                                                    expected_type):
+        raise ValueError(
+            "Plugin %s is not an instance of %s"
+            % (path, expected_type.__name__))
+    return instance
+
+
+def add_plugin_path(plugin_path: str) -> None:
+    """tsd.core.plugin_path: a directory added to the import path."""
+    if plugin_path and plugin_path not in sys.path:
+        sys.path.insert(0, plugin_path)
+
+
+def initialize_plugins(tsdb) -> None:
+    """Wire every configured plugin into the TSDB (TSDB.java:422-540)."""
+    from opentsdb_tpu.auth import (Authentication,
+                                   AllowAllAuthenticatingAuthorizer)
+    from opentsdb_tpu.plugins.spi import (
+        RTPublisher, StorageExceptionHandler, StartupPlugin,
+        UniqueIdFilterPlugin, WriteableDataPointFilterPlugin)
+    config = tsdb.config
+    plugin_path = config.get_string("tsd.core.plugin_path")
+    if plugin_path:
+        add_plugin_path(plugin_path)
+
+    if config.get_bool("tsd.core.authentication.enable"):
+        path = config.get_string("tsd.core.authentication.plugin")
+        if path:
+            tsdb.authentication = load_plugin(path, Authentication)
+        else:
+            tsdb.authentication = AllowAllAuthenticatingAuthorizer()
+        tsdb.authentication.initialize(tsdb)
+        LOG.info("Initialized authentication plugin: %s",
+                 type(tsdb.authentication).__name__)
+
+    if config.get_bool("tsd.rtpublisher.enable"):
+        path = config.get_string("tsd.rtpublisher.plugin")
+        if not path:
+            raise ValueError(
+                "tsd.rtpublisher.enable is set but tsd.rtpublisher.plugin "
+                "is empty")
+        tsdb.rt_publisher = load_plugin(path, RTPublisher)
+        tsdb.rt_publisher.initialize(tsdb)
+
+    if config.get_bool("tsd.core.storage_exception_handler.enable"):
+        path = config.get_string("tsd.core.storage_exception_handler.plugin")
+        if not path:
+            raise ValueError(
+                "tsd.core.storage_exception_handler.enable is set but the "
+                "plugin is empty")
+        tsdb.storage_exception_handler = load_plugin(
+            path, StorageExceptionHandler)
+        tsdb.storage_exception_handler.initialize(tsdb)
+
+    if config.get_bool("tsd.timeseriesfilter.enable"):
+        path = config.get_string("tsd.timeseriesfilter.plugin")
+        if not path:
+            raise ValueError("tsd.timeseriesfilter.enable is set but "
+                             "tsd.timeseriesfilter.plugin is empty")
+        tsdb.write_filter = load_plugin(path,
+                                        WriteableDataPointFilterPlugin)
+        tsdb.write_filter.initialize(tsdb)
+
+    if config.get_bool("tsd.uidfilter.enable"):
+        path = config.get_string("tsd.uidfilter.plugin")
+        if not path:
+            raise ValueError("tsd.uidfilter.enable is set but "
+                             "tsd.uidfilter.plugin is empty")
+        uid_filter = load_plugin(path, UniqueIdFilterPlugin)
+        uid_filter.initialize(tsdb)
+        for table in (tsdb.metrics, tsdb.tag_names, tsdb.tag_values):
+            table.set_filter(uid_filter)
+
+    if config.get_bool("tsd.search.enable"):
+        path = config.get_string("tsd.search.plugin")
+        from opentsdb_tpu.search import MemorySearchPlugin, SearchPlugin
+        if path:
+            tsdb.search_plugin = load_plugin(path, SearchPlugin)
+        else:
+            # Bundled default so /api/search works out of the box.
+            tsdb.search_plugin = MemorySearchPlugin()
+        tsdb.search_plugin.initialize(tsdb)
+
+    if config.get_bool("tsd.startup.enable"):
+        path = config.get_string("tsd.startup.plugin")
+        if path:
+            tsdb.startup_plugin = load_plugin(path, StartupPlugin)
+            tsdb.startup_plugin.initialize(tsdb)

@@ -1,0 +1,93 @@
+"""Plugin SPI base classes (one per reference plugin interface)."""
+
+from __future__ import annotations
+
+
+class Plugin:
+    """Shared lifecycle (every reference SPI declares these four)."""
+
+    def initialize(self, tsdb) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def version(self) -> str:
+        return "3.0.0"
+
+    def collect_stats(self, collector) -> None:
+        pass
+
+
+class RTPublisher(Plugin):
+    """Realtime datapoint fanout (RTPublisher.java: publishDataPoint
+    :121-136, sinkDataPoint :97, publishAnnotation)."""
+
+    def publish_data_point(self, metric: str, timestamp: int, value,
+                           tags: dict, tsuid: str) -> None:
+        raise NotImplementedError
+
+    def publish_histogram_point(self, metric: str, timestamp: int, hist,
+                                tags: dict, tsuid: str) -> None:
+        pass
+
+    def publish_annotation(self, annotation) -> None:
+        pass
+
+
+class StorageExceptionHandler(Plugin):
+    """Failed-write spillway (StorageExceptionHandler.java: handleError)."""
+
+    def handle_error(self, dp: dict, exception: Exception) -> None:
+        raise NotImplementedError
+
+
+class RpcPlugin(Plugin):
+    """Arbitrary protocol plugin (RpcPlugin.java)."""
+
+
+class HttpRpcPlugin(Plugin):
+    """Extra HTTP endpoints under /plugin/<route> (HttpRpcPlugin.java)."""
+
+    def route(self) -> str:
+        raise NotImplementedError
+
+    def execute_http(self, tsdb, query) -> None:
+        raise NotImplementedError
+
+
+class WriteableDataPointFilterPlugin(Plugin):
+    """Write gate (WriteableDataPointFilterPlugin.java: allowDataPoint /
+    allowHistogramPoint)."""
+
+    def allow(self, metric: str, timestamp, value, tags: dict) -> bool:
+        raise NotImplementedError
+
+    def allow_histogram(self, metric: str, timestamp, hist,
+                        tags: dict) -> bool:
+        return self.allow(metric, timestamp, hist, tags)
+
+
+class UniqueIdFilterPlugin(Plugin):
+    """UID assignment gate (UniqueIdFilterPlugin.java: allowUIDAssignment,
+    fillterUIDAssignments)."""
+
+    def allow_uid_assignment(self, name: str, kind) -> bool:
+        raise NotImplementedError
+
+
+class StartupPlugin(Plugin):
+    """Pre-TSDB startup hook (tools/StartupPlugin.java)."""
+
+    def set_ready(self, tsdb) -> None:
+        pass
+
+
+class MetaDataCache(Plugin):
+    """Meta cache SPI (meta/MetaDataCache.java)."""
+
+    def get_tsmeta(self, tsuid: str):
+        raise NotImplementedError
+
+    def put_tsmeta(self, meta) -> None:
+        raise NotImplementedError

@@ -396,6 +396,11 @@ class MemStore:
                                         if a.start_time != start_time]
             return len(self._annotations[tsuid]) != before
 
+    def annotation_keys(self) -> list[str]:
+        """Every tsuid holding annotations ("" = global)."""
+        with self._lock:
+            return list(self._annotations.keys())
+
     def delete_annotation_range(self, tsuids: Sequence[str] | None,
                                 start_ms: int, end_ms: int,
                                 global_notes: bool = False) -> int:

@@ -34,7 +34,13 @@ class TreeRule:
     notes: str = ""
 
     def __post_init__(self):
-        self._compiled = re.compile(self.regex) if self.regex else None
+        if self.regex:
+            try:
+                self._compiled = re.compile(self.regex)
+            except re.error as e:
+                raise ValueError("Invalid regex '%s': %s" % (self.regex, e))
+        else:
+            self._compiled = None
 
     def compiled_regex(self):
         return self._compiled
@@ -128,12 +134,18 @@ class Tree:
     def update_from(self, body: dict) -> None:
         for json_key, attr in (("name", "name"),
                                ("description", "description"),
-                               ("notes", "notes"),
-                               ("strictMatch", "strict_match"),
+                               ("notes", "notes")):
+            if json_key in body:
+                setattr(self, attr, body[json_key])
+        for json_key, attr in (("strictMatch", "strict_match"),
                                ("enabled", "enabled"),
                                ("storeFailures", "store_failures")):
             if json_key in body:
-                setattr(self, attr, body[json_key])
+                value = body[json_key]
+                if isinstance(value, str):
+                    # query-string form sends "true"/"false"
+                    value = value.strip().lower() == "true"
+                setattr(self, attr, bool(value))
 
     def to_json(self, include_rules: bool = True) -> dict:
         out = {

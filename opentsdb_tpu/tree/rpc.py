@@ -169,10 +169,15 @@ def _rules(tsdb, query: HttpQuery) -> None:
         raise BadRequestError(
             "All rules must belong to the same tree")
     tree = _require_tree(tsdb, tree_ids.pop())
+    # Validate the whole replacement set BEFORE mutating the tree, so a bad
+    # rule cannot destroy a working ruleset mid-apply.
+    parsed = [TreeRule.from_json(r) for r in rules]
+    for rule in parsed:
+        rule.validate()
     if method == "PUT":
         tree.rules.clear()
-    for r in rules:
-        tree.add_rule(TreeRule.from_json(r))
+    for rule in parsed:
+        tree.add_rule(rule)
     query.send_status_only(204)
 
 

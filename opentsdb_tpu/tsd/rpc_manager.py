@@ -8,10 +8,14 @@ and RpcHandler.java dispatch.
 
 from __future__ import annotations
 
+import logging
+
 from opentsdb_tpu.stats.query_stats import QueryStatsRegistry
 from opentsdb_tpu.tsd import admin_rpcs, rpcs
 from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery, HttpRequest
 from opentsdb_tpu.tsd.serializers import serializer_for
+
+LOG = logging.getLogger("tsd.rpc")
 
 
 class RpcManager:
@@ -150,6 +154,21 @@ class RpcManager:
                     "CORS domain not allowed",
                     details="Origin is not in tsd.http.request.cors_domains"))
                 return query
+        auth = self.tsdb.authentication
+        if auth is not None:
+            # Per-request HTTP auth (AuthenticationChannelHandler HTTP arm).
+            from opentsdb_tpu.auth import AuthStatus
+            try:
+                state = auth.authenticate_http(None, request)
+            except Exception:
+                LOG.exception("Authentication plugin failed on HTTP "
+                              "request from %s; failing closed", remote)
+                state = None
+            if state is None or state.status != AuthStatus.SUCCESS:
+                query.send_error(BadRequestError(
+                    "Authentication failed", status=401))
+                return query
+            query.auth_state = state
         try:
             query.serializer = serializer_for(query)
             # plugin routes live under /plugin/<route>

@@ -149,6 +149,10 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
             except Exception as e:
                 failed += 1
                 self._count("hbase_errors")
+                if tsdb.storage_exception_handler is not None:
+                    # Failed-write spillway (TSDB.storeIntoDB error
+                    # callbacks -> StorageExceptionHandler.handleError).
+                    tsdb.storage_exception_handler.handle_error(dp, e)
                 details.append({"error": "Storage exception: %s" % e,
                                 "datapoint": dp})
         if not show_details and not show_summary:
@@ -628,23 +632,18 @@ class AnnotationRpc(HttpRpc):
                 time.time() * 1000)
             norm_tsuids = [t.upper() for t in tsuids] if tsuids else None
             if tsdb.search_plugin is not None:
-                # De-index the victims before the store forgets them.
-                pools = norm_tsuids if norm_tsuids else ([""]
-                                                         if global_notes
-                                                         else None)
-                victims = []
-                if pools is None:
-                    for s in tsdb.store.all_series():
-                        victims.extend(tsdb.store.get_annotations(
-                            tsdb.tsuid(s.key), int(start), end_ms))
-                    victims.extend(tsdb.store.get_annotations(
-                        "", int(start), end_ms))
+                # De-index exactly what delete_annotation_range will drop —
+                # its precedence is global > tsuids > everything.
+                if global_notes:
+                    pools = [""]
+                elif norm_tsuids:
+                    pools = norm_tsuids
                 else:
-                    for t in pools:
-                        victims.extend(tsdb.store.get_annotations(
-                            t, int(start), end_ms))
-                for note in victims:
-                    tsdb.search_plugin.delete_annotation(note)
+                    pools = tsdb.store.annotation_keys()
+                for t in pools:
+                    for note in tsdb.store.get_annotations(
+                            t, int(start), end_ms):
+                        tsdb.search_plugin.delete_annotation(note)
             count = tsdb.store.delete_annotation_range(
                 norm_tsuids, int(start), end_ms, global_notes)
             query.send_reply({"totalDeleted": count})

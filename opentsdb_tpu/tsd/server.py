@@ -152,6 +152,7 @@ class TSDServer:
                             writer: asyncio.StreamWriter,
                             remote: str) -> None:
         conn = TelnetConn(writer)
+        conn.auth_state = None
         buffer = first
         loop = asyncio.get_running_loop()
         while True:
@@ -178,6 +179,26 @@ class TSDServer:
                     return
                 continue
             self.telnet_rpcs += 1
+            auth = self.tsdb.authentication
+            if auth is not None and not auth.is_ready(self.tsdb, conn):
+                # First-message auth (AuthenticationChannelHandler :87-124):
+                # the opening command must authenticate the channel.
+                from opentsdb_tpu.auth import AuthStatus
+                try:
+                    state = auth.authenticate_telnet(conn, text.split())
+                except Exception:
+                    LOG.exception("Authentication plugin failed on telnet "
+                                  "command from %s; failing closed", remote)
+                    state = None
+                if state is not None and state.status == AuthStatus.SUCCESS:
+                    conn.auth_state = state
+                    writer.write(b"AUTH_SUCCESS\r\n")
+                else:
+                    writer.write(b"AUTH_FAIL\r\n")
+                await writer.drain()
+                if conn.auth_state is None:
+                    return
+                continue
             reply = await loop.run_in_executor(
                 self._executor, self.rpc_manager.handle_telnet, conn, text)
             if reply:

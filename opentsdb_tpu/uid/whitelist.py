@@ -1,0 +1,47 @@
+"""Regex whitelist/blacklist UID filter.
+
+Reference behavior: /root/reference/src/uid/UniqueIdWhitelistFilter.java —
+comma-separated regex lists per UID type from tsd.uidfilter.whitelist /
+tsd.uidfilter.blacklist-style keys (metric_patterns etc.); a UID may be
+assigned only when it matches a whitelist pattern (if any are configured)
+and no blacklist pattern.
+"""
+
+from __future__ import annotations
+
+import re
+
+from opentsdb_tpu.plugins.spi import UniqueIdFilterPlugin
+
+_KEYS = {
+    "metric": ("tsd.uidfilter.metric_whitelist",
+               "tsd.uidfilter.metric_blacklist"),
+    "tagk": ("tsd.uidfilter.tagk_whitelist", "tsd.uidfilter.tagk_blacklist"),
+    "tagv": ("tsd.uidfilter.tagv_whitelist", "tsd.uidfilter.tagv_blacklist"),
+}
+
+
+class UniqueIdWhitelistFilter(UniqueIdFilterPlugin):
+    def __init__(self):
+        self.whitelists: dict[str, list[re.Pattern]] = {}
+        self.blacklists: dict[str, list[re.Pattern]] = {}
+
+    def initialize(self, tsdb) -> None:
+        for kind, (wkey, bkey) in _KEYS.items():
+            self.whitelists[kind] = self._compile(tsdb.config, wkey)
+            self.blacklists[kind] = self._compile(tsdb.config, bkey)
+
+    @staticmethod
+    def _compile(config, key: str) -> list[re.Pattern]:
+        raw = config.get_string(key) if config.has_property(key) else ""
+        return [re.compile(p.strip()) for p in raw.split(",") if p.strip()]
+
+    def allow_uid_assignment(self, name: str, kind) -> bool:
+        kind_name = getattr(kind, "value", str(kind)).lower()
+        for pattern in self.blacklists.get(kind_name, ()):
+            if pattern.search(name):
+                return False
+        whitelist = self.whitelists.get(kind_name, ())
+        if whitelist:
+            return any(p.search(name) for p in whitelist)
+        return True

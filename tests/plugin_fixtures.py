@@ -1,0 +1,40 @@
+"""Dummy plugin implementations exercised by the loader/SPI tests (the
+test/plugin/Dummy* pattern from the reference suite)."""
+
+from opentsdb_tpu.auth import AuthState, AuthStatus, Authentication
+from opentsdb_tpu.plugins import (
+    RTPublisher, StorageExceptionHandler, WriteableDataPointFilterPlugin)
+
+
+class RecordingPublisher(RTPublisher):
+    def __init__(self):
+        self.points = []
+
+    def publish_data_point(self, metric, timestamp, value, tags, tsuid):
+        self.points.append((metric, timestamp, value))
+
+
+class RecordingSEH(StorageExceptionHandler):
+    def __init__(self):
+        self.errors = []
+
+    def handle_error(self, dp, exception):
+        self.errors.append((dp, str(exception)))
+
+
+class EvenOnlyFilter(WriteableDataPointFilterPlugin):
+    def allow(self, metric, timestamp, value, tags):
+        return int(value) % 2 == 0
+
+
+class DenyAuth(Authentication):
+    def authenticate_telnet(self, conn, command):
+        if len(command) >= 3 and command[0] == "auth" and \
+                command[2] == "secret":
+            return AuthState(user=command[1], status=AuthStatus.SUCCESS)
+        return AuthState(status=AuthStatus.UNAUTHORIZED)
+
+    def authenticate_http(self, conn, request):
+        if request.header("x-token") == "secret":
+            return AuthState(user="u", status=AuthStatus.SUCCESS)
+        return AuthState(status=AuthStatus.UNAUTHORIZED)

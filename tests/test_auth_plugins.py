@@ -1,0 +1,140 @@
+"""Auth + plugin infrastructure tests.
+
+Models /root/reference/test/auth/TestAllowAllAuthenticatingAuthorizer,
+test/plugin/ dummy-plugin SPI exercises, and TestUniqueIdWhitelistFilter."""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.auth import (
+    AllowAllAuthenticatingAuthorizer, AuthState, AuthStatus, Authentication,
+    Permissions, Roles)
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.plugins import (
+    RTPublisher, StorageExceptionHandler, WriteableDataPointFilterPlugin,
+    load_plugin)
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.uid.whitelist import UniqueIdWhitelistFilter
+from opentsdb_tpu.uid import FailedToAssignUniqueIdException
+from opentsdb_tpu.utils.config import Config
+from tests.plugin_fixtures import (
+    RecordingPublisher, RecordingSEH, EvenOnlyFilter, DenyAuth)
+
+BASE = 1_356_998_400
+
+
+class TestRolesPermissions:
+    def test_roles(self):
+        r = Roles({Permissions.HTTP_PUT})
+        assert r.has_permission(Permissions.HTTP_PUT)
+        assert not r.has_permission(Permissions.HTTP_QUERY)
+        r.grant(Permissions.HTTP_QUERY)
+        assert r.has_permission(Permissions.HTTP_QUERY)
+        r.revoke(Permissions.HTTP_QUERY)
+        assert not r.has_permission(Permissions.HTTP_QUERY)
+
+    def test_allow_all(self):
+        auth = AllowAllAuthenticatingAuthorizer()
+        state = auth.authenticate_telnet(None, ["anything"])
+        assert state.status == AuthStatus.SUCCESS
+        assert state.roles.has_permission(Permissions.TELNET_PUT)
+        assert auth.authorization() is auth
+
+
+class TestPluginLoader:
+    def test_load_by_colon_path(self):
+        p = load_plugin("tests.plugin_fixtures:RecordingPublisher",
+                        RTPublisher)
+        assert isinstance(p, RecordingPublisher)
+
+    def test_load_by_dotted_path(self):
+        p = load_plugin("tests.plugin_fixtures.RecordingSEH")
+        assert isinstance(p, RecordingSEH)
+
+    def test_wrong_type_rejected(self):
+        with pytest.raises(ValueError, match="not an instance"):
+            load_plugin("tests.plugin_fixtures:RecordingSEH", RTPublisher)
+
+    def test_missing_module(self):
+        with pytest.raises(ValueError, match="Unable to locate plugin"):
+            load_plugin("no.such.module:Thing")
+
+
+class TestPluginWiring:
+    def test_rt_publisher(self):
+        t = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.rtpublisher.enable": True,
+            "tsd.rtpublisher.plugin":
+                "tests.plugin_fixtures:RecordingPublisher"}))
+        t.add_point("m", BASE, 5, {"h": "a"})
+        assert t.rt_publisher.points == [("m", BASE * 1000, 5)]
+
+    def test_rt_publisher_enabled_without_plugin_fails(self):
+        with pytest.raises(ValueError):
+            TSDB(Config({"tsd.rtpublisher.enable": True}))
+
+    def test_write_filter(self):
+        t = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.timeseriesfilter.enable": True,
+            "tsd.timeseriesfilter.plugin":
+                "tests.plugin_fixtures:EvenOnlyFilter"}))
+        t.add_point("m", BASE, 2, {"h": "a"})
+        t.add_point("m", BASE + 1, 3, {"h": "a"})  # filtered out
+        assert t.store.total_datapoints == 1
+
+    def test_seh_on_write_error(self):
+        t = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.core.storage_exception_handler.enable": True,
+            "tsd.core.storage_exception_handler.plugin":
+                "tests.plugin_fixtures:RecordingSEH"}))
+        m = RpcManager(t)
+
+        # Force a storage-layer error via a broken store method.
+        orig = t.store.add_point
+        def boom(*a, **k):
+            raise RuntimeError("storage down")
+        t.store.add_point = boom
+        q = m.handle_http(HttpRequest(
+            method="POST", uri="/api/put?details",
+            body=json.dumps({"metric": "m", "timestamp": BASE,
+                             "value": 1, "tags": {"h": "a"}}).encode()))
+        t.store.add_point = orig
+        assert len(t.storage_exception_handler.errors) == 1
+        assert "storage down" in t.storage_exception_handler.errors[0][1]
+
+    def test_uid_whitelist_filter(self):
+        t = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.uidfilter.enable": True,
+            "tsd.uidfilter.plugin":
+                "opentsdb_tpu.uid.whitelist:UniqueIdWhitelistFilter",
+            "tsd.uidfilter.metric_whitelist": "^sys\\..*",
+        }))
+        t.add_point("sys.ok", BASE, 1, {"h": "a"})
+        with pytest.raises(FailedToAssignUniqueIdException):
+            t.add_point("other.metric", BASE, 1, {"h": "a"})
+
+
+class TestHttpAuth:
+    @pytest.fixture
+    def manager(self):
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        t.authentication = DenyAuth()
+        return RpcManager(t)
+
+    def test_unauthenticated_401(self, manager):
+        q = manager.handle_http(HttpRequest(
+            method="GET", uri="/api/version"))
+        assert q.response.status == 401
+
+    def test_authenticated_passes(self, manager):
+        q = manager.handle_http(HttpRequest(
+            method="GET", uri="/api/version",
+            headers={"x-token": "secret"}))
+        assert q.response.status == 200
+        assert q.auth_state.user == "u"
