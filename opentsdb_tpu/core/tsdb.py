@@ -102,9 +102,21 @@ class TSDB:
         initialize_plugins(self)
         self.start_time = time.time()
         self._stats_lock = threading.Lock()
+        # Serializes ingest against snapshots: writers hold it briefly per
+        # record; snapshot() holds it for its stop-the-world walk so no
+        # journaled write can fall between the state capture and WAL reset.
+        self._ingest_lock = threading.RLock()
         self.datapoints_added = 0
         self.illegal_arguments = 0
         self.unknown_metrics = 0
+        # Restore LAST: WAL replay drives the full _apply_* paths, which
+        # touch stats/meta/tree state initialized above.
+        self.persistence = None
+        storage_dir = self.config.get_string("tsd.storage.directory")
+        if storage_dir:
+            from opentsdb_tpu.storage.persist import DiskPersistence
+            self.persistence = DiskPersistence(self, storage_dir)
+            self.persistence.restore()
 
     # ------------------------------------------------------------------ #
     # Write path (TSDB.addPoint :1051)                                   #
@@ -126,6 +138,15 @@ class TSDB:
     def add_point(self, metric: str, timestamp: int | float, value,
                   tags: dict[str, str]) -> None:
         """Store one datapoint; value may be int, float, or numeric string."""
+        with self._ingest_lock:
+            self._apply_point(metric, timestamp, value, tags)
+            if self.persistence is not None:
+                self.persistence.journal({"k": "p", "m": metric,
+                                          "t": timestamp, "v": value,
+                                          "g": dict(tags)})
+
+    def _apply_point(self, metric: str, timestamp: int | float, value,
+                     tags: dict[str, str]) -> None:
         if self.mode == "ro":
             raise RuntimeError("TSD is in read-only mode, writes rejected")
         is_int, num = parse_value(value)
@@ -190,10 +211,29 @@ class TSDB:
         import base64
         codec = self.histogram_manager.get_codec(codec_id)
         hist = codec.decode(base64.b64decode(payload), includes_id=False)
-        self._store_histogram(metric, timestamp, hist, tags)
+        with self._ingest_lock:
+            self._store_histogram(metric, timestamp, hist, tags)
+            if self.persistence is not None:
+                self.persistence.journal({"k": "h", "m": metric,
+                                          "t": timestamp,
+                                          "d": hist.to_json(),
+                                          "g": dict(tags)})
 
     def add_histogram_point_json(self, metric: str, timestamp: int | float,
                                  dp: dict, tags: dict[str, str]) -> None:
+        with self._ingest_lock:
+            self._apply_histogram_json(metric, timestamp, dp, tags)
+            if self.persistence is not None:
+                journal_dp = {k: v for k, v in dp.items()
+                              if k in ("id", "value", "buckets",
+                                       "underflow", "overflow")}
+                self.persistence.journal({"k": "h", "m": metric,
+                                          "t": timestamp,
+                                          "d": journal_dp,
+                                          "g": dict(tags)})
+
+    def _apply_histogram_json(self, metric: str, timestamp: int | float,
+                              dp: dict, tags: dict[str, str]) -> None:
         """JSON histogram ingest (POST /api/histogram, HistogramPojo):
         either base64 `value` or explicit `buckets` {"lo,hi": count}."""
         if self.histogram_manager is None:
@@ -206,7 +246,9 @@ class TSDB:
             hist = SimpleHistogram.from_base64(str(dp["value"]),
                                                include_id=False)
             hist.id = codec_id
-        elif dp.get("buckets"):
+        elif "buckets" in dp:
+            # Empty bucket maps are valid: the mass may sit entirely in
+            # underflow/overflow.
             hist = SimpleHistogram.from_pojo(dp, codec_id)
         else:
             raise ValueError("Missing histogram value or buckets")
@@ -245,6 +287,22 @@ class TSDB:
                             tags: dict[str, str], is_groupby: bool,
                             interval: str | None, rollup_aggregator: str | None,
                             groupby_aggregator: str | None = None) -> None:
+        with self._ingest_lock:
+            self._apply_aggregate_point(metric, timestamp, value, tags,
+                                        is_groupby, interval,
+                                        rollup_aggregator,
+                                        groupby_aggregator)
+            if self.persistence is not None:
+                self.persistence.journal({
+                    "k": "r", "m": metric, "t": timestamp, "v": value,
+                    "g": dict(tags), "gb": is_groupby, "i": interval,
+                    "a": rollup_aggregator, "ga": groupby_aggregator})
+
+    def _apply_aggregate_point(self, metric: str, timestamp: int | float,
+                               value, tags: dict[str, str], is_groupby: bool,
+                               interval: str | None,
+                               rollup_aggregator: str | None,
+                               groupby_aggregator: str | None = None) -> None:
         """Store one rolled-up and/or pre-aggregated datapoint.
 
         Reference behavior (TSDB.addAggregatePointInternal): with `interval`
@@ -391,9 +449,16 @@ class TSDB:
         return hook
 
     def add_annotation(self, note: Annotation) -> None:
-        self.store.add_annotation(note)
-        if self.search_plugin is not None:
-            self.search_plugin.index_annotation(note)
+        with self._ingest_lock:
+            self.store.add_annotation(note)
+            if self.search_plugin is not None:
+                self.search_plugin.index_annotation(note)
+            if self.persistence is not None:
+                self.persistence.journal({"k": "a", "n": {
+                    "start_time": note.start_time,
+                    "end_time": note.end_time,
+                    "tsuid": note.tsuid, "description": note.description,
+                    "notes": note.notes, "custom": note.custom}})
 
     # ------------------------------------------------------------------ #
     # Stats (TSDB.collectStats :785)                                     #
@@ -430,8 +495,23 @@ class TSDB:
     def flush(self) -> None:
         self.store.compaction_queue.flush()
 
+    def snapshot(self) -> None:
+        """Persist full state to tsd.storage.directory.
+
+        Holds the ingest lock for the walk (stop-the-world checkpoint) so a
+        concurrent write can never land after the state capture but before
+        the WAL truncation."""
+        if self.persistence is None:
+            raise RuntimeError("tsd.storage.directory is not configured")
+        with self._ingest_lock:
+            self.persistence.snapshot()
+
     def shutdown(self) -> None:
         self.flush()
+        if self.persistence is not None:
+            with self._ingest_lock:
+                self.persistence.snapshot()
+            self.persistence.close()
 
 
 def parse_value(value) -> tuple[bool, int | float]:

@@ -25,7 +25,6 @@ def load_plugin(path: str, expected_type: type | None = None):
         module_name, _, class_name = path.rpartition(".")
         if not module_name:
             raise ValueError("Invalid plugin path: %s" % path)
-    plugin_dir = None
     try:
         module = importlib.import_module(module_name)
     except ImportError as e:

@@ -23,8 +23,11 @@ SaltScanner collects them per row (SaltScanner.java:425-448).
 
 from __future__ import annotations
 
+import logging
 import threading
 import zlib
+
+_LOG = logging.getLogger("storage")
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Sequence
 
@@ -209,6 +212,25 @@ class Series:
             return (self._ts[lo:hi].copy(), self._val[lo:hi].copy(),
                     self._ival[lo:hi].copy(), self._isint[lo:hi].copy())
 
+    def restore_arrays(self, ts: np.ndarray, val: np.ndarray,
+                       ival: np.ndarray, isint: np.ndarray) -> None:
+        """Load snapshot columns verbatim (persistence restore path).
+
+        Replaces the series contents; the float and int columns are taken
+        exactly as stored so no int<->float round trip occurs.
+        """
+        n = len(ts)
+        with self._lock:
+            if n > len(self._ts):
+                self._grow(n)
+            self._ts[:n] = ts
+            self._val[:n] = val
+            self._ival[:n] = ival
+            self._isint[:n] = isint
+            self._n = n
+            self._sorted = bool(n <= 1
+                                or bool(np.all(np.diff(ts) > 0)))
+
     def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Copies of the full (ts, float_vals, int_vals, is_int) columns."""
         with self._lock:
@@ -278,6 +300,7 @@ class CompactionQueue:
         self._lock = threading.Lock()
         self.fix_duplicates = fix_duplicates
         self.compactions = 0
+        self.errors = 0
 
     def add(self, series: Series) -> None:
         with self._lock:
@@ -289,8 +312,15 @@ class CompactionQueue:
             for key, _ in items:
                 self._dirty.pop(key, None)
         for _, series in items:
-            series.normalize(self.fix_duplicates)
-            self.compactions += 1
+            try:
+                series.normalize(self.fix_duplicates)
+                self.compactions += 1
+            except ValueError as e:
+                # Duplicate data with fix_duplicates off (CompactionQueue
+                # error callback): log and move on; reads will surface the
+                # error and fsck repairs it.
+                self.errors += 1
+                _LOG.error("Compaction failed: %s", e)
         return len(items)
 
     def __len__(self) -> int:

@@ -1,0 +1,302 @@
+"""Disk persistence: snapshot + write-ahead journal.
+
+The durability role HBase's WAL played for the reference (SURVEY.md §5:
+"durability is HBase's WAL... the TSD keeps no durable state").  With
+`tsd.storage.directory` set, the TSD journals every ingest record to an
+append-only JSONL WAL and can snapshot the full state (UID dictionaries,
+scalar series columns, rollup lanes, histogram series, annotations,
+uid/ts meta, tree definitions) into the directory; startup restores the
+snapshot then replays the WAL tail.
+
+Layout under the directory:
+    snapshot.json       everything JSON-able + the series manifest
+    series.npz          columnar arrays, keys s<i>_{ts,val,ival,isint}
+    rollup.npz          same shape per rollup lane series
+    wal.jsonl           journal since the last snapshot
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+SNAPSHOT_JSON = "snapshot.json"
+SERIES_NPZ = "series.npz"
+ROLLUP_NPZ = "rollup.npz"
+WAL_FILE = "wal.jsonl"
+
+
+class DiskPersistence:
+    def __init__(self, tsdb, directory: str):
+        self.tsdb = tsdb
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._wal_lock = threading.Lock()
+        self._wal = None
+        self.wal_records = 0
+
+    # ------------------------------------------------------------------ #
+    # WAL                                                                #
+    # ------------------------------------------------------------------ #
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.directory, WAL_FILE)
+
+    def journal(self, record: dict) -> None:
+        """Append one ingest record; flushed per write (the WAL contract)."""
+        line = json.dumps(record, separators=(",", ":"))
+        with self._wal_lock:
+            if self._wal is None:
+                self._wal = open(self._wal_path(), "a", buffering=1)
+            self._wal.write(line + "\n")
+            self.wal_records += 1
+
+    def _reset_wal(self) -> None:
+        with self._wal_lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+            path = self._wal_path()
+            if os.path.exists(path):
+                os.remove(path)
+            self.wal_records = 0
+
+    def replay_wal(self) -> int:
+        """Re-ingest journaled records (startup recovery)."""
+        path = self._wal_path()
+        if not os.path.exists(path):
+            return 0
+        tsdb = self.tsdb
+        count = 0
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue   # torn tail write from a crash
+                kind = rec.get("k")
+                try:
+                    if kind == "p":
+                        tsdb._apply_point(rec["m"], rec["t"], rec["v"],
+                                          rec["g"])
+                    elif kind == "r":
+                        tsdb._apply_aggregate_point(
+                            rec["m"], rec["t"], rec["v"], rec["g"],
+                            rec["gb"], rec.get("i"), rec.get("a"),
+                            rec.get("ga"))
+                    elif kind == "h":
+                        tsdb._apply_histogram_json(rec["m"], rec["t"],
+                                                   rec["d"], rec["g"])
+                    elif kind == "a":
+                        from opentsdb_tpu.storage.memstore import Annotation
+                        # Direct store write: add_annotation would re-journal
+                        # into the WAL currently being replayed.
+                        note = Annotation(**rec["n"])
+                        tsdb.store.add_annotation(note)
+                        if tsdb.search_plugin is not None:
+                            tsdb.search_plugin.index_annotation(note)
+                    count += 1
+                except Exception:
+                    continue
+        return count
+
+    # ------------------------------------------------------------------ #
+    # Snapshot                                                           #
+    # ------------------------------------------------------------------ #
+
+    def snapshot(self) -> None:
+        tsdb = self.tsdb
+        manifest: dict = {
+            "version": 1,
+            "uids": {
+                "metric": tsdb.metrics.snapshot(),
+                "tagk": tsdb.tag_names.snapshot(),
+                "tagv": tsdb.tag_values.snapshot(),
+            },
+            "series": [],
+            "rollup": [],
+            "annotations": [],
+            "histograms": [],
+            "uidmeta": [],
+            "tsmeta": [],
+            "trees": [],
+        }
+        arrays: dict[str, np.ndarray] = {}
+        for i, series in enumerate(tsdb.store.all_series()):
+            ts, val, ival, isint = series.arrays()
+            manifest["series"].append({
+                "metric": series.key.metric,
+                "tags": list(series.key.tags),
+            })
+            arrays["s%d_ts" % i] = ts
+            arrays["s%d_val" % i] = val
+            arrays["s%d_ival" % i] = ival
+            arrays["s%d_isint" % i] = isint
+        np.savez_compressed(
+            os.path.join(self.directory, SERIES_NPZ), **arrays)
+
+        rollup_arrays: dict[str, np.ndarray] = {}
+        if tsdb.rollup_store is not None:
+            idx = 0
+            for (interval, agg, pre) in tsdb.rollup_store.lanes():
+                lane = tsdb.rollup_store.peek_lane(interval, agg, pre)
+                for series in lane.all_series():
+                    ts, val, ival, isint = series.arrays()
+                    manifest["rollup"].append({
+                        "interval": interval, "agg": agg, "pre": pre,
+                        "metric": series.key.metric,
+                        "tags": list(series.key.tags),
+                    })
+                    rollup_arrays["s%d_ts" % idx] = ts
+                    rollup_arrays["s%d_val" % idx] = val
+                    rollup_arrays["s%d_ival" % idx] = ival
+                    rollup_arrays["s%d_isint" % idx] = isint
+                    idx += 1
+        np.savez_compressed(
+            os.path.join(self.directory, ROLLUP_NPZ), **rollup_arrays)
+
+        for tsuid in tsdb.store.annotation_keys():
+            for note in tsdb.store.get_annotations(
+                    tsuid, 0, 1 << 62):
+                manifest["annotations"].append({
+                    "start_time": note.start_time,
+                    "end_time": note.end_time,
+                    "tsuid": note.tsuid,
+                    "description": note.description,
+                    "notes": note.notes,
+                    "custom": note.custom,
+                })
+
+        if tsdb.histogram_store is not None:
+            for series in tsdb.histogram_store.all_series():
+                points = series.window(0, 1 << 62)
+                manifest["histograms"].append({
+                    "metric": series.key.metric,
+                    "tags": list(series.key.tags),
+                    "points": [(t, h.to_json()) for t, h in points],
+                })
+
+        for meta in tsdb.meta_store.all_uidmeta():
+            manifest["uidmeta"].append(meta.to_json())
+        for meta in tsdb.meta_store.all_tsmeta():
+            entry = meta.to_json()
+            entry.pop("metric", None)
+            entry.pop("tags", None)
+            manifest["tsmeta"].append(entry)
+        for tree in tsdb.tree_store.all_trees():
+            manifest["trees"].append(tree.to_json(include_rules=True))
+
+        tmp = os.path.join(self.directory, SNAPSHOT_JSON + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh)
+        os.replace(tmp, os.path.join(self.directory, SNAPSHOT_JSON))
+        self._reset_wal()
+
+    # ------------------------------------------------------------------ #
+    # Restore                                                            #
+    # ------------------------------------------------------------------ #
+
+    def restore(self) -> bool:
+        """Load the snapshot (if any) then replay the WAL tail."""
+        path = os.path.join(self.directory, SNAPSHOT_JSON)
+        loaded = False
+        if os.path.exists(path):
+            with open(path) as fh:
+                manifest = json.load(fh)
+            self._restore_manifest(manifest)
+            loaded = True
+        self.replay_wal()
+        return loaded
+
+    def _restore_manifest(self, manifest: dict) -> None:
+        from opentsdb_tpu.histogram import SimpleHistogram
+        from opentsdb_tpu.meta.objects import TSMeta, UIDMeta
+        from opentsdb_tpu.storage.memstore import Annotation, SeriesKey
+        from opentsdb_tpu.tree.objects import Tree, TreeRule
+        tsdb = self.tsdb
+        tsdb.metrics.restore(manifest["uids"]["metric"])
+        tsdb.tag_names.restore(manifest["uids"]["tagk"])
+        tsdb.tag_values.restore(manifest["uids"]["tagv"])
+
+        series_path = os.path.join(self.directory, SERIES_NPZ)
+        if manifest["series"] and os.path.exists(series_path):
+            with np.load(series_path) as arrays:
+                for i, entry in enumerate(manifest["series"]):
+                    key = SeriesKey(entry["metric"],
+                                    tuple(tuple(t) for t in entry["tags"]))
+                    tsdb.store.get_or_create_series(key).restore_arrays(
+                        arrays["s%d_ts" % i], arrays["s%d_val" % i],
+                        arrays["s%d_ival" % i], arrays["s%d_isint" % i])
+
+        rollup_path = os.path.join(self.directory, ROLLUP_NPZ)
+        if manifest["rollup"] and tsdb.rollup_store is not None \
+                and os.path.exists(rollup_path):
+            with np.load(rollup_path) as arrays:
+                for i, entry in enumerate(manifest["rollup"]):
+                    key = SeriesKey(entry["metric"],
+                                    tuple(tuple(t) for t in entry["tags"]))
+                    lane = tsdb.rollup_store.lane(
+                        entry["interval"], entry["agg"], entry["pre"])
+                    lane.get_or_create_series(key).restore_arrays(
+                        arrays["s%d_ts" % i], arrays["s%d_val" % i],
+                        arrays["s%d_ival" % i], arrays["s%d_isint" % i])
+
+        for note in manifest["annotations"]:
+            tsdb.store.add_annotation(Annotation(**note))
+
+        if manifest["histograms"] and tsdb.histogram_store is not None:
+            for entry in manifest["histograms"]:
+                key = SeriesKey(entry["metric"],
+                                tuple(tuple(t) for t in entry["tags"]))
+                for t, hist_json in entry["points"]:
+                    tsdb.histogram_store.add_point(
+                        key, t, SimpleHistogram.from_pojo(hist_json))
+
+        for m in manifest["uidmeta"]:
+            meta = tsdb.meta_store.ensure_uidmeta(
+                m["type"].lower(), m["uid"], m["name"])
+            meta.display_name = m.get("displayName", "")
+            meta.description = m.get("description", "")
+            meta.notes = m.get("notes", "")
+            meta.created = m.get("created", 0)
+            meta.custom = m.get("custom")
+        for m in manifest["tsmeta"]:
+            meta = tsdb.meta_store.ensure_tsmeta(m["tsuid"])
+            meta.display_name = m.get("displayName", "")
+            meta.description = m.get("description", "")
+            meta.notes = m.get("notes", "")
+            meta.created = m.get("created", 0)
+            meta.custom = m.get("custom")
+            meta.units = m.get("units", "")
+            meta.data_type = m.get("dataType", "")
+            meta.retention = m.get("retention", 0)
+            meta.last_received = m.get("lastReceived", 0)
+            meta.total_dps = m.get("totalDatapoints", 0)
+
+        for t in manifest["trees"]:
+            tree = Tree(tree_id=t["treeId"], name=t.get("name", ""),
+                        description=t.get("description", ""),
+                        notes=t.get("notes", ""),
+                        strict_match=bool(t.get("strictMatch")),
+                        enabled=bool(t.get("enabled")),
+                        store_failures=bool(t.get("storeFailures")),
+                        created=t.get("created", 0))
+            with tsdb.tree_store._lock:
+                tsdb.tree_store._trees[tree.tree_id] = tree
+                from opentsdb_tpu.tree.objects import Branch
+                tsdb.tree_store._branches.setdefault(
+                    (tree.tree_id, ()), Branch(tree.tree_id, ()))
+            for r in t.get("rules", []):
+                tree.add_rule(TreeRule.from_json(r))
+
+    def close(self) -> None:
+        with self._wal_lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None

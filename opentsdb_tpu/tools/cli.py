@@ -1,0 +1,410 @@
+"""The `tsdb` CLI: fsck, import, mkmetric, query, tsd, scan, search, uid,
+version.
+
+Reference behavior: /root/reference/tsdb.in (:63-101 command dispatch) and
+the src/tools classes — Fsck.java (table scan + repair), TextImporter.java
+(bulk import of `metric ts value tag=v...` lines, gzip-aware),
+CliQuery.java, DumpSeries.java (scan/export), Search.java (lookup),
+UidManager.java (:63-88 grep/assign/rename/delete/fsck/metasync/metapurge/
+treesync), TSDMain.java.
+
+All commands that touch data operate on a persistent store directory
+(`--config` pointing tsd.storage.directory, the HBase-cluster analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import re
+import sys
+import time
+
+
+def make_tsdb(args):
+    from opentsdb_tpu.core import TSDB
+    from opentsdb_tpu.utils.config import Config
+    config = Config()
+    if getattr(args, "config", None):
+        config.load_file(args.config)
+    if getattr(args, "auto_metric", False):
+        config.override_config("tsd.core.auto_create_metrics", "true")
+    return TSDB(config)
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", help="Path to a configuration file")
+    p.add_argument("--auto-metric", action="store_true",
+                   help="Automatically add metrics")
+
+
+# ------------------------------------------------------------------ #
+# import (TextImporter.java)                                         #
+# ------------------------------------------------------------------ #
+
+def cmd_import(args) -> int:
+    tsdb = make_tsdb(args)
+    points = 0
+    errors = 0
+    start = time.time()
+    for path in args.files:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                words = line.split()
+                if len(words) < 4:
+                    print("Invalid line %d in %s: %s"
+                          % (lineno, path, line), file=sys.stderr)
+                    errors += 1
+                    continue
+                try:
+                    tags = {}
+                    for w in words[3:]:
+                        k, _, v = w.partition("=")
+                        if not k or not v:
+                            raise ValueError("invalid tag: " + w)
+                        tags[k] = v
+                    tsdb.add_point(words[0], float(words[1])
+                                   if "." in words[1] else int(words[1]),
+                                   words[2], tags)
+                    points += 1
+                except Exception as e:
+                    errors += 1
+                    print("Error at %s:%d: %s" % (path, lineno, e),
+                          file=sys.stderr)
+    tsdb.shutdown()
+    elapsed = time.time() - start
+    rate = points / elapsed if elapsed > 0 else 0
+    print("Total: imported %d data points in %.3fs (%.1f points/s), "
+          "%d errors" % (points, elapsed, rate, errors))
+    return 0 if errors == 0 else 1
+
+
+# ------------------------------------------------------------------ #
+# query (CliQuery.java)                                              #
+# ------------------------------------------------------------------ #
+
+def cmd_query(args) -> int:
+    from opentsdb_tpu.models import TSQuery, parse_m_subquery
+    tsdb = make_tsdb(args)
+    q = TSQuery(start=args.start, end=args.end,
+                queries=[parse_m_subquery(m) for m in args.queries])
+    q.validate()
+    for result in tsdb.new_query_runner().run(q):
+        tags = " ".join("%s=%s" % kv for kv in sorted(result.tags.items()))
+        for ts, value in result.dps:
+            print("%s %d %s %s" % (result.metric, ts // 1000, value, tags))
+    return 0
+
+
+# ------------------------------------------------------------------ #
+# scan / dump (DumpSeries.java)                                      #
+# ------------------------------------------------------------------ #
+
+def cmd_scan(args) -> int:
+    tsdb = make_tsdb(args)
+    metric_re = re.compile(args.pattern) if args.pattern else None
+    for series in sorted(tsdb.store.all_series(),
+                         key=lambda s: tsdb.tsuid(s.key)):
+        metric = tsdb.metrics.get_name(series.key.metric)
+        if metric_re is not None and not metric_re.search(metric):
+            continue
+        tags = tsdb.resolve_key_tags(series.key)
+        tag_str = " ".join("%s=%s" % kv for kv in sorted(tags.items()))
+        ts, fv, iv, isint = series.arrays()
+        if args.delete:
+            series.delete_range(int(ts[0]) if len(ts) else 0,
+                                int(ts[-1]) if len(ts) else 0)
+        for i in range(len(ts)):
+            value = int(iv[i]) if isint[i] else float(fv[i])
+            if args.importfmt:
+                print("%s %d %s %s" % (metric, ts[i] // 1000, value,
+                                       tag_str))
+            else:
+                print("%s %d %s {%s}" % (tsdb.tsuid(series.key), ts[i],
+                                         value, tag_str))
+    if args.delete:
+        tsdb.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------ #
+# search (Search.java -> TimeSeriesLookup)                           #
+# ------------------------------------------------------------------ #
+
+def cmd_search(args) -> int:
+    from opentsdb_tpu.search.lookup import LookupQuery, TimeSeriesLookup
+    tsdb = make_tsdb(args)
+    lq = LookupQuery.parse(args.query)
+    lq.limit = 0    # CLI dumps everything
+    result = TimeSeriesLookup(tsdb, lq).lookup()
+    for hit in result["results"]:
+        tags = " ".join("%s=%s" % kv for kv in sorted(hit["tags"].items()))
+        print("%s %s %s" % (hit["tsuid"], hit["metric"], tags))
+    print("%d results" % result["totalResults"])
+    return 0
+
+
+# ------------------------------------------------------------------ #
+# uid (UidManager.java)                                              #
+# ------------------------------------------------------------------ #
+
+def cmd_uid(args) -> int:
+    tsdb = make_tsdb(args)
+    sub = args.subcommand
+    rest = args.args
+    kinds = ("metrics", "tagk", "tagv")
+
+    def table_for(kind: str):
+        return tsdb.uid_table("metric" if kind == "metrics" else kind)
+
+    if sub == "grep":
+        if rest and rest[0] in kinds:
+            search_kinds, pattern = [rest[0]], rest[1] if len(rest) > 1 \
+                else ""
+        else:
+            search_kinds, pattern = list(kinds), rest[0] if rest else ""
+        regex = re.compile(pattern)
+        found = 0
+        for kind in search_kinds:
+            table = table_for(kind)
+            for name in sorted(table.names()):
+                if regex.search(name):
+                    print("%s %s: %s" % (
+                        kind, name,
+                        table.uid_to_hex(table.get_id(name))))
+                    found += 1
+        return 0 if found else 1
+    if sub == "assign":
+        if len(rest) < 2 or rest[0] not in kinds:
+            print("usage: uid assign <metrics|tagk|tagv> <name> [names]",
+                  file=sys.stderr)
+            return 2
+        table = table_for(rest[0])
+        for name in rest[1:]:
+            uid = table.get_or_create_id(name)
+            print("%s %s: %s" % (rest[0], name, table.uid_to_hex(uid)))
+        tsdb.shutdown()
+        return 0
+    if sub == "rename":
+        if len(rest) != 3 or rest[0] not in kinds:
+            print("usage: uid rename <metrics|tagk|tagv> <name> <newname>",
+                  file=sys.stderr)
+            return 2
+        table_for(rest[0]).rename(rest[1], rest[2])
+        tsdb.shutdown()
+        return 0
+    if sub == "delete":
+        if len(rest) != 2 or rest[0] not in kinds:
+            print("usage: uid delete <metrics|tagk|tagv> <name>",
+                  file=sys.stderr)
+            return 2
+        table_for(rest[0]).delete(rest[1])
+        tsdb.shutdown()
+        return 0
+    if sub == "fsck":
+        return _uid_fsck(tsdb)
+    if sub == "metasync":
+        count = 0
+        from opentsdb_tpu.meta.rpc import resolve_tsmeta
+        for series in tsdb.store.all_series():
+            tsuid = tsdb.tsuid(series.key)
+            created = tsdb.meta_store.record_datapoint(tsuid, 0,
+                                                       count=False)
+            if tsdb.search_plugin is not None:
+                tsdb.search_plugin.index_tsmeta(
+                    resolve_tsmeta(tsdb, tsuid))
+            count += 1
+        print("Synced %d TSMeta entries" % count)
+        tsdb.shutdown()
+        return 0
+    if sub == "metapurge":
+        for meta in tsdb.meta_store.all_tsmeta():
+            tsdb.meta_store.delete_tsmeta(meta.tsuid)
+        for meta in tsdb.meta_store.all_uidmeta():
+            tsdb.meta_store.delete_uidmeta(meta.type, meta.uid)
+        print("Purged all meta entries")
+        tsdb.shutdown()
+        return 0
+    if sub == "treesync":
+        total = 0
+        for tree in tsdb.tree_store.all_trees():
+            if tree.enabled:
+                total += tsdb.tree_store.rebuild(tsdb, tree)
+        print("Synced %d tree leaves" % total)
+        tsdb.shutdown()
+        return 0
+    print("Unknown uid subcommand: %s" % sub, file=sys.stderr)
+    return 2
+
+
+def _uid_fsck(tsdb) -> int:
+    """UID dictionary consistency check (UidManager fsck)."""
+    errors = 0
+    for kind, table in (("metrics", tsdb.metrics), ("tagk", tsdb.tag_names),
+                        ("tagv", tsdb.tag_values)):
+        forward = table.snapshot()
+        reverse: dict[int, str] = {}
+        for name, uid in forward.items():
+            if uid in reverse:
+                print("%s: UID collision: %r and %r share %s"
+                      % (kind, reverse[uid], name, table.uid_to_hex(uid)))
+                errors += 1
+            reverse[uid] = name
+        for name, uid in forward.items():
+            if table.get_name(uid) != name:
+                print("%s: forward/reverse mismatch for %r" % (kind, name))
+                errors += 1
+    print("%d errors found" % errors)
+    return 0 if errors == 0 else 1
+
+
+# ------------------------------------------------------------------ #
+# fsck (Fsck.java)                                                   #
+# ------------------------------------------------------------------ #
+
+def cmd_fsck(args) -> int:
+    tsdb = make_tsdb(args)
+    import numpy as np
+    series_checked = 0
+    points = 0
+    dupes = 0
+    ooo = 0
+    unknown_uids = 0
+    for series in tsdb.store.all_series():
+        series_checked += 1
+        try:
+            tsdb.metrics.get_name(series.key.metric)
+            for k, v in series.key.tags:
+                tsdb.tag_names.get_name(k)
+                tsdb.tag_values.get_name(v)
+        except Exception:
+            unknown_uids += 1
+            print("Series %s references unknown UIDs"
+                  % tsdb.tsuid(series.key))
+        ts, _, _, _ = series.arrays()
+        points += len(ts)
+        if len(ts) > 1:
+            diffs = np.diff(ts)
+            ooo += int((diffs < 0).sum())
+            dupes += int((diffs == 0).sum())
+    if args.fix and (dupes or ooo):
+        for series in tsdb.store.all_series():
+            series.normalize(fix_duplicates=True)
+        print("Resolved %d duplicates and %d out-of-order runs"
+              % (dupes, ooo))
+        tsdb.shutdown()
+    print("Scanned %d series, %d datapoints: %d duplicates, %d "
+          "out-of-order, %d unknown-UID series"
+          % (series_checked, points, dupes, ooo, unknown_uids))
+    return 0 if (dupes == 0 and ooo == 0 and unknown_uids == 0
+                 or args.fix) else 1
+
+
+# ------------------------------------------------------------------ #
+# version / mkmetric / tsd                                           #
+# ------------------------------------------------------------------ #
+
+def cmd_version(args) -> int:
+    from opentsdb_tpu import build_data
+    print(build_data.revision_string())
+    print(build_data.build_string())
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="tsdb",
+        description="Valid commands: fsck, import, mkmetric, query, tsd, "
+                    "scan, search, uid, version")
+    subs = parser.add_subparsers(dest="command", required=True)
+
+    p = subs.add_parser("fsck", help="Check/repair the data store")
+    _add_common(p)
+    p.add_argument("--fix", action="store_true",
+                   help="Repair errors (dedup + reorder)")
+    p.set_defaults(fn=cmd_fsck)
+
+    p = subs.add_parser("import", help="Bulk import datapoint files")
+    _add_common(p)
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_import)
+
+    p = subs.add_parser("mkmetric", help="Create metric UIDs")
+    _add_common(p)
+    p.add_argument("names", nargs="+")
+    p.set_defaults(fn=lambda a: cmd_uid(argparse.Namespace(
+        config=a.config, auto_metric=a.auto_metric, subcommand="assign",
+        args=["metrics"] + a.names)))
+
+    p = subs.add_parser("query", help="Run a query")
+    _add_common(p)
+    p.add_argument("start")
+    p.add_argument("--end", default=None)
+    p.add_argument("queries", nargs="+",
+                   help="m-subquery strings like sum:1h-avg:sys.cpu{...}")
+    p.set_defaults(fn=cmd_query)
+
+    p = subs.add_parser("tsd", help="Start the time series daemon")
+    _add_common(p)
+    p.add_argument("--port", type=int)
+    p.add_argument("--bind")
+    p.add_argument("--staticroot")
+    p.add_argument("--cachedir")
+    p.add_argument("--mode", choices=["rw", "ro", "wo"])
+    p.add_argument("--worker-threads", type=int, default=8)
+    p.add_argument("--verbose", action="store_true")
+    def run_tsd(a):
+        from opentsdb_tpu.tools import tsd_main
+        flags = []
+        for name in ("port", "bind", "config", "mode", "staticroot",
+                     "cachedir"):
+            value = getattr(a, name, None)
+            if value is not None:
+                flags += ["--" + name, str(value)]
+        flags += ["--worker-threads", str(a.worker_threads)]
+        if a.auto_metric:
+            flags.append("--auto-metric")
+        if a.verbose:
+            flags.append("--verbose")
+        return tsd_main.main(flags)
+    p.set_defaults(fn=run_tsd)
+
+    p = subs.add_parser("scan", help="Dump raw series data")
+    _add_common(p)
+    p.add_argument("--importfmt", action="store_true",
+                   help="Output in import-compatible format")
+    p.add_argument("--delete", action="store_true",
+                   help="Delete the scanned rows")
+    p.add_argument("pattern", nargs="?", default="",
+                   help="Metric regex filter")
+    p.set_defaults(fn=cmd_scan)
+
+    p = subs.add_parser("search", help="Look up time series")
+    _add_common(p)
+    p.add_argument("query", help='lookup spec "metric{tagk=tagv}"')
+    p.set_defaults(fn=cmd_search)
+
+    p = subs.add_parser("uid", help="UID administration")
+    _add_common(p)
+    p.add_argument("subcommand",
+                   choices=["grep", "assign", "rename", "delete", "fsck",
+                            "metasync", "metapurge", "treesync"])
+    p.add_argument("args", nargs="*")
+    p.set_defaults(fn=cmd_uid)
+
+    p = subs.add_parser("version", help="Print the version")
+    _add_common(p)
+    p.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

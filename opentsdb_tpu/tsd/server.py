@@ -194,10 +194,10 @@ class TSDServer:
                     conn.auth_state = state
                     writer.write(b"AUTH_SUCCESS\r\n")
                 else:
+                    # Channel stays open so the caller can retry
+                    # (AuthenticationChannelHandler doc).
                     writer.write(b"AUTH_FAIL\r\n")
                 await writer.drain()
-                if conn.auth_state is None:
-                    return
                 continue
             reply = await loop.run_in_executor(
                 self._executor, self.rpc_manager.handle_telnet, conn, text)

@@ -1,0 +1,148 @@
+"""CLI tests: the tsdb command surface over a persistent store directory.
+
+Models /root/reference/test/tools/ (TestFsck, TestTextImporter,
+TestUidManager, TestDumpSeries) coverage."""
+
+import gzip
+import os
+
+import pytest
+
+from opentsdb_tpu.tools.cli import main
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def conf(tmp_path):
+    path = tmp_path / "tsdb.conf"
+    path.write_text(
+        "tsd.core.auto_create_metrics = true\n"
+        "tsd.storage.directory = %s\n" % (tmp_path / "data"))
+    return str(path)
+
+
+def run(capsys, *argv):
+    code = main(list(argv))
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+class TestImportQueryScan:
+    def test_import_then_query(self, conf, tmp_path, capsys):
+        data = tmp_path / "points.txt"
+        data.write_text("".join(
+            "imp.cpu %d %d host=web01\n" % (BASE + i * 10, i)
+            for i in range(5)))
+        code, out, err = run(capsys, "import", "--config", conf, str(data))
+        assert code == 0
+        assert "imported 5 data points" in out
+
+        code, out, err = run(capsys, "query", "--config", conf,
+                             str(BASE), "--end", str(BASE + 100),
+                             "sum:imp.cpu")
+        assert code == 0
+        lines = out.strip().splitlines()
+        assert len(lines) == 5
+        assert lines[2] == "imp.cpu %d 2 host=web01" % (BASE + 20)
+
+    def test_import_gzip(self, conf, tmp_path, capsys):
+        data = tmp_path / "points.gz"
+        with gzip.open(data, "wt") as fh:
+            fh.write("gz.metric %d 7 h=a\n" % BASE)
+        code, out, _ = run(capsys, "import", "--config", conf, str(data))
+        assert code == 0 and "imported 1" in out
+
+    def test_import_bad_lines_counted(self, conf, tmp_path, capsys):
+        data = tmp_path / "bad.txt"
+        data.write_text("only.three.words 123\nok.metric %d 1 h=a\n" % BASE)
+        code, out, err = run(capsys, "import", "--config", conf, str(data))
+        assert code == 1
+        assert "1 errors" in out
+
+    def test_scan_importfmt_round_trips(self, conf, tmp_path, capsys):
+        data = tmp_path / "p.txt"
+        data.write_text("rt.metric %d 42 host=a\n" % BASE)
+        run(capsys, "import", "--config", conf, str(data))
+        code, out, _ = run(capsys, "scan", "--config", conf, "--importfmt",
+                           "rt")
+        assert code == 0
+        assert out.strip() == "rt.metric %d 42 host=a" % BASE
+
+    def test_scan_tsuid_format(self, conf, tmp_path, capsys):
+        data = tmp_path / "p.txt"
+        data.write_text("sc.metric %d 1 host=a\n" % BASE)
+        run(capsys, "import", "--config", conf, str(data))
+        code, out, _ = run(capsys, "scan", "--config", conf)
+        assert "000001000001000001" in out
+
+
+class TestUidCommands:
+    def _seed(self, conf, tmp_path, capsys):
+        data = tmp_path / "p.txt"
+        data.write_text("u.cpu %d 1 host=a\nu.mem %d 2 host=b\n"
+                        % (BASE, BASE))
+        run(capsys, "import", "--config", conf, str(data))
+
+    def test_grep(self, conf, tmp_path, capsys):
+        self._seed(conf, tmp_path, capsys)
+        code, out, _ = run(capsys, "uid", "--config", conf, "grep", "cpu")
+        assert code == 0
+        assert "metrics u.cpu:" in out
+
+    def test_assign_and_mkmetric(self, conf, capsys):
+        code, out, _ = run(capsys, "uid", "--config", conf, "assign",
+                           "metrics", "new.one", "new.two")
+        assert code == 0 and "new.one" in out
+        code, out, _ = run(capsys, "mkmetric", "--config", conf,
+                           "made.metric")
+        assert code == 0 and "made.metric" in out
+        # persisted across invocations
+        code, out, _ = run(capsys, "uid", "--config", conf, "grep", "made")
+        assert "made.metric" in out
+
+    def test_rename_delete(self, conf, tmp_path, capsys):
+        self._seed(conf, tmp_path, capsys)
+        code, _, _ = run(capsys, "uid", "--config", conf, "rename",
+                         "metrics", "u.cpu", "u.renamed")
+        assert code == 0
+        code, out, _ = run(capsys, "uid", "--config", conf, "grep",
+                           "renamed")
+        assert "u.renamed" in out
+
+    def test_uid_fsck(self, conf, tmp_path, capsys):
+        self._seed(conf, tmp_path, capsys)
+        code, out, _ = run(capsys, "uid", "--config", conf, "fsck")
+        assert code == 0 and "0 errors" in out
+
+
+class TestFsckSearchVersion:
+    def test_fsck_clean(self, conf, tmp_path, capsys):
+        data = tmp_path / "p.txt"
+        data.write_text("f.metric %d 1 h=a\n" % BASE)
+        run(capsys, "import", "--config", conf, str(data))
+        code, out, _ = run(capsys, "fsck", "--config", conf)
+        assert code == 0
+        assert "1 datapoints" in out and "0 duplicates" in out
+
+    def test_fsck_finds_and_fixes_dupes(self, conf, tmp_path, capsys):
+        data = tmp_path / "p.txt"
+        data.write_text("d.metric %d 1 h=a\nd.metric %d 2 h=a\n"
+                        % (BASE, BASE))
+        run(capsys, "import", "--config", conf, str(data))
+        code, out, _ = run(capsys, "fsck", "--config", conf, "--fix")
+        assert code == 0
+        assert "Resolved 1 duplicates" in out
+
+    def test_search(self, conf, tmp_path, capsys):
+        data = tmp_path / "p.txt"
+        data.write_text("s.metric %d 1 host=a dc=lga\n" % BASE)
+        run(capsys, "import", "--config", conf, str(data))
+        code, out, _ = run(capsys, "search", "--config", conf,
+                           "s.metric{dc=lga}")
+        assert code == 0
+        assert "1 results" in out and "dc=lga" in out
+
+    def test_version(self, capsys):
+        code, out, _ = run(capsys, "version")
+        assert code == 0 and "opentsdb_tpu" in out

@@ -1,0 +1,159 @@
+"""Persistence tests: WAL journaling + replay, snapshot + restore across
+TSDB restarts (the checkpoint/resume surface)."""
+
+import json
+import os
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.storage.memstore import Annotation
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+HIST_CONFIG = '{"SimpleHistogramDecoder": 0}'
+
+
+def make_tsdb(tmp_path, **extra):
+    props = {"tsd.core.auto_create_metrics": True,
+             "tsd.storage.directory": str(tmp_path / "data"),
+             "tsd.rollups.enable": True,
+             "tsd.core.histograms.config": HIST_CONFIG}
+    props.update(extra)
+    return TSDB(Config(props))
+
+
+def seed(t):
+    for i in range(10):
+        t.add_point("p.cpu", BASE + i * 10, i, {"host": "a"})
+        t.add_point("p.cpu", BASE + i * 10, i * 1.5, {"host": "b"})
+    t.add_aggregate_point("p.cpu", BASE, 45, {"host": "a"}, False, "1h",
+                          "sum")
+    t.add_histogram_point_json("p.lat", BASE,
+                               {"buckets": {"0,10": 5, "10,20": 5}},
+                               {"host": "a"})
+    t.add_annotation(Annotation(start_time=BASE * 1000,
+                                description="deploy"))
+
+
+def query_sum(t, metric="p.cpu", end=BASE + 600):
+    from opentsdb_tpu.models import TSQuery, parse_m_subquery
+    q = TSQuery(start=str(BASE), end=str(end),
+                queries=[parse_m_subquery("sum:" + metric)])
+    q.validate()
+    return t.new_query_runner().run(q)
+
+
+class TestWalReplay:
+    def test_replay_without_snapshot(self, tmp_path):
+        t1 = make_tsdb(tmp_path)
+        seed(t1)
+        t1.persistence.close()  # crash: no snapshot taken
+
+        t2 = make_tsdb(tmp_path)
+        assert t2.store.total_datapoints == 20
+        assert t2.rollup_store.peek_lane("1h", "sum").total_datapoints == 1
+        assert t2.histogram_store.num_series == 1
+        assert len(t2.store.get_annotations("", 0, 1 << 62)) == 1
+        # values survive exactly, including the float series
+        r = query_sum(t2)
+        vals = dict(r[0].dps)
+        assert vals[(BASE + 40) * 1000] == 4 + 6.0
+
+    def test_replay_drives_full_apply_path(self, tmp_path):
+        # WAL replay must run AFTER all TSDB state exists so meta tracking
+        # and stats fire for replayed records.
+        t1 = make_tsdb(tmp_path,
+                       **{"tsd.core.meta.enable_tsuid_tracking": True})
+        t1.add_point("rp.m", BASE, 1, {"h": "a"})
+        t1.persistence.close()  # crash
+        t2 = make_tsdb(tmp_path,
+                       **{"tsd.core.meta.enable_tsuid_tracking": True})
+        assert t2.datapoints_added == 1
+        tsuid = t2.tsuid(t2.store.all_series()[0].key)
+        assert t2.meta_store.get_tsmeta(tsuid).total_dps == 1
+
+    def test_empty_bucket_histogram_survives(self, tmp_path):
+        t1 = make_tsdb(tmp_path)
+        t1.add_histogram_point_json(
+            "p.over", BASE, {"buckets": {}, "overflow": 7}, {"h": "a"})
+        t1.persistence.close()
+        t2 = make_tsdb(tmp_path)
+        assert t2.histogram_store.num_series == 1
+        pts = t2.histogram_store.all_series()[0].window(0, 1 << 62)
+        assert pts[0][1].overflow == 7
+
+    def test_torn_tail_line_skipped(self, tmp_path):
+        t1 = make_tsdb(tmp_path)
+        t1.add_point("p.cpu", BASE, 1, {"h": "a"})
+        t1.persistence.close()
+        wal = tmp_path / "data" / "wal.jsonl"
+        with open(wal, "a") as fh:
+            fh.write('{"k":"p","m":"p.cpu","t"')  # torn write
+        t2 = make_tsdb(tmp_path)
+        assert t2.store.total_datapoints == 1
+
+
+class TestSnapshotRestore:
+    def test_round_trip(self, tmp_path):
+        t1 = make_tsdb(tmp_path, **{
+            "tsd.search.enable": True,
+            "tsd.core.meta.enable_tsuid_tracking": True})
+        seed(t1)
+        tsuid = t1.tsuid(t1.store.all_series()[0].key)
+        meta = t1.meta_store.get_tsmeta(tsuid)
+        meta.description = "saved description"
+        from opentsdb_tpu.tree.objects import Tree, TreeRule
+        tree = Tree(name="persisted", enabled=True)
+        t1.tree_store.create_tree(tree)
+        tree.add_rule(TreeRule(type="METRIC", level=0))
+        t1.shutdown()   # snapshots + truncates WAL
+        assert not os.path.exists(tmp_path / "data" / "wal.jsonl")
+
+        t2 = make_tsdb(tmp_path, **{
+            "tsd.search.enable": True,
+            "tsd.core.meta.enable_tsuid_tracking": True})
+        # UID dictionaries identical
+        assert t2.metrics.snapshot() == t1.metrics.snapshot()
+        # datapoints identical
+        assert t2.store.total_datapoints == 20
+        r1 = query_sum(t1)
+        r2 = query_sum(t2)
+        assert r1[0].dps == r2[0].dps
+        # rollups, histograms, annotations, meta, trees
+        assert t2.rollup_store.peek_lane("1h", "sum").total_datapoints == 1
+        assert t2.histogram_store.num_series == 1
+        assert len(t2.store.get_annotations("", 0, 1 << 62)) == 1
+        assert t2.meta_store.get_tsmeta(tsuid).description == \
+            "saved description"
+        assert t2.meta_store.get_tsmeta(tsuid).total_dps == 10
+        restored_tree = t2.tree_store.get_tree(1)
+        assert restored_tree.name == "persisted"
+        assert restored_tree.rule_levels()[0][0].type == "METRIC"
+
+    def test_snapshot_plus_wal_tail(self, tmp_path):
+        t1 = make_tsdb(tmp_path)
+        seed(t1)
+        t1.snapshot()
+        t1.add_point("p.cpu", BASE + 500, 99, {"host": "a"})  # post-snapshot
+        t1.persistence.close()
+
+        t2 = make_tsdb(tmp_path)
+        assert t2.store.total_datapoints == 21
+        vals = dict(query_sum(t2)[0].dps)
+        assert vals[(BASE + 500) * 1000] == 99
+
+    def test_no_directory_no_persistence(self):
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        assert t.persistence is None
+        with pytest.raises(RuntimeError):
+            t.snapshot()
+
+    def test_exact_int64_survival(self, tmp_path):
+        big = (1 << 62) + 12345
+        t1 = make_tsdb(tmp_path)
+        t1.add_point("p.big", BASE, big, {"h": "a"})
+        t1.shutdown()
+        t2 = make_tsdb(tmp_path)
+        _, _, ival, isint = t2.store.all_series()[0].arrays()
+        assert ival[0] == big and isint[0]
