@@ -111,6 +111,7 @@ class TSDB:
         self.unknown_metrics = 0
         # Restore LAST: WAL replay drives the full _apply_* paths, which
         # touch stats/meta/tree state initialized above.
+        self._replaying = False   # WAL replay bypasses the ro-mode gate
         self.persistence = None
         storage_dir = self.config.get_string("tsd.storage.directory")
         if storage_dir:
@@ -147,7 +148,9 @@ class TSDB:
 
     def _apply_point(self, metric: str, timestamp: int | float, value,
                      tags: dict[str, str]) -> None:
-        if self.mode == "ro":
+        if self.mode == "ro" and not self._replaying:
+            # WAL replay must restore data even when the daemon was
+            # restarted read-only; the gate applies to new writes only.
             raise RuntimeError("TSD is in read-only mode, writes rejected")
         is_int, num = parse_value(value)
         self.check_timestamp_and_tags(metric, timestamp, num, tags)
@@ -256,7 +259,9 @@ class TSDB:
 
     def _store_histogram(self, metric: str, timestamp: int | float, hist,
                          tags: dict[str, str]) -> None:
-        if self.mode == "ro":
+        if self.mode == "ro" and not self._replaying:
+            # WAL replay must restore data even when the daemon was
+            # restarted read-only; the gate applies to new writes only.
             raise RuntimeError("TSD is in read-only mode, writes rejected")
         self.check_timestamp_and_tags(metric, timestamp, None, tags)
         if self.write_filter is not None:
@@ -314,7 +319,9 @@ class TSDB:
         if self.rollup_store is None:
             raise RuntimeError("Rollups are not enabled "
                                "(tsd.rollups.enable=false)")
-        if self.mode == "ro":
+        if self.mode == "ro" and not self._replaying:
+            # WAL replay must restore data even when the daemon was
+            # restarted read-only; the gate applies to new writes only.
             raise RuntimeError("TSD is in read-only mode, writes rejected")
         is_int, num = parse_value(value)
         if interval:

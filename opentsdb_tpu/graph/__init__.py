@@ -1,0 +1,11 @@
+"""Graph rendering (the gnuplot subprocess replacement).
+
+Reference behavior: /root/reference/src/graph/Plot.java (:39 — writes
+gnuplot scripts + per-series data files rendered by an external gnuplot
+binary via mygnuplot.sh).  Rebuilt as a dependency-free SVG renderer: same
+role (axes/ticks/series/legend from query results), no subprocess.
+"""
+
+from opentsdb_tpu.graph.plot import Plot
+
+__all__ = ["Plot"]

@@ -1,0 +1,216 @@
+"""SVG time-series plot renderer.
+
+Plays Plot.java's role (axis/format options, per-series data, :266
+writeGnuplotScript) with an inline SVG instead of gnuplot output.  Series
+colors follow gnuplot's classic default cycle.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from xml.sax.saxutils import escape
+
+# gnuplot's classic line-color cycle
+COLORS = ("#ff0000", "#00c000", "#0080ff", "#c000ff", "#00eeee",
+          "#c04000", "#c8c800", "#4169e1", "#ffc020", "#008040")
+
+
+@dataclass
+class PlotSeries:
+    label: str
+    points: list[tuple[int, float]]   # (ts_ms, value)
+
+
+@dataclass
+class Plot:
+    """Collects series + options, emits SVG (Plot.java:39)."""
+    start_time: int                    # ms
+    end_time: int                      # ms
+    width: int = 1024
+    height: int = 576
+    title: str = ""
+    ylabel: str = ""
+    yrange: tuple[float, float] | None = None
+    ylog: bool = False
+    nokey: bool = False               # hide the legend
+    series: list[PlotSeries] = field(default_factory=list)
+
+    MARGIN_LEFT = 70
+    MARGIN_RIGHT = 20
+    MARGIN_TOP = 30
+    MARGIN_BOTTOM = 60
+
+    def add_series(self, label: str,
+                   points: list[tuple[int, float]]) -> None:
+        self.series.append(PlotSeries(label, points))
+
+    # -- scales --
+
+    def _y_domain(self) -> tuple[float, float]:
+        if self.yrange is not None:
+            return self.yrange
+        lo, hi = math.inf, -math.inf
+        for s in self.series:
+            for _, v in s.points:
+                if v == v and not math.isinf(v):     # skip NaN/Inf
+                    lo = min(lo, v)
+                    hi = max(hi, v)
+        if lo is math.inf:
+            return 0.0, 1.0
+        if lo == hi:
+            pad = abs(lo) * 0.1 or 1.0
+            return lo - pad, hi + pad
+        pad = (hi - lo) * 0.05
+        return lo - pad, hi + pad
+
+    def _x_px(self, ts: int) -> float:
+        span = max(self.end_time - self.start_time, 1)
+        inner = self.width - self.MARGIN_LEFT - self.MARGIN_RIGHT
+        return self.MARGIN_LEFT + (ts - self.start_time) / span * inner
+
+    def _y_px(self, v: float, lo: float, hi: float) -> float:
+        inner = self.height - self.MARGIN_TOP - self.MARGIN_BOTTOM
+        if self.ylog and lo > 0:
+            frac = (math.log10(v) - math.log10(lo)) / \
+                (math.log10(hi) - math.log10(lo))
+        else:
+            frac = (v - lo) / (hi - lo)
+        return self.height - self.MARGIN_BOTTOM - frac * inner
+
+    @staticmethod
+    def _nice_ticks(lo: float, hi: float, n: int = 6) -> list[float]:
+        span = hi - lo
+        if span <= 0:
+            return [lo]
+        raw = span / n
+        mag = 10 ** math.floor(math.log10(raw))
+        for mult in (1, 2, 2.5, 5, 10):
+            if raw <= mult * mag:
+                step = mult * mag
+                break
+        first = math.ceil(lo / step) * step
+        ticks = []
+        t = first
+        while t <= hi + 1e-9 * span:
+            ticks.append(round(t, 10))
+            t += step
+        return ticks
+
+    def _time_ticks(self) -> list[tuple[int, str]]:
+        span_s = (self.end_time - self.start_time) / 1000
+        if span_s <= 0:
+            return []
+        if span_s <= 3 * 3600:
+            step, fmt = 15 * 60, "%H:%M"
+        elif span_s <= 26 * 3600:
+            step, fmt = 2 * 3600, "%H:%M"
+        elif span_s <= 8 * 86400:
+            step, fmt = 86400, "%m/%d"
+        else:
+            step, fmt = 7 * 86400, "%m/%d"
+        start_s = self.start_time // 1000
+        first = (start_s // step + 1) * step
+        out = []
+        t = first
+        while t * 1000 <= self.end_time:
+            out.append((t * 1000, time.strftime(fmt, time.gmtime(t))))
+            t += step
+        return out
+
+    # -- render --
+
+    def render_svg(self) -> str:
+        lo, hi = self._y_domain()
+        w, h = self.width, self.height
+        plot_left = self.MARGIN_LEFT
+        plot_right = w - self.MARGIN_RIGHT
+        plot_top = self.MARGIN_TOP
+        plot_bottom = h - self.MARGIN_BOTTOM
+        parts = [
+            '<svg xmlns="http://www.w3.org/2000/svg" width="%d" '
+            'height="%d" viewBox="0 0 %d %d" '
+            'font-family="sans-serif" font-size="11">' % (w, h, w, h),
+            '<rect width="%d" height="%d" fill="white"/>' % (w, h),
+        ]
+        if self.title:
+            parts.append(
+                '<text x="%d" y="18" text-anchor="middle" '
+                'font-size="14">%s</text>' % (w // 2, escape(self.title)))
+        # gridlines + y ticks
+        for tick in self._nice_ticks(lo, hi):
+            y = self._y_px(tick, lo, hi)
+            if not plot_top <= y <= plot_bottom:
+                continue
+            parts.append(
+                '<line x1="%d" y1="%.1f" x2="%d" y2="%.1f" '
+                'stroke="#dddddd"/>' % (plot_left, y, plot_right, y))
+            parts.append(
+                '<text x="%d" y="%.1f" text-anchor="end" '
+                'dominant-baseline="middle">%s</text>'
+                % (plot_left - 6, y, _fmt_value(tick)))
+        # x ticks
+        for ts, label in self._time_ticks():
+            x = self._x_px(ts)
+            parts.append(
+                '<line x1="%.1f" y1="%d" x2="%.1f" y2="%d" '
+                'stroke="#dddddd"/>' % (x, plot_top, x, plot_bottom))
+            parts.append(
+                '<text x="%.1f" y="%d" text-anchor="middle">%s</text>'
+                % (x, plot_bottom + 16, escape(label)))
+        # frame
+        parts.append(
+            '<rect x="%d" y="%d" width="%d" height="%d" fill="none" '
+            'stroke="black"/>' % (plot_left, plot_top,
+                                  plot_right - plot_left,
+                                  plot_bottom - plot_top))
+        if self.ylabel:
+            parts.append(
+                '<text x="14" y="%d" transform="rotate(-90 14 %d)" '
+                'text-anchor="middle">%s</text>'
+                % ((plot_top + plot_bottom) // 2,
+                   (plot_top + plot_bottom) // 2, escape(self.ylabel)))
+        # series polylines
+        for i, s in enumerate(self.series):
+            color = COLORS[i % len(COLORS)]
+            coords = []
+            for ts, v in s.points:
+                if v != v or math.isinf(v):
+                    continue
+                if self.ylog and v <= 0:
+                    continue
+                coords.append("%.1f,%.1f"
+                              % (self._x_px(ts),
+                                 max(plot_top, min(plot_bottom,
+                                     self._y_px(v, lo, hi)))))
+            if coords:
+                parts.append(
+                    '<polyline fill="none" stroke="%s" stroke-width="1.5" '
+                    'points="%s"/>' % (color, " ".join(coords)))
+        # legend
+        if not self.nokey:
+            for i, s in enumerate(self.series[:10]):
+                color = COLORS[i % len(COLORS)]
+                y = plot_bottom + 34 + (i % 2) * 14
+                x = plot_left + (i // 2) * 240
+                parts.append(
+                    '<line x1="%d" y1="%d" x2="%d" y2="%d" stroke="%s" '
+                    'stroke-width="2"/>' % (x, y - 4, x + 18, y - 4, color))
+                parts.append(
+                    '<text x="%d" y="%d">%s</text>'
+                    % (x + 24, y, escape(s.label[:60])))
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+def _fmt_value(v: float) -> str:
+    if abs(v) >= 1e9:
+        return "%.1fG" % (v / 1e9)
+    if abs(v) >= 1e6:
+        return "%.1fM" % (v / 1e6)
+    if abs(v) >= 1e4:
+        return "%.1fk" % (v / 1e3)
+    if v == int(v):
+        return str(int(v))
+    return "%g" % v

@@ -18,10 +18,13 @@ Layout under the directory:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 
 import numpy as np
+
+LOG = logging.getLogger("storage.persist")
 
 SNAPSHOT_JSON = "snapshot.json"
 SERIES_NPZ = "series.npz"
@@ -71,6 +74,21 @@ class DiskPersistence:
             return 0
         tsdb = self.tsdb
         count = 0
+        failed = 0
+        tsdb._replaying = True
+        try:
+            count, failed = self._replay_lines(path)
+        finally:
+            tsdb._replaying = False
+        if failed:
+            LOG.error("WAL replay dropped %d of %d records; see prior "
+                      "errors", failed, count + failed)
+        return count
+
+    def _replay_lines(self, path: str) -> tuple[int, int]:
+        tsdb = self.tsdb
+        count = 0
+        failed = 0
         with open(path) as fh:
             for line in fh:
                 line = line.strip()
@@ -102,9 +120,14 @@ class DiskPersistence:
                         if tsdb.search_plugin is not None:
                             tsdb.search_plugin.index_annotation(note)
                     count += 1
-                except Exception:
-                    continue
-        return count
+                except Exception as e:
+                    # Torn tail lines are silent (JSONDecodeError above);
+                    # systematic apply failures must be visible.
+                    failed += 1
+                    if failed <= 10:
+                        LOG.error("WAL replay failed for record %r: %s",
+                                  line[:200], e)
+        return count, failed
 
     # ------------------------------------------------------------------ #
     # Snapshot                                                           #

@@ -94,10 +94,10 @@ def cmd_query(args) -> int:
     q = TSQuery(start=args.start, end=args.end,
                 queries=[parse_m_subquery(m) for m in args.queries])
     q.validate()
+    from opentsdb_tpu.utils import format_ascii_point
     for result in tsdb.new_query_runner().run(q):
-        tags = " ".join("%s=%s" % kv for kv in sorted(result.tags.items()))
         for ts, value in result.dps:
-            print("%s %d %s %s" % (result.metric, ts // 1000, value, tags))
+            print(format_ascii_point(result.metric, ts, value, result.tags))
     return 0
 
 
@@ -119,11 +119,11 @@ def cmd_scan(args) -> int:
         if args.delete:
             series.delete_range(int(ts[0]) if len(ts) else 0,
                                 int(ts[-1]) if len(ts) else 0)
+        from opentsdb_tpu.utils import format_ascii_point
         for i in range(len(ts)):
             value = int(iv[i]) if isint[i] else float(fv[i])
             if args.importfmt:
-                print("%s %d %s %s" % (metric, ts[i] // 1000, value,
-                                       tag_str))
+                print(format_ascii_point(metric, int(ts[i]), value, tags))
             else:
                 print("%s %d %s {%s}" % (tsdb.tsuid(series.key), ts[i],
                                          value, tag_str))
@@ -301,8 +301,10 @@ def cmd_fsck(args) -> int:
     print("Scanned %d series, %d datapoints: %d duplicates, %d "
           "out-of-order, %d unknown-UID series"
           % (series_checked, points, dupes, ooo, unknown_uids))
-    return 0 if (dupes == 0 and ooo == 0 and unknown_uids == 0
-                 or args.fix) else 1
+    # --fix repairs dupes/out-of-order but NOT dangling UIDs, which must
+    # keep failing the health check.
+    clean = (dupes == 0 and ooo == 0) or args.fix
+    return 0 if clean and unknown_uids == 0 else 1
 
 
 # ------------------------------------------------------------------ #

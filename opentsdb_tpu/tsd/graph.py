@@ -1,0 +1,139 @@
+"""/q graph endpoint + the built-in query UI page.
+
+Reference behavior: /root/reference/src/tsd/GraphHandler.java — parse the
+same query-string grammar as /api/query, run the queries, render (gnuplot
+PNG there, inline SVG here), with a disk result cache keyed by query hash
+(:doCacheing, tsd.http.cachedir) and `ascii`/`json` output modes; plot
+options wxh/yrange/ylog/nokey/title/ylabel mirror the CVE-2020-35476
+allowlisted parameter set (:191).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from opentsdb_tpu.graph.plot import Plot
+from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
+from opentsdb_tpu.tsd.rpcs import QueryRpc, allowed_methods
+
+
+class GraphHandler:
+    """GET /q."""
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET", "POST")
+        ts_query = QueryRpc().parse_query_string(tsdb, query)
+        ts_query.validate()
+
+        cachedir = tsdb.config.get_string("tsd.http.cachedir")
+        nocache = query.has_query_string_param("nocache")
+        cache_key = None
+        mode = ("ascii" if query.has_query_string_param("ascii")
+                else "json" if query.has_query_string_param("json")
+                else "svg")
+        if cachedir and not nocache:
+            basis = json.dumps(sorted(query.request.query.items()))
+            cache_key = os.path.join(
+                cachedir, "q_%s.%s"
+                % (hashlib.sha1(basis.encode()).hexdigest(), mode))
+            cached = self._read_cache(cache_key, ts_query)
+            if cached is not None:
+                query.send_reply(cached, content_type=_CONTENT_TYPES[mode])
+                return
+
+        results = tsdb.new_query_runner().run(ts_query)
+        if mode == "ascii":
+            body = self._ascii(results)
+        elif mode == "json":
+            body = json.dumps({
+                "plotted": sum(len(r.dps) for r in results),
+                "points": sum(len(r.dps) for r in results),
+                "etags": [sorted(r.tags.keys()) for r in results],
+                "timing": round(query.elapsed_ms()),
+            })
+        else:
+            body = self._svg(query, ts_query, results)
+
+        if cache_key is not None:
+            self._write_cache(cache_key, body)
+        query.send_reply(body, content_type=_CONTENT_TYPES[mode])
+
+    # -- renderers --
+
+    @staticmethod
+    def _ascii(results) -> str:
+        from opentsdb_tpu.utils import format_ascii_point
+        lines = []
+        for r in results:
+            for ts, value in r.dps:
+                lines.append(format_ascii_point(r.metric, ts, value,
+                                                r.tags))
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    @staticmethod
+    def _svg(query: HttpQuery, ts_query, results) -> str:
+        wxh = query.get_query_string_param("wxh") or "1024x576"
+        try:
+            w, h = (int(p) for p in wxh.lower().split("x"))
+        except ValueError:
+            raise BadRequestError("Invalid wxh parameter: " + wxh)
+        plot = Plot(start_time=ts_query.start_time,
+                    end_time=ts_query.end_time, width=w, height=h)
+        # allowlisted display params (GraphHandler.java:191)
+        plot.title = query.get_query_string_param("title") or ""
+        plot.ylabel = query.get_query_string_param("ylabel") or ""
+        plot.nokey = query.has_query_string_param("nokey")
+        plot.ylog = query.has_query_string_param("ylog")
+        yrange = query.get_query_string_param("yrange")
+        if yrange:
+            try:
+                lo, hi = yrange.strip("[]").split(":")
+                plot.yrange = (float(lo), float(hi))
+            except ValueError:
+                raise BadRequestError("Invalid yrange parameter: " + yrange)
+            if plot.yrange[0] >= plot.yrange[1]:
+                raise BadRequestError(
+                    "Invalid yrange parameter: low must be below high")
+        for r in results:
+            tags = " ".join("%s=%s" % kv for kv in sorted(r.tags.items()))
+            label = ("%s{%s}" % (r.metric, tags)) if tags else r.metric
+            plot.add_series(label, [(ts, float(v)) for ts, v in r.dps])
+        return plot.render_svg()
+
+    # -- cache (GraphHandler disk cache) --
+
+    @staticmethod
+    def _read_cache(path: str, ts_query) -> str | None:
+        try:
+            if os.path.exists(path):
+                # expire entries once the query's end time stops moving the
+                # data (anything touching "now" expires quickly)
+                import time
+                age = time.time() - os.path.getmtime(path)
+                recent = ts_query.end_time >= (time.time() - 60) * 1000
+                if age < (15 if recent else 900):
+                    with open(path) as fh:
+                        return fh.read()
+        except OSError:
+            pass
+        return None
+
+    @staticmethod
+    def _write_cache(path: str, body: str) -> None:
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(body)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+_CONTENT_TYPES = {
+    "ascii": "text/plain; charset=UTF-8",
+    "json": "application/json",
+    "svg": "image/svg+xml",
+}

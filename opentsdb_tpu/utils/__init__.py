@@ -1,4 +1,15 @@
 from opentsdb_tpu.utils.config import Config
 from opentsdb_tpu.utils import datetime_util as DateTime
 
-__all__ = ["Config", "DateTime"]
+
+def format_ascii_point(metric: str, ts_ms: int, value,
+                       tags: dict[str, str]) -> str:
+    """Import-compatible datapoint line `metric ts value k=v ...` — the one
+    format shared by `tsdb query`, `tsdb scan --importfmt`, /q?ascii, and
+    the TextImporter input grammar."""
+    tag_str = " ".join("%s=%s" % kv for kv in sorted(tags.items()))
+    return "%s %d %s%s" % (metric, ts_ms // 1000, value,
+                           (" " + tag_str) if tag_str else "")
+
+
+__all__ = ["Config", "DateTime", "format_ascii_point"]

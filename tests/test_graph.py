@@ -1,0 +1,111 @@
+"""Graph endpoint + SVG renderer tests (GraphHandler/Plot coverage)."""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.graph.plot import Plot, _fmt_value
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def manager(tmp_path):
+    t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                     "tsd.http.cachedir": str(tmp_path / "cache")}))
+    for i in range(20):
+        t.add_point("g.cpu", BASE + i * 60, 50 + 10 * (i % 3),
+                    {"host": "web01"})
+        t.add_point("g.cpu", BASE + i * 60, 20 + i, {"host": "web02"})
+    return RpcManager(t)
+
+
+def http(manager, uri):
+    q = manager.handle_http(HttpRequest(method="GET", uri=uri))
+    return q.response
+
+
+class TestPlot:
+    def test_basic_svg(self):
+        p = Plot(start_time=BASE * 1000, end_time=(BASE + 3600) * 1000)
+        p.add_series("s1", [(BASE * 1000 + i * 60_000, float(i))
+                            for i in range(10)])
+        svg = p.render_svg()
+        assert svg.startswith("<svg")
+        assert "polyline" in svg
+        assert "s1" in svg
+
+    def test_nan_points_skipped(self):
+        p = Plot(start_time=0, end_time=1000)
+        p.add_series("s", [(0, float("nan")), (500, 1.0), (900, 2.0)])
+        svg = p.render_svg()
+        # two valid points only
+        poly = [l for l in svg.split("<") if l.startswith("polyline")][0]
+        assert poly.count(",") == 2
+
+    def test_title_escaped(self):
+        p = Plot(start_time=0, end_time=1000, title="<script>x</script>")
+        assert "<script>x" not in p.render_svg()
+
+    def test_yrange_and_log(self):
+        p = Plot(start_time=0, end_time=1000, yrange=(1.0, 100.0),
+                 ylog=True)
+        p.add_series("s", [(100, 10.0), (500, -5.0)])  # -5 dropped in log
+        svg = p.render_svg()
+        assert "polyline" in svg
+
+    def test_fmt_value(self):
+        assert _fmt_value(2_000_000_000) == "2.0G"
+        assert _fmt_value(1_500_000) == "1.5M"
+        assert _fmt_value(42) == "42"
+        assert _fmt_value(1.5) == "1.5"
+
+
+class TestGraphEndpoint:
+    def test_svg_output(self, manager):
+        r = http(manager,
+                 "/q?start=%d&end=%d&m=sum:g.cpu{host=*}&wxh=640x360"
+                 % (BASE, BASE + 1200))
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "image/svg+xml"
+        svg = r.body.decode()
+        assert 'width="640"' in svg
+        assert svg.count("polyline") == 2  # two hosts
+
+    def test_ascii_output(self, manager):
+        r = http(manager, "/q?start=%d&end=%d&m=sum:g.cpu&ascii"
+                 % (BASE, BASE + 300))
+        body = r.body.decode()
+        assert body.splitlines()[0].startswith("g.cpu %d" % BASE)
+
+    def test_json_output(self, manager):
+        r = http(manager, "/q?start=%d&end=%d&m=sum:g.cpu&json"
+                 % (BASE, BASE + 300))
+        body = json.loads(r.body)
+        assert body["points"] == 6
+
+    def test_cache_round_trip(self, manager):
+        uri = "/q?start=%d&end=%d&m=sum:g.cpu&ascii" % (BASE, BASE + 300)
+        r1 = http(manager, uri)
+        r2 = http(manager, uri)   # served from the disk cache
+        assert r1.body == r2.body
+
+    def test_bad_wxh(self, manager):
+        r = http(manager, "/q?start=%d&m=sum:g.cpu&wxh=banana" % BASE)
+        assert r.status == 400
+
+    def test_display_params(self, manager):
+        r = http(manager,
+                 "/q?start=%d&end=%d&m=sum:g.cpu&title=My+Graph&nokey"
+                 "&ylabel=ms" % (BASE, BASE + 300))
+        svg = r.body.decode()
+        assert "My Graph" in svg and "ms" in svg
+
+    def test_home_page_ui(self, manager):
+        r = http(manager, "/")
+        body = r.body.decode()
+        assert "/api/suggest" in body and "/q?start=" in body

@@ -83,6 +83,17 @@ class TestWalReplay:
         pts = t2.histogram_store.all_series()[0].window(0, 1 << 62)
         assert pts[0][1].overflow == 7
 
+    def test_replay_in_readonly_mode(self, tmp_path):
+        # A crashed TSD restarted with --mode ro must still restore the
+        # WAL; the ro gate applies only to new writes.
+        t1 = make_tsdb(tmp_path)
+        t1.add_point("ro.m", BASE, 5, {"h": "a"})
+        t1.persistence.close()
+        t2 = make_tsdb(tmp_path, **{"tsd.mode": "ro"})
+        assert t2.store.total_datapoints == 1
+        with pytest.raises(RuntimeError):
+            t2.add_point("ro.m", BASE + 1, 6, {"h": "a"})
+
     def test_torn_tail_line_skipped(self, tmp_path):
         t1 = make_tsdb(tmp_path)
         t1.add_point("p.cpu", BASE, 1, {"h": "a"})
