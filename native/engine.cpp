@@ -1,0 +1,475 @@
+// Native columnar storage engine for opentsdb_tpu.
+//
+// Plays the role the HBase storage layer + asynchbase client played for the
+// reference (SURVEY.md §2.6 storage schema; compaction's space rationale,
+// /root/reference/src/core/CompactionQueue.java:40-56: amortize per-cell
+// overhead by packing cells — here, whole chunks compress together).
+//
+// Design:
+//   * per-series storage = sealed compressed chunks + an uncompressed
+//     append tail (the CompactionQueue analog: the tail seals into a
+//     compressed chunk once it reaches CHUNK_POINTS).
+//   * chunk codec: delta-of-delta zig-zag varint timestamps (time-series
+//     deltas are near-constant) + XOR'd IEEE754 value bits varint-packed
+//     (Gorilla-style), plus an is-int bitmap so Java-long exactness
+//     survives: integer points carry their int64 bits instead of a double.
+//   * reads decompress + merge + sort + last-write-wins dedup, mirroring
+//     MemStore.Series.normalize semantics.
+//   * save/load: length-prefixed dump of keys + chunks (snapshot file).
+//
+// C ABI only (driven from Python via ctypes).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+constexpr size_t CHUNK_POINTS = 512;
+
+// ---------------------------------------------------------------- varint
+
+inline void put_varint(std::vector<uint8_t>& out, uint64_t v) {
+    while (v >= 0x80) {
+        out.push_back(static_cast<uint8_t>(v) | 0x80);
+        v >>= 7;
+    }
+    out.push_back(static_cast<uint8_t>(v));
+}
+
+inline uint64_t get_varint(const uint8_t* data, size_t& pos) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (;;) {
+        uint8_t b = data[pos++];
+        v |= static_cast<uint64_t>(b & 0x7F) << shift;
+        if (!(b & 0x80)) return v;
+        shift += 7;
+    }
+}
+
+inline uint64_t zigzag(int64_t v) {
+    return (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+}
+
+inline int64_t unzigzag(uint64_t v) {
+    return static_cast<int64_t>(v >> 1) ^ -static_cast<int64_t>(v & 1);
+}
+
+// ---------------------------------------------------------------- point
+
+struct Point {
+    int64_t ts;
+    double fval;
+    int64_t ival;
+    uint8_t is_int;
+};
+
+// ---------------------------------------------------------------- chunk
+
+struct Chunk {
+    std::vector<uint8_t> data;  // compressed
+    size_t n = 0;
+    int64_t first_ts = 0;
+    int64_t last_ts = 0;
+
+    static Chunk compress(const Point* pts, size_t n) {
+        Chunk c;
+        c.n = n;
+        if (n == 0) return c;
+        c.first_ts = pts[0].ts;
+        c.last_ts = pts[n - 1].ts;
+        std::vector<uint8_t>& out = c.data;
+        out.reserve(n * 4);
+        // timestamps: first raw, then delta-of-delta zig-zag varints
+        put_varint(out, zigzag(pts[0].ts));
+        int64_t prev_ts = pts[0].ts;
+        int64_t prev_delta = 0;
+        for (size_t i = 1; i < n; i++) {
+            int64_t delta = pts[i].ts - prev_ts;
+            put_varint(out, zigzag(delta - prev_delta));
+            prev_delta = delta;
+            prev_ts = pts[i].ts;
+        }
+        // is-int bitmap
+        for (size_t i = 0; i < n; i += 8) {
+            uint8_t b = 0;
+            for (size_t j = 0; j < 8 && i + j < n; j++)
+                if (pts[i + j].is_int) b |= (1u << j);
+            out.push_back(b);
+        }
+        // values: ints as zig-zag delta varints, floats as XOR'd bit
+        // patterns (Gorilla-style, varint-packed)
+        int64_t prev_int = 0;
+        uint64_t prev_bits = 0;
+        for (size_t i = 0; i < n; i++) {
+            if (pts[i].is_int) {
+                put_varint(out, zigzag(pts[i].ival - prev_int));
+                prev_int = pts[i].ival;
+            } else {
+                uint64_t bits;
+                std::memcpy(&bits, &pts[i].fval, 8);
+                put_varint(out, bits ^ prev_bits);
+                prev_bits = bits;
+            }
+        }
+        return c;
+    }
+
+    void decompress(std::vector<Point>& out) const {
+        if (n == 0) return;
+        size_t pos = 0;
+        const uint8_t* d = data.data();
+        size_t base = out.size();
+        out.resize(base + n);
+        // timestamps
+        int64_t ts = unzigzag(get_varint(d, pos));
+        out[base].ts = ts;
+        int64_t prev_delta = 0;
+        for (size_t i = 1; i < n; i++) {
+            prev_delta += unzigzag(get_varint(d, pos));
+            ts += prev_delta;
+            out[base + i].ts = ts;
+        }
+        // is-int bitmap
+        size_t bitmap_pos = pos;
+        pos += (n + 7) / 8;
+        for (size_t i = 0; i < n; i++) {
+            out[base + i].is_int =
+                (d[bitmap_pos + i / 8] >> (i % 8)) & 1;
+        }
+        // values
+        int64_t prev_int = 0;
+        uint64_t prev_bits = 0;
+        for (size_t i = 0; i < n; i++) {
+            if (out[base + i].is_int) {
+                prev_int += unzigzag(get_varint(d, pos));
+                out[base + i].ival = prev_int;
+                out[base + i].fval = static_cast<double>(prev_int);
+            } else {
+                prev_bits ^= get_varint(d, pos);
+                double f;
+                std::memcpy(&f, &prev_bits, 8);
+                out[base + i].fval = f;
+                out[base + i].ival = 0;
+            }
+        }
+    }
+};
+
+// ---------------------------------------------------------------- series
+
+struct Series {
+    std::string key;            // opaque identity bytes from Python
+    std::vector<Chunk> chunks;
+    std::vector<Point> tail;    // uncompressed append buffer
+    bool sorted = true;
+    int64_t max_ts = INT64_MIN;
+    std::mutex mu;
+
+    size_t size() const {
+        size_t total = tail.size();
+        for (const auto& c : chunks) total += c.n;
+        return total;
+    }
+
+    size_t bytes() const {
+        size_t total = tail.capacity() * sizeof(Point);
+        for (const auto& c : chunks) total += c.data.capacity();
+        return total;
+    }
+
+    void append(int64_t ts, double fval, int64_t ival, uint8_t is_int) {
+        std::lock_guard<std::mutex> lock(mu);
+        if (ts <= max_ts) sorted = false;
+        max_ts = std::max(max_ts, ts);
+        tail.push_back(Point{ts, fval, ival, is_int});
+        if (sorted && tail.size() >= CHUNK_POINTS) seal_locked();
+    }
+
+    void seal_locked() {
+        if (tail.empty()) return;
+        chunks.push_back(Chunk::compress(tail.data(), tail.size()));
+        tail.clear();
+        tail.shrink_to_fit();
+    }
+
+    // full materialization: decompress + sort + dedup (last wins)
+    void materialize(std::vector<Point>& out) {
+        out.clear();
+        for (const auto& c : chunks) c.decompress(out);
+        out.insert(out.end(), tail.begin(), tail.end());
+        if (!sorted || chunks.size() > 1) {
+            std::stable_sort(out.begin(), out.end(),
+                             [](const Point& a, const Point& b) {
+                                 return a.ts < b.ts;
+                             });
+        }
+        // last-write-wins dedup
+        if (!out.empty()) {
+            size_t w = 0;
+            for (size_t r = 1; r < out.size(); r++) {
+                if (out[r].ts == out[w].ts) {
+                    out[w] = out[r];
+                } else {
+                    out[++w] = out[r];
+                }
+            }
+            out.resize(w + 1);
+        }
+    }
+
+    // normalize: materialize then re-seal as sorted chunks
+    void normalize() {
+        std::lock_guard<std::mutex> lock(mu);
+        if (sorted && chunks.size() <= 1) return;
+        std::vector<Point> pts;
+        materialize(pts);
+        chunks.clear();
+        for (size_t i = 0; i < pts.size(); i += CHUNK_POINTS) {
+            size_t n = std::min(CHUNK_POINTS, pts.size() - i);
+            chunks.push_back(Chunk::compress(pts.data() + i, n));
+        }
+        tail.clear();
+        sorted = true;
+    }
+};
+
+// ---------------------------------------------------------------- engine
+
+struct Engine {
+    std::vector<Series*> series;
+    std::map<std::string, int64_t> by_key;
+    std::mutex mu;
+
+    ~Engine() {
+        for (auto* s : series) delete s;
+    }
+};
+
+thread_local std::vector<Point> g_scratch;
+
+}  // namespace
+
+EXPORT void* eng_create() { return new Engine(); }
+
+EXPORT void eng_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+EXPORT int64_t eng_series(void* h, const uint8_t* key, int32_t key_len) {
+    Engine* eng = static_cast<Engine*>(h);
+    std::string k(reinterpret_cast<const char*>(key), key_len);
+    std::lock_guard<std::mutex> lock(eng->mu);
+    auto it = eng->by_key.find(k);
+    if (it != eng->by_key.end()) return it->second;
+    int64_t sid = static_cast<int64_t>(eng->series.size());
+    Series* s = new Series();
+    s->key = std::move(k);
+    eng->series.push_back(s);
+    eng->by_key.emplace(eng->series.back()->key, sid);
+    return sid;
+}
+
+EXPORT int32_t eng_num_series(void* h) {
+    Engine* eng = static_cast<Engine*>(h);
+    std::lock_guard<std::mutex> lock(eng->mu);
+    return static_cast<int32_t>(eng->series.size());
+}
+
+EXPORT int32_t eng_series_key(void* h, int64_t sid, uint8_t* out,
+                              int32_t max_len) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    int32_t n = std::min<int32_t>(max_len,
+                                  static_cast<int32_t>(s->key.size()));
+    std::memcpy(out, s->key.data(), n);
+    return static_cast<int32_t>(s->key.size());
+}
+
+EXPORT void eng_append(void* h, int64_t sid, int64_t ts, double fval,
+                       int64_t ival, int32_t is_int) {
+    Engine* eng = static_cast<Engine*>(h);
+    eng->series[sid]->append(ts, fval, ival,
+                             static_cast<uint8_t>(is_int));
+}
+
+EXPORT void eng_append_batch(void* h, int64_t sid, const int64_t* ts,
+                             const double* fval, const int64_t* ival,
+                             const uint8_t* is_int, int64_t n) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    std::lock_guard<std::mutex> lock(s->mu);
+    for (int64_t i = 0; i < n; i++) {
+        int64_t t = ts[i];
+        if (t <= s->max_ts) s->sorted = false;
+        s->max_ts = std::max(s->max_ts, t);
+        s->tail.push_back(Point{t, fval[i], ival[i], is_int[i]});
+    }
+    if (s->sorted && s->tail.size() >= CHUNK_POINTS) s->seal_locked();
+}
+
+EXPORT int64_t eng_series_len(void* h, int64_t sid) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    std::lock_guard<std::mutex> lock(s->mu);
+    return static_cast<int64_t>(s->size());
+}
+
+EXPORT int64_t eng_series_bytes(void* h, int64_t sid) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    std::lock_guard<std::mutex> lock(s->mu);
+    return static_cast<int64_t>(s->bytes());
+}
+
+// Materialize [start, end] into caller buffers sized via eng_series_len.
+// Returns the number of points written.
+EXPORT int64_t eng_window(void* h, int64_t sid, int64_t start, int64_t end,
+                          int64_t* out_ts, double* out_val,
+                          int64_t* out_ival, uint8_t* out_isint,
+                          int64_t max_n) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    std::lock_guard<std::mutex> lock(s->mu);
+    s->materialize(g_scratch);
+    auto lo = std::lower_bound(
+        g_scratch.begin(), g_scratch.end(), start,
+        [](const Point& p, int64_t v) { return p.ts < v; });
+    auto hi = std::upper_bound(
+        g_scratch.begin(), g_scratch.end(), end,
+        [](int64_t v, const Point& p) { return v < p.ts; });
+    int64_t n = 0;
+    for (auto it = lo; it != hi && n < max_n; ++it, ++n) {
+        out_ts[n] = it->ts;
+        out_val[n] = it->fval;
+        out_ival[n] = it->ival;
+        out_isint[n] = it->is_int;
+    }
+    return n;
+}
+
+EXPORT int64_t eng_delete_range(void* h, int64_t sid, int64_t start,
+                                int64_t end) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    std::lock_guard<std::mutex> lock(s->mu);
+    s->materialize(g_scratch);
+    std::vector<Point> kept;
+    kept.reserve(g_scratch.size());
+    int64_t removed = 0;
+    for (const auto& p : g_scratch) {
+        if (p.ts >= start && p.ts <= end) {
+            removed++;
+        } else {
+            kept.push_back(p);
+        }
+    }
+    s->chunks.clear();
+    for (size_t i = 0; i < kept.size(); i += CHUNK_POINTS) {
+        size_t n = std::min(CHUNK_POINTS, kept.size() - i);
+        s->chunks.push_back(Chunk::compress(kept.data() + i, n));
+    }
+    s->tail.clear();
+    s->sorted = true;
+    s->max_ts = kept.empty() ? INT64_MIN : kept.back().ts;
+    return removed;
+}
+
+EXPORT void eng_normalize(void* h, int64_t sid) {
+    Engine* eng = static_cast<Engine*>(h);
+    eng->series[sid]->normalize();
+}
+
+EXPORT int64_t eng_total_bytes(void* h) {
+    Engine* eng = static_cast<Engine*>(h);
+    std::lock_guard<std::mutex> lock(eng->mu);
+    int64_t total = 0;
+    for (auto* s : eng->series) total += s->bytes();
+    return total;
+}
+
+// ---------------------------------------------------------------- save/load
+
+EXPORT int32_t eng_save(void* h, const char* path) {
+    Engine* eng = static_cast<Engine*>(h);
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return -1;
+    std::lock_guard<std::mutex> lock(eng->mu);
+    uint64_t magic = 0x545044424E474E45ull;  // "ENGNBDPT"-ish tag
+    std::fwrite(&magic, 8, 1, f);
+    uint64_t n_series = eng->series.size();
+    std::fwrite(&n_series, 8, 1, f);
+    for (auto* s : eng->series) {
+        std::lock_guard<std::mutex> slock(s->mu);
+        s->seal_locked();
+        uint64_t klen = s->key.size();
+        std::fwrite(&klen, 8, 1, f);
+        std::fwrite(s->key.data(), 1, klen, f);
+        uint64_t n_chunks = s->chunks.size();
+        std::fwrite(&n_chunks, 8, 1, f);
+        uint8_t flags = s->sorted ? 1 : 0;
+        std::fwrite(&flags, 1, 1, f);
+        std::fwrite(&s->max_ts, 8, 1, f);
+        for (const auto& c : s->chunks) {
+            uint64_t n = c.n;
+            uint64_t len = c.data.size();
+            std::fwrite(&n, 8, 1, f);
+            std::fwrite(&c.first_ts, 8, 1, f);
+            std::fwrite(&c.last_ts, 8, 1, f);
+            std::fwrite(&len, 8, 1, f);
+            std::fwrite(c.data.data(), 1, len, f);
+        }
+    }
+    std::fclose(f);
+    return 0;
+}
+
+EXPORT void* eng_load(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return nullptr;
+    uint64_t magic = 0;
+    if (std::fread(&magic, 8, 1, f) != 1 ||
+        magic != 0x545044424E474E45ull) {
+        std::fclose(f);
+        return nullptr;
+    }
+    Engine* eng = new Engine();
+    uint64_t n_series = 0;
+    std::fread(&n_series, 8, 1, f);
+    for (uint64_t i = 0; i < n_series; i++) {
+        Series* s = new Series();
+        uint64_t klen = 0;
+        std::fread(&klen, 8, 1, f);
+        s->key.resize(klen);
+        std::fread(s->key.data(), 1, klen, f);
+        uint64_t n_chunks = 0;
+        std::fread(&n_chunks, 8, 1, f);
+        uint8_t flags = 1;
+        std::fread(&flags, 1, 1, f);
+        s->sorted = flags & 1;
+        std::fread(&s->max_ts, 8, 1, f);
+        for (uint64_t j = 0; j < n_chunks; j++) {
+            Chunk c;
+            uint64_t n = 0, len = 0;
+            std::fread(&n, 8, 1, f);
+            std::fread(&c.first_ts, 8, 1, f);
+            std::fread(&c.last_ts, 8, 1, f);
+            std::fread(&len, 8, 1, f);
+            c.n = n;
+            c.data.resize(len);
+            std::fread(c.data.data(), 1, len, f);
+            s->chunks.push_back(std::move(c));
+        }
+        int64_t sid = static_cast<int64_t>(eng->series.size());
+        eng->series.push_back(s);
+        eng->by_key.emplace(s->key, sid);
+    }
+    std::fclose(f);
+    return eng;
+}
