@@ -1,0 +1,252 @@
+"""BASELINE.md measurement configs 1-5 as runnable benchmarks.
+
+`python bench_configs.py [--config N] [--scale F]` prints one JSON line per
+config (bench.py stays the single-line headline bench the driver runs).
+
+Configs (BASELINE.md / BASELINE.json):
+  1. 1M pts, single series, avg 1h downsample          - correctness baseline
+  2. 100M pts, sum/min/max/count multi-agg 10s         - multi-kernel fusion
+  3. 10k-series group-by + avg downsample              - segment-reduce fan-out
+  4. rate + p99 over 500M pts                          - non-associative kernels
+  5. 1B pts -> 1m rollups, time-chunked                - offline batch pass
+
+Configs 2/4/5 exceed device memory as one batch, so they run through the
+streaming machinery (ops.streaming): chunks are generated on device by a
+closed-form hash (the storage layer's role; generation is timed separately
+and subtracted via a generation-only calibration pass).  Config 5 chunks by
+TIME (rollup output rows are emitted per chunk — the write-side shape of
+TSDB.addAggregatePoint), the others by point index.
+
+Use --scale 0.01 for a quick CPU smoke run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+START = 1_356_998_400_000
+STEP_MS = 10_000  # 10s cadence
+
+
+def _note(msg: str) -> None:
+    print("[bench_configs] " + msg, file=sys.stderr, flush=True)
+
+
+def _emit(config: int, label: str, points: int, seconds: float,
+          n_dev: int) -> None:
+    dp_s_chip = points / max(seconds, 1e-9) / n_dev
+    baseline = 1e9 / 2.0 / 8.0  # north star: 62.5M dp/s/chip
+    print(json.dumps({
+        "metric": "config %d: %s" % (config, label),
+        "value": round(dp_s_chip, 1),
+        "unit": "datapoints/sec/chip",
+        "vs_baseline": round(dp_s_chip / baseline, 4),
+    }), flush=True)
+
+
+def _chunk_gen(s, n, base_col):
+    """Closed-form [s, n] chunk (ts sorted per row, deterministic values)."""
+    import jax.numpy as jnp
+    rows = jnp.arange(s, dtype=jnp.int64)
+    cols = base_col + jnp.arange(n, dtype=jnp.int64)
+    h = (rows[:, None] * 2_654_435_761 + cols[None, :] * 40_503) & 0x7FFFFFFF
+    ts = START + cols[None, :] * STEP_MS + h % 4_000
+    val = 100.0 + (h % 1_000).astype(jnp.float64) * 0.05
+    mask = jnp.ones((s, n), dtype=bool)
+    return ts, val, mask
+
+
+# ------------------------------------------------------------------ #
+
+def config1(scale: float, n_dev: int) -> None:
+    """1M pts, one series, avg 1h — through the production grouped path."""
+    import jax
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
+    from opentsdb_tpu.ops.pipeline import (
+        PipelineSpec, DownsampleStep, run_group_pipeline)
+
+    n = max(int(1_000_000 * scale), 1024)
+    ts, val, mask = jax.jit(lambda: _chunk_gen(1, n, 0))()
+    gid = jnp.zeros(1, jnp.int64)
+    fixed = FixedWindows.for_range(START, START + n * STEP_MS, 3_600_000)
+    wspec, wargs = fixed.split()
+    spec = PipelineSpec("sum", DownsampleStep("avg", wspec, "none", 0.0))
+    run_group_pipeline(spec, ts, val, mask, gid, 1, wargs)  # compile
+    t0 = time.perf_counter()
+    reps = 5
+    out = None
+    for _ in range(reps):
+        out = run_group_pipeline(spec, ts, val, mask, gid, 1, wargs)
+    jax.block_until_ready(out)
+    _emit(1, "1M pts single-series avg-1h", n * reps,
+          time.perf_counter() - t0, n_dev)
+
+
+def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes):
+    """Generate+accumulate `chunks` chunks; return elapsed minus gen-only."""
+    import jax
+    from opentsdb_tpu.ops.streaming import StreamAccumulator
+
+    gen = jax.jit(_chunk_gen, static_argnums=(0, 1))
+
+    # Calibrate generation cost alone.
+    t0 = time.perf_counter()
+    for k in range(chunks):
+        jax.block_until_ready(gen(s, n_chunk, k * n_chunk))
+    gen_time = time.perf_counter() - t0
+
+    acc = StreamAccumulator.create(s, wspec, wargs)
+    acc.update(*gen(s, n_chunk, 0))  # compile
+    acc = StreamAccumulator.create(s, wspec, wargs)
+    t0 = time.perf_counter()
+    for k in range(chunks):
+        acc.update(*gen(s, n_chunk, k * n_chunk))
+    outs = [acc.finish(f) for f in finishes]
+    jax.block_until_ready(outs)
+    elapsed = time.perf_counter() - t0
+    return max(elapsed - gen_time, 1e-9), outs
+
+
+def config2(scale: float, n_dev: int) -> None:
+    """100M pts, multi-agg (sum/min/max/count) 10s downsample, streamed."""
+    from opentsdb_tpu.ops.downsample import FixedWindows
+
+    total = int(100_000_000 * scale)
+    s = 128
+    n_chunk = 65_536
+    chunks = max(total // (s * n_chunk), 1)
+    span = n_chunk * chunks * STEP_MS
+    fixed = FixedWindows.for_range(START, START + span, 10_000)
+    wspec, wargs = fixed.split()
+    secs, _ = _stream_pass(s, n_chunk, chunks, wspec, wargs,
+                           ["sum", "min", "max", "count"])
+    _emit(2, "100M pts multi-agg 10s downsample (streamed)",
+          s * n_chunk * chunks, secs, n_dev)
+
+
+def config3(scale: float, n_dev: int) -> None:
+    """Group-by over 10k tag-series + avg downsample — one dispatch."""
+    import jax
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
+    from opentsdb_tpu.ops.pipeline import (
+        PipelineSpec, DownsampleStep, run_group_pipeline)
+
+    s = max(int(10_240 * scale), 64)
+    n = 2048
+    ts, val, mask = jax.jit(lambda: _chunk_gen(s, n, 0))()
+    gid = jnp.arange(s, dtype=jnp.int64)  # every series its own group
+    fixed = FixedWindows.for_range(START, START + n * STEP_MS, 3_600_000)
+    wspec, wargs = fixed.split()
+    spec = PipelineSpec("avg", DownsampleStep("avg", wspec, "none", 0.0))
+    g = pad_pow2(s)
+    run_group_pipeline(spec, ts, val, mask, gid, g, wargs)  # compile
+    t0 = time.perf_counter()
+    reps = 3
+    out = None
+    for _ in range(reps):
+        out = run_group_pipeline(spec, ts, val, mask, gid, g, wargs)
+    jax.block_until_ready(out)
+    _emit(3, "10k-series group-by avg downsample", s * n * reps,
+          time.perf_counter() - t0, n_dev)
+
+
+def config4(scale: float, n_dev: int) -> None:
+    """rate + p99 over 500M pts: stream to grid, rate+percentile tail."""
+    import jax
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops.downsample import FixedWindows
+    from opentsdb_tpu.ops.pipeline import (
+        PipelineSpec, DownsampleStep, run_grid_tail)
+    from opentsdb_tpu.ops.rate import RateOptions
+
+    total = int(500_000_000 * scale)
+    s = 512
+    n_chunk = 65_536
+    chunks = max(total // (s * n_chunk), 1)
+    span = n_chunk * chunks * STEP_MS
+    fixed = FixedWindows.for_range(START, START + span, 60_000)
+    wspec, wargs = fixed.split()
+    t0 = time.perf_counter()
+    secs, outs = _stream_pass(s, n_chunk, chunks, wspec, wargs, ["avg"])
+    wts, v, m = outs[0]
+    spec = PipelineSpec("p99", DownsampleStep("avg", wspec, "none", 0.0),
+                        rate=RateOptions())
+    gid = jnp.zeros(s, jnp.int64)
+    tail = run_grid_tail(spec, wts, v, m, gid, 1)
+    jax.block_until_ready(tail)
+    tail_secs = time.perf_counter() - t0 - secs
+    _emit(4, "rate+p99 over 500M pts (streamed grid + percentile tail)",
+          s * n_chunk * chunks, secs + max(tail_secs, 0), n_dev)
+
+
+def config5(scale: float, n_dev: int) -> None:
+    """1B pts -> 1m rollup lanes, time-chunked (write-side batch pass)."""
+    import jax
+    from opentsdb_tpu.ops.downsample import FixedWindows
+    from opentsdb_tpu.ops.streaming import StreamAccumulator
+
+    total = int(1_000_000_000 * scale)
+    s = 1024
+    n_chunk = 65_536
+    chunks = max(total // (s * n_chunk), 1)
+    gen = jax.jit(_chunk_gen, static_argnums=(0, 1))
+
+    t0 = time.perf_counter()
+    for k in range(chunks):
+        jax.block_until_ready(gen(s, n_chunk, k * n_chunk))
+    gen_time = time.perf_counter() - t0
+
+    # Each time chunk's 1m windows are disjoint from the next chunk's, so
+    # rollup rows (sum/count/min/max lanes) emit per chunk — the write-side
+    # shape of TSDB.addAggregatePoint (:1359-1457) batched per window.
+    span = n_chunk * STEP_MS
+
+    def one_chunk(k: int) -> None:
+        chunk_start = START + k * span
+        fixed = FixedWindows.for_range(chunk_start, chunk_start + span,
+                                       60_000)
+        wspec, wargs = fixed.split()
+        acc = StreamAccumulator.create(s, wspec, wargs)
+        acc.update(*gen(s, n_chunk, k * n_chunk))
+        lanes = [acc.finish(f) for f in ("sum", "count", "min", "max")]
+        jax.block_until_ready(lanes)
+
+    one_chunk(0)  # compile (same [s, n_chunk] shape for every chunk)
+    t0 = time.perf_counter()
+    for k in range(chunks):
+        one_chunk(k)
+    elapsed = max(time.perf_counter() - t0 - gen_time, 1e-9)
+    points = s * n_chunk * chunks
+    _emit(5, "1B pts -> 1m rollup lanes (time-chunked)", points, elapsed,
+          n_dev)
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=0,
+                    help="run one config (default: all)")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="shrink factor for smoke runs (e.g. 0.01)")
+    args = ap.parse_args()
+
+    import opentsdb_tpu.ops  # noqa: F401  (jax x64)
+    import jax
+    n_dev = len(jax.devices())
+    _note("devices: %d (%s)" % (n_dev, jax.devices()[0].platform))
+
+    targets = [args.config] if args.config else sorted(CONFIGS)
+    for c in targets:
+        _note("running config %d" % c)
+        CONFIGS[c](args.scale, n_dev)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Library-embedding sample: write datapoints through the TSDB facade.
+
+Counterpart of /root/reference/src/examples/AddDataExample.java — construct
+a TSDB from config, validate/write points for one metric with tags, flush,
+and shut down cleanly.
+
+Run:  python examples/add_data_example.py
+"""
+
+import random
+import time
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.utils.config import Config
+
+
+def main() -> None:
+    # Auto-create metrics so the example works on an empty store; a
+    # production embedder would pre-assign UIDs via `tsdb uid assign`.
+    tsdb = TSDB(Config({
+        "tsd.core.auto_create_metrics": True,
+        # Uncomment for durability (WAL + snapshots under this directory):
+        # "tsd.storage.directory": "/tmp/tsdb-example",
+    }))
+    # Background compaction/WAL upkeep, as the daemon runs it:
+    tsdb.start_maintenance()
+
+    metric = "my.tsdb.test.metric"
+    tags = {"script": "example", "host": "web01"}
+
+    now = int(time.time())
+    for i in range(100):
+        value = random.randint(0, 200)
+        tsdb.add_point(metric, now - (100 - i) * 30, value, tags)
+    print("wrote 100 points to", metric)
+
+    stats = tsdb.collect_stats()
+    print("datapoints added:", stats["tsd.datapoints.added"])
+    print("series:", stats["tsd.storage.series"])
+
+    tsdb.shutdown()
+
+
+if __name__ == "__main__":
+    main()

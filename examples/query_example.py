@@ -1,0 +1,55 @@
+"""Library-embedding sample: run a query through the planner.
+
+Counterpart of /root/reference/src/examples/QueryExample.java — build a
+TSQuery (the /api/query JSON model), execute it against the TSDB, and walk
+the aggregated results.
+
+Run:  python examples/query_example.py
+"""
+
+import random
+import time
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, TSSubQuery, parse_m_subquery
+from opentsdb_tpu.utils.config import Config
+
+
+def main() -> None:
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+
+    # Seed some data (see add_data_example.py).
+    metric = "my.tsdb.test.metric"
+    now = int(time.time())
+    for host in ("web01", "web02"):
+        for i in range(120):
+            tsdb.add_point(metric, now - 3600 + i * 30,
+                           random.randint(0, 200), {"host": host})
+
+    # Query form 1: the m-expression grammar used by the URI endpoint.
+    query = TSQuery(
+        start=str(now - 3600), end=str(now),
+        queries=[parse_m_subquery("sum:5m-avg:%s{host=*}" % metric)])
+    query.validate()
+
+    # Query form 2 (equivalent): explicit TSSubQuery fields, the JSON body
+    # shape of POST /api/query.
+    from opentsdb_tpu.query.filters import build_filter
+    explicit = TSSubQuery(aggregator="sum", metric=metric,
+                          downsample="5m-avg",
+                          filters=[build_filter("host", "wildcard", "*",
+                                                group_by=True)])
+    assert explicit.to_json()["metric"] == metric
+
+    for result in tsdb.new_query_runner().run(query):
+        print(result.metric, result.tags, "aggregated:",
+              result.aggregate_tags)
+        for ts_ms, value in result.dps[:5]:
+            print("  %d -> %s" % (ts_ms // 1000, value))
+        print("  ... %d datapoints total" % len(result.dps))
+
+    tsdb.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,8 @@ from opentsdb_tpu.utils.config import Config
 
 SECOND_MASK = 0xFFFFFFFF00000000  # Const.java:19 — set bits mean milliseconds
 
+_UNSET = object()  # lazily-built query_mesh sentinel
+
 
 def normalize_timestamp_ms(timestamp: int | float) -> int:
     """Seconds-or-milliseconds heuristic (TSDB.addPointInternal).
@@ -42,6 +44,9 @@ class TSDB:
 
     def __init__(self, config: Config | None = None):
         self.config = config or Config()
+        self._query_mesh = _UNSET
+        self._query_limits = None
+        self.maintenance = None
         self.metrics = UniqueId(
             UniqueIdType.METRIC,
             width=self.config.get_int("tsd.storage.uid.width.metric"),
@@ -383,6 +388,32 @@ class TSDB:
         from opentsdb_tpu.query.planner import QueryRunner
         return QueryRunner(self)
 
+    @property
+    def query_limits(self):
+        """Scan-budget registry (QueryLimitOverride.java), built lazily."""
+        if self._query_limits is None:
+            from opentsdb_tpu.query.limits import QueryLimitOverride
+            self._query_limits = QueryLimitOverride(self.config)
+        return self._query_limits
+
+    def query_mesh(self):
+        """The device mesh serving /api/query, or None when single-device.
+
+        Built lazily from every visible device — the TPU-native counterpart
+        of the salt-bucket scanner fan-out (SaltScanner.java:269): instead of
+        one concurrent HBase scanner per salt bucket, each chip owns a shard
+        of the query batch's rows.  Disable with tsd.query.mesh.enable.
+        """
+        if not self.config.get_bool("tsd.query.mesh.enable"):
+            return None
+        if self._query_mesh is _UNSET:
+            import jax
+            from opentsdb_tpu.parallel import make_mesh
+            devices = jax.devices()
+            self._query_mesh = (make_mesh(len(devices), devices=devices)
+                                if len(devices) > 1 else None)
+        return self._query_mesh
+
     # ------------------------------------------------------------------ #
     # UID admin (TSDB.assignUid :1901, renameUid :1968, suggest :1825)   #
     # ------------------------------------------------------------------ #
@@ -473,7 +504,7 @@ class TSDB:
 
     def collect_stats(self) -> dict[str, float]:
         now = time.time()
-        return {
+        out = {
             "tsd.uid.cache-hit metrics": self.metrics.cache_hits,
             "tsd.uid.cache-miss metrics": self.metrics.cache_misses,
             "tsd.uid.ids-used metrics": len(self.metrics),
@@ -488,8 +519,15 @@ class TSDB:
             "tsd.storage.datapoints": self.store.total_datapoints,
             "tsd.storage.bytes": self.store.total_bytes,
             "tsd.compaction.count": self.store.compaction_queue.compactions,
+            # Operator-visible duplicate-data failures (fix_duplicates off):
+            # surfaced here instead of only as the first reader's 400.
+            "tsd.compaction.errors": self.store.compaction_queue.errors,
+            "tsd.compaction.queue": len(self.store.compaction_queue),
             "tsd.uptime": now - self.start_time,
         }
+        if self.maintenance is not None:
+            out.update(self.maintenance.collect_stats())
+        return out
 
     @staticmethod
     def version() -> str:
@@ -513,7 +551,24 @@ class TSDB:
         with self._ingest_lock:
             self.persistence.snapshot()
 
+    def start_maintenance(self):
+        """Start the background maintenance thread (compaction flush + WAL
+        fsync + snapshot cadence; CompactionQueue.java:95-107).
+
+        Called by the daemon main; library embedders opt in explicitly so a
+        bare TSDB() stays thread-free (the reference's tests mock the
+        compaction thread out for the same reason).
+        """
+        if self.maintenance is None:
+            from opentsdb_tpu.core.maintenance import MaintenanceThread
+            self.maintenance = MaintenanceThread(self)
+            self.maintenance.start()
+        return self.maintenance
+
     def shutdown(self) -> None:
+        if self.maintenance is not None:
+            self.maintenance.stop(final_flush=False)
+            self.maintenance = None
         self.flush()
         if self.persistence is not None:
             with self._ingest_lock:

@@ -194,6 +194,13 @@ class Aggregator:
 
 
 def _make_registry() -> dict[str, Aggregator]:
+    """The static registry (Aggregators.java:175-203) — name-for-name parity.
+
+    MovingAverage (Aggregators.java:709) is deliberately NOT here: the
+    reference's static map omits it too (it is stateful and only
+    instantiated by the gexp expression layer, ExpressionFactory
+    "movingAverage"); ours lives in expression/gexp.py f_moving_average.
+    """
     reg = {
         "sum": Aggregator("sum", LERP, _sum),
         "pfsum": Aggregator("pfsum", PREV, _sum),

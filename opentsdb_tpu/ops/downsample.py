@@ -142,6 +142,79 @@ def window_timestamps(spec: WindowSpec, wargs: dict):
     raise ValueError("Unknown window kind: " + spec.kind)
 
 
+# Downsample functions served by the sorted prefix-sum fast path (additive
+# moments only; min/max and rank/order functions keep segment reductions).
+PREFIX_AGGS = frozenset(
+    {"sum", "zimsum", "pfsum", "count", "avg", "squareSum", "dev"})
+
+
+def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
+    """Edge timestamps e[W+1]; window w spans [e[w], e[w+1])."""
+    if spec.kind == "fixed":
+        return wargs["first"] + jnp.arange(
+            spec.count + 1, dtype=jnp.int64) * spec.interval_ms
+    if spec.kind == "edges":
+        return wargs["edges"]
+    if spec.kind == "all":
+        return jnp.stack([wargs["qstart"], wargs["qend"]])
+    raise ValueError("Unknown window kind: " + spec.kind)
+
+
+def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
+                       wargs: dict):
+    """Scatter-free windowed moments for sorted rows.
+
+    TPU scatters (`segment_sum`) serialize; for the additive-moment family
+    the batch layout contract (rows time-sorted, pads at int64 max) lets
+    window reductions run as exclusive prefix sums differenced at
+    binary-searched window edges — dense vector work the VPU streams
+    through.  Non-participating slots (masked or NaN) contribute zero to
+    every cumulative sum, so correctness needs only ts-sortedness.
+
+    Returns (out[S, W], count[S, W]).
+    """
+    s, n = ts.shape
+    w = spec.count
+    fdtype = val.dtype if jnp.issubdtype(val.dtype, jnp.floating) \
+        else jnp.float64
+    vf = val.astype(fdtype)
+    ok = mask & ~jnp.isnan(vf)
+    v0 = jnp.where(ok, vf, 0)
+
+    edges = window_edges(ts.dtype, spec, wargs)
+    idx = jax.vmap(lambda row: jnp.searchsorted(row, edges, side="left"))(ts)
+
+    def windowed(data):
+        csum = jnp.concatenate(
+            [jnp.zeros((s, 1), data.dtype), jnp.cumsum(data, axis=1)], axis=1)
+        at = jnp.take_along_axis(csum, idx, axis=1)
+        return at[:, 1:] - at[:, :-1]
+
+    count = windowed(ok.astype(jnp.int64))
+    if agg_name == "count":
+        return count.astype(fdtype), count
+    total = windowed(v0)
+    safe = jnp.maximum(count, 1)
+    if agg_name in ("sum", "zimsum", "pfsum"):
+        return total, count
+    if agg_name == "avg":
+        return total / safe, count
+    if agg_name == "squareSum":
+        return windowed(v0 * v0), count
+    if agg_name == "dev":
+        # Two-pass centered moment (matches the segment path's numerics):
+        # per-point window mean via the same edge-search, then one more
+        # prefix pass over the centered squares.
+        mean = total / safe
+        win = jnp.clip(window_ids(ts, spec, wargs), 0, w - 1)
+        mean_pp = jnp.take_along_axis(mean, win, axis=1)
+        centered = jnp.where(ok, vf - mean_pp, 0)
+        m2 = windowed(centered * centered)
+        return jnp.where(count >= 2,
+                         jnp.sqrt(m2 / jnp.maximum(count - 1, 1)), 0.0), count
+    raise KeyError("No prefix-sum path for: " + agg_name)
+
+
 def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
                fill_policy: str = FILL_NONE, fill_value: float = 0.0):
     """Downsample a [S, N] batch into (window_ts[W], values[S, W], mask[S, W]).
@@ -149,7 +222,25 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
     `agg_name` follows the runDouble contract (NaN inputs skipped); output is
     always float (Downsampler.java:257).  With FILL_NONE empty windows are
     masked out; other policies emit every live window with the fill applied.
+
+    Additive-moment functions take the sorted prefix-sum fast path (no
+    scatter — the hot loop the reference walked per interval,
+    Downsampler.java:292); the rest reduce via segment ops.
     """
+    if agg_name in PREFIX_AGGS:
+        w = spec.count
+        nwin = wargs["nwin"]
+        out, count_grid = _prefix_downsample(ts, val, mask, agg_name, spec,
+                                             wargs)
+        live = jnp.arange(w, dtype=jnp.int32)[None, :] < nwin
+        out_mask = (count_grid > 0) & live
+        wts = window_timestamps(spec, wargs)
+        fdtype = val.dtype if jnp.issubdtype(val.dtype, jnp.floating) \
+            else jnp.float64
+        out, out_mask = apply_fill(out, out_mask, live, fill_policy,
+                                   fill_value, fdtype)
+        return wts, out, out_mask
+
     s, n = ts.shape
     w = spec.count
     num = s * w + 1

@@ -116,6 +116,93 @@ def run_rollup_avg_pipeline(spec: PipelineSpec, ts_s, val_s, mask_s,
                               wargs or {})
 
 
+def _group_pipeline(spec: PipelineSpec, num_groups: int, ts, val, mask, gid,
+                    wargs):
+    """All-groups-at-once pipeline: one dispatch for any group count.
+
+    Replaces the per-group Python loop of round 1 (one jit call per group-by
+    bucket — 10k dispatches for a 10k-group query) with a single
+    gid-segmented device call: downsample and rate are row-local, the
+    cross-series reduce segments over (group, window) cells.
+    """
+    step = spec.downsample
+    wts, v, m = downsample(ts, val, mask, step.function, step.window_spec,
+                           wargs, step.fill_policy, step.fill_value)
+    return _grid_tail(spec, num_groups, wts, v, m, gid)
+
+
+def _grid_tail(spec: PipelineSpec, num_groups: int, wts, v, m, gid):
+    """Shared pipeline tail: (rate ->) grouped cross-series aggregation on
+    an already-downsampled [S, W] grid.  Also the finish stage of the
+    streaming executor (ops.streaming hands it the accumulated grid)."""
+    from opentsdb_tpu.ops.group_agg import grid_group_aggregate
+    agg = get_agg(spec.aggregator)
+    if spec.rate is not None:
+        agg = Aggregator(agg.name, PREV, agg.reduce)
+    grid = jnp.asarray(wts)
+    if spec.rate is not None:
+        grid_b = jnp.broadcast_to(grid[None, :], v.shape)
+        _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
+    return grid_group_aggregate(grid, v, m, gid, num_groups, agg)
+
+
+_jitted_group = jax.jit(_group_pipeline, static_argnums=(0, 1))
+_jitted_grid_tail = jax.jit(_grid_tail, static_argnums=(0, 1))
+
+
+def run_grid_tail(spec: PipelineSpec, wts, v, m, gid, num_groups: int):
+    """Finish a streamed query: grid [S, W] -> (wts, out[G, W], mask[G, W])."""
+    return _jitted_grid_tail(spec, num_groups, wts, v, m, gid)
+
+
+def run_group_pipeline(spec: PipelineSpec, ts, val, mask, gid,
+                       num_groups: int, wargs: dict | None = None):
+    """Execute the grouped pipeline -> (wts[W], out[G, W], out_mask[G, W]).
+
+    Requires a downsample step (the shared grid is what makes the segmented
+    cross-series reduce possible); union-timestamp queries keep the
+    per-group path.
+    """
+    if spec.downsample is None:
+        raise ValueError("grouped pipeline requires a downsample step")
+    return _jitted_group(spec, num_groups, ts, val, mask, gid, wargs or {})
+
+
+def _group_rollup_avg(spec: PipelineSpec, num_groups: int, ts_s, val_s,
+                      mask_s, ts_c, val_c, mask_c, gid, wargs):
+    """Grouped rollup-avg read: sum/count lane division, then the grid tail."""
+    from opentsdb_tpu.ops.group_agg import grid_group_aggregate
+    step = spec.downsample
+    wts, sums, msum = downsample(ts_s, val_s, mask_s, "sum", step.window_spec,
+                                 wargs, FILL_NONE)
+    _, cnts, mcnt = downsample(ts_c, val_c, mask_c, "sum", step.window_spec,
+                               wargs, FILL_NONE)
+    ok = msum & mcnt & (cnts > 0)
+    v = jnp.where(ok, sums / jnp.where(ok, cnts, 1.0), jnp.nan)
+    nwin = wargs["nwin"]
+    live = jnp.arange(v.shape[-1]) < nwin
+    v, m = apply_fill(v, ok, live[None, :], step.fill_policy,
+                      step.fill_value)
+    grid = jnp.asarray(wts)
+    agg = get_agg(spec.aggregator)
+    if spec.rate is not None:
+        agg = Aggregator(agg.name, PREV, agg.reduce)
+        grid_b = jnp.broadcast_to(grid[None, :], v.shape)
+        _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
+    return grid_group_aggregate(grid, v, m, gid, num_groups, agg)
+
+
+_jitted_group_rollup_avg = jax.jit(_group_rollup_avg, static_argnums=(0, 1))
+
+
+def run_group_rollup_avg_pipeline(spec: PipelineSpec, ts_s, val_s, mask_s,
+                                  ts_c, val_c, mask_c, gid, num_groups: int,
+                                  wargs: dict | None = None):
+    """Grouped rollup-avg pipeline -> (wts[W], out[G, W], out_mask[G, W])."""
+    return _jitted_group_rollup_avg(spec, num_groups, ts_s, val_s, mask_s,
+                                    ts_c, val_c, mask_c, gid, wargs or {})
+
+
 def build_batch(windows: list, pad_to_pow2: bool = True):
     """Pack per-series (ts, fval, ival, is_int) windows into padded arrays.
 

@@ -12,12 +12,19 @@ reductions, then combined over ICI with `psum`/`pmax`/`pmin` inside
 — window moments are associative over time, so time shards combine with the
 same collectives, no halo exchange needed.
 
-Aggregators with non-decomposable moments (percentiles/median/first/last/mult)
-fall back to the single-device path; a mergeable-sketch percentile is the
-planned round-2 extension (SURVEY.md §7 hard part (b)).
+The serving path (`sharded_query_pipeline`) runs the full /api/query
+numeric pipeline — per-series downsample + rate + interpolation, then the
+grouped cross-series reduce — with rows of the [S, N] batch spread over
+every chip of the mesh.  Moment-decomposable aggregators combine partial
+(count/sum/sumsq/min/max) moments over ICI; order/rank aggregators
+(percentiles/median/first/last/mult) gather the already-downsampled [S, W]
+grid to every chip and reduce replicated — gather-to-owner with W ≪ N, so
+the transfer is the reduced grid, never the raw points.
 """
 
 from __future__ import annotations
+
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +40,10 @@ from opentsdb_tpu.parallel.mesh import AXIS_SERIES, AXIS_TIME
 _BOTH = (AXIS_SERIES, AXIS_TIME)
 
 # Cross-chip aggregators expressible as psum/pmax/pmin-combinable moments.
+# Scopes sharded_group_downsample (the offline rollup pass, which only ever
+# needs moment lanes); the SERVING path (sharded_query_pipeline below)
+# covers every registry aggregator — percentiles/median/first/last/mult run
+# via gather-to-owner on the reduced grid.
 SHARDED_AGGS = frozenset({
     "sum", "zimsum", "count", "avg", "min", "mimmin", "max", "mimmax",
     "dev", "squareSum"})
@@ -193,6 +204,98 @@ def sharded_rollup(mesh: Mesh, spec: WindowSpec):
                    P(AXIS_SERIES)),
         check_vma=False)
     return jax.jit(mapped)
+
+
+@lru_cache(maxsize=128)
+def sharded_query_pipeline(mesh: Mesh, spec, num_groups: int):
+    """Build the jitted mesh-serving step for one /api/query pipeline.
+
+    fn(ts, val, mask, gid, wargs) with rows sharded over every chip
+    (dim 0 split across both mesh axes, time dim intact so downsample/rate
+    stay row-local); returns replicated (wts[W], out[G, W], out_mask[G, W])
+    identical to ops.pipeline.run_group_pipeline's single-device answer.
+
+    `spec` is a PipelineSpec (hashable) — the builder is lru_cached so a
+    dashboard re-issuing the same query shape reuses the compiled program.
+    """
+    from opentsdb_tpu.ops.aggregators import Aggregator, get_agg, PREV
+    from opentsdb_tpu.ops.downsample import downsample, apply_fill, FILL_NONE
+    from opentsdb_tpu.ops.group_agg import (
+        MOMENT_AGGS, grid_contributions, moment_group_reduce,
+        ordered_group_reduce)
+    from opentsdb_tpu.ops.rate import rate
+
+    agg = get_agg(spec.aggregator)
+    if spec.rate is not None:
+        agg = Aggregator(agg.name, PREV, agg.reduce)
+    step = spec.downsample
+    g = num_groups
+
+    def local(ts, val, mask, gid, wargs):
+        wts, v, m = downsample(ts, val, mask, step.function, step.window_spec,
+                               wargs, step.fill_policy, step.fill_value)
+        grid = jnp.asarray(wts)
+        if spec.rate is not None:
+            grid_b = jnp.broadcast_to(grid[None, :], v.shape)
+            _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
+        vf = v.astype(jnp.float64)
+        contrib, participate = grid_contributions(grid, vf, m, agg)
+        if agg.name in MOMENT_AGGS:
+            out, _ = moment_group_reduce(
+                agg.name, contrib, participate, gid, g,
+                combine_sum=lambda x: lax.psum(x, _BOTH),
+                combine_min=lambda x: lax.pmin(x, _BOTH),
+                combine_max=lambda x: lax.pmax(x, _BOTH))
+        else:
+            # Gather-to-owner on the reduced grid: every chip receives all
+            # rows (global row order preserved — first/last follow series
+            # order) and reduces replicated.
+            c_all = lax.all_gather(contrib, _BOTH, axis=0, tiled=True)
+            p_all = lax.all_gather(participate, _BOTH, axis=0, tiled=True)
+            g_all = lax.all_gather(gid, _BOTH, axis=0, tiled=True)
+            out, _ = ordered_group_reduce(agg.name, c_all, p_all, g_all, g)
+        w = v.shape[1]
+        cols = jnp.arange(w, dtype=jnp.int64)[None, :]
+        seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
+        present = jax.ops.segment_sum(m.reshape(-1).astype(jnp.int64), seg,
+                                      num_segments=g * w)
+        out_mask = lax.psum(present, _BOTH).reshape(g, w) > 0
+        return wts, out, out_mask
+
+    mapped = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_BOTH, None), P(_BOTH, None), P(_BOTH, None), P(_BOTH),
+                  P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
+               gid: np.ndarray):
+    """Pad the series axis to device-count multiple and device_put row-sharded.
+
+    The serving-path layout: dim 0 split over both mesh axes (each chip owns
+    a block of whole rows), time dim intact.  Padding rows have mask False /
+    gid 0 so they contribute nothing to any reduction.
+    """
+    n_dev = mesh.shape[AXIS_SERIES] * mesh.shape[AXIS_TIME]
+    s, n = ts.shape
+    s_pad = -(-s // n_dev) * n_dev
+    if s_pad != s:
+        pad_ts = np.full((s_pad, n), np.iinfo(np.int64).max, np.int64)
+        pad_val = np.zeros((s_pad, n), val.dtype)
+        pad_mask = np.zeros((s_pad, n), bool)
+        pad_gid = np.zeros(s_pad, gid.dtype)
+        pad_ts[:s] = ts
+        pad_val[:s] = val
+        pad_mask[:s] = mask
+        pad_gid[:s] = gid
+        ts, val, mask, gid = pad_ts, pad_val, pad_mask, pad_gid
+    row_sh = NamedSharding(mesh, P(_BOTH, None))
+    gid_sh = NamedSharding(mesh, P(_BOTH))
+    return (jax.device_put(ts, row_sh), jax.device_put(val, row_sh),
+            jax.device_put(mask, row_sh), jax.device_put(gid, gid_sh))
 
 
 def shard_series(mesh: Mesh, ts: np.ndarray, val: np.ndarray,

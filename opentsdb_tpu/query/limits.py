@@ -1,0 +1,173 @@
+"""Per-metric scan budgets and query timeout enforcement.
+
+Reference behavior: /root/reference/src/query/QueryLimitOverride.java —
+regex-keyed byte/datapoint budget overrides hot-reloaded from a JSON file
+(:44-52, loadFromFile), first match wins, defaults when nothing matches
+(getByteLimit :137, getDataPointLimit :157) — and the enforcement sites in
+SaltScanner.java: the running query fails with HTTP 413 when it exceeds the
+datapoint budget (:580), the byte budget (:596), or `tsd.query.timeout`
+(:559).
+
+The TPU rebuild enforces at the planner: budgets are charged as series
+windows are selected (before any device batch materializes — the whole
+point is refusing work that would OOM the host building the batch), and the
+deadline is checked between group/segment dispatches.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass
+
+
+class QueryException(Exception):
+    """Query failed mid-flight; carries the HTTP status (QueryException.java)."""
+
+    def __init__(self, message: str, status: int = 413):
+        super().__init__(message)
+        self.status = status
+
+
+# Charged per datapoint when estimating "bytes fetched from storage":
+# 8B timestamp + 8B value in the columnar chunks (the reference counted
+# HBase cell bytes; ours is the columnar at-rest cost).
+BYTES_PER_POINT = 16
+
+
+@dataclass
+class LimitOverrideItem:
+    """One override entry (QueryLimitOverrideItem :249-295)."""
+    regex: str
+    byte_limit: int = 0
+    data_points_limit: int = 0
+
+    def __post_init__(self):
+        self._pattern = re.compile(self.regex)
+
+    def matches(self, metric: str) -> bool:
+        return bool(self._pattern.search(metric))
+
+
+class QueryLimitOverride:
+    """Budget registry with file hot-reload (QueryLimitOverride.java:92-118).
+
+    The overrides file is a JSON array of
+    ``{"regex": ..., "byteLimit": N, "dataPointsLimit": N}`` objects
+    (Jackson's serialization of QueryLimitOverrideItem); camelCase and
+    snake_case keys are both accepted.  Reloaded at most every
+    ``tsd.query.limits.overrides.interval`` seconds, and only when the file
+    mtime changed.
+    """
+
+    def __init__(self, config):
+        self.default_byte_limit = config.get_int(
+            "tsd.query.limits.bytes.default")
+        self.default_data_points_limit = config.get_int(
+            "tsd.query.limits.data_points.default")
+        if self.default_byte_limit < 0:
+            raise ValueError("The default byte limit cannot be negative")
+        if self.default_data_points_limit < 0:
+            raise ValueError(
+                "The default data points limit cannot be negative")
+        self.file_location = config.get_string(
+            "tsd.query.limits.overrides.config")
+        self.reload_interval = config.get_int(
+            "tsd.query.limits.overrides.interval")
+        self.overrides: list[LimitOverrideItem] = []
+        self._mtime = 0.0
+        self._next_check = 0.0
+        if self.file_location:
+            self._load_from_file()
+
+    def _load_from_file(self) -> None:
+        try:
+            mtime = os.path.getmtime(self.file_location)
+        except OSError:
+            return
+        if mtime == self._mtime:
+            return
+        with open(self.file_location) as fh:
+            raw = json.load(fh)
+        items = []
+        for entry in raw:
+            items.append(LimitOverrideItem(
+                regex=entry["regex"],
+                byte_limit=int(entry.get("byteLimit",
+                                         entry.get("byte_limit", 0))),
+                data_points_limit=int(entry.get(
+                    "dataPointsLimit", entry.get("data_points_limit", 0)))))
+        self.overrides = items
+        self._mtime = mtime
+
+    def maybe_reload(self) -> None:
+        """Hot-reload check, rate-limited to the configured interval."""
+        if not self.file_location or self.reload_interval <= 0:
+            return
+        now = time.time()
+        if now < self._next_check:
+            return
+        self._next_check = now + self.reload_interval
+        try:
+            self._load_from_file()
+        except (OSError, ValueError, KeyError, re.error):
+            pass  # keep serving the last good config (loadFromFile catch)
+
+    def get_byte_limit(self, metric: str) -> int:
+        if metric:
+            for item in self.overrides:
+                if item.matches(metric):
+                    return item.byte_limit
+        return self.default_byte_limit
+
+    def get_data_points_limit(self, metric: str) -> int:
+        if metric:
+            for item in self.overrides:
+                if item.matches(metric):
+                    return item.data_points_limit
+        return self.default_data_points_limit
+
+
+class QueryBudget:
+    """Running charge for one sub query (the SaltScanner counters).
+
+    Raises QueryException with the reference's 413 error shape when the
+    datapoint budget (:580), byte budget (:596), or wall-clock deadline
+    (:559) is exceeded.
+    """
+
+    def __init__(self, limits: QueryLimitOverride | None, metric: str,
+                 timeout_ms: int):
+        self.max_data_points = (
+            limits.get_data_points_limit(metric) if limits else 0)
+        self.max_bytes = limits.get_byte_limit(metric) if limits else 0
+        self.timeout_ms = timeout_ms
+        self.start = time.monotonic()
+        self.data_points = 0
+
+    def charge(self, num_points: int) -> None:
+        self.data_points += num_points
+        if 0 < self.max_data_points <= self.data_points:
+            raise QueryException(
+                "Sorry, you have attempted to fetch more than our limit of "
+                "%d data points. Please try filtering using more tags or "
+                "decrease your time range." % self.max_data_points)
+        if self.max_bytes > 0 and \
+                self.data_points * BYTES_PER_POINT > self.max_bytes:
+            raise QueryException(
+                "Sorry, you have attempted to fetch more than our maximum "
+                "amount of %dMB from storage. Please try filtering using "
+                "more tags or decrease your time range."
+                % (self.max_bytes / 1024 / 1024))
+
+    def check_deadline(self) -> None:
+        if self.timeout_ms <= 0:
+            return
+        elapsed_ms = (time.monotonic() - self.start) * 1000.0
+        if elapsed_ms > self.timeout_ms:
+            raise QueryException(
+                "Sorry, your query timed out. Time limit: %d ms, elapsed: "
+                "%d ms. Please try filtering using more tags or decrease "
+                "your time range." % (self.timeout_ms, elapsed_ms))

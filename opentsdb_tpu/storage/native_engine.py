@@ -1,0 +1,198 @@
+"""ctypes binding for the native columnar chunk engine (native/engine.cpp).
+
+The C++ engine plays the at-rest role HBase's block encoding + compaction
+played for the reference (CompactionQueue.java:40-56 — pack cells so the
+per-cell overhead amortizes): per-series sealed chunks hold
+delta-of-delta/zig-zag varint timestamps and Gorilla-style XOR'd values,
+with an is-int bitmap preserving Java-long exactness.
+
+The Python hot path stays columnar numpy/JAX; the engine serves as the
+compressed binary snapshot codec (storage/persist.py) — orders of magnitude
+denser than the JSONL/npz round 1 shipped and loaded with one C pass.  The
+shared library builds from source on first use (``make -C native``); every
+entry point degrades to the pure-Python path when the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+LOG = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(
+        __file__)))), "native")
+_LIB_NAME = "libtsdb_engine.so"
+
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+
+_I64 = ctypes.c_int64
+_I32 = ctypes.c_int32
+_F64 = ctypes.c_double
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F64P = ctypes.POINTER(ctypes.c_double)
+
+
+def _configure(lib) -> None:
+    lib.eng_create.restype = ctypes.c_void_p
+    lib.eng_destroy.argtypes = [ctypes.c_void_p]
+    lib.eng_series.argtypes = [ctypes.c_void_p, ctypes.c_char_p, _I32]
+    lib.eng_series.restype = _I64
+    lib.eng_num_series.argtypes = [ctypes.c_void_p]
+    lib.eng_num_series.restype = _I32
+    lib.eng_series_key.argtypes = [ctypes.c_void_p, _I64, _U8P, _I32]
+    lib.eng_series_key.restype = _I32
+    lib.eng_append_batch.argtypes = [
+        ctypes.c_void_p, _I64, _I64P, _F64P, _I64P, _U8P, _I64]
+    lib.eng_series_len.argtypes = [ctypes.c_void_p, _I64]
+    lib.eng_series_len.restype = _I64
+    lib.eng_series_bytes.argtypes = [ctypes.c_void_p, _I64]
+    lib.eng_series_bytes.restype = _I64
+    lib.eng_window.argtypes = [ctypes.c_void_p, _I64, _I64, _I64,
+                               _I64P, _F64P, _I64P, _U8P, _I64]
+    lib.eng_window.restype = _I64
+    lib.eng_delete_range.argtypes = [ctypes.c_void_p, _I64, _I64, _I64]
+    lib.eng_delete_range.restype = _I64
+    lib.eng_normalize.argtypes = [ctypes.c_void_p, _I64]
+    lib.eng_total_bytes.argtypes = [ctypes.c_void_p]
+    lib.eng_total_bytes.restype = _I64
+    lib.eng_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.eng_save.restype = _I32
+    lib.eng_load.argtypes = [ctypes.c_char_p]
+    lib.eng_load.restype = ctypes.c_void_p
+
+
+def _load_library():
+    """Load (building if needed) the shared library; None on failure."""
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        path = os.environ.get("TSDB_NATIVE_LIB") or os.path.join(
+            _NATIVE_DIR, _LIB_NAME)
+        if not os.path.exists(path) and path.startswith(_NATIVE_DIR):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               capture_output=True, timeout=120, check=True)
+            except (OSError, subprocess.SubprocessError) as e:
+                LOG.warning("native engine build failed (%s); falling back "
+                            "to the pure-Python snapshot codec", e)
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+            _configure(lib)
+            _lib = lib
+        except OSError as e:
+            LOG.warning("native engine unavailable (%s)", e)
+        return _lib
+
+
+def available() -> bool:
+    return _load_library() is not None
+
+
+class NativeEngine:
+    """One engine instance: keyed compressed series + binary save/load."""
+
+    def __init__(self, handle=None):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native engine library unavailable")
+        self._lib = lib
+        self._handle = handle if handle is not None else lib.eng_create()
+
+    @classmethod
+    def load(cls, path: str) -> "NativeEngine":
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native engine library unavailable")
+        handle = lib.eng_load(path.encode())
+        if not handle:
+            raise IOError("cannot load native snapshot: " + path)
+        return cls(handle=handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.eng_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -------------------------------------------------------------- #
+
+    def series(self, key: bytes) -> int:
+        """Stable id for a series key (created on first use)."""
+        return self._lib.eng_series(self._handle, key, len(key))
+
+    def num_series(self) -> int:
+        return self._lib.eng_num_series(self._handle)
+
+    def series_key(self, sid: int) -> bytes:
+        n = self._lib.eng_series_key(
+            self._handle, sid, ctypes.cast(ctypes.create_string_buffer(0),
+                                           _U8P), 0)
+        buf = ctypes.create_string_buffer(n)
+        self._lib.eng_series_key(self._handle, sid,
+                                 ctypes.cast(buf, _U8P), n)
+        return buf.raw[:n]
+
+    def append_batch(self, sid: int, ts: np.ndarray, fval: np.ndarray,
+                     ival: np.ndarray, is_int: np.ndarray) -> None:
+        n = len(ts)
+        if n == 0:
+            return
+        ts = np.ascontiguousarray(ts, np.int64)
+        fval = np.ascontiguousarray(fval, np.float64)
+        ival = np.ascontiguousarray(ival, np.int64)
+        is_int = np.ascontiguousarray(is_int, np.uint8)
+        self._lib.eng_append_batch(
+            self._handle, sid,
+            ts.ctypes.data_as(_I64P), fval.ctypes.data_as(_F64P),
+            ival.ctypes.data_as(_I64P), is_int.ctypes.data_as(_U8P), n)
+
+    def series_len(self, sid: int) -> int:
+        return self._lib.eng_series_len(self._handle, sid)
+
+    def series_bytes(self, sid: int) -> int:
+        return self._lib.eng_series_bytes(self._handle, sid)
+
+    def total_bytes(self) -> int:
+        return self._lib.eng_total_bytes(self._handle)
+
+    def window(self, sid: int, start: int = -(1 << 62),
+               end: int = 1 << 62):
+        """Materialize [start, end] -> (ts, fval, ival, is_int) arrays."""
+        cap = self.series_len(sid)
+        ts = np.empty(cap, np.int64)
+        fval = np.empty(cap, np.float64)
+        ival = np.empty(cap, np.int64)
+        is_int = np.empty(cap, np.uint8)
+        n = self._lib.eng_window(
+            self._handle, sid, start, end,
+            ts.ctypes.data_as(_I64P), fval.ctypes.data_as(_F64P),
+            ival.ctypes.data_as(_I64P), is_int.ctypes.data_as(_U8P), cap)
+        return (ts[:n], fval[:n], ival[:n], is_int[:n].astype(bool))
+
+    def delete_range(self, sid: int, start: int, end: int) -> int:
+        return self._lib.eng_delete_range(self._handle, sid, start, end)
+
+    def normalize(self, sid: int) -> None:
+        self._lib.eng_normalize(self._handle, sid)
+
+    def save(self, path: str) -> None:
+        if self._lib.eng_save(self._handle, path.encode()) != 0:
+            raise IOError("cannot write native snapshot: " + path)

@@ -65,6 +65,11 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(levelname)s [%(threadName)s] "
                "%(name)s: %(message)s")
     tsdb = make_tsdb_from_args(args)
+    if tsdb.config.enable_compactions:
+        # The compaction-thread analog (CompactionQueue.java:95-107): dirty
+        # series normalize off the read path, WAL fsync + snapshots follow
+        # their configured cadences.
+        tsdb.start_maintenance()
     port_cfg = tsdb.config.get_string("tsd.network.port")
     if not port_cfg:
         print("Missing network port (--port or tsd.network.port)",

@@ -216,9 +216,14 @@ class HttpQuery:
 
 def error_status(exc: Exception) -> int:
     """HTTP status for an exception: name-lookup misses are 404, user input
-    errors 400 (KeyError from malformed bodies included), the rest 500."""
+    errors 400 (KeyError from malformed bodies included), budget/timeout
+    rejections carry their own status (413, SaltScanner.java:564-601), the
+    rest 500."""
+    from opentsdb_tpu.query.limits import QueryException
     from opentsdb_tpu.uid import NoSuchUniqueName, NoSuchUniqueId
     if isinstance(exc, BadRequestError):
+        return exc.status
+    if isinstance(exc, QueryException):
         return exc.status
     if isinstance(exc, (NoSuchUniqueName, NoSuchUniqueId)):
         return 404

@@ -215,17 +215,26 @@ class TSDServer:
         loop = asyncio.get_running_loop()
         buffer = first
         while True:
-            head = parse_http_head(buffer)
-            while head is None:
-                chunk = await asyncio.wait_for(reader.read(65536),
-                                               timeout=self.idle_timeout)
-                if not chunk:
-                    return
-                buffer += chunk
-                if len(buffer) > MAX_REQUEST_BYTES:
-                    writer.write(HttpResponse(status=413).to_bytes(False))
-                    return
+            try:
                 head = parse_http_head(buffer)
+                while head is None:
+                    chunk = await asyncio.wait_for(reader.read(65536),
+                                                   timeout=self.idle_timeout)
+                    if not chunk:
+                        return
+                    buffer += chunk
+                    if len(buffer) > MAX_REQUEST_BYTES:
+                        writer.write(HttpResponse(status=413).to_bytes(False))
+                        return
+                    head = parse_http_head(buffer)
+            except BadRequestError as e:
+                # Malformed request line/headers answer 400 before closing
+                # instead of a bare socket reset (ADVICE round-1).
+                writer.write(HttpResponse(
+                    status=e.status,
+                    body=e.message.encode()).to_bytes(False))
+                await writer.drain()
+                return
             request, offset = head
             length = int(request.headers.get("content-length", "0") or 0)
             if length > MAX_REQUEST_BYTES:

@@ -58,6 +58,17 @@ DEFAULTS: dict[str, str] = {
     "tsd.query.limits.data_points.allow_override": "false",
     "tsd.query.limits.overrides.config": "",
     "tsd.query.limits.overrides.interval": "60000",
+    # TPU-native: /api/query mesh serving (the salt-scanner fan-out analog).
+    # min_series gates the mesh to batches wide enough to amortize the
+    # collective latency; below it the single-dispatch grouped path serves.
+    "tsd.query.mesh.enable": "true",
+    "tsd.query.mesh.min_series": "8",
+    # TPU-native: streaming (chunked) execution for beyond-memory queries.
+    # Queries selecting more than point_threshold datapoints stream through
+    # the device in chunk_points-sized slices instead of materializing one
+    # [S, N] batch in host memory (SaltScanner's overlapped-scan analog).
+    "tsd.query.streaming.point_threshold": "8000000",
+    "tsd.query.streaming.chunk_points": "4000000",
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",
@@ -93,6 +104,12 @@ DEFAULTS: dict[str, str] = {
     "tsd.storage.compaction.min_flush_threshold": "100",
     "tsd.storage.compaction.max_concurrent_flushes": "10000",
     "tsd.storage.compaction.flush_speed": "2",
+    # TPU-native durability cadences (maintenance thread; 0 = disabled).
+    "tsd.storage.wal_sync_interval": "0",
+    "tsd.storage.snapshot_interval": "0",
+    # Compressed binary snapshots via the native chunk engine (native/);
+    # falls back to npz automatically when the library can't build.
+    "tsd.storage.native_snapshot": "true",
     "tsd.storage.salt.width": "0",
     "tsd.storage.salt.buckets": "20",
     "tsd.storage.uid.width.metric": "3",

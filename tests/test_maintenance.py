@@ -1,0 +1,127 @@
+"""Background maintenance thread (CompactionQueue.java:95-165 analog).
+
+VERDICT round-1 missing #5 / ADVICE lows: dirty series must normalize
+without a read, duplicate-policy errors must surface in an operator
+counter, and the WAL/snapshot cadences must run off the request path.
+"""
+
+import time
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.core.maintenance import MaintenanceThread
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+def _tsdb(**over) -> TSDB:
+    cfg = {"tsd.core.auto_create_metrics": True}
+    cfg.update(over)
+    return TSDB(Config(cfg))
+
+
+def _make_dirty(tsdb, n=5):
+    """Ingest out-of-order points so series land on the compaction queue."""
+    for i in range(n):
+        tags = {"host": "w%d" % i}
+        tsdb.add_point("sys.dirty", BASE + 100, 1, tags)
+        tsdb.add_point("sys.dirty", BASE + 50, 2, tags)   # out of order
+    return tsdb
+
+
+class TestPasses:
+    """Direct passes with fabricated clocks — no sleeping."""
+
+    def test_flush_normalizes_without_read(self):
+        tsdb = _make_dirty(_tsdb())
+        queue = tsdb.store.compaction_queue
+        assert len(queue) > 0
+        mt = MaintenanceThread(tsdb)
+        mt._maybe_flush(mt._next_flush + 1)
+        assert len(queue) == 0
+        assert queue.compactions >= 5
+        for series in tsdb.store.all_series():
+            assert not series.dirty
+
+    def test_backlog_triggers_early_flush(self):
+        tsdb = _make_dirty(_tsdb(**{
+            "tsd.storage.compaction.min_flush_threshold": "3"}))
+        mt = MaintenanceThread(tsdb)
+        # Before the interval elapses, a backlog >= threshold still flushes.
+        mt._maybe_flush(0.0)
+        assert len(tsdb.store.compaction_queue) == 0
+
+    def test_small_backlog_waits_for_interval(self):
+        tsdb = _make_dirty(_tsdb(**{
+            "tsd.storage.compaction.min_flush_threshold": "100"}))
+        mt = MaintenanceThread(tsdb)
+        mt._maybe_flush(0.0)
+        assert len(tsdb.store.compaction_queue) > 0
+
+    def test_duplicate_error_counter(self):
+        tsdb = _tsdb(**{"tsd.storage.fix_duplicates": False})
+        tsdb.add_point("sys.dup", BASE + 10, 1, {"h": "a"})
+        tsdb.add_point("sys.dup", BASE + 5, 2, {"h": "a"})
+        tsdb.add_point("sys.dup", BASE + 5, 3, {"h": "a"})  # duplicate ts
+        mt = MaintenanceThread(tsdb)
+        mt._maybe_flush(mt._next_flush + 1)
+        stats = tsdb.collect_stats()
+        assert stats["tsd.compaction.errors"] >= 1
+
+    def test_wal_sync_and_snapshot(self, tmp_path):
+        tsdb = _tsdb(**{
+            "tsd.storage.directory": str(tmp_path),
+            "tsd.storage.wal_sync_interval": "1",
+            "tsd.storage.snapshot_interval": "1"})
+        tsdb.add_point("sys.cpu", BASE, 1, {"h": "a"})
+        mt = MaintenanceThread(tsdb)
+        mt._maybe_sync_wal(mt._next_sync + 1)
+        assert mt.wal_syncs == 1
+        mt._maybe_snapshot(mt._next_snapshot + 1)
+        assert mt.snapshots == 1
+        assert (tmp_path / "manifest.json").exists() or any(
+            p.suffix == ".json" for p in tmp_path.iterdir())
+
+    def test_stats_exposed(self):
+        tsdb = _tsdb()
+        tsdb.start_maintenance()
+        try:
+            stats = tsdb.collect_stats()
+            assert "tsd.maintenance.flush_passes" in stats
+            assert "tsd.compaction.queue" in stats
+        finally:
+            tsdb.shutdown()
+
+
+class TestThread:
+    def test_thread_flushes_in_background(self):
+        tsdb = _make_dirty(_tsdb(**{
+            "tsd.storage.compaction.flush_interval": "0"}))
+        mt = MaintenanceThread(tsdb)
+        mt.TICK_SECONDS = 0.02
+        mt.start()
+        try:
+            deadline = time.time() + 5.0
+            while len(tsdb.store.compaction_queue) and time.time() < deadline:
+                time.sleep(0.02)
+            assert len(tsdb.store.compaction_queue) == 0
+        finally:
+            mt.stop()
+
+    def test_stop_idempotent_and_final_flush(self):
+        tsdb = _make_dirty(_tsdb())
+        mt = MaintenanceThread(tsdb)
+        mt.start()
+        mt.stop()
+        mt.stop()
+        assert len(tsdb.store.compaction_queue) == 0
+
+    def test_shutdown_stops_thread(self):
+        tsdb = _tsdb()
+        mt = tsdb.start_maintenance()
+        assert mt.is_alive()
+        tsdb.shutdown()
+        assert not mt.is_alive()
+        assert tsdb.maintenance is None

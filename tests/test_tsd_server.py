@@ -142,3 +142,24 @@ class TestIntegration:
     def test_telnet_bad_put_reports(self, server):
         out = telnet(server, "put only.metric")
         assert "put:" in out
+
+
+class TestMalformedHttp:
+    def test_bad_request_line_gets_400(self, server):
+        """A malformed HTTP head answers 400 before close (ADVICE r1),
+        not a bare socket reset."""
+        with socket.create_connection(("127.0.0.1", server.test_port),
+                                      timeout=10) as s:
+            s.sendall(b"GET /incomplete-request-line\r\n\r\n")
+            s.settimeout(3.0)
+            out = b""
+            try:
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    out += chunk
+            except socket.timeout:
+                pass
+        assert out.startswith(b"HTTP/1.1 400")
+        assert b"Malformed request line" in out

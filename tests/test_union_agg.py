@@ -142,3 +142,21 @@ class TestGridFastPath:
             np.testing.assert_allclose(
                 [v for _, v in got], [v for _, v in want], rtol=1e-12,
                 err_msg=agg)
+
+
+class TestRegistryParity:
+    """Name-for-name parity with the reference's static aggregator map
+    (Aggregators.java:175-203 + the 18 percentile variants)."""
+
+    REFERENCE_SET = {
+        "sum", "min", "max", "avg", "none", "median", "mult", "dev",
+        "diff", "count", "zimsum", "mimmin", "mimmax", "first", "last",
+        "pfsum", "squareSum",
+        "p999", "p99", "p95", "p90", "p75", "p50",
+        "ep999r3", "ep99r3", "ep95r3", "ep90r3", "ep75r3", "ep50r3",
+        "ep999r7", "ep99r7", "ep95r7", "ep90r7", "ep75r7", "ep50r7",
+    }
+
+    def test_registry_matches_reference(self):
+        from opentsdb_tpu.ops.aggregators import agg_names
+        assert set(agg_names()) == self.REFERENCE_SET
