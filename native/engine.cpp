@@ -201,8 +201,11 @@ struct Series {
         tail.shrink_to_fit();
     }
 
-    // full materialization: decompress + sort + dedup (last wins)
-    void materialize(std::vector<Point>& out) {
+    // full materialization: decompress + sort + dedup (last wins).
+    // dedup=false keeps duplicate timestamps (stable order, so the last
+    // write for a timestamp stays last) — used by snapshot restore so a
+    // dirty series round-trips as dirty instead of being silently healed.
+    void materialize(std::vector<Point>& out, bool dedup = true) {
         out.clear();
         for (const auto& c : chunks) c.decompress(out);
         out.insert(out.end(), tail.begin(), tail.end());
@@ -213,7 +216,7 @@ struct Series {
                              });
         }
         // last-write-wins dedup
-        if (!out.empty()) {
+        if (dedup && !out.empty()) {
             size_t w = 0;
             for (size_t r = 1; r < out.size(); r++) {
                 if (out[r].ts == out[w].ts) {
@@ -346,6 +349,26 @@ EXPORT int64_t eng_window(void* h, int64_t sid, int64_t start, int64_t end,
         [](int64_t v, const Point& p) { return v < p.ts; });
     int64_t n = 0;
     for (auto it = lo; it != hi && n < max_n; ++it, ++n) {
+        out_ts[n] = it->ts;
+        out_val[n] = it->fval;
+        out_ival[n] = it->ival;
+        out_isint[n] = it->is_int;
+    }
+    return n;
+}
+
+// Like eng_window over the full range, but duplicates survive (snapshot
+// restore fidelity: a series persisted dirty must restore dirty).
+EXPORT int64_t eng_window_raw(void* h, int64_t sid, int64_t* out_ts,
+                              double* out_val, int64_t* out_ival,
+                              uint8_t* out_isint, int64_t max_n) {
+    Engine* eng = static_cast<Engine*>(h);
+    Series* s = eng->series[sid];
+    std::lock_guard<std::mutex> lock(s->mu);
+    s->materialize(g_scratch, /*dedup=*/false);
+    int64_t n = 0;
+    for (auto it = g_scratch.begin(); it != g_scratch.end() && n < max_n;
+         ++it, ++n) {
         out_ts[n] = it->ts;
         out_val[n] = it->fval;
         out_ival[n] = it->ival;

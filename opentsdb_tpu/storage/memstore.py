@@ -168,8 +168,11 @@ class Series:
         self._val[:n] = self._val[:n][order]
         self._ival[:n] = self._ival[:n][order]
         self._isint[:n] = self._isint[:n][order]
-        self._sorted = True
+        # Dedup BEFORE declaring the series clean: with fix_duplicates off
+        # _dedup_sorted raises, and the series must stay dirty so later reads
+        # keep raising and fsck can still see + repair the duplicate.
         self._dedup_sorted(fix_duplicates)
+        self._sorted = True
 
     def _dedup_sorted(self, fix_duplicates: bool) -> None:
         n = self._n

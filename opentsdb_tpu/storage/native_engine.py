@@ -60,6 +60,9 @@ def _configure(lib) -> None:
     lib.eng_window.argtypes = [ctypes.c_void_p, _I64, _I64, _I64,
                                _I64P, _F64P, _I64P, _U8P, _I64]
     lib.eng_window.restype = _I64
+    lib.eng_window_raw.argtypes = [ctypes.c_void_p, _I64,
+                                   _I64P, _F64P, _I64P, _U8P, _I64]
+    lib.eng_window_raw.restype = _I64
     lib.eng_delete_range.argtypes = [ctypes.c_void_p, _I64, _I64, _I64]
     lib.eng_delete_range.restype = _I64
     lib.eng_normalize.argtypes = [ctypes.c_void_p, _I64]
@@ -80,19 +83,28 @@ def _load_library():
         _load_attempted = True
         path = os.environ.get("TSDB_NATIVE_LIB") or os.path.join(
             _NATIVE_DIR, _LIB_NAME)
-        if not os.path.exists(path) and path.startswith(_NATIVE_DIR):
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR],
-                               capture_output=True, timeout=120, check=True)
-            except (OSError, subprocess.SubprocessError) as e:
-                LOG.warning("native engine build failed (%s); falling back "
-                            "to the pure-Python snapshot codec", e)
-                return None
+        if path.startswith(_NATIVE_DIR):
+            src = os.path.join(_NATIVE_DIR, "engine.cpp")
+            stale = (not os.path.exists(path)
+                     or (os.path.exists(src)
+                         and os.path.getmtime(src) > os.path.getmtime(path)))
+            if stale:
+                try:
+                    subprocess.run(["make", "-C", _NATIVE_DIR, "-B"],
+                                   capture_output=True, timeout=120,
+                                   check=True)
+                except (OSError, subprocess.SubprocessError) as e:
+                    LOG.warning("native engine build failed (%s); falling "
+                                "back to the pure-Python snapshot codec", e)
+                    if not os.path.exists(path):
+                        return None
         try:
             lib = ctypes.CDLL(path)
             _configure(lib)
             _lib = lib
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale prebuilt .so missing a newer export —
+            # degrade to the pure-Python codec rather than crash.
             LOG.warning("native engine unavailable (%s)", e)
         return _lib
 
@@ -173,19 +185,32 @@ class NativeEngine:
     def total_bytes(self) -> int:
         return self._lib.eng_total_bytes(self._handle)
 
-    def window(self, sid: int, start: int = -(1 << 62),
-               end: int = 1 << 62):
-        """Materialize [start, end] -> (ts, fval, ival, is_int) arrays."""
+    def _materialize(self, fn, sid: int, *mid_args):
+        """Shared column-buffer marshalling for the window reads."""
         cap = self.series_len(sid)
         ts = np.empty(cap, np.int64)
         fval = np.empty(cap, np.float64)
         ival = np.empty(cap, np.int64)
         is_int = np.empty(cap, np.uint8)
-        n = self._lib.eng_window(
-            self._handle, sid, start, end,
-            ts.ctypes.data_as(_I64P), fval.ctypes.data_as(_F64P),
-            ival.ctypes.data_as(_I64P), is_int.ctypes.data_as(_U8P), cap)
+        n = fn(self._handle, sid, *mid_args,
+               ts.ctypes.data_as(_I64P), fval.ctypes.data_as(_F64P),
+               ival.ctypes.data_as(_I64P), is_int.ctypes.data_as(_U8P), cap)
         return (ts[:n], fval[:n], ival[:n], is_int[:n].astype(bool))
+
+    def window(self, sid: int, start: int = -(1 << 62),
+               end: int = 1 << 62):
+        """Materialize [start, end] -> (ts, fval, ival, is_int) arrays."""
+        return self._materialize(self._lib.eng_window, sid, start, end)
+
+    def window_raw(self, sid: int):
+        """Full materialization with duplicate timestamps preserved.
+
+        Snapshot-restore path: a series persisted with unresolved duplicate
+        timestamps (tsd.storage.fix_duplicates=false) must restore dirty so
+        reads keep raising and fsck can repair it — eng_window's
+        last-write-wins dedup would silently heal it.
+        """
+        return self._materialize(self._lib.eng_window_raw, sid)
 
     def delete_range(self, sid: int, start: int, end: int) -> int:
         return self._lib.eng_delete_range(self._handle, sid, start, end)

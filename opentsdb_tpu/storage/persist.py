@@ -287,7 +287,9 @@ class DiskPersistence:
         with NativeEngine.load(self._series_bin_path()) as eng:
             for sid in range(eng.num_series()):
                 ident = json.loads(eng.series_key(sid))
-                ts, fval, ival, isint = eng.window(sid)
+                # raw read: unresolved duplicates must survive the
+                # round-trip so the series restores dirty (fsck repairs)
+                ts, fval, ival, isint = eng.window_raw(sid)
                 key = SeriesKey(ident["m"],
                                 tuple(tuple(t) for t in ident["t"]))
                 lane_key = ident.get("l")

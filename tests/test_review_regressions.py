@@ -104,3 +104,77 @@ class TestLiteralUidPruning:
                     queries=[parse_m_subquery("sum:m{host=zzz}")])
         q.validate()
         assert tsdb.new_query_runner().run(q) == []
+
+
+class TestNormalizeFailureStaysDirty:
+    """VERDICT r2 #3: a failed dedup (fix_duplicates=false) must leave the
+    series dirty — reads keep raising, fsck can still see and repair the
+    duplicate.  Previously _normalize_locked set _sorted=True before the
+    dedup raised, permanently hiding the duplicate (silent double-count)."""
+
+    def _dup_series(self):
+        from opentsdb_tpu.storage.memstore import Series, SeriesKey
+        s = Series(SeriesKey.make(1, {1: 1}))
+        s.append(1000, 1.0, True)
+        s.append(1000, 2.0, True)
+        return s
+
+    def test_failed_normalize_leaves_dirty_and_reads_keep_raising(self):
+        s = self._dup_series()
+        assert s.dirty
+        with pytest.raises(ValueError):
+            s.normalize(fix_duplicates=False)
+        assert s.dirty, "failed dedup must not mark the series clean"
+        # reads surface the error, as documented, on every attempt
+        with pytest.raises(ValueError):
+            s.window(0, 10_000, fix_duplicates=False)
+        with pytest.raises(ValueError):
+            s.window(0, 10_000, fix_duplicates=False)
+
+    def test_fsck_repairs_after_failed_flush(self):
+        s = self._dup_series()
+        with pytest.raises(ValueError):
+            s.normalize(fix_duplicates=False)
+        # fsck path: normalize(fix_duplicates=True) resolves last-write-wins
+        s.normalize(fix_duplicates=True)
+        assert not s.dirty
+        ts, val, _, _ = s.window(0, 10_000, fix_duplicates=False)
+        assert list(ts) == [1000]
+        assert list(val) == [2.0]
+
+    def test_compaction_flush_failure_then_repair(self):
+        from opentsdb_tpu.storage.memstore import CompactionQueue
+        s = self._dup_series()
+        q = CompactionQueue(fix_duplicates=False)
+        q.add(s)
+        q.flush()
+        assert q.errors == 1
+        assert s.dirty
+        s.normalize(fix_duplicates=True)
+        ts, val, _, _ = s.window(0, 10_000, fix_duplicates=False)
+        assert list(zip(ts, val)) == [(1000, 2.0)]
+
+
+class TestNativeSnapshotDirtyRoundTrip:
+    """A series persisted with unresolved duplicates must restore dirty:
+    eng_window's last-write-wins dedup silently healed it (and hid it from
+    fsck); the restore path must use the raw (dup-preserving) read."""
+
+    def test_window_raw_preserves_duplicates(self):
+        from opentsdb_tpu.storage import native_engine
+        if not native_engine.available():
+            pytest.skip("native engine unavailable")
+        with native_engine.NativeEngine() as eng:
+            sid = eng.series(b"k")
+            eng.append_batch(
+                sid, np.array([1000, 1000, 2000], np.int64),
+                np.array([1.0, 2.0, 3.0]), np.array([1, 2, 3], np.int64),
+                np.array([1, 1, 1], np.uint8))
+            ts, fval, _, _ = eng.window_raw(sid)
+            assert list(ts) == [1000, 1000, 2000]
+            # stable: the later write for ts=1000 stays last
+            assert list(fval) == [1.0, 2.0, 3.0]
+            # the dedup'd view still resolves last-write-wins
+            ts2, fval2, _, _ = eng.window(sid)
+            assert list(ts2) == [1000, 2000]
+            assert list(fval2) == [2.0, 3.0]
