@@ -1,35 +1,54 @@
-"""Headline benchmark: PRODUCTION query pipeline throughput.
+"""Headline benchmark: PRODUCTION query pipeline throughput — honest edition.
 
 Measures the BASELINE.json primary metric — datapoints aggregated per second
 per chip — through the exact jitted function `/api/query` dispatches
 (`ops.pipeline.run_group_pipeline`: prefix-sum windowed downsample + grouped
 cross-series reduce), replacing the reference's per-datapoint iterator stack
 (/root/reference/src/core/AggregationIterator.java:514, Downsampler.java:292,
-TsdbQuery.GroupByAndAggregateCB :981).  Round 1 benched a bespoke inline
-kernel; round 2's planner runs the same prefix-sum windowing in production,
-so the bench now measures the served path.
+TsdbQuery.GroupByAndAggregateCB :981).
 
 Shape: BASELINE config 3 scaled up — 1024 series in 100 tag groups, 65536
 points each (67.1M datapoints), avg 1h downsample + sum group aggregation.
 
-Methodology: the batch is generated on device once (host<->device transfer
-excluded — the storage layer hands the planner device-resident batches in
-steady state) by a closed-form hash (no PRNG state, irregular enough to
-defeat constant folding).  The production function is dispatched K times
-back-to-back with a varying window origin (a traced operand, so no
-recompile and no hoisting), blocking once at the end; per-iteration time is
-the slope between a K_LO and K_HI run, cancelling dispatch ramp-up.
+Methodology — designed so the bench CANNOT report a dispatch artifact.
+Round 2 shipped a 12551x number; root cause (established by direct probe,
+round 3): `jax.block_until_ready` does NOT wait for execution on the axon
+tunnel platform — back-to-back "blocked" dispatches return in ~0.1ms while
+a forced drain shows each really takes ~0.6s.  (The executions themselves
+are never skipped: k enqueued dispatches drain in k * 0.6s, identical
+operands or not.)  Therefore:
+
+  1. SYNC IS A HOST FETCH: every timed sample ends by fetching one scalar
+     from each output leaf (`np.asarray`), which provably drains the
+     execution queue (see k-scaling probe in the r3 commit message).  The
+     measured tunnel round-trip (~70ms) is subtracted per sample.
+  2. Every dispatch carries a NEVER-REPEATED operand: a per-process random
+     base + a monotonic counter folded into the window origin (a traced
+     int64 operand), so no two dispatches — within a run or across runs —
+     replay an identical execution, guarding against any future
+     result-memoization layer as well.
+  3. The headline number is a PER-DISPATCH-DRAINED median, and the total
+     measured wall time must exceed 1s (more samples are taken until it
+     does), so clock noise cannot dominate.
+  4. Plausibility guard: the implied HBM traffic (>=17 bytes/datapoint
+     touched at least once) must not exceed any real TPU's memory bandwidth
+     (cap 3.5 TB/s, above v5p's 2.77 TB/s).  A number above the cap is
+     physically impossible and the bench refuses to emit it.
+  5. Cross-check: a pipelined run (k dispatches, one drain at the end) must
+     agree with the drained median within 2x; a loud warning is emitted
+     otherwise.
 
 Baseline: BASELINE.json north star — 1B datapoints < 2s on v5e-8, i.e.
 62.5M datapoints/sec/chip.  vs_baseline > 1.0 beats the target.
 
-Prints exactly one JSON line:
+Prints exactly one JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -38,13 +57,38 @@ def _note(msg: str) -> None:
     """Progress to stderr (stdout carries exactly the one JSON line)."""
     print("[bench] " + msg, file=sys.stderr, flush=True)
 
+
 S = 1024          # series
 N = 65_536        # points per series  (S*N = 67.1M datapoints)
 GROUPS = 100
 START = 1_356_998_400_000
 INTERVAL_MS = 3_600_000   # 1h avg downsample
 STEP_MEAN_MS = 15_500     # ~15.5s cadence -> ~11.8 days of data
-K_LO, K_HI = 2, 10
+
+MIN_WALL_S = 1.0          # guard 3: total measured time must exceed this
+MIN_SAMPLES = 5
+MAX_SAMPLES = 64
+BYTES_PER_DP = 17         # ts int64 + val f64 + mask byte, touched >= once
+HBM_CAP_BYTES_S = 3.5e12  # guard 4: no TPU chip streams faster than this
+PIPELINE_K = 8            # cross-check dispatch count
+
+
+class _OriginSequence:
+    """Never-repeating window-origin offsets (guard 1).
+
+    A per-process random base plus a monotonic counter, mapped into
+    [0, INTERVAL_MS) so the shifted origin stays representative of the
+    production window layout.  7919 is prime to INTERVAL_MS, so the walk
+    visits 3.6M distinct offsets before cycling — far beyond any run.
+    """
+
+    def __init__(self):
+        self._base = int.from_bytes(os.urandom(4), "big")
+        self._i = 0
+
+    def next(self) -> int:
+        self._i += 1
+        return (self._base + self._i * 7919) % INTERVAL_MS
 
 
 def make_batch():
@@ -82,53 +126,148 @@ def build_spec():
     return spec, wargs, pad_pow2(GROUPS)
 
 
-def run_iters(spec, g_pad, batch, wargs, iters: int) -> float:
-    """Wall time for `iters` production dispatches (origin varies each)."""
-    import jax
+def dispatch(spec, g_pad, batch, wargs, origin_offset: int):
+    """One production dispatch with a unique traced window origin."""
     import jax.numpy as jnp
     from opentsdb_tpu.ops.pipeline import run_group_pipeline
 
     ts, val, mask, gid = batch
+    w = dict(wargs)
+    w["first"] = wargs["first"] - jnp.asarray(origin_offset, jnp.int64)
+    return run_group_pipeline(spec, ts, val, mask, gid, g_pad, w)
+
+
+def drain(out) -> None:
+    """Force the execution queue: fetch one scalar from every output leaf.
+
+    `jax.block_until_ready` returns without waiting on the axon tunnel;
+    a host fetch is the only sync that provably drains (k dispatches then
+    one fetch takes k * t_exec — measured, see module docstring)."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        np.asarray(leaf.ravel()[0])
+
+
+def measure_rtt() -> float:
+    """Median cost of draining an already-resident tiny array (tunnel RTT +
+    tiny-slice dispatch), subtracted from each timed sample."""
+    import jax.numpy as jnp
+
+    tiny = jnp.zeros(8)
+    drain((tiny,))
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        drain((tiny,))
+        samples.append(time.perf_counter() - t0)
+    return _median(samples)
+
+
+def measure_drained(spec, g_pad, batch, wargs, origins, rtt
+                    ) -> tuple[list[float], int, float]:
+    """Per-sample-drained times until MIN_WALL_S total (guards 1-3).
+
+    A sample is k back-to-back unique dispatches ending in one drain; k
+    adapts upward when dispatches are fast (amortizing the tunnel RTT so
+    legitimately fast hardware accumulates wall time instead of hitting
+    the sample cap).  Returns (per-DISPATCH times, final k, total wall)."""
+    k = 1
+    times: list[float] = []
+    wall = 0.0
+    while (wall < MIN_WALL_S or len(times) < MIN_SAMPLES) \
+            and len(times) < MAX_SAMPLES:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = dispatch(spec, g_pad, batch, wargs, origins.next())
+        drain(out)
+        t = time.perf_counter() - t0
+        wall += t
+        times.append(max(t - rtt, 1e-9) / k)
+        if t < max(4.0 * rtt, 0.2):
+            # too fast to resolve above the RTT: drain more dispatches per
+            # sample next round
+            k = min(k * 4, 4096)
+    return times, k, wall
+
+
+def measure_pipelined(spec, g_pad, batch, wargs, origins, rtt) -> float:
+    """k dispatches, one drain at the end (guard 5 cross-check)."""
     t0 = time.perf_counter()
     out = None
-    for i in range(iters):
-        w = dict(wargs)
-        w["first"] = wargs["first"] - jnp.asarray(i * 1_000, jnp.int64)
-        out = run_group_pipeline(spec, ts, val, mask, gid, g_pad, w)
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0
+    for _ in range(PIPELINE_K):
+        out = dispatch(spec, g_pad, batch, wargs, origins.next())
+    drain(out)
+    return (time.perf_counter() - t0 - rtt) / PIPELINE_K
 
 
-def time_best(spec, g_pad, batch, wargs, iters, reps=3) -> float:
-    return min(run_iters(spec, g_pad, batch, wargs, iters)
-               for _ in range(reps))
+from statistics import median as _median
 
 
 def main() -> None:
     import jax
 
     n_dev = len(jax.devices())
-    _note("devices: %d (%s)" % (n_dev, jax.devices()[0].platform))
+    platform = jax.devices()[0].platform
+    _note("devices: %d (%s); pipeline dispatches single-device"
+          % (n_dev, platform))
     batch = make_batch()
     _note("batch resident")
     spec, wargs, g_pad = build_spec()
+    origins = _OriginSequence()
 
-    run_iters(spec, g_pad, batch, wargs, 1)  # compile
+    # compile + warm (unique origins too — even warmup never replays)
+    drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
     _note("compiled")
-    t_lo = time_best(spec, g_pad, batch, wargs, K_LO)
-    t_hi = time_best(spec, g_pad, batch, wargs, K_HI)
-    _note("timed: lo=%.3fs hi=%.3fs" % (t_lo, t_hi))
-    per_iter = max((t_hi - t_lo) / (K_HI - K_LO), 1e-9)
+    rtt = measure_rtt()
+    _note("tunnel rtt: %.4fs (subtracted per sample)" % rtt)
 
-    dp_per_sec_per_chip = S * N / per_iter / n_dev
+    samples, k_final, total_wall = measure_drained(spec, g_pad, batch,
+                                                   wargs, origins, rtt)
+    per_iter = _median(samples)
+    _note("drained: %d samples (final k=%d dispatches/sample), "
+          "median=%.4fs/dispatch, total wall=%.2fs (min=%.4fs max=%.4fs)"
+          % (len(samples), k_final, per_iter, total_wall,
+             min(samples), max(samples)))
+    if total_wall < MIN_WALL_S:
+        _note("FATAL: could not accumulate %.1fs of measured wall time"
+              % MIN_WALL_S)
+        sys.exit(1)
+
+    dp_per_sec = S * N / per_iter
+    implied_bw = dp_per_sec * BYTES_PER_DP
+    _note("implied HBM traffic: %.1f GB/s (>= %d B/dp)"
+          % (implied_bw / 1e9, BYTES_PER_DP))
+    if implied_bw > HBM_CAP_BYTES_S:
+        _note("FATAL: implied bandwidth %.2e B/s exceeds the %.2e B/s "
+              "plausibility cap — this is a measurement artifact, refusing "
+              "to emit it" % (implied_bw, HBM_CAP_BYTES_S))
+        sys.exit(1)
+
+    per_iter_pipe = measure_pipelined(spec, g_pad, batch, wargs, origins, rtt)
+    ratio = per_iter / max(per_iter_pipe, 1e-9)
+    _note("pipelined cross-check: %.4fs/dispatch (drained/pipelined = %.2fx)"
+          % (per_iter_pipe, ratio))
+    if ratio > 2.0 or ratio < 0.5:
+        # The two timing methods disagree — one of them is an artifact.
+        # Report the SLOWER (conservative) per-dispatch time; a bench may
+        # understate but must never overstate.
+        _note("WARNING: pipelined and drained timings disagree by >2x — "
+              "reporting the slower of the two")
+        per_iter = max(per_iter, per_iter_pipe)
+        dp_per_sec = S * N / per_iter
+
     baseline = 1e9 / 2.0 / 8.0  # north star: 1B pts < 2s on 8 chips
     print(json.dumps({
         "metric": "datapoints aggregated/sec/chip through the production "
                   "/api/query pipeline (avg 1h downsample + groupby "
-                  "100 groups, 67M pts device-resident)",
-        "value": round(dp_per_sec_per_chip, 1),
+                  "100 groups, 67M pts device-resident, per-dispatch-"
+                  "drained median, unique operands every dispatch)",
+        "value": round(dp_per_sec, 1),
         "unit": "datapoints/sec/chip",
-        "vs_baseline": round(dp_per_sec_per_chip / baseline, 4),
+        "vs_baseline": round(dp_per_sec / baseline, 4),
     }))
 
 
