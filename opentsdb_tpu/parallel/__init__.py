@@ -14,10 +14,12 @@ from opentsdb_tpu.parallel.mesh import (
     make_mesh, mesh_shape_for, AXIS_SERIES, AXIS_TIME)
 from opentsdb_tpu.parallel.sharded import (
     sharded_group_downsample, sharded_rollup, shard_series,
-    sharded_query_pipeline, shard_rows, SHARDED_AGGS)
+    sharded_query_pipeline, shard_rows, SHARDED_AGGS,
+    ShardedStreamAccumulator)
 
 __all__ = [
     "make_mesh", "mesh_shape_for", "AXIS_SERIES", "AXIS_TIME",
     "sharded_group_downsample", "sharded_rollup", "shard_series",
     "sharded_query_pipeline", "shard_rows", "SHARDED_AGGS",
+    "ShardedStreamAccumulator",
 ]

@@ -206,6 +206,56 @@ def sharded_rollup(mesh: Mesh, spec: WindowSpec):
     return jax.jit(mapped)
 
 
+def _local_grid_tail(spec, num_groups: int, wts, v, m, gid):
+    """Collective-aware pipeline tail for code running INSIDE shard_map:
+    (rate ->) grouped cross-series aggregation on a row-sharded [S, W] grid.
+
+    The mesh analog of ops.pipeline._grid_tail: moment-decomposable
+    aggregators combine per-chip partial moments with psum/pmin/pmax;
+    order/rank aggregators all-gather the reduced grid (gather-to-owner,
+    W ≪ N) and reduce replicated.  Shared by the materialized serving path
+    (sharded_query_pipeline) and the streamed finish (sharded stream
+    accumulator) so both answer identically.
+    """
+    from opentsdb_tpu.ops.aggregators import Aggregator, get_agg, PREV
+    from opentsdb_tpu.ops.group_agg import (
+        MOMENT_AGGS, grid_contributions, moment_group_reduce,
+        ordered_group_reduce)
+    from opentsdb_tpu.ops.rate import rate
+
+    g = num_groups
+    agg = get_agg(spec.aggregator)
+    if spec.rate is not None:
+        agg = Aggregator(agg.name, PREV, agg.reduce)
+    grid = jnp.asarray(wts)
+    if spec.rate is not None:
+        grid_b = jnp.broadcast_to(grid[None, :], v.shape)
+        _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
+    vf = v.astype(jnp.float64)
+    contrib, participate = grid_contributions(grid, vf, m, agg)
+    if agg.name in MOMENT_AGGS:
+        out, _ = moment_group_reduce(
+            agg.name, contrib, participate, gid, g,
+            combine_sum=lambda x: lax.psum(x, _BOTH),
+            combine_min=lambda x: lax.pmin(x, _BOTH),
+            combine_max=lambda x: lax.pmax(x, _BOTH))
+    else:
+        # Gather-to-owner on the reduced grid: every chip receives all
+        # rows (global row order preserved — first/last follow series
+        # order) and reduces replicated.
+        c_all = lax.all_gather(contrib, _BOTH, axis=0, tiled=True)
+        p_all = lax.all_gather(participate, _BOTH, axis=0, tiled=True)
+        g_all = lax.all_gather(gid, _BOTH, axis=0, tiled=True)
+        out, _ = ordered_group_reduce(agg.name, c_all, p_all, g_all, g)
+    w = v.shape[1]
+    cols = jnp.arange(w, dtype=jnp.int64)[None, :]
+    seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
+    present = jax.ops.segment_sum(m.reshape(-1).astype(jnp.int64), seg,
+                                  num_segments=g * w)
+    out_mask = lax.psum(present, _BOTH).reshape(g, w) > 0
+    return wts, out, out_mask
+
+
 @lru_cache(maxsize=128)
 def sharded_query_pipeline(mesh: Mesh, spec, num_groups: int):
     """Build the jitted mesh-serving step for one /api/query pipeline.
@@ -218,49 +268,14 @@ def sharded_query_pipeline(mesh: Mesh, spec, num_groups: int):
     `spec` is a PipelineSpec (hashable) — the builder is lru_cached so a
     dashboard re-issuing the same query shape reuses the compiled program.
     """
-    from opentsdb_tpu.ops.aggregators import Aggregator, get_agg, PREV
-    from opentsdb_tpu.ops.downsample import downsample, apply_fill, FILL_NONE
-    from opentsdb_tpu.ops.group_agg import (
-        MOMENT_AGGS, grid_contributions, moment_group_reduce,
-        ordered_group_reduce)
-    from opentsdb_tpu.ops.rate import rate
+    from opentsdb_tpu.ops.downsample import downsample
 
-    agg = get_agg(spec.aggregator)
-    if spec.rate is not None:
-        agg = Aggregator(agg.name, PREV, agg.reduce)
     step = spec.downsample
-    g = num_groups
 
     def local(ts, val, mask, gid, wargs):
         wts, v, m = downsample(ts, val, mask, step.function, step.window_spec,
                                wargs, step.fill_policy, step.fill_value)
-        grid = jnp.asarray(wts)
-        if spec.rate is not None:
-            grid_b = jnp.broadcast_to(grid[None, :], v.shape)
-            _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
-        vf = v.astype(jnp.float64)
-        contrib, participate = grid_contributions(grid, vf, m, agg)
-        if agg.name in MOMENT_AGGS:
-            out, _ = moment_group_reduce(
-                agg.name, contrib, participate, gid, g,
-                combine_sum=lambda x: lax.psum(x, _BOTH),
-                combine_min=lambda x: lax.pmin(x, _BOTH),
-                combine_max=lambda x: lax.pmax(x, _BOTH))
-        else:
-            # Gather-to-owner on the reduced grid: every chip receives all
-            # rows (global row order preserved — first/last follow series
-            # order) and reduces replicated.
-            c_all = lax.all_gather(contrib, _BOTH, axis=0, tiled=True)
-            p_all = lax.all_gather(participate, _BOTH, axis=0, tiled=True)
-            g_all = lax.all_gather(gid, _BOTH, axis=0, tiled=True)
-            out, _ = ordered_group_reduce(agg.name, c_all, p_all, g_all, g)
-        w = v.shape[1]
-        cols = jnp.arange(w, dtype=jnp.int64)[None, :]
-        seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
-        present = jax.ops.segment_sum(m.reshape(-1).astype(jnp.int64), seg,
-                                      num_segments=g * w)
-        out_mask = lax.psum(present, _BOTH).reshape(g, w) > 0
-        return wts, out, out_mask
+        return _local_grid_tail(spec, num_groups, wts, v, m, gid)
 
     mapped = shard_map(
         local, mesh=mesh,
@@ -271,27 +286,158 @@ def sharded_query_pipeline(mesh: Mesh, spec, num_groups: int):
     return jax.jit(mapped)
 
 
+def n_devices(mesh: Mesh) -> int:
+    """Total chips in the query mesh (single definition — padding widths
+    derived from it must agree between the streamed and materialized
+    paths)."""
+    return mesh.shape[AXIS_SERIES] * mesh.shape[AXIS_TIME]
+
+
+def _pad_rows(s_pad: int, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
+              gid: np.ndarray | None = None, pad_gid_value: int = 0):
+    """Pad the series axis to `s_pad` with inert rows.
+
+    The pad values are load-bearing: I64_MAX timestamps keep rows sorted,
+    mask False keeps points out of every window, and `pad_gid_value` must
+    be an OUT-OF-RANGE group id (pass num_groups) — mask False alone is not
+    enough, because fill policies other than "none" expose every live
+    window after downsample, so a phantom row with a real gid would
+    participate in count/avg.  JAX segment ops drop out-of-range ids.
+    """
+    s, n = ts.shape
+    if s_pad == s:
+        return ts, val, mask, gid
+    pad_ts = np.full((s_pad, n), np.iinfo(np.int64).max, np.int64)
+    pad_val = np.zeros((s_pad, n), val.dtype)
+    pad_mask = np.zeros((s_pad, n), bool)
+    pad_ts[:s] = ts
+    pad_val[:s] = val
+    pad_mask[:s] = mask
+    out_gid = None
+    if gid is not None:
+        out_gid = np.full(s_pad, pad_gid_value, gid.dtype)
+        out_gid[:s] = gid
+    return pad_ts, pad_val, pad_mask, out_gid
+
+
+@lru_cache(maxsize=64)
+def _stream_update_fn(mesh: Mesh, window_spec):
+    """Jitted shard_map'd accumulator fold: row-local, zero collectives.
+
+    Each chip folds its own [S_local, n] chunk rows into its own
+    [S_local, W] moment state — the SaltScanner concurrent-bucket scan
+    (/root/reference/src/core/SaltScanner.java:269) with buckets = chips
+    and the TreeMap merge deferred to finish().
+    """
+    from opentsdb_tpu.ops import streaming
+
+    def upd(state, ts, val, mask, wargs):
+        return streaming._update(window_spec, state, ts, val, mask, wargs)
+
+    mapped = shard_map(
+        upd, mesh=mesh,
+        in_specs=(P(_BOTH, None), P(_BOTH, None), P(_BOTH, None),
+                  P(_BOTH, None), P()),
+        out_specs=P(_BOTH, None),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+@lru_cache(maxsize=64)
+def _stream_finish_fn(mesh: Mesh, window_spec, pipeline_spec,
+                      num_groups: int):
+    """Jitted shard_map'd stream finish: per-chip moment state -> replicated
+    (wts[W], out[G, W], out_mask[G, W]) via the collective grid tail."""
+    from opentsdb_tpu.ops import streaming
+
+    step = pipeline_spec.downsample
+
+    def fin(state, gid, wargs):
+        wts, v, m = streaming._finish(
+            window_spec, step.function, step.fill_policy, state, wargs,
+            step.fill_value)
+        return _local_grid_tail(pipeline_spec, num_groups, wts, v, m, gid)
+
+    mapped = shard_map(
+        fin, mesh=mesh,
+        in_specs=(P(_BOTH, None), P(_BOTH), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+class ShardedStreamAccumulator:
+    """Mesh-sharded streaming state: beyond-memory queries on ALL chips.
+
+    Composes the two scale axes the reference's scan layer composes —
+    concurrent salt-bucket scanners (SaltScanner.java:269) × incremental
+    per-batch callbacks (:463-740).  Series rows are sharded over every
+    chip of the mesh; each host chunk is device_put row-sharded and folded
+    into per-chip [S_local, W] moments (associative, collective-free); the
+    finish runs the sharded grid tail (psum/pmin/pmax for moment
+    aggregators, gather-to-owner for order/rank) so the answer matches the
+    single-device StreamAccumulator + run_grid_tail bit-for-bit up to
+    psum reassociation.
+
+    HBM per chip is O(S/n_chips * W + chunk), independent of total points.
+    """
+
+    def __init__(self, mesh: Mesh, num_series: int, window_spec, wargs):
+        from opentsdb_tpu.ops import streaming
+
+        self.mesh = mesh
+        self.window_spec = window_spec
+        self.wargs = wargs
+        n_dev = n_devices(mesh)
+        self.num_series = num_series
+        self.s_pad = -(-num_series // n_dev) * n_dev
+        self._row_sh = NamedSharding(mesh, P(_BOTH, None))
+        self._gid_sh = NamedSharding(mesh, P(_BOTH))
+        state = streaming._zero_state(self.s_pad, window_spec.count)
+        self.state = {k: jax.device_put(v, self._row_sh)
+                      for k, v in state.items()}
+        self._update = _stream_update_fn(mesh, window_spec)
+
+    def update(self, ts: np.ndarray, val: np.ndarray,
+               mask: np.ndarray) -> None:
+        """Fold one [num_series, n] host chunk (async — returns at enqueue).
+
+        Rows are padded to the sharded row count (callers may pack chunks
+        at `s_pad` rows directly to skip the copy); padding rows carry
+        mask False so their moment state stays zero (n=0), which the
+        finish's participate logic excludes (pad gid is out-of-range too).
+        """
+        ts, val, mask, _ = _pad_rows(self.s_pad, ts, val, mask)
+        d = [jax.device_put(x, self._row_sh) for x in (ts, val, mask)]
+        self.state = self._update(self.state, d[0], d[1], d[2], self.wargs)
+
+    def finish_tail(self, pipeline_spec, gid: np.ndarray, num_groups: int):
+        """Replicated (wts[W], out[G, W], out_mask[G, W]) for the query."""
+        fn = _stream_finish_fn(self.mesh, self.window_spec, pipeline_spec,
+                               num_groups)
+        pad_gid = np.full(self.s_pad, num_groups, np.int64)
+        pad_gid[:self.num_series] = gid
+        d_gid = jax.device_put(pad_gid, self._gid_sh)
+        return fn(self.state, d_gid, self.wargs)
+
+
 def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
-               gid: np.ndarray):
+               gid: np.ndarray, pad_gid_value: int | None = None):
     """Pad the series axis to device-count multiple and device_put row-sharded.
 
     The serving-path layout: dim 0 split over both mesh axes (each chip owns
-    a block of whole rows), time dim intact.  Padding rows have mask False /
-    gid 0 so they contribute nothing to any reduction.
+    a block of whole rows), time dim intact.  Padding rows get mask False
+    AND `pad_gid_value` (pass num_groups: an out-of-range group id, whose
+    segments JAX scatter drops).  mask False alone is NOT enough — fill
+    policies other than "none" expose every live window after downsample,
+    so a phantom row with a real gid would participate in count/avg.
     """
-    n_dev = mesh.shape[AXIS_SERIES] * mesh.shape[AXIS_TIME]
+    n_dev = n_devices(mesh)
     s, n = ts.shape
     s_pad = -(-s // n_dev) * n_dev
-    if s_pad != s:
-        pad_ts = np.full((s_pad, n), np.iinfo(np.int64).max, np.int64)
-        pad_val = np.zeros((s_pad, n), val.dtype)
-        pad_mask = np.zeros((s_pad, n), bool)
-        pad_gid = np.zeros(s_pad, gid.dtype)
-        pad_ts[:s] = ts
-        pad_val[:s] = val
-        pad_mask[:s] = mask
-        pad_gid[:s] = gid
-        ts, val, mask, gid = pad_ts, pad_val, pad_mask, pad_gid
+    ts, val, mask, gid = _pad_rows(
+        s_pad, ts, val, mask, gid,
+        pad_gid_value if pad_gid_value is not None else 0)
     row_sh = NamedSharding(mesh, P(_BOTH, None))
     gid_sh = NamedSharding(mesh, P(_BOTH))
     return (jax.device_put(ts, row_sh), jax.device_put(val, row_sh),

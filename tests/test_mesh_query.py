@@ -94,6 +94,11 @@ MOMENT_QUERIES = [
     "mimmax:1m-max:sys.cpu.user{dc=*}",
     "count:1m-avg:sys.cpu.user",
     "sum:1m-avg-zero:sys.cpu.user{dc=*}",
+    # Phantom-row regression (r3): shard_rows pads S to a device-count
+    # multiple; under a fill policy every live window is exposed, so a
+    # padded row with an in-range gid would inflate count / drag avg.
+    "count:1m-avg-zero:sys.cpu.user{dc=*}",
+    "avg:1m-avg-zero:sys.cpu.user{dc=*}",
     "sum:rate:1m-avg:sys.cpu.user{dc=*}",
 ]
 
