@@ -1,0 +1,65 @@
+"""A/B harness for the downsample hot path (VERDICT r2 next-step #2).
+
+Measures the production `/api/query` pipeline (same shape as bench.py)
+under each combination of:
+  * scan mode: flat one-pass cumsum  vs  blocked two-level scan
+  * timestamp compaction: int64 ms  vs  int32 ms-offsets
+
+using the honest drain-based timing from bench.py (unique operands per
+dispatch, host-fetch sync, RTT-subtracted per-dispatch medians — see
+bench.py's module docstring for why `block_until_ready` cannot be used).
+
+The toggle setters clear every dependent jit cache themselves (the
+toggles are read at trace time, so a stale cache would silently measure
+the previous config).
+
+Prints one JSON line per config on stdout (stderr carries progress), e.g.
+  {"config": "blocked+int32", "s_per_dispatch": 0.61, "dp_per_sec": 1.1e8}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import bench
+from bench import (_OriginSequence, build_spec, dispatch, drain, make_batch,
+                   measure_drained, measure_rtt, _median, S, N)
+
+
+def main() -> None:
+    from opentsdb_tpu.ops import downsample as ds
+
+    batch = make_batch()
+    bench._note("batch resident")
+    spec, wargs, g_pad = build_spec()
+    origins = _OriginSequence()
+    rtt = measure_rtt()
+    bench._note("rtt %.4fs" % rtt)
+
+    configs = [
+        ("flat+int64", "flat", False),
+        ("flat+int32", "flat", True),
+        ("blocked+int64", "blocked", False),
+        ("blocked+int32", "blocked", True),
+    ]
+    for name, mode, compact in configs:
+        ds.set_scan_mode(mode)        # setters clear the jit caches
+        ds.set_ts_compaction(compact)
+        drain(dispatch(spec, g_pad, batch, wargs, origins.next()))  # compile
+        samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins,
+                                        rtt)
+        per = _median(samples)
+        print(json.dumps({
+            "config": name,
+            "s_per_dispatch": round(per, 4),
+            "dp_per_sec": round(S * N / per, 1),
+        }), flush=True)
+        bench._note("%s: %.4fs/dispatch" % (name, per))
+    # restore defaults
+    ds.set_scan_mode("blocked")
+    ds.set_ts_compaction(True)
+
+
+if __name__ == "__main__":
+    main()

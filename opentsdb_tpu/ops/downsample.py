@@ -160,6 +160,122 @@ def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
     raise ValueError("Unknown window kind: " + spec.kind)
 
 
+# Prefix-scan strategy for the hot path.  "flat" = one cumsum over the full
+# time axis; "blocked" = two-level scan (intra-block cumsum + tiny block-
+# offset scan) — shorter scan segments, same memory.  Which wins is a
+# hardware/XLA-lowering question; bench_prefix.py A/Bs them on the chip.
+_SCAN_MODE = "blocked"
+_SCAN_BLOCK = 512
+
+_I32_BIG = np.int64(2**31 - 2)
+
+
+_COMPACT_ENABLED = True
+
+
+def _clear_dependent_caches() -> None:
+    """Drop every compiled program that baked in the hot-path toggles.
+
+    The toggles are read at TRACE time; a cached program keeps its config
+    forever, so flipping a toggle without clearing these would silently
+    mix configs between already-seen and new query shapes.
+    """
+    from opentsdb_tpu.ops import pipeline, streaming
+    for fn in (pipeline._jitted_group, pipeline._jitted_grid_tail,
+               pipeline._jitted_group_rollup_avg, streaming._jitted_update,
+               streaming._jitted_finish):
+        fn.clear_cache()
+    try:
+        from opentsdb_tpu.parallel import sharded
+        sharded.sharded_query_pipeline.cache_clear()
+        sharded._stream_update_fn.cache_clear()
+        sharded._stream_finish_fn.cache_clear()
+    except ImportError:  # parallel extras absent in minimal installs
+        pass
+
+
+def set_scan_mode(mode: str) -> None:
+    """'flat' | 'blocked' — benchmarking hook; clears affected jit caches."""
+    global _SCAN_MODE
+    if mode not in ("flat", "blocked"):
+        raise ValueError("scan mode must be 'flat' or 'blocked'")
+    _SCAN_MODE = mode
+    _clear_dependent_caches()
+
+
+def set_ts_compaction(enabled: bool) -> None:
+    """Toggle int32 timestamp compaction — benchmarking hook; clears
+    affected jit caches."""
+    global _COMPACT_ENABLED
+    _COMPACT_ENABLED = bool(enabled)
+    _clear_dependent_caches()
+
+
+def _edge_prefix_builder(s: int, n: int, idx):
+    """Returns windowed(data): per-window sums via prefix evaluation at the
+    searched edge positions idx[S, W+1] (exclusive prefixes differenced).
+
+    flat: materialize cumsum[S, N+1], gather at idx.
+    blocked: intra-block cumsum (scan length _SCAN_BLOCK) + cumsum over the
+    [S, B] block totals; prefix(p) = block_offset[p // K] + intra[p-1 within
+    its block].  Same HBM traffic, much shorter scan dependency chains.
+    """
+    if _SCAN_MODE == "flat" or n % _SCAN_BLOCK or n <= _SCAN_BLOCK:
+        def windowed(data):
+            csum = jnp.concatenate(
+                [jnp.zeros((s, 1), data.dtype),
+                 jnp.cumsum(data, axis=1)], axis=1)
+            at = jnp.take_along_axis(csum, idx, axis=1)
+            return at[:, 1:] - at[:, :-1]
+        return windowed
+
+    k = _SCAN_BLOCK
+    b = n // k
+    blk = idx // k               # block containing each edge position
+    off = idx - blk * k          # position within the block
+    # Exclusive intra-block prefix at `off` = inclusive intra cumsum at
+    # off-1; off==0 contributes nothing.  Flatten (block, slot) so one
+    # gather serves both lookups.
+    gather_pos = jnp.clip(blk * k + off - 1, 0, n - 1)
+    zero_intra = off == 0
+    safe_blk = jnp.clip(blk, 0, b)   # idx can be n -> blk == b (offset row)
+
+    def windowed(data):
+        blocks = data.reshape(s, b, k)
+        intra = jnp.cumsum(blocks, axis=2)
+        bsum = intra[:, :, -1]
+        boff = jnp.concatenate(
+            [jnp.zeros((s, 1), data.dtype), jnp.cumsum(bsum, axis=1)],
+            axis=1)                                      # [S, B+1]
+        base = jnp.take_along_axis(boff, safe_blk, axis=1)
+        part = jnp.take_along_axis(intra.reshape(s, n), gather_pos, axis=1)
+        part = jnp.where(zero_intra, jnp.zeros_like(part), part)
+        at = base + part
+        return at[:, 1:] - at[:, :-1]
+    return windowed
+
+
+def _compact_ts(ts, spec: WindowSpec, wargs: dict):
+    """(ts', edges') for the prefix path: int32 ms offsets when
+    the whole fixed-window grid provably spans < 2^31 ms.
+
+    TPUs have no native 64-bit integer ALU — every compare in the
+    binary search and every window-id division runs emulated on int64.
+    Fixed grids know their span statically (count * interval); offsets
+    from the traced window origin fit int32, and clipping keeps the
+    int64-max padding timestamps sorted (they land beyond the last edge,
+    exactly like before).  Calendar/all grids keep int64.
+    """
+    edges64 = window_edges(ts.dtype, spec, wargs)
+    if not _COMPACT_ENABLED or spec.kind != "fixed" or \
+            (spec.count + 1) * spec.interval_ms >= 2**31 - 2:
+        return ts, edges64
+    first = wargs["first"]
+    ts32 = jnp.clip(ts - first, -_I32_BIG, _I32_BIG).astype(jnp.int32)
+    edges32 = jnp.clip(edges64 - first, -_I32_BIG, _I32_BIG).astype(jnp.int32)
+    return ts32, edges32
+
+
 def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
                        wargs: dict):
     """Scatter-free windowed moments for sorted rows.
@@ -171,6 +287,11 @@ def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
     through.  Non-participating slots (masked or NaN) contribute zero to
     every cumulative sum, so correctness needs only ts-sortedness.
 
+    Hot-path dtypes: timestamps compact to int32 offsets when the grid
+    span allows (no 64-bit emulation in the search), counts accumulate in
+    int32 (N < 2^31 per row); VALUES stay float64 — the reference's Java
+    double accumulation is the numeric contract (Downsampler.java:257).
+
     Returns (out[S, W], count[S, W]).
     """
     s, n = ts.shape
@@ -181,16 +302,12 @@ def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
     ok = mask & ~jnp.isnan(vf)
     v0 = jnp.where(ok, vf, 0)
 
-    edges = window_edges(ts.dtype, spec, wargs)
-    idx = jax.vmap(lambda row: jnp.searchsorted(row, edges, side="left"))(ts)
+    cts, cedges = _compact_ts(ts, spec, wargs)
+    idx = jax.vmap(
+        lambda row: jnp.searchsorted(row, cedges, side="left"))(cts)
+    windowed = _edge_prefix_builder(s, n, idx)
 
-    def windowed(data):
-        csum = jnp.concatenate(
-            [jnp.zeros((s, 1), data.dtype), jnp.cumsum(data, axis=1)], axis=1)
-        at = jnp.take_along_axis(csum, idx, axis=1)
-        return at[:, 1:] - at[:, :-1]
-
-    count = windowed(ok.astype(jnp.int64))
+    count = windowed(ok.astype(jnp.int32)).astype(jnp.int64)
     if agg_name == "count":
         return count.astype(fdtype), count
     total = windowed(v0)

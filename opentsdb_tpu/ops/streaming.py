@@ -37,8 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
-    WindowSpec, apply_fill, window_edges, window_ids, window_timestamps,
-    FILL_NONE)
+    WindowSpec, apply_fill, window_ids, window_timestamps,
+    _compact_ts, _edge_prefix_builder, FILL_NONE)
 
 # Downsample functions whose window moments merge associatively.
 STREAMABLE_DS = frozenset({
@@ -68,16 +68,12 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict):
     ok = mask & ~jnp.isnan(vf)
     v0 = jnp.where(ok, vf, 0.0)
 
-    edges = window_edges(ts.dtype, spec, wargs)
-    idx = jax.vmap(lambda row: jnp.searchsorted(row, edges, side="left"))(ts)
+    cts, cedges = _compact_ts(ts, spec, wargs)
+    idx = jax.vmap(
+        lambda row: jnp.searchsorted(row, cedges, side="left"))(cts)
+    windowed = _edge_prefix_builder(s, n, idx)
 
-    def windowed(data):
-        csum = jnp.concatenate(
-            [jnp.zeros((s, 1), data.dtype), jnp.cumsum(data, axis=1)], axis=1)
-        at = jnp.take_along_axis(csum, idx, axis=1)
-        return at[:, 1:] - at[:, :-1]
-
-    cnt = windowed(ok.astype(jnp.int64))
+    cnt = windowed(ok.astype(jnp.int32)).astype(jnp.int64)
     tot = windowed(v0)
     safe = jnp.maximum(cnt, 1)
     mean = tot / safe

@@ -422,22 +422,21 @@ class ShardedStreamAccumulator:
 
 
 def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
-               gid: np.ndarray, pad_gid_value: int | None = None):
+               gid: np.ndarray, pad_gid_value: int):
     """Pad the series axis to device-count multiple and device_put row-sharded.
 
     The serving-path layout: dim 0 split over both mesh axes (each chip owns
     a block of whole rows), time dim intact.  Padding rows get mask False
-    AND `pad_gid_value` (pass num_groups: an out-of-range group id, whose
-    segments JAX scatter drops).  mask False alone is NOT enough — fill
-    policies other than "none" expose every live window after downsample,
-    so a phantom row with a real gid would participate in count/avg.
+    AND `pad_gid_value` — REQUIRED, pass num_groups: an out-of-range group
+    id, whose segments JAX scatter drops.  mask False alone is NOT enough —
+    fill policies other than "none" expose every live window after
+    downsample, so a phantom row with an in-range gid would participate in
+    count/avg (the r3 phantom-row bug).
     """
     n_dev = n_devices(mesh)
     s, n = ts.shape
     s_pad = -(-s // n_dev) * n_dev
-    ts, val, mask, gid = _pad_rows(
-        s_pad, ts, val, mask, gid,
-        pad_gid_value if pad_gid_value is not None else 0)
+    ts, val, mask, gid = _pad_rows(s_pad, ts, val, mask, gid, pad_gid_value)
     row_sh = NamedSharding(mesh, P(_BOTH, None))
     gid_sh = NamedSharding(mesh, P(_BOTH))
     return (jax.device_put(ts, row_sh), jax.device_put(val, row_sh),

@@ -107,3 +107,92 @@ def test_all_window_matches_reference(agg):
     np.testing.assert_array_equal(np.asarray(omask)[:, :1], want_cnt > 0)
     np.testing.assert_allclose(got[want_cnt > 0], want[want_cnt > 0],
                                rtol=1e-11, atol=1e-9)
+
+
+class TestScanModesAndCompaction:
+    """r3 hot-path rework: blocked two-level scan + int32 ts compaction.
+
+    The default batches above (N=64) fall back to the flat scan, so these
+    pin the blocked path (N divisible by the 512 block) and the int32 /
+    int64 timestamp compaction decision against the numpy reference and
+    each other.
+    """
+
+    def _big_batch(self, rng, s=4, n=1024, spread_ms=40_000_000):
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        for i in range(s):
+            k = int(rng.integers(n // 2, n - 7))
+            t = START + np.sort(rng.choice(spread_ms, size=k, replace=False))
+            v = rng.normal(100.0, 30.0, k)
+            v[rng.random(k) < 0.05] = np.nan
+            ts[i, :k] = t
+            val[i, :k] = v
+            mask[i, :k] = True
+        return ts, val, mask
+
+    @pytest.mark.parametrize("agg", sorted(PREFIX_AGGS))
+    def test_blocked_equals_flat_equals_reference(self, agg):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        rng = np.random.default_rng(11)
+        ts, val, mask = self._big_batch(rng)
+        windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
+        spec, wargs = windows.split()
+        outs = {}
+        for mode in ("flat", "blocked"):
+            ds_mod.set_scan_mode(mode)
+            try:
+                _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
+                                           FILL_NONE)
+            finally:
+                ds_mod.set_scan_mode("blocked")
+            outs[mode] = (np.asarray(out), np.asarray(omask))
+        np.testing.assert_array_equal(outs["flat"][1], outs["blocked"][1])
+        m = outs["flat"][1]
+        np.testing.assert_allclose(outs["blocked"][0][m], outs["flat"][0][m],
+                                   rtol=1e-12, atol=1e-12)
+        edges = np.arange(windows.first_window_ms,
+                          windows.first_window_ms
+                          + (windows.count + 1) * 3_600_000, 3_600_000)
+        want, want_cnt = _numpy_reference(ts, val, mask, agg, edges)
+        got = outs["blocked"][0][:, :windows.count]
+        np.testing.assert_allclose(got[want_cnt > 0], want[want_cnt > 0],
+                                   rtol=1e-11, atol=1e-9)
+
+    def test_int64_fallback_for_wide_grids(self):
+        """A grid spanning >= 2^31 ms must keep int64 timestamps and still
+        answer correctly (the compaction guard, not the compaction)."""
+        from opentsdb_tpu.ops.downsample import _compact_ts
+        import jax.numpy as jnp
+        rng = np.random.default_rng(12)
+        ts, val, mask = self._big_batch(rng, spread_ms=200_000_000)
+        # 1-day windows over ~7 years: span 2555 days > 2^31 ms (~24.8 days)
+        windows = FixedWindows.for_range(
+            START, START + 2555 * 86_400_000, 86_400_000)
+        spec, wargs = windows.split()
+        cts, _ = _compact_ts(jnp.asarray(ts), spec, wargs)
+        assert cts.dtype == jnp.int64
+        _, out, omask = downsample(ts, val, mask, "sum", spec, wargs,
+                                   FILL_NONE)
+        edges = np.arange(
+            windows.first_window_ms,
+            windows.first_window_ms + (windows.count + 1) * 86_400_000,
+            86_400_000, dtype=np.int64)
+        want, want_cnt = _numpy_reference(ts, val, mask, "sum", edges)
+        got = np.asarray(out)[:, :windows.count]
+        np.testing.assert_allclose(got[want_cnt > 0], want[want_cnt > 0],
+                                   rtol=1e-11, atol=1e-9)
+
+    def test_int32_compaction_active_for_narrow_grids(self):
+        from opentsdb_tpu.ops.downsample import _compact_ts
+        import jax.numpy as jnp
+        rng = np.random.default_rng(13)
+        ts, _, _ = self._big_batch(rng)
+        windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
+        spec, wargs = windows.split()
+        cts, cedges = _compact_ts(jnp.asarray(ts), spec, wargs)
+        assert cts.dtype == jnp.int32
+        assert cedges.dtype == jnp.int32
+        # pads (int64 max) stay at the sorted tail after clipping
+        assert bool((np.diff(np.asarray(cts), axis=1) >= 0).all())
