@@ -181,7 +181,8 @@ def _clear_dependent_caches() -> None:
     mix configs between already-seen and new query shapes.
     """
     from opentsdb_tpu.ops import pipeline, streaming
-    for fn in (pipeline._jitted_group, pipeline._jitted_grid_tail,
+    for fn in (pipeline._jitted, pipeline._jitted_rollup_avg,
+               pipeline._jitted_group, pipeline._jitted_grid_tail,
                pipeline._jitted_group_rollup_avg, streaming._jitted_update,
                streaming._jitted_finish):
         fn.clear_cache()
