@@ -432,12 +432,7 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
         else:
             out = jnp.where(count_grid >= 2, last_v - first_v, 0.0)
     elif agg_name == "median" or agg_name.startswith(("p", "ep")):
-        # Sort (segment, value) pairs so each window is a sorted contiguous run.
-        sort_v = jnp.where(ok, vf.reshape(-1), jnp.inf)
-        order = jnp.lexsort((sort_v, seg))
-        sorted_v = sort_v[order]
-        sorted_seg = seg[order]
-        seg_starts = jnp.searchsorted(sorted_seg, jnp.arange(s * w))
+        sorted_v, seg_starts = _sorted_runs(vf.reshape(-1), ok, seg, s * w)
         if agg_name == "median":
             top = max(s * n - 1, 0)
             idx = jnp.clip(seg_starts + counts // 2, 0, top)
@@ -453,6 +448,22 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
     out, out_mask = apply_fill(out, out_mask, live, fill_policy, fill_value,
                                fdtype)
     return wts, out, out_mask
+
+
+def _sorted_runs(flat_v, okf, seg, num_cells: int):
+    """Value-sorted contiguous runs per segment cell.
+
+    Sorts (segment, value) pairs so each cell's members form an ascending
+    contiguous run (non-members +inf, at each run's tail).  Returns
+    (sorted_v, starts[num_cells]).  Shared by the exact percentile path
+    above and the streaming sketch's per-chunk rank grid.
+    """
+    sv = jnp.where(okf, flat_v, jnp.inf)
+    order = jnp.lexsort((sv, seg))
+    sorted_v = sv[order]
+    sorted_seg = seg[order]
+    starts = jnp.searchsorted(sorted_seg, jnp.arange(num_cells))
+    return sorted_v, starts
 
 
 def apply_fill(out, out_mask, live, fill_policy: str, fill_value: float,

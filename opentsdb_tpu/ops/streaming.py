@@ -18,9 +18,24 @@ downsample functions with associative merges stream:
   * first/last -> chunks arrive in time order, so first sticks and last
     overwrites; diff = last - first; mult -> running product
 
-Only rank-based window functions (median/p* as *downsample* functions)
-cannot stream — those queries fall back to the materialized path and the
-scan budget guards them.
+Rank-based window functions (median/p* as *downsample* functions) stream
+through a mergeable fixed-size quantile summary (is_sketch_ds below): each
+chunk's exact per-(series, window) K-point equi-rank grid folds into the
+accumulated grid by weighted merge + re-interpolation.  Error is in RANK,
+not value: one compaction to a K-grid moves a quantile's rank by at most
+1/(2K), so a cell that receives data from C chunks drifts at most
+~C/(2K) of its population in the worst case (K=64).  Two things keep C
+small in practice: chunks partition TIME while windows partition time
+too, so a window-sized cell only overlaps the few chunks that span it
+(an empty-side merge is an exact no-op); and on stationary data the
+per-merge errors are signed and largely cancel (random-walk, not
+linear — see test_many_merges_drift_bounded).  The hazard case is a
+window much wider than a chunk (e.g. "0all" over a huge range), where C
+equals the chunk count; for those prefer the exact path via
+tsd.query.streaming.sketch_percentiles=false + budgets.  The exact sort
+path still serves materialized (sub-threshold) queries; the reference
+would have refused big rank queries on budget instead
+(Aggregators.java:657-708 sorts fully in memory).
 
 JAX's async dispatch gives the ScannerCB overlap for free: `update()`
 returns as soon as the device program is enqueued, so the host fetches and
@@ -38,18 +53,36 @@ import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
     WindowSpec, apply_fill, window_ids, window_timestamps,
-    _compact_ts, _edge_prefix_builder, FILL_NONE)
+    _compact_ts, _edge_prefix_builder, _sorted_runs, FILL_NONE)
 
-# Downsample functions whose window moments merge associatively.
+# Downsample functions whose window moments merge associatively (exact).
 STREAMABLE_DS = frozenset({
     "sum", "zimsum", "pfsum", "count", "avg", "squareSum", "dev",
     "min", "mimmin", "max", "mimmax", "first", "last", "diff", "mult"})
 
+# Summary points per (series, window) quantile sketch.
+SKETCH_K = 64
+
 _I64_MAX = np.iinfo(np.int64).max
 
 
-def _zero_state(s: int, w: int) -> dict:
-    return {
+def is_sketch_ds(name: str) -> bool:
+    """Rank-based downsample functions served by the mergeable quantile
+    summary when streaming (median / p* / ep*r3 / ep*r7)."""
+    if name == "median":
+        return True
+    if name.startswith(("p", "ep")) and name not in ("pfsum",):
+        from opentsdb_tpu.ops.downsample import parse_percentile_name
+        try:
+            parse_percentile_name(name)
+            return True
+        except (KeyError, ValueError):   # non-percentile p*-named fn
+            return False
+    return False
+
+
+def _zero_state(s: int, w: int, sketch: bool = False) -> dict:
+    state = {
         "n": jnp.zeros((s, w), jnp.int64),
         "total": jnp.zeros((s, w), jnp.float64),
         "m2": jnp.zeros((s, w), jnp.float64),
@@ -59,9 +92,15 @@ def _zero_state(s: int, w: int) -> dict:
         "last": jnp.zeros((s, w), jnp.float64),
         "prod": jnp.ones((s, w), jnp.float64),
     }
+    if sketch:
+        # q[s, w, j] = value at fractional rank (j+0.5)/K of the cell's
+        # population seen so far (midpoint convention); counts live in "n".
+        state["q"] = jnp.zeros((s, w, SKETCH_K), jnp.float64)
+    return state
 
 
-def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict):
+def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
+                   with_sketch: bool = False):
     """One chunk's per-(series, window) moments via the prefix-sum kernel."""
     s, n = ts.shape
     vf = val.astype(jnp.float64)
@@ -106,8 +145,123 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict):
     last_v = flat_v[jnp.clip(last_i, 0, s * n - 1)].reshape(s, w)
     prod = jax.ops.segment_prod(jnp.where(okf, flat, 1.0), seg,
                                 num_segments=num)[:-1].reshape(s, w)
-    return dict(n=cnt, total=tot, m2=m2, lo=lo, hi=hi, first=first_v,
-                last=last_v, prod=prod)
+    out = dict(n=cnt, total=tot, m2=m2, lo=lo, hi=hi, first=first_v,
+               last=last_v, prod=prod)
+    if with_sketch:
+        # Exact per-cell equi-rank grid for this chunk: value-sort within
+        # (series, window) runs, then interpolate the K midpoint ranks.
+        sorted_v, starts = _sorted_runs(flat, okf, seg, s * w)
+        out["q"] = _rank_grid(sorted_v, starts,
+                              cnt.reshape(-1)).reshape(s, w, SKETCH_K)
+    return out
+
+
+def _rank_grid(sorted_v, starts, cnt, k: int = SKETCH_K):
+    """Exact K-point equi-rank grid per cell from value-sorted runs.
+
+    sorted_v[L] ascending within each cell's contiguous run (non-members
+    +inf at the run tail), starts[C] run offsets, cnt[C] member counts.
+    Returns q[C, k]: value at fractional rank (j+0.5)/k of each cell via
+    linear interpolation between adjacent order statistics; empty cells
+    yield zeros (their count is zero, so merges ignore them).
+    """
+    c = cnt.shape[0]
+    cf = cnt.astype(jnp.float64)[:, None]
+    # fractional 0-based rank of target j: (j+0.5)/k * cnt - 0.5
+    fr = (jnp.arange(k, dtype=jnp.float64)[None, :] + 0.5) / k * cf - 0.5
+    fr = jnp.clip(fr, 0.0, jnp.maximum(cf - 1.0, 0.0))
+    lo = jnp.floor(fr)
+    frac = fr - lo
+    top = sorted_v.shape[0] - 1
+    base = starts[:, None].astype(jnp.int64)
+    i_lo = jnp.clip(base + lo.astype(jnp.int64), 0, top)
+    i_hi = jnp.clip(base + lo.astype(jnp.int64) + 1,
+                    0, top)
+    # never read past the cell's own run
+    last = base + jnp.maximum(cnt[:, None].astype(jnp.int64) - 1, 0)
+    i_hi = jnp.minimum(i_hi, last)
+    v_lo = sorted_v[i_lo.reshape(-1)].reshape(c, k)
+    v_hi = sorted_v[i_hi.reshape(-1)].reshape(c, k)
+    q = v_lo + frac * (v_hi - v_lo)
+    return jnp.where(cnt[:, None] > 0, q, 0.0)
+
+
+def _interp_rows(t, xp, fp):
+    """Row-wise linear interpolation, inf-safe.
+
+    Unlike jnp.interp, equal-value brackets return the endpoint instead of
+    computing a 0 * (fp_hi - fp_lo) slope — inf - inf would poison grids
+    carrying legitimate infinite data values.  t[C, K], xp/fp[C, X].
+    """
+    x = xp.shape[1]
+    idx = jax.vmap(lambda tr, xr: jnp.searchsorted(xr, tr, side="left"))(
+        t, xp)
+    lo = jnp.clip(idx - 1, 0, x - 1)
+    hi = jnp.clip(idx, 0, x - 1)
+    x_lo = jnp.take_along_axis(xp, lo, axis=1)
+    x_hi = jnp.take_along_axis(xp, hi, axis=1)
+    f_lo = jnp.take_along_axis(fp, lo, axis=1)
+    f_hi = jnp.take_along_axis(fp, hi, axis=1)
+    dx = x_hi - x_lo
+    frac = jnp.where(dx > 0, (t - x_lo) / jnp.where(dx > 0, dx, 1.0), 0.0)
+    same = (f_lo == f_hi) | (dx <= 0)
+    return jnp.where(same, f_lo, f_lo + frac * (f_hi - f_lo))
+
+
+def _merge_sketch(q1, n1, q2, n2, k: int = SKETCH_K):
+    """Weighted merge of two per-cell equi-rank summaries -> one K-grid.
+
+    Each summary point carries weight n/K at its midpoint rank; the merged
+    grid re-reads the mixture's cumulative weight at the K new midpoint
+    targets.  One compaction moves any quantile's rank by <= 1/(2K) of the
+    cell population — the documented per-merge error bound.
+    q1/q2: [C, K]; n1/n2: [C].  Returns [C, K].
+    """
+    nf1 = n1.astype(jnp.float64)[:, None]
+    nf2 = n2.astype(jnp.float64)[:, None]
+    v = jnp.concatenate([q1, q2], axis=1)                    # [C, 2K]
+    wt = jnp.concatenate([jnp.broadcast_to(nf1 / k, q1.shape),
+                          jnp.broadcast_to(nf2 / k, q2.shape)], axis=1)
+    # Zero-weight points (an empty side) must not perturb interpolation:
+    # sort them last via an inf key, then REPLACE them with the row's max
+    # carried value — their cum ranks are flat at the total, so any target
+    # interpolating into that region reads the max instead of poisoning
+    # the grid (a 0-clamp would break sortedness and decay every
+    # subsequent merge).  A sentinel FLAG (not isfinite) distinguishes
+    # them from legitimate +inf data values, which must survive so the
+    # streamed and exact paths agree on inf-bearing series.
+    sentinel = wt <= 0
+    key = jnp.where(sentinel, jnp.inf, v)
+    order = jnp.argsort(key, axis=1)
+    v = jnp.take_along_axis(v, order, axis=1)
+    wt = jnp.take_along_axis(wt, order, axis=1)
+    sentinel = jnp.take_along_axis(sentinel, order, axis=1)
+    vmax = jnp.max(jnp.where(sentinel, -jnp.inf, v), axis=1, keepdims=True)
+    v = jnp.where(sentinel, vmax, v)
+    cum = jnp.cumsum(wt, axis=1) - 0.5 * wt                  # midpoint ranks
+    total = nf1 + nf2
+    targets = (jnp.arange(k, dtype=jnp.float64)[None, :] + 0.5) / k * total
+    merged = _interp_rows(targets, cum, v)
+    both_zero = (n1 + n2) <= 0
+    return jnp.where(both_zero[:, None], 0.0, merged)
+
+
+def sketch_quantile(q, n, pct):
+    """Estimate the pct-quantile (0-100) from summaries q[..., K], n[...].
+
+    Linear interpolation on the midpoint-rank grid (R-7-flavored); the
+    ep*r3/r7 estimator distinction is below the sketch's rank error and is
+    deliberately collapsed here (documented approximation).
+    """
+    k = q.shape[-1]
+    nf = jnp.maximum(n.astype(jnp.float64), 1.0)
+    lead = q.shape[:-1]
+    qs = q.reshape(-1, k)
+    nfs = nf.reshape(-1, 1)
+    mid = (jnp.arange(k, dtype=jnp.float64)[None, :] + 0.5) / k * nfs
+    target = jnp.asarray(pct, jnp.float64) / 100.0 * nfs[:, 0]
+    out = _interp_rows(target[:, None], mid, qs)[:, 0]
+    return out.reshape(lead)
 
 
 def _merge(state: dict, chunk: dict) -> dict:
@@ -125,7 +279,7 @@ def _merge(state: dict, chunk: dict) -> dict:
     m2 = state["m2"] + chunk["m2"] + delta * delta * nf1 * nf2 / safe_n
     had = n1 > 0
     got = n2 > 0
-    return {
+    merged = {
         "n": n,
         "total": t1 + t2,
         "m2": m2,
@@ -136,10 +290,17 @@ def _merge(state: dict, chunk: dict) -> dict:
         "last": jnp.where(got, chunk["last"], state["last"]),
         "prod": state["prod"] * chunk["prod"],
     }
+    if "q" in state:
+        s, w, k = state["q"].shape
+        merged["q"] = _merge_sketch(
+            state["q"].reshape(-1, k), n1.reshape(-1),
+            chunk["q"].reshape(-1, k), n2.reshape(-1)).reshape(s, w, k)
+    return merged
 
 
 def _update(spec: WindowSpec, state: dict, ts, val, mask, wargs: dict):
-    return _merge(state, _chunk_moments(ts, val, mask, spec, wargs))
+    return _merge(state, _chunk_moments(ts, val, mask, spec, wargs,
+                                        with_sketch="q" in state))
 
 
 _jitted_update = jax.jit(_update, static_argnums=0)
@@ -174,6 +335,16 @@ def _finish(spec: WindowSpec, ds_function: str, fill_policy: str,
         out = jnp.where(n >= 2, state["last"] - state["first"], 0.0)
     elif ds_function == "mult":
         out = state["prod"]
+    elif "q" in state and is_sketch_ds(ds_function):
+        # Approximate (rank error ~chunks/(2K), see module docstring);
+        # median uses the 50th pct of the summary rather than the exact
+        # upper-median convention — the gap is below the sketch error.
+        if ds_function == "median":
+            pct = 50.0
+        else:
+            from opentsdb_tpu.ops.downsample import parse_percentile_name
+            pct, _est = parse_percentile_name(ds_function)
+        out = sketch_quantile(state["q"], n, pct)
     else:
         raise KeyError("Downsample function does not stream: " + ds_function)
     w = spec.count
@@ -204,10 +375,13 @@ class StreamAccumulator:
     state: dict
 
     @staticmethod
-    def create(num_series: int, spec: WindowSpec,
-               wargs: dict) -> "StreamAccumulator":
+    def create(num_series: int, spec: WindowSpec, wargs: dict,
+               sketch: bool = False) -> "StreamAccumulator":
+        """`sketch=True` adds the [S, W, K] quantile-summary lane so
+        rank-based downsample functions can finish (approximate)."""
         return StreamAccumulator(spec, wargs, _zero_state(num_series,
-                                                          spec.count))
+                                                          spec.count,
+                                                          sketch))
 
     def update(self, ts, val, mask) -> None:
         """Fold one [S, n] chunk in (async — returns at enqueue)."""

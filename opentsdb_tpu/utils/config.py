@@ -69,6 +69,10 @@ DEFAULTS: dict[str, str] = {
     # [S, N] batch in host memory (SaltScanner's overlapped-scan analog).
     "tsd.query.streaming.point_threshold": "8000000",
     "tsd.query.streaming.chunk_points": "4000000",
+    # rank-based downsample fns stream via the mergeable quantile summary
+    # (approximate, rank error ~chunks/(2K)); false = materialize instead,
+    # subject to the scan budgets
+    "tsd.query.streaming.sketch_percentiles": "true",
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",
