@@ -1,4 +1,4 @@
-"""BASELINE.md measurement configs 1-5 as runnable benchmarks.
+"""BASELINE.md measurement configs 1-6 as runnable benchmarks.
 
 `python bench_configs.py [--config N] [--scale F]` prints one JSON line per
 config (bench.py stays the single-line headline bench the driver runs).
@@ -9,13 +9,25 @@ Configs (BASELINE.md / BASELINE.json):
   3. 10k-series group-by + avg downsample              - segment-reduce fan-out
   4. rate + p99 over 500M pts                          - non-associative kernels
   5. 1B pts -> 1m rollups, time-chunked                - offline batch pass
+  6. bulk ingest points/sec (host write path)          - TSDB.add_points_bulk
+
+Timing methodology (same rules as bench.py — see its module docstring for
+why `jax.block_until_ready` CANNOT be used on this platform):
+  * every timed run ends in a host scalar fetch (drain) that provably
+    empties the execution queue; the measured tunnel RTT is subtracted;
+  * no dispatch is ever repeated with identical operands: repetitions
+    shift the traced window origin / chunk base through a per-process
+    random walk, so neither the runtime nor any future memoization layer
+    can short-circuit a rep;
+  * each config accumulates >= 1s of measured wall time where the scale
+    allows, and reports a median over passes.
 
 Configs 2/4/5 exceed device memory as one batch, so they run through the
 streaming machinery (ops.streaming): chunks are generated on device by a
 closed-form hash (the storage layer's role; generation is timed separately
-and subtracted via a generation-only calibration pass).  Config 5 chunks by
-TIME (rollup output rows are emitted per chunk — the write-side shape of
-TSDB.addAggregatePoint), the others by point index.
+with its own drains and subtracted).  Config 5 chunks by TIME (rollup
+output rows are emitted per chunk — the write-side shape of
+TSDB.addAggregatePoint); the others by point index.
 
 Use --scale 0.01 for a quick CPU smoke run.
 """
@@ -24,11 +36,18 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
+from bench import drain, measure_rtt, _median
+
 START = 1_356_998_400_000
 STEP_MS = 10_000  # 10s cadence
+
+MIN_WALL_S = 1.0
+MIN_PASSES = 3
+MAX_PASSES = 32
 
 
 def _note(msg: str) -> None:
@@ -36,15 +55,50 @@ def _note(msg: str) -> None:
 
 
 def _emit(config: int, label: str, points: int, seconds: float,
-          n_dev: int) -> None:
-    dp_s_chip = points / max(seconds, 1e-9) / n_dev
-    baseline = 1e9 / 2.0 / 8.0  # north star: 62.5M dp/s/chip
+          n_dev: int, unit: str = "datapoints/sec/chip",
+          baseline: float | None = None) -> None:
+    rate = points / max(seconds, 1e-9) / n_dev
+    if baseline is None:
+        baseline = 1e9 / 2.0 / 8.0  # north star: 62.5M dp/s/chip
     print(json.dumps({
         "metric": "config %d: %s" % (config, label),
-        "value": round(dp_s_chip, 1),
-        "unit": "datapoints/sec/chip",
-        "vs_baseline": round(dp_s_chip / baseline, 4),
+        "value": round(rate, 1),
+        "unit": unit,
+        "vs_baseline": round(rate / baseline, 4),
     }), flush=True)
+
+
+class _Uniquifier:
+    """Never-repeating int offsets (per-process random base + counter) —
+    folded into window origins and chunk bases so no two dispatches are
+    operand-identical, within or across runs."""
+
+    def __init__(self):
+        self._base = int.from_bytes(os.urandom(4), "big")
+        self._i = 0
+
+    def next(self, mod: int = 3_600_000) -> int:
+        self._i += 1
+        return (self._base + self._i * 7919) % mod
+
+
+_UNIQ = _Uniquifier()
+_RTT = 0.0
+
+
+def _timed_passes(run_pass):
+    """Median per-pass seconds over unique-operand passes, >= MIN_WALL_S
+    total measured wall; each pass must end with its own drain inside."""
+    times = []
+    wall = 0.0
+    while (wall < MIN_WALL_S or len(times) < MIN_PASSES) \
+            and len(times) < MAX_PASSES:
+        t0 = time.perf_counter()
+        run_pass()
+        dt = time.perf_counter() - t0
+        wall += dt
+        times.append(max(dt - _RTT, 1e-9))
+    return _median(times), len(times)
 
 
 def _chunk_gen(s, n, base_col):
@@ -59,55 +113,102 @@ def _chunk_gen(s, n, base_col):
     return ts, val, mask
 
 
+_GEN = None
+
+
+def _gen_fn():
+    """Module-level jitted chunk generator — one compile cache for every
+    pass (a per-pass jax.jit wrapper would land its recompile inside the
+    gen calibration that gets SUBTRACTED from measured time, inflating
+    the reported throughput)."""
+    global _GEN
+    if _GEN is None:
+        import jax
+        _GEN = jax.jit(_chunk_gen, static_argnums=(0, 1))
+    return _GEN
+
+
 # ------------------------------------------------------------------ #
+
+def _grouped_config(config: int, label: str, s: int, n: int, gid, g: int,
+                    spec, fixed, n_dev: int, reps_points: int) -> None:
+    """Shared shape of configs 1 and 3: one grouped dispatch per pass,
+    window origin shifted uniquely each pass."""
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops.pipeline import run_group_pipeline
+
+    gen = _gen_fn()
+    batch = gen(s, n, 0)
+    drain(batch)
+    wspec, wargs = fixed.split()
+    ts, val, mask = batch
+
+    def one_pass():
+        w = dict(wargs)
+        w["first"] = wargs["first"] - jnp.asarray(_UNIQ.next(), jnp.int64)
+        drain(run_group_pipeline(spec, ts, val, mask, gid, g, w))
+
+    one_pass()  # compile
+    per_pass, n_passes = _timed_passes(one_pass)
+    _note("config %d: %d passes, median %.4fs" % (config, n_passes,
+                                                  per_pass))
+    _emit(config, label, reps_points, per_pass, n_dev)
+
 
 def config1(scale: float, n_dev: int) -> None:
     """1M pts, one series, avg 1h — through the production grouped path."""
-    import jax
     import jax.numpy as jnp
-    from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
-    from opentsdb_tpu.ops.pipeline import (
-        PipelineSpec, DownsampleStep, run_group_pipeline)
+    from opentsdb_tpu.ops.downsample import FixedWindows
+    from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
 
     n = max(int(1_000_000 * scale), 1024)
-    ts, val, mask = jax.jit(lambda: _chunk_gen(1, n, 0))()
-    gid = jnp.zeros(1, jnp.int64)
     fixed = FixedWindows.for_range(START, START + n * STEP_MS, 3_600_000)
-    wspec, wargs = fixed.split()
+    wspec, _ = fixed.split()
     spec = PipelineSpec("sum", DownsampleStep("avg", wspec, "none", 0.0))
-    run_group_pipeline(spec, ts, val, mask, gid, 1, wargs)  # compile
-    t0 = time.perf_counter()
-    reps = 5
-    out = None
-    for _ in range(reps):
-        out = run_group_pipeline(spec, ts, val, mask, gid, 1, wargs)
-    jax.block_until_ready(out)
-    _emit(1, "1M pts single-series avg-1h", n * reps,
-          time.perf_counter() - t0, n_dev)
+    _grouped_config(1, "1M pts single-series avg-1h", 1, n,
+                    jnp.zeros(1, jnp.int64), 1, spec, fixed, n_dev, n)
 
 
-def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes):
-    """Generate+accumulate `chunks` chunks; return elapsed minus gen-only."""
-    import jax
+def config3(scale: float, n_dev: int) -> None:
+    """Group-by over 10k tag-series + avg downsample — one dispatch."""
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
+    from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
+
+    s = max(int(10_240 * scale), 64)
+    n = 2048
+    fixed = FixedWindows.for_range(START, START + n * STEP_MS, 3_600_000)
+    wspec, _ = fixed.split()
+    spec = PipelineSpec("avg", DownsampleStep("avg", wspec, "none", 0.0))
+    _grouped_config(3, "10k-series group-by avg downsample", s, n,
+                    jnp.arange(s, dtype=jnp.int64), pad_pow2(s), spec,
+                    fixed, n_dev, s * n)
+
+
+def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
+                 sketch: bool = False):
+    """Generate+accumulate `chunks` chunks starting at column base0;
+    returns (elapsed_minus_gen, finish outputs).  Every chunk base is
+    unique (caller advances base0 per pass); generation is calibrated with
+    its own drains over a disjoint base range."""
     from opentsdb_tpu.ops.streaming import StreamAccumulator
 
-    gen = jax.jit(_chunk_gen, static_argnums=(0, 1))
+    gen = _gen_fn()
 
-    # Calibrate generation cost alone.
+    # Calibrate generation cost alone (disjoint bases; drained per chunk).
+    cal0 = base0 + chunks * n_chunk
     t0 = time.perf_counter()
     for k in range(chunks):
-        jax.block_until_ready(gen(s, n_chunk, k * n_chunk))
-    gen_time = time.perf_counter() - t0
+        drain(gen(s, n_chunk, cal0 + k * n_chunk))
+    gen_time = max(time.perf_counter() - t0 - _RTT * chunks, 0.0)
 
-    acc = StreamAccumulator.create(s, wspec, wargs)
-    acc.update(*gen(s, n_chunk, 0))  # compile
-    acc = StreamAccumulator.create(s, wspec, wargs)
+    acc = StreamAccumulator.create(s, wspec, wargs, sketch=sketch)
     t0 = time.perf_counter()
     for k in range(chunks):
-        acc.update(*gen(s, n_chunk, k * n_chunk))
+        acc.update(*gen(s, n_chunk, base0 + k * n_chunk))
     outs = [acc.finish(f) for f in finishes]
-    jax.block_until_ready(outs)
-    elapsed = time.perf_counter() - t0
+    drain(outs)
+    elapsed = time.perf_counter() - t0 - _RTT
     return max(elapsed - gen_time, 1e-9), outs
 
 
@@ -120,44 +221,35 @@ def config2(scale: float, n_dev: int) -> None:
     n_chunk = 65_536
     chunks = max(total // (s * n_chunk), 1)
     span = n_chunk * chunks * STEP_MS
-    fixed = FixedWindows.for_range(START, START + span, 10_000)
-    wspec, wargs = fixed.split()
-    secs, _ = _stream_pass(s, n_chunk, chunks, wspec, wargs,
-                           ["sum", "min", "max", "count"])
+    points = s * n_chunk * chunks
+
+    def one_pass():
+        # unique chunk base AND matching window origin per pass
+        base0 = _UNIQ.next(1 << 26)
+        pass_start = START + base0 * STEP_MS
+        fixed = FixedWindows.for_range(pass_start, pass_start + span,
+                                       10_000)
+        wspec, wargs = fixed.split()
+        secs, _ = _stream_pass(s, n_chunk, chunks, wspec, wargs,
+                               ["sum", "min", "max", "count"], base0)
+        return secs
+
+    one_pass()  # compile (wspec is shape-stable across passes)
+    times = []
+    wall = 0.0
+    while (wall < MIN_WALL_S or len(times) < MIN_PASSES) \
+            and len(times) < 8:
+        secs = one_pass()
+        times.append(secs)
+        wall += secs
+    _note("config 2: %d passes, median %.3fs" % (len(times),
+                                                 _median(times)))
     _emit(2, "100M pts multi-agg 10s downsample (streamed)",
-          s * n_chunk * chunks, secs, n_dev)
-
-
-def config3(scale: float, n_dev: int) -> None:
-    """Group-by over 10k tag-series + avg downsample — one dispatch."""
-    import jax
-    import jax.numpy as jnp
-    from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
-    from opentsdb_tpu.ops.pipeline import (
-        PipelineSpec, DownsampleStep, run_group_pipeline)
-
-    s = max(int(10_240 * scale), 64)
-    n = 2048
-    ts, val, mask = jax.jit(lambda: _chunk_gen(s, n, 0))()
-    gid = jnp.arange(s, dtype=jnp.int64)  # every series its own group
-    fixed = FixedWindows.for_range(START, START + n * STEP_MS, 3_600_000)
-    wspec, wargs = fixed.split()
-    spec = PipelineSpec("avg", DownsampleStep("avg", wspec, "none", 0.0))
-    g = pad_pow2(s)
-    run_group_pipeline(spec, ts, val, mask, gid, g, wargs)  # compile
-    t0 = time.perf_counter()
-    reps = 3
-    out = None
-    for _ in range(reps):
-        out = run_group_pipeline(spec, ts, val, mask, gid, g, wargs)
-    jax.block_until_ready(out)
-    _emit(3, "10k-series group-by avg downsample", s * n * reps,
-          time.perf_counter() - t0, n_dev)
+          points, _median(times), n_dev)
 
 
 def config4(scale: float, n_dev: int) -> None:
     """rate + p99 over 500M pts: stream to grid, rate+percentile tail."""
-    import jax
     import jax.numpy as jnp
     from opentsdb_tpu.ops.downsample import FixedWindows
     from opentsdb_tpu.ops.pipeline import (
@@ -169,24 +261,36 @@ def config4(scale: float, n_dev: int) -> None:
     n_chunk = 65_536
     chunks = max(total // (s * n_chunk), 1)
     span = n_chunk * chunks * STEP_MS
-    fixed = FixedWindows.for_range(START, START + span, 60_000)
-    wspec, wargs = fixed.split()
-    t0 = time.perf_counter()
-    secs, outs = _stream_pass(s, n_chunk, chunks, wspec, wargs, ["avg"])
-    wts, v, m = outs[0]
-    spec = PipelineSpec("p99", DownsampleStep("avg", wspec, "none", 0.0),
+    fixed0 = FixedWindows.for_range(START, START + span, 60_000)
+    wspec0, _ = fixed0.split()
+    spec = PipelineSpec("p99", DownsampleStep("avg", wspec0, "none", 0.0),
                         rate=RateOptions())
     gid = jnp.zeros(s, jnp.int64)
-    tail = run_grid_tail(spec, wts, v, m, gid, 1)
-    jax.block_until_ready(tail)
-    tail_secs = time.perf_counter() - t0 - secs
+    points = s * n_chunk * chunks
+
+    def one_pass():
+        base0 = _UNIQ.next(1 << 26) * 6  # keep origin 60s-aligned
+        pass_start = START + base0 * STEP_MS
+        fixed = FixedWindows.for_range(pass_start, pass_start + span,
+                                       60_000)
+        wspec, wargs = fixed.split()
+        secs, outs = _stream_pass(s, n_chunk, chunks, wspec, wargs,
+                                  ["avg"], base0)
+        t0 = time.perf_counter()
+        wts, v, m = outs[0]
+        drain(run_grid_tail(spec, wts, v, m, gid, 1))
+        return secs + max(time.perf_counter() - t0 - _RTT, 0.0)
+
+    one_pass()  # compile
+    times = [one_pass() for _ in range(MIN_PASSES)]
+    _note("config 4: %d passes, median %.3fs" % (len(times),
+                                                 _median(times)))
     _emit(4, "rate+p99 over 500M pts (streamed grid + percentile tail)",
-          s * n_chunk * chunks, secs + max(tail_secs, 0), n_dev)
+          points, _median(times), n_dev)
 
 
 def config5(scale: float, n_dev: int) -> None:
     """1B pts -> 1m rollup lanes, time-chunked (write-side batch pass)."""
-    import jax
     from opentsdb_tpu.ops.downsample import FixedWindows
     from opentsdb_tpu.ops.streaming import StreamAccumulator
 
@@ -194,39 +298,86 @@ def config5(scale: float, n_dev: int) -> None:
     s = 1024
     n_chunk = 65_536
     chunks = max(total // (s * n_chunk), 1)
-    gen = jax.jit(_chunk_gen, static_argnums=(0, 1))
+    gen = _gen_fn()
+    span = n_chunk * STEP_MS
+    points = s * n_chunk * chunks
 
-    t0 = time.perf_counter()
-    for k in range(chunks):
-        jax.block_until_ready(gen(s, n_chunk, k * n_chunk))
-    gen_time = time.perf_counter() - t0
+    def gen_calibration(base0):
+        t0 = time.perf_counter()
+        for k in range(chunks):
+            drain(gen(s, n_chunk, base0 + k * n_chunk))
+        return max(time.perf_counter() - t0 - _RTT * chunks, 0.0)
 
     # Each time chunk's 1m windows are disjoint from the next chunk's, so
     # rollup rows (sum/count/min/max lanes) emit per chunk — the write-side
     # shape of TSDB.addAggregatePoint (:1359-1457) batched per window.
-    span = n_chunk * STEP_MS
-
-    def one_chunk(k: int) -> None:
-        chunk_start = START + k * span
+    def one_chunk(k: int, base0: int) -> None:
+        chunk_start = START + (base0 + k * n_chunk) * STEP_MS
         fixed = FixedWindows.for_range(chunk_start, chunk_start + span,
                                        60_000)
         wspec, wargs = fixed.split()
         acc = StreamAccumulator.create(s, wspec, wargs)
-        acc.update(*gen(s, n_chunk, k * n_chunk))
-        lanes = [acc.finish(f) for f in ("sum", "count", "min", "max")]
-        jax.block_until_ready(lanes)
+        acc.update(*gen(s, n_chunk, base0 + k * n_chunk))
+        drain([acc.finish(f) for f in ("sum", "count", "min", "max")])
 
-    one_chunk(0)  # compile (same [s, n_chunk] shape for every chunk)
+    one_chunk(0, _UNIQ.next(1 << 28))  # compile (same shapes every chunk)
+
+    def one_pass():
+        base0 = _UNIQ.next(1 << 28)
+        gen_time = gen_calibration(base0 + chunks * n_chunk)
+        t0 = time.perf_counter()
+        for k in range(chunks):
+            one_chunk(k, base0)
+        return max(time.perf_counter() - t0 - gen_time - _RTT * chunks,
+                   1e-9)
+
+    times = [one_pass() for _ in range(MIN_PASSES)]
+    _note("config 5: %d passes, median %.3fs" % (len(times),
+                                                 _median(times)))
+    _emit(5, "1B pts -> 1m rollup lanes (time-chunked)", points,
+          _median(times), n_dev)
+
+
+def config6(scale: float, n_dev: int) -> None:
+    """Host ingest: bulk /api/put path vs per-point, points/sec.
+
+    Pure host-side (no device dispatch): honest wall clock.  The emitted
+    vs_baseline is the speedup of the bulk path over the per-point path
+    (the reference's only write-scale claim is qualitative, README:12-15).
+    """
+    from opentsdb_tpu.core import TSDB
+    from opentsdb_tpu.utils.config import Config
+
+    n = max(int(400_000 * scale), 10_000)
+    hosts = 64
+    dps = [{"metric": "ingest.bench", "timestamp": 1_356_998_400 + i,
+            "value": float(i % 97) + 0.5, "tags": {"host": "h%d"
+                                                   % (i % hosts)}}
+           for i in range(n)]
+
+    t_bulk = TSDB(Config({"tsd.core.auto_create_metrics": True}))
     t0 = time.perf_counter()
-    for k in range(chunks):
-        one_chunk(k)
-    elapsed = max(time.perf_counter() - t0 - gen_time, 1e-9)
-    points = s * n_chunk * chunks
-    _emit(5, "1B pts -> 1m rollup lanes (time-chunked)", points, elapsed,
-          n_dev)
+    success, errors = t_bulk.add_points_bulk(dps)
+    bulk_secs = time.perf_counter() - t0
+    assert success == n and not errors
+
+    t_single = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    t0 = time.perf_counter()
+    for dp in dps:
+        t_single.add_point(dp["metric"], dp["timestamp"], dp["value"],
+                           dp["tags"])
+    single_secs = time.perf_counter() - t0
+
+    _note("config 6: bulk %.3fs, per-point %.3fs for %d pts"
+          % (bulk_secs, single_secs, n))
+    _emit(6, "bulk ingest points/sec (vs_baseline = speedup over "
+             "per-point add_point)", n, bulk_secs, 1,
+          unit="points/sec ingested",
+          baseline=n / max(single_secs, 1e-9))
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
 
 
 def main() -> None:
@@ -239,8 +390,11 @@ def main() -> None:
 
     import opentsdb_tpu.ops  # noqa: F401  (jax x64)
     import jax
+    global _RTT
     n_dev = len(jax.devices())
     _note("devices: %d (%s)" % (n_dev, jax.devices()[0].platform))
+    _RTT = measure_rtt()
+    _note("tunnel rtt: %.4fs" % _RTT)
 
     targets = [args.config] if args.config else sorted(CONFIGS)
     for c in targets:

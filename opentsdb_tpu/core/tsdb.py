@@ -151,6 +151,109 @@ class TSDB:
                                           "t": timestamp, "v": value,
                                           "g": dict(tags)})
 
+    def add_points_bulk(self, dps: list[dict]
+                        ) -> tuple[int, list[tuple[int, Exception]]]:
+        """Vectorized bulk ingest for POST /api/put bodies.
+
+        The reference writes each point through one addPoint call
+        (PutDataPointRpc.processDataPoint :309 -> TSDB.addPoint :1051);
+        per-point that costs a parse, a validation, a key resolution, a
+        lock and a journal write.  Here points validate individually (so
+        per-point error reporting survives) but group by series, and each
+        series takes ONE lock + ONE columnar append_batch; the WAL gets
+        one record per request.  Returns (success_count,
+        [(index, exception), ...]) with indexes into `dps`.
+        """
+        import numpy as np
+
+        if self.mode == "ro" and not self._replaying:
+            # per-point errors, like the per-point path raising from every
+            # add_point call — the RPC layer's accounting (hbase_errors,
+            # SEH spillway, 400 + summary) must see each rejected write
+            exc = RuntimeError("TSD is in read-only mode, writes rejected")
+            return 0, [(i, exc) for i in range(len(dps))]
+        errors: list[tuple[int, Exception]] = []
+        # key -> (ts_ms, float, exact-int, is_int, dp index, raw dp,
+        #         publish args) column lists
+        groups: dict = {}
+        key_cache: dict = {}
+        success = 0
+        for i, dp in enumerate(dps):
+            try:
+                for field in ("metric", "timestamp", "value", "tags"):
+                    if field not in dp or dp[field] in (None, "", {}):
+                        raise ValueError("Missing required field: %s"
+                                         % field)
+                metric = dp["metric"]
+                tags = dict(dp["tags"])
+                is_int, num = parse_value(dp["value"])
+                if is_int and not (-(1 << 63) <= num < (1 << 63)):
+                    # beyond Java long (the reference's parseLong rejects
+                    # it per point); without this check the group's int64
+                    # column build would fail EVERY point of the series
+                    raise ValueError("Invalid value, out of long range: %r"
+                                     % dp["value"])
+                self.check_timestamp_and_tags(metric, dp["timestamp"], num,
+                                              tags)
+                if self.write_filter is not None and \
+                        not self.write_filter.allow(metric, dp["timestamp"],
+                                                    num, tags):
+                    success += 1   # silently dropped, like _apply_point
+                    continue
+                ts_ms = normalize_timestamp_ms(dp["timestamp"])
+                if self.rollup_store is not None and self.tag_raw_data:
+                    tags[self.agg_tag_key] = self.raw_agg_tag_value
+                ck = (metric, tuple(sorted(tags.items())))
+                key = key_cache.get(ck)
+                if key is None:
+                    key = self._series_key(metric, tags, create=True)
+                    key_cache[ck] = key
+                bucket = groups.get(key)
+                if bucket is None:
+                    bucket = groups[key] = ([], [], [], [], [], [], [])
+                bucket[0].append(ts_ms)
+                bucket[1].append(float(num))
+                bucket[2].append(int(num) if is_int else 0)
+                bucket[3].append(is_int)
+                bucket[4].append(i)
+                bucket[5].append(dp)
+                if self.rt_publisher is not None:
+                    bucket[6].append((metric, ts_ms, num, tags, key))
+                success += 1
+            except Exception as e:
+                errors.append((i, e))
+        stored: list[dict] = []    # journal only what actually landed
+        publish: list = []
+        with self._ingest_lock:
+            for key, (tss, fvals, ivals, isints, idxs, raw,
+                      pubs) in groups.items():
+                try:
+                    ts_arr = np.asarray(tss, np.int64)
+                    self.store.add_batch(
+                        key, ts_arr, np.asarray(fvals, np.float64),
+                        np.asarray(isints, bool),
+                        ival=np.asarray(ivals, np.int64))
+                except Exception as e:
+                    # storage failure: every point of this series batch
+                    # reports it (SEH spillway parity with the per-point
+                    # path's storeIntoDB error callbacks)
+                    errors.extend((i, e) for i in idxs)
+                    success -= len(idxs)
+                    continue
+                with self._stats_lock:
+                    self.datapoints_added += len(tss)
+                self._track_meta(key, int(ts_arr.max()), n=len(tss))
+                stored.extend(raw)
+                publish.extend(pubs)
+            if self.persistence is not None and stored \
+                    and not self._replaying:
+                self.persistence.journal({"k": "pb", "d": stored})
+        for metric, ts_ms, num, tags, key in publish:
+            self.rt_publisher.publish_data_point(metric, ts_ms, num, tags,
+                                                 key.tsuid())
+        errors.sort(key=lambda t: t[0])
+        return success, errors
+
     def _apply_point(self, metric: str, timestamp: int | float, value,
                      tags: dict[str, str]) -> None:
         if self.mode == "ro" and not self._replaying:
@@ -450,16 +553,17 @@ class TSDB:
     # Annotations                                                        #
     # ------------------------------------------------------------------ #
 
-    def _track_meta(self, key, ts_ms: int) -> None:
+    def _track_meta(self, key, ts_ms: int, n: int = 1) -> None:
         """TSMeta maintenance on the write path (TSDB.java:1259-1285):
         counters only under enable_tsuid_tracking; realtime_ts creates and
-        indexes the TSMeta once per new series (TSMeta.storeIfNecessary)."""
+        indexes the TSMeta once per new series (TSMeta.storeIfNecessary).
+        `n` > 1 counts a whole bulk batch (ts_ms = the batch max)."""
         if not (self.enable_tsuid_tracking or self.enable_realtime_ts
                 or self.tree_processing):
             return
         tsuid = self.tsuid(key)
         created = self.meta_store.record_datapoint(
-            tsuid, ts_ms, count=self.enable_tsuid_tracking)
+            tsuid, ts_ms, count=self.enable_tsuid_tracking, n=n)
         if created and (self.tree_processing or (
                 self.enable_realtime_ts
                 and self.search_plugin is not None)):

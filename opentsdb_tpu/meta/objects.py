@@ -151,13 +151,15 @@ class MetaStore:
             return meta
 
     def record_datapoint(self, tsuid: str, ts_ms: int,
-                         count: bool = True) -> bool:
+                         count: bool = True, n: int = 1) -> bool:
         """Ensure the TSMeta row and (optionally) bump the counters.
 
         Returns True when this call created the TSMeta — the
         TSMeta.storeIfNecessary signal realtime indexing keys off.  Counters
         last_received/total_dps only move under
         tsd.core.meta.enable_tsuid_tracking (TSMeta.incrementAndGetCounter).
+        `n` lets the bulk ingest path count a whole batch in one call
+        (ts_ms should then be the batch's max timestamp).
         """
         key = tsuid.upper()
         with self._lock:
@@ -168,7 +170,7 @@ class MetaStore:
                 self._tsmeta[key] = meta
             if count:
                 meta.last_received = max(meta.last_received, ts_ms // 1000)
-                meta.total_dps += 1
+                meta.total_dps += n
         return created
 
     def delete_tsmeta(self, tsuid: str) -> bool:

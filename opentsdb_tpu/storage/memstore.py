@@ -114,8 +114,14 @@ class Series:
             self._n += 1
 
     def append_batch(self, ts_ms: np.ndarray, values: np.ndarray,
-                     is_int: np.ndarray | bool) -> None:
-        """Bulk ingest (TextImporter-style); arrays must be 1-D, same length."""
+                     is_int: np.ndarray | bool,
+                     ival: np.ndarray | None = None) -> None:
+        """Bulk ingest (TextImporter-style); arrays must be 1-D, same length.
+
+        Pass `ival` (exact int64 values where is_int) for mixed batches
+        whose integer points exceed 2^53 — a float64 `values` round-trip
+        would lose them (Java-long exactness, Internal.vleEncodeLong :963).
+        """
         m = len(ts_ms)
         if m == 0:
             return
@@ -124,7 +130,9 @@ class Series:
             isint = np.full(m, bool(is_int))
         else:
             isint = np.asarray(is_int, dtype=bool)
-        if np.issubdtype(values.dtype, np.integer):
+        if ival is not None:
+            ival = np.asarray(ival, dtype=np.int64)
+        elif np.issubdtype(values.dtype, np.integer):
             ival = values
         else:
             # Float-typed arrays may still carry integer points; the int
@@ -369,9 +377,10 @@ class MemStore:
         self.datapoints_added += 1
 
     def add_batch(self, key: SeriesKey, ts_ms: np.ndarray, values: np.ndarray,
-                  is_int: np.ndarray | bool) -> None:
+                  is_int: np.ndarray | bool,
+                  ival: np.ndarray | None = None) -> None:
         series = self.get_or_create_series(key)
-        series.append_batch(ts_ms, values, is_int)
+        series.append_batch(ts_ms, values, is_int, ival)
         if series.dirty:
             self.compaction_queue.add(series)
         self.datapoints_added += len(ts_ms)

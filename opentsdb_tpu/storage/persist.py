@@ -116,6 +116,19 @@ class DiskPersistence:
                     if kind == "p":
                         tsdb._apply_point(rec["m"], rec["t"], rec["v"],
                                           rec["g"])
+                    elif kind == "pb":
+                        # bulk put record: one WAL line per /api/put body.
+                        # Successful points have already landed, so a
+                        # partial failure must not mark the whole line
+                        # lost — count and log the failed points only.
+                        _, errs = tsdb.add_points_bulk(rec["d"])
+                        if errs:
+                            failed += len(errs)
+                            for i, e in errs[:3]:
+                                LOG.error(
+                                    "WAL bulk replay dropped point %d "
+                                    "of a %d-point record: %s", i,
+                                    len(rec["d"]), e)
                     elif kind == "r":
                         tsdb._apply_aggregate_point(
                             rec["m"], rec["t"], rec["v"], rec["g"],

@@ -122,32 +122,48 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
         tsdb.add_point(dp["metric"], dp["timestamp"], dp["value"],
                        dict(dp["tags"]))
 
+    def ingest_points(self, tsdb, dps: list[dict]
+                      ) -> tuple[int, list[tuple[int, Exception]]]:
+        """(success, [(index, exception)]).  Raw puts take the vectorized
+        bulk path; rollup/histogram records override with the per-point
+        loop through their own store_point."""
+        return tsdb.add_points_bulk(dps)
+
+    def _ingest_one_by_one(self, tsdb, dps: list[dict]
+                           ) -> tuple[int, list[tuple[int, Exception]]]:
+        success = 0
+        errors: list[tuple[int, Exception]] = []
+        for i, dp in enumerate(dps):
+            try:
+                self.store_point(tsdb, dp)
+                success += 1
+            except Exception as e:
+                errors.append((i, e))
+        return success, errors
+
     def process_data_points(self, tsdb, query: HttpQuery,
                             dps: list[dict]) -> None:
-        """processDataPoint (:309): per-point error collection, 204 on
-        clean success, details/summary modes."""
+        """processDataPoint (:309) semantics over the vectorized bulk
+        ingest: points validate individually (per-point error collection,
+        204 on clean success, details/summary modes) but land as one
+        columnar batch per series (TSDB.add_points_bulk)."""
         if not dps:
             raise BadRequestError("No datapoints found in content")
         show_details = query.has_query_string_param("details")
         show_summary = query.has_query_string_param("summary")
         details: list[dict] = []
-        success = 0
-        failed = 0
-        for dp in dps:
-            try:
-                self.store_point(tsdb, dp)
-                success += 1
-            except NoSuchUniqueName as e:
-                failed += 1
+        success, errors = self.ingest_points(tsdb, dps)
+        failed = len(errors)
+        for i, e in errors:
+            dp = dps[i]
+            if isinstance(e, NoSuchUniqueName):
                 self._count("unknown_metrics")
                 details.append({"error": "Unknown metric",
                                 "datapoint": dp})
-            except (ValueError, TypeError) as e:
-                failed += 1
+            elif isinstance(e, (ValueError, TypeError)):
                 self._count("illegal_arguments")
                 details.append({"error": str(e), "datapoint": dp})
-            except Exception as e:
-                failed += 1
+            else:
                 self._count("hbase_errors")
                 if tsdb.storage_exception_handler is not None:
                     # Failed-write spillway (TSDB.storeIntoDB error
@@ -192,6 +208,9 @@ class RollupDataPointRpc(PutDataPointRpc):
     """
 
     kind = "rollup"
+
+    def ingest_points(self, tsdb, dps):
+        return self._ingest_one_by_one(tsdb, dps)
 
     def import_telnet_point(self, tsdb, words: list[str]) -> None:
         if len(words) < 6:
@@ -255,6 +274,9 @@ class HistogramDataPointRpc(PutDataPointRpc):
     """Telnet `histogram` + POST /api/histogram."""
 
     kind = "histogram"
+
+    def ingest_points(self, tsdb, dps):
+        return self._ingest_one_by_one(tsdb, dps)
 
     def import_telnet_point(self, tsdb, words: list[str]) -> None:
         # histogram <codec_id> <metric> <ts> <base64 or json value> tag=v...

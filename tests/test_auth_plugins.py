@@ -94,16 +94,17 @@ class TestPluginWiring:
                 "tests.plugin_fixtures:RecordingSEH"}))
         m = RpcManager(t)
 
-        # Force a storage-layer error via a broken store method.
-        orig = t.store.add_point
+        # Force a storage-layer error via a broken store method (the bulk
+        # put path lands points through add_batch).
+        orig = t.store.add_batch
         def boom(*a, **k):
             raise RuntimeError("storage down")
-        t.store.add_point = boom
+        t.store.add_batch = boom
         q = m.handle_http(HttpRequest(
             method="POST", uri="/api/put?details",
             body=json.dumps({"metric": "m", "timestamp": BASE,
                              "value": 1, "tags": {"h": "a"}}).encode()))
-        t.store.add_point = orig
+        t.store.add_batch = orig
         assert len(t.storage_exception_handler.errors) == 1
         assert "storage down" in t.storage_exception_handler.errors[0][1]
 
