@@ -4,6 +4,8 @@ Measures the production `/api/query` pipeline (same shape as bench.py)
 under each combination of:
   * scan mode: flat one-pass cumsum  vs  blocked two-level scan
   * timestamp compaction: int64 ms  vs  int32 ms-offsets
+  * value accumulation: float64 (default, Java-double parity)  vs  the
+    float32 fast mode (set_value_precision('single'))
 
 using the honest drain-based timing from bench.py (unique operands per
 dispatch, host-fetch sync, RTT-subtracted per-dispatch medians — see
@@ -38,14 +40,18 @@ def main() -> None:
     bench._note("rtt %.4fs" % rtt)
 
     configs = [
-        ("flat+int64", "flat", False),
-        ("flat+int32", "flat", True),
-        ("blocked+int64", "blocked", False),
-        ("blocked+int32", "blocked", True),
+        ("flat+int64", "flat", False, "double"),
+        ("flat+int32", "flat", True, "double"),
+        ("blocked+int64", "blocked", False, "double"),
+        ("blocked+int32", "blocked", True, "double"),
+        # fast mode: float32 accumulation (native ALUs; NOT the default —
+        # breaks the 1e-9 Java-double parity contract, documented)
+        ("blocked+int32+f32", "blocked", True, "single"),
     ]
-    for name, mode, compact in configs:
+    for name, mode, compact, precision in configs:
         ds.set_scan_mode(mode)        # setters clear the jit caches
         ds.set_ts_compaction(compact)
+        ds.set_value_precision(precision)
         drain(dispatch(spec, g_pad, batch, wargs, origins.next()))  # compile
         samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins,
                                         rtt)
@@ -59,6 +65,7 @@ def main() -> None:
     # restore defaults
     ds.set_scan_mode("blocked")
     ds.set_ts_compaction(True)
+    ds.set_value_precision("double")
 
 
 if __name__ == "__main__":

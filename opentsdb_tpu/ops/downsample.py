@@ -172,6 +172,14 @@ _I32_BIG = np.int64(2**31 - 2)
 
 _COMPACT_ENABLED = True
 
+# Value-accumulation precision for the prefix hot path.  "double" (default)
+# is the numeric contract — the reference accumulates in Java double
+# (Downsampler.java:257) and the golden tests pin 1e-9 agreement.  "single"
+# runs the cumsum in float32 (native TPU ALUs; f64 is emulated) at
+# ~n_points_per_window * 6e-8 relative error — a documented fast mode for
+# dashboards, never the default.
+_VALUE_PRECISION = "double"
+
 
 def _clear_dependent_caches() -> None:
     """Drop every compiled program that baked in the hot-path toggles.
@@ -209,6 +217,16 @@ def set_ts_compaction(enabled: bool) -> None:
     affected jit caches."""
     global _COMPACT_ENABLED
     _COMPACT_ENABLED = bool(enabled)
+    _clear_dependent_caches()
+
+
+def set_value_precision(mode: str) -> None:
+    """'double' | 'single' — prefix-path accumulation dtype; clears
+    affected jit caches.  See _VALUE_PRECISION above for the contract."""
+    global _VALUE_PRECISION
+    if mode not in ("double", "single"):
+        raise ValueError("precision must be 'double' or 'single'")
+    _VALUE_PRECISION = mode
     _clear_dependent_caches()
 
 
@@ -301,7 +319,8 @@ def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
         else jnp.float64
     vf = val.astype(fdtype)
     ok = mask & ~jnp.isnan(vf)
-    v0 = jnp.where(ok, vf, 0)
+    acc_dtype = jnp.float32 if _VALUE_PRECISION == "single" else fdtype
+    v0 = jnp.where(ok, vf, 0).astype(acc_dtype)
 
     cts, cedges = _compact_ts(ts, spec, wargs)
     idx = jax.vmap(
@@ -314,11 +333,11 @@ def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
     total = windowed(v0)
     safe = jnp.maximum(count, 1)
     if agg_name in ("sum", "zimsum", "pfsum"):
-        return total, count
+        return total.astype(fdtype), count
     if agg_name == "avg":
-        return total / safe, count
+        return (total / safe).astype(fdtype), count
     if agg_name == "squareSum":
-        return windowed(v0 * v0), count
+        return windowed(v0 * v0).astype(fdtype), count
     if agg_name == "dev":
         # Two-pass centered moment (matches the segment path's numerics):
         # per-point window mean via the same edge-search, then one more
@@ -326,10 +345,11 @@ def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
         mean = total / safe
         win = jnp.clip(window_ids(ts, spec, wargs), 0, w - 1)
         mean_pp = jnp.take_along_axis(mean, win, axis=1)
-        centered = jnp.where(ok, vf - mean_pp, 0)
+        centered = jnp.where(ok, vf - mean_pp, 0).astype(acc_dtype)
         m2 = windowed(centered * centered)
         return jnp.where(count >= 2,
-                         jnp.sqrt(m2 / jnp.maximum(count - 1, 1)), 0.0), count
+                         jnp.sqrt(m2 / jnp.maximum(count - 1, 1))
+                         .astype(fdtype), 0.0), count
     raise KeyError("No prefix-sum path for: " + agg_name)
 
 

@@ -196,3 +196,45 @@ class TestScanModesAndCompaction:
         assert cedges.dtype == jnp.int32
         # pads (int64 max) stay at the sorted tail after clipping
         assert bool((np.diff(np.asarray(cts), axis=1) >= 0).all())
+
+
+class TestSinglePrecisionMode:
+    """Opt-in f32 accumulation (set_value_precision): documented fast mode;
+    must stay within float32 tolerance of the double path and never be the
+    default."""
+
+    def test_default_is_double(self):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        assert ds_mod._VALUE_PRECISION == "double"
+
+    @pytest.mark.parametrize("agg", ["sum", "avg", "dev", "squareSum"])
+    def test_single_within_f32_tolerance(self, agg):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        rng = np.random.default_rng(17)
+        ts = np.full((3, 1024), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((3, 1024), np.float64)
+        mask = np.zeros((3, 1024), bool)
+        for i in range(3):
+            k = 1000
+            ts[i, :k] = START + np.sort(
+                rng.choice(10_000_000, size=k, replace=False))
+            val[i, :k] = rng.normal(100.0, 10.0, k)
+            mask[i, :k] = True
+        windows = FixedWindows.for_range(START, START + 10_000_000,
+                                         3_600_000)
+        spec, wargs = windows.split()
+        _, want, wmask = downsample(ts, val, mask, agg, spec, wargs,
+                                    FILL_NONE)
+        ds_mod.set_value_precision("single")
+        try:
+            _, got, gmask = downsample(ts, val, mask, agg, spec, wargs,
+                                       FILL_NONE)
+        finally:
+            ds_mod.set_value_precision("double")
+        want = np.asarray(want)
+        got = np.asarray(got)
+        m = np.asarray(wmask)
+        np.testing.assert_array_equal(np.asarray(gmask), m)
+        assert got.dtype == want.dtype == np.float64  # contract: f64 out
+        # ~350 points/window in f32: relative error bounded by ~n*eps
+        np.testing.assert_allclose(got[m], want[m], rtol=5e-4, atol=1e-3)

@@ -1,0 +1,48 @@
+"""jax.profiler harness for the production query pipeline (VERDICT r2 #2).
+
+Captures an XLA trace of the headline bench dispatch so the hot ops
+(cumsum, searchsorted, gathers, segment reductions) can be attributed:
+
+    python tools/profile_query.py [--outdir /tmp/tsdb_profile] [--passes 3]
+
+View with TensorBoard's profile plugin or xprof.  Each profiled pass uses
+a unique window origin and ends in a host drain (same honesty rules as
+bench.py — `block_until_ready` does not wait on this platform, so traces
+bounded by it would be empty).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--outdir", default="/tmp/tsdb_profile")
+    ap.add_argument("--passes", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    from bench import (_OriginSequence, build_spec, dispatch, drain,
+                       make_batch, _note)
+
+    batch = make_batch()
+    spec, wargs, g_pad = build_spec()
+    origins = _OriginSequence()
+    drain(dispatch(spec, g_pad, batch, wargs, origins.next()))  # compile
+    _note("compiled; tracing %d passes -> %s" % (args.passes, args.outdir))
+
+    with jax.profiler.trace(args.outdir):
+        for _ in range(args.passes):
+            out = dispatch(spec, g_pad, batch, wargs, origins.next())
+            drain(out)
+    _note("trace written to %s" % args.outdir)
+
+
+if __name__ == "__main__":
+    main()
