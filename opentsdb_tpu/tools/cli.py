@@ -44,10 +44,33 @@ def _add_common(p: argparse.ArgumentParser) -> None:
 # ------------------------------------------------------------------ #
 
 def cmd_import(args) -> int:
+    """Bulk text import (TextImporter.java role).
+
+    Lines parse into put dicts and flush through the vectorized
+    add_points_bulk in batches — one columnar append per series per
+    batch, one WAL record per batch — with per-line error reporting."""
+    BATCH = 50_000
     tsdb = make_tsdb(args)
     points = 0
     errors = 0
     start = time.time()
+    pending: list[dict] = []
+    origins: list[tuple[str, int]] = []   # (path, lineno) per pending dp
+
+    def flush() -> None:
+        nonlocal points, errors
+        if not pending:
+            return
+        success, errs = tsdb.add_points_bulk(pending)
+        points += success
+        errors += len(errs)
+        for i, e in errs:
+            path, lineno = origins[i]
+            print("Error at %s:%d: %s" % (path, lineno, e),
+                  file=sys.stderr)
+        pending.clear()
+        origins.clear()
+
     for path in args.files:
         opener = gzip.open if path.endswith(".gz") else open
         with opener(path, "rt") as fh:
@@ -68,14 +91,18 @@ def cmd_import(args) -> int:
                         if not k or not v:
                             raise ValueError("invalid tag: " + w)
                         tags[k] = v
-                    tsdb.add_point(words[0], float(words[1])
-                                   if "." in words[1] else int(words[1]),
-                                   words[2], tags)
-                    points += 1
+                    ts = float(words[1]) if "." in words[1] \
+                        else int(words[1])
+                    pending.append({"metric": words[0], "timestamp": ts,
+                                    "value": words[2], "tags": tags})
+                    origins.append((path, lineno))
                 except Exception as e:
                     errors += 1
                     print("Error at %s:%d: %s" % (path, lineno, e),
                           file=sys.stderr)
+                if len(pending) >= BATCH:
+                    flush()
+    flush()
     tsdb.shutdown()
     elapsed = time.time() - start
     rate = points / elapsed if elapsed > 0 else 0
