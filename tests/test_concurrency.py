@@ -1,0 +1,134 @@
+"""Concurrent ingest + query stress.
+
+The reference's NEWS records races in the scan path ("Fix races in the
+salt scanner and multigets", NEWS:27); our equivalents are the Series
+lock (normalize-under-read), the CompactionQueue, and the bulk-ingest
+grouping.  These tests hammer writers (per-point, bulk, out-of-order)
+against concurrent readers and assert no exceptions and no lost points.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+def mk_tsdb():
+    return TSDB(Config({"tsd.core.auto_create_metrics": True,
+                        "tsd.storage.fix_duplicates": True}))
+
+
+class TestConcurrentIngestQuery:
+    def test_writers_vs_readers_no_loss(self):
+        tsdb = mk_tsdb()
+        n_writers, per_writer = 4, 500
+        errors = []
+        done = threading.Event()
+
+        def writer(w):
+            try:
+                for k in range(per_writer):
+                    # interleave in-order and out-of-order appends
+                    ts = BASE + (k if k % 3 else per_writer - k) + w * 10_000
+                    tsdb.add_point("c.m", ts, k, {"host": "w%d" % w})
+            except Exception as e:            # pragma: no cover
+                errors.append(e)
+
+        def bulk_writer(w):
+            try:
+                for k in range(0, per_writer, 50):
+                    dps = [{"metric": "c.bulk", "timestamp":
+                            BASE + k + i + w * 10_000, "value": i,
+                            "tags": {"host": "b%d" % w}}
+                           for i in range(50)]
+                    s, errs = tsdb.add_points_bulk(dps)
+                    assert s == 50 and not errs
+            except Exception as e:            # pragma: no cover
+                errors.append(e)
+
+        def reader():
+            try:
+                while not done.is_set():
+                    q = TSQuery(start=str(BASE - 10),
+                                end=str(BASE + 100_000),
+                                queries=[parse_m_subquery("sum:c.m")])
+                    q.validate()
+                    tsdb.new_query_runner().run(q)
+            except Exception as e:            # pragma: no cover
+                errors.append(e)
+
+        def flusher():
+            while not done.is_set():
+                tsdb.store.compaction_queue.flush()
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(n_writers)]
+        threads += [threading.Thread(target=bulk_writer, args=(w,))
+                    for w in range(2)]
+        aux = [threading.Thread(target=reader),
+               threading.Thread(target=flusher)]
+        for t in aux + threads:
+            t.start()
+        for t in threads:
+            t.join()
+        done.set()
+        for t in aux:
+            t.join()
+
+        assert not errors, errors
+        # no lost per-point writes (ooo interleave has ts collisions within
+        # a writer resolved last-write-wins, so count unique ts per writer)
+        expect = sum(
+            len({(k if k % 3 else per_writer - k) for k in
+                 range(per_writer)}) for _ in range(n_writers))
+        got = 0
+        for s in tsdb.store.all_series():
+            if tsdb.metrics.get_name(s.key.metric) == "c.m":
+                s.normalize()
+                got += len(s)
+        assert got == expect
+        # no lost bulk writes
+        got_bulk = sum(len(s) for s in tsdb.store.all_series()
+                       if tsdb.metrics.get_name(s.key.metric) == "c.bulk")
+        assert got_bulk == 2 * per_writer
+
+    def test_normalize_under_concurrent_append(self):
+        """A read (which normalizes under the series lock) racing interior
+        appends must never corrupt sort order or drop points."""
+        tsdb = mk_tsdb()
+        stop = threading.Event()
+        errors = []
+
+        def appender():
+            rng = np.random.default_rng(7)
+            k = 0
+            while not stop.is_set() and k < 3000:
+                ts = BASE + int(rng.integers(0, 5000))
+                tsdb.add_point("r.m", ts, k, {"host": "a"})
+                k += 1
+
+        def windower():
+            try:
+                while not stop.is_set():
+                    for s in tsdb.store.all_series():
+                        ts, _, _, _ = s.window(0, 1 << 62)
+                        if len(ts) > 1:
+                            assert bool((np.diff(ts) > 0).all()), \
+                                "window returned unsorted/duplicated data"
+            except Exception as e:            # pragma: no cover
+                errors.append(e)
+
+        a = threading.Thread(target=appender)
+        w = threading.Thread(target=windower)
+        a.start()
+        w.start()
+        a.join()
+        stop.set()
+        w.join()
+        assert not errors, errors
