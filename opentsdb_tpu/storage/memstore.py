@@ -216,12 +216,58 @@ class Series:
         concurrent out-of-order append cannot invalidate the sort mid-read.
         """
         with self._lock:
-            self._normalize_locked(fix_duplicates)
-            n = self._n
-            lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
-            hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))
+            lo, hi = self._window_bounds_locked(start_ms, end_ms,
+                                                fix_duplicates)
             return (self._ts[lo:hi].copy(), self._val[lo:hi].copy(),
                     self._ival[lo:hi].copy(), self._isint[lo:hi].copy())
+
+    def _window_bounds_locked(self, start_ms: int, end_ms: int,
+                              fix_duplicates: bool) -> tuple[int, int]:
+        """(lo, hi) buffer indexes of [start_ms, end_ms] — callers hold
+        the lock.  The single definition of the window bound semantics
+        shared by window(), window_count(), window_chunk() and
+        delete_range()."""
+        self._normalize_locked(fix_duplicates)
+        n = self._n
+        lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
+        hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))
+        return lo, hi
+
+    def window_count(self, start_ms: int, end_ms: int,
+                     fix_duplicates: bool = True) -> int:
+        """Points in [start_ms, end_ms] without materializing them
+        (budget charging / streaming-path planning)."""
+        with self._lock:
+            lo, hi = self._window_bounds_locked(start_ms, end_ms,
+                                                fix_duplicates)
+            return hi - lo
+
+    def window_chunk(self, start_ms: int, end_ms: int,
+                     after_ts: int | None, limit: int,
+                     fix_duplicates: bool = True
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """Copy up to `limit` window points with timestamp > `after_ts`
+        (None = from the window start) — the streaming scan's cursor read.
+
+        The cursor is a TIMESTAMP, not an index: concurrent out-of-order
+        writes (or the dedup a normalize performs) shift buffer positions
+        between calls, so an index cursor could double-read or skip
+        pre-existing points.  Timestamp progression is monotone — each
+        pre-existing point is returned at most once; a point landing
+        behind the cursor mid-query is a new write, which the streaming
+        pass's documented contract (like the reference's scanner over live
+        rows, SaltScanner.java:269) already excludes from visibility
+        guarantees.  Returns (ts, float_vals).
+        """
+        with self._lock:
+            lo, hi = self._window_bounds_locked(start_ms, end_ms,
+                                                fix_duplicates)
+            n = self._n
+            if after_ts is not None:
+                lo = max(lo, int(np.searchsorted(self._ts[:n], after_ts,
+                                                 side="right")))
+            b = min(lo + max(limit, 0), hi)
+            return self._ts[lo:b].copy(), self._val[lo:b].copy()
 
     def restore_arrays(self, ts: np.ndarray, val: np.ndarray,
                        ival: np.ndarray, isint: np.ndarray) -> None:
@@ -254,10 +300,9 @@ class Series:
         """Remove points with start_ms <= ts <= end_ms (query delete flag,
         TsdbQuery.setDelete / scanner DeleteRequest path)."""
         with self._lock:
-            self._normalize_locked(fix_duplicates)
+            lo, hi = self._window_bounds_locked(start_ms, end_ms,
+                                                fix_duplicates)
             n = self._n
-            lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
-            hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))
             removed = hi - lo
             if removed <= 0:
                 return 0

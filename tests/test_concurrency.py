@@ -30,6 +30,11 @@ class TestConcurrentIngestQuery:
         n_writers, per_writer = 4, 500
         errors = []
         done = threading.Event()
+        # pre-create the metrics so the reader can't race the first write's
+        # UID assignment (querying an unknown metric correctly errors)
+        tsdb.add_point("c.m", BASE - 1000, 0, {"host": "seed"})
+        tsdb.add_points_bulk([{"metric": "c.bulk", "timestamp": BASE - 1000,
+                               "value": 0, "tags": {"host": "seed"}}])
 
         def writer(w):
             try:
@@ -84,7 +89,7 @@ class TestConcurrentIngestQuery:
         assert not errors, errors
         # no lost per-point writes (ooo interleave has ts collisions within
         # a writer resolved last-write-wins, so count unique ts per writer)
-        expect = sum(
+        expect = 1 + sum(              # +1: the seed point
             len({(k if k % 3 else per_writer - k) for k in
                  range(per_writer)}) for _ in range(n_writers))
         got = 0
@@ -96,7 +101,7 @@ class TestConcurrentIngestQuery:
         # no lost bulk writes
         got_bulk = sum(len(s) for s in tsdb.store.all_series()
                        if tsdb.metrics.get_name(s.key.metric) == "c.bulk")
-        assert got_bulk == 2 * per_writer
+        assert got_bulk == 2 * per_writer + 1   # +1: the seed point
 
     def test_normalize_under_concurrent_append(self):
         """A read (which normalizes under the series lock) racing interior
