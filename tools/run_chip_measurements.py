@@ -1,0 +1,71 @@
+"""One-shot real-chip measurement session for round 3 artifacts.
+
+Runs, in order, each as a separate subprocess (the axon tunnel is
+exclusive and can wedge if a JAX process dies mid-dispatch — isolating
+stages means a crash loses one stage, not the session):
+
+  1. bench_prefix.py          — A/B the hot-path variants (JSON lines)
+  2. bench.py                 — headline number with the winning defaults
+  3. bench_configs.py         — BASELINE configs 1-7 at full scale
+
+Results append to BENCH_CONFIGS_r03.json (JSON lines + a trailing
+metadata line).  Run: python tools/run_chip_measurements.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "BENCH_CONFIGS_r03.json")
+
+
+def run_stage(name: str, argv: list[str], timeout: int) -> list[str]:
+    print("== %s ==" % name, file=sys.stderr, flush=True)
+    t0 = time.time()
+    proc = subprocess.run(argv, cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout)
+    sys.stderr.write(proc.stderr[-4000:])
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    print("== %s done rc=%d in %.0fs, %d json lines =="
+          % (name, proc.returncode, time.time() - t0, len(lines)),
+          file=sys.stderr, flush=True)
+    return lines
+
+
+def main() -> None:
+    results: list[dict] = []
+    for name, argv, timeout in [
+        ("bench_prefix", [sys.executable, "bench_prefix.py"], 3600),
+        ("bench", [sys.executable, "bench.py"], 1800),
+        ("bench_configs", [sys.executable, "bench_configs.py"], 5400),
+    ]:
+        try:
+            for ln in run_stage(name, argv, timeout):
+                rec = json.loads(ln)
+                rec["stage"] = name
+                results.append(rec)
+        except Exception as e:          # keep later stages alive
+            print("stage %s failed: %s" % (name, e), file=sys.stderr)
+            results.append({"stage": name, "error": str(e)})
+
+    with open(OUT, "w") as fh:
+        for rec in results:
+            fh.write(json.dumps(rec) + "\n")
+        fh.write(json.dumps({
+            "stage": "meta",
+            "recorded_unix": int(time.time()),
+            "methodology": "drain-synced (block_until_ready is a no-op on "
+                           "axon), unique operands per dispatch, RTT "
+                           "subtracted, >=1s wall per measurement; see "
+                           "bench.py docstring",
+        }) + "\n")
+    print("wrote %s (%d records)" % (OUT, len(results)))
+
+
+if __name__ == "__main__":
+    main()
