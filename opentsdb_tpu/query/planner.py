@@ -522,7 +522,8 @@ class QueryRunner:
         # no pre-existing point is ever streamed twice even when an out-of-
         # order write shifts buffer positions mid-query (see window_chunk)
         cursors: list[int | None] = [None] * s
-        for _ in range(-(-max_len // n_chunk)):
+        n_chunks_total = -(-max_len // n_chunk)
+        for chunk_i in range(n_chunks_total):
             ts = np.full((s_rows, n_chunk), PAD_TS, np.int64)
             val = np.zeros((s_rows, n_chunk), np.float64)
             mask = np.zeros((s_rows, n_chunk), bool)
@@ -536,6 +537,16 @@ class QueryRunner:
                     mask[i, :m] = True
                     cursors[i] = int(t[-1])
             update(ts, val, mask)
+            if (chunk_i + 1) % 16 == 0:
+                # Backpressure: updates enqueue asynchronously, and a long
+                # scan would otherwise stage hundreds of chunk transfers
+                # (GBs) ahead of the device.  Fetching one scalar of the
+                # accumulator state drains the queue to this point
+                # (block_until_ready does not wait on the axon tunnel);
+                # cadence 16 keeps the double-buffering overlap.
+                state = (sharded_acc.state if sharded_acc is not None
+                         else acc.state)
+                np.asarray(state["n"][:1, :1])
 
         if sharded_acc is not None:
             return sharded_acc.finish_tail(spec, gid, g_pad)
