@@ -510,9 +510,11 @@ class TSDB:
         if not self.config.get_bool("tsd.query.mesh.enable"):
             return None
         if self._query_mesh is _UNSET:
-            import jax
             from opentsdb_tpu.parallel import make_mesh
-            devices = jax.devices()
+            from opentsdb_tpu.parallel.distributed import (
+                maybe_init_distributed, host_major_devices)
+            maybe_init_distributed(self.config)
+            devices = host_major_devices()
             self._query_mesh = (make_mesh(len(devices), devices=devices)
                                 if len(devices) > 1 else None)
         return self._query_mesh

@@ -1,0 +1,72 @@
+"""Multi-host (DCN) initialization for the query mesh.
+
+The reference's distributed substrate is the asynchbase RPC fabric to
+HBase RegionServers plus ZooKeeper discovery (/root/reference/src/core/
+TSDB.java:235-253) — storage-side scale-out.  The TPU-native equivalent
+scales the COMPUTE mesh across hosts: `jax.distributed.initialize` joins
+every TSD process into one JAX runtime whose `jax.devices()` spans all
+hosts, and the existing shard_map kernels run unchanged — XLA routes
+collectives over ICI within a slice and DCN between hosts.
+
+Layout stance (scaling-book recipe): the series axis is the outer,
+host-spanning axis — row shards never exchange raw points, so the only
+DCN traffic is the reduced [G, W] / [S, W] grids (psum or the
+gather-to-owner all_gather), both orders of magnitude smaller than the
+scanned data.  The time axis stays within a host so the denser moment
+combines ride ICI.
+
+Config (all tsd.network.distributed.*):
+  coordinator     "host:port" of process 0 — presence enables multi-host
+  num_processes   total TSD processes in the cluster
+  process_id      this process's index (defaults to $JAX_PROCESS_ID)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOG = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_init_distributed(config) -> bool:
+    """Join the multi-host JAX runtime when configured; idempotent.
+
+    Returns True when running multi-host (after a successful initialize),
+    False for the ordinary single-host deployment.
+    """
+    global _initialized
+    coordinator = config.get_string("tsd.network.distributed.coordinator")
+    if not coordinator:
+        return False
+    if _initialized:
+        return True
+    num = config.get_int("tsd.network.distributed.num_processes")
+    pid_raw = config.get_string("tsd.network.distributed.process_id") \
+        or os.environ.get("JAX_PROCESS_ID", "")
+    if num <= 0 or pid_raw == "":
+        raise ValueError(
+            "tsd.network.distributed.coordinator is set but num_processes/"
+            "process_id are not — every TSD in the cluster needs all three")
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num,
+                               process_id=int(pid_raw))
+    _initialized = True
+    LOG.info("joined multi-host JAX runtime: %d processes, %d devices",
+             num, len(jax.devices()))
+    return True
+
+
+def host_major_devices():
+    """All visible devices ordered host-major (process_index, then id).
+
+    Feeding this order into make_mesh puts each host's chips contiguous
+    on the series axis, so the time-axis collectives stay intra-host
+    (ICI) and only the small reduced-grid combines cross DCN.
+    """
+    import jax
+    return sorted(jax.devices(),
+                  key=lambda d: (d.process_index, d.id))

@@ -16,6 +16,11 @@ DEFAULTS: dict[str, str] = {
     "tsd.mode": "rw",
     "tsd.no_diediedie": "false",
     "tsd.network.bind": "0.0.0.0",
+    # multi-host mesh (parallel/distributed.py): coordinator "host:port"
+    # of process 0 enables jax.distributed; all three must be set
+    "tsd.network.distributed.coordinator": "",
+    "tsd.network.distributed.num_processes": "0",
+    "tsd.network.distributed.process_id": "",
     "tsd.network.port": "",
     "tsd.network.worker_threads": "",
     "tsd.network.async_io": "true",

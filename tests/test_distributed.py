@@ -1,0 +1,57 @@
+"""Multi-host mesh initialization (parallel/distributed.py).
+
+Real multi-host cannot run in this environment; these pin the config
+gating, the fail-fast on partial config, idempotency, and the
+host-major device ordering contract that keeps time-axis collectives
+intra-host.
+"""
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.parallel import distributed
+from opentsdb_tpu.utils.config import Config
+
+
+class TestMaybeInitDistributed:
+    def setup_method(self):
+        distributed._initialized = False
+
+    def test_disabled_without_coordinator(self):
+        assert distributed.maybe_init_distributed(Config({})) is False
+
+    def test_partial_config_fails_fast(self):
+        conf = Config({"tsd.network.distributed.coordinator": "c0:1234"})
+        with pytest.raises(ValueError):
+            distributed.maybe_init_distributed(conf)
+
+    def test_initialize_called_once(self, monkeypatch):
+        calls = []
+
+        import jax
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: calls.append(kw))
+        conf = Config({
+            "tsd.network.distributed.coordinator": "c0:1234",
+            "tsd.network.distributed.num_processes": "4",
+            "tsd.network.distributed.process_id": "2",
+        })
+        assert distributed.maybe_init_distributed(conf) is True
+        assert distributed.maybe_init_distributed(conf) is True
+        assert calls == [{"coordinator_address": "c0:1234",
+                          "num_processes": 4, "process_id": 2}]
+
+    def test_host_major_ordering(self):
+        devs = distributed.host_major_devices()
+        keys = [(d.process_index, d.id) for d in devs]
+        assert keys == sorted(keys)
+        assert len(devs) == 8   # the virtual CPU mesh
+
+    def test_query_mesh_uses_host_major_devices(self):
+        tsdb = TSDB(Config({"tsd.query.mesh.enable": True}))
+        mesh = tsdb.query_mesh()
+        assert mesh is not None
+        flat = list(mesh.devices.flat)
+        keys = [(d.process_index, d.id) for d in flat]
+        assert keys == sorted(keys)
