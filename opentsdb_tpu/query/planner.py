@@ -109,6 +109,14 @@ class QueryRunner:
 
     def __init__(self, tsdb):
         self.tsdb = tsdb
+        # numeric execution telemetry for the last run() — merged into the
+        # query's QueryStats and served at /api/stats/query (the
+        # scanner-level stats of QueryStats.java:132, re-expressed for
+        # batch execution: points scanned, streamed chunks, mesh devices)
+        self.exec_stats: dict[str, float] = {}
+
+    def _bump(self, key: str, value: float) -> None:
+        self.exec_stats[key] = self.exec_stats.get(key, 0.0) + value
 
     # -- series selection ------------------------------------------------
 
@@ -407,6 +415,8 @@ class QueryRunner:
             "tsd.query.streaming.sketch_percentiles"))
         stream_ok = (seg.kind != "rollup_avg"
                      and (ds_fn in STREAMABLE_DS or sketchable))
+        self._bump("pointsScanned", total_points)
+        self._bump("seriesScanned", len(gid))
         if stream_ok and total_points > tsdb.config.get_int(
                 "tsd.query.streaming.point_threshold"):
             # Beyond the threshold the batch never materializes: bounded
@@ -444,6 +454,8 @@ class QueryRunner:
                     >= tsdb.config.get_int("tsd.query.mesh.min_series")):
                 from opentsdb_tpu.parallel import (
                     sharded_query_pipeline, shard_rows)
+                from opentsdb_tpu.parallel.sharded import n_devices
+                self.exec_stats["meshDevices"] = float(n_devices(mesh))
                 fn = sharded_query_pipeline(mesh, spec, g_pad)
                 d_ts, d_val, d_mask, d_gid = shard_rows(
                     mesh, ts, val, mask, gid, pad_gid_value=g_pad)
@@ -523,6 +535,10 @@ class QueryRunner:
         # order write shifts buffer positions mid-query (see window_chunk)
         cursors: list[int | None] = [None] * s
         n_chunks_total = -(-max_len // n_chunk)
+        self._bump("streamedChunks", n_chunks_total)
+        if sharded_acc is not None:
+            from opentsdb_tpu.parallel.sharded import n_devices
+            self.exec_stats["meshDevices"] = float(n_devices(mesh))
         for chunk_i in range(n_chunks_total):
             ts = np.full((s_rows, n_chunk), PAD_TS, np.int64)
             val = np.zeros((s_rows, n_chunk), np.float64)
@@ -702,6 +718,7 @@ class QueryRunner:
         return [merged[k] for k in sorted(merged)]
 
     def run(self, query: TSQuery) -> list[QueryResult]:
+        self.exec_stats = {}
         out = []
         for sub in query.queries:
             out.extend(self.run_sub(query, sub))

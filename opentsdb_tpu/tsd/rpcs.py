@@ -353,6 +353,7 @@ class QueryRpc(HttpRpc):
                 deleted = self._delete(tsdb, ts_query)
             if qs is not None:
                 qs.mark("aggregationTime")
+                qs.stats.update(runner.exec_stats)
             payload = query.serializer.format_query_v1(ts_query, results)
             if ts_query.show_summary or ts_query.show_stats:
                 payload.append({"statsSummary": {

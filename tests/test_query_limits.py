@@ -170,3 +170,32 @@ class TestEndToEnd:
         q.validate()
         with pytest.raises(QueryException):
             tsdb.new_query_runner().run(q)
+
+
+class TestExecStats:
+    """Execution telemetry surfaces at /api/stats/query (r3): points and
+    series scanned, streamed chunk count, mesh device count."""
+
+    def test_exec_stats_recorded(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.utils.config import Config
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                         "tsd.query.streaming.point_threshold": "50",
+                         "tsd.query.streaming.chunk_points": "64",
+                         "tsd.query.mesh.enable": False}))
+        for h in range(2):
+            for k in range(100):
+                t.add_point("es.m", 1356998400 + k * 5 + h, k,
+                            {"host": "h%d" % h})
+        runner = t.new_query_runner()
+        q = TSQuery(start="1356998400", end="1356999400",
+                    queries=[parse_m_subquery("sum:1m-avg:es.m")])
+        q.validate()
+        runner.run(q)
+        assert runner.exec_stats["pointsScanned"] == 200
+        assert runner.exec_stats["seriesScanned"] == 2
+        assert runner.exec_stats["streamedChunks"] >= 1
+        # a second run resets the counters
+        runner.run(q)
+        assert runner.exec_stats["pointsScanned"] == 200
