@@ -191,7 +191,7 @@ def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
     returns (elapsed_minus_gen, finish outputs).  Every chunk base is
     unique (caller advances base0 per pass); generation is calibrated with
     its own drains over a disjoint base range."""
-    from opentsdb_tpu.ops.streaming import StreamAccumulator
+    from opentsdb_tpu.ops.streaming import StreamAccumulator, lanes_for
 
     gen = _gen_fn()
 
@@ -202,7 +202,8 @@ def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
         drain(gen(s, n_chunk, cal0 + k * n_chunk))
     gen_time = max(time.perf_counter() - t0 - _RTT * chunks, 0.0)
 
-    acc = StreamAccumulator.create(s, wspec, wargs, sketch=sketch)
+    acc = StreamAccumulator.create(s, wspec, wargs, sketch=sketch,
+                                   lanes=lanes_for(finishes))
     t0 = time.perf_counter()
     for k in range(chunks):
         acc.update(*gen(s, n_chunk, base0 + k * n_chunk))
@@ -316,7 +317,10 @@ def config5(scale: float, n_dev: int) -> None:
         fixed = FixedWindows.for_range(chunk_start, chunk_start + span,
                                        60_000)
         wspec, wargs = fixed.split()
-        acc = StreamAccumulator.create(s, wspec, wargs)
+        from opentsdb_tpu.ops.streaming import lanes_for
+        acc = StreamAccumulator.create(
+            s, wspec, wargs,
+            lanes=lanes_for(("sum", "count", "min", "max")))
         acc.update(*gen(s, n_chunk, base0 + k * n_chunk))
         drain([acc.finish(f) for f in ("sum", "count", "min", "max")])
 

@@ -55,15 +55,46 @@ from opentsdb_tpu.ops.downsample import (
     WindowSpec, apply_fill, window_ids, window_timestamps,
     _compact_ts, _edge_prefix_builder, _sorted_runs, FILL_NONE)
 
-# Downsample functions whose window moments merge associatively (exact).
-STREAMABLE_DS = frozenset({
-    "sum", "zimsum", "pfsum", "count", "avg", "squareSum", "dev",
-    "min", "mimmin", "max", "mimmax", "first", "last", "diff", "mult"})
-
 # Summary points per (series, window) quantile sketch.
 SKETCH_K = 64
 
 _I64_MAX = np.iinfo(np.int64).max
+
+# Extra state lanes each downsample function's finish needs ("n" is always
+# present — it carries the output mask).  Restricting the accumulator to
+# the needed lanes removes ALL segment scatters from the streamed hot loop
+# for the additive family (lo/hi/first/last/prod are the scatter-heavy
+# lanes) and shrinks state memory accordingly.
+LANES_FOR = {
+    "sum": {"total"}, "zimsum": {"total"}, "pfsum": {"total"},
+    "count": set(), "avg": {"total"},
+    "squareSum": {"total", "m2"}, "dev": {"total", "m2"},
+    "min": {"lo"}, "mimmin": {"lo"}, "max": {"hi"}, "mimmax": {"hi"},
+    "first": {"first"}, "last": {"last"}, "diff": {"first", "last"},
+    "mult": {"prod"},
+}
+# Downsample functions whose window moments merge associatively (exact) —
+# derived from LANES_FOR so the two can never drift.
+STREAMABLE_DS = frozenset(LANES_FOR)
+_ALL_LANES = frozenset(
+    {"total", "m2", "lo", "hi", "first", "last", "prod"})
+
+
+def lanes_for(ds_functions) -> frozenset:
+    """Union of state lanes needed to finish the given ds functions.
+
+    Rank-based (sketch) functions contribute NO moment lanes — their
+    state is the sketch lane, enabled by the accumulators' `sketch` flag;
+    unknown functions fall back to every lane (conservative).
+    """
+    out: set = set()
+    for fn in ds_functions:
+        if is_sketch_ds(fn):
+            continue
+        out |= LANES_FOR.get(fn, _ALL_LANES)
+    if "m2" in out:
+        out.add("total")   # the centered pass needs the mean
+    return frozenset(out)
 
 
 def is_sketch_ds(name: str) -> bool:
@@ -81,17 +112,27 @@ def is_sketch_ds(name: str) -> bool:
     return False
 
 
-def _zero_state(s: int, w: int, sketch: bool = False) -> dict:
-    state = {
-        "n": jnp.zeros((s, w), jnp.int64),
-        "total": jnp.zeros((s, w), jnp.float64),
-        "m2": jnp.zeros((s, w), jnp.float64),
-        "lo": jnp.full((s, w), jnp.inf, jnp.float64),
-        "hi": jnp.full((s, w), -jnp.inf, jnp.float64),
-        "first": jnp.zeros((s, w), jnp.float64),
-        "last": jnp.zeros((s, w), jnp.float64),
-        "prod": jnp.ones((s, w), jnp.float64),
+def _zero_state(s: int, w: int, sketch: bool = False,
+                lanes: frozenset | None = None) -> dict:
+    """Zero accumulator state holding only the requested lanes
+    (None = every lane, the conservative default)."""
+    if lanes is None:
+        lanes = _ALL_LANES
+    if "m2" in lanes and "total" not in lanes:
+        raise ValueError("the m2 lane requires the total lane (use "
+                         "lanes_for())")
+    builders = {
+        "total": lambda: jnp.zeros((s, w), jnp.float64),
+        "m2": lambda: jnp.zeros((s, w), jnp.float64),
+        "lo": lambda: jnp.full((s, w), jnp.inf, jnp.float64),
+        "hi": lambda: jnp.full((s, w), -jnp.inf, jnp.float64),
+        "first": lambda: jnp.zeros((s, w), jnp.float64),
+        "last": lambda: jnp.zeros((s, w), jnp.float64),
+        "prod": lambda: jnp.ones((s, w), jnp.float64),
     }
+    state = {"n": jnp.zeros((s, w), jnp.int64)}
+    for name in lanes:
+        state[name] = builders[name]()
     if sketch:
         # q[s, w, j] = value at fractional rank (j+0.5)/K of the cell's
         # population seen so far (midpoint convention); counts live in "n".
@@ -100,12 +141,19 @@ def _zero_state(s: int, w: int, sketch: bool = False) -> dict:
 
 
 def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
+                   lanes: frozenset = _ALL_LANES,
                    with_sketch: bool = False):
-    """One chunk's per-(series, window) moments via the prefix-sum kernel."""
+    """One chunk's per-(series, window) moments, restricted to `lanes`.
+
+    The additive lanes (n/total/m2) ride the scatter-free prefix-sum
+    kernel; lo/hi/first/last/prod need per-point window membership and
+    cost one segment scatter each — skipped entirely when not requested,
+    which is the common case (sum/avg/count queries stream scatter-free).
+    """
     s, n = ts.shape
+    w = spec.count
     vf = val.astype(jnp.float64)
     ok = mask & ~jnp.isnan(vf)
-    v0 = jnp.where(ok, vf, 0.0)
 
     cts, cedges = _compact_ts(ts, spec, wargs)
     idx = jax.vmap(
@@ -113,46 +161,66 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     windowed = _edge_prefix_builder(s, n, idx)
 
     cnt = windowed(ok.astype(jnp.int32)).astype(jnp.int64)
-    tot = windowed(v0)
-    safe = jnp.maximum(cnt, 1)
-    mean = tot / safe
-    w = spec.count
-    raw_win = window_ids(ts, spec, wargs)
-    win = jnp.clip(raw_win, 0, w - 1)
-    mean_pp = jnp.take_along_axis(mean, win, axis=1)
-    centered = jnp.where(ok, vf - mean_pp, 0.0)
-    m2 = windowed(centered * centered)
+    out = {"n": cnt}
 
-    # min/max/first/last/prod need per-point window membership; the segment
-    # forms are fine here (one scatter per chunk, amortized over its points).
-    num = s * w + 1
-    valid = ok & (raw_win >= 0) & (raw_win < jnp.asarray(w, raw_win.dtype))
-    rows = jnp.arange(s, dtype=jnp.int64)[:, None]
-    seg = jnp.where(valid, rows * w + win, s * w).reshape(-1)
-    flat = jnp.where(valid, vf, 0.0).reshape(-1)
-    okf = valid.reshape(-1)
-    lo = jax.ops.segment_min(jnp.where(okf, flat, jnp.inf), seg,
-                             num_segments=num)[:-1].reshape(s, w)
-    hi = jax.ops.segment_max(jnp.where(okf, flat, -jnp.inf), seg,
-                             num_segments=num)[:-1].reshape(s, w)
-    pos = jnp.arange(s * n, dtype=jnp.int64)
-    first_i = jax.ops.segment_min(jnp.where(okf, pos, _I64_MAX), seg,
-                                  num_segments=num)[:-1]
-    last_i = jax.ops.segment_max(jnp.where(okf, pos, -1), seg,
-                                 num_segments=num)[:-1]
-    flat_v = vf.reshape(-1)
-    first_v = flat_v[jnp.clip(first_i, 0, s * n - 1)].reshape(s, w)
-    last_v = flat_v[jnp.clip(last_i, 0, s * n - 1)].reshape(s, w)
-    prod = jax.ops.segment_prod(jnp.where(okf, flat, 1.0), seg,
-                                num_segments=num)[:-1].reshape(s, w)
-    out = dict(n=cnt, total=tot, m2=m2, lo=lo, hi=hi, first=first_v,
-               last=last_v, prod=prod)
-    if with_sketch:
-        # Exact per-cell equi-rank grid for this chunk: value-sort within
-        # (series, window) runs, then interpolate the K midpoint ranks.
-        sorted_v, starts = _sorted_runs(flat, okf, seg, s * w)
-        out["q"] = _rank_grid(sorted_v, starts,
-                              cnt.reshape(-1)).reshape(s, w, SKETCH_K)
+    need_win = ("m2" in lanes or with_sketch
+                or lanes & {"lo", "hi", "first", "last", "prod"})
+    raw_win = window_ids(ts, spec, wargs) if need_win else None
+
+    if "total" in lanes:
+        v0 = jnp.where(ok, vf, 0.0)
+        tot = windowed(v0)
+        out["total"] = tot
+        if "m2" in lanes:
+            mean = tot / jnp.maximum(cnt, 1)
+            win = jnp.clip(raw_win, 0, w - 1)
+            mean_pp = jnp.take_along_axis(mean, win, axis=1)
+            centered = jnp.where(ok, vf - mean_pp, 0.0)
+            out["m2"] = windowed(centered * centered)
+
+    seg_lanes = lanes & {"lo", "hi", "first", "last", "prod"}
+    if seg_lanes or with_sketch:
+        num = s * w + 1
+        win = jnp.clip(raw_win, 0, w - 1)
+        valid = ok & (raw_win >= 0) & (raw_win
+                                       < jnp.asarray(w, raw_win.dtype))
+        rows = jnp.arange(s, dtype=jnp.int64)[:, None]
+        seg = jnp.where(valid, rows * w + win, s * w).reshape(-1)
+        flat = jnp.where(valid, vf, 0.0).reshape(-1)
+        okf = valid.reshape(-1)
+        if "lo" in seg_lanes:
+            out["lo"] = jax.ops.segment_min(
+                jnp.where(okf, flat, jnp.inf), seg,
+                num_segments=num)[:-1].reshape(s, w)
+        if "hi" in seg_lanes:
+            out["hi"] = jax.ops.segment_max(
+                jnp.where(okf, flat, -jnp.inf), seg,
+                num_segments=num)[:-1].reshape(s, w)
+        if seg_lanes & {"first", "last"}:
+            pos = jnp.arange(s * n, dtype=jnp.int64)
+            flat_v = vf.reshape(-1)
+            if "first" in seg_lanes:
+                first_i = jax.ops.segment_min(
+                    jnp.where(okf, pos, _I64_MAX), seg,
+                    num_segments=num)[:-1]
+                out["first"] = flat_v[
+                    jnp.clip(first_i, 0, s * n - 1)].reshape(s, w)
+            if "last" in seg_lanes:
+                last_i = jax.ops.segment_max(
+                    jnp.where(okf, pos, -1), seg,
+                    num_segments=num)[:-1]
+                out["last"] = flat_v[
+                    jnp.clip(last_i, 0, s * n - 1)].reshape(s, w)
+        if "prod" in seg_lanes:
+            out["prod"] = jax.ops.segment_prod(
+                jnp.where(okf, flat, 1.0), seg,
+                num_segments=num)[:-1].reshape(s, w)
+        if with_sketch:
+            # Exact per-cell equi-rank grid for this chunk: value-sort
+            # within (series, window) runs, interpolate K midpoint ranks.
+            sorted_v, starts = _sorted_runs(flat, okf, seg, s * w)
+            out["q"] = _rank_grid(sorted_v, starts,
+                                  cnt.reshape(-1)).reshape(s, w, SKETCH_K)
     return out
 
 
@@ -265,31 +333,37 @@ def sketch_quantile(q, n, pct):
 
 
 def _merge(state: dict, chunk: dict) -> dict:
-    """Associative merge of two moment sets (Chan et al. for m2)."""
+    """Associative merge of two moment sets, per present lane (Chan et al.
+    for m2)."""
     n1, n2 = state["n"], chunk["n"]
-    t1, t2 = state["total"], chunk["total"]
     n = n1 + n2
-    safe_n = jnp.maximum(n, 1).astype(jnp.float64)
-    nf1 = n1.astype(jnp.float64)
-    nf2 = n2.astype(jnp.float64)
-    # delta = mean2 - mean1 with empty sides contributing zero.
-    mean1 = t1 / jnp.maximum(nf1, 1.0)
-    mean2 = t2 / jnp.maximum(nf2, 1.0)
-    delta = jnp.where((n1 > 0) & (n2 > 0), mean2 - mean1, 0.0)
-    m2 = state["m2"] + chunk["m2"] + delta * delta * nf1 * nf2 / safe_n
     had = n1 > 0
     got = n2 > 0
-    merged = {
-        "n": n,
-        "total": t1 + t2,
-        "m2": m2,
-        "lo": jnp.minimum(state["lo"], chunk["lo"]),
-        "hi": jnp.maximum(state["hi"], chunk["hi"]),
-        # Chunks arrive in time order: first sticks, last overwrites.
-        "first": jnp.where(had, state["first"], chunk["first"]),
-        "last": jnp.where(got, chunk["last"], state["last"]),
-        "prod": state["prod"] * chunk["prod"],
-    }
+    merged = {"n": n}
+    if "total" in state:
+        t1, t2 = state["total"], chunk["total"]
+        merged["total"] = t1 + t2
+        if "m2" in state:
+            safe_n = jnp.maximum(n, 1).astype(jnp.float64)
+            nf1 = n1.astype(jnp.float64)
+            nf2 = n2.astype(jnp.float64)
+            # delta = mean2 - mean1 with empty sides contributing zero.
+            mean1 = t1 / jnp.maximum(nf1, 1.0)
+            mean2 = t2 / jnp.maximum(nf2, 1.0)
+            delta = jnp.where(had & got, mean2 - mean1, 0.0)
+            merged["m2"] = (state["m2"] + chunk["m2"]
+                            + delta * delta * nf1 * nf2 / safe_n)
+    if "lo" in state:
+        merged["lo"] = jnp.minimum(state["lo"], chunk["lo"])
+    if "hi" in state:
+        merged["hi"] = jnp.maximum(state["hi"], chunk["hi"])
+    # Chunks arrive in time order: first sticks, last overwrites.
+    if "first" in state:
+        merged["first"] = jnp.where(had, state["first"], chunk["first"])
+    if "last" in state:
+        merged["last"] = jnp.where(got, chunk["last"], state["last"])
+    if "prod" in state:
+        merged["prod"] = state["prod"] * chunk["prod"]
     if "q" in state:
         s, w, k = state["q"].shape
         merged["q"] = _merge_sketch(
@@ -299,7 +373,9 @@ def _merge(state: dict, chunk: dict) -> dict:
 
 
 def _update(spec: WindowSpec, state: dict, ts, val, mask, wargs: dict):
+    lanes = frozenset(state) & _ALL_LANES
     return _merge(state, _chunk_moments(ts, val, mask, spec, wargs,
+                                        lanes=lanes,
                                         with_sketch="q" in state))
 
 
@@ -309,6 +385,12 @@ _jitted_update = jax.jit(_update, static_argnums=0)
 def _finish(spec: WindowSpec, ds_function: str, fill_policy: str,
             state: dict, wargs: dict, fill_value):
     """Final per-series downsampled grid from accumulated moments."""
+    missing = LANES_FOR.get(ds_function, frozenset()) - frozenset(state)
+    if missing:
+        raise KeyError(
+            "accumulator lacks lane(s) %s for %s — create it with "
+            "lanes=lanes_for([...]) covering every finish function"
+            % (sorted(missing), ds_function))
     n = state["n"]
     safe = jnp.maximum(n, 1)
     if ds_function in ("sum", "zimsum", "pfsum"):
@@ -376,12 +458,15 @@ class StreamAccumulator:
 
     @staticmethod
     def create(num_series: int, spec: WindowSpec, wargs: dict,
-               sketch: bool = False) -> "StreamAccumulator":
+               sketch: bool = False,
+               lanes: frozenset | None = None) -> "StreamAccumulator":
         """`sketch=True` adds the [S, W, K] quantile-summary lane so
-        rank-based downsample functions can finish (approximate)."""
+        rank-based downsample functions can finish (approximate).
+        `lanes` (from lanes_for()) restricts state to what the finish
+        functions need — sum/avg/count stream scatter-free."""
         return StreamAccumulator(spec, wargs, _zero_state(num_series,
                                                           spec.count,
-                                                          sketch))
+                                                          sketch, lanes))
 
     def update(self, ts, val, mask) -> None:
         """Fold one [S, n] chunk in (async — returns at enqueue)."""

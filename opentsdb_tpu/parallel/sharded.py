@@ -383,7 +383,7 @@ class ShardedStreamAccumulator:
     """
 
     def __init__(self, mesh: Mesh, num_series: int, window_spec, wargs,
-                 sketch: bool = False):
+                 sketch: bool = False, lanes: frozenset | None = None):
         from opentsdb_tpu.ops import streaming
 
         self.mesh = mesh
@@ -394,7 +394,8 @@ class ShardedStreamAccumulator:
         self.s_pad = -(-num_series // n_dev) * n_dev
         self._row_sh = NamedSharding(mesh, P(_BOTH, None))
         self._gid_sh = NamedSharding(mesh, P(_BOTH))
-        state = streaming._zero_state(self.s_pad, window_spec.count, sketch)
+        state = streaming._zero_state(self.s_pad, window_spec.count,
+                                      sketch, lanes)
         self.state = {k: jax.device_put(v, self._row_sh)
                       for k, v in state.items()}
         self._update = _stream_update_fn(mesh, window_spec)

@@ -514,18 +514,21 @@ class QueryRunner:
         # per-chip footprint is O(S/n_chips * W + chunk) and the finish
         # combines over ICI — concurrent salt buckets × incremental
         # callbacks (SaltScanner.java:269 × :463) in one composition.
+        from opentsdb_tpu.ops.streaming import lanes_for
+        lanes = lanes_for([spec.downsample.function])
         mesh = tsdb.query_mesh()
         sharded_acc = None
         if (mesh is not None and s
                 >= tsdb.config.get_int("tsd.query.mesh.min_series")):
             from opentsdb_tpu.parallel import ShardedStreamAccumulator
             sharded_acc = ShardedStreamAccumulator(mesh, s, window_spec,
-                                                   wargs, sketch=sketch)
+                                                   wargs, sketch=sketch,
+                                                   lanes=lanes)
             s_rows = sharded_acc.s_pad   # pack at padded width: no re-copy
             update = sharded_acc.update
         else:
             acc = StreamAccumulator.create(s, window_spec, wargs,
-                                           sketch=sketch)
+                                           sketch=sketch, lanes=lanes)
             s_rows = s
             update = lambda t, v, m: acc.update(  # noqa: E731
                 jnp.asarray(t), jnp.asarray(v), jnp.asarray(m))

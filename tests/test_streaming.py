@@ -420,3 +420,71 @@ class TestSketchPercentiles:
         # ...and the p50 region is untouched
         est = float(st.sketch_quantile(q, jnp.asarray([101]), 50.0)[0])
         assert abs(est - 50.0) < 3.0
+
+
+class TestLaneSelection:
+    """r3: the accumulator carries only the lanes its finish functions
+    need — sum/avg/count queries stream with NO segment scatters."""
+
+    def test_minimal_lanes_answers_match_full(self):
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import FixedWindows
+        from opentsdb_tpu.ops.streaming import (
+            StreamAccumulator, lanes_for)
+        rng = np.random.default_rng(51)
+        s, n = 4, 512
+        start = 1_356_998_400_000
+        ts = (np.sort(rng.integers(0, 3_000_000, (s, n)), axis=1)
+              + start).astype(np.int64)
+        val = rng.normal(10, 3, (s, n))
+        mask = rng.random((s, n)) > 0.1
+        fixed = FixedWindows.for_range(start, start + 3_000_000, 60_000)
+        spec, wargs = fixed.split()
+        for fns in (["sum"], ["avg", "count"], ["dev"], ["min", "max"],
+                    ["first", "last", "diff"], ["mult"]):
+            full = StreamAccumulator.create(s, spec, wargs)
+            slim = StreamAccumulator.create(s, spec, wargs,
+                                            lanes=lanes_for(fns))
+            for k in range(0, n, 128):
+                sl = slice(k, k + 128)
+                for acc in (full, slim):
+                    acc.update(jnp.asarray(ts[:, sl]),
+                               jnp.asarray(val[:, sl]),
+                               jnp.asarray(mask[:, sl]))
+            for fn in fns:
+                wf, of, mf = full.finish(fn)
+                ws, os_, ms = slim.finish(fn)
+                np.testing.assert_array_equal(np.asarray(mf),
+                                              np.asarray(ms))
+                m = np.asarray(mf)
+                np.testing.assert_allclose(np.asarray(os_)[m],
+                                           np.asarray(of)[m],
+                                           rtol=1e-12, atol=1e-12)
+
+    def test_sum_lanes_have_no_scatter(self):
+        """The jitted update for sum-only lanes must contain no scatter
+        ops (the segment lanes are the only scatter users)."""
+        import jax
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import FixedWindows
+        from opentsdb_tpu.ops import streaming
+        fixed = FixedWindows.for_range(0, 3_000_000, 60_000)
+        spec, wargs = fixed.split()
+        state = streaming._zero_state(4, spec.count,
+                                      lanes=streaming.lanes_for(["sum"]))
+        ts = jnp.zeros((4, 128), jnp.int64)
+        val = jnp.zeros((4, 128))
+        mask = jnp.ones((4, 128), bool)
+        hlo = jax.jit(streaming._update, static_argnums=0).lower(
+            spec, state, ts, val, mask, wargs).as_text()
+        assert "scatter" not in hlo, "sum-only stream update has a scatter"
+
+    def test_missing_lane_raises_clearly(self):
+        from opentsdb_tpu.ops.downsample import FixedWindows
+        from opentsdb_tpu.ops.streaming import StreamAccumulator, lanes_for
+        fixed = FixedWindows.for_range(0, 3_000_000, 60_000)
+        spec, wargs = fixed.split()
+        acc = StreamAccumulator.create(2, spec, wargs,
+                                       lanes=lanes_for(["sum"]))
+        with pytest.raises(KeyError, match="lacks lane"):
+            acc.finish("max")
