@@ -136,7 +136,9 @@ def _zero_state(s: int, w: int, sketch: bool = False,
     if sketch:
         # q[s, w, j] = value at fractional rank (j+0.5)/K of the cell's
         # population seen so far (midpoint convention); counts live in "n".
-        state["q"] = jnp.zeros((s, w, SKETCH_K), jnp.float64)
+        # float32: the sketch's rank error (~chunks/2K) dwarfs f32 value
+        # precision by orders of magnitude, and f64 is emulated on TPU.
+        state["q"] = jnp.zeros((s, w, SKETCH_K), jnp.float32)
     return state
 
 
@@ -219,8 +221,8 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
             # Exact per-cell equi-rank grid for this chunk: value-sort
             # within (series, window) runs, interpolate K midpoint ranks.
             sorted_v, starts = _sorted_runs(flat, okf, seg, s * w)
-            out["q"] = _rank_grid(sorted_v, starts,
-                                  cnt.reshape(-1)).reshape(s, w, SKETCH_K)
+            out["q"] = _rank_grid(sorted_v, starts, cnt.reshape(-1)) \
+                .reshape(s, w, SKETCH_K).astype(jnp.float32)
     return out
 
 
@@ -311,7 +313,7 @@ def _merge_sketch(q1, n1, q2, n2, k: int = SKETCH_K):
     targets = (jnp.arange(k, dtype=jnp.float64)[None, :] + 0.5) / k * total
     merged = _interp_rows(targets, cum, v)
     both_zero = (n1 + n2) <= 0
-    return jnp.where(both_zero[:, None], 0.0, merged)
+    return jnp.where(both_zero[:, None], 0.0, merged).astype(q1.dtype)
 
 
 def sketch_quantile(q, n, pct):
