@@ -53,7 +53,8 @@ import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
     WindowSpec, apply_fill, window_ids, window_timestamps,
-    _compact_ts, _edge_prefix_builder, _sorted_runs, FILL_NONE)
+    _compact_ts, _edge_prefix_builder, _extreme_downsample, _sorted_runs,
+    FILL_NONE)
 
 # Summary points per (series, window) quantile sketch.
 SKETCH_K = 64
@@ -166,7 +167,7 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     out = {"n": cnt}
 
     need_win = ("m2" in lanes or with_sketch
-                or lanes & {"lo", "hi", "first", "last", "prod"})
+                or lanes & {"first", "last", "prod"})
     raw_win = window_ids(ts, spec, wargs) if need_win else None
 
     if "total" in lanes:
@@ -180,7 +181,18 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
             centered = jnp.where(ok, vf - mean_pp, 0.0)
             out["m2"] = windowed(centered * centered)
 
-    seg_lanes = lanes & {"lo", "hi", "first", "last", "prod"}
+    # lo/hi ride the scatter-free segmented reset-scan — ONE fused scan
+    # for both (XLA CSEs the edge-search it shares with the prefix lanes
+    # inside this one jit)
+    if lanes & {"lo", "hi"}:
+        lo, hi, _ = _extreme_downsample(ts, val, mask, spec, wargs,
+                                        "lo" in lanes, "hi" in lanes)
+        if lo is not None:
+            out["lo"] = lo
+        if hi is not None:
+            out["hi"] = hi
+
+    seg_lanes = lanes & {"first", "last", "prod"}
     if seg_lanes or with_sketch:
         num = s * w + 1
         win = jnp.clip(raw_win, 0, w - 1)
@@ -190,14 +202,6 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
         seg = jnp.where(valid, rows * w + win, s * w).reshape(-1)
         flat = jnp.where(valid, vf, 0.0).reshape(-1)
         okf = valid.reshape(-1)
-        if "lo" in seg_lanes:
-            out["lo"] = jax.ops.segment_min(
-                jnp.where(okf, flat, jnp.inf), seg,
-                num_segments=num)[:-1].reshape(s, w)
-        if "hi" in seg_lanes:
-            out["hi"] = jax.ops.segment_max(
-                jnp.where(okf, flat, -jnp.inf), seg,
-                num_segments=num)[:-1].reshape(s, w)
         if seg_lanes & {"first", "last"}:
             pos = jnp.arange(s * n, dtype=jnp.int64)
             flat_v = vf.reshape(-1)

@@ -238,3 +238,61 @@ class TestSinglePrecisionMode:
         assert got.dtype == want.dtype == np.float64  # contract: f64 out
         # ~350 points/window in f32: relative error bounded by ~n*eps
         np.testing.assert_allclose(got[m], want[m], rtol=5e-4, atol=1e-3)
+
+
+class TestExtremeScanPath:
+    """r3: min/max downsample rides a segmented reset-scan, no scatter."""
+
+    @pytest.mark.parametrize("agg", ["min", "max", "mimmin", "mimmax"])
+    def test_matches_numpy_reference(self, agg):
+        rng = np.random.default_rng(61)
+        ts = np.full((4, 256), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((4, 256), np.float64)
+        mask = np.zeros((4, 256), bool)
+        for i in range(4):
+            k = int(rng.integers(20, 250))
+            ts[i, :k] = START + np.sort(
+                rng.choice(9_000_000, size=k, replace=False))
+            v = rng.normal(0, 50, k)
+            v[rng.random(k) < 0.07] = np.nan
+            val[i, :k] = v
+            mask[i, :k] = True
+            # also mask out some interior points
+            mask[i, :k] &= rng.random(k) > 0.05
+        windows = FixedWindows.for_range(START, START + 9_000_000,
+                                         600_000)
+        spec, wargs = windows.split()
+        _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
+                                   FILL_NONE)
+        out, omask = np.asarray(out), np.asarray(omask)
+        fn = np.min if agg in ("min", "mimmin") else np.max
+        edges = np.arange(windows.first_window_ms,
+                          windows.first_window_ms
+                          + (windows.count + 1) * 600_000, 600_000)
+        for i in range(4):
+            for w in range(windows.count):
+                sel = (mask[i] & (ts[i] >= edges[w]) & (ts[i] < edges[w + 1])
+                       & ~np.isnan(val[i]))
+                if sel.sum():
+                    assert omask[i, w]
+                    assert out[i, w] == fn(val[i][sel]), (agg, i, w)
+                else:
+                    assert not omask[i, w]
+
+    def test_materialized_and_streamed_minmax_have_no_scatter(self):
+        import jax
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops import streaming
+        windows = FixedWindows.for_range(0, 3_000_000, 60_000)
+        spec, wargs = windows.split()
+        ts = jnp.zeros((4, 128), jnp.int64)
+        val = jnp.zeros((4, 128))
+        mask = jnp.ones((4, 128), bool)
+        hlo = jax.jit(downsample, static_argnums=(3, 4, 6)).lower(
+            ts, val, mask, "min", spec, wargs, FILL_NONE).as_text()
+        assert "scatter" not in hlo
+        state = streaming._zero_state(
+            4, spec.count, lanes=streaming.lanes_for(["min", "max"]))
+        hlo = jax.jit(streaming._update, static_argnums=0).lower(
+            spec, state, ts, val, mask, wargs).as_text()
+        assert "scatter" not in hlo
