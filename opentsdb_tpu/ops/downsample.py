@@ -149,8 +149,19 @@ PREFIX_AGGS = frozenset(
 
 # min/max ride a scatter-free segmented reset-scan (sorted rows make each
 # window a contiguous run; an associative_scan that resets at run starts
-# replaces the serializing segment scatter).
+# replaces the serializing segment scatter).  "segment" keeps the scatter
+# form — faster on CPU where scatters are cheap; the chip A/B decides.
 EXTREME_AGGS = frozenset({"min", "mimmin", "max", "mimmax"})
+_EXTREME_MODE = "scan"
+
+
+def set_extreme_mode(mode: str) -> None:
+    """'scan' | 'segment' — min/max downsample strategy; clears caches."""
+    global _EXTREME_MODE
+    if mode not in ("scan", "segment"):
+        raise ValueError("extreme mode must be 'scan' or 'segment'")
+    _EXTREME_MODE = mode
+    _clear_dependent_caches()
 
 
 def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
@@ -441,7 +452,8 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
     scatter — the hot loop the reference walked per interval,
     Downsampler.java:292); the rest reduce via segment ops.
     """
-    if agg_name in PREFIX_AGGS or agg_name in EXTREME_AGGS:
+    if agg_name in PREFIX_AGGS or (
+            agg_name in EXTREME_AGGS and _EXTREME_MODE == "scan"):
         w = spec.count
         nwin = wargs["nwin"]
         if agg_name in PREFIX_AGGS:

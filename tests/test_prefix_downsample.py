@@ -296,3 +296,31 @@ class TestExtremeScanPath:
         hlo = jax.jit(streaming._update, static_argnums=0).lower(
             spec, state, ts, val, mask, wargs).as_text()
         assert "scatter" not in hlo
+
+    @pytest.mark.parametrize("agg", ["min", "max"])
+    def test_scan_equals_segment_mode(self, agg):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        rng = np.random.default_rng(62)
+        ts = np.full((3, 128), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((3, 128), np.float64)
+        mask = np.zeros((3, 128), bool)
+        for i in range(3):
+            k = int(rng.integers(30, 120))
+            ts[i, :k] = START + np.sort(
+                rng.choice(5_000_000, size=k, replace=False))
+            val[i, :k] = rng.normal(0, 9, k)
+            mask[i, :k] = True
+        windows = FixedWindows.for_range(START, START + 5_000_000, 600_000)
+        spec, wargs = windows.split()
+        _, want, wmask = downsample(ts, val, mask, agg, spec, wargs,
+                                    FILL_NONE)
+        ds_mod.set_extreme_mode("segment")
+        try:
+            _, got, gmask = downsample(ts, val, mask, agg, spec, wargs,
+                                       FILL_NONE)
+        finally:
+            ds_mod.set_extreme_mode("scan")
+        np.testing.assert_array_equal(np.asarray(gmask), np.asarray(wmask))
+        m = np.asarray(wmask)
+        np.testing.assert_array_equal(np.asarray(got)[m],
+                                      np.asarray(want)[m])
