@@ -1,4 +1,4 @@
-"""BASELINE.md measurement configs 1-6 as runnable benchmarks.
+"""BASELINE.md measurement configs 1-7 as runnable benchmarks.
 
 `python bench_configs.py [--config N] [--scale F]` prints one JSON line per
 config (bench.py stays the single-line headline bench the driver runs).
@@ -10,6 +10,7 @@ Configs (BASELINE.md / BASELINE.json):
   4. rate + p99 over 500M pts                          - non-associative kernels
   5. 1B pts -> 1m rollups, time-chunked                - offline batch pass
   6. bulk ingest points/sec (host write path)          - TSDB.add_points_bulk
+  7. p50 end-to-end /api/query latency, 1B pts in-store - full served path
 
 Timing methodology (same rules as bench.py — see its module docstring for
 why `jax.block_until_ready` CANNOT be used on this platform):
@@ -293,7 +294,7 @@ def config4(scale: float, n_dev: int) -> None:
 def config5(scale: float, n_dev: int) -> None:
     """1B pts -> 1m rollup lanes, time-chunked (write-side batch pass)."""
     from opentsdb_tpu.ops.downsample import FixedWindows
-    from opentsdb_tpu.ops.streaming import StreamAccumulator
+    from opentsdb_tpu.ops.streaming import StreamAccumulator, lanes_for
 
     total = int(1_000_000_000 * scale)
     s = 1024
@@ -317,7 +318,6 @@ def config5(scale: float, n_dev: int) -> None:
         fixed = FixedWindows.for_range(chunk_start, chunk_start + span,
                                        60_000)
         wspec, wargs = fixed.split()
-        from opentsdb_tpu.ops.streaming import lanes_for
         acc = StreamAccumulator.create(
             s, wspec, wargs,
             lanes=lanes_for(("sum", "count", "min", "max")))

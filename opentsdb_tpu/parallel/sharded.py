@@ -410,8 +410,10 @@ class ShardedStreamAccumulator:
         finish's participate logic excludes (pad gid is out-of-range too).
         """
         ts, val, mask, _ = _pad_rows(self.s_pad, ts, val, mask)
-        d = [jax.device_put(x, self._row_sh) for x in (ts, val, mask)]
-        self.state = self._update(self.state, d[0], d[1], d[2], self.wargs)
+        d_ts, d_val, d_mask = (jax.device_put(x, self._row_sh)
+                               for x in (ts, val, mask))
+        self.state = self._update(self.state, d_ts, d_val, d_mask,
+                                  self.wargs)
 
     def finish_tail(self, pipeline_spec, gid: np.ndarray, num_groups: int):
         """Replicated (wts[W], out[G, W], out_mask[G, W]) for the query."""

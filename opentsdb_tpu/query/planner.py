@@ -23,7 +23,8 @@ from opentsdb_tpu.ops.downsample import (
 from opentsdb_tpu.ops.pipeline import (
     PipelineSpec, DownsampleStep, run_pipeline, run_group_pipeline,
     run_group_rollup_avg_pipeline, run_grid_tail, build_batch, PAD_TS)
-from opentsdb_tpu.ops.streaming import StreamAccumulator, STREAMABLE_DS
+from opentsdb_tpu.ops.streaming import (
+    StreamAccumulator, STREAMABLE_DS, is_sketch_ds, lanes_for)
 from opentsdb_tpu.rollup.config import NoSuchRollupForInterval, RollupQuery
 from opentsdb_tpu.storage.memstore import Series, SeriesKey
 from opentsdb_tpu.uid import NoSuchUniqueName
@@ -410,7 +411,6 @@ class QueryRunner:
 
         total_points = sum(sum(c) for _, _, c in kept)
         ds_fn = seg.ds_function or ds.function
-        from opentsdb_tpu.ops.streaming import is_sketch_ds
         sketchable = (is_sketch_ds(ds_fn) and tsdb.config.get_bool(
             "tsd.query.streaming.sketch_percentiles"))
         stream_ok = (seg.kind != "rollup_avg"
@@ -514,7 +514,6 @@ class QueryRunner:
         # per-chip footprint is O(S/n_chips * W + chunk) and the finish
         # combines over ICI — concurrent salt buckets × incremental
         # callbacks (SaltScanner.java:269 × :463) in one composition.
-        from opentsdb_tpu.ops.streaming import lanes_for
         lanes = lanes_for([spec.downsample.function])
         mesh = tsdb.query_mesh()
         sharded_acc = None
