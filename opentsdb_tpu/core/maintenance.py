@@ -52,6 +52,7 @@ class MaintenanceThread(threading.Thread):
         self.wal_syncs = 0
         self.snapshots = 0
         self.snapshot_errors = 0
+        self.device_cache_refreshes = 0
 
     # ------------------------------------------------------------------ #
 
@@ -62,6 +63,7 @@ class MaintenanceThread(threading.Thread):
                 self._maybe_flush(now)
                 self._maybe_sync_wal(now)
                 self._maybe_snapshot(now)
+                self._maybe_refresh_device_cache()
             except Exception:
                 LOG.exception("maintenance pass failed")
 
@@ -100,6 +102,17 @@ class MaintenanceThread(threading.Thread):
             persistence.sync_wal()
             self.wal_syncs += 1
 
+    def _maybe_refresh_device_cache(self) -> None:
+        """Rebuild device-cache entries invalidated by ingest.
+
+        Off the query path by design: queries on a stale metric fall back
+        to the host build (fast miss) and queue it here; this thread pays
+        the re-upload so ingest-heavy metrics regain device-cache hits
+        without ever blocking a request."""
+        cache = self.tsdb.device_cache
+        if cache is not None:
+            self.device_cache_refreshes += cache.refresh(self.tsdb.store)
+
     def _maybe_snapshot(self, now: float) -> None:
         if self.snapshot_interval <= 0 or now < self._next_snapshot:
             return
@@ -121,4 +134,6 @@ class MaintenanceThread(threading.Thread):
             "tsd.maintenance.wal_syncs": self.wal_syncs,
             "tsd.maintenance.snapshots": self.snapshots,
             "tsd.maintenance.snapshot_errors": self.snapshot_errors,
+            "tsd.maintenance.device_cache_refreshes":
+                self.device_cache_refreshes,
         }

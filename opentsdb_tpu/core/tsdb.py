@@ -60,6 +60,15 @@ class TSDB:
         self.store = MemStore(
             salt_buckets=self.config.salt_buckets,
             fix_duplicates=self.config.fix_duplicates)
+        from opentsdb_tpu.storage.device_cache import DeviceSeriesCache
+        self.device_cache = (
+            DeviceSeriesCache(
+                self.config.get_int("tsd.query.device_cache.mb") * 2**20,
+                self.config.get_int(
+                    "tsd.query.device_cache.build_max_points"),
+                fix_duplicates=self.config.fix_duplicates)
+            if self.config.get_bool("tsd.query.device_cache.enable")
+            else None)
         from opentsdb_tpu.rollup import RollupConfig, RollupStore
         self.rollup_config = RollupConfig.from_config(self.config)
         self.rollup_store = (
@@ -633,6 +642,8 @@ class TSDB:
         }
         if self.maintenance is not None:
             out.update(self.maintenance.collect_stats())
+        if self.device_cache is not None:
+            out.update(self.device_cache.collect_stats())
         return out
 
     @staticmethod

@@ -447,11 +447,28 @@ class QueryRunner:
             out_ts, out_val, out_mask = run_group_rollup_avg_pipeline(
                 spec, ts, val, mask, tc, vc, mc, gid, g_pad, wargs)
         else:
-            ts, val, mask, _ = build_batch(
-                self._materialize_windows(kept, seg, fix))
             mesh = tsdb.query_mesh()
-            if (mesh is not None and ts.shape[0]
-                    >= tsdb.config.get_int("tsd.query.mesh.min_series")):
+            use_mesh = (mesh is not None and len(gid) >= tsdb.config.get_int(
+                "tsd.query.mesh.min_series"))
+            ts = None
+            if (tsdb.device_cache is not None and not use_mesh
+                    and seg.kind == "raw"):
+                # Device-cache fast path (BlockCache analog): hot metrics'
+                # columns are pinned in HBM, the [S, N] batch assembles
+                # on-device in one gather dispatch — no host->device data
+                # transfer.  A miss (cold/stale) silently builds below.
+                series_list = [s for _, members, _ in kept
+                               for s, _t in members]
+                got = tsdb.device_cache.batch_for(
+                    tsdb.store, series_list[0].key.metric, series_list,
+                    seg.start_ms, seg.end_ms, fix)
+                if got is not None:
+                    ts, val, mask = got
+                    self.exec_stats["deviceCacheHit"] = 1.0
+            if ts is None:
+                ts, val, mask, _ = build_batch(
+                    self._materialize_windows(kept, seg, fix))
+            if use_mesh:
                 from opentsdb_tpu.parallel import (
                     sharded_query_pipeline, shard_rows)
                 from opentsdb_tpu.parallel.sharded import n_devices

@@ -1,3 +1,7 @@
+# NOTE: DeviceSeriesCache is deliberately NOT re-exported here — importing
+# it pulls jax, and the storage layer stays importable numpy-only (the
+# persistence tooling and memstore tests rely on that).  Use the deep path:
+# `from opentsdb_tpu.storage.device_cache import DeviceSeriesCache`.
 from opentsdb_tpu.storage.memstore import (
     MemStore,
     Series,

@@ -1,0 +1,307 @@
+"""Device-resident series cache: hot columnar data lives in HBM.
+
+The TPU-native analog of the reference's storage-side block caching (the
+HBase BlockCache that made repeated scans of hot rows memory-speed; the
+reference leans on it implicitly — every SaltScanner pass re-reads the
+same regions, SaltScanner.java:269).  Here the roles are inverted: the
+store is host RAM, the accelerator is across a PCIe/tunnel link, and the
+dominant cost of a repeated `/api/query` is re-uploading the same raw
+points every dispatch.  This cache pins each hot metric's columnar data
+in device HBM once; subsequent queries gather their [S, N] window batch
+ON DEVICE in a single dispatch — zero host->device traffic for the data
+itself (only the tiny per-series start/length vectors travel).
+
+Design:
+
+  * One entry per metric: every series' normalized (ts, val) columns
+    concatenated into two 1-D device buffers (padded to pow2 length to
+    bound gather recompiles), plus host-side row offsets.
+  * Consistency is by content-version, not locks: `Series.snapshot()`
+    captures (data, version) atomically; at query time
+    `Series.window_bounds()` returns (lo, hi, version) atomically.  A
+    version mismatch on ANY requested series is a miss — the planner
+    falls back to the host build path, and the entry is queued for a
+    background refresh (the maintenance thread calls `refresh()`), so
+    ingest-heavy metrics never pay rebuild costs on the query path.
+  * Byte-budgeted LRU (`tsd.query.device_cache.mb`): entries evict
+    least-recently-used first; metrics larger than the whole budget (or
+    `tsd.query.device_cache.build_max_points`) are never cached — the
+    streaming path owns beyond-memory scans.
+
+Only the float lane is cached: the grouped downsample pipeline (the hot
+path this accelerates) always runs in float (Downsampler.java:257 —
+downsampled values are doubles).  Queries needing the exact-int lane
+(raw union aggregation of all-int series) take the host path unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+_LOG = logging.getLogger("device_cache")
+
+# The padding contract (sentinel + pow2 growth) MUST stay bit-identical to
+# build_batch's — the prefix downsample path relies on cached rows sorting
+# exactly like host-built rows.  PAD_TS mirrors ops.pipeline.PAD_TS and
+# pad_pow2 is lazy-imported from ops.downsample inside the functions that
+# use it: a module-level import would pull jax into every storage import,
+# and this module must stay importable numpy-only (tests assert the PAD_TS
+# parity so the mirror cannot drift silently).
+PAD_TS = np.iinfo(np.int64).max
+_BYTES_PER_POINT = 16  # int64 ts + float64 val
+
+
+def _pad_pow2(n: int, floor: int = 8) -> int:
+    from opentsdb_tpu.ops.downsample import pad_pow2
+    return pad_pow2(n, floor)
+
+
+@dataclass
+class _Entry:
+    metric: int
+    row: dict          # SeriesKey -> row index
+    series_objs: list  # row -> the Series OBJECT snapshotted: identity is
+    #                    part of validity — a deleted+recreated series has an
+    #                    equal key and a restarted version counter, and must
+    #                    not validate against the old snapshot
+    versions: list     # row -> version at snapshot
+    offsets: np.ndarray  # [S+1] int64 start offsets into the buffers
+    ts_dev: object     # device [P] int64 (pow2-padded, pads PAD_TS)
+    val_dev: object    # device [P] float64
+    nbytes: int = 0
+    tick: int = 0      # LRU clock
+    stale: bool = field(default=False)
+
+
+class DeviceSeriesCache:
+    """Byte-budgeted, version-validated device cache of metric columns."""
+
+    def __init__(self, max_bytes: int, build_max_points: int = 200_000_000,
+                 fix_duplicates: bool = True):
+        self.max_bytes = int(max_bytes)
+        self.build_max_points = int(build_max_points)
+        # The store-wide duplicate policy: snapshots must normalize with
+        # EXACTLY the policy reads use — with fix_duplicates off, a build
+        # touching duplicate data must fail (and never silently dedup the
+        # live series out from under fsck).
+        self.fix_duplicates = bool(fix_duplicates)
+        self._entries: dict[int, _Entry] = {}
+        self._stale_metrics: set[int] = set()
+        self._building: set[int] = set()
+        self._lock = threading.Lock()
+        self._tick = 0
+        # stats (surfaced via /api/stats; mutated under _lock)
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+        self.evictions = 0
+
+    # -- sizing ----------------------------------------------------------
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- query path ------------------------------------------------------
+
+    def batch_for(self, store, metric: int, series_list, start_ms: int,
+                  end_ms: int, fix_duplicates: bool = True):
+        """Device [S, N] (ts, val, mask) for the series' windows, or None.
+
+        A None return means cold/stale/over-budget — the caller uses its
+        host build path.  Never blocks on a rebuild: staleness only queues
+        the metric for the maintenance-thread `refresh()`.
+        """
+        with self._lock:
+            entry = self._entries.get(metric)
+        if entry is None:
+            entry = self._build(store, metric)
+            if entry is None:
+                self._count("misses")
+                return None
+        s = len(series_list)
+        starts = np.empty(s, np.int64)
+        lengths = np.empty(s, np.int64)
+        for i, series in enumerate(series_list):
+            row = entry.row.get(series.key)
+            if row is None or entry.series_objs[row] is not series:
+                # a series born after the snapshot — or deleted and
+                # recreated under the same key (fresh object, restarted
+                # version counter): either way the snapshot is invalid
+                self._mark_stale(metric, entry)
+                self._count("misses")
+                return None
+            try:
+                lo, hi, version = series.window_bounds(start_ms, end_ms,
+                                                       fix_duplicates)
+            except ValueError:
+                self._count("misses")
+                return None     # unresolved duplicates: host path raises
+            if version != entry.versions[row]:
+                self._mark_stale(metric, entry)
+                self._count("misses")
+                return None
+            starts[i] = entry.offsets[row] + lo
+            lengths[i] = hi - lo
+        n = _pad_pow2(max(int(lengths.max(initial=0)), 1))
+        with self._lock:
+            self._tick += 1
+            entry.tick = self._tick
+            self.hits += 1
+        return _gather_windows(entry.ts_dev, entry.val_dev,
+                               starts, lengths, n)
+
+    # -- build / refresh -------------------------------------------------
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + 1)
+
+    def _mark_stale(self, metric: int, entry: _Entry) -> None:
+        with self._lock:
+            entry.stale = True
+            self._stale_metrics.add(metric)
+
+    def _build(self, store, metric: int):
+        """Snapshot every series of `metric` into device buffers.
+
+        At most one build per metric runs at a time: concurrent queries on
+        the same cold metric miss fast (host path) instead of each paying
+        the snapshot + upload."""
+        with self._lock:
+            if metric in self._building:
+                return None
+            self._building.add(metric)
+        try:
+            return self._build_guarded(store, metric)
+        finally:
+            with self._lock:
+                self._building.discard(metric)
+
+    def _build_guarded(self, store, metric: int):
+        series_list = store.series_for_metric(metric)
+        if not series_list:
+            return None
+        total = sum(len(s) for s in series_list)
+        nbytes = _pad_pow2(max(total, 1), floor=1024) * _BYTES_PER_POINT
+        if total > self.build_max_points or nbytes > self.max_bytes:
+            return None
+        parts_ts, parts_val, versions, row = [], [], [], {}
+        offsets = np.zeros(len(series_list) + 1, np.int64)
+        try:
+            for i, series in enumerate(series_list):
+                ts, val, version = series.snapshot(self.fix_duplicates)
+                parts_ts.append(ts)
+                parts_val.append(val)
+                versions.append(version)
+                row[series.key] = i
+                offsets[i + 1] = offsets[i] + len(ts)
+        except ValueError:
+            return None     # duplicate data pending fsck: don't cache it
+        total = int(offsets[-1])
+        p = _pad_pow2(max(total, 1), floor=1024)
+        ts_buf = np.full(p, PAD_TS, np.int64)
+        val_buf = np.zeros(p, np.float64)
+        if total:
+            ts_buf[:total] = np.concatenate(parts_ts)
+            val_buf[:total] = np.concatenate(parts_val)
+        entry = _Entry(metric=metric, row=row, series_objs=series_list,
+                       versions=versions, offsets=offsets,
+                       ts_dev=_to_device(ts_buf), val_dev=_to_device(val_buf),
+                       nbytes=p * _BYTES_PER_POINT)
+        with self._lock:
+            self._evict_for_locked(entry.nbytes)
+            self._tick += 1
+            entry.tick = self._tick
+            self._entries[metric] = entry
+            self._stale_metrics.discard(metric)
+            self.builds += 1
+        return entry
+
+    def _evict_for_locked(self, incoming_bytes: int) -> None:
+        used = sum(e.nbytes for e in self._entries.values())
+        while self._entries and used + incoming_bytes > self.max_bytes:
+            victim = min(self._entries.values(), key=lambda e: e.tick)
+            self._entries.pop(victim.metric)
+            used -= victim.nbytes
+            self.evictions += 1
+
+    def refresh(self, store, max_rebuilds: int = 4) -> int:
+        """Rebuild up to `max_rebuilds` stale entries (maintenance hook).
+
+        Runs off the query path: the background thread pays the re-upload
+        so queries only ever see a fast hit or a fast miss.
+        """
+        with self._lock:
+            pending = list(self._stale_metrics)[:max_rebuilds]
+            for m in pending:
+                self._stale_metrics.discard(m)
+                self._entries.pop(m, None)
+        done = 0
+        for m in pending:
+            if self._build(store, m) is not None:
+                done += 1
+        return done
+
+    def invalidate(self, metric: int | None = None) -> None:
+        """Drop one metric's entry, or everything (/api/dropcaches)."""
+        with self._lock:
+            if metric is None:
+                self._entries.clear()
+                self._stale_metrics.clear()
+            else:
+                self._entries.pop(metric, None)
+                self._stale_metrics.discard(metric)
+
+    def collect_stats(self) -> dict:
+        return {
+            "tsd.query.device_cache.hits": float(self.hits),
+            "tsd.query.device_cache.misses": float(self.misses),
+            "tsd.query.device_cache.builds": float(self.builds),
+            "tsd.query.device_cache.evictions": float(self.evictions),
+            "tsd.query.device_cache.entries": float(len(self)),
+            "tsd.query.device_cache.bytes": float(self.bytes_used),
+        }
+
+
+def _to_device(arr: np.ndarray):
+    import jax
+    return jax.device_put(arr)
+
+
+_GATHER_CACHE: dict = {}
+
+
+def _gather_windows(ts_buf, val_buf, starts, lengths, n: int):
+    """One-dispatch on-device batch assembly from the pinned buffers.
+
+    out[i, j] = buf[starts[i] + j] masked to j < lengths[i]; pads mirror
+    build_batch (PAD_TS timestamps keep rows sorted for the prefix path).
+    Compiled once per (buffer length, N) — both pow2-padded.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    key = n
+    fn = _GATHER_CACHE.get(key)
+    if fn is None:
+        def gather(tb, vb, st, ln):
+            j = jnp.arange(n, dtype=jnp.int64)
+            idx = st[:, None] + j[None, :]
+            m = j[None, :] < ln[:, None]
+            safe = jnp.clip(idx, 0, tb.shape[0] - 1)
+            ts = jnp.where(m, tb[safe], PAD_TS)
+            val = jnp.where(m, vb[safe], 0.0)
+            return ts, val, m
+        fn = jax.jit(gather)
+        _GATHER_CACHE[key] = fn
+    return fn(ts_buf, val_buf, jnp.asarray(starts), jnp.asarray(lengths))

@@ -72,7 +72,7 @@ class Series:
     """
 
     __slots__ = ("key", "_ts", "_val", "_ival", "_isint", "_n", "_sorted",
-                 "_lock", "shard")
+                 "_lock", "shard", "_version")
 
     INITIAL_CAPACITY = 64
 
@@ -86,6 +86,11 @@ class Series:
         self._n = 0
         self._sorted = True
         self._lock = threading.Lock()
+        # Monotone content-version: bumped by every mutation that changes
+        # visible data (appends, restore, deletes, dedup).  The device
+        # series cache snapshots (data, version) atomically and treats any
+        # later mismatch as staleness — see storage/device_cache.py.
+        self._version = 0
 
     def __len__(self) -> int:
         return self._n
@@ -93,6 +98,10 @@ class Series:
     @property
     def dirty(self) -> bool:
         return not self._sorted
+
+    @property
+    def version(self) -> int:
+        return self._version
 
     def _grow(self, need: int) -> None:
         new_cap = max(need, len(self._ts) * 2, self.INITIAL_CAPACITY)
@@ -112,6 +121,7 @@ class Series:
             self._ival[self._n] = int(value) if is_int else 0
             self._isint[self._n] = is_int
             self._n += 1
+            self._version += 1
 
     def append_batch(self, ts_ms: np.ndarray, values: np.ndarray,
                      is_int: np.ndarray | bool,
@@ -151,6 +161,7 @@ class Series:
                                  (self._n and ts_ms[0] <= self._ts[self._n - 1])):
                 self._sorted = False
             self._n = need
+            self._version += 1
 
     def normalize(self, fix_duplicates: bool = True) -> None:
         """Sort by timestamp, resolving duplicates last-write-wins.
@@ -204,6 +215,7 @@ class Series:
         self._ival[:m] = self._ival[:n][keep]
         self._isint[:m] = self._isint[:n][keep]
         self._n = m
+        self._version += 1
 
     def window(self, start_ms: int, end_ms: int, fix_duplicates: bool = True
                ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -232,6 +244,32 @@ class Series:
         lo = int(np.searchsorted(self._ts[:n], start_ms, side="left"))
         hi = int(np.searchsorted(self._ts[:n], end_ms, side="right"))
         return lo, hi
+
+    def window_bounds(self, start_ms: int, end_ms: int,
+                      fix_duplicates: bool = True) -> tuple[int, int, int]:
+        """(lo, hi, version) for [start_ms, end_ms] under one lock hold.
+
+        The version lets the device cache validate that its snapshot still
+        matches the live series AND that (lo, hi) index that snapshot: both
+        are taken under the same lock, so no append can slip between them.
+        """
+        with self._lock:
+            lo, hi = self._window_bounds_locked(start_ms, end_ms,
+                                                fix_duplicates)
+            return lo, hi, self._version
+
+    def snapshot(self, fix_duplicates: bool = True
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Normalized (ts, float_vals, version) copies under one lock hold.
+
+        The device-cache build path: the returned version identifies
+        exactly this content — any later mutation bumps it.
+        """
+        with self._lock:
+            self._normalize_locked(fix_duplicates)
+            n = self._n
+            return (self._ts[:n].copy(), self._val[:n].copy(),
+                    self._version)
 
     def window_count(self, start_ms: int, end_ms: int,
                      fix_duplicates: bool = True) -> int:
@@ -287,6 +325,7 @@ class Series:
             self._n = n
             self._sorted = bool(n <= 1
                                 or bool(np.all(np.diff(ts) > 0)))
+            self._version += 1
 
     def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Copies of the full (ts, float_vals, int_vals, is_int) columns."""
@@ -312,6 +351,7 @@ class Series:
             self._ival[lo:lo + keep] = self._ival[hi:n]
             self._isint[lo:lo + keep] = self._isint[hi:n]
             self._n = n - removed
+            self._version += 1
             return removed
 
     @property

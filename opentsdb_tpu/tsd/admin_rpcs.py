@@ -84,6 +84,8 @@ class ShowConfig(HttpRpc):
 class DropCachesRpc(TelnetRpc, HttpRpc):
     def _drop(self, tsdb) -> None:
         tsdb.store.drop_caches()
+        if tsdb.device_cache is not None:
+            tsdb.device_cache.invalidate()
         # UID cachs are authoritative dictionaries here (no backing store),
         # so unlike UniqueId.dropCaches they must NOT be emptied.
 

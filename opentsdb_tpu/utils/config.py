@@ -78,6 +78,14 @@ DEFAULTS: dict[str, str] = {
     # (approximate, rank error ~chunks/(2K)); false = materialize instead,
     # subject to the scan budgets
     "tsd.query.streaming.sketch_percentiles": "true",
+    # TPU-native: device-resident series cache (the BlockCache analog) —
+    # hot metrics' columns pinned in HBM; repeat queries assemble their
+    # batch on-device with zero host->device data traffic.  Size is a
+    # byte budget (LRU); metrics beyond build_max_points are never cached
+    # (the streaming path owns beyond-memory scans).
+    "tsd.query.device_cache.enable": "true",
+    "tsd.query.device_cache.mb": "4096",
+    "tsd.query.device_cache.build_max_points": "200000000",
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",

@@ -1,0 +1,231 @@
+"""Device-resident series cache: correctness, staleness, eviction.
+
+The cache must be INVISIBLE in results — every test asserts the cached
+answer equals the host-built answer — and visible only in stats.  Models
+the reference's storage-cache stance (repeat scans served memory-speed
+without changing query semantics).
+"""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.storage.device_cache import DeviceSeriesCache
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+def make_tsdb(**cfg):
+    conf = {"tsd.core.auto_create_metrics": True}
+    conf.update(cfg)
+    t = TSDB(Config(conf))
+    for i in range(40):
+        t.add_point("dc.m", BASE + i * 10, float(i), {"host": "a"})
+        t.add_point("dc.m", BASE + i * 10, float(i * 2), {"host": "b"})
+    return t
+
+
+def run_group_query(tsdb, m="avg:1m-avg:dc.m{host=*}",
+                    start=str(BASE), end=str(BASE + 400)):
+    q = TSQuery(start=start, end=end, queries=[parse_m_subquery(m)])
+    q.validate()
+    runner = tsdb.new_query_runner()
+    res = runner.run(q)
+    return res, runner.exec_stats
+
+
+def dps_map(results):
+    return {tuple(sorted(r.tags.items())): r.dps for r in results}
+
+
+class TestDeviceCacheResults:
+    def test_second_query_hits_and_matches(self):
+        tsdb = make_tsdb()
+        cold, stats1 = run_group_query(tsdb)
+        warm, stats2 = run_group_query(tsdb)
+        assert stats2.get("deviceCacheHit") == 1.0
+        assert dps_map(cold) == dps_map(warm)
+        assert tsdb.device_cache.hits >= 1
+        assert tsdb.device_cache.builds == 1
+
+    def test_subset_filter_hits_same_entry(self):
+        tsdb = make_tsdb()
+        run_group_query(tsdb)                       # builds the entry
+        res, stats = run_group_query(tsdb, "sum:1m-avg:dc.m{host=a}")
+        assert stats.get("deviceCacheHit") == 1.0
+        assert tsdb.device_cache.builds == 1        # no second build
+        (dps,) = dps_map(res).values()
+        # host=a values are i=0..39 at 10s cadence: 1m windows avg 6 pts
+        assert dps[0][1] == pytest.approx(np.mean([0, 1, 2, 3, 4, 5]))
+
+    def test_window_narrowing_uses_cache(self):
+        tsdb = make_tsdb()
+        run_group_query(tsdb)
+        res, stats = run_group_query(tsdb, start=str(BASE + 60),
+                                     end=str(BASE + 180))
+        assert stats.get("deviceCacheHit") == 1.0
+        ref_tsdb = make_tsdb(**{"tsd.query.device_cache.enable": "false"})
+        ref, ref_stats = run_group_query(ref_tsdb, start=str(BASE + 60),
+                                         end=str(BASE + 180))
+        assert "deviceCacheHit" not in ref_stats
+        assert dps_map(res) == dps_map(ref)
+
+    def test_disabled_by_config(self):
+        tsdb = make_tsdb(**{"tsd.query.device_cache.enable": "false"})
+        assert tsdb.device_cache is None
+        _, stats = run_group_query(tsdb)
+        assert "deviceCacheHit" not in stats
+
+
+class TestStaleness:
+    def test_append_invalidates_then_refresh_restores(self):
+        tsdb = make_tsdb()
+        run_group_query(tsdb)
+        tsdb.add_point("dc.m", BASE + 400, 99.0, {"host": "a"})
+        res, stats = run_group_query(tsdb, end=str(BASE + 401))
+        # stale -> host fallback, still correct (fresh point included)
+        assert "deviceCacheHit" not in stats
+        (a_dps,) = (d for t, d in dps_map(res).items()
+                    if dict(t)["host"] == "a")
+        # final 1m window holds i=36..39 plus the fresh 99:
+        # avg = (36+37+38+39+99)/5 — a stale serve would give 37.5
+        assert a_dps[-1][1] == pytest.approx(49.8)
+        # background refresh readmits the metric
+        assert tsdb.device_cache.refresh(tsdb.store) == 1
+        res2, stats2 = run_group_query(tsdb, end=str(BASE + 401))
+        assert stats2.get("deviceCacheHit") == 1.0
+        assert dps_map(res2) == dps_map(res)
+
+    def test_new_series_invalidates(self):
+        tsdb = make_tsdb()
+        run_group_query(tsdb)
+        tsdb.add_point("dc.m", BASE + 5, 7.0, {"host": "c"})
+        res, stats = run_group_query(tsdb)
+        assert "deviceCacheHit" not in stats
+        assert len(res) == 3
+        tsdb.device_cache.refresh(tsdb.store)
+        res2, stats2 = run_group_query(tsdb)
+        assert stats2.get("deviceCacheHit") == 1.0
+        assert dps_map(res2) == dps_map(res)
+
+    def test_deleted_and_recreated_series_never_validates(self):
+        # A recreated series has an equal key and a RESTARTED version
+        # counter — value-equality alone would let the old snapshot pass
+        # validation and serve deleted data (review r3 finding #1).
+        tsdb = make_tsdb()
+        run_group_query(tsdb)
+        metric = tsdb.metrics.get_id("dc.m")
+        key_a = sorted((s.key for s in
+                        tsdb.store.series_for_metric(metric)),
+                       key=lambda k: k.tags)[0]
+        old = tsdb.store.get_series(key_a)
+        tsdb.store.delete_series(key_a)
+        s2 = tsdb.store.get_or_create_series(key_a)
+        for i in range(40):   # one append per point: reach the SAME version
+            s2.append(BASE * 1000 + i * 10_000, 5.0, False)
+        assert s2.version == old.version  # version alone cannot distinguish
+        res, stats = run_group_query(tsdb)
+        assert "deviceCacheHit" not in stats   # stale, NOT a false hit
+        tsdb.device_cache.refresh(tsdb.store)
+        res2, stats2 = run_group_query(tsdb)
+        assert stats2.get("deviceCacheHit") == 1.0
+        assert dps_map(res2) == dps_map(res)
+
+    def test_build_respects_fix_duplicates_off(self):
+        # With tsd.storage.fix_duplicates=false a build over duplicate data
+        # must FAIL — never silently dedup the live series out from under
+        # fsck (review r3 finding #2).
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                            "tsd.storage.fix_duplicates": "false"}))
+        for v in (1.0, 2.0):
+            tsdb.add_point("dup.m", BASE + 60, v, {"h": "x"})
+        tsdb.add_point("dup.m", BASE + 10, 0.0, {"h": "x"})  # keep it dirty
+        metric = tsdb.metrics.get_id("dup.m")
+        (series,) = tsdb.store.series_for_metric(metric)
+        cache = tsdb.device_cache
+        assert cache.fix_duplicates is False
+        got = cache.batch_for(tsdb.store, metric, [series],
+                              BASE * 1000, (BASE + 100) * 1000,
+                              fix_duplicates=False)
+        assert got is None and cache.builds == 0
+        # the duplicate is still there for fsck to find
+        with pytest.raises(ValueError):
+            series.normalize(fix_duplicates=False)
+
+    def test_pad_contract_matches_pipeline(self):
+        from opentsdb_tpu.ops.pipeline import PAD_TS as PIPE_PAD
+        from opentsdb_tpu.storage.device_cache import PAD_TS as CACHE_PAD
+        assert PIPE_PAD == CACHE_PAD
+
+    def test_dropcaches_clears(self):
+        tsdb = make_tsdb()
+        run_group_query(tsdb)
+        assert len(tsdb.device_cache) == 1
+        tsdb.device_cache.invalidate()
+        assert len(tsdb.device_cache) == 0
+        _, stats = run_group_query(tsdb)    # rebuilds silently
+        assert stats.get("deviceCacheHit") == 1.0
+
+
+class TestBudget:
+    def test_oversized_metric_never_cached(self):
+        cache = DeviceSeriesCache(max_bytes=1024)   # 64 points worth
+        tsdb = make_tsdb()
+        metric = tsdb.metrics.get_id("dc.m")
+        series = tsdb.store.series_for_metric(metric)
+        got = cache.batch_for(tsdb.store, metric, series, BASE * 1000,
+                              (BASE + 400) * 1000)
+        assert got is None and cache.builds == 0
+
+    def test_build_max_points_gate(self):
+        cache = DeviceSeriesCache(max_bytes=1 << 30, build_max_points=10)
+        tsdb = make_tsdb()
+        metric = tsdb.metrics.get_id("dc.m")
+        series = tsdb.store.series_for_metric(metric)
+        assert cache.batch_for(tsdb.store, metric, series, BASE * 1000,
+                               (BASE + 400) * 1000) is None
+
+    def test_lru_eviction(self):
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        for m in ("m.one", "m.two"):
+            for i in range(16):
+                tsdb.add_point(m, BASE + i * 10, float(i), {"h": "x"})
+        # budget fits exactly one pow2-padded entry (1024 pts * 16B)
+        cache = DeviceSeriesCache(max_bytes=1024 * 16)
+        for name in ("m.one", "m.two"):
+            metric = tsdb.metrics.get_id(name)
+            series = tsdb.store.series_for_metric(metric)
+            assert cache.batch_for(tsdb.store, metric, series, BASE * 1000,
+                                   (BASE + 200) * 1000) is not None
+        assert cache.evictions == 1 and len(cache) == 1
+
+    def test_stats_surface(self):
+        tsdb = make_tsdb()
+        run_group_query(tsdb)
+        stats = tsdb.collect_stats()
+        assert stats["tsd.query.device_cache.entries"] == 1.0
+        assert stats["tsd.query.device_cache.builds"] == 1.0
+
+
+class TestGatherCorrectness:
+    def test_gather_matches_host_build(self):
+        from opentsdb_tpu.ops.pipeline import build_batch, PAD_TS
+        tsdb = make_tsdb()
+        metric = tsdb.metrics.get_id("dc.m")
+        series = sorted(tsdb.store.series_for_metric(metric),
+                        key=lambda s: s.key.tags)
+        cache = DeviceSeriesCache(max_bytes=1 << 30)
+        lo_ms, hi_ms = (BASE + 60) * 1000, (BASE + 180) * 1000
+        ts_d, val_d, mask_d = cache.batch_for(tsdb.store, metric, series,
+                                              lo_ms, hi_ms)
+        windows = [s.window(lo_ms, hi_ms) for s in series]
+        ts_h, val_h, mask_h, _ = build_batch(windows)
+        ts_d, val_d, mask_d = (np.asarray(ts_d), np.asarray(val_d),
+                               np.asarray(mask_d))
+        assert ts_d.shape == ts_h.shape
+        np.testing.assert_array_equal(mask_d, mask_h)
+        np.testing.assert_array_equal(ts_d[mask_d], ts_h[mask_h])
+        np.testing.assert_array_equal(val_d[mask_d], val_h[mask_h])
+        assert (ts_d[~mask_d] == PAD_TS).all()
