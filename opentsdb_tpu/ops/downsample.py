@@ -178,9 +178,12 @@ def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
 
 # Prefix-scan strategy for the hot path.  "flat" = one cumsum over the full
 # time axis; "blocked" = two-level scan (intra-block cumsum + tiny block-
-# offset scan) — shorter scan segments, same memory.  Which wins is a
-# hardware/XLA-lowering question; bench_prefix.py A/Bs them on the chip.
-_SCAN_MODE = "blocked"
+# offset scan) — shorter scan segments, same memory.  Measured on the real
+# chip (BENCH_CONFIGS_r03.json bench_prefix stage): flat 0.568s vs blocked
+# 0.600s per 67M-pt dispatch at int32 — XLA's native cumsum lowering beats
+# the hand-blocked form on TPU, so flat is the default (CPU favors blocked,
+# but defaults follow the chip).
+_SCAN_MODE = "flat"
 _SCAN_BLOCK = 512
 
 _I32_BIG = np.int64(2**31 - 2)

@@ -1,0 +1,128 @@
+"""Stage-decomposition bench: attribute the headline dispatch's time.
+
+The full production dispatch (bench.py shape: 1024x65536, avg-1h, 100
+groups) runs ~0.59s on the chip while its theoretical bandwidth cost is
+~10ms — ~300x gap that neither precision (f32 saves 8%) nor scan form
+(flat vs blocked within 5%) explains.  This bench times each pipeline
+stage as its own jitted dispatch, plus raw primitives as bandwidth
+yardsticks, using bench.py's honest drain methodology:
+
+    python tools/stage_bench.py
+
+Prints one JSON line per stage.  Stage sum > full-pipeline time is
+expected (XLA fuses across stage boundaries in the real program); the
+value is the RANKING — whichever stage dominates is the rework target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import bench
+from bench import (_OriginSequence, build_spec, drain, make_batch,
+                   measure_rtt, _median, S, N, INTERVAL_MS)
+
+
+def _note(msg: str) -> None:
+    print("[stages] " + msg, file=sys.stderr, flush=True)
+
+
+def time_fn(fn, args, rtt, reps=3):
+    """Median drained time of fn(*args) with the tunnel RTT removed."""
+    drain(fn(*args))            # compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        drain(fn(*args))
+        times.append(max(time.perf_counter() - t0 - rtt, 1e-9))
+    return _median(times)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from opentsdb_tpu.ops import downsample as ds
+
+    batch = make_batch()
+    _note("batch resident")
+    spec, wargs, g_pad = build_spec()
+    origins = _OriginSequence()
+    rtt = measure_rtt()
+    _note("rtt %.4fs" % rtt)
+    ts, val, mask, gid = batch
+    window_spec = spec.downsample.window_spec
+    w = window_spec.count
+
+    # Host-computed fixtures reused by isolated stages
+    first = wargs["first"]
+    cts, cedges = jax.jit(lambda t: ds._compact_ts(t, window_spec, wargs))(ts)
+    idx = jax.jit(lambda t, e: jax.vmap(
+        lambda row: jnp.searchsorted(row, e, side="left"))(t))(cts, cedges)
+    drain((cts, cedges, idx))
+
+    stages = {}
+
+    # raw primitives: bandwidth yardsticks
+    stages["prim_f64_mul"] = time_fn(
+        jax.jit(lambda v: v * 1.000001), (val,), rtt)
+    stages["prim_f64_cumsum"] = time_fn(
+        jax.jit(lambda v: jnp.cumsum(v, axis=1)), (val,), rtt)
+    stages["prim_f32_cumsum"] = time_fn(
+        jax.jit(lambda v: jnp.cumsum(v.astype(jnp.float32), axis=1)),
+        (val,), rtt)
+    stages["prim_i64_sub"] = time_fn(
+        jax.jit(lambda t: t - first), (ts,), rtt)
+    stages["prim_gather_edges"] = time_fn(
+        jax.jit(lambda c, i: jnp.take_along_axis(c, i, axis=1)),
+        (jnp.cumsum(val, axis=1), jnp.clip(idx, 0, N - 1)), rtt)
+
+    # pipeline stages in production order
+    stages["compact_ts"] = time_fn(
+        jax.jit(lambda t: ds._compact_ts(t, window_spec, wargs)), (ts,), rtt)
+    stages["searchsorted"] = time_fn(
+        jax.jit(lambda t, e: jax.vmap(
+            lambda row: jnp.searchsorted(row, e, side="left"))(t)),
+        (cts, cedges), rtt)
+
+    def windowed_avg(v, m, i):
+        builder = ds._edge_prefix_builder(S, N, i)
+        ok = m & ~jnp.isnan(v)
+        count = builder(ok.astype(jnp.int32))
+        total = builder(jnp.where(ok, v, 0.0))
+        return total / jnp.maximum(count, 1)
+
+    stages["windowed_avg_given_idx"] = time_fn(
+        jax.jit(windowed_avg), (val, mask, idx), rtt)
+
+    def full_downsample(t, v, m):
+        return ds.downsample(t, v, m, "avg", window_spec, wargs)
+
+    stages["downsample_full"] = time_fn(
+        jax.jit(full_downsample), (ts, val, mask), rtt)
+
+    from opentsdb_tpu.ops.group_agg import grid_group_aggregate
+    wts0, dval, dmask = jax.jit(full_downsample)(ts, val, mask)
+    drain((wts0, dval, dmask))
+    stages["group_tail"] = time_fn(
+        jax.jit(lambda g, v, m, gi: grid_group_aggregate(
+            g, v, m, gi, g_pad, "sum")),
+        (wts0, dval, dmask, jnp.asarray(gid)), rtt)
+
+    from bench import dispatch
+    stages["full_pipeline"] = time_fn(
+        lambda *a: dispatch(spec, g_pad, batch, wargs, origins.next()),
+        (), rtt)
+
+    for name, t in stages.items():
+        print(json.dumps({"stage": name, "seconds": round(t, 4),
+                          "dp_per_sec": round(S * N / t, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
