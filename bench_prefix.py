@@ -62,8 +62,51 @@ def main() -> None:
             "dp_per_sec": round(S * N / per, 1),
         }), flush=True)
         bench._note("%s: %.4fs/dispatch" % (name, per))
+    # edge-search strategy A/B at the winning scan config: binary search
+    # (log2(N) gather rounds) vs compare_all (fused compare+reduce).
+    ds.set_scan_mode("flat")
+    ds.set_ts_compaction(True)
+    ds.set_value_precision("double")
+    for smode in ("scan", "compare_all"):
+        ds.set_search_mode(smode)
+        drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
+        samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins,
+                                        rtt)
+        per = _median(samples)
+        print(json.dumps({
+            "config": "flat+int32+search_" + smode,
+            "s_per_dispatch": round(per, 4),
+            "dp_per_sec": round(S * N / per, 1),
+        }), flush=True)
+        bench._note("search_%s: %.4fs/dispatch" % (smode, per))
+    ds.set_search_mode("scan")
+
+    # min/max strategy A/B (NOTES r3: segments won on CPU, the chip
+    # decides the default): same shape, "min" downsample instead of avg.
+    from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
+    ds.set_scan_mode("flat")
+    ds.set_ts_compaction(True)
+    ds.set_value_precision("double")
+    spec_min = PipelineSpec(
+        aggregator="sum",
+        downsample=DownsampleStep("min", spec.downsample.window_spec,
+                                  "none", 0.0))
+    for mode in ("scan", "segment"):
+        ds.set_extreme_mode(mode)
+        drain(dispatch(spec_min, g_pad, batch, wargs, origins.next()))
+        samples, _, _ = measure_drained(spec_min, g_pad, batch, wargs,
+                                        origins, rtt)
+        per = _median(samples)
+        print(json.dumps({
+            "config": "min+extreme_" + mode,
+            "s_per_dispatch": round(per, 4),
+            "dp_per_sec": round(S * N / per, 1),
+        }), flush=True)
+        bench._note("min+extreme_%s: %.4fs/dispatch" % (mode, per))
+
     # restore defaults
-    ds.set_scan_mode("blocked")
+    ds.set_extreme_mode("scan")
+    ds.set_scan_mode("flat")
     ds.set_ts_compaction(True)
     ds.set_value_precision("double")
 

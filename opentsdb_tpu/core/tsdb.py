@@ -66,7 +66,9 @@ class TSDB:
                 self.config.get_int("tsd.query.device_cache.mb") * 2**20,
                 self.config.get_int(
                     "tsd.query.device_cache.build_max_points"),
-                fix_duplicates=self.config.fix_duplicates)
+                fix_duplicates=self.config.fix_duplicates,
+                batch_max_bytes=self.config.get_int(
+                    "tsd.query.device_cache.batch_mb") * 2**20)
             if self.config.get_bool("tsd.query.device_cache.enable")
             else None)
         from opentsdb_tpu.rollup import RollupConfig, RollupStore

@@ -191,6 +191,25 @@ _I32_BIG = np.int64(2**31 - 2)
 
 _COMPACT_ENABLED = True
 
+# Edge-position search strategy.  "scan" = jnp.searchsorted's binary
+# search: log2(N) rounds of gathers — TPU gathers serialize, so for the
+# [S, W+1]-edges-into-[S, N] search this is a chain of ~17 gather passes.
+# "compare_all" = one broadcasted compare + sum-reduce (idx[s, w] =
+# #points < edge): O(N*W) VPU compares that XLA fuses into a streaming
+# reduction over W-tiles — no gathers at all.  Which wins depends on W:
+# compare_all work grows linearly with the edge count while scan's grows
+# logarithmically with N; bench_prefix A/Bs both on the chip.
+_SEARCH_MODE = "scan"
+
+
+def set_search_mode(mode: str) -> None:
+    """'scan' | 'compare_all' — edge-search strategy; clears caches."""
+    global _SEARCH_MODE
+    if mode not in ("scan", "compare_all"):
+        raise ValueError("search mode must be 'scan' or 'compare_all'")
+    _SEARCH_MODE = mode
+    _clear_dependent_caches()
+
 # Value-accumulation precision for the prefix hot path.  "double" (default)
 # is the numeric contract — the reference accumulates in Java double
 # (Downsampler.java:257) and the golden tests pin 1e-9 agreement.  "single"
@@ -374,8 +393,9 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     vf = val.astype(fdtype)
     ok = mask & ~jnp.isnan(vf)
     cts, cedges = _compact_ts(ts, spec, wargs)
-    idx = jax.vmap(
-        lambda row: jnp.searchsorted(row, cedges, side="left"))(cts)
+    method = ("compare_all" if _SEARCH_MODE == "compare_all" else "scan")
+    idx = jax.vmap(lambda row: jnp.searchsorted(
+        row, cedges, side="left", method=method))(cts)
     windowed = _edge_prefix_builder(s, n, idx)
     count = windowed(ok.astype(jnp.int32)).astype(jnp.int64)
     return vf, ok, idx, windowed, count

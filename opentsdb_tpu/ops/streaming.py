@@ -385,7 +385,14 @@ def _update(spec: WindowSpec, state: dict, ts, val, mask, wargs: dict):
                                         with_sketch="q" in state))
 
 
-_jitted_update = jax.jit(_update, static_argnums=0)
+# State buffers are DONATED: the accumulator grid can reach GBs (config 2:
+# [128, 2^20] x 4 lanes ~ 3.5 GB), and without donation every queued async
+# update holds old state + chunk moments + new state — the r3 chip run
+# crashed the TPU worker exactly there.  Donation lets XLA alias the
+# state in/out buffers so the peak stays ~one state + one chunk.  The
+# caller never touches the pre-update state again (StreamAccumulator
+# replaces self.state at enqueue).
+_jitted_update = jax.jit(_update, static_argnums=0, donate_argnums=1)
 
 
 def _finish(spec: WindowSpec, ds_function: str, fill_policy: str,

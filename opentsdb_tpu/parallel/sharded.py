@@ -340,7 +340,10 @@ def _stream_update_fn(mesh: Mesh, window_spec):
                   P(_BOTH, None), P()),
         out_specs=P(_BOTH, None),
         check_vma=False)
-    return jax.jit(mapped)
+    # Donate the state (arg 0) for the same reason as streaming's
+    # _jitted_update: the sharded grid can reach GBs per chip and the
+    # caller replaces its reference at enqueue.
+    return jax.jit(mapped, donate_argnums=0)
 
 
 @lru_cache(maxsize=64)

@@ -81,9 +81,15 @@ class DeviceSeriesCache:
     """Byte-budgeted, version-validated device cache of metric columns."""
 
     def __init__(self, max_bytes: int, build_max_points: int = 200_000_000,
-                 fix_duplicates: bool = True):
+                 fix_duplicates: bool = True,
+                 batch_max_bytes: int = 6 << 30):
         self.max_bytes = int(max_bytes)
         self.build_max_points = int(build_max_points)
+        # The gather EXPANDS the packed buffer to a padded [S, N] batch;
+        # row-length skew can make that much larger than the entry itself.
+        # Batches estimated beyond this bound decline (the streaming path
+        # serves them chunked instead of OOMing the device).
+        self.batch_max_bytes = int(batch_max_bytes)
         # The store-wide duplicate policy: snapshots must normalize with
         # EXACTLY the policy reads use — with fix_duplicates off, a build
         # touching duplicate data must fail (and never silently dedup the
@@ -114,16 +120,26 @@ class DeviceSeriesCache:
     # -- query path ------------------------------------------------------
 
     def batch_for(self, store, metric: int, series_list, start_ms: int,
-                  end_ms: int, fix_duplicates: bool = True):
+                  end_ms: int, fix_duplicates: bool = True,
+                  build: bool = True):
         """Device [S, N] (ts, val, mask) for the series' windows, or None.
 
         A None return means cold/stale/over-budget — the caller uses its
-        host build path.  Never blocks on a rebuild: staleness only queues
-        the metric for the maintenance-thread `refresh()`.
+        host build path.  `build=False` declines to construct a cold entry
+        inline and queues it for the maintenance-thread `refresh()`
+        instead — callers pass it when they have a cheaper cold path (the
+        streaming scan overlaps transfer with compute; a blocking full-
+        metric upload first would be strictly worse).  Staleness likewise
+        only ever queues a background rebuild.
         """
         with self._lock:
             entry = self._entries.get(metric)
         if entry is None:
+            if not build:
+                with self._lock:
+                    self._stale_metrics.add(metric)
+                self._count("misses")
+                return None
             entry = self._build(store, metric)
             if entry is None:
                 self._count("misses")
@@ -153,6 +169,9 @@ class DeviceSeriesCache:
             starts[i] = entry.offsets[row] + lo
             lengths[i] = hi - lo
         n = _pad_pow2(max(int(lengths.max(initial=0)), 1))
+        if s * n * 17 > self.batch_max_bytes:   # ts8 + val8 + mask1
+            self._count("misses")
+            return None
         with self._lock:
             self._tick += 1
             entry.tick = self._tick

@@ -86,6 +86,7 @@ DEFAULTS: dict[str, str] = {
     "tsd.query.device_cache.enable": "true",
     "tsd.query.device_cache.mb": "4096",
     "tsd.query.device_cache.build_max_points": "200000000",
+    "tsd.query.device_cache.batch_mb": "6144",
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",

@@ -201,6 +201,32 @@ class TestBudget:
                                    (BASE + 200) * 1000) is not None
         assert cache.evictions == 1 and len(cache) == 1
 
+    def test_batch_expansion_guard(self):
+        tsdb = make_tsdb()
+        metric = tsdb.metrics.get_id("dc.m")
+        series = tsdb.store.series_for_metric(metric)
+        cache = DeviceSeriesCache(max_bytes=1 << 30, batch_max_bytes=64)
+        got = cache.batch_for(tsdb.store, metric, series, BASE * 1000,
+                              (BASE + 400) * 1000)
+        assert got is None            # would expand past batch_max_bytes
+        assert cache.builds == 1      # the entry itself was fine
+
+    def test_cached_metric_preempts_streaming(self):
+        # Over the streaming threshold a COLD metric streams (no blocking
+        # inline build) and queues itself; after the maintenance-thread
+        # build, the same query answers materialized from HBM — identical
+        # values either way.
+        tsdb = make_tsdb(**{"tsd.query.streaming.point_threshold": "10"})
+        res_stream, s1 = run_group_query(tsdb)
+        assert s1.get("streamedChunks", 0) > 0
+        assert "deviceCacheHit" not in s1
+        assert tsdb.device_cache.builds == 0     # cold build was deferred
+        assert tsdb.device_cache.refresh(tsdb.store) == 1
+        res_cached, s2 = run_group_query(tsdb)
+        assert s2.get("deviceCacheHit") == 1.0
+        assert "streamedChunks" not in s2
+        assert dps_map(res_cached) == dps_map(res_stream)
+
     def test_stats_surface(self):
         tsdb = make_tsdb()
         run_group_query(tsdb)

@@ -146,7 +146,7 @@ class TestScanModesAndCompaction:
                 _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
                                            FILL_NONE)
             finally:
-                ds_mod.set_scan_mode("blocked")
+                ds_mod.set_scan_mode("flat")  # restore the chip-won default
             outs[mode] = (np.asarray(out), np.asarray(omask))
         np.testing.assert_array_equal(outs["flat"][1], outs["blocked"][1])
         m = outs["flat"][1]
@@ -159,6 +159,30 @@ class TestScanModesAndCompaction:
         got = outs["blocked"][0][:, :windows.count]
         np.testing.assert_allclose(got[want_cnt > 0], want[want_cnt > 0],
                                    rtol=1e-11, atol=1e-9)
+
+    @pytest.mark.parametrize("agg", ["avg", "sum", "count", "dev"])
+    def test_compare_all_search_equals_scan(self, agg):
+        """The compare_all edge search (fused compare+reduce, no gathers)
+        must index identically to the binary search on every grid kind."""
+        from opentsdb_tpu.ops import downsample as ds_mod
+        rng = np.random.default_rng(23)
+        ts, val, mask = self._big_batch(rng)
+        windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
+        spec, wargs = windows.split()
+        outs = {}
+        for mode in ("scan", "compare_all"):
+            ds_mod.set_search_mode(mode)
+            try:
+                _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
+                                           FILL_NONE)
+            finally:
+                ds_mod.set_search_mode("scan")
+            outs[mode] = (np.asarray(out), np.asarray(omask))
+        np.testing.assert_array_equal(outs["scan"][1], outs["compare_all"][1])
+        m = outs["scan"][1]
+        np.testing.assert_allclose(outs["compare_all"][0][m],
+                                   outs["scan"][0][m],
+                                   rtol=1e-12, atol=1e-12)
 
     def test_int64_fallback_for_wide_grids(self):
         """A grid spanning >= 2^31 ms must keep int64 timestamps and still

@@ -39,11 +39,18 @@ def run_stage(name: str, argv: list[str], timeout: int) -> list[str]:
 
 def main() -> None:
     results: list[dict] = []
-    for name, argv, timeout in [
+    stages = [
         ("bench_prefix", [sys.executable, "bench_prefix.py"], 3600),
         ("bench", [sys.executable, "bench.py"], 1800),
-        ("bench_configs", [sys.executable, "bench_configs.py"], 5400),
-    ]:
+    ]
+    # One subprocess PER config: config 2 crashed the TPU worker in the r3
+    # session and the single bench_configs process lost configs 3-7 with it.
+    # Isolated, a crash costs exactly one config (the worker restarts
+    # between subprocesses).
+    stages += [("bench_configs:%d" % c,
+                [sys.executable, "bench_configs.py", "--config", str(c)],
+                2400) for c in range(1, 8)]
+    for name, argv, timeout in stages:
         try:
             for ln in run_stage(name, argv, timeout):
                 rec = json.loads(ln)

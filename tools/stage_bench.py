@@ -66,29 +66,35 @@ def main() -> None:
         lambda row: jnp.searchsorted(row, e, side="left"))(t))(cts, cedges)
     drain((cts, cedges, idx))
 
-    stages = {}
+    def record(name, t):
+        # one JSON line per stage, emitted IMMEDIATELY: a chip crash in a
+        # later stage must not lose earlier attributions (the reason this
+        # tool exists)
+        print(json.dumps({"stage": name, "seconds": round(t, 4),
+                          "dp_per_sec": round(S * N / t, 1)}), flush=True)
+        _note("%s: %.4fs" % (name, t))
 
     # raw primitives: bandwidth yardsticks
-    stages["prim_f64_mul"] = time_fn(
-        jax.jit(lambda v: v * 1.000001), (val,), rtt)
-    stages["prim_f64_cumsum"] = time_fn(
-        jax.jit(lambda v: jnp.cumsum(v, axis=1)), (val,), rtt)
-    stages["prim_f32_cumsum"] = time_fn(
+    record("prim_f64_mul", time_fn(
+        jax.jit(lambda v: v * 1.000001), (val,), rtt))
+    record("prim_f64_cumsum", time_fn(
+        jax.jit(lambda v: jnp.cumsum(v, axis=1)), (val,), rtt))
+    record("prim_f32_cumsum", time_fn(
         jax.jit(lambda v: jnp.cumsum(v.astype(jnp.float32), axis=1)),
-        (val,), rtt)
-    stages["prim_i64_sub"] = time_fn(
-        jax.jit(lambda t: t - first), (ts,), rtt)
-    stages["prim_gather_edges"] = time_fn(
+        (val,), rtt))
+    record("prim_i64_sub", time_fn(
+        jax.jit(lambda t: t - first), (ts,), rtt))
+    record("prim_gather_edges", time_fn(
         jax.jit(lambda c, i: jnp.take_along_axis(c, i, axis=1)),
-        (jnp.cumsum(val, axis=1), jnp.clip(idx, 0, N - 1)), rtt)
+        (jnp.cumsum(val, axis=1), jnp.clip(idx, 0, N - 1)), rtt))
 
     # pipeline stages in production order
-    stages["compact_ts"] = time_fn(
-        jax.jit(lambda t: ds._compact_ts(t, window_spec, wargs)), (ts,), rtt)
-    stages["searchsorted"] = time_fn(
+    record("compact_ts", time_fn(
+        jax.jit(lambda t: ds._compact_ts(t, window_spec, wargs)), (ts,), rtt))
+    record("searchsorted", time_fn(
         jax.jit(lambda t, e: jax.vmap(
             lambda row: jnp.searchsorted(row, e, side="left"))(t)),
-        (cts, cedges), rtt)
+        (cts, cedges), rtt))
 
     def windowed_avg(v, m, i):
         builder = ds._edge_prefix_builder(S, N, i)
@@ -97,31 +103,30 @@ def main() -> None:
         total = builder(jnp.where(ok, v, 0.0))
         return total / jnp.maximum(count, 1)
 
-    stages["windowed_avg_given_idx"] = time_fn(
-        jax.jit(windowed_avg), (val, mask, idx), rtt)
+    record("windowed_avg_given_idx", time_fn(
+        jax.jit(windowed_avg), (val, mask, idx), rtt))
 
     def full_downsample(t, v, m):
         return ds.downsample(t, v, m, "avg", window_spec, wargs)
 
-    stages["downsample_full"] = time_fn(
-        jax.jit(full_downsample), (ts, val, mask), rtt)
+    record("downsample_full", time_fn(
+        jax.jit(full_downsample), (ts, val, mask), rtt))
 
     from opentsdb_tpu.ops.group_agg import grid_group_aggregate
+    from opentsdb_tpu.ops.aggregators import get_agg
     wts0, dval, dmask = jax.jit(full_downsample)(ts, val, mask)
     drain((wts0, dval, dmask))
-    stages["group_tail"] = time_fn(
+    agg_sum = get_agg("sum")
+    record("group_tail", time_fn(
         jax.jit(lambda g, v, m, gi: grid_group_aggregate(
-            g, v, m, gi, g_pad, "sum")),
-        (wts0, dval, dmask, jnp.asarray(gid)), rtt)
+            g, v, m, gi, g_pad, agg_sum)),
+        (wts0, dval, dmask, jnp.asarray(gid)), rtt))
 
     from bench import dispatch
-    stages["full_pipeline"] = time_fn(
+    record("full_pipeline", time_fn(
         lambda *a: dispatch(spec, g_pad, batch, wargs, origins.next()),
-        (), rtt)
+        (), rtt))
 
-    for name, t in stages.items():
-        print(json.dumps({"stage": name, "seconds": round(t, 4),
-                          "dp_per_sec": round(S * N / t, 1)}), flush=True)
 
 
 if __name__ == "__main__":
