@@ -365,6 +365,18 @@ def config6(scale: float, n_dev: int) -> None:
     bulk_secs = time.perf_counter() - t0
     assert success == n and not errors
 
+    # native C++ body parser (the path a real POST /api/put takes): raw
+    # JSON bytes in, columnar batches out — includes the JSON parse the
+    # pre-parsed python timing above gets for free
+    body = json.dumps(dps).encode()
+    t_native = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    t0 = time.perf_counter()
+    native = t_native.add_points_bulk_native(body)
+    native_secs = time.perf_counter() - t0
+    have_native = native is not None
+    if have_native:
+        assert native[0] == n and not native[1]
+
     t_single = TSDB(Config({"tsd.core.auto_create_metrics": True}))
     t0 = time.perf_counter()
     for dp in dps:
@@ -372,11 +384,15 @@ def config6(scale: float, n_dev: int) -> None:
                            dp["tags"])
     single_secs = time.perf_counter() - t0
 
-    _note("config 6: bulk %.3fs, per-point %.3fs for %d pts"
-          % (bulk_secs, single_secs, n))
-    _emit(6, "bulk ingest points/sec (vs_baseline = speedup over "
-             "per-point add_point)", n, bulk_secs, 1,
-          unit="points/sec ingested",
+    _note("config 6: native %s, bulk %.3fs, per-point %.3fs for %d pts"
+          % ("%.3fs" % native_secs if have_native else "unavailable",
+             bulk_secs, single_secs, n))
+    best_secs = native_secs if have_native else bulk_secs
+    _emit(6, "bulk ingest points/sec via %s (vs_baseline = speedup over "
+             "per-point add_point)"
+          % ("the native C++ /api/put body parser" if have_native
+             else "the python bulk path"),
+          n, best_secs, 1, unit="points/sec ingested",
           baseline=n / max(single_secs, 1e-9))
 
 

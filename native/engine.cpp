@@ -20,11 +20,14 @@
 // C ABI only (driven from Python via ctypes).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <unordered_map>
 #include <string>
 #include <vector>
 
@@ -495,4 +498,911 @@ EXPORT void* eng_load(const char* path) {
     }
     std::fclose(f);
     return eng;
+}
+
+// ================================================================ bulk put
+//
+// Native fast path for POST /api/put bodies (the reference's ingest
+// scale claim, README:12-15, flows through PutDataPointRpc:272 ->
+// TSDB.addPoint per point).  The Python bulk path (TSDB.add_points_bulk)
+// already amortizes locks and column appends; profiling shows the
+// remaining ~75% is the per-point Python loop: JSON object walk,
+// validation, value classification, tag canonicalization.  This parser
+// does all of that in one pass over the raw body bytes and hands Python
+// back columnar arrays plus a distinct-series key table, so Python cost
+// becomes O(distinct series), not O(points).
+//
+// Semantics mirror tsdb.py EXACTLY (error strings included) — any
+// construct whose Python behavior is exotic (non-string metric/tags,
+// arbitrary-precision timestamps, bool timestamps) returns FALLBACK so
+// the caller reruns the Python path; behavior can never silently drift
+// for inputs the native path accepts.  Tag canonicalization: tags sort
+// bytewise on UTF-8 keys == Python's sorted() on code points.
+
+namespace putparse {
+
+struct PutBatch {
+    std::vector<int64_t> ts;        // normalized ms
+    std::vector<double> fval;
+    std::vector<int64_t> ival;
+    std::vector<uint8_t> isint;
+    std::vector<int32_t> group;     // -1 on error
+    std::vector<int64_t> span;      // 2*i: start, 2*i+1: end byte offsets
+    // errors are SPARSE (parallel arrays, point index ascending) — a
+    // per-point string pair would dominate allocation on clean bodies
+    std::vector<int64_t> err_idx;
+    std::vector<std::string> err_msg;
+    std::vector<std::string> err_kind;  // "ValueError" | "TypeError"
+    // group table: canonical "metric\x1Ftagk\x1Etagv\x1F..." keys
+    std::vector<std::string> gkeys;
+    std::unordered_map<std::string, int32_t> gindex;
+    // reused scratch (steady-state zero allocation per point)
+    std::string ckey_scratch;
+    std::vector<std::pair<std::string, std::string>> sort_scratch;
+};
+
+struct Parser {
+    const char* p;
+    const char* end;
+    bool fallback = false;
+
+    explicit Parser(const char* data, size_t len)
+        : p(data), end(data + len) {}
+
+    void ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                           *p == '\r'))
+            p++;
+    }
+    bool lit(const char* s) {
+        size_t n = std::strlen(s);
+        if (static_cast<size_t>(end - p) < n || std::memcmp(p, s, n) != 0)
+            return false;
+        p += n;
+        return true;
+    }
+    // JSON string -> UTF-8 std::string; false on malformed
+    bool str(std::string& out) {
+        out.clear();
+        if (p >= end || *p != '"') return false;
+        p++;
+        while (p < end) {
+            unsigned char c = static_cast<unsigned char>(*p);
+            if (c == '"') { p++; return true; }
+            if (c == '\\') {
+                if (++p >= end) return false;
+                char e = *p++;
+                switch (e) {
+                    case '"': out.push_back('"'); break;
+                    case '\\': out.push_back('\\'); break;
+                    case '/': out.push_back('/'); break;
+                    case 'b': out.push_back('\b'); break;
+                    case 'f': out.push_back('\f'); break;
+                    case 'n': out.push_back('\n'); break;
+                    case 'r': out.push_back('\r'); break;
+                    case 't': out.push_back('\t'); break;
+                    case 'u': {
+                        if (end - p < 4) return false;
+                        unsigned cp = 0;
+                        for (int i = 0; i < 4; i++) {
+                            char h = *p++;
+                            cp <<= 4;
+                            if (h >= '0' && h <= '9') cp |= h - '0';
+                            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+                            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+                            else return false;
+                        }
+                        bool paired = false;
+                        if (cp >= 0xD800 && cp <= 0xDBFF && end - p >= 6 &&
+                            p[0] == '\\' && p[1] == 'u') {
+                            unsigned lo = 0;
+                            const char* q = p + 2;
+                            bool ok = true;
+                            for (int i = 0; i < 4; i++) {
+                                char h = q[i];
+                                lo <<= 4;
+                                if (h >= '0' && h <= '9') lo |= h - '0';
+                                else if (h >= 'a' && h <= 'f')
+                                    lo |= h - 'a' + 10;
+                                else if (h >= 'A' && h <= 'F')
+                                    lo |= h - 'A' + 10;
+                                else { ok = false; break; }
+                            }
+                            if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+                                cp = 0x10000 + ((cp - 0xD800) << 10)
+                                     + (lo - 0xDC00);
+                                p += 6;
+                                paired = true;
+                            }
+                        }
+                        // Lone surrogates are valid JSON (json.loads
+                        // keeps them as Python surrogate code points)
+                        // but have no UTF-8 encoding — the Python path
+                        // owns that exotic case.
+                        if (cp >= 0xD800 && cp <= 0xDFFF && !paired) {
+                            fallback = true;
+                            cp = 0xFFFD;
+                        }
+                        // encode UTF-8
+                        if (cp < 0x80) out.push_back(static_cast<char>(cp));
+                        else if (cp < 0x800) {
+                            out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+                            out.push_back(static_cast<char>(
+                                0x80 | (cp & 0x3F)));
+                        } else if (cp < 0x10000) {
+                            out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+                            out.push_back(static_cast<char>(
+                                0x80 | ((cp >> 6) & 0x3F)));
+                            out.push_back(static_cast<char>(
+                                0x80 | (cp & 0x3F)));
+                        } else {
+                            out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+                            out.push_back(static_cast<char>(
+                                0x80 | ((cp >> 12) & 0x3F)));
+                            out.push_back(static_cast<char>(
+                                0x80 | ((cp >> 6) & 0x3F)));
+                            out.push_back(static_cast<char>(
+                                0x80 | (cp & 0x3F)));
+                        }
+                        break;
+                    }
+                    default: return false;
+                }
+            } else {
+                out.push_back(static_cast<char>(c));
+                p++;
+            }
+        }
+        return false;  // unterminated
+    }
+    // skip any JSON value (for unknown keys); false on malformed
+    bool skip() {
+        ws();
+        if (p >= end) return false;
+        char c = *p;
+        if (c == '"') { std::string s_; return str(s_); }
+        if (c == '{' || c == '[') {
+            char open = c, close = (c == '{') ? '}' : ']';
+            int depth = 0;
+            bool in_str = false;
+            while (p < end) {
+                char d = *p;
+                if (in_str) {
+                    if (d == '\\') { p++; if (p >= end) return false; }
+                    else if (d == '"') in_str = false;
+                } else {
+                    if (d == '"') in_str = true;
+                    else if (d == open) depth++;
+                    else if (d == close) {
+                        if (--depth == 0) { p++; return true; }
+                    }
+                }
+                p++;
+            }
+            return false;
+        }
+        // number / literal
+        const char* q = p;
+        while (q < end && *q != ',' && *q != '}' && *q != ']' &&
+               *q != ' ' && *q != '\t' && *q != '\n' && *q != '\r')
+            q++;
+        if (q == p) return false;
+        p = q;
+        return true;
+    }
+};
+
+// Python-int grammar: optional sign, digits with single underscores
+// BETWEEN digits (int("1_0") == 10).  Returns false if not an integer
+// literal by Python rules.
+inline bool py_int(const std::string& t, bool& overflow, int64_t& out) {
+    size_t i = 0;
+    bool neg = false;
+    overflow = false;
+    out = 0;
+    if (i < t.size() && (t[i] == '+' || t[i] == '-')) {
+        neg = t[i] == '-';
+        i++;
+    }
+    if (i >= t.size()) return false;
+    bool prev_digit = false;
+    bool acc_overflow = false;
+    uint64_t acc = 0;
+    for (; i < t.size(); i++) {
+        char c = t[i];
+        if (c == '_') {
+            // Python int(): single underscores BETWEEN digits only
+            if (!prev_digit || i + 1 >= t.size()) return false;
+            prev_digit = false;
+            continue;
+        }
+        if (c < '0' || c > '9') return false;
+        prev_digit = true;
+        uint64_t d = static_cast<uint64_t>(c - '0');
+        if (acc > (UINT64_MAX - d) / 10) acc_overflow = true;
+        else acc = acc * 10 + d;
+    }
+    if (!prev_digit) return false;
+    // Java-long range check (Python ints are unbounded; the CALLER
+    // rejects out-of-range with "out of long range")
+    uint64_t lim = neg ? (1ULL << 63) : (1ULL << 63) - 1;
+    if (acc_overflow || acc > lim) {
+        overflow = true;
+        return true;
+    }
+    out = neg ? (acc == (1ULL << 63) ? INT64_MIN
+                                     : -static_cast<int64_t>(acc))
+              : static_cast<int64_t>(acc);
+    return true;
+}
+
+// Python-float grammar is strtod plus underscores-between-digits and
+// without hex floats.  Returns false if not parseable as Python float.
+inline bool py_float(const std::string& t, double& out) {
+    if (t.empty()) return false;
+    std::string clean;
+    clean.reserve(t.size());
+    bool prev_digit = false;
+    for (size_t i = 0; i < t.size(); i++) {
+        char c = t[i];
+        if (c == '_') {
+            bool next_digit = i + 1 < t.size() && t[i + 1] >= '0' &&
+                              t[i + 1] <= '9';
+            if (!prev_digit || !next_digit) return false;
+            continue;
+        }
+        if (c == 'x' || c == 'X') return false;  // no hex floats
+        prev_digit = c >= '0' && c <= '9';
+        clean.push_back(c);
+    }
+    const char* s = clean.c_str();
+    char* endp = nullptr;
+    out = std::strtod(s, &endp);
+    return endp == s + clean.size() && endp != s;
+}
+
+// simplified Python repr() of a decoded string (enough for error
+// messages on realistic inputs; exotic escapes fall back)
+inline bool py_repr(const std::string& s, std::string& out) {
+    bool has_sq = s.find('\'') != std::string::npos;
+    bool has_dq = s.find('"') != std::string::npos;
+    char quote = (has_sq && !has_dq) ? '"' : '\'';
+    out.clear();
+    out.push_back(quote);
+    for (unsigned char c : s) {
+        if (c < 0x20 || c == 0x7F) return false;   // control chars: punt
+        if (c == static_cast<unsigned char>(quote)) {
+            out.push_back('\\');
+        } else if (c == '\\') {
+            out.push_back('\\');
+        }
+        out.push_back(static_cast<char>(c));
+    }
+    out.push_back(quote);
+    return true;
+}
+
+// repr of a double the way Python renders it in error messages
+inline std::string py_float_str(double v) {
+    char buf[64];
+    double r = v;
+    std::snprintf(buf, sizeof buf, "%.17g", r);
+    // Python uses repr shortest round-trip; try %.15g, %.16g first
+    for (int prec = 15; prec <= 17; prec++) {
+        std::snprintf(buf, sizeof buf, "%.*g", prec, r);
+        if (std::strtod(buf, nullptr) == r) break;
+    }
+    std::string s(buf);
+    if (s.find('.') == std::string::npos &&
+        s.find('e') == std::string::npos &&
+        s.find('n') == std::string::npos &&
+        s.find('i') == std::string::npos)
+        s += ".0";
+    return s;
+}
+
+constexpr int64_t SECOND_MASK_LO = 0x100000000LL;  // ts >= 2^32 -> already ms
+
+struct PointScratch {
+    std::string metric;
+    size_t ntags = 0;         // live prefix of `tags` (slots are reused)
+    bool metric_seen = false, metric_is_str = false;
+    std::string ts_str;       // lexeme or decoded string
+    bool ts_seen = false, ts_is_str = false, ts_is_num = false;
+    double ts_num = 0;
+    bool ts_num_is_int = false;
+    int64_t ts_int = 0;
+    std::string val_str;
+    bool val_seen = false, val_is_str = false, val_is_num = false,
+         val_is_bool = false, val_bool = false, val_is_null = false;
+    double val_num = 0;
+    bool val_num_is_int = false;
+    int64_t val_int = 0;
+    bool val_int_overflow = false;
+    std::vector<std::pair<std::string, std::string>> tags;
+    bool tags_seen = false, tags_empty = false;
+};
+
+}  // namespace putparse
+
+using putparse::PutBatch;
+using putparse::Parser;
+using putparse::PointScratch;
+
+namespace putparse {
+
+// parse one number token with STRICT JSON grammar
+// ('-'? (0|[1-9][0-9]*) ('.'[0-9]+)? ([eE][+-]?[0-9]+)?); sets is_int if
+// the lexeme has no . e E.  Leniency here would make the API accept
+// bodies (+5, 007, .5) that json.loads rejects, so accept/reject
+// behavior would depend on whether the native library is present.
+inline bool number(Parser& P, double& out, bool& is_int, int64_t& ival,
+                   bool& overflow, std::string& lexeme) {
+    const char* q = P.p;
+    if (q < P.end && *q == '-') q++;
+    if (q >= P.end || *q < '0' || *q > '9') return false;
+    if (*q == '0') q++;                       // no leading zeros
+    else while (q < P.end && *q >= '0' && *q <= '9') q++;
+    bool frac = false;
+    if (q < P.end && *q == '.') {
+        frac = true;
+        q++;
+        if (q >= P.end || *q < '0' || *q > '9') return false;
+        while (q < P.end && *q >= '0' && *q <= '9') q++;
+    }
+    if (q < P.end && (*q == 'e' || *q == 'E')) {
+        frac = true;
+        q++;
+        if (q < P.end && (*q == '+' || *q == '-')) q++;
+        if (q >= P.end || *q < '0' || *q > '9') return false;
+        while (q < P.end && *q >= '0' && *q <= '9') q++;
+    }
+    lexeme.assign(P.p, q - P.p);
+    is_int = !frac;
+    if (is_int) {
+        if (!py_int(lexeme, overflow, ival)) return false;
+        out = static_cast<double>(ival);
+        if (overflow) out = 0;
+    } else {
+        char* endp = nullptr;
+        out = std::strtod(lexeme.c_str(), &endp);
+        if (endp != lexeme.c_str() + lexeme.size()) return false;
+    }
+    P.p = q;
+    return true;
+}
+
+}  // namespace putparse
+
+
+namespace putparse {
+
+enum FieldKind : uint8_t {
+    K_ABSENT = 0, K_NULL, K_STRING, K_NUMBER, K_BOOL, K_OBJECT, K_ARRAY,
+    K_EMPTY_OBJECT
+};
+
+struct RawPoint {
+    PointScratch s;
+    uint8_t metric_kind = K_ABSENT;
+    uint8_t ts_kind = K_ABSENT;
+    uint8_t val_kind = K_ABSENT;
+    uint8_t tags_kind = K_ABSENT;
+    int64_t span_start = 0, span_end = 0;
+    std::string ts_lexeme;    // original number lexeme for %s rendering
+    std::string val_lexeme;
+
+    // Reset for reuse between points: strings keep their capacity, so a
+    // long body parses with near-zero steady-state allocation (storing
+    // one RawPoint per point cost ~10 allocs x N and dominated the
+    // parse at 400k points).
+    void reset() {
+        metric_kind = ts_kind = val_kind = tags_kind = K_ABSENT;
+        span_start = span_end = 0;
+        ts_lexeme.clear();
+        val_lexeme.clear();
+        s.metric.clear();
+        s.ts_str.clear();
+        s.val_str.clear();
+        s.ntags = 0;          // slots stay allocated for reuse
+        s.metric_seen = s.metric_is_str = false;
+        s.ts_seen = s.ts_is_str = s.ts_is_num = false;
+        s.ts_num = 0;
+        s.ts_num_is_int = false;
+        s.ts_int = 0;
+        s.val_seen = s.val_is_str = s.val_is_num = false;
+        s.val_is_bool = s.val_bool = s.val_is_null = false;
+        s.val_num = 0;
+        s.val_num_is_int = false;
+        s.val_int = 0;
+        s.val_int_overflow = false;
+        s.tags_seen = s.tags_empty = false;
+    }
+};
+
+// Parse one datapoint object into RawPoint; returns false -> malformed
+// JSON (whole-body fallback).  Sets P.fallback for exotic-but-valid
+// constructs whose Python behavior we refuse to mirror natively.
+inline bool parse_point(Parser& P, RawPoint& rp, const char* base) {
+    P.ws();
+    if (P.p >= P.end || *P.p != '{') return false;
+    rp.span_start = P.p - base;
+    P.p++;
+    bool first = true;
+    std::string key;              // reused across fields
+    for (;;) {
+        P.ws();
+        if (P.p < P.end && *P.p == '}') {
+            P.p++;
+            break;
+        }
+        if (!first) {
+            if (P.p >= P.end || *P.p != ',') return false;
+            P.p++;
+            P.ws();
+        }
+        first = false;
+        if (!P.str(key)) return false;
+        P.ws();
+        if (P.p >= P.end || *P.p != ':') return false;
+        P.p++;
+        P.ws();
+        if (key == "metric") {
+            if (P.p < P.end && *P.p == '"') {
+                if (!P.str(rp.s.metric)) return false;
+                rp.metric_kind = K_STRING;
+            } else if (P.lit("null")) {
+                rp.metric_kind = K_NULL;
+            } else {
+                rp.metric_kind = K_NUMBER;  // any non-string: fallback later
+                P.fallback = true;
+                if (!P.skip()) return false;
+            }
+        } else if (key == "timestamp") {
+            if (P.p < P.end && *P.p == '"') {
+                if (!P.str(rp.s.ts_str)) return false;
+                rp.ts_kind = K_STRING;
+            } else if (P.lit("null")) {
+                rp.ts_kind = K_NULL;
+            } else if (P.lit("true") || P.lit("false")) {
+                rp.ts_kind = K_BOOL;
+                P.fallback = true;
+            } else if (P.p < P.end && (*P.p == '{' || *P.p == '[')) {
+                const char* before = P.p;
+                char open = *P.p;
+                if (!P.skip()) return false;
+                // Python: {} == {} -> missing field; others TypeError
+                std::string body(before, P.p - before);
+                bool empty = true;
+                for (char c : body)
+                    if (c != '{' && c != '}' && c != '[' && c != ']' &&
+                        c != ' ' && c != '\t' && c != '\n' && c != '\r')
+                        empty = false;
+                rp.ts_kind = (empty && open == '{') ? K_EMPTY_OBJECT
+                                                    : K_OBJECT;
+                if (rp.ts_kind == K_OBJECT) P.fallback = true;
+            } else {
+                bool is_int = false, of = false;
+                int64_t iv = 0;
+                if (!number(P, rp.s.ts_num, is_int, iv, of,
+                            rp.ts_lexeme)) return false;
+                if (of) { P.fallback = true; }   // arbitrary-precision ts
+                rp.ts_kind = K_NUMBER;
+                rp.s.ts_is_num = true;
+                rp.s.ts_num_is_int = is_int;
+                rp.s.ts_int = iv;
+            }
+        } else if (key == "value") {
+            if (P.p < P.end && *P.p == '"') {
+                if (!P.str(rp.s.val_str)) return false;
+                rp.val_kind = K_STRING;
+            } else if (P.lit("null")) {
+                rp.val_kind = K_NULL;
+            } else if (P.lit("true")) {
+                rp.val_kind = K_BOOL;
+                rp.s.val_bool = true;
+            } else if (P.lit("false")) {
+                rp.val_kind = K_BOOL;
+                rp.s.val_bool = false;
+            } else if (P.p < P.end && (*P.p == '{' || *P.p == '[')) {
+                const char* before = P.p;
+                char open = *P.p;
+                if (!P.skip()) return false;
+                std::string body(before, P.p - before);
+                bool empty = true;
+                for (char c : body)
+                    if (c != '{' && c != '}' && c != '[' && c != ']' &&
+                        c != ' ' && c != '\t' && c != '\n' && c != '\r')
+                        empty = false;
+                rp.val_kind = (empty && open == '{') ? K_EMPTY_OBJECT
+                                                     : K_OBJECT;
+                if (rp.val_kind == K_OBJECT) P.fallback = true;
+            } else {
+                bool is_int = false, of = false;
+                int64_t iv = 0;
+                if (!number(P, rp.s.val_num, is_int, iv, of,
+                            rp.val_lexeme)) return false;
+                rp.val_kind = K_NUMBER;
+                rp.s.val_is_num = true;
+                rp.s.val_num_is_int = is_int;
+                rp.s.val_int = iv;
+                rp.s.val_int_overflow = of;
+            }
+        } else if (key == "tags") {
+            if (P.p < P.end && *P.p == '{') {
+                P.p++;
+                rp.s.ntags = 0;
+                bool tfirst = true;
+                for (;;) {
+                    P.ws();
+                    if (P.p < P.end && *P.p == '}') { P.p++; break; }
+                    if (!tfirst) {
+                        if (P.p >= P.end || *P.p != ',') return false;
+                        P.p++;
+                        P.ws();
+                    }
+                    tfirst = false;
+                    // The last-wins dedupe below is O(ntags) per tag —
+                    // fine to the 8-tag limit (+ slack), quadratic for
+                    // adversarial bodies; beyond the cap the Python
+                    // path's O(n) dict handles it (the point errors
+                    // with "Too many tags" either way).
+                    if (rp.s.ntags >= 64) {
+                        P.fallback = true;
+                        rp.s.ntags = 63;
+                    }
+                    // parse straight into a reused slot (string
+                    // capacities persist across points)
+                    if (rp.s.ntags == rp.s.tags.size())
+                        rp.s.tags.emplace_back();
+                    auto& slot = rp.s.tags[rp.s.ntags];
+                    if (!P.str(slot.first)) return false;
+                    P.ws();
+                    if (P.p >= P.end || *P.p != ':') return false;
+                    P.p++;
+                    P.ws();
+                    if (P.p < P.end && *P.p == '"') {
+                        if (!P.str(slot.second)) return false;
+                    } else {
+                        P.fallback = true;     // non-string tag value
+                        if (!P.skip()) return false;
+                        slot.second.clear();
+                    }
+                    // canonical-key separators must stay unambiguous
+                    if (slot.first.find('\x1E') != std::string::npos ||
+                        slot.first.find('\x1F') != std::string::npos ||
+                        slot.second.find('\x1E') != std::string::npos ||
+                        slot.second.find('\x1F') != std::string::npos)
+                        P.fallback = true;
+                    bool replaced = false;     // JSON duplicate key: last wins
+                    for (size_t ti = 0; ti < rp.s.ntags; ti++)
+                        if (rp.s.tags[ti].first == slot.first) {
+                            rp.s.tags[ti].second = slot.second;
+                            replaced = true;
+                        }
+                    if (!replaced) rp.s.ntags++;
+                }
+                rp.tags_kind = rp.s.ntags == 0 ? K_EMPTY_OBJECT : K_OBJECT;
+            } else if (P.lit("null")) {
+                rp.tags_kind = K_NULL;
+            } else {
+                rp.tags_kind = K_ARRAY;
+                P.fallback = true;
+                if (!P.skip()) return false;
+            }
+        } else {
+            if (!P.skip()) return false;   // unknown fields are ignored
+        }
+    }
+    rp.span_end = P.p - base;
+    return true;
+}
+
+// render the Python %s of the timestamp as received
+inline std::string ts_as_str(const RawPoint& rp) {
+    if (rp.ts_kind == K_STRING) return rp.s.ts_str;
+    if (rp.s.ts_num_is_int) return rp.ts_lexeme;
+    return py_float_str(rp.s.ts_num);
+}
+
+// Validate + normalize one raw point into the batch (mirrors
+// add_points_bulk's per-point try block, same error order and strings).
+// Returns false -> needs Python fallback for THIS construct.
+inline bool finish_point(const RawPoint& rp, PutBatch& out) {
+    std::string err, kind;
+    int64_t ts_ms = 0;
+    double fv = 0;
+    int64_t iv = 0;
+    bool is_int = false;
+
+    auto fail = [&](const char* k, const std::string& m) {
+        out.err_idx.push_back(static_cast<int64_t>(out.ts.size()));
+        out.err_msg.push_back(m);
+        out.err_kind.push_back(k);
+        out.ts.push_back(0);
+        out.fval.push_back(0);
+        out.ival.push_back(0);
+        out.isint.push_back(0);
+        out.group.push_back(-1);
+        out.span.push_back(rp.span_start);
+        out.span.push_back(rp.span_end);
+    };
+
+    // 1. missing required fields, in field order
+    const char* missing = nullptr;
+    if (rp.metric_kind == K_ABSENT || rp.metric_kind == K_NULL ||
+        (rp.metric_kind == K_STRING && rp.s.metric.empty()))
+        missing = "metric";
+    else if (rp.ts_kind == K_ABSENT || rp.ts_kind == K_NULL ||
+             rp.ts_kind == K_EMPTY_OBJECT ||
+             (rp.ts_kind == K_STRING && rp.s.ts_str.empty()))
+        missing = "timestamp";
+    else if (rp.val_kind == K_ABSENT || rp.val_kind == K_NULL ||
+             rp.val_kind == K_EMPTY_OBJECT ||
+             (rp.val_kind == K_STRING && rp.s.val_str.empty()))
+        missing = "value";
+    else if (rp.tags_kind == K_ABSENT || rp.tags_kind == K_NULL ||
+             rp.tags_kind == K_EMPTY_OBJECT)
+        missing = "tags";
+    if (missing) {
+        fail("ValueError", std::string("Missing required field: ") + missing);
+        return true;
+    }
+
+    // 2. parse_value
+    std::string vrepr;
+    if (rp.val_kind == K_BOOL) {
+        fail("ValueError", std::string("Invalid value: ")
+             + (rp.s.val_bool ? "True" : "False"));
+        return true;
+    } else if (rp.val_kind == K_NUMBER) {
+        is_int = rp.s.val_num_is_int;
+        if (is_int) {
+            iv = rp.s.val_int;
+            fv = static_cast<double>(iv);
+            vrepr = rp.val_lexeme;
+            // normalize "+5" repr to 5 like Python's repr(int)
+            if (!vrepr.empty() && vrepr[0] == '+') vrepr = vrepr.substr(1);
+            if (rp.s.val_int_overflow) {
+                fail("ValueError",
+                     "Invalid value, out of long range: " + vrepr);
+                return true;
+            }
+        } else {
+            fv = rp.s.val_num;
+            // json.loads parses 1e999 to float inf; the Python path
+            // rejects it (parse_value: isinf/isnan -> Invalid value)
+            if (std::isinf(fv) || std::isnan(fv)) {
+                fail("ValueError", "Invalid value: " + py_float_str(fv));
+                return true;
+            }
+        }
+    } else {  // string
+        std::string text = rp.s.val_str;
+        for (char c : text)
+            if (static_cast<unsigned char>(c) >= 0x80)
+                return false;   // unicode strip semantics: Python path
+        size_t a = text.find_first_not_of(" \t\n\r\f\v");
+        size_t b = text.find_last_not_of(" \t\n\r\f\v");
+        text = (a == std::string::npos) ? "" : text.substr(a, b - a + 1);
+        if (!py_repr(rp.s.val_str, vrepr)) return false;
+        if (text.empty()) {
+            fail("ValueError", "Empty value");
+            return true;
+        }
+        bool of = false;
+        if (py_int(text, of, iv)) {
+            is_int = true;
+            fv = static_cast<double>(iv);
+            if (of) {
+                fail("ValueError",
+                     "Invalid value, out of long range: " + vrepr);
+                return true;
+            }
+        } else {
+            double d = 0;
+            if (!py_float(text, d)) {
+                fail("ValueError", "Invalid value: " + vrepr);
+                return true;
+            }
+            if (std::isnan(d) || std::isinf(d)) {
+                fail("ValueError", "Invalid value: " + vrepr);
+                return true;
+            }
+            fv = d;
+        }
+    }
+
+    // 3. check_timestamp_and_tags: tags presence/count, int(ts) >= 0
+    if (rp.s.ntags == 0) {
+        fail("ValueError", "Need at least one tag (metric=" + rp.s.metric
+             + ", ts=" + ts_as_str(rp) + ")");
+        return true;
+    }
+    if (rp.s.ntags > 8) {
+        char buf[80];
+        std::snprintf(buf, sizeof buf,
+                      "Too many tags: %zu maximum allowed: 8",
+                      rp.s.ntags);
+        fail("ValueError", buf);
+        return true;
+    }
+    int64_t ts_int = 0;
+    if (rp.ts_kind == K_STRING) {
+        std::string t = rp.s.ts_str;
+        for (char c : t)
+            if (static_cast<unsigned char>(c) >= 0x80) return false;
+        size_t a = t.find_first_not_of(" \t\n\r\f\v");
+        size_t b = t.find_last_not_of(" \t\n\r\f\v");
+        std::string stripped =
+            (a == std::string::npos) ? "" : t.substr(a, b - a + 1);
+        bool of = false;
+        if (!py_int(stripped, of, ts_int) || of) {
+            if (of) return false;   // arbitrary-precision: Python path
+            std::string r;
+            if (!py_repr(t, r)) return false;
+            fail("ValueError",
+                 "invalid literal for int() with base 10: " + r);
+            return true;
+        }
+    } else if (rp.s.ts_num_is_int) {
+        ts_int = rp.s.ts_int;
+    } else {
+        // Beyond int64 the cast is UB and Python's behavior diverges
+        // per value (arbitrary-precision ints, OverflowError on inf):
+        // the Python path owns those
+        if (!(rp.s.ts_num > -9.2e18 && rp.s.ts_num < 9.2e18)) return false;
+        ts_int = static_cast<int64_t>(rp.s.ts_num);  // trunc toward zero
+    }
+    if (ts_int < 0) {
+        fail("ValueError", "Invalid timestamp: " + ts_as_str(rp));
+        return true;
+    }
+
+    // 4. normalize_timestamp_ms
+    ts_ms = (ts_int >= SECOND_MASK_LO) ? ts_int : ts_int * 1000;
+
+    // 5. canonical series key: metric + bytewise-sorted tags (index
+    //    sort + scratch key buffer: no string copies on the hot path)
+    if (rp.s.metric.find('\x1E') != std::string::npos ||
+        rp.s.metric.find('\x1F') != std::string::npos)
+        return false;
+    uint32_t tag_order[8];
+    for (uint32_t i = 0; i < rp.s.ntags; i++) tag_order[i] = i;
+    std::sort(tag_order, tag_order + rp.s.ntags,
+              [&rp](uint32_t a, uint32_t b) {
+                  return rp.s.tags[a] < rp.s.tags[b];
+              });
+    std::string& ckey = out.ckey_scratch;
+    ckey.clear();
+    ckey.append(rp.s.metric);
+    for (uint32_t i = 0; i < rp.s.ntags; i++) {
+        const auto& kv = rp.s.tags[tag_order[i]];
+        ckey.push_back('\x1F');
+        ckey.append(kv.first);
+        ckey.push_back('\x1E');
+        ckey.append(kv.second);
+    }
+    auto it = out.gindex.find(ckey);
+    int32_t gid;
+    if (it == out.gindex.end()) {
+        gid = static_cast<int32_t>(out.gkeys.size());
+        out.gkeys.push_back(ckey);
+        out.gindex.emplace(ckey, gid);
+    } else {
+        gid = it->second;
+    }
+
+    out.ts.push_back(ts_ms);
+    out.fval.push_back(fv);
+    out.ival.push_back(is_int ? iv : 0);
+    out.isint.push_back(is_int ? 1 : 0);
+    out.group.push_back(gid);
+    out.span.push_back(rp.span_start);
+    out.span.push_back(rp.span_end);
+    return true;
+}
+
+}  // namespace putparse
+
+// -------------------------------------------------------------- C ABI
+
+EXPORT void* eng_put_parse(const char* data, int64_t len) {
+    using namespace putparse;
+    Parser P(data, static_cast<size_t>(len));
+    P.ws();
+    if (P.p >= P.end) return nullptr;
+    auto* out = new PutBatch();
+    out->ts.reserve(static_cast<size_t>(len / 80 + 1));
+    RawPoint rp;                 // ONE scratch, reset per point: string
+    //                              capacities persist, so a long body
+    //                              parses with ~zero per-point allocation
+    auto one = [&]() -> bool {
+        rp.reset();
+        if (!parse_point(P, rp, data)) return false;
+        if (P.fallback) return false;
+        return finish_point(rp, *out);
+    };
+    if (*P.p == '[') {
+        P.p++;
+        bool first = true;
+        for (;;) {
+            P.ws();
+            if (P.p < P.end && *P.p == ']') { P.p++; break; }
+            if (!first) {
+                if (P.p >= P.end || *P.p != ',') { delete out; return nullptr; }
+                P.p++;
+            }
+            first = false;
+            if (!one()) { delete out; return nullptr; }
+        }
+    } else if (*P.p == '{') {
+        if (!one()) { delete out; return nullptr; }
+    } else {
+        delete out;
+        return nullptr;
+    }
+    P.ws();
+    if (P.p != P.end) { delete out; return nullptr; }  // trailing garbage
+    return out;
+}
+
+EXPORT void eng_put_free(void* h) {
+    delete static_cast<putparse::PutBatch*>(h);
+}
+
+EXPORT int64_t eng_put_npoints(void* h) {
+    return static_cast<int64_t>(
+        static_cast<putparse::PutBatch*>(h)->ts.size());
+}
+
+EXPORT int64_t eng_put_ngroups(void* h) {
+    return static_cast<int64_t>(
+        static_cast<putparse::PutBatch*>(h)->gkeys.size());
+}
+
+EXPORT const int64_t* eng_put_ts(void* h) {
+    return static_cast<putparse::PutBatch*>(h)->ts.data();
+}
+
+EXPORT const double* eng_put_fval(void* h) {
+    return static_cast<putparse::PutBatch*>(h)->fval.data();
+}
+
+EXPORT const int64_t* eng_put_ival(void* h) {
+    return static_cast<putparse::PutBatch*>(h)->ival.data();
+}
+
+EXPORT const uint8_t* eng_put_isint(void* h) {
+    return static_cast<putparse::PutBatch*>(h)->isint.data();
+}
+
+EXPORT const int32_t* eng_put_group(void* h) {
+    return static_cast<putparse::PutBatch*>(h)->group.data();
+}
+
+EXPORT const int64_t* eng_put_spans(void* h) {
+    return static_cast<putparse::PutBatch*>(h)->span.data();
+}
+
+EXPORT const char* eng_put_group_key(void* h, int64_t g) {
+    auto* b = static_cast<putparse::PutBatch*>(h);
+    if (g < 0 || static_cast<size_t>(g) >= b->gkeys.size()) return nullptr;
+    return b->gkeys[static_cast<size_t>(g)].c_str();
+}
+
+EXPORT int64_t eng_put_nerrors(void* h) {
+    return static_cast<int64_t>(
+        static_cast<putparse::PutBatch*>(h)->err_idx.size());
+}
+
+// j-th error (ascending point index): returns message, sets *point_index
+// and *kind
+EXPORT const char* eng_put_error(void* h, int64_t j, int64_t* point_index,
+                                 const char** kind) {
+    auto* b = static_cast<putparse::PutBatch*>(h);
+    if (j < 0 || static_cast<size_t>(j) >= b->err_idx.size()) return nullptr;
+    *point_index = b->err_idx[static_cast<size_t>(j)];
+    *kind = b->err_kind[static_cast<size_t>(j)].c_str();
+    return b->err_msg[static_cast<size_t>(j)].c_str();
 }

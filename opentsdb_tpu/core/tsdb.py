@@ -265,6 +265,84 @@ class TSDB:
         errors.sort(key=lambda t: t[0])
         return success, errors
 
+    def add_points_bulk_native(self, body: bytes):
+        """Native-parser fast path for a raw /api/put JSON body.
+
+        The C++ parser (native/engine.cpp eng_put_parse) does the per-point
+        work — JSON walk, validation with the Python path's exact error
+        strings, value classification, timestamp normalization, series-key
+        canonicalization — in one pass over the body bytes; Python cost
+        drops to O(distinct series).  Returns
+        (success, [(index, exception)], spans[n, 2]) or None when the fast
+        path does not apply: native library absent, malformed JSON (the
+        Python path owns the user-visible parse error), a construct the
+        parser refuses to mirror, or a TSDB feature that needs per-point
+        Python hooks (write filter, real-time publisher, raw-data rollup
+        tagging, WAL journaling).
+        """
+        import numpy as np
+
+        if (self.write_filter is not None or self.rt_publisher is not None
+                or self.persistence is not None
+                or (self.rollup_store is not None and self.tag_raw_data)):
+            return None
+        from opentsdb_tpu.storage.native_engine import parse_put_body
+        parsed = parse_put_body(body)
+        if parsed is None:
+            return None
+        if self.mode == "ro" and not self._replaying:
+            exc = RuntimeError("TSD is in read-only mode, writes rejected")
+            return 0, [(i, exc) for i in range(parsed.n)], parsed.spans
+        errors: list[tuple[int, Exception]] = [
+            (i, ValueError(msg) if kind == "ValueError" else TypeError(msg))
+            for i, kind, msg in parsed.errors]
+        success = parsed.n - len(errors)
+
+        # one key resolution per DISTINCT series; a resolution failure
+        # (e.g. unknown metric with auto-create off) fails every point of
+        # that group, exactly like the per-point path would
+        keys: list = []
+        for metric, tags in parsed.group_keys:
+            try:
+                keys.append(self._series_key(metric, tags, create=True))
+            except Exception as e:
+                keys.append(e)
+
+        order = np.argsort(parsed.group, kind="stable")
+        order = order[parsed.group[order] >= 0]
+        bounds = np.searchsorted(parsed.group[order],
+                                 np.arange(len(keys) + 1))
+        with self._ingest_lock:
+            for g in range(len(keys)):
+                idx = order[bounds[g]:bounds[g + 1]]
+                if not len(idx):
+                    continue
+                key = keys[g]
+                if isinstance(key, Exception):
+                    if isinstance(key, NoSuchUniqueName):
+                        # stat parity: the per-point path increments
+                        # unknown_metrics once per failing POINT; the
+                        # one resolution above already counted 1
+                        with self._stats_lock:
+                            self.unknown_metrics += len(idx) - 1
+                    errors.extend((int(i), key) for i in idx)
+                    success -= len(idx)
+                    continue
+                ts_arr = parsed.ts[idx]
+                try:
+                    self.store.add_batch(key, ts_arr, parsed.fval[idx],
+                                         parsed.isint[idx],
+                                         ival=parsed.ival[idx])
+                except Exception as e:
+                    errors.extend((int(i), e) for i in idx)
+                    success -= len(idx)
+                    continue
+                with self._stats_lock:
+                    self.datapoints_added += len(idx)
+                self._track_meta(key, int(ts_arr.max()), n=len(idx))
+        errors.sort(key=lambda t: t[0])
+        return success, errors, parsed.spans
+
     def _apply_point(self, metric: str, timestamp: int | float, value,
                      tags: dict[str, str]) -> None:
         if self.mode == "ro" and not self._replaying:

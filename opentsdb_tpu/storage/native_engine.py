@@ -72,6 +72,28 @@ def _configure(lib) -> None:
     lib.eng_save.restype = _I32
     lib.eng_load.argtypes = [ctypes.c_char_p]
     lib.eng_load.restype = ctypes.c_void_p
+    # bulk put parser
+    lib.eng_put_parse.argtypes = [ctypes.c_char_p, _I64]
+    lib.eng_put_parse.restype = ctypes.c_void_p
+    lib.eng_put_free.argtypes = [ctypes.c_void_p]
+    lib.eng_put_npoints.argtypes = [ctypes.c_void_p]
+    lib.eng_put_npoints.restype = _I64
+    lib.eng_put_ngroups.argtypes = [ctypes.c_void_p]
+    lib.eng_put_ngroups.restype = _I64
+    for name, ptr in (("eng_put_ts", _I64P), ("eng_put_fval", _F64P),
+                      ("eng_put_ival", _I64P), ("eng_put_isint", _U8P),
+                      ("eng_put_group", ctypes.POINTER(_I32)),
+                      ("eng_put_spans", _I64P)):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = ptr
+    lib.eng_put_group_key.argtypes = [ctypes.c_void_p, _I64]
+    lib.eng_put_group_key.restype = ctypes.c_char_p
+    lib.eng_put_nerrors.argtypes = [ctypes.c_void_p]
+    lib.eng_put_nerrors.restype = _I64
+    lib.eng_put_error.argtypes = [ctypes.c_void_p, _I64, _I64P,
+                                  ctypes.POINTER(ctypes.c_char_p)]
+    lib.eng_put_error.restype = ctypes.c_char_p
 
 
 def _load_library():
@@ -221,3 +243,77 @@ class NativeEngine:
     def save(self, path: str) -> None:
         if self._lib.eng_save(self._handle, path.encode()) != 0:
             raise IOError("cannot write native snapshot: " + path)
+
+
+class ParsedPutBatch:
+    """Columnar view of one parsed /api/put body (native fast path).
+
+    Wraps the C++ parse result: validated + normalized point columns, a
+    distinct-series key table, and per-point error messages mirroring the
+    Python path's exception strings.  Columns are COPIED out so the
+    native buffer frees eagerly.
+    """
+
+    __slots__ = ("n", "ts", "fval", "ival", "isint", "group", "spans",
+                 "errors", "group_keys")
+
+    def __init__(self, lib, handle):
+        n = lib.eng_put_npoints(handle)
+        g = lib.eng_put_ngroups(handle)
+        self.n = n
+
+        def col(fn, dtype, count):
+            ptr = fn(handle)
+            return np.ctypeslib.as_array(ptr, shape=(count,)).copy() \
+                if count else np.empty(0, dtype)
+
+        self.ts = col(lib.eng_put_ts, np.int64, n)
+        self.fval = col(lib.eng_put_fval, np.float64, n)
+        self.ival = col(lib.eng_put_ival, np.int64, n)
+        self.isint = col(lib.eng_put_isint, np.uint8, n).astype(bool)
+        self.group = col(lib.eng_put_group, np.int32, n)
+        self.spans = col(lib.eng_put_spans, np.int64, 2 * n).reshape(n, 2) \
+            if n else np.empty((0, 2), np.int64)
+        self.errors = []            # [(index, kind, message)]
+        kind_p = ctypes.c_char_p()
+        idx_p = ctypes.c_int64()
+        for j in range(lib.eng_put_nerrors(handle)):
+            msg = lib.eng_put_error(handle, j, ctypes.byref(idx_p),
+                                    ctypes.byref(kind_p))
+            self.errors.append((int(idx_p.value),
+                                (kind_p.value or b"").decode(),
+                                (msg or b"").decode()))
+        self.group_keys = []        # [(metric, {tagk: tagv})]
+        for gi in range(g):
+            raw = lib.eng_put_group_key(handle, gi).decode()
+            parts = raw.split("\x1f")
+            tags = {}
+            for pair in parts[1:]:
+                k, _, v = pair.partition("\x1e")
+                tags[k] = v
+            self.group_keys.append((parts[0], tags))
+
+
+def parse_put_body(body: bytes):
+    """Parse a /api/put JSON body natively; None -> use the Python path.
+
+    None covers: library unavailable, malformed JSON (the Python path
+    raises the user-visible parse error), and any construct whose Python
+    semantics the native parser refuses to mirror (non-string tags,
+    arbitrary-precision timestamps, ...).
+    """
+    lib = _load_library()
+    if lib is None or not hasattr(lib, "eng_put_parse"):
+        return None
+    handle = lib.eng_put_parse(body, len(body))
+    if not handle:
+        return None
+    try:
+        return ParsedPutBatch(lib, handle)
+    except UnicodeDecodeError:
+        # group keys that aren't valid UTF-8 (the parser guards the
+        # known producers of these, e.g. lone surrogates, but a decode
+        # failure must degrade to the Python path, never to a 500)
+        return None
+    finally:
+        lib.eng_put_free(handle)

@@ -112,8 +112,40 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
     def execute_http(self, tsdb, query: HttpQuery) -> None:
         self._count("http_requests")
         allowed_methods(query, "POST")
+        native = self._try_native_put(tsdb, query)
+        if native is not None:
+            success, errors, spans = native
+            if success == 0 and not errors:
+                raise BadRequestError("No datapoints found in content")
+            body = query.request.body
+
+            def dp_at(i: int) -> dict:
+                # original datapoint for details-mode error reporting,
+                # recovered lazily from its recorded byte span
+                import json
+                s, e = spans[i]
+                try:
+                    return json.loads(body[int(s):int(e)])
+                except Exception:
+                    return {}
+
+            self._respond_put(tsdb, query, success, errors, dp_at)
+            return
         dps = query.serializer.parse_put_v1()
         self.process_data_points(tsdb, query, dps)
+
+    def _try_native_put(self, tsdb, query: HttpQuery):
+        """The C++ body parser, when nothing needs per-point Python:
+        base put RPC only (rollup/histogram subclasses parse their own
+        records), the stock JSON serializer, and a TSDB without
+        per-point hooks (checked inside add_points_bulk_native)."""
+        from opentsdb_tpu.tsd.serializers import HttpJsonSerializer
+        if (type(self).ingest_points is not PutDataPointRpc.ingest_points
+                or type(query.serializer).parse_put_v1
+                is not HttpJsonSerializer.parse_put_v1
+                or not query.request.body):
+            return None
+        return tsdb.add_points_bulk_native(query.request.body)
 
     def store_point(self, tsdb, dp: dict) -> None:
         for field in ("metric", "timestamp", "value", "tags"):
@@ -149,13 +181,19 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
         columnar batch per series (TSDB.add_points_bulk)."""
         if not dps:
             raise BadRequestError("No datapoints found in content")
+        success, errors = self.ingest_points(tsdb, dps)
+        self._respond_put(tsdb, query, success, errors, lambda i: dps[i])
+
+    def _respond_put(self, tsdb, query: HttpQuery, success: int,
+                     errors: list, dp_at) -> None:
+        """Shared response tail: per-error counters + SEH spillway +
+        204/details/summary shaping (same for both ingest parsers)."""
         show_details = query.has_query_string_param("details")
         show_summary = query.has_query_string_param("summary")
         details: list[dict] = []
-        success, errors = self.ingest_points(tsdb, dps)
         failed = len(errors)
         for i, e in errors:
-            dp = dps[i]
+            dp = dp_at(i)
             if isinstance(e, NoSuchUniqueName):
                 self._count("unknown_metrics")
                 details.append({"error": "Unknown metric",
