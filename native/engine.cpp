@@ -533,12 +533,16 @@ struct PutBatch {
     std::vector<int64_t> err_idx;
     std::vector<std::string> err_msg;
     std::vector<std::string> err_kind;  // "ValueError" | "TypeError"
-    // group table: canonical "metric\x1Ftagk\x1Etagv\x1F..." keys
-    std::vector<std::string> gkeys;
+    // group table: canonical (sorted-tag) identity keys plus the FIRST-
+    // OCCURRENCE original-order form.  Python resolves series keys from
+    // the original order so UID ASSIGNMENT order matches the per-point
+    // path exactly (tagk/tagv ids are user-visible via /api/uid).
+    std::vector<std::string> gkeys;       // canonical, identity
+    std::vector<std::string> gorig;       // original tag order, exposed
     std::unordered_map<std::string, int32_t> gindex;
     // reused scratch (steady-state zero allocation per point)
     std::string ckey_scratch;
-    std::vector<std::pair<std::string, std::string>> sort_scratch;
+    std::string orig_scratch;
 };
 
 struct Parser {
@@ -1098,6 +1102,48 @@ inline bool parse_point(Parser& P, RawPoint& rp, const char* base) {
     return true;
 }
 
+
+// canonical series-key + group-table insert shared by the JSON and
+// telnet paths (step 5 of finish_point): identity = metric + bytewise-
+// SORTED tags; the stored gorig form keeps ORIGINAL tag order so Python
+// key resolution assigns UIDs in per-point-path order.
+inline int32_t assign_group(const std::string& metric,
+                            const PointScratch& s, PutBatch& out) {
+    uint32_t tag_order[8];
+    for (uint32_t i = 0; i < s.ntags; i++) tag_order[i] = i;
+    std::sort(tag_order, tag_order + s.ntags,
+              [&s](uint32_t a, uint32_t b) {
+                  return s.tags[a] < s.tags[b];
+              });
+    std::string& ckey = out.ckey_scratch;
+    ckey.clear();
+    ckey.append(metric);
+    for (uint32_t i = 0; i < s.ntags; i++) {
+        const auto& kv = s.tags[tag_order[i]];
+        ckey.push_back('\x1F');
+        ckey.append(kv.first);
+        ckey.push_back('\x1E');
+        ckey.append(kv.second);
+    }
+    auto it = out.gindex.find(ckey);
+    if (it != out.gindex.end()) return it->second;
+    int32_t gid = static_cast<int32_t>(out.gkeys.size());
+    out.gkeys.push_back(ckey);
+    std::string& orig = out.orig_scratch;
+    orig.clear();
+    orig.append(metric);
+    for (uint32_t i = 0; i < s.ntags; i++) {
+        const auto& kv = s.tags[i];
+        orig.push_back('\x1F');
+        orig.append(kv.first);
+        orig.push_back('\x1E');
+        orig.append(kv.second);
+    }
+    out.gorig.push_back(orig);
+    out.gindex.emplace(ckey, gid);
+    return gid;
+}
+
 // render the Python %s of the timestamp as received
 inline std::string ts_as_str(const RawPoint& rp) {
     if (rp.ts_kind == K_STRING) return rp.s.ts_str;
@@ -1267,31 +1313,7 @@ inline bool finish_point(const RawPoint& rp, PutBatch& out) {
     if (rp.s.metric.find('\x1E') != std::string::npos ||
         rp.s.metric.find('\x1F') != std::string::npos)
         return false;
-    uint32_t tag_order[8];
-    for (uint32_t i = 0; i < rp.s.ntags; i++) tag_order[i] = i;
-    std::sort(tag_order, tag_order + rp.s.ntags,
-              [&rp](uint32_t a, uint32_t b) {
-                  return rp.s.tags[a] < rp.s.tags[b];
-              });
-    std::string& ckey = out.ckey_scratch;
-    ckey.clear();
-    ckey.append(rp.s.metric);
-    for (uint32_t i = 0; i < rp.s.ntags; i++) {
-        const auto& kv = rp.s.tags[tag_order[i]];
-        ckey.push_back('\x1F');
-        ckey.append(kv.first);
-        ckey.push_back('\x1E');
-        ckey.append(kv.second);
-    }
-    auto it = out.gindex.find(ckey);
-    int32_t gid;
-    if (it == out.gindex.end()) {
-        gid = static_cast<int32_t>(out.gkeys.size());
-        out.gkeys.push_back(ckey);
-        out.gindex.emplace(ckey, gid);
-    } else {
-        gid = it->second;
-    }
+    int32_t gid = assign_group(rp.s.metric, rp.s, out);
 
     out.ts.push_back(ts_ms);
     out.fval.push_back(fv);
@@ -1387,8 +1409,8 @@ EXPORT const int64_t* eng_put_spans(void* h) {
 
 EXPORT const char* eng_put_group_key(void* h, int64_t g) {
     auto* b = static_cast<putparse::PutBatch*>(h);
-    if (g < 0 || static_cast<size_t>(g) >= b->gkeys.size()) return nullptr;
-    return b->gkeys[static_cast<size_t>(g)].c_str();
+    if (g < 0 || static_cast<size_t>(g) >= b->gorig.size()) return nullptr;
+    return b->gorig[static_cast<size_t>(g)].c_str();
 }
 
 EXPORT int64_t eng_put_nerrors(void* h) {
@@ -1405,4 +1427,258 @@ EXPORT const char* eng_put_error(void* h, int64_t j, int64_t* point_index,
     *point_index = b->err_idx[static_cast<size_t>(j)];
     *kind = b->err_kind[static_cast<size_t>(j)].c_str();
     return b->err_msg[static_cast<size_t>(j)].c_str();
+}
+
+// ============================================================ telnet put
+//
+// Batch parser for the telnet line protocol's `put` command — the
+// reference's primary high-volume ingest path (PutDataPointRpc telnet
+// arm, :129).  Input is a block of N complete lines (the server batches
+// consecutive put-lines); output reuses PutBatch plus a per-line status
+// so exotic lines (non-ASCII, duplicate tags with different values,
+// arbitrary-precision numbers) fall back to the per-line Python handler
+// INDIVIDUALLY — a weird line costs itself, not the batch.
+//
+// Line grammar + error strings mirror tsd/rpcs.py exactly:
+//   put <metric> <ts> <value> <tag=v>+
+//   errors: "not enough arguments (need least 4, got %d)",
+//           "invalid timestamp: %s" / int() literal errors, parse_value
+//           strings, "invalid tag: %s", "Too many tags: %d ..."
+
+namespace putparse {
+
+enum LineStatus : int8_t {
+    LINE_OK = 0,        // columns appended, group assigned
+    LINE_ERROR = 1,     // error recorded (telnet-formatted message)
+    LINE_FALLBACK = 2,  // python must process this line individually
+    LINE_SKIP = 3,      // blank line: no output at all
+};
+
+struct TelnetBatch {
+    PutBatch batch;                  // columns/groups/errors as for JSON
+    std::vector<int8_t> line_status;
+    std::vector<int64_t> line_span;  // 2*i: start, 2*i+1: end offsets
+    std::vector<int32_t> line_point; // line -> point index or -1
+};
+
+// ASCII whitespace only; any byte >= 0x80 in a line forces fallback
+// (Python str.split() also splits on unicode whitespace).
+inline bool is_ws(char c) {
+    return c == ' ' || c == '\t' || c == '\r' || c == '\n' ||
+           c == '\f' || c == '\v';
+}
+
+// Parse ONE put line [p, q).  Appends to tb.batch on success/error.
+inline LineStatus telnet_line(const char* p, const char* q,
+                              int64_t span_start, TelnetBatch& tb,
+                              RawPoint& rp) {
+    PutBatch& out = tb.batch;
+    for (const char* c = p; c < q; c++)
+        if (static_cast<unsigned char>(*c) >= 0x80) return LINE_FALLBACK;
+
+    // tokenize (Python str.split(): runs of whitespace)
+    const char* words[4];        // put, metric, ts, value
+    size_t wlen[4];
+    size_t nw = 0;
+    const char* c = p;
+    const char* tag_start = nullptr;
+    int extra_words = 0;         // words beyond the first 4 (tags)
+    while (c < q) {
+        while (c < q && is_ws(*c)) c++;
+        if (c >= q) break;
+        const char* w0 = c;
+        while (c < q && !is_ws(*c)) c++;
+        if (nw < 4) {
+            words[nw] = w0;
+            wlen[nw] = static_cast<size_t>(c - w0);
+            nw++;
+        } else {
+            if (tag_start == nullptr) tag_start = w0;
+            extra_words++;
+        }
+    }
+    if (nw == 0) return LINE_SKIP;
+    if (wlen[0] != 3 || std::memcmp(words[0], "put", 3) != 0)
+        return LINE_FALLBACK;    // not a put line: python handles it
+
+    rp.reset();
+    rp.span_start = span_start;
+    rp.span_end = span_start + (q - p);
+
+    auto fail = [&](const std::string& m) {
+        out.err_idx.push_back(static_cast<int64_t>(out.ts.size()));
+        out.err_msg.push_back(m);
+        out.err_kind.push_back("ValueError");
+        out.ts.push_back(0);
+        out.fval.push_back(0);
+        out.ival.push_back(0);
+        out.isint.push_back(0);
+        out.group.push_back(-1);
+        out.span.push_back(rp.span_start);
+        out.span.push_back(rp.span_end);
+        return LINE_ERROR;
+    };
+
+    int total_args = static_cast<int>(nw) - 1 + extra_words;
+    if (total_args < 4) {
+        char buf[72];
+        std::snprintf(buf, sizeof buf,
+                      "not enough arguments (need least 4, got %d)",
+                      total_args);
+        return fail(buf);
+    }
+
+    // timestamp (parse_telnet_timestamp: float when '.', else int; > 0)
+    std::string ts_text(words[2], wlen[2]);
+    bool ts_is_float = ts_text.find('.') != std::string::npos;
+    double ts_f = 0;
+    int64_t ts_i = 0;
+    if (ts_is_float) {
+        if (!py_float(ts_text, ts_f)) {
+            std::string r;
+            if (!py_repr(ts_text, r)) return LINE_FALLBACK;
+            return fail("could not convert string to float: " + r);
+        }
+        if (!(ts_f > -9.2e18 && ts_f < 9.2e18)) return LINE_FALLBACK;
+        if (ts_f <= 0) return fail("invalid timestamp: " + ts_text);
+        ts_i = static_cast<int64_t>(ts_f);
+    } else {
+        bool of = false;
+        if (!py_int(ts_text, of, ts_i)) {
+            std::string r;
+            if (!py_repr(ts_text, r)) return LINE_FALLBACK;
+            return fail("invalid literal for int() with base 10: " + r);
+        }
+        if (of) return LINE_FALLBACK;   // python arbitrary precision
+        if (ts_i <= 0) return fail("invalid timestamp: " + ts_text);
+    }
+
+    // tags: re-walk the tail words
+    rp.s.ntags = 0;
+    c = tag_start;
+    while (c != nullptr && c < q) {
+        while (c < q && is_ws(*c)) c++;
+        if (c >= q) break;
+        const char* w0 = c;
+        while (c < q && !is_ws(*c)) c++;
+        std::string w(w0, c - w0);
+        size_t eq = w.find('=');
+        if (eq == std::string::npos || eq == 0 || eq + 1 == w.size())
+            return fail("invalid tag: " + w);
+        if (w.find('\x1E') != std::string::npos ||
+            w.find('\x1F') != std::string::npos)
+            return LINE_FALLBACK;
+        if (rp.s.ntags >= 64) return LINE_FALLBACK;  // bounded dedupe
+        if (rp.s.ntags == rp.s.tags.size()) rp.s.tags.emplace_back();
+        auto& slot = rp.s.tags[rp.s.ntags];
+        slot.first.assign(w, 0, eq);
+        slot.second.assign(w, eq + 1, std::string::npos);
+        bool dup = false;
+        for (size_t ti = 0; ti < rp.s.ntags; ti++) {
+            if (rp.s.tags[ti].first == slot.first) {
+                if (rp.s.tags[ti].second != slot.second)
+                    return LINE_FALLBACK;  // "duplicate tag" repr message
+                dup = true;
+            }
+        }
+        if (!dup) rp.s.ntags++;
+    }
+
+    // value AFTER tag grammar (python precedence: import_telnet_point
+    // runs parse_tags before add_point's parse_value) but BEFORE the
+    // tag-count check (which lives in check_timestamp_and_tags, called
+    // after parse_value inside _apply_point)
+    std::string val_text(words[3], wlen[3]);
+    std::string vrepr;
+    if (!py_repr(val_text, vrepr)) return LINE_FALLBACK;
+    bool is_int = false, vof = false;
+    int64_t iv = 0;
+    double fv = 0;
+    if (py_int(val_text, vof, iv)) {
+        is_int = true;
+        if (vof) return LINE_FALLBACK;  // store-side OverflowError path
+        fv = static_cast<double>(iv);
+    } else {
+        if (!py_float(val_text, fv))
+            return fail("Invalid value: " + vrepr);
+        if (std::isnan(fv) || std::isinf(fv))
+            return fail("Invalid value: " + vrepr);
+    }
+
+    if (rp.s.ntags > 8) {
+        char buf[80];
+        std::snprintf(buf, sizeof buf,
+                      "Too many tags: %zu maximum allowed: 8", rp.s.ntags);
+        return fail(buf);
+    }
+
+    // canonical key + columns (same as the JSON path's step 5)
+    std::string metric(words[1], wlen[1]);
+    if (metric.find('\x1E') != std::string::npos ||
+        metric.find('\x1F') != std::string::npos)
+        return LINE_FALLBACK;
+    int32_t gid = assign_group(metric, rp.s, out);
+    int64_t ts_ms = (ts_i >= SECOND_MASK_LO) ? ts_i : ts_i * 1000;
+    out.ts.push_back(ts_ms);
+    out.fval.push_back(fv);
+    out.ival.push_back(is_int ? iv : 0);
+    out.isint.push_back(is_int ? 1 : 0);
+    out.group.push_back(gid);
+    out.span.push_back(rp.span_start);
+    out.span.push_back(rp.span_end);
+    return LINE_OK;
+}
+
+}  // namespace putparse
+
+EXPORT void* eng_telnet_parse(const char* data, int64_t len) {
+    using namespace putparse;
+    auto* tb = new TelnetBatch();
+    tb->batch.ts.reserve(static_cast<size_t>(len / 40 + 1));
+    RawPoint rp;
+    const char* p = data;
+    const char* end = data + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(
+            std::memchr(p, '\n', static_cast<size_t>(end - p)));
+        const char* q = nl ? nl : end;
+        int64_t start = p - data;
+        size_t pt_before = tb->batch.ts.size();
+        LineStatus st = telnet_line(p, q, start, *tb, rp);
+        if (st != LINE_SKIP) {
+            tb->line_status.push_back(st);
+            tb->line_span.push_back(start);
+            tb->line_span.push_back(q - data);
+            tb->line_point.push_back(
+                st == LINE_FALLBACK
+                    ? -1 : static_cast<int32_t>(pt_before));
+        }
+        p = nl ? nl + 1 : end;
+    }
+    return tb;
+}
+
+EXPORT void eng_telnet_free(void* h) {
+    delete static_cast<putparse::TelnetBatch*>(h);
+}
+
+EXPORT void* eng_telnet_batch(void* h) {   // the embedded PutBatch view
+    return &static_cast<putparse::TelnetBatch*>(h)->batch;
+}
+
+EXPORT int64_t eng_telnet_nlines(void* h) {
+    return static_cast<int64_t>(
+        static_cast<putparse::TelnetBatch*>(h)->line_status.size());
+}
+
+EXPORT const int8_t* eng_telnet_status(void* h) {
+    return static_cast<putparse::TelnetBatch*>(h)->line_status.data();
+}
+
+EXPORT const int64_t* eng_telnet_spans(void* h) {
+    return static_cast<putparse::TelnetBatch*>(h)->line_span.data();
+}
+
+EXPORT const int32_t* eng_telnet_point(void* h) {
+    return static_cast<putparse::TelnetBatch*>(h)->line_point.data();
 }

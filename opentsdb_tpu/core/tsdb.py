@@ -278,21 +278,44 @@ class TSDB:
         Python path owns the user-visible parse error), a construct the
         parser refuses to mirror, or a TSDB feature that needs per-point
         Python hooks (write filter, real-time publisher, raw-data rollup
-        tagging, WAL journaling).
+        tagging).  With persistence on, the raw body journals as one
+        "pj" WAL record; replay re-parses it through this same path.
         """
-        import numpy as np
-
-        if (self.write_filter is not None or self.rt_publisher is not None
-                or self.persistence is not None
-                or (self.rollup_store is not None and self.tag_raw_data)):
+        if not self._native_ingest_eligible():
             return None
+        body_text = None
+        if self.persistence is not None and not self._replaying:
+            try:
+                # journaled verbatim as a "pj" record; replay re-parses
+                # through this same path (deterministic per-point outcome)
+                body_text = body.decode("utf-8")
+            except UnicodeDecodeError:
+                return None
         from opentsdb_tpu.storage.native_engine import parse_put_body
         parsed = parse_put_body(body)
         if parsed is None:
             return None
+        success, errors = self._ingest_parsed_columns(
+            parsed, {"k": "pj", "b": body_text}
+            if body_text is not None else None)
+        return success, errors, parsed.spans
+
+    def _native_ingest_eligible(self) -> bool:
+        """True when no TSDB feature needs per-point Python hooks."""
+        return (self.write_filter is None and self.rt_publisher is None
+                and not (self.rollup_store is not None
+                         and self.tag_raw_data))
+
+    def _ingest_parsed_columns(self, parsed, journal_record
+                               ) -> tuple[int, list]:
+        """Land a native-parsed column batch: per-group key resolution,
+        columnar appends, stats/meta, WAL.  Shared by the JSON-body and
+        telnet-block fast paths.  Returns (success, [(index, exc)])."""
+        import numpy as np
+
         if self.mode == "ro" and not self._replaying:
             exc = RuntimeError("TSD is in read-only mode, writes rejected")
-            return 0, [(i, exc) for i in range(parsed.n)], parsed.spans
+            return 0, [(i, exc) for i in range(parsed.n)]
         errors: list[tuple[int, Exception]] = [
             (i, ValueError(msg) if kind == "ValueError" else TypeError(msg))
             for i, kind, msg in parsed.errors]
@@ -340,8 +363,48 @@ class TSDB:
                 with self._stats_lock:
                     self.datapoints_added += len(idx)
                 self._track_meta(key, int(ts_arr.max()), n=len(idx))
+            if journal_record is not None and success > 0:
+                # inside the ingest lock: a snapshot cannot slip between
+                # the appends above and this journal line
+                self.persistence.journal(journal_record)
         errors.sort(key=lambda t: t[0])
-        return success, errors, parsed.spans
+        return success, errors
+
+    def add_telnet_batch_native(self, block: bytes):
+        """Native fast path for a block of telnet `put` lines.
+
+        Returns (telnet_batch, point_errors: dict[index, Exception]) or
+        None when ineligible (same gates as add_points_bulk_native; the
+        caller then walks lines through the per-line handler).  Lines the
+        parser refuses (non-ASCII, exotic grammar) are marked FALLBACK in
+        the returned batch and cost only themselves.  With persistence
+        on, the raw block journals as one "pt" record.
+        """
+        if not self._native_ingest_eligible():
+            return None
+        from opentsdb_tpu.storage.native_engine import (parse_telnet_block,
+                                                        LINE_FALLBACK)
+        tb = parse_telnet_block(block)
+        if tb is None:
+            return None
+        record = None
+        if self.persistence is not None and not self._replaying:
+            # journal only the natively-handled lines: FALLBACK lines
+            # journal their own per-point "p" records when the per-line
+            # handler lands them, so including them here would double-
+            # ingest on a library-less replay
+            data = block
+            if (tb.status == LINE_FALLBACK).any():
+                data = b"\n".join(
+                    bytes(block[int(s):int(e)])
+                    for st, (s, e) in zip(tb.status, tb.spans)
+                    if st != LINE_FALLBACK)
+            try:
+                record = {"k": "pt", "b": data.decode("utf-8")}
+            except UnicodeDecodeError:
+                return None
+        _, errors = self._ingest_parsed_columns(tb.points, record)
+        return tb, dict(errors)
 
     def _apply_point(self, metric: str, timestamp: int | float, value,
                      tags: dict[str, str]) -> None:

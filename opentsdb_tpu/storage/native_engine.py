@@ -94,6 +94,20 @@ def _configure(lib) -> None:
     lib.eng_put_error.argtypes = [ctypes.c_void_p, _I64, _I64P,
                                   ctypes.POINTER(ctypes.c_char_p)]
     lib.eng_put_error.restype = ctypes.c_char_p
+    # telnet put-line batch parser
+    lib.eng_telnet_parse.argtypes = [ctypes.c_char_p, _I64]
+    lib.eng_telnet_parse.restype = ctypes.c_void_p
+    lib.eng_telnet_free.argtypes = [ctypes.c_void_p]
+    lib.eng_telnet_batch.argtypes = [ctypes.c_void_p]
+    lib.eng_telnet_batch.restype = ctypes.c_void_p
+    lib.eng_telnet_nlines.argtypes = [ctypes.c_void_p]
+    lib.eng_telnet_nlines.restype = _I64
+    lib.eng_telnet_status.argtypes = [ctypes.c_void_p]
+    lib.eng_telnet_status.restype = ctypes.POINTER(ctypes.c_int8)
+    lib.eng_telnet_spans.argtypes = [ctypes.c_void_p]
+    lib.eng_telnet_spans.restype = _I64P
+    lib.eng_telnet_point.argtypes = [ctypes.c_void_p]
+    lib.eng_telnet_point.restype = ctypes.POINTER(_I32)
 
 
 def _load_library():
@@ -292,6 +306,52 @@ class ParsedPutBatch:
                 k, _, v = pair.partition("\x1e")
                 tags[k] = v
             self.group_keys.append((parts[0], tags))
+
+
+LINE_OK, LINE_ERROR, LINE_FALLBACK = 0, 1, 2
+
+
+class ParsedTelnetBatch:
+    """Columnar view of one parsed telnet put-line block.
+
+    `points` is the shared ParsedPutBatch column view; per-LINE arrays
+    map each non-blank line to its outcome: OK/ERROR lines carry the
+    point index they produced, FALLBACK lines (exotic grammar the parser
+    refuses to mirror) carry their byte span so the caller can replay
+    just those through the per-line Python handler.
+    """
+
+    __slots__ = ("points", "n_lines", "status", "spans", "point_index")
+
+    def __init__(self, lib, handle):
+        self.points = ParsedPutBatch(lib, lib.eng_telnet_batch(handle))
+        n = int(lib.eng_telnet_nlines(handle))
+        self.n_lines = n
+
+        def col(fn, count):
+            return np.ctypeslib.as_array(fn(handle), shape=(count,)).copy() \
+                if count else np.empty(0, np.int64)
+
+        self.status = col(lib.eng_telnet_status, n)
+        self.spans = col(lib.eng_telnet_spans, 2 * n).reshape(n, 2) \
+            if n else np.empty((0, 2), np.int64)
+        self.point_index = col(lib.eng_telnet_point, n)
+
+
+def parse_telnet_block(block: bytes):
+    """Parse a block of telnet put lines natively; None -> Python path."""
+    lib = _load_library()
+    if lib is None or not hasattr(lib, "eng_telnet_parse"):
+        return None
+    handle = lib.eng_telnet_parse(block, len(block))
+    if not handle:
+        return None
+    try:
+        return ParsedTelnetBatch(lib, handle)
+    except UnicodeDecodeError:
+        return None
+    finally:
+        lib.eng_telnet_free(handle)
 
 
 def parse_put_body(body: bytes):

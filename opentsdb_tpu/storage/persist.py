@@ -129,6 +129,72 @@ class DiskPersistence:
                                     "WAL bulk replay dropped point %d "
                                     "of a %d-point record: %s", i,
                                     len(rec["d"]), e)
+                    elif kind == "pj":
+                        # raw /api/put body journaled by the native fast
+                        # path: re-parse through the same path (falling
+                        # back to the python bulk parser if the library
+                        # is absent on restore).  Per-point PARSE errors
+                        # replay deterministically and were never stored
+                        # — only storage-type failures count as dropped.
+                        body = rec["b"].encode("utf-8")
+                        out = tsdb.add_points_bulk_native(body)
+                        if out is None:
+                            dps = json.loads(rec["b"])
+                            if isinstance(dps, dict):
+                                dps = [dps]
+                            _, errs = tsdb.add_points_bulk(dps)
+                        else:
+                            errs = out[1]
+                        storage_errs = [
+                            (i, e) for i, e in errs
+                            if not isinstance(e, (ValueError, TypeError))]
+                        if storage_errs:
+                            failed += len(storage_errs)
+                            for i, e in storage_errs[:3]:
+                                LOG.error("WAL native-put replay dropped "
+                                          "point %d: %s", i, e)
+                    elif kind == "pt":
+                        # raw telnet put-line block from the native batch
+                        # path.  Natively-refused (FALLBACK) lines were
+                        # journaled by their own per-point "p" records at
+                        # ingest time, so only the natively-landed lines
+                        # replay here.  LINE_ERROR lines replay their
+                        # deterministic parse error and stored nothing —
+                        # only storage-type failures count as dropped.
+                        out = tsdb.add_telnet_batch_native(rec["b"].encode())
+                        if out is not None:
+                            storage_errs = [
+                                (i, e) for i, e in out[1].items()
+                                if not isinstance(e, (ValueError,
+                                                      TypeError))]
+                            if storage_errs:
+                                failed += len(storage_errs)
+                                for i, e in storage_errs[:3]:
+                                    LOG.error("WAL telnet replay dropped "
+                                              "point %d: %s", i, e)
+                        else:
+                            # library absent on restore: walk put lines
+                            # through the point parser, bypassing
+                            # add_point (which would re-journal into the
+                            # WAL being replayed)
+                            from opentsdb_tpu.tsd.rpcs import (
+                                parse_tags, parse_telnet_timestamp)
+                            for raw in rec["b"].splitlines():
+                                words = raw.split()
+                                if len(words) < 5 or words[0] != "put":
+                                    continue
+                                try:
+                                    tsdb._apply_point(
+                                        words[1],
+                                        parse_telnet_timestamp(words[2]),
+                                        words[3], parse_tags(words[4:]))
+                                except (ValueError, TypeError):
+                                    pass   # deterministic parse error:
+                                    #        stored nothing at ingest too
+                                except Exception as e:
+                                    failed += 1
+                                    LOG.error("WAL telnet replay dropped "
+                                              "a line: %s", e)
                     elif kind == "r":
                         tsdb._apply_aggregate_point(
                             rec["m"], rec["t"], rec["v"], rec["g"],

@@ -140,6 +140,21 @@ class RpcManager:
             return "unknown command: %s.  Try `help'.\n" % words[0]
         return handler.execute_telnet(self.tsdb, conn, words)
 
+    def handle_telnet_batch(self, conn, block: bytes) -> str:
+        """Consecutive telnet put lines batched by the server loop.
+
+        Dispatches to the put handler's batch arm (native columnar
+        ingest) when one is installed; otherwise — e.g. read-only mode
+        drops `put` from the table — each line walks handle_telnet so
+        per-line replies ("unknown command: put") stay identical.
+        """
+        from opentsdb_tpu.tsd.rpcs import PutDataPointRpc
+        handler = self.telnet_commands.get("put")
+        if type(handler) is PutDataPointRpc:
+            return handler.execute_telnet_batch(self.tsdb, conn, block,
+                                                self)
+        return PutDataPointRpc._telnet_lines_one_by_one(conn, block, self)
+
     def handle_http(self, request: HttpRequest,
                     remote: str = "unknown") -> "HttpQuery":
         query = HttpQuery(self.tsdb, request, remote)

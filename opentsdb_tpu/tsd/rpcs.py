@@ -107,6 +107,68 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
         tags = parse_tags(words[4:])
         tsdb.add_point(metric, timestamp, value, tags)
 
+    def execute_telnet_batch(self, tsdb, conn, block: bytes,
+                             manager) -> str:
+        """A block of consecutive telnet put lines in ONE call.
+
+        The native parser lands every clean line columnar
+        (TSDB.add_telnet_batch_native); lines it refuses replay through
+        the per-line handler individually, so replies keep line order
+        and per-line semantics exactly.  Without the native library the
+        whole block walks the per-line path.
+        """
+        native = None
+        if type(self).import_telnet_point \
+                is PutDataPointRpc.import_telnet_point:
+            native = tsdb.add_telnet_batch_native(block)
+        if native is None:
+            return self._telnet_lines_one_by_one(conn, block, manager)
+        from opentsdb_tpu.storage.native_engine import LINE_FALLBACK
+        tb, point_errors = native
+        out: list[str] = []
+        # tally counters locally: one lock round-trip per BATCH, not per
+        # line (the per-line lock is exactly the overhead batching kills)
+        requests = unknown = illegal = storage = 0
+        for li in range(tb.n_lines):
+            if tb.status[li] == LINE_FALLBACK:
+                s, e = tb.spans[li]
+                text = block[s:e].decode("utf-8", "replace").strip("\r\n")
+                reply = manager.handle_telnet(conn, text)
+                if reply:
+                    out.append(reply)
+                continue
+            requests += 1
+            exc = point_errors.get(int(tb.point_index[li]))
+            if exc is None:
+                continue
+            if isinstance(exc, NoSuchUniqueName):
+                unknown += 1
+                out.append("put: unknown metric: %s\n" % exc)
+            elif isinstance(exc, (ValueError, TypeError)):
+                illegal += 1
+                out.append("put: %s\n" % exc)
+            else:
+                storage += 1
+                out.append("put: %s: %s\n" % (type(exc).__name__, exc))
+        with self._lock:
+            self.requests += requests
+            self.unknown_metrics += unknown
+            self.illegal_arguments += illegal
+            self.hbase_errors += storage
+        return "".join(out)
+
+    @staticmethod
+    def _telnet_lines_one_by_one(conn, block: bytes, manager) -> str:
+        out = []
+        for raw in block.splitlines():
+            text = raw.decode("utf-8", "replace").strip("\r\n")
+            if not text.strip():
+                continue
+            reply = manager.handle_telnet(conn, text)
+            if reply:
+                out.append(reply)
+        return "".join(out)
+
     # -- HTTP --
 
     def execute_http(self, tsdb, query: HttpQuery) -> None:

@@ -154,18 +154,23 @@ class TSDServer:
         conn = TelnetConn(writer)
         conn.auth_state = None
         buffer = first
+        pending: bytes | None = None
         loop = asyncio.get_running_loop()
         while True:
-            try:
-                line = await asyncio.wait_for(reader.readline(),
-                                              timeout=self.idle_timeout)
-            except asyncio.TimeoutError:
-                return
-            except ValueError:
-                # StreamReader limit (MAX_TELNET_LINE) exceeded.
-                writer.write(b"error: line too long\n")
-                await writer.drain()
-                return
+            if pending is not None:
+                line = pending
+                pending = None
+            else:
+                try:
+                    line = await asyncio.wait_for(reader.readline(),
+                                                  timeout=self.idle_timeout)
+                except asyncio.TimeoutError:
+                    return
+                except ValueError:
+                    # StreamReader limit (MAX_TELNET_LINE) exceeded.
+                    writer.write(b"error: line too long\n")
+                    await writer.drain()
+                    return
             data = buffer + line
             buffer = b""
             if len(data) > MAX_TELNET_LINE:
@@ -199,8 +204,46 @@ class TSDServer:
                     writer.write(b"AUTH_FAIL\r\n")
                 await writer.drain()
                 continue
-            reply = await loop.run_in_executor(
-                self._executor, self.rpc_manager.handle_telnet, conn, text)
+            if auth is None and data.split(None, 1)[:1] == [b"put"]:
+                # Batch consecutive already-buffered put lines into ONE
+                # executor dispatch (the native columnar ingest): a
+                # pipelined writer otherwise pays a Python parse AND a
+                # thread-pool hop PER LINE.  Only complete lines already
+                # in the reader's buffer join — this never waits for
+                # more input, so single-line latency is unchanged.
+                block = [data]
+                too_long = False
+                while (len(block) < 4096
+                       and b"\n" in getattr(reader, "_buffer", b"")):
+                    try:
+                        nxt = await reader.readline()
+                    except ValueError:
+                        # buffered line beyond MAX_TELNET_LINE: land the
+                        # lines collected so far, THEN reply the same
+                        # error the unpipelined path would
+                        too_long = True
+                        break
+                    if not nxt:
+                        break
+                    if (len(nxt) > MAX_TELNET_LINE
+                            or nxt.split(None, 1)[:1] != [b"put"]):
+                        pending = nxt     # main loop handles it next
+                        break
+                    block.append(nxt)
+                self.telnet_rpcs += len(block) - 1
+                reply = await loop.run_in_executor(
+                    self._executor, self.rpc_manager.handle_telnet_batch,
+                    conn, b"".join(block))
+                if too_long:
+                    if reply:
+                        writer.write(reply.encode())
+                    writer.write(b"error: line too long\n")
+                    await writer.drain()
+                    return
+            else:
+                reply = await loop.run_in_executor(
+                    self._executor, self.rpc_manager.handle_telnet, conn,
+                    text)
             if reply:
                 writer.write(reply.encode())
                 await writer.drain()

@@ -272,11 +272,139 @@ class TestDifferential:
         ts, fv, iv, ii = series.arrays()
         assert iv.tolist() == [big] and ii.tolist() == [True]
 
-    def test_persistence_falls_back(self, tmp_path):
-        tsdb = make_tsdb(**{"tsd.storage.directory": str(tmp_path)})
-        body = '{"metric":"m","timestamp":%d,"value":1,"tags":{"h":"a"}}' \
+    def test_wal_journal_and_replay(self, tmp_path):
+        # native puts journal the raw body ("pj") and replay through the
+        # same parser on restart — including partial-failure bodies
+        cfg = {"tsd.storage.directory": str(tmp_path)}
+        tsdb = make_tsdb(**cfg)
+        body = ('[{"metric":"m","timestamp":%d,"value":1,"tags":{"h":"a"}},'
+                '{"metric":"m","timestamp":%d,"value":"bad",'
+                '"tags":{"h":"a"}},'
+                '{"metric":"m","timestamp":%d,"value":3,"tags":{"h":"b"}}]'
+                % (BASE, BASE + 1, BASE + 2))
+        out = tsdb.add_points_bulk_native(body.encode())
+        assert out is not None and out[0] == 2 and len(out[1]) == 1
+        before = store_state(tsdb)
+        # simulate crash (no clean shutdown; the WAL is line-buffered):
+        # a new TSDB over the same directory replays the journal
+        restored = make_tsdb(**cfg)
+        assert store_state(restored) == before
+
+    def test_wal_replay_without_native_library(self, tmp_path, monkeypatch):
+        cfg = {"tsd.storage.directory": str(tmp_path)}
+        tsdb = make_tsdb(**cfg)
+        body = '{"metric":"m","timestamp":%d,"value":7,"tags":{"h":"a"}}' \
             % BASE
-        assert tsdb.add_points_bulk_native(body.encode()) is None
+        assert tsdb.add_points_bulk_native(body.encode())[0] == 1
+        before = store_state(tsdb)
+        monkeypatch.setattr(native_engine, "parse_put_body", lambda b: None)
+        restored = make_tsdb(**cfg)    # replay must use the python parser
+        assert store_state(restored) == before
+
+
+class FakeConn:
+    def __init__(self):
+        self.close_after_write = False
+        self.auth_state = None
+
+
+class TestTelnetBatch:
+    def _manager(self, tsdb):
+        from opentsdb_tpu.tsd.rpc_manager import RpcManager
+        return RpcManager(tsdb)
+
+    def _batch(self, tsdb, lines):
+        return self._manager(tsdb).handle_telnet_batch(
+            FakeConn(), ("\n".join(lines) + "\n").encode())
+
+    def _one_by_one(self, tsdb, lines):
+        m = self._manager(tsdb)
+        conn = FakeConn()
+        out = []
+        for ln in lines:
+            r = m.handle_telnet(conn, ln)
+            if r:
+                out.append(r)
+        return "".join(out)
+
+    CASES = [
+        # clean lines, several series, int + float + string values
+        ["put t.m %d 1 h=a" % BASE,
+         "put t.m %d 2.5 h=a" % (BASE + 1),
+         "put t.m %d 3 h=b dc=x" % (BASE + 2)],
+        # per-line errors interleaved, order preserved
+        ["put t.m %d 1 h=a" % BASE,
+         "put t.m notats 2 h=a",
+         "put t.m %d nope h=a" % (BASE + 1),
+         "put t.m -5 2 h=a",
+         "put t.m %d 2" % (BASE + 2),
+         "put t.m %d 4 h=c" % (BASE + 3)],
+        # bad tags, too many tags, ms + float timestamps
+        ["put t.m %d 1 noequals" % BASE,
+         "put t.m %d 1 =v" % BASE,
+         "put t.m %d 1 k=" % BASE,
+         "put t.m %d 1 %s" % (BASE, " ".join("t%d=v" % i
+                                             for i in range(9))),
+         "put t.m %d500 1 h=a" % BASE,
+         "put t.m %d.75 1 h=a" % BASE],
+        # duplicate tags (same ok, different -> python fallback message)
+        ["put t.m %d 1 h=a h=a" % BASE,
+         "put t.m %d 1 h=a h=b" % (BASE + 1)],
+        # non-put lines inside a block route to their own handlers
+        ["put t.m %d 1 h=a" % BASE,
+         "version",
+         "frobnicate"],
+        # error precedence: bad value AND bad tag on one line replies the
+        # TAG error (parse_tags runs before parse_value; review r3)
+        ["put t.m %d notanumber bad-tag" % BASE,
+         "put t.m notats bad1 alsobad"],
+    ]
+
+    @pytest.mark.parametrize("case", range(len(CASES)))
+    def test_batch_equals_one_by_one(self, case):
+        lines = self.CASES[case]
+        t1, t2 = make_tsdb(), make_tsdb()
+        reply_batch = self._batch(t1, lines)
+        reply_single = self._one_by_one(t2, lines)
+        assert reply_batch == reply_single
+        assert store_state(t1) == store_state(t2)
+
+    def test_batch_without_native_library(self, monkeypatch):
+        monkeypatch.setattr(native_engine, "parse_telnet_block",
+                            lambda b: None)
+        lines = self.CASES[1]
+        t1, t2 = make_tsdb(), make_tsdb()
+        assert self._batch(t1, lines) == self._one_by_one(t2, lines)
+        assert store_state(t1) == store_state(t2)
+
+    def test_readonly_mode_batch(self):
+        # ro mode drops `put` from the telnet table: every line replies
+        # "unknown command" exactly like the per-line path
+        t1 = make_tsdb(**{"tsd.mode": "ro"})
+        t2 = make_tsdb(**{"tsd.mode": "ro"})
+        lines = ["put t.m %d 1 h=a" % BASE] * 2
+        assert self._batch(t1, lines) == self._one_by_one(t2, lines)
+        assert store_state(t1) == {}
+
+    def test_wal_journal_and_replay_telnet(self, tmp_path):
+        cfg = {"tsd.storage.directory": str(tmp_path)}
+        tsdb = make_tsdb(**cfg)
+        lines = ["put t.m %d 5 h=a" % BASE,
+                 "put t.m %d bad h=a" % (BASE + 1),      # parse error
+                 "put t.m %d 2 h=a h=b" % (BASE + 2)]    # python fallback
+        reply = self._batch(tsdb, lines)
+        assert "Invalid value" in reply and "duplicate tag" in reply
+        before = store_state(tsdb)
+        restored = make_tsdb(**cfg)
+        assert store_state(restored) == before
+
+    def test_exact_int_lane_via_telnet(self):
+        big = (1 << 60) + 7
+        tsdb = make_tsdb()
+        self._batch(tsdb, ["put t.m %d %d h=a" % (BASE, big)])
+        (series,) = tsdb.store.all_series()
+        _, _, iv, ii = series.arrays()
+        assert iv.tolist() == [big] and ii.tolist() == [True]
 
 
 class TestHttpIntegration:
