@@ -334,7 +334,8 @@ class QueryRunner:
         windows = self._windows_for(sub, query)
         if windows is not None:
             return self._run_segment_grouped(query, sub, seg, groups,
-                                             windows, global_notes, budget)
+                                             windows, global_notes, budget,
+                                             store)
         return self._run_segment_union(query, sub, seg, groups, global_notes,
                                        budget)
 
@@ -363,8 +364,8 @@ class QueryRunner:
 
     def _run_segment_grouped(self, query: TSQuery, sub: TSSubQuery,
                              seg: Segment, groups, windows,
-                             global_notes: list,
-                             budget) -> dict[tuple, QueryResult]:
+                             global_notes: list, budget,
+                             store=None) -> dict[tuple, QueryResult]:
         """All group-by buckets in ONE device dispatch (downsample queries).
 
         Round 1 looped over buckets in Python — one jitted call per group,
@@ -431,14 +432,17 @@ class QueryRunner:
         series_list = [s for _, members, _ in kept for s, _t in members]
         would_stream = (stream_ok and total_points > tsdb.config.get_int(
             "tsd.query.streaming.point_threshold"))
-        if (tsdb.device_cache is not None and not use_mesh
-                and seg.kind == "raw"):
+        if (tsdb.device_cache is not None and not use_mesh and store is not None
+                and seg.kind in ("raw", "rollup")):
             # Cold entries build inline only when the alternative is a full
             # host materialization anyway; when streaming would serve this
             # query, the cold build is deferred to the maintenance thread
-            # (stream now, hit HBM next time).
+            # (stream now, hit HBM next time).  `store` is the EXACT store
+            # the series were resolved from (raw store, a rollup lane, or
+            # the pre-agg lane) — entries key on the store object, so each
+            # coexists in the cache.
             cached = tsdb.device_cache.batch_for(
-                tsdb.store, series_list[0].key.metric, series_list,
+                store, series_list[0].key.metric, series_list,
                 seg.start_ms, seg.end_ms, fix, build=not would_stream)
             if cached is not None:
                 self.exec_stats["deviceCacheHit"] = 1.0

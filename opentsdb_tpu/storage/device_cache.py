@@ -62,6 +62,9 @@ def _pad_pow2(n: int, floor: int = 8) -> int:
 
 @dataclass
 class _Entry:
+    store: object      # the MemStore snapshotted (raw store or a rollup
+    #                    lane) — entries are keyed by (store, metric), and
+    #                    the strong ref also keeps id(store) stable
     metric: int
     row: dict          # SeriesKey -> row index
     series_objs: list  # row -> the Series OBJECT snapshotted: identity is
@@ -95,9 +98,11 @@ class DeviceSeriesCache:
         # touching duplicate data must fail (and never silently dedup the
         # live series out from under fsck).
         self.fix_duplicates = bool(fix_duplicates)
-        self._entries: dict[int, _Entry] = {}
-        self._stale_metrics: set[int] = set()
-        self._building: set[int] = set()
+        # keyed by (id(store), metric): the raw store and every rollup
+        # lane share the metric-uid space but hold different data
+        self._entries: dict[tuple, _Entry] = {}
+        self._stale: dict[tuple, object] = {}   # key -> store (for refresh)
+        self._building: set[tuple] = set()
         self._lock = threading.Lock()
         self._tick = 0
         # stats (surfaced via /api/stats; mutated under _lock)
@@ -132,12 +137,13 @@ class DeviceSeriesCache:
         metric upload first would be strictly worse).  Staleness likewise
         only ever queues a background rebuild.
         """
+        ekey = (id(store), metric)
         with self._lock:
-            entry = self._entries.get(metric)
+            entry = self._entries.get(ekey)
         if entry is None:
             if not build:
                 with self._lock:
-                    self._stale_metrics.add(metric)
+                    self._stale[ekey] = store
                 self._count("misses")
                 return None
             entry = self._build(store, metric)
@@ -153,7 +159,7 @@ class DeviceSeriesCache:
                 # a series born after the snapshot — or deleted and
                 # recreated under the same key (fresh object, restarted
                 # version counter): either way the snapshot is invalid
-                self._mark_stale(metric, entry)
+                self._mark_stale(ekey, entry)
                 self._count("misses")
                 return None
             try:
@@ -163,7 +169,7 @@ class DeviceSeriesCache:
                 self._count("misses")
                 return None     # unresolved duplicates: host path raises
             if version != entry.versions[row]:
-                self._mark_stale(metric, entry)
+                self._mark_stale(ekey, entry)
                 self._count("misses")
                 return None
             starts[i] = entry.offsets[row] + lo
@@ -185,26 +191,27 @@ class DeviceSeriesCache:
         with self._lock:
             setattr(self, name, getattr(self, name) + 1)
 
-    def _mark_stale(self, metric: int, entry: _Entry) -> None:
+    def _mark_stale(self, ekey: tuple, entry: _Entry) -> None:
         with self._lock:
             entry.stale = True
-            self._stale_metrics.add(metric)
+            self._stale[ekey] = entry.store
 
     def _build(self, store, metric: int):
         """Snapshot every series of `metric` into device buffers.
 
-        At most one build per metric runs at a time: concurrent queries on
-        the same cold metric miss fast (host path) instead of each paying
-        the snapshot + upload."""
+        At most one build per (store, metric) runs at a time: concurrent
+        queries on the same cold metric miss fast (host path) instead of
+        each paying the snapshot + upload."""
+        ekey = (id(store), metric)
         with self._lock:
-            if metric in self._building:
+            if ekey in self._building:
                 return None
-            self._building.add(metric)
+            self._building.add(ekey)
         try:
             return self._build_guarded(store, metric)
         finally:
             with self._lock:
-                self._building.discard(metric)
+                self._building.discard(ekey)
 
     def _build_guarded(self, store, metric: int):
         series_list = store.series_for_metric(metric)
@@ -233,16 +240,18 @@ class DeviceSeriesCache:
         if total:
             ts_buf[:total] = np.concatenate(parts_ts)
             val_buf[:total] = np.concatenate(parts_val)
-        entry = _Entry(metric=metric, row=row, series_objs=series_list,
+        entry = _Entry(store=store, metric=metric, row=row,
+                       series_objs=series_list,
                        versions=versions, offsets=offsets,
                        ts_dev=_to_device(ts_buf), val_dev=_to_device(val_buf),
                        nbytes=p * _BYTES_PER_POINT)
+        ekey = (id(store), metric)
         with self._lock:
             self._evict_for_locked(entry.nbytes)
             self._tick += 1
             entry.tick = self._tick
-            self._entries[metric] = entry
-            self._stale_metrics.discard(metric)
+            self._entries[ekey] = entry
+            self._stale.pop(ekey, None)
             self.builds += 1
         return entry
 
@@ -250,24 +259,27 @@ class DeviceSeriesCache:
         used = sum(e.nbytes for e in self._entries.values())
         while self._entries and used + incoming_bytes > self.max_bytes:
             victim = min(self._entries.values(), key=lambda e: e.tick)
-            self._entries.pop(victim.metric)
+            self._entries.pop((id(victim.store), victim.metric))
             used -= victim.nbytes
             self.evictions += 1
 
-    def refresh(self, store, max_rebuilds: int = 4) -> int:
+    def refresh(self, store=None, max_rebuilds: int = 4) -> int:
         """Rebuild up to `max_rebuilds` stale entries (maintenance hook).
 
         Runs off the query path: the background thread pays the re-upload
-        so queries only ever see a fast hit or a fast miss.
+        so queries only ever see a fast hit or a fast miss.  Each stale
+        key remembers its own store (raw store or rollup lane); the
+        `store` argument is accepted for call-site symmetry but unused.
         """
+        del store
         with self._lock:
-            pending = list(self._stale_metrics)[:max_rebuilds]
-            for m in pending:
-                self._stale_metrics.discard(m)
-                self._entries.pop(m, None)
+            pending = list(self._stale.items())[:max_rebuilds]
+            for ekey, _ in pending:
+                self._stale.pop(ekey, None)
+                self._entries.pop(ekey, None)
         done = 0
-        for m in pending:
-            if self._build(store, m) is not None:
+        for (_, metric), st in pending:
+            if self._build(st, metric) is not None:
                 done += 1
         return done
 
@@ -276,10 +288,12 @@ class DeviceSeriesCache:
         with self._lock:
             if metric is None:
                 self._entries.clear()
-                self._stale_metrics.clear()
+                self._stale.clear()
             else:
-                self._entries.pop(metric, None)
-                self._stale_metrics.discard(metric)
+                for ekey in [k for k in self._entries if k[1] == metric]:
+                    self._entries.pop(ekey, None)
+                for ekey in [k for k in self._stale if k[1] == metric]:
+                    self._stale.pop(ekey, None)
 
     def collect_stats(self) -> dict:
         return {

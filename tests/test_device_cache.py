@@ -6,6 +6,7 @@ the reference's storage-cache stance (repeat scans served memory-speed
 without changing query semantics).
 """
 
+import json
 import numpy as np
 import pytest
 
@@ -38,6 +39,16 @@ def run_group_query(tsdb, m="avg:1m-avg:dc.m{host=*}",
 
 def dps_map(results):
     return {tuple(sorted(r.tags.items())): r.dps for r in results}
+
+
+def run_group_query_pre(tsdb, m, start=str(BASE), end=str(BASE + 400)):
+    """Same grouped query with pre_aggregate=True on the subquery."""
+    sub = parse_m_subquery(m)
+    sub.pre_aggregate = True
+    q = TSQuery(start=start, end=end, queries=[sub])
+    q.validate()
+    runner = tsdb.new_query_runner()
+    return runner.run(q), runner.exec_stats
 
 
 class TestDeviceCacheResults:
@@ -226,6 +237,61 @@ class TestBudget:
         assert s2.get("deviceCacheHit") == 1.0
         assert "streamedChunks" not in s2
         assert dps_map(res_cached) == dps_map(res_stream)
+
+    def test_rollup_lane_cached_separately(self):
+        # raw store and a rollup lane share the metric-uid space: each
+        # gets its own entry, and rollup queries hit from HBM too
+        tsdb = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.rollups.enable": True,
+            "tsd.rollups.config": json.dumps({
+                "intervals": [{"interval": "1h", "table": "tsdb-rollup-1h",
+                               "preAggregationTable": "tsdb-rollup-agg-1h",
+                               "rowSpan": "1d"}],
+                "aggregationIds": {"sum": 0, "count": 1, "min": 2,
+                                   "max": 3}})}))
+        for i in range(30):
+            tsdb.add_point("rc.m", BASE + i * 10, float(i), {"h": "a"})
+            tsdb.add_aggregate_point("rc.m", BASE + i * 3600, float(i),
+                                     {"h": "a"}, False, "1h", "sum")
+        raw_q = "sum:1m-avg:rc.m{h=*}"
+        roll_q = "sum:1h-sum:rc.m{h=*}"
+        run_group_query(tsdb, raw_q)
+        res_r, s_r = run_group_query(
+            tsdb, roll_q, end=str(BASE + 30 * 3600))
+        res_r2, s_r2 = run_group_query(
+            tsdb, roll_q, end=str(BASE + 30 * 3600))
+        assert s_r2.get("deviceCacheHit") == 1.0
+        assert dps_map(res_r2) == dps_map(res_r)
+        assert tsdb.device_cache.builds == 2   # raw entry + lane entry
+        _, s_raw = run_group_query(tsdb, raw_q)
+        assert s_raw.get("deviceCacheHit") == 1.0   # raw entry intact
+
+    def test_pre_aggregate_lane_uses_its_own_entry(self):
+        # pre_aggregate=True resolves series from the pre-agg LANE even
+        # on a raw segment: the cache must key on that lane, never build
+        # (and then stale-mark) a raw-store entry for it (review r3)
+        tsdb = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.rollups.enable": True,
+            "tsd.rollups.config": json.dumps({
+                "intervals": [{"interval": "1h", "table": "t",
+                               "preAggregationTable": "tp",
+                               "rowSpan": "1d"}],
+                "aggregationIds": {"sum": 0, "count": 1}})}))
+        for i in range(20):
+            tsdb.add_point("pa.m", BASE + i * 10, float(i), {"host": "a"})
+            tsdb.add_aggregate_point("pa.m", BASE + i * 10, float(i * 3),
+                                     {"host": "a"}, True, None, None, "sum")
+        q = "sum:1m-avg:pa.m{host=*}"
+        run_group_query(tsdb, q)                      # raw entry
+        res1, _ = run_group_query_pre(tsdb, q)        # pre-agg lane entry
+        res2, s2 = run_group_query_pre(tsdb, q)
+        assert s2.get("deviceCacheHit") == 1.0
+        assert dps_map(res2) == dps_map(res1)
+        assert tsdb.device_cache.builds == 2
+        _, s_raw = run_group_query(tsdb, q)
+        assert s_raw.get("deviceCacheHit") == 1.0     # raw entry untouched
 
     def test_stats_surface(self):
         tsdb = make_tsdb()

@@ -163,9 +163,11 @@ class TestIntegration:
                 "/api/query?start=%d&end=%d&m=sum:1h-count:pipe.m"
                 % (BASE - 10, BASE + 300))
             if status == 200:
-                total = sum(json.loads(data)[0]["dps"].values())
-                if total == 198:   # poll covers the full assertion: a
-                    break          # later batch may still be landing
+                res = json.loads(data)
+                if res:            # empty until the first batch lands
+                    total = sum(res[0]["dps"].values())
+                    if total == 198:   # poll covers the full assertion: a
+                        break          # later batch may still be landing
             time.sleep(0.1)
         assert total == 198
 
