@@ -118,7 +118,8 @@ class TestScanModesAndCompaction:
     each other.
     """
 
-    def _big_batch(self, rng, s=4, n=1024, spread_ms=40_000_000):
+    def _big_batch(self, rng, s=4, n=1024, spread_ms=40_000_000,
+                   nan_rate=0.05):
         ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
         val = np.zeros((s, n), np.float64)
         mask = np.zeros((s, n), bool)
@@ -126,11 +127,26 @@ class TestScanModesAndCompaction:
             k = int(rng.integers(n // 2, n - 7))
             t = START + np.sort(rng.choice(spread_ms, size=k, replace=False))
             v = rng.normal(100.0, 30.0, k)
-            v[rng.random(k) < 0.05] = np.nan
+            if nan_rate:
+                v[rng.random(k) < nan_rate] = np.nan
             ts[i, :k] = t
             val[i, :k] = v
             mask[i, :k] = True
         return ts, val, mask
+
+    @staticmethod
+    def _assert_matches_reference(ts, val, mask, agg, windows, out, omask):
+        """One definition of the numpy-reference comparison (values AND
+        output mask) shared by every test in this class."""
+        edges = np.arange(windows.first_window_ms,
+                          windows.first_window_ms
+                          + (windows.count + 1) * 3_600_000, 3_600_000)
+        want, want_cnt = _numpy_reference(ts, val, mask, agg, edges)
+        got = np.asarray(out)[:, :windows.count]
+        got_mask = np.asarray(omask)[:, :windows.count]
+        np.testing.assert_array_equal(got_mask, want_cnt > 0)
+        np.testing.assert_allclose(got[want_cnt > 0], want[want_cnt > 0],
+                                   rtol=1e-11, atol=1e-9)
 
     @pytest.mark.parametrize("agg", sorted(PREFIX_AGGS))
     def test_blocked_equals_flat_equals_reference(self, agg):
@@ -152,13 +168,42 @@ class TestScanModesAndCompaction:
         m = outs["flat"][1]
         np.testing.assert_allclose(outs["blocked"][0][m], outs["flat"][0][m],
                                    rtol=1e-12, atol=1e-12)
-        edges = np.arange(windows.first_window_ms,
-                          windows.first_window_ms
-                          + (windows.count + 1) * 3_600_000, 3_600_000)
-        want, want_cnt = _numpy_reference(ts, val, mask, agg, edges)
-        got = outs["blocked"][0][:, :windows.count]
-        np.testing.assert_allclose(got[want_cnt > 0], want[want_cnt > 0],
-                                   rtol=1e-11, atol=1e-9)
+        self._assert_matches_reference(ts, val, mask, agg, windows,
+                                       outs["blocked"][0], outs["blocked"][1])
+
+    @pytest.mark.parametrize("agg", ["avg", "count", "dev"])
+    def test_dirty_batches_take_the_counted_path(self, agg):
+        """The clean-batch count shortcut (count = diff(idx), skipping the
+        int32 cumsum) must never fire wrong: batches with NaN values or
+        masked-out REAL slots (mask false but ts real — not a pad) answer
+        identically to the numpy reference."""
+        rng = np.random.default_rng(7)
+        ts, val, mask = self._big_batch(rng)     # already has NaNs
+        # masked-out real slots: valid timestamps the mask excludes
+        drop = rng.random(mask.shape) < 0.1
+        mask2 = mask & ~drop
+        windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
+        spec, wargs = windows.split()
+        _, out, omask = downsample(ts, val, mask2, agg, spec, wargs,
+                                   FILL_NONE)
+        self._assert_matches_reference(ts, val, mask2, agg, windows, out,
+                                       omask)
+
+    @pytest.mark.parametrize("agg", sorted(PREFIX_AGGS))
+    def test_clean_batches_take_the_diff_shortcut(self, agg):
+        """CLEAN batches (no NaN, mask == real slots — the build_batch /
+        device-cache construction) answer via count = diff(idx); pin that
+        branch against the numpy reference (nothing else in the suite
+        exercises it: every other batch has NaNs)."""
+        rng = np.random.default_rng(13)
+        ts, val, mask = self._big_batch(rng, nan_rate=0.0)
+        assert not np.isnan(val[mask]).any()
+        windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
+        spec, wargs = windows.split()
+        _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
+                                   FILL_NONE)
+        self._assert_matches_reference(ts, val, mask, agg, windows, out,
+                                       omask)
 
     @pytest.mark.parametrize("agg", ["avg", "sum", "count", "dev"])
     def test_compare_all_search_equals_scan(self, agg):
