@@ -52,9 +52,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
-    WindowSpec, apply_fill, window_ids, window_timestamps,
-    _compact_ts, _edge_prefix_builder, _extreme_downsample, _sorted_runs,
-    FILL_NONE)
+    WindowSpec, apply_fill, window_timestamps,
+    _extreme_downsample, _sorted_runs,
+    _window_scan_setup, _window_ids_fast, FILL_NONE)
 
 # Summary points per (series, window) quantile sketch.
 SKETCH_K = 64
@@ -155,20 +155,17 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     """
     s, n = ts.shape
     w = spec.count
-    vf = val.astype(jnp.float64)
-    ok = mask & ~jnp.isnan(vf)
-
-    cts, cedges = _compact_ts(ts, spec, wargs)
-    idx = jax.vmap(
-        lambda row: jnp.searchsorted(row, cedges, side="left"))(cts)
-    windowed = _edge_prefix_builder(s, n, idx)
-
-    cnt = windowed(ok.astype(jnp.int32)).astype(jnp.int64)
+    # ONE setup shared with the materialized path: same edge search
+    # (incl. the search-mode toggle), same int32 compaction, and the
+    # clean-batch count shortcut — streamed chunks are clean by
+    # construction, so their count lane costs no scan at all.
+    vf, ok, cts, idx, windowed, cnt = _window_scan_setup(ts, val, mask,
+                                                         spec, wargs)
     out = {"n": cnt}
 
     need_win = ("m2" in lanes or with_sketch
                 or lanes & {"first", "last", "prod"})
-    raw_win = window_ids(ts, spec, wargs) if need_win else None
+    raw_win = _window_ids_fast(ts, cts, spec, wargs) if need_win else None
 
     if "total" in lanes:
         v0 = jnp.where(ok, vf, 0.0)

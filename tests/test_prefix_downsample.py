@@ -197,7 +197,12 @@ class TestScanModesAndCompaction:
         exercises it: every other batch has NaNs)."""
         rng = np.random.default_rng(13)
         ts, val, mask = self._big_batch(rng, nan_rate=0.0)
+        # assert the batch really satisfies the clean predicate the
+        # kernel tests (mask == realness AND no NaN under mask) — else a
+        # regression disabling the shortcut would pass unnoticed (both
+        # branches agree on counts)
         assert not np.isnan(val[mask]).any()
+        np.testing.assert_array_equal(mask, ts != np.iinfo(np.int64).max)
         windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
         spec, wargs = windows.split()
         _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
