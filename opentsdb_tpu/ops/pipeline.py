@@ -42,6 +42,9 @@ class PipelineSpec:
     downsample: DownsampleStep | None = None
     rate: RateOptions | None = None
     int_mode: bool = False  # Java long arithmetic end-to-end
+    # union-path tile budget override (<= 0: module default); the batched
+    # union runner sets default/B so B vmapped groups share one envelope
+    tile_cells: int = 0
 
 
 def _pipeline(spec: PipelineSpec, ts, val, mask, wargs):
@@ -63,11 +66,33 @@ def _pipeline(spec: PipelineSpec, ts, val, mask, wargs):
         work_ts, work_val, work_mask = rate(ts, val, mask, spec.rate,
                                             all_int=spec.int_mode)
         return union_aggregate(work_ts, work_val, work_mask, agg,
-                               int_mode=False)
-    return union_aggregate(ts, val, mask, agg, int_mode=spec.int_mode)
+                               int_mode=False, tile_cells=spec.tile_cells)
+    return union_aggregate(ts, val, mask, agg, int_mode=spec.int_mode,
+                           tile_cells=spec.tile_cells)
 
 
 _jitted = jax.jit(_pipeline, static_argnums=0)
+
+
+def _union_batch_pipeline(spec: PipelineSpec, ts, val, mask):
+    """B same-shaped union (no-downsample) groups in ONE dispatch.
+
+    vmaps the union pipeline over a leading group axis [B, S, N]; the
+    caller divides the union tile budget by B via spec.tile_cells so the
+    total materialization envelope stays what a single group's would be.
+    The per-group union grids are independent — outputs come back
+    batched ([B, S*N] timestamps/values/mask), one row per group.
+    """
+    return jax.vmap(lambda t, v, m: _pipeline(spec, t, v, m, {}))(
+        ts, val, mask)
+
+
+_jitted_union_batch = jax.jit(_union_batch_pipeline, static_argnums=0)
+
+
+def run_union_batch_pipeline(spec: PipelineSpec, ts, val, mask):
+    """Batched union pipeline -> per-group (u[B, U], out[B, U], mask[B, U])."""
+    return _jitted_union_batch(spec, ts, val, mask)
 
 
 def run_pipeline(spec: PipelineSpec, ts, val, mask, wargs: dict | None = None):

@@ -136,9 +136,11 @@ def set_union_tile_cells(cells: int) -> None:
     _UNION_TILE_CELLS = int(cells)
     from opentsdb_tpu.ops import pipeline
     pipeline._jitted.clear_cache()
+    pipeline._jitted_union_batch.clear_cache()
 
 
-def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False):
+def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False,
+                    tile_cells: int = 0):
     """Aggregate a [S, N] batch at the union of all timestamps.
 
     Returns (u[S*N] timestamps, out[S*N] values, u_mask[S*N]).  `int_mode`
@@ -147,10 +149,13 @@ def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False):
 
     The per-slot reduce over the series axis is independent across union
     slots, so the union axis is processed in tiles of at most
-    _UNION_TILE_CELLS // S slots via `lax.map` — peak memory is one tile's
-    [S, tile] contributions, never the quadratic [S, S*N] matrix
-    (VERDICT r2 weak #5).  Tiling is a static-shape decision: small
-    batches keep the single-pass form with no loop overhead.
+    tile_cells // S slots via `lax.map` (`tile_cells` <= 0 means the
+    module default; callers running B instances under vmap pass
+    default/B so the ENVELOPE, not the per-instance tile, stays fixed) —
+    peak memory is one tile's [S, tile] contributions, never the
+    quadratic [S, S*N] matrix (VERDICT r2 weak #5).  Tiling is a
+    static-shape decision: small batches keep the single-pass form with
+    no loop overhead.
     """
     ts, val, mask = compact_rows(ts, val, mask)
     u, u_mask = union_timestamps(ts, mask)
@@ -164,7 +169,9 @@ def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False):
                 t, v, m, u_chunk, agg.interpolation, int_mode)
         )(ts, work_val, mask)
 
-    tile = max(_UNION_TILE_CELLS // max(s, 1), 1)
+    if tile_cells <= 0:
+        tile_cells = _UNION_TILE_CELLS
+    tile = max(tile_cells // max(s, 1), 1)
     if total <= tile:
         contrib, participate = contribs(u)
         return u, agg.reduce(contrib, participate), u_mask

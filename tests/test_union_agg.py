@@ -253,3 +253,60 @@ class TestTiledUnion:
             assert got.shape[0] == len(np.unique(ts))
         finally:
             union_agg.set_union_tile_cells(1 << 24)
+
+
+class TestBatchedUnionGroups:
+    """Shape-class group batching: B same-shaped groups in one vmapped
+    dispatch must answer exactly like per-group dispatches (review the
+    planner's _run_segment_union)."""
+
+    def _tsdb(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        base = 1_356_998_400
+        rng = np.random.default_rng(3)
+        # 12 hosts, same cadence/point-count (one shape class); 3 hosts
+        # with a different count (a second class); int-valued metric too
+        for h in range(12):
+            for i in range(24):
+                t.add_point("ub.f", base + i * 10 + h, 1.5 * i + h,
+                            {"host": "h%02d" % h})
+        for h in range(3):
+            for i in range(40):
+                t.add_point("ub.f", base + i * 7, 2.0 * i,
+                            {"host": "x%02d" % h})
+        for h in range(6):
+            for i in range(24):
+                t.add_point("ub.i", base + i * 10, i * h,
+                            {"host": "h%02d" % h})
+        return t
+
+    def _run(self, tsdb, m, rate=""):
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        q = TSQuery(start="1356998400", end="1356999400",
+                    queries=[parse_m_subquery(m)])
+        q.validate()
+        res = tsdb.new_query_runner().run(q)
+        return {tuple(sorted(r.tags.items())): r.dps for r in res}
+
+    @pytest.mark.parametrize("m", [
+        "sum:ub.f{host=*}",            # float, two shape classes
+        "avg:ub.f{host=*}",
+        "sum:ub.i{host=*}",            # int_mode batch
+        "sum:rate:ub.f{host=*}",       # rate through the union path
+    ])
+    def test_batched_equals_singleton(self, m, monkeypatch):
+        from opentsdb_tpu.query import planner as planner_mod
+        t1, t2 = self._tsdb(), self._tsdb()
+        batched = self._run(t1, m)
+        monkeypatch.setattr(planner_mod.QueryRunner, "_UNION_BATCH_MAX", 1)
+        singleton = self._run(t2, m)
+        assert batched.keys() == singleton.keys()
+        for k in batched:
+            assert batched[k] == singleton[k], (m, k)
+
+    def test_int_values_stay_ints(self):
+        out = self._run(self._tsdb(), "sum:ub.i{host=*}")
+        some = next(iter(out.values()))
+        assert all(isinstance(v, int) for _, v in some)
