@@ -435,3 +435,88 @@ class TestHttpIntegration:
             % BASE
         r = self._post(make_tsdb(), body)
         assert r.status == 204
+
+
+class TestFuzzDifferential:
+    """Randomized bodies through both parsers: for every generated body
+    the native path must either match the Python path exactly (success,
+    errors, stored columns) or decline wholesale (None)."""
+
+    @staticmethod
+    def _gen_value(rng):
+        kind = rng.integers(0, 10)
+        if kind < 3:
+            return int(rng.integers(-10**12, 10**12))
+        if kind < 5:
+            return round(float(rng.normal(0, 1e6)), 6)
+        if kind == 5:
+            return str(int(rng.integers(-10**9, 10**9)))
+        if kind == 6:
+            return "%.4f" % float(rng.normal(0, 100))
+        if kind == 7:
+            return rng.choice(["", " ", "abc", "1e4", ".5", "5.",
+                               "+7", "-0", "1_000", "nan", "inf",
+                               "0x10", "4e", "--5", " 42 "]).item()
+        if kind == 8:
+            return bool(rng.integers(0, 2))
+        return None
+
+    @staticmethod
+    def _gen_ts(rng):
+        kind = rng.integers(0, 8)
+        if kind < 4:
+            return int(rng.integers(0, 2**33))
+        if kind == 4:
+            return -int(rng.integers(1, 10**6))
+        if kind == 5:
+            return float(rng.integers(0, 2**32)) + 0.25
+        if kind == 6:
+            return str(int(rng.integers(0, 2**32)))
+        return rng.choice(["", "x", "1.5", "  7  "]).item()
+
+    @staticmethod
+    def _gen_tags(rng):
+        kind = rng.integers(0, 10)
+        if kind == 0:
+            return {}
+        if kind == 1:
+            return None
+        n = int(rng.integers(1, 11))
+        return {"k%d" % i: rng.choice(
+            ["v", "a b", "été", "v-%d" % i]).item() for i in range(n)}
+
+    def _gen_dp(self, rng):
+        dp = {}
+        if rng.random() > 0.05:
+            dp["metric"] = rng.choice(["fz.m1", "fz.m2", ""]).item()
+        if rng.random() > 0.05:
+            dp["timestamp"] = self._gen_ts(rng)
+        if rng.random() > 0.05:
+            dp["value"] = self._gen_value(rng)
+        if rng.random() > 0.05:
+            dp["tags"] = self._gen_tags(rng)
+        return dp
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_bodies(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(40):
+            n = int(rng.integers(1, 8))
+            dps = [self._gen_dp(rng) for _ in range(n)]
+            body = json.dumps(dps)
+            t_n, t_p = make_tsdb(), make_tsdb()
+            native = t_n.add_points_bulk_native(body.encode())
+            try:
+                py = t_p.add_points_bulk(json.loads(body))
+                py_exc = None
+            except Exception as e:       # python path itself may raise
+                py, py_exc = None, e
+            if native is None:
+                continue                 # wholesale decline: always legal
+            assert py_exc is None, (body, py_exc)
+            n_success, n_errors, _ = native
+            p_success, p_errors = py
+            assert n_success == p_success, body
+            assert [(i, type(e).__name__, str(e)) for i, e in n_errors] \
+                == [(i, type(e).__name__, str(e)) for i, e in p_errors], body
+            assert store_state(t_n) == store_state(t_p), body

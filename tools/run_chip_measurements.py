@@ -41,6 +41,7 @@ def main() -> None:
     results: list[dict] = []
     stages = [
         ("bench_prefix", [sys.executable, "bench_prefix.py"], 3600),
+        ("stage_bench", [sys.executable, "tools/stage_bench.py"], 3600),
         ("bench", [sys.executable, "bench.py"], 1800),
     ]
     # One subprocess PER config: config 2 crashed the TPU worker in the r3
