@@ -137,3 +137,77 @@ class TestConcurrentIngestQuery:
         stop.set()
         w.join()
         assert not errors, errors
+
+
+class TestDeviceCacheConcurrency:
+    def test_writers_queries_refresher_race(self):
+        """Writers appending, queries hitting/missing the device cache,
+        and the refresh loop rebuilding — no exceptions, and the final
+        quiesced query equals a cache-free control."""
+        import threading
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.utils.config import Config
+
+        tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+        base = 1_356_998_400
+        for i in range(50):
+            tsdb.add_point("cc.m", base + i, float(i), {"h": "a"})
+            tsdb.add_point("cc.m", base + i, float(i * 2), {"h": "b"})
+
+        stop = threading.Event()
+        errors: list = []
+
+        def guard(fn):
+            def run():
+                try:
+                    while not stop.is_set():
+                        fn()
+                except Exception as e:     # pragma: no cover
+                    errors.append(e)
+            return run
+
+        n_writes = [0]
+
+        def write():
+            i = n_writes[0] = n_writes[0] + 1
+            tsdb.add_point("cc.m", base + 100 + i, float(i), {"h": "a"})
+
+        def query():
+            q = TSQuery(start=str(base), end=str(base + 10_000),
+                        queries=[parse_m_subquery("sum:1m-avg:cc.m{h=*}")])
+            q.validate()
+            res = tsdb.new_query_runner().run(q)
+            assert len(res) == 2
+
+        def refresh():
+            tsdb.device_cache.refresh(tsdb.store)
+
+        threads = [threading.Thread(target=guard(f))
+                   for f in (write, query, query, refresh)]
+        for t in threads:
+            t.start()
+        import time as _t
+        _t.sleep(3.0)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, errors[:2]
+
+        # quiesced: cached answer == control without a cache
+        tsdb.device_cache.refresh(tsdb.store)
+        q = TSQuery(start=str(base), end=str(base + 10_000),
+                    queries=[parse_m_subquery("sum:1m-avg:cc.m{h=*}")])
+        q.validate()
+        got = {tuple(sorted(r.tags.items())): r.dps
+               for r in tsdb.new_query_runner().run(q)}
+        control = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                               "tsd.query.device_cache.enable": "false"}))
+        for s in tsdb.store.all_series():
+            ts, fv, iv, ii = s.arrays()
+            key = control._series_key(
+                "cc.m", tsdb.resolve_key_tags(s.key), create=True)
+            control.store.add_batch(key, ts, fv, ii, ival=iv)
+        want = {tuple(sorted(r.tags.items())): r.dps
+                for r in control.new_query_runner().run(q)}
+        assert got == want
