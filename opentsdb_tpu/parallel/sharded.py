@@ -444,10 +444,43 @@ def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
     s, n = ts.shape
     s_pad = -(-s // n_dev) * n_dev
     ts, val, mask, gid = _pad_rows(s_pad, ts, val, mask, gid, pad_gid_value)
+    return _put_row_sharded(mesh, ts, val, mask, gid)
+
+
+def _put_row_sharded(mesh: Mesh, ts, val, mask, gid):
+    """The shared layout tail: dim 0 over both mesh axes, time intact."""
     row_sh = NamedSharding(mesh, P(_BOTH, None))
     gid_sh = NamedSharding(mesh, P(_BOTH))
     return (jax.device_put(ts, row_sh), jax.device_put(val, row_sh),
             jax.device_put(mask, row_sh), jax.device_put(gid, gid_sh))
+
+
+def shard_rows_device(mesh: Mesh, ts, val, mask, gid: np.ndarray,
+                      pad_gid_value: int):
+    """shard_rows for an already-device-resident batch (device-cache hit).
+
+    Row padding happens ON DEVICE (tiny concats, same load-bearing pad
+    rule as _pad_rows) and the device_put re-lays the single-device
+    arrays out across the mesh — an ICI scatter on real hardware instead
+    of a fresh host upload.  gid is host-side (the planner builds it per
+    query) and pads exactly like shard_rows.
+    """
+    n_dev = n_devices(mesh)
+    s, n = ts.shape
+    s_pad = -(-s // n_dev) * n_dev
+    if s_pad != s:
+        # pure pad ROWS from _pad_rows (empty data in, pads out), then
+        # concatenated on device: one definition of the phantom-row rule
+        # serves both layouts
+        pad_ts, pad_val, pad_mask, pad_gid = _pad_rows(
+            s_pad - s, np.empty((0, n), np.int64),
+            np.empty((0, n), val.dtype), np.empty((0, n), bool),
+            np.empty(0, gid.dtype), pad_gid_value)
+        ts = jnp.concatenate([ts, jnp.asarray(pad_ts)])
+        val = jnp.concatenate([val, jnp.asarray(pad_val)])
+        mask = jnp.concatenate([mask, jnp.asarray(pad_mask)])
+        gid = np.concatenate([gid, pad_gid])
+    return _put_row_sharded(mesh, ts, val, mask, gid)
 
 
 def shard_series(mesh: Mesh, ts: np.ndarray, val: np.ndarray,

@@ -433,7 +433,7 @@ class QueryRunner:
         series_list = [s for _, members, _ in kept for s, _t in members]
         would_stream = (stream_ok and total_points > tsdb.config.get_int(
             "tsd.query.streaming.point_threshold"))
-        if (tsdb.device_cache is not None and not use_mesh and store is not None
+        if (tsdb.device_cache is not None and store is not None
                 and seg.kind in ("raw", "rollup")):
             # Cold entries build inline only when the alternative is a full
             # host materialization anyway; when streaming would serve this
@@ -483,11 +483,19 @@ class QueryRunner:
             if use_mesh:
                 from opentsdb_tpu.parallel import (
                     sharded_query_pipeline, shard_rows)
-                from opentsdb_tpu.parallel.sharded import n_devices
+                from opentsdb_tpu.parallel.sharded import (
+                    n_devices, shard_rows_device)
                 self.exec_stats["meshDevices"] = float(n_devices(mesh))
                 fn = sharded_query_pipeline(mesh, spec, g_pad)
-                d_ts, d_val, d_mask, d_gid = shard_rows(
-                    mesh, ts, val, mask, gid, pad_gid_value=g_pad)
+                if cached is not None:
+                    # cache hit under the mesh: re-lay the device batch
+                    # out across the chips (ICI scatter) instead of a
+                    # fresh host upload
+                    d_ts, d_val, d_mask, d_gid = shard_rows_device(
+                        mesh, ts, val, mask, gid, pad_gid_value=g_pad)
+                else:
+                    d_ts, d_val, d_mask, d_gid = shard_rows(
+                        mesh, ts, val, mask, gid, pad_gid_value=g_pad)
                 out_ts, out_val, out_mask = fn(d_ts, d_val, d_mask, d_gid,
                                                wargs)
             else:

@@ -27,11 +27,13 @@ from opentsdb_tpu.utils.config import Config
 START = 1356998400  # seconds
 
 
-def _mk_tsdb(mesh: bool, min_series: int = 0) -> TSDB:
+def _mk_tsdb(mesh: bool, min_series: int = 0,
+             device_cache: bool = True) -> TSDB:
     return TSDB(Config({
         "tsd.core.auto_create_metrics": True,
         "tsd.query.mesh.enable": mesh,
         "tsd.query.mesh.min_series": min_series,
+        "tsd.query.device_cache.enable": device_cache,
     }))
 
 
@@ -152,3 +154,44 @@ def test_http_handler_served_from_mesh(pair):
         bodies.append(json.loads(q.response.body))
     assert_equivalent(bodies[0], bodies[1])
     assert len(bodies[0]) == 3
+
+
+def test_mesh_host_path_without_device_cache(pair):
+    """The host shard_rows path must stay covered on its own: with the
+    device cache off, mesh answers still equal the single-device
+    control (pins the _pad_rows phantom-row rule independently of the
+    cache, which otherwise serves every warm raw query)."""
+    _, plain = pair
+    meshed_nocache = _mk_tsdb(True, device_cache=False)
+    _ingest(meshed_nocache)
+    m = "avg:1m-avg:sys.cpu.user{dc=*}"
+    runner = meshed_nocache.new_query_runner()
+    q = TSQuery(start=str(START), end=str(START + 600),
+                queries=[parse_m_subquery(m)])
+    q.validate()
+    got = [r.to_json() for r in runner.run(q)]
+    assert "deviceCacheHit" not in runner.exec_stats
+    assert runner.exec_stats.get("meshDevices", 0) >= 8
+    assert_equivalent(got, _run(plain, m))
+
+
+def test_mesh_serves_from_device_cache(pair):
+    """A cache hit under the mesh re-lays the device batch across the
+    chips (shard_rows_device) — answers must equal a cache-DISABLED
+    meshed control (the host shard_rows path) and the single-device
+    control."""
+    meshed, plain = pair
+    meshed_nocache = _mk_tsdb(True, device_cache=False)
+    _ingest(meshed_nocache)
+    m = "sum:1m-avg:sys.cpu.user{dc=*}"
+    _run(meshed, m)                       # build/warm the cache entry
+    runner = meshed.new_query_runner()
+    q = TSQuery(start=str(START), end=str(START + 600),
+                queries=[parse_m_subquery(m)])
+    q.validate()
+    warm_res = runner.run(q)
+    assert runner.exec_stats.get("deviceCacheHit") == 1.0
+    assert runner.exec_stats.get("meshDevices", 0) >= 8
+    warm = [r.to_json() for r in warm_res]
+    assert_equivalent(warm, _run(meshed_nocache, m))
+    assert_equivalent(warm, _run(plain, m))
