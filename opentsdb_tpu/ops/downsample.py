@@ -21,6 +21,7 @@ part (d).
 
 from __future__ import annotations
 
+import os as _os
 from dataclasses import dataclass
 
 import jax
@@ -152,7 +153,9 @@ PREFIX_AGGS = frozenset(
 # replaces the serializing segment scatter).  "segment" keeps the scatter
 # form — faster on CPU where scatters are cheap; the chip A/B decides.
 EXTREME_AGGS = frozenset({"min", "mimmin", "max", "mimmax"})
-_EXTREME_MODE = "scan"
+_EXTREME_MODE = (_os.environ.get("TSDB_EXTREME_MODE")
+                 if _os.environ.get("TSDB_EXTREME_MODE")
+                 in ("scan", "segment") else "scan")
 
 
 def set_extreme_mode(mode: str) -> None:
@@ -183,7 +186,14 @@ def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
 # 0.600s per 67M-pt dispatch at int32 — XLA's native cumsum lowering beats
 # the hand-blocked form on TPU, so flat is the default (CPU favors blocked,
 # but defaults follow the chip).
-_SCAN_MODE = "flat"
+#
+# Env overrides (TSDB_SCAN_MODE / TSDB_SEARCH_MODE / TSDB_EXTREME_MODE,
+# read once at import): lets the one-command measurement session feed
+# bench_prefix's A/B winners into the later stages without editing
+# source mid-run.  Invalid values are ignored (defaults win).
+_SCAN_MODE = (_os.environ.get("TSDB_SCAN_MODE")
+              if _os.environ.get("TSDB_SCAN_MODE") in ("flat", "blocked")
+              else "flat")
 _SCAN_BLOCK = 512
 
 _I32_BIG = np.int64(2**31 - 2)
@@ -199,7 +209,9 @@ _COMPACT_ENABLED = True
 # reduction over W-tiles — no gathers at all.  Which wins depends on W:
 # compare_all work grows linearly with the edge count while scan's grows
 # logarithmically with N; bench_prefix A/Bs both on the chip.
-_SEARCH_MODE = "scan"
+_SEARCH_MODE = (_os.environ.get("TSDB_SEARCH_MODE")
+                if _os.environ.get("TSDB_SEARCH_MODE")
+                in ("scan", "compare_all") else "scan")
 
 
 def set_search_mode(mode: str) -> None:

@@ -24,17 +24,57 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "BENCH_CONFIGS_r03.json")
 
 
-def run_stage(name: str, argv: list[str], timeout: int) -> list[str]:
+def run_stage(name: str, argv: list[str], timeout: int,
+              extra_env: dict | None = None) -> list[str]:
     print("== %s ==" % name, file=sys.stderr, flush=True)
     t0 = time.time()
+    env = dict(os.environ)
+    env.update(extra_env or {})
     proc = subprocess.run(argv, cwd=REPO, capture_output=True, text=True,
-                          timeout=timeout)
+                          timeout=timeout, env=env)
     sys.stderr.write(proc.stderr[-4000:])
     lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
     print("== %s done rc=%d in %.0fs, %d json lines =="
           % (name, proc.returncode, time.time() - t0, len(lines)),
           file=sys.stderr, flush=True)
     return lines
+
+
+def pick_winners(prefix_records: list[dict]) -> dict:
+    """A/B winners from bench_prefix -> env overrides for later stages.
+
+    Only the HONEST defaults race: the f32 config is excluded (it breaks
+    the Java-double contract and never becomes a default); the min/max
+    extreme A/B picks from its own pair.
+    """
+    env = {}
+    by_cfg = {r["config"]: r["s_per_dispatch"] for r in prefix_records
+              if "config" in r and "s_per_dispatch" in r}
+    racers = {
+        "flat+int64": ("flat", "0", "scan"),
+        "flat+int32": ("flat", "1", "scan"),
+        "blocked+int64": ("blocked", "0", "scan"),
+        "blocked+int32": ("blocked", "1", "scan"),
+        "flat+int32+search_scan": ("flat", "1", "scan"),
+        "flat+int32+search_compare_all": ("flat", "1", "compare_all"),
+    }
+    timed = [(by_cfg[c], cfg) for c, cfg in racers.items() if c in by_cfg]
+    if timed:
+        _, (scan, compact, search) = min(timed)
+        env["TSDB_SCAN_MODE"] = scan
+        env["TSDB_SEARCH_MODE"] = search
+        # compaction has no env toggle knob needed: int32 won on chip and
+        # is the default; record the evidence only
+        del compact
+    ext = {c: by_cfg[c] for c in ("min+extreme_scan", "min+extreme_segment")
+           if c in by_cfg}
+    if len(ext) == 2:
+        env["TSDB_EXTREME_MODE"] = (
+            "scan" if ext["min+extreme_scan"] <= ext["min+extreme_segment"]
+            else "segment")
+    if env:
+        print("== A/B winners -> %s ==" % env, file=sys.stderr, flush=True)
+    return env
 
 
 def main() -> None:
@@ -51,12 +91,20 @@ def main() -> None:
     stages += [("bench_configs:%d" % c,
                 [sys.executable, "bench_configs.py", "--config", str(c)],
                 2400) for c in range(1, 8)]
+    winner_env: dict = {}
     for name, argv, timeout in stages:
         try:
-            for ln in run_stage(name, argv, timeout):
+            lines = run_stage(name, argv, timeout, extra_env=winner_env)
+            stage_recs = []
+            for ln in lines:
                 rec = json.loads(ln)
                 rec["stage"] = name
+                if winner_env:
+                    rec["ab_overrides"] = dict(winner_env)
                 results.append(rec)
+                stage_recs.append(rec)
+            if name == "bench_prefix":
+                winner_env = pick_winners(stage_recs)
         except Exception as e:          # keep later stages alive
             print("stage %s failed: %s" % (name, e), file=sys.stderr)
             results.append({"stage": name, "error": str(e)})
