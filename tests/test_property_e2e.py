@@ -1,0 +1,125 @@
+"""End-to-end property test: random stores + random grouped downsample
+queries vs an independent numpy evaluator.
+
+The reference's test strategy (SURVEY.md §4) pairs golden values with
+synthetic stores; this adds the randomized sweep: for every (aggregator,
+downsample fn, fill, grouping) drawn, the full served pipeline — planner,
+device cache, batching, kernels, extraction — must match a slow model
+built directly from the raw points.  Downsample (grid) queries only: the
+union-LERP path has its own differential suites.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.models import TSQuery, parse_m_subquery
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+SPAN_S = 1_800
+INTERVAL_S = 60
+
+DS_FNS = ["avg", "sum", "min", "max", "count", "dev"]
+GROUP_AGGS = ["sum", "avg", "min", "max", "count"]
+
+
+def _model_downsample(points, fn):
+    """points: list[(ts_ms, val)] in one window -> downsampled value."""
+    vals = [v for _, v in points]
+    if fn == "avg":
+        return sum(vals) / len(vals)
+    if fn == "sum":
+        return sum(vals)
+    if fn == "min":
+        return min(vals)
+    if fn == "max":
+        return max(vals)
+    if fn == "count":
+        return float(len(vals))
+    if fn == "dev":
+        if len(vals) < 2:
+            return 0.0
+        m = sum(vals) / len(vals)
+        return math.sqrt(sum((v - m) ** 2 for v in vals) / (len(vals) - 1))
+    raise KeyError(fn)
+
+
+def _model_query(series, fn, agg):
+    """series: {host: [(ts_ms, val)]} -> {window_start_s: value} with the
+    reference's cross-series semantics: sum/avg/min/max LERP a series'
+    missing grid slots between its first and last windows
+    (AggregationIterator LERP policy); count is zero-if-missing (ZIM) —
+    only actual values count."""
+    grids = {}
+    for host, pts in series.items():
+        windows = {}
+        for ts, v in pts:
+            w = (ts // 1000 // INTERVAL_S) * INTERVAL_S
+            windows.setdefault(w, []).append((ts, v))
+        grids[host] = {w: _model_downsample(p, fn)
+                       for w, p in windows.items()}
+    all_w = sorted({w for g in grids.values() for w in g})
+    lerp = agg in ("sum", "avg", "min", "max")
+    out = {}
+    for w in all_w:
+        vals = []
+        for g in grids.values():
+            if w in g:
+                vals.append(g[w])
+            elif min(g) < w < max(g):
+                if lerp:
+                    lo = max(x for x in g if x < w)
+                    hi = min(x for x in g if x > w)
+                    frac = (w - lo) / (hi - lo)
+                    vals.append(g[lo] + (g[hi] - g[lo]) * frac)
+                else:
+                    vals.append(0.0)   # ZIM: in-span series substitute 0
+                    #                    and still count
+        if not vals:
+            continue
+        if agg == "sum":
+            out[w] = sum(vals)
+        elif agg == "avg":
+            out[w] = sum(vals) / len(vals)
+        elif agg == "min":
+            out[w] = min(vals)
+        elif agg == "max":
+            out[w] = max(vals)
+        elif agg == "count":
+            out[w] = float(len(vals))
+    return out
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_grouped_downsample_queries(seed):
+    rng = np.random.default_rng(seed)
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    n_hosts = int(rng.integers(2, 7))
+    series: dict = {}
+    for h in range(n_hosts):
+        host = "h%02d" % h
+        n_pts = int(rng.integers(5, 120))
+        ts_s = np.sort(rng.choice(SPAN_S, size=n_pts, replace=False))
+        pts = []
+        for t in ts_s:
+            v = float(np.round(rng.normal(100, 40), 6))
+            tsdb.add_point("prop.m", BASE + int(t), v, {"host": host})
+            pts.append(((BASE + int(t)) * 1000, v))
+        series[host] = pts
+
+    for fn in DS_FNS:
+        for agg in rng.choice(GROUP_AGGS, size=2, replace=False):
+            m = "%s:%ds-%s:prop.m" % (agg, INTERVAL_S, fn)
+            q = TSQuery(start=str(BASE), end=str(BASE + SPAN_S + 60),
+                        queries=[parse_m_subquery(m)])
+            q.validate()
+            (res,) = tsdb.new_query_runner().run(q)
+            got = {int(ts) // 1000: v for ts, v in res.dps}
+            want = _model_query(series, fn, str(agg))
+            assert set(got) == set(want), (m, "window sets differ")
+            for w in want:
+                assert got[w] == pytest.approx(want[w], rel=1e-9,
+                                               abs=1e-9), (m, w)
