@@ -520,3 +520,42 @@ class TestFuzzDifferential:
             assert [(i, type(e).__name__, str(e)) for i, e in n_errors] \
                 == [(i, type(e).__name__, str(e)) for i, e in p_errors], body
             assert store_state(t_n) == store_state(t_p), body
+
+
+class TestTelnetFuzz:
+    """Random telnet line corpus: the batch handler must reply and store
+    exactly like the per-line handler."""
+
+    WORDS = ["put", "putt", "", "m.one", "m.two", "1356998400",
+             "1356998400500", "1356998400.5", "-3", "0", "xyz", "1e4",
+             "42", "-7.25", " ", "h=a", "h=b", "dc=x", "h=", "=v",
+             "noeq", "h=a=b", "été=v", "h=a h=a", "version"]
+
+    def _line(self, rng):
+        n = int(rng.integers(1, 9))
+        return " ".join(rng.choice(self.WORDS, size=n))
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_lines(self, seed):
+        from opentsdb_tpu.tsd.rpc_manager import RpcManager
+
+        class Conn:
+            close_after_write = False
+            auth_state = None
+
+        rng = np.random.default_rng(seed + 100)
+        lines = [self._line(rng) for _ in range(60)]
+        # seed some guaranteed-clean lines so data lands too
+        for i in range(0, 60, 7):
+            lines[i] = "put m.one %d %d h=a" % (BASE + i, i)
+        block = ("\n".join(lines) + "\n").encode()
+
+        t_b, t_s = make_tsdb(), make_tsdb()
+        reply_b = RpcManager(t_b).handle_telnet_batch(Conn(), block)
+        m_s = RpcManager(t_s)
+        conn = Conn()
+        reply_s = "".join(
+            r for r in (m_s.handle_telnet(conn, ln) for ln in lines
+                        if ln.strip()) if r)
+        assert reply_b == reply_s, (seed,)
+        assert store_state(t_b) == store_state(t_s), (seed,)
