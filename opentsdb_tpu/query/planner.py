@@ -553,9 +553,39 @@ class QueryRunner:
         # callbacks (SaltScanner.java:269 × :463) in one composition.
         lanes = lanes_for([spec.downsample.function])
         mesh = tsdb.query_mesh()
+        use_sharded = (mesh is not None and s >= tsdb.config.get_int(
+            "tsd.query.mesh.min_series"))
+        # The accumulator grid is O(S x W x lane bytes): a fine downsample
+        # over a huge range (10s windows x a year -> millions of windows)
+        # would OOM the device mid-query.  Refuse up front with the
+        # reference's budget error shape instead (QueryRpc 413 contract) —
+        # the operator either coarsens the interval or raises the budget.
+        # The limit is PER CHIP: the sharded path splits rows over the
+        # mesh, so its estimate divides by the device count.  The sketch
+        # lane dominates when present (K float32 summary points + the
+        # count lane per cell).
+        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
+        if state_mb > 0:
+            from opentsdb_tpu.ops.streaming import SKETCH_K
+            from opentsdb_tpu.query.limits import QueryException
+            per_cell = 8 + 8 * len(lanes) + (4 * SKETCH_K if sketch else 0)
+            n_chips = 1
+            if use_sharded:
+                from opentsdb_tpu.parallel.sharded import n_devices
+                n_chips = n_devices(mesh)
+            est = s * window_spec.count * per_cell // n_chips
+            if est > state_mb * 2**20:
+                raise QueryException(
+                    "Sorry, this query's streaming state (%d series x %d "
+                    "windows%s) needs ~%dMB of accelerator memory per "
+                    "chip, over the %dMB limit "
+                    "(tsd.query.streaming.state_mb). Please use a coarser "
+                    "downsample interval or decrease your time range."
+                    % (s, window_spec.count,
+                       " x %d-point sketches" % SKETCH_K if sketch else "",
+                       est // 2**20, state_mb))
         sharded_acc = None
-        if (mesh is not None and s
-                >= tsdb.config.get_int("tsd.query.mesh.min_series")):
+        if use_sharded:
             from opentsdb_tpu.parallel import ShardedStreamAccumulator
             sharded_acc = ShardedStreamAccumulator(mesh, s, window_spec,
                                                    wargs, sketch=sketch,

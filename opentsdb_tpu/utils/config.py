@@ -78,6 +78,10 @@ DEFAULTS: dict[str, str] = {
     # (approximate, rank error ~chunks/(2K)); false = materialize instead,
     # subject to the scan budgets
     "tsd.query.streaming.sketch_percentiles": "true",
+    # refuse queries whose streaming accumulator grid (S x W x lanes)
+    # would exceed this many MB of device memory (0 = unlimited); the
+    # 413 points the operator at a coarser interval or a shorter range
+    "tsd.query.streaming.state_mb": "6144",
     # TPU-native: device-resident series cache (the BlockCache analog) —
     # hot metrics' columns pinned in HBM; repeat queries assemble their
     # batch on-device with zero host->device data traffic.  Size is a

@@ -488,3 +488,74 @@ class TestLaneSelection:
                                        lanes=lanes_for(["sum"]))
         with pytest.raises(KeyError, match="lacks lane"):
             acc.finish("max")
+
+
+class TestStateBudget:
+    def test_oversized_streaming_grid_refused_as_413(self):
+        """A fine downsample over a huge range must refuse with the
+        budget error shape, not OOM the device mid-query."""
+        import pytest
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.query.limits import QueryException
+        from opentsdb_tpu.utils.config import Config
+
+        tsdb = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.query.streaming.point_threshold": "10",
+            "tsd.query.device_cache.enable": "false",
+            "tsd.query.streaming.state_mb": "1",
+        }))
+        base = 1_356_998_400
+        span = 40_000_000     # ~463 days
+        for i in range(200):
+            tsdb.add_point("big.m", base + i * (span // 200), float(i),
+                           {"h": "a"})
+        q = TSQuery(start=str(base), end=str(base + span),
+                    queries=[parse_m_subquery("sum:10s-avg:big.m")])
+        q.validate()
+        with pytest.raises(QueryException, match="accelerator memory"):
+            tsdb.new_query_runner().run(q)
+
+    def test_sketch_lane_counted_and_mesh_divides(self):
+        """Percentile sketches dominate the state estimate (review r3);
+        the mesh divides the per-chip footprint so a sharded query under
+        the per-chip budget still streams."""
+        import pytest
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.query.limits import QueryException
+        from opentsdb_tpu.utils.config import Config
+
+        base = 1_356_998_400
+        span = 4_000_000
+
+        def mk(state_mb, mesh):
+            t = TSDB(Config({
+                "tsd.core.auto_create_metrics": True,
+                "tsd.query.streaming.point_threshold": "10",
+                "tsd.query.device_cache.enable": "false",
+                "tsd.query.mesh.enable": mesh,
+                "tsd.query.mesh.min_series": 0,
+                "tsd.query.streaming.state_mb": str(state_mb),
+            }))
+            for h in range(8):
+                for i in range(40):
+                    t.add_point("sk.m", base + i * (span // 40) + h,
+                                float(i), {"h": "h%d" % h})
+            return t
+
+        def q(t, m="p99:60s-p99:sk.m"):
+            tq = TSQuery(start=str(base), end=str(base + span),
+                         queries=[parse_m_subquery(m)])
+            tq.validate()
+            return t.new_query_runner().run(tq)
+
+        # sketch bytes push this over a limit the plain-lane math passes:
+        # 8 series x 65536 padded windows x ~272B/cell ~ 136MB > 100MB,
+        # while the old (lanes+1)*8 estimate said ~8MB
+        with pytest.raises(QueryException, match="sketches"):
+            q(mk(100, mesh=False))
+        # the 8-device mesh divides the same footprint to ~17MB/chip
+        res = q(mk(100, mesh=True))
+        assert res and res[0].dps
