@@ -419,6 +419,22 @@ class QueryRunner:
                      and (ds_fn in STREAMABLE_DS or sketchable))
         self._bump("pointsScanned", total_points)
         self._bump("seriesScanned", len(gid))
+        # The materialized path has the streaming guard's hazard too:
+        # SPARSE series over a huge range with a fine interval build a
+        # [S, W] grid regardless of point count (a year at 10s windows is
+        # 3M+ columns).  Same knob, same 413 shape; ~3 grid lanes live
+        # through a dispatch (values, counts, mask/fill intermediates).
+        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
+        if state_mb > 0 and \
+                len(gid) * window_spec.count * 24 > state_mb * 2**20:
+            from opentsdb_tpu.query.limits import QueryException
+            raise QueryException(
+                "Sorry, this query's downsample grid (%d series x %d "
+                "windows) needs ~%dMB of accelerator memory, over the "
+                "%dMB limit (tsd.query.streaming.state_mb). Please use a "
+                "coarser downsample interval or decrease your time range."
+                % (len(gid), window_spec.count,
+                   len(gid) * window_spec.count * 24 // 2**20, state_mb))
 
         mesh = tsdb.query_mesh()
         use_mesh = (mesh is not None and len(gid) >= tsdb.config.get_int(

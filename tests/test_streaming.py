@@ -559,3 +559,28 @@ class TestStateBudget:
         # the 8-device mesh divides the same footprint to ~17MB/chip
         res = q(mk(100, mesh=True))
         assert res and res[0].dps
+
+    def test_materialized_grid_guard(self):
+        """Sparse series over a huge range with a fine interval must
+        refuse too — the [S, W] grid is points-independent."""
+        import pytest
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.query.limits import QueryException
+        from opentsdb_tpu.utils.config import Config
+
+        base = 1_356_998_400
+        span = 40_000_000
+        tsdb = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.query.device_cache.enable": "false",
+            "tsd.query.streaming.state_mb": "2",
+        }))
+        for i in range(50):     # 50 points: far under any point budget
+            tsdb.add_point("sp.m", base + i * (span // 50), float(i),
+                           {"h": "a"})
+        q = TSQuery(start=str(base), end=str(base + span),
+                    queries=[parse_m_subquery("sum:10s-avg:sp.m")])
+        q.validate()
+        with pytest.raises(QueryException, match="downsample grid"):
+            tsdb.new_query_runner().run(q)
