@@ -92,6 +92,21 @@ def main() -> None:
                 [sys.executable, "bench_configs.py", "--config", str(c)],
                 2400) for c in range(1, 8)]
     winner_env: dict = {}
+    def write_out() -> None:
+        # Rewritten after EVERY stage: a session cutoff (or a second
+        # tunnel death) mid-run keeps everything measured so far.
+        with open(OUT, "w") as fh:
+            for rec in results:
+                fh.write(json.dumps(rec) + "\n")
+            fh.write(json.dumps({
+                "stage": "meta",
+                "recorded_unix": int(time.time()),
+                "methodology": "drain-synced (block_until_ready is a "
+                               "no-op on axon), unique operands per "
+                               "dispatch, RTT subtracted, >=1s wall per "
+                               "measurement; see bench.py docstring",
+            }) + "\n")
+
     for name, argv, timeout in stages:
         try:
             lines = run_stage(name, argv, timeout, extra_env=winner_env)
@@ -108,18 +123,7 @@ def main() -> None:
         except Exception as e:          # keep later stages alive
             print("stage %s failed: %s" % (name, e), file=sys.stderr)
             results.append({"stage": name, "error": str(e)})
-
-    with open(OUT, "w") as fh:
-        for rec in results:
-            fh.write(json.dumps(rec) + "\n")
-        fh.write(json.dumps({
-            "stage": "meta",
-            "recorded_unix": int(time.time()),
-            "methodology": "drain-synced (block_until_ready is a no-op on "
-                           "axon), unique operands per dispatch, RTT "
-                           "subtracted, >=1s wall per measurement; see "
-                           "bench.py docstring",
-        }) + "\n")
+        write_out()
     print("wrote %s (%d records)" % (OUT, len(results)))
 
 
