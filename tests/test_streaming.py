@@ -528,7 +528,7 @@ class TestStateBudget:
         from opentsdb_tpu.utils.config import Config
 
         base = 1_356_998_400
-        span = 4_000_000
+        span = 400_000
 
         def mk(state_mb, mesh):
             t = TSDB(Config({
@@ -552,12 +552,12 @@ class TestStateBudget:
             return t.new_query_runner().run(tq)
 
         # sketch bytes push this over a limit the plain-lane math passes:
-        # 8 series x 65536 padded windows x ~272B/cell ~ 136MB > 100MB,
-        # while the old (lanes+1)*8 estimate said ~8MB
+        # 8 series x 8192 padded windows x ~272B/cell ~ 17MB > 10MB,
+        # while a (lanes+1)*8 estimate would say well under 1MB
         with pytest.raises(QueryException, match="sketches"):
-            q(mk(100, mesh=False))
-        # the 8-device mesh divides the same footprint to ~17MB/chip
-        res = q(mk(100, mesh=True))
+            q(mk(10, mesh=False))
+        # the 8-device mesh divides the same footprint to ~2.2MB/chip
+        res = q(mk(10, mesh=True))
         assert res and res[0].dps
 
     def test_materialized_grid_guard(self):
