@@ -1072,11 +1072,12 @@ inline bool parse_point(Parser& P, RawPoint& rp, const char* base) {
                         if (!P.skip()) return false;
                         slot.second.clear();
                     }
-                    // canonical-key separators must stay unambiguous
-                    if (slot.first.find('\x1E') != std::string::npos ||
-                        slot.first.find('\x1F') != std::string::npos ||
-                        slot.second.find('\x1E') != std::string::npos ||
-                        slot.second.find('\x1F') != std::string::npos)
+                    // canonical-key separators must stay unambiguous; NUL
+                    // would truncate the c_char_p group-key return (ADVICE r3)
+                    if (slot.first.find_first_of("\x1E\x1F", 0) != std::string::npos ||
+                        slot.first.find('\0', 0) != std::string::npos ||
+                        slot.second.find_first_of("\x1E\x1F", 0) != std::string::npos ||
+                        slot.second.find('\0', 0) != std::string::npos)
                         P.fallback = true;
                     bool replaced = false;     // JSON duplicate key: last wins
                     for (size_t ti = 0; ti < rp.s.ntags; ti++)
@@ -1310,8 +1311,8 @@ inline bool finish_point(const RawPoint& rp, PutBatch& out) {
 
     // 5. canonical series key: metric + bytewise-sorted tags (index
     //    sort + scratch key buffer: no string copies on the hot path)
-    if (rp.s.metric.find('\x1E') != std::string::npos ||
-        rp.s.metric.find('\x1F') != std::string::npos)
+    if (rp.s.metric.find_first_of("\x1E\x1F", 0) != std::string::npos ||
+        rp.s.metric.find('\0', 0) != std::string::npos)
         return false;
     int32_t gid = assign_group(rp.s.metric, rp.s, out);
 
@@ -1565,8 +1566,8 @@ inline LineStatus telnet_line(const char* p, const char* q,
         size_t eq = w.find('=');
         if (eq == std::string::npos || eq == 0 || eq + 1 == w.size())
             return fail("invalid tag: " + w);
-        if (w.find('\x1E') != std::string::npos ||
-            w.find('\x1F') != std::string::npos)
+        if (w.find_first_of("\x1E\x1F", 0) != std::string::npos ||
+            w.find('\0', 0) != std::string::npos)
             return LINE_FALLBACK;
         if (rp.s.ntags >= 64) return LINE_FALLBACK;  // bounded dedupe
         if (rp.s.ntags == rp.s.tags.size()) rp.s.tags.emplace_back();
@@ -1614,8 +1615,8 @@ inline LineStatus telnet_line(const char* p, const char* q,
 
     // canonical key + columns (same as the JSON path's step 5)
     std::string metric(words[1], wlen[1]);
-    if (metric.find('\x1E') != std::string::npos ||
-        metric.find('\x1F') != std::string::npos)
+    if (metric.find_first_of("\x1E\x1F", 0) != std::string::npos ||
+        metric.find('\0', 0) != std::string::npos)
         return LINE_FALLBACK;
     int32_t gid = assign_group(metric, rp.s, out);
     int64_t ts_ms = (ts_i >= SECOND_MASK_LO) ? ts_i : ts_i * 1000;

@@ -162,6 +162,26 @@ class TSDB:
                                           "t": timestamp, "v": value,
                                           "g": dict(tags)})
 
+    def _validate_put_dp(self, dp: dict):
+        """Per-point /api/put validation, storage-free (no UID creation):
+        required fields, value parse + Java-long range, timestamp/tags.
+        Returns (metric, tags, is_int, num); raises the same error the
+        stored path would."""
+        for field in ("metric", "timestamp", "value", "tags"):
+            if field not in dp or dp[field] in (None, "", {}):
+                raise ValueError("Missing required field: %s" % field)
+        metric = dp["metric"]
+        tags = dict(dp["tags"])
+        is_int, num = parse_value(dp["value"])
+        if is_int and not (-(1 << 63) <= num < (1 << 63)):
+            # beyond Java long (the reference's parseLong rejects it per
+            # point); without this check the group's int64 column build
+            # would fail EVERY point of the series
+            raise ValueError("Invalid value, out of long range: %r"
+                             % dp["value"])
+        self.check_timestamp_and_tags(metric, dp["timestamp"], num, tags)
+        return metric, tags, is_int, num
+
     def add_points_bulk(self, dps: list[dict]
                         ) -> tuple[int, list[tuple[int, Exception]]]:
         """Vectorized bulk ingest for POST /api/put bodies.
@@ -178,11 +198,21 @@ class TSDB:
         import numpy as np
 
         if self.mode == "ro" and not self._replaying:
-            # per-point errors, like the per-point path raising from every
-            # add_point call — the RPC layer's accounting (hbase_errors,
-            # SEH spillway, 400 + summary) must see each rejected write
+            # Validation errors first, RO for the rest — matching the
+            # per-point path, where parsing reports before add_point hits
+            # the RO gate (ADVICE r3): error classes and the RPC layer's
+            # accounting (illegal_arguments vs hbase_errors, SEH spillway,
+            # 400 + summary) must not depend on the ingest path taken.
             exc = RuntimeError("TSD is in read-only mode, writes rejected")
-            return 0, [(i, exc) for i in range(len(dps))]
+            ro_errors: list[tuple[int, Exception]] = []
+            for i, dp in enumerate(dps):
+                try:
+                    self._validate_put_dp(dp)
+                except Exception as e:
+                    ro_errors.append((i, e))
+                else:
+                    ro_errors.append((i, exc))
+            return 0, ro_errors
         errors: list[tuple[int, Exception]] = []
         # key -> (ts_ms, float, exact-int, is_int, dp index, raw dp,
         #         publish args) column lists
@@ -191,21 +221,7 @@ class TSDB:
         success = 0
         for i, dp in enumerate(dps):
             try:
-                for field in ("metric", "timestamp", "value", "tags"):
-                    if field not in dp or dp[field] in (None, "", {}):
-                        raise ValueError("Missing required field: %s"
-                                         % field)
-                metric = dp["metric"]
-                tags = dict(dp["tags"])
-                is_int, num = parse_value(dp["value"])
-                if is_int and not (-(1 << 63) <= num < (1 << 63)):
-                    # beyond Java long (the reference's parseLong rejects
-                    # it per point); without this check the group's int64
-                    # column build would fail EVERY point of the series
-                    raise ValueError("Invalid value, out of long range: %r"
-                                     % dp["value"])
-                self.check_timestamp_and_tags(metric, dp["timestamp"], num,
-                                              tags)
+                metric, tags, is_int, num = self._validate_put_dp(dp)
                 if self.write_filter is not None and \
                         not self.write_filter.allow(metric, dp["timestamp"],
                                                     num, tags):
@@ -314,8 +330,16 @@ class TSDB:
         import numpy as np
 
         if self.mode == "ro" and not self._replaying:
+            # Per-point path parity: points whose parse already failed
+            # report their ValueError/TypeError (validation runs before
+            # the RO gate there); only parseable points get the RO error
+            # (ADVICE r3).
             exc = RuntimeError("TSD is in read-only mode, writes rejected")
-            return 0, [(i, exc) for i in range(parsed.n)]
+            ro_errors: dict[int, Exception] = {
+                i: ValueError(msg) if kind == "ValueError"
+                else TypeError(msg)
+                for i, kind, msg in parsed.errors}
+            return 0, [(i, ro_errors.get(i, exc)) for i in range(parsed.n)]
         errors: list[tuple[int, Exception]] = [
             (i, ValueError(msg) if kind == "ValueError" else TypeError(msg))
             for i, kind, msg in parsed.errors]
@@ -408,12 +432,15 @@ class TSDB:
 
     def _apply_point(self, metric: str, timestamp: int | float, value,
                      tags: dict[str, str]) -> None:
+        is_int, num = parse_value(value)
+        self.check_timestamp_and_tags(metric, timestamp, num, tags)
         if self.mode == "ro" and not self._replaying:
             # WAL replay must restore data even when the daemon was
             # restarted read-only; the gate applies to new writes only.
+            # Gate AFTER validation: every ingest path (per-point, bulk,
+            # native columnar) must classify a malformed point the same
+            # way regardless of mode (ADVICE r3).
             raise RuntimeError("TSD is in read-only mode, writes rejected")
-        is_int, num = parse_value(value)
-        self.check_timestamp_and_tags(metric, timestamp, num, tags)
         if self.write_filter is not None and not self.write_filter.allow(
                 metric, timestamp, num, tags):
             return
@@ -519,11 +546,12 @@ class TSDB:
 
     def _store_histogram(self, metric: str, timestamp: int | float, hist,
                          tags: dict[str, str]) -> None:
+        self.check_timestamp_and_tags(metric, timestamp, None, tags)
         if self.mode == "ro" and not self._replaying:
             # WAL replay must restore data even when the daemon was
             # restarted read-only; the gate applies to new writes only.
+            # Gate after validation, like _apply_point (ADVICE r3).
             raise RuntimeError("TSD is in read-only mode, writes rejected")
-        self.check_timestamp_and_tags(metric, timestamp, None, tags)
         if self.write_filter is not None:
             # WriteableDataPointFilterPlugin gate (TSDB.java:1301-1306,
             # allowHistogramPoint; filters without a histogram hook use the
@@ -579,10 +607,6 @@ class TSDB:
         if self.rollup_store is None:
             raise RuntimeError("Rollups are not enabled "
                                "(tsd.rollups.enable=false)")
-        if self.mode == "ro" and not self._replaying:
-            # WAL replay must restore data even when the daemon was
-            # restarted read-only; the gate applies to new writes only.
-            raise RuntimeError("TSD is in read-only mode, writes rejected")
         is_int, num = parse_value(value)
         if interval:
             # Raises NoSuchRollupForInterval for unconfigured intervals.
@@ -616,6 +640,11 @@ class TSDB:
                     % groupby_aggregator)
             tags[self.agg_tag_key] = groupby_aggregator.upper()
         self.check_timestamp_and_tags(metric, timestamp, num, tags)
+        if self.mode == "ro" and not self._replaying:
+            # WAL replay must restore data even when the daemon was
+            # restarted read-only; the gate applies to new writes only.
+            # Gate after validation, like _apply_point (ADVICE r3).
+            raise RuntimeError("TSD is in read-only mode, writes rejected")
         ts_ms = normalize_timestamp_ms(timestamp)
         key = self._series_key(metric, tags, create=True)
         lane_agg = (rollup_aggregator if interval else groupby_aggregator)

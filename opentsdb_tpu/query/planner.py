@@ -419,23 +419,6 @@ class QueryRunner:
                      and (ds_fn in STREAMABLE_DS or sketchable))
         self._bump("pointsScanned", total_points)
         self._bump("seriesScanned", len(gid))
-        # The materialized path has the streaming guard's hazard too:
-        # SPARSE series over a huge range with a fine interval build a
-        # [S, W] grid regardless of point count (a year at 10s windows is
-        # 3M+ columns).  Same knob, same 413 shape; ~3 grid lanes live
-        # through a dispatch (values, counts, mask/fill intermediates).
-        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
-        if state_mb > 0 and \
-                len(gid) * window_spec.count * 24 > state_mb * 2**20:
-            from opentsdb_tpu.query.limits import QueryException
-            raise QueryException(
-                "Sorry, this query's downsample grid (%d series x %d "
-                "windows) needs ~%dMB of accelerator memory, over the "
-                "%dMB limit (tsd.query.streaming.state_mb). Please use a "
-                "coarser downsample interval or decrease your time range."
-                % (len(gid), window_spec.count,
-                   len(gid) * window_spec.count * 24 // 2**20, state_mb))
-
         mesh = tsdb.query_mesh()
         use_mesh = (mesh is not None and len(gid) >= tsdb.config.get_int(
             "tsd.query.mesh.min_series"))
@@ -449,6 +432,45 @@ class QueryRunner:
         series_list = [s for _, members, _ in kept for s, _t in members]
         would_stream = (stream_ok and total_points > tsdb.config.get_int(
             "tsd.query.streaming.point_threshold"))
+
+        def check_grid_budget():
+            # The materialized path has the streaming guard's hazard too:
+            # SPARSE series over a huge range with a fine interval build a
+            # [S, W] grid regardless of point count (a year at 10s windows
+            # is 3M+ columns).  Same knob, same 413 shape; ~3 grid lanes
+            # live through a dispatch (values, counts, mask/fill
+            # intermediates).  Per-chip when the mesh serves the query —
+            # the streamed path has its own per-chip guard in
+            # _stream_grouped (ADVICE r3 medium) — but rollup_avg never
+            # shards and carries a second count-lane grid, so it is held
+            # to the flat single-chip estimate at double weight.
+            state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
+            if state_mb <= 0:
+                return
+            n_chips, lanes = 1, 1
+            if seg.kind == "rollup_avg":
+                lanes = 2
+            elif use_mesh:
+                from opentsdb_tpu.parallel.sharded import n_devices
+                n_chips = n_devices(mesh)
+            grid_bytes = len(gid) * window_spec.count * 24 * lanes \
+                // n_chips
+            if grid_bytes > state_mb * 2**20:
+                from opentsdb_tpu.query.limits import QueryException
+                raise QueryException(
+                    "Sorry, this query's downsample grid (%d series x %d "
+                    "windows) needs ~%dMB of accelerator memory per chip, "
+                    "over the %dMB limit (tsd.query.streaming.state_mb). "
+                    "Please use a coarser downsample interval or decrease "
+                    "your time range."
+                    % (len(gid), window_spec.count,
+                       grid_bytes // 2**20, state_mb))
+
+        if not would_stream:
+            # Destined to materialize: refuse BEFORE the device-cache
+            # lookup can trigger a cold inline [S, N] build (and evict
+            # warm entries) for a query that 413s anyway.
+            check_grid_budget()
         if (tsdb.device_cache is not None and store is not None
                 and seg.kind in ("raw", "rollup")):
             # Cold entries build inline only when the alternative is a full
@@ -463,6 +485,10 @@ class QueryRunner:
                 seg.start_ms, seg.end_ms, fix, build=not would_stream)
             if cached is not None:
                 self.exec_stats["deviceCacheHit"] = 1.0
+                if would_stream:
+                    # warm hit diverted a streaming query onto the
+                    # materialized path: it still builds the [S, W] grid
+                    check_grid_budget()
 
         if cached is None and would_stream:
             # Beyond the threshold the batch never materializes: bounded

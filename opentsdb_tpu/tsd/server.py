@@ -28,6 +28,28 @@ LOG = logging.getLogger("tsd.server")
 MAX_REQUEST_BYTES = 64 * 1024 * 1024   # HttpRequestDecoder aggregator cap
 MAX_TELNET_LINE = 1024 * 1024
 
+# Telnet put batching peeks at asyncio.StreamReader's buffered bytes to
+# decide whether another complete line can be consumed WITHOUT awaiting
+# more input.  There is no public API for this; `_buffer` (a bytearray)
+# has been the implementation since CPython 3.4.  The peek is isolated
+# here so a future rename degrades loudly (one warning, correct
+# unbatched behavior) instead of silently costing the 14x batching win.
+_warned_no_buffer = False
+
+
+def _has_buffered_line(reader: asyncio.StreamReader) -> bool:
+    """True when a complete line is already in the reader's buffer."""
+    buf = getattr(reader, "_buffer", None)
+    if buf is None:
+        global _warned_no_buffer
+        if not _warned_no_buffer:
+            _warned_no_buffer = True
+            LOG.warning(
+                "asyncio.StreamReader._buffer is gone in this CPython; "
+                "telnet put batching disabled (correct but slower)")
+        return False
+    return b"\n" in buf
+
 
 class ConnectionRefused(Exception):
     pass
@@ -213,8 +235,7 @@ class TSDServer:
                 # more input, so single-line latency is unchanged.
                 block = [data]
                 too_long = False
-                while (len(block) < 4096
-                       and b"\n" in getattr(reader, "_buffer", b"")):
+                while len(block) < 4096 and _has_buffered_line(reader):
                     try:
                         nxt = await reader.readline()
                     except ValueError:

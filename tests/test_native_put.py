@@ -181,6 +181,15 @@ FALLBACK_BODIES = [
     # 100 tags: beyond the bounded-dedupe cap (review r3 DoS guard)
     '{"metric":"m","timestamp":1356998400,"value":1,"tags":{%s}}'
     % ",".join('"t%03d":"v"' % i for i in range(100)),
+    # embedded NUL would truncate the c_char_p group-key return, silently
+    # storing under a chopped series name (ADVICE r3 high) — Python path
+    # owns these
+    '{"metric":"sys\\u0000cpu","timestamp":%d,"value":1,'
+    '"tags":{"h":"a"}}' % BASE,
+    '{"metric":"m","timestamp":%d,"value":1,'
+    '"tags":{"h\\u0000x":"a"}}' % BASE,
+    '{"metric":"m","timestamp":%d,"value":1,'
+    '"tags":{"h":"a\\u0000b"}}' % BASE,
 ]
 
 
@@ -249,6 +258,35 @@ class TestDifferential:
         assert native[0] == py[0] == 0
         assert len(native[1]) == len(py[1]) == 1
         assert st_n == st_p == {}
+
+    def test_readonly_mode_mixed_validity(self):
+        # Points whose parse fails report their ValueError even in RO
+        # mode (the per-point path validates before the RO gate); only
+        # the parseable point gets the RO error — on BOTH paths
+        # (ADVICE r3).
+        body = ('[{"metric":"m","timestamp":%d,"value":"bad",'
+                '"tags":{"h":"a"}},'
+                '{"metric":"m","timestamp":%d,"value":1,"tags":{"h":"a"}},'
+                '{"metric":"m","timestamp":%d,"value":2,"tags":{}}]'
+                % (BASE, BASE + 1, BASE + 2))
+        native, py, st_n, st_p = run_both(body, **{"tsd.mode": "ro"})
+        assert native[0] == py[0] == 0
+        n_cls = [(i, type(e).__name__, str(e)) for i, e in native[1]]
+        p_cls = [(i, type(e).__name__, str(e)) for i, e in py[1]]
+        assert n_cls == p_cls
+        assert [c for _, c, _ in n_cls] \
+            == ["ValueError", "RuntimeError", "ValueError"]
+        assert st_n == st_p == {}
+
+    def test_readonly_gate_after_validation_per_point(self):
+        # The per-point path must classify a malformed point the same way
+        # the bulk paths do, RO mode or not: validation errors beat the
+        # RO RuntimeError (review r4).
+        tsdb = make_tsdb(**{"tsd.mode": "ro"})
+        with pytest.raises(ValueError):
+            tsdb.add_point("m", BASE, "notanumber", {"h": "a"})
+        with pytest.raises(RuntimeError, match="read-only"):
+            tsdb.add_point("m", BASE, 1, {"h": "a"})
 
     def test_spans_recover_original_datapoints(self):
         body = ('[ {"metric":"m","timestamp":%d,"value":"bad",'
@@ -358,6 +396,12 @@ class TestTelnetBatch:
         # TAG error (parse_tags runs before parse_value; review r3)
         ["put t.m %d notanumber bad-tag" % BASE,
          "put t.m notats bad1 alsobad"],
+        # raw NUL bytes must not truncate the series name via the C
+        # group-key return (ADVICE r3 high): per-line python fallback
+        ["put sys\x00cpu %d 1 h=a" % BASE,
+         "put t.m %d 1 h\x00x=a" % (BASE + 1),
+         "put t.m %d 1 h=a\x00b" % (BASE + 2),
+         "put t.m %d 1 h=a" % (BASE + 3)],
     ]
 
     @pytest.mark.parametrize("case", range(len(CASES)))

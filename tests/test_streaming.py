@@ -584,3 +584,44 @@ class TestStateBudget:
         q.validate()
         with pytest.raises(QueryException, match="downsample grid"):
             tsdb.new_query_runner().run(q)
+
+    def test_materialized_grid_guard_divides_by_mesh(self):
+        """The materialized-path grid guard is per-chip: the same query
+        that 413s flat must be admitted when the 8-device mesh serves it
+        (ADVICE r3 medium — the flat estimate made the per-chip streaming
+        allowance unreachable)."""
+        import pytest
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.query.limits import QueryException
+        from opentsdb_tpu.utils.config import Config
+
+        base = 1_356_998_400
+        span = 1_500_000      # 150k windows at 10s
+
+        def mk(mesh):
+            t = TSDB(Config({
+                "tsd.core.auto_create_metrics": True,
+                "tsd.query.device_cache.enable": "false",
+                "tsd.query.mesh.enable": mesh,
+                "tsd.query.mesh.min_series": 0,
+                "tsd.query.streaming.state_mb": "8",
+            }))
+            for h in range(8):
+                for i in range(50):
+                    t.add_point("mg.m", base + i * (span // 50) + h,
+                                float(i), {"h": "h%d" % h})
+            return t
+
+        def q(t):
+            tq = TSQuery(start=str(base), end=str(base + span),
+                         queries=[parse_m_subquery("sum:10s-avg:mg.m")])
+            tq.validate()
+            return t.new_query_runner().run(tq)
+
+        # flat: 8 series x ~150k windows x 24B ~ 28MB > 8MB -> refuse
+        with pytest.raises(QueryException, match="downsample grid"):
+            q(mk(mesh=False))
+        # mesh: ~3.6MB/chip across 8 devices -> admitted
+        res = q(mk(mesh=True))
+        assert res and res[0].dps

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every 5 min; append status to /tmp/tpu_watch.log
+while true; do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 300 python -c "
+import jax
+ds = jax.devices()
+import jax.numpy as jnp
+(jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()
+print('ALIVE', ds)
+" 2>&1 | tail -2)
+  echo "$ts $out" >> /tmp/tpu_watch.log
+  if echo "$out" | grep -q ALIVE; then
+    echo "$ts TPU IS BACK" >> /tmp/tpu_watch.log
+  fi
+  sleep 240
+done
