@@ -62,8 +62,8 @@ class DownsamplingSpecification:
             interval_ms = DT.parse_duration(raw_interval)
 
         function = parts[1]
-        from opentsdb_tpu.ops.aggregators import AGGREGATORS
-        if function not in AGGREGATORS:
+        from opentsdb_tpu.ops.aggregators import is_valid_agg
+        if not is_valid_agg(function):
             raise ValueError("No such downsampling function: " + function)
         if function == "none":
             raise ValueError("cannot use the NONE aggregator for downsampling")
@@ -112,8 +112,8 @@ class TSSubQuery:
     def validate(self) -> None:
         if not self.aggregator:
             raise ValueError("Missing the aggregation function")
-        from opentsdb_tpu.ops.aggregators import AGGREGATORS
-        if self.aggregator not in AGGREGATORS:
+        from opentsdb_tpu.ops.aggregators import is_valid_agg
+        if not is_valid_agg(self.aggregator):
             raise ValueError("No such aggregator: " + self.aggregator)
         if not self.metric and not self.tsuids:
             raise ValueError(

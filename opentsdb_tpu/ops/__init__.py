@@ -20,6 +20,14 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+# Keep the host CPU platform registered next to a restricted accelerator
+# platform (JAX_PLATFORMS=tpu/axon): the small-query fast lane places
+# sub-threshold dispatches on the host, dodging the accelerator dispatch
+# floor.  Must happen before the first backend initialization.
+from opentsdb_tpu.ops.hostlane import ensure_cpu_platform  # noqa: E402
+
+ensure_cpu_platform()
+
 from opentsdb_tpu.ops import aggregators  # noqa: E402
 from opentsdb_tpu.ops.aggregators import AGGREGATORS, get_agg, agg_names  # noqa: E402
 

@@ -173,6 +173,96 @@ def _none_agg(values, mask):
     return _first_ordered(values, mask)
 
 
+def java_moving_average(sums, live, n_window: int, int_mode: bool = False):
+    """The MovingAverage evaluation loop, vectorized over the last axis.
+
+    Reference semantics (Aggregators.MovingAverage:709-760): each
+    evaluated timestamp pushes its cross-series sum, then the result is
+    the average of the PRECEDING `n_window` sums — exclusive of the
+    current one, 0 until that window has filled, Java long division in
+    the integer lane.  `sums[..., T]` are per-evaluation totals and
+    `live[..., T]` marks which slots are real evaluations (grid windows
+    with data / unique union timestamps); dead slots neither produce nor
+    consume window state, exactly like timestamps the iterator never
+    visits.
+    """
+    shape = sums.shape
+    t = shape[-1]
+    s2 = sums.reshape(-1, t)
+    l2 = live.reshape(-1, t)
+    r = s2.shape[0]
+    kk = jnp.cumsum(l2.astype(jnp.int64), axis=1)     # live count through t
+    zero = jnp.asarray(0, s2.dtype)
+    contrib = jnp.where(l2, s2, zero)
+    d = jnp.cumsum(contrib, axis=1)
+    # dm[row, m] = sum of the first m live contributions.  Every column t
+    # with the same kk value carries the same d value (d only moves at
+    # live columns), so an unconditional scatter is exact.
+    rows = jnp.arange(r)[:, None]
+    dm = jnp.zeros((r, t + 1), d.dtype).at[rows, kk].set(d)
+    prev = kk - 1                     # live evaluations before column t
+    hi = jnp.take_along_axis(dm, jnp.clip(prev, 0, t), axis=1)
+    lo = jnp.take_along_axis(dm, jnp.clip(prev - n_window, 0, t), axis=1)
+    wsum = hi - lo
+    if int_mode and not jnp.issubdtype(s2.dtype, jnp.floating):
+        out = lax.div(wsum, jnp.asarray(n_window, wsum.dtype))
+    else:
+        out = wsum.astype(jnp.float64) / n_window
+    filled = l2 & (prev >= n_window)  # conditionMet: window fully behind us
+    out = jnp.where(filled, out, jnp.asarray(0, out.dtype))
+    return out.reshape(shape[:-1] + (t,))
+
+
+def moving_average_columns(contrib, participate, live, n_window: int,
+                           int_mode: bool = False):
+    """Cross-series sum per column, then the Java window loop.
+
+    `live[T]` is the caller's evaluation mask (duplicate union slots
+    participate in interpolation but are NOT separate evaluations, so the
+    per-column participation cannot stand in for it)."""
+    ok = participate & ~jnp.isnan(contrib.astype(jnp.float64))
+    zero = jnp.asarray(0, contrib.dtype)
+    sums = jnp.where(ok, contrib, zero).sum(axis=0)
+    out = java_moving_average(sums, live, n_window, int_mode)
+    if jnp.issubdtype(out.dtype, jnp.floating):
+        return jnp.where(live, out, jnp.nan)
+    return out
+
+
+DEFAULT_MA_WINDOW = 5
+
+
+def ma_window(name: str) -> int | None:
+    """`movingAverage` family parse: bare name (DEFAULT_MA_WINDOW points)
+    or `movingAverage<N>` for a trailing window of N points.  Returns the
+    window size, or None when `name` is not a moving average.
+
+    The reference only instantiates MovingAverage through the expression
+    layer (ExpressionFactory "movingAverage"; absent from the static
+    registry, Aggregators.java:175-203) — registering it here makes the
+    same windowed form addressable from `m=` and downsample positions,
+    with time-unit windows remaining gexp-only (the reduce signature has
+    no timestamps).
+    """
+    if not name.startswith("movingAverage"):
+        return None
+    suffix = name[len("movingAverage"):]
+    if suffix == "":
+        return DEFAULT_MA_WINDOW
+    if suffix.isdigit() and int(suffix) > 0:
+        return int(suffix)
+    return None
+
+
+def _moving_average_reduce(values, mask, n_window: int):
+    # Direct registry form: every column with a participant counts as an
+    # evaluation.  The union/grid paths call moving_average_columns with
+    # their own live mask instead (duplicate-slot correctness).
+    live = _valid(values, mask).any(axis=0)
+    int_mode = not jnp.issubdtype(values.dtype, jnp.floating)
+    return moving_average_columns(values, mask, live, n_window, int_mode)
+
+
 def _percentile_agg(values, mask, q, estimation):
     ok = _valid(values, mask)
     out = masked_percentile(values.astype(jnp.float64), ok, q, estimation,
@@ -219,6 +309,11 @@ def _make_registry() -> dict[str, Aggregator]:
         "first": Aggregator("first", ZIM, _first_ordered),
         "last": Aggregator("last", ZIM, _last_ordered),
         "squareSum": Aggregator("squareSum", ZIM, _squaresum),
+        # LERP like the expression layer's instantiation
+        # (ExpressionFactory.java movingAverage)
+        "movingAverage": Aggregator(
+            "movingAverage", LERP,
+            partial(_moving_average_reduce, n_window=DEFAULT_MA_WINDOW)),
     }
     percentiles = [99.9, 99.0, 95.0, 90.0, 75.0, 50.0]
     names = ["999", "99", "95", "90", "75", "50"]
@@ -234,12 +329,32 @@ def _make_registry() -> dict[str, Aggregator]:
 
 AGGREGATORS: dict[str, Aggregator] = _make_registry()
 
+# Dynamically-constructed movingAverage<N> aggregators, cached apart from
+# the static registry so /api/aggregators keeps a stable listing.  The
+# cache is bounded: query strings are untrusted, and each distinct N also
+# seeds fresh jit traces downstream — beyond the cap new windows still
+# work, they just construct per call (review r4).
+_DYNAMIC: dict[str, Aggregator] = {}
+_DYNAMIC_MAX = 128
+
 
 def get_agg(name: str) -> Aggregator:
-    agg = AGGREGATORS.get(name)
+    agg = AGGREGATORS.get(name) or _DYNAMIC.get(name)
     if agg is None:
-        raise KeyError("No such aggregator: " + name)
+        n = ma_window(name)
+        if n is not None:
+            agg = Aggregator(name, LERP,
+                             partial(_moving_average_reduce, n_window=n))
+            if len(_DYNAMIC) < _DYNAMIC_MAX:
+                _DYNAMIC[name] = agg
+        else:
+            raise KeyError("No such aggregator: " + name)
     return agg
+
+
+def is_valid_agg(name: str) -> bool:
+    """Registry membership including the movingAverage<N> family."""
+    return name in AGGREGATORS or ma_window(name) is not None
 
 
 def agg_names() -> list[str]:

@@ -511,6 +511,25 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
     scatter — the hot loop the reference walked per interval,
     Downsampler.java:292); the rest reduce via segment ops.
     """
+    from opentsdb_tpu.ops.aggregators import java_moving_average, ma_window
+    nw = ma_window(agg_name)
+    if nw is not None:
+        # Downsample-position movingAverage<N>: the reference Downsampler
+        # would feed each window's values into the aggregator, whose
+        # run{Long,Double} sums them and averages the PRECEDING N window
+        # sums (Aggregators.MovingAverage:709) — so: window sums, then
+        # the same Java loop across this series' data-bearing windows.
+        wts, sums, sum_mask = downsample(ts, val, mask, "sum", spec, wargs,
+                                         FILL_NONE, 0.0)
+        out = java_moving_average(sums, sum_mask, nw)
+        w = spec.count
+        live = jnp.arange(w, dtype=jnp.int32)[None, :] < wargs["nwin"]
+        fdtype = val.dtype if jnp.issubdtype(val.dtype, jnp.floating) \
+            else jnp.float64
+        out, out_mask = apply_fill(out.astype(fdtype), sum_mask, live,
+                                   fill_policy, fill_value, fdtype)
+        return wts, out, out_mask
+
     if agg_name in PREFIX_AGGS or (
             agg_name in EXTREME_AGGS and _EXTREME_MODE == "scan"):
         w = spec.count

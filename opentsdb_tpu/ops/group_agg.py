@@ -43,6 +43,14 @@ MOMENT_AGGS = frozenset({
     "mimmax", "dev", "squareSum"})
 
 
+def is_moment_agg(name: str) -> bool:
+    """movingAverage<N> included: its cross-series step is a plain sum
+    (psum-combinable); the temporal window pass runs on the already
+    combined [G, W] grid."""
+    from opentsdb_tpu.ops.aggregators import ma_window
+    return name in MOMENT_AGGS or ma_window(name) is not None
+
+
 def _identity(x):
     return x
 
@@ -138,7 +146,17 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
                         jnp.sqrt(m2.reshape(g, w)
                                  / jnp.maximum(cnt_grid - 1, 1)), 0.0)
     else:
-        raise KeyError("Aggregator %r is not moment-decomposable" % agg_name)
+        from opentsdb_tpu.ops.aggregators import java_moving_average, \
+            ma_window
+        nw = ma_window(agg_name)
+        if nw is None:
+            raise KeyError("Aggregator %r is not moment-decomposable"
+                           % agg_name)
+        # Cross-series sum combines across chips; the Java window pass
+        # then runs on the replicated [G, W] grid (live = windows with
+        # data, matching the evaluation order the iterator would visit).
+        tot = combine_sum(jax.ops.segment_sum(v, seg, num_segments=num))
+        out = java_moving_average(tot.reshape(g, w), cnt_grid > 0, nw)
 
     if agg_name != "count":
         out = jnp.where(cnt_grid > 0, out, jnp.nan)
@@ -217,7 +235,7 @@ def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
     """
     vf = val.astype(jnp.float64)
     contrib, participate = grid_contributions(grid_ts, vf, mask, agg)
-    if agg.name in MOMENT_AGGS:
+    if is_moment_agg(agg.name):
         out, _ = moment_group_reduce(agg.name, contrib, participate, gid,
                                      num_groups)
     else:

@@ -172,6 +172,39 @@ def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False,
     if tile_cells <= 0:
         tile_cells = _UNION_TILE_CELLS
     tile = max(tile_cells // max(s, 1), 1)
+
+    from opentsdb_tpu.ops.aggregators import (java_moving_average,
+                                              ma_window)
+    nw = ma_window(agg.name)
+    if nw is not None:
+        # The temporal window state crosses union slots, but the
+        # cross-series SUM per slot is column-independent — so the sums
+        # tile under the same memory envelope as every other aggregator,
+        # and the (cheap, [U]-shaped) Java window pass runs once on the
+        # concatenated sums.  Duplicate slots participate in
+        # interpolation but are NOT evaluations: live is u_mask, not
+        # per-column participation (review r4).
+        def tile_sums(u_chunk):
+            contrib, participate = contribs(u_chunk)
+            ok = participate & ~jnp.isnan(contrib.astype(jnp.float64))
+            zero = jnp.asarray(0, contrib.dtype)
+            return jnp.where(ok, contrib, zero).sum(axis=0)
+
+        if total <= tile:
+            sums = tile_sums(u)
+        else:
+            n_tiles = -(-total // tile)
+            pad = n_tiles * tile - total
+            u_padded = jnp.concatenate(
+                [u, jnp.full((pad,), _PAD, u.dtype)]) if pad else u
+            sums = lax.map(tile_sums,
+                           u_padded.reshape(n_tiles, tile)).reshape(-1)
+            sums = sums[:total]
+        out = java_moving_average(sums, u_mask, nw, int_mode)
+        if jnp.issubdtype(out.dtype, jnp.floating):
+            out = jnp.where(u_mask, out, jnp.nan)
+        return u, out, u_mask
+
     if total <= tile:
         contrib, participate = contribs(u)
         return u, agg.reduce(contrib, participate), u_mask
@@ -229,5 +262,13 @@ def grid_aggregate(grid_ts, val, mask, agg: Aggregator, int_mode: bool = False):
     interp = interpolate(agg.interpolation, int_mode, x, x0, y0, x1, y1,
                          work_val)
     contrib = jnp.where(mask, work_val, interp)
-    out = agg.reduce(contrib, in_range)
+    from opentsdb_tpu.ops.aggregators import (ma_window,
+                                              moving_average_columns)
+    nw = ma_window(agg.name)
+    if nw is not None:
+        # grid slots with no data anywhere are never evaluated
+        out = moving_average_columns(contrib, in_range, any_mask, nw,
+                                     int_mode)
+    else:
+        out = agg.reduce(contrib, in_range)
     return grid_ts, out, any_mask

@@ -219,7 +219,7 @@ def _local_grid_tail(spec, num_groups: int, wts, v, m, gid):
     """
     from opentsdb_tpu.ops.aggregators import Aggregator, get_agg, PREV
     from opentsdb_tpu.ops.group_agg import (
-        MOMENT_AGGS, grid_contributions, moment_group_reduce,
+        grid_contributions, is_moment_agg, moment_group_reduce,
         ordered_group_reduce)
     from opentsdb_tpu.ops.rate import rate
 
@@ -233,7 +233,7 @@ def _local_grid_tail(spec, num_groups: int, wts, v, m, gid):
         _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
     vf = v.astype(jnp.float64)
     contrib, participate = grid_contributions(grid, vf, m, agg)
-    if agg.name in MOMENT_AGGS:
+    if is_moment_agg(agg.name):
         out, _ = moment_group_reduce(
             agg.name, contrib, participate, gid, g,
             combine_sum=lambda x: lax.psum(x, _BOTH),

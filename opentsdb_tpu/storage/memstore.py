@@ -280,6 +280,17 @@ class Series:
                                                 fix_duplicates)
             return hi - lo
 
+    def window_stride_timestamps(self, start_ms: int, end_ms: int,
+                                 stride: int, fix_duplicates: bool = True
+                                 ) -> np.ndarray:
+        """Every stride-th timestamp in [start_ms, end_ms] — the streaming
+        chunk-boundary positions, used by the planner's sketch-hazard
+        estimate (O(points/stride), never materializes the window)."""
+        with self._lock:
+            lo, hi = self._window_bounds_locked(start_ms, end_ms,
+                                                fix_duplicates)
+            return self._ts[lo:hi:max(stride, 1)].copy()
+
     def window_chunk(self, start_ms: int, end_ms: int,
                      after_ts: int | None, limit: int,
                      fix_duplicates: bool = True

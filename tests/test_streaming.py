@@ -347,6 +347,94 @@ class TestSketchPercentiles:
                 assert abs(gv - wv) <= 0.08 * max(abs(wv), 1.0), \
                     (ts_key, gv, wv)
 
+    def test_hazard_shape_auto_routes_exact(self):
+        """VERDICT r3 #7: window span >> chunk span (the '0all over a huge
+        range' shape) must NOT silently drift — the planner detects that a
+        cell would absorb more than sketch_max_merges chunk merges and
+        serves the exact materialized answer instead."""
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.utils.config import Config
+
+        base = 1_356_998_400
+        n_pts = 6000
+        data = np.random.default_rng(77).normal(40, 12, n_pts)
+
+        def mk(**extra):
+            cfg = {"tsd.core.auto_create_metrics": True,
+                   "tsd.query.streaming.point_threshold": "10",
+                   "tsd.query.streaming.chunk_points": "512",
+                   "tsd.query.device_cache.enable": "false",
+                   "tsd.query.mesh.enable": False}
+            cfg.update(extra)
+            t = TSDB(Config(cfg))
+            for k in range(n_pts):
+                t.add_point("hz.m", base + k, float(data[k]), {"h": "a"})
+            return t
+
+        def run(t):
+            # one giant window over everything: every chunk merges into
+            # the same cell (n_chunk=1024 -> ~6 merges > the default 4)
+            q = TSQuery(start=str(base - 1), end=str(base + n_pts + 1),
+                        queries=[parse_m_subquery("sum:0all-p50:hz.m")])
+            q.validate()
+            runner = t.new_query_runner()
+            res = [r.to_json() for r in runner.run(q)]
+            return res, runner.exec_stats
+
+        exact_t = mk(**{"tsd.query.streaming.point_threshold": "1000000000",
+                        "tsd.query.streaming.sketch_percentiles": "false"})
+        protected, stats = run(mk())
+        exact, _ = run(exact_t)
+        assert stats.get("sketchHazardExact") == 1.0
+        assert protected[0]["dps"] == exact[0]["dps"]  # bit-exact, no drift
+
+        # opt-out (max_merges=0) keeps the old sketched behavior, whose
+        # rank error on this worst-case shape stays within the documented
+        # C/(2K) bound
+        sketched, st2 = run(mk(**{
+            "tsd.query.streaming.sketch_max_merges": "0"}))
+        assert "sketchHazardExact" not in st2
+        got = list(sketched[0]["dps"].values())[0]
+        vals = np.sort(data)
+        rank = np.searchsorted(vals, got) / n_pts
+        c_merges = -(-n_pts // 1024)
+        assert abs(rank - 0.5) <= c_merges / (2 * 64) + 1 / 64, \
+            (got, rank, c_merges)
+
+    def test_hazard_estimate_is_skew_exact(self):
+        """Points concentrated in ONE window of a wide fine-grained range
+        (review r4): a per-series AVERAGE estimate sees ~1 merge/cell and
+        keeps the sketch; the boundary-multiplicity estimate sees the
+        real ~12 merges and routes exact."""
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.models import TSQuery, parse_m_subquery
+        from opentsdb_tpu.utils.config import Config
+
+        base = 1_356_998_400
+        t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                         "tsd.query.streaming.point_threshold": "10",
+                         "tsd.query.streaming.chunk_points": "512",
+                         "tsd.query.device_cache.enable": "false",
+                         "tsd.query.mesh.enable": False}))
+        rng = np.random.default_rng(13)
+        # 12k points inside one minute...
+        for k in range(12_000):
+            t.add_point("sk2.m", base * 1000 + k * 5, float(rng.normal()),
+                        {"h": "a"})
+        # ...then a sprinkle across a further week of 60s windows
+        week = 7 * 86_400
+        for k in range(200):
+            t.add_point("sk2.m", base + 120 + k * (week // 200),
+                        float(rng.normal()), {"h": "a"})
+        q = TSQuery(start=str(base - 1), end=str(base + week),
+                    queries=[parse_m_subquery("sum:60s-p90:sk2.m")])
+        q.validate()
+        runner = t.new_query_runner()
+        res = runner.run(q)
+        assert runner.exec_stats.get("sketchHazardExact") == 1.0
+        assert res and res[0].dps
+
     def test_sharded_sketch_matches_single_device(self):
         import jax.numpy as jnp
         from opentsdb_tpu.ops.downsample import FixedWindows

@@ -160,7 +160,10 @@ class TestRegistryParity:
 
     def test_registry_matches_reference(self):
         from opentsdb_tpu.ops.aggregators import agg_names
-        assert set(agg_names()) == self.REFERENCE_SET
+        # movingAverage is a deliberate extension (VERDICT r3 #8): the
+        # reference keeps it expression-layer-only; we also register the
+        # windowed form for m=/downsample positions (test_moving_average).
+        assert set(agg_names()) - {"movingAverage"} == self.REFERENCE_SET
 
 
 class TestTiledUnion:
