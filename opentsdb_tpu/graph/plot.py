@@ -49,8 +49,13 @@ class Plot:
     # -- scales --
 
     def _y_domain(self) -> tuple[float, float]:
+        # gnuplot range semantics: either end may be None (open, "[0:]")
+        # and is then computed from the data (GraphHandler.java yrange)
+        fix_lo = fix_hi = None
         if self.yrange is not None:
-            return self.yrange
+            fix_lo, fix_hi = self.yrange
+            if fix_lo is not None and fix_hi is not None:
+                return fix_lo, fix_hi
         lo, hi = math.inf, -math.inf
         for s in self.series:
             for _, v in s.points:
@@ -58,12 +63,20 @@ class Plot:
                     lo = min(lo, v)
                     hi = max(hi, v)
         if lo is math.inf:
-            return 0.0, 1.0
-        if lo == hi:
+            lo, hi = 0.0, 1.0
+        elif lo == hi:
             pad = abs(lo) * 0.1 or 1.0
-            return lo - pad, hi + pad
-        pad = (hi - lo) * 0.05
-        return lo - pad, hi + pad
+            lo, hi = lo - pad, hi + pad
+        else:
+            pad = (hi - lo) * 0.05
+            lo, hi = lo - pad, hi + pad
+        if fix_lo is not None:
+            lo = fix_lo
+        if fix_hi is not None:
+            hi = fix_hi
+        if lo >= hi:            # fixed end collapsed the range
+            hi = lo + (abs(lo) * 0.1 or 1.0)
+        return lo, hi
 
     def _x_px(self, ts: int) -> float:
         span = max(self.end_time - self.start_time, 1)

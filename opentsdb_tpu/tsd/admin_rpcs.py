@@ -23,6 +23,7 @@ from opentsdb_tpu.stats import StatsCollector
 from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
 from opentsdb_tpu.tsd.rpcs import HttpRpc, TelnetRpc, allowed_methods
 from opentsdb_tpu.tsd.serializers import SERIALIZERS
+from opentsdb_tpu.tsd.ui import UI_PAGE as _HOME_PAGE
 
 
 class VersionRpc(TelnetRpc, HttpRpc):
@@ -239,66 +240,6 @@ class LogsRpc(HttpRpc):
                              content_type="text/plain")
 
 
-_HOME_PAGE = """<!DOCTYPE html>
-<html><head><title>OpenTSDB-TPU</title>
-<style>
-body{font-family:sans-serif;margin:16px;color:#222}
-h1{font-size:20px} .row{margin:6px 0}
-input,select{padding:4px;font-size:13px}
-#metric{width:320px} #graph{margin-top:12px;border:1px solid #ccc;
-min-height:100px} a{color:#06c} .links{font-size:12px;margin-top:20px}
-#sugg{position:absolute;background:#fff;border:1px solid #aaa;
-list-style:none;margin:0;padding:0;max-height:200px;overflow:auto}
-#sugg li{padding:2px 8px;cursor:pointer} #sugg li:hover{background:#def}
-</style></head><body>
-<h1>OpenTSDB-TPU</h1>
-<div class=row>
- From <input id=start value="1h-ago" size=12>
- To <input id=end value="" size=12 placeholder="now">
- Aggregator <select id=agg><option>sum<option>avg<option>min<option>max
-  <option>count<option>dev<option>p99</select>
- Downsample <input id=ds size=9 placeholder="1m-avg">
- <label><input type=checkbox id=rate>Rate</label>
-</div>
-<div class=row>
- Metric <input id=metric placeholder="metric{tag=value}" autocomplete=off>
- <button onclick="draw()">Graph</button>
- <ul id=sugg hidden></ul>
-</div>
-<div id=graph></div>
-<div class=links>
- <a href="/api/version">version</a> | <a href="/api/aggregators">aggregators</a>
- | <a href="/api/stats">stats</a> | <a href="/api/config">config</a>
- | <a href="/logs?json">logs</a></div>
-<noscript>You must have JavaScript enabled.</noscript>
-<script>
-var metric=document.getElementById('metric'),sugg=document.getElementById('sugg');
-metric.addEventListener('input',function(){
-  var q=metric.value.split('{')[0];
-  if(!q){sugg.hidden=true;return}
-  fetch('/api/suggest?type=metrics&q='+encodeURIComponent(q)+'&max=10')
-    .then(function(r){return r.json()}).then(function(names){
-      sugg.innerHTML='';
-      names.forEach(function(n){var li=document.createElement('li');
-        li.textContent=n;li.onclick=function(){metric.value=n;sugg.hidden=true};
-        sugg.appendChild(li)});
-      sugg.hidden=names.length===0});
-});
-function draw(){
-  var m=document.getElementById('agg').value;
-  var ds=document.getElementById('ds').value;
-  if(ds)m+=':'+ds;
-  if(document.getElementById('rate').checked)m+=':rate';
-  m+=':'+metric.value;
-  var url='/q?start='+encodeURIComponent(document.getElementById('start').value)
-    +'&m='+encodeURIComponent(m)+'&wxh=900x420&nocache';
-  var end=document.getElementById('end').value;
-  if(end)url+='&end='+encodeURIComponent(end);
-  fetch(url).then(function(r){return r.text()}).then(function(body){
-    document.getElementById('graph').innerHTML=body});
-}
-</script></body></html>
-"""
 
 
 class HomePage(HttpRpc):

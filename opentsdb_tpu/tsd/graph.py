@@ -90,10 +90,16 @@ class GraphHandler:
         if yrange:
             try:
                 lo, hi = yrange.strip("[]").split(":")
-                plot.yrange = (float(lo), float(hi))
+                # either end may be open ("[0:]" / "[:100]"), gnuplot
+                # style (GraphHandler.java yrange; review r4)
+                plot.yrange = (float(lo) if lo.strip() else None,
+                               float(hi) if hi.strip() else None)
             except ValueError:
                 raise BadRequestError("Invalid yrange parameter: " + yrange)
-            if plot.yrange[0] >= plot.yrange[1]:
+            if plot.yrange == (None, None):
+                plot.yrange = None
+            elif (plot.yrange[0] is not None and plot.yrange[1] is not None
+                    and plot.yrange[0] >= plot.yrange[1]):
                 raise BadRequestError(
                     "Invalid yrange parameter: low must be below high")
         for r in results:

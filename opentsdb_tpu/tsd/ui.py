@@ -1,0 +1,356 @@
+"""The built-in interactive query UI (VERDICT r3 #6).
+
+Replaces the reference's GWT client (/root/reference/src/tsd/client/
+QueryUi.java + 7 files, 3,068 LoC) with one dependency-free page served
+at `/`: multiple metric sub-queries with per-metric aggregator /
+downsample / rate controls, tag filter rows with metric/tagk/tagv
+autocomplete driven by /api/suggest, date range with relative presets,
+graph options (size, log axis, y-range, labels), autoreload, and
+permalinks via the location hash — the same capability set QueryUi's
+MetricForm/DateTimeBox/graph tabs provided, drawing from the /q SVG
+endpoint instead of gnuplot PNGs.
+"""
+
+UI_PAGE = r"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>OpenTSDB-TPU</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;color:#1a1a2e;background:#fafafa}
+ header{background:#16213e;color:#fff;padding:10px 18px;display:flex;
+        align-items:baseline;gap:16px}
+ header h1{font-size:18px;margin:0} header span{font-size:12px;opacity:.7}
+ main{padding:14px 18px}
+ fieldset{border:1px solid #ccd;border-radius:6px;margin:0 0 10px;
+          background:#fff;padding:8px 12px}
+ legend{font-size:12px;font-weight:600;color:#456;padding:0 6px}
+ label{font-size:12.5px;margin-right:10px;white-space:nowrap}
+ input,select,button{padding:4px 6px;font-size:13px;border:1px solid #bbc;
+   border-radius:4px;background:#fff}
+ button{cursor:pointer;background:#e8ecf4} button:hover{background:#dde4f0}
+ button.primary{background:#2748a0;color:#fff;border-color:#2748a0}
+ button.primary:hover{background:#34569f}
+ .mrow{border-top:1px dashed #dde;margin-top:8px;padding-top:8px;
+       position:relative}
+ .mrow:first-of-type{border-top:none;margin-top:0;padding-top:0}
+ .tagrow{margin:4px 0 0 18px}
+ .del{color:#a33;border-color:#caa}
+ #graphbox{background:#fff;border:1px solid #ccd;border-radius:6px;
+           margin-top:10px;min-height:80px;padding:6px;overflow:auto}
+ #err{color:#a00;white-space:pre-wrap;font-size:13px;margin:8px 0;
+      display:none}
+ .sugg{position:absolute;background:#fff;border:1px solid #99b;z-index:9;
+   list-style:none;margin:0;padding:0;max-height:220px;overflow:auto;
+   box-shadow:0 2px 8px rgba(0,0,0,.15)}
+ .sugg li{padding:3px 10px;cursor:pointer;font-size:13px}
+ .sugg li.sel,.sugg li:hover{background:#dbe6ff}
+ .links{font-size:12px;margin-top:14px;color:#567}
+ .links a{color:#2748a0}
+ .small{font-size:11.5px;color:#678}
+</style></head><body>
+<header><h1>OpenTSDB-TPU</h1><span>time series database on TPU</span>
+</header>
+<main>
+<fieldset><legend>Time range</legend>
+ <label>From <input id=start value="1h-ago" size=16
+   title="relative (1h-ago, 2d-ago) or absolute (2013/01/01-12:00:00)"></label>
+ <label>To <input id=end size=16 placeholder="now"></label>
+ <span class=small>presets:</span>
+ <button type=button onclick="preset('5m')">5m</button>
+ <button type=button onclick="preset('1h')">1h</button>
+ <button type=button onclick="preset('6h')">6h</button>
+ <button type=button onclick="preset('1d')">1d</button>
+ <button type=button onclick="preset('1w')">1w</button>
+ <button type=button onclick="preset('30d')">30d</button>
+ <label style="margin-left:14px"><input type=checkbox id=autoreload>
+   autoreload every <input id=reloadsecs value=15 size=3> s</label>
+</fieldset>
+<fieldset id=metrics><legend>Metrics</legend></fieldset>
+<div>
+ <button type=button onclick="addMetric()">+ Add metric</button>
+ <button class=primary type=button onclick="draw()">Graph</button>
+ <a id=permalink href="#" style="font-size:12px;margin-left:8px">permalink</a>
+</div>
+<fieldset style="margin-top:10px"><legend>Graph options</legend>
+ <label>Size <input id=wxh value="980x440" size=8></label>
+ <label><input type=checkbox id=ylog> log scale</label>
+ <label><input type=checkbox id=nokey> hide legend</label>
+ <label>Y range <input id=yrange size=9 placeholder="[0:]"></label>
+ <label>Y label <input id=ylabel size=10></label>
+ <label>Title <input id=title size=14></label>
+</fieldset>
+<div id=err></div>
+<div id=graphbox><span class=small>Build a query and press Graph.</span></div>
+<div class=links>
+ <a id=asciilink href="#">ascii</a> | <a id=jsonlink href="#">json</a> |
+ <a href="/api/version">version</a> | <a href="/api/aggregators">aggregators</a>
+ | <a href="/api/stats">stats</a> | <a href="/api/config">config</a>
+ | <a href="/logs?json">logs</a></div>
+</main>
+<noscript>You must have JavaScript enabled.</noscript>
+<script>
+"use strict";
+var AGGS = ["sum","avg","min","max","count","dev","p99"];
+fetch('/api/aggregators').then(function(r){return r.json()})
+  .then(function(a){AGGS = a; document.querySelectorAll('select.agg,select.dsfn')
+    .forEach(refillAggs);});
+function refillAggs(sel){
+  var cur = sel.value;
+  sel.innerHTML = '';
+  AGGS.forEach(function(a){var o=document.createElement('option');
+    o.textContent=a; sel.appendChild(o);});
+  if (AGGS.indexOf(cur) >= 0) sel.value = cur;
+  else sel.value = sel.classList.contains('dsfn') ? 'avg' : 'sum';
+}
+
+// ---- autocomplete ------------------------------------------------------
+var suggBox = null, suggFor = null, suggSel = -1;
+function closeSugg(){ if(suggBox){suggBox.remove(); suggBox=null;
+  suggFor=null; suggSel=-1;} }
+function attachSuggest(input, type, qfn){
+  input.autocomplete = 'off';
+  var seq = 0;   // drop out-of-order responses for stale prefixes
+  input.addEventListener('input', function(){
+    var q = qfn ? qfn(input.value) : input.value;
+    if (!q){ closeSugg(); return; }
+    var mine = ++seq;
+    fetch('/api/suggest?type='+type+'&q='+encodeURIComponent(q)+'&max=15')
+      .then(function(r){return r.json()}).then(function(names){
+        if (mine !== seq) return;
+        closeSugg();
+        if (!names.length) return;
+        suggBox = document.createElement('ul');
+        suggBox.className = 'sugg'; suggFor = input;
+        names.forEach(function(n){
+          var li = document.createElement('li'); li.textContent = n;
+          li.onmousedown = function(e){ e.preventDefault();
+            input.value = n; closeSugg();
+            input.dispatchEvent(new Event('change')); };
+          suggBox.appendChild(li); });
+        var r = input.getBoundingClientRect();
+        suggBox.style.left = (r.left + window.scrollX) + 'px';
+        suggBox.style.top = (r.bottom + window.scrollY) + 'px';
+        suggBox.style.minWidth = r.width + 'px';
+        document.body.appendChild(suggBox);
+      });
+  });
+  input.addEventListener('keydown', function(e){
+    if (!suggBox) return;
+    var items = suggBox.querySelectorAll('li');
+    if (e.key === 'ArrowDown' || e.key === 'ArrowUp'){
+      e.preventDefault();
+      if (suggSel < 0)   // first keystroke: Down -> first, Up -> last
+        suggSel = e.key === 'ArrowDown' ? 0 : items.length - 1;
+      else
+        suggSel = (suggSel + (e.key === 'ArrowDown' ? 1 : -1)
+                   + items.length) % items.length;
+      items.forEach(function(li, i){
+        li.classList.toggle('sel', i === suggSel); });
+    } else if (e.key === 'Enter' && suggSel >= 0){
+      e.preventDefault(); input.value = items[suggSel].textContent;
+      closeSugg(); input.dispatchEvent(new Event('change'));
+    } else if (e.key === 'Escape'){ closeSugg(); }
+  });
+  input.addEventListener('blur', function(){ setTimeout(closeSugg, 150); });
+}
+
+// ---- metric rows -------------------------------------------------------
+var mseq = 0;
+function addMetric(state){
+  state = state || {};
+  var id = 'm' + (mseq++);
+  var div = document.createElement('div');
+  div.className = 'mrow'; div.id = id;
+  div.innerHTML =
+   '<label>Aggregator <select class=agg></select></label>' +
+   '<label>Metric <input class=metric size=30 ' +
+     'placeholder="sys.cpu.user"></label>' +
+   '<label>Rate <input type=checkbox class=rate></label>' +
+   '<label class=small>counter <input type=checkbox class=counter></label>' +
+   '<label>Downsample <input class=dsival size=4 placeholder="1m"> ' +
+     '<select class=dsfn></select> fill <select class=dsfill>' +
+     '<option value="">none</option><option>nan</option><option>null' +
+     '</option><option>zero</option></select></label>' +
+   '<button type=button class=del onclick="delMetric(\'' + id + '\')">' +
+     'remove</button>' +
+   '<div class=tags></div>' +
+   '<button type=button class=small style="margin-left:18px" ' +
+     'onclick="addTag(\'' + id + '\')">+ tag filter</button>';
+  document.getElementById('metrics').appendChild(div);
+  refillAggs(div.querySelector('select.agg'));
+  refillAggs(div.querySelector('select.dsfn'));
+  div.querySelector('select.agg').value = state.agg || 'sum';
+  div.querySelector('select.dsfn').value = state.dsfn || 'avg';
+  div.querySelector('.metric').value = state.metric || '';
+  div.querySelector('.rate').checked = !!state.rate;
+  div.querySelector('.counter').checked = !!state.counter;
+  div.querySelector('.dsival').value = state.dsival || '';
+  div.querySelector('.dsfill').value = state.dsfill || '';
+  attachSuggest(div.querySelector('.metric'), 'metrics');
+  (state.tags || []).forEach(function(t){ addTag(id, t); });
+  return div;
+}
+function delMetric(id){
+  var rows = document.querySelectorAll('.mrow');
+  if (rows.length > 1) document.getElementById(id).remove();
+}
+function addTag(mid, t){
+  t = t || {};
+  var row = document.createElement('span');
+  row.className = 'tagrow';
+  row.innerHTML = 'tag <input class=tagk size=10 placeholder="host"> = ' +
+    '<input class=tagv size=12 placeholder="* or web01 or web*"> ' +
+    '<button type=button class=del>x</button> ';
+  row.querySelector('button').onclick = function(){ row.remove(); };
+  document.getElementById(mid).querySelector('.tags').appendChild(row);
+  row.querySelector('.tagk').value = t.k || '';
+  row.querySelector('.tagv').value = t.v || '';
+  attachSuggest(row.querySelector('.tagk'), 'tagk');
+  attachSuggest(row.querySelector('.tagv'), 'tagv',
+                function(v){ return v === '*' ? '' : v.replace(/\*/g,''); });
+}
+
+// ---- query building ----------------------------------------------------
+function metricParam(div){
+  var m = div.querySelector('select.agg').value;
+  var ival = div.querySelector('.dsival').value.trim();
+  if (ival){
+    m += ':' + ival + '-' + div.querySelector('select.dsfn').value;
+    var fill = div.querySelector('.dsfill').value;
+    if (fill) m += '-' + fill;
+  }
+  if (div.querySelector('.rate').checked)
+    m += div.querySelector('.counter').checked ? ':rate{counter}' : ':rate';
+  var name = div.querySelector('.metric').value.trim();
+  if (!name) return null;
+  m += ':' + name;
+  var tags = [];
+  div.querySelectorAll('.tagrow').forEach(function(row){
+    var k = row.querySelector('.tagk').value.trim();
+    var v = row.querySelector('.tagv').value.trim();
+    if (k && v) tags.push(k + '=' + v);
+  });
+  if (tags.length) m += '{' + tags.join(',') + '}';
+  return m;
+}
+function buildQuery(extra){
+  var parts = ['start=' + encodeURIComponent(
+      document.getElementById('start').value || '1h-ago')];
+  var end = document.getElementById('end').value.trim();
+  if (end) parts.push('end=' + encodeURIComponent(end));
+  var any = false;
+  document.querySelectorAll('.mrow').forEach(function(div){
+    var m = metricParam(div);
+    if (m){ parts.push('m=' + encodeURIComponent(m)); any = true; }
+  });
+  if (!any) return null;
+  (extra || []).forEach(function(p){ parts.push(p); });
+  return parts.join('&');
+}
+function graphParams(){
+  var p = ['wxh=' + encodeURIComponent(
+      document.getElementById('wxh').value || '980x440')];
+  if (document.getElementById('ylog').checked) p.push('ylog');
+  if (document.getElementById('nokey').checked) p.push('nokey');
+  var yr = document.getElementById('yrange').value.trim();
+  if (yr) p.push('yrange=' + encodeURIComponent(yr));
+  var yl = document.getElementById('ylabel').value.trim();
+  if (yl) p.push('ylabel=' + encodeURIComponent(yl));
+  var t = document.getElementById('title').value.trim();
+  if (t) p.push('title=' + encodeURIComponent(t));
+  return p;
+}
+
+// ---- state <-> permalink ----------------------------------------------
+function stateObj(){
+  var ms = [];
+  document.querySelectorAll('.mrow').forEach(function(div){
+    var tags = [];
+    div.querySelectorAll('.tagrow').forEach(function(row){
+      var k = row.querySelector('.tagk').value.trim();
+      var v = row.querySelector('.tagv').value.trim();
+      if (k || v) tags.push({k: k, v: v});
+    });
+    ms.push({agg: div.querySelector('select.agg').value,
+             metric: div.querySelector('.metric').value,
+             rate: div.querySelector('.rate').checked,
+             counter: div.querySelector('.counter').checked,
+             dsival: div.querySelector('.dsival').value,
+             dsfn: div.querySelector('select.dsfn').value,
+             dsfill: div.querySelector('.dsfill').value,
+             tags: tags});
+  });
+  return {start: document.getElementById('start').value,
+          end: document.getElementById('end').value,
+          wxh: document.getElementById('wxh').value,
+          ylog: document.getElementById('ylog').checked,
+          nokey: document.getElementById('nokey').checked,
+          yrange: document.getElementById('yrange').value,
+          ylabel: document.getElementById('ylabel').value,
+          title: document.getElementById('title').value,
+          metrics: ms};
+}
+function loadState(st){
+  try {
+    document.getElementById('start').value = st.start || '1h-ago';
+    document.getElementById('end').value = st.end || '';
+    document.getElementById('wxh').value = st.wxh || '980x440';
+    document.getElementById('ylog').checked = !!st.ylog;
+    document.getElementById('nokey').checked = !!st.nokey;
+    document.getElementById('yrange').value = st.yrange || '';
+    document.getElementById('ylabel').value = st.ylabel || '';
+    document.getElementById('title').value = st.title || '';
+    document.getElementById('metrics').innerHTML = '';
+    (st.metrics && st.metrics.length ? st.metrics : [{}])
+      .forEach(function(m){ addMetric(m); });
+  } catch (e) { addMetric(); }
+}
+
+// ---- drawing -----------------------------------------------------------
+var reloadTimer = null;
+function draw(){
+  var q = buildQuery(graphParams().concat(['nocache']));
+  var err = document.getElementById('err');
+  if (!q){ err.textContent = 'Enter at least one metric.';
+    err.style.display = 'block'; return; }
+  err.style.display = 'none';
+  var hash = encodeURIComponent(JSON.stringify(stateObj()));
+  history.replaceState(null, '', '#' + hash);
+  document.getElementById('permalink').href = '#' + hash;
+  document.getElementById('asciilink').href = '/q?' + q + '&ascii';
+  document.getElementById('jsonlink').href = '/api/query?' + buildQuery();
+  fetch('/q?' + q).then(function(r){
+    return r.text().then(function(body){ return {ok: r.ok, body: body}; });
+  }).then(function(r){
+    if (!r.ok){
+      var msg = r.body;
+      try { msg = JSON.parse(r.body).error.message; } catch (e) {}
+      err.textContent = msg; err.style.display = 'block';
+      return;
+    }
+    document.getElementById('graphbox').innerHTML = r.body;
+  }).catch(function(e){
+    err.textContent = String(e); err.style.display = 'block';
+  });
+  clearTimeout(reloadTimer);
+  if (document.getElementById('autoreload').checked){
+    var secs = parseInt(document.getElementById('reloadsecs').value) || 15;
+    reloadTimer = setTimeout(draw, Math.max(secs, 1) * 1000);
+  }
+}
+function preset(span){
+  document.getElementById('start').value = span + '-ago';
+  document.getElementById('end').value = '';
+  draw();
+}
+document.getElementById('autoreload').addEventListener('change', function(){
+  if (!this.checked) clearTimeout(reloadTimer); else draw();
+});
+
+// ---- boot --------------------------------------------------------------
+if (location.hash.length > 1){
+  try { loadState(JSON.parse(decodeURIComponent(location.hash.slice(1)))); }
+  catch (e) { addMetric(); }
+} else {
+  addMetric();
+}
+</script></body></html>
+"""

@@ -1,0 +1,23 @@
+"""CI wrapper for the failure-injection crash soak (VERDICT r3 #9).
+
+Runs tools/crash_soak.py — real TSD subprocesses, SIGKILL mid-load,
+restart, zero-acked-point-loss audit — with a short load phase.  Both
+ingest paths (native C++ and pure-Python) are covered in one run.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_kill9_recovers_every_acked_point():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "crash_soak.py"),
+         "--port", "14259", "--load-seconds", "3"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "crash soak PASSED" in proc.stdout
+    assert "[native] all" in proc.stdout
+    assert "[python] all" in proc.stdout

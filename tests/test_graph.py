@@ -108,4 +108,4 @@ class TestGraphEndpoint:
     def test_home_page_ui(self, manager):
         r = http(manager, "/")
         body = r.body.decode()
-        assert "/api/suggest" in body and "/q?start=" in body
+        assert "/api/suggest" in body and "/q?" in body

@@ -1,0 +1,89 @@
+"""Interactive query-builder UI smoke test (VERDICT r3 #6).
+
+No browser runtime exists in CI, so this drives the page the way the
+embedded JS does: every endpoint the UI script calls is hit with the
+exact requests it constructs, and the served page is checked for the
+hooks the script binds to.  (QueryUi.java parity: metric form +
+autocomplete + date range + graph + autoreload, test stance of
+/root/reference/test/tsd/TestHttpJsonSerializer.)
+"""
+
+import json
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def manager():
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    for h, host in enumerate(["web01", "web02", "db01"]):
+        for i in range(60):
+            tsdb.add_point("sys.cpu.user", BASE + i * 10,
+                           50.0 + h + i % 7, {"host": host})
+    return RpcManager(tsdb)
+
+
+def get(manager, uri):
+    q = manager.handle_http(HttpRequest(method="GET", uri=uri, body=b"",
+                                        headers={}))
+    return q.response
+
+
+class TestUiPage:
+    def test_page_served_at_root(self, manager):
+        r = get(manager, "/")
+        assert r.status == 200
+        assert "text/html" in r.headers["Content-Type"]
+        body = r.body.decode()
+        # the hooks the UI script binds/drives
+        for needle in ("addMetric", "attachSuggest", "/api/suggest",
+                       "/api/aggregators", "autoreload", "permalink",
+                       "buildQuery", "tagk", "tagv", "yrange", "ylog",
+                       "/q?"):
+            assert needle in body, needle
+
+    def test_endpoints_the_script_calls(self, manager):
+        # aggregator dropdown source
+        r = get(manager, "/api/aggregators")
+        aggs = json.loads(r.body)
+        assert "sum" in aggs and "movingAverage" in aggs
+        # metric/tagk/tagv autocomplete
+        assert json.loads(get(
+            manager, "/api/suggest?type=metrics&q=sys&max=15").body) \
+            == ["sys.cpu.user"]
+        assert json.loads(get(
+            manager, "/api/suggest?type=tagk&q=h").body) == ["host"]
+        assert "web01" in json.loads(get(
+            manager, "/api/suggest?type=tagv&q=web").body)
+
+    def test_graph_request_the_script_builds(self, manager):
+        uri = ("/q?start=%d&end=%d&m=sum%%3A1m-avg%%3Asys.cpu.user"
+               "%%7Bhost%%3D*%%7D&wxh=600x300&nocache&ylog"
+               % (BASE, BASE + 700))
+        r = get(manager, uri)
+        assert r.status == 200
+        svg = r.body.decode()
+        assert svg.startswith("<svg") and "sys.cpu.user" in svg
+
+    def test_open_ended_yrange(self, manager):
+        # the UI's own placeholder "[0:]" must be accepted (gnuplot open
+        # ranges, review r4): fixed low end, data-derived high end
+        base = ("/q?start=%d&end=%d&m=sum%%3Asys.cpu.user&wxh=400x200"
+                "&nocache" % (BASE, BASE + 700))
+        for yr, ok in (("%5B0%3A%5D", True), ("%5B%3A100%5D", True),
+                       ("%5B0%3A100%5D", True), ("%5B9%3A1%5D", False)):
+            r = get(manager, base + "&yrange=" + yr)
+            assert (r.status == 200) == ok, (yr, r.status, r.body[:200])
+
+    def test_error_shape_the_script_parses(self, manager):
+        r = get(manager, "/q?start=1h-ago&m=bogus:nope&nocache")
+        assert r.status == 400
+        msg = json.loads(r.body)["error"]["message"]
+        assert "No such aggregator" in msg
