@@ -157,17 +157,58 @@ def _grouped_config(config: int, label: str, s: int, n: int, gid, g: int,
 
 
 def config1(scale: float, n_dev: int) -> None:
-    """1M pts, one series, avg 1h — through the production grouped path."""
-    import jax.numpy as jnp
-    from opentsdb_tpu.ops.downsample import FixedWindows
-    from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
+    """1M pts, one series, avg 1h — END TO END through the planner.
+
+    r3 measured the bare device kernel and still lost 11x to the Java
+    iterator (dispatch floor).  r4's fix is routing, so this config must
+    measure what a client sees: TSQuery -> planner -> (host fast lane
+    below tsd.query.host_lane.max_points | accelerator above) -> JSON
+    dps.  Both lanes are reported; the default lane (host) is the
+    headline config-1 number.
+    """
+    import numpy as np
+    from opentsdb_tpu.core import TSDB
+    from opentsdb_tpu.models import TSQuery, parse_m_subquery
+    from opentsdb_tpu.utils.config import Config
 
     n = max(int(1_000_000 * scale), 1024)
-    fixed = FixedWindows.for_range(START, START + n * STEP_MS, 3_600_000)
-    wspec, _ = fixed.split()
-    spec = PipelineSpec("sum", DownsampleStep("avg", wspec, "none", 0.0))
-    _grouped_config(1, "1M pts single-series avg-1h", 1, n,
-                    jnp.zeros(1, jnp.int64), 1, spec, fixed, n_dev, n)
+
+    def mk(host_lane_pts):
+        t = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.query.device_cache.enable": "false",
+            "tsd.query.mesh.enable": False,
+            "tsd.query.host_lane.max_points": str(host_lane_pts),
+        }))
+        key = t._series_key("bench.c1", {"h": "a"}, create=True)
+        ts_ms = START + np.arange(n, dtype=np.int64) * STEP_MS
+        vals = 100.0 + (np.arange(n) % 1_000) * 0.05
+        t.store.add_batch(key, ts_ms, vals, np.zeros(n, bool))
+        return t
+
+    for label, host_pts in (("host-lane", 10_000_000), ("device-lane", 0)):
+        t = mk(host_pts)
+
+        def one_pass():
+            # unique start SECOND per pass (within the hour before the
+            # data, so every point stays in range and the epoch-aligned
+            # window grid genuinely varies): no cache layer can
+            # short-circuit a repeat (review r4 — a sub-second offset
+            # was quantized away by the //1000)
+            off_s = _UNIQ.next(3600)
+            q = TSQuery(start=str(START // 1000 - 3600 + off_s),
+                        end=str((START + n * STEP_MS) // 1000),
+                        queries=[parse_m_subquery("sum:1h-avg:bench.c1")])
+            q.validate()
+            res = t.new_query_runner().run(q)
+            assert res and res[0].dps   # host values: inherently drained
+
+        one_pass()  # compile
+        per_pass, n_passes = _timed_passes(one_pass)
+        _note("config 1 (%s): %d passes, median %.4fs"
+              % (label, n_passes, per_pass))
+        _emit(1, "1M pts single-series avg-1h end-to-end (%s)" % label,
+              n, per_pass, 1)
 
 
 def config3(scale: float, n_dev: int) -> None:

@@ -104,7 +104,29 @@ def main() -> None:
         }), flush=True)
         bench._note("min+extreme_%s: %.4fs/dispatch" % (mode, per))
 
+    # group-reduce strategy A/B (r4): segment scatter vs one-hot matmul
+    # for the cross-series moment combine — scatters serialize on TPU,
+    # the matmul streams on the MXU (same f64 contract, reassociated).
+    from opentsdb_tpu.ops import group_agg as ga
+    ds.set_extreme_mode("scan")
+    ds.set_scan_mode("flat")
+    ds.set_ts_compaction(True)
+    ds.set_value_precision("double")
+    for gmode in ("segment", "matmul"):
+        ga.set_group_reduce_mode(gmode)
+        drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
+        samples, _, _ = measure_drained(spec, g_pad, batch, wargs,
+                                        origins, rtt)
+        per = _median(samples)
+        print(json.dumps({
+            "config": "flat+int32+group_" + gmode,
+            "s_per_dispatch": round(per, 4),
+            "dp_per_sec": round(S * N / per, 1),
+        }), flush=True)
+        bench._note("group_%s: %.4fs/dispatch" % (gmode, per))
+
     # restore defaults
+    ga.set_group_reduce_mode("segment")
     ds.set_extreme_mode("scan")
     ds.set_scan_mode("flat")
     ds.set_ts_compaction(True)

@@ -55,6 +55,45 @@ def _identity(x):
     return x
 
 
+# Cross-series moment reduction strategy: "segment" scatters per-cell
+# partial moments with jax.ops.segment_sum (serializing on TPU), "matmul"
+# computes the same sums as onehot[G, S] @ grid[S, W] contractions — dense
+# MXU work, no scatter.  Both are float64 (Java-double contract); the sum
+# order differs so results can drift in the last ulp.  The chip A/B
+# (bench_prefix) picks the default via TSDB_GROUP_REDUCE_MODE; min/max
+# moments have no matmul form and keep segment ops either way.
+import os as _os
+
+_GROUP_REDUCE_MODE = (_os.environ.get("TSDB_GROUP_REDUCE_MODE")
+                      if _os.environ.get("TSDB_GROUP_REDUCE_MODE")
+                      in ("segment", "matmul") else "segment")
+
+# Shape gate for the matmul form: the dense one-hot is [S, G] f64, so a
+# wide group-by (10k groups) would build GBs and burn O(S*G*W) FLOPs —
+# those shapes keep the scatter regardless of the A/B winner.
+_MATMUL_MAX_GROUPS = 512
+_MATMUL_MAX_ONEHOT_BYTES = 1 << 25        # 32 MB
+
+
+def set_group_reduce_mode(mode: str) -> None:
+    """Benchmarking/ops hook; clears the jitted pipelines that baked the
+    old strategy in (read at trace time)."""
+    global _GROUP_REDUCE_MODE
+    if mode not in ("segment", "matmul"):
+        raise ValueError("group reduce mode must be segment|matmul")
+    _GROUP_REDUCE_MODE = mode
+    from opentsdb_tpu.ops import pipeline
+    pipeline._jitted.clear_cache()
+    pipeline._jitted_group.clear_cache()
+    pipeline._jitted_grid_tail.clear_cache()
+    pipeline._jitted_rollup_avg.clear_cache()
+    pipeline._jitted_group_rollup_avg.clear_cache()
+    from opentsdb_tpu.parallel import sharded
+    sharded.sharded_query_pipeline.cache_clear()
+    if hasattr(sharded, "_stream_finish_fn"):
+        sharded._stream_finish_fn.cache_clear()
+
+
 def grid_contributions(grid_ts, val, mask, agg: Aggregator):
     """Per-series contribution + participation at every grid slot.
 
@@ -110,41 +149,76 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     s, w = contrib.shape
     g = num_groups
     num = g * w
-    seg, ok, v = _flat_segments(contrib, participate, gid, g)
 
-    cnt = combine_sum(jax.ops.segment_sum(ok.astype(jnp.int64), seg,
-                                          num_segments=num))
-    cnt_grid = cnt.reshape(g, w)
+    if agg_name in ("min", "mimmin", "max", "mimmax"):
+        # extremes have no matmul form: always segment ops + pmin/pmax
+        seg, ok, v = _flat_segments(contrib, participate, gid, g)
+        cnt = combine_sum(jax.ops.segment_sum(ok.astype(jnp.int64), seg,
+                                              num_segments=num))
+        cnt_grid = cnt.reshape(g, w)
+        if agg_name in ("min", "mimmin"):
+            ext = combine_min(jax.ops.segment_min(
+                jnp.where(ok, v, jnp.inf), seg, num_segments=num))
+        else:
+            ext = combine_max(jax.ops.segment_max(
+                jnp.where(ok, v, -jnp.inf), seg, num_segments=num))
+        out = jnp.where(cnt_grid > 0, ext.reshape(g, w), jnp.nan)
+        return out, cnt_grid
+
+    # One finish, two group-sum primitives.  The matmul form is gated to
+    # shapes where the dense one-hot is cheap (small G relative to S —
+    # the headline group-by shape); a 10k-group query would build a
+    # multi-GB [S, G] one-hot, so big-G shapes keep the scatter
+    # regardless of the A/B winner (review r4).
+    vf = contrib.astype(jnp.float64)
+    ok2 = participate & ~jnp.isnan(vf)
+    v2 = jnp.where(ok2, vf, 0.0)
+    use_matmul = (_GROUP_REDUCE_MODE == "matmul"
+                  and g <= _MATMUL_MAX_GROUPS
+                  and s * g * 8 <= _MATMUL_MAX_ONEHOT_BYTES)
+    if use_matmul:
+        # out[g, w] = Σ_s onehot[s, g] * grid[s, w] — dense MXU work, no
+        # serializing scatter.  Counts are 0/1 sums (exact in f64 far
+        # beyond any real S); value sums reassociate vs segment_sum, so
+        # parity is to the last ulp, not bitwise.
+        o_t = (gid[:, None]
+               == jnp.arange(g, dtype=gid.dtype)[None, :]) \
+            .astype(jnp.float64).T                             # [G, S]
+
+        def gsum(x2d):   # [S, W] -> [G, W], cross-chip combined
+            return combine_sum((o_t @ x2d).reshape(-1)).reshape(g, w)
+    else:
+        cols = jnp.arange(w, dtype=jnp.int64)[None, :]
+        seg = (jnp.clip(gid.astype(jnp.int64), 0, g)[:, None] * w
+               + cols).reshape(-1)
+        seg = jnp.where(seg < num, seg, num)
+
+        def gsum(x2d):
+            return combine_sum(jax.ops.segment_sum(
+                x2d.reshape(-1), seg, num_segments=num + 1)[:-1]) \
+                .reshape(g, w)
+
+    cnt_grid = gsum(ok2.astype(jnp.float64)).astype(jnp.int64)
     safe = jnp.maximum(cnt_grid, 1)
 
     if agg_name in ("sum", "zimsum", "pfsum"):
-        tot = combine_sum(jax.ops.segment_sum(v, seg, num_segments=num))
-        out = tot.reshape(g, w)
+        out = gsum(v2)
     elif agg_name == "count":
         out = cnt_grid.astype(jnp.float64)
     elif agg_name == "avg":
-        tot = combine_sum(jax.ops.segment_sum(v, seg, num_segments=num))
-        out = tot.reshape(g, w) / safe
+        out = gsum(v2) / safe
     elif agg_name == "squareSum":
-        sq = combine_sum(jax.ops.segment_sum(v * v, seg, num_segments=num))
-        out = sq.reshape(g, w)
-    elif agg_name in ("min", "mimmin"):
-        lo = combine_min(jax.ops.segment_min(
-            jnp.where(ok, v, jnp.inf), seg, num_segments=num))
-        out = lo.reshape(g, w)
-    elif agg_name in ("max", "mimmax"):
-        hi = combine_max(jax.ops.segment_max(
-            jnp.where(ok, v, -jnp.inf), seg, num_segments=num))
-        out = hi.reshape(g, w)
+        out = gsum(v2 * v2)
     elif agg_name == "dev":
-        tot = combine_sum(jax.ops.segment_sum(v, seg, num_segments=num))
-        mean = (tot.reshape(g, w) / safe).reshape(-1)
-        centered = jnp.where(ok, v - mean[seg], 0.0)
-        m2 = combine_sum(jax.ops.segment_sum(centered * centered, seg,
-                                             num_segments=num))
+        # Two-pass centered moment with the GLOBAL mean (one extra
+        # combine round-trip) — the scheme the reference's Welford loop
+        # approximates (Aggregators.java:498).
+        mean = gsum(v2) / safe                                  # [G, W]
+        mean_pp = jnp.take(mean, jnp.clip(gid, 0, g - 1), axis=0)
+        centered = jnp.where(ok2, vf - mean_pp, 0.0)
+        m2 = gsum(centered * centered)
         out = jnp.where(cnt_grid >= 2,
-                        jnp.sqrt(m2.reshape(g, w)
-                                 / jnp.maximum(cnt_grid - 1, 1)), 0.0)
+                        jnp.sqrt(m2 / jnp.maximum(cnt_grid - 1, 1)), 0.0)
     else:
         from opentsdb_tpu.ops.aggregators import java_moving_average, \
             ma_window
@@ -155,8 +229,7 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
         # Cross-series sum combines across chips; the Java window pass
         # then runs on the replicated [G, W] grid (live = windows with
         # data, matching the evaluation order the iterator would visit).
-        tot = combine_sum(jax.ops.segment_sum(v, seg, num_segments=num))
-        out = java_moving_average(tot.reshape(g, w), cnt_grid > 0, nw)
+        out = java_moving_average(gsum(v2), cnt_grid > 0, nw)
 
     if agg_name != "count":
         out = jnp.where(cnt_grid > 0, out, jnp.nan)

@@ -195,3 +195,41 @@ def test_mesh_serves_from_device_cache(pair):
     warm = [r.to_json() for r in warm_res]
     assert_equivalent(warm, _run(meshed_nocache, m))
     assert_equivalent(warm, _run(plain, m))
+
+
+class TestMatmulGroupReduce:
+    """group-reduce strategy toggle (r4 perf lever): the one-hot matmul
+    moments must answer exactly like the segment-scatter moments, on and
+    off the mesh, for every moment aggregator + movingAverage.  min/max
+    fall back to segment ops under the toggle and must keep working."""
+
+    QUERIES = MOMENT_QUERIES + [
+        "movingAverage3:1m-sum:sys.cpu.user{dc=*}",
+        "min:1m-max:sys.cpu.user{dc=*}",     # segment fallback path
+    ]
+
+    @pytest.fixture()
+    def matmul_mode(self):
+        from opentsdb_tpu.ops import group_agg
+        group_agg.set_group_reduce_mode("matmul")
+        yield
+        group_agg.set_group_reduce_mode("segment")
+
+    @pytest.mark.parametrize("m", QUERIES)
+    def test_matmul_equals_segment(self, matmul_mode, m):
+        t = _mk_tsdb(False)
+        _ingest(t)
+        got = _run(t, m)
+        from opentsdb_tpu.ops import group_agg
+        group_agg.set_group_reduce_mode("segment")
+        want = _run(t, m)
+        group_agg.set_group_reduce_mode("matmul")
+        assert_equivalent(got, want)
+
+    def test_matmul_on_mesh(self, matmul_mode, pair):
+        _meshed, plain = pair
+        t = _mk_tsdb(True)
+        _ingest(t)
+        got = _run(t, "sum:1m-avg:sys.cpu.user{dc=*}")
+        want = _run(plain, "sum:1m-avg:sys.cpu.user{dc=*}")
+        assert_equivalent(got, want)

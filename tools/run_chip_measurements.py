@@ -1,14 +1,18 @@
-"""One-shot real-chip measurement session for round 3 artifacts.
+"""One-shot real-chip measurement session for round 4 artifacts.
 
 Runs, in order, each as a separate subprocess (the axon tunnel is
 exclusive and can wedge if a JAX process dies mid-dispatch — isolating
 stages means a crash loses one stage, not the session):
 
-  1. bench_prefix.py          — A/B the hot-path variants (JSON lines)
-  2. bench.py                 — headline number with the winning defaults
-  3. bench_configs.py         — BASELINE configs 1-7 at full scale
+  1. bench_prefix.py          — A/B the hot-path variants (JSON lines),
+                                incl. the r4 group-reduce segment/matmul
+                                race; winners feed later stages via env
+  2. tools/stage_bench.py     — per-stage attribution of one dispatch
+  3. bench.py                 — headline number with the winning defaults
+  4. bench_configs.py         — BASELINE configs 1-7 at full scale,
+                                crash-isolated one subprocess per config
 
-Results append to BENCH_CONFIGS_r03.json (JSON lines + a trailing
+Results append to BENCH_CONFIGS_r04.json (JSON lines + a trailing
 metadata line).  Run: python tools/run_chip_measurements.py
 """
 
@@ -21,7 +25,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "BENCH_CONFIGS_r03.json")
+OUT = os.path.join(REPO, "BENCH_CONFIGS_r04.json")
 
 
 def run_stage(name: str, argv: list[str], timeout: int,
@@ -72,6 +76,12 @@ def pick_winners(prefix_records: list[dict]) -> dict:
         env["TSDB_EXTREME_MODE"] = (
             "scan" if ext["min+extreme_scan"] <= ext["min+extreme_segment"]
             else "segment")
+    grp = {c: by_cfg[c] for c in ("flat+int32+group_segment",
+                                  "flat+int32+group_matmul") if c in by_cfg}
+    if len(grp) == 2:
+        env["TSDB_GROUP_REDUCE_MODE"] = (
+            "segment" if grp["flat+int32+group_segment"]
+            <= grp["flat+int32+group_matmul"] else "matmul")
     if env:
         print("== A/B winners -> %s ==" % env, file=sys.stderr, flush=True)
     return env
