@@ -190,9 +190,44 @@ def f_alias(args) -> list[SeriesResult]:
     return out
 
 
+def _java_expr_moving_average(ts, v, is_time: bool, window_ms: int,
+                              window_n: int) -> np.ndarray:
+    """The reference expression-layer evaluation loop, exactly
+    (/root/reference/src/query/expression/MovingAverage.java:191
+    MovingAverageAggregator): INCLUSIVE of the current point, 0 until the
+    window condition is met; time windows additionally skip the series'
+    first point (window_started) and require a point OLDER than the
+    window to exist before emitting."""
+    n_pts = len(v)
+    idx = np.arange(n_pts)
+    # Non-finite values poison exactly the windows containing them (the
+    # Java loop sums fresh per point; a plain cumsum would emit NaN
+    # forever after an inf via inf - inf).  Finite windows go through
+    # cumsum differences; the (rare) windows overlapping a non-finite
+    # point re-sum their slice directly for the exact Java result
+    # (inf -> inf, mixed infs/NaN -> NaN).
+    bad = ~np.isfinite(v)
+    csum = np.concatenate([[0.0], np.cumsum(np.where(bad, 0.0, v))])
+    bsum = np.concatenate([[0], np.cumsum(bad.astype(np.int64))])
+    if is_time:
+        lo = np.searchsorted(ts, ts - window_ms, side="right")
+        met = (lo > 0) & (idx > 0)
+    else:
+        lo = np.maximum(idx - window_n + 1, 0)
+        met = idx >= window_n - 1
+    cnt = np.maximum(idx + 1 - lo, 1)
+    mean = (csum[idx + 1] - csum[lo]) / cnt
+    res = np.where(met, mean, 0.0)
+    for i in np.flatnonzero(met & (bsum[idx + 1] - bsum[lo] > 0)):
+        res[i] = np.sum(v[lo[i]:i + 1]) / cnt[i]
+    return res
+
+
 def f_moving_average(args) -> list[SeriesResult]:
-    """movingAverage(m, N) points or movingAverage(m, '10min') time window
-    (MovingAverage.java)."""
+    """movingAverage(m, N) points or movingAverage(m, '10min') time
+    window, applied per result series like the reference (each series
+    wrapped in its own AggregationIterator,
+    /root/reference/src/query/expression/MovingAverage.java:105-118)."""
     _need_series(args, "movingAverage")
     if len(args) < 2:
         raise ValueError("Missing moving average window size")
@@ -208,6 +243,8 @@ def f_moving_average(args) -> list[SeriesResult]:
             raise ValueError("Invalid moving window parameter: " + param)
         canonical = {"sec": "s", "min": "m", "hr": "h", "day": "d",
                      "week": "w"}.get(unit, unit)
+        # parse_duration rejects zero/negative spans, matching the
+        # reference's condition <= 0 check (MovingAverage.java:74-77)
         window_ms = DT.parse_duration(count + canonical)
     else:
         window_n = int(param)
@@ -216,15 +253,8 @@ def f_moving_average(args) -> list[SeriesResult]:
                              "greater than zero")
     out = []
     for s in args[0]:
-        vals = np.full_like(s.values, np.nan)
-        for i in range(len(s.values)):
-            if is_time:
-                lo = np.searchsorted(s.ts, s.ts[i] - window_ms, side="right")
-            else:
-                lo = max(0, i - window_n + 1)
-            window = s.values[lo:i + 1]
-            if len(window):
-                vals[i] = float(np.mean(window))
+        vals = _java_expr_moving_average(
+            s.ts, s.values.astype(np.float64), is_time, window_ms, window_n)
         out.append(s.copy_with(label="movingAverage(%s,%s)"
                                % (s.label, param), values=vals))
     return out

@@ -46,9 +46,11 @@ class HistogramSeries:
         with self._lock:
             if self._ts and ts_ms < self._ts[-1]:
                 self._sorted = False
+                self._cols = None    # the re-sort shuffles everything
             self._ts.append(ts_ms)
             self._hists.append(hist)
-            self._cols = None
+            # in-order appends keep the columnar image: columns()
+            # detects the length gap and extends incrementally
 
     def _normalize_locked(self) -> None:
         if not self._sorted:
@@ -78,26 +80,46 @@ class HistogramSeries:
 
     def columns(self):
         """(ts[N], indptr[N+1], bids[nnz], cnts[nnz], vocab) — stable
-        arrays (rebuilt, never mutated) safe to use outside the lock."""
+        arrays (rebuilt, never mutated) safe to use outside the lock.
+
+        In-order appends EXTEND the previous image (the Python
+        per-bucket walk covers only the new points; array concats are
+        vectorized), so a steady write+query mix pays O(new), not
+        O(total), per query.  Out-of-order appends re-sort and rebuild.
+        """
         with self._lock:
             self._normalize_locked()
-            if self._cols is None:
-                vocab_idx = {b: i for i, b in enumerate(self._vocab)}
-                indptr = np.zeros(len(self._hists) + 1, np.int64)
-                bids: list[int] = []
-                cnts: list[int] = []
-                for i, h in enumerate(self._hists):
-                    for b, c in h.buckets.items():
-                        gi = vocab_idx.get(b)
-                        if gi is None:
-                            gi = vocab_idx[b] = len(self._vocab)
-                            self._vocab.append(b)
-                        bids.append(gi)
-                        cnts.append(c)
-                    indptr[i + 1] = len(bids)
-                self._cols = (np.asarray(self._ts, np.int64), indptr,
-                              np.asarray(bids, np.int64),
-                              np.asarray(cnts, np.int64))
+            start = 0
+            old = self._cols
+            if old is not None and len(old[1]) - 1 == len(self._hists):
+                return old + (list(self._vocab),)
+            if old is not None:
+                start = len(old[1]) - 1
+            vocab_idx = {b: i for i, b in enumerate(self._vocab)}
+            indptr = np.zeros(len(self._hists) - start + 1, np.int64)
+            base = int(old[1][-1]) if old is not None else 0
+            indptr[0] = base
+            bids: list[int] = []
+            cnts: list[int] = []
+            for i, h in enumerate(self._hists[start:]):
+                for b, c in h.buckets.items():
+                    gi = vocab_idx.get(b)
+                    if gi is None:
+                        gi = vocab_idx[b] = len(self._vocab)
+                        self._vocab.append(b)
+                    bids.append(gi)
+                    cnts.append(c)
+                indptr[i + 1] = base + len(bids)
+            new_ts = np.asarray(self._ts[start:], np.int64)
+            new_bids = np.asarray(bids, np.int64)
+            new_cnts = np.asarray(cnts, np.int64)
+            if old is None:
+                self._cols = (new_ts, indptr, new_bids, new_cnts)
+            else:
+                self._cols = (np.concatenate([old[0], new_ts]),
+                              np.concatenate([old[1], indptr[1:]]),
+                              np.concatenate([old[2], new_bids]),
+                              np.concatenate([old[3], new_cnts]))
             return self._cols + (list(self._vocab),)
 
     def __len__(self) -> int:

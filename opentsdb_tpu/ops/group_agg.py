@@ -82,16 +82,10 @@ def set_group_reduce_mode(mode: str) -> None:
     if mode not in ("segment", "matmul"):
         raise ValueError("group reduce mode must be segment|matmul")
     _GROUP_REDUCE_MODE = mode
-    from opentsdb_tpu.ops import pipeline
-    pipeline._jitted.clear_cache()
-    pipeline._jitted_group.clear_cache()
-    pipeline._jitted_grid_tail.clear_cache()
-    pipeline._jitted_rollup_avg.clear_cache()
-    pipeline._jitted_group_rollup_avg.clear_cache()
-    from opentsdb_tpu.parallel import sharded
-    sharded.sharded_query_pipeline.cache_clear()
-    if hasattr(sharded, "_stream_finish_fn"):
-        sharded._stream_finish_fn.cache_clear()
+    # one list of toggle-dependent compiled programs, owned by downsample
+    # (review r4: a hand-copied list here would drift)
+    from opentsdb_tpu.ops.downsample import _clear_dependent_caches
+    _clear_dependent_caches()
 
 
 def grid_contributions(grid_ts, val, mask, agg: Aggregator):

@@ -245,12 +245,18 @@ def build_batch(windows: list, pad_to_pow2: bool = True):
         if len(w[0]) and not bool(np.all(isint)):
             all_int = False
             break
-    ts = np.full((s, n), PAD_TS, dtype=np.int64)
-    mask = np.zeros((s, n), dtype=bool)
-    val = np.zeros((s, n), dtype=np.int64 if all_int else np.float64)
+    # np.empty + per-row tail fill, not np.full/zeros: a dense batch
+    # (the common case — one big series is the whole row) would pay a
+    # full-array memset immediately overwritten by the copy
+    ts = np.empty((s, n), dtype=np.int64)
+    mask = np.empty((s, n), dtype=bool)
+    val = np.empty((s, n), dtype=np.int64 if all_int else np.float64)
     for i, (t, fv, iv, isint) in enumerate(windows):
         k = len(t)
         ts[i, :k] = t
+        ts[i, k:] = PAD_TS
         val[i, :k] = iv if all_int else fv
+        val[i, k:] = 0
         mask[i, :k] = True
+        mask[i, k:] = False
     return ts, val, mask, all_int

@@ -302,3 +302,105 @@ class TestExpEndpoint:
         q = manager.handle_http(HttpRequest(
             method="GET", uri="/api/query/exp"))
         assert q.response.status == 405
+
+
+class TestMovingAverageJavaParity:
+    """gexp movingAverage vs a literal transcription of the reference
+    expression-layer loop (query/expression/MovingAverage.java:191):
+    inclusive of the current point, 0 until the window fills, time
+    windows skip the series' first point and need an older-than-window
+    point before emitting."""
+
+    @staticmethod
+    def java_model(ts, vals, cond, is_time):
+        out = []
+        acc = []          # newest first: (ts, v)
+        window_started = False
+        for t, v in zip(ts, vals):
+            acc.insert(0, (t, v))
+            if is_time and not window_started:
+                window_started = True
+                out.append(0.0)
+                continue
+            s, count, met = 0.0, 0, False
+            cum, last = 0, -1
+            for (dt, dv) in acc:
+                if is_time:
+                    if last < 0:
+                        last = dt
+                    else:
+                        cum += last - dt
+                        last = dt
+                        if cum >= cond:
+                            met = True
+                            break
+                s += dv
+                count += 1
+                if not is_time and count >= cond:
+                    met = True
+                    break
+            out.append(s / count if met and count else 0.0)
+        return out
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_point_window(self, seed):
+        import numpy as np
+        from opentsdb_tpu.expression.gexp import _java_expr_moving_average
+        rng = np.random.default_rng(seed)
+        n = 40
+        ts = np.cumsum(rng.integers(1000, 30000, n)) + 1_000_000
+        v = rng.normal(50, 20, n)
+        got = _java_expr_moving_average(ts, v, False, 0, 5)
+        want = self.java_model(ts, v, 5, False)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+        assert (got[:4] == 0).all()    # window unfilled -> 0, not means
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_time_window(self, seed):
+        import numpy as np
+        from opentsdb_tpu.expression.gexp import _java_expr_moving_average
+        rng = np.random.default_rng(100 + seed)
+        n = 40
+        ts = np.cumsum(rng.integers(1000, 30000, n)) + 1_000_000
+        v = rng.normal(50, 20, n)
+        got = _java_expr_moving_average(ts, v, True, 60_000, 0)
+        want = self.java_model(ts, v, 60_000, True)
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+        assert got[0] == 0.0           # window_started skip
+
+    def test_nan_poisons_only_its_windows(self):
+        import numpy as np
+        from opentsdb_tpu.expression.gexp import _java_expr_moving_average
+        ts = np.arange(10, dtype=np.int64) * 10_000
+        v = np.ones(10)
+        v[4] = np.nan
+        got = _java_expr_moving_average(ts, v, False, 0, 3)
+        assert np.isnan(got[4]) and np.isnan(got[5]) and np.isnan(got[6])
+        assert got[7] == 1.0 and got[3] == 1.0   # outside the window: clean
+
+
+    def test_zero_time_window_rejected(self):
+        import numpy as np
+        import pytest as _pytest
+        from opentsdb_tpu.expression.gexp import f_moving_average
+        from opentsdb_tpu.expression.series import SeriesResult
+        s = SeriesResult(label="m", tags={}, agg_tags=[],
+                         ts=np.arange(3) * 1000, values=np.ones(3))
+        with _pytest.raises(ValueError,
+                    match="Zero or negative duration"):
+            f_moving_average([[s], "'0m'"])
+
+    def test_inf_poisons_only_its_windows(self):
+        """An inf (e.g. from divideSeries by zero) must give inf means
+        while in-window and clean means after — never NaN-forever via
+        cumsum inf - inf (review r4)."""
+        import numpy as np
+        from opentsdb_tpu.expression.gexp import _java_expr_moving_average
+        ts = np.arange(10, dtype=np.int64) * 10_000
+        v = np.ones(10)
+        v[3] = np.inf
+        got = _java_expr_moving_average(ts, v, False, 0, 3)
+        assert got[0] == 0.0 and got[1] == 0.0
+        assert got[2] == 1.0
+        assert np.isinf(got[3]) and np.isinf(got[4]) and np.isinf(got[5])
+        assert got[6] == 1.0 and got[9] == 1.0

@@ -340,3 +340,42 @@ class TestDeviceQueryPath:
         assert len(results) == 2       # one group, two percentiles
         assert len(results[0].dps) == 16
         assert elapsed < 30, elapsed   # generous CI bound; was minutes
+
+
+class TestIncrementalColumns:
+    def test_interleaved_appends_and_queries_match_single_build(self):
+        """columns() extends incrementally on in-order appends and
+        rebuilds on out-of-order ones; the image must equal a one-shot
+        build regardless of how queries interleave with writes."""
+        from opentsdb_tpu.histogram.store import HistogramSeries
+        from opentsdb_tpu.storage.memstore import SeriesKey
+
+        rng = np.random.default_rng(3)
+        s1 = HistogramSeries(SeriesKey.make(1, {}))
+        s2 = HistogramSeries(SeriesKey.make(1, {}))
+        ts = 0
+        for _ in range(6):
+            burst = []
+            for _ in range(int(rng.integers(1, 30))):
+                ts += int(rng.integers(0, 100)) \
+                    - (20 if rng.random() < 0.3 else 0)  # some out-of-order
+                burst.append((max(ts, 0), make_hist(
+                    {(0, 1): int(rng.integers(0, 9)),
+                     (float(rng.integers(1, 4)), 9.0): 2})))
+            for t, hh in burst:
+                s1.append(t, hh)
+                s2.append(t, hh)
+            s1.columns()               # query every burst: incremental
+        a = s1.columns()
+        b = s2.columns()               # single full build
+        assert a[0].tolist() == b[0].tolist()
+        assert a[1].tolist() == b[1].tolist()
+        # vocab order may differ; compare per-point (bounds, count) sets
+        for i in range(len(a[0])):
+            ea = sorted((a[4][g], c) for g, c in
+                        zip(a[2][a[1][i]:a[1][i + 1]],
+                            a[3][a[1][i]:a[1][i + 1]]))
+            eb = sorted((b[4][g], c) for g, c in
+                        zip(b[2][b[1][i]:b[1][i + 1]],
+                            b[3][b[1][i]:b[1][i + 1]]))
+            assert ea == eb, i

@@ -226,10 +226,16 @@ class TestMatmulGroupReduce:
         group_agg.set_group_reduce_mode("matmul")
         assert_equivalent(got, want)
 
-    def test_matmul_on_mesh(self, matmul_mode, pair):
+    @pytest.mark.parametrize("m", QUERIES)
+    def test_matmul_on_mesh(self, matmul_mode, pair, m):
+        """Every matmul-mode aggregator (incl. dev's second gsum pass and
+        the min/max segment fallback) under the real mesh collectives."""
         _meshed, plain = pair
         t = _mk_tsdb(True)
         _ingest(t)
-        got = _run(t, "sum:1m-avg:sys.cpu.user{dc=*}")
-        want = _run(plain, "sum:1m-avg:sys.cpu.user{dc=*}")
+        got = _run(t, m)
+        from opentsdb_tpu.ops import group_agg
+        group_agg.set_group_reduce_mode("segment")
+        want = _run(plain, m)
+        group_agg.set_group_reduce_mode("matmul")
         assert_equivalent(got, want)

@@ -1,5 +1,8 @@
 #!/bin/bash
-# Probe the axon TPU tunnel every 5 min; append status to /tmp/tpu_watch.log
+# Probe the axon tunnel every ~5 min; on recovery, immediately run the
+# full chip measurement session (once), then keep logging status.
+# Log: /tmp/tpu_watch.log   Measurement log: /tmp/chip_measurements.log
+cd /root/repo
 while true; do
   ts=$(date -u +%H:%M:%S)
   out=$(timeout 300 python -c "
@@ -8,10 +11,19 @@ ds = jax.devices()
 import jax.numpy as jnp
 (jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()
 print('ALIVE', ds)
-" 2>&1 | tail -2)
-  echo "$ts $out" >> /tmp/tpu_watch.log
+" 2>&1)
+  echo "$ts $(echo "$out" | tail -1)" >> /tmp/tpu_watch.log
   if echo "$out" | grep -q ALIVE; then
-    echo "$ts TPU IS BACK" >> /tmp/tpu_watch.log
+    # run-once only after a SUCCESSFUL session: a transient ALIVE on the
+    # flaky tunnel must not permanently consume the auto-run
+    if [ "$(cat /tmp/chip_measurements.started 2>/dev/null)" != "0" ]; then
+      echo "$ts TPU BACK - starting measurement session" >> /tmp/tpu_watch.log
+      timeout 28800 python tools/run_chip_measurements.py \
+        > /tmp/chip_measurements.log 2>&1
+      rc=$?
+      echo "$rc" > /tmp/chip_measurements.started
+      echo "$(date -u +%H:%M:%S) measurement session rc=$rc" >> /tmp/tpu_watch.log
+    fi
   fi
   sleep 240
 done
