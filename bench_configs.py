@@ -537,10 +537,16 @@ def main() -> None:
                     help="run one config (default: all)")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="shrink factor for smoke runs (e.g. 0.01)")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (e.g. cpu) — the env var "
+                         "alone is overridden by the ambient sitecustomize, "
+                         "so CPU smoke runs need the in-process update")
     args = ap.parse_args()
 
     import opentsdb_tpu.ops  # noqa: F401  (jax x64)
     import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
     global _RTT
     n_dev = len(jax.devices())
     _note("devices: %d (%s)" % (n_dev, jax.devices()[0].platform))

@@ -226,16 +226,17 @@ class TestMatmulGroupReduce:
         group_agg.set_group_reduce_mode("matmul")
         assert_equivalent(got, want)
 
-    @pytest.mark.parametrize("m", QUERIES)
-    def test_matmul_on_mesh(self, matmul_mode, pair, m):
+    def test_matmul_on_mesh(self, pair):
         """Every matmul-mode aggregator (incl. dev's second gsum pass and
-        the min/max segment fallback) under the real mesh collectives."""
-        _meshed, plain = pair
-        t = _mk_tsdb(True)
-        _ingest(t)
-        got = _run(t, m)
+        the min/max segment fallback) under the real mesh collectives —
+        ONE mode flip and one meshed store for the whole sweep (cache
+        clears + recompiles per flip are the expensive part)."""
         from opentsdb_tpu.ops import group_agg
-        group_agg.set_group_reduce_mode("segment")
-        want = _run(plain, m)
+        meshed, plain = pair
+        wants = {m: _run(plain, m) for m in self.QUERIES}   # segment mode
         group_agg.set_group_reduce_mode("matmul")
-        assert_equivalent(got, want)
+        try:
+            for m in self.QUERIES:
+                assert_equivalent(_run(meshed, m), wants[m])
+        finally:
+            group_agg.set_group_reduce_mode("segment")

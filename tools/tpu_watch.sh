@@ -14,15 +14,22 @@ print('ALIVE', ds)
 " 2>&1)
   echo "$ts $(echo "$out" | tail -1)" >> /tmp/tpu_watch.log
   if echo "$out" | grep -q ALIVE; then
-    # run-once only after a SUCCESSFUL session: a transient ALIVE on the
-    # flaky tunnel must not permanently consume the auto-run
-    if [ "$(cat /tmp/chip_measurements.started 2>/dev/null)" != "0" ]; then
-      echo "$ts TPU BACK - starting measurement session" >> /tmp/tpu_watch.log
+    # retry until one SUCCESSFUL session (a transient ALIVE must not
+    # consume the run), but cap attempts — a deterministic failure must
+    # not monopolize the shared chip with back-to-back 8h sessions.
+    # Marker holds "ok" after success, else the attempt count.
+    state=$(cat /tmp/chip_measurements.started 2>/dev/null)
+    attempts=${state:-0}
+    if [ "$state" != "ok" ] && [ "$attempts" -lt 3 ] 2>/dev/null; then
+      attempts=$((attempts + 1))
+      echo "$attempts" > /tmp/chip_measurements.started
+      echo "$ts TPU BACK - measurement attempt $attempts" >> /tmp/tpu_watch.log
       timeout 28800 python tools/run_chip_measurements.py \
-        > /tmp/chip_measurements.log 2>&1
+        > "/tmp/chip_measurements.$attempts.log" 2>&1
       rc=$?
-      echo "$rc" > /tmp/chip_measurements.started
-      echo "$(date -u +%H:%M:%S) measurement session rc=$rc" >> /tmp/tpu_watch.log
+      [ "$rc" = "0" ] && echo "ok" > /tmp/chip_measurements.started
+      echo "$(date -u +%H:%M:%S) measurement attempt $attempts rc=$rc" \
+        >> /tmp/tpu_watch.log
     fi
   fi
   sleep 240
