@@ -44,6 +44,9 @@ def main() -> None:
         ("flat+int32", "flat", True, "double"),
         ("blocked+int64", "blocked", False, "double"),
         ("blocked+int32", "blocked", True, "double"),
+        # r4 chip-attribution lever: no full-length f64 scan at all —
+        # sub-block f64 reduces + tiny cumsum + 32-wide remainder dots
+        ("subblock+int32", "subblock", True, "double"),
         # fast mode: float32 accumulation (native ALUs; NOT the default —
         # breaks the 1e-9 Java-double parity contract, documented)
         ("blocked+int32+f32", "blocked", True, "single"),
@@ -63,11 +66,12 @@ def main() -> None:
         }), flush=True)
         bench._note("%s: %.4fs/dispatch" % (name, per))
     # edge-search strategy A/B at the winning scan config: binary search
-    # (log2(N) gather rounds) vs compare_all (fused compare+reduce).
+    # (log2(N) gather rounds) vs compare_all (fused compare+reduce) vs
+    # hier (sub-block firsts + 32-wide remainder — 1/32 the compares).
     ds.set_scan_mode("flat")
     ds.set_ts_compaction(True)
     ds.set_value_precision("double")
-    for smode in ("scan", "compare_all"):
+    for smode in ("scan", "compare_all", "hier"):
         ds.set_search_mode(smode)
         drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
         samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins,
@@ -112,7 +116,7 @@ def main() -> None:
     ds.set_scan_mode("flat")
     ds.set_ts_compaction(True)
     ds.set_value_precision("double")
-    for gmode in ("segment", "matmul"):
+    for gmode in ("segment", "matmul", "sorted"):
         ga.set_group_reduce_mode(gmode)
         drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
         samples, _, _ = measure_drained(spec, g_pad, batch, wargs,
@@ -125,9 +129,26 @@ def main() -> None:
         }), flush=True)
         bench._note("group_%s: %.4fs/dispatch" % (gmode, per))
 
+    # the r4 composition: every attribution-driven lever at once —
+    # validates the per-axis winners actually compose (fusion could
+    # interact) before run_chip_measurements feeds them forward
+    ds.set_scan_mode("subblock")
+    ds.set_search_mode("hier")
+    ga.set_group_reduce_mode("sorted")
+    drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
+    samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins, rtt)
+    per = _median(samples)
+    print(json.dumps({
+        "config": "subblock+int32+hier+sorted",
+        "s_per_dispatch": round(per, 4),
+        "dp_per_sec": round(S * N / per, 1),
+    }), flush=True)
+    bench._note("combo subblock+hier+sorted: %.4fs/dispatch" % per)
+
     # restore defaults
     ga.set_group_reduce_mode("segment")
     ds.set_extreme_mode("scan")
+    ds.set_search_mode("scan")
     ds.set_scan_mode("flat")
     ds.set_ts_compaction(True)
     ds.set_value_precision("double")

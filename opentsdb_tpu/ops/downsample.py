@@ -181,20 +181,28 @@ def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
 
 # Prefix-scan strategy for the hot path.  "flat" = one cumsum over the full
 # time axis; "blocked" = two-level scan (intra-block cumsum + tiny block-
-# offset scan) — shorter scan segments, same memory.  Measured on the real
-# chip (BENCH_CONFIGS_r03.json bench_prefix stage): flat 0.568s vs blocked
-# 0.600s per 67M-pt dispatch at int32 — XLA's native cumsum lowering beats
-# the hand-blocked form on TPU, so flat is the default (CPU favors blocked,
-# but defaults follow the chip).
+# offset scan) — shorter scan segments, same memory.  "subblock" = no
+# full-length scan at all: exact f64 sums of 32-point sub-blocks (a tree
+# reduce — one cheap pass), a cumsum over the [S, N/32] sub-block sums
+# (1/32 the scan work), and per-edge remainders as 32-wide masked dots.
+# Rationale (r4 chip attribution, tools/stage_bench.py): a full-length
+# f64 cumsum costs 95ms/67M pts on the chip while an f64 elementwise
+# pass costs 14ms — the emulated-f64 SCAN is the bottleneck, not the
+# data traffic, so the subblock form does 1/32 of it.
+# Measured on the real chip (BENCH_CONFIGS_r03.json bench_prefix stage):
+# flat 0.568s vs blocked 0.600s per 67M-pt dispatch at int32 — XLA's
+# native cumsum lowering beats the hand-blocked form on TPU.
 #
 # Env overrides (TSDB_SCAN_MODE / TSDB_SEARCH_MODE / TSDB_EXTREME_MODE,
 # read once at import): lets the one-command measurement session feed
 # bench_prefix's A/B winners into the later stages without editing
 # source mid-run.  Invalid values are ignored (defaults win).
+_SCAN_MODES = ("flat", "blocked", "subblock")
 _SCAN_MODE = (_os.environ.get("TSDB_SCAN_MODE")
-              if _os.environ.get("TSDB_SCAN_MODE") in ("flat", "blocked")
+              if _os.environ.get("TSDB_SCAN_MODE") in _SCAN_MODES
               else "flat")
 _SCAN_BLOCK = 512
+_SUB_K = 32      # subblock scan / hier search granule (power of two)
 
 _I32_BIG = np.int64(2**31 - 2)
 
@@ -206,19 +214,24 @@ _COMPACT_ENABLED = True
 # [S, W+1]-edges-into-[S, N] search this is a chain of ~17 gather passes.
 # "compare_all" = one broadcasted compare + sum-reduce (idx[s, w] =
 # #points < edge): O(N*W) VPU compares that XLA fuses into a streaming
-# reduction over W-tiles — no gathers at all.  Which wins depends on W:
-# compare_all work grows linearly with the edge count while scan's grows
-# logarithmically with N; bench_prefix A/Bs both on the chip.
+# reduction over W-tiles — no gathers at all.  "hier" = two-level
+# compare_all: count sub-block FIRST timestamps below each edge (rows are
+# time-sorted, so every earlier sub-block is entirely below the edge),
+# then resolve the one boundary sub-block with a 32-wide compare — the
+# compare work drops from O(N*W) to O(N*W/32 + 32*W).  r3/r4 chip data:
+# scan 182ms, compare_all ~116ms for the 65536x513 headline search.
+_SEARCH_MODES = ("scan", "compare_all", "hier")
 _SEARCH_MODE = (_os.environ.get("TSDB_SEARCH_MODE")
                 if _os.environ.get("TSDB_SEARCH_MODE")
-                in ("scan", "compare_all") else "scan")
+                in _SEARCH_MODES else "scan")
 
 
 def set_search_mode(mode: str) -> None:
-    """'scan' | 'compare_all' — edge-search strategy; clears caches."""
+    """'scan' | 'compare_all' | 'hier' — edge-search strategy; clears
+    caches."""
     global _SEARCH_MODE
-    if mode not in ("scan", "compare_all"):
-        raise ValueError("search mode must be 'scan' or 'compare_all'")
+    if mode not in _SEARCH_MODES:
+        raise ValueError("search mode must be one of %r" % (_SEARCH_MODES,))
     _SEARCH_MODE = mode
     _clear_dependent_caches()
 
@@ -254,10 +267,11 @@ def _clear_dependent_caches() -> None:
 
 
 def set_scan_mode(mode: str) -> None:
-    """'flat' | 'blocked' — benchmarking hook; clears affected jit caches."""
+    """'flat' | 'blocked' | 'subblock' — benchmarking hook; clears
+    affected jit caches."""
     global _SCAN_MODE
-    if mode not in ("flat", "blocked"):
-        raise ValueError("scan mode must be 'flat' or 'blocked'")
+    if mode not in _SCAN_MODES:
+        raise ValueError("scan mode must be one of %r" % (_SCAN_MODES,))
     _SCAN_MODE = mode
     _clear_dependent_caches()
 
@@ -320,6 +334,43 @@ def _edge_prefix_builder(s: int, n: int, idx):
         part = jnp.take_along_axis(intra.reshape(s, n), gather_pos, axis=1)
         part = jnp.where(zero_intra, jnp.zeros_like(part), part)
         at = base + part
+        return at[:, 1:] - at[:, :-1]
+    return windowed
+
+
+def _edge_subblock_builder(s: int, n: int, idx):
+    """windowed(data) with NO full-length scan (scan mode "subblock").
+
+    prefix(p) decomposes at the 32-point sub-block containing p: the sum
+    of every earlier sub-block (an exact f64 tree reduce + a cumsum over
+    [S, N/32] sub-block sums — 1/32 of the flat form's scan work) plus a
+    32-wide masked dot over the boundary sub-block, gathered as ONE
+    contiguous [1, K] slice per edge (vector loads, not 32 scalar
+    gathers).  Chip rationale: the emulated-f64 full-length cumsum costs
+    ~7x an elementwise f64 pass (tools/stage_bench.py r4) — this form
+    keeps the same f64 accumulation contract with 1/32 of the scan.
+    """
+    k = _SUB_K
+    nb = n // k
+    blk = idx // k                     # [S, W+1] boundary sub-block
+    off = idx - blk * k                # position within it
+    safe_blk = jnp.clip(blk, 0, nb - 1)
+    lanes = jnp.arange(k, dtype=off.dtype)
+
+    def windowed(data):
+        d3 = data.reshape(s, nb, k)
+        ssum = d3.sum(axis=2)                                   # [S, nb]
+        scum = jnp.concatenate(
+            [jnp.zeros((s, 1), data.dtype), jnp.cumsum(ssum, axis=1)],
+            axis=1)                                             # [S, nb+1]
+        base = jnp.take_along_axis(scum, blk, axis=1)
+        bvals = jnp.take_along_axis(
+            d3, safe_blk[:, :, None], axis=1)                   # [S, W+1, K]
+        # blk == nb (edge past every point) has off == 0, so the masked
+        # dot over the clipped gather contributes nothing there.
+        rem = jnp.where(lanes[None, None, :] < off[:, :, None],
+                        bvals, 0).sum(axis=2)
+        at = base + rem
         return at[:, 1:] - at[:, :-1]
     return windowed
 
@@ -407,6 +458,37 @@ def _window_ids_fast(ts, cts, spec: WindowSpec, wargs: dict):
     return window_ids(ts, spec, wargs)
 
 
+def _edge_search(cts, cedges):
+    """idx[S, W+1] = per-row count of points strictly below each edge.
+
+    "hier" exploits row sortedness at sub-block granularity: if a
+    sub-block's FIRST timestamp is below the edge, every point of every
+    EARLIER sub-block is too (each is <= that first) — so one compare+
+    count over the [S, N/32] sub-block firsts locates the boundary
+    sub-block, and a 32-wide compare over that one (contiguous) sub-block
+    finishes the count.  O(N*W/32) compares vs compare_all's O(N*W) and
+    scan's log2(N) serialized gather rounds.
+    """
+    s, n = cts.shape
+    if _SEARCH_MODE == "hier" and n % _SUB_K == 0 and n > _SUB_K:
+        k = _SUB_K
+        nb = n // k
+        c3 = cts.reshape(s, nb, k)
+        firsts = c3[:, :, 0]                                     # [S, nb]
+        nfull = jnp.sum(firsts[:, :, None] < cedges[None, None, :],
+                        axis=1)                                  # [S, W+1]
+        blk = jnp.maximum(nfull - 1, 0)     # boundary sub-block (nfull>0)
+        bvals = jnp.take_along_axis(c3, blk[:, :, None], axis=1)
+        rem = jnp.sum(bvals < cedges[None, :, None], axis=2)
+        idx = blk * k + rem
+        # int32 like searchsorted's result (n < 2^31): int64 here would
+        # push the subblock builder's edge arithmetic onto emulated ALUs
+        return jnp.where(nfull == 0, 0, idx).astype(jnp.int32)
+    method = ("compare_all" if _SEARCH_MODE == "compare_all" else "scan")
+    return jax.vmap(lambda row: jnp.searchsorted(
+        row, cedges, side="left", method=method))(cts)
+
+
 def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     """Shared preamble of the sorted-row window kernels: float view, valid
     mask, edge positions, the edge-prefix evaluator, and per-window counts.
@@ -418,10 +500,11 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     vf = val.astype(fdtype)
     ok = mask & ~jnp.isnan(vf)
     cts, cedges = _compact_ts(ts, spec, wargs)
-    method = ("compare_all" if _SEARCH_MODE == "compare_all" else "scan")
-    idx = jax.vmap(lambda row: jnp.searchsorted(
-        row, cedges, side="left", method=method))(cts)
-    windowed = _edge_prefix_builder(s, n, idx)
+    idx = _edge_search(cts, cedges)
+    if _SCAN_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K:
+        windowed = _edge_subblock_builder(s, n, idx)
+    else:
+        windowed = _edge_prefix_builder(s, n, idx)
     # Per-window counts: for a CLEAN batch — every unmasked slot is a pad
     # (ts at int64 max, beyond the last edge) and no masked value is NaN —
     # the edge positions already count exactly the participating points,

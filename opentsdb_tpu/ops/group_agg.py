@@ -58,15 +58,22 @@ def _identity(x):
 # Cross-series moment reduction strategy: "segment" scatters per-cell
 # partial moments with jax.ops.segment_sum (serializing on TPU), "matmul"
 # computes the same sums as onehot[G, S] @ grid[S, W] contractions — dense
-# MXU work, no scatter.  Both are float64 (Java-double contract); the sum
-# order differs so results can drift in the last ulp.  The chip A/B
-# (bench_prefix) picks the default via TSDB_GROUP_REDUCE_MODE; min/max
-# moments have no matmul form and keep segment ops either way.
+# MXU work, no scatter.  "sorted" permutes rows into group order on
+# device (argsort of gid — S elements, trivial) so every group is a
+# contiguous row run; group sums and extremes are short segmented
+# reset-scans along the tiny [S, W] grid's row axis — no scatter, no
+# one-hot, cost independent
+# of the group count (r4 chip attribution: the segment tail cost 219ms
+# and the matmul tail ~100ms on a 0.5M-cell grid that one pass covers
+# in ~1ms).  All are float64 (Java-double contract); the sum order
+# differs so results can drift in the last ulp.  The chip A/B
+# (bench_prefix) picks the default via TSDB_GROUP_REDUCE_MODE.
 import os as _os
 
+_GROUP_REDUCE_MODES = ("segment", "matmul", "sorted")
 _GROUP_REDUCE_MODE = (_os.environ.get("TSDB_GROUP_REDUCE_MODE")
                       if _os.environ.get("TSDB_GROUP_REDUCE_MODE")
-                      in ("segment", "matmul") else "segment")
+                      in _GROUP_REDUCE_MODES else "segment")
 
 # Shape gate for the matmul form: the dense one-hot is [S, G] f64, so a
 # wide group-by (10k groups) would build GBs and burn O(S*G*W) FLOPs —
@@ -79,13 +86,85 @@ def set_group_reduce_mode(mode: str) -> None:
     """Benchmarking/ops hook; clears the jitted pipelines that baked the
     old strategy in (read at trace time)."""
     global _GROUP_REDUCE_MODE
-    if mode not in ("segment", "matmul"):
-        raise ValueError("group reduce mode must be segment|matmul")
+    if mode not in _GROUP_REDUCE_MODES:
+        raise ValueError("group reduce mode must be one of %r"
+                         % (_GROUP_REDUCE_MODES,))
     _GROUP_REDUCE_MODE = mode
     # one list of toggle-dependent compiled programs, owned by downsample
     # (review r4: a hand-copied list here would drift)
     from opentsdb_tpu.ops.downsample import _clear_dependent_caches
     _clear_dependent_caches()
+
+
+class _SortedGroups:
+    """Rows permuted into group order: the machinery behind mode "sorted".
+
+    Group g's members occupy rows [bounds[g], bounds[g+1]) of the
+    permuted grid; rows with gid outside [0, G) sort past bounds[G] and
+    drop out.  Group sums AND extremes are segmented reset-scans over
+    the permuted row order, gathered at each group's last row.
+    Everything is [S, W]-sized vector work — no scatter.
+    """
+
+    def __init__(self, gid, num_groups: int, s: int):
+        self.g = num_groups
+        self.s = s
+        self.perm = jnp.argsort(gid, stable=True)
+        self.sorted_gid = jnp.take(gid, self.perm)
+        self.bounds = jnp.searchsorted(
+            self.sorted_gid, jnp.arange(num_groups + 1,
+                                        dtype=self.sorted_gid.dtype))
+        # reset flags: row starts a new group run (for the reset-scan)
+        self.flags = jnp.concatenate(
+            [jnp.ones((1,), bool),
+             self.sorted_gid[1:] != self.sorted_gid[:-1]])
+
+    def sum(self, x2d):
+        """[S, W] -> [G, W] per-group column sums via a segmented
+        reset-scan (NOT a cumsum differenced at bounds: that computes a
+        small group's sum as the difference of two large running totals,
+        and the cancellation error scales with the GLOBAL total — a
+        1e15-magnitude group next to a 1.0-magnitude group would break
+        the 1e-9 parity contract.  The reset-scan restarts each group's
+        accumulation at zero, so error scales with the group's own sum,
+        same as segment_sum)."""
+        from jax import lax
+        xs = jnp.take(x2d, self.perm, axis=0)
+        flags = jnp.broadcast_to(self.flags[:, None], xs.shape)
+
+        def combine(a, b):
+            av, af = a
+            bv, bf = b
+            return jnp.where(bf, bv, av + bv), af | bf
+
+        scanned, _ = lax.associative_scan(combine, (xs, flags), axis=0)
+        ends = jnp.clip(self.bounds[1:] - 1, 0, self.s - 1)
+        out = jnp.take(scanned, ends, axis=0)            # [G, W]
+        # empty groups gather a neighboring run's total: zero them
+        empty = (self.bounds[1:] == self.bounds[:-1])[:, None]
+        return jnp.where(empty, jnp.zeros_like(out), out)
+
+    def extreme(self, x2d, want_max: bool):
+        """[S, W] -> [G, W] per-group min or max via a reset-scan.
+
+        Callers pre-fill non-participating cells with the identity
+        (+inf for min / -inf for max); empty groups return the identity.
+        """
+        from jax import lax
+        xs = jnp.take(x2d, self.perm, axis=0)
+        flags = jnp.broadcast_to(self.flags[:, None], xs.shape)
+
+        def combine(a, b):
+            av, af = a
+            bv, bf = b
+            ext = jnp.maximum(av, bv) if want_max else jnp.minimum(av, bv)
+            return jnp.where(bf, bv, ext), af | bf
+
+        scanned, _ = lax.associative_scan(combine, (xs, flags), axis=0)
+        # group g's run ends at row bounds[g+1]-1; empty groups gather a
+        # clipped row and are masked by the caller's count grid
+        ends = jnp.clip(self.bounds[1:] - 1, 0, self.s - 1)
+        return jnp.take(scanned, ends, axis=0)
 
 
 def grid_contributions(grid_ts, val, mask, agg: Aggregator):
@@ -145,7 +224,26 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     num = g * w
 
     if agg_name in ("min", "mimmin", "max", "mimmax"):
-        # extremes have no matmul form: always segment ops + pmin/pmax
+        want_max = agg_name in ("max", "mimmax")
+        if _GROUP_REDUCE_MODE == "sorted":
+            # contiguous-run reset-scan over group-sorted rows: no scatter
+            sg = _SortedGroups(gid, g, s)
+            vf0 = contrib.astype(jnp.float64)
+            ok0 = participate & ~jnp.isnan(vf0)
+            local_cnt = sg.sum(ok0.astype(jnp.float64))         # [G, W]
+            cnt_grid = combine_sum(local_cnt.reshape(-1)) \
+                .reshape(g, w).astype(jnp.int64)
+            ident = -jnp.inf if want_max else jnp.inf
+            filled = jnp.where(ok0, vf0, ident)
+            ext = sg.extreme(filled, want_max)
+            # a group empty on THIS shard must contribute the identity to
+            # pmin/pmax, not the boundary gather's neighboring-run value
+            ext = jnp.where(local_cnt > 0.5, ext, ident).reshape(-1)
+            ext = (combine_max(ext) if want_max
+                   else combine_min(ext)).reshape(g, w)
+            out = jnp.where(cnt_grid > 0, ext, jnp.nan)
+            return out, cnt_grid
+        # segment/matmul modes: extremes have no matmul form — scatter ops
         seg, ok, v = _flat_segments(contrib, participate, gid, g)
         cnt = combine_sum(jax.ops.segment_sum(ok.astype(jnp.int64), seg,
                                               num_segments=num))
@@ -170,7 +268,12 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     use_matmul = (_GROUP_REDUCE_MODE == "matmul"
                   and g <= _MATMUL_MAX_GROUPS
                   and s * g * 8 <= _MATMUL_MAX_ONEHOT_BYTES)
-    if use_matmul:
+    if _GROUP_REDUCE_MODE == "sorted":
+        sg = _SortedGroups(gid, g, s)
+
+        def gsum(x2d):   # [S, W] -> [G, W], cross-chip combined
+            return combine_sum(sg.sum(x2d).reshape(-1)).reshape(g, w)
+    elif use_matmul:
         # out[g, w] = Σ_s onehot[s, g] * grid[s, w] — dense MXU work, no
         # serializing scatter.  Counts are 0/1 sums (exact in f64 far
         # beyond any real S); value sums reassociate vs segment_sum, so
@@ -309,9 +412,16 @@ def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
         out, _ = ordered_group_reduce(agg.name, contrib, participate, gid,
                                       num_groups)
     s, w = val.shape
-    cols = jnp.arange(w, dtype=jnp.int64)[None, :]
-    seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
-    present = jax.ops.segment_sum(mask.reshape(-1).astype(jnp.int64), seg,
-                                  num_segments=num_groups * w)
-    out_mask = present.reshape(num_groups, w) > 0
+    if _GROUP_REDUCE_MODE == "sorted":
+        # same reset-scan machinery (XLA CSEs the repeated argsort)
+        present = _SortedGroups(gid, num_groups, s).sum(
+            mask.astype(jnp.float64))
+        out_mask = present > 0.5
+    else:
+        cols = jnp.arange(w, dtype=jnp.int64)[None, :]
+        seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
+        present = jax.ops.segment_sum(
+            mask.reshape(-1).astype(jnp.int64), seg,
+            num_segments=num_groups * w)
+        out_mask = present.reshape(num_groups, w) > 0
     return grid_ts, out, out_mask

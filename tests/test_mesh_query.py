@@ -240,3 +240,69 @@ class TestMatmulGroupReduce:
                 assert_equivalent(_run(meshed, m), wants[m])
         finally:
             group_agg.set_group_reduce_mode("segment")
+
+
+class TestSortedGroupReduce:
+    """group-reduce mode "sorted" (r4 chip-attribution lever): rows are
+    argsort-permuted into contiguous group runs, sums become axis-0
+    cumsum-diffs and extremes a segmented reset-scan — no scatter, no
+    one-hot.  Must answer exactly like the segment scatter, on and off
+    the mesh, for every moment aggregator including the extremes (which,
+    unlike matmul mode, have a native sorted form)."""
+
+    QUERIES = MOMENT_QUERIES + [
+        "movingAverage3:1m-sum:sys.cpu.user{dc=*}",
+        "min:1m-max:sys.cpu.user{dc=*}",
+        "max:1m-min:sys.cpu.user{host=*}",
+    ]
+
+    def test_sorted_equals_segment(self):
+        from opentsdb_tpu.ops import group_agg
+        t = _mk_tsdb(False)
+        _ingest(t)
+        wants = {m: _run(t, m) for m in self.QUERIES}       # segment mode
+        group_agg.set_group_reduce_mode("sorted")
+        try:
+            for m in self.QUERIES:
+                assert_equivalent(_run(t, m), wants[m])
+        finally:
+            group_agg.set_group_reduce_mode("segment")
+
+    def test_sorted_on_mesh(self, pair):
+        """The sorted machinery runs per-shard inside shard_map (each chip
+        sorts its local rows; psum/pmin/pmax combine across chips) — one
+        mode flip for the whole sweep."""
+        from opentsdb_tpu.ops import group_agg
+        meshed, plain = pair
+        wants = {m: _run(plain, m) for m in self.QUERIES}   # segment mode
+        group_agg.set_group_reduce_mode("sorted")
+        try:
+            for m in self.QUERIES:
+                assert_equivalent(_run(meshed, m), wants[m])
+        finally:
+            group_agg.set_group_reduce_mode("segment")
+
+    def test_sorted_sum_magnitude_skew(self):
+        """Cross-group cancellation regression (r4 review): a 1.0-magnitude
+        group next to a 1e15-magnitude group must keep 1e-9 relative
+        accuracy — the reset-scan form restarts accumulation per group,
+        where a cumsum differenced at group bounds would lose the small
+        group entirely in the big group's running total."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops import group_agg
+        s, w, g = 8, 4, 2
+        contrib = np.ones((s, w))
+        contrib[:4] = 1e15           # group 0 rows dwarf group 1's
+        contrib[4:] = 0.25
+        part = np.ones((s, w), bool)
+        gid = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+        group_agg.set_group_reduce_mode("sorted")
+        try:
+            out, cnt = group_agg.moment_group_reduce(
+                "sum", jnp.asarray(contrib), jnp.asarray(part),
+                jnp.asarray(gid), g)
+        finally:
+            group_agg.set_group_reduce_mode("segment")
+        np.testing.assert_allclose(np.asarray(out)[0], 4e15, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(out)[1], 1.0, rtol=1e-12)
+        np.testing.assert_array_equal(np.asarray(cnt), 4)

@@ -149,14 +149,17 @@ class TestScanModesAndCompaction:
                                    rtol=1e-11, atol=1e-9)
 
     @pytest.mark.parametrize("agg", sorted(PREFIX_AGGS))
-    def test_blocked_equals_flat_equals_reference(self, agg):
+    def test_scan_modes_agree_and_match_reference(self, agg):
+        """flat / blocked / subblock scan forms index and sum identically
+        (subblock replaces the full-length f64 cumsum with sub-block
+        reduces + 32-wide remainder dots — r4 chip attribution)."""
         from opentsdb_tpu.ops import downsample as ds_mod
         rng = np.random.default_rng(11)
         ts, val, mask = self._big_batch(rng)
         windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
         spec, wargs = windows.split()
         outs = {}
-        for mode in ("flat", "blocked"):
+        for mode in ("flat", "blocked", "subblock"):
             ds_mod.set_scan_mode(mode)
             try:
                 _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
@@ -164,12 +167,14 @@ class TestScanModesAndCompaction:
             finally:
                 ds_mod.set_scan_mode("flat")  # restore the chip-won default
             outs[mode] = (np.asarray(out), np.asarray(omask))
-        np.testing.assert_array_equal(outs["flat"][1], outs["blocked"][1])
-        m = outs["flat"][1]
-        np.testing.assert_allclose(outs["blocked"][0][m], outs["flat"][0][m],
-                                   rtol=1e-12, atol=1e-12)
+        for mode in ("blocked", "subblock"):
+            np.testing.assert_array_equal(outs["flat"][1], outs[mode][1])
+            m = outs["flat"][1]
+            np.testing.assert_allclose(outs[mode][0][m], outs["flat"][0][m],
+                                       rtol=1e-12, atol=1e-12)
         self._assert_matches_reference(ts, val, mask, agg, windows,
-                                       outs["blocked"][0], outs["blocked"][1])
+                                       outs["subblock"][0],
+                                       outs["subblock"][1])
 
     @pytest.mark.parametrize("agg", ["avg", "count", "dev"])
     def test_dirty_batches_take_the_counted_path(self, agg):
@@ -210,17 +215,20 @@ class TestScanModesAndCompaction:
         self._assert_matches_reference(ts, val, mask, agg, windows, out,
                                        omask)
 
-    @pytest.mark.parametrize("agg", ["avg", "sum", "count", "dev"])
-    def test_compare_all_search_equals_scan(self, agg):
-        """The compare_all edge search (fused compare+reduce, no gathers)
-        must index identically to the binary search on every grid kind."""
+    @pytest.mark.parametrize("agg", ["avg", "sum", "count", "dev", "min",
+                                     "max"])
+    def test_search_modes_agree(self, agg):
+        """compare_all (fused compare+reduce) and hier (sub-block firsts +
+        32-wide remainder compare) must index identically to the binary
+        search — min/max included: the extreme reset-scan consumes the
+        same edge positions."""
         from opentsdb_tpu.ops import downsample as ds_mod
         rng = np.random.default_rng(23)
         ts, val, mask = self._big_batch(rng)
         windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
         spec, wargs = windows.split()
         outs = {}
-        for mode in ("scan", "compare_all"):
+        for mode in ("scan", "compare_all", "hier"):
             ds_mod.set_search_mode(mode)
             try:
                 _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
@@ -228,11 +236,47 @@ class TestScanModesAndCompaction:
             finally:
                 ds_mod.set_search_mode("scan")
             outs[mode] = (np.asarray(out), np.asarray(omask))
-        np.testing.assert_array_equal(outs["scan"][1], outs["compare_all"][1])
-        m = outs["scan"][1]
-        np.testing.assert_allclose(outs["compare_all"][0][m],
-                                   outs["scan"][0][m],
-                                   rtol=1e-12, atol=1e-12)
+        for mode in ("compare_all", "hier"):
+            np.testing.assert_array_equal(outs["scan"][1], outs[mode][1])
+            m = outs["scan"][1]
+            np.testing.assert_allclose(outs[mode][0][m],
+                                       outs["scan"][0][m],
+                                       rtol=1e-12, atol=1e-12)
+
+    def test_hier_search_tie_timestamps(self):
+        """Duplicate timestamps straddling sub-block boundaries: the hier
+        search's strict-< decomposition must agree with searchsorted
+        'left' when runs of equal timestamps cross the 32-point granule
+        and when edges land exactly on a timestamp."""
+        from opentsdb_tpu.ops import downsample as ds_mod
+        s, n = 2, 128
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        # row 0: one value repeated across 3 sub-blocks, edge == the value
+        t0 = START + 60_000
+        ts[0, :100] = t0
+        val[0, :100] = 1.0
+        mask[0, :100] = True
+        # row 1: ties at a window edge exactly at a sub-block boundary
+        ts[1, :64] = START
+        ts[1, 64:96] = START + 120_000
+        val[1, :96] = 2.0
+        mask[1, :96] = True
+        windows = FixedWindows.for_range(START, START + 300_000, 60_000)
+        spec, wargs = windows.split()
+        outs = {}
+        for mode in ("scan", "hier"):
+            ds_mod.set_search_mode(mode)
+            try:
+                _, out, omask = downsample(ts, val, mask, "sum", spec,
+                                           wargs, FILL_NONE)
+            finally:
+                ds_mod.set_search_mode("scan")
+            outs[mode] = (np.asarray(out), np.asarray(omask))
+        np.testing.assert_array_equal(outs["scan"][1], outs["hier"][1])
+        np.testing.assert_allclose(outs["hier"][0][outs["scan"][1]],
+                                   outs["scan"][0][outs["scan"][1]])
 
     def test_int64_fallback_for_wide_grids(self):
         """A grid spanning >= 2^31 ms must keep int64 timestamps and still

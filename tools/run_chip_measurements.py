@@ -54,34 +54,39 @@ def pick_winners(prefix_records: list[dict]) -> dict:
     env = {}
     by_cfg = {r["config"]: r["s_per_dispatch"] for r in prefix_records
               if "config" in r and "s_per_dispatch" in r}
-    racers = {
-        "flat+int64": ("flat", "0", "scan"),
-        "flat+int32": ("flat", "1", "scan"),
-        "blocked+int64": ("blocked", "0", "scan"),
-        "blocked+int32": ("blocked", "1", "scan"),
-        "flat+int32+search_scan": ("flat", "1", "scan"),
-        "flat+int32+search_compare_all": ("flat", "1", "compare_all"),
+
+    # Every candidate row is a COMPLETE measured configuration
+    # (scan, search, group) — the winner is the fastest row actually
+    # timed on the chip, never an unmeasured composition of per-axis
+    # winners (fusion can interact; the combo row exists precisely so a
+    # subblock+hier+sorted regression would disqualify itself here).
+    # int64 / f32 rows are evidence-only: int32 compaction is the
+    # baked default and f32 breaks the Java-double contract.
+    full_rows = {
+        "flat+int32": ("flat", "scan", "segment"),
+        "blocked+int32": ("blocked", "scan", "segment"),
+        "subblock+int32": ("subblock", "scan", "segment"),
+        "flat+int32+search_scan": ("flat", "scan", "segment"),
+        "flat+int32+search_compare_all": ("flat", "compare_all", "segment"),
+        "flat+int32+search_hier": ("flat", "hier", "segment"),
+        "flat+int32+group_segment": ("flat", "scan", "segment"),
+        "flat+int32+group_matmul": ("flat", "scan", "matmul"),
+        "flat+int32+group_sorted": ("flat", "scan", "sorted"),
+        "subblock+int32+hier+sorted": ("subblock", "hier", "sorted"),
     }
-    timed = [(by_cfg[c], cfg) for c, cfg in racers.items() if c in by_cfg]
+    timed = [(by_cfg[c], modes) for c, modes in full_rows.items()
+             if c in by_cfg]
     if timed:
-        _, (scan, compact, search) = min(timed)
+        _, (scan, search, group) = min(timed)
         env["TSDB_SCAN_MODE"] = scan
         env["TSDB_SEARCH_MODE"] = search
-        # compaction has no env toggle knob needed: int32 won on chip and
-        # is the default; record the evidence only
-        del compact
+        env["TSDB_GROUP_REDUCE_MODE"] = group
     ext = {c: by_cfg[c] for c in ("min+extreme_scan", "min+extreme_segment")
            if c in by_cfg}
     if len(ext) == 2:
         env["TSDB_EXTREME_MODE"] = (
             "scan" if ext["min+extreme_scan"] <= ext["min+extreme_segment"]
             else "segment")
-    grp = {c: by_cfg[c] for c in ("flat+int32+group_segment",
-                                  "flat+int32+group_matmul") if c in by_cfg}
-    if len(grp) == 2:
-        env["TSDB_GROUP_REDUCE_MODE"] = (
-            "segment" if grp["flat+int32+group_segment"]
-            <= grp["flat+int32+group_matmul"] else "matmul")
     if env:
         print("== A/B winners -> %s ==" % env, file=sys.stderr, flush=True)
     return env

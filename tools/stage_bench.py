@@ -96,6 +96,18 @@ def main() -> None:
             lambda row: jnp.searchsorted(row, e, side="left"))(t)),
         (cts, cedges), rtt))
 
+    # r4 attribution-driven forms, timed beside the originals
+    def search_hier(t, e):
+        mode = ds._SEARCH_MODE
+        ds._SEARCH_MODE = "hier"
+        try:
+            return ds._edge_search(t, e)
+        finally:
+            ds._SEARCH_MODE = mode
+
+    record("searchsorted_hier", time_fn(
+        jax.jit(search_hier), (cts, cedges), rtt))
+
     def windowed_avg(v, m, i):
         builder = ds._edge_prefix_builder(S, N, i)
         ok = m & ~jnp.isnan(v)
@@ -105,6 +117,16 @@ def main() -> None:
 
     record("windowed_avg_given_idx", time_fn(
         jax.jit(windowed_avg), (val, mask, idx), rtt))
+
+    def windowed_avg_subblock(v, m, i):
+        builder = ds._edge_subblock_builder(S, N, i)
+        ok = m & ~jnp.isnan(v)
+        count = builder(ok.astype(jnp.int32))
+        total = builder(jnp.where(ok, v, 0.0))
+        return total / jnp.maximum(count, 1)
+
+    record("windowed_avg_subblock", time_fn(
+        jax.jit(windowed_avg_subblock), (val, mask, idx), rtt))
 
     def full_downsample(t, v, m):
         return ds.downsample(t, v, m, "avg", window_spec, wargs)
@@ -121,6 +143,20 @@ def main() -> None:
         jax.jit(lambda g, v, m, gi: grid_group_aggregate(
             g, v, m, gi, g_pad, agg_sum)),
         (wts0, dval, dmask, jnp.asarray(gid)), rtt))
+
+    from opentsdb_tpu.ops import group_agg as ga
+
+    def group_tail_sorted(g, v, m, gi):
+        mode = ga._GROUP_REDUCE_MODE
+        ga._GROUP_REDUCE_MODE = "sorted"
+        try:
+            return grid_group_aggregate(g, v, m, gi, g_pad, agg_sum)
+        finally:
+            ga._GROUP_REDUCE_MODE = mode
+
+    record("group_tail_sorted", time_fn(
+        jax.jit(group_tail_sorted), (wts0, dval, dmask, jnp.asarray(gid)),
+        rtt))
 
     from bench import dispatch
     record("full_pipeline", time_fn(
