@@ -109,7 +109,8 @@ STEP_MEAN_MS = 15_500     # ~15.5s cadence -> ~11.8 days of data
 MIN_WALL_S = 1.0          # guard 3: total measured time must exceed this
 MIN_SAMPLES = 5
 MAX_SAMPLES = 64
-BYTES_PER_DP = 17         # ts int64 + val f64 + mask byte, touched >= once
+BYTES_PER_DP = 13         # ts int32 + val f64 + mask byte, touched >= once
+#                           (cache-hit layout: int32 offset timestamps)
 HBM_CAP_BYTES_S = 3.5e12  # guard 4: no TPU chip streams faster than this
 PIPELINE_K = 8            # cross-check dispatch count
 
@@ -133,21 +134,29 @@ class _OriginSequence:
 
 
 def make_batch():
-    """Device-resident [S, N] batch via a jitted closed-form generator."""
+    """Device-resident [S, N] batch via a jitted closed-form generator.
+
+    Timestamps are int32 offsets from the first window's start — the
+    layout the device cache's gather delivers for eligible fixed grids
+    (storage/device_cache.py `ts_base`), so the measured dispatch is the
+    production cache-hit dispatch: no per-point compaction pass.
+    """
     import opentsdb_tpu.ops  # noqa: F401  (enables jax x64 mode)
     import jax
     import jax.numpy as jnp
+
+    first = START - (START % INTERVAL_MS)
 
     def gen():
         rows = jnp.arange(S, dtype=jnp.int64)
         cols = jnp.arange(N, dtype=jnp.int64)
         h = (rows[:, None] * 2_654_435_761 + cols[None, :] * 40_503) \
             & 0x7FFFFFFF
-        ts = START + cols[None, :] * STEP_MEAN_MS + h % 5_000
+        ts = (START - first) + cols[None, :] * STEP_MEAN_MS + h % 5_000
         val = 100.0 + (h % 1_000).astype(jnp.float64) * 0.05
         mask = jnp.ones((S, N), dtype=bool)
         gid = rows % GROUPS
-        return ts, val, mask, gid
+        return ts.astype(jnp.int32), val, mask, gid
 
     out = jax.jit(gen)()
     jax.block_until_ready(out)
@@ -155,12 +164,16 @@ def make_batch():
 
 
 def build_spec():
+    import jax.numpy as jnp
     from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
     from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
 
     end = START + N * STEP_MEAN_MS + 5_000
     fixed = FixedWindows.for_range(START, end, INTERVAL_MS)
     window_spec, wargs = fixed.split()
+    # the batch carries int32 offsets from the first window (make_batch);
+    # ts_base tells the pipeline so only the [W+1] edges re-base
+    wargs["ts_base"] = jnp.asarray(fixed.first_window_ms, jnp.int64)
     spec = PipelineSpec(
         aggregator="sum",
         downsample=DownsampleStep("avg", window_spec, "none", 0.0))

@@ -118,8 +118,22 @@ class AllWindow:
                  "nwin": jnp.asarray(1, jnp.int32)})
 
 
+def _absolute_ts(ts, wargs: dict):
+    """Reconstruct absolute int64 timestamps from a pre-compacted batch.
+
+    Device-cache hits can arrive as int32 offsets from wargs["ts_base"]
+    (the per-point compaction pass moved into the cache's gather
+    dispatch); paths that need absolute time (the segment fallback, edge
+    grids) lift back to int64 here.  int64 batches pass through.
+    """
+    if ts.dtype == jnp.int32 and "ts_base" in wargs:
+        return ts.astype(jnp.int64) + wargs["ts_base"]
+    return ts
+
+
 def window_ids(ts, spec: WindowSpec, wargs: dict):
     """Window index per point; negative / >= count means outside any window."""
+    ts = _absolute_ts(ts, wargs)
     if spec.kind == "fixed":
         return ((ts - wargs["first"]) // spec.interval_ms).astype(jnp.int64)
     if spec.kind == "edges":
@@ -205,6 +219,11 @@ _SCAN_BLOCK = 512
 _SUB_K = 32      # subblock scan / hier search granule (power of two)
 
 _I32_BIG = np.int64(2**31 - 2)
+# Pad sentinel for int32 batches — the exact value the device cache's
+# ts_base gather writes (storage.device_cache.I32_PAD_TS mirrors this;
+# a parity test pins the pair).  Clean-batch detection compares against
+# it and pad sorting relies on it exceeding every re-based edge.
+_I32_PAD = np.int32(2**31 - 2)
 
 
 _COMPACT_ENABLED = True
@@ -375,6 +394,22 @@ def _edge_subblock_builder(s: int, n: int, idx):
     return windowed
 
 
+def precompact_base(spec: WindowSpec, first_window_ms) -> int | None:
+    """The int32 pre-compaction base for a batch source, or None.
+
+    When a fixed grid provably spans < 2^31 ms, batch builders (the
+    device cache's gather) may deliver timestamps as int32 offsets from
+    this base — the per-point compaction pass then disappears from the
+    query dispatch entirely (r4 chip attribution: 74ms of the headline
+    dispatch was the ts - first sub+clip+cast over [S, N] int64).
+    """
+    if (_COMPACT_ENABLED and spec.kind == "fixed"
+            and first_window_ms is not None
+            and (spec.count + 1) * spec.interval_ms < 2**31 - 2):
+        return int(first_window_ms)
+    return None
+
+
 def _compact_ts(ts, spec: WindowSpec, wargs: dict):
     """(ts', edges') for the prefix path: int32 ms offsets when
     the whole fixed-window grid provably spans < 2^31 ms.
@@ -385,7 +420,16 @@ def _compact_ts(ts, spec: WindowSpec, wargs: dict):
     from the traced window origin fit int32, and clipping keeps the
     int64-max padding timestamps sorted (they land beyond the last edge,
     exactly like before).  Calendar/all grids keep int64.
+
+    Pre-compacted batches (int32 offsets from wargs["ts_base"], built by
+    the device cache's gather dispatch) skip the per-point pass: only
+    the [W+1] edge vector is re-based here.
     """
+    if ts.dtype == jnp.int32 and "ts_base" in wargs:
+        edges64 = window_edges(jnp.int64, spec, wargs)
+        edges32 = jnp.clip(edges64 - wargs["ts_base"],
+                           -_I32_BIG, _I32_BIG).astype(jnp.int32)
+        return ts, edges32
     edges64 = window_edges(ts.dtype, spec, wargs)
     if not _COMPACT_ENABLED or spec.kind != "fixed" or \
             (spec.count + 1) * spec.interval_ms >= 2**31 - 2:
@@ -454,8 +498,40 @@ def _window_ids_fast(ts, cts, spec: WindowSpec, wargs: dict):
     int64 arithmetic.  Non-fixed grids keep the generic search.
     """
     if spec.kind == "fixed" and cts.dtype == jnp.int32:
+        if ts.dtype == jnp.int32 and "ts_base" in wargs:
+            # pre-compacted batch: cts is relative to ts_base, not to the
+            # window origin — re-base with one int32 scalar subtract
+            shift = (wargs["first"] - wargs["ts_base"]).astype(jnp.int32)
+            return (cts - shift) // jnp.int32(spec.interval_ms)
         return cts // jnp.int32(spec.interval_ms)
     return window_ids(ts, spec, wargs)
+
+
+# Dense-vs-binary search crossover.  Per edge, compare_all costs N
+# compares, hier N/32 compares, the binary search log2(N) serialized
+# gathers; every form is linear in the edge count, so the decision is a
+# RATIO of per-edge costs, independent of W.  The r4 chip attribution
+# measured ~20ns/gather (scan: 182ms / 8.9M gathers) vs ~3.4ps/compare
+# (compare_all: 116ms / 34e9) — a ~5900x gap; 4096 is the conservative
+# round-down, placing the compare_all crossover just past the headline's
+# N=65536 (where compare_all measured faster) and well before a
+# streaming chunk's N=1M (config 2's W~10M grid: a dense search there
+# burned the whole 2400s chip budget in r4).
+_SEARCH_DEMOTE_RATIO = 4096
+
+
+def _effective_search_mode(s: int, n: int, w_edges: int) -> str:
+    """The configured search mode, demoted to "scan" for shapes where the
+    dense form's per-edge compare cost would dwarf the binary search's
+    per-edge gather cost."""
+    del s, w_edges   # both forms scale linearly with these
+    mode = _SEARCH_MODE
+    logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
+    if mode == "compare_all" and n > _SEARCH_DEMOTE_RATIO * logn:
+        return "scan"
+    if mode == "hier" and n // _SUB_K > _SEARCH_DEMOTE_RATIO * logn:
+        return "scan"
+    return mode
 
 
 def _edge_search(cts, cedges):
@@ -470,7 +546,8 @@ def _edge_search(cts, cedges):
     scan's log2(N) serialized gather rounds.
     """
     s, n = cts.shape
-    if _SEARCH_MODE == "hier" and n % _SUB_K == 0 and n > _SUB_K:
+    mode = _effective_search_mode(s, n, cedges.shape[0])
+    if mode == "hier" and n % _SUB_K == 0 and n > _SUB_K:
         k = _SUB_K
         nb = n // k
         c3 = cts.reshape(s, nb, k)
@@ -484,7 +561,7 @@ def _edge_search(cts, cedges):
         # int32 like searchsorted's result (n < 2^31): int64 here would
         # push the subblock builder's edge arithmetic onto emulated ALUs
         return jnp.where(nfull == 0, 0, idx).astype(jnp.int32)
-    method = ("compare_all" if _SEARCH_MODE == "compare_all" else "scan")
+    method = ("compare_all" if mode == "compare_all" else "scan")
     return jax.vmap(lambda row: jnp.searchsorted(
         row, cedges, side="left", method=method))(cts)
 
@@ -506,13 +583,15 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     else:
         windowed = _edge_prefix_builder(s, n, idx)
     # Per-window counts: for a CLEAN batch — every unmasked slot is a pad
-    # (ts at int64 max, beyond the last edge) and no masked value is NaN —
-    # the edge positions already count exactly the participating points,
-    # so count = diff(idx) and the dedicated int32 cumsum pass (a full
-    # [S, N] scan + gather, as expensive as the value scan it sits next
-    # to) is skipped.  Batches from build_batch / the device cache are
-    # clean by construction; NaN data or exotic masks take the scan.
-    clean = ~jnp.any(ok ^ (ts != _I64_MAX))
+    # (ts at the pad sentinel, beyond the last edge) and no masked value
+    # is NaN — the edge positions already count exactly the participating
+    # points, so count = diff(idx) and the dedicated int32 cumsum pass (a
+    # full [S, N] scan + gather, as expensive as the value scan it sits
+    # next to) is skipped.  Batches from build_batch / the device cache
+    # are clean by construction; NaN data or exotic masks take the scan.
+    # Pre-compacted int32 batches pad at the clip ceiling, not int64 max.
+    pad_sentinel = _I32_PAD if ts.dtype == jnp.int32 else _I64_MAX
+    clean = ~jnp.any(ok ^ (ts != pad_sentinel))
     count = jax.lax.cond(
         clean,
         lambda: (idx[:, 1:] - idx[:, :-1]).astype(jnp.int64),

@@ -514,11 +514,23 @@ class QueryRunner:
             # the series were resolved from (raw store, a rollup lane, or
             # the pre-agg lane) — entries key on the store object, so each
             # coexists in the cache.
+            # ts_base: eligible fixed grids get int32 offset timestamps
+            # straight from the gather (the compaction pass leaves the
+            # query dispatch — r4 chip attribution).  Mesh queries keep
+            # int64: shard_rows_device's row padding is int64-typed.
+            from opentsdb_tpu.ops.downsample import precompact_base
+            ts_base = None if use_mesh else precompact_base(
+                window_spec, getattr(windows, "first_window_ms", None))
             cached = tsdb.device_cache.batch_for(
                 store, series_list[0].key.metric, series_list,
-                seg.start_ms, seg.end_ms, fix, build=not would_stream)
+                seg.start_ms, seg.end_ms, fix, build=not would_stream,
+                ts_base=ts_base)
             if cached is not None:
                 self.exec_stats["deviceCacheHit"] = 1.0
+                if ts_base is not None:
+                    import jax.numpy as jnp
+                    wargs = dict(wargs)
+                    wargs["ts_base"] = jnp.asarray(ts_base, jnp.int64)
                 if would_stream:
                     # warm hit diverted a streaming query onto the
                     # materialized path: it still builds the [S, W] grid

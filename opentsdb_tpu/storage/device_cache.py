@@ -52,6 +52,11 @@ _LOG = logging.getLogger("device_cache")
 # and this module must stay importable numpy-only (tests assert the PAD_TS
 # parity so the mirror cannot drift silently).
 PAD_TS = np.iinfo(np.int64).max
+# Pad sentinel for int32 pre-compacted batches (the ts_base gather):
+# mirrors ops.downsample._I32_PAD under the same no-jax-import rule;
+# the parity test pins the two (clean-batch detection and pad sorting
+# both depend on the exact value).
+I32_PAD_TS = np.int32(2**31 - 2)
 _BYTES_PER_POINT = 16  # int64 ts + float64 val
 
 
@@ -126,7 +131,7 @@ class DeviceSeriesCache:
 
     def batch_for(self, store, metric: int, series_list, start_ms: int,
                   end_ms: int, fix_duplicates: bool = True,
-                  build: bool = True):
+                  build: bool = True, ts_base: int | None = None):
         """Device [S, N] (ts, val, mask) for the series' windows, or None.
 
         A None return means cold/stale/over-budget — the caller uses its
@@ -136,6 +141,13 @@ class DeviceSeriesCache:
         streaming scan overlaps transfer with compute; a blocking full-
         metric upload first would be strictly worse).  Staleness likewise
         only ever queues a background rebuild.
+
+        `ts_base` (from ops.downsample.precompact_base) asks the gather
+        to emit timestamps as int32 offsets from that base — the query
+        dispatch then skips its per-point compaction pass entirely.  The
+        caller guarantees the window grid spans < 2^31 ms from the base;
+        pads land at the int32 clip ceiling (sorted past every edge,
+        mirroring the int64 PAD_TS contract).
         """
         ekey = (id(store), metric)
         with self._lock:
@@ -175,7 +187,11 @@ class DeviceSeriesCache:
             starts[i] = entry.offsets[row] + lo
             lengths[i] = hi - lo
         n = _pad_pow2(max(int(lengths.max(initial=0)), 1))
-        if s * n * 17 > self.batch_max_bytes:   # ts8 + val8 + mask1
+        # ts8+val8+mask1, or ts4+val8+mask1 for int32 pre-compacted
+        # batches — the budget must not decline batches the smaller
+        # layout actually fits
+        per_point = 13 if ts_base is not None else 17
+        if s * n * per_point > self.batch_max_bytes:
             self._count("misses")
             return None
         with self._lock:
@@ -183,7 +199,7 @@ class DeviceSeriesCache:
             entry.tick = self._tick
             self.hits += 1
         return _gather_windows(entry.ts_dev, entry.val_dev,
-                               starts, lengths, n)
+                               starts, lengths, n, ts_base)
 
     # -- build / refresh -------------------------------------------------
 
@@ -314,27 +330,42 @@ def _to_device(arr: np.ndarray):
 _GATHER_CACHE: dict = {}
 
 
-def _gather_windows(ts_buf, val_buf, starts, lengths, n: int):
+def _gather_windows(ts_buf, val_buf, starts, lengths, n: int,
+                    ts_base: int | None = None):
     """One-dispatch on-device batch assembly from the pinned buffers.
 
     out[i, j] = buf[starts[i] + j] masked to j < lengths[i]; pads mirror
     build_batch (PAD_TS timestamps keep rows sorted for the prefix path).
     Compiled once per (buffer length, N) — both pow2-padded.
+
+    With `ts_base`, timestamps come back as int32 offsets from the base
+    (the compaction fused into this gather — the query dispatch already
+    paying for this data pass makes the sub+cast free, r4 attribution):
+    pads sit at the int32 clip ceiling, past every window edge.
     """
     import jax
     import jax.numpy as jnp
 
-    key = n
+    key = (n, ts_base is not None)
     fn = _GATHER_CACHE.get(key)
     if fn is None:
-        def gather(tb, vb, st, ln):
+        i32_ceiling = I32_PAD_TS
+
+        def gather(tb, vb, st, ln, base):
             j = jnp.arange(n, dtype=jnp.int64)
             idx = st[:, None] + j[None, :]
             m = j[None, :] < ln[:, None]
             safe = jnp.clip(idx, 0, tb.shape[0] - 1)
-            ts = jnp.where(m, tb[safe], PAD_TS)
+            if ts_base is None:
+                ts = jnp.where(m, tb[safe], PAD_TS)
+            else:
+                off = jnp.clip(tb[safe] - base, 0, i32_ceiling) \
+                    .astype(jnp.int32)
+                ts = jnp.where(m, off, i32_ceiling)
             val = jnp.where(m, vb[safe], 0.0)
             return ts, val, m
         fn = jax.jit(gather)
         _GATHER_CACHE[key] = fn
-    return fn(ts_buf, val_buf, jnp.asarray(starts), jnp.asarray(lengths))
+    base = jnp.asarray(0 if ts_base is None else ts_base, jnp.int64)
+    return fn(ts_buf, val_buf, jnp.asarray(starts), jnp.asarray(lengths),
+              base)

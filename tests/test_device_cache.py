@@ -170,6 +170,16 @@ class TestStaleness:
         from opentsdb_tpu.storage.device_cache import PAD_TS as CACHE_PAD
         assert PIPE_PAD == CACHE_PAD
 
+    def test_i32_pad_contract_matches_downsample(self):
+        """The int32 pre-compacted pad sentinel is mirrored (the cache
+        must stay importable without jax): clean-batch detection and pad
+        sorting both break silently if the two ever drift."""
+        import numpy as np
+        from opentsdb_tpu.ops.downsample import _I32_PAD
+        from opentsdb_tpu.storage.device_cache import I32_PAD_TS
+        assert _I32_PAD == I32_PAD_TS
+        assert I32_PAD_TS.dtype == np.int32
+
     def test_dropcaches_clears(self):
         tsdb = make_tsdb()
         run_group_query(tsdb)

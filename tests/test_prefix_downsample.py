@@ -442,3 +442,152 @@ class TestExtremeScanPath:
         m = np.asarray(wmask)
         np.testing.assert_array_equal(np.asarray(got)[m],
                                       np.asarray(want)[m])
+
+
+class TestPrecompactedBatches:
+    """int32 pre-compacted batches (device-cache gather layout, r4): the
+    query dispatch receives ts as int32 offsets from wargs["ts_base"] and
+    must answer identically to the absolute-int64 batch on every path —
+    prefix family, extremes, and the segment fallback (percentiles) that
+    reconstructs absolute time."""
+
+    I32_PAD = np.int32(2**31 - 2)
+
+    def _pair(self, rng, s=4, n=512, spread_ms=40_000_000):
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import FixedWindows, precompact_base
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        for i in range(s):
+            k = int(rng.integers(n // 2, n - 7))
+            t = START + np.sort(rng.choice(spread_ms, size=k, replace=False))
+            ts[i, :k] = t
+            val[i, :k] = rng.normal(100.0, 30.0, k)
+            mask[i, :k] = True
+        windows = FixedWindows.for_range(START, START + spread_ms, 3_600_000)
+        spec, wargs = windows.split()
+        base = precompact_base(spec, windows.first_window_ms)
+        assert base is not None, "grid must be compaction-eligible"
+        ts32 = np.where(mask, ts - base, self.I32_PAD).astype(np.int32)
+        wargs32 = dict(wargs)
+        wargs32["ts_base"] = jnp.asarray(base, jnp.int64)
+        return ts, ts32, val, mask, spec, wargs, wargs32, windows
+
+    @pytest.mark.parametrize("agg", ["avg", "sum", "count", "dev", "min",
+                                     "max", "p90", "median", "first"])
+    def test_int32_batch_equals_int64(self, agg):
+        rng = np.random.default_rng(31)
+        ts, ts32, val, mask, spec, wargs, wargs32, _ = self._pair(rng)
+        _, want, want_m = downsample(ts, val, mask, agg, spec, wargs,
+                                     FILL_NONE)
+        _, got, got_m = downsample(ts32, val, mask, agg, spec, wargs32,
+                                   FILL_NONE)
+        np.testing.assert_array_equal(np.asarray(want_m), np.asarray(got_m))
+        m = np.asarray(want_m)
+        np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m],
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_int32_batch_with_shifted_origin(self):
+        """bench.py traces a shifted window origin (first' < ts_base):
+        the window-id re-base and edge re-base must stay consistent."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(37)
+        ts, ts32, val, mask, spec, wargs, wargs32, _ = self._pair(rng)
+        for shift in (7_919, 1_800_000):
+            w64 = dict(wargs)
+            w64["first"] = wargs["first"] - jnp.asarray(shift, jnp.int64)
+            w32 = dict(wargs32)
+            w32["first"] = wargs32["first"] - jnp.asarray(shift, jnp.int64)
+            for agg in ("avg", "dev", "min"):
+                _, want, want_m = downsample(ts, val, mask, agg, spec, w64,
+                                             FILL_NONE)
+                _, got, got_m = downsample(ts32, val, mask, agg, spec, w32,
+                                           FILL_NONE)
+                np.testing.assert_array_equal(np.asarray(want_m),
+                                              np.asarray(got_m))
+                m = np.asarray(want_m)
+                np.testing.assert_allclose(np.asarray(got)[m],
+                                           np.asarray(want)[m],
+                                           rtol=1e-12, atol=1e-12)
+
+    def test_cache_gather_emits_int32_layout(self):
+        """The device cache's ts_base gather must emit exactly this
+        contract: int32 dtype, offsets from base, pads at the clip
+        ceiling."""
+        from opentsdb_tpu.storage.device_cache import _gather_windows
+        import jax.numpy as jnp
+        buf_ts = np.array([START + 10, START + 20, START + 30, START + 40],
+                          np.int64)
+        buf_val = np.array([1.0, 2.0, 3.0, 4.0])
+        ts, val, m = _gather_windows(jnp.asarray(buf_ts),
+                                     jnp.asarray(buf_val),
+                                     np.array([0, 2]), np.array([2, 1]),
+                                     4, ts_base=START)
+        ts = np.asarray(ts)
+        assert ts.dtype == np.int32
+        np.testing.assert_array_equal(ts[0], [10, 20, self.I32_PAD,
+                                              self.I32_PAD])
+        np.testing.assert_array_equal(ts[1], [30, self.I32_PAD,
+                                              self.I32_PAD, self.I32_PAD])
+        np.testing.assert_array_equal(np.asarray(m),
+                                      [[True, True, False, False],
+                                       [True, False, False, False]])
+
+
+class TestSearchModeShapeGuard:
+    """Dense search forms must demote to the binary search on wide grids
+    (streaming config 2's W ~ 10M edges would turn compare_all's O(N*W)
+    into tens of seconds per chunk — the r4 chip session's config-2
+    timeout)."""
+
+    def test_long_rows_demote_dense_modes(self):
+        from opentsdb_tpu.ops.downsample import _effective_search_mode
+        from opentsdb_tpu.ops import downsample as ds_mod
+        cases = {
+            # (mode, n) -> expected effective mode
+            ("compare_all", 65536): "compare_all",   # headline: stays
+            ("compare_all", 1 << 20): "scan",        # 1M-pt chunk: demote
+            ("hier", 65536): "hier",
+            ("hier", 1 << 20): "hier",     # N/32 still beats 20 gathers
+            ("hier", 1 << 24): "scan",     # 16M-pt rows: demote
+        }
+        for (mode, n), want in cases.items():
+            ds_mod.set_search_mode(mode)
+            try:
+                got = _effective_search_mode(1024, n, 514)
+            finally:
+                ds_mod.set_search_mode("scan")
+            assert got == want, (mode, n, got, want)
+
+    def test_demoted_search_still_correct(self):
+        """A (tiny-N, huge-W) shape under compare_all answers identically
+        to scan — through the demotion path."""
+        from opentsdb_tpu.ops import downsample as ds_mod
+        rng = np.random.default_rng(41)
+        s, n = 2, 256
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        for i in range(s):
+            k = 200
+            t = START + np.sort(rng.choice(5_000_000, size=k, replace=False))
+            ts[i, :k] = t
+            val[i, :k] = rng.normal(10, 3, k)
+            mask[i, :k] = True
+        windows = FixedWindows.for_range(START, START + 5_000_000, 1_000)
+        spec, wargs = windows.split()      # ~5000 windows, N=256
+        ratio = ds_mod._SEARCH_DEMOTE_RATIO
+        ds_mod._SEARCH_DEMOTE_RATIO = 1    # force demotion at this shape
+        try:
+            ds_mod.set_search_mode("compare_all")
+            _, got, gm = downsample(ts, val, mask, "sum", spec, wargs,
+                                    FILL_NONE)
+        finally:
+            ds_mod._SEARCH_DEMOTE_RATIO = ratio
+            ds_mod.set_search_mode("scan")
+        _, want, wm = downsample(ts, val, mask, "sum", spec, wargs,
+                                 FILL_NONE)
+        np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm))
+        m = np.asarray(wm)
+        np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m])
