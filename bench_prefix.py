@@ -95,7 +95,7 @@ def main() -> None:
         aggregator="sum",
         downsample=DownsampleStep("min", spec.downsample.window_spec,
                                   "none", 0.0))
-    for mode in ("scan", "segment"):
+    for mode in ("scan", "segment", "subblock"):
         ds.set_extreme_mode(mode)
         drain(dispatch(spec_min, g_pad, batch, wargs, origins.next()))
         samples, _, _ = measure_drained(spec_min, g_pad, batch, wargs,

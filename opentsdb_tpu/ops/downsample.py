@@ -165,18 +165,25 @@ PREFIX_AGGS = frozenset(
 # min/max ride a scatter-free segmented reset-scan (sorted rows make each
 # window a contiguous run; an associative_scan that resets at run starts
 # replaces the serializing segment scatter).  "segment" keeps the scatter
-# form — faster on CPU where scatters are cheap; the chip A/B decides.
+# form — faster on CPU where scatters are cheap.  "subblock" removes the
+# full-length scan too (the r4 subblock-sum idea applied to extremes):
+# 32-point sub-block reduces, a reset-scan over the [S, N/32] sub-block
+# extremes for each window's interior, and 32-wide masked reduces over
+# the two boundary sub-blocks.  The chip A/B decides the default.
 EXTREME_AGGS = frozenset({"min", "mimmin", "max", "mimmax"})
+_EXTREME_MODES = ("scan", "segment", "subblock")
 _EXTREME_MODE = (_os.environ.get("TSDB_EXTREME_MODE")
                  if _os.environ.get("TSDB_EXTREME_MODE")
-                 in ("scan", "segment") else "scan")
+                 in _EXTREME_MODES else "scan")
 
 
 def set_extreme_mode(mode: str) -> None:
-    """'scan' | 'segment' — min/max downsample strategy; clears caches."""
+    """'scan' | 'segment' | 'subblock' — min/max downsample strategy;
+    clears caches."""
     global _EXTREME_MODE
-    if mode not in ("scan", "segment"):
-        raise ValueError("extreme mode must be 'scan' or 'segment'")
+    if mode not in _EXTREME_MODES:
+        raise ValueError("extreme mode must be one of %r"
+                         % (_EXTREME_MODES,))
     _EXTREME_MODE = mode
     _clear_dependent_caches()
 
@@ -661,6 +668,110 @@ def _extreme_downsample(ts, val, mask, spec: WindowSpec, wargs: dict,
     return lo, hi, count
 
 
+def _use_subblock_extreme(n: int) -> bool:
+    """ONE eligibility predicate for extreme mode "subblock", shared by
+    the materialized and streaming paths (they must never drift);
+    ineligible shapes fall back to the scan form on BOTH paths."""
+    return _EXTREME_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
+
+
+def _extreme_subblock(ts, val, mask, spec: WindowSpec, wargs: dict,
+                      want_min: bool, want_max: bool):
+    """Windowed min/max with no full-length scan (extreme mode "subblock").
+
+    Decomposes each window at 32-point sub-block granularity: sub-blocks
+    whose span [B*32, (B+1)*32) lies inside [idx[w], idx[w+1]) are
+    entirely window w's, so the interior extreme is a segmented
+    reset-scan over the [S, N/32] sub-block extremes (1/32 the scan
+    work); the at-most-two boundary sub-blocks are resolved with 32-wide
+    masked reduces over contiguous [1, 32] gathers.  Same decomposition
+    as _edge_subblock_builder, reduced with min/max instead of sum.
+    min and max share ONE scan when both are wanted (the carry holds
+    both lanes), like the full-length scan form.
+
+    Returns (lo[S, W] | None, hi[S, W] | None, count[S, W]).
+    """
+    from jax import lax
+
+    s, n = ts.shape
+    vf, ok, cts, idx, _windowed, count = _window_scan_setup(ts, val, mask,
+                                                            spec, wargs)
+    k = _SUB_K
+    nb = n // k
+    lo_e = idx[:, :-1]                     # [S, W] window start positions
+    hi_e = idx[:, 1:]                      # window end positions
+    b0 = jnp.clip(lo_e // k, 0, nb - 1)    # boundary sub-blocks
+    b1 = jnp.clip(hi_e // k, 0, nb - 1)
+    r0 = (lo_e + k - 1) // k               # first interior sub-block
+    r1 = hi_e // k                         # one past last interior
+    lanes = jnp.arange(k, dtype=idx.dtype)
+
+    v3 = vf.reshape(s, nb, k)
+    o3 = ok.reshape(s, nb, k)
+    g0v = jnp.take_along_axis(v3, b0[:, :, None], axis=1)    # [S, W, K]
+    g0o = jnp.take_along_axis(o3, b0[:, :, None], axis=1)
+    g1v = jnp.take_along_axis(v3, b1[:, :, None], axis=1)
+    g1o = jnp.take_along_axis(o3, b1[:, :, None], axis=1)
+    pos0 = b0[:, :, None] * k + lanes[None, None, :]
+    pos1 = b1[:, :, None] * k + lanes[None, None, :]
+    in0 = (pos0 >= lo_e[:, :, None]) & (pos0 < hi_e[:, :, None]) & g0o
+    in1 = (pos1 >= lo_e[:, :, None]) & (pos1 < hi_e[:, :, None]) & g1o
+
+    # Interior reset flags: sub-block b starts some window's interior,
+    # i.e. b appears in the (per-row sorted) r0 sequence — a searchsorted
+    # membership test, O(nb log W), not an [S, W, nb] broadcast compare
+    # (which would exceed the full-length scan this mode replaces).
+    blocks = jnp.arange(nb, dtype=r0.dtype)
+    w_pad = r0.shape[1]
+    p = jax.vmap(lambda row: jnp.searchsorted(row, blocks,
+                                              side="left"))(r0)
+    at = jnp.take_along_axis(r0, jnp.clip(p, 0, w_pad - 1), axis=1)
+    flags = (at == blocks[None, :]) & (p < w_pad)
+    interior_pos = jnp.clip(r1 - 1, 0, nb - 1)
+    has_interior = r1 > r0
+
+    # one scan carries every wanted lane + the shared reset flag
+    carry = ()
+    if want_min:
+        carry += (jnp.where(o3, v3, jnp.inf).min(axis=2),)
+    if want_max:
+        carry += (jnp.where(o3, v3, -jnp.inf).max(axis=2),)
+    carry += (flags,)
+
+    def combine(a, b):
+        bf = b[-1]
+        out = []
+        i = 0
+        if want_min:
+            out.append(jnp.where(bf, b[i], jnp.minimum(a[i], b[i])))
+            i += 1
+        if want_max:
+            out.append(jnp.where(bf, b[i], jnp.maximum(a[i], b[i])))
+        return tuple(out) + (a[-1] | bf,)
+
+    scanned = lax.associative_scan(combine, carry, axis=1)
+
+    def finish(lane, is_min: bool):
+        ident = jnp.inf if is_min else -jnp.inf
+        op = jnp.minimum if is_min else jnp.maximum
+        red = jnp.min if is_min else jnp.max
+        interior = jnp.take_along_axis(lane, interior_pos, axis=1)
+        interior = jnp.where(has_interior, interior, ident)
+        rem0 = red(jnp.where(in0, g0v, ident), axis=2)
+        rem1 = red(jnp.where(in1, g1v, ident), axis=2)
+        out = op(op(interior, rem0), rem1)
+        return jnp.where(count > 0, out, ident)
+
+    i = 0
+    lo = hi = None
+    if want_min:
+        lo = finish(scanned[i], True)
+        i += 1
+    if want_max:
+        hi = finish(scanned[i], False)
+    return lo, hi, count
+
+
 def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
                fill_policy: str = FILL_NONE, fill_value: float = 0.0):
     """Downsample a [S, N] batch into (window_ts[W], values[S, W], mask[S, W]).
@@ -693,15 +804,20 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
         return wts, out, out_mask
 
     if agg_name in PREFIX_AGGS or (
-            agg_name in EXTREME_AGGS and _EXTREME_MODE == "scan"):
+            agg_name in EXTREME_AGGS
+            and _EXTREME_MODE in ("scan", "subblock")):
         w = spec.count
         nwin = wargs["nwin"]
         if agg_name in PREFIX_AGGS:
             out, count_grid = _prefix_downsample(ts, val, mask, agg_name,
                                                  spec, wargs)
         else:
+            # ineligible shapes under "subblock" fall back to the scan
+            # form (NOT the segment scatter) — same rule as streaming
             is_min = agg_name in ("min", "mimmin")
-            lo, hi, count_grid = _extreme_downsample(
+            extreme = _extreme_subblock if _use_subblock_extreme(
+                ts.shape[1]) else _extreme_downsample
+            lo, hi, count_grid = extreme(
                 ts, val, mask, spec, wargs, is_min, not is_min)
             out = lo if is_min else hi
         live = jnp.arange(w, dtype=jnp.int32)[None, :] < nwin

@@ -180,10 +180,14 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
 
     # lo/hi ride the scatter-free segmented reset-scan — ONE fused scan
     # for both (XLA CSEs the edge-search it shares with the prefix lanes
-    # inside this one jit)
+    # inside this one jit); extreme mode "subblock" swaps in the
+    # sub-block decomposition, same as the materialized path
     if lanes & {"lo", "hi"}:
-        lo, hi, _ = _extreme_downsample(ts, val, mask, spec, wargs,
-                                        "lo" in lanes, "hi" in lanes)
+        from opentsdb_tpu.ops import downsample as _ds
+        extreme = _ds._extreme_subblock \
+            if _ds._use_subblock_extreme(n) else _extreme_downsample
+        lo, hi, _ = extreme(ts, val, mask, spec, wargs,
+                            "lo" in lanes, "hi" in lanes)
         if lo is not None:
             out["lo"] = lo
         if hi is not None:

@@ -416,9 +416,14 @@ class TestExtremeScanPath:
         assert "scatter" not in hlo
 
     @pytest.mark.parametrize("agg", ["min", "max"])
-    def test_scan_equals_segment_mode(self, agg):
+    @pytest.mark.parametrize("seed,interval", [(62, 600_000), (63, 60_000),
+                                               (64, 2_500_000)])
+    def test_extreme_modes_agree(self, agg, seed, interval):
+        """scan / segment / subblock extreme forms answer identically —
+        interval sweep covers windows smaller than, comparable to, and
+        much wider than the 32-point sub-block granule."""
         from opentsdb_tpu.ops import downsample as ds_mod
-        rng = np.random.default_rng(62)
+        rng = np.random.default_rng(seed)
         ts = np.full((3, 128), np.iinfo(np.int64).max, np.int64)
         val = np.zeros((3, 128), np.float64)
         mask = np.zeros((3, 128), bool)
@@ -428,11 +433,47 @@ class TestExtremeScanPath:
                 rng.choice(5_000_000, size=k, replace=False))
             val[i, :k] = rng.normal(0, 9, k)
             mask[i, :k] = True
-        windows = FixedWindows.for_range(START, START + 5_000_000, 600_000)
+        windows = FixedWindows.for_range(START, START + 5_000_000, interval)
         spec, wargs = windows.split()
         _, want, wmask = downsample(ts, val, mask, agg, spec, wargs,
                                     FILL_NONE)
-        ds_mod.set_extreme_mode("segment")
+        for mode in ("segment", "subblock"):
+            ds_mod.set_extreme_mode(mode)
+            try:
+                _, got, gmask = downsample(ts, val, mask, agg, spec, wargs,
+                                           FILL_NONE)
+            finally:
+                ds_mod.set_extreme_mode("scan")
+            np.testing.assert_array_equal(np.asarray(gmask),
+                                          np.asarray(wmask))
+            m = np.asarray(wmask)
+            np.testing.assert_array_equal(np.asarray(got)[m],
+                                          np.asarray(want)[m])
+
+    @pytest.mark.parametrize("agg", ["min", "max"])
+    def test_subblock_extreme_dense_ties(self, agg):
+        """Dense rows where window edges land exactly on sub-block
+        boundaries and all values equal in a window — boundary masks and
+        the interior reset-scan must not double-count or miss lanes."""
+        from opentsdb_tpu.ops import downsample as ds_mod
+        s, n = 2, 128
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        # row 0: 96 points, one per ms — windows of 32 points align with
+        # sub-blocks exactly
+        ts[0, :96] = START + np.arange(96)
+        val[0, :96] = np.tile([5.0, -3.0, 7.0, 1.0], 24)
+        mask[0, :96] = True
+        # row 1: 100 points spanning sub-block boundaries unevenly
+        ts[1, :100] = START + np.arange(100) * 7
+        val[1, :100] = -np.arange(100, dtype=float)
+        mask[1, :100] = True
+        windows = FixedWindows.for_range(START, START + 700, 32)
+        spec, wargs = windows.split()
+        _, want, wmask = downsample(ts, val, mask, agg, spec, wargs,
+                                    FILL_NONE)
+        ds_mod.set_extreme_mode("subblock")
         try:
             _, got, gmask = downsample(ts, val, mask, agg, spec, wargs,
                                        FILL_NONE)

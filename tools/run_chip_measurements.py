@@ -81,12 +81,11 @@ def pick_winners(prefix_records: list[dict]) -> dict:
         env["TSDB_SCAN_MODE"] = scan
         env["TSDB_SEARCH_MODE"] = search
         env["TSDB_GROUP_REDUCE_MODE"] = group
-    ext = {c: by_cfg[c] for c in ("min+extreme_scan", "min+extreme_segment")
-           if c in by_cfg}
-    if len(ext) == 2:
-        env["TSDB_EXTREME_MODE"] = (
-            "scan" if ext["min+extreme_scan"] <= ext["min+extreme_segment"]
-            else "segment")
+    ext_modes = ("scan", "segment", "subblock")
+    ext = [(by_cfg["min+extreme_" + m], m) for m in ext_modes
+           if "min+extreme_" + m in by_cfg]
+    if len(ext) == len(ext_modes):   # a partial race crowns no winner
+        env["TSDB_EXTREME_MODE"] = min(ext)[1]
     if env:
         print("== A/B winners -> %s ==" % env, file=sys.stderr, flush=True)
     return env
