@@ -526,17 +526,31 @@ def _window_ids_fast(ts, cts, spec: WindowSpec, wargs: dict):
 # burned the whole 2400s chip budget in r4).
 _SEARCH_DEMOTE_RATIO = 4096
 
+# Sub-block remainder forms (hier search, subblock scan/extreme) gather
+# one [*, K] lane per edge/window — an [S, W, K] intermediate.  For the
+# intended shapes W*K << N (headline: 513 edges x 32 = 2.4% of N); when
+# a grid is wider than the data (streaming config 2: W ~ N*10), that
+# intermediate EXCEEDS the batch itself and can OOM (a 0.01-scale CPU
+# smoke hit a 283GB allocation).  Cap it at this multiple of the data.
+_SUBBLOCK_EDGE_FACTOR = 4
+
+
+def _subblock_edges_fit(n: int, w_edges: int) -> bool:
+    return w_edges * _SUB_K <= _SUBBLOCK_EDGE_FACTOR * n
+
 
 def _effective_search_mode(s: int, n: int, w_edges: int) -> str:
     """The configured search mode, demoted to "scan" for shapes where the
     dense form's per-edge compare cost would dwarf the binary search's
-    per-edge gather cost."""
-    del s, w_edges   # both forms scale linearly with these
+    per-edge gather cost, or (hier) where the [S, W, K] remainder
+    intermediate would outgrow the batch."""
+    del s   # every form scales linearly with S
     mode = _SEARCH_MODE
     logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
     if mode == "compare_all" and n > _SEARCH_DEMOTE_RATIO * logn:
         return "scan"
-    if mode == "hier" and n // _SUB_K > _SEARCH_DEMOTE_RATIO * logn:
+    if mode == "hier" and (n // _SUB_K > _SEARCH_DEMOTE_RATIO * logn
+                           or not _subblock_edges_fit(n, w_edges)):
         return "scan"
     return mode
 
@@ -585,7 +599,8 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     ok = mask & ~jnp.isnan(vf)
     cts, cedges = _compact_ts(ts, spec, wargs)
     idx = _edge_search(cts, cedges)
-    if _SCAN_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K:
+    if (_SCAN_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
+            and _subblock_edges_fit(n, cedges.shape[0])):
         windowed = _edge_subblock_builder(s, n, idx)
     else:
         windowed = _edge_prefix_builder(s, n, idx)
@@ -668,11 +683,14 @@ def _extreme_downsample(ts, val, mask, spec: WindowSpec, wargs: dict,
     return lo, hi, count
 
 
-def _use_subblock_extreme(n: int) -> bool:
+def _use_subblock_extreme(n: int, w_padded: int) -> bool:
     """ONE eligibility predicate for extreme mode "subblock", shared by
     the materialized and streaming paths (they must never drift);
-    ineligible shapes fall back to the scan form on BOTH paths."""
-    return _EXTREME_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
+    ineligible shapes fall back to the scan form on BOTH paths.  The
+    edge-fit guard bounds the [S, W, K] boundary-lane intermediates on
+    wider-than-data grids (see _SUBBLOCK_EDGE_FACTOR)."""
+    return (_EXTREME_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
+            and _subblock_edges_fit(n, w_padded + 1))
 
 
 def _extreme_subblock(ts, val, mask, spec: WindowSpec, wargs: dict,
@@ -816,7 +834,7 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
             # form (NOT the segment scatter) — same rule as streaming
             is_min = agg_name in ("min", "mimmin")
             extreme = _extreme_subblock if _use_subblock_extreme(
-                ts.shape[1]) else _extreme_downsample
+                ts.shape[1], spec.count) else _extreme_downsample
             lo, hi, count_grid = extreme(
                 ts, val, mask, spec, wargs, is_min, not is_min)
             out = lo if is_min else hi

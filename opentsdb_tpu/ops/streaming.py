@@ -185,7 +185,7 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     if lanes & {"lo", "hi"}:
         from opentsdb_tpu.ops import downsample as _ds
         extreme = _ds._extreme_subblock \
-            if _ds._use_subblock_extreme(n) else _extreme_downsample
+            if _ds._use_subblock_extreme(n, w) else _extreme_downsample
         lo, hi, _ = extreme(ts, val, mask, spec, wargs,
                             "lo" in lanes, "hi" in lanes)
         if lo is not None:

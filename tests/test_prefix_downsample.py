@@ -632,3 +632,72 @@ class TestSearchModeShapeGuard:
         np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm))
         m = np.asarray(wm)
         np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m])
+
+
+class TestWideGridGuards:
+    """Wider-than-data grids (streaming config 2: W ~ 10x N) must not
+    materialize [S, W, K] sub-block intermediates — the 0.01-scale CPU
+    smoke hit a 283GB allocation before these guards existed."""
+
+    def test_eligibility_predicates(self):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        # headline shape: everything eligible
+        assert ds_mod._subblock_edges_fit(65536, 514)
+        ds_mod.set_extreme_mode("subblock")
+        try:
+            assert ds_mod._use_subblock_extreme(65536, 513)
+            # config-2 chunk: 64k-pt chunk against a 1M-window grid
+            assert not ds_mod._use_subblock_extreme(65536, 1 << 20)
+        finally:
+            ds_mod.set_extreme_mode("scan")
+        ds_mod.set_search_mode("hier")
+        try:
+            assert ds_mod._effective_search_mode(1, 65536, 1 << 20) == "scan"
+            assert ds_mod._effective_search_mode(1, 65536, 514) == "hier"
+        finally:
+            ds_mod.set_search_mode("scan")
+
+    def test_wide_grid_all_modes_answer(self):
+        """A wide sparse grid (W >> N) under every new mode at once must
+        answer identically to the defaults — through the demotion/
+        fallback paths, without blowing memory."""
+        from opentsdb_tpu.ops import downsample as ds_mod
+        from opentsdb_tpu.ops import group_agg
+        rng = np.random.default_rng(51)
+        s, n = 2, 64
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        for i in range(s):
+            k = 50
+            ts[i, :k] = START + np.sort(
+                rng.choice(40_000_000, size=k, replace=False))
+            val[i, :k] = rng.normal(0, 5, k)
+            mask[i, :k] = True
+        # 10s windows over ~11 hours: 4000+ windows vs 64 points
+        windows = FixedWindows.for_range(START, START + 40_000_000, 10_000)
+        spec, wargs = windows.split()
+        assert spec.count > 16 * n
+        want = {}
+        for agg in ("sum", "min", "max", "avg"):
+            _, out, om = downsample(ts, val, mask, agg, spec, wargs,
+                                    FILL_NONE)
+            want[agg] = (np.asarray(out), np.asarray(om))
+        ds_mod.set_scan_mode("subblock")
+        ds_mod.set_search_mode("hier")
+        ds_mod.set_extreme_mode("subblock")
+        group_agg.set_group_reduce_mode("sorted")
+        try:
+            for agg in ("sum", "min", "max", "avg"):
+                _, out, om = downsample(ts, val, mask, agg, spec, wargs,
+                                        FILL_NONE)
+                np.testing.assert_array_equal(np.asarray(om), want[agg][1])
+                m = want[agg][1]
+                np.testing.assert_allclose(np.asarray(out)[m],
+                                           want[agg][0][m],
+                                           rtol=1e-12, atol=1e-12)
+        finally:
+            ds_mod.set_scan_mode("flat")
+            ds_mod.set_search_mode("scan")
+            ds_mod.set_extreme_mode("scan")
+            group_agg.set_group_reduce_mode("segment")
