@@ -52,7 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
-    WindowSpec, apply_fill, window_timestamps,
+    WindowSpec, apply_fill, window_ids, window_timestamps,
     _extreme_downsample, _sorted_runs,
     _window_scan_setup, _window_ids_fast, FILL_NONE)
 
@@ -143,6 +143,69 @@ def _zero_state(s: int, w: int, sketch: bool = False,
     return state
 
 
+def _segment_chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
+                           lanes: frozenset):
+    """Chunk moments for wider-than-data grids: N-bounded sorted scatters.
+
+    When a chunk's window grid has (far) more windows than the chunk has
+    points (BASELINE config 2: a 64k-point chunk against a ~1M-window
+    10s grid), every edge-search form costs O(W) or worse PER CHUNK —
+    the r4 chip session burned its whole config-2 budget there.  Here
+    the cost is bounded by the POINT count instead: per-point window ids
+    (a division on fixed grids), then one segment reduction per lane
+    with `indices_are_sorted=True` — the flattened (row, window) ids are
+    genuinely sorted because rows are time-sorted, and invalid slots
+    keep their clipped (monotone) id while contributing the lane's
+    identity element, never a shuffled sentinel.
+
+    Serves the n/total/m2/lo/hi lanes (the streamable moment family);
+    callers keep the edge-search form for first/last/prod/sketch.
+    """
+    s, n = ts.shape
+    w = spec.count
+    num = s * w
+    vf = val.astype(jnp.float64)
+    ok = mask & ~jnp.isnan(vf)
+    win = window_ids(ts, spec, wargs)
+    nwin = wargs["nwin"]
+    valid = ok & (win >= 0) & (win < nwin.astype(win.dtype))
+    winc = jnp.clip(win, 0, w - 1)
+    rows = jnp.arange(s, dtype=winc.dtype)[:, None]
+    seg = (rows * w + winc).reshape(-1)
+
+    def reduce(data, ident, kind="sum"):
+        flat = jnp.where(valid, data, ident).reshape(-1)
+        fn = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+              "max": jax.ops.segment_max}[kind]
+        return fn(flat, seg, num_segments=num,
+                  indices_are_sorted=True).reshape(s, w)
+
+    cnt = reduce(jnp.ones_like(vf, dtype=jnp.int64), 0).astype(jnp.int64)
+    out = {"n": cnt}
+    if "total" in lanes:
+        tot = reduce(vf, 0.0)
+        out["total"] = tot
+        if "m2" in lanes:
+            mean = tot / jnp.maximum(cnt, 1)
+            mean_pp = jnp.take_along_axis(mean, winc, axis=1)
+            centered = jnp.where(valid, vf - mean_pp, 0.0)
+            out["m2"] = reduce(centered * centered, 0.0)
+    if "lo" in lanes:
+        out["lo"] = reduce(vf, jnp.inf, "min")
+    if "hi" in lanes:
+        out["hi"] = reduce(vf, -jnp.inf, "max")
+    return out
+
+
+def _use_segment_chunk(n: int, w: int, lanes: frozenset,
+                       with_sketch: bool) -> bool:
+    """Route chunks whose grid is >4x wider than their point count to the
+    segment form; first/last/prod and the sketch keep the edge-search
+    form (their reductions are position- or sort-based)."""
+    return (w > 4 * n and not with_sketch
+            and not (lanes & {"first", "last", "prod"}))
+
+
 def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
                    lanes: frozenset = _ALL_LANES,
                    with_sketch: bool = False):
@@ -152,9 +215,13 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     kernel; lo/hi/first/last/prod need per-point window membership and
     cost one segment scatter each — skipped entirely when not requested,
     which is the common case (sum/avg/count queries stream scatter-free).
+    Wider-than-data grids (W >> chunk points) take the N-bounded segment
+    form instead — see _segment_chunk_moments.
     """
     s, n = ts.shape
     w = spec.count
+    if _use_segment_chunk(n, w, lanes, with_sketch):
+        return _segment_chunk_moments(ts, val, mask, spec, wargs, lanes)
     # ONE setup shared with the materialized path: same edge search
     # (incl. the search-mode toggle), same int32 compaction, and the
     # clean-batch count shortcut — streamed chunks are clean by

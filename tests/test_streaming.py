@@ -713,3 +713,45 @@ class TestStateBudget:
         # mesh: ~3.6MB/chip across 8 devices -> admitted
         res = q(mk(mesh=True))
         assert res and res[0].dps
+
+
+class TestSegmentChunkMoments:
+    """Wider-than-data chunk grids (config 2's shape) take the N-bounded
+    segment form: must merge to the same accumulated grid as the
+    edge-search form, chunk by chunk."""
+
+    def test_wide_grid_stream_equals_narrow_path(self):
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import FixedWindows, FILL_NONE
+        from opentsdb_tpu.ops import streaming
+        rng = np.random.default_rng(71)
+        s, n_chunk, chunks = 3, 64, 4
+        # 10ms windows over the whole span: W ~ 40x the chunk size
+        span = 200_000
+        windows = FixedWindows.for_range(0, span, 70)
+        spec, wargs = windows.split()
+        assert streaming._use_segment_chunk(
+            n_chunk, spec.count, frozenset({"total", "lo", "hi"}), False)
+        ts = np.sort(rng.choice(span, size=(s, n_chunk * chunks),
+                                replace=False), axis=1).astype(np.int64)
+        val = rng.normal(50, 20, (s, n_chunk * chunks))
+        val[rng.random(val.shape) < 0.04] = np.nan
+        mask = rng.random(val.shape) < 0.95
+        lanes = streaming.lanes_for(["sum", "min", "max", "count", "dev"])
+        acc = streaming.StreamAccumulator.create(s, spec, wargs,
+                                                 lanes=lanes)
+        for c in range(chunks):
+            sl = slice(c * n_chunk, (c + 1) * n_chunk)
+            acc.update(ts[:, sl], val[:, sl], mask[:, sl])
+        # reference: one-shot materialized downsample over the full batch
+        from opentsdb_tpu.ops.downsample import downsample
+        for fn in ("sum", "min", "max", "count", "dev", "avg"):
+            wts, got, gm = acc.finish(fn)
+            _, want, wm = downsample(ts, val, mask, fn, spec, wargs,
+                                     FILL_NONE)
+            np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm),
+                                          err_msg=fn)
+            m = np.asarray(wm)
+            np.testing.assert_allclose(np.asarray(got)[m],
+                                       np.asarray(want)[m],
+                                       rtol=1e-9, atol=1e-9, err_msg=fn)
