@@ -199,10 +199,12 @@ def _segment_chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
 
 def _use_segment_chunk(n: int, w: int, lanes: frozenset,
                        with_sketch: bool) -> bool:
-    """Route chunks whose grid is >4x wider than their point count to the
-    segment form; first/last/prod and the sketch keep the edge-search
-    form (their reductions are position- or sort-based)."""
-    return (w > 4 * n and not with_sketch
+    """Route chunks with more windows than points to the segment form:
+    past W ~ N the edge search's per-edge work exceeds the segment
+    form's per-point work (config 4 sits at exactly W = 4N; config 2 at
+    W = 16N).  first/last/prod and the sketch keep the edge-search form
+    (their reductions are position- or sort-based)."""
+    return (w > n and not with_sketch
             and not (lanes & {"first", "last", "prod"}))
 
 
