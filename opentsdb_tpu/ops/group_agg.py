@@ -30,7 +30,6 @@ import jax.numpy as jnp
 
 from opentsdb_tpu.ops.aggregators import Aggregator
 from opentsdb_tpu.ops.downsample import parse_percentile_name
-from opentsdb_tpu.ops.percentile import segment_percentile
 from opentsdb_tpu.ops.rate import _prev_valid_index
 from opentsdb_tpu.ops.union_agg import interpolate, _next_valid
 
@@ -372,21 +371,33 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
         else:
             out = jnp.where(cnt >= 2, last_v - first_v, 0.0)
     elif agg_name == "median" or agg_name.startswith(("p", "ep")):
-        sv = jnp.where(ok, v, jnp.inf)
-        order = jnp.lexsort((sv, seg))
-        sorted_v = sv[order]
-        sorted_seg = seg[order]
-        starts = jnp.searchsorted(sorted_seg, jnp.arange(num))
+        # ONE column sort with (gid, value) lexicographic keys instead of
+        # a global [S*W] lexsort: each window's column sorts its S values
+        # independently (W tiny bitonic sorts — the natural vectorized
+        # form), invalid rows keyed past every group.  starts/counts per
+        # (group, window) run follow from the cnt grid already computed.
+        from jax import lax
+        from opentsdb_tpu.ops.percentile import column_run_percentile
+        vf2 = contrib.astype(jnp.float64)
+        ok2 = (participate & ~jnp.isnan(vf2))
+        in_range = (gid >= 0) & (gid < g)
+        gkey = jnp.broadcast_to(
+            jnp.where(in_range, gid, g).astype(jnp.int32)[:, None], (s, w))
+        gkey = jnp.where(ok2, gkey, g)
+        vals = jnp.where(ok2, vf2, jnp.inf)
+        _, sorted_cols = lax.sort((gkey, vals), dimension=0, num_keys=2)
+        starts = jnp.concatenate(
+            [jnp.zeros((1, w), cnt.dtype),
+             jnp.cumsum(cnt, axis=0)], axis=0)[:-1]          # [G, W]
         if agg_name == "median":
             # Upper median sorted[n // 2] (Aggregators.Median :397-431).
-            flat_cnt = cnt.reshape(-1)
-            idx = jnp.clip(starts + flat_cnt // 2, 0, max(s * w - 1, 0))
-            out = jnp.where(flat_cnt > 0, sorted_v[idx],
-                            jnp.nan).reshape(g, w)
+            idx = jnp.clip(starts + cnt // 2, 0, s - 1)
+            out = jnp.where(
+                cnt > 0,
+                jnp.take_along_axis(sorted_cols, idx, axis=0), jnp.nan)
         else:
             q, est = parse_percentile_name(agg_name)
-            out = segment_percentile(sorted_v, starts, cnt.reshape(-1), q,
-                                     est).reshape(g, w)
+            out = column_run_percentile(sorted_cols, starts, cnt, q, est)
     else:
         raise KeyError("No such aggregator: " + agg_name)
 

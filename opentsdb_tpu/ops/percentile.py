@@ -74,22 +74,12 @@ def masked_percentile(values, mask, q: float, estimation: str = EST_LEGACY,
     return jnp.where(n > 0, out, jnp.nan)
 
 
-def segment_percentile(sorted_values, seg_starts, seg_counts, q: float,
-                       estimation: str = EST_LEGACY):
-    """Percentile per segment of a flat array pre-sorted within segments.
-
-    `sorted_values[f]` holds all window values, each window's run sorted
-    ascending; window w occupies [seg_starts[w], seg_starts[w]+seg_counts[w]).
-    Used by the downsample percentile path where windows are contiguous runs.
-    """
-    n = seg_counts
+def _estimate(at, n, q: float, estimation: str):
+    """Shared estimator core: `at(k)` returns the k-th (1-based) order
+    statistic of each cell's run, clipped to the run; `n` is the count
+    per cell.  One definition serves the flat-run and column-run forms —
+    the three estimators must never drift between them."""
     nf = n.astype(jnp.float64)
-    top = jnp.maximum(len(sorted_values) - 1, 0)
-
-    def at(one_based_idx):
-        idx = seg_starts + jnp.clip(one_based_idx - 1, 0, jnp.maximum(n - 1, 0))
-        return sorted_values[jnp.clip(idx, 0, top)]
-
     if estimation == EST_LEGACY:
         pos = q * (nf + 1.0) / 100.0
         fpos = jnp.floor(pos)
@@ -100,7 +90,8 @@ def segment_percentile(sorted_values, seg_starts, seg_counts, q: float,
                         jnp.where(pos >= nf, at(n), mid))
     elif estimation == EST_R3:
         h = nf * q / 100.0
-        k = jnp.clip(jnp.ceil(h - 0.5).astype(jnp.int64), 1, jnp.maximum(n, 1))
+        k = jnp.clip(jnp.ceil(h - 0.5).astype(jnp.int64), 1,
+                     jnp.maximum(n, 1))
         out = at(k)
     elif estimation == EST_R7:
         h = (nf - 1.0) * q / 100.0 + 1.0
@@ -109,5 +100,45 @@ def segment_percentile(sorted_values, seg_starts, seg_counts, q: float,
         out = at(k) + (h - fh) * (at(k + 1) - at(k))
     else:
         raise ValueError("Unknown estimation type: " + estimation)
-
     return jnp.where(n > 0, out, jnp.nan)
+
+
+def segment_percentile(sorted_values, seg_starts, seg_counts, q: float,
+                       estimation: str = EST_LEGACY):
+    """Percentile per segment of a flat array pre-sorted within segments.
+
+    `sorted_values[f]` holds all window values, each window's run sorted
+    ascending; window w occupies [seg_starts[w], seg_starts[w]+seg_counts[w]).
+    Used by the downsample percentile path where windows are contiguous runs.
+    """
+    n = seg_counts
+    top = jnp.maximum(len(sorted_values) - 1, 0)
+
+    def at(one_based_idx):
+        idx = seg_starts + jnp.clip(one_based_idx - 1, 0,
+                                    jnp.maximum(n - 1, 0))
+        return sorted_values[jnp.clip(idx, 0, top)]
+
+    return _estimate(at, n, q, estimation)
+
+
+def column_run_percentile(sorted_cols, starts, counts, q: float,
+                          estimation: str = EST_LEGACY):
+    """Percentile per (group, window) cell of column-sorted runs.
+
+    `sorted_cols[S, W]` holds each column sorted so group g's members
+    occupy rows [starts[g, w], starts[g, w] + counts[g, w]); starts /
+    counts are [G, W].  The 2-D counterpart of segment_percentile — one
+    column sort replaces a global [S*W] lexsort in the grouped
+    cross-series percentile reduction.
+    """
+    n = counts
+    top = sorted_cols.shape[0] - 1
+
+    def at(one_based_idx):
+        idx = starts + jnp.clip(one_based_idx - 1, 0,
+                                jnp.maximum(n - 1, 0))
+        return jnp.take_along_axis(sorted_cols,
+                                   jnp.clip(idx, 0, top), axis=0)
+
+    return _estimate(at, n, q, estimation)
