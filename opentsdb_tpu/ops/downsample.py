@@ -28,8 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from opentsdb_tpu.ops.percentile import (
-    segment_percentile, EST_LEGACY, EST_R3, EST_R7)
+from opentsdb_tpu.ops.percentile import EST_LEGACY, EST_R3, EST_R7
 
 # Fill policies (FillPolicy.java:22-27).
 FILL_NONE = "none"
@@ -920,15 +919,29 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
         else:
             out = jnp.where(count_grid >= 2, last_v - first_v, 0.0)
     elif agg_name == "median" or agg_name.startswith(("p", "ep")):
-        sorted_v, seg_starts = _sorted_runs(vf.reshape(-1), ok, seg, s * w)
+        # Row-wise (window, value) sort: windows partition each row's
+        # points, so S independent row sorts replace the global [S*N]
+        # lexsort (invalid slots keyed past every window); per-cell runs
+        # follow from the count grid.
+        from jax import lax
+        from opentsdb_tpu.ops.percentile import row_run_percentile
+        ok2 = ok.reshape(s, n)
+        wkey = jnp.where(ok2, jnp.clip(win, 0, w - 1).astype(jnp.int32),
+                         w)
+        svals = jnp.where(ok2, vf, jnp.inf)
+        _, sorted_rows = lax.sort((wkey, svals), dimension=1, num_keys=2)
+        starts = jnp.concatenate(
+            [jnp.zeros((s, 1), count_grid.dtype),
+             jnp.cumsum(count_grid, axis=1)], axis=1)[:, :-1]
         if agg_name == "median":
-            top = max(s * n - 1, 0)
-            idx = jnp.clip(seg_starts + counts // 2, 0, top)
-            out = jnp.where(counts > 0, sorted_v[idx], jnp.nan).reshape(s, w)
+            idx = jnp.clip(starts + count_grid // 2, 0, n - 1)
+            out = jnp.where(
+                count_grid > 0,
+                jnp.take_along_axis(sorted_rows, idx, axis=1), jnp.nan)
         else:
             q, est = parse_percentile_name(agg_name)
-            out = segment_percentile(sorted_v, seg_starts, counts, q,
-                                     est).reshape(s, w)
+            out = row_run_percentile(sorted_rows, starts, count_grid, q,
+                                     est)
     else:
         raise KeyError("No such downsampling function: " + agg_name)
 

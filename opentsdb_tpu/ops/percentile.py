@@ -103,21 +103,23 @@ def _estimate(at, n, q: float, estimation: str):
     return jnp.where(n > 0, out, jnp.nan)
 
 
-def segment_percentile(sorted_values, seg_starts, seg_counts, q: float,
+def row_run_percentile(sorted_rows, starts, counts, q: float,
                        estimation: str = EST_LEGACY):
-    """Percentile per segment of a flat array pre-sorted within segments.
+    """Percentile per (series, window) cell of row-sorted runs.
 
-    `sorted_values[f]` holds all window values, each window's run sorted
-    ascending; window w occupies [seg_starts[w], seg_starts[w]+seg_counts[w]).
-    Used by the downsample percentile path where windows are contiguous runs.
+    `sorted_rows[S, N]` holds each row sorted so window w's members
+    occupy columns [starts[s, w], starts[s, w] + counts[s, w]); starts /
+    counts are [S, W].  Serves the downsample-position percentile path —
+    S independent row sorts instead of one global [S*N] lexsort.
     """
-    n = seg_counts
-    top = jnp.maximum(len(sorted_values) - 1, 0)
+    n = counts
+    top = sorted_rows.shape[1] - 1
 
     def at(one_based_idx):
-        idx = seg_starts + jnp.clip(one_based_idx - 1, 0,
-                                    jnp.maximum(n - 1, 0))
-        return sorted_values[jnp.clip(idx, 0, top)]
+        idx = starts + jnp.clip(one_based_idx - 1, 0,
+                                jnp.maximum(n - 1, 0))
+        return jnp.take_along_axis(sorted_rows,
+                                   jnp.clip(idx, 0, top), axis=1)
 
     return _estimate(at, n, q, estimation)
 
@@ -128,7 +130,7 @@ def column_run_percentile(sorted_cols, starts, counts, q: float,
 
     `sorted_cols[S, W]` holds each column sorted so group g's members
     occupy rows [starts[g, w], starts[g, w] + counts[g, w]); starts /
-    counts are [G, W].  The 2-D counterpart of segment_percentile — one
+    counts are [G, W].  The transposed twin of row_run_percentile — one
     column sort replaces a global [S*W] lexsort in the grouped
     cross-series percentile reduction.
     """
