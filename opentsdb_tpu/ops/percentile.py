@@ -22,56 +22,23 @@ EST_R3 = "r_3"
 EST_R7 = "r_7"
 
 
-def _gather_axis0(sorted_vals, idx):
-    """sorted_vals[idx[t], t] for 0-based idx[T] along axis 0."""
-    idx = jnp.clip(idx, 0, sorted_vals.shape[0] - 1)
-    return jnp.take_along_axis(sorted_vals, idx[None, :], axis=0)[0]
-
-
 def masked_percentile(values, mask, q: float, estimation: str = EST_LEGACY,
                       axis: int = 0):
     """Percentile q (0..100] of masked values along `axis` (axis 0 supported).
 
     Masked-out slots are sorted to +inf so valid values occupy the first n
-    positions of each column; empty columns yield NaN.
+    positions of each column; empty columns yield NaN.  The degenerate
+    whole-column case of column_run_percentile (starts = 0), sharing the
+    same estimator core (commons-math3 LEGACY pos = p*(n+1)/100, and
+    Hyndman-Fan R-3 / R-7).
     """
     if axis != 0:
         raise ValueError("masked_percentile reduces axis 0")
     n = mask.sum(axis=0)
     sorted_vals = jnp.sort(jnp.where(mask, values, jnp.inf), axis=0)
-    nf = n.astype(jnp.float64)
-
-    if estimation == EST_LEGACY:
-        # commons-math3 Percentile default: pos = p*(n+1)/100 (1-based);
-        # pos < 1 -> min, pos >= n -> max, else lerp between floor/ceil stats.
-        pos = q * (nf + 1.0) / 100.0
-        fpos = jnp.floor(pos)
-        d = pos - fpos
-        k = fpos.astype(jnp.int64)  # 1-based lower index
-        lower = _gather_axis0(sorted_vals, k - 1)
-        upper = _gather_axis0(sorted_vals, k)
-        mid = lower + d * (upper - lower)
-        out = jnp.where(pos < 1.0, _gather_axis0(sorted_vals, jnp.zeros_like(k)),
-                        jnp.where(pos >= nf, _gather_axis0(sorted_vals, n - 1),
-                                  mid))
-    elif estimation == EST_R3:
-        # R-3: h = n*p/100; index = ceil(h - 0.5) (round half down), 1-based.
-        h = nf * q / 100.0
-        k = jnp.ceil(h - 0.5).astype(jnp.int64)
-        k = jnp.clip(k, 1, jnp.maximum(n, 1))
-        out = _gather_axis0(sorted_vals, k - 1)
-    elif estimation == EST_R7:
-        # R-7: h = (n-1)*p/100 + 1; lerp between floor(h) and floor(h)+1.
-        h = (nf - 1.0) * q / 100.0 + 1.0
-        fh = jnp.floor(h)
-        k = fh.astype(jnp.int64)
-        lower = _gather_axis0(sorted_vals, k - 1)
-        upper = _gather_axis0(sorted_vals, jnp.minimum(k, n - 1))
-        out = lower + (h - fh) * (upper - lower)
-    else:
-        raise ValueError("Unknown estimation type: " + estimation)
-
-    return jnp.where(n > 0, out, jnp.nan)
+    starts = jnp.zeros((1,) + n.shape, dtype=jnp.int64)
+    return column_run_percentile(sorted_vals, starts, n[None, :], q,
+                                 estimation)[0]
 
 
 def _estimate(at, n, q: float, estimation: str):
