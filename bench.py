@@ -53,14 +53,20 @@ import sys
 import threading
 import time
 
-# Chip-validated hot-path modes (BENCH_CONFIGS_r04a.json bench_prefix
-# stage, real TPU): compare_all beat the binary search 0.512 vs 0.578
-# s/dispatch and the matmul group-reduce beat the segment scatter 0.489
-# vs 0.606 at this benchmark's shape.  Applied as DEFAULTS here (the
-# driver runs bench.py without the measurement session's winner env);
-# explicit env wins, and the shape guards demote dense forms off this
-# benchmark's shape.  The next measurement session re-races these
-# against the r4 subblock/hier/sorted candidates.
+# Chip-validated hot-path modes.  Preference order: explicit env >
+# BENCH_WINNERS.json (written by tools/run_chip_measurements.py from the
+# fastest COMPLETE measured config of its bench_prefix A/B race on the
+# real chip) > the r4a hand-recorded winners (BENCH_CONFIGS_r04a.json:
+# compare_all beat the binary search 0.512 vs 0.578 s/dispatch, matmul
+# group-reduce beat the segment scatter 0.489 vs 0.606).  Shape guards
+# demote dense forms off this benchmark's shape either way.
+try:
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_WINNERS.json")) as _fh:
+        for _k, _v in json.load(_fh).get("env", {}).items():
+            os.environ.setdefault(_k, _v)
+except (OSError, ValueError):
+    pass
 os.environ.setdefault("TSDB_SEARCH_MODE", "compare_all")
 os.environ.setdefault("TSDB_GROUP_REDUCE_MODE", "matmul")
 

@@ -88,6 +88,13 @@ def pick_winners(prefix_records: list[dict]) -> dict:
         env["TSDB_EXTREME_MODE"] = min(ext)[1]
     if env:
         print("== A/B winners -> %s ==" % env, file=sys.stderr, flush=True)
+        # Persist for bench.py's standalone runs (the driver invokes it
+        # without this session's env): latest chip-crowned modes win.
+        with open(os.path.join(REPO, "BENCH_WINNERS.json"), "w") as fh:
+            json.dump({"env": env, "recorded_unix": int(time.time()),
+                       "source": "bench_prefix A/B on the real chip "
+                                 "(fastest complete measured config)"},
+                      fh, indent=1)
     return env
 
 
