@@ -31,127 +31,101 @@ from bench import (_OriginSequence, build_spec, dispatch, drain, make_batch,
 
 def main() -> None:
     from opentsdb_tpu.ops import downsample as ds
+    from opentsdb_tpu.ops import group_agg as ga
+    from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
 
     batch = make_batch()
     bench._note("batch resident")
     spec, wargs, g_pad = build_spec()
+    spec_min = PipelineSpec(
+        aggregator="sum",
+        downsample=DownsampleStep("min", spec.downsample.window_spec,
+                                  "none", 0.0))
     origins = _OriginSequence()
     rtt = measure_rtt()
     bench._note("rtt %.4fs" % rtt)
 
-    configs = [
-        ("flat+int64", "flat", False, "double"),
-        ("flat+int32", "flat", True, "double"),
-        ("blocked+int64", "blocked", False, "double"),
-        ("blocked+int32", "blocked", True, "double"),
-        # r4 chip-attribution lever: no full-length f64 scan at all —
-        # sub-block f64 reduces + tiny cumsum + 32-wide remainder dots
-        ("subblock+int32", "subblock", True, "double"),
-        # fast mode: float32 accumulation (native ALUs; NOT the default —
-        # breaks the 1e-9 Java-double parity contract, documented)
-        ("blocked+int32+f32", "blocked", True, "single"),
-    ]
-    for name, mode, compact, precision in configs:
-        ds.set_scan_mode(mode)        # setters clear the jit caches
-        ds.set_ts_compaction(compact)
-        ds.set_value_precision(precision)
-        drain(dispatch(spec, g_pad, batch, wargs, origins.next()))  # compile
-        samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins,
-                                        rtt)
-        per = _median(samples)
+    def restore_defaults() -> None:
+        ga.set_group_reduce_mode("segment")
+        ds.set_extreme_mode("scan")
+        ds.set_search_mode("scan")
+        ds.set_scan_mode("flat")
+        ds.set_ts_compaction(True)
+        ds.set_value_precision("double")
+
+    def race(name: str, setup, pipeline_spec) -> None:
+        """One isolated race row: a candidate that fails to compile or
+        dispatch prints an error row and the race continues — an
+        unattended session must never lose the remaining rows to one
+        bad candidate (the setters below always run from the restored
+        default state)."""
+        restore_defaults()
+        try:
+            setup()
+            drain(dispatch(pipeline_spec, g_pad, batch, wargs,
+                           origins.next()))           # compile + warm
+            samples, _, _ = measure_drained(pipeline_spec, g_pad, batch,
+                                            wargs, origins, rtt)
+            per = _median(samples)
+        except Exception as e:   # noqa: BLE001 — provenance over purity
+            print(json.dumps({"config": name,
+                              "error": "%s: %s" % (type(e).__name__, e)}),
+                  flush=True)
+            bench._note("%s FAILED: %s" % (name, e))
+            return
         print(json.dumps({
             "config": name,
             "s_per_dispatch": round(per, 4),
             "dp_per_sec": round(S * N / per, 1),
         }), flush=True)
         bench._note("%s: %.4fs/dispatch" % (name, per))
-    # edge-search strategy A/B at the winning scan config: binary search
+
+    # scan mode x ts compaction x accumulation precision.  "subblock" is
+    # the r4 chip-attribution lever: no full-length f64 scan at all —
+    # sub-block f64 reduces + tiny cumsum + 32-wide remainder dots.  The
+    # f32 row is evidence-only (breaks the Java-double parity contract).
+    for name, mode, compact, precision in [
+            ("flat+int64", "flat", False, "double"),
+            ("flat+int32", "flat", True, "double"),
+            ("blocked+int64", "blocked", False, "double"),
+            ("blocked+int32", "blocked", True, "double"),
+            ("subblock+int32", "subblock", True, "double"),
+            ("blocked+int32+f32", "blocked", True, "single")]:
+        def setup(m=mode, c=compact, p=precision):
+            ds.set_scan_mode(m)
+            ds.set_ts_compaction(c)
+            ds.set_value_precision(p)
+        race(name, setup, spec)
+
+    # edge-search strategy at the flat+int32 config: binary search
     # (log2(N) gather rounds) vs compare_all (fused compare+reduce) vs
     # hier (sub-block firsts + 32-wide remainder — 1/32 the compares).
-    ds.set_scan_mode("flat")
-    ds.set_ts_compaction(True)
-    ds.set_value_precision("double")
     for smode in ("scan", "compare_all", "hier"):
-        ds.set_search_mode(smode)
-        drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
-        samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins,
-                                        rtt)
-        per = _median(samples)
-        print(json.dumps({
-            "config": "flat+int32+search_" + smode,
-            "s_per_dispatch": round(per, 4),
-            "dp_per_sec": round(S * N / per, 1),
-        }), flush=True)
-        bench._note("search_%s: %.4fs/dispatch" % (smode, per))
-    ds.set_search_mode("scan")
+        race("flat+int32+search_" + smode,
+             lambda m=smode: ds.set_search_mode(m), spec)
 
-    # min/max strategy A/B (NOTES r3: segments won on CPU, the chip
-    # decides the default): same shape, "min" downsample instead of avg.
-    from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
-    ds.set_scan_mode("flat")
-    ds.set_ts_compaction(True)
-    ds.set_value_precision("double")
-    spec_min = PipelineSpec(
-        aggregator="sum",
-        downsample=DownsampleStep("min", spec.downsample.window_spec,
-                                  "none", 0.0))
-    for mode in ("scan", "segment", "subblock"):
-        ds.set_extreme_mode(mode)
-        drain(dispatch(spec_min, g_pad, batch, wargs, origins.next()))
-        samples, _, _ = measure_drained(spec_min, g_pad, batch, wargs,
-                                        origins, rtt)
-        per = _median(samples)
-        print(json.dumps({
-            "config": "min+extreme_" + mode,
-            "s_per_dispatch": round(per, 4),
-            "dp_per_sec": round(S * N / per, 1),
-        }), flush=True)
-        bench._note("min+extreme_%s: %.4fs/dispatch" % (mode, per))
+    # min/max strategy: full-length reset-scan vs segment scatter vs the
+    # r4 sub-block decomposition.
+    for emode in ("scan", "segment", "subblock"):
+        race("min+extreme_" + emode,
+             lambda m=emode: ds.set_extreme_mode(m), spec_min)
 
-    # group-reduce strategy A/B (r4): segment scatter vs one-hot matmul
-    # for the cross-series moment combine — scatters serialize on TPU,
-    # the matmul streams on the MXU (same f64 contract, reassociated).
-    from opentsdb_tpu.ops import group_agg as ga
-    ds.set_extreme_mode("scan")
-    ds.set_scan_mode("flat")
-    ds.set_ts_compaction(True)
-    ds.set_value_precision("double")
+    # group-reduce strategy: segment scatter vs one-hot matmul (MXU) vs
+    # sorted contiguous-run reset-scans (r4).
     for gmode in ("segment", "matmul", "sorted"):
-        ga.set_group_reduce_mode(gmode)
-        drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
-        samples, _, _ = measure_drained(spec, g_pad, batch, wargs,
-                                        origins, rtt)
-        per = _median(samples)
-        print(json.dumps({
-            "config": "flat+int32+group_" + gmode,
-            "s_per_dispatch": round(per, 4),
-            "dp_per_sec": round(S * N / per, 1),
-        }), flush=True)
-        bench._note("group_%s: %.4fs/dispatch" % (gmode, per))
+        race("flat+int32+group_" + gmode,
+             lambda m=gmode: ga.set_group_reduce_mode(m), spec)
 
     # the r4 composition: every attribution-driven lever at once —
     # validates the per-axis winners actually compose (fusion could
-    # interact) before run_chip_measurements feeds them forward
-    ds.set_scan_mode("subblock")
-    ds.set_search_mode("hier")
-    ga.set_group_reduce_mode("sorted")
-    drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
-    samples, _, _ = measure_drained(spec, g_pad, batch, wargs, origins, rtt)
-    per = _median(samples)
-    print(json.dumps({
-        "config": "subblock+int32+hier+sorted",
-        "s_per_dispatch": round(per, 4),
-        "dp_per_sec": round(S * N / per, 1),
-    }), flush=True)
-    bench._note("combo subblock+hier+sorted: %.4fs/dispatch" % per)
+    # interact); pick_winners only ever feeds forward MEASURED rows.
+    def combo():
+        ds.set_scan_mode("subblock")
+        ds.set_search_mode("hier")
+        ga.set_group_reduce_mode("sorted")
+    race("subblock+int32+hier+sorted", combo, spec)
 
-    # restore defaults
-    ga.set_group_reduce_mode("segment")
-    ds.set_extreme_mode("scan")
-    ds.set_search_mode("scan")
-    ds.set_scan_mode("flat")
-    ds.set_ts_compaction(True)
-    ds.set_value_precision("double")
+    restore_defaults()
 
 
 if __name__ == "__main__":
