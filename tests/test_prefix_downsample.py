@@ -701,3 +701,53 @@ class TestWideGridGuards:
             ds_mod.set_search_mode("scan")
             ds_mod.set_extreme_mode("scan")
             group_agg.set_group_reduce_mode("segment")
+
+
+class TestNewModesAcrossWindowKinds:
+    """subblock / hier / sorted-extreme against calendar-edge and 0all
+    grids (the mode-equivalence sweeps above are fixed-grid only; the
+    int32 compaction does NOT apply to these kinds, so the modes must
+    work on raw int64 timestamps too)."""
+
+    def _batch(self, rng, s=3, n=128):
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n), np.float64)
+        mask = np.zeros((s, n), bool)
+        for i in range(s):
+            k = int(rng.integers(60, n - 5))
+            ts[i, :k] = START + np.sort(
+                rng.choice(5_000_000, size=k, replace=False))
+            v = rng.normal(20, 8, k)
+            v[rng.random(k) < 0.04] = np.nan
+            val[i, :k] = v
+            mask[i, :k] = True
+        return ts, val, mask
+
+    @pytest.mark.parametrize("agg", ["sum", "avg", "min", "max", "dev"])
+    @pytest.mark.parametrize("kind", ["edges", "all"])
+    def test_modes_agree_on_irregular_grids(self, agg, kind):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        rng = np.random.default_rng(83)
+        ts, val, mask = self._batch(rng)
+        if kind == "edges":
+            # deliberately irregular calendar-style edges
+            windows = EdgeWindows((START, START + 700_000, START + 800_000,
+                                   START + 2_000_000, START + 4_999_999))
+        else:
+            windows = AllWindow(START + 5_000, START + 4_500_000)
+        spec, wargs = windows.split()
+        _, want, wm = downsample(ts, val, mask, agg, spec, wargs, FILL_NONE)
+        ds_mod.set_scan_mode("subblock")
+        ds_mod.set_search_mode("hier")
+        ds_mod.set_extreme_mode("subblock")
+        try:
+            _, got, gm = downsample(ts, val, mask, agg, spec, wargs,
+                                    FILL_NONE)
+        finally:
+            ds_mod.set_scan_mode("flat")
+            ds_mod.set_search_mode("scan")
+            ds_mod.set_extreme_mode("scan")
+        np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm))
+        m = np.asarray(wm)
+        np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m],
+                                   rtol=1e-12, atol=1e-12)
