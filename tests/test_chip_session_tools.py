@@ -1,0 +1,97 @@
+"""The unattended measurement session's winner-selection logic.
+
+tools/run_chip_measurements.py feeds bench_prefix's A/B winners into
+every later stage of the chip session; a bug here silently corrupts the
+round's headline artifacts, and the session runs unattended (the
+watcher fires it on tunnel recovery), so the logic is pinned here.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "run_chip_measurements",
+        os.path.join(REPO, "tools", "run_chip_measurements.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.OUT = os.path.join(str(tmp_path), "out.json")
+    mod.REPO = str(tmp_path)          # winners file lands in tmp
+    return mod
+
+
+def _rows(d):
+    return [{"config": k, "s_per_dispatch": v} for k, v in d.items()]
+
+
+class TestPickWinners:
+    def test_fastest_complete_row_wins(self, tmp_path):
+        mod = _load(tmp_path)
+        env = mod.pick_winners(_rows({
+            "flat+int32": 0.59,
+            "subblock+int32": 0.30,
+            "flat+int32+search_hier": 0.45,
+            "flat+int32+group_sorted": 0.50,
+            "subblock+int32+hier+sorted": 0.20,   # fastest measured
+        }))
+        assert env["TSDB_SCAN_MODE"] == "subblock"
+        assert env["TSDB_SEARCH_MODE"] == "hier"
+        assert env["TSDB_GROUP_REDUCE_MODE"] == "sorted"
+
+    def test_regressed_combo_is_not_composed(self, tmp_path):
+        """Per-axis winners that were never measured TOGETHER must not be
+        composed: the fastest single measured row carries the day."""
+        mod = _load(tmp_path)
+        env = mod.pick_winners(_rows({
+            "subblock+int32": 0.30,                 # scan-axis winner
+            "flat+int32+search_hier": 0.35,         # search-axis winner
+            "flat+int32+group_sorted": 0.40,        # group-axis winner
+            "subblock+int32+hier+sorted": 0.90,     # combo regressed!
+        }))
+        # fastest measured row is subblock+int32 = (subblock, scan, segment)
+        assert env["TSDB_SCAN_MODE"] == "subblock"
+        assert env["TSDB_SEARCH_MODE"] == "scan"
+        assert env["TSDB_GROUP_REDUCE_MODE"] == "segment"
+
+    def test_partial_extreme_race_crowns_no_winner(self, tmp_path):
+        mod = _load(tmp_path)
+        env = mod.pick_winners(_rows({
+            "min+extreme_scan": 0.5,
+            "min+extreme_segment": 7.0,   # subblock row missing (crashed)
+        }))
+        assert "TSDB_EXTREME_MODE" not in env
+
+    def test_error_rows_are_ignored(self, tmp_path):
+        mod = _load(tmp_path)
+        env = mod.pick_winners(
+            _rows({"flat+int32": 0.59}) + [
+                {"config": "subblock+int32", "error": "Mosaic lowering"}])
+        assert env["TSDB_SCAN_MODE"] == "flat"
+
+    def test_winners_file_written(self, tmp_path):
+        mod = _load(tmp_path)
+        mod.pick_winners(_rows({
+            "subblock+int32": 0.30,
+            "min+extreme_scan": 0.5,
+            "min+extreme_segment": 0.6,
+            "min+extreme_subblock": 0.4,
+        }))
+        data = json.load(open(os.path.join(str(tmp_path),
+                                           "BENCH_WINNERS.json")))
+        assert data["env"]["TSDB_SCAN_MODE"] == "subblock"
+        assert data["env"]["TSDB_EXTREME_MODE"] == "subblock"
+
+    def test_f32_and_int64_rows_are_evidence_only(self, tmp_path):
+        mod = _load(tmp_path)
+        env = mod.pick_winners(_rows({
+            "blocked+int32+f32": 0.01,    # fastest but contract-breaking
+            "flat+int64": 0.02,
+            "flat+int32": 0.59,
+        }))
+        assert env["TSDB_SCAN_MODE"] == "flat"
+        assert env["TSDB_SEARCH_MODE"] == "scan"
