@@ -344,9 +344,19 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
     s, w = contrib.shape
     g = num_groups
     num = g * w
-    seg, ok, v = _flat_segments(contrib, participate, gid, g)
-    cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg,
-                              num_segments=num).reshape(g, w)
+    if agg_name == "median" or agg_name.startswith(("p", "ep")):
+        # scatter-free: counts via the contiguous-run reset-scan (the
+        # sorted-mode machinery, used unconditionally here — the [S*W]
+        # segment scatter was the remaining per-dispatch scatter on the
+        # percentile aggregator path)
+        vf0 = contrib.astype(jnp.float64)
+        ok0 = participate & ~jnp.isnan(vf0)
+        cnt = _SortedGroups(gid, g, s).sum(
+            ok0.astype(jnp.float64)).astype(jnp.int64)
+    else:
+        seg, ok, v = _flat_segments(contrib, participate, gid, g)
+        cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg,
+                                  num_segments=num).reshape(g, w)
 
     if agg_name == "mult":
         out = jax.ops.segment_prod(jnp.where(ok, v, 1.0), seg,
