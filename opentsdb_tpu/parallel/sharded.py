@@ -297,8 +297,10 @@ def _pad_rows(s_pad: int, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
               gid: np.ndarray | None = None, pad_gid_value: int = 0):
     """Pad the series axis to `s_pad` with inert rows.
 
-    The pad values are load-bearing: I64_MAX timestamps keep rows sorted,
-    mask False keeps points out of every window, and `pad_gid_value` must
+    The pad values are load-bearing: pad-sentinel timestamps keep rows
+    sorted (I64_MAX, or the int32 clip ceiling for pre-compacted ts_base
+    batches — the device-cache gather's pad value), mask False keeps
+    points out of every window, and `pad_gid_value` must
     be an OUT-OF-RANGE group id (pass num_groups) — mask False alone is not
     enough, because fill policies other than "none" expose every live
     window after downsample, so a phantom row with a real gid would
@@ -307,7 +309,10 @@ def _pad_rows(s_pad: int, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
     s, n = ts.shape
     if s_pad == s:
         return ts, val, mask, gid
-    pad_ts = np.full((s_pad, n), np.iinfo(np.int64).max, np.int64)
+    from opentsdb_tpu.storage.device_cache import I32_PAD_TS
+    sentinel = I32_PAD_TS if ts.dtype == np.int32 \
+        else np.iinfo(np.int64).max
+    pad_ts = np.full((s_pad, n), sentinel, ts.dtype)
     pad_val = np.zeros((s_pad, n), val.dtype)
     pad_mask = np.zeros((s_pad, n), bool)
     pad_ts[:s] = ts
@@ -471,10 +476,11 @@ def shard_rows_device(mesh: Mesh, ts, val, mask, gid: np.ndarray,
     if s_pad != s:
         # pure pad ROWS from _pad_rows (empty data in, pads out), then
         # concatenated on device: one definition of the phantom-row rule
-        # serves both layouts
+        # serves both layouts (incl. the int32 ts_base pad sentinel)
         pad_ts, pad_val, pad_mask, pad_gid = _pad_rows(
-            s_pad - s, np.empty((0, n), np.int64),
-            np.empty((0, n), val.dtype), np.empty((0, n), bool),
+            s_pad - s, np.empty((0, n), np.dtype(str(ts.dtype))),
+            np.empty((0, n), np.dtype(str(val.dtype))),
+            np.empty((0, n), bool),
             np.empty(0, gid.dtype), pad_gid_value)
         ts = jnp.concatenate([ts, jnp.asarray(pad_ts)])
         val = jnp.concatenate([val, jnp.asarray(pad_val)])

@@ -516,10 +516,10 @@ class QueryRunner:
             # coexists in the cache.
             # ts_base: eligible fixed grids get int32 offset timestamps
             # straight from the gather (the compaction pass leaves the
-            # query dispatch — r4 chip attribution).  Mesh queries keep
-            # int64: shard_rows_device's row padding is int64-typed.
+            # query dispatch — r4 chip attribution); shard_rows_device
+            # pads with the matching int32 sentinel for mesh re-scatter.
             from opentsdb_tpu.ops.downsample import precompact_base
-            ts_base = None if use_mesh else precompact_base(
+            ts_base = precompact_base(
                 window_spec, getattr(windows, "first_window_ms", None))
             cached = tsdb.device_cache.batch_for(
                 store, series_list[0].key.metric, series_list,
