@@ -47,6 +47,7 @@ class TSDB:
         self._query_mesh = _UNSET
         self._query_limits = None
         self.maintenance = None
+        self._apply_kernel_modes()
         self.metrics = UniqueId(
             UniqueIdType.METRIC,
             width=self.config.get_int("tsd.storage.uid.width.metric"),
@@ -138,6 +139,23 @@ class TSDB:
     # ------------------------------------------------------------------ #
     # Write path (TSDB.addPoint :1051)                                   #
     # ------------------------------------------------------------------ #
+
+    def _apply_kernel_modes(self) -> None:
+        """Apply tsd.query.kernel.* hot-path strategy config (operator
+        counterpart of the TSDB_*_MODE env toggles; empty = leave the
+        module default / env choice alone).  The setters clear the
+        dependent jit caches themselves."""
+        from opentsdb_tpu.ops import downsample as _ds
+        from opentsdb_tpu.ops import group_agg as _ga
+        for key, setter in (
+                ("tsd.query.kernel.scan_mode", _ds.set_scan_mode),
+                ("tsd.query.kernel.search_mode", _ds.set_search_mode),
+                ("tsd.query.kernel.extreme_mode", _ds.set_extreme_mode),
+                ("tsd.query.kernel.group_reduce_mode",
+                 _ga.set_group_reduce_mode)):
+            value = self.config.get_string(key)
+            if value:
+                setter(value)   # invalid values raise at startup, loudly
 
     def check_timestamp_and_tags(self, metric: str, timestamp: int | float,
                                  value, tags: dict[str, str]) -> None:

@@ -103,6 +103,14 @@ DEFAULTS: dict[str, str] = {
     "tsd.query.device_cache.mb": "4096",
     "tsd.query.device_cache.build_max_points": "200000000",
     "tsd.query.device_cache.batch_mb": "6144",
+    # Hot-path kernel strategies (chip-A/B'd by bench_prefix.py; the
+    # measurement session records winners in BENCH_WINNERS.json).  Empty
+    # keeps the module defaults / TSDB_*_MODE env; every form carries
+    # shape guards that demote it off losing shapes regardless.
+    "tsd.query.kernel.scan_mode": "",          # flat|blocked|subblock
+    "tsd.query.kernel.search_mode": "",        # scan|compare_all|hier
+    "tsd.query.kernel.extreme_mode": "",       # scan|segment|subblock
+    "tsd.query.kernel.group_reduce_mode": "",  # segment|matmul|sorted
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",

@@ -95,3 +95,47 @@ class TestPickWinners:
         }))
         assert env["TSDB_SCAN_MODE"] == "flat"
         assert env["TSDB_SEARCH_MODE"] == "scan"
+
+
+class TestKernelModeConfig:
+    """tsd.query.kernel.* config keys apply the hot-path strategies at
+    TSDB init (operator counterpart of the env toggles)."""
+
+    def test_config_applies_and_restores(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        from opentsdb_tpu.ops import downsample as ds
+        from opentsdb_tpu.ops import group_agg as ga
+        before = (ds._SCAN_MODE, ds._SEARCH_MODE, ds._EXTREME_MODE,
+                  ga._GROUP_REDUCE_MODE)
+        try:
+            TSDB(Config({
+                "tsd.query.kernel.scan_mode": "subblock",
+                "tsd.query.kernel.search_mode": "hier",
+                "tsd.query.kernel.extreme_mode": "subblock",
+                "tsd.query.kernel.group_reduce_mode": "sorted",
+            }))
+            assert ds._SCAN_MODE == "subblock"
+            assert ds._SEARCH_MODE == "hier"
+            assert ds._EXTREME_MODE == "subblock"
+            assert ga._GROUP_REDUCE_MODE == "sorted"
+        finally:
+            ds.set_scan_mode(before[0])
+            ds.set_search_mode(before[1])
+            ds.set_extreme_mode(before[2])
+            ga.set_group_reduce_mode(before[3])
+
+    def test_invalid_mode_raises_at_startup(self):
+        import pytest
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        with pytest.raises(ValueError):
+            TSDB(Config({"tsd.query.kernel.scan_mode": "bogus"}))
+
+    def test_empty_leaves_defaults(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        from opentsdb_tpu.ops import downsample as ds
+        before = ds._SCAN_MODE
+        TSDB(Config({}))
+        assert ds._SCAN_MODE == before
