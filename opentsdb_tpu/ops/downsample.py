@@ -951,22 +951,6 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
     return wts, out, out_mask
 
 
-def _sorted_runs(flat_v, okf, seg, num_cells: int):
-    """Value-sorted contiguous runs per segment cell.
-
-    Sorts (segment, value) pairs so each cell's members form an ascending
-    contiguous run (non-members +inf, at each run's tail).  Returns
-    (sorted_v, starts[num_cells]).  Shared by the exact percentile path
-    above and the streaming sketch's per-chunk rank grid.
-    """
-    sv = jnp.where(okf, flat_v, jnp.inf)
-    order = jnp.lexsort((sv, seg))
-    sorted_v = sv[order]
-    sorted_seg = seg[order]
-    starts = jnp.searchsorted(sorted_seg, jnp.arange(num_cells))
-    return sorted_v, starts
-
-
 def apply_fill(out, out_mask, live, fill_policy: str, fill_value: float,
                fdtype=None):
     """Fill empty live windows per FillPolicy (FillingDownsampler semantics).

@@ -53,7 +53,7 @@ import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
     WindowSpec, apply_fill, window_ids, window_timestamps,
-    _extreme_downsample, _sorted_runs,
+    _extreme_downsample,
     _window_scan_setup, _window_ids_fast, FILL_NONE)
 
 # Summary points per (series, window) quantile sketch.
@@ -292,42 +292,54 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
                 jnp.where(okf, flat, 1.0), seg,
                 num_segments=num)[:-1].reshape(s, w)
         if with_sketch:
-            # Exact per-cell equi-rank grid for this chunk: value-sort
-            # within (series, window) runs, interpolate K midpoint ranks.
-            sorted_v, starts = _sorted_runs(flat, okf, seg, s * w)
-            out["q"] = _rank_grid(sorted_v, starts, cnt.reshape(-1)) \
-                .reshape(s, w, SKETCH_K).astype(jnp.float32)
+            # Exact per-cell equi-rank grid for this chunk: ONE row sort
+            # with (window, value) keys (windows partition each row's
+            # points — S independent sorts, not a global [S*N] lexsort),
+            # then interpolate K midpoint ranks per cell.
+            from jax import lax
+            wkey = jnp.where(valid, win.astype(jnp.int32), w)
+            svals = jnp.where(valid, vf, jnp.inf)
+            _, sorted_rows = lax.sort((wkey, svals), dimension=1,
+                                      num_keys=2)
+            row_starts = jnp.concatenate(
+                [jnp.zeros((s, 1), jnp.int64),
+                 jnp.cumsum(cnt, axis=1)], axis=1)[:, :-1]   # [S, W]
+            out["q"] = _rank_grid(sorted_rows, row_starts, cnt) \
+                .astype(jnp.float32)
     return out
 
 
-def _rank_grid(sorted_v, starts, cnt, k: int = SKETCH_K):
-    """Exact K-point equi-rank grid per cell from value-sorted runs.
+def _rank_grid(sorted_rows, starts, cnt, k: int = SKETCH_K):
+    """Exact K-point equi-rank grid per cell from row-sorted runs.
 
-    sorted_v[L] ascending within each cell's contiguous run (non-members
-    +inf at the run tail), starts[C] run offsets, cnt[C] member counts.
-    Returns q[C, k]: value at fractional rank (j+0.5)/k of each cell via
-    linear interpolation between adjacent order statistics; empty cells
-    yield zeros (their count is zero, so merges ignore them).
+    sorted_rows[S, N] ascending within each (series, window) run (cell
+    (s, w) occupies columns [starts[s, w], starts[s, w] + cnt[s, w]);
+    non-members +inf past every run).  Returns q[S, W, k]: value at
+    fractional rank (j+0.5)/k of each cell via linear interpolation
+    between adjacent order statistics; empty cells yield zeros (their
+    count is zero, so merges ignore them).
     """
-    c = cnt.shape[0]
-    cf = cnt.astype(jnp.float64)[:, None]
+    s, w = cnt.shape
+    cf = cnt.astype(jnp.float64)[:, :, None]
     # fractional 0-based rank of target j: (j+0.5)/k * cnt - 0.5
-    fr = (jnp.arange(k, dtype=jnp.float64)[None, :] + 0.5) / k * cf - 0.5
+    fr = (jnp.arange(k, dtype=jnp.float64)[None, None, :] + 0.5) / k \
+        * cf - 0.5
     fr = jnp.clip(fr, 0.0, jnp.maximum(cf - 1.0, 0.0))
     lo = jnp.floor(fr)
     frac = fr - lo
-    top = sorted_v.shape[0] - 1
-    base = starts[:, None].astype(jnp.int64)
+    top = sorted_rows.shape[1] - 1
+    base = starts[:, :, None].astype(jnp.int64)
     i_lo = jnp.clip(base + lo.astype(jnp.int64), 0, top)
-    i_hi = jnp.clip(base + lo.astype(jnp.int64) + 1,
-                    0, top)
+    i_hi = jnp.clip(base + lo.astype(jnp.int64) + 1, 0, top)
     # never read past the cell's own run
-    last = base + jnp.maximum(cnt[:, None].astype(jnp.int64) - 1, 0)
+    last = base + jnp.maximum(cnt[:, :, None].astype(jnp.int64) - 1, 0)
     i_hi = jnp.minimum(i_hi, last)
-    v_lo = sorted_v[i_lo.reshape(-1)].reshape(c, k)
-    v_hi = sorted_v[i_hi.reshape(-1)].reshape(c, k)
+    v_lo = jnp.take_along_axis(sorted_rows, i_lo.reshape(s, w * k),
+                               axis=1).reshape(s, w, k)
+    v_hi = jnp.take_along_axis(sorted_rows, i_hi.reshape(s, w * k),
+                               axis=1).reshape(s, w, k)
     q = v_lo + frac * (v_hi - v_lo)
-    return jnp.where(cnt[:, None] > 0, q, 0.0)
+    return jnp.where(cnt[:, :, None] > 0, q, 0.0)
 
 
 def _interp_rows(t, xp, fp):

@@ -479,8 +479,9 @@ class TestSketchPercentiles:
         for _ in range(64):
             vals = np.sort(rng.normal(100, 25, 256))
             everything.append(vals)
-            grid = st._rank_grid(jnp.asarray(vals), jnp.asarray([0]),
-                                 jnp.asarray([256]))
+            grid = st._rank_grid(jnp.asarray(vals)[None, :],
+                                 jnp.asarray([[0]]),
+                                 jnp.asarray([[256]]))[0]
             q = st._merge_sketch(q, n, grid, jnp.asarray([256]))
             n = n + 256
         allv = np.concatenate(everything)
@@ -497,8 +498,9 @@ class TestSketchPercentiles:
         from opentsdb_tpu.ops import streaming as st
         K = st.SKETCH_K
         vals = np.sort(np.concatenate([np.arange(100.0), [np.inf]]))
-        grid = st._rank_grid(jnp.asarray(vals), jnp.asarray([0]),
-                             jnp.asarray([101]))
+        grid = st._rank_grid(jnp.asarray(vals)[None, :],
+                             jnp.asarray([[0]]),
+                             jnp.asarray([[101]]))[0]
         q = st._merge_sketch(jnp.zeros((1, K)), jnp.asarray([0]),
                              grid, jnp.asarray([101]))
         # two empty merges after: inf must still be there
