@@ -150,13 +150,16 @@ class _OriginSequence:
         return (self._base + self._i * 7919) % INTERVAL_MS
 
 
-def make_batch():
+def make_batch(precompacted: bool = True):
     """Device-resident [S, N] batch via a jitted closed-form generator.
 
-    Timestamps are int32 offsets from the first window's start — the
-    layout the device cache's gather delivers for eligible fixed grids
-    (storage/device_cache.py `ts_base`), so the measured dispatch is the
-    production cache-hit dispatch: no per-point compaction pass.
+    Default layout: timestamps as int32 offsets from the first window's
+    start — what the device cache's gather delivers for eligible fixed
+    grids (storage/device_cache.py `ts_base`), so the measured dispatch
+    is the production cache-hit dispatch: no per-point compaction pass.
+    `precompacted=False` keeps absolute int64 timestamps (the host-build
+    path's layout) — bench_prefix uses it to race the per-dispatch
+    compaction against the pre-compacted layout honestly.
     """
     import opentsdb_tpu.ops  # noqa: F401  (enables jax x64 mode)
     import jax
@@ -169,18 +172,20 @@ def make_batch():
         cols = jnp.arange(N, dtype=jnp.int64)
         h = (rows[:, None] * 2_654_435_761 + cols[None, :] * 40_503) \
             & 0x7FFFFFFF
-        ts = (START - first) + cols[None, :] * STEP_MEAN_MS + h % 5_000
+        ts = START + cols[None, :] * STEP_MEAN_MS + h % 5_000
         val = 100.0 + (h % 1_000).astype(jnp.float64) * 0.05
         mask = jnp.ones((S, N), dtype=bool)
         gid = rows % GROUPS
-        return ts.astype(jnp.int32), val, mask, gid
+        if precompacted:
+            return (ts - first).astype(jnp.int32), val, mask, gid
+        return ts, val, mask, gid
 
     out = jax.jit(gen)()
     jax.block_until_ready(out)
     return out
 
 
-def build_spec():
+def build_spec(precompacted: bool = True):
     import jax.numpy as jnp
     from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
     from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
@@ -188,9 +193,11 @@ def build_spec():
     end = START + N * STEP_MEAN_MS + 5_000
     fixed = FixedWindows.for_range(START, end, INTERVAL_MS)
     window_spec, wargs = fixed.split()
-    # the batch carries int32 offsets from the first window (make_batch);
-    # ts_base tells the pipeline so only the [W+1] edges re-base
-    wargs["ts_base"] = jnp.asarray(fixed.first_window_ms, jnp.int64)
+    if precompacted:
+        # the batch carries int32 offsets from the first window
+        # (make_batch); ts_base tells the pipeline so only the [W+1]
+        # edges re-base
+        wargs["ts_base"] = jnp.asarray(fixed.first_window_ms, jnp.int64)
     spec = PipelineSpec(
         aggregator="sum",
         downsample=DownsampleStep("avg", window_spec, "none", 0.0))

@@ -34,9 +34,11 @@ def main() -> None:
     from opentsdb_tpu.ops import group_agg as ga
     from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
 
-    batch = make_batch()
-    bench._note("batch resident")
+    batch = make_batch()                       # int32 ts_base layout
+    batch64 = make_batch(precompacted=False)   # absolute int64 layout
+    bench._note("batches resident")
     spec, wargs, g_pad = build_spec()
+    _spec64, wargs64, _g = build_spec(precompacted=False)
     spec_min = PipelineSpec(
         aggregator="sum",
         downsample=DownsampleStep("min", spec.downsample.window_spec,
@@ -53,19 +55,22 @@ def main() -> None:
         ds.set_ts_compaction(True)
         ds.set_value_precision("double")
 
-    def race(name: str, setup, pipeline_spec) -> None:
+    def race(name: str, setup, pipeline_spec, use_batch=None,
+             use_wargs=None) -> None:
         """One isolated race row: a candidate that fails to compile or
         dispatch prints an error row and the race continues — an
         unattended session must never lose the remaining rows to one
         bad candidate (the setters below always run from the restored
         default state)."""
         restore_defaults()
+        b = batch if use_batch is None else use_batch
+        w = wargs if use_wargs is None else use_wargs
         try:
             setup()
-            drain(dispatch(pipeline_spec, g_pad, batch, wargs,
+            drain(dispatch(pipeline_spec, g_pad, b, w,
                            origins.next()))           # compile + warm
-            samples, _, _ = measure_drained(pipeline_spec, g_pad, batch,
-                                            wargs, origins, rtt)
+            samples, _, _ = measure_drained(pipeline_spec, g_pad, b,
+                                            w, origins, rtt)
             per = _median(samples)
         except Exception as e:   # noqa: BLE001 — provenance over purity
             print(json.dumps({"config": name,
@@ -80,20 +85,30 @@ def main() -> None:
         }), flush=True)
         bench._note("%s: %.4fs/dispatch" % (name, per))
 
-    # scan mode x ts compaction x accumulation precision.  "subblock" is
-    # the r4 chip-attribution lever: no full-length f64 scan at all —
-    # sub-block f64 reduces + tiny cumsum + 32-wide remainder dots.  The
-    # f32 row is evidence-only (breaks the Java-double parity contract).
-    for name, mode, compact, precision in [
-            ("flat+int64", "flat", False, "double"),
-            ("flat+int32", "flat", True, "double"),
-            ("blocked+int64", "blocked", False, "double"),
-            ("blocked+int32", "blocked", True, "double"),
-            ("subblock+int32", "subblock", True, "double"),
-            ("blocked+int32+f32", "blocked", True, "single")]:
-        def setup(m=mode, c=compact, p=precision):
-            ds.set_scan_mode(m)
+    # Batch-layout evidence rows on the ABSOLUTE-int64 batch (the
+    # host-build layout): raw int64 end-to-end vs per-dispatch int32
+    # compaction (the r3 production path).  These quantify what the
+    # pre-compacted ts_base layout saves; the default rows below all
+    # ride the pre-compacted int32 batch (the cache-hit layout bench.py
+    # measures) where per-dispatch compaction is already gone.
+    for name, compact in [("flat+int64raw", False),
+                          ("flat+int64+dispatchcompact", True)]:
+        def setup(c=compact):
             ds.set_ts_compaction(c)
+        race(name, setup, spec, use_batch=batch64, use_wargs=wargs64)
+
+    # scan mode x accumulation precision on the pre-compacted batch.
+    # "subblock" is the r4 chip-attribution lever: no full-length f64
+    # scan at all — sub-block f64 reduces + tiny cumsum + 32-wide
+    # remainder dots.  The f32 row is evidence-only (breaks the
+    # Java-double parity contract).
+    for name, mode, precision in [
+            ("flat+int32", "flat", "double"),
+            ("blocked+int32", "blocked", "double"),
+            ("subblock+int32", "subblock", "double"),
+            ("blocked+int32+f32", "blocked", "single")]:
+        def setup(m=mode, p=precision):
+            ds.set_scan_mode(m)
             ds.set_value_precision(p)
         race(name, setup, spec)
 

@@ -143,18 +143,29 @@ class TSDB:
     def _apply_kernel_modes(self) -> None:
         """Apply tsd.query.kernel.* hot-path strategy config (operator
         counterpart of the TSDB_*_MODE env toggles; empty = leave the
-        module default / env choice alone).  The setters clear the
-        dependent jit caches themselves."""
+        module default / env choice alone).
+
+        PROCESS-GLOBAL: the strategies are trace-time module state (a
+        per-instance form would thread through every jitted pipeline's
+        static args), so the last constructed TSDB with a NON-EMPTY key
+        wins for the whole process — matching the one-TSDB-per-process
+        production shape.  Embedders running several TSDBs must config
+        them identically or leave the keys empty.  No-op when the value
+        already matches (the setters flush every dependent jit cache)."""
         from opentsdb_tpu.ops import downsample as _ds
         from opentsdb_tpu.ops import group_agg as _ga
-        for key, setter in (
-                ("tsd.query.kernel.scan_mode", _ds.set_scan_mode),
-                ("tsd.query.kernel.search_mode", _ds.set_search_mode),
-                ("tsd.query.kernel.extreme_mode", _ds.set_extreme_mode),
+        for key, setter, current in (
+                ("tsd.query.kernel.scan_mode", _ds.set_scan_mode,
+                 lambda: _ds._SCAN_MODE),
+                ("tsd.query.kernel.search_mode", _ds.set_search_mode,
+                 lambda: _ds._SEARCH_MODE),
+                ("tsd.query.kernel.extreme_mode", _ds.set_extreme_mode,
+                 lambda: _ds._EXTREME_MODE),
                 ("tsd.query.kernel.group_reduce_mode",
-                 _ga.set_group_reduce_mode)):
+                 _ga.set_group_reduce_mode,
+                 lambda: _ga._GROUP_REDUCE_MODE)):
             value = self.config.get_string(key)
-            if value:
+            if value and value != current():
                 setter(value)   # invalid values raise at startup, loudly
 
     def check_timestamp_and_tags(self, metric: str, timestamp: int | float,

@@ -344,16 +344,7 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
     s, w = contrib.shape
     g = num_groups
     num = g * w
-    if agg_name == "median" or agg_name.startswith(("p", "ep")):
-        # scatter-free: counts via the contiguous-run reset-scan (the
-        # sorted-mode machinery, used unconditionally here — the [S*W]
-        # segment scatter was the remaining per-dispatch scatter on the
-        # percentile aggregator path)
-        vf0 = contrib.astype(jnp.float64)
-        ok0 = participate & ~jnp.isnan(vf0)
-        cnt = _SortedGroups(gid, g, s).sum(
-            ok0.astype(jnp.float64)).astype(jnp.int64)
-    else:
+    if not (agg_name == "median" or agg_name.startswith(("p", "ep"))):
         seg, ok, v = _flat_segments(contrib, participate, gid, g)
         cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg,
                                   num_segments=num).reshape(g, w)
@@ -384,8 +375,10 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
         # ONE column sort with (gid, value) lexicographic keys instead of
         # a global [S*W] lexsort: each window's column sorts its S values
         # independently (W tiny bitonic sorts — the natural vectorized
-        # form), invalid rows keyed past every group.  starts/counts per
-        # (group, window) run follow from the cnt grid already computed.
+        # form), invalid rows keyed past every group.  The SAME sort
+        # yields starts AND counts (per-column searchsorted of the
+        # sorted keys at the group boundaries) — no scatter, no second
+        # valid-mask definition, nothing but this one sort.
         from jax import lax
         from opentsdb_tpu.ops.percentile import column_run_percentile
         vf2 = contrib.astype(jnp.float64)
@@ -395,13 +388,18 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
             jnp.where(in_range, gid, g).astype(jnp.int32)[:, None], (s, w))
         gkey = jnp.where(ok2, gkey, g)
         vals = jnp.where(ok2, vf2, jnp.inf)
-        _, sorted_cols = lax.sort((gkey, vals), dimension=0, num_keys=2)
-        starts = jnp.concatenate(
-            [jnp.zeros((1, w), cnt.dtype),
-             jnp.cumsum(cnt, axis=0)], axis=0)[:-1]          # [G, W]
+        sorted_keys, sorted_cols = lax.sort((gkey, vals), dimension=0,
+                                            num_keys=2)
+        bounds = jax.vmap(
+            lambda col: jnp.searchsorted(
+                col, jnp.arange(g + 1, dtype=sorted_keys.dtype)),
+            in_axes=1, out_axes=1)(sorted_keys)              # [G+1, W]
+        starts = bounds[:-1]
+        cnt = (bounds[1:] - bounds[:-1]).astype(jnp.int64)
         if agg_name == "median":
             # Upper median sorted[n // 2] (Aggregators.Median :397-431).
-            idx = jnp.clip(starts + cnt // 2, 0, s - 1)
+            idx = jnp.clip(starts + (cnt // 2).astype(starts.dtype),
+                           0, s - 1)
             out = jnp.where(
                 cnt > 0,
                 jnp.take_along_axis(sorted_cols, idx, axis=0), jnp.nan)
