@@ -23,6 +23,14 @@ platform registered alongside.  If the backend already initialized
 without a CPU platform the lane degrades to None and the planner keeps
 the accelerator path — routing is best-effort, correctness never depends
 on it.
+
+Known trade-off: the hot-path kernel strategies (scan/search/extreme/
+group-reduce modes) are process-global trace-time choices, so the lane
+compiles whatever modes the chip A/B crowned — tuned for the TPU, not
+the host.  At host-lane sizes (<= ~2M points) the measured spread
+between modes is small (every mode answers identically; only speed
+differs), and per-lane modes would mean per-lane jit cache flushes —
+deliberately not worth it.
 """
 
 from __future__ import annotations
