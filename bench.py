@@ -53,22 +53,31 @@ import sys
 import threading
 import time
 
-# Chip-validated hot-path modes.  Preference order: explicit env >
-# BENCH_WINNERS.json (written by tools/run_chip_measurements.py from the
-# fastest COMPLETE measured config of its bench_prefix A/B race on the
-# real chip) > the r4a hand-recorded winners (BENCH_CONFIGS_r04a.json:
-# compare_all beat the binary search 0.512 vs 0.578 s/dispatch, matmul
-# group-reduce beat the segment scatter 0.489 vs 0.606).  Shape guards
-# demote dense forms off this benchmark's shape either way.
-try:
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_WINNERS.json")) as _fh:
-        for _k, _v in json.load(_fh).get("env", {}).items():
-            os.environ.setdefault(_k, _v)
-except (OSError, ValueError):
-    pass
-os.environ.setdefault("TSDB_SEARCH_MODE", "compare_all")
-os.environ.setdefault("TSDB_GROUP_REDUCE_MODE", "matmul")
+def _apply_mode_defaults() -> None:
+    """Chip-validated hot-path modes, applied INSIDE main() only.
+
+    At module level this would leak into every importer (bench_configs /
+    bench_prefix import this module for its measurement helpers and must
+    control their own modes — an import-time setdefault put compare_all
+    under config 4's streamed grid and OOM'd it).  Preference order:
+    explicit env > BENCH_WINNERS.json (written by
+    tools/run_chip_measurements.py from the fastest COMPLETE measured
+    config of its bench_prefix A/B race on the real chip) > the r4a
+    hand-recorded winners (BENCH_CONFIGS_r04a.json: compare_all beat the
+    binary search 0.512 vs 0.578 s/dispatch, matmul group-reduce beat
+    the segment scatter 0.489 vs 0.606).  Shape guards demote dense
+    forms off losing shapes either way.  Must run before the first
+    opentsdb_tpu.ops import (the modes are read at import time).
+    """
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_WINNERS.json")) as fh:
+            for k, v in json.load(fh).get("env", {}).items():
+                os.environ.setdefault(k, v)
+    except (OSError, ValueError):
+        pass
+    os.environ.setdefault("TSDB_SEARCH_MODE", "compare_all")
+    os.environ.setdefault("TSDB_GROUP_REDUCE_MODE", "matmul")
 
 
 def _note(msg: str) -> None:
@@ -347,6 +356,7 @@ def run() -> None:
 
 
 def main() -> None:
+    _apply_mode_defaults()
     _arm_watchdog(float(os.environ.get("BENCH_DEADLINE_S", "1500")))
     try:
         run()

@@ -538,15 +538,23 @@ def _subblock_edges_fit(n: int, w_edges: int) -> bool:
     return w_edges * _SUB_K <= _SUBBLOCK_EDGE_FACTOR * n
 
 
+# compare_all's [N, W+1] per-row compare can MATERIALIZE when the
+# backend does not fuse the reduce (measured: CPU at N=65536 x 16385
+# edges attempted a multi-TB buffer).  Cap the per-row compare matrix;
+# the headline shape (65536 x 514 = 34M cells) stays comfortably under.
+_COMPARE_ALL_CELL_CAP = 1 << 27
+
+
 def _effective_search_mode(s: int, n: int, w_edges: int) -> str:
     """The configured search mode, demoted to "scan" for shapes where the
     dense form's per-edge compare cost would dwarf the binary search's
-    per-edge gather cost, or (hier) where the [S, W, K] remainder
-    intermediate would outgrow the batch."""
+    per-edge gather cost, or where its intermediate would outgrow memory
+    (compare_all's per-row compare matrix; hier's [S, W, K] remainder)."""
     del s   # every form scales linearly with S
     mode = _SEARCH_MODE
     logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
-    if mode == "compare_all" and n > _SEARCH_DEMOTE_RATIO * logn:
+    if mode == "compare_all" and (n > _SEARCH_DEMOTE_RATIO * logn
+                                  or n * w_edges > _COMPARE_ALL_CELL_CAP):
         return "scan"
     if mode == "hier" and (n // _SUB_K > _SEARCH_DEMOTE_RATIO * logn
                            or not _subblock_edges_fit(n, w_edges)):

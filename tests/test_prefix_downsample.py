@@ -751,3 +751,19 @@ class TestNewModesAcrossWindowKinds:
         m = np.asarray(wm)
         np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m],
                                    rtol=1e-12, atol=1e-12)
+
+
+def test_compare_all_memory_cap_demotes():
+    """compare_all must demote on shapes whose per-row [N, W+1] compare
+    matrix would materialize huge (config 4's 64k-pt chunk against a
+    16k-window grid attempted a multi-TB buffer on CPU)."""
+    from opentsdb_tpu.ops import downsample as ds_mod
+    ds_mod.set_search_mode("compare_all")
+    try:
+        # headline: 65536 x 514 cells — stays
+        assert ds_mod._effective_search_mode(1024, 65536, 514) \
+            == "compare_all"
+        # config-4 chunk grid: 65536 x 16385 cells — demote
+        assert ds_mod._effective_search_mode(512, 65536, 16385) == "scan"
+    finally:
+        ds_mod.set_search_mode("scan")
