@@ -131,14 +131,26 @@ def main() -> None:
         race("flat+int32+group_" + gmode,
              lambda m=gmode: ga.set_group_reduce_mode(m), spec)
 
-    # the r4 composition: every attribution-driven lever at once —
-    # validates the per-axis winners actually compose (fusion could
-    # interact); pick_winners only ever feeds forward MEASURED rows.
-    def combo():
-        ds.set_scan_mode("subblock")
-        ds.set_search_mode("hier")
-        ga.set_group_reduce_mode("sorted")
-    race("subblock+int32+hier+sorted", combo, spec)
+    # r4 compositions: the attribution-driven levers together and in
+    # pairs — fusion can interact, and pick_winners only ever feeds
+    # forward MEASURED rows, so the pairs are the fallbacks if the full
+    # combo regresses on one member.
+    def combo(scan=None, search=None, group=None):
+        def setup():
+            if scan:
+                ds.set_scan_mode(scan)
+            if search:
+                ds.set_search_mode(search)
+            if group:
+                ga.set_group_reduce_mode(group)
+        return setup
+
+    race("subblock+int32+hier", combo("subblock", "hier"), spec)
+    race("subblock+int32+sorted", combo("subblock", group="sorted"), spec)
+    race("flat+int32+hier+sorted", combo(search="hier", group="sorted"),
+         spec)
+    race("subblock+int32+hier+sorted",
+         combo("subblock", "hier", "sorted"), spec)
 
     restore_defaults()
 

@@ -72,6 +72,9 @@ def pick_winners(prefix_records: list[dict]) -> dict:
         "flat+int32+group_segment": ("flat", "scan", "segment"),
         "flat+int32+group_matmul": ("flat", "scan", "matmul"),
         "flat+int32+group_sorted": ("flat", "scan", "sorted"),
+        "subblock+int32+hier": ("subblock", "hier", "segment"),
+        "subblock+int32+sorted": ("subblock", "scan", "sorted"),
+        "flat+int32+hier+sorted": ("flat", "hier", "sorted"),
         "subblock+int32+hier+sorted": ("subblock", "hier", "sorted"),
     }
     timed = [(by_cfg[c], modes) for c, modes in full_rows.items()
