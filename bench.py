@@ -237,17 +237,27 @@ def drain(out) -> None:
         np.asarray(leaf.ravel()[0])
 
 
-def measure_rtt() -> float:
-    """Median cost of draining an already-resident tiny array (tunnel RTT +
-    tiny-slice dispatch), subtracted from each timed sample."""
+def measure_rtt(template=None) -> float:
+    """Median cost of draining an ALREADY-COMPUTED output, subtracted from
+    each timed sample.
+
+    The drain fetches one scalar per output leaf, and each fetch is a
+    serial tunnel round-trip (~70ms on axon) — so the sync cost scales
+    with the output's LEAF COUNT, not with chip work.  Measuring it
+    against a tiny one-leaf array undercounts a 3-leaf pipeline output by
+    two whole round-trips (~0.15s billed as execution at k=1; the r04b
+    session recorded exactly this: drained-k=1 0.250s vs pipelined
+    0.142s vs race-row-at-k=4 0.154s).  Pass the warmed-up output pytree
+    as `template` to measure the true per-sample sync cost; with no
+    template the old tiny-array probe is kept (single-leaf drains)."""
     import jax.numpy as jnp
 
-    tiny = jnp.zeros(8)
-    drain((tiny,))
+    probe = (jnp.zeros(8),) if template is None else template
+    drain(probe)
     samples = []
     for _ in range(5):
         t0 = time.perf_counter()
-        drain((tiny,))
+        drain(probe)
         samples.append(time.perf_counter() - t0)
     return _median(samples)
 
@@ -306,10 +316,17 @@ def run() -> None:
     origins = _OriginSequence()
 
     # compile + warm (unique origins too — even warmup never replays)
-    drain(dispatch(spec, g_pad, batch, wargs, origins.next()))
+    warm = dispatch(spec, g_pad, batch, wargs, origins.next())
+    drain(warm)
     _note("compiled")
-    rtt = measure_rtt()
-    _note("tunnel rtt: %.4fs (subtracted per sample)" % rtt)
+    # Sync cost measured against the REAL output structure: the drain is
+    # one serial tunnel round-trip per leaf, so a tiny one-leaf probe
+    # undercounts it by (leaves-1) RTTs and bills the difference as chip
+    # time (docstring of measure_rtt).
+    rtt = measure_rtt(template=warm)
+    _note("tunnel rtt: %.4fs for the %d-leaf output drain "
+          "(subtracted per sample)"
+          % (rtt, len(jax.tree_util.tree_leaves(warm))))
 
     samples, k_final, total_wall = measure_drained(spec, g_pad, batch,
                                                    wargs, origins, rtt)

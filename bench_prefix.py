@@ -44,8 +44,16 @@ def main() -> None:
         downsample=DownsampleStep("min", spec.downsample.window_spec,
                                   "none", 0.0))
     origins = _OriginSequence()
-    rtt = measure_rtt()
-    bench._note("rtt %.4fs" % rtt)
+    # Sync-cost probe against a REAL warmed pipeline output: the drain is
+    # one tunnel round-trip per leaf, so a one-leaf probe would bill
+    # (leaves-1) RTTs as chip time on every non-escalated sample, and a
+    # hand-built template would go stale if the pipeline's output pytree
+    # ever changes shape (see bench.measure_rtt docstring).  Every race
+    # row dispatches this same structure.
+    warm = dispatch(spec, g_pad, batch, wargs, origins.next())
+    drain(warm)
+    rtt = measure_rtt(template=warm)
+    bench._note("rtt %.4fs (real-output drain)" % rtt)
 
     def restore_defaults() -> None:
         ga.set_group_reduce_mode("segment")

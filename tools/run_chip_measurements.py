@@ -137,6 +137,11 @@ def main() -> None:
             stage_recs = []
             for ln in lines:
                 rec = json.loads(ln)
+                # stage_bench emits its own per-record "stage" label;
+                # preserve it (the r04b session clobbered the attribution
+                # labels and they had to be recovered from stderr)
+                if "stage" in rec:
+                    rec["label"] = rec.pop("stage")
                 rec["stage"] = name
                 if winner_env:
                     rec["ab_overrides"] = dict(winner_env)

@@ -34,13 +34,22 @@ def _note(msg: str) -> None:
 
 
 def time_fn(fn, args, rtt, reps=3):
-    """Median drained time of fn(*args) with the tunnel RTT removed."""
-    drain(fn(*args))            # compile
+    """Median drained time of fn(*args) with the sync cost removed.
+
+    The drain is one serial tunnel round-trip PER OUTPUT LEAF (~70ms each
+    on axon), so the subtracted cost is measured against THIS stage's own
+    already-computed output — a shared one-leaf probe would bill 1-2
+    whole RTTs as chip time on every multi-leaf stage and distort the
+    ranking this tool exists to produce.  `rtt` is kept as a floor for
+    degenerate cases (a drain can never cost less than one round-trip)."""
+    out = fn(*args)
+    drain(out)                  # compile
+    sync = max(measure_rtt(template=out), rtt)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         drain(fn(*args))
-        times.append(max(time.perf_counter() - t0 - rtt, 1e-9))
+        times.append(max(time.perf_counter() - t0 - sync, 1e-9))
     return _median(times)
 
 
