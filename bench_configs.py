@@ -86,10 +86,32 @@ class _Uniquifier:
 _UNIQ = _Uniquifier()
 _RTT = 0.0
 
+# Drain cost by leaf count: the drain is one serial tunnel round-trip
+# PER LEAF of the drained structure (see bench.measure_rtt), so each
+# distinct structure's sync cost is measured against the real thing once
+# and cached.  Subtracting only the one-leaf _RTT would bill (leaves-1)
+# round-trips per drain as execution time — and in the generation
+# calibrations (which drain a 3-leaf batch per chunk) the error flips
+# direction: inflated gen_time gets SUBTRACTED, overstating throughput.
+_SYNC_BY_LEAVES: dict = {}
 
-def _timed_passes(run_pass):
+
+def _sync_cost(template) -> float:
+    """Measured drain cost of this (already-computed) structure, floored
+    at one round-trip; cached per leaf count."""
+    import jax
+    n = len(jax.tree_util.tree_leaves(template))
+    if n not in _SYNC_BY_LEAVES:
+        _SYNC_BY_LEAVES[n] = max(measure_rtt(template=template), _RTT)
+    return _SYNC_BY_LEAVES[n]
+
+
+def _timed_passes(run_pass, sync: float | None = None):
     """Median per-pass seconds over unique-operand passes, >= MIN_WALL_S
-    total measured wall; each pass must end with its own drain inside."""
+    total measured wall; each pass must end with its own drain inside.
+    `sync` is the measured drain cost of the pass's output structure
+    (defaults to the one-leaf _RTT)."""
+    sub = _RTT if sync is None else sync
     times = []
     wall = 0.0
     while (wall < MIN_WALL_S or len(times) < MIN_PASSES) \
@@ -98,7 +120,7 @@ def _timed_passes(run_pass):
         run_pass()
         dt = time.perf_counter() - t0
         wall += dt
-        times.append(max(dt - _RTT, 1e-9))
+        times.append(max(dt - sub, 1e-9))
     return _median(times), len(times)
 
 
@@ -149,8 +171,11 @@ def _grouped_config(config: int, label: str, s: int, n: int, gid, g: int,
         w["first"] = wargs["first"] - jnp.asarray(_UNIQ.next(), jnp.int64)
         drain(run_group_pipeline(spec, ts, val, mask, gid, g, w))
 
-    one_pass()  # compile
-    per_pass, n_passes = _timed_passes(one_pass)
+    w0 = dict(wargs)
+    w0["first"] = wargs["first"] - jnp.asarray(_UNIQ.next(), jnp.int64)
+    warm = run_group_pipeline(spec, ts, val, mask, gid, g, w0)  # compile
+    drain(warm)
+    per_pass, n_passes = _timed_passes(one_pass, sync=_sync_cost(warm))
     _note("config %d: %d passes, median %.4fs" % (config, n_passes,
                                                   per_pass))
     _emit(config, label, reps_points, per_pass, n_dev)
@@ -239,10 +264,13 @@ def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
 
     # Calibrate generation cost alone (disjoint bases; drained per chunk).
     cal0 = base0 + chunks * n_chunk
+    batch = None
     t0 = time.perf_counter()
     for k in range(chunks):
-        drain(gen(s, n_chunk, cal0 + k * n_chunk))
-    gen_time = max(time.perf_counter() - t0 - _RTT * chunks, 0.0)
+        batch = gen(s, n_chunk, cal0 + k * n_chunk)
+        drain(batch)
+    gen_wall = time.perf_counter() - t0
+    gen_time = max(gen_wall - _sync_cost(batch) * chunks, 0.0)
 
     acc = StreamAccumulator.create(s, wspec, wargs, sketch=sketch,
                                    lanes=lanes_for(finishes))
@@ -251,7 +279,7 @@ def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
         acc.update(*gen(s, n_chunk, base0 + k * n_chunk))
     outs = [acc.finish(f) for f in finishes]
     drain(outs)
-    elapsed = time.perf_counter() - t0 - _RTT
+    elapsed = time.perf_counter() - t0 - _sync_cost(outs)
     return max(elapsed - gen_time, 1e-9), outs
 
 
@@ -321,8 +349,9 @@ def config4(scale: float, n_dev: int) -> None:
                                   ["avg"], base0)
         t0 = time.perf_counter()
         wts, v, m = outs[0]
-        drain(run_grid_tail(spec, wts, v, m, gid, 1))
-        return secs + max(time.perf_counter() - t0 - _RTT, 0.0)
+        tail = run_grid_tail(spec, wts, v, m, gid, 1)
+        drain(tail)
+        return secs + max(time.perf_counter() - t0 - _sync_cost(tail), 0.0)
 
     one_pass()  # compile
     times = [one_pass() for _ in range(MIN_PASSES)]
@@ -346,10 +375,13 @@ def config5(scale: float, n_dev: int) -> None:
     points = s * n_chunk * chunks
 
     def gen_calibration(base0):
+        batch = None
         t0 = time.perf_counter()
         for k in range(chunks):
-            drain(gen(s, n_chunk, base0 + k * n_chunk))
-        return max(time.perf_counter() - t0 - _RTT * chunks, 0.0)
+            batch = gen(s, n_chunk, base0 + k * n_chunk)
+            drain(batch)
+        wall = time.perf_counter() - t0
+        return max(wall - _sync_cost(batch) * chunks, 0.0)
 
     # Each time chunk's 1m windows are disjoint from the next chunk's, so
     # rollup rows (sum/count/min/max lanes) emit per chunk — the write-side
@@ -363,9 +395,14 @@ def config5(scale: float, n_dev: int) -> None:
             s, wspec, wargs,
             lanes=lanes_for(("sum", "count", "min", "max")))
         acc.update(*gen(s, n_chunk, base0 + k * n_chunk))
-        drain([acc.finish(f) for f in ("sum", "count", "min", "max")])
+        outs = [acc.finish(f) for f in ("sum", "count", "min", "max")]
+        drain(outs)
+        return outs
 
-    one_chunk(0, _UNIQ.next(1 << 28))  # compile (same shapes every chunk)
+    # compile (same shapes every chunk); keep the output structure for
+    # the per-chunk sync-cost subtraction below
+    tmpl = one_chunk(0, _UNIQ.next(1 << 28))
+    chunk_sync = _sync_cost(tmpl)
 
     def one_pass():
         base0 = _UNIQ.next(1 << 28)
@@ -373,8 +410,8 @@ def config5(scale: float, n_dev: int) -> None:
         t0 = time.perf_counter()
         for k in range(chunks):
             one_chunk(k, base0)
-        return max(time.perf_counter() - t0 - gen_time - _RTT * chunks,
-                   1e-9)
+        return max(time.perf_counter() - t0 - gen_time
+                   - chunk_sync * chunks, 1e-9)
 
     times = [one_pass() for _ in range(MIN_PASSES)]
     _note("config 5: %d passes, median %.3fs" % (len(times),

@@ -34,6 +34,12 @@ def main() -> None:
     from opentsdb_tpu.ops import group_agg as ga
     from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
 
+    # This harness races EXPLICIT kernel modes: the platform guard (which
+    # demotes dense search forms on CPU execution) would silently time
+    # the scan kernel under a dense row's label on a CPU dev box.  A
+    # no-op on the chip, where the race is meant to run.
+    ds.set_platform_mode_guard(False)
+
     batch = make_batch()                       # int32 ts_base layout
     batch64 = make_batch(precompacted=False)   # absolute int64 layout
     bench._note("batches resident")

@@ -544,19 +544,55 @@ def _subblock_edges_fit(n: int, w_edges: int) -> bool:
 # the headline shape (65536 x 514 = 34M cells) stays comfortably under.
 _COMPARE_ALL_CELL_CAP = 1 << 27
 
+# hier's sub-block-firsts compare is a [N/K, W+1] per-row matrix — 32x
+# smaller than compare_all's, but it still materializes where the
+# backend does not fuse the compare into its count.  Measured at the
+# config-1 shape (N=1M, W=3501: 109M cells/row): 18x slower than the
+# binary search on the host lane, and a scoped-vmem compile failure on
+# the chip (r04b session, config 1 device lane).  The headline shape
+# (2048 x 286 = 0.6M cells/row) sits two orders of magnitude under this
+# cap; shapes above it take the binary search.
+_HIER_CELL_CAP = 1 << 23
+
+
+# The dense search forms are ACCELERATOR winners: on the chip their
+# compare+count fuses into vmem (r04b: hier 0.416s vs scan 0.590s on the
+# headline dispatch), but on CPU the backend materializes the compare
+# matrix — measured 70x slower than the binary search at [64, 65536] x
+# 514 edges, and 18x end-to-end on the config-1 host lane.  With this
+# guard on (production default), any trace executing on CPU — the
+# planner's small-query host lane, or a CPU-only process — takes the
+# binary search regardless of the configured/env mode.  Tests disable it
+# suite-wide (conftest) so CPU CI still exercises the dense kernels'
+# correctness at small shapes.
+_PLATFORM_MODE_GUARD = True
+
+
+def set_platform_mode_guard(on: bool) -> None:
+    """Enable/disable CPU demotion of dense search modes; clears caches."""
+    global _PLATFORM_MODE_GUARD
+    _PLATFORM_MODE_GUARD = bool(on)
+    _clear_dependent_caches()
+
 
 def _effective_search_mode(s: int, n: int, w_edges: int) -> str:
     """The configured search mode, demoted to "scan" for shapes where the
     dense form's per-edge compare cost would dwarf the binary search's
     per-edge gather cost, or where its intermediate would outgrow memory
-    (compare_all's per-row compare matrix; hier's [S, W, K] remainder)."""
+    (compare_all's per-row compare matrix; hier's [S, W, K] remainder),
+    and on CPU execution (see _PLATFORM_MODE_GUARD)."""
     del s   # every form scales linearly with S
     mode = _SEARCH_MODE
+    if _PLATFORM_MODE_GUARD and mode != "scan":
+        from opentsdb_tpu.ops.hostlane import execution_platform
+        if execution_platform() == "cpu":
+            return "scan"
     logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
     if mode == "compare_all" and (n > _SEARCH_DEMOTE_RATIO * logn
                                   or n * w_edges > _COMPARE_ALL_CELL_CAP):
         return "scan"
     if mode == "hier" and (n // _SUB_K > _SEARCH_DEMOTE_RATIO * logn
+                           or (n // _SUB_K) * w_edges > _HIER_CELL_CAP
                            or not _subblock_edges_fit(n, w_edges)):
         return "scan"
     return mode

@@ -24,18 +24,23 @@ without a CPU platform the lane degrades to None and the planner keeps
 the accelerator path — routing is best-effort, correctness never depends
 on it.
 
-Known trade-off: the hot-path kernel strategies (scan/search/extreme/
-group-reduce modes) are process-global trace-time choices, so the lane
-compiles whatever modes the chip A/B crowned — tuned for the TPU, not
-the host.  At host-lane sizes (<= ~2M points) the measured spread
-between modes is small (every mode answers identically; only speed
-differs), and per-lane modes would mean per-lane jit cache flushes —
-deliberately not worth it.
+The kernel strategies (scan/search/extreme/group-reduce modes) are
+process-global trace-time choices, but they are resolved PER EXECUTION
+PLATFORM: the r04b chip session measured the dense edge-search forms —
+chip winners — running 18x SLOWER than the binary search on the host
+lane at the config-1 shape (they materialize their compare matrix where
+the backend does not fuse it into the count), so the shape guards in
+ops.downsample consult `execution_platform()` and demote dense forms on
+CPU.  This is safe with one shared jit cache because
+`jax.default_device` participates in the cache key (probed: two devices
+-> two traces, re-entry hits the cache), so each lane's trace reads the
+lane context that was active when IT was traced.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
 import os
 
@@ -75,6 +80,23 @@ def cpu_device():
     return _CPU_DEVICE
 
 
+# True while a host_lane() context is active on this thread/task: the
+# planner routed this dispatch to the host CPU, so trace-time kernel-mode
+# guards must pick host-friendly strategies (see module docstring).
+_LANE_ACTIVE = contextvars.ContextVar("tsdb_host_lane_active",
+                                      default=False)
+
+
+@contextlib.contextmanager
+def _lane_marked(inner):
+    tok = _LANE_ACTIVE.set(True)
+    try:
+        with inner:
+            yield
+    finally:
+        _LANE_ACTIVE.reset(tok)
+
+
 def host_lane(enabled: bool):
     """Context manager: place this dispatch on the host CPU when enabled
     and a CPU device exists; otherwise a no-op."""
@@ -82,4 +104,18 @@ def host_lane(enabled: bool):
     if dev is None:
         return contextlib.nullcontext()
     import jax
-    return jax.default_device(dev)
+    return _lane_marked(jax.default_device(dev))
+
+
+def execution_platform() -> str:
+    """Best-effort platform this thread's dispatches execute on — for
+    trace-time kernel-mode guards.  'cpu' inside an active host_lane()
+    (regardless of the process's accelerator), else the default backend's
+    platform ('tpu', 'cpu', ...)."""
+    if _LANE_ACTIVE.get():
+        return "cpu"
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return "cpu"

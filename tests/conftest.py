@@ -29,6 +29,15 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The platform guard demotes the dense (accelerator-winner) search forms
+# to the binary search whenever execution lands on CPU — which is every
+# test in this suite.  Disable it suite-wide so CPU CI keeps exercising
+# the dense kernels' correctness; tests of the guard itself re-enable it
+# locally (tests/test_prefix_downsample.py::TestPlatformModeGuard).
+from opentsdb_tpu.ops import downsample as _ds  # noqa: E402
+
+_ds.set_platform_mode_guard(False)
+
 
 @pytest.fixture
 def rng():

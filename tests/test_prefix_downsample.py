@@ -590,7 +590,11 @@ class TestSearchModeShapeGuard:
             ("compare_all", 65536): "compare_all",   # headline: stays
             ("compare_all", 1 << 20): "scan",        # 1M-pt chunk: demote
             ("hier", 65536): "hier",
-            ("hier", 1 << 20): "hier",     # N/32 still beats 20 gathers
+            # 1M-pt rows x 514 edges: 16.8M compare cells/row exceeds
+            # _HIER_CELL_CAP — the config-1 shape (109M cells/row) ran
+            # 18x slower on the host lane and failed scoped-vmem compile
+            # on the chip (r04b), so wide hier matrices demote
+            ("hier", 1 << 20): "scan",
             ("hier", 1 << 24): "scan",     # 16M-pt rows: demote
         }
         for (mode, n), want in cases.items():
@@ -632,6 +636,71 @@ class TestSearchModeShapeGuard:
         np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm))
         m = np.asarray(wm)
         np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m])
+
+
+class TestPlatformModeGuard:
+    """Dense search forms are accelerator winners only: with the platform
+    guard on (the production default; conftest disables it suite-wide so
+    CPU CI still exercises the dense kernels), any CPU execution — the
+    host lane or a CPU-only process — takes the binary search (r04b chip
+    session: hier 18x slower than scan end-to-end on the config-1 host
+    lane)."""
+
+    def _guarded(self, fn):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        ds_mod.set_platform_mode_guard(True)
+        try:
+            return fn(ds_mod)
+        finally:
+            ds_mod.set_platform_mode_guard(False)
+            ds_mod.set_search_mode("scan")
+
+    def test_cpu_backend_demotes_dense_modes(self):
+        # this suite runs on the CPU platform, so the default backend is
+        # cpu and the guard demotes even outside a host_lane context
+        def check(ds_mod):
+            for mode in ("compare_all", "hier"):
+                ds_mod.set_search_mode(mode)
+                assert ds_mod._effective_search_mode(8, 65536, 514) == "scan"
+        self._guarded(check)
+
+    def test_host_lane_context_reports_cpu(self):
+        from opentsdb_tpu.ops import hostlane
+        assert hostlane.execution_platform() == "cpu"  # cpu default backend
+        with hostlane.host_lane(True):
+            assert hostlane.execution_platform() == "cpu"
+
+    def test_guard_off_keeps_dense_modes(self):
+        from opentsdb_tpu.ops import downsample as ds_mod
+        ds_mod.set_search_mode("hier")
+        try:
+            assert ds_mod._effective_search_mode(8, 65536, 514) == "hier"
+        finally:
+            ds_mod.set_search_mode("scan")
+
+    def test_guarded_query_answers_identically(self):
+        """End-to-end: the same downsample under guard+dense-mode equals
+        the scan answer (the guard changes strategy, never values)."""
+        rng = np.random.default_rng(7)
+        s, n = 2, 512
+        ts = np.sort(rng.choice(10_000_000, size=(s, n), replace=False),
+                     axis=1) + START
+        val = rng.normal(50, 10, (s, n))
+        mask = np.ones((s, n), bool)
+        windows = FixedWindows.for_range(START, START + 10_000_001, 60_000)
+        spec, wargs = windows.split()
+        _, want, wm = downsample(ts, val, mask, "sum", spec, wargs,
+                                 FILL_NONE)
+
+        def run_guarded(ds_mod):
+            ds_mod.set_search_mode("hier")
+            return downsample(ts, val, mask, "sum", spec, wargs, FILL_NONE)
+
+        _, got, gm = self._guarded(run_guarded)
+        np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm))
+        m = np.asarray(wm)
+        np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m],
+                                   rtol=1e-12)
 
 
 class TestWideGridGuards:

@@ -58,6 +58,11 @@ def main() -> None:
     import jax.numpy as jnp
     from opentsdb_tpu.ops import downsample as ds
 
+    # Stages time EXPLICIT kernel forms; the platform guard would demote
+    # the dense search forms on a CPU dev box and mislabel the rows (a
+    # no-op on the chip).
+    ds.set_platform_mode_guard(False)
+
     batch = make_batch()
     _note("batch resident")
     spec, wargs, g_pad = build_spec()
