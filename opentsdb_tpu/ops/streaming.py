@@ -53,7 +53,7 @@ import numpy as np
 
 from opentsdb_tpu.ops.downsample import (
     WindowSpec, apply_fill, window_ids, window_timestamps,
-    _extreme_downsample,
+    _absolute_ts, _extreme_downsample,
     _window_scan_setup, _window_ids_fast, FILL_NONE)
 
 # Summary points per (series, window) quantile sketch.
@@ -114,9 +114,13 @@ def is_sketch_ds(name: str) -> bool:
 
 
 def _zero_state(s: int, w: int, sketch: bool = False,
-                lanes: frozenset | None = None) -> dict:
+                lanes: frozenset | None = None,
+                with_oob: bool = False) -> dict:
     """Zero accumulator state holding only the requested lanes
-    (None = every lane, the conservative default)."""
+    (None = every lane, the conservative default).  `with_oob` adds the
+    0-d audit counter sliced updates maintain — only slice-enabled
+    accumulators carry it (the sharded accumulator's shard_map specs are
+    rank-2 per leaf)."""
     if lanes is None:
         lanes = _ALL_LANES
     if "m2" in lanes and "total" not in lanes:
@@ -132,6 +136,11 @@ def _zero_state(s: int, w: int, sketch: bool = False,
         "prod": lambda: jnp.ones((s, w), jnp.float64),
     }
     state = {"n": jnp.zeros((s, w), jnp.int64)}
+    if with_oob:
+        # audit counter for window-sliced updates: valid points that
+        # fell OUTSIDE the caller-declared window slice (a w0/slice
+        # contract violation — see StreamAccumulator.update)
+        state["oob"] = jnp.zeros((), jnp.int64)
     for name in lanes:
         state[name] = builders[name]()
     if sketch:
@@ -457,6 +466,8 @@ def _merge(state: dict, chunk: dict) -> dict:
         merged["q"] = _merge_sketch(
             state["q"].reshape(-1, k), n1.reshape(-1),
             chunk["q"].reshape(-1, k), n2.reshape(-1)).reshape(s, w, k)
+    if "oob" in state:
+        merged["oob"] = state["oob"] + chunk.get("oob", 0)
     return merged
 
 
@@ -467,6 +478,77 @@ def _update(spec: WindowSpec, state: dict, ts, val, mask, wargs: dict):
                                         with_sketch="q" in state))
 
 
+def _update_sliced(spec: WindowSpec, wc: int, state: dict, ts, val, mask,
+                   wargs: dict, w0):
+    """Fold a chunk whose windows live in [w0, w0 + wc) of the grid.
+
+    The full-grid update computes and merges [S, W] moment grids PER
+    CHUNK — for wider-than-data streams (BASELINE config 2: an 8.4M-pt
+    chunk against a 721k-window grid) that is O(S*W) state traffic and a
+    92M-segment scatter per chunk, which is where the measured
+    4.7s/chunk went (chip, r04b).  A time-ordered chunk only ever
+    touches a contiguous window range, so: compute the chunk's moments
+    on a LOCAL wc-window grid (same kernels, wc static), merge them into
+    the state's [w0, w0+wc) slice, and write the slice back —
+    O(S*wc + points) per chunk, W-independent.
+
+    w0 is caller-declared (the planner/bench know each chunk's time
+    range on the host); valid points OUTSIDE the declared slice are
+    counted into state["oob"] instead of being silently dropped, so a
+    wrong w0 is detectable (StreamAccumulator.oob_count()).  Fixed
+    grids only.
+    """
+    from jax import lax
+
+    if spec.kind != "fixed":
+        raise ValueError("sliced streaming updates require a fixed grid")
+    w_total = spec.count
+    lanes = frozenset(state) & _ALL_LANES
+    w0 = jnp.clip(jnp.asarray(w0, jnp.int64), 0, max(w_total - wc, 0))
+
+    spec_l = WindowSpec("fixed", wc, spec.interval_ms)
+    wargs_l = dict(wargs)
+    wargs_l["first"] = wargs["first"] + w0 * spec.interval_ms
+    wargs_l["nwin"] = jnp.clip(
+        wargs["nwin"] - w0.astype(jnp.int32), 0, wc).astype(jnp.int32)
+    chunk = _chunk_moments(ts, val, mask, spec_l, wargs_l, lanes=lanes,
+                           with_sketch="q" in state)
+
+    # slice-merge: every lane is a per-cell associative merge, so merging
+    # the slice equals merging the full grid (cells outside the slice
+    # receive only identity contributions from this chunk)
+    cur = {}
+    for k in state:
+        if k == "oob":
+            continue
+        if k == "q":
+            s, _, kq = state["q"].shape
+            cur["q"] = lax.dynamic_slice(state["q"], (0, w0, 0),
+                                         (s, wc, kq))
+        else:
+            s = state[k].shape[0]
+            cur[k] = lax.dynamic_slice(state[k], (0, w0), (s, wc))
+    merged = _merge(cur, chunk)
+    new_state = dict(state)
+    for k, v in merged.items():
+        starts = (0, w0, 0) if k == "q" else (0, w0)
+        new_state[k] = lax.dynamic_update_slice(state[k], v, starts)
+
+    # audit: valid in-grid points the declared slice missed.  No
+    # per-point division: in-grid membership is a timestamp range
+    # compare, and the points the slice DID fold are exactly the live
+    # cells of the local count lane the kernels already computed.
+    ok = mask & ~jnp.isnan(val.astype(jnp.float64))
+    tsa = _absolute_ts(ts, wargs)
+    lo = wargs["first"]
+    hi = lo + wargs["nwin"].astype(jnp.int64) * spec.interval_ms
+    in_grid_total = jnp.sum(ok & (tsa >= lo) & (tsa < hi))
+    live_l = jnp.arange(wc, dtype=jnp.int32)[None, :] < wargs_l["nwin"]
+    folded = jnp.sum(jnp.where(live_l, chunk["n"], 0))
+    new_state["oob"] = state["oob"] + (in_grid_total - folded)
+    return new_state
+
+
 # State buffers are DONATED: the accumulator grid can reach GBs (config 2:
 # [128, 2^20] x 4 lanes ~ 3.5 GB), and without donation every queued async
 # update holds old state + chunk moments + new state — the r3 chip run
@@ -475,6 +557,8 @@ def _update(spec: WindowSpec, state: dict, ts, val, mask, wargs: dict):
 # caller never touches the pre-update state again (StreamAccumulator
 # replaces self.state at enqueue).
 _jitted_update = jax.jit(_update, static_argnums=0, donate_argnums=1)
+_jitted_update_sliced = jax.jit(_update_sliced, static_argnums=(0, 1),
+                                donate_argnums=2)
 
 
 def _finish(spec: WindowSpec, ds_function: str, fill_policy: str,
@@ -550,23 +634,59 @@ class StreamAccumulator:
     spec: WindowSpec
     wargs: dict
     state: dict
+    window_slice: int | None = None
 
     @staticmethod
     def create(num_series: int, spec: WindowSpec, wargs: dict,
                sketch: bool = False,
-               lanes: frozenset | None = None) -> "StreamAccumulator":
+               lanes: frozenset | None = None,
+               window_slice: int | None = None) -> "StreamAccumulator":
         """`sketch=True` adds the [S, W, K] quantile-summary lane so
         rank-based downsample functions can finish (approximate).
         `lanes` (from lanes_for()) restricts state to what the finish
-        functions need — sum/avg/count stream scatter-free."""
-        return StreamAccumulator(spec, wargs, _zero_state(num_series,
-                                                          spec.count,
-                                                          sketch, lanes))
+        functions need — sum/avg/count stream scatter-free.
+        `window_slice` (fixed grids only) enables O(S*wc)-per-chunk
+        sliced updates for wider-than-data streams: the static count of
+        windows any single chunk can span; callers then pass each
+        chunk's first window index to update(w0=...)."""
+        wc = None
+        if window_slice is not None and spec.kind == "fixed":
+            # quantize up for jit-cache stability across similar streams,
+            # but gently: full pow2 padding would double the slice (and
+            # every per-chunk fold) at just-past-a-power shapes
+            ws = max(int(window_slice), 1)
+            bucket = 1 << max(6, ws.bit_length() - 3)
+            wc = min(-(-ws // bucket) * bucket, spec.count)
+            if wc >= spec.count:
+                wc = None      # slice as wide as the grid: use full path
+        return StreamAccumulator(spec, wargs,
+                                 _zero_state(num_series, spec.count,
+                                             sketch, lanes,
+                                             with_oob=wc is not None),
+                                 wc)
 
-    def update(self, ts, val, mask) -> None:
-        """Fold one [S, n] chunk in (async — returns at enqueue)."""
-        self.state = _jitted_update(self.spec, self.state, ts, val, mask,
-                                    self.wargs)
+    def update(self, ts, val, mask, w0: int | None = None) -> None:
+        """Fold one [S, n] chunk in (async — returns at enqueue).
+
+        `w0`: index of the first grid window this chunk's points can
+        touch (host-known for time-ordered chunking).  With a
+        window_slice-enabled accumulator this routes to the sliced
+        update — the chunk must fit in [w0, w0 + window_slice); points
+        outside are counted in oob_count() rather than folded."""
+        if w0 is not None and self.window_slice is not None:
+            self.state = _jitted_update_sliced(
+                self.spec, self.window_slice, self.state, ts, val, mask,
+                self.wargs, w0)
+        else:
+            self.state = _jitted_update(self.spec, self.state, ts, val,
+                                        mask, self.wargs)
+
+    def oob_count(self) -> int:
+        """Valid points sliced updates missed (w0 contract violations);
+        0 in correct use.  Host sync."""
+        if "oob" not in self.state:
+            return 0
+        return int(np.asarray(self.state["oob"]))
 
     def finish(self, ds_function: str, fill_policy: str = FILL_NONE,
                fill_value: float = 0.0):

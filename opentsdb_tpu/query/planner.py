@@ -708,11 +708,14 @@ class QueryRunner:
             s_rows = sharded_acc.s_pad   # pack at padded width: no re-copy
             update = sharded_acc.update
         else:
-            acc = StreamAccumulator.create(s, window_spec, wargs,
-                                           sketch=sketch, lanes=lanes)
+            # Created after the first chunk is packed: its observed
+            # window span sizes the sliced-update window (wider-than-
+            # data grids fold each chunk into an O(S*wc) state slice
+            # instead of touching the whole [S, W] grid — the r04b chip
+            # session measured 4.7s/chunk on config 2's 721k-window grid
+            # with full-grid folds).
+            acc = None
             s_rows = s
-            update = lambda t, v, m: acc.update(  # noqa: E731
-                jnp.asarray(t), jnp.asarray(v), jnp.asarray(m))
 
         # timestamp cursors, not index offsets: monotone progression means
         # no pre-existing point is ever streamed twice even when an out-of-
@@ -723,10 +726,14 @@ class QueryRunner:
         if sharded_acc is not None:
             from opentsdb_tpu.parallel.sharded import n_devices
             self.exec_stats["meshDevices"] = float(n_devices(mesh))
+        use_slice = window_spec.kind == "fixed" and sharded_acc is None
+        first_ms = int(np.asarray(wargs["first"])) if use_slice else 0
+        interval = window_spec.interval_ms
         for chunk_i in range(n_chunks_total):
             ts = np.full((s_rows, n_chunk), PAD_TS, np.int64)
             val = np.zeros((s_rows, n_chunk), np.float64)
             mask = np.zeros((s_rows, n_chunk), bool)
+            tmin = tmax = None
             for i, series in enumerate(series_list):
                 t, fv = series.window_chunk(seg.start_ms, seg.end_ms,
                                             cursors[i], n_chunk, fix)
@@ -736,7 +743,31 @@ class QueryRunner:
                     val[i, :m] = fv
                     mask[i, :m] = True
                     cursors[i] = int(t[-1])
-            update(ts, val, mask)
+                    tmin = int(t[0]) if tmin is None else min(tmin,
+                                                              int(t[0]))
+                    tmax = int(t[-1]) if tmax is None else max(tmax,
+                                                               int(t[-1]))
+            if sharded_acc is not None:
+                update(ts, val, mask)
+            else:
+                if acc is None:
+                    wslice = None
+                    if use_slice and tmin is not None:
+                        # 2x the first chunk's span: headroom for later
+                        # chunks (series advance on their own cursors, so
+                        # spans vary); a chunk that still overflows just
+                        # takes the full-grid fold below
+                        wslice = 2 * ((tmax - tmin) // interval + 2)
+                    acc = StreamAccumulator.create(
+                        s, window_spec, wargs, sketch=sketch, lanes=lanes,
+                        window_slice=wslice)
+                w0 = None
+                if acc.window_slice is not None and tmin is not None \
+                        and (tmax - tmin) // interval + 2 \
+                        <= acc.window_slice:
+                    w0 = (tmin - first_ms) // interval
+                acc.update(jnp.asarray(ts), jnp.asarray(val),
+                           jnp.asarray(mask), w0=w0)
             if (chunk_i + 1) % 16 == 0:
                 # Backpressure: updates enqueue asynchronously, and a long
                 # scan would otherwise stage hundreds of chunk transfers
@@ -750,6 +781,16 @@ class QueryRunner:
 
         if sharded_acc is not None:
             return sharded_acc.finish_tail(spec, gid, g_pad)
+        if acc is None:     # zero chunks (empty range): empty state
+            acc = StreamAccumulator.create(s, window_spec, wargs,
+                                           sketch=sketch, lanes=lanes)
+        if acc.oob_count():
+            # w0 = floor((chunk_min - first)/interval) with wc >= the
+            # chunk's span makes this impossible; a nonzero count means
+            # dropped points, never serve a wrong answer
+            raise RuntimeError(
+                "internal: %d points fell outside their declared "
+                "streaming window slice" % acc.oob_count())
         step = spec.downsample
         wts, v, m = acc.finish(step.function, step.fill_policy,
                                step.fill_value)

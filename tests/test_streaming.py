@@ -97,6 +97,115 @@ def test_single_chunk_equals_full():
                                rtol=1e-12, atol=1e-12)
 
 
+class TestSlicedUpdates:
+    """Window-sliced streaming updates (W-independent per-chunk cost)
+    must match the full-grid fold bit-for-bit: merging a chunk into the
+    [w0, w0+wc) state slice equals merging it into the whole grid when
+    the chunk's windows all land in the slice — and points outside the
+    declared slice are audited, never silently dropped."""
+
+    @staticmethod
+    def _stream_sliced(ts, val, mask, windows, ds_fn, chunk=17,
+                       window_slice=None, w0_offset=0, sketch=False):
+        spec, wargs = windows.split()
+        s, n = ts.shape
+        if window_slice is None:
+            # widest chunk's window span (host-known, like the planner)
+            window_slice = 1
+            for k in range(0, n, chunk):
+                cts = ts[:, k:k + chunk]
+                real = cts[cts != PAD]
+                if real.size:
+                    span = int((real.max() - real.min())
+                               // windows.interval_ms) + 2
+                    window_slice = max(window_slice, span)
+        acc = StreamAccumulator.create(s, spec, wargs, sketch=sketch,
+                                       window_slice=window_slice)
+        for k in range(0, n, chunk):
+            w = min(chunk, n - k)
+            cts = np.full((s, chunk), PAD, np.int64)
+            cval = np.zeros((s, chunk), np.float64)
+            cmask = np.zeros((s, chunk), bool)
+            cts[:, :w] = ts[:, k:k + chunk]
+            cval[:, :w] = val[:, k:k + chunk]
+            cmask[:, :w] = mask[:, k:k + chunk]
+            real = cts[cts != PAD]
+            w0 = 0 if not real.size else int(
+                (real.min() - windows.first_window_ms)
+                // windows.interval_ms)
+            acc.update(cts, cval, cmask, w0=w0 + w0_offset)
+        return acc
+
+    @pytest.mark.parametrize("ds_fn", sorted(STREAMABLE_DS))
+    def test_sliced_equals_full_stream(self, ds_fn):
+        rng = np.random.default_rng(29)
+        ts, val, mask = _sorted_batch(rng)
+        # wide grid relative to the data: 900s of data on 10s windows
+        windows = FixedWindows.for_range(START, START + 900_000, 10_000)
+        want = _stream_in_chunks(ts, val, mask, windows, ds_fn)
+        acc = self._stream_sliced(ts, val, mask, windows, ds_fn)
+        assert acc.window_slice is not None, "slice must be engaged"
+        assert acc.oob_count() == 0
+        gts, gout, gmask = (np.asarray(x) for x in acc.finish(ds_fn,
+                                                              FILL_NONE))
+        wts, wout, wmask = (np.asarray(x) for x in want)
+        np.testing.assert_array_equal(gts, wts)
+        np.testing.assert_array_equal(gmask, wmask)
+        np.testing.assert_allclose(gout[gmask], wout[wmask],
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_sliced_sketch_matches_full(self):
+        rng = np.random.default_rng(31)
+        ts, val, mask = _sorted_batch(rng, s=3)
+        windows = FixedWindows.for_range(START, START + 900_000, 10_000)
+        spec, wargs = windows.split()
+        s, n = ts.shape
+        acc_full = StreamAccumulator.create(s, spec, wargs, sketch=True)
+        for k in range(0, n, 17):
+            w = min(17, n - k)
+            cts = np.full((s, 17), PAD, np.int64)
+            cval = np.zeros((s, 17), np.float64)
+            cmask = np.zeros((s, 17), bool)
+            cts[:, :w] = ts[:, k:k + 17]
+            cval[:, :w] = val[:, k:k + 17]
+            cmask[:, :w] = mask[:, k:k + 17]
+            acc_full.update(cts, cval, cmask)
+        acc = self._stream_sliced(ts, val, mask, windows, "p90",
+                                  sketch=True)
+        assert acc.oob_count() == 0
+        _, want, wmask = acc_full.finish("p90", FILL_NONE)
+        _, got, gmask = acc.finish("p90", FILL_NONE)
+        np.testing.assert_array_equal(np.asarray(gmask), np.asarray(wmask))
+        m = np.asarray(wmask)
+        np.testing.assert_allclose(np.asarray(got)[m], np.asarray(want)[m],
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_wrong_w0_is_audited_not_silent(self):
+        rng = np.random.default_rng(37)
+        ts, val, mask = _sorted_batch(rng, s=2)
+        windows = FixedWindows.for_range(START, START + 900_000, 10_000)
+        acc = self._stream_sliced(ts, val, mask, windows, "sum",
+                                  w0_offset=40)   # shift slices off target
+        assert acc.oob_count() > 0
+
+    def test_slice_as_wide_as_grid_falls_back(self):
+        rng = np.random.default_rng(41)
+        ts, val, mask = _sorted_batch(rng, s=2)
+        windows = FixedWindows.for_range(START, START + 900_000, 300_000)
+        spec, wargs = windows.split()
+        acc = StreamAccumulator.create(2, spec, wargs,
+                                       window_slice=10_000)
+        assert acc.window_slice is None     # wider than the grid: full path
+        acc.update(ts, val, mask, w0=0)     # w0 accepted, full-grid fold
+        assert acc.oob_count() == 0
+        _, out, omask = acc.finish("sum", FILL_NONE)
+        _, want, wm = downsample(ts, val, mask, "sum", spec, wargs,
+                                 FILL_NONE)
+        np.testing.assert_allclose(np.asarray(out)[np.asarray(omask)],
+                                   np.asarray(want)[np.asarray(wm)],
+                                   rtol=1e-12)
+
+
 class TestPlannerStreaming:
     """E2e: a sub-threshold and an over-threshold run answer identically."""
 
