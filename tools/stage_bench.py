@@ -111,13 +111,22 @@ def main() -> None:
         (cts, cedges), rtt))
 
     # r4 attribution-driven forms, timed beside the originals
-    def search_hier(t, e):
-        mode = ds._SEARCH_MODE
-        ds._SEARCH_MODE = "hier"
+    import contextlib
+
+    @contextlib.contextmanager
+    def forced_mode(module, attr, value):
+        """Trace-time module-global kernel-mode swap with restore (the
+        modes are read when jit traces, inside the with-block)."""
+        prev = getattr(module, attr)
+        setattr(module, attr, value)
         try:
-            return ds._edge_search(t, e)
+            yield
         finally:
-            ds._SEARCH_MODE = mode
+            setattr(module, attr, prev)
+
+    def search_hier(t, e):
+        with forced_mode(ds, "_SEARCH_MODE", "hier"):
+            return ds._edge_search(t, e)
 
     record("searchsorted_hier", time_fn(
         jax.jit(search_hier), (cts, cedges), rtt))
@@ -161,16 +170,48 @@ def main() -> None:
     from opentsdb_tpu.ops import group_agg as ga
 
     def group_tail_sorted(g, v, m, gi):
-        mode = ga._GROUP_REDUCE_MODE
-        ga._GROUP_REDUCE_MODE = "sorted"
-        try:
+        with forced_mode(ga, "_GROUP_REDUCE_MODE", "sorted"):
             return grid_group_aggregate(g, v, m, gi, g_pad, agg_sum)
-        finally:
-            ga._GROUP_REDUCE_MODE = mode
 
     record("group_tail_sorted", time_fn(
         jax.jit(group_tail_sorted), (wts0, dval, dmask, jnp.asarray(gid)),
         rtt))
+
+    # Decompose the group tail (~180ms measured r04b on [1024, 512]
+    # grids whose raw traffic is ~2MB — three orders of magnitude above
+    # bandwidth cost; these rows find where it actually goes):
+    # interpolation machinery vs each reduce mode vs the raw reset-scan
+    # primitive the sorted mode leans on.
+    from opentsdb_tpu.ops.group_agg import (grid_contributions,
+                                            moment_group_reduce,
+                                            _SortedGroups)
+    gid_arr = jnp.asarray(gid)
+    # same f64 cast grid_group_aggregate applies before the call — the
+    # stage must time the program the pipeline actually runs, including
+    # under the single-precision A/B mode
+    contrib_fn = jax.jit(lambda g, v, m: grid_contributions(
+        g, v.astype(jnp.float64), m, agg_sum))
+    record("group_contrib", time_fn(contrib_fn, (wts0, dval, dmask), rtt))
+    contrib, participate = contrib_fn(wts0, dval, dmask)
+    drain((contrib, participate))
+
+    def reduce_under(mode):
+        def run(c, p, gi):
+            with forced_mode(ga, "_GROUP_REDUCE_MODE", mode):
+                return moment_group_reduce("sum", c, p, gi, g_pad)
+        return run
+
+    for mode in ("segment", "matmul", "sorted"):
+        record("group_reduce_" + mode, time_fn(
+            jax.jit(reduce_under(mode)), (contrib, participate, gid_arr),
+            rtt))
+
+    def raw_reset_scan(c, gi):
+        sg = _SortedGroups(gi, g_pad, c.shape[0])
+        return sg.sum(c.astype(jnp.float64))
+
+    record("group_raw_reset_scan", time_fn(
+        jax.jit(raw_reset_scan), (contrib, gid_arr), rtt))
 
     from bench import dispatch
     record("full_pipeline", time_fn(
