@@ -286,6 +286,7 @@ def _clear_dependent_caches() -> None:
         from opentsdb_tpu.parallel import sharded
         sharded.sharded_query_pipeline.cache_clear()
         sharded._stream_update_fn.cache_clear()
+        sharded._stream_update_sliced_fn.cache_clear()
         sharded._stream_finish_fn.cache_clear()
     except ImportError:  # parallel extras absent in minimal installs
         pass

@@ -620,6 +620,21 @@ def _finish(spec: WindowSpec, ds_function: str, fill_policy: str,
 _jitted_finish = jax.jit(_finish, static_argnums=(0, 1, 2))
 
 
+def quantize_window_slice(window_slice, spec: WindowSpec):
+    """Static sliced-update width from a requested chunk window span.
+
+    Quantized up for jit-cache stability across similar streams, but
+    gently: full pow2 padding would double the slice (and every
+    per-chunk fold) at just-past-a-power shapes.  None when slicing
+    cannot help (non-fixed grid, or the slice would cover the grid)."""
+    if window_slice is None or spec.kind != "fixed":
+        return None
+    ws = max(int(window_slice), 1)
+    bucket = 1 << max(6, ws.bit_length() - 3)
+    wc = min(-(-ws // bucket) * bucket, spec.count)
+    return None if wc >= spec.count else wc
+
+
 @dataclass
 class StreamAccumulator:
     """Device-resident per-(series, window) moment state fed chunk by chunk.
@@ -649,16 +664,7 @@ class StreamAccumulator:
         sliced updates for wider-than-data streams: the static count of
         windows any single chunk can span; callers then pass each
         chunk's first window index to update(w0=...)."""
-        wc = None
-        if window_slice is not None and spec.kind == "fixed":
-            # quantize up for jit-cache stability across similar streams,
-            # but gently: full pow2 padding would double the slice (and
-            # every per-chunk fold) at just-past-a-power shapes
-            ws = max(int(window_slice), 1)
-            bucket = 1 << max(6, ws.bit_length() - 3)
-            wc = min(-(-ws // bucket) * bucket, spec.count)
-            if wc >= spec.count:
-                wc = None      # slice as wide as the grid: use full path
+        wc = quantize_window_slice(window_slice, spec)
         return StreamAccumulator(spec, wargs,
                                  _zero_state(num_series, spec.count,
                                              sketch, lanes,

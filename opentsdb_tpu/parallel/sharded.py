@@ -325,8 +325,22 @@ def _pad_rows(s_pad: int, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
     return pad_ts, pad_val, pad_mask, out_gid
 
 
+def padded_rows(mesh: Mesh, s: int) -> int:
+    """Sharded row count: series padded up to a multiple of the mesh's
+    device count (one source of truth for accumulator state and the
+    planner's chunk-packing width)."""
+    n_dev = n_devices(mesh)
+    return -(-s // n_dev) * n_dev
+
+
+def _leaf_spec(key: str):
+    """shard_map spec per accumulator-state leaf: grids shard rows over
+    the mesh; the 0-d oob audit counter stays replicated."""
+    return P() if key == "oob" else P(_BOTH, None)
+
+
 @lru_cache(maxsize=64)
-def _stream_update_fn(mesh: Mesh, window_spec):
+def _stream_update_fn(mesh: Mesh, window_spec, state_keys=None):
     """Jitted shard_map'd accumulator fold: row-local, zero collectives.
 
     Each chip folds its own [S_local, n] chunk rows into its own
@@ -339,15 +353,48 @@ def _stream_update_fn(mesh: Mesh, window_spec):
     def upd(state, ts, val, mask, wargs):
         return streaming._update(window_spec, state, ts, val, mask, wargs)
 
+    # state_keys is passed when the accumulator carries the 0-d "oob"
+    # audit leaf (slice-enabled accumulators whose overflow chunks fall
+    # back to this full fold): per-leaf specs keep the scalar replicated
+    # while the grids shard
+    state_specs = P(_BOTH, None) if state_keys is None else {
+        k: _leaf_spec(k) for k in state_keys}
     mapped = shard_map(
         upd, mesh=mesh,
-        in_specs=(P(_BOTH, None), P(_BOTH, None), P(_BOTH, None),
+        in_specs=(state_specs, P(_BOTH, None), P(_BOTH, None),
                   P(_BOTH, None), P()),
-        out_specs=P(_BOTH, None),
+        out_specs=state_specs,
         check_vma=False)
     # Donate the state (arg 0) for the same reason as streaming's
     # _jitted_update: the sharded grid can reach GBs per chip and the
     # caller replaces its reference at enqueue.
+    return jax.jit(mapped, donate_argnums=0)
+
+
+@lru_cache(maxsize=64)
+def _stream_update_sliced_fn(mesh: Mesh, window_spec, wc: int,
+                             state_keys: frozenset):
+    """Sharded window-sliced fold (see streaming._update_sliced): each
+    chip merges its row shard's chunk moments into the [w0, w0+wc) slice
+    of its own [S_local, W] state — per-chunk cost O(S_local*wc), not
+    O(S_local*W).  w0 is replicated; the 0-d oob audit counter psums
+    over the mesh so it stays replicated."""
+    from opentsdb_tpu.ops import streaming
+
+    def upd(state, ts, val, mask, wargs, w0):
+        prev_oob = state["oob"]
+        new = streaming._update_sliced(window_spec, wc, state, ts, val,
+                                       mask, wargs, w0)
+        new["oob"] = prev_oob + lax.psum(new["oob"] - prev_oob, _BOTH)
+        return new
+
+    state_specs = {k: _leaf_spec(k) for k in state_keys}
+    mapped = shard_map(
+        upd, mesh=mesh,
+        in_specs=(state_specs, P(_BOTH, None), P(_BOTH, None),
+                  P(_BOTH, None), P(), P()),
+        out_specs=state_specs,
+        check_vma=False)
     return jax.jit(mapped, donate_argnums=0)
 
 
@@ -391,37 +438,66 @@ class ShardedStreamAccumulator:
     """
 
     def __init__(self, mesh: Mesh, num_series: int, window_spec, wargs,
-                 sketch: bool = False, lanes: frozenset | None = None):
+                 sketch: bool = False, lanes: frozenset | None = None,
+                 window_slice: int | None = None):
         from opentsdb_tpu.ops import streaming
 
         self.mesh = mesh
         self.window_spec = window_spec
         self.wargs = wargs
-        n_dev = n_devices(mesh)
         self.num_series = num_series
-        self.s_pad = -(-num_series // n_dev) * n_dev
+        self.s_pad = padded_rows(mesh, num_series)
         self._row_sh = NamedSharding(mesh, P(_BOTH, None))
+        self._rep_sh = NamedSharding(mesh, P())
         self._gid_sh = NamedSharding(mesh, P(_BOTH))
+        self.window_slice = streaming.quantize_window_slice(window_slice,
+                                                            window_spec)
         state = streaming._zero_state(self.s_pad, window_spec.count,
-                                      sketch, lanes)
-        self.state = {k: jax.device_put(v, self._row_sh)
-                      for k, v in state.items()}
-        self._update = _stream_update_fn(mesh, window_spec)
+                                      sketch, lanes,
+                                      with_oob=self.window_slice
+                                      is not None)
+        self.state = {k: jax.device_put(
+            v, self._rep_sh if _leaf_spec(k) == P() else self._row_sh)
+            for k, v in state.items()}
+        keys = (frozenset(state) if self.window_slice is not None
+                else None)
+        self._update = _stream_update_fn(mesh, window_spec, keys)
+        self._update_sliced = None
+        if self.window_slice is not None:
+            self._update_sliced = _stream_update_sliced_fn(
+                mesh, window_spec, self.window_slice, keys)
 
     def update(self, ts: np.ndarray, val: np.ndarray,
-               mask: np.ndarray) -> None:
+               mask: np.ndarray, w0: int | None = None) -> None:
         """Fold one [num_series, n] host chunk (async — returns at enqueue).
 
         Rows are padded to the sharded row count (callers may pack chunks
         at `s_pad` rows directly to skip the copy); padding rows carry
         mask False so their moment state stays zero (n=0), which the
         finish's participate logic excludes (pad gid is out-of-range too).
+
+        `w0` (with a window_slice-enabled accumulator) routes to the
+        sliced fold — each chip merges an O(S_local * wc) state slice
+        instead of its whole [S_local, W] grid; see
+        StreamAccumulator.update for the contract.
         """
         ts, val, mask, _ = _pad_rows(self.s_pad, ts, val, mask)
         d_ts, d_val, d_mask = (jax.device_put(x, self._row_sh)
                                for x in (ts, val, mask))
+        if w0 is not None and self._update_sliced is not None:
+            self.state = self._update_sliced(self.state, d_ts, d_val,
+                                             d_mask, self.wargs,
+                                             jnp.asarray(w0, jnp.int64))
+            return
         self.state = self._update(self.state, d_ts, d_val, d_mask,
                                   self.wargs)
+
+    def oob_count(self) -> int:
+        """Valid points sliced folds missed (w0 contract violations);
+        0 in correct use.  Host sync."""
+        if "oob" not in self.state:
+            return 0
+        return int(np.asarray(self.state["oob"]))
 
     def finish_tail(self, pipeline_spec, gid: np.ndarray, num_groups: int):
         """Replicated (wts[W], out[G, W], out_mask[G, W]) for the query."""
@@ -430,7 +506,10 @@ class ShardedStreamAccumulator:
         pad_gid = np.full(self.s_pad, num_groups, np.int64)
         pad_gid[:self.num_series] = gid
         d_gid = jax.device_put(pad_gid, self._gid_sh)
-        return fn(self.state, d_gid, self.wargs)
+        # the finish fn's state spec is rank-2 per leaf; the 0-d oob
+        # audit counter is not part of the grid finish
+        state = {k: v for k, v in self.state.items() if k != "oob"}
+        return fn(state, d_gid, self.wargs)
 
 
 def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
@@ -445,9 +524,8 @@ def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
     downsample, so a phantom row with an in-range gid would participate in
     count/avg (the r3 phantom-row bug).
     """
-    n_dev = n_devices(mesh)
     s, n = ts.shape
-    s_pad = -(-s // n_dev) * n_dev
+    s_pad = padded_rows(mesh, s)
     ts, val, mask, gid = _pad_rows(s_pad, ts, val, mask, gid, pad_gid_value)
     return _put_row_sharded(mesh, ts, val, mask, gid)
 
@@ -470,9 +548,8 @@ def shard_rows_device(mesh: Mesh, ts, val, mask, gid: np.ndarray,
     of a fresh host upload.  gid is host-side (the planner builds it per
     query) and pads exactly like shard_rows.
     """
-    n_dev = n_devices(mesh)
     s, n = ts.shape
-    s_pad = -(-s // n_dev) * n_dev
+    s_pad = padded_rows(mesh, s)
     if s_pad != s:
         # pure pad ROWS from _pad_rows (empty data in, pads out), then
         # concatenated on device: one definition of the phantom-row rule

@@ -699,23 +699,31 @@ class QueryRunner:
                     % (s, window_spec.count,
                        " x %d-point sketches" % SKETCH_K if sketch else "",
                        est // 2**20, state_mb))
-        sharded_acc = None
+        # Both accumulators are created AFTER the first chunk is packed:
+        # its observed window span sizes the sliced-update window
+        # (wider-than-data grids fold each chunk into an O(S*wc) state
+        # slice instead of touching the whole [S, W] grid — the r04b
+        # chip session measured 4.7s/chunk on config 2's 721k-window
+        # grid with full-grid folds; the sharded form slices each chip's
+        # [S_local, W] state the same way).
+        acc = None          # StreamAccumulator | ShardedStreamAccumulator
         if use_sharded:
-            from opentsdb_tpu.parallel import ShardedStreamAccumulator
-            sharded_acc = ShardedStreamAccumulator(mesh, s, window_spec,
-                                                   wargs, sketch=sketch,
-                                                   lanes=lanes)
-            s_rows = sharded_acc.s_pad   # pack at padded width: no re-copy
-            update = sharded_acc.update
+            from opentsdb_tpu.parallel.sharded import (n_devices,
+                                                       padded_rows)
+            s_rows = padded_rows(mesh, s)    # pack padded: no re-copy
+            self.exec_stats["meshDevices"] = float(n_devices(mesh))
         else:
-            # Created after the first chunk is packed: its observed
-            # window span sizes the sliced-update window (wider-than-
-            # data grids fold each chunk into an O(S*wc) state slice
-            # instead of touching the whole [S, W] grid — the r04b chip
-            # session measured 4.7s/chunk on config 2's 721k-window grid
-            # with full-grid folds).
-            acc = None
             s_rows = s
+
+        def make_acc(wslice):
+            if use_sharded:
+                from opentsdb_tpu.parallel import ShardedStreamAccumulator
+                return ShardedStreamAccumulator(
+                    mesh, s, window_spec, wargs, sketch=sketch,
+                    lanes=lanes, window_slice=wslice)
+            return StreamAccumulator.create(
+                s, window_spec, wargs, sketch=sketch, lanes=lanes,
+                window_slice=wslice)
 
         # timestamp cursors, not index offsets: monotone progression means
         # no pre-existing point is ever streamed twice even when an out-of-
@@ -723,10 +731,7 @@ class QueryRunner:
         cursors: list[int | None] = [None] * s
         n_chunks_total = -(-max_len // n_chunk)
         self._bump("streamedChunks", n_chunks_total)
-        if sharded_acc is not None:
-            from opentsdb_tpu.parallel.sharded import n_devices
-            self.exec_stats["meshDevices"] = float(n_devices(mesh))
-        use_slice = window_spec.kind == "fixed" and sharded_acc is None
+        use_slice = window_spec.kind == "fixed"
         first_ms = int(np.asarray(wargs["first"])) if use_slice else 0
         interval = window_spec.interval_ms
         for chunk_i in range(n_chunks_total):
@@ -747,25 +752,22 @@ class QueryRunner:
                                                               int(t[0]))
                     tmax = int(t[-1]) if tmax is None else max(tmax,
                                                                int(t[-1]))
-            if sharded_acc is not None:
-                update(ts, val, mask)
+            if acc is None:
+                wslice = None
+                if use_slice and tmin is not None:
+                    # 2x the first chunk's span: headroom for later
+                    # chunks (series advance on their own cursors, so
+                    # spans vary); a chunk that still overflows just
+                    # takes the full-grid fold below
+                    wslice = 2 * ((tmax - tmin) // interval + 2)
+                acc = make_acc(wslice)
+            w0 = None
+            if acc.window_slice is not None and tmin is not None \
+                    and (tmax - tmin) // interval + 2 <= acc.window_slice:
+                w0 = (tmin - first_ms) // interval
+            if use_sharded:
+                acc.update(ts, val, mask, w0=w0)
             else:
-                if acc is None:
-                    wslice = None
-                    if use_slice and tmin is not None:
-                        # 2x the first chunk's span: headroom for later
-                        # chunks (series advance on their own cursors, so
-                        # spans vary); a chunk that still overflows just
-                        # takes the full-grid fold below
-                        wslice = 2 * ((tmax - tmin) // interval + 2)
-                    acc = StreamAccumulator.create(
-                        s, window_spec, wargs, sketch=sketch, lanes=lanes,
-                        window_slice=wslice)
-                w0 = None
-                if acc.window_slice is not None and tmin is not None \
-                        and (tmax - tmin) // interval + 2 \
-                        <= acc.window_slice:
-                    w0 = (tmin - first_ms) // interval
                 acc.update(jnp.asarray(ts), jnp.asarray(val),
                            jnp.asarray(mask), w0=w0)
             if (chunk_i + 1) % 16 == 0:
@@ -775,15 +777,10 @@ class QueryRunner:
                 # accumulator state drains the queue to this point
                 # (block_until_ready does not wait on the axon tunnel);
                 # cadence 16 keeps the double-buffering overlap.
-                state = (sharded_acc.state if sharded_acc is not None
-                         else acc.state)
-                np.asarray(state["n"][:1, :1])
+                np.asarray(acc.state["n"][:1, :1])
 
-        if sharded_acc is not None:
-            return sharded_acc.finish_tail(spec, gid, g_pad)
         if acc is None:     # zero chunks (empty range): empty state
-            acc = StreamAccumulator.create(s, window_spec, wargs,
-                                           sketch=sketch, lanes=lanes)
+            acc = make_acc(None)
         if acc.oob_count():
             # w0 = floor((chunk_min - first)/interval) with wc >= the
             # chunk's span makes this impossible; a nonzero count means
@@ -791,6 +788,8 @@ class QueryRunner:
             raise RuntimeError(
                 "internal: %d points fell outside their declared "
                 "streaming window slice" % acc.oob_count())
+        if use_sharded:
+            return acc.finish_tail(spec, gid, g_pad)
         step = spec.downsample
         wts, v, m = acc.finish(step.function, step.fill_policy,
                                step.fill_value)

@@ -188,6 +188,64 @@ class TestSlicedUpdates:
                                   w0_offset=40)   # shift slices off target
         assert acc.oob_count() > 0
 
+    def test_sharded_sliced_matches_full(self):
+        """Mesh accumulator: sliced folds (per-chip state-slice merges,
+        replicated oob psum) must reproduce the full-grid mesh fold and
+        the slice must actually engage."""
+        from opentsdb_tpu.parallel.mesh import make_mesh
+        from opentsdb_tpu.parallel import ShardedStreamAccumulator
+        from opentsdb_tpu.ops.pipeline import PipelineSpec, DownsampleStep
+        from opentsdb_tpu.ops.streaming import lanes_for
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(43)
+        s = 11                               # pads to 16 sharded rows
+        ts, val, mask = _sorted_batch(rng, s=s)
+        windows = FixedWindows.for_range(START, START + 900_000, 10_000)
+        spec, wargs = windows.split()
+        gid = np.arange(s, dtype=np.int64) % 3
+        pipe = PipelineSpec("sum",
+                            DownsampleStep("avg", spec, "none", 0.0))
+
+        def run(window_slice):
+            acc = ShardedStreamAccumulator(
+                mesh, s, spec, wargs, lanes=lanes_for(["avg"]),
+                window_slice=window_slice)
+            n = ts.shape[1]
+            for k in range(0, n, 17):
+                w = min(17, n - k)
+                cts = np.full((s, 17), PAD, np.int64)
+                cval = np.zeros((s, 17), np.float64)
+                cmask = np.zeros((s, 17), bool)
+                cts[:, :w] = ts[:, k:k + 17]
+                cval[:, :w] = val[:, k:k + 17]
+                cmask[:, :w] = mask[:, k:k + 17]
+                real = cts[cts != PAD]
+                w0 = None
+                if acc.window_slice is not None and real.size:
+                    span = int((real.max() - real.min())
+                               // windows.interval_ms) + 2
+                    if span <= acc.window_slice:
+                        w0 = int((real.min() - windows.first_window_ms)
+                                 // windows.interval_ms)
+                acc.update(cts, cval, cmask, w0=w0)
+            return acc, acc.finish_tail(pipe, gid, 4)
+
+        acc_s, got = run(window_slice=64)
+        assert acc_s.window_slice is not None
+        assert acc_s.oob_count() == 0
+        acc_f, want = run(window_slice=None)
+        assert acc_f.window_slice is None
+        for g, w in zip(got, want):
+            g, w = np.asarray(g), np.asarray(w)
+            if g.dtype == bool:
+                np.testing.assert_array_equal(g, w)
+            else:
+                np.testing.assert_allclose(
+                    np.where(np.isnan(g), 0.0, g),
+                    np.where(np.isnan(w), 0.0, w), rtol=1e-12, atol=1e-12)
+                np.testing.assert_array_equal(np.isnan(g), np.isnan(w))
+
     def test_slice_as_wide_as_grid_falls_back(self):
         rng = np.random.default_rng(41)
         ts, val, mask = _sorted_batch(rng, s=2)
