@@ -151,6 +151,33 @@ def main() -> None:
     record("windowed_avg_subblock", time_fn(
         jax.jit(windowed_avg_subblock), (val, mask, idx), rtt))
 
+    # Decompose the subblock windowed-sum (88ms r04b, the biggest
+    # accurately-measured single stage): the [S, nb, K] tree reduce vs
+    # the tiny cumsum vs the [S, W+1, K] boundary gather + masked dot.
+    # Bandwidth yardstick: prim_f64_mul touches the same 537MB in ~18ms,
+    # so whichever row exceeds that is compute/serialization, not HBM.
+    k_sub = ds._SUB_K
+    nb = N // k_sub
+    reduce_fn = jax.jit(lambda v: v.reshape(S, nb, k_sub).sum(axis=2))
+    record("subblock_reduce", time_fn(reduce_fn, (val,), rtt))
+    ssum0 = reduce_fn(val)
+    drain((ssum0,))
+    record("subblock_cumsum", time_fn(
+        jax.jit(lambda x: jnp.cumsum(x, axis=1)), (ssum0,), rtt))
+
+    def subblock_remainder(v, i):
+        blk = i // k_sub
+        off = i - blk * k_sub
+        safe_blk = jnp.clip(blk, 0, nb - 1)
+        d3 = v.reshape(S, nb, k_sub)
+        bvals = jnp.take_along_axis(d3, safe_blk[:, :, None], axis=1)
+        lanes = jnp.arange(k_sub, dtype=off.dtype)
+        return jnp.where(lanes[None, None, :] < off[:, :, None],
+                         bvals, 0).sum(axis=2)
+
+    record("subblock_remainder", time_fn(
+        jax.jit(subblock_remainder), (val, idx), rtt))
+
     def full_downsample(t, v, m):
         return ds.downsample(t, v, m, "avg", window_spec, wargs)
 
