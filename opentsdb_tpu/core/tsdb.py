@@ -167,6 +167,19 @@ class TSDB:
             value = self.config.get_string(key)
             if value and value != current():
                 setter(value)   # invalid values raise at startup, loudly
+        raw = self.config.get_string("tsd.query.kernel.platform_guard")
+        if raw:   # empty keeps the module default (on) / test override
+            token = raw.strip().lower()
+            if token in ("true", "1", "yes"):
+                guard = True
+            elif token in ("false", "0", "no"):
+                guard = False
+            else:   # a typo must not silently disable the CPU guard
+                raise ValueError(
+                    "tsd.query.kernel.platform_guard must be "
+                    "true/false (got %r)" % raw)
+            if guard != _ds._PLATFORM_MODE_GUARD:
+                _ds.set_platform_mode_guard(guard)
 
     def check_timestamp_and_tags(self, metric: str, timestamp: int | float,
                                  value, tags: dict[str, str]) -> None:

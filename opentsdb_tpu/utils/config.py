@@ -111,6 +111,11 @@ DEFAULTS: dict[str, str] = {
     "tsd.query.kernel.search_mode": "",        # scan|compare_all|hier
     "tsd.query.kernel.extreme_mode": "",       # scan|segment|subblock
     "tsd.query.kernel.group_reduce_mode": "",  # segment|matmul|sorted
+    # Demote dense (accelerator-winner) search forms to the binary scan
+    # on CPU execution — the planner's small-query host lane included
+    # (measured 18x slower there under the chip-crowned modes).  Empty
+    # keeps the module default (on); "false" opts out.
+    "tsd.query.kernel.platform_guard": "",
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",

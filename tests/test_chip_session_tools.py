@@ -125,6 +125,22 @@ class TestKernelModeConfig:
             ds.set_extreme_mode(before[2])
             ga.set_group_reduce_mode(before[3])
 
+    def test_platform_guard_key(self):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        from opentsdb_tpu.ops import downsample as ds
+        before = ds._PLATFORM_MODE_GUARD
+        try:
+            TSDB(Config({"tsd.query.kernel.platform_guard": "true"}))
+            assert ds._PLATFORM_MODE_GUARD is True
+            TSDB(Config({"tsd.query.kernel.platform_guard": "false"}))
+            assert ds._PLATFORM_MODE_GUARD is False
+            # empty leaves whatever is set (the suite runs guard-off)
+            TSDB(Config({}))
+            assert ds._PLATFORM_MODE_GUARD is False
+        finally:
+            ds.set_platform_mode_guard(before)
+
     def test_invalid_mode_raises_at_startup(self):
         import pytest
         from opentsdb_tpu.core import TSDB

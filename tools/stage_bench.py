@@ -80,12 +80,13 @@ def main() -> None:
         lambda row: jnp.searchsorted(row, e, side="left"))(t))(cts, cedges)
     drain((cts, cedges, idx))
 
-    def record(name, t):
+    def record(name, t, points=None):
         # one JSON line per stage, emitted IMMEDIATELY: a chip crash in a
         # later stage must not lose earlier attributions (the reason this
         # tool exists)
+        pts = S * N if points is None else points
         print(json.dumps({"stage": name, "seconds": round(t, 4),
-                          "dp_per_sec": round(S * N / t, 1)}), flush=True)
+                          "dp_per_sec": round(pts / t, 1)}), flush=True)
         _note("%s: %.4fs" % (name, t))
 
     # raw primitives: bandwidth yardsticks
@@ -244,6 +245,61 @@ def main() -> None:
     record("full_pipeline", time_fn(
         lambda *a: dispatch(spec, g_pad, batch, wargs, origins.next()),
         (), rtt))
+
+    # Streamed chunk fold at the config-2 slice shape: a [128, 65536]
+    # chunk against its ~82k-window local slice (W ~ 1.25N).  The
+    # _use_segment_chunk threshold routes W > N to segment reductions
+    # (TPU scatters serialize) — these rows race that against the dense
+    # edge-search form so the threshold gets chip data.
+    from opentsdb_tpu.ops import streaming as st
+    from opentsdb_tpu.ops.downsample import FixedWindows
+
+    s2, n2 = 128, 65_536
+    step2 = 10_000
+    start2 = 1_356_998_400_000
+    # The production sliced fold runs on an UNPADDED quantized local
+    # grid (streaming.quantize_window_slice: 65,538-window chunk span ->
+    # wc = 81,920); pow2-padding the spec here (131,072) would measure
+    # 2N windows instead of the 1.25N the planner actually dispatches.
+    from opentsdb_tpu.ops.streaming import quantize_window_slice
+    fixed2 = FixedWindows.for_range(start2, start2 + n2 * step2 + step2,
+                                    10_000)
+    wc2 = quantize_window_slice(fixed2.count,
+                                ds.WindowSpec("fixed", 1 << 20, 10_000))
+    wspec2 = ds.WindowSpec("fixed", wc2, 10_000)
+    wargs2 = {"first": jnp.asarray(fixed2.first_window_ms, jnp.int64),
+              "nwin": jnp.asarray(fixed2.count, jnp.int32)}
+    rows2 = jnp.arange(s2, dtype=jnp.int64)
+    cols2 = jnp.arange(n2, dtype=jnp.int64)
+    h2 = (rows2[:, None] * 2_654_435_761 + cols2[None, :] * 40_503) \
+        & 0x7FFFFFFF
+    ts2 = start2 + cols2[None, :] * step2 + h2 % 4_000
+    val2 = 100.0 + (h2 % 1_000).astype(jnp.float64) * 0.05
+    mask2 = jnp.ones((s2, n2), bool)
+    drain((ts2, val2, mask2))
+    lanes2 = st.lanes_for(["sum", "min", "max", "count"])
+
+    def chunk_segment(t, v, m):
+        return st._segment_chunk_moments(t, v, m, wspec2, wargs2, lanes2)
+
+    record("stream_chunk_segment", time_fn(
+        jax.jit(chunk_segment), (ts2, val2, mask2), rtt),
+        points=s2 * n2)
+
+    def chunk_dense_forced(t, v, m):
+        # bypass _use_segment_chunk: same lanes through the edge-search
+        # machinery (prefix sums + reset-scan extremes)
+        vf, ok, cts_l, idx_l, windowed, cnt = ds._window_scan_setup(
+            t, v, m, wspec2, wargs2)
+        out = {"n": cnt, "total": windowed(jnp.where(ok, vf, 0.0))}
+        lo, hi, _ = ds._extreme_downsample(t, v, m, wspec2, wargs2,
+                                           True, True)
+        out["lo"], out["hi"] = lo, hi
+        return out
+
+    record("stream_chunk_dense", time_fn(
+        jax.jit(chunk_dense_forced), (ts2, val2, mask2), rtt),
+        points=s2 * n2)
 
 
 
