@@ -1,0 +1,72 @@
+"""grid_contributions: the dense (hole-free) lax.cond fast lane must be
+exactly the full interpolation branch's answer at the all-true boundary,
+and the full branch must be unchanged for holey masks."""
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.ops.aggregators import get_agg
+from opentsdb_tpu.ops.group_agg import grid_contributions
+from opentsdb_tpu.ops.rate import _prev_valid_index
+from opentsdb_tpu.ops.union_agg import interpolate, _next_valid
+
+
+def _full_reference(grid_ts, val, mask, agg):
+    """The pre-cond straight-line implementation, kept as the oracle."""
+    import jax.numpy as jnp
+    w = val.shape[1]
+    prev_i = _prev_valid_index(mask)
+    next_i = _next_valid(mask)
+    has_prev = prev_i >= 0
+    has_next = next_i < w
+    safe_prev = jnp.clip(prev_i, 0, w - 1)
+    safe_next = jnp.clip(next_i, 0, w - 1)
+    x = grid_ts[None, :]
+    x0 = jnp.take(grid_ts, safe_prev)
+    x1 = jnp.take(grid_ts, safe_next)
+    y0 = jnp.take_along_axis(val, safe_prev, axis=1)
+    y1 = jnp.take_along_axis(val, safe_next, axis=1)
+    participate = has_prev & has_next | mask
+    interp = interpolate(agg.interpolation, False, x, x0, y0, x1, y1, val)
+    return jnp.where(mask, val, interp), participate
+
+
+@pytest.mark.parametrize("aggname", ["sum", "min", "zimsum", "mimmax"])
+@pytest.mark.parametrize("holey", [False, True])
+def test_cond_matches_full_reference(aggname, holey):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(17)
+    s, w = 6, 48
+    grid_ts = jnp.asarray(np.arange(w, dtype=np.int64) * 60_000)
+    val = jnp.asarray(rng.normal(20, 5, (s, w)))
+    if holey:
+        mask = jnp.asarray(rng.random((s, w)) > 0.25)
+    else:
+        mask = jnp.ones((s, w), bool)
+    agg = get_agg(aggname)
+    got_c, got_p = grid_contributions(grid_ts, val, mask, agg)
+    want_c, want_p = _full_reference(grid_ts, val, mask, agg)
+    np.testing.assert_array_equal(np.asarray(got_p), np.asarray(want_p))
+    gp = np.asarray(want_p)
+    np.testing.assert_allclose(np.asarray(got_c)[gp],
+                               np.asarray(want_c)[gp], rtol=0, atol=0)
+
+
+def test_f32_values_keep_working():
+    """Both cond branches must agree on dtype, which depends on the
+    agg's interpolation policy (LERP promotes f32 through the int64
+    timestamp division; ZIM keeps f32) — a latent trace-time TypeError
+    before the eval_shape-derived cast."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(19)
+    s, w = 3, 16
+    grid_ts = jnp.asarray(np.arange(w, dtype=np.int64) * 1000)
+    val = jnp.asarray(rng.normal(0, 1, (s, w)).astype(np.float32))
+    for aggname, want_dtype in (("sum", jnp.float64),    # LERP promotes
+                                ("zimsum", jnp.float32)):  # ZIM keeps
+        agg = get_agg(aggname)
+        for mask in (jnp.ones((s, w), bool),
+                     jnp.asarray(rng.random((s, w)) > 0.5)):
+            c, p = grid_contributions(grid_ts, val, mask, agg)
+            assert c.dtype == want_dtype, (aggname, c.dtype)
+            assert p.shape == (s, w)

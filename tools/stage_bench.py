@@ -261,11 +261,11 @@ def main() -> None:
     # grid (streaming.quantize_window_slice: 65,538-window chunk span ->
     # wc = 81,920); pow2-padding the spec here (131,072) would measure
     # 2N windows instead of the 1.25N the planner actually dispatches.
-    from opentsdb_tpu.ops.streaming import quantize_window_slice
     fixed2 = FixedWindows.for_range(start2, start2 + n2 * step2 + step2,
                                     10_000)
-    wc2 = quantize_window_slice(fixed2.count,
-                                ds.WindowSpec("fixed", 1 << 20, 10_000))
+    wc2 = st.quantize_window_slice(fixed2.count,
+                                   ds.WindowSpec("fixed", 1 << 20,
+                                                 10_000))
     wspec2 = ds.WindowSpec("fixed", wc2, 10_000)
     wargs2 = {"first": jnp.asarray(fixed2.first_window_ms, jnp.int64),
               "nwin": jnp.asarray(fixed2.count, jnp.int32)}
