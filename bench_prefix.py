@@ -120,6 +120,7 @@ def main() -> None:
             ("flat+int32", "flat", "double"),
             ("blocked+int32", "blocked", "double"),
             ("subblock+int32", "subblock", "double"),
+            ("subblock2+int32", "subblock2", "double"),
             ("blocked+int32+f32", "blocked", "single")]:
         def setup(m=mode, p=precision):
             ds.set_scan_mode(m)
@@ -165,6 +166,8 @@ def main() -> None:
          spec)
     race("subblock+int32+hier+sorted",
          combo("subblock", "hier", "sorted"), spec)
+    race("subblock2+int32+hier+sorted",
+         combo("subblock2", "hier", "sorted"), spec)
 
     restore_defaults()
 

@@ -217,7 +217,7 @@ def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
 # read once at import): lets the one-command measurement session feed
 # bench_prefix's A/B winners into the later stages without editing
 # source mid-run.  Invalid values are ignored (defaults win).
-_SCAN_MODES = ("flat", "blocked", "subblock")
+_SCAN_MODES = ("flat", "blocked", "subblock", "subblock2")
 _SCAN_MODE = (_os.environ.get("TSDB_SCAN_MODE")
               if _os.environ.get("TSDB_SCAN_MODE") in _SCAN_MODES
               else "flat")
@@ -293,7 +293,8 @@ def _clear_dependent_caches() -> None:
 
 
 def set_scan_mode(mode: str) -> None:
-    """'flat' | 'blocked' | 'subblock' — benchmarking hook; clears
+    """'flat' | 'blocked' | 'subblock' | 'subblock2' — benchmarking
+    hook; clears
     affected jit caches."""
     global _SCAN_MODE
     if mode not in _SCAN_MODES:
@@ -396,6 +397,44 @@ def _edge_subblock_builder(s: int, n: int, idx):
         # dot over the clipped gather contributes nothing there.
         rem = jnp.where(lanes[None, None, :] < off[:, :, None],
                         bvals, 0).sum(axis=2)
+        at = base + rem
+        return at[:, 1:] - at[:, :-1]
+    return windowed
+
+
+def _edge_subblock2_builder(s: int, n: int, idx):
+    """subblock variant: within-block inclusive prefixes + ONE scalar
+    gather per edge (scan mode "subblock2").
+
+    Same decomposition as _edge_subblock_builder, but the boundary
+    remainder is read from a precomputed within-block prefix
+    (cumsum along the K axis — a depth-log2(K) scan over the full data,
+    cheap and parallel) with a single element gather per edge, instead
+    of gathering a [*, K] lane per edge and masked-dotting it.  Trades
+    one extra full-size vector pass for 1/K of the per-edge gather
+    volume and no [S, W+1, K] intermediate — so it has no
+    _subblock_edges_fit constraint.  The chip race decides which wins.
+    """
+    k = _SUB_K
+    nb = n // k
+    blk = idx // k                     # [S, W+1] boundary sub-block
+    off = idx - blk * k                # position within it
+    safe_blk = jnp.clip(blk, 0, nb - 1)
+
+    def windowed(data):
+        d3 = data.reshape(s, nb, k)
+        prefix3 = jnp.cumsum(d3, axis=2)            # within-block incl.
+        ssum = prefix3[:, :, -1]                    # block sums for free
+        scum = jnp.concatenate(
+            [jnp.zeros((s, 1), data.dtype), jnp.cumsum(ssum, axis=1)],
+            axis=1)                                             # [S, nb+1]
+        base = jnp.take_along_axis(scum, blk, axis=1)
+        prefix = prefix3.reshape(s, n)
+        # off == 0 (edge at a block boundary, incl. blk == nb past every
+        # point) contributes no remainder; otherwise prefix[blk*K+off-1]
+        pos = jnp.clip(safe_blk * k + off - 1, 0, n - 1)
+        rem = jnp.where(off > 0,
+                        jnp.take_along_axis(prefix, pos, axis=1), 0)
         at = base + rem
         return at[:, 1:] - at[:, :-1]
     return windowed
@@ -646,6 +685,10 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     if (_SCAN_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
             and _subblock_edges_fit(n, cedges.shape[0])):
         windowed = _edge_subblock_builder(s, n, idx)
+    elif (_SCAN_MODE == "subblock2" and n % _SUB_K == 0 and n > _SUB_K):
+        # no edges-fit constraint: the remainder reads a same-size
+        # prefix array, never an [S, W, K] intermediate
+        windowed = _edge_subblock2_builder(s, n, idx)
     else:
         windowed = _edge_prefix_builder(s, n, idx)
     # Per-window counts: for a CLEAN batch — every unmasked slot is a pad

@@ -159,7 +159,7 @@ class TestScanModesAndCompaction:
         windows = FixedWindows.for_range(START, START + 40_000_000, 3_600_000)
         spec, wargs = windows.split()
         outs = {}
-        for mode in ("flat", "blocked", "subblock"):
+        for mode in ("flat", "blocked", "subblock", "subblock2"):
             ds_mod.set_scan_mode(mode)
             try:
                 _, out, omask = downsample(ts, val, mask, agg, spec, wargs,
@@ -167,7 +167,7 @@ class TestScanModesAndCompaction:
             finally:
                 ds_mod.set_scan_mode("flat")  # restore the chip-won default
             outs[mode] = (np.asarray(out), np.asarray(omask))
-        for mode in ("blocked", "subblock"):
+        for mode in ("blocked", "subblock", "subblock2"):
             np.testing.assert_array_equal(outs["flat"][1], outs[mode][1])
             m = outs["flat"][1]
             np.testing.assert_allclose(outs[mode][0][m], outs["flat"][0][m],
@@ -770,6 +770,21 @@ class TestWideGridGuards:
             ds_mod.set_search_mode("scan")
             ds_mod.set_extreme_mode("scan")
             group_agg.set_group_reduce_mode("segment")
+        # subblock2 has NO edges-fit constraint (its remainder reads a
+        # same-size prefix, not an [S, W, K] lane) — it must answer the
+        # wide grid identically with the sub-block path ACTIVE
+        ds_mod.set_scan_mode("subblock2")
+        try:
+            for agg in ("sum", "avg"):
+                _, out, om = downsample(ts, val, mask, agg, spec, wargs,
+                                        FILL_NONE)
+                np.testing.assert_array_equal(np.asarray(om), want[agg][1])
+                m = want[agg][1]
+                np.testing.assert_allclose(np.asarray(out)[m],
+                                           want[agg][0][m],
+                                           rtol=1e-12, atol=1e-12)
+        finally:
+            ds_mod.set_scan_mode("flat")
 
 
 class TestNewModesAcrossWindowKinds:
@@ -794,7 +809,8 @@ class TestNewModesAcrossWindowKinds:
 
     @pytest.mark.parametrize("agg", ["sum", "avg", "min", "max", "dev"])
     @pytest.mark.parametrize("kind", ["edges", "all"])
-    def test_modes_agree_on_irregular_grids(self, agg, kind):
+    @pytest.mark.parametrize("scan_mode", ["subblock", "subblock2"])
+    def test_modes_agree_on_irregular_grids(self, agg, kind, scan_mode):
         from opentsdb_tpu.ops import downsample as ds_mod
         rng = np.random.default_rng(83)
         ts, val, mask = self._batch(rng)
@@ -806,7 +822,7 @@ class TestNewModesAcrossWindowKinds:
             windows = AllWindow(START + 5_000, START + 4_500_000)
         spec, wargs = windows.split()
         _, want, wm = downsample(ts, val, mask, agg, spec, wargs, FILL_NONE)
-        ds_mod.set_scan_mode("subblock")
+        ds_mod.set_scan_mode(scan_mode)
         ds_mod.set_search_mode("hier")
         ds_mod.set_extreme_mode("subblock")
         try:

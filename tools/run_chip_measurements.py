@@ -66,6 +66,8 @@ def pick_winners(prefix_records: list[dict]) -> dict:
         "flat+int32": ("flat", "scan", "segment"),
         "blocked+int32": ("blocked", "scan", "segment"),
         "subblock+int32": ("subblock", "scan", "segment"),
+        "subblock2+int32": ("subblock2", "scan", "segment"),
+        "subblock2+int32+hier+sorted": ("subblock2", "hier", "sorted"),
         "flat+int32+search_scan": ("flat", "scan", "segment"),
         "flat+int32+search_compare_all": ("flat", "compare_all", "segment"),
         "flat+int32+search_hier": ("flat", "hier", "segment"),
