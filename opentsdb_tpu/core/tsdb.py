@@ -167,6 +167,11 @@ class TSDB:
             value = self.config.get_string(key)
             if value and value != current():
                 setter(value)   # invalid values raise at startup, loudly
+        ratio = self.config.get_string(
+            "tsd.query.kernel.stream_segment_ratio")
+        if ratio:
+            from opentsdb_tpu.ops import streaming as _st
+            _st.set_segment_chunk_ratio(float(ratio))  # bad float: loud
         raw = self.config.get_string("tsd.query.kernel.platform_guard")
         if raw:   # empty keeps the module default (on) / test override
             token = raw.strip().lower()

@@ -206,14 +206,35 @@ def _segment_chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     return out
 
 
+# Segment-vs-dense routing threshold for streamed chunks: the segment
+# form engages when W > ratio * N.  1.0 is the analytic crossover (per-
+# edge search work vs per-point scatter work); the chip session's
+# stream_chunk_segment / stream_chunk_dense rows (tools/stage_bench.py)
+# measure the real one — TPU scatters serialize, so the measured ratio
+# may sit well above 1.  Env override pending a chip-crowned default.
+import os as _os
+
+_SEGMENT_CHUNK_RATIO = float(_os.environ.get(
+    "TSDB_STREAM_SEGMENT_RATIO", "1.0"))
+
+
+def set_segment_chunk_ratio(ratio: float) -> None:
+    """W/N threshold above which streamed chunks take the segment form;
+    clears dependent jit caches (read at trace time)."""
+    global _SEGMENT_CHUNK_RATIO
+    _SEGMENT_CHUNK_RATIO = float(ratio)
+    from opentsdb_tpu.ops.downsample import _clear_dependent_caches
+    _clear_dependent_caches()
+
+
 def _use_segment_chunk(n: int, w: int, lanes: frozenset,
                        with_sketch: bool) -> bool:
     """Route chunks with more windows than points to the segment form:
-    past W ~ N the edge search's per-edge work exceeds the segment
+    past W ~ ratio*N the edge search's per-edge work exceeds the segment
     form's per-point work (config 4 sits at exactly W = 4N; config 2 at
     W = 16N).  first/last/prod and the sketch keep the edge-search form
     (their reductions are position- or sort-based)."""
-    return (w > n and not with_sketch
+    return (w > _SEGMENT_CHUNK_RATIO * n and not with_sketch
             and not (lanes & {"first", "last", "prod"}))
 
 

@@ -116,6 +116,9 @@ DEFAULTS: dict[str, str] = {
     # (measured 18x slower there under the chip-crowned modes).  Empty
     # keeps the module default (on); "false" opts out.
     "tsd.query.kernel.platform_guard": "",
+    # Streamed chunks take the segment form when W > ratio * N (or the
+    # TSDB_STREAM_SEGMENT_RATIO env); empty keeps the module default.
+    "tsd.query.kernel.stream_segment_ratio": "",
     "tsd.query.multi_get.enable": "false",
     "tsd.query.multi_get.limit": "131072",
     "tsd.query.multi_get.batch_size": "1024",
