@@ -155,3 +155,28 @@ class TestKernelModeConfig:
         before = ds._SCAN_MODE
         TSDB(Config({}))
         assert ds._SCAN_MODE == before
+
+
+class TestStreamRatioCrowning:
+    """stage_bench's stream-chunk race crowns the W/N routing threshold
+    only on a complete race the dense form won."""
+
+    def test_dense_win_raises_ratio(self):
+        from tools.run_chip_measurements import pick_stream_ratio
+        recs = [{"label": "stream_chunk_segment", "seconds": 0.5},
+                {"label": "stream_chunk_dense", "seconds": 0.2}]
+        assert pick_stream_ratio(recs) == "2.0"
+
+    def test_segment_win_keeps_default(self):
+        from tools.run_chip_measurements import pick_stream_ratio
+        recs = [{"label": "stream_chunk_segment", "seconds": 0.2},
+                {"label": "stream_chunk_dense", "seconds": 0.5}]
+        assert pick_stream_ratio(recs) is None
+
+    def test_partial_race_crowns_nothing(self):
+        from tools.run_chip_measurements import pick_stream_ratio
+        assert pick_stream_ratio(
+            [{"label": "stream_chunk_dense", "seconds": 0.2}]) is None
+        assert pick_stream_ratio(
+            [{"label": "stream_chunk_segment",
+              "error": "x"}]) is None

@@ -103,6 +103,21 @@ def pick_winners(prefix_records: list[dict]) -> dict:
     return env
 
 
+def pick_stream_ratio(stage_recs: list[dict]) -> str | None:
+    """Stream-chunk routing race (stage_bench, config-2 slice shape,
+    W ~ 1.25N): when the dense edge-search fold beat the segment scatter
+    on the chip, return the raised W/N routing threshold (as the env
+    string) so config 2's sliced folds take the dense form; None keeps
+    the module default.  A partial race (either row missing/errored)
+    crowns nothing."""
+    by_label = {r.get("label"): r.get("seconds") for r in stage_recs}
+    seg = by_label.get("stream_chunk_segment")
+    dense = by_label.get("stream_chunk_dense")
+    if seg is not None and dense is not None and dense < seg:
+        return "2.0"
+    return None
+
+
 def main() -> None:
     results: list[dict] = []
     stages = [
@@ -151,6 +166,24 @@ def main() -> None:
                 stage_recs.append(rec)
             if name == "bench_prefix":
                 winner_env = pick_winners(stage_recs)
+            if name == "stage_bench":
+                ratio = pick_stream_ratio(stage_recs)
+                if ratio is not None:
+                    winner_env["TSDB_STREAM_SEGMENT_RATIO"] = ratio
+                    print("== stream routing: dense won -> ratio %s =="
+                          % ratio, file=sys.stderr, flush=True)
+                    try:
+                        with open(os.path.join(REPO,
+                                               "BENCH_WINNERS.json")) as fh:
+                            winners = json.load(fh)
+                    except (OSError, ValueError):
+                        winners = {"env": {}}
+                    winners.setdefault("env", {})[
+                        "TSDB_STREAM_SEGMENT_RATIO"] = ratio
+                    with open(os.path.join(REPO,
+                                           "BENCH_WINNERS.json"),
+                              "w") as fh:
+                        json.dump(winners, fh, indent=1)
         except Exception as e:          # keep later stages alive
             print("stage %s failed: %s" % (name, e), file=sys.stderr)
             results.append({"stage": name, "error": str(e)})
