@@ -20,7 +20,14 @@ print('ALIVE', ds)
     # Marker holds "ok" after success, else the attempt count.
     state=$(cat /tmp/chip_measurements.started 2>/dev/null)
     attempts=${state:-0}
-    if [ "$state" != "ok" ] && [ "$attempts" -lt 3 ] 2>/dev/null; then
+    if [ "$state" = "ok" ]; then
+      # done: stop probing entirely — a probe holds the exclusive tunnel
+      # for seconds and two JAX processes deadlock it, so an idle watcher
+      # must not race the driver's end-of-round bench run
+      echo "$ts measurement complete; watcher exiting" >> /tmp/tpu_watch.log
+      exit 0
+    fi
+    if [ "$attempts" -lt 3 ] 2>/dev/null; then
       attempts=$((attempts + 1))
       echo "$attempts" > /tmp/chip_measurements.started
       echo "$ts TPU BACK - measurement attempt $attempts" >> /tmp/tpu_watch.log
