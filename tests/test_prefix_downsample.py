@@ -585,6 +585,10 @@ class TestSearchModeShapeGuard:
     def test_long_rows_demote_dense_modes(self):
         from opentsdb_tpu.ops.downsample import _effective_search_mode
         from opentsdb_tpu.ops import downsample as ds_mod
+        # this test pins the SHAPE rules; the platform guard (tested in
+        # TestPlatformModeGuard) would demote everything on CPU first
+        guard_before = ds_mod._PLATFORM_MODE_GUARD
+        ds_mod.set_platform_mode_guard(False)
         cases = {
             # (mode, n) -> expected effective mode
             ("compare_all", 65536): "compare_all",   # headline: stays
@@ -597,13 +601,16 @@ class TestSearchModeShapeGuard:
             ("hier", 1 << 20): "scan",
             ("hier", 1 << 24): "scan",     # 16M-pt rows: demote
         }
-        for (mode, n), want in cases.items():
-            ds_mod.set_search_mode(mode)
-            try:
-                got = _effective_search_mode(1024, n, 514)
-            finally:
-                ds_mod.set_search_mode("scan")
-            assert got == want, (mode, n, got, want)
+        try:
+            for (mode, n), want in cases.items():
+                ds_mod.set_search_mode(mode)
+                try:
+                    got = _effective_search_mode(1024, n, 514)
+                finally:
+                    ds_mod.set_search_mode("scan")
+                assert got == want, (mode, n, got, want)
+        finally:
+            ds_mod.set_platform_mode_guard(guard_before)
 
     def test_demoted_search_still_correct(self):
         """A (tiny-N, huge-W) shape under compare_all answers identically
