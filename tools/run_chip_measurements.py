@@ -132,6 +132,12 @@ def main() -> None:
     stages += [("bench_configs:%d" % c,
                 [sys.executable, "bench_configs.py", "--config", str(c)],
                 2400) for c in range(1, 8)]
+    # last (least critical): an XLA trace of the headline dispatch under
+    # the crowned modes, for offline per-op attribution (untracked dir)
+    stages += [("profile",
+                [sys.executable, "tools/profile_query.py",
+                 "--outdir", os.path.join(REPO, "PROFILE_r04"),
+                 "--passes", "2"], 1200)]
     winner_env: dict = {}
     def write_out() -> None:
         # Rewritten after EVERY stage: a session cutoff (or a second
