@@ -29,7 +29,7 @@ OUT = os.path.join(REPO, "BENCH_CONFIGS_r04.json")
 
 
 def run_stage(name: str, argv: list[str], timeout: int,
-              extra_env: dict | None = None) -> list[str]:
+              extra_env: dict | None = None) -> tuple[list[str], int]:
     print("== %s ==" % name, file=sys.stderr, flush=True)
     t0 = time.time()
     env = dict(os.environ)
@@ -41,7 +41,25 @@ def run_stage(name: str, argv: list[str], timeout: int,
     print("== %s done rc=%d in %.0fs, %d json lines =="
           % (name, proc.returncode, time.time() - t0, len(lines)),
           file=sys.stderr, flush=True)
-    return lines
+    return lines, proc.returncode
+
+
+def tunnel_alive(timeout: int = 240) -> bool:
+    """Post-failure triage probe: can a fresh process still reach the
+    chip?  Only called AFTER a stage failed (the tunnel is already
+    suspect) — probing a healthy tunnel risks the kill-mid-dial wedge,
+    so this is never a pre-flight check.  A wedged tunnel hangs the
+    probe; the timeout kill classifies it dead."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; jax.devices(); "
+             "(jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready(); "
+             "print('TUNNEL_OK')"],
+            capture_output=True, text=True, timeout=timeout)
+        return "TUNNEL_OK" in probe.stdout
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def pick_winners(prefix_records: list[dict]) -> dict:
@@ -154,9 +172,18 @@ def main() -> None:
                                "measurement; see bench.py docstring",
             }) + "\n")
 
+    dead = False
     for name, argv, timeout in stages:
+        if dead:
+            results.append({"stage": name, "error":
+                            "skipped: tunnel dead (post-failure probe)"})
+            write_out()
+            continue
+        failed = False
         try:
-            lines = run_stage(name, argv, timeout, extra_env=winner_env)
+            lines, rc = run_stage(name, argv, timeout,
+                                  extra_env=winner_env)
+            failed = rc != 0
             stage_recs = []
             for ln in lines:
                 rec = json.loads(ln)
@@ -193,7 +220,18 @@ def main() -> None:
         except Exception as e:          # keep later stages alive
             print("stage %s failed: %s" % (name, e), file=sys.stderr)
             results.append({"stage": name, "error": str(e)})
+            failed = True
         write_out()
+        if failed:
+            # a failed stage means the tunnel is suspect: one triage
+            # probe decides whether the remaining stages get their shot
+            # or the session finalizes now instead of burning each
+            # stage's full timeout against a wedge (configs 5-7 lost
+            # ~75min to exactly that in the r04b session)
+            if not tunnel_alive():
+                print("== tunnel probe DEAD after %s: skipping remaining "
+                      "stages ==" % name, file=sys.stderr, flush=True)
+                dead = True
     print("wrote %s (%d records)" % (OUT, len(results)))
 
 
