@@ -70,3 +70,30 @@ def test_f32_values_keep_working():
             c, p = grid_contributions(grid_ts, val, mask, agg)
             assert c.dtype == want_dtype, (aggname, c.dtype)
             assert p.shape == (s, w)
+
+
+class TestSubblock2Boundaries:
+    """_edge_subblock2_builder at adversarial edge positions: edges
+    exactly ON block boundaries (off == 0 -> no remainder), idx == 0,
+    idx == N (past every point) — pinned against the flat prefix
+    builder, which shares the idx contract."""
+
+    def test_boundary_edge_positions(self):
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops import downsample as ds
+        s, n, k = 2, 128, ds._SUB_K
+        rng = np.random.default_rng(7)
+        data = jnp.asarray(rng.normal(0, 10, (s, n)))
+        # idx rows hit: 0, exact block boundaries, mid-block, n
+        idx = jnp.asarray(np.array([
+            [0, k, 2 * k, 2 * k + 1, 3 * k - 1, n, n],
+            [0, 1, k - 1, k, k + 1, n - 1, n]], dtype=np.int32))
+        want = ds._edge_prefix_builder(s, n, idx)(data)
+        got = ds._edge_subblock2_builder(s, n, idx)(data)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-12, atol=1e-12)
+        # int32 data (the count lane's dtype) must work too
+        di = jnp.asarray(rng.integers(0, 5, (s, n)).astype(np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(ds._edge_subblock2_builder(s, n, idx)(di)),
+            np.asarray(ds._edge_prefix_builder(s, n, idx)(di)))
