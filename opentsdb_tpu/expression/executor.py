@@ -178,17 +178,59 @@ class QueryExecutor:
             source = pojo.expressions if pojo.expressions else pojo.metrics
             outputs = [{"id": e["id"]} for e in source]
 
+        # Expression DAG: an expression's variables may name OTHER
+        # expressions (reference: QueryExecutor.java:19-23 builds a
+        # jgrapht DirectedAcyclicGraph over the expressions and wires
+        # each ExpressionIterator's variable iterators from metric OR
+        # expression results).  Evaluate in topological order, feeding
+        # each result back into the variable namespace; a cycle (incl.
+        # self-reference) is a 400.
         exprs = {e["id"]: e for e in pojo.expressions}
+        self._eval: dict[str, dict] = {}
+        for eid in self._topo_order(exprs):
+            ev = self._eval_expression(exprs[eid], results, fills)
+            self._eval[eid] = ev
+            results[eid] = ev["series"]
+
         out_objs = []
         for output in outputs:
             oid = output.get("id")
             if oid in exprs:
                 out_objs.append(self._serialize_expression(
-                    exprs[oid], output, results, fills))
+                    exprs[oid], output))
             elif oid in results:
                 out_objs.append(self._serialize_metric(
                     oid, output, results[oid]))
         return {"outputs": out_objs, "query": self._echo_query()}
+
+    @staticmethod
+    def _topo_order(exprs: dict[str, dict]) -> list[str]:
+        """Kahn's algorithm over expression->expression references; 400 on
+        a cycle (the reference's DirectedAcyclicGraph add throws there)."""
+        from opentsdb_tpu.tsd.http import BadRequestError
+        deps = {}
+        for eid, e in exprs.items():
+            deps[eid] = {v for v in compile_expression(e["expr"]).variables
+                         if v in exprs}
+            if eid in deps[eid]:
+                raise BadRequestError(
+                    "Self referencing expression found: %s" % eid)
+        order = []
+        ready = sorted(eid for eid, d in deps.items() if not d)
+        pending = {eid: set(d) for eid, d in deps.items() if d}
+        while ready:
+            eid = ready.pop()
+            order.append(eid)
+            for other in sorted(pending):
+                pending[other].discard(eid)
+                if not pending[other]:
+                    ready.append(other)
+                    del pending[other]
+        if pending:
+            raise BadRequestError(
+                "Circular expression reference involving: %s"
+                % ", ".join(sorted(pending)))
+        return order
 
     # -- joins (VariableIterator: INTERSECTION / UNION by tags) --
 
@@ -230,9 +272,13 @@ class QueryExecutor:
             joined.append(sets)
         return joined
 
-    def _serialize_expression(self, expr: dict, output: dict,
-                              results: dict[str, list[SeriesResult]],
-                              fills: dict[str, float]) -> dict:
+    def _eval_expression(self, expr: dict,
+                         results: dict[str, list[SeriesResult]],
+                         fills: dict[str, float]) -> dict:
+        """Evaluate one expression against the current variable namespace
+        (metric results + previously evaluated expressions) and package
+        each joined column as a SeriesResult so downstream expressions
+        can consume it like any other variable."""
         compiled = compile_expression(expr["expr"])
         var_ids = [v for v in compiled.variables if v in results]
         join_spec = expr.get("join") or {}
@@ -278,6 +324,18 @@ class QueryExecutor:
                                           if s is not None
                                           for t in s.agg_tags}),
             })
+        series = [SeriesResult(label=expr["id"],
+                               tags=dict(metas[i]["commonTags"]),
+                               agg_tags=list(metas[i]["aggregatedTags"]),
+                               ts=grid,
+                               values=np.asarray(columns[i], np.float64))
+                  for i in range(len(columns))]
+        return {"grid": grid, "columns": columns, "metas": metas,
+                "series": series}
+
+    def _serialize_expression(self, expr: dict, output: dict) -> dict:
+        ev = self._eval[expr["id"]]
+        grid, columns = ev["grid"], ev["columns"]
         dps = []
         for j, t in enumerate(grid.tolist()):
             row = [t] + [self._num(col[j]) for col in columns]
@@ -292,7 +350,7 @@ class QueryExecutor:
                 "setCount": len(grid),
                 "series": len(columns),
             },
-            "meta": metas,
+            "meta": ev["metas"],
         }
 
     def _serialize_metric(self, oid: str, output: dict,

@@ -258,6 +258,75 @@ class TestExpEndpoint:
         assert e["dpsMeta"]["series"] == 1
         assert e["dps"][1][1] == 1 + 5
 
+    def test_nested_expression(self, manager):
+        """Expression-over-expression: the reference topo-sorts an
+        expression DAG (/root/reference/src/tsd/QueryExecutor.java:19-23
+        jgrapht DirectedAcyclicGraph; ExpressionIterator wires variable
+        iterators from metric OR expression results), so `e2 = e1 / 2`
+        must evaluate against e1's output — declaration order must not
+        matter."""
+        body = self.base_query()
+        body["expressions"] = [
+            {"id": "e2", "expr": "e1 / 2"},    # declared BEFORE its dep
+            {"id": "e1", "expr": "a + b"},
+        ]
+        body["outputs"] = [{"id": "e1"}, {"id": "e2"}]
+        status, out = self.post_exp(manager, body)
+        assert status == 200
+        by_id = {o["id"]: o for o in out["outputs"]}
+        assert by_id["e1"]["dpsMeta"]["series"] == 1
+        assert by_id["e2"]["dpsMeta"]["series"] == 1
+        for i in range(10):
+            r1 = by_id["e1"]["dps"][i]
+            r2 = by_id["e2"]["dps"][i]
+            assert r1[0] == r2[0] == (BASE + i * 10) * 1000
+            assert r1[1] == 100 + 2 * i          # a + b on web01
+            assert r2[1] == pytest.approx((100 + 2 * i) / 2)
+
+    def test_nested_expression_mixed_variables(self, manager):
+        # e2 joins an expression result WITH a metric result by tags:
+        # e1 - a == b for the intersection-joined web01 series
+        body = self.base_query()
+        body["expressions"] = [
+            {"id": "e1", "expr": "a + b"},
+            {"id": "e2", "expr": "e1 - a"},
+        ]
+        body["outputs"] = [{"id": "e2"}]
+        status, out = self.post_exp(manager, body)
+        assert status == 200
+        e2 = out["outputs"][0]
+        assert e2["dpsMeta"]["series"] == 1
+        for i in range(10):
+            assert e2["dps"][i][1] == 100 + i    # == b (sys.mem web01)
+
+    def test_three_level_expression_chain(self, manager):
+        body = self.base_query()
+        body["expressions"] = [
+            {"id": "e3", "expr": "e2 * 2"},
+            {"id": "e1", "expr": "a + b"},
+            {"id": "e2", "expr": "e1 + 1"},
+        ]
+        body["outputs"] = [{"id": "e3"}]
+        status, out = self.post_exp(manager, body)
+        assert status == 200
+        for i in range(10):
+            assert out["outputs"][0]["dps"][i][1] == (100 + 2 * i + 1) * 2
+
+    def test_expression_cycle_rejected(self, manager):
+        body = self.base_query()
+        body["expressions"] = [
+            {"id": "e1", "expr": "e2 + 1"},
+            {"id": "e2", "expr": "e1 + 1"},
+        ]
+        status, out = self.post_exp(manager, body)
+        assert status == 400
+
+    def test_expression_self_reference_rejected(self, manager):
+        body = self.base_query()
+        body["expressions"] = [{"id": "e1", "expr": "e1 + 1"}]
+        status, out = self.post_exp(manager, body)
+        assert status == 400
+
     def test_duplicate_expression_id_rejected(self, manager):
         body = self.base_query()
         body["expressions"] = [{"id": "e", "expr": "a"},
