@@ -169,6 +169,11 @@ def main() -> None:
     race("subblock2+int32+hier+sorted",
          combo("subblock2", "hier", "sorted"), spec)
 
+    # the shape-driven cost model's own pick (ops/costmodel.py "auto"):
+    # racing it against the explicit rows shows on-chip whether the
+    # chooser lands on the winner without being crowned
+    race("auto+int32", combo("auto", "auto", "auto"), spec)
+
     restore_defaults()
 
 

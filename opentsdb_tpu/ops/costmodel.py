@@ -1,0 +1,236 @@
+"""Shape-driven kernel-mode selection (VERDICT r4 #4).
+
+Replaces crowned-env-var-plus-reactive-guard mode policy with a small
+analytical cost model: for each kernel axis (edge search, prefix scan,
+extreme reduce, group reduce) predict the per-dispatch cost of every
+feasible mode from the dispatch shape and the execution platform, and
+take the argmin.  Feasibility (memory caps, divisibility, platform
+hazards) stays with the kernels in downsample.py/group_agg.py — this
+module only ranks the modes those guards admit, so a wrong prediction
+can cost a few x, never an OOM or a compile failure.
+
+The per-unit constants are CALIBRATED, not guessed: each anchor cites
+the chip measurement it comes from (BENCH_CONFIGS_r04.json bench_prefix
+/ stage_bench rows at the headline shape — 1024 series x 65536 points,
+514 window edges, f64 contract).  A measurement session can re-calibrate
+without code edits by writing BENCH_CALIBRATION.json at the repo root
+({"tpu": {...}, "cpu": {...}} partial overrides); BENCH_WINNERS.json
+stays as recorded evidence, no longer policy.
+
+The decisions this model reproduces from the r4 chip data:
+  * search: hier (20ms) < compare_all (116ms) < binary scan (154ms) on
+    the chip at the headline shape; binary everywhere on CPU (the dense
+    compare materializes there — measured 18-70x slower).
+  * prefix scan: subblock windowed-sum (88ms) < flat (130ms) on the
+    chip (the full-length emulated-f64 cumsum is the cost, 100ms vs
+    3ms for 1/32-length); flat on CPU (native vector cumsum, the extra
+    subblock passes only add traffic).
+  * extremes: reset-scan (0.5245s/dispatch) < subblock (0.8282 — its
+    per-edge boundary-lane reduces outweigh the shorter scan at the
+    headline W) << segment scatter (7.161) on the chip; the scatter is
+    cheap on CPU.
+  * group reduce: the serializing segment scatter (219ms) loses on the
+    chip to the one-hot MXU matmul (~100ms at G=100) and the sorted
+    reset-scan (~90ms, G-independent); matmul's cost grows linearly in
+    G so large-G queries flip to sorted.  CPU keeps segment.
+
+Reference being outperformed: the per-datapoint iterator stack
+(/root/reference/src/core/AggregationIterator.java:514,
+Downsampler.java:292) has exactly one "mode"; this module exists
+because the TPU-first kernel space has several and the fastest one is
+shape-dependent.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+# --------------------------------------------------------------------- #
+# Calibrated per-unit costs, seconds.  Anchors (r04b chip session,
+# BENCH_CONFIGS_r04.json, headline shape S=1024 N=65536 E=514 G=100
+# W=512):
+#   gather_round  0.154s / (S*E*log2(N)=8.42e6)      binary search stage
+#   cmp_cell      0.116s / (S*N*E=3.45e10)           compare_all stage
+#   hier_cell     0.020s / (S*(N/32)*E=1.08e9)       hier stage
+#   scan_f64      0.100s / (S*N=6.71e7)              f64 cumsum stage
+#   elem_f64      0.018s / (S*N=6.71e7)              raw f64 elementwise
+#   win_gather    (0.130-0.100)s / (S*E=5.26e5)      flat windowed-sum
+#                                                    minus its cumsum
+#   seg_scatter   0.219s / (S*W=5.24e5)              group segment stage
+#   mxu_cell      0.100s / (G*S*W=5.24e9)            group matmul stage
+#   sorted_grid   0.090s / (S*W=5.24e5)              group sorted stage
+#   ext_scan      0.52s/dispatch vs ext_segment 7.09s — modeled per
+#                 grid element over S*N
+# CPU anchors are this dev box (differential suite timings): searchsorted
+# ~2e-8/unit, native cumsum ~1.5e-9/elem, scatters ~5e-9/elem; the
+# dense-compare materialization hazard is handled by feasibility (the
+# platform guard), not by the model.
+# --------------------------------------------------------------------- #
+
+DEFAULT_COSTS: dict[str, dict[str, float]] = {
+    "tpu": {
+        "gather_round": 1.83e-8,
+        "cmp_cell": 3.36e-12,
+        "hier_cell": 1.87e-11,
+        "scan_f64": 1.49e-9,
+        "elem_f64": 2.7e-10,
+        "win_gather": 5.7e-8,
+        "seg_scatter": 4.2e-7,
+        "mxu_cell": 1.9e-9,
+        "sorted_grid": 1.7e-7,
+        "ext_scan_elem": 6.0e-9,
+        "ext_seg_elem": 1.06e-7,
+        "ext_boundary_cell": 4.0e-8,
+    },
+    "cpu": {
+        "gather_round": 2.0e-8,
+        "cmp_cell": 1.0e-9,      # materializes; feasibility-capped anyway
+        "hier_cell": 1.0e-9,
+        "scan_f64": 1.5e-9,      # native f64 vector cumsum
+        # CPU passes are memory-bound at the same rate as the cumsum, so
+        # an extra elementwise pass costs the cumsum's full traffic —
+        # this is what makes flat beat subblock on the host
+        "elem_f64": 1.5e-9,
+        "win_gather": 2.0e-8,
+        "seg_scatter": 5.0e-9,   # CPU scatters are cheap
+        "mxu_cell": 1.0e-9,      # no MXU: dense [G,S]x[S,W] is real FLOPs
+        "sorted_grid": 1.0e-8,
+        "ext_scan_elem": 4.0e-9,
+        "ext_seg_elem": 2.0e-9,
+        "ext_boundary_cell": 2.0e-8,
+    },
+}
+
+_CALIBRATION_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "BENCH_CALIBRATION.json")
+
+_COSTS: dict[str, dict[str, float]] | None = None
+
+
+def costs(platform: str) -> dict[str, float]:
+    """Per-unit costs for a platform, with BENCH_CALIBRATION.json
+    overrides applied once per process.  Unknown platforms (the axon
+    tunnel reports 'axon') use the TPU table — this framework's device
+    path IS the TPU path."""
+    global _COSTS
+    if _COSTS is None:
+        table = {p: dict(c) for p, c in DEFAULT_COSTS.items()}
+        try:
+            with open(_CALIBRATION_FILE) as fh:
+                for plat, over in json.load(fh).items():
+                    if plat in table and isinstance(over, dict):
+                        for k, v in over.items():
+                            if k in table[plat]:
+                                table[plat][k] = float(v)
+        except (OSError, ValueError):
+            pass
+        _COSTS = table
+    return _COSTS["cpu" if platform == "cpu" else "tpu"]
+
+
+def reload_calibration() -> None:
+    """Drop the cached cost table (tests / post-session recalibration).
+    Callers that already traced with the old table must clear jit caches
+    themselves (downsample.set_* helpers do)."""
+    global _COSTS
+    _COSTS = None
+
+
+def _log2(n: int) -> int:
+    return max(int(math.ceil(math.log2(max(n, 2)))), 1)
+
+
+# -- edge search: idx[S, E] from [S, N] sorted timestamps -------------- #
+
+def predict_search(mode: str, s: int, n: int, e: int,
+                   platform: str) -> float:
+    c = costs(platform)
+    if mode == "scan":
+        return s * e * _log2(n) * c["gather_round"]
+    if mode == "compare_all":
+        return s * n * e * c["cmp_cell"]
+    if mode == "hier":
+        k = 32
+        return s * ((n // k) + k) * e * c["hier_cell"]
+    raise ValueError("unknown search mode: " + mode)
+
+
+def choose_search(s: int, n: int, e: int, platform: str,
+                  candidates: list[str]) -> str:
+    return min(candidates,
+               key=lambda m: predict_search(m, s, n, e, platform))
+
+
+# -- prefix scan: windowed sums over [S, N] ---------------------------- #
+
+def predict_scan(mode: str, s: int, n: int, e: int,
+                 platform: str) -> float:
+    c = costs(platform)
+    if mode == "flat":
+        return s * n * c["scan_f64"] + s * e * c["win_gather"]
+    if mode == "blocked":
+        # two-level scan: same element count, measured slightly slower
+        # than flat on both platforms (r3 chip: 0.600 vs 0.568)
+        return 1.06 * (s * n * c["scan_f64"] + s * e * c["win_gather"])
+    if mode in ("subblock", "subblock2"):
+        k = 32
+        return (s * n * c["elem_f64"]                 # sub-block reduce
+                + s * (n // k) * c["scan_f64"]        # 1/32-length cumsum
+                + s * e * k * c["elem_f64"]           # boundary remainder
+                + s * e * c["win_gather"])
+    raise ValueError("unknown scan mode: " + mode)
+
+
+def choose_scan(s: int, n: int, e: int, platform: str,
+                candidates: list[str]) -> str:
+    return min(candidates,
+               key=lambda m: predict_scan(m, s, n, e, platform))
+
+
+# -- extreme (min/max) over [S, N] ------------------------------------- #
+
+def predict_extreme(mode: str, s: int, n: int, e: int,
+                    platform: str) -> float:
+    c = costs(platform)
+    if mode == "scan":
+        return s * n * c["ext_scan_elem"]
+    if mode == "segment":
+        return s * n * c["ext_seg_elem"]
+    if mode == "subblock":
+        k = 32
+        # sub-block reduces + a 1/32-length reset-scan + per-edge
+        # boundary-lane masked reduces (the term that loses it the
+        # headline shape: measured 0.83 vs scan's 0.52 s/dispatch)
+        return (s * n * c["elem_f64"]
+                + s * (n // k) * c["ext_scan_elem"]
+                + s * e * k * c["ext_boundary_cell"])
+    raise ValueError("unknown extreme mode: " + mode)
+
+
+def choose_extreme(s: int, n: int, e: int, platform: str,
+                   candidates: list[str]) -> str:
+    return min(candidates,
+               key=lambda m: predict_extreme(m, s, n, e, platform))
+
+
+# -- group reduce: [S, W] + gid[S] -> [G, W] --------------------------- #
+
+def predict_group(mode: str, s: int, w: int, g: int,
+                  platform: str) -> float:
+    c = costs(platform)
+    if mode == "segment":
+        return s * w * c["seg_scatter"]
+    if mode == "matmul":
+        return g * s * w * c["mxu_cell"]
+    if mode == "sorted":
+        return s * w * c["sorted_grid"]
+    raise ValueError("unknown group mode: " + mode)
+
+
+def choose_group(s: int, w: int, g: int, platform: str,
+                 candidates: list[str]) -> str:
+    return min(candidates,
+               key=lambda m: predict_group(m, s, w, g, platform))

@@ -170,15 +170,16 @@ PREFIX_AGGS = frozenset(
 # extremes for each window's interior, and 32-wide masked reduces over
 # the two boundary sub-blocks.  The chip A/B decides the default.
 EXTREME_AGGS = frozenset({"min", "mimmin", "max", "mimmax"})
-_EXTREME_MODES = ("scan", "segment", "subblock")
+_EXTREME_MODES = ("auto", "scan", "segment", "subblock")
 _EXTREME_MODE = (_os.environ.get("TSDB_EXTREME_MODE")
                  if _os.environ.get("TSDB_EXTREME_MODE")
-                 in _EXTREME_MODES else "scan")
+                 in _EXTREME_MODES else "auto")
 
 
 def set_extreme_mode(mode: str) -> None:
-    """'scan' | 'segment' | 'subblock' — min/max downsample strategy;
-    clears caches."""
+    """'auto' | 'scan' | 'segment' | 'subblock' — min/max downsample
+    strategy ('auto' = shape/platform cost model, ops.costmodel); clears
+    caches."""
     global _EXTREME_MODE
     if mode not in _EXTREME_MODES:
         raise ValueError("extreme mode must be one of %r"
@@ -217,10 +218,10 @@ def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
 # read once at import): lets the one-command measurement session feed
 # bench_prefix's A/B winners into the later stages without editing
 # source mid-run.  Invalid values are ignored (defaults win).
-_SCAN_MODES = ("flat", "blocked", "subblock", "subblock2")
+_SCAN_MODES = ("auto", "flat", "blocked", "subblock", "subblock2")
 _SCAN_MODE = (_os.environ.get("TSDB_SCAN_MODE")
               if _os.environ.get("TSDB_SCAN_MODE") in _SCAN_MODES
-              else "flat")
+              else "auto")
 _SCAN_BLOCK = 512
 _SUB_K = 32      # subblock scan / hier search granule (power of two)
 
@@ -245,14 +246,15 @@ _COMPACT_ENABLED = True
 # then resolve the one boundary sub-block with a 32-wide compare — the
 # compare work drops from O(N*W) to O(N*W/32 + 32*W).  r3/r4 chip data:
 # scan 182ms, compare_all ~116ms for the 65536x513 headline search.
-_SEARCH_MODES = ("scan", "compare_all", "hier")
+_SEARCH_MODES = ("auto", "scan", "compare_all", "hier")
 _SEARCH_MODE = (_os.environ.get("TSDB_SEARCH_MODE")
                 if _os.environ.get("TSDB_SEARCH_MODE")
-                in _SEARCH_MODES else "scan")
+                in _SEARCH_MODES else "auto")
 
 
 def set_search_mode(mode: str) -> None:
-    """'scan' | 'compare_all' | 'hier' — edge-search strategy; clears
+    """'auto' | 'scan' | 'compare_all' | 'hier' — edge-search strategy
+    ('auto' = shape/platform cost model, ops.costmodel); clears
     caches."""
     global _SEARCH_MODE
     if mode not in _SEARCH_MODES:
@@ -293,8 +295,8 @@ def _clear_dependent_caches() -> None:
 
 
 def set_scan_mode(mode: str) -> None:
-    """'flat' | 'blocked' | 'subblock' | 'subblock2' — benchmarking
-    hook; clears
+    """'auto' | 'flat' | 'blocked' | 'subblock' | 'subblock2' —
+    benchmarking/ops hook ('auto' = shape/platform cost model); clears
     affected jit caches."""
     global _SCAN_MODE
     if mode not in _SCAN_MODES:
@@ -330,7 +332,9 @@ def _edge_prefix_builder(s: int, n: int, idx):
     [S, B] block totals; prefix(p) = block_offset[p // K] + intra[p-1 within
     its block].  Same HBM traffic, much shorter scan dependency chains.
     """
-    if _SCAN_MODE == "flat" or n % _SCAN_BLOCK or n <= _SCAN_BLOCK:
+    # only an EXPLICIT "blocked" takes the two-level form ("auto" never
+    # picks it: it lost the r3 chip race, 0.600 vs 0.568)
+    if _SCAN_MODE != "blocked" or n % _SCAN_BLOCK or n <= _SCAN_BLOCK:
         def windowed(data):
             csum = jnp.concatenate(
                 [jnp.zeros((s, 1), data.dtype),
@@ -615,25 +619,87 @@ def set_platform_mode_guard(on: bool) -> None:
     _clear_dependent_caches()
 
 
+def _search_feasible(mode: str, n: int, w_edges: int) -> bool:
+    """Hard feasibility for the dense search forms: memory caps on the
+    compare intermediates and the per-edge compare-vs-gather cost ratio.
+    Shapes outside these bounds demote to the binary scan no matter what
+    crowned/auto policy says — a wrong choice here is an OOM or a
+    scoped-vmem compile failure, not a slowdown."""
+    logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
+    if mode == "compare_all":
+        return (n <= _SEARCH_DEMOTE_RATIO * logn
+                and n * w_edges <= _COMPARE_ALL_CELL_CAP)
+    if mode == "hier":
+        return (n % _SUB_K == 0 and n > _SUB_K
+                and n // _SUB_K <= _SEARCH_DEMOTE_RATIO * logn
+                and (n // _SUB_K) * w_edges <= _HIER_CELL_CAP
+                and _subblock_edges_fit(n, w_edges))
+    return True
+
+
 def _effective_search_mode(s: int, n: int, w_edges: int) -> str:
-    """The configured search mode, demoted to "scan" for shapes where the
-    dense form's per-edge compare cost would dwarf the binary search's
-    per-edge gather cost, or where its intermediate would outgrow memory
-    (compare_all's per-row compare matrix; hier's [S, W, K] remainder),
-    and on CPU execution (see _PLATFORM_MODE_GUARD)."""
-    del s   # every form scales linearly with S
+    """The search mode for this shape: 'auto' (default) ranks the
+    feasible modes with the calibrated cost model (ops.costmodel);
+    an explicit mode (env/setter — measurement sessions) is honored but
+    still demoted to "scan" when infeasible for the shape or when the
+    trace executes on CPU (see _PLATFORM_MODE_GUARD — the dense forms'
+    compare matrices materialize there)."""
     mode = _SEARCH_MODE
+    from opentsdb_tpu.ops.hostlane import execution_platform
+    if mode == "auto":
+        platform = execution_platform()
+        if platform == "cpu":
+            return "scan"      # dense compares materialize on CPU
+        from opentsdb_tpu.ops import costmodel
+        cands = [m for m in ("scan", "compare_all", "hier")
+                 if _search_feasible(m, n, w_edges)]
+        return costmodel.choose_search(s, n, w_edges, platform, cands)
     if _PLATFORM_MODE_GUARD and mode != "scan":
-        from opentsdb_tpu.ops.hostlane import execution_platform
         if execution_platform() == "cpu":
             return "scan"
-    logn = max(int(np.ceil(np.log2(max(n, 2)))), 1)
-    if mode == "compare_all" and (n > _SEARCH_DEMOTE_RATIO * logn
-                                  or n * w_edges > _COMPARE_ALL_CELL_CAP):
+    if not _search_feasible(mode, n, w_edges):
         return "scan"
-    if mode == "hier" and (n // _SUB_K > _SEARCH_DEMOTE_RATIO * logn
-                           or (n // _SUB_K) * w_edges > _HIER_CELL_CAP
-                           or not _subblock_edges_fit(n, w_edges)):
+    return mode
+
+
+def _effective_scan_mode(s: int, n: int, w_edges: int) -> str:
+    """The prefix-scan strategy for this shape: 'auto' ranks the
+    feasible modes with the cost model (the sub-block forms need
+    K-divisible rows; "subblock" additionally needs the [S, W, K]
+    boundary intermediate to fit).  Explicit modes keep their existing
+    call-site eligibility fallbacks."""
+    mode = _SCAN_MODE
+    if mode != "auto":
+        return mode
+    sub_ok = n % _SUB_K == 0 and n > _SUB_K
+    cands = ["flat"]
+    if sub_ok and _subblock_edges_fit(n, w_edges):
+        cands.append("subblock")
+    if sub_ok:
+        cands.append("subblock2")
+    if len(cands) == 1:
+        return "flat"
+    from opentsdb_tpu.ops.hostlane import execution_platform
+    from opentsdb_tpu.ops import costmodel
+    return costmodel.choose_scan(s, n, w_edges, execution_platform(),
+                                 cands)
+
+
+def _effective_extreme_mode(n: int, w_padded: int) -> str:
+    """The min/max strategy for this shape: 'auto' ranks scan vs segment
+    vs (when eligible) subblock with the cost model; an explicit
+    "subblock" falls back to "scan" on ineligible shapes — same rule on
+    the materialized and streaming paths (they must never drift)."""
+    mode = _EXTREME_MODE
+    sub_ok = (n % _SUB_K == 0 and n > _SUB_K
+              and _subblock_edges_fit(n, w_padded + 1))
+    if mode == "auto":
+        from opentsdb_tpu.ops.hostlane import execution_platform
+        from opentsdb_tpu.ops import costmodel
+        cands = ["scan", "segment"] + (["subblock"] if sub_ok else [])
+        return costmodel.choose_extreme(1, n, w_padded + 1,
+                                        execution_platform(), cands)
+    if mode == "subblock" and not sub_ok:
         return "scan"
     return mode
 
@@ -682,10 +748,11 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     ok = mask & ~jnp.isnan(vf)
     cts, cedges = _compact_ts(ts, spec, wargs)
     idx = _edge_search(cts, cedges)
-    if (_SCAN_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
+    smode = _effective_scan_mode(s, n, cedges.shape[0])
+    if (smode == "subblock" and n % _SUB_K == 0 and n > _SUB_K
             and _subblock_edges_fit(n, cedges.shape[0])):
         windowed = _edge_subblock_builder(s, n, idx)
-    elif (_SCAN_MODE == "subblock2" and n % _SUB_K == 0 and n > _SUB_K):
+    elif (smode == "subblock2" and n % _SUB_K == 0 and n > _SUB_K):
         # no edges-fit constraint: the remainder reads a same-size
         # prefix array, never an [S, W, K] intermediate
         windowed = _edge_subblock2_builder(s, n, idx)
@@ -771,13 +838,12 @@ def _extreme_downsample(ts, val, mask, spec: WindowSpec, wargs: dict,
 
 
 def _use_subblock_extreme(n: int, w_padded: int) -> bool:
-    """ONE eligibility predicate for extreme mode "subblock", shared by
-    the materialized and streaming paths (they must never drift);
-    ineligible shapes fall back to the scan form on BOTH paths.  The
-    edge-fit guard bounds the [S, W, K] boundary-lane intermediates on
-    wider-than-data grids (see _SUBBLOCK_EDGE_FACTOR)."""
-    return (_EXTREME_MODE == "subblock" and n % _SUB_K == 0 and n > _SUB_K
-            and _subblock_edges_fit(n, w_padded + 1))
+    """ONE predicate for taking the subblock extreme form, shared by the
+    materialized and streaming paths (they must never drift); ineligible
+    shapes fall back to the scan form on BOTH paths.  Eligibility (the
+    edge-fit guard bounding the [S, W, K] boundary-lane intermediates)
+    and auto-selection both live in _effective_extreme_mode."""
+    return _effective_extreme_mode(n, w_padded) == "subblock"
 
 
 def _extreme_subblock(ts, val, mask, spec: WindowSpec, wargs: dict,
@@ -908,9 +974,9 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
                                    fill_policy, fill_value, fdtype)
         return wts, out, out_mask
 
-    if agg_name in PREFIX_AGGS or (
-            agg_name in EXTREME_AGGS
-            and _EXTREME_MODE in ("scan", "subblock")):
+    emode = (_effective_extreme_mode(ts.shape[1], spec.count)
+             if agg_name in EXTREME_AGGS else None)
+    if agg_name in PREFIX_AGGS or emode in ("scan", "subblock"):
         w = spec.count
         nwin = wargs["nwin"]
         if agg_name in PREFIX_AGGS:
@@ -920,8 +986,8 @@ def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
             # ineligible shapes under "subblock" fall back to the scan
             # form (NOT the segment scatter) — same rule as streaming
             is_min = agg_name in ("min", "mimmin")
-            extreme = _extreme_subblock if _use_subblock_extreme(
-                ts.shape[1], spec.count) else _extreme_downsample
+            extreme = _extreme_subblock if emode == "subblock" \
+                else _extreme_downsample
             lo, hi, count_grid = extreme(
                 ts, val, mask, spec, wargs, is_min, not is_min)
             out = lo if is_min else hi

@@ -69,10 +69,10 @@ def _identity(x):
 # (bench_prefix) picks the default via TSDB_GROUP_REDUCE_MODE.
 import os as _os
 
-_GROUP_REDUCE_MODES = ("segment", "matmul", "sorted")
+_GROUP_REDUCE_MODES = ("auto", "segment", "matmul", "sorted")
 _GROUP_REDUCE_MODE = (_os.environ.get("TSDB_GROUP_REDUCE_MODE")
                       if _os.environ.get("TSDB_GROUP_REDUCE_MODE")
-                      in _GROUP_REDUCE_MODES else "segment")
+                      in _GROUP_REDUCE_MODES else "auto")
 
 # Shape gate for the matmul form: the dense one-hot is [S, G] f64, so a
 # wide group-by (10k groups) would build GBs and burn O(S*G*W) FLOPs —
@@ -82,8 +82,9 @@ _MATMUL_MAX_ONEHOT_BYTES = 1 << 25        # 32 MB
 
 
 def set_group_reduce_mode(mode: str) -> None:
-    """Benchmarking/ops hook; clears the jitted pipelines that baked the
-    old strategy in (read at trace time)."""
+    """Benchmarking/ops hook ('auto' = shape/platform cost model); clears
+    the jitted pipelines that baked the old strategy in (read at trace
+    time)."""
     global _GROUP_REDUCE_MODE
     if mode not in _GROUP_REDUCE_MODES:
         raise ValueError("group reduce mode must be one of %r"
@@ -93,6 +94,31 @@ def set_group_reduce_mode(mode: str) -> None:
     # (review r4: a hand-copied list here would drift)
     from opentsdb_tpu.ops.downsample import _clear_dependent_caches
     _clear_dependent_caches()
+
+
+def _matmul_feasible(s: int, g: int) -> bool:
+    return g <= _MATMUL_MAX_GROUPS and s * g * 8 <= _MATMUL_MAX_ONEHOT_BYTES
+
+
+def _effective_group_reduce_mode(s: int, w: int, g: int,
+                                 extremes: bool = False) -> str:
+    """The group-combine strategy for this shape: 'auto' (default) ranks
+    segment/sorted/(feasible) matmul with the calibrated cost model
+    (ops.costmodel — chip anchors: segment scatter 219ms, matmul ~100ms
+    at G=100, sorted ~90ms G-independent on the headline grid; CPU
+    scatters are cheap so segment wins there).  Explicit modes keep the
+    matmul feasibility gate at the call sites."""
+    mode = _GROUP_REDUCE_MODE
+    if mode != "auto":
+        return mode
+    from opentsdb_tpu.ops.hostlane import execution_platform
+    from opentsdb_tpu.ops import costmodel
+    cands = ["segment", "sorted"]
+    # extremes have no matmul form (min/max don't distribute over the
+    # one-hot dot) — auto must rank only the forms that exist for them
+    if not extremes and _matmul_feasible(s, g):
+        cands.append("matmul")
+    return costmodel.choose_group(s, w, g, execution_platform(), cands)
 
 
 class _SortedGroups:
@@ -245,10 +271,12 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     s, w = contrib.shape
     g = num_groups
     num = g * w
+    extremes = agg_name in ("min", "mimmin", "max", "mimmax")
+    mode = _effective_group_reduce_mode(s, w, g, extremes=extremes)
 
-    if agg_name in ("min", "mimmin", "max", "mimmax"):
+    if extremes:
         want_max = agg_name in ("max", "mimmax")
-        if _GROUP_REDUCE_MODE == "sorted":
+        if mode == "sorted":
             # contiguous-run reset-scan over group-sorted rows: no scatter
             sg = _SortedGroups(gid, g, s)
             vf0 = contrib.astype(jnp.float64)
@@ -288,10 +316,8 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     vf = contrib.astype(jnp.float64)
     ok2 = participate & ~jnp.isnan(vf)
     v2 = jnp.where(ok2, vf, 0.0)
-    use_matmul = (_GROUP_REDUCE_MODE == "matmul"
-                  and g <= _MATMUL_MAX_GROUPS
-                  and s * g * 8 <= _MATMUL_MAX_ONEHOT_BYTES)
-    if _GROUP_REDUCE_MODE == "sorted":
+    use_matmul = mode == "matmul" and _matmul_feasible(s, g)
+    if mode == "sorted":
         sg = _SortedGroups(gid, g, s)
 
         def gsum(x2d):   # [S, W] -> [G, W], cross-chip combined
@@ -455,7 +481,15 @@ def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
         out, _ = ordered_group_reduce(agg.name, contrib, participate, gid,
                                       num_groups)
     s, w = val.shape
-    if _GROUP_REDUCE_MODE == "sorted":
+    # same extremes flag as moment_group_reduce's own decision: the mask
+    # pass must ride the mode the reduce actually took, or an auto pick
+    # of matmul (excluded for extremes) would put the segment scatter
+    # back into a dispatch the sorted mode was chosen to keep
+    # scatter-free (review r5)
+    extreme_agg = agg.name in ("min", "mimmin", "max", "mimmax")
+    if _effective_group_reduce_mode(
+            s, w, num_groups,
+            extremes=is_moment_agg(agg.name) and extreme_agg) == "sorted":
         # same reset-scan machinery (XLA CSEs the repeated argsort)
         present = _SortedGroups(gid, num_groups, s).sum(
             mask.astype(jnp.float64))

@@ -398,22 +398,33 @@ class TestExtremeScanPath:
                     assert not omask[i, w]
 
     def test_materialized_and_streamed_minmax_have_no_scatter(self):
+        """The scan-form extreme kernel is scatter-free (TPU scatters
+        serialize).  Mode "scan" is forced: under the default "auto" the
+        cost model correctly picks the segment scatter on CPU — where
+        this suite runs and scatters are cheap — so the property being
+        pinned is the scan KERNEL's, not the chooser's."""
         import jax
         import jax.numpy as jnp
+        from opentsdb_tpu.ops import downsample as ds_mod
         from opentsdb_tpu.ops import streaming
         windows = FixedWindows.for_range(0, 3_000_000, 60_000)
         spec, wargs = windows.split()
         ts = jnp.zeros((4, 128), jnp.int64)
         val = jnp.zeros((4, 128))
         mask = jnp.ones((4, 128), bool)
-        hlo = jax.jit(downsample, static_argnums=(3, 4, 6)).lower(
-            ts, val, mask, "min", spec, wargs, FILL_NONE).as_text()
-        assert "scatter" not in hlo
-        state = streaming._zero_state(
-            4, spec.count, lanes=streaming.lanes_for(["min", "max"]))
-        hlo = jax.jit(streaming._update, static_argnums=0).lower(
-            spec, state, ts, val, mask, wargs).as_text()
-        assert "scatter" not in hlo
+        prior = ds_mod._EXTREME_MODE
+        ds_mod.set_extreme_mode("scan")
+        try:
+            hlo = jax.jit(downsample, static_argnums=(3, 4, 6)).lower(
+                ts, val, mask, "min", spec, wargs, FILL_NONE).as_text()
+            assert "scatter" not in hlo
+            state = streaming._zero_state(
+                4, spec.count, lanes=streaming.lanes_for(["min", "max"]))
+            hlo = jax.jit(streaming._update, static_argnums=0).lower(
+                spec, state, ts, val, mask, wargs).as_text()
+            assert "scatter" not in hlo
+        finally:
+            ds_mod.set_extreme_mode(prior)
 
     @pytest.mark.parametrize("agg", ["min", "max"])
     @pytest.mark.parametrize("seed,interval", [(62, 600_000), (63, 60_000),

@@ -80,11 +80,14 @@ def main() -> None:
         lambda row: jnp.searchsorted(row, e, side="left"))(t))(cts, cedges)
     drain((cts, cedges, idx))
 
+    recorded: dict[str, float] = {}
+
     def record(name, t, points=None):
         # one JSON line per stage, emitted IMMEDIATELY: a chip crash in a
         # later stage must not lose earlier attributions (the reason this
         # tool exists)
         pts = S * N if points is None else points
+        recorded[name] = t
         print(json.dumps({"stage": name, "seconds": round(t, 4),
                           "dp_per_sec": round(pts / t, 1)}), flush=True)
         _note("%s: %.4fs" % (name, t))
@@ -301,6 +304,36 @@ def main() -> None:
         jax.jit(chunk_dense_forced), (ts2, val2, mask2), rtt),
         points=s2 * n2)
 
+    # ---- cost-model calibration (ops/costmodel.py) -------------------
+    # Convert THIS session's stage timings into the per-unit costs the
+    # shape-driven mode chooser uses, so auto-selection follows the chip
+    # actually measured rather than the hardcoded r4 anchors.  The
+    # session runner persists the record to BENCH_CALIBRATION.json.
+    # Never emitted on CPU (a smoke run must not masquerade as chip
+    # calibration).
+    if jax.devices()[0].platform != "cpu":
+        import numpy as _np
+        e_cnt = int(cedges.shape[0])
+        logn = max(int(_np.ceil(_np.log2(max(N, 2)))), 1)
+        denoms = {
+            "gather_round": ("searchsorted", S * e_cnt * logn),
+            "hier_cell": ("searchsorted_hier",
+                          S * ((N // 32) + 32) * e_cnt),
+            "scan_f64": ("prim_f64_cumsum", S * N),
+            "elem_f64": ("prim_f64_mul", S * N),
+            "win_gather": ("prim_gather_edges", S * e_cnt),
+            "seg_scatter": ("group_reduce_segment", S * w),
+            "mxu_cell": ("group_reduce_matmul", g_pad * S * w),
+            "sorted_grid": ("group_reduce_sorted", S * w),
+        }
+        costs = {key: recorded[label] / denom
+                 for key, (label, denom) in denoms.items()
+                 if label in recorded and recorded[label] > 0}
+        if costs:
+            print(json.dumps({"stage": "calibration",
+                              "costs_tpu": {k: float("%.4g" % v)
+                                            for k, v in costs.items()}}),
+                  flush=True)
 
 
 if __name__ == "__main__":
