@@ -31,14 +31,26 @@ output rows are emitted per chunk — the write-side shape of
 TSDB.addAggregatePoint); the others by point index.
 
 Use --scale 0.01 for a quick CPU smoke run.
+
+Deadline discipline (--deadline S): every loop that can run long — timed
+passes, streamed chunk folds, config 7's ingest — checks a COOPERATIVE
+per-config deadline between units of work and finalizes early with a
+partial-but-honest row (the points actually processed over the seconds
+actually measured) instead of being SIGKILLed mid-dispatch by an outer
+subprocess timeout.  A JAX process killed mid-dispatch wedges the axon
+tunnel (it ended both r4 chip sessions and cost configs 5-7 twice);
+the outer kill is now a last resort that fires only after this
+in-process deadline has already had a grace window to finish draining.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
+import threading
 import time
 
 from bench import drain, measure_rtt, _median
@@ -50,23 +62,50 @@ MIN_WALL_S = 1.0
 MIN_PASSES = 3
 MAX_PASSES = 32
 
+# Cooperative per-config deadline (monotonic seconds; None = unlimited).
+_DEADLINE: float | None = None
+_CURRENT_CONFIG = 0
+
+
+def _deadline_left() -> float:
+    return math.inf if _DEADLINE is None else _DEADLINE - time.monotonic()
+
+
+def _fits(estimated_s: float) -> bool:
+    """Can another unit of work (estimated from the last one) finish
+    before the deadline?  1.5x headroom: overrunning by one unit is the
+    failure mode this exists to prevent."""
+    return _deadline_left() > 1.5 * estimated_s
+
 
 def _note(msg: str) -> None:
     print("[bench_configs] " + msg, file=sys.stderr, flush=True)
 
 
+# Audit trail for the sync-unresolvable plausibility guard: when it
+# fires, BOTH medians land in the emitted record so the classification
+# can be re-checked offline (ADVICE r4: a hardcoded ceiling could
+# silently flip a future faster chip between subtracted and raw).
+_GUARD_INFO: dict | None = None
+
+
 def _emit(config: int, label: str, points: int, seconds: float,
           n_dev: int, unit: str = "datapoints/sec/chip",
           baseline: float | None = None) -> None:
+    global _GUARD_INFO
     rate = points / max(seconds, 1e-9) / n_dev
     if baseline is None:
         baseline = 1e9 / 2.0 / 8.0  # north star: 62.5M dp/s/chip
-    print(json.dumps({
+    rec = {
         "metric": "config %d: %s" % (config, label),
         "value": round(rate, 1),
         "unit": unit,
         "vs_baseline": round(rate / baseline, 4),
-    }), flush=True)
+    }
+    if _GUARD_INFO:
+        rec.update(_GUARD_INFO)
+        _GUARD_INFO = None
+    print(json.dumps(rec), flush=True)
 
 
 class _Uniquifier:
@@ -86,24 +125,29 @@ class _Uniquifier:
 _UNIQ = _Uniquifier()
 _RTT = 0.0
 
-# Drain cost by leaf count: the drain is one serial tunnel round-trip
-# PER LEAF of the drained structure (see bench.measure_rtt), so each
-# distinct structure's sync cost is measured against the real thing once
-# and cached.  Subtracting only the one-leaf _RTT would bill (leaves-1)
-# round-trips per drain as execution time — and in the generation
-# calibrations (which drain a 3-leaf batch per chunk) the error flips
-# direction: inflated gen_time gets SUBTRACTED, overstating throughput.
-_SYNC_BY_LEAVES: dict = {}
+# Drain cost by output structure: the drain is one serial tunnel
+# round-trip PER LEAF of the drained structure (see bench.measure_rtt),
+# so each distinct structure's sync cost is measured against the real
+# thing once and cached.  Subtracting only the one-leaf _RTT would bill
+# (leaves-1) round-trips per drain as execution time — and in the
+# generation calibrations (which drain a 3-leaf batch per chunk) the
+# error flips direction: inflated gen_time gets SUBTRACTED, overstating
+# throughput.  Keyed on the full structure identity (treedef + leaf
+# shapes/dtypes), not leaf count alone: two same-leaf-count outputs
+# (a replicated tail vs a sharded grid) must not share one cached value.
+_SYNC_BY_STRUCT: dict = {}
 
 
 def _sync_cost(template) -> float:
     """Measured drain cost of this (already-computed) structure, floored
-    at one round-trip; cached per leaf count."""
+    at one round-trip; cached per structure identity."""
     import jax
-    n = len(jax.tree_util.tree_leaves(template))
-    if n not in _SYNC_BY_LEAVES:
-        _SYNC_BY_LEAVES[n] = max(measure_rtt(template=template), _RTT)
-    return _SYNC_BY_LEAVES[n]
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    key = (str(treedef),
+           tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+    if key not in _SYNC_BY_STRUCT:
+        _SYNC_BY_STRUCT[key] = max(measure_rtt(template=template), _RTT)
+    return _SYNC_BY_STRUCT[key]
 
 
 def _timed_passes(run_pass, sync: float | None = None,
@@ -119,12 +163,17 @@ def _timed_passes(run_pass, sync: float | None = None,
     median instead — a small-scale smoke must understate, never emit a
     floored-to-1ns artifact (a 0.01-scale CPU run once printed 208T
     dp/s for config 3 exactly this way)."""
+    global _GUARD_INFO
     sub = _RTT if sync is None else sync
     times = []
     raw = []
     wall = 0.0
     while (wall < MIN_WALL_S or len(times) < MIN_PASSES) \
             and len(times) < MAX_PASSES:
+        if times and not _fits(raw[-1]):
+            _note("deadline: stopping after %d passes (%.0fs left)"
+                  % (len(times), _deadline_left()))
+            break
         t0 = time.perf_counter()
         run_pass()
         dt = time.perf_counter() - t0
@@ -137,6 +186,10 @@ def _timed_passes(run_pass, sync: float | None = None,
         if implied_bw > 3.5e12:                 # no chip streams faster
             _note("sync-unresolvable pass (%.2e B/s implied): "
                   "reporting the raw unsubtracted median" % implied_bw)
+            # both medians ride the emitted record for offline audit
+            _GUARD_INFO = {"sync_unresolvable": True,
+                           "raw_median_s": round(_median(raw), 6),
+                           "subtracted_median_s": round(per, 6)}
             per = _median(raw)
     return per, len(times)
 
@@ -151,6 +204,16 @@ def _chunk_gen(s, n, base_col):
     val = 100.0 + (h % 1_000).astype(jnp.float64) * 0.05
     mask = jnp.ones((s, n), dtype=bool)
     return ts, val, mask
+
+
+def _queue_sync(acc) -> None:
+    """Force the execution queue with ONE scalar fetch (~one tunnel
+    round-trip) — jax.block_until_ready is a no-op on axon (bench.py
+    module docstring), so a host fetch is the only real sync."""
+    import jax
+    import numpy as np
+    leaf = jax.tree_util.tree_leaves(acc.state)[0]
+    np.asarray(leaf.ravel()[0])
 
 
 _GEN = None
@@ -272,23 +335,31 @@ def config3(scale: float, n_dev: int) -> None:
 
 def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
                  sketch: bool = False):
-    """Generate+accumulate `chunks` chunks starting at column base0;
-    returns (elapsed_minus_gen, finish outputs).  Every chunk base is
-    unique (caller advances base0 per pass); generation is calibrated with
-    its own drains over a disjoint base range."""
+    """Generate+accumulate up to `chunks` chunks starting at column
+    base0; returns (elapsed_minus_gen, finish outputs, chunks_done).
+    Every chunk base is unique (caller advances base0 per pass);
+    generation is calibrated with its own drains over a disjoint base
+    range.  Both loops check the cooperative deadline BETWEEN chunks:
+    a slow chip folds fewer chunks and the caller reports the partial
+    point count honestly — it is never SIGKILLed mid-dispatch."""
     from opentsdb_tpu.ops.streaming import StreamAccumulator, lanes_for
 
     gen = _gen_fn()
 
     # Calibrate generation cost alone (disjoint bases; drained per chunk).
+    # The per-chunk gen cost also feeds the fold loop's deadline estimate.
     cal0 = base0 + chunks * n_chunk
     batch = None
     t0 = time.perf_counter()
+    cal_done = 0
     for k in range(chunks):
+        if cal_done and not _fits((time.perf_counter() - t0) / cal_done):
+            break
         batch = gen(s, n_chunk, cal0 + k * n_chunk)
         drain(batch)
+        cal_done += 1
     gen_wall = time.perf_counter() - t0
-    gen_time = max(gen_wall - _sync_cost(batch) * chunks, 0.0)
+    gen_per_chunk = max(gen_wall / cal_done - _sync_cost(batch), 0.0)
 
     # Window-sliced folds: each chunk's window range is host-known, so
     # the accumulator merges an O(S*wc) slice instead of the full [S, W]
@@ -300,16 +371,33 @@ def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
     acc = StreamAccumulator.create(s, wspec, wargs, sketch=sketch,
                                    lanes=lanes_for(finishes),
                                    window_slice=wslice)
+    # update() is async (returns at enqueue): without a sync the
+    # between-chunk clock reads enqueue time and a slow chip is only
+    # discovered inside the final — uninterruptible — drain.  With a
+    # deadline armed, one scalar fetch per chunk forces the queue so
+    # elapsed/done is true execution time; each fetch costs ~one RTT,
+    # measured and subtracted below.
+    pace = _DEADLINE is not None
     t0 = time.perf_counter()
+    done = 0
     for k in range(chunks):
+        if done and not _fits((time.perf_counter() - t0) / done):
+            _note("deadline: folding stopped at chunk %d/%d (%.0fs left)"
+                  % (done, chunks, _deadline_left()))
+            break
         w0 = (START + (base0 + k * n_chunk) * STEP_MS - first_ms) \
             // interval
         acc.update(*gen(s, n_chunk, base0 + k * n_chunk), w0=w0)
+        done += 1
+        if pace:
+            _queue_sync(acc)
     outs = [acc.finish(f) for f in finishes]
     drain(outs)
     elapsed = time.perf_counter() - t0 - _sync_cost(outs)
+    if pace:
+        elapsed -= _RTT * done
     assert acc.oob_count() == 0, "streaming slice dropped points"
-    return max(elapsed - gen_time, 1e-9), outs
+    return max(elapsed - gen_per_chunk * done, 1e-9), outs, done
 
 
 def config2(scale: float, n_dev: int) -> None:
@@ -330,22 +418,32 @@ def config2(scale: float, n_dev: int) -> None:
         fixed = FixedWindows.for_range(pass_start, pass_start + span,
                                        10_000)
         wspec, wargs = fixed.split()
-        secs, _ = _stream_pass(s, n_chunk, chunks, wspec, wargs,
-                               ["sum", "min", "max", "count"], base0)
-        return secs
+        secs, _, done = _stream_pass(s, n_chunk, chunks, wspec, wargs,
+                                     ["sum", "min", "max", "count"], base0)
+        return secs, s * n_chunk * done
 
     one_pass()  # compile (wspec is shape-stable across passes)
-    times = []
+    passes = []     # (secs, points actually folded) — may be partial
     wall = 0.0
-    while (wall < MIN_WALL_S or len(times) < MIN_PASSES) \
-            and len(times) < 8:
-        secs = one_pass()
-        times.append(secs)
+    t_loop = time.perf_counter()
+    while (wall < MIN_WALL_S or len(passes) < MIN_PASSES) \
+            and len(passes) < 8:
+        if passes and not _fits((time.perf_counter() - t_loop)
+                                / len(passes)):
+            break
+        secs, pts = one_pass()
+        passes.append((secs, pts))
         wall += secs
-    _note("config 2: %d passes, median %.3fs" % (len(times),
-                                                 _median(times)))
-    _emit(2, "100M pts multi-agg 10s downsample (streamed)",
-          points, _median(times), n_dev)
+    ranked = sorted(passes, key=lambda p: p[0] / p[1])
+    secs_med, pts_med = ranked[len(ranked) // 2]   # median per-point time
+    partial = pts_med < points
+    _note("config 2: %d passes, median %.3fs over %d pts%s"
+          % (len(passes), secs_med, pts_med,
+             " (deadline-partial)" if partial else ""))
+    _emit(2, "100M pts multi-agg 10s downsample (streamed)%s"
+          % (" [partial: %d of %d pts before the deadline]"
+             % (pts_med, points) if partial else ""),
+          pts_med, secs_med, n_dev)
 
 
 def config4(scale: float, n_dev: int) -> None:
@@ -374,20 +472,35 @@ def config4(scale: float, n_dev: int) -> None:
         fixed = FixedWindows.for_range(pass_start, pass_start + span,
                                        60_000)
         wspec, wargs = fixed.split()
-        secs, outs = _stream_pass(s, n_chunk, chunks, wspec, wargs,
-                                  ["avg"], base0)
+        secs, outs, done = _stream_pass(s, n_chunk, chunks, wspec, wargs,
+                                        ["avg"], base0)
         t0 = time.perf_counter()
         wts, v, m = outs[0]
         tail = run_grid_tail(spec, wts, v, m, gid, 1)
         drain(tail)
-        return secs + max(time.perf_counter() - t0 - _sync_cost(tail), 0.0)
+        tail_s = max(time.perf_counter() - t0 - _sync_cost(tail), 0.0)
+        return secs + tail_s, s * n_chunk * done
 
     one_pass()  # compile
-    times = [one_pass() for _ in range(MIN_PASSES)]
-    _note("config 4: %d passes, median %.3fs" % (len(times),
-                                                 _median(times)))
-    _emit(4, "rate+p99 over 500M pts (streamed grid + percentile tail)",
-          points, _median(times), n_dev)
+    t1 = time.perf_counter()
+    passes = [one_pass()]
+    last_wall = time.perf_counter() - t1
+    for _ in range(MIN_PASSES - 1):
+        if not _fits(last_wall):
+            break
+        t1 = time.perf_counter()
+        passes.append(one_pass())
+        last_wall = time.perf_counter() - t1
+    ranked = sorted(passes, key=lambda p: p[0] / p[1])
+    secs_med, pts_med = ranked[len(ranked) // 2]
+    partial = pts_med < points
+    _note("config 4: %d passes, median %.3fs over %d pts%s"
+          % (len(passes), secs_med, pts_med,
+             " (deadline-partial)" if partial else ""))
+    _emit(4, "rate+p99 over 500M pts (streamed grid + percentile tail)%s"
+          % (" [partial: %d of %d pts before the deadline]"
+             % (pts_med, points) if partial else ""),
+          pts_med, secs_med, n_dev)
 
 
 def config5(scale: float, n_dev: int) -> None:
@@ -406,11 +519,15 @@ def config5(scale: float, n_dev: int) -> None:
     def gen_calibration(base0):
         batch = None
         t0 = time.perf_counter()
+        done = 0
         for k in range(chunks):
+            if done and not _fits((time.perf_counter() - t0) / done):
+                break
             batch = gen(s, n_chunk, base0 + k * n_chunk)
             drain(batch)
+            done += 1
         wall = time.perf_counter() - t0
-        return max(wall - _sync_cost(batch) * chunks, 0.0)
+        return max(wall / done - _sync_cost(batch), 0.0)   # per chunk
 
     # Each time chunk's 1m windows are disjoint from the next chunk's, so
     # rollup rows (sum/count/min/max lanes) emit per chunk — the write-side
@@ -435,18 +552,41 @@ def config5(scale: float, n_dev: int) -> None:
 
     def one_pass():
         base0 = _UNIQ.next(1 << 28)
-        gen_time = gen_calibration(base0 + chunks * n_chunk)
+        gen_per_chunk = gen_calibration(base0 + chunks * n_chunk)
         t0 = time.perf_counter()
+        done = 0
         for k in range(chunks):
+            # one_chunk drains per chunk, so elapsed/done is real
+            # execution time and the deadline check is meaningful
+            if done and not _fits((time.perf_counter() - t0) / done):
+                _note("deadline: rollup stopped at chunk %d/%d"
+                      % (done, chunks))
+                break
             one_chunk(k, base0)
-        return max(time.perf_counter() - t0 - gen_time
-                   - chunk_sync * chunks, 1e-9)
+            done += 1
+        secs = max(time.perf_counter() - t0
+                   - (gen_per_chunk + chunk_sync) * done, 1e-9)
+        return secs, s * n_chunk * done
 
-    times = [one_pass() for _ in range(MIN_PASSES)]
-    _note("config 5: %d passes, median %.3fs" % (len(times),
-                                                 _median(times)))
-    _emit(5, "1B pts -> 1m rollup lanes (time-chunked)", points,
-          _median(times), n_dev)
+    t1 = time.perf_counter()
+    passes = [one_pass()]
+    last_wall = time.perf_counter() - t1
+    for _ in range(MIN_PASSES - 1):
+        if not _fits(last_wall):
+            break
+        t1 = time.perf_counter()
+        passes.append(one_pass())
+        last_wall = time.perf_counter() - t1
+    ranked = sorted(passes, key=lambda p: p[0] / p[1])
+    secs_med, pts_med = ranked[len(ranked) // 2]
+    partial = pts_med < points
+    _note("config 5: %d passes, median %.3fs over %d pts%s"
+          % (len(passes), secs_med, pts_med,
+             " (deadline-partial)" if partial else ""))
+    _emit(5, "1B pts -> 1m rollup lanes (time-chunked)%s"
+          % (" [partial: %d of %d pts before the deadline]"
+             % (pts_med, points) if partial else ""),
+          pts_med, secs_med, n_dev)
 
 
 def config6(scale: float, n_dev: int) -> None:
@@ -528,15 +668,24 @@ def config7(scale: float, n_dev: int) -> None:
     tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
     t0 = time.perf_counter()
     rng = np.random.default_rng(0)
+    n_series = 0
     for i in range(s):
+        # host-side ingest can dominate a slow box: a deadline cut here
+        # still yields an honest row — the label carries the real
+        # in-store point count and vs_baseline scales with it
+        if i and not _fits((time.perf_counter() - t0) / i):
+            _note("deadline: ingest stopped at series %d/%d" % (i, s))
+            break
         ts = (START + np.arange(per, dtype=np.int64) * STEP_MS
               + int(rng.integers(0, 4000)))
         sk = tsdb._series_key("lat.m", {"host": "h%04d" % i,
                                         "dc": "d%d" % (i % 16)},
                               create=True)
         tsdb.store.add_batch(sk, ts, rng.normal(100, 25, per), False)
+        n_series += 1
+    in_store = n_series * per
     _note("config 7: ingested %d pts in %.1fs"
-          % (s * per, time.perf_counter() - t0))
+          % (in_store, time.perf_counter() - t0))
 
     end_s = (START + per * STEP_MS) // 1000 + 10
 
@@ -553,21 +702,29 @@ def config7(scale: float, n_dev: int) -> None:
     # beyond-memory number).
     tsdb.start_maintenance()
     try:
+        t1 = time.perf_counter()
         run_query()  # compile + queue the cache build
-        deadline = time.time() + 60
+        first_query_s = time.perf_counter() - t1
+        deadline = time.time() + min(60.0, max(_deadline_left() / 2, 5.0))
         while (tsdb.device_cache is not None and len(tsdb.device_cache) == 0
-               and s * per <= tsdb.device_cache.build_max_points
+               and in_store <= tsdb.device_cache.build_max_points
                and time.time() < deadline):
             time.sleep(0.5)
         cached = (tsdb.device_cache is not None
                   and len(tsdb.device_cache) > 0)
-        if cached:
+        if cached and _fits(first_query_s):
             run_query()     # compile the cached-batch shape untimed
         lats = []
+        last = first_query_s
         for _ in range(MIN_PASSES):
+            if lats and not _fits(last):
+                _note("deadline: stopping after %d latency passes"
+                      % len(lats))
+                break
             t0 = time.perf_counter()
             run_query()
-            lats.append(time.perf_counter() - t0)
+            last = time.perf_counter() - t0
+            lats.append(last)
     finally:
         if tsdb.maintenance is not None:
             tsdb.maintenance.stop(final_flush=False)
@@ -576,25 +733,56 @@ def config7(scale: float, n_dev: int) -> None:
     _note("config 7: latencies %s (device cache %s)"
           % ([round(x, 3) for x in lats],
              "warm" if cached else "not used"))
+    # north star: 1B pts < 2s on 8 chips = a 16 chip-second budget PER
+    # BILLION points; scale the budget to what is actually in the store
+    # so smoke runs and deadline-partial ingests stay honest
+    budget_s = 16.0 * in_store / 1e9
     print(json.dumps({
         "metric": "config 7: p50 /api/query latency, %d pts in-store, "
-                  "%s; single-chip-equivalent target 16s"
-                  % (s * per,
+                  "%s; single-chip-equivalent budget %.2fs"
+                  % (in_store,
                      "served from the device-resident series cache "
                      "(production steady state: maintenance thread "
                      "pinned the metric in HBM after the first streamed "
                      "pass)" if cached else
                      "streamed via chunked store reads (beyond the "
                      "device cache budget; includes host packing + "
-                     "host->device transfer)"),
+                     "host->device transfer)", budget_s),
         "value": round(p50, 3),
         "unit": "seconds p50 latency",
-        "vs_baseline": round(16.0 / max(p50, 1e-9) / n_dev, 4),
+        "vs_baseline": round(budget_s / max(p50, 1e-9) / n_dev, 4),
     }), flush=True)
 
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7}
+
+
+def _arm_watchdog(grace_after_deadline_s: float) -> None:
+    """Last resort behind the cooperative checks: if a single dispatch
+    hangs past the deadline + grace (a truly wedged tunnel — the
+    cooperative checks can't interrupt an in-flight drain), emit an
+    error row for the current config and exit 0 so the session's
+    artifact stays parseable.  The outer subprocess SIGKILL sits behind
+    BOTH layers and should never fire on a merely-slow config."""
+    if _DEADLINE is None:
+        return
+
+    def fire():
+        while True:
+            left = _deadline_left() + grace_after_deadline_s
+            if left <= 0:
+                break
+            time.sleep(min(left, 10.0))
+        print(json.dumps({
+            "metric": "config %d" % _CURRENT_CONFIG,
+            "error": "in-process watchdog: dispatch unresponsive %.0fs "
+                     "past the cooperative deadline (tunnel wedged?)"
+                     % grace_after_deadline_s,
+        }), flush=True)
+        sys.stdout.flush()
+        os._exit(0)
+    threading.Thread(target=fire, daemon=True).start()
 
 
 def main() -> None:
@@ -603,17 +791,28 @@ def main() -> None:
                     help="run one config (default: all)")
     ap.add_argument("--scale", type=float, default=1.0,
                     help="shrink factor for smoke runs (e.g. 0.01)")
+    ap.add_argument("--deadline", type=float, default=0.0,
+                    help="cooperative per-config budget in seconds: each "
+                         "config finalizes a partial-but-honest row "
+                         "instead of overrunning (0 = unlimited)")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (e.g. cpu) — the env var "
                          "alone is overridden by the ambient sitecustomize, "
                          "so CPU smoke runs need the in-process update")
     args = ap.parse_args()
 
+    global _RTT, _DEADLINE, _CURRENT_CONFIG
+    if args.deadline > 0:
+        # covers backend init too: jax.devices() on a wedged tunnel
+        # hangs forever and would otherwise die JSON-less to the outer
+        # timeout
+        _DEADLINE = time.monotonic() + args.deadline
+        _arm_watchdog(300.0)
+
     import opentsdb_tpu.ops  # noqa: F401  (jax x64)
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    global _RTT
     n_dev = len(jax.devices())
     _note("devices: %d (%s)" % (n_dev, jax.devices()[0].platform))
     _RTT = measure_rtt()
@@ -622,6 +821,9 @@ def main() -> None:
     targets = [args.config] if args.config else sorted(CONFIGS)
     for c in targets:
         _note("running config %d" % c)
+        _CURRENT_CONFIG = c
+        if args.deadline > 0:
+            _DEADLINE = time.monotonic() + args.deadline
         CONFIGS[c](args.scale, n_dev)
 
 

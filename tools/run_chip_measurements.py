@@ -1,4 +1,4 @@
-"""One-shot real-chip measurement session for round 4 artifacts.
+"""One-shot real-chip measurement session for round 5 artifacts.
 
 Runs, in order, each as a separate subprocess (the axon tunnel is
 exclusive and can wedge if a JAX process dies mid-dispatch — isolating
@@ -10,9 +10,16 @@ stages means a crash loses one stage, not the session):
   2. tools/stage_bench.py     — per-stage attribution of one dispatch
   3. bench.py                 — headline number with the winning defaults
   4. bench_configs.py         — BASELINE configs 1-7 at full scale,
-                                crash-isolated one subprocess per config
+                                crash-isolated one subprocess per config,
+                                each under a COOPERATIVE in-process
+                                deadline (--deadline) that finalizes a
+                                partial-but-honest row; the subprocess
+                                timeout sits 900s behind it as a last
+                                resort (its SIGKILL mid-dispatch is what
+                                wedged the tunnel in both r4 sessions)
+  5. tools/hist_bench.py      — histogram device-path throughput row
 
-Results append to BENCH_CONFIGS_r04.json (JSON lines + a trailing
+Results append to BENCH_CONFIGS_r05.json (JSON lines + a trailing
 metadata line).  Run: python tools/run_chip_measurements.py
 """
 
@@ -25,7 +32,12 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "BENCH_CONFIGS_r04.json")
+OUT = os.path.join(REPO, "BENCH_CONFIGS_r05.json")
+
+# Cooperative per-config budget; the subprocess SIGKILL fires 900s later
+# (watchdog grace is 300s, so a healthy-but-slow config always finalizes
+# its own row first).
+CONFIG_DEADLINE_S = 1500
 
 
 def run_stage(name: str, argv: list[str], timeout: int,
@@ -148,13 +160,18 @@ def main() -> None:
     # Isolated, a crash costs exactly one config (the worker restarts
     # between subprocesses).
     stages += [("bench_configs:%d" % c,
-                [sys.executable, "bench_configs.py", "--config", str(c)],
-                2400) for c in range(1, 8)]
+                [sys.executable, "bench_configs.py", "--config", str(c),
+                 "--deadline", str(CONFIG_DEADLINE_S)],
+                CONFIG_DEADLINE_S + 900) for c in range(1, 8)]
+    # histogram device-path throughput (VERDICT r4 #9: first chip number
+    # for the histogram query path)
+    stages += [("hist_bench", [sys.executable, "tools/hist_bench.py"],
+                1800)]
     # last (least critical): an XLA trace of the headline dispatch under
     # the crowned modes, for offline per-op attribution (untracked dir)
     stages += [("profile",
                 [sys.executable, "tools/profile_query.py",
-                 "--outdir", os.path.join(REPO, "PROFILE_r04"),
+                 "--outdir", os.path.join(REPO, "PROFILE_r05"),
                  "--passes", "2"], 1200)]
     winner_env: dict = {}
     def write_out() -> None:
@@ -180,9 +197,17 @@ def main() -> None:
             write_out()
             continue
         failed = False
+        # The crowned winner env was measured at the HEADLINE shape and
+        # feeds the stages that dispatch that shape (stage_bench, bench,
+        # profile).  The BASELINE configs span very different shapes and
+        # run under the shape-driven cost model's auto selection —
+        # globally-forced winners are exactly what broke config 1 in r4
+        # (hier cell blowup rc=1).
+        stage_env = {} if name.startswith("bench_configs") \
+            or name == "hist_bench" else winner_env
         try:
             lines, rc = run_stage(name, argv, timeout,
-                                  extra_env=winner_env)
+                                  extra_env=stage_env)
             failed = rc != 0
             stage_recs = []
             for ln in lines:
@@ -193,13 +218,25 @@ def main() -> None:
                 if "stage" in rec:
                     rec["label"] = rec.pop("stage")
                 rec["stage"] = name
-                if winner_env:
-                    rec["ab_overrides"] = dict(winner_env)
+                if stage_env:
+                    rec["ab_overrides"] = dict(stage_env)
                 results.append(rec)
                 stage_recs.append(rec)
             if name == "bench_prefix":
                 winner_env = pick_winners(stage_recs)
             if name == "stage_bench":
+                # persist the chip-derived cost-model constants so mode
+                # auto-selection (ops/costmodel.py) follows THIS chip
+                for rec in stage_recs:
+                    if rec.get("label") == "calibration" \
+                            and rec.get("costs_tpu"):
+                        with open(os.path.join(
+                                REPO, "BENCH_CALIBRATION.json"),
+                                "w") as fh:
+                            json.dump({"tpu": rec["costs_tpu"]}, fh,
+                                      indent=1)
+                        print("== wrote BENCH_CALIBRATION.json ==",
+                              file=sys.stderr, flush=True)
                 ratio = pick_stream_ratio(stage_recs)
                 if ratio is not None:
                     winner_env["TSDB_STREAM_SEGMENT_RATIO"] = ratio
