@@ -1,0 +1,209 @@
+"""Cross-host request serving: one /api/query, the whole cluster's data.
+
+Reference behavior being matched: a single TSD answers a query by
+fanning scanners out across every storage node that holds a salt bucket
+and aggregating the returned rows itself (SaltScanner — one scanner per
+bucket across RegionServers, /root/reference/src/core/SaltScanner.java:269;
+the TSD is the aggregation point).  The TPU-native equivalent keeps the
+same shape: the TSD that receives a query asks every peer TSD for the
+RAW matching series (aggregator "none", no downsample/rate — each peer
+runs its own planner over its own store and chips), folds the returned
+series together with its local ones into a scratch store, and runs the
+ORIGINAL query against that — so downsampling, rate, interpolation,
+group-by, and percentiles all execute once, locally, with exactly the
+single-host semantics the test suite pins.  DCN traffic is the raw
+matching points, as in the reference's scanner model.
+
+This is the REQUEST-DRIVEN serving path for data partitioned across
+independent TSD processes (each ingesting its own series).  It is
+complementary to the SPMD path (`tsd.network.distributed.*` +
+`jax.distributed.initialize`), where every process holds a shard of one
+logical store and executes lock-step collectives — that path has the
+higher throughput ceiling but needs all processes in one JAX runtime;
+this one needs only HTTP reachability.
+
+Config:
+  tsd.network.cluster.peers       comma-separated "host:port" of the
+                                  OTHER TSDs (empty = single-host serving)
+  tsd.network.cluster.timeout_ms  per-peer raw-series fetch timeout
+
+Loop prevention: fan-out requests carry the `X-TSDB-Cluster: fanout`
+header; a TSD answering one serves purely from its local store.
+A peer failure fails the query (the reference's scanner-error stance:
+a partial answer is worse than an error).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+
+LOG = logging.getLogger(__name__)
+
+CLUSTER_HEADER = "x-tsdb-cluster"
+
+
+def cluster_peers(config) -> list[str]:
+    raw = config.get_string("tsd.network.cluster.peers") or ""
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+def is_fanout_request(http_query) -> bool:
+    """True for requests issued by a peer's fan-out (serve locally)."""
+    return bool(http_query.request.headers.get(CLUSTER_HEADER))
+
+
+def _raw_query(ts_query: TSQuery) -> TSQuery:
+    """The per-series extraction query: same range/filters, NO
+    aggregation, downsampling, or rate — peers ship raw matching points
+    and every cross-series semantic runs once at the receiver."""
+    raw = TSQuery(start=ts_query.start, end=ts_query.end)
+    raw.ms_resolution = True
+    for i, sub in enumerate(ts_query.queries):
+        if not sub.metric:
+            # TSUIDs are per-process surrogate keys here (the reference's
+            # are cluster-global via the shared HBase uid table) — a
+            # tsuid doesn't name the same series on a peer
+            raise ValueError("cluster serving requires metric-named "
+                             "subqueries (tsuids are host-local)")
+        r = TSSubQuery(aggregator="none", metric=sub.metric, index=i)
+        r.filters = copy.deepcopy(sub.filters)
+        r.explicit_tags = sub.explicit_tags
+        raw.queries.append(r)
+    raw.validate()
+    return raw
+
+
+def _sub_json(raw: TSQuery, index: int) -> dict:
+    """One-subquery POST body for a peer (one request per subquery keeps
+    the result->subquery mapping trivial, like one scanner per bucket)."""
+    sub = raw.queries[index]
+    body = {
+        "start": raw.start,
+        "msResolution": True,
+        "queries": [{
+            "aggregator": "none",
+            "metric": sub.metric,
+            "explicitTags": sub.explicit_tags,
+            "filters": [f.to_json() for f in (sub.filters or [])],
+        }],
+    }
+    if raw.end:
+        body["end"] = raw.end
+    return body
+
+
+def _fetch_peer(peer: str, body: dict, timeout_s: float) -> list[dict]:
+    req = urllib.request.Request(
+        "http://%s/api/query" % peer,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-TSDB-Cluster": "fanout"},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _ingest_series(scratch, metric: str, tags: dict,
+                   dps_items) -> int:
+    """Fold one raw series into the scratch store; returns point count.
+    dps_items: iterable of (ts_ms int, value int|float)."""
+    pts = [(int(t), v) for t, v in dps_items
+           if not (isinstance(v, float) and v != v)]      # drop NaN fills
+    if not pts:
+        return 0
+    pts.sort()
+    ts = np.fromiter((t for t, _ in pts), np.int64, len(pts))
+    vals = np.fromiter((float(v) for _, v in pts), np.float64, len(pts))
+    is_int = np.fromiter(
+        (isinstance(v, int) or (isinstance(v, float) and v.is_integer()
+                                and abs(v) < 2 ** 53)
+         for _, v in pts), bool, len(pts))
+    key = scratch._series_key(metric, tags, create=True)
+    scratch.store.add_batch(key, ts, vals, is_int)
+    return len(pts)
+
+
+def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
+    """Fan the query's raw-series extraction across this host and every
+    peer, fold everything into a scratch store, run the ORIGINAL query
+    against it.  Returns the planner's QueryResult list (drop-in for
+    QueryRunner.run).  `exec_stats`, when given, receives the scratch
+    runner's execution telemetry plus cluster counters (the /api/stats/
+    query surface must not go dark for clustered queries)."""
+    from opentsdb_tpu.core import TSDB
+    from opentsdb_tpu.utils.config import Config
+
+    peers = cluster_peers(tsdb.config)
+    timeout_s = max(
+        tsdb.config.get_int("tsd.network.cluster.timeout_ms"), 1000) / 1e3
+    raw = _raw_query(ts_query)
+
+    scratch = TSDB(Config({
+        "tsd.core.auto_create_metrics": True,
+        # serving knobs only — the scratch is a per-query aggregation
+        # buffer, not a daemon
+        "tsd.query.device_cache.enable": "false",
+    }))
+    total = 0
+
+    # peer fetches submit FIRST so they overlap the local extraction
+    # below (the two are independent; serializing them would make the
+    # extraction phase local_scan + max(peer_fetch) instead of the max)
+    jobs = [(peer, i) for peer in peers for i in range(len(raw.queries))]
+    pool = futures = None
+    if jobs:
+        # no context manager: a peer failure must return its error NOW,
+        # not after every straggling in-flight fetch drains its timeout
+        # (shutdown(wait=False, cancel_futures=True) drops the queued
+        # ones; already-running urllib calls finish in the background)
+        pool = ThreadPoolExecutor(max_workers=min(len(jobs), 16))
+        futures = {pool.submit(_fetch_peer, peer,
+                               _sub_json(raw, i), timeout_s):
+                   (peer, i) for peer, i in jobs}
+
+    # local extraction: straight off this host's store/planner (objects,
+    # no JSON round-trip), concurrent with the in-flight peer fetches
+    try:
+        for qr in tsdb.new_query_runner().run(raw):
+            total += _ingest_series(scratch, qr.metric, qr.tags, qr.dps)
+        if futures:
+            for fut, (peer, i) in futures.items():
+                try:
+                    payload = fut.result()
+                except Exception as e:
+                    raise RuntimeError(
+                        "cluster peer %s failed the raw-series fetch: %s"
+                        % (peer, e)) from e
+                for item in payload:
+                    if "metric" not in item:
+                        continue        # statsSummary etc.
+                    total += _ingest_series(
+                        scratch, item["metric"], item.get("tags") or {},
+                        ((int(t), v)
+                         for t, v in (item.get("dps") or {}).items()))
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+    LOG.debug("cluster fan-out folded %d raw points from %d peers",
+              total, len(peers))
+    runner = scratch.new_query_runner()
+    out = runner.run(ts_query)
+    for qr in out:
+        # the scratch store mints its own surrogate uids, so its tsuids
+        # name nothing outside this query — without the reference's
+        # cluster-global uid table (HBase tsdb-uid) there is no honest
+        # cluster-wide tsuid to return
+        qr.tsuids = []
+    if exec_stats is not None:
+        exec_stats.update(runner.exec_stats)
+        exec_stats["clusterPeers"] = len(peers)
+        exec_stats["clusterRawPoints"] = total
+    return out

@@ -21,6 +21,10 @@ DEFAULTS: dict[str, str] = {
     "tsd.network.distributed.coordinator": "",
     "tsd.network.distributed.num_processes": "0",
     "tsd.network.distributed.process_id": "",
+    # request-driven cluster serving (tsd/cluster.py): other TSDs whose
+    # stores this one fans /api/query out to (SaltScanner role)
+    "tsd.network.cluster.peers": "",
+    "tsd.network.cluster.timeout_ms": "15000",
     "tsd.network.port": "",
     "tsd.network.worker_threads": "",
     "tsd.network.async_io": "true",

@@ -1,0 +1,204 @@
+"""Cross-host request serving (VERDICT r4 #6, tsd/cluster.py): one
+/api/query answered from EVERY TSD's store, pinned to the answer a
+single TSD holding all the data gives.
+
+Reference capability matched: cluster-wide scan fan-out with the
+receiving TSD as the aggregation point
+(/root/reference/src/core/SaltScanner.java:269).
+
+Topology under test: a REAL TSDServer (peer) on a live socket holds
+half the series; the receiving TSD holds the other half and lists the
+peer in tsd.network.cluster.peers.  Queries go through the receiver's
+HTTP surface (RpcManager.handle_http — the same path the server
+drives), which fans the raw-series extraction out over real HTTP.
+"""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.tsd.server import TSDServer
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+HOSTS = ["h%02d" % i for i in range(8)]
+
+
+def _fill(tsdb, hosts):
+    """Deterministic per-host series: ints and floats, shared slots so
+    group interpolation and downsampling cross host boundaries.  The
+    series index derives from the host NAME so any subset of hosts
+    generates the same data the full-oracle fixture holds for them."""
+    for host in hosts:
+        hi = int(host[1:])
+        for k in range(40):
+            ts = BASE + k * 15 + (hi % 3)       # staggered timestamps
+            val = (k + 1) * (hi + 1) if (hi + k) % 3 else (k + 0.25)
+            tsdb.add_point("clu.m", ts, val,
+                           {"host": host, "dc": "d%d" % (hi % 2)})
+        tsdb.add_point("clu.other", BASE + hi, float(hi), {"host": host})
+
+
+@pytest.fixture(scope="module")
+def peer_server():
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    _fill(tsdb, HOSTS[4:])                      # peer holds the back half
+    srv = TSDServer(tsdb, port=0, bind="127.0.0.1", worker_threads=2)
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        async def main():
+            await srv.start()
+            holder["port"] = srv._server.sockets[0].getsockname()[1]
+            holder["loop"] = asyncio.get_running_loop()
+            started.set()
+            await srv.serve_forever()
+        asyncio.run(main())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    srv.test_port = holder["port"]
+    yield srv
+    holder["loop"].call_soon_threadsafe(srv._shutdown_event.set)
+    t.join(5)
+
+
+@pytest.fixture(scope="module")
+def receiver(peer_server):
+    tsdb = TSDB(Config({
+        "tsd.core.auto_create_metrics": True,
+        "tsd.network.cluster.peers": "127.0.0.1:%d" % peer_server.test_port,
+    }))
+    _fill(tsdb, HOSTS[:4])                      # receiver holds the front
+    return RpcManager(tsdb)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """A single TSD holding ALL the data — the answer to pin against."""
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True}))
+    _fill(tsdb, HOSTS)
+    return RpcManager(tsdb)
+
+
+def _assert_dps_equal(got: dict, want: dict, ctx) -> None:
+    """Same timestamps, values equal to 1e-9 relative — the scratch
+    store folds series in a different row order than the oracle, so
+    interpolated sums may drift in the last ulp (the suite-wide
+    tolerance for cross-order float reductions)."""
+    assert set(got) == set(want), ctx
+    for t in want:
+        g, w = got[t], want[t]
+        if isinstance(g, (int, float)) and isinstance(w, (int, float)):
+            assert g == pytest.approx(w, rel=1e-9, abs=1e-9), (ctx, t)
+        else:
+            assert g == w, (ctx, t)
+
+
+def ask(manager, uri, headers=None):
+    q = manager.handle_http(HttpRequest(method="GET", uri=uri,
+                                        headers=headers or {}))
+    body = q.response.body
+    text = body.decode() if isinstance(body, (bytes, bytearray)) else body
+    return q.response.status, json.loads(text)
+
+
+QUERIES = [
+    "sum:clu.m",
+    "sum:clu.m{dc=*}",
+    "avg:1m-avg:clu.m",
+    "max:clu.m{host=*}",
+    "sum:rate:clu.m{dc=d1}",
+    "p95:clu.m",
+    "none:clu.m{host=literal_or(h01|h05)}",
+    "sum:1m-sum-zero:clu.m",
+]
+
+
+class TestClusterMatchesSingleHost:
+    @pytest.mark.parametrize("m", QUERIES)
+    def test_pinned_to_oracle(self, receiver, oracle, m):
+        uri = ("/api/query?start=%d&end=%d&m=%s"
+               % (BASE - 60, BASE + 1200, m.replace("{", "%7B")
+                  .replace("}", "%7D").replace("|", "%7C")))
+        st_c, got = ask(receiver, uri)
+        st_o, want = ask(oracle, uri)
+        assert st_o == 200 and st_c == 200, (st_c, got)
+        key = lambda r: (r["metric"], tuple(sorted(r["tags"].items())))
+        got_by, want_by = ({key(r): r for r in res}
+                           for res in (got, want))
+        assert set(got_by) == set(want_by)
+        for k in want_by:
+            _assert_dps_equal(got_by[k]["dps"], want_by[k]["dps"], (m, k))
+            assert got_by[k]["aggregateTags"] == \
+                want_by[k]["aggregateTags"], (m, k)
+
+    def test_multi_subquery(self, receiver, oracle):
+        uri = ("/api/query?start=%d&m=sum:clu.m&m=max:clu.other"
+               % (BASE - 60))
+        _, got = ask(receiver, uri)
+        _, want = ask(oracle, uri)
+        assert len(got) == len(want) == 2
+        for g, w in zip(got, want):
+            _assert_dps_equal(g["dps"], w["dps"], "multi")
+
+
+class TestClusterMechanics:
+    def test_fanout_header_serves_locally(self, receiver):
+        """The loop guard: a peer's fan-out request must answer from the
+        local store only (no recursion into the cluster)."""
+        uri = "/api/query?start=%d&m=none:clu.m" % (BASE - 60)
+        _, local = ask(receiver, uri, headers={"x-tsdb-cluster": "fanout"})
+        _, clustered = ask(receiver, uri)
+        # receiver holds 4 of the 8 series; the clustered answer has all
+        assert len(local) == 4
+        assert len(clustered) == 8
+
+    def test_peer_failure_fails_the_query(self):
+        """SaltScanner stance: a dead peer is an error, not a silently
+        partial answer."""
+        tsdb = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.network.cluster.peers": "127.0.0.1:1",   # nothing there
+            "tsd.network.cluster.timeout_ms": "1500",
+        }))
+        tsdb.add_point("clu.m", BASE, 1.0, {"host": "x"})
+        mgr = RpcManager(tsdb)
+        q = mgr.handle_http(HttpRequest(
+            method="GET",
+            uri="/api/query?start=%d&m=sum:clu.m" % (BASE - 60)))
+        assert q.response.status >= 500
+
+    def test_tsuid_queries_serve_locally(self, receiver):
+        """TSUIDs are host-local surrogate keys (the reference's are
+        cluster-global via the shared HBase uid table), so a tsuid
+        subquery must NOT fan out — it serves from the local store
+        exactly as it did before peers were configured."""
+        # fetch a real local tsuid first (via the fan-out header so the
+        # answer is the LOCAL store's view — clustered outputs carry no
+        # tsuids, scratch uids name nothing outside their query)
+        st, out = ask(receiver,
+                      "/api/query?start=%d&m=none:clu.m&show_tsuids=true"
+                      % (BASE - 60),
+                      headers={"x-tsdb-cluster": "fanout"})
+        assert st == 200 and out[0].get("tsuids")
+        tsuid = out[0]["tsuids"][0]
+        q = receiver.handle_http(HttpRequest(
+            method="POST", uri="/api/query",
+            body=json.dumps({
+                "start": BASE - 60,
+                "queries": [{"aggregator": "sum", "tsuids": [tsuid]}],
+            }).encode(),
+            headers={"content-type": "application/json"}))
+        assert q.response.status == 200
+        body = q.response.body
+        res = json.loads(body.decode() if isinstance(body, bytes)
+                         else body)
+        assert res and res[0]["dps"]          # local series answered
