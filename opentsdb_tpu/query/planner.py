@@ -752,9 +752,17 @@ class QueryRunner:
                                                               int(t[0]))
                     tmax = int(t[-1]) if tmax is None else max(tmax,
                                                                int(t[-1]))
+            if tmin is None:
+                # a pointless chunk folds nothing: skip it — and, when
+                # the accumulator doesn't exist yet, WITHOUT creating
+                # it, so the window_slice sizing below sees the first
+                # chunk that actually has points (ADVICE r4: an empty
+                # first chunk used to pin window_slice=None and every
+                # later chunk paid the full-grid O(S*W) fold)
+                continue
             if acc is None:
                 wslice = None
-                if use_slice and tmin is not None:
+                if use_slice:
                     # 2x the first chunk's span: headroom for later
                     # chunks (series advance on their own cursors, so
                     # spans vary); a chunk that still overflows just
