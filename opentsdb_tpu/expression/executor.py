@@ -122,11 +122,16 @@ class PojoQuery:
 
 
 class QueryExecutor:
-    """Runs a PojoQuery: metrics -> variable matrices -> expressions."""
+    """Runs a PojoQuery: metrics -> variable matrices -> expressions.
 
-    def __init__(self, tsdb, pojo: PojoQuery):
+    `http_query` (when serving over HTTP) lets the metric extraction go
+    through the cluster front door — fan-out loop prevention reads the
+    request's X-TSDB-Cluster header."""
+
+    def __init__(self, tsdb, pojo: PojoQuery, http_query=None):
         self.tsdb = tsdb
         self.pojo = pojo
+        self.http_query = http_query
 
     def _build_ts_query(self) -> TSQuery:
         q = TSQuery(start=self.pojo.start, end=self.pojo.end)
@@ -148,10 +153,10 @@ class QueryExecutor:
         return q
 
     def execute(self) -> dict:
+        from opentsdb_tpu.tsd.cluster import serve_query
         pojo = self.pojo
         ts_query = self._build_ts_query()
         ts_query.validate()
-        runner = self.tsdb.new_query_runner()
 
         # metric id -> list[SeriesResult] (one per group-by bucket)
         results: dict[str, list[SeriesResult]] = {
@@ -169,7 +174,7 @@ class QueryExecutor:
                 fills[m["id"]] = float(fp.get("value", 0.0))
             else:
                 fills[m["id"]] = np.nan
-        for qr in runner.run(ts_query):
+        for qr in serve_query(self.tsdb, ts_query, self.http_query):
             results[id_by_index[qr.index]].append(
                 SeriesResult.from_query_result(qr))
 
@@ -405,5 +410,5 @@ def handle_exp_query(tsdb, query) -> None:
     from opentsdb_tpu.tsd.rpcs import allowed_methods
     allowed_methods(query, "POST")
     pojo = PojoQuery.parse(query.json_body())
-    executor = QueryExecutor(tsdb, pojo)
+    executor = QueryExecutor(tsdb, pojo, http_query=query)
     query.send_reply(executor.execute())

@@ -473,11 +473,13 @@ def handle_gexp_query(tsdb, query) -> None:
             seen[mq] = sub.index
             ts_query.queries.append(sub)
     ts_query.validate()
-    runner = tsdb.new_query_runner()
+    # the cluster front door: fans to peers when configured (the gexp
+    # functions then see the whole cluster's series), local otherwise
+    from opentsdb_tpu.tsd.cluster import serve_query
 
     metric_results: dict[str, list[SeriesResult]] = {m: [] for m in seen}
     by_index = {i: m for m, i in seen.items()}
-    for qr in runner.run(ts_query):
+    for qr in serve_query(tsdb, ts_query, query):
         metric_results[by_index[qr.index]].append(
             SeriesResult.from_query_result(qr))
 

@@ -122,7 +122,10 @@ def cmd_query(args) -> int:
                 queries=[parse_m_subquery(m) for m in args.queries])
     q.validate()
     from opentsdb_tpu.utils import format_ascii_point
-    for result in tsdb.new_query_runner().run(q):
+    # fans out when the CLI's config names cluster peers (same front
+    # door as the daemon's /api/query)
+    from opentsdb_tpu.tsd.cluster import serve_query
+    for result in serve_query(tsdb, q):
         for ts, value in result.dps:
             print(format_ascii_point(result.metric, ts, value, result.tags))
     return 0

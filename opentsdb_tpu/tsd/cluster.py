@@ -131,6 +131,25 @@ def _ingest_series(scratch, metric: str, tags: dict,
     return len(pts)
 
 
+def serve_query(tsdb, ts_query: TSQuery, http_query=None,
+                exec_stats: dict | None = None):
+    """The single front door for every query-shaped endpoint (/api/query,
+    /api/query/exp metric extraction, /api/query/gexp): clustered when
+    peers are configured and the request is eligible, local otherwise.
+    Eligibility: not a peer's own fan-out (loop guard), not a delete,
+    and every subquery metric-named (tsuids are host-local)."""
+    if cluster_peers(tsdb.config) \
+            and (http_query is None or not is_fanout_request(http_query)) \
+            and not getattr(ts_query, "delete", False) \
+            and all(sub.metric for sub in ts_query.queries):
+        return run_clustered(tsdb, ts_query, exec_stats=exec_stats)
+    runner = tsdb.new_query_runner()
+    out = runner.run(ts_query)
+    if exec_stats is not None:
+        exec_stats.update(runner.exec_stats)
+    return out
+
+
 def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     """Fan the query's raw-series extraction across this host and every
     peer, fold everything into a scratch store, run the ORIGINAL query

@@ -43,7 +43,12 @@ class GraphHandler:
                 query.send_reply(cached, content_type=_CONTENT_TYPES[mode])
                 return
 
-        results = tsdb.new_query_runner().run(ts_query)
+        # same cluster front door as /api/query — the UI draws via /q,
+        # so a clustered operator's graphs must span the cluster too.
+        # Cache consistency holds: clustered-vs-local depends only on
+        # static config, so one cache key always maps to one mode.
+        from opentsdb_tpu.tsd.cluster import serve_query
+        results = serve_query(tsdb, ts_query, query)
         if mode == "ascii":
             body = self._ascii(results)
         elif mode == "json":

@@ -447,26 +447,15 @@ class QueryRpc(HttpRpc):
                 else:
                     raise BadRequestError(str(e))
         try:
-            runner = tsdb.new_query_runner()
-            from opentsdb_tpu.tsd.cluster import (cluster_peers,
-                                                  is_fanout_request,
-                                                  run_clustered)
-            if cluster_peers(tsdb.config) and not is_fanout_request(query) \
-                    and not ts_query.delete \
-                    and all(sub.metric for sub in ts_query.queries):
-                # one query, the whole cluster's data (SaltScanner role:
-                # /root/reference/src/core/SaltScanner.java:269); peers'
-                # fan-out requests serve purely locally (loop guard).
-                # tsuid subqueries stay local: tsuids are host-local
-                # surrogate keys here, so fanning them out would name
-                # different series on each peer.
-                exec_stats = {}
-                results = run_clustered(tsdb, ts_query,
-                                        exec_stats=exec_stats)
-            else:
-                results = runner.run(ts_query)
-                # read AFTER run(): the runner rebinds exec_stats there
-                exec_stats = runner.exec_stats
+            # one query, the whole cluster's data when peers are
+            # configured (SaltScanner role:
+            # /root/reference/src/core/SaltScanner.java:269); peers'
+            # fan-out requests, deletes, and tsuid subqueries serve
+            # purely locally — see cluster.serve_query
+            from opentsdb_tpu.tsd.cluster import serve_query
+            exec_stats: dict = {}
+            results = serve_query(tsdb, ts_query, query,
+                                  exec_stats=exec_stats)
             if ts_query.delete:
                 deleted = self._delete(tsdb, ts_query)
             if qs is not None:

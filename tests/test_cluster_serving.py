@@ -140,6 +140,65 @@ class TestClusterMatchesSingleHost:
             assert got_by[k]["aggregateTags"] == \
                 want_by[k]["aggregateTags"], (m, k)
 
+    def test_gexp_spans_cluster(self, receiver, oracle):
+        """/api/query/gexp's metric extraction goes through the cluster
+        front door too — the function inputs must span every host."""
+        uri = ("/api/query/gexp?start=%d&end=%d&exp=scale(sum:clu.m,2)"
+               % (BASE - 60, BASE + 1200))
+        st_c, got = ask(receiver, uri)
+        st_o, want = ask(oracle, uri)
+        assert st_c == st_o == 200
+        assert len(got) == len(want) == 1
+        _assert_dps_equal(got[0]["dps"], want[0]["dps"], "gexp")
+
+    def test_exp_spans_cluster(self, receiver, oracle):
+        body = {
+            "time": {"start": str(BASE - 60), "end": str(BASE + 1200),
+                     "aggregator": "sum"},
+            "metrics": [{"id": "m", "metric": "clu.m"}],
+            "expressions": [{"id": "e", "expr": "m * 3"}],
+        }
+        results = {}
+        for name, mgr in (("got", receiver), ("want", oracle)):
+            q = mgr.handle_http(HttpRequest(
+                method="POST", uri="/api/query/exp",
+                body=json.dumps(body).encode(),
+                headers={"content-type": "application/json"}))
+            assert q.response.status == 200
+            raw = q.response.body
+            results[name] = json.loads(
+                raw.decode() if isinstance(raw, bytes) else raw)
+        g = results["got"]["outputs"][0]["dps"]
+        w = results["want"]["outputs"][0]["dps"]
+        assert g and len(g) == len(w)
+        for gr, wr in zip(g, w):
+            assert gr[0] == wr[0]
+            assert gr[1] == pytest.approx(wr[1], rel=1e-9)
+
+    def test_q_graph_endpoint_spans_cluster(self, receiver, oracle):
+        """/q (the UI's data endpoint) must agree with /api/query on a
+        clustered TSD — ascii mode compares actual plotted points."""
+        uri = ("/q?start=%d&end=%d&m=sum:clu.m&ascii&nocache"
+               % (BASE - 60, BASE + 1200))
+        got = receiver.handle_http(HttpRequest(method="GET", uri=uri))
+        want = oracle.handle_http(HttpRequest(method="GET", uri=uri))
+        assert got.response.status == want.response.status == 200
+
+        def pts(resp):
+            body = resp.response.body
+            text = body.decode() if isinstance(body, (bytes, bytearray)) \
+                else str(body)
+            out = {}
+            for ln in text.splitlines():
+                parts = ln.split()
+                if len(parts) >= 3:
+                    out[(parts[0], parts[1])] = float(parts[2])
+            return out
+        g, w = pts(got), pts(want)
+        assert g and set(g) == set(w)
+        for k in w:     # values must include the PEER's contribution
+            assert g[k] == pytest.approx(w[k], rel=1e-9), k
+
     def test_multi_subquery(self, receiver, oracle):
         uri = ("/api/query?start=%d&m=sum:clu.m&m=max:clu.other"
                % (BASE - 60))
