@@ -111,10 +111,11 @@ DEFAULTS: dict[str, str] = {
     # measurement session records winners in BENCH_WINNERS.json).  Empty
     # keeps the module defaults / TSDB_*_MODE env; every form carries
     # shape guards that demote it off losing shapes regardless.
-    "tsd.query.kernel.scan_mode": "",          # flat|blocked|subblock
-    "tsd.query.kernel.search_mode": "",        # scan|compare_all|hier
-    "tsd.query.kernel.extreme_mode": "",       # scan|segment|subblock
-    "tsd.query.kernel.group_reduce_mode": "",  # segment|matmul|sorted
+    # empty = module default ("auto": the ops/costmodel.py shape chooser)
+    "tsd.query.kernel.scan_mode": "",          # auto|flat|blocked|subblock|subblock2
+    "tsd.query.kernel.search_mode": "",        # auto|scan|compare_all|hier
+    "tsd.query.kernel.extreme_mode": "",       # auto|scan|segment|subblock
+    "tsd.query.kernel.group_reduce_mode": "",  # auto|segment|matmul|sorted
     # Demote dense (accelerator-winner) search forms to the binary scan
     # on CPU execution — the planner's small-query host lane included
     # (measured 18x slower there under the chip-crowned modes).  Empty
