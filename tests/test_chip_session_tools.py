@@ -157,6 +157,57 @@ class TestKernelModeConfig:
         assert ds._SCAN_MODE == before
 
 
+class TestCalibrationPersistence:
+    def test_calibration_record_written(self, tmp_path):
+        mod = _load(tmp_path)
+        recs = [{"label": "searchsorted", "seconds": 0.154},
+                {"label": "calibration",
+                 "costs_tpu": {"scan_f64": 1.5e-9, "hier_cell": 1.9e-11}}]
+        assert mod.persist_calibration(recs, str(tmp_path))
+        with open(os.path.join(str(tmp_path),
+                               "BENCH_CALIBRATION.json")) as fh:
+            data = json.load(fh)
+        assert data == {"tpu": {"scan_f64": 1.5e-9,
+                                "hier_cell": 1.9e-11}}
+        # and the cost model actually consumes what was written
+        from opentsdb_tpu.ops import costmodel
+        import pytest
+        orig = costmodel._CALIBRATION_FILE
+        costmodel._CALIBRATION_FILE = os.path.join(
+            str(tmp_path), "BENCH_CALIBRATION.json")
+        costmodel.reload_calibration()
+        try:
+            assert costmodel.costs("tpu")["scan_f64"] == \
+                pytest.approx(1.5e-9)
+        finally:
+            costmodel._CALIBRATION_FILE = orig
+            costmodel.reload_calibration()
+
+    def test_no_record_writes_nothing(self, tmp_path):
+        mod = _load(tmp_path)
+        assert not mod.persist_calibration(
+            [{"label": "searchsorted", "seconds": 0.1}], str(tmp_path))
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "BENCH_CALIBRATION.json"))
+
+
+class TestStageOverrides:
+    def test_configs_and_hist_run_under_auto(self, tmp_path):
+        mod = _load(tmp_path)
+        winners = {"TSDB_SCAN_MODE": "subblock",
+                   "TSDB_SEARCH_MODE": "hier"}
+        # headline-shape stages get the crowned winners
+        assert mod.stage_overrides("bench", winners) == winners
+        assert mod.stage_overrides("stage_bench", winners) == winners
+        assert mod.stage_overrides("profile", winners) == winners
+        # heterogeneous-shape stages run under the cost model's auto
+        # (forced winners are what broke config 1 in r4)
+        for c in range(1, 8):
+            assert mod.stage_overrides("bench_configs:%d" % c,
+                                       winners) == {}
+        assert mod.stage_overrides("hist_bench", winners) == {}
+
+
 class TestStreamRatioCrowning:
     """stage_bench's stream-chunk race crowns the W/N routing threshold
     only on a complete race the dense form won."""

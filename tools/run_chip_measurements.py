@@ -133,6 +133,31 @@ def pick_winners(prefix_records: list[dict]) -> dict:
     return env
 
 
+def persist_calibration(stage_recs: list[dict], repo: str) -> bool:
+    """Write stage_bench's chip-derived cost-model constants to
+    BENCH_CALIBRATION.json (ops/costmodel.py reads it).  Returns True
+    when a calibration record was found and written."""
+    for rec in stage_recs:
+        if rec.get("label") == "calibration" and rec.get("costs_tpu"):
+            with open(os.path.join(repo, "BENCH_CALIBRATION.json"),
+                      "w") as fh:
+                json.dump({"tpu": rec["costs_tpu"]}, fh, indent=1)
+            return True
+    return False
+
+
+def stage_overrides(name: str, winner_env: dict) -> dict:
+    """Which env overrides a stage runs under.  The crowned winner env
+    was measured at the HEADLINE shape and feeds the stages that
+    dispatch that shape (stage_bench, bench, profile).  The BASELINE
+    configs span very different shapes and run under the shape-driven
+    cost model's auto selection — globally-forced winners are exactly
+    what broke config 1 in r4 (hier cell blowup rc=1)."""
+    if name.startswith("bench_configs") or name == "hist_bench":
+        return {}
+    return winner_env
+
+
 def pick_stream_ratio(stage_recs: list[dict]) -> str | None:
     """Stream-chunk routing race (stage_bench, config-2 slice shape,
     W ~ 1.25N): when the dense edge-search fold beat the segment scatter
@@ -197,14 +222,7 @@ def main() -> None:
             write_out()
             continue
         failed = False
-        # The crowned winner env was measured at the HEADLINE shape and
-        # feeds the stages that dispatch that shape (stage_bench, bench,
-        # profile).  The BASELINE configs span very different shapes and
-        # run under the shape-driven cost model's auto selection —
-        # globally-forced winners are exactly what broke config 1 in r4
-        # (hier cell blowup rc=1).
-        stage_env = {} if name.startswith("bench_configs") \
-            or name == "hist_bench" else winner_env
+        stage_env = stage_overrides(name, winner_env)
         try:
             lines, rc = run_stage(name, argv, timeout,
                                   extra_env=stage_env)
@@ -227,16 +245,9 @@ def main() -> None:
             if name == "stage_bench":
                 # persist the chip-derived cost-model constants so mode
                 # auto-selection (ops/costmodel.py) follows THIS chip
-                for rec in stage_recs:
-                    if rec.get("label") == "calibration" \
-                            and rec.get("costs_tpu"):
-                        with open(os.path.join(
-                                REPO, "BENCH_CALIBRATION.json"),
-                                "w") as fh:
-                            json.dump({"tpu": rec["costs_tpu"]}, fh,
-                                      indent=1)
-                        print("== wrote BENCH_CALIBRATION.json ==",
-                              file=sys.stderr, flush=True)
+                if persist_calibration(stage_recs, REPO):
+                    print("== wrote BENCH_CALIBRATION.json ==",
+                          file=sys.stderr, flush=True)
                 ratio = pick_stream_ratio(stage_recs)
                 if ratio is not None:
                     winner_env["TSDB_STREAM_SEGMENT_RATIO"] = ratio
