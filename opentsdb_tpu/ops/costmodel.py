@@ -23,8 +23,12 @@ The decisions this model reproduces from the r4 chip data:
     compare materializes there — measured 18-70x slower).
   * prefix scan: subblock windowed-sum (88ms) < flat (130ms) on the
     chip (the full-length emulated-f64 cumsum is the cost, 100ms vs
-    3ms for 1/32-length); flat on CPU (native vector cumsum, the extra
-    subblock passes only add traffic).
+    3ms for 1/32-length) — and subblock wins on CPU too, 5.5x: the XLA
+    CPU cumsum is a SERIAL scalar loop (measured on the config-1 shape,
+    [1, 2^20]: 8.8ms cumsum vs 0.97ms elementwise; full avg path 2.1ms
+    subblock / 11.6 flat / 9.4 subblock2 — subblock2's within-block
+    inclusive-prefix pass is flat-class on CPU, so it gets its own
+    per-element constant).
   * extremes: reset-scan (0.5245s/dispatch) < subblock (0.8282 — its
     per-edge boundary-lane reduces outweigh the shorter scan at the
     headline W) << segment scatter (7.161) on the chip; the scatter is
@@ -76,6 +80,11 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "hier_cell": 1.87e-11,
         "scan_f64": 1.49e-9,
         "elem_f64": 2.7e-10,
+        # within-block prefix pass: priced slightly ABOVE elem_f64 so
+        # the chip-race-crowned subblock stays the auto pick on TPU
+        # until a calibration actually measures subblock2 faster (its
+        # CPU prefix pass is 8x elem-cost — the chip may disappoint too)
+        "sub2_elem": 3.5e-10,
         "win_gather": 5.7e-8,
         "seg_scatter": 4.2e-7,
         "mxu_cell": 1.9e-9,
@@ -88,11 +97,15 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "gather_round": 2.0e-8,
         "cmp_cell": 1.0e-9,      # materializes; feasibility-capped anyway
         "hier_cell": 1.0e-9,
-        "scan_f64": 1.5e-9,      # native f64 vector cumsum
-        # CPU passes are memory-bound at the same rate as the cumsum, so
-        # an extra elementwise pass costs the cumsum's full traffic —
-        # this is what makes flat beat subblock on the host
-        "elem_f64": 1.5e-9,
+        # XLA's CPU cumsum lowers to a SERIAL scalar loop: measured
+        # 8.8ms over 2^20 f64 (8.4e-9/elem) while an elementwise pass
+        # streams the same data in 0.97ms — the subblock form's
+        # 1/32-length scan is therefore a ~6x win on the host as well
+        "scan_f64": 8.4e-9,
+        "elem_f64": 1.0e-9,
+        # subblock2's within-block inclusive prefixes are flat-class on
+        # CPU (measured 9.4ms vs subblock's 2.1 on the config-1 shape)
+        "sub2_elem": 8.0e-9,
         "win_gather": 2.0e-8,
         "seg_scatter": 5.0e-9,   # CPU scatters are cheap
         "mxu_cell": 1.0e-9,      # no MXU: dense [G,S]x[S,W] is real FLOPs
@@ -175,11 +188,20 @@ def predict_scan(mode: str, s: int, n: int, e: int,
         # two-level scan: same element count, measured slightly slower
         # than flat on both platforms (r3 chip: 0.600 vs 0.568)
         return 1.06 * (s * n * c["scan_f64"] + s * e * c["win_gather"])
-    if mode in ("subblock", "subblock2"):
+    if mode == "subblock":
         k = 32
         return (s * n * c["elem_f64"]                 # sub-block reduce
                 + s * (n // k) * c["scan_f64"]        # 1/32-length cumsum
                 + s * e * k * c["elem_f64"]           # boundary remainder
+                + s * e * c["win_gather"])
+    if mode == "subblock2":
+        k = 32
+        # within-block inclusive prefixes (block sums fall out of the
+        # last lane) + ONE element gather per edge — no [S, E, K]
+        # remainder intermediate, but the prefix pass has its own
+        # platform-dependent cost (serial-ish on CPU)
+        return (s * n * c["sub2_elem"]
+                + s * (n // k) * c["scan_f64"]
                 + s * e * c["win_gather"])
     raise ValueError("unknown scan mode: " + mode)
 

@@ -178,3 +178,56 @@ class TestNativeSnapshotDirtyRoundTrip:
             ts2, fval2, _, _ = eng.window(sid)
             assert list(ts2) == [1000, 2000]
             assert list(fval2) == [2.0, 3.0]
+
+
+class TestWindowIntoTypeRace:
+    """build_batch_direct sizes/types the batch in one lock hold and
+    fills rows in another (review r5): a float point appended between
+    the two must NOT be read from the int column (append() stores 0
+    there) — the fill refuses and the builder retypes to float."""
+
+    def _series(self):
+        from opentsdb_tpu.storage.memstore import Series, SeriesKey
+        import numpy as np
+        s = Series(SeriesKey(1, ((1, 1),)))
+        ts = np.arange(10, dtype=np.int64) * 1000
+        s.append_batch(ts, np.arange(10, dtype=np.float64), True)
+        return s
+
+    def test_window_into_refuses_stale_int_contract(self):
+        import numpy as np
+        s = self._series()
+        count, all_int = s.window_stats(0, 100_000)
+        assert count == 10 and all_int
+        s.append(5_500, 3.5, False)          # float lands in range
+        ts_row = np.empty(16, np.int64)
+        val_row = np.empty(16, np.int64)
+        mask_row = np.empty(16, bool)
+        k, ok = s.window_into(0, 100_000, True, ts_row, val_row,
+                              mask_row, want_int=True)
+        assert not ok and k == 0
+        # the float view still serves everything
+        fval = np.empty(16, np.float64)
+        k, ok = s.window_into(0, 100_000, True, ts_row, fval, mask_row,
+                              want_int=False)
+        assert ok and k == 11
+        assert 3.5 in fval[:k]
+
+    def test_build_batch_direct_retypes_to_float(self):
+        import numpy as np
+        from opentsdb_tpu.ops.pipeline import build_batch_direct
+        s = self._series()
+
+        class Racy:
+            """Looks all-int at sizing time, grows a float by fill time."""
+            def window_stats(self, a, b, fix=True):
+                return s.window_stats(a, b, fix)
+            def window_into(self, a, b, fix, tr, vr, mr, want_int):
+                if want_int:
+                    s.append(5_500, 3.5, False)
+                return s.window_into(a, b, fix, tr, vr, mr, want_int)
+
+        ts, val, mask, all_int = build_batch_direct([Racy()], 0, 100_000,
+                                                    True)
+        assert not all_int and val.dtype == np.float64
+        assert 3.5 in val[0][mask[0]]
