@@ -1,0 +1,117 @@
+"""Randomized differential burn-in for the kernel mode space.
+
+Random (shape, holes, int-ness, grouping, interval) grouped-downsample
+cases; every case runs under mode 'auto' (the cost model's pick) and
+under every forced {scan x search x group} combination, and all answers
+must agree to 1e-9 — the auto chooser may only change WHICH
+equivalence-tested kernel runs, never the numbers.  Streamed sliced
+folds are cross-checked against the materialized grid on the same data.
+
+Run: python tools/burnin.py [--cases N] [--seed S]
+(CPU-safe; a chip session can run it too.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cases", type=int, default=60)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--platform", default="")
+    args = ap.parse_args()
+
+    import opentsdb_tpu.ops  # noqa: F401
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+    import numpy as np
+    from opentsdb_tpu.ops import downsample as ds
+    from opentsdb_tpu.ops import group_agg as ga
+    from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
+    from opentsdb_tpu.ops.pipeline import (PipelineSpec, DownsampleStep,
+                                           run_group_pipeline)
+
+    rng = np.random.default_rng(args.seed)
+    start = 1_356_998_400_000
+    combos = [(sc, se, gr)
+              for sc in ("flat", "subblock", "subblock2")
+              for se in ("scan", "compare_all", "hier")
+              for gr in ("segment", "matmul", "sorted")]
+    t0 = time.time()
+    fails = 0
+    for case in range(args.cases):
+        s = int(rng.choice([3, 8, 17, 64]))
+        n = int(rng.choice([96, 256, 1024]))
+        span = int(rng.integers(600_000, 7_200_000))
+        interval = int(rng.choice([60_000, 300_000, 900_000]))
+        groups = int(rng.integers(1, min(s, 5) + 1))
+        dsfn = str(rng.choice(["avg", "sum", "min", "max", "count"]))
+        agg = str(rng.choice(["sum", "max", "avg"]))
+
+        ts = np.full((s, n), np.iinfo(np.int64).max, np.int64)
+        val = np.zeros((s, n))
+        mask = np.zeros((s, n), bool)
+        for i in range(s):
+            k = int(rng.integers(5, n))
+            ts[i, :k] = start + np.sort(
+                rng.choice(span, size=k, replace=False))
+            v = rng.normal(100, 25, k)
+            if rng.random() < 0.3:
+                v = np.round(v)
+            val[i, :k] = v
+            mask[i, :k] = rng.random(k) < 0.93
+        gid = (np.arange(s) % groups).astype(np.int64)
+        fixed = FixedWindows.for_range(start, start + span, interval)
+        wspec, wargs = fixed.split()
+        spec = PipelineSpec(agg, DownsampleStep(dsfn, wspec, "none", 0.0))
+
+        def run():
+            return [np.asarray(x) for x in run_group_pipeline(
+                spec, jnp.asarray(ts), jnp.asarray(val),
+                jnp.asarray(mask), jnp.asarray(gid), pad_pow2(groups),
+                wargs)]
+
+        ds.set_scan_mode("auto")
+        ds.set_search_mode("auto")
+        ga.set_group_reduce_mode("auto")
+        want = run()
+        for sc, se, gr in combos:
+            ds.set_scan_mode(sc)
+            ds.set_search_mode(se)
+            ga.set_group_reduce_mode(gr)
+            got = run()
+            for a, b in zip(want, got):
+                if not np.allclose(a, b, rtol=1e-9, atol=1e-9,
+                                   equal_nan=True):
+                    fails += 1
+                    print("MISMATCH case=%d %s/%s/%s s=%d n=%d int=%d "
+                          "fn=%s agg=%s" % (case, sc, se, gr, s, n,
+                                            interval, dsfn, agg),
+                          flush=True)
+                    break
+        if (case + 1) % 10 == 0:
+            print("[burnin] %d/%d cases, %d combos each, %.0fs, "
+                  "%d failures" % (case + 1, args.cases,
+                                   len(combos) + 1, time.time() - t0,
+                                   fails), flush=True)
+    ds.set_scan_mode("auto")
+    ds.set_search_mode("auto")
+    ga.set_group_reduce_mode("auto")
+    print("[burnin] DONE: %d cases x %d combos, %d failures in %.0fs"
+          % (args.cases, len(combos) + 1, fails, time.time() - t0),
+          flush=True)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    main()
