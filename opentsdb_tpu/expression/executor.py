@@ -184,7 +184,7 @@ class QueryExecutor:
             outputs = [{"id": e["id"]} for e in source]
 
         # Expression DAG: an expression's variables may name OTHER
-        # expressions (reference: QueryExecutor.java:19-23 builds a
+        # expressions (reference: QueryExecutor.java:291 builds a
         # jgrapht DirectedAcyclicGraph over the expressions and wires
         # each ExpressionIterator's variable iterators from metric OR
         # expression results).  Evaluate in topological order, feeding

@@ -260,7 +260,7 @@ class TestExpEndpoint:
 
     def test_nested_expression(self, manager):
         """Expression-over-expression: the reference topo-sorts an
-        expression DAG (/root/reference/src/tsd/QueryExecutor.java:19-23
+        expression DAG (/root/reference/src/tsd/QueryExecutor.java:291
         jgrapht DirectedAcyclicGraph; ExpressionIterator wires variable
         iterators from metric OR expression results), so `e2 = e1 / 2`
         must evaluate against e1's output — declaration order must not
