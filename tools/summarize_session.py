@@ -1,0 +1,108 @@
+"""Render a chip-session artifact (BENCH_CONFIGS_rNN.json) as markdown.
+
+    python tools/summarize_session.py [path]
+
+Sections: the bench_prefix race table (sorted by dispatch time, winner
+starred), stage attribution, the headline row, per-config BASELINE rows
+with vs_baseline, histogram row, and any error/skip rows — the exact
+tables NOTES_rNN.md and README report after a session.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def load(path: str) -> list[dict]:
+    recs = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    recs.append(json.loads(ln))
+                except ValueError:
+                    pass
+    return recs
+
+
+def main() -> None:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        repo, "BENCH_CONFIGS_r05.json")
+    recs = load(path)
+    if not recs:
+        print("no records in %s" % path)
+        return
+
+    prefix = [r for r in recs if r.get("stage") == "bench_prefix"
+              and "s_per_dispatch" in r]
+    if prefix:
+        print("## bench_prefix race (%d rows)\n" % len(prefix))
+        print("| config | s/dispatch | dp/s |")
+        print("|---|---|---|")
+        best = min(r["s_per_dispatch"] for r in prefix)
+        for r in sorted(prefix, key=lambda r: r["s_per_dispatch"]):
+            star = " **<- winner**" if r["s_per_dispatch"] == best else ""
+            print("| %s%s | %.4f | %.1fM |"
+                  % (r["config"], star, r["s_per_dispatch"],
+                     r.get("dp_per_sec", 0) / 1e6))
+        print()
+
+    stages = [r for r in recs if r.get("stage") == "stage_bench"
+              and "seconds" in r]
+    if stages:
+        print("## stage attribution\n")
+        print("| stage | ms | dp/s |")
+        print("|---|---|---|")
+        for r in stages:
+            print("| %s | %.1f | %.1fM |"
+                  % (r.get("label", "?"), r["seconds"] * 1e3,
+                     r.get("dp_per_sec", 0) / 1e6))
+        print()
+    cal = [r for r in recs if r.get("label") == "calibration"]
+    if cal:
+        print("calibration written: %s\n"
+              % json.dumps(cal[-1].get("costs_tpu", {})))
+
+    bench = [r for r in recs if r.get("stage") == "bench"
+             and "vs_baseline" in r]
+    for r in bench:
+        if r.get("skipped"):
+            print("## headline: SKIPPED — %s\n" % r.get("reason"))
+        else:
+            print("## headline: %.1fM dp/s/chip  (vs_baseline %.2fx)\n"
+                  % (r.get("value", 0) / 1e6, r.get("vs_baseline", 0)))
+
+    configs = [r for r in recs
+               if str(r.get("stage", "")).startswith("bench_configs")
+               and "vs_baseline" in r]
+    if configs:
+        print("## BASELINE configs\n")
+        print("| metric | value | vs_baseline |")
+        print("|---|---|---|")
+        for r in configs:
+            print("| %s | %s %s | %.3fx |"
+                  % (r["metric"][:110], r.get("value"),
+                     r.get("unit", ""), r.get("vs_baseline", 0)))
+        print()
+
+    hist = [r for r in recs if r.get("stage") == "hist_bench"
+            and "vs_baseline" in r]
+    for r in hist:
+        print("## histogram: %s %s  (%.2fx vs numpy reference)\n"
+              % (r.get("value"), r.get("unit", ""),
+                 r.get("vs_baseline", 0)))
+
+    errors = [r for r in recs if "error" in r]
+    if errors:
+        print("## errors / skips\n")
+        for r in errors:
+            print("- %s: %s" % (r.get("stage", r.get("metric", "?")),
+                                str(r["error"])[:200]))
+
+
+if __name__ == "__main__":
+    main()
