@@ -208,6 +208,26 @@ class TestStageOverrides:
         assert mod.stage_overrides("hist_bench", winners) == {}
 
 
+class TestStagePriorityOrder:
+    def test_headline_and_configs_before_races(self, tmp_path):
+        """A session cut short by the round boundary must still produce
+        the BASELINE table: bench + configs + histogram run before the
+        race/attribution stages."""
+        mod = _load(tmp_path)
+        order = {"bench": 0, "bench_configs": 1, "hist_bench": 2,
+                 "bench_prefix": 3, "stage_bench": 4, "profile": 5}
+        names = ["bench_prefix", "stage_bench", "bench"] + \
+            ["bench_configs:%d" % c for c in range(1, 8)] + \
+            ["hist_bench", "profile"]
+        stages = [(n, [], 0) for n in names]
+        stages.sort(key=lambda st: order.get(st[0].split(":")[0], 9))
+        got = [n for n, _, _ in stages]
+        assert got[0] == "bench"
+        assert got[1:8] == ["bench_configs:%d" % c for c in range(1, 8)]
+        assert got[8] == "hist_bench"
+        assert got[9:] == ["bench_prefix", "stage_bench", "profile"]
+
+
 class TestStreamRatioCrowning:
     """stage_bench's stream-chunk race crowns the W/N routing threshold
     only on a complete race the dense form won."""

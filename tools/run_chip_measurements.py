@@ -1,15 +1,13 @@
 """One-shot real-chip measurement session for round 5 artifacts.
 
-Runs, in order, each as a separate subprocess (the axon tunnel is
-exclusive and can wedge if a JAX process dies mid-dispatch — isolating
-stages means a crash loses one stage, not the session):
+Runs, in PRIORITY order for a late tunnel recovery, each as a separate
+subprocess (the axon tunnel is exclusive and can wedge if a JAX process
+dies mid-dispatch — isolating stages means a crash loses one stage, not
+the session):
 
-  1. bench_prefix.py          — A/B the hot-path variants (JSON lines),
-                                incl. the r4 group-reduce segment/matmul
-                                race; winners feed later stages via env
-  2. tools/stage_bench.py     — per-stage attribution of one dispatch
-  3. bench.py                 — headline number with the winning defaults
-  4. bench_configs.py         — BASELINE configs 1-7 at full scale,
+  1. bench.py                 — headline number (BENCH_WINNERS.json
+                                chip-crowned defaults)
+  2. bench_configs.py         — BASELINE configs 1-7 at full scale,
                                 crash-isolated one subprocess per config,
                                 each under a COOPERATIVE in-process
                                 deadline (--deadline) that finalizes a
@@ -17,7 +15,12 @@ stages means a crash loses one stage, not the session):
                                 timeout sits 900s behind it as a last
                                 resort (its SIGKILL mid-dispatch is what
                                 wedged the tunnel in both r4 sessions)
-  5. tools/hist_bench.py      — histogram device-path throughput row
+  3. tools/hist_bench.py      — histogram device-path throughput row
+  4. bench_prefix.py          — A/B the hot-path variants (incl. the r5
+                                subblock2 rows and the cost model's own
+                                "auto" row); winners feed later stages
+  5. tools/stage_bench.py     — per-stage attribution + the cost-model
+                                calibration record
 
 Results append to BENCH_CONFIGS_r05.json (JSON lines + a trailing
 metadata line).  Run: python tools/run_chip_measurements.py
@@ -213,6 +216,18 @@ def main() -> None:
                                "dispatch, RTT subtracted, >=1s wall per "
                                "measurement; see bench.py docstring",
             }) + "\n")
+
+    # Stage ORDER is priority order for a late tunnel recovery (the
+    # outage has eaten most of the round before): the headline bench and
+    # the BASELINE configs — configs 5-7 have never had a chip number —
+    # come before the race/attribution stages, so a session cut short by
+    # the round boundary still produces the table the round is for.
+    # bench.py uses the r4-crowned BENCH_WINNERS.json defaults (env
+    # overrides only appear once bench_prefix has run); the configs run
+    # under cost-model auto by design either way.
+    order = {"bench": 0, "bench_configs": 1, "hist_bench": 2,
+             "bench_prefix": 3, "stage_bench": 4, "profile": 5}
+    stages.sort(key=lambda st: order.get(st[0].split(":")[0], 9))
 
     dead = False
     for name, argv, timeout in stages:
