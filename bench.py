@@ -184,7 +184,11 @@ def make_batch(precompacted: bool = True):
         ts = START + cols[None, :] * STEP_MEAN_MS + h % 5_000
         val = 100.0 + (h % 1_000).astype(jnp.float64) * 0.05
         mask = jnp.ones((S, N), dtype=bool)
-        gid = rows % GROUPS
+        # contiguous group runs — the layout the planner actually emits
+        # (planner.py:403 concatenates per-group member lists), so the
+        # benched dispatch matches production row order and the sorted
+        # reduce modes can skip their permute (spec.rows_sorted)
+        gid = rows * GROUPS // S
         if precompacted:
             return (ts - first).astype(jnp.int32), val, mask, gid
         return ts, val, mask, gid
@@ -209,7 +213,8 @@ def build_spec(precompacted: bool = True):
         wargs["ts_base"] = jnp.asarray(fixed.first_window_ms, jnp.int64)
     spec = PipelineSpec(
         aggregator="sum",
-        downsample=DownsampleStep("avg", window_spec, "none", 0.0))
+        downsample=DownsampleStep("avg", window_spec, "none", 0.0),
+        rows_sorted=True)
     return spec, wargs, pad_pow2(GROUPS)
 
 

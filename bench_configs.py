@@ -391,6 +391,9 @@ def _stream_pass(s, n_chunk, chunks, wspec, wargs, finishes, base0: int,
         done += 1
         if pace:
             _queue_sync(acc)
+        if done % 4 == 0:
+            _note("stream: %d/%d chunks (%.2fs/chunk)"
+                  % (done, chunks, (time.perf_counter() - t0) / done))
     outs = [acc.finish(f) for f in finishes]
     drain(outs)
     elapsed = time.perf_counter() - t0 - _sync_cost(outs)
@@ -546,13 +549,18 @@ def config5(scale: float, n_dev: int) -> None:
         return outs
 
     # compile (same shapes every chunk); keep the output structure for
-    # the per-chunk sync-cost subtraction below
+    # the per-chunk sync-cost subtraction below.  Progress notes bracket
+    # every potentially-slow phase: the r5 session's config-5 watchdog
+    # fired with ZERO notes in stderr, leaving the hang unattributable.
+    _note("config 5: compiling rollup chunk (%d chunks/pass)" % chunks)
     tmpl = one_chunk(0, _UNIQ.next(1 << 28))
     chunk_sync = _sync_cost(tmpl)
+    _note("config 5: compile done")
 
     def one_pass():
         base0 = _UNIQ.next(1 << 28)
         gen_per_chunk = gen_calibration(base0 + chunks * n_chunk)
+        _note("config 5: gen calibrated (%.3fs/chunk)" % gen_per_chunk)
         t0 = time.perf_counter()
         done = 0
         for k in range(chunks):
@@ -564,6 +572,9 @@ def config5(scale: float, n_dev: int) -> None:
                 break
             one_chunk(k, base0)
             done += 1
+            if done % 4 == 0:
+                _note("config 5: %d/%d chunks (%.2fs/chunk)"
+                      % (done, chunks, (time.perf_counter() - t0) / done))
         secs = max(time.perf_counter() - t0
                    - (gen_per_chunk + chunk_sync) * done, 1e-9)
         return secs, s * n_chunk * done

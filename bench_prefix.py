@@ -141,8 +141,9 @@ def main() -> None:
              lambda m=emode: ds.set_extreme_mode(m), spec_min)
 
     # group-reduce strategy: segment scatter vs one-hot matmul (MXU) vs
-    # sorted contiguous-run reset-scans (r4).
-    for gmode in ("segment", "matmul", "sorted"):
+    # sorted contiguous-run reset-scans (r4) vs the r5 blocked
+    # level-masked fold with int32 counts ("sorted2").
+    for gmode in ("segment", "matmul", "sorted", "sorted2"):
         race("flat+int32+group_" + gmode,
              lambda m=gmode: ga.set_group_reduce_mode(m), spec)
 
@@ -168,6 +169,10 @@ def main() -> None:
          combo("subblock", "hier", "sorted"), spec)
     race("subblock2+int32+hier+sorted",
          combo("subblock2", "hier", "sorted"), spec)
+    race("subblock+int32+hier+sorted2",
+         combo("subblock", "hier", "sorted2"), spec)
+    race("subblock2+int32+hier+sorted2",
+         combo("subblock2", "hier", "sorted2"), spec)
 
     # the shape-driven cost model's own pick (ops/costmodel.py "auto"):
     # racing it against the explicit rows shows on-chip whether the

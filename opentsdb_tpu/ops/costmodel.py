@@ -89,6 +89,11 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "seg_scatter": 4.2e-7,
         "mxu_cell": 1.9e-9,
         "sorted_grid": 1.7e-7,
+        # blocked level-masked fold (mode "sorted2"): ESTIMATE (~0.4x
+        # sorted — half the full-width levels, no pair-op selects/bool
+        # channel) until a chip race records it; deliberately not an
+        # auto candidate until then (group_agg._effective_group_reduce_mode)
+        "sorted2_grid": 7.0e-8,
         "ext_scan_elem": 6.0e-9,
         "ext_seg_elem": 1.06e-7,
         "ext_boundary_cell": 4.0e-8,
@@ -110,6 +115,8 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "seg_scatter": 5.0e-9,   # CPU scatters are cheap
         "mxu_cell": 1.0e-9,      # no MXU: dense [G,S]x[S,W] is real FLOPs
         "sorted_grid": 1.0e-8,
+        "sorted2_grid": 1.0e-8,  # estimate; not an auto candidate yet
+
         "ext_scan_elem": 4.0e-9,
         "ext_seg_elem": 2.0e-9,
         "ext_boundary_cell": 2.0e-8,
@@ -249,6 +256,8 @@ def predict_group(mode: str, s: int, w: int, g: int,
         return g * s * w * c["mxu_cell"]
     if mode == "sorted":
         return s * w * c["sorted_grid"]
+    if mode == "sorted2":
+        return s * w * c["sorted2_grid"]
     raise ValueError("unknown group mode: " + mode)
 
 

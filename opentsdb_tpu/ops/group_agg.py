@@ -69,7 +69,7 @@ def _identity(x):
 # (bench_prefix) picks the default via TSDB_GROUP_REDUCE_MODE.
 import os as _os
 
-_GROUP_REDUCE_MODES = ("auto", "segment", "matmul", "sorted")
+_GROUP_REDUCE_MODES = ("auto", "segment", "matmul", "sorted", "sorted2")
 _GROUP_REDUCE_MODE = (_os.environ.get("TSDB_GROUP_REDUCE_MODE")
                       if _os.environ.get("TSDB_GROUP_REDUCE_MODE")
                       in _GROUP_REDUCE_MODES else "auto")
@@ -113,6 +113,9 @@ def _effective_group_reduce_mode(s: int, w: int, g: int,
         return mode
     from opentsdb_tpu.ops.hostlane import execution_platform
     from opentsdb_tpu.ops import costmodel
+    # "sorted2" is deliberately NOT an auto candidate yet: its cost
+    # constant is an estimate until a chip race records it (r5 policy:
+    # no unraced mode can be auto-picked by a BASELINE config).
     cands = ["segment", "sorted"]
     # extremes have no matmul form (min/max don't distribute over the
     # one-hot dot) — auto must rank only the forms that exist for them
@@ -131,11 +134,19 @@ class _SortedGroups:
     Everything is [S, W]-sized vector work — no scatter.
     """
 
-    def __init__(self, gid, num_groups: int, s: int):
+    def __init__(self, gid, num_groups: int, s: int,
+                 presorted: bool = False):
         self.g = num_groups
         self.s = s
-        self.perm = jnp.argsort(gid, stable=True)
-        self.sorted_gid = jnp.take(gid, self.perm)
+        if presorted:
+            # Caller-guaranteed non-decreasing gid (the planner always
+            # emits groups as concatenated runs, planner.py:403): skip
+            # the argsort AND the [S, W] permute gather in every fold.
+            self.perm = None
+            self.sorted_gid = gid
+        else:
+            self.perm = jnp.argsort(gid, stable=True)
+            self.sorted_gid = jnp.take(gid, self.perm)
         self.bounds = jnp.searchsorted(
             self.sorted_gid, jnp.arange(num_groups + 1,
                                         dtype=self.sorted_gid.dtype))
@@ -154,7 +165,8 @@ class _SortedGroups:
         accumulation at zero, so error scales with the group's own sum,
         same as segment_sum)."""
         from jax import lax
-        xs = jnp.take(x2d, self.perm, axis=0)
+        xs = x2d if self.perm is None \
+            else jnp.take(x2d, self.perm, axis=0)
         flags = jnp.broadcast_to(self.flags[:, None], xs.shape)
 
         def combine(a, b):
@@ -176,7 +188,8 @@ class _SortedGroups:
         (+inf for min / -inf for max); empty groups return the identity.
         """
         from jax import lax
-        xs = jnp.take(x2d, self.perm, axis=0)
+        xs = x2d if self.perm is None \
+            else jnp.take(x2d, self.perm, axis=0)
         flags = jnp.broadcast_to(self.flags[:, None], xs.shape)
 
         def combine(a, b):
@@ -190,6 +203,115 @@ class _SortedGroups:
         # clipped row and are masked by the caller's count grid
         ends = jnp.clip(self.bounds[1:] - 1, 0, self.s - 1)
         return jnp.take(scanned, ends, axis=0)
+
+    # -- mode "sorted2": blocked level-masked folds (same answers) ---- #
+
+    def sum2(self, x2d):
+        """[S, W] -> [G, W] per-group column sums via the blocked
+        level-masked reset-scan (_blocked_group_fold) — dtype-preserving,
+        so int32 counts ride native TPU adds instead of emulated f64."""
+        xs = x2d if self.perm is None \
+            else jnp.take(x2d, self.perm, axis=0)
+        return _blocked_group_fold(xs, self.flags, self.bounds, self.s,
+                                   jnp.add, 0)
+
+    def extreme2(self, x2d, want_max: bool):
+        """[S, W] -> [G, W] per-group min/max via the blocked fold;
+        same identity-fill contract as extreme()."""
+        xs = x2d if self.perm is None \
+            else jnp.take(x2d, self.perm, axis=0)
+        if want_max:
+            return _blocked_group_fold(xs, self.flags, self.bounds,
+                                       self.s, jnp.maximum, -jnp.inf)
+        return _blocked_group_fold(xs, self.flags, self.bounds, self.s,
+                                   jnp.minimum, jnp.inf)
+
+
+_SORTED2_K = 8          # rows per block in the blocked reset-scan
+
+
+def _blocked_group_fold(xs, flags, bounds, s_orig: int, op, identity):
+    """Per-group fold over group-sorted rows: a blocked, level-masked
+    segmented (reset) scan — the machinery behind group mode "sorted2".
+
+    Same answer as _SortedGroups' associative_scan reset-fold, ~3x less
+    device work on the value channel:
+
+      * the reset flags depend only on the [S] row axis, never on W, so
+        every level's carry mask is precomputed on [S] bools and the
+        heavy [S, W] channel pays ONE select+op per level instead of the
+        pair operator's add + two selects + a broadcast [S, W] bool OR;
+      * blocking at K rows halves the level count on the full-size
+        channel: log2(K) full-width levels + log2(S/K) levels on the
+        [S/K, W] block summaries (vs log2(S) full-width levels).
+
+    Like the reset-scan (and unlike a cumsum differenced at group
+    bounds), no addition ever combines values from two different groups
+    — error scales with each group's own magnitude, so the
+    1e15-next-to-1.0 skew contract holds (see _SortedGroups.sum).
+
+    xs: [S, W] group-sorted rows (any dtype with `op`/`identity`, f64
+    values or int32 counts); flags: [S] bool, True where a row starts a
+    new group run; bounds: [G+1] group row bounds; s_orig: valid row
+    count (xs rows past it are ignored).  Returns [G, W] per-group fold,
+    `identity` for empty groups.
+    """
+    k = _SORTED2_K
+    s, w = xs.shape
+    sp = -(-max(s, 1) // k) * k
+    if sp != s:
+        pad_rows = jnp.full((sp - s, w), identity, xs.dtype)
+        xs = jnp.concatenate([xs, pad_rows], axis=0)
+        flags = jnp.concatenate(
+            [flags, jnp.ones((sp - s,), bool)], axis=0)
+    nb = sp // k
+    pos_in_block = jnp.arange(sp, dtype=jnp.int32) % k
+
+    def shift_rows(a, d, fill):
+        return jnp.concatenate(
+            [jnp.full((d,) + a.shape[1:], fill, a.dtype), a[:-d]], axis=0)
+
+    # Within-block Hillis-Steele with per-level [S] carry masks: after
+    # log2(K) levels, row i holds the fold of its run restricted to its
+    # own block (runs reset at group starts).
+    fl = flags
+    v = xs
+    d = 1
+    while d < k:
+        in_block = pos_in_block >= d
+        carry = in_block & ~fl
+        v = jnp.where(carry[:, None], op(v, shift_rows(v, d, identity)), v)
+        fl = fl | (in_block & shift_rows(fl, d, False))
+        d *= 2
+
+    # Block summaries: Y[b] = fold of block b's trailing run; Fb[b] =
+    # block contains a run start (so carries stop at it).
+    y = v[k - 1::k]                                         # [nb, W]
+    fb = flags.reshape(nb, k).any(axis=1)                   # [nb]
+    zb = y
+    fbl = fb
+    bpos = jnp.arange(nb, dtype=jnp.int32)
+    d = 1
+    while d < nb:
+        carry_b = (bpos >= d) & ~fbl
+        zb = jnp.where(carry_b[:, None],
+                       op(zb, shift_rows(zb, d, identity)), zb)
+        fbl = fbl | ((bpos >= d) & shift_rows(fbl, d, False))
+        d *= 2
+
+    # Group g ends at row e: fold = intra[e], combined with the previous
+    # blocks' summary iff e's run reaches back past its block start
+    # (no flag in rows [block_start(e) .. e] — an OR-scan on [S] bools).
+    fcum = jnp.cumsum(flags.reshape(nb, k).astype(jnp.int32),
+                      axis=1).reshape(sp) > 0               # [S'] incl. OR
+    ends = jnp.clip(bounds[1:] - 1, 0, s_orig - 1)          # [G]
+    be = (ends // k).astype(jnp.int32)
+    intra_e = jnp.take(v, ends, axis=0)                     # [G, W]
+    z_prev = jnp.take(zb, jnp.clip(be - 1, 0, nb - 1), axis=0)
+    carry_e = ((~jnp.take(fcum, ends)) & (be > 0))[:, None]
+    out = jnp.where(carry_e, op(intra_e, z_prev), intra_e)
+    empty = (bounds[1:] == bounds[:-1])[:, None]
+    return jnp.where(empty, jnp.asarray(identity, xs.dtype), out)
 
 
 def grid_contributions(grid_ts, val, mask, agg: Aggregator):
@@ -258,7 +380,8 @@ def _flat_segments(contrib, participate, gid, num_groups: int):
 
 def moment_group_reduce(agg_name: str, contrib, participate, gid,
                         num_groups: int, combine_sum=_identity,
-                        combine_min=_identity, combine_max=_identity):
+                        combine_min=_identity, combine_max=_identity,
+                        rows_sorted: bool = False):
     """[S, W] -> ([G, W] out, [G, W] count) for moment-decomposable aggs.
 
     `combine_*` inject the cross-chip collectives (psum/pmin/pmax over the
@@ -276,17 +399,22 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
 
     if extremes:
         want_max = agg_name in ("max", "mimmax")
-        if mode == "sorted":
-            # contiguous-run reset-scan over group-sorted rows: no scatter
-            sg = _SortedGroups(gid, g, s)
+        if mode in ("sorted", "sorted2"):
+            # contiguous-run reset-scan over group-sorted rows: no
+            # scatter.  sorted2 = the blocked fold, with native-int32
+            # counts (exact: counts <= S).
+            sg = _SortedGroups(gid, g, s, rows_sorted)
+            fold = sg.sum2 if mode == "sorted2" else sg.sum
+            cdt = jnp.int32 if mode == "sorted2" else jnp.float64
             vf0 = contrib.astype(jnp.float64)
             ok0 = participate & ~jnp.isnan(vf0)
-            local_cnt = sg.sum(ok0.astype(jnp.float64))         # [G, W]
+            local_cnt = fold(ok0.astype(cdt))                   # [G, W]
             cnt_grid = combine_sum(local_cnt.reshape(-1)) \
                 .reshape(g, w).astype(jnp.int64)
             ident = -jnp.inf if want_max else jnp.inf
             filled = jnp.where(ok0, vf0, ident)
-            ext = sg.extreme(filled, want_max)
+            ext = (sg.extreme2(filled, want_max) if mode == "sorted2"
+                   else sg.extreme(filled, want_max))
             # a group empty on THIS shard must contribute the identity to
             # pmin/pmax, not the boundary gather's neighboring-run value
             ext = jnp.where(local_cnt > 0.5, ext, ident).reshape(-1)
@@ -317,11 +445,12 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     ok2 = participate & ~jnp.isnan(vf)
     v2 = jnp.where(ok2, vf, 0.0)
     use_matmul = mode == "matmul" and _matmul_feasible(s, g)
-    if mode == "sorted":
-        sg = _SortedGroups(gid, g, s)
+    if mode in ("sorted", "sorted2"):
+        sg = _SortedGroups(gid, g, s, rows_sorted)
+        fold = sg.sum2 if mode == "sorted2" else sg.sum
 
         def gsum(x2d):   # [S, W] -> [G, W], cross-chip combined
-            return combine_sum(sg.sum(x2d).reshape(-1)).reshape(g, w)
+            return combine_sum(fold(x2d).reshape(-1)).reshape(g, w)
     elif use_matmul:
         # out[g, w] = Σ_s onehot[s, g] * grid[s, w] — dense MXU work, no
         # serializing scatter.  Counts are 0/1 sums (exact in f64 far
@@ -344,7 +473,10 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
                 x2d.reshape(-1), seg, num_segments=num + 1)[:-1]) \
                 .reshape(g, w)
 
-    cnt_grid = gsum(ok2.astype(jnp.float64)).astype(jnp.int64)
+    # sorted2 counts ride int32 (native TPU adds, exact — counts <= S;
+    # psum combines int32 fine); other modes keep their f64/scatter form
+    cnt_dtype = jnp.int32 if mode == "sorted2" else jnp.float64
+    cnt_grid = gsum(ok2.astype(cnt_dtype)).astype(jnp.int64)
     safe = jnp.maximum(cnt_grid, 1)
 
     if agg_name in ("sum", "zimsum", "pfsum"):
@@ -464,19 +596,24 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
 
 
 def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
-                         agg: Aggregator):
+                         agg: Aggregator, rows_sorted: bool = False):
     """All-groups-at-once grid aggregation (single-device form).
 
     [S, W] batch + gid[S] -> (grid_ts[W], out[G, W], out_mask[G, W]).
     out_mask marks (group, window) cells where at least one member holds an
     actual (non-interpolated) value — the union-timestamp rule restricted to
     the shared grid.
+
+    rows_sorted=True is a CALLER GUARANTEE that gid is non-decreasing
+    (the planner always builds it that way, planner.py:403) — the sorted
+    modes then skip the argsort and the [S, W] permute gathers.  A false
+    claim silently misassigns rows to groups.
     """
     vf = val.astype(jnp.float64)
     contrib, participate = grid_contributions(grid_ts, vf, mask, agg)
     if is_moment_agg(agg.name):
         out, _ = moment_group_reduce(agg.name, contrib, participate, gid,
-                                     num_groups)
+                                     num_groups, rows_sorted=rows_sorted)
     else:
         out, _ = ordered_group_reduce(agg.name, contrib, participate, gid,
                                       num_groups)
@@ -487,13 +624,18 @@ def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
     # back into a dispatch the sorted mode was chosen to keep
     # scatter-free (review r5)
     extreme_agg = agg.name in ("min", "mimmin", "max", "mimmax")
-    if _effective_group_reduce_mode(
-            s, w, num_groups,
-            extremes=is_moment_agg(agg.name) and extreme_agg) == "sorted":
-        # same reset-scan machinery (XLA CSEs the repeated argsort)
-        present = _SortedGroups(gid, num_groups, s).sum(
-            mask.astype(jnp.float64))
-        out_mask = present > 0.5
+    mask_mode = _effective_group_reduce_mode(
+        s, w, num_groups,
+        extremes=is_moment_agg(agg.name) and extreme_agg)
+    if mask_mode in ("sorted", "sorted2"):
+        # same fold machinery as the reduce (XLA CSEs the repeated
+        # argsort/bounds); sorted2 presence rides native int32 adds.
+        # Both fold exact integer counts, so > 0 is the same test.
+        sg = _SortedGroups(gid, num_groups, s, rows_sorted)
+        present = (sg.sum2(mask.astype(jnp.int32))
+                   if mask_mode == "sorted2"
+                   else sg.sum(mask.astype(jnp.float64)))
+        out_mask = present > 0
     else:
         cols = jnp.arange(w, dtype=jnp.int64)[None, :]
         seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)

@@ -45,6 +45,10 @@ class PipelineSpec:
     # union-path tile budget override (<= 0: module default); the batched
     # union runner sets default/B so B vmapped groups share one envelope
     tile_cells: int = 0
+    # caller guarantee: the batch's gid is non-decreasing (the planner
+    # always emits groups as concatenated runs, planner.py:403) — the
+    # sorted group-reduce modes then skip argsort + permute gathers
+    rows_sorted: bool = False
 
 
 def _pipeline(spec: PipelineSpec, ts, val, mask, wargs):
@@ -168,7 +172,8 @@ def _grid_tail(spec: PipelineSpec, num_groups: int, wts, v, m, gid):
     if spec.rate is not None:
         grid_b = jnp.broadcast_to(grid[None, :], v.shape)
         _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
-    return grid_group_aggregate(grid, v, m, gid, num_groups, agg)
+    return grid_group_aggregate(grid, v, m, gid, num_groups, agg,
+                                rows_sorted=spec.rows_sorted)
 
 
 _jitted_group = jax.jit(_group_pipeline, static_argnums=(0, 1))
@@ -214,7 +219,8 @@ def _group_rollup_avg(spec: PipelineSpec, num_groups: int, ts_s, val_s,
         agg = Aggregator(agg.name, PREV, agg.reduce)
         grid_b = jnp.broadcast_to(grid[None, :], v.shape)
         _, v, m = rate(grid_b, v, m, spec.rate, all_int=False)
-    return grid_group_aggregate(grid, v, m, gid, num_groups, agg)
+    return grid_group_aggregate(grid, v, m, gid, num_groups, agg,
+                                rows_sorted=spec.rows_sorted)
 
 
 _jitted_group_rollup_avg = jax.jit(_group_rollup_avg, static_argnums=(0, 1))

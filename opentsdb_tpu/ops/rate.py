@@ -38,12 +38,19 @@ class RateOptions:
 
 
 def _prev_valid_index(mask):
-    """prev[k] = largest j < k with mask[j], else -1; per row, via cummax."""
+    """prev[k] = largest j < k with mask[j], else -1; per row, via cummax.
+
+    Indices ride int32: any axis length fits, int32 scans are native TPU
+    ALU work (int64 lowers to emulated u32-pair reduce-windows — ~7x
+    slower, and the u32-pair lowering trips an XLA scoped-vmem compile
+    bug at some [1, N] shapes: "Ran out of memory in memory space vmem
+    ... reduce-window u32[1,2,128]", seen on configs 1/4).
+    """
     s, n = mask.shape
-    pos = jnp.where(mask, jnp.arange(n, dtype=jnp.int64)[None, :], -1)
+    pos = jnp.where(mask, jnp.arange(n, dtype=jnp.int32)[None, :], -1)
     running = lax.associative_scan(jnp.maximum, pos, axis=1)
     prev = jnp.concatenate(
-        [jnp.full((s, 1), -1, dtype=jnp.int64), running[:, :-1]], axis=1)
+        [jnp.full((s, 1), -1, dtype=jnp.int32), running[:, :-1]], axis=1)
     return prev
 
 

@@ -225,12 +225,15 @@ def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False,
 
 
 def _next_valid(mask):
+    # int32 indices: native TPU scan (int64 = emulated u32 pairs, and
+    # the u32-pair reduce-window lowering trips an XLA scoped-vmem
+    # compile bug at some shapes — see rate._prev_valid_index).
     n = mask.shape[1]
-    big = jnp.asarray(n, jnp.int64)
-    pos = jnp.where(mask, jnp.arange(n, dtype=jnp.int64)[None, :], big)
+    big = jnp.asarray(n, jnp.int32)
+    pos = jnp.where(mask, jnp.arange(n, dtype=jnp.int32)[None, :], big)
     running = lax.associative_scan(jnp.minimum, pos, axis=1, reverse=True)
     return jnp.concatenate(
-        [running[:, 1:], jnp.full((mask.shape[0], 1), big, jnp.int64)], axis=1)
+        [running[:, 1:], jnp.full((mask.shape[0], 1), big, jnp.int32)], axis=1)
 
 
 def grid_aggregate(grid_ts, val, mask, agg: Aggregator, int_mode: bool = False):

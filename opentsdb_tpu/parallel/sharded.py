@@ -238,7 +238,10 @@ def _local_grid_tail(spec, num_groups: int, wts, v, m, gid):
             agg.name, contrib, participate, gid, g,
             combine_sum=lambda x: lax.psum(x, _BOTH),
             combine_min=lambda x: lax.pmin(x, _BOTH),
-            combine_max=lambda x: lax.pmax(x, _BOTH))
+            combine_max=lambda x: lax.pmax(x, _BOTH),
+            # contiguous row sharding + end-padding preserve the
+            # planner's non-decreasing gid on every shard
+            rows_sorted=spec.rows_sorted)
     else:
         # Gather-to-owner on the reduced grid: every chip receives all
         # rows (global row order preserved — first/last follow series

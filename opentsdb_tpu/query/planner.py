@@ -410,7 +410,10 @@ class QueryRunner:
                 seg.ds_function or ds.function, window_spec,
                 ds.fill_policy, ds.fill_value),
             rate=sub.rate_options if sub.rate else None,
-            int_mode=False)
+            int_mode=False,
+            # gid above is concatenated group runs — non-decreasing by
+            # construction; lets sorted reduce modes skip the permute
+            rows_sorted=True)
 
         total_points = sum(sum(c) for _, _, c in kept)
         ds_fn = seg.ds_function or ds.function

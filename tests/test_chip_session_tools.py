@@ -214,13 +214,12 @@ class TestStagePriorityOrder:
         the BASELINE table: bench + configs + histogram run before the
         race/attribution stages."""
         mod = _load(tmp_path)
-        order = {"bench": 0, "bench_configs": 1, "hist_bench": 2,
-                 "bench_prefix": 3, "stage_bench": 4, "profile": 5}
         names = ["bench_prefix", "stage_bench", "bench"] + \
             ["bench_configs:%d" % c for c in range(1, 8)] + \
             ["hist_bench", "profile"]
         stages = [(n, [], 0) for n in names]
-        stages.sort(key=lambda st: order.get(st[0].split(":")[0], 9))
+        stages.sort(
+            key=lambda st: mod.STAGE_PRIORITY.get(st[0].split(":")[0], 9))
         got = [n for n, _, _ in stages]
         assert got[0] == "bench"
         assert got[1:8] == ["bench_configs:%d" % c for c in range(1, 8)]

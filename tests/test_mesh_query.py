@@ -282,6 +282,112 @@ class TestSortedGroupReduce:
         finally:
             group_agg.set_group_reduce_mode("segment")
 
+    def test_sorted2_equals_segment(self):
+        """Mode "sorted2" (r5): blocked level-masked reset-fold + int32
+        counts must answer exactly like the segment scatter for every
+        moment aggregator including extremes."""
+        from opentsdb_tpu.ops import group_agg
+        t = _mk_tsdb(False)
+        _ingest(t)
+        wants = {m: _run(t, m) for m in self.QUERIES}       # segment mode
+        group_agg.set_group_reduce_mode("sorted2")
+        try:
+            for m in self.QUERIES:
+                assert_equivalent(_run(t, m), wants[m])
+        finally:
+            group_agg.set_group_reduce_mode("segment")
+
+    def test_sorted2_on_mesh(self, pair):
+        """sorted2 per-shard under shard_map: int32 count psums + blocked
+        folds must match the plain-store segment answers."""
+        from opentsdb_tpu.ops import group_agg
+        meshed, plain = pair
+        wants = {m: _run(plain, m) for m in self.QUERIES}   # segment mode
+        group_agg.set_group_reduce_mode("sorted2")
+        try:
+            for m in self.QUERIES:
+                assert_equivalent(_run(meshed, m), wants[m])
+        finally:
+            group_agg.set_group_reduce_mode("segment")
+
+    def test_sorted2_sum_magnitude_skew(self):
+        """The blocked fold must keep the reset-scan's error contract:
+        additions never cross a group boundary, so a 1.0-magnitude group
+        survives next to a 1e15-magnitude neighbor (a cumsum differenced
+        at group bounds would lose it)."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops import group_agg
+        s, w, g = 8, 4, 2
+        contrib = np.ones((s, w))
+        contrib[:4] = 1e15
+        contrib[4:] = 0.25
+        part = np.ones((s, w), bool)
+        gid = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+        group_agg.set_group_reduce_mode("sorted2")
+        try:
+            out, cnt = group_agg.moment_group_reduce(
+                "sum", jnp.asarray(contrib), jnp.asarray(part),
+                jnp.asarray(gid), g)
+        finally:
+            group_agg.set_group_reduce_mode("segment")
+        np.testing.assert_allclose(np.asarray(out)[0], 4e15, rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(out)[1], 1.0, rtol=1e-12)
+        np.testing.assert_array_equal(np.asarray(cnt), 4)
+
+    def test_blocked_fold_randomized(self):
+        """_blocked_group_fold vs numpy per-group folds across shapes
+        that exercise every block-boundary case: runs inside one block,
+        spanning blocks, block-aligned starts, empty groups, non-multiple
+        -of-K row counts, out-of-range gids, single rows."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.group_agg import (_SortedGroups,
+                                                _blocked_group_fold)
+        rng = np.random.default_rng(7)
+        for s, g in [(1, 1), (3, 2), (8, 2), (9, 4), (16, 1), (17, 5),
+                     (64, 7), (130, 13), (257, 40)]:
+            w = int(rng.integers(1, 6))
+            gid = np.sort(rng.integers(0, g, size=s))
+            if rng.random() < 0.3 and s > 2:    # out-of-range tail rows
+                gid[-1] = g + 1
+            x = rng.normal(size=(s, w)) * 10.0 ** float(rng.integers(-3, 4))
+            sg = _SortedGroups(jnp.asarray(np.sort(gid)), g, s)
+            got_sum = np.asarray(sg.sum2(jnp.asarray(x)))
+            got_min = np.asarray(sg.extreme2(jnp.asarray(x), False))
+            want_sum = np.zeros((g, w))
+            want_min = np.full((g, w), np.inf)
+            for gi in range(g):
+                rows = np.sort(gid) == gi
+                if rows.any():
+                    want_sum[gi] = x[rows].sum(axis=0)
+                    want_min[gi] = x[rows].min(axis=0)
+            np.testing.assert_allclose(got_sum, want_sum, rtol=1e-12,
+                                       err_msg="s=%d g=%d" % (s, g))
+            np.testing.assert_allclose(got_min, want_min, rtol=0,
+                                       err_msg="s=%d g=%d" % (s, g))
+
+    def test_presorted_skips_permute_same_answers(self):
+        """rows_sorted=True (the planner's layout guarantee) must answer
+        bit-for-bit like the argsort path on already-sorted gid, for
+        every fold flavor."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.group_agg import _SortedGroups
+        rng = np.random.default_rng(11)
+        for s, g in [(8, 3), (33, 5), (128, 100)]:
+            gid = jnp.asarray(np.sort(rng.integers(0, g, size=s)))
+            x = jnp.asarray(rng.normal(size=(s, 3)))
+            a = _SortedGroups(gid, g, s)
+            b = _SortedGroups(gid, g, s, presorted=True)
+            np.testing.assert_array_equal(np.asarray(a.sum(x)),
+                                          np.asarray(b.sum(x)))
+            np.testing.assert_array_equal(np.asarray(a.sum2(x)),
+                                          np.asarray(b.sum2(x)))
+            np.testing.assert_array_equal(
+                np.asarray(a.extreme(x, True)),
+                np.asarray(b.extreme(x, True)))
+            np.testing.assert_array_equal(
+                np.asarray(a.extreme2(x, False)),
+                np.asarray(b.extreme2(x, False)))
+
     def test_sorted_sum_magnitude_skew(self):
         """Cross-group cancellation regression (r4 review): a 1.0-magnitude
         group next to a 1e15-magnitude group must keep 1e-9 relative

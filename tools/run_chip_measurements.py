@@ -42,6 +42,13 @@ OUT = os.path.join(REPO, "BENCH_CONFIGS_r05.json")
 # its own row first).
 CONFIG_DEADLINE_S = 1500
 
+# Stage ORDER for a late tunnel recovery: the headline bench and the
+# BASELINE configs come before the race/attribution stages, so a session
+# cut short by the round boundary still produces the table the round is
+# for (module-level so the priority test exercises THIS dict).
+STAGE_PRIORITY = {"bench": 0, "bench_configs": 1, "hist_bench": 2,
+                  "bench_prefix": 3, "stage_bench": 4, "profile": 5}
+
 
 def run_stage(name: str, argv: list[str], timeout: int,
               extra_env: dict | None = None) -> tuple[list[str], int]:
@@ -107,10 +114,13 @@ def pick_winners(prefix_records: list[dict]) -> dict:
         "flat+int32+group_segment": ("flat", "scan", "segment"),
         "flat+int32+group_matmul": ("flat", "scan", "matmul"),
         "flat+int32+group_sorted": ("flat", "scan", "sorted"),
+        "flat+int32+group_sorted2": ("flat", "scan", "sorted2"),
         "subblock+int32+hier": ("subblock", "hier", "segment"),
         "subblock+int32+sorted": ("subblock", "scan", "sorted"),
         "flat+int32+hier+sorted": ("flat", "hier", "sorted"),
         "subblock+int32+hier+sorted": ("subblock", "hier", "sorted"),
+        "subblock+int32+hier+sorted2": ("subblock", "hier", "sorted2"),
+        "subblock2+int32+hier+sorted2": ("subblock2", "hier", "sorted2"),
     }
     timed = [(by_cfg[c], modes) for c, modes in full_rows.items()
              if c in by_cfg]
@@ -217,17 +227,11 @@ def main() -> None:
                                "measurement; see bench.py docstring",
             }) + "\n")
 
-    # Stage ORDER is priority order for a late tunnel recovery (the
-    # outage has eaten most of the round before): the headline bench and
-    # the BASELINE configs — configs 5-7 have never had a chip number —
-    # come before the race/attribution stages, so a session cut short by
-    # the round boundary still produces the table the round is for.
-    # bench.py uses the r4-crowned BENCH_WINNERS.json defaults (env
-    # overrides only appear once bench_prefix has run); the configs run
-    # under cost-model auto by design either way.
-    order = {"bench": 0, "bench_configs": 1, "hist_bench": 2,
-             "bench_prefix": 3, "stage_bench": 4, "profile": 5}
-    stages.sort(key=lambda st: order.get(st[0].split(":")[0], 9))
+    # STAGE_PRIORITY (module top): bench.py uses the prior-crowned
+    # BENCH_WINNERS.json defaults (env overrides only appear once
+    # bench_prefix has run); the configs run under cost-model auto by
+    # design either way.
+    stages.sort(key=lambda st: STAGE_PRIORITY.get(st[0].split(":")[0], 9))
 
     dead = False
     for name, argv, timeout in stages:

@@ -232,7 +232,7 @@ def main() -> None:
                 return moment_group_reduce("sum", c, p, gi, g_pad)
         return run
 
-    for mode in ("segment", "matmul", "sorted"):
+    for mode in ("segment", "matmul", "sorted", "sorted2"):
         record("group_reduce_" + mode, time_fn(
             jax.jit(reduce_under(mode)), (contrib, participate, gid_arr),
             rtt))
@@ -304,6 +304,33 @@ def main() -> None:
         jax.jit(chunk_dense_forced), (ts2, val2, mask2), rtt),
         points=s2 * n2)
 
+    # FULL production sliced update at the config-2 shape — chunk
+    # moments PLUS the donated-state slice merge, dynamic_update_slice
+    # write-back, and oob audit the chunk rows above exclude.  If config
+    # 2's observed per-chunk cost exceeds the winning chunk-moments row,
+    # the difference lives here.  State is threaded (donation consumes
+    # the input buffers), so each rep folds into the previous rep's
+    # state exactly like the production loop.
+    try:
+        full_spec = ds.WindowSpec("fixed", 1 << 20, 10_000)
+        full_wargs = {"first": jnp.asarray(start2 - (1 << 19) * 10_000,
+                                           jnp.int64),
+                      "nwin": jnp.asarray(1 << 20, jnp.int32)}
+        acc2 = st.StreamAccumulator.create(
+            s2, full_spec, full_wargs, lanes=lanes2,
+            window_slice=fixed2.count)
+        w0_mid = 1 << 19
+        acc2.update(ts2, val2, mask2, w0=w0_mid)       # compile + warm
+        acc2.oob_count()                               # force the queue
+        reps, t0 = 3, time.perf_counter()
+        for _ in range(reps):
+            acc2.update(ts2, val2, mask2, w0=w0_mid)
+            acc2.oob_count()
+        per = (time.perf_counter() - t0) / reps - rtt
+        record("stream_sliced_update", per, points=s2 * n2)
+    except Exception as e:   # noqa: BLE001 — keep later stages alive
+        _note("stream_sliced_update FAILED: %s" % e)
+
     # ---- cost-model calibration (ops/costmodel.py) -------------------
     # Convert THIS session's stage timings into the per-unit costs the
     # shape-driven mode chooser uses, so auto-selection follows the chip
@@ -325,6 +352,7 @@ def main() -> None:
             "seg_scatter": ("group_reduce_segment", S * w),
             "mxu_cell": ("group_reduce_matmul", g_pad * S * w),
             "sorted_grid": ("group_reduce_sorted", S * w),
+            "sorted2_grid": ("group_reduce_sorted2", S * w),
         }
         costs = {key: recorded[label] / denom
                  for key, (label, denom) in denoms.items()
