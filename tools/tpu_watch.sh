@@ -1,6 +1,6 @@
 #!/bin/bash
 # Probe the axon tunnel every ~5 min; on recovery, immediately run the
-# full chip measurement session (once), then keep logging status.
+# follow-up chip session (the stages r05 lost), then keep logging status.
 # Log: /tmp/tpu_watch.log   Measurement log: /tmp/chip_measurements.log
 cd /root/repo
 while true; do
@@ -18,7 +18,7 @@ print('ALIVE', ds)
     # consume the run), but cap attempts — a deterministic failure must
     # not monopolize the shared chip with back-to-back 8h sessions.
     # Marker holds "ok" after success, else the attempt count.
-    state=$(cat /tmp/chip_measurements.started 2>/dev/null)
+    state=$(cat /tmp/chip_followup.started 2>/dev/null)
     attempts=${state:-0}
     if [ "$state" = "ok" ]; then
       # done: stop probing entirely — a probe holds the exclusive tunnel
@@ -29,12 +29,12 @@ print('ALIVE', ds)
     fi
     if [ "$attempts" -lt 3 ] 2>/dev/null; then
       attempts=$((attempts + 1))
-      echo "$attempts" > /tmp/chip_measurements.started
+      echo "$attempts" > /tmp/chip_followup.started
       echo "$ts TPU BACK - measurement attempt $attempts" >> /tmp/tpu_watch.log
-      timeout 28800 python tools/run_chip_measurements.py \
-        > "/tmp/chip_measurements.$attempts.log" 2>&1
+      timeout 28800 python tools/run_followup_measurements.py \
+        > "/tmp/chip_followup.$attempts.log" 2>&1
       rc=$?
-      [ "$rc" = "0" ] && echo "ok" > /tmp/chip_measurements.started
+      [ "$rc" = "0" ] && echo "ok" > /tmp/chip_followup.started
       echo "$(date -u +%H:%M:%S) measurement attempt $attempts rc=$rc" \
         >> /tmp/tpu_watch.log
     fi
