@@ -35,6 +35,14 @@ from opentsdb_tpu.ops.union_agg import interpolate, _next_valid
 
 _I64_MAX = jnp.iinfo(jnp.int64).max
 
+
+def _seg_dtype(num: int):
+    """Segment/scatter id dtype: int32 whenever the id range fits.
+    int64 on TPU is an emulated u32 pair — scatter/gather index handling
+    is native at 32 bits, and every feasible (group, window) or (row,
+    window) id space here is far below 2^31."""
+    return jnp.int32 if num < 2 ** 31 else jnp.int64
+
 # Aggregators whose cross-series reduction decomposes into psum/pmin/pmax
 # combinable per-chip moments (count/sum/sumsq/min/max + two-pass dev).
 MOMENT_AGGS = frozenset({
@@ -370,8 +378,9 @@ def grid_contributions(grid_ts, val, mask, agg: Aggregator):
 def _flat_segments(contrib, participate, gid, num_groups: int):
     """Flatten [S, W] to (seg, ok, v) over (group, window) cells."""
     s, w = contrib.shape
-    cols = jnp.arange(w, dtype=jnp.int64)[None, :]
-    seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
+    dt = _seg_dtype(num_groups * w + w)
+    cols = jnp.arange(w, dtype=dt)[None, :]
+    seg = (gid.astype(dt)[:, None] * w + cols).reshape(-1)
     vf = contrib.astype(jnp.float64)
     ok = (participate & ~jnp.isnan(vf)).reshape(-1)
     v = jnp.where(ok, vf.reshape(-1), 0.0)
@@ -424,9 +433,9 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
             return out, cnt_grid
         # segment/matmul modes: extremes have no matmul form — scatter ops
         seg, ok, v = _flat_segments(contrib, participate, gid, g)
-        cnt = combine_sum(jax.ops.segment_sum(ok.astype(jnp.int64), seg,
+        cnt = combine_sum(jax.ops.segment_sum(ok.astype(jnp.int32), seg,
                                               num_segments=num))
-        cnt_grid = cnt.reshape(g, w)
+        cnt_grid = cnt.reshape(g, w).astype(jnp.int64)
         if agg_name in ("min", "mimmin"):
             ext = combine_min(jax.ops.segment_min(
                 jnp.where(ok, v, jnp.inf), seg, num_segments=num))
@@ -463,10 +472,11 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
         def gsum(x2d):   # [S, W] -> [G, W], cross-chip combined
             return combine_sum((o_t @ x2d).reshape(-1)).reshape(g, w)
     else:
-        cols = jnp.arange(w, dtype=jnp.int64)[None, :]
-        seg = (jnp.clip(gid.astype(jnp.int64), 0, g)[:, None] * w
+        dt = _seg_dtype(num + w)     # pre-clamp ids reach num + w - 1
+        cols = jnp.arange(w, dtype=dt)[None, :]
+        seg = (jnp.clip(gid.astype(dt), 0, g)[:, None] * w
                + cols).reshape(-1)
-        seg = jnp.where(seg < num, seg, num)
+        seg = jnp.where(seg < num, seg, jnp.asarray(num, dt))
 
         def gsum(x2d):
             return combine_sum(jax.ops.segment_sum(
@@ -528,20 +538,21 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
     num = g * w
     if not (agg_name == "median" or agg_name.startswith(("p", "ep"))):
         seg, ok, v = _flat_segments(contrib, participate, gid, g)
-        cnt = jax.ops.segment_sum(ok.astype(jnp.int64), seg,
-                                  num_segments=num).reshape(g, w)
+        cnt = jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                  num_segments=num).reshape(g, w) \
+            .astype(jnp.int64)
 
     if agg_name == "mult":
         out = jax.ops.segment_prod(jnp.where(ok, v, 1.0), seg,
                                    num_segments=num).reshape(g, w)
     elif agg_name in ("first", "last", "diff", "none"):
         rows = jnp.broadcast_to(
-            jnp.arange(s, dtype=jnp.int64)[:, None], (s, w)).reshape(-1)
+            jnp.arange(s, dtype=jnp.int32)[:, None], (s, w)).reshape(-1)
         first_row = jax.ops.segment_min(
-            jnp.where(ok, rows, jnp.asarray(s, jnp.int64)), seg,
+            jnp.where(ok, rows, jnp.asarray(s, jnp.int32)), seg,
             num_segments=num).reshape(g, w)
         last_row = jax.ops.segment_max(
-            jnp.where(ok, rows, jnp.asarray(-1, jnp.int64)), seg,
+            jnp.where(ok, rows, jnp.asarray(-1, jnp.int32)), seg,
             num_segments=num).reshape(g, w)
         vf = contrib.astype(jnp.float64)
         first_v = jnp.take_along_axis(vf, jnp.clip(first_row, 0, s - 1),
@@ -637,10 +648,11 @@ def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
                    else sg.sum(mask.astype(jnp.float64)))
         out_mask = present > 0
     else:
-        cols = jnp.arange(w, dtype=jnp.int64)[None, :]
-        seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
+        dt = _seg_dtype(num_groups * w + w)
+        cols = jnp.arange(w, dtype=dt)[None, :]
+        seg = (gid.astype(dt)[:, None] * w + cols).reshape(-1)
         present = jax.ops.segment_sum(
-            mask.reshape(-1).astype(jnp.int64), seg,
+            mask.reshape(-1).astype(jnp.int32), seg,
             num_segments=num_groups * w)
         out_mask = present.reshape(num_groups, w) > 0
     return grid_ts, out, out_mask

@@ -59,7 +59,6 @@ from opentsdb_tpu.ops.downsample import (
 # Summary points per (series, window) quantile sketch.
 SKETCH_K = 64
 
-_I64_MAX = np.iinfo(np.int64).max
 
 # Extra state lanes each downsample function's finish needs ("n" is always
 # present — it carries the output mask).  Restricting the accumulator to
@@ -178,8 +177,12 @@ def _segment_chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
     win = window_ids(ts, spec, wargs)
     nwin = wargs["nwin"]
     valid = ok & (win >= 0) & (win < nwin.astype(win.dtype))
-    winc = jnp.clip(win, 0, w - 1)
-    rows = jnp.arange(s, dtype=winc.dtype)[:, None]
+    # int32 ids once clipped in-range: int64 scatter indices are
+    # emulated u32 pairs on TPU (the id space s*w is far below 2^31)
+    from opentsdb_tpu.ops.group_agg import _seg_dtype
+    dt = _seg_dtype(s * w + w)
+    winc = jnp.clip(win, 0, w - 1).astype(dt)
+    rows = jnp.arange(s, dtype=dt)[:, None]
     seg = (rows * w + winc).reshape(-1)
 
     def reduce(data, ident, kind="sum"):
@@ -189,7 +192,7 @@ def _segment_chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
         return fn(flat, seg, num_segments=num,
                   indices_are_sorted=True).reshape(s, w)
 
-    cnt = reduce(jnp.ones_like(vf, dtype=jnp.int64), 0).astype(jnp.int64)
+    cnt = reduce(jnp.ones_like(vf, dtype=jnp.int32), 0).astype(jnp.int64)
     out = {"n": cnt}
     if "total" in lanes:
         tot = reduce(vf, 0.0)
@@ -294,20 +297,24 @@ def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
 
     seg_lanes = lanes & {"first", "last", "prod"}
     if seg_lanes or with_sketch:
+        from opentsdb_tpu.ops.group_agg import _seg_dtype
         num = s * w + 1
-        win = jnp.clip(raw_win, 0, w - 1)
+        dt = _seg_dtype(s * w + w)
+        win = jnp.clip(raw_win, 0, w - 1).astype(dt)
         valid = ok & (raw_win >= 0) & (raw_win
                                        < jnp.asarray(w, raw_win.dtype))
-        rows = jnp.arange(s, dtype=jnp.int64)[:, None]
-        seg = jnp.where(valid, rows * w + win, s * w).reshape(-1)
+        rows = jnp.arange(s, dtype=dt)[:, None]
+        seg = jnp.where(valid, rows * w + win,
+                        jnp.asarray(s * w, dt)).reshape(-1)
         flat = jnp.where(valid, vf, 0.0).reshape(-1)
         okf = valid.reshape(-1)
         if seg_lanes & {"first", "last"}:
-            pos = jnp.arange(s * n, dtype=jnp.int64)
+            dtp = _seg_dtype(s * n + 1)      # positions span s*n, not s*w
+            pos = jnp.arange(s * n, dtype=dtp)
             flat_v = vf.reshape(-1)
             if "first" in seg_lanes:
                 first_i = jax.ops.segment_min(
-                    jnp.where(okf, pos, _I64_MAX), seg,
+                    jnp.where(okf, pos, jnp.iinfo(dtp).max), seg,
                     num_segments=num)[:-1]
                 out["first"] = flat_v[
                     jnp.clip(first_i, 0, s * n - 1)].reshape(s, w)

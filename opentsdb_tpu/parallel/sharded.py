@@ -65,14 +65,18 @@ def _group_moments(ts, val, mask, gid, num_groups: int, spec: WindowSpec,
     valid = mask & (win >= 0) & (win < nwin.astype(win.dtype))
     vf = val.astype(jnp.float64)
     ok = valid & ~jnp.isnan(vf)
-    seg = jnp.where(ok, gid[:, None].astype(jnp.int64) * w
-                    + jnp.clip(win, 0, w - 1), num_groups * w)
+    # int32 segment ids + counts: int64 is an emulated u32 pair on TPU
+    from opentsdb_tpu.ops.group_agg import _seg_dtype
+    dt = _seg_dtype(num)
+    seg = jnp.where(ok, gid[:, None].astype(dt) * w
+                    + jnp.clip(win, 0, w - 1).astype(dt),
+                    jnp.asarray(num_groups * w, dt))
     seg = seg.reshape(-1)
     ok_flat = ok.reshape(-1)
     flat_v = jnp.where(ok_flat, vf.reshape(-1), 0.0)
 
-    count = jax.ops.segment_sum(ok_flat.astype(jnp.int64), seg,
-                                num_segments=num)[:-1]
+    count = jax.ops.segment_sum(ok_flat.astype(jnp.int32), seg,
+                                num_segments=num)[:-1].astype(jnp.int64)
     total = jax.ops.segment_sum(flat_v, seg, num_segments=num)[:-1]
     count = lax.psum(count, _BOTH)
     total = lax.psum(total, _BOTH)
@@ -139,8 +143,8 @@ def sharded_group_downsample(mesh: Mesh, agg_name: str, spec: WindowSpec,
             ts, val, mask, gid, num_groups, spec, wargs)
         out, cnt = _finish(agg_name, seg, ok_flat, flat_v, count, total,
                            num, num_groups, w)
-        live = jnp.arange(w, dtype=jnp.int64)[None, :] \
-            < wargs["nwin"].astype(jnp.int64)
+        live = jnp.arange(w, dtype=jnp.int32)[None, :] \
+            < wargs["nwin"].astype(jnp.int32)
         out_mask = (cnt > 0) & live
         out = jnp.where(out_mask, out, jnp.nan)
         wts = window_timestamps(spec, wargs)
@@ -176,14 +180,16 @@ def sharded_rollup(mesh: Mesh, spec: WindowSpec):
         valid = mask & (win >= 0) & (win < nwin.astype(win.dtype))
         vf = val.astype(jnp.float64)
         ok = valid & ~jnp.isnan(vf)
-        rows = jnp.arange(s, dtype=jnp.int64)[:, None]
-        seg = jnp.where(ok, rows * w + jnp.clip(win, 0, w - 1),
-                        s * w).reshape(-1)
+        from opentsdb_tpu.ops.group_agg import _seg_dtype
+        dt = _seg_dtype(num)
+        rows = jnp.arange(s, dtype=dt)[:, None]
+        seg = jnp.where(ok, rows * w + jnp.clip(win, 0, w - 1).astype(dt),
+                        jnp.asarray(s * w, dt)).reshape(-1)
         okf = ok.reshape(-1)
         flat = jnp.where(okf, vf.reshape(-1), 0.0)
 
-        cnt = jax.ops.segment_sum(okf.astype(jnp.int64), seg,
-                                  num_segments=num)[:-1]
+        cnt = jax.ops.segment_sum(okf.astype(jnp.int32), seg,
+                                  num_segments=num)[:-1].astype(jnp.int64)
         tot = jax.ops.segment_sum(flat, seg, num_segments=num)[:-1]
         lo = jax.ops.segment_min(jnp.where(okf, flat, jnp.inf), seg,
                                  num_segments=num)[:-1]
@@ -219,8 +225,8 @@ def _local_grid_tail(spec, num_groups: int, wts, v, m, gid):
     """
     from opentsdb_tpu.ops.aggregators import Aggregator, get_agg, PREV
     from opentsdb_tpu.ops.group_agg import (
-        grid_contributions, is_moment_agg, moment_group_reduce,
-        ordered_group_reduce)
+        _seg_dtype, grid_contributions, is_moment_agg,
+        moment_group_reduce, ordered_group_reduce)
     from opentsdb_tpu.ops.rate import rate
 
     g = num_groups
@@ -251,9 +257,10 @@ def _local_grid_tail(spec, num_groups: int, wts, v, m, gid):
         g_all = lax.all_gather(gid, _BOTH, axis=0, tiled=True)
         out, _ = ordered_group_reduce(agg.name, c_all, p_all, g_all, g)
     w = v.shape[1]
-    cols = jnp.arange(w, dtype=jnp.int64)[None, :]
-    seg = (gid.astype(jnp.int64)[:, None] * w + cols).reshape(-1)
-    present = jax.ops.segment_sum(m.reshape(-1).astype(jnp.int64), seg,
+    dt = _seg_dtype(g * w + w)
+    cols = jnp.arange(w, dtype=dt)[None, :]
+    seg = (gid.astype(dt)[:, None] * w + cols).reshape(-1)
+    present = jax.ops.segment_sum(m.reshape(-1).astype(jnp.int32), seg,
                                   num_segments=g * w)
     out_mask = lax.psum(present, _BOTH).reshape(g, w) > 0
     return wts, out, out_mask
