@@ -244,10 +244,16 @@ def assemble_columnar(groups_members, start_ms: int, end_ms: int,
     bounds = np.asarray(bounds_sorted, np.float64).reshape(-1, 2)
     mid = np.zeros(b_pad, np.float64)
     mid[:n_b] = (bounds[:, 0] + bounds[:, 1]) / 2.0
+    n_rows = _pad_pow2(max(row_base, 1))
+    seg = np.concatenate(seg_parts)
+    if n_rows * b_pad < 2 ** 31:
+        # scatter ids ride int32 (int64 is an emulated u32 pair on TPU);
+        # counts stay int64 — they are exact Java longs
+        seg = seg.astype(np.int32)
     return {
-        "seg": np.concatenate(seg_parts),
+        "seg": seg,
         "cnt": np.concatenate(cnt_parts),
-        "n_rows": _pad_pow2(max(row_base, 1)),
+        "n_rows": n_rows,
         "n_buckets": b_pad,
         "n_real_buckets": n_b,
         "bounds": bounds,
