@@ -231,3 +231,44 @@ class TestWindowIntoTypeRace:
                                                     True)
         assert not all_int and val.dtype == np.float64
         assert 3.5 in val[0][mask[0]]
+
+
+class TestSegDtypeGuards:
+    """int32 segment-id migration (r5 review): the dtype guard must test
+    the quantity the ids actually span, and flip to int64 exactly at
+    2^31."""
+
+    def test_boundary(self):
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.group_agg import _seg_dtype
+        assert _seg_dtype(2 ** 31 - 1) == jnp.int32
+        assert _seg_dtype(2 ** 31) == jnp.int64
+
+    def test_first_last_positions_span_points_not_ids(self):
+        """first/last lanes rank flat point positions (s*n of them);
+        the review caught the guard testing the smaller s*w id space.
+        Exercise the seg-lane path at n >> w and pin first/last values."""
+        import numpy as np
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.streaming import _chunk_moments
+        from opentsdb_tpu.ops.downsample import WindowSpec
+        s, n, w = 2, 64, 4
+        start = 1_356_998_400_000
+        step = 1_000
+        ts = start + np.arange(n, dtype=np.int64)[None, :] * step \
+            + np.zeros((s, 1), np.int64)
+        val = np.arange(s * n, dtype=np.float64).reshape(s, n)
+        wspec = WindowSpec("fixed", w, 16_000)
+        wargs = {"first": jnp.asarray(start, jnp.int64),
+                 "nwin": jnp.asarray(w, jnp.int32)}
+        out = _chunk_moments(jnp.asarray(ts), jnp.asarray(val),
+                             jnp.ones((s, n), bool), wspec, wargs,
+                             lanes=frozenset({"n", "first", "last"}))
+        first = np.asarray(out["first"])
+        last = np.asarray(out["last"])
+        # window k of row r covers points [16k, 16(k+1)): first/last are
+        # the row-flat values at those positions
+        for r in range(s):
+            for k in range(w):
+                assert first[r, k] == r * n + 16 * k
+                assert last[r, k] == r * n + 16 * (k + 1) - 1
