@@ -46,7 +46,7 @@ def main() -> None:
     combos = [(sc, se, gr)
               for sc in ("flat", "subblock", "subblock2")
               for se in ("scan", "compare_all", "hier")
-              for gr in ("segment", "matmul", "sorted")]
+              for gr in ("segment", "matmul", "sorted", "sorted2")]
     t0 = time.time()
     fails = 0
     for case in range(args.cases):
@@ -71,9 +71,15 @@ def main() -> None:
             val[i, :k] = v
             mask[i, :k] = rng.random(k) < 0.93
         gid = (np.arange(s) % groups).astype(np.int64)
+        # half the cases ride the planner's layout guarantee: sorted gid
+        # + rows_sorted=True (the presorted fast path skips the permute)
+        presorted = bool(rng.random() < 0.5)
+        if presorted:
+            gid = np.sort(gid)
         fixed = FixedWindows.for_range(start, start + span, interval)
         wspec, wargs = fixed.split()
-        spec = PipelineSpec(agg, DownsampleStep(dsfn, wspec, "none", 0.0))
+        spec = PipelineSpec(agg, DownsampleStep(dsfn, wspec, "none", 0.0),
+                            rows_sorted=presorted)
 
         def run():
             return [np.asarray(x) for x in run_group_pipeline(
