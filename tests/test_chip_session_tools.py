@@ -250,3 +250,97 @@ class TestStreamRatioCrowning:
         assert pick_stream_ratio(
             [{"label": "stream_chunk_segment",
               "error": "x"}]) is None
+
+
+def _load_followup(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "run_followup_measurements",
+        os.path.join(REPO, "tools", "run_followup_measurements.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # The module inserts REPO/tools into sys.path for its sibling
+    # import; drop every copy so repeated loads don't leak entries that
+    # could shadow imports in later-collected tests.
+    tools_dir = os.path.join(REPO, "tools")
+    while tools_dir in sys.path:
+        sys.path.remove(tools_dir)
+    mod.OUT = os.path.join(str(tmp_path), "r05b.json")
+    mod.CANON = os.path.join(str(tmp_path), "canon.json")
+    mod.DONE_STATE = os.path.join(str(tmp_path), "done.json")
+    return mod
+
+
+class TestFollowupMerge:
+    """merge_into_canonical is re-run after EVERY stage with the
+    cumulative results list; the superseded history must survive the
+    re-merges (it holds the only prior-session chip numbers)."""
+
+    BENCH_OLD = {"stage": "bench", "value": 518.0, "vs_baseline": 8.29}
+    BENCH_NEW = {"stage": "bench", "value": 489.0, "vs_baseline": 7.83}
+
+    def _write_canon(self, mod, rows):
+        with open(mod.CANON, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+
+    def _read_canon(self, mod):
+        return [json.loads(l) for l in open(mod.CANON) if l.strip()]
+
+    def test_superseded_survives_remerge(self, tmp_path):
+        mod = _load_followup(tmp_path)
+        self._write_canon(mod, [self.BENCH_OLD])
+        # write_out() merges after every stage: same record many times
+        for _ in range(3):
+            mod.merge_into_canonical([dict(self.BENCH_NEW)])
+        (row,) = self._read_canon(mod)
+        assert row["value"] == 489.0
+        assert row["superseded"] == [{"value": 518.0, "vs_baseline": 8.29}]
+
+    def test_superseded_history_chains(self, tmp_path):
+        # The crowned bench superseding the baseline bench must keep the
+        # prior session's number, not just the latest predecessor.
+        mod = _load_followup(tmp_path)
+        self._write_canon(mod, [self.BENCH_OLD])
+        mod.merge_into_canonical([dict(self.BENCH_NEW)])
+        crowned = {"stage": "bench", "value": 600.0, "vs_baseline": 9.6}
+        mod.merge_into_canonical([dict(self.BENCH_NEW), crowned])
+        (row,) = self._read_canon(mod)
+        assert row["value"] == 600.0
+        assert row["superseded"] == [
+            {"value": 489.0, "vs_baseline": 7.83},
+            {"value": 518.0, "vs_baseline": 8.29}]
+
+    def test_value_never_displaced_by_error(self, tmp_path):
+        mod = _load_followup(tmp_path)
+        self._write_canon(mod, [self.BENCH_OLD])
+        mod.merge_into_canonical([{"stage": "bench", "error": "boom"}])
+        (row,) = self._read_canon(mod)
+        assert row["value"] == 518.0
+
+    def test_fresh_value_supersedes_error_row(self, tmp_path):
+        mod = _load_followup(tmp_path)
+        self._write_canon(mod, [{"stage": "bench_configs:4",
+                                 "error": "rc=1"}])
+        mod.merge_into_canonical([{"stage": "bench_configs:4",
+                                   "value": 100.0, "vs_baseline": 1.6}])
+        (row,) = self._read_canon(mod)
+        assert row["value"] == 100.0
+        assert "superseded" not in row
+
+
+class TestFollowupResumeState:
+    """The done-state lets a retry resume at the first unmeasured stage
+    (tunnel windows are short); it must round-trip and key by position
+    so the two same-named bench entries stay distinct."""
+
+    def test_done_state_roundtrip(self, tmp_path):
+        mod = _load_followup(tmp_path)
+        assert mod._load_done() == set()
+        mod._save_done({"0:bench", "1:bench_configs:4"})
+        assert mod._load_done() == {"0:bench", "1:bench_configs:4"}
+
+    def test_corrupt_done_state_resets(self, tmp_path):
+        mod = _load_followup(tmp_path)
+        with open(mod.DONE_STATE, "w") as fh:
+            fh.write("not json")
+        assert mod._load_done() == set()

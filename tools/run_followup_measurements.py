@@ -49,6 +49,23 @@ from run_chip_measurements import (  # noqa: E402
 
 OUT = os.path.join(REPO, "BENCH_CONFIGS_r05b.json")
 CANON = os.path.join(REPO, "BENCH_CONFIGS_r05.json")
+# Stages completed across watcher attempts (tunnel windows are short —
+# the Aug 2 window lasted one stage): a retry resumes at the first stage
+# the previous attempt lost instead of re-measuring from the top.
+DONE_STATE = "/tmp/chip_followup.done"
+
+
+def _load_done() -> set:
+    try:
+        with open(DONE_STATE) as fh:
+            return set(json.load(fh))
+    except (OSError, ValueError):
+        return set()
+
+
+def _save_done(done: set) -> None:
+    with open(DONE_STATE, "w") as fh:
+        json.dump(sorted(done), fh)
 
 
 def merge_into_canonical(results: list[dict]) -> None:
@@ -70,9 +87,22 @@ def merge_into_canonical(results: list[dict]) -> None:
             continue
         prev = rows.get(stage)
         if prev is not None and "value" in prev:
+            if (prev.get("value") == rec.get("value")
+                    and prev.get("vs_baseline") == rec.get("vs_baseline")):
+                # Same record re-merged (write_out runs after every
+                # stage): keep prev and its superseded history intact.
+                continue
             rec = dict(rec)
-            rec["superseded"] = {k: prev[k] for k in
-                                 ("value", "vs_baseline") if k in prev}
+            # Chain the full history: a second supersede (e.g. the
+            # crowned bench over the baseline bench) must not erase the
+            # prior session's number.  Older dict-form entries migrate
+            # to the list form on the next merge.
+            hist = prev.get("superseded")
+            hist = ([] if hist is None
+                    else (hist if isinstance(hist, list) else [hist]))
+            rec["superseded"] = [{k: prev[k] for k in
+                                  ("value", "vs_baseline")
+                                  if k in prev}] + hist
         rows[stage] = rec
         if stage not in order:
             order.append(stage)
@@ -124,8 +154,42 @@ def main() -> None:
                                "follow-up session (r05b)"}) + "\n")
         merge_into_canonical(results)
 
+    done = _load_done()
+    # Re-seed this attempt's OUT with the prior attempts' measured rows
+    # for done stages, so r05b stays the union of the session's attempts
+    # rather than truncating to the latest one.
+    done_names = {k.split(":", 1)[1] for k in done}
+    try:
+        with open(OUT) as fh:
+            for ln in fh:
+                rec = json.loads(ln)
+                if ("value" in rec and rec.get("stage") in done_names):
+                    results.append(rec)
+    except (OSError, ValueError):
+        pass
+    # bench_prefix crowned winners in a prior attempt: rehydrate the env
+    # for this attempt's "WINNERS" stages (profile / crowned bench).
+    if any(k.endswith(":bench_prefix") for k in done):
+        try:
+            with open(os.path.join(REPO, "BENCH_WINNERS.json")) as fh:
+                winner_env = dict(json.load(fh).get("env", {}))
+        except (OSError, ValueError):
+            pass
     dead = False
-    for name, argv, timeout, env in stages:
+    any_failed = False
+    for idx, (name, argv, timeout, env) in enumerate(stages):
+        # Key by position, not name: the two "bench" entries (initial vs
+        # freshly-crowned) are distinct runs that merge under one stage.
+        # A done LATER entry of the same name also retires this one —
+        # re-running the baseline bench after the crowned bench already
+        # measured would supersede the crowned headline in the merge.
+        done_key = "%d:%s" % (idx, name)
+        if done_key in done or any(
+                k.split(":", 1)[1] == name and int(k.split(":", 1)[0]) > idx
+                for k in done):
+            print("== %s already measured (prior attempt); skipping =="
+                  % name, file=sys.stderr, flush=True)
+            continue
         if dead:
             results.append({"stage": name, "error":
                             "skipped: tunnel dead (post-failure probe)"})
@@ -166,16 +230,54 @@ def main() -> None:
             results.append({"stage": name, "error": str(e)})
             failed = True
         write_out()
-        if failed and not tunnel_alive():
-            print("== tunnel probe DEAD after %s: skipping remaining "
-                  "stages ==" % name, file=sys.stderr, flush=True)
-            dead = True
+        if not failed:
+            done.add(done_key)
+            _save_done(done)
+        else:
+            any_failed = True
+            if not tunnel_alive():
+                print("== tunnel probe DEAD after %s: skipping remaining "
+                      "stages ==" % name, file=sys.stderr, flush=True)
+                dead = True
 
     # The canonical config-2 row = the measured winner of the routing
-    # race, with the losing routing recorded alongside.
+    # race, with the losing routing recorded alongside.  Read the race
+    # rows back from the CANONICAL artifact (not just this attempt's
+    # results): after a resume, one routing may have been measured in a
+    # prior attempt, and crowning from a partial race would misreport
+    # the winner.
     raced = {r["stage"]: r for r in results
              if r.get("stage", "").startswith("bench_configs:2:")
              and "value" in r}
+    try:
+        with open(CANON) as fh:
+            for ln in fh:
+                rec = json.loads(ln)
+                if (rec.get("stage", "").startswith("bench_configs:2:")
+                        and "value" in rec
+                        and rec["stage"] not in raced):
+                    raced[rec["stage"]] = rec
+    except (OSError, ValueError):
+        pass
+    def _resolved(tag: str) -> bool:
+        # A routing is resolved once it has measured (any attempt) or
+        # actually EXECUTED this attempt (a failed routing still lets
+        # the surviving one be crowned; a later successful retry
+        # re-crowns the full race and supersedes).  A "skipped: tunnel
+        # dead" placeholder never ran — it must not resolve the race.
+        full = "bench_configs:2:" + tag
+        if any(k.split(":", 1)[1] == full for k in done):
+            return True
+        for r in results:
+            if r.get("stage") != full:
+                continue
+            if "value" in r or not str(r.get("error", "")).startswith(
+                    "skipped:"):
+                return True
+        return False
+    if not (raced and all(_resolved(t) for t in ("dense", "segment"))):
+        # A routing is still unresolved (pending retry): don't crown.
+        raced = {}
     if raced:
         best = max(raced.values(), key=lambda r: r["value"])
         rest = [r for r in raced.values() if r is not best]
@@ -188,6 +290,16 @@ def main() -> None:
         results.append(row)
         write_out()
     print("wrote %s (%d records)" % (OUT, len(results)))
+    # Nonzero exit when stages remain unmeasured (tunnel died or a stage
+    # failed) so the armed watcher retries; rc=0 marks the session done
+    # and clears the resume state (a stale done file would make a future
+    # re-armed session skip everything and report success on no work).
+    if dead or any_failed:
+        sys.exit(1)
+    try:
+        os.remove(DONE_STATE)
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

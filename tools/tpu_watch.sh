@@ -1,9 +1,20 @@
 #!/bin/bash
-# Probe the axon tunnel every ~5 min; on recovery, immediately run the
-# follow-up chip session (the stages r05 lost), then keep logging status.
-# Log: /tmp/tpu_watch.log   Measurement log: /tmp/chip_measurements.log
+# Probe the axon tunnel every ~4 min; on recovery, run the follow-up
+# chip session (the stages r05 lost).  The runner resumes across
+# attempts (/tmp/chip_followup.done) and exits nonzero while stages
+# remain unmeasured, so short tunnel windows accumulate coverage.
+# Hard stops: 3 attempts, or MAX_WALL_S since launch — an idle probe
+# must never race the driver's end-of-round bench for the exclusive
+# tunnel.  Log: /tmp/tpu_watch.log
 cd /root/repo
+START_TS=$(date +%s)
+MAX_WALL_S=${MAX_WALL_S:-28800}   # 8h
 while true; do
+  if [ $(($(date +%s) - START_TS)) -ge "$MAX_WALL_S" ]; then
+    echo "$(date -u +%H:%M:%S) wall cap reached; watcher exiting" \
+      >> /tmp/tpu_watch.log
+    exit 0
+  fi
   ts=$(date -u +%H:%M:%S)
   out=$(timeout 300 python -c "
 import jax
@@ -14,29 +25,39 @@ print('ALIVE', ds)
 " 2>&1)
   echo "$ts $(echo "$out" | tail -1)" >> /tmp/tpu_watch.log
   if echo "$out" | grep -q ALIVE; then
-    # retry until one SUCCESSFUL session (a transient ALIVE must not
-    # consume the run), but cap attempts — a deterministic failure must
-    # not monopolize the shared chip with back-to-back 8h sessions.
-    # Marker holds "ok" after success, else the attempt count.
     state=$(cat /tmp/chip_followup.started 2>/dev/null)
     attempts=${state:-0}
+    # Fresh arming (no attempt marker): clear any stale resume state
+    # from an EARLIER armed session, or the runner would skip its
+    # stages and report old rows as freshly measured.  Within one armed
+    # session the marker exists, so resume state survives retries.
+    [ -f /tmp/chip_followup.started ] || rm -f /tmp/chip_followup.done
     if [ "$state" = "ok" ]; then
-      # done: stop probing entirely — a probe holds the exclusive tunnel
-      # for seconds and two JAX processes deadlock it, so an idle watcher
-      # must not race the driver's end-of-round bench run
       echo "$ts measurement complete; watcher exiting" >> /tmp/tpu_watch.log
       exit 0
     fi
     if [ "$attempts" -lt 3 ] 2>/dev/null; then
+      # The wall cap bounds the RUN too, not just the next probe: a
+      # session launched near the cap must not hold the exclusive
+      # tunnel into the driver's end-of-round bench window.
+      remaining=$((MAX_WALL_S - ($(date +%s) - START_TS)))
+      if [ "$remaining" -lt 900 ]; then
+        echo "$ts tunnel back but <15min of wall budget; watcher exiting" \
+          >> /tmp/tpu_watch.log
+        exit 0
+      fi
       attempts=$((attempts + 1))
       echo "$attempts" > /tmp/chip_followup.started
       echo "$ts TPU BACK - measurement attempt $attempts" >> /tmp/tpu_watch.log
-      timeout 28800 python tools/run_followup_measurements.py \
+      timeout "$remaining" python tools/run_followup_measurements.py \
         > "/tmp/chip_followup.$attempts.log" 2>&1
       rc=$?
       [ "$rc" = "0" ] && echo "ok" > /tmp/chip_followup.started
       echo "$(date -u +%H:%M:%S) measurement attempt $attempts rc=$rc" \
         >> /tmp/tpu_watch.log
+    else
+      echo "$ts attempt cap reached; watcher exiting" >> /tmp/tpu_watch.log
+      exit 0
     fi
   fi
   sleep 240
