@@ -125,6 +125,37 @@ def _arm_watchdog(deadline_s: float) -> None:
     threading.Thread(target=fire, daemon=True).start()
 
 
+def arm_init_watchdog(timeout_s: float = 240.0) -> threading.Event:
+    """Short guard for BACKEND INIT in the session tools: a live tunnel
+    dials in seconds, a dead one hangs jax.devices() ~25 min before
+    raising (observed Aug 2) — burning most of a recovery window on a
+    stage that cannot measure.  Call, touch the backend, then set() the
+    returned event; on timeout a JSON error row keeps the artifact
+    parseable and exit 1 lets the session runner fail fast.  bench.py's
+    own driver runs keep the 1500s _arm_watchdog skip contract instead."""
+    ev = threading.Event()
+
+    def fire():
+        if not ev.wait(timeout_s):
+            print(json.dumps({
+                "metric": "backend_init",
+                "error": "backend init unresponsive %.0fs (tunnel dead)"
+                         % timeout_s}), flush=True)
+            sys.stdout.flush()
+            os._exit(1)
+    threading.Thread(target=fire, daemon=True).start()
+    return ev
+
+
+def guard_backend_init(timeout_s: float = 240.0) -> None:
+    """Arm the init watchdog, touch the backend, release — the one-call
+    form so call sites can't forget the release half of the contract."""
+    ev = arm_init_watchdog(timeout_s)
+    import jax
+    jax.devices()
+    ev.set()
+
+
 S = 1024          # series
 N = 65_536        # points per series  (S*N = 67.1M datapoints)
 GROUPS = 100

@@ -824,7 +824,19 @@ def main() -> None:
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
-    n_dev = len(jax.devices())
+    # Backend init fails fast on a dead tunnel (see guard_backend_init):
+    # only under a session deadline — unattended runs must not burn a
+    # recovery window inside a hung dial.
+    try:
+        if args.deadline > 0:
+            from bench import guard_backend_init
+            guard_backend_init()
+        n_dev = len(jax.devices())
+    except Exception as e:
+        print(json.dumps({
+            "metric": "backend_init",
+            "error": "backend init failed: %s" % e}), flush=True)
+        sys.exit(1)
     _note("devices: %d (%s)" % (n_dev, jax.devices()[0].platform))
     _RTT = measure_rtt()
     _note("tunnel rtt: %.4fs" % _RTT)

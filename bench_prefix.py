@@ -40,6 +40,10 @@ def main() -> None:
     # no-op on the chip, where the race is meant to run.
     ds.set_platform_mode_guard(False)
 
+    # Fail fast if the tunnel died since the previous stage (a hung
+    # dial burns the whole recovery window otherwise).
+    bench.guard_backend_init()
+
     batch = make_batch()                       # int32 ts_base layout
     batch64 = make_batch(precompacted=False)   # absolute int64 layout
     bench._note("batches resident")

@@ -49,9 +49,15 @@ def main() -> None:
     args = ap.parse_args()
 
     import opentsdb_tpu.ops  # noqa: F401  (jax x64)
+    import jax
     if args.platform:
-        import jax
         jax.config.update("jax_platforms", args.platform)
+    if args.platform != "cpu":
+        # Fail fast if the tunnel died since the previous stage (a hung
+        # dial burns the whole recovery window otherwise); CPU-forced
+        # smoke runs skip the guard — local init can't hang.
+        from bench import guard_backend_init
+        guard_backend_init()
 
     from opentsdb_tpu.core import TSDB
     from opentsdb_tpu.models import TSQuery, parse_m_subquery

@@ -63,6 +63,10 @@ def main() -> None:
     # no-op on the chip).
     ds.set_platform_mode_guard(False)
 
+    # Fail fast if the tunnel died since the previous stage (a hung
+    # dial burns the whole recovery window otherwise).
+    bench.guard_backend_init()
+
     batch = make_batch()
     _note("batch resident")
     spec, wargs, g_pad = build_spec()

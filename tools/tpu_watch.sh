@@ -3,9 +3,10 @@
 # chip session (the stages r05 lost).  The runner resumes across
 # attempts (/tmp/chip_followup.done) and exits nonzero while stages
 # remain unmeasured, so short tunnel windows accumulate coverage.
-# Hard stops: 3 attempts, or MAX_WALL_S since launch — an idle probe
-# must never race the driver's end-of-round bench for the exclusive
-# tunnel.  Log: /tmp/tpu_watch.log
+# Hard stops: 6 attempts (resume + the 240s init watchdog make a false
+# window cheap), or MAX_WALL_S since launch — an idle probe must never
+# race the driver's end-of-round bench for the exclusive tunnel.
+# Log: /tmp/tpu_watch.log
 cd /root/repo
 START_TS=$(date +%s)
 MAX_WALL_S=${MAX_WALL_S:-28800}   # 8h
@@ -36,7 +37,7 @@ print('ALIVE', ds)
       echo "$ts measurement complete; watcher exiting" >> /tmp/tpu_watch.log
       exit 0
     fi
-    if [ "$attempts" -lt 3 ] 2>/dev/null; then
+    if [ "$attempts" -lt 6 ] 2>/dev/null; then
       # The wall cap bounds the RUN too, not just the next probe: a
       # session launched near the cap must not hold the exclusive
       # tunnel into the driver's end-of-round bench window.
