@@ -190,6 +190,16 @@ def main() -> None:
             print("== %s already measured (prior attempt); skipping =="
                   % name, file=sys.stderr, flush=True)
             continue
+        # Cooperative session budget (tpu_watch.sh): stop STARTING
+        # stages near the wall deadline instead of being SIGKILLed
+        # mid-dispatch — that kill is the known tunnel-wedge mechanism.
+        wall_deadline = float(os.environ.get("SESSION_DEADLINE_UNIX", 0))
+        if wall_deadline and time.time() > wall_deadline - 600:
+            results.append({"stage": name, "error":
+                            "skipped: session wall budget exhausted"})
+            any_failed = True
+            write_out()
+            continue
         if dead:
             results.append({"stage": name, "error":
                             "skipped: tunnel dead (post-failure probe)"})

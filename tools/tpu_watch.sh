@@ -50,7 +50,12 @@ print('ALIVE', ds)
       attempts=$((attempts + 1))
       echo "$attempts" > /tmp/chip_followup.started
       echo "$ts TPU BACK - measurement attempt $attempts" >> /tmp/tpu_watch.log
-      timeout "$remaining" python tools/run_followup_measurements.py \
+      # Cooperative budget: the runner stops STARTING stages at the
+      # deadline and exits cleanly; the hard timeout is a distant
+      # backstop (a SIGKILL mid-dispatch on a live tunnel is the known
+      # wedge mechanism and would endanger the driver's own bench run).
+      SESSION_DEADLINE_UNIX=$(($(date +%s) + remaining)) \
+        timeout $((remaining + 1800)) python tools/run_followup_measurements.py \
         > "/tmp/chip_followup.$attempts.log" 2>&1
       rc=$?
       [ "$rc" = "0" ] && echo "ok" > /tmp/chip_followup.started
