@@ -48,6 +48,11 @@ class TSDB:
         self._query_limits = None
         self.maintenance = None
         self._apply_kernel_modes()
+        # chaos/failure-testing hooks (tsd.faults.config; no-op unless
+        # armed) — installed before any storage or network touchpoint so
+        # WAL-replay faults inject from the very first restore
+        from opentsdb_tpu.utils import faults
+        faults.install_from_config(self.config)
         self.metrics = UniqueId(
             UniqueIdType.METRIC,
             width=self.config.get_int("tsd.storage.uid.width.metric"),

@@ -174,7 +174,9 @@ class QueryExecutor:
                 fills[m["id"]] = float(fp.get("value", 0.0))
             else:
                 fills[m["id"]] = np.nan
-        for qr in serve_query(self.tsdb, ts_query, self.http_query):
+        exec_stats: dict = {}
+        for qr in serve_query(self.tsdb, ts_query, self.http_query,
+                              exec_stats=exec_stats):
             results[id_by_index[qr.index]].append(
                 SeriesResult.from_query_result(qr))
 
@@ -206,7 +208,14 @@ class QueryExecutor:
             elif oid in results:
                 out_objs.append(self._serialize_metric(
                     oid, output, results[oid]))
-        return {"outputs": out_objs, "query": self._echo_query()}
+        reply = {"outputs": out_objs, "query": self._echo_query()}
+        from opentsdb_tpu.tsd.cluster import partial_annotation
+        partial = partial_annotation(exec_stats)
+        if partial:
+            # degraded cluster serving: the 200 must not be silently
+            # partial
+            reply.update(partial)
+        return reply
 
     @staticmethod
     def _topo_order(exprs: dict[str, dict]) -> list[str]:

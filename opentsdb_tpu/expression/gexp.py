@@ -479,7 +479,8 @@ def handle_gexp_query(tsdb, query) -> None:
 
     metric_results: dict[str, list[SeriesResult]] = {m: [] for m in seen}
     by_index = {i: m for m, i in seen.items()}
-    for qr in serve_query(tsdb, ts_query, query):
+    exec_stats: dict = {}
+    for qr in serve_query(tsdb, ts_query, query, exec_stats=exec_stats):
         metric_results[by_index[qr.index]].append(
             SeriesResult.from_query_result(qr))
 
@@ -487,4 +488,10 @@ def handle_gexp_query(tsdb, query) -> None:
     for tree in trees:
         for s in evaluate_tree(tree, metric_results):
             out.append(s.to_query_json(ts_query.ms_resolution))
+    from opentsdb_tpu.tsd.cluster import partial_annotation
+    partial = partial_annotation(exec_stats)
+    if partial:
+        # degraded cluster serving: the 200 must not be silently partial
+        # (same trailer convention as /api/query)
+        out.append(partial)
     query.send_reply(out)

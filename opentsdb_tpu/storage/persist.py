@@ -24,6 +24,8 @@ import threading
 
 import numpy as np
 
+from opentsdb_tpu.utils import faults
+
 LOG = logging.getLogger("storage.persist")
 
 SNAPSHOT_JSON = "snapshot.json"
@@ -41,6 +43,11 @@ class DiskPersistence:
         self._wal_lock = threading.Lock()
         self._wal = None
         self.wal_records = 0
+        # opt-in per-append disk barrier (tsd.storage.wal.fsync): every
+        # journaled record is crash-durable before the write acks; off,
+        # durability rides the wal_sync_interval cadence
+        self._fsync_per_append = tsdb.config.get_bool(
+            "tsd.storage.wal.fsync")
 
     # ------------------------------------------------------------------ #
     # WAL                                                                #
@@ -51,12 +58,15 @@ class DiskPersistence:
 
     def journal(self, record: dict) -> None:
         """Append one ingest record; flushed per write (the WAL contract)."""
+        faults.check("wal.append")
         line = json.dumps(record, separators=(",", ":"))
         with self._wal_lock:
             if self._wal is None:
                 self._wal = open(self._wal_path(), "a", buffering=1)
             self._wal.write(line + "\n")
             self.wal_records += 1
+            if self._fsync_per_append:
+                os.fsync(self._wal.fileno())
 
     def sync_wal(self) -> None:
         """fsync the WAL so acknowledged writes survive an OS crash.
@@ -66,6 +76,7 @@ class DiskPersistence:
         by the maintenance thread (tsd.storage.wal_sync_interval) instead
         of per-write so the ingest path never pays it.
         """
+        faults.check("wal.fsync")
         with self._wal_lock:
             if self._wal is not None:
                 os.fsync(self._wal.fileno())
@@ -80,11 +91,46 @@ class DiskPersistence:
                 os.remove(path)
             self.wal_records = 0
 
+    def _trim_torn_tail(self, path: str) -> None:
+        """Truncate a newline-less final line (crash mid-append) BEFORE
+        replay and before appends resume.  Left in place, the next
+        journal() would concatenate its record onto the torn fragment —
+        destroying the first acknowledged post-restart write and turning
+        the tail into a mid-file-corruption false alarm on the replay
+        after that."""
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        with open(path, "rb+") as fh:
+            fh.seek(-1, os.SEEK_END)
+            if fh.read(1) == b"\n":
+                return
+            # scan back for the last newline in chunks
+            pos = size
+            keep = 0
+            while pos > 0:
+                step = min(65536, pos)
+                pos -= step
+                fh.seek(pos)
+                chunk = fh.read(step)
+                nl = chunk.rfind(b"\n")
+                if nl != -1:
+                    keep = pos + nl + 1
+                    break
+            LOG.warning(
+                "WAL replay: truncating torn final line (crash "
+                "mid-append, %d bytes past the last complete record)",
+                size - keep)
+            fh.truncate(keep)
+            fh.flush()
+            os.fsync(fh.fileno())
+
     def replay_wal(self) -> int:
         """Re-ingest journaled records (startup recovery)."""
         path = self._wal_path()
         if not os.path.exists(path):
             return 0
+        self._trim_torn_tail(path)
         tsdb = self.tsdb
         count = 0
         failed = 0
@@ -102,15 +148,28 @@ class DiskPersistence:
         tsdb = self.tsdb
         count = 0
         failed = 0
+        # _trim_torn_tail already removed the genuine crash artifact (a
+        # newline-less torn tail) before this runs, so an unparseable
+        # line here — tail included — is a fully-written record that
+        # got garbled: corruption worth alarming on, counted in the
+        # dropped-records total.  Replay continues either way so one
+        # bad line doesn't take down every later acknowledged write.
+        lineno = 0
         with open(path) as fh:
             for line in fh:
+                lineno += 1
                 line = line.strip()
                 if not line:
                     continue
                 try:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
-                    continue   # torn tail write from a crash
+                    failed += 1
+                    LOG.error(
+                        "WAL replay: skipped unparseable line %d "
+                        "(corruption — crash-torn tails are trimmed "
+                        "before replay): %r", lineno, line[:80])
+                    continue
                 kind = rec.get("k")
                 try:
                     if kind == "p":

@@ -83,6 +83,17 @@ def main(argv: list[str] | None = None) -> int:
 
     async def run():
         await server.start()
+        # SIGTERM/SIGINT take the GRACEFUL path (drain in-flight
+        # responder work, then tsdb.shutdown -> final snapshot) instead
+        # of the default instant kill — a supervisor's stop must not be
+        # a crash.  request_shutdown is idempotent and thread-safe.
+        import signal
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, server.request_shutdown)
+            except (NotImplementedError, RuntimeError):
+                pass         # non-main thread / platform without support
         await server.serve_forever()
 
     try:

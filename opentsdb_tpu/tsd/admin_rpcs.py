@@ -116,6 +116,10 @@ class StatsRpc(TelnetRpc, HttpRpc):
         collector = StatsCollector(
             "tsd", use_host_tag=True)
         collector.record_map(tsdb.collect_stats())
+        # cluster fault-tolerance surface: per-peer breaker state,
+        # retry/failure counters, partial-result tallies (tsd/cluster.py)
+        from opentsdb_tpu.tsd.cluster import collect_stats as cluster_stats
+        cluster_stats(tsdb, collector)
         if tsdb.rollup_store is not None:
             collector.record_map(tsdb.rollup_store.collect_stats())
         if self.rpc_manager is not None:

@@ -22,15 +22,41 @@ logical store and executes lock-step collectives — that path has the
 higher throughput ceiling but needs all processes in one JAX runtime;
 this one needs only HTTP reachability.
 
+Fault tolerance (the asynchbase role — the reference TSD survives
+RegionServer flaps because its storage client retries internally;
+direct HTTP fan-out needs its own layer):
+
+  * every peer fetch runs under capped-exponential-backoff retries
+    (utils/retry.py) with the overall budget from
+    `tsd.network.cluster.timeout_ms`;
+  * each peer has a circuit breaker: after
+    `tsd.network.cluster.breaker.threshold` consecutive fetch failures
+    it opens and fetches fail fast (no network) until
+    `tsd.network.cluster.breaker.cooldown_ms` elapses, then ONE
+    half-open probe decides (success closes it, failure re-opens);
+    state is surfaced through collect_stats -> /api/stats;
+  * `tsd.network.cluster.partial_results` picks the stance when a peer
+    still fails after all that: "error" (default — the reference's
+    scanner-error stance, a partial answer is worse than an error)
+    fails the query; "allow" folds whatever peers answered, marks
+    `exec_stats["partialResults"]`/`["clusterPeersFailed"]`, and the
+    query answers 200 with the surviving data (tsd/rpcs.py annotates
+    the response body).
+
 Config:
   tsd.network.cluster.peers       comma-separated "host:port" of the
                                   OTHER TSDs (empty = single-host serving)
-  tsd.network.cluster.timeout_ms  per-peer raw-series fetch timeout
+  tsd.network.cluster.timeout_ms  overall per-fetch budget (all retries)
+  tsd.network.cluster.partial_results           "error" | "allow"
+  tsd.network.cluster.retry.max_attempts        attempts per peer fetch
+  tsd.network.cluster.retry.attempt_timeout_ms  per-attempt deadline
+                                  (0 = the full remaining budget)
+  tsd.network.cluster.breaker.threshold         consecutive failures
+                                  that open a peer's breaker (0 = off)
+  tsd.network.cluster.breaker.cooldown_ms       open -> half-open delay
 
 Loop prevention: fan-out requests carry the `X-TSDB-Cluster: fanout`
 header; a TSD answering one serves purely from its local store.
-A peer failure fails the query (the reference's scanner-error stance:
-a partial answer is worse than an error).
 """
 
 from __future__ import annotations
@@ -38,12 +64,17 @@ from __future__ import annotations
 import copy
 import json
 import logging
+import threading
+import time
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+from opentsdb_tpu.utils import faults
+from opentsdb_tpu.utils.retry import RetryPolicy, call_with_retries
 
 LOG = logging.getLogger(__name__)
 
@@ -59,6 +90,183 @@ def is_fanout_request(http_query) -> bool:
     """True for requests issued by a peer's fan-out (serve locally)."""
     return bool(http_query.request.headers.get(CLUSTER_HEADER))
 
+
+# --------------------------------------------------------------------- #
+# Circuit breakers                                                      #
+# --------------------------------------------------------------------- #
+
+class BreakerOpenError(ConnectionError):
+    """A peer's circuit is open: failing fast without a network call."""
+
+
+class CircuitBreaker:
+    """closed -> (threshold consecutive failures) -> open ->
+    (cooldown) -> half-open probe -> closed | open.
+
+    ``threshold`` counts whole fetches (post-retry), not attempts:
+    retries absorb transients, the breaker reacts to persistent ones.
+    ``clock`` is injectable so tests drive the cooldown without sleeps.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int, cooldown_s: float, clock=time.monotonic):
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self._probing = False
+        self.opens = 0             # lifetime open transitions (stats)
+        self.fast_fails = 0        # calls refused while open (stats)
+
+    def allow(self) -> bool:
+        """True if a fetch may proceed now.  While open, the first call
+        after the cooldown becomes the single half-open probe."""
+        if self.threshold <= 0:
+            return True
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if self._clock() - self.opened_at >= self.cooldown_s:
+                    self.state = self.HALF_OPEN
+                    self._probing = True
+                    return True
+                self.fast_fails += 1
+                return False
+            # half-open: exactly one probe in flight.  Not counted as a
+            # fast fail — callers may WAIT on the probe's verdict
+            # (probe_pending) instead of failing.
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def probe_pending(self) -> bool:
+        """True while a half-open probe is in flight — a sibling fetch
+        (another subquery of the same clustered query) should await its
+        verdict rather than fast-fail; the probe's success must not
+        fail the very query that triggered it."""
+        with self._lock:
+            return self.state == self.HALF_OPEN and self._probing
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = self.CLOSED
+            self.consecutive_failures = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            if self.state == self.HALF_OPEN:
+                # failed probe: back to a full cooldown
+                self.state = self.OPEN
+                self.opened_at = self._clock()
+                self.opens += 1
+                self._probing = False
+                return
+            self.consecutive_failures += 1
+            if (self.state == self.CLOSED
+                    and self.consecutive_failures >= self.threshold):
+                self.state = self.OPEN
+                self.opened_at = self._clock()
+                self.opens += 1
+
+
+class ClusterState:
+    """Per-TSDB fault-tolerance state: one breaker per peer plus the
+    counters /api/stats surfaces.  Lives across queries (attached to the
+    TSDB instance by _state below)."""
+
+    def __init__(self, config):
+        self.threshold = config.get_int(
+            "tsd.network.cluster.breaker.threshold")
+        self.cooldown_s = config.get_int(
+            "tsd.network.cluster.breaker.cooldown_ms") / 1e3
+        self._lock = threading.Lock()
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self.fetch_retries = 0
+        self.fetch_failures = 0
+        self.partial_queries = 0
+        self.failed_queries = 0
+
+    def breaker(self, peer: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(peer)
+            if b is None:
+                b = self._breakers[peer] = CircuitBreaker(
+                    self.threshold, self.cooldown_s)
+            return b
+
+    def count(self, attr: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + n)
+
+    def breakers(self) -> dict[str, CircuitBreaker]:
+        with self._lock:
+            return dict(self._breakers)
+
+
+_STATE_LOCK = threading.Lock()
+
+
+def _state(tsdb) -> ClusterState:
+    state = getattr(tsdb, "_cluster_state", None)
+    if state is None:
+        with _STATE_LOCK:
+            state = getattr(tsdb, "_cluster_state", None)
+            if state is None:
+                state = ClusterState(tsdb.config)
+                tsdb._cluster_state = state
+    return state
+
+
+def partial_annotation(exec_stats: dict) -> dict | None:
+    """The degraded-serving annotation every query-shaped endpoint
+    attaches to a 200 that is missing peers (None when the fold was
+    complete).  One definition so the contract can't diverge per
+    endpoint."""
+    if not exec_stats.get("partialResults"):
+        return None
+    return {
+        "partialResults": True,
+        "clusterPeersFailed": exec_stats["clusterPeersFailed"],
+        "clusterPeers": exec_stats.get("clusterPeers", 0),
+    }
+
+
+def collect_stats(tsdb, collector) -> None:
+    """Cluster fault-tolerance telemetry for /api/stats + telnet stats.
+    Nothing is recorded on a TSD that never served clustered (the state
+    attaches on first fan-out), keeping single-host stats unchanged."""
+    state = getattr(tsdb, "_cluster_state", None)
+    if state is None:
+        return
+    collector.record("cluster.fetch.retries", state.fetch_retries)
+    collector.record("cluster.fetch.failures", state.fetch_failures)
+    collector.record("cluster.queries", state.partial_queries,
+                     "result=partial")
+    collector.record("cluster.queries", state.failed_queries,
+                     "result=failed")
+    numeric = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
+               CircuitBreaker.OPEN: 2}
+    for peer, b in sorted(state.breakers().items()):
+        collector.record("cluster.breaker.state", numeric[b.state],
+                         "peer=%s" % peer)
+        collector.record("cluster.breaker.opens", b.opens,
+                         "peer=%s" % peer)
+        collector.record("cluster.breaker.fast_fails", b.fast_fails,
+                         "peer=%s" % peer)
+
+
+# --------------------------------------------------------------------- #
+# Fan-out plumbing                                                      #
+# --------------------------------------------------------------------- #
 
 def _raw_query(ts_query: TSQuery) -> TSQuery:
     """The per-series extraction query: same range/filters, NO
@@ -101,6 +309,7 @@ def _sub_json(raw: TSQuery, index: int) -> dict:
 
 
 def _fetch_peer(peer: str, body: dict, timeout_s: float) -> list[dict]:
+    faults.check("cluster.peer_fetch", peer=peer)
     req = urllib.request.Request(
         "http://%s/api/query" % peer,
         data=json.dumps(body).encode(),
@@ -108,7 +317,91 @@ def _fetch_peer(peer: str, body: dict, timeout_s: float) -> list[dict]:
                  "X-TSDB-Cluster": "fanout"},
         method="POST")
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-        return json.loads(resp.read().decode())
+        data = resp.read()
+    data = faults.mangle("cluster.peer_body", data, peer=peer)
+    return json.loads(data.decode())
+
+
+def _retry_policy(config) -> RetryPolicy:
+    budget_s = max(config.get_int("tsd.network.cluster.timeout_ms"),
+                   1000) / 1e3
+    attempt_ms = config.get_int(
+        "tsd.network.cluster.retry.attempt_timeout_ms")
+    return RetryPolicy(
+        max_attempts=max(
+            config.get_int("tsd.network.cluster.retry.max_attempts"), 1),
+        budget_s=budget_s,
+        attempt_timeout_s=attempt_ms / 1e3 if attempt_ms > 0 else 0.0)
+
+
+class PeerRejectedError(RuntimeError):
+    """The peer answered a deterministic 4xx: reachable and responsive,
+    so neither retried (same request, same answer) nor a breaker
+    failure (availability is fine; the REQUEST is what it rejects)."""
+
+
+def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
+                   body: dict) -> list[dict]:
+    """One peer fetch under the full fault-tolerance stack: breaker
+    fast-fail, then retries with backoff inside the overall budget."""
+    breaker = state.breaker(peer)
+    start = time.monotonic()
+    allowed = breaker.allow()
+    if not allowed and breaker.probe_pending():
+        # a sibling subquery of this same query is the half-open probe:
+        # wait for its verdict instead of fast-failing — the probe's
+        # success must not fail the query that triggered it
+        deadline = start + policy.budget_s
+        while (not allowed and breaker.probe_pending()
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+            allowed = breaker.allow()
+        # the wait spent part of THIS fetch's overall budget — the
+        # retries below get only the remainder, keeping timeout_ms the
+        # true per-fetch ceiling
+        waited = time.monotonic() - start
+        if waited > 0.01:
+            import dataclasses
+            policy = dataclasses.replace(
+                policy, budget_s=max(policy.budget_s - waited, 0.1))
+    if not allowed:
+        state.count("fetch_failures")
+        raise BreakerOpenError(
+            "peer %s circuit is open (%d consecutive failures; retry "
+            "after cooldown)" % (peer, breaker.consecutive_failures))
+
+    def fetch(timeout_s: float) -> list[dict]:
+        try:
+            return _fetch_peer(peer, body, timeout_s)
+        except urllib.error.HTTPError as e:
+            if 400 <= e.code < 500:
+                raise PeerRejectedError(
+                    "peer %s rejected the raw-series fetch: HTTP %d"
+                    % (peer, e.code)) from e
+            raise
+
+    try:
+        result = call_with_retries(
+            fetch, policy,
+            no_retry_on=(PeerRejectedError,),
+            on_retry=lambda n, e: (
+                state.count("fetch_retries"),
+                LOG.warning("retrying peer %s (attempt %d failed: %s)",
+                            peer, n, e)))
+    except PeerRejectedError:
+        # responsive peer: availability-wise a SUCCESS — crucially this
+        # settles a half-open probe (otherwise _probing would stay set
+        # forever and wedge the breaker half-open with every later
+        # fetch busy-waiting on a verdict that never comes)
+        breaker.record_success()
+        state.count("fetch_failures")
+        raise
+    except Exception:
+        breaker.record_failure()
+        state.count("fetch_failures")
+        raise
+    breaker.record_success()
+    return result
 
 
 def _ingest_series(scratch, metric: str, tags: dict,
@@ -156,13 +449,20 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     against it.  Returns the planner's QueryResult list (drop-in for
     QueryRunner.run).  `exec_stats`, when given, receives the scratch
     runner's execution telemetry plus cluster counters (the /api/stats/
-    query surface must not go dark for clustered queries)."""
+    query surface must not go dark for clustered queries).
+
+    Peer failures (after retries/breakers): with
+    tsd.network.cluster.partial_results=error the first one fails the
+    query; with "allow" the surviving peers' data still answers and the
+    failed-peer count rides out in exec_stats."""
     from opentsdb_tpu.core import TSDB
     from opentsdb_tpu.utils.config import Config
 
     peers = cluster_peers(tsdb.config)
-    timeout_s = max(
-        tsdb.config.get_int("tsd.network.cluster.timeout_ms"), 1000) / 1e3
+    state = _state(tsdb)
+    policy = _retry_policy(tsdb.config)
+    allow_partial = (tsdb.config.get_string(
+        "tsd.network.cluster.partial_results").strip().lower() == "allow")
     raw = _raw_query(ts_query)
 
     scratch = TSDB(Config({
@@ -179,15 +479,17 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     jobs = [(peer, i) for peer in peers for i in range(len(raw.queries))]
     pool = futures = None
     if jobs:
-        # no context manager: a peer failure must return its error NOW,
-        # not after every straggling in-flight fetch drains its timeout
-        # (shutdown(wait=False, cancel_futures=True) drops the queued
-        # ones; already-running urllib calls finish in the background)
+        # no context manager: in "error" mode a peer failure must return
+        # its error NOW, not after every straggling in-flight fetch
+        # drains its timeout (shutdown(wait=False, cancel_futures=True)
+        # drops the queued ones; already-running urllib calls finish in
+        # the background)
         pool = ThreadPoolExecutor(max_workers=min(len(jobs), 16))
-        futures = {pool.submit(_fetch_peer, peer,
-                               _sub_json(raw, i), timeout_s):
-                   (peer, i) for peer, i in jobs}
+        futures = {pool.submit(_guarded_fetch, state, policy, peer,
+                               _sub_json(raw, i)): (peer, i)
+                   for peer, i in jobs}
 
+    failed_peers: set[str] = set()
     # local extraction: straight off this host's store/planner (objects,
     # no JSON round-trip), concurrent with the in-flight peer fetches
     try:
@@ -198,9 +500,17 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
                 try:
                     payload = fut.result()
                 except Exception as e:
-                    raise RuntimeError(
-                        "cluster peer %s failed the raw-series fetch: %s"
-                        % (peer, e)) from e
+                    if not allow_partial:
+                        state.count("failed_queries")
+                        raise RuntimeError(
+                            "cluster peer %s failed the raw-series "
+                            "fetch: %s" % (peer, e)) from e
+                    if peer not in failed_peers:
+                        failed_peers.add(peer)
+                        LOG.warning(
+                            "cluster peer %s failed; serving partial "
+                            "results without it: %s", peer, e)
+                    continue
                 for item in payload:
                     if "metric" not in item:
                         continue        # statsSummary etc.
@@ -211,8 +521,10 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     finally:
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
-    LOG.debug("cluster fan-out folded %d raw points from %d peers",
-              total, len(peers))
+    LOG.debug("cluster fan-out folded %d raw points from %d peers "
+              "(%d failed)", total, len(peers), len(failed_peers))
+    if failed_peers:
+        state.count("partial_queries")
     runner = scratch.new_query_runner()
     out = runner.run(ts_query)
     for qr in out:
@@ -225,4 +537,7 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
         exec_stats.update(runner.exec_stats)
         exec_stats["clusterPeers"] = len(peers)
         exec_stats["clusterRawPoints"] = total
+        if failed_peers:
+            exec_stats["clusterPeersFailed"] = len(failed_peers)
+            exec_stats["partialResults"] = True
     return out

@@ -47,23 +47,36 @@ class GraphHandler:
         # so a clustered operator's graphs must span the cluster too.
         # Cache consistency holds: clustered-vs-local depends only on
         # static config, so one cache key always maps to one mode.
-        from opentsdb_tpu.tsd.cluster import serve_query
-        results = serve_query(tsdb, ts_query, query)
+        from opentsdb_tpu.tsd.cluster import partial_annotation, serve_query
+        exec_stats: dict = {}
+        results = serve_query(tsdb, ts_query, query,
+                              exec_stats=exec_stats)
+        partial = partial_annotation(exec_stats)
         if mode == "ascii":
             body = self._ascii(results)
         elif mode == "json":
-            body = json.dumps({
+            reply = {
                 "plotted": sum(len(r.dps) for r in results),
                 "points": sum(len(r.dps) for r in results),
                 "etags": [sorted(r.tags.keys()) for r in results],
                 "timing": round(query.elapsed_ms()),
-            })
+            }
+            if partial:
+                reply.update(partial)
+            body = json.dumps(reply)
         else:
             body = self._svg(query, ts_query, results)
 
-        if cache_key is not None:
+        if cache_key is not None and not partial:
+            # a degraded answer must never be cached as the full one —
+            # later clients would read a silently partial graph
             self._write_cache(cache_key, body)
         query.send_reply(body, content_type=_CONTENT_TYPES[mode])
+        if partial:
+            # ascii/svg can't carry a body annotation; the header marks
+            # every /q mode uniformly
+            query.response.headers["X-TSDB-Partial-Results"] = str(
+                partial["clusterPeersFailed"])
 
     # -- renderers --
 

@@ -462,11 +462,23 @@ class QueryRpc(HttpRpc):
                 qs.mark("aggregationTime")
                 qs.stats.update(exec_stats)
             payload = query.serializer.format_query_v1(ts_query, results)
+            from opentsdb_tpu.tsd.cluster import partial_annotation
+            partial = partial_annotation(exec_stats)
+            if partial:
+                # degraded serving (tsd.network.cluster.partial_results=
+                # allow): the 200 must say out loud that peers were
+                # missing from the fold — a trailer entry (no "metric"
+                # key, so fan-out receivers and statsSummary-aware
+                # clients already skip it)
+                payload.append(partial)
             if ts_query.show_summary or ts_query.show_stats:
-                payload.append({"statsSummary": {
+                summary = {
                     "datapoints": sum(len(r.dps) for r in results),
                     "queryTime": round(query.elapsed_ms(), 3),
-                }})
+                }
+                if partial:
+                    summary.update(partial)
+                payload.append({"statsSummary": summary})
             query.send_reply(payload)
             if qs is not None and self.stats_registry is not None:
                 qs.mark("serializationTime")

@@ -14,6 +14,7 @@ RpcResponder.java) so jit-compiled query work never blocks the accept loop.
 from __future__ import annotations
 
 import asyncio
+import functools
 import logging
 import threading
 import time
@@ -27,6 +28,11 @@ LOG = logging.getLogger("tsd.server")
 
 MAX_REQUEST_BYTES = 64 * 1024 * 1024   # HttpRequestDecoder aggregator cap
 MAX_TELNET_LINE = 1024 * 1024
+# graceful-shutdown budget for in-flight responder work: generous
+# enough for the longest legitimate request (a full cluster retry
+# budget is 15s), bounded so one wedged handler can't hold the daemon
+# past its supervisor's patience
+DRAIN_GRACE_S = 30.0
 
 # Telnet put batching peeks at asyncio.StreamReader's buffered bytes to
 # decide whether another complete line can be consumed WITHOUT awaiting
@@ -80,6 +86,11 @@ class TSDServer:
         self.exceptions_caught = 0
         self.telnet_rpcs = 0
         self.http_rpcs = 0
+        # RPCs dispatched but whose reply has not hit the socket yet.
+        # Touched only on the event-loop thread (no lock); stop() waits
+        # on it so a drained handler's response still gets delivered
+        # before the TSDB (and then the loop) tears down.
+        self._inflight_rpcs = 0
         self._open_connections = 0
         self._conn_lock = threading.Lock()
         self.max_connections = tsdb.config.get_int(
@@ -115,7 +126,35 @@ class TSDServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
-        self._executor.shutdown(wait=False)
+        # Drain in-flight responder work BEFORE tearing down the TSDB:
+        # handlers may still be mid-write (a put landing, a query
+        # serializing), and shutdown(wait=False) + tsdb.shutdown() would
+        # snapshot/close the WAL underneath them.  cancel_futures drops
+        # QUEUED requests (accepted but unstarted — shutdown owes them
+        # nothing) while running ones finish; the drain runs in the
+        # loop's default executor so the event loop stays live and the
+        # draining handlers can still deliver their responses.  The wait
+        # is bounded: one wedged handler must not hold the daemon
+        # hostage past the grace period (the supervisor's SIGKILL would
+        # land us in exactly the mid-write teardown this drain avoids).
+        loop = asyncio.get_running_loop()
+        drain = loop.run_in_executor(
+            None, functools.partial(self._executor.shutdown, wait=True,
+                                    cancel_futures=True))
+        try:
+            await asyncio.wait_for(asyncio.shield(drain),
+                                   timeout=DRAIN_GRACE_S)
+        except asyncio.TimeoutError:
+            LOG.warning("responder drain exceeded %ss; proceeding with "
+                        "TSDB teardown (a handler is wedged)",
+                        DRAIN_GRACE_S)
+        # The drain guarantees the WORK finished; the handler coroutines
+        # still need loop time to write their replies.  Yield until the
+        # last dispatched reply hits its socket (bounded — a dead client
+        # can't block shutdown).
+        deadline = loop.time() + 5.0
+        while self._inflight_rpcs and loop.time() < deadline:
+            await asyncio.sleep(0.02)
         self.tsdb.shutdown()
         LOG.info("Server shut down")
 
@@ -226,48 +265,54 @@ class TSDServer:
                     writer.write(b"AUTH_FAIL\r\n")
                 await writer.drain()
                 continue
-            if auth is None and data.split(None, 1)[:1] == [b"put"]:
-                # Batch consecutive already-buffered put lines into ONE
-                # executor dispatch (the native columnar ingest): a
-                # pipelined writer otherwise pays a Python parse AND a
-                # thread-pool hop PER LINE.  Only complete lines already
-                # in the reader's buffer join — this never waits for
-                # more input, so single-line latency is unchanged.
-                block = [data]
-                too_long = False
-                while len(block) < 4096 and _has_buffered_line(reader):
-                    try:
-                        nxt = await reader.readline()
-                    except ValueError:
-                        # buffered line beyond MAX_TELNET_LINE: land the
-                        # lines collected so far, THEN reply the same
-                        # error the unpipelined path would
-                        too_long = True
-                        break
-                    if not nxt:
-                        break
-                    if (len(nxt) > MAX_TELNET_LINE
-                            or nxt.split(None, 1)[:1] != [b"put"]):
-                        pending = nxt     # main loop handles it next
-                        break
-                    block.append(nxt)
-                self.telnet_rpcs += len(block) - 1
-                reply = await loop.run_in_executor(
-                    self._executor, self.rpc_manager.handle_telnet_batch,
-                    conn, b"".join(block))
-                if too_long:
-                    if reply:
-                        writer.write(reply.encode())
-                    writer.write(b"error: line too long\n")
+            self._inflight_rpcs += 1
+            try:
+                if auth is None and data.split(None, 1)[:1] == [b"put"]:
+                    # Batch consecutive already-buffered put lines into
+                    # ONE executor dispatch (the native columnar
+                    # ingest): a pipelined writer otherwise pays a
+                    # Python parse AND a thread-pool hop PER LINE.  Only
+                    # complete lines already in the reader's buffer join
+                    # — this never waits for more input, so single-line
+                    # latency is unchanged.
+                    block = [data]
+                    too_long = False
+                    while len(block) < 4096 and _has_buffered_line(reader):
+                        try:
+                            nxt = await reader.readline()
+                        except ValueError:
+                            # buffered line beyond MAX_TELNET_LINE: land
+                            # the lines collected so far, THEN reply the
+                            # same error the unpipelined path would
+                            too_long = True
+                            break
+                        if not nxt:
+                            break
+                        if (len(nxt) > MAX_TELNET_LINE
+                                or nxt.split(None, 1)[:1] != [b"put"]):
+                            pending = nxt     # main loop handles it next
+                            break
+                        block.append(nxt)
+                    self.telnet_rpcs += len(block) - 1
+                    reply = await loop.run_in_executor(
+                        self._executor,
+                        self.rpc_manager.handle_telnet_batch,
+                        conn, b"".join(block))
+                    if too_long:
+                        if reply:
+                            writer.write(reply.encode())
+                        writer.write(b"error: line too long\n")
+                        await writer.drain()
+                        return
+                else:
+                    reply = await loop.run_in_executor(
+                        self._executor, self.rpc_manager.handle_telnet,
+                        conn, text)
+                if reply:
+                    writer.write(reply.encode())
                     await writer.drain()
-                    return
-            else:
-                reply = await loop.run_in_executor(
-                    self._executor, self.rpc_manager.handle_telnet, conn,
-                    text)
-            if reply:
-                writer.write(reply.encode())
-                await writer.drain()
+            finally:
+                self._inflight_rpcs -= 1
             if conn.close_after_write or not line:
                 return
 
@@ -320,15 +365,19 @@ class TSDServer:
             buffer = buffer[offset + length:] + body[length:]
 
             self.http_rpcs += 1
-            query = await loop.run_in_executor(
-                self._executor, self.rpc_manager.handle_http, request,
-                remote)
-            keep_alive = (request.version != "HTTP/1.0"
-                          and (request.header("connection") or "").lower()
-                          != "close")
-            response = query.response or HttpResponse(status=500)
-            writer.write(response.to_bytes(keep_alive))
-            await writer.drain()
+            self._inflight_rpcs += 1
+            try:
+                query = await loop.run_in_executor(
+                    self._executor, self.rpc_manager.handle_http, request,
+                    remote)
+                keep_alive = (request.version != "HTTP/1.0"
+                              and (request.header("connection")
+                                   or "").lower() != "close")
+                response = query.response or HttpResponse(status=500)
+                writer.write(response.to_bytes(keep_alive))
+                await writer.drain()
+            finally:
+                self._inflight_rpcs -= 1
             if not keep_alive:
                 return
             if not buffer:

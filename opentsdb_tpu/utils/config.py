@@ -24,7 +24,28 @@ DEFAULTS: dict[str, str] = {
     # request-driven cluster serving (tsd/cluster.py): other TSDs whose
     # stores this one fans /api/query out to (SaltScanner role)
     "tsd.network.cluster.peers": "",
+    # overall per-peer-fetch budget, shared across every retry attempt
     "tsd.network.cluster.timeout_ms": "15000",
+    # peer-failure stance after retries/breakers: "error" fails the
+    # query (the reference's scanner-error stance); "allow" answers 200
+    # with the surviving peers' data + exec_stats partialResults /
+    # clusterPeersFailed annotations
+    "tsd.network.cluster.partial_results": "error",
+    # retry/backoff for peer raw-series fetches (utils/retry.py).
+    # attempt_timeout 0 = each attempt may use the full remaining
+    # budget, so a slow-but-healthy peer keeps the window it had before
+    # retries existed; fast failures (refused, reset, garbage) leave
+    # most of the budget for their retries
+    "tsd.network.cluster.retry.max_attempts": "3",
+    "tsd.network.cluster.retry.attempt_timeout_ms": "0",
+    # per-peer circuit breaker: open after N consecutive fetch failures
+    # (0 disables), half-open probe after the cooldown; state surfaces
+    # via /api/stats (cluster.breaker.*)
+    "tsd.network.cluster.breaker.threshold": "5",
+    "tsd.network.cluster.breaker.cooldown_ms": "5000",
+    # fault injection (utils/faults.py): inline JSON spec list or @path.
+    # A testing/chaos surface — NEVER arm in production.
+    "tsd.faults.config": "",
     "tsd.network.port": "",
     "tsd.network.worker_threads": "",
     "tsd.network.async_io": "true",
@@ -161,6 +182,10 @@ DEFAULTS: dict[str, str] = {
     "tsd.storage.compaction.flush_speed": "2",
     # TPU-native durability cadences (maintenance thread; 0 = disabled).
     "tsd.storage.wal_sync_interval": "0",
+    # opt-in per-append WAL fsync: every journaled record hits the disk
+    # barrier before the write acks (crash-consistent at ingest cost;
+    # the default leans on the wal_sync_interval cadence instead)
+    "tsd.storage.wal.fsync": "false",
     "tsd.storage.snapshot_interval": "0",
     # Compressed binary snapshots via the native chunk engine (native/);
     # falls back to npz automatically when the library can't build.

@@ -1,0 +1,190 @@
+"""Fault injection: config-gated hook points on the failure-prone edges.
+
+Every fault-tolerance behavior in this codebase (peer retries, circuit
+breakers, degraded partial results, WAL crash recovery) ships with a
+deterministic failure test — which requires a way to MAKE the failure
+happen on demand.  Production code calls the module-level hooks at its
+hazard sites; with no faults armed each hook is a single attribute read
+(``_active`` False) so the request path pays nothing.
+
+Sites currently instrumented:
+
+  cluster.peer_fetch    before a peer HTTP fetch (tsd/cluster.py) —
+                        ``peer`` in the context
+  cluster.peer_body     the decoded peer response body, pre-parse
+  wal.append            before a WAL journal write (storage/persist.py)
+  wal.fsync             before a WAL fsync
+
+Fault kinds:
+
+  latency     {"kind": "latency", "ms": 500}           sleep, then pass
+  refuse      {"kind": "refuse"}                        ConnectionRefusedError
+  error       {"kind": "error", "message": "..."}       OSError
+  disconnect  {"kind": "disconnect"}                    ConnectionResetError
+              (at a body site: the body truncates mid-stream first, the
+              mid-response-disconnect shape)
+  garbage     {"kind": "garbage"}                        body replaced with
+              bytes that are not JSON (body sites only)
+
+Matching/arming:
+
+  {"site": "cluster.peer_fetch", "kind": "refuse",
+   "match": {"peer": "127.0.0.1:4243"},   # optional ctx equality filter
+   "times": 2}                            # optional: fire N times then
+                                          # disarm (omitted = every call)
+
+Specs install programmatically (``install([...])`` — what the tests and
+tools/chaos_soak.py use) or from config: ``tsd.faults.config`` holds
+inline JSON (a list of specs) or ``@/path/to/specs.json``, read once by
+``install_from_config`` at TSDB construction.  Injection is a testing
+surface; the config gate exists so a REAL spawned daemon (crash/chaos
+soaks) can run with faults armed — never arm it in production.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+LOG = logging.getLogger(__name__)
+
+CONFIG_KEY = "tsd.faults.config"
+
+
+class FaultError(OSError):
+    """Raised by the generic "error" fault kind."""
+
+
+class _Fault:
+    def __init__(self, spec: dict):
+        self.site = spec["site"]
+        self.kind = spec["kind"]
+        self.spec = dict(spec)
+        self.match = spec.get("match") or {}
+        self.times = spec.get("times")      # None = unlimited
+        self.fired = 0
+
+    def applies(self, ctx: dict) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+
+class FaultInjector:
+    """The registry.  One process-wide instance (``FAULTS``) — hook
+    sites are module-level calls, and the soak tools arm faults before
+    the daemon under test constructs its TSDB."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._faults: list[_Fault] = []
+        self._active = False        # fast-path gate, read without lock
+        self._installed_configs: set[str] = set()
+        self.injected = 0
+
+    # -- arming --
+
+    def install(self, specs: list[dict]) -> None:
+        with self._lock:
+            self._faults.extend(_Fault(s) for s in specs)
+            self._active = bool(self._faults)
+        if specs:
+            LOG.warning("fault injection ARMED: %d spec(s) — %s",
+                        len(specs),
+                        ", ".join("%s/%s" % (s["site"], s["kind"])
+                                  for s in specs))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._faults.clear()
+            self._installed_configs.clear()
+            self._active = False
+
+    def install_from_config(self, config) -> None:
+        """Read ``tsd.faults.config`` (inline JSON list or ``@path``).
+
+        Idempotent per spec string: every TSDB construction in the
+        process calls this, and a second TSDB on the same config must
+        not double-arm the specs (a "times": 1 fault firing twice)."""
+        raw = (config.get_string(CONFIG_KEY)
+               if config.has_property(CONFIG_KEY) else "") or ""
+        raw = raw.strip()
+        if not raw:
+            return
+        with self._lock:
+            if raw in self._installed_configs:
+                return
+            self._installed_configs.add(raw)
+        try:
+            if raw.startswith("@"):
+                with open(raw[1:]) as fh:
+                    specs = json.load(fh)
+            else:
+                specs = json.loads(raw)
+        except (OSError, ValueError) as e:
+            LOG.error("ignoring unreadable %s: %s", CONFIG_KEY, e)
+            return
+        if isinstance(specs, dict):
+            specs = [specs]
+        self.install(specs)
+
+    # -- hook points --
+
+    def _take(self, site: str, kinds: tuple, ctx: dict) -> _Fault | None:
+        with self._lock:
+            for f in self._faults:
+                if f.site == site and f.kind in kinds and f.applies(ctx):
+                    f.fired += 1
+                    self.injected += 1
+                    return f
+        return None
+
+    def check(self, site: str, **ctx) -> None:
+        """Call at a hazard site; may sleep and/or raise the armed
+        failure.  No-op (one attribute read) when nothing is armed."""
+        if not self._active:
+            return
+        f = self._take(site, ("latency", "refuse", "error", "disconnect"),
+                       ctx)
+        if f is None:
+            return
+        if f.kind == "latency":
+            time.sleep(f.spec.get("ms", 100) / 1e3)
+            return
+        LOG.info("injecting %s at %s (%s)", f.kind, site, ctx)
+        if f.kind == "refuse":
+            raise ConnectionRefusedError(
+                "injected connection refusal at %s" % site)
+        if f.kind == "disconnect":
+            raise ConnectionResetError(
+                "injected disconnect at %s" % site)
+        raise FaultError(f.spec.get("message",
+                                    "injected fault at %s" % site))
+
+    def mangle(self, site: str, data: bytes, **ctx) -> bytes:
+        """Body-corruption hook: pass the payload through; an armed
+        fault replaces it with garbage or truncates it mid-stream (the
+        "disconnect" shape: half a body, then the peer goes away)."""
+        if not self._active:
+            return data
+        f = self._take(site, ("garbage", "disconnect"), ctx)
+        if f is None:
+            return data
+        LOG.info("injecting %s at %s (%s)", f.kind, site, ctx)
+        if f.kind == "garbage":
+            return b"\x00garbage{{{not json"
+        raise ConnectionResetError(
+            "injected mid-body disconnect at %s after %d bytes"
+            % (site, len(data) // 2))
+
+
+FAULTS = FaultInjector()
+
+# module-level aliases: hazard sites call faults.check(...)/faults.mangle
+check = FAULTS.check
+mangle = FAULTS.mangle
+install = FAULTS.install
+clear = FAULTS.clear
+install_from_config = FAULTS.install_from_config

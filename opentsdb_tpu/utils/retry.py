@@ -1,0 +1,103 @@
+"""Capped exponential backoff with jitter, per-attempt deadlines, and an
+overall time budget.
+
+The role asynchbase's internal retry machinery played for the reference
+(HBaseClient retries RegionServer RPCs through NSRE/flap windows so the
+TSD above it never sees a transient): our rebuild replaced asynchbase
+with direct HTTP fan-out (tsd/cluster.py) and dropped that layer — this
+module restores it as a reusable utility.
+
+Semantics:
+
+  * up to ``max_attempts`` calls of ``fn(attempt_timeout_s)``;
+  * each attempt gets a deadline: the configured per-attempt cap (or,
+    unset, the whole budget — a slow-but-healthy first attempt keeps
+    the full window it had before retries existed; retries then run on
+    whatever remains, which fast failures like a refused connection
+    leave nearly intact) bounded by the remaining overall budget;
+  * between attempts: capped exponential backoff with full jitter
+    (delay = uniform(0, min(cap, base * mult**n))) — the AWS-style
+    decorrelation that keeps a retry thundering herd from
+    re-synchronizing on a recovering peer;
+  * a retry is only scheduled while budget remains for both the sleep
+    AND a meaningful next attempt (``min_attempt_s``); otherwise the
+    last error raises immediately.
+
+``clock``/``sleep``/``rand`` are injectable so the fault-injection tests
+drive every branch deterministically (tests/test_fault_tolerance.py).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Tuple, Type
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How a retried call behaves.  ``budget_s`` is the overall wall
+    budget across every attempt and backoff sleep (for cluster fetches:
+    ``tsd.network.cluster.timeout_ms``)."""
+
+    max_attempts: int = 3
+    budget_s: float = 15.0
+    attempt_timeout_s: float = 0.0   # 0 = the full budget per attempt
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    min_attempt_s: float = 0.05      # don't bother with a sliver attempt
+
+    def per_attempt_s(self) -> float:
+        if self.attempt_timeout_s > 0:
+            return self.attempt_timeout_s
+        return self.budget_s
+
+
+def call_with_retries(fn: Callable[[float], object],
+                      policy: RetryPolicy,
+                      retry_on: Tuple[Type[BaseException], ...]
+                      = (Exception,),
+                      no_retry_on: Tuple[Type[BaseException], ...] = (),
+                      on_retry: Callable[[int, BaseException], None]
+                      | None = None,
+                      clock: Callable[[], float] = time.monotonic,
+                      sleep: Callable[[float], None] = time.sleep,
+                      rand: Callable[[], float] = random.random):
+    """Run ``fn(attempt_timeout_s)`` under ``policy``; returns its value
+    or raises the last error once attempts/budget are exhausted.
+    ``no_retry_on`` wins over ``retry_on``: a deterministic failure
+    (e.g. the server rejected the request as malformed) propagates
+    immediately — retrying the same request buys the same answer.
+    ``on_retry(attempt_number, exc)`` fires before each backoff sleep
+    (telemetry hook — cluster.py counts these into /api/stats)."""
+    start = clock()
+    last_exc: BaseException | None = None
+    for attempt in range(1, policy.max_attempts + 1):
+        remaining = policy.budget_s - (clock() - start)
+        if remaining <= 0:
+            break
+        try:
+            return fn(min(policy.per_attempt_s(), remaining))
+        except retry_on as e:      # noqa: PERF203 — the retry loop
+            if no_retry_on and isinstance(e, no_retry_on):
+                raise
+            last_exc = e
+            if attempt >= policy.max_attempts:
+                break
+            delay = min(policy.max_delay_s,
+                        policy.base_delay_s
+                        * policy.multiplier ** (attempt - 1)) * rand()
+            remaining = policy.budget_s - (clock() - start)
+            if remaining - delay < policy.min_attempt_s:
+                break              # no budget left for a real retry
+            if on_retry is not None:
+                on_retry(attempt, e)
+            if delay > 0:
+                sleep(delay)
+    if last_exc is None:
+        raise TimeoutError(
+            "retry budget %.3fs exhausted before the first attempt"
+            % policy.budget_s)
+    raise last_exc

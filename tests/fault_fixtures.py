@@ -1,0 +1,178 @@
+"""Deterministic failure machinery for the fault-tolerance tests.
+
+`FaultyPeer` is a real TCP server speaking just enough HTTP to stand in
+for a cluster peer's /api/query: every fan-out fetch hits an actual
+socket, and the fault mode decides what the wire does — answer
+correctly, hang, cut the connection mid-body, or return bytes that are
+not JSON.  Failures are injected by the SERVER side, so the client
+stack under test (urllib + retry + breaker in tsd/cluster.py) sees the
+genuine network error shapes, not monkeypatched stand-ins.
+
+No sleeps-as-synchronization anywhere: "timeout" holds the socket open
+until the client's own deadline fires, and breaker cooldowns are driven
+by rewinding `opened_at` (see force_cooldown_elapsed) instead of
+waiting wall-clock time.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+
+# fault modes a FaultyPeer can serve
+OK = "ok"                   # 200 + canned payload
+TIMEOUT = "timeout"         # accept, read, never answer
+DISCONNECT = "disconnect"   # 200 headers, half the body, RST
+GARBAGE = "garbage"         # 200 + bytes that are not JSON
+ERROR_500 = "error500"      # well-formed 500 (transient: retried)
+ERROR_400 = "error400"      # well-formed 400 (deterministic: not retried)
+
+
+def refused_port() -> int:
+    """A port with nothing listening: connecting gets ECONNREFUSED
+    deterministically (bound then immediately released, so the OS
+    won't reassign it to another listener within the test)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class FaultyPeer:
+    """A fake peer TSD on a live socket with a switchable fault mode.
+
+    ``peer.mode = TIMEOUT`` flips behavior between requests;
+    ``peer.script = [GARBAGE, OK]`` serves one mode per request then
+    falls back to ``mode`` (deterministic transient-then-recover);
+    ``peer.requests`` counts connections that delivered a full request
+    (the breaker fast-fail tests assert this does NOT grow)."""
+
+    def __init__(self, payload: list[dict] | None = None):
+        self.payload = payload if payload is not None else []
+        self.mode = OK
+        self.script: list[str] = []
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._hung: list[socket.socket] = []
+        self._closing = False
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> str:
+        return "127.0.0.1:%d" % self.port
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for c in self._hung:        # release clients stuck in TIMEOUT
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._hung.clear()
+        self._thread.join(5)
+
+    # -- server internals --
+
+    def _serve(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _read_request(self, conn: socket.socket) -> bytes | None:
+        conn.settimeout(10)
+        data = b""
+        while b"\r\n\r\n" not in data:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            data += chunk
+        head, _, rest = data.partition(b"\r\n\r\n")
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":", 1)[1])
+        while len(rest) < length:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None
+            rest += chunk
+        return rest[:length]
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            if self._read_request(conn) is None:
+                return
+            with self._lock:
+                mode = self.script.pop(0) if self.script else self.mode
+                self.requests += 1
+            if mode == TIMEOUT:
+                # hold the connection open, never answer: the client's
+                # own per-attempt deadline is what fires
+                with self._lock:
+                    self._hung.append(conn)
+                return                  # close() releases it
+            if mode == ERROR_500:
+                conn.sendall(b"HTTP/1.1 500 Internal Server Error\r\n"
+                             b"Content-Length: 9\r\n\r\nkaboom :(")
+            elif mode == ERROR_400:
+                conn.sendall(b"HTTP/1.1 400 Bad Request\r\n"
+                             b"Content-Length: 8\r\n\r\nrejected")
+            elif mode == GARBAGE:
+                body = b"\x7f{{{this is not json"
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Type: application/json\r\n"
+                             b"Content-Length: %d\r\n\r\n%s"
+                             % (len(body), body))
+            elif mode == DISCONNECT:
+                body = json.dumps(self.payload).encode()
+                # advertise the full length, ship half, cut the line
+                # hard (RST via SO_LINGER 0) — the mid-response
+                # disconnect a crashing peer produces
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Type: application/json\r\n"
+                             b"Content-Length: %d\r\n\r\n" % len(body))
+                conn.sendall(body[:max(len(body) // 2, 1)])
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            else:
+                body = json.dumps(self.payload).encode()
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Type: application/json\r\n"
+                             b"Content-Length: %d\r\n\r\n%s"
+                             % (len(body), body))
+            conn.close()
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def series_payload(metric: str, tags: dict, dps: dict) -> list[dict]:
+    """One raw series in the shape a peer's fan-out response carries."""
+    return [{"metric": metric, "tags": tags,
+             "aggregateTags": [], "dps": dps}]
+
+
+def force_cooldown_elapsed(breaker) -> None:
+    """Rewind an OPEN breaker's clock so its next allow() is the
+    half-open probe — cooldown transitions without wall-clock sleeps."""
+    breaker.opened_at -= breaker.cooldown_s + 1e-3

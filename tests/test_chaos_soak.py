@@ -1,0 +1,25 @@
+"""CI wrapper for the cluster chaos soak (tools/chaos_soak.py).
+
+Real receiver + peer TSD subprocesses with a fault-injecting proxy
+between them: randomized latency/reset/mid-body-disconnect/garbage
+faults across the query loop, asserting the two mode contracts — no
+500s under partial_results=allow, no wrong answers under the default
+"error" — and that the cluster heals to full answers once faults stop.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cluster_contracts_hold_under_chaos():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14263", "--rounds", "8", "--seed", "11"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "chaos soak PASSED" in proc.stdout
+    assert "[allow] 8 rounds OK" in proc.stdout
+    assert "[error] 8 rounds OK" in proc.stdout

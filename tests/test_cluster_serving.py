@@ -209,6 +209,292 @@ class TestClusterMatchesSingleHost:
             _assert_dps_equal(g["dps"], w["dps"], "multi")
 
 
+def _receiver_for(peers: str, **cfg):
+    """A fresh receiver TSD (own breakers) holding one local series."""
+    props = {"tsd.core.auto_create_metrics": True,
+             "tsd.network.cluster.peers": peers,
+             "tsd.network.cluster.timeout_ms": "1000",
+             "tsd.network.cluster.retry.max_attempts": "2"}
+    props.update(cfg)
+    tsdb = TSDB(Config(props))
+    tsdb.add_point("clu.m", BASE, 7.0, {"host": "local"})
+    return tsdb, RpcManager(tsdb)
+
+
+def _query(mgr, extra=""):
+    return ask(mgr, "/api/query?start=%d&end=%d&m=sum:clu.m%s"
+               % (BASE - 60, BASE + 1200, extra))
+
+
+def _partial_trailer(payload):
+    for entry in payload:
+        if isinstance(entry, dict) and entry.get("partialResults"):
+            return entry
+    return None
+
+
+class TestFaultInjectedServing:
+    """Deterministic peer faults (tests/fault_fixtures.py — real
+    sockets, server-injected failures) through both
+    tsd.network.cluster.partial_results modes."""
+
+    @pytest.fixture()
+    def peer(self):
+        from tests.fault_fixtures import FaultyPeer, series_payload
+        p = FaultyPeer(series_payload(
+            "clu.m", {"host": "remote"},
+            {str((BASE + 5) * 1000): 11.0}))
+        yield p
+        p.close()
+
+    # -- "allow": every fault shape degrades to a 200 partial answer --
+
+    @pytest.mark.parametrize("fault", ["timeout", "refuse", "disconnect",
+                                       "garbage", "error500"])
+    def test_partial_allow_degrades_to_200(self, peer, fault):
+        from tests import fault_fixtures as ff
+        if fault == "refuse":
+            address = "127.0.0.1:%d" % ff.refused_port()
+        else:
+            peer.mode = fault
+            address = peer.address
+        tsdb, mgr = _receiver_for(
+            address, **{"tsd.network.cluster.partial_results": "allow"})
+        status, payload = _query(mgr, extra="&show_summary")
+        assert status == 200
+        # the local series still answers
+        series = [e for e in payload if "metric" in e]
+        assert series and series[0]["dps"]
+        trailer = _partial_trailer(payload)
+        assert trailer and trailer["clusterPeersFailed"] == 1
+        summary = [e for e in payload if "statsSummary" in e]
+        assert summary and summary[0]["statsSummary"][
+            "clusterPeersFailed"] == 1
+
+    def test_partial_allow_folds_surviving_peer(self, peer):
+        """Acceptance shape: two peers, one dead — the 200 carries the
+        SURVIVING peer's data plus local, and counts exactly one
+        failure."""
+        from tests import fault_fixtures as ff
+        dead = "127.0.0.1:%d" % ff.refused_port()
+        tsdb, mgr = _receiver_for(
+            "%s,%s" % (peer.address, dead),
+            **{"tsd.network.cluster.partial_results": "allow"})
+        status, payload = _query(mgr)
+        assert status == 200
+        trailer = _partial_trailer(payload)
+        assert trailer and trailer["clusterPeersFailed"] == 1
+        assert trailer["clusterPeers"] == 2
+        # sum folds local (7 @ BASE) and the surviving peer (11 @ BASE+5)
+        dps = [e for e in payload if "metric" in e][0]["dps"]
+        assert set(dps.values()) == {7.0, 11.0}
+
+    # -- "error" (default): same faults keep failing fast --
+
+    @pytest.mark.parametrize("fault", ["timeout", "refuse", "disconnect",
+                                       "garbage"])
+    def test_error_mode_fails_the_query(self, peer, fault):
+        from tests import fault_fixtures as ff
+        if fault == "refuse":
+            address = "127.0.0.1:%d" % ff.refused_port()
+        else:
+            peer.mode = fault
+            address = peer.address
+        tsdb, mgr = _receiver_for(address)   # default partial_results
+        status, _ = _query(mgr)
+        assert status >= 500
+
+    def test_partial_allow_annotates_gexp_too(self, peer):
+        """Every query-shaped endpoint must announce degraded serving —
+        /api/query/gexp carries the same trailer as /api/query."""
+        from tests import fault_fixtures as ff
+        dead = "127.0.0.1:%d" % ff.refused_port()
+        tsdb, mgr = _receiver_for(
+            dead, **{"tsd.network.cluster.partial_results": "allow"})
+        status, payload = ask(
+            mgr, "/api/query/gexp?start=%d&end=%d&exp=scale(sum:clu.m,2)"
+            % (BASE - 60, BASE + 1200))
+        assert status == 200
+        trailer = _partial_trailer(payload)
+        assert trailer and trailer["clusterPeersFailed"] == 1
+        series = [e for e in payload if "metric" in e]
+        assert series and series[0]["dps"]        # local data, scaled
+
+    def test_retry_recovers_transient_fault(self, peer):
+        """One garbage response then a clean one: the retry layer makes
+        the query whole — 200, full data, NOT partial — in both modes."""
+        peer.script = ["garbage"]            # first request only
+        tsdb, mgr = _receiver_for(peer.address)
+        status, payload = _query(mgr)
+        assert status == 200
+        assert _partial_trailer(payload) is None
+        dps = [e for e in payload if "metric" in e][0]["dps"]
+        assert set(dps.values()) == {7.0, 11.0}
+        assert peer.requests == 2            # the retry really happened
+        assert tsdb._cluster_state.fetch_retries == 1
+
+
+class TestCircuitBreaker:
+    """Per-peer breaker transitions: closed -> open (fast fail, no
+    network) -> half-open probe -> closed; a failed probe re-opens.
+    Cooldowns advance by rewinding the breaker clock, not sleeping."""
+
+    def _breaker_receiver(self, peer, **cfg):
+        base = {"tsd.network.cluster.breaker.threshold": "2",
+                "tsd.network.cluster.breaker.cooldown_ms": "60000",
+                "tsd.network.cluster.retry.max_attempts": "1"}
+        base.update(cfg)
+        return _receiver_for(peer.address, **base)
+
+    def test_open_after_threshold_then_fast_fail(self):
+        from tests.fault_fixtures import FaultyPeer
+        peer = FaultyPeer()
+        try:
+            peer.mode = "garbage"
+            tsdb, mgr = self._breaker_receiver(peer)
+            for _ in range(2):               # threshold consecutive fails
+                status, _ = _query(mgr)
+                assert status >= 500
+            breaker = tsdb._cluster_state.breaker(peer.address)
+            assert breaker.state == breaker.OPEN
+            served = peer.requests
+            status, _ = _query(mgr)          # open: fails WITHOUT network
+            assert status >= 500
+            assert peer.requests == served
+            assert breaker.fast_fails >= 1
+        finally:
+            peer.close()
+
+    def test_half_open_probe_closes_on_success(self):
+        from tests.fault_fixtures import FaultyPeer, force_cooldown_elapsed
+        peer = FaultyPeer([])
+        try:
+            peer.mode = "garbage"
+            tsdb, mgr = self._breaker_receiver(peer)
+            for _ in range(2):
+                _query(mgr)
+            breaker = tsdb._cluster_state.breaker(peer.address)
+            assert breaker.state == breaker.OPEN
+            peer.mode = "ok"                 # peer recovered
+            force_cooldown_elapsed(breaker)
+            status, _ = _query(mgr)          # the half-open probe
+            assert status == 200
+            assert breaker.state == breaker.CLOSED
+            assert breaker.consecutive_failures == 0
+        finally:
+            peer.close()
+
+    def test_half_open_probe_reopens_on_failure(self):
+        from tests.fault_fixtures import FaultyPeer, force_cooldown_elapsed
+        peer = FaultyPeer()
+        try:
+            peer.mode = "garbage"
+            tsdb, mgr = self._breaker_receiver(peer)
+            for _ in range(2):
+                _query(mgr)
+            breaker = tsdb._cluster_state.breaker(peer.address)
+            assert breaker.state == breaker.OPEN
+            opens_before = breaker.opens
+            force_cooldown_elapsed(breaker)
+            status, _ = _query(mgr)          # probe fails -> re-open
+            assert status >= 500
+            assert breaker.state == breaker.OPEN
+            assert breaker.opens == opens_before + 1
+        finally:
+            peer.close()
+
+    def test_half_open_probe_multi_subquery_query_succeeds(self):
+        """A multi-subquery query against a recovered half-open peer:
+        one job becomes the probe, the SIBLING jobs wait for its verdict
+        instead of fast-failing — the query that triggers the
+        successful probe must not defeat itself."""
+        from tests.fault_fixtures import FaultyPeer, force_cooldown_elapsed
+        peer = FaultyPeer([])
+        try:
+            peer.mode = "garbage"
+            tsdb, mgr = self._breaker_receiver(peer)
+            for _ in range(2):
+                _query(mgr)
+            breaker = tsdb._cluster_state.breaker(peer.address)
+            assert breaker.state == breaker.OPEN
+            peer.mode = "ok"
+            force_cooldown_elapsed(breaker)
+            status, _ = ask(mgr, "/api/query?start=%d&end=%d"
+                            "&m=sum:clu.m&m=max:clu.m"
+                            % (BASE - 60, BASE + 1200))   # 2 peer jobs
+            assert status == 200
+            assert breaker.state == breaker.CLOSED
+        finally:
+            peer.close()
+
+    def test_deterministic_4xx_not_retried_not_a_breaker_event(self):
+        """A healthy peer answering 400 is reachable and responsive:
+        exactly one attempt (the same request buys the same answer) and
+        the breaker stays closed."""
+        from tests.fault_fixtures import FaultyPeer
+        peer = FaultyPeer()
+        try:
+            peer.mode = "error400"
+            tsdb, mgr = _receiver_for(
+                peer.address,
+                **{"tsd.network.cluster.retry.max_attempts": "3"})
+            status, _ = _query(mgr)
+            assert status >= 500                 # error mode: query fails
+            assert peer.requests == 1            # no retry
+            breaker = tsdb._cluster_state.breaker(peer.address)
+            assert breaker.state == breaker.CLOSED
+            assert breaker.consecutive_failures == 0
+        finally:
+            peer.close()
+
+    def test_4xx_during_half_open_probe_settles_the_breaker(self):
+        """A 4xx answer to the half-open probe proves the peer is
+        responsive: the probe must SETTLE (availability success) —
+        leaving _probing set would wedge the breaker half-open and make
+        every later fetch busy-wait its whole budget."""
+        from tests.fault_fixtures import FaultyPeer, force_cooldown_elapsed
+        peer = FaultyPeer()
+        try:
+            peer.mode = "garbage"
+            tsdb, mgr = self._breaker_receiver(peer)
+            for _ in range(2):
+                _query(mgr)
+            breaker = tsdb._cluster_state.breaker(peer.address)
+            assert breaker.state == breaker.OPEN
+            peer.mode = "error400"               # responsive but rejects
+            force_cooldown_elapsed(breaker)
+            status, _ = _query(mgr)              # the probe
+            assert status >= 500                 # query still errors
+            assert breaker.state == breaker.CLOSED   # NOT wedged
+            peer.mode = "ok"
+            status, _ = _query(mgr)              # immediately served
+            assert status == 200
+        finally:
+            peer.close()
+
+    def test_breaker_state_surfaces_in_api_stats(self):
+        from tests.fault_fixtures import FaultyPeer
+        peer = FaultyPeer()
+        try:
+            peer.mode = "garbage"
+            tsdb, mgr = self._breaker_receiver(peer)
+            for _ in range(2):
+                _query(mgr)
+            status, records = ask(mgr, "/api/stats")
+            assert status == 200
+            by_metric = {}
+            for r in records:
+                by_metric.setdefault(r["metric"], []).append(r)
+            state_rows = by_metric.get("tsd.cluster.breaker.state")
+            assert state_rows and state_rows[0]["tags"]["peer"] \
+                == peer.address
+            assert state_rows[0]["value"] == 2       # open
+            assert "tsd.cluster.fetch.failures" in by_metric
+            assert by_metric["tsd.cluster.fetch.failures"][0]["value"] >= 2
+        finally:
+            peer.close()
+
+
 class TestClusterMechanics:
     def test_fanout_header_serves_locally(self, receiver):
         """The loop guard: a peer's fan-out request must answer from the

@@ -1,0 +1,309 @@
+"""Unit coverage for the fault-tolerance primitives: retry/backoff
+(utils/retry.py), circuit breakers (tsd/cluster.py), the fault-injection
+registry (utils/faults.py), and the per-append WAL fsync opt-in.
+
+Everything here is clock-injected — no wall-clock sleeps."""
+
+import json
+import os
+
+import pytest
+
+from opentsdb_tpu.tsd.cluster import CircuitBreaker
+from opentsdb_tpu.utils import faults
+from opentsdb_tpu.utils.faults import FaultInjector
+from opentsdb_tpu.utils.retry import RetryPolicy, call_with_retries
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+class TestRetry:
+    def _call(self, fn, policy, clock=None, **kw):
+        clock = clock or FakeClock()
+        return call_with_retries(fn, policy, clock=clock,
+                                 sleep=clock.sleep, rand=lambda: 1.0, **kw)
+
+    def test_success_after_transients(self):
+        calls = []
+
+        def fn(timeout_s):
+            calls.append(timeout_s)
+            if len(calls) < 3:
+                raise ConnectionResetError("flake")
+            return "ok"
+
+        retries = []
+        policy = RetryPolicy(max_attempts=3, budget_s=9.0)
+        assert self._call(fn, policy,
+                          on_retry=lambda n, e: retries.append(n)) == "ok"
+        assert len(calls) == 3
+        assert retries == [1, 2]
+
+    def test_attempts_exhausted_raises_last_error(self):
+        policy = RetryPolicy(max_attempts=2, budget_s=10.0)
+        with pytest.raises(ValueError, match="always"):
+            self._call(lambda t: (_ for _ in ()).throw(
+                ValueError("always")), policy)
+
+    def test_per_attempt_deadline_defaults_to_full_budget(self):
+        """A slow-but-healthy first attempt keeps the whole window it
+        had before retries existed; a fast failure leaves the remainder
+        to its retry."""
+        clock = FakeClock()
+        seen = []
+        policy = RetryPolicy(max_attempts=4, budget_s=8.0,
+                             base_delay_s=0.0)
+
+        def fn(timeout_s):
+            seen.append(timeout_s)
+            clock.sleep(1.0)                      # fast-ish failure
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            self._call(fn, policy, clock=clock)
+        assert seen[0] == pytest.approx(8.0)      # the full budget
+        assert seen[1] == pytest.approx(7.0)      # what remains
+
+    def test_attempt_deadline_capped_by_remaining_budget(self):
+        clock = FakeClock()
+        seen = []
+        policy = RetryPolicy(max_attempts=2, budget_s=1.0,
+                             attempt_timeout_s=5.0, base_delay_s=0.0)
+
+        def fn(timeout_s):
+            seen.append(timeout_s)
+            clock.sleep(0.6)                      # attempt consumed time
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            self._call(fn, policy, clock=clock)
+        assert seen[0] == pytest.approx(1.0)      # capped by budget
+        assert seen[1] == pytest.approx(0.4)      # the remainder
+
+    def test_no_retry_when_budget_cannot_fit_one(self):
+        clock = FakeClock()
+        calls = []
+        policy = RetryPolicy(max_attempts=5, budget_s=1.0,
+                             base_delay_s=0.0)
+
+        def fn(timeout_s):
+            calls.append(1)
+            clock.sleep(2.0)                      # blows the whole budget
+            raise OSError("slow")
+
+        with pytest.raises(OSError):
+            self._call(fn, policy, clock=clock)
+        assert len(calls) == 1                    # no doomed retry
+
+    def test_backoff_is_capped(self):
+        clock = FakeClock()
+        slept = []
+        policy = RetryPolicy(max_attempts=6, budget_s=100.0,
+                             base_delay_s=1.0, max_delay_s=3.0,
+                             multiplier=4.0)
+
+        def sleep(s):
+            slept.append(s)
+            clock.sleep(s)
+
+        with pytest.raises(OSError):
+            call_with_retries(
+                lambda t: (_ for _ in ()).throw(OSError("x")), policy,
+                clock=clock, sleep=sleep, rand=lambda: 1.0)
+        # 1.0, then capped at 3.0 forever (full jitter pinned to 1.0)
+        assert slept[0] == pytest.approx(1.0)
+        assert all(s == pytest.approx(3.0) for s in slept[1:])
+
+    def test_non_retryable_error_propagates_immediately(self):
+        calls = []
+
+        def fn(timeout_s):
+            calls.append(1)
+            raise KeyError("not transient")
+
+        policy = RetryPolicy(max_attempts=3, budget_s=10.0)
+        with pytest.raises(KeyError):
+            self._call(fn, policy, retry_on=(OSError,))
+        assert len(calls) == 1
+
+
+class TestCircuitBreakerUnit:
+    def _breaker(self, threshold=3, cooldown=10.0):
+        clock = FakeClock()
+        return CircuitBreaker(threshold, cooldown, clock=clock), clock
+
+    def test_closed_until_threshold(self):
+        b, _ = self._breaker(threshold=3)
+        for _ in range(2):
+            assert b.allow()
+            b.record_failure()
+        assert b.state == b.CLOSED
+        b.record_failure()
+        assert b.state == b.OPEN
+        assert b.opens == 1
+
+    def test_success_resets_consecutive_count(self):
+        b, _ = self._breaker(threshold=2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == b.CLOSED                # never two consecutive
+
+    def test_open_fast_fails_until_cooldown(self):
+        b, clock = self._breaker(threshold=1, cooldown=10.0)
+        b.record_failure()
+        assert not b.allow()
+        assert b.fast_fails == 1
+        clock.now += 10.0
+        assert b.allow()                          # the half-open probe
+        assert b.state == b.HALF_OPEN
+
+    def test_half_open_admits_exactly_one_probe(self):
+        b, clock = self._breaker(threshold=1, cooldown=1.0)
+        b.record_failure()
+        clock.now += 1.0
+        assert b.allow()
+        assert not b.allow()                      # second caller blocked
+        b.record_success()
+        assert b.state == b.CLOSED
+        assert b.allow()
+
+    def test_failed_probe_restarts_cooldown(self):
+        b, clock = self._breaker(threshold=1, cooldown=5.0)
+        b.record_failure()
+        clock.now += 5.0
+        assert b.allow()
+        b.record_failure()                        # probe failed
+        assert b.state == b.OPEN
+        assert not b.allow()                      # full cooldown again
+        clock.now += 5.0
+        assert b.allow()
+
+    def test_zero_threshold_disables(self):
+        b, _ = self._breaker(threshold=0)
+        for _ in range(10):
+            b.record_failure()
+            assert b.allow()
+        assert b.state == b.CLOSED
+
+
+class TestFaultInjector:
+    def test_inactive_is_noop(self):
+        inj = FaultInjector()
+        inj.check("cluster.peer_fetch", peer="x")        # nothing raises
+        assert inj.mangle("cluster.peer_body", b"abc") == b"abc"
+
+    def test_refuse_and_error_kinds(self):
+        inj = FaultInjector()
+        inj.install([{"site": "s", "kind": "refuse"}])
+        with pytest.raises(ConnectionRefusedError):
+            inj.check("s")
+        inj.clear()
+        inj.install([{"site": "s", "kind": "error", "message": "boom"}])
+        with pytest.raises(OSError, match="boom"):
+            inj.check("s")
+
+    def test_times_disarms_after_n_fires(self):
+        inj = FaultInjector()
+        inj.install([{"site": "s", "kind": "disconnect", "times": 2}])
+        for _ in range(2):
+            with pytest.raises(ConnectionResetError):
+                inj.check("s")
+        inj.check("s")                                   # disarmed
+
+    def test_match_filters_by_context(self):
+        inj = FaultInjector()
+        inj.install([{"site": "s", "kind": "refuse",
+                      "match": {"peer": "a:1"}}])
+        inj.check("s", peer="b:2")                       # no match
+        with pytest.raises(ConnectionRefusedError):
+            inj.check("s", peer="a:1")
+
+    def test_mangle_garbage_and_disconnect(self):
+        inj = FaultInjector()
+        inj.install([{"site": "body", "kind": "garbage", "times": 1},
+                     {"site": "body", "kind": "disconnect", "times": 1}])
+        mangled = inj.mangle("body", b'{"ok": 1}')
+        with pytest.raises(ValueError):
+            json.loads(mangled.decode(errors="replace"))
+        with pytest.raises(ConnectionResetError):
+            inj.mangle("body", b'{"ok": 1}')
+        assert inj.mangle("body", b'{"ok": 1}') == b'{"ok": 1}'
+
+    def test_install_from_config_inline_and_path(self, tmp_path):
+        from opentsdb_tpu.utils.config import Config
+        inj = FaultInjector()
+        inj.install_from_config(Config({
+            "tsd.faults.config":
+                '[{"site": "s", "kind": "refuse"}]'}))
+        with pytest.raises(ConnectionRefusedError):
+            inj.check("s")
+
+        spec = tmp_path / "faults.json"
+        spec.write_text('[{"site": "t", "kind": "refuse"}]')
+        inj2 = FaultInjector()
+        inj2.install_from_config(Config({
+            "tsd.faults.config": "@%s" % spec}))
+        with pytest.raises(ConnectionRefusedError):
+            inj2.check("t")
+
+    def test_unreadable_config_is_ignored(self):
+        from opentsdb_tpu.utils.config import Config
+        inj = FaultInjector()
+        inj.install_from_config(Config({
+            "tsd.faults.config": "@/nonexistent/faults.json"}))
+        inj.check("anything")
+        inj2 = FaultInjector()
+        inj2.install_from_config(Config({
+            "tsd.faults.config": "not json at all"}))
+        inj2.check("anything")
+
+
+class TestWalFsyncOptIn:
+    def _tsdb(self, tmp_path, fsync):
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        return TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.storage.directory": str(tmp_path / "d"),
+            "tsd.storage.wal.fsync": fsync}))
+
+    def test_fsync_per_append_when_enabled(self, tmp_path, monkeypatch):
+        import opentsdb_tpu.storage.persist as persist_mod
+        synced = []
+        monkeypatch.setattr(persist_mod.os, "fsync",
+                            lambda fd: synced.append(fd))
+        t = self._tsdb(tmp_path, "true")
+        t.add_point("w.m", 1_356_998_400, 1, {"h": "a"})
+        t.add_point("w.m", 1_356_998_401, 2, {"h": "a"})
+        assert len(synced) == 2                   # one barrier per append
+
+    def test_no_fsync_by_default(self, tmp_path, monkeypatch):
+        import opentsdb_tpu.storage.persist as persist_mod
+        synced = []
+        monkeypatch.setattr(persist_mod.os, "fsync",
+                            lambda fd: synced.append(fd))
+        t = self._tsdb(tmp_path, "false")
+        t.add_point("w.m", 1_356_998_400, 1, {"h": "a"})
+        assert synced == []
+
+    def test_wal_append_fault_hook(self, tmp_path):
+        t = self._tsdb(tmp_path, "false")
+        faults.install([{"site": "wal.append", "kind": "error",
+                         "message": "disk gone", "times": 1}])
+        try:
+            with pytest.raises(OSError, match="disk gone"):
+                t.add_point("w.m", 1_356_998_400, 1, {"h": "a"})
+        finally:
+            faults.clear()
+        # the failure was the journal's, not the store's — next point OK
+        t.add_point("w.m", 1_356_998_401, 2, {"h": "a"})

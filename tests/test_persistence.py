@@ -104,6 +104,53 @@ class TestWalReplay:
         t2 = make_tsdb(tmp_path)
         assert t2.store.total_datapoints == 1
 
+    def test_crash_mid_append_logs_and_replays_the_rest(self, tmp_path,
+                                                       caplog):
+        """The crash shape: the process died inside journal(), leaving
+        partial JSON as the LAST line.  Replay must restore every
+        complete record, log the torn tail (it was never acknowledged),
+        and not raise."""
+        import logging
+        t1 = make_tsdb(tmp_path)
+        for i in range(5):
+            t1.add_point("p.cpu", BASE + i, i, {"h": "a"})
+        t1.persistence.close()
+        wal = tmp_path / "data" / "wal.jsonl"
+        # truncate INTO the final record (no trailing newline), exactly
+        # what a kill -9 between write() and the page landing produces
+        raw = wal.read_bytes()
+        wal.write_bytes(raw[:-9])
+        with caplog.at_level(logging.WARNING, logger="storage.persist"):
+            t2 = make_tsdb(tmp_path)
+        assert t2.store.total_datapoints == 4      # all complete records
+        assert any("torn final line" in r.message for r in caplog.records)
+        # the torn fragment was TRUNCATED, so the first post-restart
+        # append starts a clean line instead of concatenating onto it —
+        # a second crash/restart must keep that acknowledged write
+        t2.add_point("p.cpu", BASE + 99, 99, {"h": "a"})
+        t2.persistence.close()                     # crash: no snapshot
+        t3 = make_tsdb(tmp_path)
+        assert t3.store.total_datapoints == 5
+
+    def test_mid_file_corruption_replays_later_records(self, tmp_path,
+                                                       caplog):
+        """A bad line that is NOT the tail is corruption worth alarming
+        on — but the acknowledged records after it must still replay."""
+        import logging
+        t1 = make_tsdb(tmp_path)
+        for i in range(4):
+            t1.add_point("p.cpu", BASE + i, i, {"h": "a"})
+        t1.persistence.close()
+        wal = tmp_path / "data" / "wal.jsonl"
+        lines = wal.read_text().splitlines()
+        lines[1] = lines[1][: len(lines[1]) // 2]  # corrupt record 2
+        wal.write_text("\n".join(lines) + "\n")
+        with caplog.at_level(logging.ERROR, logger="storage.persist"):
+            t2 = make_tsdb(tmp_path)
+        assert t2.store.total_datapoints == 3      # 1, 3, 4 survive
+        assert any("unparseable line" in r.message
+                   for r in caplog.records)
+
 
 class TestSnapshotRestore:
     def test_round_trip(self, tmp_path):

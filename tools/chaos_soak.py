@@ -1,0 +1,309 @@
+"""Chaos soak: a live 2-TSD cluster under randomized peer faults.
+
+The serving-path counterpart of tools/crash_soak.py (which proves WAL
+durability under kill -9): this proves the CLUSTER fault-tolerance
+contract of tsd/cluster.py against real daemons on real sockets.
+
+Topology: a peer TSD and a receiver TSD (both real subprocesses), with
+the receiver's `tsd.network.cluster.peers` pointed at a fault-injecting
+TCP proxy in THIS process.  Each query round the proxy rolls a fault
+for its next connections — clean pass-through, added latency beyond the
+cluster budget, immediate reset, mid-body disconnect, or a garbage
+body — and the soak asserts the mode contract:
+
+  * partial_results=allow : NO query may answer 500.  Every 200 is
+    either the full fold (local 1.0 + peer 2.0 = 3.0 per slot) or the
+    local half (1.0) carrying the partialResults trailer.
+  * partial_results=error : NO WRONG ANSWERS.  A query either answers
+    the exact full fold or fails with >= 500 — never a 200 with
+    partial/garbled data (the seed's semantics, preserved).
+
+Both phases finish with the proxy clean and assert the cluster heals
+(breaker half-open probe recovers) to a full answer.
+
+    python tools/chaos_soak.py [--rounds 25] [--seed 7] [--port 14261]
+
+Exit code 0 = both contracts held every round.
+"""
+
+import argparse
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE = 1_356_998_400
+SLOTS = 8          # datapoints per host
+FAULTS = ["ok", "ok", "latency", "reset", "disconnect", "garbage"]
+
+
+def wait_port(port, timeout=60):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                return True
+        except OSError:
+            time.sleep(0.2)
+    return False
+
+
+def spawn_tsd(port, extra_cfg: dict):
+    import tempfile
+    conf_dir = tempfile.mkdtemp(prefix="chaos_soak_")
+    cfg = os.path.join(conf_dir, "tsd.conf")
+    with open(cfg, "w") as fh:
+        fh.write("tsd.core.auto_create_metrics = true\n")
+        for k, v in extra_cfg.items():
+            fh.write("%s = %s\n" % (k, v))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "opentsdb_tpu.tools.tsd_main",
+         "--port", str(port), "--bind", "127.0.0.1", "--config", cfg],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    if not wait_port(port):
+        proc.kill()
+        raise RuntimeError("TSD did not come up on %d" % port)
+    return proc
+
+
+class FaultProxy(threading.Thread):
+    """TCP proxy to the peer TSD; `fault` picks what the NEXT
+    connections endure.  Faults are applied per-connection, so every
+    retry attempt in the client rolls through the current setting."""
+
+    def __init__(self, upstream_port: int):
+        super().__init__(daemon=True)
+        self.upstream_port = upstream_port
+        self.fault = "ok"
+        self.closing = False
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(32)
+        self.port = self.sock.getsockname()[1]
+        self.start()
+
+    def run(self):
+        while not self.closing:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn, self.fault),
+                             daemon=True).start()
+
+    def close(self):
+        self.closing = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _handle(self, conn, fault):
+        try:
+            conn.settimeout(10)
+            if fault == "reset":
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                conn.close()
+                return
+            if fault == "latency":
+                time.sleep(1.6)          # beyond the 1s cluster budget
+            # read the request head+body (single request per fan-out conn)
+            req = b""
+            while b"\r\n\r\n" not in req:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                req += chunk
+            head, _, body = req.partition(b"\r\n\r\n")
+            length = 0
+            for line in head.split(b"\r\n"):
+                if line.lower().startswith(b"content-length:"):
+                    length = int(line.split(b":", 1)[1])
+            while len(body) < length:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                body += chunk
+            if fault == "garbage":
+                junk = b"\x7f{{{chaos"
+                conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: "
+                             b"application/json\r\nContent-Length: %d"
+                             b"\r\n\r\n%s" % (len(junk), junk))
+                conn.close()
+                return
+            # forward to the real peer, relay the full response back
+            with socket.create_connection(
+                    ("127.0.0.1", self.upstream_port), timeout=10) as up:
+                up.sendall(req)
+                resp = b""
+                up.settimeout(10)
+                try:
+                    while True:
+                        chunk = up.recv(65536)
+                        if not chunk:
+                            break
+                        resp += chunk
+                        if self._complete(resp):
+                            break
+                except socket.timeout:
+                    pass
+            if fault == "disconnect":
+                conn.sendall(resp[: max(len(resp) // 2, 1)])
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            else:
+                conn.sendall(resp)
+            conn.close()
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _complete(resp: bytes) -> bool:
+        if b"\r\n\r\n" not in resp:
+            return False
+        head, _, body = resp.partition(b"\r\n\r\n")
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                return len(body) >= int(line.split(b":", 1)[1])
+        return False
+
+
+def http_put(port, points):
+    body = json.dumps(points).encode()
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/api/put?sync" % port, data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status == 204
+
+
+def seed_host(port, host, value):
+    pts = [{"metric": "chaos.m", "timestamp": BASE + k, "value": value,
+            "tags": {"host": host}} for k in range(SLOTS)]
+    assert http_put(port, pts)
+
+
+def query(port):
+    url = ("http://127.0.0.1:%d/api/query?start=%d&end=%d&m=sum:chaos.m"
+           % (port, BASE - 1, BASE + 600))
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, None
+
+
+def classify(payload):
+    """-> ("full"|"partial"|"wrong", dps) against the seeded data."""
+    series = [e for e in payload if isinstance(e, dict) and "metric" in e]
+    trailer = any(isinstance(e, dict) and e.get("partialResults")
+                  for e in payload)
+    if len(series) != 1:
+        return "wrong", {}
+    dps = series[0]["dps"]
+    vals = set(dps.values())
+    if len(dps) == SLOTS and vals == {3.0} and not trailer:
+        return "full", dps
+    if len(dps) == SLOTS and vals == {1.0} and trailer:
+        return "partial", dps
+    return "wrong", dps
+
+
+def run_phase(mode: str, rounds: int, rng, peer_port: int,
+              recv_port: int) -> dict:
+    proxy = FaultProxy(peer_port)
+    recv = spawn_tsd(recv_port, {
+        "tsd.network.cluster.peers": "127.0.0.1:%d" % proxy.port,
+        "tsd.network.cluster.timeout_ms": "1000",
+        "tsd.network.cluster.retry.max_attempts": "2",
+        "tsd.network.cluster.breaker.threshold": "3",
+        "tsd.network.cluster.breaker.cooldown_ms": "800",
+        "tsd.network.cluster.partial_results": mode,
+    })
+    tally = {"full": 0, "partial": 0, "5xx": 0}
+    try:
+        seed_host(recv_port, "local", 1)
+        counts = []
+        for i in range(rounds):
+            proxy.fault = rng.choice(FAULTS)
+            status, payload = query(recv_port)
+            if status >= 500:
+                if mode == "allow":
+                    print("[allow] round %d (%s): got %d — CONTRACT "
+                          "VIOLATION" % (i, proxy.fault, status),
+                          flush=True)
+                    raise SystemExit(1)
+                tally["5xx"] += 1
+                counts.append((proxy.fault, status))
+                continue
+            kind, dps = classify(payload)
+            if kind == "wrong" or (mode == "error" and kind != "full"):
+                print("[%s] round %d (%s): 200 with %s answer %s — "
+                      "CONTRACT VIOLATION"
+                      % (mode, i, proxy.fault, kind, dps), flush=True)
+                raise SystemExit(1)
+            tally[kind] += 1
+            counts.append((proxy.fault, kind))
+        # heal check: clean proxy, wait out the breaker cooldown, and
+        # the cluster must answer FULL again
+        proxy.fault = "ok"
+        deadline = time.time() + 10
+        healed = False
+        while time.time() < deadline:
+            status, payload = query(recv_port)
+            if status == 200 and classify(payload)[0] == "full":
+                healed = True
+                break
+            time.sleep(0.3)
+        if not healed:
+            print("[%s] cluster did not heal after faults cleared"
+                  % mode, flush=True)
+            raise SystemExit(1)
+    finally:
+        proxy.close()
+        recv.send_signal(signal.SIGTERM)
+        recv.wait()
+    return tally
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--port", type=int, default=14261)
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+    peer = spawn_tsd(args.port, {})
+    try:
+        seed_host(args.port, "remote", 2)
+        for mode in ("allow", "error"):
+            tally = run_phase(mode, args.rounds, rng, args.port,
+                              args.port + 1)
+            print("[%s] %d rounds OK: %s (healed to full)"
+                  % (mode, args.rounds, tally), flush=True)
+    finally:
+        peer.send_signal(signal.SIGTERM)
+        peer.wait()
+    print("chaos soak PASSED: no 500s in allow mode, no wrong answers "
+          "in error mode", flush=True)
+
+
+if __name__ == "__main__":
+    main()

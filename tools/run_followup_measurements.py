@@ -68,11 +68,31 @@ def _save_done(done: set) -> None:
         json.dump(sorted(done), fh)
 
 
+def _superseded_chain(row: dict) -> list[dict]:
+    """A row's supersede history as a list (older dict-form included)."""
+    hist = row.get("superseded")
+    if hist is None:
+        return []
+    return hist if isinstance(hist, list) else [hist]
+
+
+def _in_superseded_chain(row: dict, rec: dict) -> bool:
+    """True when rec's (value, vs_baseline) already appears in row's
+    superseded history — re-merging it would alternate-supersede the
+    current value forever (ADVICE r5 medium)."""
+    key = (rec.get("value"), rec.get("vs_baseline"))
+    return any((h.get("value"), h.get("vs_baseline")) == key
+               for h in _superseded_chain(row))
+
+
 def merge_into_canonical(results: list[dict]) -> None:
     """Fold measured rows into BENCH_CONFIGS_r05.json: a value row
     supersedes an error/absent row for the same stage; a fresh value row
     supersedes an older one (newer code), keeping the old value in
-    "superseded".  Error rows never displace values."""
+    "superseded".  Error rows never displace values, skip artifacts
+    (bench.py "skipped": true, value 0.0) never displace REAL values,
+    and a row already present in the superseded chain never re-merges
+    (it is history, not news)."""
     try:
         with open(CANON) as fh:
             canon = [json.loads(ln) for ln in fh if ln.strip()]
@@ -87,22 +107,29 @@ def merge_into_canonical(results: list[dict]) -> None:
             continue
         prev = rows.get(stage)
         if prev is not None and "value" in prev:
+            if rec.get("skipped") and not prev.get("skipped"):
+                # a no-measurement artifact must never displace a real
+                # number (ADVICE r5 high)
+                continue
             if (prev.get("value") == rec.get("value")
                     and prev.get("vs_baseline") == rec.get("vs_baseline")):
                 # Same record re-merged (write_out runs after every
                 # stage): keep prev and its superseded history intact.
                 continue
+            if _in_superseded_chain(prev, rec):
+                continue
             rec = dict(rec)
             # Chain the full history: a second supersede (e.g. the
             # crowned bench over the baseline bench) must not erase the
             # prior session's number.  Older dict-form entries migrate
-            # to the list form on the next merge.
-            hist = prev.get("superseded")
-            hist = ([] if hist is None
-                    else (hist if isinstance(hist, list) else [hist]))
-            rec["superseded"] = [{k: prev[k] for k in
-                                  ("value", "vs_baseline")
-                                  if k in prev}] + hist
+            # to the list form on the next merge.  Skip artifacts carry
+            # no measurement, so they never enter the history.
+            hist = _superseded_chain(prev)
+            if not prev.get("skipped"):
+                hist = [{k: prev[k] for k in ("value", "vs_baseline")
+                         if k in prev}] + hist
+            if hist:
+                rec["superseded"] = hist
         rows[stage] = rec
         if stage not in order:
             order.append(stage)
@@ -157,13 +184,30 @@ def main() -> None:
     done = _load_done()
     # Re-seed this attempt's OUT with the prior attempts' measured rows
     # for done stages, so r05b stays the union of the session's attempts
-    # rather than truncating to the latest one.
+    # rather than truncating to the latest one.  Rows the canonical
+    # artifact already remembers in a superseded chain stay OUT of the
+    # re-seed: merging one back would displace the newer current value,
+    # and the next resume would displace it back — the alternating
+    # duplicate growth of ADVICE r5.  Skip artifacts never re-seed
+    # (they are no-measurements awaiting a retry).
     done_names = {k.split(":", 1)[1] for k in done}
+    canon_rows: dict = {}
+    try:
+        with open(CANON) as fh:
+            for ln in fh:
+                row = json.loads(ln)
+                if row.get("stage") not in (None, "meta"):
+                    canon_rows[row["stage"]] = row
+    except (OSError, ValueError):
+        pass
     try:
         with open(OUT) as fh:
             for ln in fh:
                 rec = json.loads(ln)
-                if ("value" in rec and rec.get("stage") in done_names):
+                if ("value" in rec and rec.get("stage") in done_names
+                        and not rec.get("skipped")
+                        and not _in_superseded_chain(
+                            canon_rows.get(rec["stage"], {}), rec)):
                     results.append(rec)
     except (OSError, ValueError):
         pass
@@ -225,6 +269,11 @@ def main() -> None:
                     rec["ab_overrides"] = dict(stage_env)
                 results.append(rec)
                 stage_recs.append(rec)
+            if any(r.get("skipped") for r in stage_recs):
+                # bench.py skip artifacts (value 0.0, rc 0) are
+                # NO-measurements: the stage must not mark done — the
+                # armed watcher retries it (ADVICE r5 high)
+                failed = True
             if name == "bench_prefix":
                 winner_env = pick_winners(stage_recs)
             if name == "stage_bench":
@@ -258,13 +307,13 @@ def main() -> None:
     # the winner.
     raced = {r["stage"]: r for r in results
              if r.get("stage", "").startswith("bench_configs:2:")
-             and "value" in r}
+             and "value" in r and not r.get("skipped")}
     try:
         with open(CANON) as fh:
             for ln in fh:
                 rec = json.loads(ln)
                 if (rec.get("stage", "").startswith("bench_configs:2:")
-                        and "value" in rec
+                        and "value" in rec and not rec.get("skipped")
                         and rec["stage"] not in raced):
                     raced[rec["stage"]] = rec
     except (OSError, ValueError):
@@ -281,6 +330,8 @@ def main() -> None:
         for r in results:
             if r.get("stage") != full:
                 continue
+            if r.get("skipped"):
+                continue     # a skip artifact never measured: retry owed
             if "value" in r or not str(r.get("error", "")).startswith(
                     "skipped:"):
                 return True
