@@ -32,7 +32,9 @@ def _load_git():
             FULL_REVISION = rev.stdout.strip()
             SHORT_REVISION = FULL_REVISION[:7]
     except Exception:
-        pass
+        # best-effort build metadata: no git / not a checkout is a
+        # normal deployment shape, the placeholders above serve
+        pass  # tsdblint: disable=except-swallow
 
 
 _load_git()

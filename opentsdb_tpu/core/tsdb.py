@@ -47,6 +47,7 @@ class TSDB:
         self._query_mesh = _UNSET
         self._query_limits = None
         self.maintenance = None
+        self._apply_precision_config()
         self._apply_kernel_modes()
         # chaos/failure-testing hooks (tsd.faults.config; no-op unless
         # armed) — installed before any storage or network touchpoint so
@@ -128,9 +129,10 @@ class TSDB:
         # record; snapshot() holds it for its stop-the-world walk so no
         # journaled write can fall between the state capture and WAL reset.
         self._ingest_lock = threading.RLock()
+        # guarded-by: _stats_lock
         self.datapoints_added = 0
-        self.illegal_arguments = 0
-        self.unknown_metrics = 0
+        self.illegal_arguments = 0  # guarded-by: _stats_lock
+        self.unknown_metrics = 0  # guarded-by: _stats_lock
         # Restore LAST: WAL replay drives the full _apply_* paths, which
         # touch stats/meta/tree state initialized above.
         self._replaying = False   # WAL replay bypasses the ro-mode gate
@@ -144,6 +146,32 @@ class TSDB:
     # ------------------------------------------------------------------ #
     # Write path (TSDB.addPoint :1051)                                   #
     # ------------------------------------------------------------------ #
+
+    def _apply_precision_config(self) -> None:
+        """Enforce tsd.tpu.precision.x64 (default true): ms-resolution
+        timestamps are int64, and with jax_enable_x64 off jnp.int64
+        silently degrades to int32 — every timestamp past 2^31 ms
+        truncates.  The ops package enables x64 at import; with the key
+        true this RE-ENABLES it per TSDB construction (flipping the
+        process-global flag back on if an embedder turned it off), so
+        queries never run in the silently-truncating state.  With the
+        key false nothing is re-asserted and the downsample planners'
+        require_x64 guard raises at query-plan time instead (the
+        operator owns that choice and gets a warning here)."""
+        import jax
+
+        from opentsdb_tpu import ops  # noqa: F401  (enables x64 on import)
+        if self.config.get_bool("tsd.tpu.precision.x64"):
+            if not jax.config.jax_enable_x64:
+                jax.config.update("jax_enable_x64", True)
+        else:
+            import logging
+            logging.getLogger("tsdb").warning(
+                "tsd.tpu.precision.x64=false: x64 is not re-asserted for "
+                "this TSDB; if jax_enable_x64 is turned off the "
+                "downsample planners refuse int64 window math "
+                "(ops.downsample.require_x64) rather than truncate "
+                "ms timestamps")
 
     def _apply_kernel_modes(self) -> None:
         """Apply tsd.query.kernel.* hot-path strategy config (operator

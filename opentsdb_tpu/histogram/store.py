@@ -35,11 +35,13 @@ class HistogramSeries:
 
     def __init__(self, key: SeriesKey):
         self.key = key
+        # guarded-by: _lock
         self._ts: list[int] = []
-        self._hists: list[SimpleHistogram] = []
-        self._sorted = True
+        self._hists: list[SimpleHistogram] = []  # guarded-by: _lock
+        self._sorted = True  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._cols = None     # (ts[N], indptr[N+1], bids[nnz], cnts[nnz])
+        # (ts[N], indptr[N+1], bids[nnz], cnts[nnz])
+        self._cols = None  # guarded-by: _lock
         self._vocab: list[tuple[float, float]] = []   # local id -> bounds
 
     def append(self, ts_ms: int, hist: SimpleHistogram) -> None:
@@ -130,10 +132,11 @@ class HistogramStore:
     """All histogram series, keyed like the scalar MemStore."""
 
     def __init__(self):
+        # guarded-by: _lock
         self._series: dict[SeriesKey, HistogramSeries] = {}
-        self._by_metric: dict[int, set[SeriesKey]] = {}
+        self._by_metric: dict[int, set[SeriesKey]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
-        self.datapoints_added = 0
+        self.datapoints_added = 0  # guarded-by: _lock
 
     def add_point(self, key: SeriesKey, ts_ms: int,
                   hist: SimpleHistogram) -> None:

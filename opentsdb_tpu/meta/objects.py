@@ -106,8 +106,9 @@ class MetaStore:
     """In-memory tsdb-meta table: UIDMeta by (type, uid), TSMeta by tsuid."""
 
     def __init__(self):
+        # guarded-by: _lock
         self._uidmeta: dict[tuple[str, str], UIDMeta] = {}
-        self._tsmeta: dict[str, TSMeta] = {}
+        self._tsmeta: dict[str, TSMeta] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- UIDMeta --

@@ -40,6 +40,26 @@ FILL_SCALAR = "scalar"
 _I64_MAX = np.iinfo(np.int64).max
 
 
+def require_x64() -> None:
+    """Refuse to plan int64 window math when x64 is disabled.
+
+    The window kernels build jnp.int64 timestamp grids; with
+    jax_enable_x64 off JAX silently lowers them to int32 and every ms
+    timestamp past 2^31 (≈ Jan 1970 + 25 days) truncates — queries
+    return wrong windows with no error.  The ops package __init__
+    enables x64 process-wide and TSDB construction re-asserts it
+    (tsd.tpu.precision.x64); this guard is the backstop for embedders
+    that flip the flag afterwards.  Called from the host-side window
+    planners (one attribute read per query plan, nothing on the device
+    path)."""
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "jax_enable_x64 is disabled: int64 ms-timestamp window math "
+            "would silently truncate to int32.  Re-enable x64 (or set "
+            "tsd.tpu.precision.x64=true, the default, and construct the "
+            "TSDB after any config that disables it).")
+
+
 def pad_pow2(n: int, floor: int = 8) -> int:
     out = floor
     while out < n:
@@ -75,6 +95,7 @@ class FixedWindows:
         return FixedWindows(interval_ms, first, count)
 
     def split(self, pad: bool = True) -> tuple[WindowSpec, dict]:
+        require_x64()
         padded = pad_pow2(self.count) if pad else self.count
         return (WindowSpec("fixed", padded, self.interval_ms),
                 {"first": jnp.asarray(self.first_window_ms, jnp.int64),
@@ -91,6 +112,7 @@ class EdgeWindows:
         return len(self.edges) - 1
 
     def split(self, pad: bool = True) -> tuple[WindowSpec, dict]:
+        require_x64()
         w = self.count
         padded = pad_pow2(w) if pad else w
         edges = np.full(padded + 1, _I64_MAX, dtype=np.int64)
@@ -111,6 +133,7 @@ class AllWindow:
         return 1
 
     def split(self, pad: bool = True) -> tuple[WindowSpec, dict]:
+        require_x64()
         return (WindowSpec("all", 1),
                 {"qstart": jnp.asarray(self.query_start_ms, jnp.int64),
                  "qend": jnp.asarray(self.query_end_ms, jnp.int64),

@@ -127,4 +127,6 @@ def execution_platform() -> str:
         import jax
         return jax.default_backend()
     except Exception:
-        return "cpu"
+        # best-effort probe before the backend initializes; "cpu" is
+        # the conservative answer for the trace-time mode guards
+        return "cpu"  # tsdblint: disable=except-swallow

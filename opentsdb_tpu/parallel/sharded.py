@@ -125,6 +125,7 @@ def _finish(agg_name, seg, ok_flat, flat_v, count, total, num,
     return out, cnt
 
 
+@lru_cache(maxsize=128)
 def sharded_group_downsample(mesh: Mesh, agg_name: str, spec: WindowSpec,
                              num_groups: int):
     """Build the jitted sharded step: [S,N] batch -> [G,W] group aggregates.
@@ -132,6 +133,9 @@ def sharded_group_downsample(mesh: Mesh, agg_name: str, spec: WindowSpec,
     fn(ts, val, mask, gid, wargs) with ts/val/mask sharded (series, time),
     gid sharded (series,); returns replicated
     (window_ts[W], out[G, W], out_mask[G, W]).
+
+    lru_cached (tsdblint jax-jit-per-call): every call used to build a
+    fresh shard_map + jax.jit wrapper, recompiling per invocation.
     """
     if agg_name not in SHARDED_AGGS:
         raise KeyError("Aggregator %r has no cross-chip decomposition"
@@ -159,8 +163,12 @@ def sharded_group_downsample(mesh: Mesh, agg_name: str, spec: WindowSpec,
     return jax.jit(mapped)
 
 
+@lru_cache(maxsize=32)
 def sharded_rollup(mesh: Mesh, spec: WindowSpec):
     """Build the sharded offline rollup pass (BASELINE config 5).
+
+    lru_cached (tsdblint jax-jit-per-call): the rollup job calls this
+    per run, and an uncached builder meant a full recompile per pass.
 
     fn(ts, val, mask, wargs) -> per-series (window_ts[W], sum[S,W],
     count[S,W], min[S,W], max[S,W]) with the series axis still sharded on

@@ -27,7 +27,7 @@ class RollupStore:
     def __init__(self, config: RollupConfig, salt_buckets: int = 20):
         self.config = config
         self.salt_buckets = salt_buckets
-        self._lanes: dict[tuple[str, str, bool], MemStore] = {}
+        self._lanes: dict[tuple[str, str, bool], MemStore] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def lane(self, interval: str, aggregator: str,

@@ -55,9 +55,10 @@ class MemorySearchPlugin(SearchPlugin):
     """Substring-matching in-memory index."""
 
     def __init__(self):
+        # guarded-by: _lock
         self._tsmeta: dict[str, object] = {}
-        self._uidmeta: dict[tuple[str, str], object] = {}
-        self._annotations: list = []
+        self._uidmeta: dict[tuple[str, str], object] = {}  # guarded-by: _lock
+        self._annotations: list = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # -- indexing --

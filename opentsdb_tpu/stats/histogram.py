@@ -17,7 +17,7 @@ class LatencyHistogram:
             raise ValueError("num_buckets must be >= 1")
         self.interval = interval
         self.cutoff = cutoff
-        self.buckets = [0] * num_buckets
+        self.buckets = [0] * num_buckets  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _bucket_index(self, value: int) -> int:

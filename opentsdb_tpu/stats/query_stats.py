@@ -88,8 +88,9 @@ class QueryStatsRegistry:
 
     def __init__(self, keep: int = COMPLETED_KEEP):
         self.keep = keep
+        # guarded-by: _lock
         self._running: dict[tuple, QueryStats] = {}
-        self._completed: list[QueryStats] = []
+        self._completed: list[QueryStats] = []  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def start(self, qs: QueryStats) -> None:

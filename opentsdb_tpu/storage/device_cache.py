@@ -105,12 +105,14 @@ class DeviceSeriesCache:
         self.fix_duplicates = bool(fix_duplicates)
         # keyed by (id(store), metric): the raw store and every rollup
         # lane share the metric-uid space but hold different data
+        # guarded-by: _lock
         self._entries: dict[tuple, _Entry] = {}
-        self._stale: dict[tuple, object] = {}   # key -> store (for refresh)
-        self._building: set[tuple] = set()
+        self._stale: dict[tuple, object] = {}  # key -> store  # guarded-by: _lock
+        self._building: set[tuple] = set()  # guarded-by: _lock
         self._lock = threading.Lock()
-        self._tick = 0
-        # stats (surfaced via /api/stats; mutated under _lock)
+        self._tick = 0  # guarded-by: _lock
+        # stats (surfaced via /api/stats)
+        # guarded-by: _lock
         self.hits = 0
         self.misses = 0
         self.builds = 0
@@ -364,7 +366,9 @@ def _gather_windows(ts_buf, val_buf, starts, lengths, n: int,
                 ts = jnp.where(m, off, i32_ceiling)
             val = jnp.where(m, vb[safe], 0.0)
             return ts, val, m
-        fn = jax.jit(gather)
+        # memoized per (N, compaction) in _GATHER_CACHE just above — the
+        # wrapper is constructed once per padded batch shape, not per call
+        fn = jax.jit(gather)  # tsdblint: disable=jax-jit-per-call
         _GATHER_CACHE[key] = fn
     base = jnp.asarray(0 if ts_base is None else ts_base, jnp.int64)
     return fn(ts_buf, val_buf, jnp.asarray(starts), jnp.asarray(lengths),

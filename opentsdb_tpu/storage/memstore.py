@@ -79,18 +79,19 @@ class Series:
     def __init__(self, key: SeriesKey, shard: int = 0):
         self.key = key
         self.shard = shard
+        # guarded-by: _lock
         self._ts = np.empty(self.INITIAL_CAPACITY, dtype=np.int64)
-        self._val = np.empty(self.INITIAL_CAPACITY, dtype=np.float64)
-        self._ival = np.zeros(self.INITIAL_CAPACITY, dtype=np.int64)
-        self._isint = np.empty(self.INITIAL_CAPACITY, dtype=bool)
-        self._n = 0
-        self._sorted = True
+        self._val = np.empty(self.INITIAL_CAPACITY, dtype=np.float64)  # guarded-by: _lock
+        self._ival = np.zeros(self.INITIAL_CAPACITY, dtype=np.int64)  # guarded-by: _lock
+        self._isint = np.empty(self.INITIAL_CAPACITY, dtype=bool)  # guarded-by: _lock
+        self._n = 0  # guarded-by: _lock
+        self._sorted = True  # guarded-by: _lock
         self._lock = threading.Lock()
         # Monotone content-version: bumped by every mutation that changes
         # visible data (appends, restore, deletes, dedup).  The device
         # series cache snapshots (data, version) atomically and treats any
         # later mismatch as staleness — see storage/device_cache.py.
-        self._version = 0
+        self._version = 0  # guarded-by: _lock
 
     def __len__(self) -> int:
         return self._n
@@ -103,7 +104,7 @@ class Series:
     def version(self) -> int:
         return self._version
 
-    def _grow(self, need: int) -> None:
+    def _grow_locked(self, need: int) -> None:
         new_cap = max(need, len(self._ts) * 2, self.INITIAL_CAPACITY)
         self._ts = np.resize(self._ts, new_cap)
         self._val = np.resize(self._val, new_cap)
@@ -113,7 +114,7 @@ class Series:
     def append(self, ts_ms: int, value, is_int: bool) -> None:
         with self._lock:
             if self._n == len(self._ts):
-                self._grow(self._n + 1)
+                self._grow_locked(self._n + 1)
             if self._sorted and self._n and ts_ms <= self._ts[self._n - 1]:
                 self._sorted = False
             self._ts[self._n] = ts_ms
@@ -151,7 +152,7 @@ class Series:
         with self._lock:
             need = self._n + m
             if need > len(self._ts):
-                self._grow(need)
+                self._grow_locked(need)
             self._ts[self._n:need] = ts_ms
             self._val[self._n:need] = values
             self._ival[self._n:need] = ival
@@ -188,12 +189,12 @@ class Series:
         self._ival[:n] = self._ival[:n][order]
         self._isint[:n] = self._isint[:n][order]
         # Dedup BEFORE declaring the series clean: with fix_duplicates off
-        # _dedup_sorted raises, and the series must stay dirty so later reads
+        # _dedup_sorted_locked raises, and the series must stay dirty so later reads
         # keep raising and fsck can still see + repair the duplicate.
-        self._dedup_sorted(fix_duplicates)
+        self._dedup_sorted_locked(fix_duplicates)
         self._sorted = True
 
-    def _dedup_sorted(self, fix_duplicates: bool) -> None:
+    def _dedup_sorted_locked(self, fix_duplicates: bool) -> None:
         n = self._n
         if n < 2:
             return
@@ -367,7 +368,7 @@ class Series:
         n = len(ts)
         with self._lock:
             if n > len(self._ts):
-                self._grow(n)
+                self._grow_locked(n)
             self._ts[:n] = ts
             self._val[:n] = val
             self._ival[:n] = ival
@@ -442,7 +443,7 @@ class CompactionQueue:
     """
 
     def __init__(self, fix_duplicates: bool = True):
-        self._dirty: dict[SeriesKey, Series] = {}
+        self._dirty: dict[SeriesKey, Series] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self.fix_duplicates = fix_duplicates
         self.compactions = 0
@@ -484,11 +485,12 @@ class MemStore:
     def __init__(self, salt_buckets: int = 20, fix_duplicates: bool = True):
         self.salt_buckets = salt_buckets
         self.fix_duplicates = fix_duplicates
+        # guarded-by: _lock
         self._series: dict[SeriesKey, Series] = {}
-        self._by_metric: dict[int, set[SeriesKey]] = {}
+        self._by_metric: dict[int, set[SeriesKey]] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self.compaction_queue = CompactionQueue(fix_duplicates)
-        # annotations: tsuid-keyed and global (empty-key) lists
+        # annotations: tsuid-keyed and global lists  # guarded-by: _lock
         self._annotations: dict[str, list[Annotation]] = {}
         self.datapoints_added = 0
 
@@ -496,29 +498,36 @@ class MemStore:
 
     def get_or_create_series(self, key: SeriesKey) -> Series:
         with self._lock:
-            series = self._series.get(key)
-            if series is None:
-                series = Series(key, shard=key.salt(self.salt_buckets))
-                self._series[key] = series
-                self._by_metric.setdefault(key.metric, set()).add(key)
-            return series
+            return self._get_or_create_series_locked(key)
+
+    def _get_or_create_series_locked(self, key: SeriesKey) -> Series:
+        series = self._series.get(key)
+        if series is None:
+            series = Series(key, shard=key.salt(self.salt_buckets))
+            self._series[key] = series
+            self._by_metric.setdefault(key.metric, set()).add(key)
+        return series
 
     def add_point(self, key: SeriesKey, ts_ms: int, value: float,
                   is_int: bool) -> None:
-        series = self.get_or_create_series(key)
+        # counter bump shares the lookup's lock hold: one store-lock
+        # acquisition per ingest call, not two
+        with self._lock:
+            series = self._get_or_create_series_locked(key)
+            self.datapoints_added += 1
         series.append(ts_ms, value, is_int)
         if series.dirty:
             self.compaction_queue.add(series)
-        self.datapoints_added += 1
 
     def add_batch(self, key: SeriesKey, ts_ms: np.ndarray, values: np.ndarray,
                   is_int: np.ndarray | bool,
                   ival: np.ndarray | None = None) -> None:
-        series = self.get_or_create_series(key)
+        with self._lock:
+            series = self._get_or_create_series_locked(key)
+            self.datapoints_added += len(ts_ms)
         series.append_batch(ts_ms, values, is_int, ival)
         if series.dirty:
             self.compaction_queue.add(series)
-        self.datapoints_added += len(ts_ms)
 
     # -- read path --
 

@@ -41,8 +41,8 @@ class DiskPersistence:
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self._wal_lock = threading.Lock()
-        self._wal = None
-        self.wal_records = 0
+        self._wal = None  # guarded-by: _wal_lock
+        self.wal_records = 0  # guarded-by: _wal_lock
         # opt-in per-append disk barrier (tsd.storage.wal.fsync): every
         # journaled record is crash-durable before the write acks; off,
         # durability rides the wal_sync_interval cadence

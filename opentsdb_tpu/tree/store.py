@@ -18,8 +18,9 @@ MAX_TREES = 65535
 
 class TreeStore:
     def __init__(self):
+        # guarded-by: _lock
         self._trees: dict[int, Tree] = {}
-        # (tree_id, path tuple) -> Branch
+        # (tree_id, path tuple) -> Branch  # guarded-by: _lock
         self._branches: dict[tuple[int, tuple[str, ...]], Branch] = {}
         self._lock = threading.Lock()
 

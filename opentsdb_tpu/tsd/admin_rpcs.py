@@ -125,6 +125,9 @@ class StatsRpc(TelnetRpc, HttpRpc):
         if self.rpc_manager is not None:
             for rpc in getattr(self.rpc_manager, "ingest_rpcs", []):
                 rpc.collect_stats(collector)
+            # error-envelope tallies (http.errors family=4xx/5xx): the
+            # operator-visible counterpart of the uniform error envelope
+            self.rpc_manager.collect_stats(collector)
         if self.server is not None:
             self.server.collect_stats(collector)
         return collector
@@ -215,7 +218,9 @@ class LogBuffer(logging.Handler):
         try:
             self.ring.append(self.format(record))
         except Exception:
-            pass
+            # logging from inside the log handler would recurse; a
+            # record the ring can't format is dropped by design
+            pass  # tsdblint: disable=except-swallow
 
 
 _LOG_BUFFER = LogBuffer()

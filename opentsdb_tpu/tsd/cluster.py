@@ -115,12 +115,15 @@ class CircuitBreaker:
         self.cooldown_s = cooldown_s
         self._clock = clock
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self.state = self.CLOSED
-        self.consecutive_failures = 0
-        self.opened_at = 0.0
-        self._probing = False
-        self.opens = 0             # lifetime open transitions (stats)
-        self.fast_fails = 0        # calls refused while open (stats)
+        self.consecutive_failures = 0  # guarded-by: _lock
+        self.opened_at = 0.0  # guarded-by: _lock
+        self._probing = False  # guarded-by: _lock
+        # lifetime open transitions (stats)  # guarded-by: _lock
+        self.opens = 0
+        # calls refused while open (stats)  # guarded-by: _lock
+        self.fast_fails = 0
 
     def allow(self) -> bool:
         """True if a fetch may proceed now.  While open, the first call
@@ -189,11 +192,12 @@ class ClusterState:
         self.cooldown_s = config.get_int(
             "tsd.network.cluster.breaker.cooldown_ms") / 1e3
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._breakers: dict[str, CircuitBreaker] = {}
-        self.fetch_retries = 0
-        self.fetch_failures = 0
-        self.partial_queries = 0
-        self.failed_queries = 0
+        self.fetch_retries = 0  # guarded-by: _lock
+        self.fetch_failures = 0  # guarded-by: _lock
+        self.partial_queries = 0  # guarded-by: _lock
+        self.failed_queries = 0  # guarded-by: _lock
 
     def breaker(self, peer: str) -> CircuitBreaker:
         with self._lock:

@@ -9,10 +9,12 @@ and RpcHandler.java dispatch.
 from __future__ import annotations
 
 import logging
+import threading
 
 from opentsdb_tpu.stats.query_stats import QueryStatsRegistry
 from opentsdb_tpu.tsd import admin_rpcs, rpcs
-from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery, HttpRequest
+from opentsdb_tpu.tsd.http import (BadRequestError, HttpQuery, HttpRequest,
+                                   error_status)
 from opentsdb_tpu.tsd.serializers import serializer_for
 
 LOG = logging.getLogger("tsd.rpc")
@@ -31,6 +33,26 @@ class RpcManager:
         self._initialize_builtin_rpcs()
         self.telnet_plugins: dict[str, rpcs.TelnetRpc] = {}
         self.http_plugins: dict[str, rpcs.HttpRpc] = {}
+        # error-envelope accounting (surfaced as http.errors by
+        # /api/stats): handler failures must leave an operator-visible
+        # trail, not just a client-side status code
+        self._err_lock = threading.Lock()
+        # guarded-by: _err_lock
+        self.client_errors = 0          # 4xx envelopes sent
+        self.server_errors = 0          # 5xx envelopes sent  # guarded-by: _err_lock
+
+    def _count_error(self, status: int) -> None:
+        with self._err_lock:
+            if status >= 500:
+                self.server_errors += 1
+            else:
+                self.client_errors += 1
+
+    def collect_stats(self, collector) -> None:
+        with self._err_lock:
+            client, server = self.client_errors, self.server_errors
+        collector.record("http.errors", client, "family=4xx")
+        collector.record("http.errors", server, "family=5xx")
 
     def _initialize_builtin_rpcs(self) -> None:
         cfg = self.tsdb.config
@@ -165,6 +187,7 @@ class RpcManager:
             if self._preflight(query):
                 return query
             if query.request.header("origin"):
+                self._count_error(400)
                 query.send_error(BadRequestError(
                     "CORS domain not allowed",
                     details="Origin is not in tsd.http.request.cors_domains"))
@@ -180,6 +203,7 @@ class RpcManager:
                               "request from %s; failing closed", remote)
                 state = None
             if state is None or state.status != AuthStatus.SUCCESS:
+                self._count_error(401)
                 query.send_error(BadRequestError(
                     "Authentication failed", status=401))
                 return query
@@ -210,6 +234,13 @@ class RpcManager:
             if query.response is None:
                 raise RuntimeError("handler sent no response")
         except Exception as e:  # uniform error envelope
+            status = error_status(e)
+            self._count_error(status)
+            if status >= 500:
+                # expected client mistakes (4xx) stay quiet; an
+                # internal failure gets the full trace in the daemon log
+                LOG.exception("handler for [%s] from %s failed with an "
+                              "internal error", request.path, remote)
             query.send_error(e)
         self._apply_cors(query)
         return query

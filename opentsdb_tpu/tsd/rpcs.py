@@ -11,6 +11,7 @@ SuggestRpc.java, AnnotationRpc.java, UniqueIdRpc.java (:63-77).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
@@ -20,6 +21,8 @@ from opentsdb_tpu.storage.memstore import Annotation
 from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
 from opentsdb_tpu.uid import NoSuchUniqueName
 from opentsdb_tpu.stats.query_stats import QueryStats, DuplicateQueryException
+
+LOG = logging.getLogger("tsd.rpcs")
 
 
 class TelnetRpc:
@@ -63,13 +66,14 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
     kind = "put"
 
     def __init__(self):
+        # guarded-by: _lock
         self.requests = 0
-        self.http_requests = 0
-        self.hbase_errors = 0
-        self.invalid_values = 0
-        self.illegal_arguments = 0
-        self.unknown_metrics = 0
-        self.writes_blocked = 0
+        self.http_requests = 0  # guarded-by: _lock
+        self.hbase_errors = 0  # guarded-by: _lock
+        self.invalid_values = 0  # guarded-by: _lock
+        self.illegal_arguments = 0  # guarded-by: _lock
+        self.unknown_metrics = 0  # guarded-by: _lock
+        self.writes_blocked = 0  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _count(self, attr: str) -> None:
@@ -189,6 +193,13 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
                 try:
                     return json.loads(body[int(s):int(e)])
                 except Exception:
+                    # a span the native parser mis-recorded: the error
+                    # report ships without its datapoint, which is worth
+                    # an operator trace (the ingest verdict itself is
+                    # unaffected)
+                    LOG.warning(
+                        "could not recover datapoint %d (bytes %d:%d) "
+                        "for details-mode error reporting", i, s, e)
                     return {}
 
             self._respond_put(tsdb, query, success, errors, dp_at)

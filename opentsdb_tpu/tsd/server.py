@@ -81,8 +81,8 @@ class TSDServer:
         install_log_buffer()
         self.rpc_manager = RpcManager(tsdb, server=self,
                                       shutdown_cb=self.request_shutdown)
-        self.connections_established = 0
-        self.connections_rejected = 0
+        self.connections_established = 0  # guarded-by: _conn_lock
+        self.connections_rejected = 0  # guarded-by: _conn_lock
         self.exceptions_caught = 0
         self.telnet_rpcs = 0
         self.http_rpcs = 0
@@ -91,7 +91,7 @@ class TSDServer:
         # on it so a drained handler's response still gets delivered
         # before the TSDB (and then the loop) tears down.
         self._inflight_rpcs = 0
-        self._open_connections = 0
+        self._open_connections = 0  # guarded-by: _conn_lock
         self._conn_lock = threading.Lock()
         self.max_connections = tsdb.config.get_int(
             "tsd.core.connections.limit")
@@ -205,7 +205,9 @@ class TSDServer:
                 writer.close()
                 await writer.wait_closed()
             except Exception:
-                pass
+                # best-effort close of an already-failed/finished
+                # connection; nothing to serve and nothing to account
+                pass  # tsdblint: disable=except-swallow
 
     # -- telnet path --
 

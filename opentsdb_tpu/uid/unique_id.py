@@ -74,13 +74,15 @@ class UniqueId:
         self.width = width
         self.random_ids = random_ids
         self._lock = threading.RLock()
+        # guarded-by: _lock
         self._name_to_id: dict[str, int] = {}
-        self._id_to_name: dict[int, str] = {}
-        self._max_id = 0  # MAXID counter row equivalent (UniqueId.java:79)
-        self.cache_hits = 0
-        self.cache_misses = 0
-        self.assigned = 0
-        self._id_filter = None  # UniqueIdFilterPlugin hook
+        self._id_to_name: dict[int, str] = {}  # guarded-by: _lock
+        # MAXID counter row equivalent (UniqueId.java:79)  # guarded-by: _lock
+        self._max_id = 0
+        self.cache_hits = 0  # guarded-by: _lock
+        self.cache_misses = 0  # guarded-by: _lock
+        self.assigned = 0  # guarded-by: _lock
+        self._id_filter = None  # UniqueIdFilterPlugin hook  # guarded-by: _lock
         self.on_create = None   # callable(name, uid) on new assignment
 
     @property
@@ -88,18 +90,24 @@ class UniqueId:
         return (1 << (8 * self.width)) - 1
 
     def set_filter(self, plugin) -> None:
-        self._id_filter = plugin
+        with self._lock:
+            self._id_filter = plugin
 
     # -- lookups --
 
     def get_id(self, name: str) -> int:
         """Name -> UID, raising NoSuchUniqueName (UniqueId.getId)."""
+        # counters bump inside the same hold as the lookup: the lockless
+        # form lost increments under concurrent resolution (tsdblint
+        # lock-unguarded-mutation)
         with self._lock:
             uid = self._name_to_id.get(name)
+            if uid is None:
+                self.cache_misses += 1
+            else:
+                self.cache_hits += 1
         if uid is None:
-            self.cache_misses += 1
             raise NoSuchUniqueName(self.kind.value, name)
-        self.cache_hits += 1
         return uid
 
     def get_name(self, uid: int) -> str:

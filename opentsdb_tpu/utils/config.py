@@ -1,232 +1,502 @@
-"""Flat `tsd.*` properties configuration with typed getters.
+"""Flat `tsd.*` properties configuration with typed getters and a schema.
 
-Reference behavior: /root/reference/src/utils/Config.java (:53, setDefaults :560)
-— a properties file of tsd.* keys with hardcoded defaults, typed accessors, and
-hot access from every layer.  TPU additions live under the `tsd.tpu.*` prefix.
+Reference behavior: /root/reference/src/utils/Config.java (:53, setDefaults
+:560) — a properties file of tsd.* keys with hardcoded defaults, typed
+accessors, and hot access from every layer.  TPU additions live under the
+`tsd.tpu.*` prefix.
+
+Every key the codebase reads is declared in ``CONFIG_SCHEMA`` (key ->
+type, default, doc); ``DEFAULTS`` is derived from it.  The tsdblint
+config analyzer (tools/lint/config_schema.py) holds every ``tsd.*``
+literal in the package to this registry — unknown keys, typed-getter
+mismatches, and dead entries all fail tier-1 — and
+``generate_config_doc()`` renders docs/configuration.md from it, so the
+reference doc cannot drift from the code.
+
+Keys marked ``compat=True`` are accepted from reference opentsdb.conf
+files but not (yet) read by this codebase; they are excluded from the
+dead-key check and flagged in the generated doc.
 """
 
 from __future__ import annotations
 
 import json
 import os
+from dataclasses import dataclass
 from typing import Any
 
-# Defaults mirror Config.setDefaults (Config.java:560-659) plus TPU-native keys.
-DEFAULTS: dict[str, str] = {
-    "tsd.mode": "rw",
-    "tsd.no_diediedie": "false",
-    "tsd.network.bind": "0.0.0.0",
-    # multi-host mesh (parallel/distributed.py): coordinator "host:port"
-    # of process 0 enables jax.distributed; all three must be set
-    "tsd.network.distributed.coordinator": "",
-    "tsd.network.distributed.num_processes": "0",
-    "tsd.network.distributed.process_id": "",
-    # request-driven cluster serving (tsd/cluster.py): other TSDs whose
-    # stores this one fans /api/query out to (SaltScanner role)
-    "tsd.network.cluster.peers": "",
-    # overall per-peer-fetch budget, shared across every retry attempt
-    "tsd.network.cluster.timeout_ms": "15000",
-    # peer-failure stance after retries/breakers: "error" fails the
-    # query (the reference's scanner-error stance); "allow" answers 200
-    # with the surviving peers' data + exec_stats partialResults /
-    # clusterPeersFailed annotations
-    "tsd.network.cluster.partial_results": "error",
-    # retry/backoff for peer raw-series fetches (utils/retry.py).
-    # attempt_timeout 0 = each attempt may use the full remaining
-    # budget, so a slow-but-healthy peer keeps the window it had before
-    # retries existed; fast failures (refused, reset, garbage) leave
-    # most of the budget for their retries
-    "tsd.network.cluster.retry.max_attempts": "3",
-    "tsd.network.cluster.retry.attempt_timeout_ms": "0",
-    # per-peer circuit breaker: open after N consecutive fetch failures
-    # (0 disables), half-open probe after the cooldown; state surfaces
-    # via /api/stats (cluster.breaker.*)
-    "tsd.network.cluster.breaker.threshold": "5",
-    "tsd.network.cluster.breaker.cooldown_ms": "5000",
-    # fault injection (utils/faults.py): inline JSON spec list or @path.
-    # A testing/chaos surface — NEVER arm in production.
-    "tsd.faults.config": "",
-    "tsd.network.port": "",
-    "tsd.network.worker_threads": "",
-    "tsd.network.async_io": "true",
-    "tsd.network.tcp_no_delay": "true",
-    "tsd.network.keep_alive": "true",
-    "tsd.network.reuse_address": "true",
-    "tsd.core.authentication.enable": "false",
-    "tsd.core.authentication.plugin": "",
-    "tsd.core.auto_create_metrics": "false",
-    "tsd.core.auto_create_tagks": "true",
-    "tsd.core.auto_create_tagvs": "true",
-    "tsd.core.connections.limit": "0",
-    "tsd.core.enable_api": "true",
-    "tsd.core.enable_ui": "true",
-    "tsd.core.histograms.config": "",
-    "tsd.core.meta.enable_realtime_ts": "false",
-    "tsd.core.meta.enable_realtime_uid": "false",
-    "tsd.core.meta.enable_tsuid_incrementing": "false",
-    "tsd.core.meta.enable_tsuid_tracking": "false",
-    "tsd.core.meta.cache.enable": "false",
-    "tsd.core.meta.cache.plugin": "",
-    "tsd.core.plugin_path": "",
-    "tsd.core.response.async": "true",
-    "tsd.core.socket.timeout": "0",
-    "tsd.core.tree.enable_processing": "false",
-    "tsd.core.preload_uid_cache": "false",
-    "tsd.core.preload_uid_cache.max_entries": "300000",
-    "tsd.core.storage_exception_handler.enable": "false",
-    "tsd.core.storage_exception_handler.plugin": "",
-    "tsd.core.uid.random_metrics": "false",
-    "tsd.core.bulk.allow_out_of_order_timestamps": "false",
-    "tsd.core.timezone": "UTC",
-    "tsd.query.filter.expansion_limit": "4096",
-    "tsd.query.skip_unresolved_tagvs": "false",
-    "tsd.query.allow_simultaneous_duplicates": "true",
-    "tsd.query.enable_fuzzy_filter": "true",
-    "tsd.query.limits.bytes.default": "0",
-    "tsd.query.limits.bytes.allow_override": "false",
-    "tsd.query.limits.data_points.default": "0",
-    "tsd.query.limits.data_points.allow_override": "false",
-    "tsd.query.limits.overrides.config": "",
-    "tsd.query.limits.overrides.interval": "60000",
-    # TPU-native: /api/query mesh serving (the salt-scanner fan-out analog).
-    # min_series gates the mesh to batches wide enough to amortize the
-    # collective latency; below it the single-dispatch grouped path serves.
-    "tsd.query.mesh.enable": "true",
-    "tsd.query.mesh.min_series": "8",
-    # Small-query fast lane: below this many scanned points a query's
-    # dispatch runs the SAME jitted pipeline on the host CPU platform —
-    # the accelerator dispatch floor (tunnel RTT + launch + transfer)
-    # dwarfs the compute at this scale (VERDICT r3 weak #2).  0 disables.
-    "tsd.query.host_lane.max_points": "2000000",
-    # TPU-native: streaming (chunked) execution for beyond-memory queries.
-    # Queries selecting more than point_threshold datapoints stream through
-    # the device in chunk_points-sized slices instead of materializing one
-    # [S, N] batch in host memory (SaltScanner's overlapped-scan analog).
-    "tsd.query.streaming.point_threshold": "8000000",
-    "tsd.query.streaming.chunk_points": "4000000",
-    # rank-based downsample fns stream via the mergeable quantile summary
-    # (approximate, rank error ~chunks/(2K)); false = materialize instead,
-    # subject to the scan budgets
-    "tsd.query.streaming.sketch_percentiles": "true",
-    # auto-protect (VERDICT r3 #7): when one (series, window) cell would
-    # absorb more than this many chunk merges (window span >> chunk span,
-    # e.g. "0all" over a huge range, worst-case rank drift ~merges/128),
-    # the planner routes to the exact materialized path — which the scan
-    # budgets then admit or 413 — instead of silently drifting.  0 trusts
-    # the sketch unconditionally.
-    "tsd.query.streaming.sketch_max_merges": "4",
-    # refuse queries whose streaming accumulator grid (S x W x lanes)
-    # would exceed this many MB of device memory (0 = unlimited); the
-    # 413 points the operator at a coarser interval or a shorter range
-    "tsd.query.streaming.state_mb": "6144",
-    # TPU-native: device-resident series cache (the BlockCache analog) —
-    # hot metrics' columns pinned in HBM; repeat queries assemble their
-    # batch on-device with zero host->device data traffic.  Size is a
-    # byte budget (LRU); metrics beyond build_max_points are never cached
-    # (the streaming path owns beyond-memory scans).
-    "tsd.query.device_cache.enable": "true",
-    "tsd.query.device_cache.mb": "4096",
-    "tsd.query.device_cache.build_max_points": "200000000",
-    "tsd.query.device_cache.batch_mb": "6144",
-    # Hot-path kernel strategies (chip-A/B'd by bench_prefix.py; the
-    # measurement session records winners in BENCH_WINNERS.json).  Empty
-    # keeps the module defaults / TSDB_*_MODE env; every form carries
-    # shape guards that demote it off losing shapes regardless.
-    # empty = module default ("auto": the ops/costmodel.py shape chooser)
-    "tsd.query.kernel.scan_mode": "",          # auto|flat|blocked|subblock|subblock2
-    "tsd.query.kernel.search_mode": "",        # auto|scan|compare_all|hier
-    "tsd.query.kernel.extreme_mode": "",       # auto|scan|segment|subblock
-    "tsd.query.kernel.group_reduce_mode": "",  # auto|segment|matmul|sorted
-    # Demote dense (accelerator-winner) search forms to the binary scan
-    # on CPU execution — the planner's small-query host lane included
-    # (measured 18x slower there under the chip-crowned modes).  Empty
-    # keeps the module default (on); "false" opts out.
-    "tsd.query.kernel.platform_guard": "",
-    # Streamed chunks take the segment form when W > ratio * N (or the
-    # TSDB_STREAM_SEGMENT_RATIO env); empty keeps the module default.
-    "tsd.query.kernel.stream_segment_ratio": "",
-    "tsd.query.multi_get.enable": "false",
-    "tsd.query.multi_get.limit": "131072",
-    "tsd.query.multi_get.batch_size": "1024",
-    "tsd.query.multi_get.concurrent": "20",
-    "tsd.query.multi_get.get_all_salts": "false",
-    "tsd.query.timeout": "0",
-    "tsd.rpc.plugins": "",
-    "tsd.rpc.telnet.return_errors": "true",
-    "tsd.rollups.enable": "false",
-    "tsd.rollups.config": "",
-    "tsd.rollups.tag_raw": "false",
-    "tsd.rollups.agg_tag_key": "_aggregate",
-    "tsd.rollups.raw_agg_tag_value": "RAW",
-    "tsd.rollups.block_derived": "true",
-    "tsd.rollups.split_query.enable": "false",
-    "tsd.rtpublisher.enable": "false",
-    "tsd.rtpublisher.plugin": "",
-    "tsd.search.enable": "false",
-    "tsd.search.plugin": "",
-    "tsd.stats.canonical": "false",
-    "tsd.startup.enable": "false",
-    "tsd.startup.plugin": "",
-    "tsd.storage.fix_duplicates": "false",
-    "tsd.storage.flush_interval": "1000",
-    "tsd.storage.data_table": "tsdb",
-    "tsd.storage.uid_table": "tsdb-uid",
-    "tsd.storage.tree_table": "tsdb-tree",
-    "tsd.storage.meta_table": "tsdb-meta",
-    "tsd.storage.enable_appends": "false",
-    "tsd.storage.repair_appends": "false",
-    "tsd.storage.enable_compaction": "true",
-    "tsd.storage.compaction.flush_interval": "10",
-    "tsd.storage.compaction.min_flush_threshold": "100",
-    "tsd.storage.compaction.max_concurrent_flushes": "10000",
-    "tsd.storage.compaction.flush_speed": "2",
-    # TPU-native durability cadences (maintenance thread; 0 = disabled).
-    "tsd.storage.wal_sync_interval": "0",
-    # opt-in per-append WAL fsync: every journaled record hits the disk
-    # barrier before the write acks (crash-consistent at ingest cost;
-    # the default leans on the wal_sync_interval cadence instead)
-    "tsd.storage.wal.fsync": "false",
-    "tsd.storage.snapshot_interval": "0",
-    # Compressed binary snapshots via the native chunk engine (native/);
-    # falls back to npz automatically when the library can't build.
-    "tsd.storage.native_snapshot": "true",
-    "tsd.storage.salt.width": "0",
-    "tsd.storage.salt.buckets": "20",
-    "tsd.storage.uid.width.metric": "3",
-    "tsd.storage.uid.width.tagk": "3",
-    "tsd.storage.uid.width.tagv": "3",
-    "tsd.storage.max_tags": "8",
-    "tsd.storage.directory": "",
-    "tsd.timeseriesfilter.enable": "false",
-    "tsd.timeseriesfilter.plugin": "",
-    "tsd.uid.use_mode": "false",
-    "tsd.uid.lru.enable": "false",
-    "tsd.uid.lru.name.size": "5000000",
-    "tsd.uid.lru.id.size": "5000000",
-    "tsd.uidfilter.enable": "false",
-    "tsd.uidfilter.plugin": "",
-    "tsd.core.stats_with_port": "false",
-    "tsd.http.show_stack_trace": "true",
-    "tsd.http.query.allow_delete": "false",
-    "tsd.http.header_tag": "",
-    "tsd.http.request.enable_chunked": "true",
-    "tsd.http.request.max_chunk": "1048576",
-    "tsd.http.request.cors_domains": "",
-    "tsd.http.request.cors_headers": (
-        "Authorization, Content-Type, Accept, Origin, User-Agent, DNT, "
-        "Cache-Control, X-Mx-ReqToken, Keep-Alive, X-Requested-With, "
-        "If-Modified-Since"),
-    "tsd.http.cachedir": "",
-    "tsd.http.staticroot": "",
-    # --- TPU-native knobs (no reference equivalent) ---
-    "tsd.tpu.enable": "true",
-    "tsd.tpu.mesh.shards": "0",            # 0 = use all visible devices
-    "tsd.tpu.batch.max_series": "4096",
-    "tsd.tpu.batch.pad_pow2": "true",
-    "tsd.tpu.precision.x64": "true",
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    """One declared key: accessor type, default (always a string — the
+    properties file is untyped), one-line doc, and the compat flag."""
+    type: str           # "str" | "int" | "float" | "bool" | "dir"
+    default: str
+    doc: str
+    compat: bool = False
+
+
+def _e(type: str, default: Any, doc: str, compat: bool = False
+       ) -> ConfigEntry:
+    if isinstance(default, bool):
+        default = "true" if default else "false"
+    return ConfigEntry(type, str(default), doc, compat)
+
+
+CONFIG_SCHEMA: dict[str, ConfigEntry] = {
+    # -- daemon -------------------------------------------------------- #
+    "tsd.mode": _e("str", "rw",
+                   "Operation mode: rw, ro (reads only) or wo (writes "
+                   "only); gates which RPC routes mount."),
+    "tsd.no_diediedie": _e("bool", False,
+                           "Disable the telnet/HTTP diediedie shutdown "
+                           "command."),
+    "tsd.network.bind": _e("str", "0.0.0.0",
+                           "Address the TSD listens on."),
+    "tsd.network.port": _e("int", "",
+                           "TCP port to serve on (telnet + HTTP on one "
+                           "socket); empty defers to the CLI --port."),
+    "tsd.network.keep_alive_timeout": _e(
+        "int", "300",
+        "Idle seconds before an open connection is dropped."),
+    "tsd.network.worker_threads": _e(
+        "int", "", "Responder thread count (reference compat; the "
+        "daemon takes --worker-threads).", compat=True),
+    "tsd.network.async_io": _e("bool", True,
+                               "Reference compat; I/O is always async "
+                               "here.", compat=True),
+    "tsd.network.tcp_no_delay": _e("bool", True,
+                                   "Reference compat socket flag.",
+                                   compat=True),
+    "tsd.network.keep_alive": _e("bool", True,
+                                 "Reference compat socket flag.",
+                                 compat=True),
+    "tsd.network.reuse_address": _e("bool", True,
+                                    "Reference compat socket flag.",
+                                    compat=True),
+    # -- multi-host mesh (parallel/distributed.py) --------------------- #
+    "tsd.network.distributed.coordinator": _e(
+        "str", "", "Coordinator host:port of process 0; setting it (plus "
+        "num_processes/process_id) enables jax.distributed."),
+    "tsd.network.distributed.num_processes": _e(
+        "int", "0", "Process count of the distributed mesh."),
+    "tsd.network.distributed.process_id": _e(
+        "int", "", "This process's rank in the distributed mesh."),
+    # -- request-driven cluster serving (tsd/cluster.py) --------------- #
+    "tsd.network.cluster.peers": _e(
+        "str", "", "Comma-separated host:port of the OTHER TSDs whose "
+        "stores /api/query fans out to (empty = single-host serving)."),
+    "tsd.network.cluster.timeout_ms": _e(
+        "int", "15000", "Overall per-peer-fetch budget, shared across "
+        "every retry attempt."),
+    "tsd.network.cluster.partial_results": _e(
+        "str", "error", "Peer-failure stance after retries/breakers: "
+        "'error' fails the query; 'allow' answers 200 with surviving "
+        "peers' data plus partialResults annotations."),
+    "tsd.network.cluster.retry.max_attempts": _e(
+        "int", "3", "Attempts per peer raw-series fetch "
+        "(utils/retry.py capped exponential backoff)."),
+    "tsd.network.cluster.retry.attempt_timeout_ms": _e(
+        "int", "0", "Per-attempt deadline; 0 = each attempt may use the "
+        "full remaining budget."),
+    "tsd.network.cluster.breaker.threshold": _e(
+        "int", "5", "Consecutive fetch failures that open a peer's "
+        "circuit breaker (0 disables breakers)."),
+    "tsd.network.cluster.breaker.cooldown_ms": _e(
+        "int", "5000", "Open -> half-open probe delay; breaker state "
+        "surfaces via /api/stats (cluster.breaker.*)."),
+    # -- fault injection (utils/faults.py) ----------------------------- #
+    "tsd.faults.config": _e(
+        "str", "", "Fault-injection spec: inline JSON list or @path. "
+        "A testing/chaos surface — NEVER arm in production.  Specs are "
+        "validated against the registered hook sites at startup."),
+    # -- core ---------------------------------------------------------- #
+    "tsd.core.authentication.enable": _e(
+        "bool", False, "Require telnet/HTTP authentication."),
+    "tsd.core.authentication.plugin": _e(
+        "str", "", "Authentication plugin class path."),
+    "tsd.core.auto_create_metrics": _e(
+        "bool", False, "Assign UIDs to unseen metric names on ingest "
+        "instead of rejecting the point."),
+    "tsd.core.auto_create_tagks": _e(
+        "bool", True, "Assign UIDs to unseen tag keys on ingest."),
+    "tsd.core.auto_create_tagvs": _e(
+        "bool", True, "Assign UIDs to unseen tag values on ingest."),
+    "tsd.core.connections.limit": _e(
+        "int", "0", "Max concurrent open connections (0 = unlimited)."),
+    "tsd.core.enable_api": _e("bool", True, "Mount the /api routes."),
+    "tsd.core.enable_ui": _e("bool", True,
+                             "Mount the built-in UI routes."),
+    "tsd.core.histograms.config": _e(
+        "str", "", "Histogram codec config: inline JSON or @path."),
+    "tsd.core.meta.enable_realtime_ts": _e(
+        "bool", False, "Track TSMeta objects in real time."),
+    "tsd.core.meta.enable_realtime_uid": _e(
+        "bool", False, "Track UIDMeta objects in real time."),
+    "tsd.core.meta.enable_tsuid_incrementing": _e(
+        "bool", False, "Increment a counter per TSUID on ingest."),
+    "tsd.core.meta.enable_tsuid_tracking": _e(
+        "bool", False, "Track last-write per TSUID on ingest."),
+    "tsd.core.meta.cache.enable": _e(
+        "bool", False, "Reference compat meta-cache toggle.",
+        compat=True),
+    "tsd.core.meta.cache.plugin": _e(
+        "str", "", "Reference compat meta-cache plugin.", compat=True),
+    "tsd.core.plugin_path": _e(
+        "dir", "", "Directory added to the import path for plugin "
+        "discovery."),
+    "tsd.core.response.async": _e(
+        "bool", True, "Reference compat; responses are always async.",
+        compat=True),
+    "tsd.core.socket.timeout": _e(
+        "int", "0", "Reference compat socket timeout.", compat=True),
+    "tsd.core.tree.enable_processing": _e(
+        "bool", False, "Run tree rules against incoming TSMeta."),
+    "tsd.core.preload_uid_cache": _e(
+        "bool", False, "Reference compat UID-cache preload.",
+        compat=True),
+    "tsd.core.preload_uid_cache.max_entries": _e(
+        "int", "300000", "Reference compat UID-cache preload bound.",
+        compat=True),
+    "tsd.core.storage_exception_handler.enable": _e(
+        "bool", False, "Enable the failed-write spillway plugin."),
+    "tsd.core.storage_exception_handler.plugin": _e(
+        "str", "", "Storage exception handler plugin class path."),
+    "tsd.core.uid.random_metrics": _e(
+        "bool", False, "Assign metric UIDs randomly instead of "
+        "sequentially."),
+    "tsd.core.bulk.allow_out_of_order_timestamps": _e(
+        "bool", False, "Reference compat bulk-import flag.",
+        compat=True),
+    "tsd.core.timezone": _e(
+        "str", "UTC", "Reference compat default timezone (queries carry "
+        "their own tz).", compat=True),
+    "tsd.core.stats_with_port": _e(
+        "bool", False, "Reference compat: tag stats with the TSD port.",
+        compat=True),
+    # -- query --------------------------------------------------------- #
+    "tsd.query.filter.expansion_limit": _e(
+        "int", "4096", "Reference compat filter-expansion bound.",
+        compat=True),
+    "tsd.query.skip_unresolved_tagvs": _e(
+        "bool", False, "Reference compat unresolved-tagv stance.",
+        compat=True),
+    "tsd.query.allow_simultaneous_duplicates": _e(
+        "bool", True, "Allow identical queries to run concurrently "
+        "instead of rejecting the second."),
+    "tsd.query.enable_fuzzy_filter": _e(
+        "bool", True, "Reference compat fuzzy-row-filter toggle.",
+        compat=True),
+    "tsd.query.limits.bytes.default": _e(
+        "int", "0", "Per-query scanned-bytes budget (0 = unlimited); "
+        "exceeding answers 413."),
+    "tsd.query.limits.bytes.allow_override": _e(
+        "bool", False, "Reference compat per-query override toggle.",
+        compat=True),
+    "tsd.query.limits.data_points.default": _e(
+        "int", "0", "Per-query scanned-datapoints budget (0 = "
+        "unlimited)."),
+    "tsd.query.limits.data_points.allow_override": _e(
+        "bool", False, "Reference compat per-query override toggle.",
+        compat=True),
+    "tsd.query.limits.overrides.config": _e(
+        "str", "", "Per-metric budget overrides: inline JSON or @path."),
+    "tsd.query.limits.overrides.interval": _e(
+        "int", "60000", "Override-config reload interval (ms)."),
+    "tsd.query.mesh.enable": _e(
+        "bool", True, "Serve wide /api/query batches via the sharded "
+        "device mesh (the salt-scanner fan-out analog)."),
+    "tsd.query.mesh.min_series": _e(
+        "int", "8", "Min series per batch before the mesh path engages "
+        "(amortizes collective latency)."),
+    "tsd.query.host_lane.max_points": _e(
+        "int", "2000000", "Below this many scanned points the jitted "
+        "pipeline runs on the host CPU platform — the accelerator "
+        "dispatch floor dwarfs the compute at this scale.  0 disables."),
+    "tsd.query.streaming.point_threshold": _e(
+        "int", "8000000", "Queries past this many datapoints stream "
+        "through the device in chunks instead of materializing one "
+        "[S, N] batch."),
+    "tsd.query.streaming.chunk_points": _e(
+        "int", "4000000", "Streaming chunk size in points."),
+    "tsd.query.streaming.sketch_percentiles": _e(
+        "bool", True, "Rank-based downsample fns stream via the "
+        "mergeable quantile sketch (approximate); false materializes "
+        "subject to the scan budgets."),
+    "tsd.query.streaming.sketch_max_merges": _e(
+        "int", "4", "Max chunk merges per (series, window) cell before "
+        "the planner routes to the exact materialized path (0 trusts "
+        "the sketch unconditionally)."),
+    "tsd.query.streaming.state_mb": _e(
+        "int", "6144", "Refuse queries whose streaming accumulator grid "
+        "would exceed this many MB of device memory (0 = unlimited)."),
+    "tsd.query.device_cache.enable": _e(
+        "bool", True, "Pin hot metrics' columns in device HBM (the "
+        "BlockCache analog); repeat queries assemble batches on-device."),
+    "tsd.query.device_cache.mb": _e(
+        "int", "4096", "Device cache byte budget (LRU eviction)."),
+    "tsd.query.device_cache.build_max_points": _e(
+        "int", "200000000", "Metrics beyond this many points are never "
+        "cached (the streaming path owns beyond-memory scans)."),
+    "tsd.query.device_cache.batch_mb": _e(
+        "int", "6144", "Decline cached-batch gathers whose padded "
+        "[S, N] expansion exceeds this bound."),
+    "tsd.query.kernel.scan_mode": _e(
+        "str", "", "Prefix-scan strategy: auto|flat|blocked|subblock|"
+        "subblock2 (empty keeps the module default / TSDB_SCAN_MODE "
+        "env)."),
+    "tsd.query.kernel.search_mode": _e(
+        "str", "", "Edge-search strategy: auto|scan|compare_all|hier."),
+    "tsd.query.kernel.extreme_mode": _e(
+        "str", "", "min/max downsample strategy: "
+        "auto|scan|segment|subblock."),
+    "tsd.query.kernel.group_reduce_mode": _e(
+        "str", "", "Group-reduce strategy: auto|segment|matmul|sorted."),
+    "tsd.query.kernel.platform_guard": _e(
+        "bool", "", "Demote dense search forms to the binary scan on "
+        "CPU execution (empty keeps the module default: on)."),
+    "tsd.query.kernel.stream_segment_ratio": _e(
+        "float", "", "Streamed chunks take the segment form when "
+        "W > ratio * N (empty keeps the module default)."),
+    "tsd.query.multi_get.enable": _e(
+        "bool", False, "Reference compat multigets toggle.", compat=True),
+    "tsd.query.multi_get.limit": _e(
+        "int", "131072", "Reference compat multigets bound.",
+        compat=True),
+    "tsd.query.multi_get.batch_size": _e(
+        "int", "1024", "Reference compat multigets batch size.",
+        compat=True),
+    "tsd.query.multi_get.concurrent": _e(
+        "int", "20", "Reference compat multigets concurrency.",
+        compat=True),
+    "tsd.query.multi_get.get_all_salts": _e(
+        "bool", False, "Reference compat multigets salt stance.",
+        compat=True),
+    "tsd.query.timeout": _e(
+        "int", "0", "Per-query wall-clock timeout in ms (0 = none)."),
+    # -- rpc / rollups / plugins --------------------------------------- #
+    "tsd.rpc.plugins": _e(
+        "str", "", "Reference compat RPC plugin list.", compat=True),
+    "tsd.rpc.telnet.return_errors": _e(
+        "bool", True, "Reference compat telnet error stance.",
+        compat=True),
+    "tsd.rollups.enable": _e("bool", False,
+                             "Enable rollup/pre-aggregate ingest and "
+                             "query serving."),
+    "tsd.rollups.config": _e(
+        "str", "", "Rollup interval table: inline JSON or @path."),
+    "tsd.rollups.tag_raw": _e(
+        "bool", False, "Tag raw datapoints with the agg tag on ingest."),
+    "tsd.rollups.agg_tag_key": _e(
+        "str", "_aggregate", "Tag key marking pre-aggregated series."),
+    "tsd.rollups.raw_agg_tag_value": _e(
+        "str", "RAW", "Agg-tag value marking raw series."),
+    "tsd.rollups.block_derived": _e(
+        "bool", True, "Reject queries for derived aggregates with no "
+        "stored lane."),
+    "tsd.rollups.split_query.enable": _e(
+        "bool", False, "Serve query head from rollups and tail from raw "
+        "(SplitRollupQuery)."),
+    "tsd.rtpublisher.enable": _e(
+        "bool", False, "Publish ingested points to a real-time plugin."),
+    "tsd.rtpublisher.plugin": _e(
+        "str", "", "Real-time publisher plugin class path."),
+    "tsd.search.enable": _e("bool", False,
+                            "Index meta/annotations into a search "
+                            "plugin."),
+    "tsd.search.plugin": _e("str", "", "Search plugin class path."),
+    "tsd.stats.canonical": _e(
+        "bool", False, "Reference compat canonical-stats naming.",
+        compat=True),
+    "tsd.startup.enable": _e("bool", False, "Run a startup plugin."),
+    "tsd.startup.plugin": _e("str", "", "Startup plugin class path."),
+    # -- storage ------------------------------------------------------- #
+    "tsd.storage.fix_duplicates": _e(
+        "bool", False, "Resolve duplicate timestamps at read (last "
+        "write wins) instead of raising."),
+    "tsd.storage.flush_interval": _e(
+        "int", "1000", "Reference compat HBase flush interval.",
+        compat=True),
+    "tsd.storage.data_table": _e(
+        "str", "tsdb", "Reference compat table name.", compat=True),
+    "tsd.storage.uid_table": _e(
+        "str", "tsdb-uid", "Reference compat table name.", compat=True),
+    "tsd.storage.tree_table": _e(
+        "str", "tsdb-tree", "Reference compat table name.", compat=True),
+    "tsd.storage.meta_table": _e(
+        "str", "tsdb-meta", "Reference compat table name.", compat=True),
+    "tsd.storage.enable_appends": _e(
+        "bool", False, "Reference compat append-write mode.",
+        compat=True),
+    "tsd.storage.repair_appends": _e(
+        "bool", False, "Reference compat append repair mode.",
+        compat=True),
+    "tsd.storage.enable_compaction": _e(
+        "bool", True, "Background-compact dirty series rows."),
+    "tsd.storage.compaction.flush_interval": _e(
+        "int", "10", "Seconds between compaction flush passes."),
+    "tsd.storage.compaction.min_flush_threshold": _e(
+        "int", "100", "Backlog size that triggers an early flush pass."),
+    "tsd.storage.compaction.max_concurrent_flushes": _e(
+        "int", "10000", "Max series flushed per pass."),
+    "tsd.storage.compaction.flush_speed": _e(
+        "int", "2", "Backlog-pressure multiplier on the per-pass flush "
+        "slice."),
+    "tsd.storage.wal_sync_interval": _e(
+        "int", "0", "Seconds between WAL fsync passes (0 = disabled; "
+        "line buffering still survives process crashes)."),
+    "tsd.storage.wal.fsync": _e(
+        "bool", False, "fsync the WAL per journaled record: "
+        "crash-consistent at ingest cost (default rides the "
+        "wal_sync_interval cadence)."),
+    "tsd.storage.snapshot_interval": _e(
+        "int", "0", "Seconds between full state snapshots (0 = "
+        "disabled)."),
+    "tsd.storage.native_snapshot": _e(
+        "bool", True, "Snapshot series via the compressed native chunk "
+        "engine; falls back to npz when the library can't build."),
+    "tsd.storage.salt.width": _e(
+        "int", "0", "Row-key salt width (reference parity; affects "
+        "TSUID shape)."),
+    "tsd.storage.salt.buckets": _e(
+        "int", "20", "Salt bucket count."),
+    "tsd.storage.uid.width.metric": _e(
+        "int", "3", "Metric UID byte width."),
+    "tsd.storage.uid.width.tagk": _e(
+        "int", "3", "Tag-key UID byte width."),
+    "tsd.storage.uid.width.tagv": _e(
+        "int", "3", "Tag-value UID byte width."),
+    "tsd.storage.max_tags": _e(
+        "int", "8", "Reference compat max tags per point (enforced as a "
+        "constant here).", compat=True),
+    "tsd.storage.directory": _e(
+        "dir", "", "Directory for snapshots + the WAL; empty disables "
+        "persistence."),
+    # -- uid / filters ------------------------------------------------- #
+    "tsd.timeseriesfilter.enable": _e(
+        "bool", False, "Enable the per-point write filter plugin."),
+    "tsd.timeseriesfilter.plugin": _e(
+        "str", "", "Write filter plugin class path."),
+    "tsd.uid.use_mode": _e(
+        "bool", False, "Reference compat UID mode flag.", compat=True),
+    "tsd.uid.lru.enable": _e(
+        "bool", False, "Reference compat UID LRU cache toggle.",
+        compat=True),
+    "tsd.uid.lru.name.size": _e(
+        "int", "5000000", "Reference compat UID LRU bound.", compat=True),
+    "tsd.uid.lru.id.size": _e(
+        "int", "5000000", "Reference compat UID LRU bound.", compat=True),
+    "tsd.uidfilter.enable": _e(
+        "bool", False, "Enable the UID-assignment filter plugin."),
+    "tsd.uidfilter.plugin": _e(
+        "str", "", "UID filter plugin class path."),
+    "tsd.uidfilter.metric_whitelist": _e(
+        "str", "", "Comma-separated regexes a new metric name must "
+        "match (UniqueIdWhitelistFilter)."),
+    "tsd.uidfilter.metric_blacklist": _e(
+        "str", "", "Comma-separated regexes that reject a new metric "
+        "name."),
+    "tsd.uidfilter.tagk_whitelist": _e(
+        "str", "", "Whitelist regexes for new tag keys."),
+    "tsd.uidfilter.tagk_blacklist": _e(
+        "str", "", "Blacklist regexes for new tag keys."),
+    "tsd.uidfilter.tagv_whitelist": _e(
+        "str", "", "Whitelist regexes for new tag values."),
+    "tsd.uidfilter.tagv_blacklist": _e(
+        "str", "", "Blacklist regexes for new tag values."),
+    # -- http ---------------------------------------------------------- #
+    "tsd.http.show_stack_trace": _e(
+        "bool", True, "Include the stack trace in error envelopes."),
+    "tsd.http.query.allow_delete": _e(
+        "bool", False, "Allow DELETE /api/query (and the delete query "
+        "flag) to drop matched datapoints."),
+    "tsd.http.header_tag": _e(
+        "str", "", "Reference compat header-to-tag mapping.",
+        compat=True),
+    "tsd.http.request.enable_chunked": _e(
+        "bool", True, "Reference compat chunked-request toggle.",
+        compat=True),
+    "tsd.http.request.max_chunk": _e(
+        "int", "1048576", "Reference compat chunk size bound.",
+        compat=True),
+    "tsd.http.request.cors_domains": _e(
+        "str", "", "Comma-separated origins allowed CORS access "
+        "(* allows any)."),
+    "tsd.http.request.cors_headers": _e(
+        "str", ("Authorization, Content-Type, Accept, Origin, "
+                "User-Agent, DNT, Cache-Control, X-Mx-ReqToken, "
+                "Keep-Alive, X-Requested-With, If-Modified-Since"),
+        "Headers returned in Access-Control-Allow-Headers."),
+    "tsd.http.cachedir": _e(
+        "dir", "", "Graph/cache scratch directory."),
+    "tsd.http.staticroot": _e(
+        "dir", "", "Static UI file root."),
+    # -- TPU-native knobs (no reference equivalent) -------------------- #
+    "tsd.tpu.enable": _e(
+        "bool", True, "Reserved master toggle for accelerator serving.",
+        compat=True),
+    "tsd.tpu.mesh.shards": _e(
+        "int", "0", "Device-mesh shard count (0 = all visible devices).",
+        compat=True),
+    "tsd.tpu.batch.max_series": _e(
+        "int", "4096", "Reserved batch-width bound.", compat=True),
+    "tsd.tpu.batch.pad_pow2": _e(
+        "bool", True, "Reserved pow2-padding toggle.", compat=True),
+    "tsd.tpu.precision.x64": _e(
+        "bool", True, "Require 64-bit JAX arithmetic (Java double/long "
+        "parity; int64 ms timestamps).  True (default): TSDB "
+        "construction re-enables jax_enable_x64 if something turned it "
+        "off.  False: x64 is left alone and the downsample planners "
+        "refuse int64 window math while it is off rather than silently "
+        "truncate ms timestamps."),
 }
 
+# Defaults mirror Config.setDefaults (Config.java:560-659) plus TPU-native
+# keys; derived from the schema so the two can never diverge.
+DEFAULTS: dict[str, str] = {k: e.default for k, e in CONFIG_SCHEMA.items()}
+
 _SECRET_MARKERS = ("pass", "key", "secret", "token")
+
+
+def generate_config_doc() -> str:
+    """Render docs/configuration.md from CONFIG_SCHEMA (one table per
+    top-level prefix).  tests/test_lint_clean.py pins the committed file
+    to this output."""
+    groups: dict[str, list[tuple[str, ConfigEntry]]] = {}
+    for key, entry in sorted(CONFIG_SCHEMA.items()):
+        prefix = ".".join(key.split(".")[:2])
+        groups.setdefault(prefix, []).append((key, entry))
+    lines = [
+        "# Configuration reference",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand.",
+        "     Regenerate with: python tools/lint/run.py --update-doc",
+        "     Source of truth: opentsdb_tpu/utils/config.py "
+        "CONFIG_SCHEMA. -->",
+        "",
+        "All keys live in a flat Java-properties file "
+        "(`./opentsdb.conf` or `/etc/opentsdb/opentsdb.conf`, or any "
+        "path passed to `Config`).  Types are enforced by tsdblint "
+        "against the accessor used at every read site.  Keys marked "
+        "*compat* are accepted from reference OpenTSDB config files but "
+        "not read by this codebase yet.",
+        "",
+    ]
+    for prefix in sorted(groups):
+        lines.append("## `%s.*`" % prefix)
+        lines.append("")
+        lines.append("| key | type | default | description |")
+        lines.append("|---|---|---|---|")
+        for key, entry in groups[prefix]:
+            default = entry.default if len(entry.default) <= 40 \
+                else entry.default[:37] + "..."
+            doc = entry.doc + (" *(compat)*" if entry.compat else "")
+            lines.append("| `%s` | %s | `%s` | %s |"
+                         % (key, entry.type,
+                            default.replace("|", "\\|") or " ",
+                            doc.replace("|", "\\|")))
+        lines.append("")
+    return "\n".join(lines)
 
 
 class Config:

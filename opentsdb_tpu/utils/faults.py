@@ -52,9 +52,57 @@ LOG = logging.getLogger(__name__)
 
 CONFIG_KEY = "tsd.faults.config"
 
+# The registered hook sites and the context keys their call sites pass.
+# Specs are validated against this at install time: a typo'd site or
+# match key would otherwise arm NOTHING and silently defeat the chaos
+# harness (the fault "passes" because it never fires).
+KNOWN_SITES: dict[str, frozenset] = {
+    "cluster.peer_fetch": frozenset({"peer"}),
+    "cluster.peer_body": frozenset({"peer"}),
+    "wal.append": frozenset(),
+    "wal.fsync": frozenset(),
+}
+# Body-corruption kinds only make sense at mangle() sites.
+BODY_SITES = frozenset({"cluster.peer_body"})
+CHECK_KINDS = frozenset({"latency", "refuse", "error", "disconnect"})
+BODY_KINDS = frozenset({"garbage", "disconnect"})
+
 
 class FaultError(OSError):
     """Raised by the generic "error" fault kind."""
+
+
+class FaultSpecError(ValueError):
+    """An invalid fault spec: unknown site/kind/match key.  Raised at
+    install (daemon startup for config-armed specs) — loudly, because a
+    fault that silently never fires is a chaos test that tests nothing."""
+
+
+def validate_spec(spec: dict) -> None:
+    if not isinstance(spec, dict):
+        raise FaultSpecError("fault spec must be an object: %r" % (spec,))
+    site = spec.get("site")
+    kind = spec.get("kind")
+    if site not in KNOWN_SITES:
+        raise FaultSpecError(
+            "unknown fault site %r (known: %s)"
+            % (site, ", ".join(sorted(KNOWN_SITES))))
+    allowed = CHECK_KINDS | (BODY_KINDS if site in BODY_SITES
+                             else frozenset())
+    if kind not in allowed:
+        raise FaultSpecError(
+            "fault kind %r is not valid at site %r (allowed: %s)"
+            % (kind, site, ", ".join(sorted(allowed))))
+    match = spec.get("match") or {}
+    unknown = set(match) - KNOWN_SITES[site]
+    if unknown:
+        raise FaultSpecError(
+            "match key(s) %s are never passed at site %r (context keys: "
+            "%s)" % (sorted(unknown), site,
+                     ", ".join(sorted(KNOWN_SITES[site])) or "none"))
+    times = spec.get("times")
+    if times is not None and (not isinstance(times, int) or times <= 0):
+        raise FaultSpecError("'times' must be a positive int: %r" % times)
 
 
 class _Fault:
@@ -79,14 +127,20 @@ class FaultInjector:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._faults: list[_Fault] = []
-        self._active = False        # fast-path gate, read without lock
-        self._installed_configs: set[str] = set()
-        self.injected = 0
+        self._active = False  # guarded-by: _lock (fast-path read lockless)
+        self._installed_configs: set[str] = set()  # guarded-by: _lock
+        self.injected = 0  # guarded-by: _lock
 
     # -- arming --
 
     def install(self, specs: list[dict]) -> None:
+        """Arm specs; every spec validates against KNOWN_SITES first so
+        a typo'd hook name fails the install instead of silently arming
+        a fault that never fires (FaultSpecError)."""
+        for s in specs:
+            validate_spec(s)
         with self._lock:
             self._faults.extend(_Fault(s) for s in specs)
             self._active = bool(self._faults)
@@ -117,18 +171,33 @@ class FaultInjector:
             if raw in self._installed_configs:
                 return
             self._installed_configs.add(raw)
+        # any failure below un-marks the spec string: an @path whose
+        # file is fixed (or a corrected spec reinstalled after a
+        # FaultSpecError) must be able to arm on a later construction,
+        # not be silently remembered as "already installed"
+        installed = False
         try:
-            if raw.startswith("@"):
-                with open(raw[1:]) as fh:
-                    specs = json.load(fh)
-            else:
-                specs = json.loads(raw)
-        except (OSError, ValueError) as e:
-            LOG.error("ignoring unreadable %s: %s", CONFIG_KEY, e)
-            return
-        if isinstance(specs, dict):
-            specs = [specs]
-        self.install(specs)
+            try:
+                # ValueError covers JSONDecodeError AND the
+                # UnicodeDecodeError a non-UTF-8 file raises; parsing
+                # cannot raise FaultSpecError (that comes from
+                # install() below), so the broad catch is safe
+                if raw.startswith("@"):
+                    with open(raw[1:]) as fh:
+                        specs = json.load(fh)
+                else:
+                    specs = json.loads(raw)
+            except (OSError, ValueError) as e:
+                LOG.error("ignoring unreadable %s: %s", CONFIG_KEY, e)
+                return
+            if isinstance(specs, dict):
+                specs = [specs]
+            self.install(specs)      # FaultSpecError on a typo'd spec
+            installed = True
+        finally:
+            if not installed:
+                with self._lock:
+                    self._installed_configs.discard(raw)
 
     # -- hook points --
 

@@ -1,0 +1,23 @@
+"""True-negative fixtures for the config_schema analyzer: declared keys
+read through the right getters — ZERO findings against the miniature
+schema (tsd.good.flag bool / tsd.good.count int / tsd.good.name str).
+Parsed, never imported.
+"""
+
+import logging
+
+# a dotted logger name is not a config key (call arguments are exempt
+# from the module-constant idiom)
+LOG = logging.getLogger("tsd.fixture")
+
+WELL_KNOWN = "tsd.good.name"
+
+
+def read(config):
+    flag = config.get_bool("tsd.good.flag")
+    count = config.get_int("tsd.good.count")
+    # get_string is the raw accessor, legal on any declared key
+    raw = config.get_string("tsd.good.count")
+    name = config.get_string(WELL_KNOWN)
+    present = config.has_property("tsd.good.flag")
+    return flag, count, raw, name, present

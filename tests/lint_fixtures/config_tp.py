@@ -1,0 +1,27 @@
+"""True-positive fixtures for the config_schema analyzer.
+
+The unit tests run these against an injected miniature schema:
+
+    tsd.good.flag   -> bool
+    tsd.good.count  -> int
+    tsd.good.name   -> str
+
+`# EXPECT: <rule>` markers pin the (line, rule) pairs.  Parsed, never
+imported.
+"""
+
+# a typo'd module-level key constant (the CONFIG_KEY idiom)
+TYPOED_KEY = "tsd.good.flga"                 # EXPECT: config-unknown-key
+
+KEY_TABLE = {
+    "metric": ("tsd.good.name",
+               "tsd.good.nmae"),             # EXPECT: config-unknown-key
+}
+
+
+def read(config):
+    if config.get_bool("tsd.good.falg"):     # EXPECT: config-unknown-key
+        pass
+    n = config.get_bool("tsd.good.count")    # EXPECT: config-type-mismatch
+    s = config.get_int("tsd.good.name")      # EXPECT: config-type-mismatch
+    return n, s

@@ -1,0 +1,53 @@
+"""True-negative fixtures for the exception_discipline analyzer: every
+broad handler here visibly deals with the failure — ZERO findings.
+Parsed, never imported.
+"""
+
+import logging
+
+LOG = logging.getLogger("fixture")
+
+
+class Handler:
+    def __init__(self):
+        self.errors = 0
+
+    def logs(self, fn):
+        try:
+            return fn()
+        except Exception:
+            LOG.exception("fn failed")
+            return None
+
+    def counts(self, fn):
+        try:
+            return fn()
+        except Exception:
+            self.errors += 1
+            return None
+
+    def reraises(self, fn):
+        try:
+            return fn()
+        except Exception:
+            raise RuntimeError("wrapped")
+
+    def narrow(self, fn):
+        # narrow catches are outside the rule entirely
+        try:
+            return fn()
+        except (ValueError, KeyError):
+            return None
+
+    def propagates_the_object(self, fn):
+        try:
+            return fn()
+        except Exception as e:
+            return {"error": str(e)}
+
+    def suppressed(self, fn):
+        try:
+            return fn()
+        except Exception:
+            # fixture for the suppression path: silence is deliberate
+            pass  # tsdblint: disable=except-swallow

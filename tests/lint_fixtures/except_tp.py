@@ -1,0 +1,26 @@
+"""True-positive fixtures for the exception_discipline analyzer.
+`# EXPECT: <rule>` markers pin the (line, rule) pairs.  Parsed, never
+imported.
+"""
+
+
+def swallow_pass(fn):
+    try:
+        return fn()
+    except Exception:                        # EXPECT: except-swallow
+        pass
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:                                  # EXPECT: except-swallow  # noqa: E722
+        return None
+
+
+def swallow_default(fn, registry):
+    try:
+        return fn()
+    except (ValueError, Exception):          # EXPECT: except-swallow
+        registry.clear()
+        return {}

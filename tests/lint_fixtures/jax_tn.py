@@ -1,0 +1,54 @@
+"""True-negative fixtures for the jax_hygiene analyzer: every pattern
+here is legitimate and must produce ZERO findings.
+
+Parsed, never imported.  The x64 guard for the jnp.int64 use below is
+the module's own jax_enable_x64 update — the pattern the ops package
+__init__ uses.
+"""
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+
+
+def kernel(spec, ts, val):
+    # branching on a STATIC argument is resolved at trace time
+    if spec == "sum":
+        out = jnp.sum(val)
+    else:
+        out = jnp.max(val)
+    # shape/dtype/len are static metadata, not traced values
+    if ts.dtype == jnp.int32 or ts.shape[0] > 4 or len(ts.shape) > 1:
+        out = out + 1
+    # membership on a traced-args dict with a constant key is static
+    return jnp.where(val > 0, out, 0.0)
+
+
+_jitted = jax.jit(kernel, static_argnums=(0,))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def decorated(ts, width: int):
+    # int() on static metadata is fine
+    return ts.reshape(int(ts.shape[0] // width), width).astype(jnp.int64)
+
+
+@lru_cache(maxsize=8)
+def builder(n: int):
+    # memoized builder: one jit wrapper per static n, the blessed
+    # pattern for shape-keyed construction
+    def gather(ts):
+        return ts[:n]
+    return jax.jit(gather)
+
+
+def grid(wargs, ts):
+    if "base" in wargs:       # constant-key membership: trace-static
+        ts = ts + wargs["base"]
+    return ts
+
+
+_jitted_grid = jax.jit(grid)

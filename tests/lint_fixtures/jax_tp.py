@@ -1,0 +1,46 @@
+"""True-positive fixtures for the jax_hygiene analyzer.
+
+Each hazardous line carries an `# EXPECT: <rule>` marker; the analyzer
+unit tests assert exactly those (line, rule) pairs fire — no more, no
+less.  This module is parsed, never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(ts, val, threshold):
+    if val > threshold:                      # EXPECT: jax-tracer-branch
+        return ts
+    peak = float(val)                        # EXPECT: jax-host-sync
+    host = np.asarray(ts)                    # EXPECT: jax-host-sync
+    first = ts[0].item()                     # EXPECT: jax-host-sync
+    while val > 0:                           # EXPECT: jax-tracer-branch
+        val = val - 1
+    return peak + host.sum() + first
+
+
+_jitted = jax.jit(kernel)
+
+
+def helper(x):
+    # reached transitively from the jitted root: x is traced here too
+    return x.tolist()                        # EXPECT: jax-host-sync
+
+
+def outer(ts, val, threshold):
+    return helper(ts)
+
+
+_jitted_outer = jax.jit(outer, static_argnums=(2,))
+
+
+def per_call_wrapper(fn, ts):
+    wrapped = jax.jit(fn)                    # EXPECT: jax-jit-per-call
+    return wrapped(ts)
+
+
+def widen(ts):
+    # no x64 guard anywhere in this module or its package
+    return ts.astype(jnp.int64)              # EXPECT: jax-int64-no-x64-guard

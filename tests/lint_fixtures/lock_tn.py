@@ -1,0 +1,66 @@
+"""True-negative fixtures for the lock_discipline analyzer: disciplined
+locking that must produce ZERO findings.  Parsed, never imported.
+"""
+
+import threading
+
+
+class DisciplinedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self.hits = 0
+        self.misses = 0  # guarded-by: _lock
+        self.label = ""          # never mutated under the lock: not shared
+
+    def record(self, hit: bool):
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+
+    def rename(self, label: str):
+        self.label = label
+
+
+class CallerHoldsConvention:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = {}  # guarded-by: _lock
+
+    def put(self, k, v):
+        with self._lock:
+            self._evict_locked()
+            self.entries[k] = v
+
+    def _evict_locked(self):
+        # *_locked methods run with the caller holding the lock
+        while len(self.entries) > 8:
+            self.entries.popitem()
+
+
+class ReentrantSelfCall:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.n = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def bump_twice(self):
+        # RLock: re-acquiring on the same instance is reentrant, no cycle
+        with self._lock:
+            self.bump()
+            self.n += 1
+
+
+class NoLocksAtAll:
+    """Single-threaded helper: no locks, no annotation obligations."""
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1

@@ -1,0 +1,85 @@
+"""True-positive fixtures for the lock_discipline analyzer.
+
+`# EXPECT: <rule>` markers pin the (line, rule) pairs the unit tests
+assert.  Parsed, never imported.
+"""
+
+import threading
+
+
+class UnannotatedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0                        # EXPECT: lock-missing-annotation
+
+    def record(self):
+        with self._lock:
+            self.hits += 1
+
+
+class RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: _lock
+
+    def ok(self):
+        with self._lock:
+            self.total += 1
+
+    def racy(self):
+        self.total += 1                      # EXPECT: lock-unguarded-mutation
+
+
+class BogusAnnotation:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.x = 0  # guarded-by: _mutex     # EXPECT: lock-missing-annotation
+
+    def bump(self):
+        with self._lock:
+            self.x += 1
+
+
+class AlphaAB:
+    def __init__(self, beta):
+        self._lock_a = threading.Lock()
+        self.beta: "BetaBA" = beta
+        self.n = 0  # guarded-by: _lock_a
+
+    def forward(self):
+        with self._lock_a:
+            self.n += 1
+            self.beta.poke()
+
+    def poke_a(self):
+        with self._lock_a:
+            self.n += 1
+
+
+class BetaBA:
+    def __init__(self, alpha):
+        self._lock_b = threading.Lock()
+        self.alpha: "AlphaAB" = alpha
+        self.m = 0  # guarded-by: _lock_b
+
+    def poke(self):
+        with self._lock_b:
+            self.m += 1
+
+    def backward(self):
+        with self._lock_b:
+            self.alpha.poke_a()              # EXPECT: lock-order-cycle
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.k = 0  # guarded-by: _lock
+
+    def inner(self):
+        with self._lock:
+            self.k += 1
+
+    def outer(self):
+        with self._lock:
+            self.inner()                     # EXPECT: lock-order-cycle

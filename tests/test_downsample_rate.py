@@ -169,3 +169,42 @@ class TestRate:
     def test_ms_precision(self):
         out = self.run_rate([([0, 500], [0, 5])])
         assert out == [(500, 10.0)]  # 5 units / 0.5s
+
+
+class TestX64Guard:
+    """ops.downsample.require_x64 (tsdblint jax-int64-no-x64-guard
+    satellite): with jax_enable_x64 off, jnp.int64 silently lowers to
+    int32 and ms timestamps past 2^31 truncate — the window planners
+    must refuse instead."""
+
+    def test_planners_refuse_without_x64(self):
+        import jax
+        import pytest
+        from opentsdb_tpu.ops.downsample import (
+            AllWindow, EdgeWindows, FixedWindows)
+        jax.config.update("jax_enable_x64", False)
+        try:
+            with pytest.raises(RuntimeError, match="x64"):
+                FixedWindows.for_range(0, 60_000, 10_000).split()
+            with pytest.raises(RuntimeError, match="x64"):
+                EdgeWindows(edges=(0, 1000, 2000)).split()
+            with pytest.raises(RuntimeError, match="x64"):
+                AllWindow(0, 1000).split()
+        finally:
+            jax.config.update("jax_enable_x64", True)
+
+    def test_planners_work_with_x64(self):
+        from opentsdb_tpu.ops.downsample import FixedWindows
+        spec, wargs = FixedWindows.for_range(0, 60_000, 10_000).split()
+        assert spec.count >= 7
+
+    def test_tsdb_construction_reasserts_x64(self):
+        import jax
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.utils.config import Config
+        jax.config.update("jax_enable_x64", False)
+        try:
+            TSDB(Config())          # default tsd.tpu.precision.x64=true
+            assert jax.config.jax_enable_x64
+        finally:
+            jax.config.update("jax_enable_x64", True)

@@ -204,68 +204,73 @@ class TestFaultInjector:
 
     def test_refuse_and_error_kinds(self):
         inj = FaultInjector()
-        inj.install([{"site": "s", "kind": "refuse"}])
+        inj.install([{"site": "wal.append", "kind": "refuse"}])
         with pytest.raises(ConnectionRefusedError):
-            inj.check("s")
+            inj.check("wal.append")
         inj.clear()
-        inj.install([{"site": "s", "kind": "error", "message": "boom"}])
+        inj.install([{"site": "wal.append", "kind": "error",
+                      "message": "boom"}])
         with pytest.raises(OSError, match="boom"):
-            inj.check("s")
+            inj.check("wal.append")
 
     def test_times_disarms_after_n_fires(self):
         inj = FaultInjector()
-        inj.install([{"site": "s", "kind": "disconnect", "times": 2}])
+        inj.install([{"site": "wal.append", "kind": "disconnect",
+                      "times": 2}])
         for _ in range(2):
             with pytest.raises(ConnectionResetError):
-                inj.check("s")
-        inj.check("s")                                   # disarmed
+                inj.check("wal.append")
+        inj.check("wal.append")                          # disarmed
 
     def test_match_filters_by_context(self):
         inj = FaultInjector()
-        inj.install([{"site": "s", "kind": "refuse",
+        inj.install([{"site": "cluster.peer_fetch", "kind": "refuse",
                       "match": {"peer": "a:1"}}])
-        inj.check("s", peer="b:2")                       # no match
+        inj.check("cluster.peer_fetch", peer="b:2")      # no match
         with pytest.raises(ConnectionRefusedError):
-            inj.check("s", peer="a:1")
+            inj.check("cluster.peer_fetch", peer="a:1")
 
     def test_mangle_garbage_and_disconnect(self):
         inj = FaultInjector()
-        inj.install([{"site": "body", "kind": "garbage", "times": 1},
-                     {"site": "body", "kind": "disconnect", "times": 1}])
-        mangled = inj.mangle("body", b'{"ok": 1}')
+        inj.install([{"site": "cluster.peer_body", "kind": "garbage", "times": 1},
+                     {"site": "cluster.peer_body", "kind": "disconnect",
+                      "times": 1}])
+        mangled = inj.mangle("cluster.peer_body", b'{"ok": 1}')
         with pytest.raises(ValueError):
             json.loads(mangled.decode(errors="replace"))
         with pytest.raises(ConnectionResetError):
-            inj.mangle("body", b'{"ok": 1}')
-        assert inj.mangle("body", b'{"ok": 1}') == b'{"ok": 1}'
+            inj.mangle("cluster.peer_body", b'{"ok": 1}')
+        assert inj.mangle("cluster.peer_body", b'{"ok": 1}') \
+            == b'{"ok": 1}'
 
     def test_install_from_config_inline_and_path(self, tmp_path):
         from opentsdb_tpu.utils.config import Config
         inj = FaultInjector()
         inj.install_from_config(Config({
             "tsd.faults.config":
-                '[{"site": "s", "kind": "refuse"}]'}))
+                '[{"site": "wal.append", "kind": "refuse"}]'}))
         with pytest.raises(ConnectionRefusedError):
-            inj.check("s")
+            inj.check("wal.append")
 
         spec = tmp_path / "faults.json"
-        spec.write_text('[{"site": "t", "kind": "refuse"}]')
+        spec.write_text(
+            '[{"site": "wal.fsync", "kind": "refuse"}]')
         inj2 = FaultInjector()
         inj2.install_from_config(Config({
             "tsd.faults.config": "@%s" % spec}))
         with pytest.raises(ConnectionRefusedError):
-            inj2.check("t")
+            inj2.check("wal.fsync")
 
     def test_unreadable_config_is_ignored(self):
         from opentsdb_tpu.utils.config import Config
         inj = FaultInjector()
         inj.install_from_config(Config({
             "tsd.faults.config": "@/nonexistent/faults.json"}))
-        inj.check("anything")
+        inj.check("wal.append")
         inj2 = FaultInjector()
         inj2.install_from_config(Config({
             "tsd.faults.config": "not json at all"}))
-        inj2.check("anything")
+        inj2.check("wal.append")
 
 
 class TestWalFsyncOptIn:
@@ -307,3 +312,80 @@ class TestWalFsyncOptIn:
             faults.clear()
         # the failure was the journal's, not the store's — next point OK
         t.add_point("w.m", 1_356_998_401, 2, {"h": "a"})
+
+
+class TestFaultSpecValidation:
+    """A typo'd hook/site name used to arm a fault that never fires —
+    the chaos harness then 'passes' while testing nothing.  Specs now
+    validate against faults.KNOWN_SITES at install time."""
+
+    def test_unknown_site_raises(self):
+        inj = FaultInjector()
+        with pytest.raises(faults.FaultSpecError, match="unknown fault site"):
+            inj.install([{"site": "cluster.peer_fetc", "kind": "refuse"}])
+
+    def test_unknown_kind_raises(self):
+        inj = FaultInjector()
+        with pytest.raises(faults.FaultSpecError, match="not valid"):
+            inj.install([{"site": "wal.append", "kind": "refsue"}])
+
+    def test_body_kind_rejected_at_check_site(self):
+        inj = FaultInjector()
+        with pytest.raises(faults.FaultSpecError, match="not valid"):
+            inj.install([{"site": "wal.append", "kind": "garbage"}])
+        # ...but accepted at the body site
+        inj.install([{"site": "cluster.peer_body", "kind": "garbage"}])
+
+    def test_unknown_match_key_raises(self):
+        inj = FaultInjector()
+        with pytest.raises(faults.FaultSpecError, match="never passed"):
+            inj.install([{"site": "cluster.peer_fetch", "kind": "refuse",
+                          "match": {"peen": "x:1"}}])
+
+    def test_bad_times_raises(self):
+        inj = FaultInjector()
+        with pytest.raises(faults.FaultSpecError, match="times"):
+            inj.install([{"site": "wal.append", "kind": "refuse",
+                          "times": 0}])
+
+    def test_config_armed_typo_fails_startup_loudly(self):
+        from opentsdb_tpu.utils.config import Config
+        inj = FaultInjector()
+        with pytest.raises(faults.FaultSpecError):
+            inj.install_from_config(Config({
+                "tsd.faults.config":
+                    '[{"site": "wal.appendd", "kind": "refuse"}]'}))
+
+    def test_valid_spec_still_arms(self):
+        inj = FaultInjector()
+        inj.install([{"site": "cluster.peer_fetch", "kind": "refuse",
+                      "match": {"peer": "a:1"}, "times": 1}])
+        with pytest.raises(ConnectionRefusedError):
+            inj.check("cluster.peer_fetch", peer="a:1")
+
+    def test_failed_config_install_can_be_retried(self, tmp_path):
+        """A spec string that failed to arm must not be remembered as
+        installed — fixing the @path file (or the spec) and
+        constructing again has to arm it."""
+        from opentsdb_tpu.utils.config import Config
+        spec = tmp_path / "faults.json"
+        spec.write_text("not json at all")
+        inj = FaultInjector()
+        cfg = Config({"tsd.faults.config": "@%s" % spec})
+        inj.install_from_config(cfg)            # unreadable: logged, inert
+        inj.check("wal.append")                 # nothing armed
+        spec.write_text('[{"site": "wal.append", "kind": "refuse"}]')
+        inj.install_from_config(cfg)            # same raw string, fixed file
+        with pytest.raises(ConnectionRefusedError):
+            inj.check("wal.append")
+
+    def test_typoed_config_install_can_be_corrected(self):
+        from opentsdb_tpu.utils.config import Config
+        inj = FaultInjector()
+        bad = '[{"site": "wal.appendd", "kind": "refuse"}]'
+        with pytest.raises(faults.FaultSpecError):
+            inj.install_from_config(Config({"tsd.faults.config": bad}))
+        # the failed string is NOT remembered: a second attempt still
+        # validates (and still fails) instead of silently no-opping
+        with pytest.raises(faults.FaultSpecError):
+            inj.install_from_config(Config({"tsd.faults.config": bad}))

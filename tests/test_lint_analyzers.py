@@ -1,0 +1,180 @@
+"""Unit tests for the tsdblint analyzers against the fixture corpus.
+
+Every true-positive fixture line carries an `# EXPECT: <rule>` marker;
+the tests assert the analyzer fires EXACTLY those (line, rule) pairs —
+a fixture violation caught by the wrong rule, a missed line, or an
+extra finding all fail.  True-negative fixtures must come back empty.
+All four analyzers run over every fixture, so each corpus also proves
+the other three stay silent on it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint.core import (  # noqa: E402
+    LintContext, apply_baseline, load_baseline, run_lint, save_baseline)
+
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+# the miniature schema the config fixtures are written against (the
+# tsd.good.* names are fixture-only, not real CONFIG_SCHEMA keys)
+FIXTURE_SCHEMA = {
+    "tsd.good.flag": "bool",    # tsdblint: disable=config-unknown-key
+    "tsd.good.count": "int",    # tsdblint: disable=config-unknown-key
+    "tsd.good.name": "str",     # tsdblint: disable=config-unknown-key
+}
+
+_EXPECT = re.compile(r"#\s*EXPECT:\s*([a-z0-9-]+)")
+
+
+def _expected(path: str) -> set[tuple[int, str]]:
+    out = set()
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = _EXPECT.search(line)
+            if m:
+                out.add((i, m.group(1)))
+    return out
+
+
+def _lint_fixture(name: str) -> list:
+    ctx = LintContext(REPO)
+    ctx.bucket("config")["schema"] = dict(FIXTURE_SCHEMA)
+    ctx.bucket("config")["compat"] = set()
+    path = os.path.join(FIXTURES, name)
+    return run_lint([path], root=REPO, ctx=ctx)
+
+
+TRUE_POSITIVE = ["jax_tp.py", "lock_tp.py", "config_tp.py", "except_tp.py"]
+TRUE_NEGATIVE = ["jax_tn.py", "lock_tn.py", "config_tn.py", "except_tn.py"]
+
+
+@pytest.mark.parametrize("name", TRUE_POSITIVE)
+def test_true_positives_each_caught_by_exactly_the_intended_rule(name):
+    path = os.path.join(FIXTURES, name)
+    expected = _expected(path)
+    assert expected, "fixture %s declares no EXPECT markers" % name
+    got = {(f.line, f.rule) for f in _lint_fixture(name)}
+    missed = expected - got
+    extra = got - expected
+    assert not missed, "rules that failed to fire in %s: %s" % (name, missed)
+    assert not extra, "unexpected findings in %s: %s" % (name, extra)
+
+
+@pytest.mark.parametrize("name", TRUE_NEGATIVE)
+def test_true_negatives_stay_clean(name):
+    findings = _lint_fixture(name)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_suppression_must_sit_on_or_above_the_line(tmp_path):
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "import threading\n"
+        "\n\nclass C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0  # guarded-by: _lock\n"
+        "    def ok(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def racy(self):\n"
+        "        # tsdblint: disable=lock-unguarded-mutation\n"
+        "        self.n += 1\n"
+        "    def still_racy(self):\n"
+        "        self.n += 1\n")
+    findings = run_lint([str(src)], root=str(tmp_path))
+    assert [(f.rule, f.line) for f in findings] == \
+        [("lock-unguarded-mutation", 15)]
+
+
+class TestBaseline:
+    def _findings(self, name="lock_tp.py"):
+        return _lint_fixture(name)
+
+    def test_round_trip_is_byte_stable(self, tmp_path):
+        findings = self._findings()
+        p1 = tmp_path / "b1.json"
+        p2 = tmp_path / "b2.json"
+        save_baseline(findings, str(p1))
+        # re-running the suite and re-saving must reproduce the file
+        # byte-for-byte (stable ordering, no churn)
+        save_baseline(self._findings(), str(p2))
+        assert p1.read_bytes() == p2.read_bytes()
+        loaded = load_baseline(str(p1))
+        assert sum(loaded.values()) == len(findings)
+
+    def test_baseline_absorbs_exactly_its_count(self, tmp_path):
+        findings = self._findings()
+        path = tmp_path / "b.json"
+        save_baseline(findings, str(path))
+        baseline = load_baseline(str(path))
+        # everything grandfathered -> nothing new
+        assert apply_baseline(findings, baseline) == []
+        # a NEW duplicate of a baselined shape still reports
+        doubled = findings + [findings[0]]
+        fresh = apply_baseline(sorted(doubled), baseline)
+        assert len(fresh) == 1
+        assert fresh[0].fingerprint == findings[0].fingerprint
+
+    def test_baseline_is_line_number_free(self, tmp_path):
+        path = tmp_path / "b.json"
+        save_baseline(self._findings(), str(path))
+        payload = json.loads(path.read_text())
+        assert payload["version"] == 1
+        for entry in payload["findings"]:
+            assert set(entry) == {"path", "rule", "message", "count"}
+            assert "line" not in entry
+
+
+def test_checked_in_baseline_round_trips(tmp_path):
+    """The committed baseline must be exactly what save_baseline emits
+    for its own contents (stable ordering, no churn on re-run)."""
+    committed = os.path.join(REPO, "tools", "lint", "baseline.json")
+    baseline = load_baseline(committed)
+    # reconstruct findings from the baseline and re-save
+    from tools.lint.core import Finding
+    findings = []
+    for (path, rule, message), count in baseline.items():
+        findings.extend([Finding(path, 1, rule, message)] * count)
+    out = tmp_path / "roundtrip.json"
+    save_baseline(findings, str(out))
+    with open(committed, "rb") as fh:
+        assert fh.read() == out.read_bytes()
+
+
+def test_dead_key_fires_despite_own_declaration_literal(tmp_path):
+    """A schema key's own declaration literal in utils/config.py must
+    not count as a read — otherwise config-dead-key could never fire."""
+    pkg = tmp_path / "utils"
+    pkg.mkdir()
+    cfg = pkg / "config.py"
+    cfg.write_text(
+        'SCHEMA = {\n'
+        '    "tsd.good.flag": None,\n'
+        '    "tsd.good.count": None,\n'
+        '    "tsd.good.name": None,\n'
+        '}\n')
+    reader = tmp_path / "reader.py"
+    reader.write_text(
+        'def read(config):\n'
+        '    return config.get_bool("tsd.good.flag")\n')
+    ctx = LintContext(str(tmp_path))
+    ctx.bucket("config")["schema"] = dict(FIXTURE_SCHEMA)
+    ctx.bucket("config")["compat"] = {"tsd.good.name"}
+    findings = run_lint([str(cfg), str(reader)], root=str(tmp_path),
+                        ctx=ctx)
+    dead = {f.message.split("'")[1] for f in findings
+            if f.rule == "config-dead-key"}
+    # flag is read, name is compat -> only count is dead
+    assert dead == {"tsd.good.count"}

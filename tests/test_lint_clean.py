@@ -1,0 +1,109 @@
+"""tier-1 gate: tsdblint must be clean over the package.
+
+Runs the full static-analysis suite (tools/lint/) over opentsdb_tpu/
+with the checked-in baseline — any NEW violation of JAX kernel hygiene,
+lock discipline, the config-key schema, or exception discipline fails
+the build.  Also pins the schema side-contracts: every tsd.* key read
+through a Config getter anywhere in the package is declared in
+CONFIG_SCHEMA, and docs/configuration.md is byte-for-byte the generated
+output of that schema.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint.core import (  # noqa: E402
+    apply_baseline, load_baseline, run_lint)
+
+BASELINE = os.path.join(REPO, "tools", "lint", "baseline.json")
+
+
+def _package_findings():
+    return run_lint(["opentsdb_tpu"], root=REPO)
+
+
+def test_lint_suite_is_clean_over_the_package():
+    findings = apply_baseline(_package_findings(), load_baseline(BASELINE))
+    assert findings == [], (
+        "new tsdblint findings (fix them, suppress with a justified "
+        "'# tsdblint: disable=<rule>', or — for genuinely grandfathered "
+        "debt — run tools/lint/run.py --update-baseline):\n"
+        + "\n".join(f.render() for f in findings))
+
+
+def test_every_config_key_read_is_declared_in_schema():
+    """Acceptance pin: every tsd.* key read in opentsdb_tpu/ (and in
+    tools/ and tests/, which configure real TSDBs) names a declared
+    CONFIG_SCHEMA key.  Reuses the config analyzer itself — one
+    implementation of 'what counts as a config read' — and filters to
+    its unknown-key rule (tests/tools are otherwise outside the lint
+    gate's scope).  lint_fixtures are deliberate violations and stay
+    excluded."""
+    import glob
+    paths = ["opentsdb_tpu", "tools"] + sorted(
+        glob.glob(os.path.join(REPO, "tests", "*.py")))
+    findings = run_lint(paths, root=REPO)
+    unknown = [f.render() for f in findings
+               if f.rule == "config-unknown-key"]
+    assert unknown == [], (
+        "config keys read but not declared in CONFIG_SCHEMA:\n"
+        + "\n".join(unknown))
+
+
+def test_config_doc_is_generated_and_in_sync():
+    from opentsdb_tpu.utils.config import generate_config_doc
+    doc = os.path.join(REPO, "docs", "configuration.md")
+    assert os.path.exists(doc), \
+        "docs/configuration.md missing — python tools/lint/run.py --update-doc"
+    with open(doc, encoding="utf-8") as fh:
+        committed = fh.read()
+    assert committed == generate_config_doc(), (
+        "docs/configuration.md is stale — regenerate with "
+        "python tools/lint/run.py --update-doc")
+
+
+def test_schema_defaults_parse_as_their_declared_type():
+    from opentsdb_tpu.utils.config import CONFIG_SCHEMA
+    bad = []
+    for key, entry in CONFIG_SCHEMA.items():
+        if entry.type not in ("str", "dir", "int", "float", "bool"):
+            bad.append("%s: unknown type %r" % (key, entry.type))
+            continue
+        if not entry.default:
+            continue        # empty = unset is legal for every type
+        try:
+            if entry.type == "int":
+                int(entry.default)
+            elif entry.type == "float":
+                float(entry.default)
+            elif entry.type == "bool":
+                assert entry.default.lower() in (
+                    "true", "false", "1", "0", "yes", "no")
+        except (ValueError, AssertionError):
+            bad.append("%s: default %r does not parse as %s"
+                       % (key, entry.default, entry.type))
+    assert bad == [], bad
+
+
+def test_defaults_are_derived_from_schema():
+    from opentsdb_tpu.utils.config import CONFIG_SCHEMA, DEFAULTS
+    assert DEFAULTS == {k: e.default for k, e in CONFIG_SCHEMA.items()}
+
+
+def test_cli_exits_zero_on_clean_tree(tmp_path):
+    """The run.py entry point the CI docs point at: exit 0 with the
+    committed baseline, and --json stays parseable."""
+    import json
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint", "run.py"),
+         "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []

@@ -402,3 +402,36 @@ class TestModes:
         m = RpcManager(t)
         assert "api/query" not in m.http_commands
         assert "version" in m.http_commands  # UI still on
+
+
+class TestErrorEnvelopeAccounting:
+    """tsdblint exception-discipline satellite: the uniform error
+    envelope now counts 4xx/5xx responses and surfaces them at
+    /api/stats (http.errors family=4xx/5xx)."""
+
+    def test_4xx_counts_client_errors(self, manager):
+        r = http(manager, "GET", "/api/nosuchroute")
+        assert r.status == 404
+        assert manager.client_errors == 1
+        assert manager.server_errors == 0
+
+    def test_5xx_counts_server_errors(self, manager, tsdb):
+        class Boom:
+            def execute_http(self, tsdb, query):
+                raise RuntimeError("internal boom")
+
+        manager.http_commands["api/boom"] = Boom()
+        r = http(manager, "GET", "/api/boom")
+        assert r.status == 500
+        assert manager.client_errors == 0
+        assert manager.server_errors == 1
+
+    def test_stats_surface_http_errors(self, manager):
+        http(manager, "GET", "/api/nosuchroute")
+        r = http(manager, "GET", "/api/stats?json")
+        records = jbody(r)
+        families = {(rec["tags"].get("family"), rec["value"])
+                    for rec in records
+                    if rec["metric"] == "tsd.http.errors"}
+        assert ("4xx", 1) in families
+        assert ("5xx", 0) in families

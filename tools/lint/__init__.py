@@ -1,0 +1,18 @@
+"""tsdblint: repo-native static analysis for the TPU-TSDB codebase.
+
+Four AST-based analyzers enforce the invariants mechanical review keeps
+missing (see tools/lint/README.md for the rule catalog):
+
+  jax_hygiene            host-sync / retrace hazards in jit-reachable ops/
+  lock_discipline        guarded-by annotations, unguarded mutations,
+                         lock-order cycles
+  config_schema          tsd.* keys vs utils/config.py CONFIG_SCHEMA
+  exception_discipline   broad excepts that swallow without log/count
+
+The suite is wired into tier-1 via tests/test_lint_clean.py; the CLI is
+tools/lint/run.py.
+"""
+
+from tools.lint.core import (  # noqa: F401
+    Finding, Analyzer, LintContext, run_lint, load_baseline, save_baseline,
+    apply_baseline, ALL_ANALYZERS)

@@ -1,0 +1,184 @@
+"""Config-key schema checks: every tsd.* read must be a declared key.
+
+`opentsdb_tpu/utils/config.py` declares `CONFIG_SCHEMA` (key -> type,
+default, doc).  This analyzer holds the codebase to it:
+
+  config-unknown-key     a `tsd.*` literal passed to a Config getter
+                         (get_string / get_int / get_float / get_bool /
+                         get_directory_name / has_property /
+                         override_config), or a module-level `tsd.*`
+                         string constant (the CONFIG_KEY / key-table
+                         idiom), names no declared key — a typo'd key
+                         reads the default forever and misconfigures
+                         silently.
+  config-type-mismatch   the getter's type disagrees with the schema
+                         (`get_bool` on an int key answers False for
+                         every nonzero value...).  `get_string` is the
+                         raw accessor and is allowed on any key.
+  config-dead-key        a schema entry (not marked compat) that no
+                         scanned code reads — stale registry entries
+                         hide real keys.  Whole-program pass; only runs
+                         when the scan includes utils/config.py.
+
+Module-level constants count as *reads* for the dead-key pass (the
+whitelist/_KEYS idiom reads them through a variable), and they are only
+checked in modules matching the key-constant idiom — string constants
+at module scope whose value starts with "tsd.".
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_UNKNOWN = "config-unknown-key"
+RULE_TYPE = "config-type-mismatch"
+RULE_DEAD = "config-dead-key"
+
+# getter -> type it imposes (None = type-neutral)
+GETTERS: dict[str, str | None] = {
+    "get_string": None,
+    "get_int": "int",
+    "get_float": "float",
+    "get_bool": "bool",
+    "get_directory_name": "dir",
+    "has_property": None,
+    "override_config": None,
+}
+
+# schema type -> typed getters allowed (get_string always allowed)
+_ALLOWED = {
+    "str": {"get_directory_name"},
+    "dir": {"get_directory_name"},
+    "int": {"get_int", "get_float"},
+    "float": {"get_float"},
+    "bool": {"get_bool"},
+}
+
+
+def _load_schema(ctx: LintContext) -> tuple[dict[str, str], set[str]]:
+    """(key -> type, compat keys).  Tests inject via
+    ctx.bucket("config")["schema"] / ["compat"]."""
+    bucket = ctx.bucket("config")
+    if "schema" not in bucket:
+        from opentsdb_tpu.utils.config import CONFIG_SCHEMA
+        bucket["schema"] = {k: e.type for k, e in CONFIG_SCHEMA.items()}
+        bucket["compat"] = {k for k, e in CONFIG_SCHEMA.items() if e.compat}
+    return bucket["schema"], bucket.get("compat", set())
+
+
+def _is_key(value) -> bool:
+    return isinstance(value, str) and value.startswith("tsd.") \
+        and len(value) > 4
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    schema, _compat = _load_schema(ctx)
+    bucket = ctx.bucket("config")
+    read = bucket.setdefault("read_keys", set())
+    out: list[Finding] = []
+
+    # declaration-site lines for the dead-key pass
+    if src.path.endswith("utils/config.py"):
+        bucket["config_py"] = src
+
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        getter = node.func.attr
+        if getter not in GETTERS or not node.args:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and _is_key(arg.value)):
+            continue
+        key = arg.value
+        read.add(key)
+        if key not in schema:
+            out.append(Finding(
+                src.path, node.lineno, RULE_UNKNOWN,
+                "config key '%s' (via %s) is not declared in "
+                "CONFIG_SCHEMA" % (key, getter)))
+            continue
+        imposed = GETTERS[getter]
+        if imposed is not None and \
+                getter not in _ALLOWED.get(schema[key], set()) and \
+                imposed != schema[key]:
+            out.append(Finding(
+                src.path, node.lineno, RULE_TYPE,
+                "%s() on config key '%s' which is declared '%s' in "
+                "CONFIG_SCHEMA" % (getter, key, schema[key])))
+
+    # module-level tsd.* string constants (CONFIG_KEY / key-table idiom):
+    # bare literals and literals inside dict/tuple/list displays.  Call
+    # arguments are excluded — logging.getLogger("tsd.rpc") names a
+    # logger, not a key.
+    if not src.path.endswith("utils/config.py"):
+        for stmt in src.tree.body:
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            for node in _non_call_constants(stmt.value):
+                if _is_key(node.value):
+                    read.add(node.value)
+                    if node.value not in schema:
+                        out.append(Finding(
+                            src.path, node.lineno, RULE_UNKNOWN,
+                            "module-level config key constant '%s' is "
+                            "not declared in CONFIG_SCHEMA" % node.value))
+
+    # every other tsd.* literal (stats metric names, keys passed through
+    # variables into getters, doc strings) counts as a *read* for the
+    # dead-key pass — a key mentioned anywhere is not dead — without
+    # being checked for membership (metric names are not config keys).
+    # utils/config.py is excluded: a schema entry's own declaration
+    # literal must not count as a read, or dead keys could never exist.
+    if not src.path.endswith("utils/config.py"):
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) and _is_key(node.value):
+                read.add(node.value)
+    return out
+
+
+def _non_call_constants(root: ast.expr | None):
+    """String constants reachable without entering a Call subtree."""
+    if root is None:
+        return
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            continue
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def finish(ctx: LintContext) -> list[Finding]:
+    bucket = ctx.bucket("config")
+    config_src = bucket.get("config_py")
+    if config_src is None:
+        return []        # partial scan (fixtures): no dead-key verdicts
+    schema, compat = _load_schema(ctx)
+    read = bucket.get("read_keys", set())
+    out: list[Finding] = []
+    for key in sorted(schema):
+        if key in read or key in compat:
+            continue
+        line = 0
+        needle = '"%s"' % key
+        for i, text in enumerate(config_src.lines, start=1):
+            if needle in text:
+                line = i
+                break
+        out.append(Finding(
+            config_src.path, line, RULE_DEAD,
+            "config key '%s' is declared in CONFIG_SCHEMA but never read "
+            "by any scanned code (mark compat=True if it is accepted for "
+            "reference-config compatibility)" % key))
+    return out
+
+
+ANALYZER = Analyzer(
+    "config_schema", (RULE_UNKNOWN, RULE_TYPE, RULE_DEAD), check, finish)

@@ -1,0 +1,228 @@
+"""tsdblint framework: findings, suppressions, baseline, runner.
+
+Design choices, in the order they bit previous linters:
+
+  * Baseline entries are keyed by (path, rule, message) — NOT line
+    numbers — so unrelated edits above a grandfathered finding don't
+    churn the baseline file.  Messages therefore never embed line
+    numbers; duplicates within a file carry a count.
+  * Suppressions are source comments (`# tsdblint: disable=rule[,rule]`)
+    on the flagged line or the line directly above it, plus a file-level
+    form (`# tsdblint: disable-file=rule`) honored anywhere in the first
+    20 lines.  Suppressing should be a visible, reviewable act.
+  * Analyzers are two-phase: `check(file)` per parsed file, `finish()`
+    once after the walk for whole-program rules (lock-order cycles,
+    dead config keys).  Both phases emit Finding objects.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Callable, Iterable
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SUPPRESS_MARK = "tsdblint: disable="
+SUPPRESS_FILE_MARK = "tsdblint: disable-file="
+FILE_MARK_SCAN_LINES = 20
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation.  `message` must be line-number-free (it is
+    the baseline identity together with path and rule)."""
+    path: str       # repo-relative, posix separators
+    line: int       # 1-based; 0 for whole-file findings
+    rule: str
+    message: str
+
+    @property
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.message)
+
+    def render(self) -> str:
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.rule,
+                                   self.message)
+
+
+class SourceFile:
+    """A parsed source file handed to each analyzer."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        with open(abspath, "r", encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=relpath)
+        self._suppressed = self._parse_suppressions()
+        self._file_suppressed = self._parse_file_suppressions()
+
+    # -- suppressions --
+
+    def _parse_suppressions(self) -> dict[int, set[str]]:
+        out: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            pos = line.find(SUPPRESS_MARK)
+            if pos < 0:
+                continue
+            rules = line[pos + len(SUPPRESS_MARK):].split("#")[0]
+            names = {r.strip() for r in rules.split(",") if r.strip()}
+            out.setdefault(i, set()).update(names)
+        return out
+
+    def _parse_file_suppressions(self) -> set[str]:
+        out: set[str] = set()
+        for line in self.lines[:FILE_MARK_SCAN_LINES]:
+            pos = line.find(SUPPRESS_FILE_MARK)
+            if pos < 0:
+                continue
+            rules = line[pos + len(SUPPRESS_FILE_MARK):].split("#")[0]
+            out.update(r.strip() for r in rules.split(",") if r.strip())
+        return out
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if rule in self._file_suppressed:
+            return True
+        for at in (line, line - 1):
+            if rule in self._suppressed.get(at, set()):
+                return True
+        return False
+
+
+class LintContext:
+    """Shared state across files and analyzers (whole-program passes)."""
+
+    def __init__(self, root: str = REPO_ROOT):
+        self.root = root
+        self.data: dict = {}       # analyzer-namespaced scratch space
+        self.files: list[SourceFile] = []
+
+    def bucket(self, name: str) -> dict:
+        return self.data.setdefault(name, {})
+
+
+class Analyzer:
+    """One named analyzer: per-file check + optional whole-program finish."""
+
+    def __init__(self, name: str, rules: tuple[str, ...],
+                 check: Callable[[SourceFile, LintContext], list[Finding]],
+                 finish: Callable[[LintContext], list[Finding]] | None = None):
+        self.name = name
+        self.rules = rules
+        self.check = check
+        self.finish = finish
+
+
+def _iter_py_files(paths: Iterable[str], root: str) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        abspath = path if os.path.isabs(path) else os.path.join(root, path)
+        if os.path.isfile(abspath):
+            out.append(abspath)
+            continue
+        for dirpath, dirnames, filenames in os.walk(abspath):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def get_analyzers() -> list[Analyzer]:
+    """All four analyzers (imported lazily so `core` has no circulars)."""
+    from tools.lint import (config_schema, exception_discipline,
+                            jax_hygiene, lock_discipline)
+    return [jax_hygiene.ANALYZER, lock_discipline.ANALYZER,
+            config_schema.ANALYZER, exception_discipline.ANALYZER]
+
+
+ALL_ANALYZERS = get_analyzers
+
+
+def run_lint(paths: Iterable[str], root: str = REPO_ROOT,
+             analyzers: list[Analyzer] | None = None,
+             ctx: LintContext | None = None) -> list[Finding]:
+    """Run analyzers over `paths`; returns suppression-filtered findings
+    in (path, line, rule) order.  Syntax errors surface as `parse-error`
+    findings rather than crashing the run."""
+    if analyzers is None:
+        analyzers = get_analyzers()
+    if ctx is None:
+        ctx = LintContext(root)
+    findings: list[Finding] = []
+    for abspath in _iter_py_files(paths, root):
+        rel = os.path.relpath(abspath, root)
+        try:
+            src = SourceFile(abspath, rel)
+        except SyntaxError as e:
+            findings.append(Finding(rel.replace(os.sep, "/"),
+                                    e.lineno or 0, "parse-error", str(e)))
+            continue
+        ctx.files.append(src)
+        for analyzer in analyzers:
+            for f in analyzer.check(src, ctx):
+                if not src.suppressed(f.line, f.rule):
+                    findings.append(f)
+    by_path = {src.path: src for src in ctx.files}
+    for analyzer in analyzers:
+        if analyzer.finish is None:
+            continue
+        for f in analyzer.finish(ctx):
+            src = by_path.get(f.path)
+            if src is not None and src.suppressed(f.line, f.rule):
+                continue
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule,
+                                           f.message))
+
+
+# --------------------------------------------------------------------- #
+# Baseline                                                              #
+# --------------------------------------------------------------------- #
+
+BASELINE_VERSION = 1
+
+
+def save_baseline(findings: list[Finding], path: str) -> None:
+    """Line-number-free, sorted, deduplicated-with-counts — re-running
+    over an unchanged tree must reproduce the file byte-for-byte."""
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    entries = [{"path": p, "rule": r, "message": m, "count": c}
+               for (p, r, m), c in sorted(counts.items())]
+    payload = {"version": BASELINE_VERSION, "findings": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> dict[tuple[str, str, str], int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    return {(e["path"], e["rule"], e["message"]): int(e.get("count", 1))
+            for e in payload.get("findings", [])}
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[tuple[str, str, str], int]
+                   ) -> list[Finding]:
+    """Subtract grandfathered findings.  Each baseline entry absorbs up
+    to `count` identical findings; the excess (a NEW violation of an old
+    shape) still reports."""
+    budget = dict(baseline)
+    fresh: list[Finding] = []
+    for f in findings:
+        left = budget.get(f.fingerprint, 0)
+        if left > 0:
+            budget[f.fingerprint] = left - 1
+        else:
+            fresh.append(f)
+    return fresh

@@ -1,0 +1,117 @@
+"""Exception discipline: broad excepts must log or count before swallowing.
+
+A bare `except:` or `except Exception:` in a serving path that silently
+swallows turns every novel failure into a ghost — the request "works",
+the operator sees nothing, and the bug report arrives weeks later with
+no trace.  One rule:
+
+  except-swallow   a bare/broad except handler whose body neither
+                   re-raises, returns/propagates an error object, logs
+                   (a call through a logger-shaped name: LOG.exception,
+                   logger.warning, ...), nor counts (a call to a
+                   *count*/*record* method, or an in-place counter
+                   increment).
+
+Handlers that legitimately must stay silent (best-effort cleanup on an
+already-failed path) carry a `# tsdblint: disable=except-swallow`
+suppression with the justification in the comment — silence should be
+visible in review.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_SWALLOW = "except-swallow"
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGERISH = ("log", "logger")
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_COUNTERISH = ("count", "record", "increment", "incr")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names: list[str] = []
+    for node in ([t] if not isinstance(t, ast.Tuple) else t.elts):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in _BROAD for n in names)
+
+
+def _logger_call(node: ast.Call) -> bool:
+    f = node.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _LOG_METHODS:
+        return False
+    base = f.value
+    name = None
+    if isinstance(base, ast.Name):
+        name = base.id
+    elif isinstance(base, ast.Attribute):
+        name = base.attr
+    return name is not None and any(m in name.lower() for m in _LOGGERISH)
+
+
+def _counter_call(node: ast.Call) -> bool:
+    f = node.func
+    attr = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return attr is not None and any(m in attr.lower() for m in _COUNTERISH)
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True when the handler visibly deals with the failure."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.AugAssign):
+            return True          # counter increment
+        if isinstance(node, ast.Call) and (
+                _logger_call(node) or _counter_call(node)):
+            return True
+        # handing the exception object onward (send_error(e),
+        # errors.append((i, e)), return "...%s" % e) is handling too
+        if isinstance(node, ast.Name) and handler.name is not None \
+                and node.id == handler.name:
+            return True
+    return False
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node):
+            continue
+        if _handles(node):
+            continue
+        # a suppression anywhere in the handler body counts — the
+        # natural place for it is on the `pass`, not the `except` line
+        end = max((getattr(s, "end_lineno", s.lineno)
+                   for s in node.body), default=node.lineno)
+        if any(src.suppressed(ln, RULE_SWALLOW)
+               for ln in range(node.lineno, end + 1)):
+            continue
+        fn = "?"
+        # enclosing function name for a line-free message
+        for parent in ast.walk(src.tree):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(n is node for n in ast.walk(parent)):
+                fn = parent.name
+        out.append(Finding(
+            src.path, node.lineno, RULE_SWALLOW,
+            "broad except in '%s' swallows without logging or counting "
+            "— log, count, re-raise, or suppress with a justification"
+            % fn))
+    return out
+
+
+ANALYZER = Analyzer("exception_discipline", (RULE_SWALLOW,), check)

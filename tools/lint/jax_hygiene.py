@@ -1,0 +1,403 @@
+"""JAX kernel hygiene: host-sync and retrace hazards in jit-reachable code.
+
+The 489.5M pts/s headline lives or dies on the `ops/` kernels staying
+free of accidental device->host synchronization and per-call retracing.
+Four rules:
+
+  jax-host-sync          `.item()` / `.tolist()` / `float()` / `int()` /
+                         `bool()` / `np.asarray()` applied to a traced
+                         value inside a jit-reachable function — each one
+                         blocks on the device and kills dispatch overlap.
+  jax-tracer-branch      Python `if`/`while` on a traced value — a
+                         ConcretizationError at best, a silent retrace
+                         per distinct value at worst.
+  jax-jit-per-call       `jax.jit(...)` constructed inside a function
+                         body: a fresh jit wrapper per call path retraces
+                         every time (module-scope construction, like
+                         ops/pipeline.py's `_jitted`, compiles once).
+                         Builders that memoize the wrapper in a cache
+                         keyed by static shape are legitimate — suppress
+                         with a comment explaining the cache.
+  jax-int64-no-x64-guard `jnp.int64` in a module with no x64 guard in
+                         sight (own `jax_enable_x64` update, an x64 guard
+                         helper, or a package __init__ that enables x64):
+                         with x64 disabled jnp.int64 silently becomes
+                         int32 and ms timestamps truncate.
+
+Traced-value analysis is intraprocedural with same-module call-graph
+propagation: parameters of functions bound by module-scope `jax.jit`
+(minus static_argnums/static_argnames) seed the traced set; a call from
+a traced function propagates traced-rooted arguments into the callee's
+parameters to fixpoint.  Expressions reached only through `.shape` /
+`.dtype` / `.ndim` / `len()` / `isinstance()` are static at trace time
+and never count as traced-rooted.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_HOST_SYNC = "jax-host-sync"
+RULE_TRACER_BRANCH = "jax-tracer-branch"
+RULE_JIT_PER_CALL = "jax-jit-per-call"
+RULE_INT64_GUARD = "jax-int64-no-x64-guard"
+
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr"}
+_SYNC_METHODS = {"item", "tolist"}
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_NP_SYNC_FUNCS = {"asarray", "array", "frombuffer", "copy"}
+
+
+def _imports_jax(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax" or a.name.startswith("jax.")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "jax"
+                                or node.module.startswith("jax.")):
+                return True
+    return False
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    """`jax.jit` as an expression (also bare `jit` imported from jax)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_target(call: ast.Call):
+    """(func_expr, static_positions, static_names) for a jax.jit(...) or
+    partial(jax.jit, ...) call; None when `call` is neither."""
+    if _is_jax_jit(call.func):
+        target = call.args[0] if call.args else None
+    elif (isinstance(call.func, (ast.Name, ast.Attribute))
+          and (getattr(call.func, "id", None) == "partial"
+               or getattr(call.func, "attr", None) == "partial")
+          and call.args and _is_jax_jit(call.args[0])):
+        target = call.args[1] if len(call.args) > 1 else None
+    else:
+        return None
+    positions: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            positions.update(_int_tuple(kw.value))
+        elif kw.arg == "static_argnames":
+            names.update(_str_tuple(kw.value))
+    return target, positions, names
+
+
+def _int_tuple(node: ast.expr) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _str_tuple(node: ast.expr) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+class _TracedRooted(ast.NodeVisitor):
+    """Does an expression reach a traced name other than through a
+    static (.shape/.dtype/len/...) window?"""
+
+    def __init__(self, traced: set[str]):
+        self.traced = traced
+        self.hit = False
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.traced:
+            self.hit = True
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in _STATIC_ATTRS:
+            return
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) and node.func.id in _STATIC_CALLS:
+            return
+        if isinstance(node.func, ast.Attribute):
+            self.visit(node.func.value)
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # `"key" in wargs` — dict membership on a traced-values dict is
+        # resolved at trace time; a constant left operand marks it.
+        if (len(node.ops) == 1 and isinstance(node.ops[0],
+                                              (ast.In, ast.NotIn))
+                and isinstance(node.left, ast.Constant)):
+            return
+        self.generic_visit(node)
+
+
+def _rooted(expr: ast.expr, traced: set[str]) -> bool:
+    if not traced:
+        return False
+    v = _TracedRooted(traced)
+    v.visit(expr)
+    return v.hit
+
+
+def _module_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)}
+
+
+def _seed_traced(tree: ast.Module, funcs: dict[str, ast.FunctionDef]
+                 ) -> dict[str, set[str]]:
+    """Traced params of functions jit-bound at module scope."""
+    traced: dict[str, set[str]] = {}
+
+    def bind(target: ast.expr, positions: set[int], names: set[str]) -> None:
+        if not isinstance(target, ast.Name) or target.id not in funcs:
+            return
+        fn = funcs[target.id]
+        params = _param_names(fn)
+        static = {params[i] for i in positions if i < len(params)} | names
+        traced.setdefault(fn.name, set()).update(
+            p for p in params if p not in static)
+
+    for node in tree.body:
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call):
+                hit = _jit_call_target(call)
+                if hit and hit[0] is not None:
+                    bind(*hit)
+    for name, fn in funcs.items():
+        for dec in fn.decorator_list:
+            if _is_jax_jit(dec):
+                traced.setdefault(name, set()).update(_param_names(fn))
+            elif isinstance(dec, ast.Call):
+                hit = _jit_call_target(dec)
+                if hit is not None:
+                    _, positions, names2 = hit
+                    params = _param_names(fn)
+                    static = {params[i] for i in positions
+                              if i < len(params)} | names2
+                    traced.setdefault(name, set()).update(
+                        p for p in params if p not in static)
+    return traced
+
+
+def _propagate(funcs: dict[str, ast.FunctionDef],
+               traced: dict[str, set[str]]) -> None:
+    """Same-module fixpoint: traced-rooted call args taint callee params."""
+    changed = True
+    while changed:
+        changed = False
+        for name, tset in list(traced.items()):
+            fn = funcs.get(name)
+            if fn is None or not tset:
+                continue
+            for call in ast.walk(fn):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and call.func.id in funcs):
+                    continue
+                callee = funcs[call.func.id]
+                params = _param_names(callee)
+                tgt = traced.setdefault(callee.name, set())
+                for i, arg in enumerate(call.args):
+                    if i < len(params) and params[i] not in tgt \
+                            and _rooted(arg, tset):
+                        tgt.add(params[i])
+                        changed = True
+                for kw in call.keywords:
+                    if kw.arg in params and kw.arg not in tgt \
+                            and _rooted(kw.value, tset):
+                        tgt.add(kw.arg)
+                        changed = True
+
+
+def _uses_jnp_int64(tree: ast.Module) -> int:
+    """First line using jnp.int64 / jax.numpy.int64, or 0."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "int64":
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("jnp",):
+                return node.lineno
+            if isinstance(base, ast.Attribute) and base.attr == "numpy" \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "jax":
+                return node.lineno
+    return 0
+
+
+def _has_x64_guard(src: SourceFile) -> bool:
+    """The module itself, a package __init__ above it, or an import of
+    the ops package (whose __init__ pins x64 process-wide) guards x64."""
+    if "jax_enable_x64" in src.text or "x64" in _identifiers(src.tree):
+        return True
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.startswith("opentsdb_tpu.ops"):
+            return True
+        if isinstance(node, ast.Import) and any(
+                a.name.startswith("opentsdb_tpu.ops") for a in node.names):
+            return True
+    d = os.path.dirname(src.abspath)
+    for _ in range(6):
+        init = os.path.join(d, "__init__.py")
+        if os.path.isfile(init):
+            try:
+                with open(init, "r", encoding="utf-8") as fh:
+                    if "jax_enable_x64" in fh.read():
+                        return True
+            except OSError:
+                pass
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return False
+
+
+def _identifiers(tree: ast.Module) -> str:
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+        elif isinstance(node, ast.alias):
+            names.append(node.asname or node.name)
+        elif isinstance(node, ast.FunctionDef):
+            names.append(node.name)
+    return " ".join(names)
+
+
+def _is_memoizer(dec: ast.expr) -> bool:
+    """@lru_cache / @cache / @functools.lru_cache(...) decorators."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    name = dec.attr if isinstance(dec, ast.Attribute) else \
+        dec.id if isinstance(dec, ast.Name) else ""
+    return name in ("lru_cache", "cache")
+
+
+def _jit_per_call(src: SourceFile) -> list[Finding]:
+    out: list[Finding] = []
+
+    def visit(node: ast.AST, stack: list):
+        for child in ast.iter_child_nodes(node):
+            frame = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # the function's own decorators run at its DEFINITION
+                # scope, not inside it
+                for dec in child.decorator_list:
+                    visit(dec, stack)
+                frame = stack + [child]
+                for part in child.body:
+                    visit(part, frame)
+                continue
+            if isinstance(child, ast.Call) and stack \
+                    and _jit_call_target(child) is not None:
+                memoized = any(
+                    any(_is_memoizer(d) for d in fn.decorator_list)
+                    for fn in stack)
+                if not memoized:
+                    out.append(Finding(
+                        src.path, child.lineno, RULE_JIT_PER_CALL,
+                        "jax.jit constructed inside '%s': per-call jit "
+                        "wrappers retrace every invocation — hoist to "
+                        "module scope, or memoize (@lru_cache, or a dict "
+                        "cache + suppression comment)" % stack[-1].name))
+            visit(child, frame)
+
+    visit(src.tree, [])
+    return out
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    if not _imports_jax(src.tree):
+        return []
+    out: list[Finding] = []
+    funcs = _module_functions(src.tree)
+    traced = _seed_traced(src.tree, funcs)
+    _propagate(funcs, traced)
+
+    int64_line = _uses_jnp_int64(src.tree)
+    if int64_line and not _has_x64_guard(src):
+        out.append(Finding(
+            src.path, int64_line, RULE_INT64_GUARD,
+            "jnp.int64 used without an x64 guard: with jax_enable_x64 off "
+            "this is silently int32 and ms timestamps truncate — enable "
+            "x64 in the package __init__ or add an explicit guard"))
+
+    # jit construction inside any function body (module scope is the
+    # cheap, compile-once place for it).  Memoized builders — functions
+    # under @lru_cache/@cache — construct once per static key and are
+    # exempt; hand-rolled dict caches suppress with a comment.
+    out.extend(_jit_per_call(src))
+
+    for name, tset in traced.items():
+        fn = funcs.get(name)
+        if fn is None or not tset:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)) \
+                    and _rooted(node.test, tset):
+                out.append(Finding(
+                    src.path, node.lineno, RULE_TRACER_BRANCH,
+                    "Python branch on a traced value in jit-reachable "
+                    "'%s': use jnp.where / lax.cond instead" % name))
+            elif isinstance(node, ast.IfExp) and _rooted(node.test, tset):
+                out.append(Finding(
+                    src.path, node.lineno, RULE_TRACER_BRANCH,
+                    "conditional expression on a traced value in "
+                    "jit-reachable '%s': use jnp.where / lax.cond "
+                    "instead" % name))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in _SYNC_METHODS \
+                        and _rooted(f.value, tset):
+                    out.append(Finding(
+                        src.path, node.lineno, RULE_HOST_SYNC,
+                        ".%s() on a traced value in jit-reachable '%s' "
+                        "forces a device sync" % (f.attr, name)))
+                elif isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS \
+                        and node.args and _rooted(node.args[0], tset):
+                    out.append(Finding(
+                        src.path, node.lineno, RULE_HOST_SYNC,
+                        "%s() on a traced value in jit-reachable '%s' "
+                        "forces a device sync" % (f.id, name)))
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr in _NP_SYNC_FUNCS \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in ("np", "numpy") \
+                        and node.args and _rooted(node.args[0], tset):
+                    out.append(Finding(
+                        src.path, node.lineno, RULE_HOST_SYNC,
+                        "np.%s() on a traced value in jit-reachable '%s' "
+                        "pulls the array to the host" % (f.attr, name)))
+    return out
+
+
+ANALYZER = Analyzer(
+    "jax_hygiene",
+    (RULE_HOST_SYNC, RULE_TRACER_BRANCH, RULE_JIT_PER_CALL,
+     RULE_INT64_GUARD),
+    check)

@@ -1,0 +1,334 @@
+"""Lock discipline: guarded-by annotations, unguarded mutations, cycles.
+
+The threaded TSD server grew stateful fault-tolerance (per-peer
+breakers, WAL, drain-on-shutdown); this analyzer makes the locking
+contract explicit and machine-checked.  Three rules:
+
+  lock-missing-annotation  a class attribute is mutated inside a
+                           `with self.<lock>` block somewhere, so it is
+                           shared state — its declaration must carry a
+                           `# guarded-by: <lock>` annotation (inline, or
+                           a standalone comment covering the contiguous
+                           assignment block below it).  Also fired when
+                           an annotation names a lock the class doesn't
+                           hold.
+  lock-unguarded-mutation  a guarded-by-annotated attribute is mutated
+                           without the named lock held.  `__init__` and
+                           methods named `*_locked` (the caller-holds-
+                           the-lock convention) are exempt.
+  lock-order-cycle         the graph "while holding (Class, lockA), a
+                           call is made that acquires (Class', lockB)"
+                           contains a cycle — including the length-1
+                           cycle of re-acquiring a non-reentrant Lock on
+                           the same instance (self-deadlock).
+
+Mutations tracked: assignment / augmented assignment / deletion of
+`self.<attr>`, and subscript stores into `self.<attr>[...]`.  In-place
+method mutation (`self.x.append(...)`) is out of scope — annotate and
+guard the attribute anyway; the write-through rules still catch
+rebinding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_MISSING = "lock-missing-annotation"
+RULE_UNGUARDED = "lock-unguarded-mutation"
+RULE_CYCLE = "lock-order-cycle"
+
+_GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+def _lock_ctor_kind(node: ast.expr) -> str | None:
+    """'Lock' / 'RLock' when `node` is threading.Lock()/RLock() (or a
+    bare Lock()/RLock() import)."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS:
+        name = f.attr
+    elif isinstance(f, ast.Name) and f.id in _LOCK_CTORS:
+        name = f.id
+    return name
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _mutation_targets(stmt: ast.stmt) -> list[str]:
+    """self-attributes this statement mutates."""
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    out: list[str] = []
+    for t in targets:
+        if isinstance(t, ast.Tuple):
+            out.extend(a for e in t.elts
+                       if (a := _self_attr(e)) is not None)
+            continue
+        attr = _self_attr(t)
+        if attr is not None:
+            out.append(attr)
+            continue
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr is not None:
+                out.append(attr)
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, name: str, path: str, lineno: int):
+        self.name = name
+        self.path = path
+        self.lineno = lineno
+        self.locks: dict[str, str] = {}          # lock attr -> Lock|RLock
+        self.annotations: dict[str, tuple[str, int]] = {}  # attr -> (lock, ln)
+        self.init_lines: dict[str, int] = {}     # attr -> first decl line
+        # (attr, method, line, frozenset(held locks))
+        self.mutations: list[tuple[str, str, int, frozenset]] = []
+        # method -> set of lock attrs it acquires (with self.X)
+        self.acquires: dict[str, set[str]] = {}
+        # (held lock, call node, method) for the cycle graph
+        self.calls_under_lock: list[tuple[str, ast.Call, str]] = []
+        self.attr_types: dict[str, str] = {}     # self.attr -> ClassName
+
+
+def _annotation_for_line(src: SourceFile, lineno: int) -> str | None:
+    """Inline `# guarded-by:` on `lineno`, or a comment above covering a
+    contiguous block of PLAIN declarations.  A declaration carrying its
+    own trailing comment ends the block — so a standalone guarded-by
+    comment only reaches declarations that visibly opted in by staying
+    bare, never silently past an annotated/documented neighbor."""
+    m = _GUARDED_BY.search(src.lines[lineno - 1])
+    if m:
+        return m.group(1)
+    i = lineno - 2          # 0-based index of the line above
+    while i >= 0:
+        text = src.lines[i].strip()
+        if not text:
+            return None
+        if text.startswith("#"):
+            m = _GUARDED_BY.search(text)
+            if m:
+                return m.group(1)
+            i -= 1
+            continue
+        # a bare declaration line continues the block; a commented one
+        # (it has its own annotation story) or anything else ends it
+        if "#" not in text and re.match(
+                r"self\.[A-Za-z_][A-Za-z0-9_]*\s*(:[^=]+)?=", text):
+            i -= 1
+            continue
+        return None
+    return None
+
+
+def _scan_class(src: SourceFile, cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls.name, src.path, cls.lineno)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pass 1: lock attrs, attr declarations, attr types
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            else:
+                continue
+            attr = _self_attr(target)
+            if attr is None:
+                continue
+            info.init_lines.setdefault(attr, node.lineno)
+            if isinstance(node, ast.AnnAssign):
+                # `self.peer: "PeerClass" = peer` — the annotation types
+                # the attribute for cross-class cycle resolution
+                ann = node.annotation
+                if isinstance(ann, ast.Name):
+                    info.attr_types[attr] = ann.id
+                elif isinstance(ann, ast.Constant) \
+                        and isinstance(ann.value, str):
+                    info.attr_types[attr] = ann.value
+            kind = _lock_ctor_kind(value)
+            if kind is not None:
+                info.locks[attr] = kind
+            elif isinstance(value, ast.Call):
+                f = value.func
+                cname = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else None
+                if cname is not None:
+                    info.attr_types[attr] = cname
+    # pass 2: annotations on declarations
+    for attr, line in info.init_lines.items():
+        lock = _annotation_for_line(src, line)
+        if lock is not None:
+            info.annotations[attr] = (lock, line)
+    # pass 3: mutations + lock acquisition + calls under lock
+    for m in methods:
+        _walk_with_locks(m, m.body, frozenset(), info)
+    return info
+
+
+def _walk_with_locks(method: ast.FunctionDef, body: list[ast.stmt],
+                     held: frozenset, info: _ClassInfo) -> None:
+    for stmt in body:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in stmt.items:
+                expr = item.context_expr
+                # `with self._lock:` / `with self._lock.acquire...` no —
+                # plain attribute context managers only
+                attr = _self_attr(expr)
+                if attr is not None and attr in info.locks:
+                    acquired.add(attr)
+            now = held | acquired
+            for a in acquired:
+                info.acquires.setdefault(method.name, set()).add(a)
+            _walk_with_locks(method, stmt.body, frozenset(now), info)
+            continue
+        for attr in _mutation_targets(stmt):
+            info.mutations.append((attr, method.name, stmt.lineno, held))
+        # nested statements (if/for/try/...) — recurse into their bodies
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                _walk_with_locks(method, sub, held, info)
+        for handler in getattr(stmt, "handlers", []) or []:
+            _walk_with_locks(method, handler.body, held, info)
+        if held:
+            for node in ast.walk(stmt) if not isinstance(
+                    stmt, (ast.With, ast.AsyncWith)) else []:
+                if isinstance(node, ast.Call):
+                    for lock in held:
+                        info.calls_under_lock.append(
+                            (lock, node, method.name))
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    out: list[Finding] = []
+    bucket = ctx.bucket("lock")
+    classes = bucket.setdefault("classes", {})
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _scan_class(src, node)
+        classes[info.name] = info
+        if not info.locks:
+            continue
+        # attrs mutated under a lock (outside __init__) are shared state
+        shared: dict[str, set[str]] = {}
+        for attr, method, _line, held in info.mutations:
+            if method == "__init__" or attr in info.locks:
+                continue
+            if held:
+                shared.setdefault(attr, set()).update(held)
+        for attr, locks in sorted(shared.items()):
+            if attr not in info.annotations:
+                named = ", ".join("'%s'" % n for n in sorted(locks))
+                out.append(Finding(
+                    src.path, info.init_lines.get(attr, info.lineno),
+                    RULE_MISSING,
+                    "%s.%s is mutated under lock %s but its declaration "
+                    "has no '# guarded-by: <lock>' annotation"
+                    % (info.name, attr, named)))
+        for attr, (lock, line) in sorted(info.annotations.items()):
+            if lock not in info.locks:
+                out.append(Finding(
+                    src.path, line, RULE_MISSING,
+                    "%s.%s is annotated guarded-by '%s' but the class "
+                    "holds no such lock" % (info.name, attr, lock)))
+                continue
+            for mattr, method, mline, held in info.mutations:
+                if mattr != attr or method == "__init__" \
+                        or method.endswith("_locked"):
+                    continue
+                if lock not in held:
+                    out.append(Finding(
+                        src.path, mline, RULE_UNGUARDED,
+                        "%s.%s (guarded-by %s) is mutated in '%s' without "
+                        "the lock held" % (info.name, attr, lock, method)))
+    return out
+
+
+def _cycle_edges(classes: dict[str, _ClassInfo]):
+    """(holder_node, target_node, path, line) edges between (Class, lock)
+    nodes, resolved through self-calls and typed attribute calls."""
+    edges = []
+    for info in classes.values():
+        for lock, call, method in info.calls_under_lock:
+            f = call.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            target: _ClassInfo | None = None
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                target = info
+            else:
+                attr = _self_attr(f.value)
+                if attr is not None:
+                    tname = info.attr_types.get(attr)
+                    target = classes.get(tname) if tname else None
+            if target is None:
+                continue
+            for tlock in sorted(target.acquires.get(f.attr, ())):
+                src_node = (info.name, lock)
+                dst_node = (target.name, tlock)
+                if src_node == dst_node and \
+                        info.locks.get(lock) == "RLock":
+                    continue    # reentrant: same-lock self-call is fine
+                edges.append((src_node, dst_node, info.path, call.lineno))
+    return edges
+
+
+def finish(ctx: LintContext) -> list[Finding]:
+    classes = ctx.bucket("lock").get("classes", {})
+    edges = _cycle_edges(classes)
+    graph: dict[tuple, set[tuple]] = {}
+    meta: dict[tuple[tuple, tuple], tuple[str, int]] = {}
+    for a, b, path, line in edges:
+        graph.setdefault(a, set()).add(b)
+        meta.setdefault((a, b), (path, line))
+    out: list[Finding] = []
+    seen_cycles: set[tuple] = set()
+    for start in sorted(graph):
+        stack = [(start, (start,))]
+        while stack:
+            node, path_nodes = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cycle = path_nodes + (start,)
+                    # canonical rotation for dedup
+                    body = cycle[:-1]
+                    k = min(range(len(body)),
+                            key=lambda i: body[i:] + body[:i])
+                    canon = body[k:] + body[:k]
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    fpath, fline = meta[(node, start)]
+                    out.append(Finding(
+                        fpath, fline, RULE_CYCLE,
+                        "lock-order cycle: " + " -> ".join(
+                            "%s.%s" % n for n in cycle)))
+                elif nxt not in path_nodes:
+                    stack.append((nxt, path_nodes + (nxt,)))
+    return out
+
+
+ANALYZER = Analyzer(
+    "lock_discipline", (RULE_MISSING, RULE_UNGUARDED, RULE_CYCLE),
+    check, finish)

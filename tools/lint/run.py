@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""tsdblint CLI.
+
+    python tools/lint/run.py                      # lint opentsdb_tpu/
+    python tools/lint/run.py --json               # machine-readable
+    python tools/lint/run.py --update-baseline    # grandfather findings
+    python tools/lint/run.py --no-baseline        # raw findings
+    python tools/lint/run.py --update-doc         # regen docs/configuration.md
+    python tools/lint/run.py path/to/file.py ...  # specific targets
+
+Exit status: 0 = no findings beyond the baseline, 1 = new findings,
+2 = usage/internal error.  The tier-1 gate (tests/test_lint_clean.py)
+runs the same code in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.lint.core import (  # noqa: E402
+    REPO_ROOT, apply_baseline, load_baseline, run_lint, save_baseline)
+
+DEFAULT_PATHS = ["opentsdb_tpu"]
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tsdblint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: opentsdb_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/lint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baseline ignored")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--update-doc", action="store_true",
+                    help="regenerate docs/configuration.md from "
+                         "CONFIG_SCHEMA and exit")
+    args = ap.parse_args(argv)
+
+    if args.update_doc:
+        from opentsdb_tpu.utils.config import generate_config_doc
+        doc_path = os.path.join(REPO_ROOT, "docs", "configuration.md")
+        os.makedirs(os.path.dirname(doc_path), exist_ok=True)
+        with open(doc_path, "w", encoding="utf-8") as fh:
+            fh.write(generate_config_doc())
+        print("wrote %s" % os.path.relpath(doc_path, REPO_ROOT))
+        return 0
+
+    paths = args.paths or DEFAULT_PATHS
+    findings = run_lint(paths)
+
+    if args.update_baseline:
+        save_baseline(findings, args.baseline)
+        print("baseline updated: %d finding(s) grandfathered into %s"
+              % (len(findings), os.path.relpath(args.baseline, REPO_ROOT)))
+        return 0
+
+    if not args.no_baseline:
+        findings = apply_baseline(findings, load_baseline(args.baseline))
+
+    if args.as_json:
+        print(json.dumps([{"path": f.path, "line": f.line, "rule": f.rule,
+                           "message": f.message} for f in findings],
+                         indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print("\n%d finding(s)" % len(findings))
+        else:
+            print("tsdblint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
