@@ -173,6 +173,7 @@ def _none_agg(values, mask):
     return _first_ordered(values, mask)
 
 
+# shape: sums[S,W] any, live[S,W] bool -> [S,W] any
 def java_moving_average(sums, live, n_window: int, int_mode: bool = False):
     """The MovingAverage evaluation loop, vectorized over the last axis.
 

@@ -140,6 +140,7 @@ class AllWindow:
                  "nwin": jnp.asarray(1, jnp.int32)})
 
 
+# shape: ts[S,N] any, wargs.ts_base[] i64 -> [S,N] i64
 def _absolute_ts(ts, wargs: dict):
     """Reconstruct absolute int64 timestamps from a pre-compacted batch.
 
@@ -153,6 +154,8 @@ def _absolute_ts(ts, wargs: dict):
     return ts
 
 
+# shape: ts[S,N] any, wargs.first[] i64, wargs.edges[*] i64
+# shape: wargs.qstart[] i64, wargs.qend[] i64 -> [S,N] i64
 def window_ids(ts, spec: WindowSpec, wargs: dict):
     """Window index per point; negative / >= count means outside any window."""
     ts = _absolute_ts(ts, wargs)
@@ -167,6 +170,7 @@ def window_ids(ts, spec: WindowSpec, wargs: dict):
     raise ValueError("Unknown window kind: " + spec.kind)
 
 
+# shape: wargs.first[] i64, wargs.edges[*] i64, wargs.qstart[] i64 -> [W] i64
 def window_timestamps(spec: WindowSpec, wargs: dict):
     """Representative (start-of-interval) timestamp per window [count]."""
     if spec.kind == "fixed":
@@ -211,6 +215,7 @@ def set_extreme_mode(mode: str) -> None:
     _clear_dependent_caches()
 
 
+# shape: wargs.first[] i64, wargs.edges[*] i64 -> [W1] i64
 def window_edges(ts_dtype, spec: WindowSpec, wargs: dict):
     """Edge timestamps e[W+1]; window w spans [e[w], e[w+1])."""
     if spec.kind == "fixed":
@@ -483,6 +488,7 @@ def precompact_base(spec: WindowSpec, first_window_ms) -> int | None:
     return None
 
 
+# shape: ts[S,N] any, wargs.first[] i64, wargs.ts_base[] i64
 def _compact_ts(ts, spec: WindowSpec, wargs: dict):
     """(ts', edges') for the prefix path: int32 ms offsets when
     the whole fixed-window grid provably spans < 2^31 ms.
@@ -513,6 +519,7 @@ def _compact_ts(ts, spec: WindowSpec, wargs: dict):
     return ts32, edges32
 
 
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool -> ([S,W] f64, [S,W] any)
 def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
                        wargs: dict):
     """Scatter-free windowed moments for sorted rows.
@@ -562,6 +569,7 @@ def _prefix_downsample(ts, val, mask, agg_name: str, spec: WindowSpec,
     raise KeyError("No prefix-sum path for: " + agg_name)
 
 
+# shape: ts[S,N] any, cts[S,N] any, wargs.first[] i64, wargs.ts_base[] i64 -> [S,N] any
 def _window_ids_fast(ts, cts, spec: WindowSpec, wargs: dict):
     """Per-point window ids, preferring the compacted int32 timestamps.
 
@@ -573,8 +581,15 @@ def _window_ids_fast(ts, cts, spec: WindowSpec, wargs: dict):
     if spec.kind == "fixed" and cts.dtype == jnp.int32:
         if ts.dtype == jnp.int32 and "ts_base" in wargs:
             # pre-compacted batch: cts is relative to ts_base, not to the
-            # window origin — re-base with one int32 scalar subtract
-            shift = (wargs["first"] - wargs["ts_base"]).astype(jnp.int32)
+            # window origin — re-base with one int32 scalar subtract.
+            # The i64 difference is clipped before narrowing: today's
+            # callers derive ts_base FROM first (delta 0), but a caller
+            # handing a stale base from another query's grid would
+            # otherwise wrap silently and scatter points into random
+            # windows; saturated deltas land everything out-of-range
+            # instead, which the valid-window mask then drops.
+            shift = jnp.clip(wargs["first"] - wargs["ts_base"],
+                             -_I32_BIG, _I32_BIG).astype(jnp.int32)
             return (cts - shift) // jnp.int32(spec.interval_ms)
         return cts // jnp.int32(spec.interval_ms)
     return window_ids(ts, spec, wargs)
@@ -966,6 +981,8 @@ def _extreme_subblock(ts, val, mask, spec: WindowSpec, wargs: dict,
     return lo, hi, count
 
 
+# shape: ts[S,N] any, val[S,N] any, mask[S,N] bool, wargs.first[] i64
+# shape: wargs.nwin[] i32 -> ([W] i64, [S,W] f64, [S,W] bool)
 def downsample(ts, val, mask, agg_name: str, spec: WindowSpec, wargs: dict,
                fill_policy: str = FILL_NONE, fill_value: float = 0.0):
     """Downsample a [S, N] batch into (window_ts[W], values[S, W], mask[S, W]).

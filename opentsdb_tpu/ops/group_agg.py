@@ -387,6 +387,7 @@ def _flat_segments(contrib, participate, gid, num_groups: int):
     return seg, ok, v
 
 
+# shape: contrib[S,W] any, participate[S,W] bool, gid[S] any
 def moment_group_reduce(agg_name: str, contrib, participate, gid,
                         num_groups: int, combine_sum=_identity,
                         combine_min=_identity, combine_max=_identity,
@@ -524,6 +525,7 @@ def moment_group_reduce(agg_name: str, contrib, participate, gid,
     return out, cnt_grid
 
 
+# shape: contrib[S,W] any, participate[S,W] bool, gid[S] any
 def ordered_group_reduce(agg_name: str, contrib, participate, gid,
                          num_groups: int):
     """[S, W] -> ([G, W] out, [G, W] count) for rank/order-based aggs.
@@ -606,6 +608,7 @@ def ordered_group_reduce(agg_name: str, contrib, participate, gid,
     return out, cnt
 
 
+# shape: grid_ts[W] i64, val[S,W] any, mask[S,W] bool, gid[S] any
 def grid_group_aggregate(grid_ts, val, mask, gid, num_groups: int,
                          agg: Aggregator, rows_sorted: bool = False):
     """All-groups-at-once grid aggregation (single-device form).

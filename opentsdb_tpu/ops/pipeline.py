@@ -94,11 +94,13 @@ def _union_batch_pipeline(spec: PipelineSpec, ts, val, mask):
 _jitted_union_batch = jax.jit(_union_batch_pipeline, static_argnums=0)
 
 
+# shape: ts[B,S,N] any, val[B,S,N] any, mask[B,S,N] bool
 def run_union_batch_pipeline(spec: PipelineSpec, ts, val, mask):
     """Batched union pipeline -> per-group (u[B, U], out[B, U], mask[B, U])."""
     return _jitted_union_batch(spec, ts, val, mask)
 
 
+# shape: ts[S,N] any, val[S,N] any, mask[S,N] bool
 def run_pipeline(spec: PipelineSpec, ts, val, mask, wargs: dict | None = None):
     """Execute the pipeline; returns (out_ts, out_val, out_mask) on device."""
     return _jitted(spec, ts, val, mask, wargs or {})
@@ -185,6 +187,7 @@ def run_grid_tail(spec: PipelineSpec, wts, v, m, gid, num_groups: int):
     return _jitted_grid_tail(spec, num_groups, wts, v, m, gid)
 
 
+# shape: ts[S,N] any, val[S,N] any, mask[S,N] bool, gid[S] any
 def run_group_pipeline(spec: PipelineSpec, ts, val, mask, gid,
                        num_groups: int, wargs: dict | None = None):
     """Execute the grouped pipeline -> (wts[W], out[G, W], out_mask[G, W]).
@@ -234,6 +237,7 @@ def run_group_rollup_avg_pipeline(spec: PipelineSpec, ts_s, val_s, mask_s,
                                     ts_c, val_c, mask_c, gid, wargs or {})
 
 
+# shape: -> ([S,N] i64, [S,N] f64, [S,N] bool, [] bool)
 def build_batch_direct(series_list: list, start_ms: int, end_ms: int,
                        fix_duplicates: bool, pad_to_pow2: bool = True):
     """Single-copy batch build: size/type from window_stats, then each
@@ -274,6 +278,7 @@ def build_batch_direct(series_list: list, start_ms: int, end_ms: int,
         all_int = False
 
 
+# shape: -> ([S,N] i64, [S,N] f64, [S,N] bool, [] bool)
 def build_batch(windows: list, pad_to_pow2: bool = True):
     """Pack per-series (ts, fval, ival, is_int) windows into padded arrays.
 

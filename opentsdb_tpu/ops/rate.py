@@ -54,6 +54,7 @@ def _prev_valid_index(mask):
     return prev
 
 
+# shape: ts[S,N] any, val[S,N] any, mask[S,N] bool
 def rate(ts, val, mask, options: RateOptions, all_int: bool = False):
     """Compute rates over a [S, N] sorted batch.
 

@@ -241,6 +241,8 @@ def _use_segment_chunk(n: int, w: int, lanes: frozenset,
             and not (lanes & {"first", "last", "prod"}))
 
 
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool, wargs.first[] i64
+# shape: wargs.nwin[] i32
 def _chunk_moments(ts, val, mask, spec: WindowSpec, wargs: dict,
                    lanes: frozenset = _ALL_LANES,
                    with_sketch: bool = False):
@@ -506,6 +508,8 @@ def _update(spec: WindowSpec, state: dict, ts, val, mask, wargs: dict):
                                         with_sketch="q" in state))
 
 
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool, wargs.first[] i64
+# shape: wargs.nwin[] i32
 def _update_sliced(spec: WindowSpec, wc: int, state: dict, ts, val, mask,
                    wargs: dict, w0):
     """Fold a chunk whose windows live in [w0, w0 + wc) of the grid.

@@ -139,6 +139,7 @@ def set_union_tile_cells(cells: int) -> None:
     pipeline._jitted_union_batch.clear_cache()
 
 
+# shape: ts[S,N] any, val[S,N] any, mask[S,N] bool
 def union_aggregate(ts, val, mask, agg: Aggregator, int_mode: bool = False,
                     tile_cells: int = 0):
     """Aggregate a [S, N] batch at the union of all timestamps.
@@ -236,6 +237,7 @@ def _next_valid(mask):
         [running[:, 1:], jnp.full((mask.shape[0], 1), big, jnp.int32)], axis=1)
 
 
+# shape: grid_ts[W] i64, val[S,W] any, mask[S,W] bool
 def grid_aggregate(grid_ts, val, mask, agg: Aggregator, int_mode: bool = False):
     """Fast path: all series share one timestamp grid (post-downsample).
 

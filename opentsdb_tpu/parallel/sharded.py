@@ -311,6 +311,7 @@ def n_devices(mesh: Mesh) -> int:
     return mesh.shape[AXIS_SERIES] * mesh.shape[AXIS_TIME]
 
 
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool
 def _pad_rows(s_pad: int, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
               gid: np.ndarray | None = None, pad_gid_value: int = 0):
     """Pad the series axis to `s_pad` with inert rows.
@@ -530,6 +531,7 @@ class ShardedStreamAccumulator:
         return fn(state, d_gid, self.wargs)
 
 
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool, gid[S] any
 def shard_rows(mesh: Mesh, ts: np.ndarray, val: np.ndarray, mask: np.ndarray,
                gid: np.ndarray, pad_gid_value: int):
     """Pad the series axis to device-count multiple and device_put row-sharded.
@@ -556,6 +558,7 @@ def _put_row_sharded(mesh: Mesh, ts, val, mask, gid):
             jax.device_put(mask, row_sh), jax.device_put(gid, gid_sh))
 
 
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool, gid[S] any
 def shard_rows_device(mesh: Mesh, ts, val, mask, gid: np.ndarray,
                       pad_gid_value: int):
     """shard_rows for an already-device-resident batch (device-cache hit).

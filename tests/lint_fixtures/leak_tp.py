@@ -1,0 +1,31 @@
+"""True-positive fixtures for the resource_leak analyzer.  Parsed,
+never imported.  The analyzer unit tests inject this file's path as the
+leak scope."""
+
+import socket
+from concurrent.futures import ThreadPoolExecutor
+
+
+def never_closed(path):
+    fh = open(path)                          # EXPECT: resource-leak
+    fh.readlines()
+
+
+def early_return_leaks(path, strict):
+    fh = open(path)
+    if not strict:
+        return None                          # EXPECT: resource-leak-return
+    data = fh.readlines()
+    fh.close()
+    return data
+
+
+def executor_never_shut_down(jobs):
+    pool = ThreadPoolExecutor(max_workers=4)  # EXPECT: resource-leak
+    for job in jobs:
+        pool.submit(job)
+
+
+def socket_dropped(host, port):
+    conn = socket.create_connection((host, port))  # EXPECT: resource-leak
+    conn.sendall(b"version\n")

@@ -1,0 +1,67 @@
+"""True-negative fixtures for the shape_dtype analyzer: every pattern
+here is the sanctioned form of a shape_tp.py hazard and must stay
+silent.  Parsed, never imported."""
+
+import jax.numpy as jnp
+
+_x64_marker = True      # this fixture assumes jax_enable_x64, like ops/
+
+
+# shape: ts[S, N] i64, val[S, N] f64, mask[S, N] bool -> [S, W] f64
+def kernel(ts, val, mask):
+    return val
+
+
+# shape: a[S, N] f64, b[S, N] f64 -> [S, N] f64
+def pairwise(a, b):
+    return a + b
+
+
+# shape: ts[S, N] i64 -> [S, N] i32
+def declared_narrow(ts):
+    # the 32-bit result is part of this function's contract: callers
+    # passing i64 hit the declared-narrowing exemption, and the clip
+    # below saturates instead of wrapping
+    return jnp.clip(ts, -2**30, 2**30).astype(jnp.int32)
+
+
+def clipped_narrowing(ts, val, mask):
+    ids = kernel(ts, val, mask)
+    bounded = jnp.clip(ts, 0, 2**30)
+    offs = bounded.astype(jnp.int32)         # clipped first: fine
+    return ids, offs
+
+
+# shape: ts[S, N] i64, val[S, N] f64, mask[S, N] bool
+def well_shaped_call(ts, val, mask):
+    return kernel(ts, val, mask)             # ranks and dims line up
+
+
+# shape: a[S, N] f64
+def consistent_binding(a):
+    doubled = a + a
+    return pairwise(a, doubled)              # both [S, N]: fine
+
+
+# shape: val[S, N] f64
+def axis_in_range(val):
+    return jnp.sum(val, axis=1)
+
+
+# shape: mask[S, N] bool, hi[S, N] f64
+def aligned_where(mask, hi):
+    lo = jnp.zeros((4, 4), jnp.float64)
+    scalar_branch = jnp.where(mask, hi, 0.0)   # weak python scalar: fine
+    return jnp.where(mask, hi, lo), scalar_branch
+
+
+# shape: x[S, N] i32 -> [S, N] i32
+def takes_i32(x):
+    return x
+
+
+# shape: ts[S, N] i64
+def narrowing_into_declared_param(ts):
+    # passing i64 into a contract param declared i32 is the DECLARED
+    # narrowing — the callee owns the clamp
+    return takes_i32(ts)

@@ -1,0 +1,56 @@
+"""True-positive fixtures for the shape_dtype analyzer.
+
+Each hazardous line carries an `# EXPECT: <rule>` marker.  Parsed, never
+imported.  The `_x64_marker` identifier satisfies the jax_hygiene x64
+heuristic so only the shape rules are exercised here.
+"""
+
+import jax.numpy as jnp
+
+_x64_marker = True      # this fixture assumes jax_enable_x64, like ops/
+
+
+# shape: ts[S, N] i64, val[S, N] f64, mask[S, N] bool -> [S, W] f64
+def kernel(ts, val, mask):
+    return val
+
+
+# shape: a[S, N] f64, b[S, N] f64 -> [S, N] f64
+def pairwise(a, b):
+    return a + b
+
+
+# shape: ts[S, N] i64 -> [S, N] i32
+def declared_narrow(ts):
+    return jnp.clip(ts, -2**30, 2**30).astype(jnp.int32)
+
+
+# shape: ts[S, N] i64, val[S, N] f64, mask[S, N] bool
+def unguarded_narrowing(ts, val, mask):
+    ids = kernel(ts, val, mask)
+    offs = ts.astype(jnp.int32)              # EXPECT: shape-dtype-narrowing
+    demoted = jnp.asarray(val, jnp.float32)  # EXPECT: shape-dtype-narrowing
+    return ids, offs, demoted
+
+
+# shape: ts[S, N] i64, val[S, N] f64, mask[S, N] bool
+def rank_mismatch(ts, val, mask):
+    collapsed = jnp.sum(val, axis=1)
+    return kernel(collapsed, val, mask)      # EXPECT: shape-contract-mismatch
+
+
+# shape: a[S, N] f64
+def transposed_operand(a):
+    flipped = a.T
+    return pairwise(a, flipped)              # EXPECT: shape-contract-mismatch
+
+
+# shape: val[S, N] f64
+def axis_out_of_range(val):
+    return jnp.sum(val, axis=2)              # EXPECT: shape-axis-mismatch
+
+
+# shape: mask[S, N] bool, hi[S, N] f64
+def divergent_where(mask, hi):
+    lo = jnp.zeros((4, 4), jnp.float32)
+    return jnp.where(mask, hi, lo)           # EXPECT: shape-divergent-dtypes

@@ -1,0 +1,44 @@
+"""True-negative fixtures for the taint analyzer: every route here is
+sanitized the way query/limits.py intends — a budget charge, a limit
+guard that raises, or a min() clamp — and must stay silent.  Parsed,
+never imported."""
+
+import numpy as np
+
+MAX_BUCKETS = 4096
+
+
+def alloc_helper(count):
+    return np.zeros(count)
+
+
+def charged_route(query, budget):
+    n = int(query.get_query_string_param("n"))
+    budget.charge(n)                   # the 413 contract runs FIRST
+    buf = np.zeros(n)
+    return alloc_helper(n), buf
+
+
+def clamped_route(query):
+    n = int(query.get_query_string_param("n"))
+    n = min(n, MAX_BUCKETS)            # explicit clamp launders the size
+    return np.zeros(n)
+
+
+def guarded_route(query, limits):
+    n = int(query.required_query_string_param("count"))
+    if n > limits.get_data_points_limit("m"):
+        raise ValueError("over budget")
+    return alloc_helper(n)
+
+
+def proportional_route(query):
+    # len() of data the request already shipped is proportional, not
+    # amplified — the analyzer deliberately treats it as clean
+    parts = (query.get_query_string_param("csv") or "").split(",")
+    return np.zeros(len(parts))
+
+
+def untainted_route(config):
+    n = config.get_int("tsd.good.count")  # operator-controlled, not a
+    return np.zeros(n)                    # request field

@@ -1,0 +1,50 @@
+"""True-positive fixtures for the taint analyzer: request fields sizing
+allocations with no limits sanitizer on the route.  Parsed, never
+imported.  The analyzer unit tests inject this file's path as the sink
+scope."""
+
+import numpy as np
+
+
+def pad_pow2(n, floor=8):
+    out = floor
+    while out < n:                 # control dependence: n sizes out
+        out *= 2
+    return out
+
+
+def alloc_helper(count):
+    return np.zeros(count)
+
+
+def direct_sink(query):
+    n = int(query.get_query_string_param("n"))
+    buf = np.zeros(n)                        # EXPECT: taint-unsanitized-alloc
+    rows = [None] * n                        # EXPECT: taint-unsanitized-alloc
+    for _ in range(n):                       # EXPECT: taint-unsanitized-alloc
+        rows.append(buf)
+    return rows
+
+
+def interprocedural_sink(query):
+    n = int(query.required_query_string_param("count"))
+    return alloc_helper(n)                   # EXPECT: taint-unsanitized-alloc
+
+
+def while_amplified_sink(query):
+    n = int(query.get_query_string_param("windows"))
+    padded = pad_pow2(n)
+    return np.empty(padded + 1)              # EXPECT: taint-unsanitized-alloc
+
+
+def body_sink(query):
+    body = query.json_body()
+    k = int(body["buckets"])
+    return np.full(k, 0.0)                   # EXPECT: taint-unsanitized-alloc
+
+
+def min_of_two_tainted(query):
+    a = int(query.get_query_string_param("a"))
+    b = int(query.get_query_string_param("b"))
+    n = min(a, b)      # both operands request-derived: bounds nothing
+    return np.zeros(n)                       # EXPECT: taint-unsanitized-alloc

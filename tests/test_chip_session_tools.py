@@ -10,6 +10,9 @@ import importlib.util
 import json
 import os
 import sys
+import time
+
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -326,6 +329,59 @@ class TestFollowupMerge:
         (row,) = self._read_canon(mod)
         assert row["value"] == 100.0
         assert "superseded" not in row
+
+
+class TestStageWallGating:
+    """ADVICE r5 low: each stage is gated on ITS OWN timeout budget
+    against SESSION_DEADLINE_UNIX, not a flat 600s — a 3600s race
+    started 900s before the wall used to pass the flat check and then
+    die to the outer watchdog mid-dispatch (the known tunnel-wedge
+    mechanism)."""
+
+    def test_stage_fits_by_its_own_timeout(self, tmp_path, monkeypatch):
+        mod = _load_followup(tmp_path)
+        started = []
+
+        def fake_run_stage(name, argv, timeout, extra_env=None):
+            started.append(name)
+            return [], 0
+
+        monkeypatch.setattr(mod, "run_stage", fake_run_stage)
+        monkeypatch.setattr(mod, "tunnel_alive", lambda: True)
+        # 2000s of wall left: the 1800s benches (+120s margin) fit, the
+        # 2400s config stages and 3600s races do not.  The old flat
+        # 600s check would have started every one of them.
+        deadline = time.time() + 2000
+        monkeypatch.setenv("SESSION_DEADLINE_UNIX", str(deadline))
+        with pytest.raises(SystemExit):
+            mod.main()
+        assert "bench" in started
+        assert "hist_bench" in started
+        assert "profile" in started
+        assert not any(s.startswith("bench_configs") for s in started)
+        assert "bench_prefix" not in started
+        assert "stage_bench" not in started
+        out_rows = [json.loads(l) for l in open(mod.OUT) if l.strip()]
+        skipped = {r["stage"]: r["error"] for r in out_rows
+                   if "error" in r}
+        assert "bench_prefix" in skipped
+        assert "stage needs 3600s" in skipped["bench_prefix"]
+        assert "margin" in skipped["bench_prefix"]
+
+    def test_no_deadline_runs_everything(self, tmp_path, monkeypatch):
+        mod = _load_followup(tmp_path)
+        started = []
+
+        def fake_run_stage(name, argv, timeout, extra_env=None):
+            started.append(name)
+            return [], 0
+
+        monkeypatch.setattr(mod, "run_stage", fake_run_stage)
+        monkeypatch.setattr(mod, "tunnel_alive", lambda: True)
+        monkeypatch.delenv("SESSION_DEADLINE_UNIX", raising=False)
+        mod.main()
+        assert "bench_prefix" in started
+        assert "stage_bench" in started
 
 
 class TestFollowupResumeState:

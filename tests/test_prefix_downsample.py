@@ -563,6 +563,35 @@ class TestPrecompactedBatches:
                                            np.asarray(want)[m],
                                            rtol=1e-12, atol=1e-12)
 
+    def test_stale_base_saturates_instead_of_wrapping(self):
+        """Regression (shape-dtype-narrowing fix): a window origin
+        farther than int32 from ts_base must NOT wrap in the int32
+        re-base of `_window_ids_fast` (used by the dev mean-per-point
+        gather, the extreme scans, and streaming's window keys).
+        Pre-fix, `(first - ts_base).astype(int32)` wrapped a
+        2^32 + one-interval delta to exactly one interval — every point
+        landed one window off IN RANGE, silently wrong; with the
+        saturating clip the ids go far out of range and the validity
+        masks drop them."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import (WindowSpec,
+                                                 _window_ids_fast)
+        interval = 3_600_000
+        spec = WindowSpec("fixed", 8, interval)
+        cts = jnp.asarray([[0, interval, 2 * interval]], jnp.int32)
+        base = jnp.asarray(START, jnp.int64)
+        # honest base: ids are the plain division
+        ids = _window_ids_fast(cts, cts, spec,
+                               {"first": base, "ts_base": base})
+        np.testing.assert_array_equal(np.asarray(ids), [[0, 1, 2]])
+        # stale base, 2^32 + interval away: int32 wrap would yield
+        # shift == interval and ids [[-1, 0, 1]] — plausible, wrong.
+        # The clip saturates the shift, pushing every id out of range.
+        stale = {"first": base + 2**32 + interval, "ts_base": base}
+        ids = np.asarray(_window_ids_fast(cts, cts, spec, stale))
+        assert ((ids < 0) | (ids >= spec.count)).all(), (
+            "stale re-base wrapped into plausible window ids: %r" % ids)
+
     def test_cache_gather_emits_int32_layout(self):
         """The device cache's ts_base gather must emit exactly this
         contract: int32 dtype, offsets from base, pads at the clip

@@ -172,6 +172,152 @@ class TestEndToEnd:
             tsdb.new_query_runner().run(q)
 
 
+class TestChargeOverflow:
+    """charge() accumulation edges — these functions are load-bearing
+    taint sanitizers now (tools/lint/taint.py), so their boundary
+    behavior is pinned."""
+
+    def test_charge_exactly_at_limit_raises(self):
+        # `0 < max <= charged` — reaching the budget IS exceeding it
+        # (SaltScanner :580 counts then compares)
+        b = QueryBudget(None, "m", 0)
+        b.max_data_points = 100
+        with pytest.raises(QueryException):
+            b.charge(100)
+
+    def test_many_small_charges_accumulate(self):
+        b = QueryBudget(None, "m", 0)
+        b.max_data_points = 100
+        for _ in range(99):
+            b.charge(1)
+        with pytest.raises(QueryException):
+            b.charge(1)
+
+    def test_huge_single_charge_does_not_wrap(self):
+        # python ints are arbitrary precision, but the byte-budget
+        # multiply (points * BYTES_PER_POINT) must still compare
+        # correctly at 64-bit-overflow magnitudes
+        b = QueryBudget(None, "m", 0)
+        b.max_bytes = 1024
+        with pytest.raises(QueryException):
+            b.charge(2**62)
+
+    def test_byte_budget_across_increments(self):
+        b = QueryBudget(None, "m", 0)
+        b.max_bytes = 10 * BYTES_PER_POINT
+        b.charge(5)
+        b.charge(5)            # exactly 10 points = max_bytes: allowed
+        with pytest.raises(QueryException):
+            b.charge(1)
+
+    def test_budget_binds_per_metric_override(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([
+            {"regex": "^sys\\.", "dataPointsLimit": 5},
+        ]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.data_points.default": "50"}))
+        tight = QueryBudget(lim, "sys.cpu.user", 0)
+        loose = QueryBudget(lim, "disk.free", 0)
+        with pytest.raises(QueryException):
+            tight.charge(5)
+        loose.charge(49)       # default applies to non-matching metrics
+
+
+class TestMaybeReload:
+    def test_reload_rate_limited_to_interval(self, tmp_path):
+        import os
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 1}]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.overrides.interval": "3600"}))
+        lim.maybe_reload()      # arms the interval window
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 9}]))
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        # within the interval the changed file is NOT re-read
+        lim.maybe_reload()
+        assert lim.get_data_points_limit("abc") == 1
+        # once the interval elapses (simulated), the change lands
+        lim._next_check = 0
+        lim.maybe_reload()
+        assert lim.get_data_points_limit("abc") == 9
+
+    def test_reload_noop_without_file_or_interval(self):
+        lim = QueryLimitOverride(_config())
+        lim.maybe_reload()      # no file configured: must not raise
+        lim2 = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.interval": "0"}))
+        lim2.maybe_reload()     # interval 0 disables the check
+
+    def test_unchanged_mtime_skips_reparse(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 2}]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.overrides.interval": "1"}))
+        before = lim.overrides
+        lim._next_check = 0
+        lim.maybe_reload()
+        assert lim.overrides is before   # same mtime: same objects
+
+
+class TestBudgetBeforeWindowPlan:
+    """Regression for this PR's taint fix: the window plan (its [W+1]
+    edge vector is sized by the query's range/interval) materializes
+    only AFTER the budget accepted the scan."""
+
+    def _calendar_query(self, end_offset=600):
+        q = TSQuery(start=str(BASE), end=str(BASE + end_offset),
+                    queries=[parse_m_subquery(
+                        "sum:1mc-avg:sys.cpu.user{host=*}")])
+        q.validate()
+        return q
+
+    def _spied_split(self, monkeypatch):
+        from opentsdb_tpu.ops import downsample as ds
+        calls = []
+        orig = ds.EdgeWindows.split
+
+        def spy(self, pad=True):
+            calls.append(1)
+            return orig(self, pad)
+
+        monkeypatch.setattr(ds.EdgeWindows, "split", spy)
+        return calls
+
+    def test_over_budget_never_builds_the_edge_vector(self, monkeypatch):
+        calls = self._spied_split(monkeypatch)
+        tsdb = _loaded_tsdb(**{
+            "tsd.query.limits.data_points.default": "10",
+            "tsd.query.mesh.enable": False})
+        with pytest.raises(QueryException):
+            tsdb.new_query_runner().run(self._calendar_query())
+        assert calls == [], "413'd query still built its window plan"
+
+    def test_empty_range_never_builds_the_edge_vector(self, monkeypatch):
+        calls = self._spied_split(monkeypatch)
+        tsdb = _loaded_tsdb(**{"tsd.query.mesh.enable": False})
+        q = TSQuery(start=str(BASE + 50_000),
+                    end=str(BASE + 50_600),
+                    queries=[parse_m_subquery(
+                        "sum:1mc-avg:sys.cpu.user{host=*}")])
+        q.validate()
+        results = tsdb.new_query_runner().run(q)
+        assert all(not r.dps for r in results)
+        assert calls == [], "no-data query still built its window plan"
+
+    def test_in_budget_calendar_query_still_serves(self, monkeypatch):
+        calls = self._spied_split(monkeypatch)
+        tsdb = _loaded_tsdb(**{
+            "tsd.query.limits.data_points.default": "100000",
+            "tsd.query.mesh.enable": False})
+        results = tsdb.new_query_runner().run(self._calendar_query())
+        assert results and any(r.dps for r in results)
+        assert calls, "calendar query should plan edge windows"
+
+
 class TestExecStats:
     """Execution telemetry surfaces at /api/stats/query (r3): points and
     series scanned, streamed chunk count, mesh device count."""

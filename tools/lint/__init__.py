@@ -1,7 +1,8 @@
 """tsdblint: repo-native static analysis for the TPU-TSDB codebase.
 
-Four AST-based analyzers enforce the invariants mechanical review keeps
-missing (see tools/lint/README.md for the rule catalog):
+Seven AST-based analyzers enforce the invariants mechanical review
+keeps missing (tools/lint/README.md has the rule catalog,
+docs/static_analysis.md the deep docs).  Per-file:
 
   jax_hygiene            host-sync / retrace hazards in jit-reachable ops/
   lock_discipline        guarded-by annotations, unguarded mutations,
@@ -9,8 +10,17 @@ missing (see tools/lint/README.md for the rule catalog):
   config_schema          tsd.* keys vs utils/config.py CONFIG_SCHEMA
   exception_discipline   broad excepts that swallow without log/count
 
+Interprocedural, over a repo-wide call graph (callgraph.py):
+
+  shape_dtype            symbolic shape/dtype inference vs `# shape:`
+                         kernel contracts (narrowing, axis/rank bugs)
+  taint                  request fields -> allocation sizes without a
+                         limits sanitizer (charge / get_*_limit / min)
+  resource_leak          sockets/files/executors that miss
+                         close/with/finally on an exit path
+
 The suite is wired into tier-1 via tests/test_lint_clean.py; the CLI is
-tools/lint/run.py.
+tools/lint/run.py (--sarif, --changed-only; precommit.sh wraps it).
 """
 
 from tools.lint.core import (  # noqa: F401

@@ -1,0 +1,276 @@
+"""Repo-wide call graph + per-function summaries for the v2 analyzers.
+
+Everything is AST-derived — no imports are executed.  One CallGraph is
+built per lint run (cached in the LintContext) and shared by the
+shape/dtype, taint, and resource-leak analyzers.
+
+Resolution strategy, in decreasing order of confidence:
+
+  * bare name        -> nested def in the caller, module function,
+                        `from x import f` symbol, or a class (constructor)
+  * alias.attr       -> function/class of an imported module
+  * self.m(...)      -> method m of the caller's class (then same-module
+                        base classes by name)
+  * obj.m(...)       -> name-based devirtualization: every class in the
+                        scanned tree defining m, but only when at most
+                        DEVIRT_MAX classes do — common names (`get`,
+                        `close`, ...) resolve to nothing rather than to
+                        everything.
+
+Multi-target resolution returns *all* candidates; analyzers union the
+effects, which over-approximates data flow but never invents call edges
+to arbitrarily-named methods.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+DEVIRT_MAX = 4
+
+# Method names that collide with builtin str/list/dict/set methods are
+# never name-devirtualized: `text.split(",")` must not resolve to every
+# scanned class that happens to define split().  Receiver-TYPE-based
+# resolution (resolve(..., recv_types=...)) still reaches these methods
+# precisely.
+BUILTIN_METHODS = frozenset({
+    "split", "join", "strip", "lstrip", "rstrip", "get", "items", "keys",
+    "values", "append", "extend", "pop", "update", "sort", "copy", "index",
+    "count", "upper", "lower", "startswith", "endswith", "replace",
+    "format", "encode", "decode", "find", "add", "remove", "discard",
+    "insert", "clear", "setdefault", "read", "write", "readlines",
+    "close", "open", "run", "send", "recv", "next", "flush", "reverse",
+    "title", "search", "match", "group", "groups", "mark",
+})
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qname: str                       # module[.Class].name
+    module: str
+    klass: str | None
+    name: str
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    path: str                        # repo-relative posix path
+    nested: dict = dataclasses.field(default_factory=dict)  # name -> FuncInfo
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    @property
+    def is_method(self) -> bool:
+        return self.klass is not None
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    path: str
+    functions: dict = dataclasses.field(default_factory=dict)
+    classes: dict = dataclasses.field(default_factory=dict)   # cls -> {meth: FuncInfo}
+    bases: dict = dataclasses.field(default_factory=dict)     # cls -> [base names]
+    imports: dict = dataclasses.field(default_factory=dict)   # alias -> dotted target
+
+
+def module_name(relpath: str) -> str:
+    parts = relpath.replace("\\", "/").split("/")
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p and p != "..")
+
+
+class CallGraph:
+    def __init__(self, files):
+        self.modules: dict[str, ModuleInfo] = {}
+        self.funcs: dict[str, FuncInfo] = {}
+        self.methods_by_name: dict[str, list[FuncInfo]] = {}
+        self.classes_by_name: dict[str, list[tuple[str, str]]] = {}
+        self.files = list(files)
+        for src in self.files:
+            self._index_module(src)
+
+    # -- indexing --------------------------------------------------------
+
+    def _index_module(self, src) -> None:
+        mod = ModuleInfo(module_name(src.path), src.path)
+        self.modules[mod.name] = mod
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes_by_name.setdefault(node.name, []).append(
+                    (mod.name, node.name))
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(mod.name, node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mod.imports[a.asname or a.name] = (
+                        base + "." + a.name if base else a.name)
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_func(mod, None, node, src.path)
+            elif isinstance(node, ast.ClassDef):
+                mod.bases[node.name] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)]
+                methods = mod.classes.setdefault(node.name, {})
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fi = self._add_func(mod, node.name, sub, src.path)
+                        methods[sub.name] = fi
+                        self.methods_by_name.setdefault(sub.name,
+                                                        []).append(fi)
+
+    def _add_func(self, mod: ModuleInfo, klass: str | None, node,
+                  path: str) -> FuncInfo:
+        qname = ".".join(x for x in (mod.name, klass, node.name) if x)
+        fi = FuncInfo(qname, mod.name, klass, node.name, node, path)
+        self.funcs[qname] = fi
+        if klass is None:
+            mod.functions[node.name] = fi
+        for sub in ast.walk(node):
+            if sub is not node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested = FuncInfo(qname + ".<nested>." + sub.name, mod.name,
+                                  klass, sub.name, sub, path)
+                fi.nested[sub.name] = nested
+        return fi
+
+    @staticmethod
+    def _from_base(modname: str, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        # relative import: drop `level` trailing components of the module
+        base = ".".join(modname.split(".")[:-node.level])
+        if node.module:
+            base = base + "." + node.module if base else node.module
+        return base
+
+    # -- lookup ----------------------------------------------------------
+
+    def _symbol(self, dotted: str):
+        """A dotted import target -> FuncInfo (function) or
+        ("class", module, name) or None."""
+        if dotted in self.modules:
+            return None
+        head, _, tail = dotted.rpartition(".")
+        mod = self.modules.get(head)
+        if mod is None:
+            return None
+        if tail in mod.functions:
+            return mod.functions[tail]
+        if tail in mod.classes:
+            return ("class", mod.name, tail)
+        return None
+
+    def class_method(self, module: str, klass: str, meth: str):
+        """Method lookup walking same-module (or imported) bases."""
+        seen = set()
+        queue = [(module, klass)]
+        while queue:
+            m, k = queue.pop(0)
+            if (m, k) in seen:
+                continue
+            seen.add((m, k))
+            mod = self.modules.get(m)
+            if mod is None:
+                continue
+            fi = mod.classes.get(k, {}).get(meth)
+            if fi is not None:
+                return fi
+            for base in mod.bases.get(k, ()):
+                tgt = mod.imports.get(base)
+                if tgt is not None:
+                    sym = self._symbol(tgt)
+                    if isinstance(sym, tuple):
+                        queue.append((sym[1], sym[2]))
+                else:
+                    queue.append((m, base))
+        return None
+
+    def constructor(self, module: str, klass: str):
+        """__init__ of a class, or None (dataclass-style implicit init)."""
+        return self.class_method(module, klass, "__init__")
+
+    def resolve(self, call: ast.Call, caller: FuncInfo,
+                recv_types: set | None = None
+                ) -> list[tuple[FuncInfo | None, bool, str | None]]:
+        """Call targets as (info, is_constructor, class_name) triples.
+
+        A constructor target with no explicit __init__ (dataclasses)
+        yields (None, True, ClassName) so callers can still model
+        "tainted args -> tainted instance".  `recv_types` — inferred
+        class names of a method call's receiver — makes `obj.m()`
+        resolution exact; without it, name-devirtualization kicks in
+        for uncommon method names only.
+        """
+        f = call.func
+        mod = self.modules.get(caller.module)
+        if mod is None:
+            return []
+        if isinstance(f, ast.Name):
+            if f.id in caller.nested:
+                return [(caller.nested[f.id], False, None)]
+            if f.id in mod.functions:
+                return [(mod.functions[f.id], False, None)]
+            if f.id in mod.classes:
+                init = self.constructor(mod.name, f.id)
+                return [(init, True, f.id)]
+            tgt = mod.imports.get(f.id)
+            if tgt is not None:
+                sym = self._symbol(tgt)
+                if isinstance(sym, FuncInfo):
+                    return [(sym, False, None)]
+                if isinstance(sym, tuple):
+                    init = self.constructor(sym[1], sym[2])
+                    return [(init, True, sym[2])]
+            return []
+        if not isinstance(f, ast.Attribute):
+            return []
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and caller.klass is not None:
+                hit = self.class_method(caller.module, caller.klass, f.attr)
+                if hit is not None:
+                    return [(hit, False, None)]
+                return []
+            tgt = mod.imports.get(base.id)
+            if tgt is not None and tgt in self.modules:
+                other = self.modules[tgt]
+                if f.attr in other.functions:
+                    return [(other.functions[f.attr], False, None)]
+                if f.attr in other.classes:
+                    init = self.constructor(other.name, f.attr)
+                    return [(init, True, f.attr)]
+                return []
+        if recv_types:
+            out = []
+            for cls in sorted(recv_types):
+                for cmod, cname in self.classes_by_name.get(cls, ()):
+                    hit = self.class_method(cmod, cname, f.attr)
+                    if hit is not None:
+                        out.append((hit, False, None))
+            if out:
+                return out
+        if f.attr in BUILTIN_METHODS:
+            return []
+        cands = self.methods_by_name.get(f.attr, [])
+        if 0 < len(cands) <= DEVIRT_MAX:
+            return [(c, False, None) for c in cands]
+        return []
+
+
+def get_callgraph(ctx) -> CallGraph:
+    bucket = ctx.bucket("callgraph")
+    if "graph" not in bucket or bucket.get("nfiles") != len(ctx.files):
+        bucket["graph"] = CallGraph(ctx.files)
+        bucket["nfiles"] = len(ctx.files)
+    return bucket["graph"]

@@ -134,11 +134,17 @@ def _iter_py_files(paths: Iterable[str], root: str) -> list[str]:
 
 
 def get_analyzers() -> list[Analyzer]:
-    """All four analyzers (imported lazily so `core` has no circulars)."""
+    """All seven analyzers (imported lazily so `core` has no circulars).
+
+    The PR-2 four are per-file; the v2 three (shape/dtype abstract
+    interpretation, request-field taint, resource-leak paths) run over
+    the interprocedural call graph built once per LintContext."""
     from tools.lint import (config_schema, exception_discipline,
-                            jax_hygiene, lock_discipline)
+                            jax_hygiene, lock_discipline, resource_leak,
+                            shape_dtype, taint)
     return [jax_hygiene.ANALYZER, lock_discipline.ANALYZER,
-            config_schema.ANALYZER, exception_discipline.ANALYZER]
+            config_schema.ANALYZER, exception_discipline.ANALYZER,
+            shape_dtype.ANALYZER, taint.ANALYZER, resource_leak.ANALYZER]
 
 
 ALL_ANALYZERS = get_analyzers

@@ -1,0 +1,14 @@
+#!/bin/sh
+# tsdblint pre-commit wrapper: lint only what you touched.
+#
+# Install:   ln -s ../../tools/lint/precommit.sh .git/hooks/pre-commit
+# Run ad hoc: tools/lint/precommit.sh
+#
+# The whole tree is analyzed (the interprocedural analyzers need every
+# function summary) but findings are reported only for files that
+# differ from HEAD — so a dirty checkout never blocks your commit on
+# someone else's debt, and the full-tree pass stays under the tier-1
+# 30s budget (tests/test_lint_analyzers.py pins it).
+set -e
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+exec python "$REPO_ROOT/tools/lint/run.py" --changed-only "$@"

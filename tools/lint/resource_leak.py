@@ -1,0 +1,251 @@
+"""Resource-leak paths: sockets, files, executors, WAL handles.
+
+A TSD leaks quietly: a socket left open per failed peer fetch, a WAL
+file handle dropped on an early return, an executor that never shuts
+down — each survives the request that created it and accumulates until
+the fd table or the thread count kills the process.  This analyzer
+walks every function in the serving/storage/tooling layers and checks
+that an acquired resource reaches `close()` (or kin), a `with` block,
+or a `try/finally` on all NON-exceptional exit paths.
+
+Model (optimistic — a rule fires only when NO route to cleanup exists):
+
+  acquire   `open(...)`, `socket.socket/create_connection`,
+            `ThreadPoolExecutor/ProcessPoolExecutor`, `subprocess.Popen`,
+            `gzip/bz2/lzma.open`, `os.fdopen`,
+            `tempfile.*TemporaryFile` — bound to a LOCAL name.
+  release   a `.close/.shutdown/.stop/.terminate/.kill/.wait/
+            .communicate/.release/.join()` call on that name; a `with`
+            context; a `try/finally` whose finally releases it (the
+            name counts as protected for the whole try).
+  escape    ownership transfer ends tracking: returned, yielded, stored
+            into an attribute/subscript/container, passed as a call
+            argument, or aliased — the receiver is responsible now.
+
+Two findings:
+
+  resource-leak          the function can finish with the resource open
+                         (no release/escape anywhere after acquisition)
+  resource-leak-return   an early `return` crosses a live resource that
+                         a LATER line does release — the error path
+                         leaks what the happy path closes
+
+Scope: `opentsdb_tpu/tsd/`, `opentsdb_tpu/storage/`,
+`opentsdb_tpu/tools/` by default.  Exceptional exits (raise) are out of
+scope by design — that is what `with`/`finally` are for, and flagging
+every raise-crossing would drown the real findings.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_LEAK = "resource-leak"
+RULE_LEAK_RETURN = "resource-leak-return"
+
+LEAK_DIRS = ("opentsdb_tpu/tsd/", "opentsdb_tpu/storage/",
+             "opentsdb_tpu/tools/")
+
+ACQUIRE_NAMES = {"open", "ThreadPoolExecutor", "ProcessPoolExecutor",
+                 "Popen"}
+ACQUIRE_ATTRS = {
+    ("socket", "socket"), ("socket", "create_connection"),
+    ("subprocess", "Popen"), ("gzip", "open"), ("bz2", "open"),
+    ("lzma", "open"), ("io", "open"), ("os", "fdopen"),
+    ("tempfile", "NamedTemporaryFile"), ("tempfile", "TemporaryFile"),
+}
+RELEASERS = {"close", "shutdown", "stop", "terminate", "kill", "wait",
+             "communicate", "release", "join", "quit", "detach"}
+
+
+def _acquire_kind(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id in ACQUIRE_NAMES:
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and (f.value.id, f.attr) in ACQUIRE_ATTRS:
+        return "%s.%s" % (f.value.id, f.attr)
+    return None
+
+
+def _find_acquire(expr: ast.expr) -> str | None:
+    """The acquisition kind of an assignment's value expression: the
+    call itself, or either arm of a conditional expression."""
+    if isinstance(expr, ast.Call):
+        return _acquire_kind(expr)
+    if isinstance(expr, ast.IfExp):
+        return _find_acquire(expr.body) or _find_acquire(expr.orelse)
+    return None
+
+
+class _FnLeaks:
+    def __init__(self, fn, path: str):
+        self.fn = fn
+        self.path = path
+        self.open: dict[str, tuple[int, str]] = {}
+        self.findings: list[Finding] = []
+
+    def run(self) -> list[Finding]:
+        self._walk(self.fn.body, frozenset())
+        for name, (line, kind) in self.open.items():
+            self.findings.append(Finding(
+                self.path, line, RULE_LEAK,
+                "%s acquired by %r in '%s' never reaches close/with/"
+                "finally — the handle outlives the function on every "
+                "path" % (kind, name, self.fn.name)))
+        return self.findings
+
+    # -- name usage classification --------------------------------------
+
+    def _released(self, st: ast.stmt) -> set[str]:
+        """Names released by `.close()`-style calls anywhere in `st`."""
+        out = set()
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in RELEASERS \
+                    and isinstance(node.func.value, ast.Name):
+                out.add(node.func.value.id)
+        return out
+
+    def _escaped(self, st: ast.stmt) -> set[str]:
+        """Names whose ownership transfers somewhere inside `st`."""
+        out: set[str] = set()
+
+        def note(e):
+            if isinstance(e, ast.Name):
+                out.add(e.id)
+
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call):
+                for a in node.args:
+                    note(a.value if isinstance(a, ast.Starred) else a)
+                for kw in node.keywords:
+                    note(kw.value)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None:
+                    for sub in ast.walk(node.value):
+                        note(sub)
+            elif isinstance(node, ast.Assign):
+                # alias, attribute store, container store
+                if isinstance(node.value, ast.Name):
+                    for tgt in node.targets:
+                        if isinstance(tgt, (ast.Attribute, ast.Subscript,
+                                            ast.Name)):
+                            note(node.value)
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, (ast.Tuple, ast.List, ast.Dict,
+                                        ast.Set)):
+                        for el in ast.iter_child_nodes(sub):
+                            note(el)
+            elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+                for el in node.elts:
+                    note(el)
+            elif isinstance(node, ast.Dict):
+                for el in list(node.keys) + list(node.values):
+                    note(el)
+        return out
+
+    # -- statement walk --------------------------------------------------
+
+    def _apply(self, st: ast.stmt) -> None:
+        """Releases and escapes inside one statement."""
+        for name in self._released(st) | self._escaped(st):
+            self.open.pop(name, None)
+
+    def _walk(self, stmts, protected: frozenset) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue                  # nested defs own their scopes
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                kind = _find_acquire(st.value)
+                self._apply(st)
+                if kind is not None \
+                        and st.targets[0].id not in protected:
+                    self.open[st.targets[0].id] = (st.lineno, kind)
+                continue
+            if isinstance(st, ast.Return):
+                returned = {n.id for n in ast.walk(st)
+                            if isinstance(n, ast.Name)}
+                for name, (line, kind) in list(self.open.items()):
+                    if name in returned:
+                        self.open.pop(name)   # ownership to the caller
+                        continue
+                    if name in protected:
+                        continue    # an enclosing finally releases it
+                    self.findings.append(Finding(
+                        self.path, st.lineno, RULE_LEAK_RETURN,
+                        "return in '%s' crosses %s %r acquired earlier "
+                        "and still open — this exit path leaks what a "
+                        "later line releases" % (self.fn.name, kind,
+                                                 name)))
+                    self.open.pop(name)   # report each path-leak once
+                continue
+            if isinstance(st, ast.With):
+                # `with open(...) as fh` manages itself
+                self._apply_expr_only(st.items)
+                self._walk(st.body, protected)
+                continue
+            if isinstance(st, ast.Try):
+                # a finally that releases a name protects it everywhere
+                # in the try — including acquisitions INSIDE the body
+                # and early returns that cross them
+                released = set()
+                for fst in st.finalbody:
+                    released |= self._released(fst) | self._escaped(fst)
+                for name in released:
+                    self.open.pop(name, None)
+                inner = protected | released
+                self._walk(st.body, inner)
+                for h in st.handlers:
+                    self._walk(h.body, inner)
+                self._walk(st.orelse, inner)
+                self._walk(st.finalbody, protected)
+                # the finally has run once the try completes
+                for name in released:
+                    self.open.pop(name, None)
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                self._apply_test(st.test)
+                self._walk(st.body, protected)
+                self._walk(st.orelse, protected)
+                continue
+            if isinstance(st, ast.For):
+                self._apply_test(st.iter)
+                self._walk(st.body, protected)
+                self._walk(st.orelse, protected)
+                continue
+            self._apply(st)
+
+    def _apply_test(self, expr: ast.expr) -> None:
+        fake = ast.Expr(value=expr)
+        self._apply(fake)
+
+    def _apply_expr_only(self, items) -> None:
+        for item in items:
+            fake = ast.Expr(value=item.context_expr)
+            self._apply(fake)
+            if item.optional_vars is not None:
+                # `as` target of a with: managed, never tracked
+                if isinstance(item.optional_vars, ast.Name):
+                    self.open.pop(item.optional_vars.id, None)
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    bucket = ctx.bucket("leak")
+    dirs = tuple(bucket.get("paths", LEAK_DIRS))
+    if not (src.path.startswith(dirs) or any(d in src.path
+                                             for d in dirs)):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_FnLeaks(node, src.path).run())
+    return findings
+
+
+ANALYZER = Analyzer("resource_leak", (RULE_LEAK, RULE_LEAK_RETURN), check)

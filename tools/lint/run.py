@@ -3,10 +3,18 @@
 
     python tools/lint/run.py                      # lint opentsdb_tpu/
     python tools/lint/run.py --json               # machine-readable
+    python tools/lint/run.py --sarif              # SARIF 2.1.0 output
+    python tools/lint/run.py --changed-only       # findings in files
+                                                  # touched vs HEAD only
     python tools/lint/run.py --update-baseline    # grandfather findings
     python tools/lint/run.py --no-baseline        # raw findings
     python tools/lint/run.py --update-doc         # regen docs/configuration.md
     python tools/lint/run.py path/to/file.py ...  # specific targets
+
+`--changed-only` still ANALYZES the whole tree (the interprocedural
+analyzers need every summary) but reports only findings located in
+files `git` says differ from HEAD (staged, unstaged, or untracked) —
+the pre-commit wiring (tools/lint/precommit.sh).
 
 Exit status: 0 = no findings beyond the baseline, 1 = new findings,
 2 = usage/internal error.  The tier-1 gate (tests/test_lint_clean.py)
@@ -45,6 +53,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="write current findings as the new baseline")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
+    ap.add_argument("--sarif", action="store_true",
+                    help="emit findings as SARIF 2.1.0")
+    ap.add_argument("--changed-only", action="store_true",
+                    dest="changed_only",
+                    help="report only findings in files changed vs HEAD "
+                         "(whole tree is still analyzed)")
     ap.add_argument("--update-doc", action="store_true",
                     help="regenerate docs/configuration.md from "
                          "CONFIG_SCHEMA and exit")
@@ -71,7 +85,15 @@ def main(argv: list[str] | None = None) -> int:
     if not args.no_baseline:
         findings = apply_baseline(findings, load_baseline(args.baseline))
 
-    if args.as_json:
+    if args.changed_only:
+        changed = _changed_files()
+        findings = [f for f in findings if f.path in changed]
+
+    if args.sarif:
+        from tools.lint.core import get_analyzers
+        from tools.lint.sarif import to_sarif
+        print(json.dumps(to_sarif(findings, get_analyzers()), indent=1))
+    elif args.as_json:
         print(json.dumps([{"path": f.path, "line": f.line, "rule": f.rule,
                            "message": f.message} for f in findings],
                          indent=1))
@@ -83,6 +105,34 @@ def main(argv: list[str] | None = None) -> int:
         else:
             print("tsdblint: clean")
     return 1 if findings else 0
+
+
+def _changed_files() -> set[str]:
+    """Repo-relative posix paths git reports as differing from HEAD:
+    staged + unstaged + untracked.  A failing git command degrades
+    LOUDLY (stderr warning) and keeps whatever the other command
+    reported — a transient `git ls-files` hiccup must not silently
+    filter every finding out of the pre-commit gate."""
+    import subprocess
+    out: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  cwd=REPO_ROOT, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print("tsdblint: warning: %s failed (%s) — changed-only "
+                  "file set may be incomplete" % (" ".join(cmd), e),
+                  file=sys.stderr)
+            continue
+        if proc.returncode != 0:
+            print("tsdblint: warning: %s exited %d — changed-only "
+                  "file set may be incomplete"
+                  % (" ".join(cmd), proc.returncode), file=sys.stderr)
+            continue
+        out.update(line.strip().replace(os.sep, "/")
+                   for line in proc.stdout.splitlines() if line.strip())
+    return out
 
 
 if __name__ == "__main__":

@@ -1,0 +1,75 @@
+"""SARIF 2.1.0 output for tsdblint findings.
+
+One run, one tool (`tsdblint`), one result per finding.  Rule metadata
+is collected from the registered analyzers so viewers (GitHub code
+scanning, VS Code SARIF viewer) can group by rule.  Messages are the
+same line-number-free strings the baseline keys on; the physical
+location carries the line.
+"""
+
+from __future__ import annotations
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+_RULE_DESCRIPTIONS = {
+    "jax-host-sync": "Device sync on a traced value in jit-reachable code",
+    "jax-tracer-branch": "Python branch on a traced value",
+    "jax-jit-per-call": "jax.jit constructed per call",
+    "jax-int64-no-x64-guard": "jnp.int64 without an x64 guard",
+    "lock-missing-annotation": "Lock-guarded attribute lacks guarded-by",
+    "lock-unguarded-mutation": "Guarded attribute mutated without lock",
+    "lock-order-cycle": "Lock acquisition order cycle",
+    "config-unknown-key": "Config key read but not declared in schema",
+    "config-type-mismatch": "Typed config getter disagrees with schema",
+    "config-dead-key": "Schema key no code reads",
+    "except-swallow": "Broad except neither logs, counts, nor re-raises",
+    "shape-contract-mismatch": "Caller disagrees with a # shape: contract",
+    "shape-dtype-narrowing": "64-bit value narrowed to 32-bit unguarded",
+    "shape-axis-mismatch": "Reduction axis outside the operand's rank",
+    "shape-divergent-dtypes": "where/concat operands of divergent dtypes",
+    "taint-unsanitized-alloc":
+        "Request field sizes an allocation with no limits sanitizer",
+    "resource-leak": "Acquired resource never reaches close/with/finally",
+    "resource-leak-return": "Early return crosses a live resource",
+    "parse-error": "File failed to parse",
+}
+
+
+def to_sarif(findings, analyzers) -> dict:
+    rule_ids = sorted({f.rule for f in findings}
+                      | {r for a in analyzers for r in a.rules})
+    rules = [{
+        "id": rid,
+        "shortDescription": {
+            "text": _RULE_DESCRIPTIONS.get(rid, rid)},
+    } for rid in rule_ids]
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = [{
+        "ruleId": f.rule,
+        "ruleIndex": index[f.rule],
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                # repo-relative URI, no originalUriBaseIds: the consumer
+                # (code-scanning upload, SARIF viewer workspace root)
+                # resolves against its own checkout
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(f.line, 1)},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tsdblint",
+                "rules": rules,
+            }},
+            "results": results,
+            "columnKind": "utf16CodeUnits",
+        }],
+    }

@@ -1,0 +1,678 @@
+"""Shape/dtype abstract interpretation over the JAX kernel layers.
+
+The pipeline's silent failure modes are numeric, not crashes: an int64
+ms-timestamp narrowed to int32 wraps, a float64 accumulator demoted to
+float32 loses the reference's Java-double contract, an axis mixed up
+between (series, time) and (time, series) aggregates the wrong way and
+only surfaces as wrong numbers.  This analyzer tracks symbolic shapes
+and dtypes through `jnp`/`np` expressions, seeded by lightweight
+`# shape:` contract comments on kernel signatures, and checks callers
+against those contracts across functions.
+
+Contract grammar — comment line(s) directly above the `def` (multiple
+lines merge; dict entries use dotted names):
+
+    # shape: ts[S,N] i64, val[S,N] f64, mask[S,N] bool -> [S,W] f64
+    # shape: wargs.first[] i64, wargs.nwin[] i32
+
+  * dims: comma-separated symbols; `[]` = scalar; `*` = unconstrained
+  * dtypes: i64 i32 f64 f32 bool any
+  * returns: `-> [dims] dtype` or `-> ([dims] dtype, [dims] dtype, ...)`
+
+Rules:
+
+  shape-contract-mismatch   a call argument whose inferred rank differs
+                            from the contract, or whose dim symbols bind
+                            a callee symbol inconsistently across the
+                            call's arguments (the axis-transpose bug),
+                            or whose dtype conflicts in kind/width with
+                            the declaration (widening direction).
+  shape-dtype-narrowing     a 64-bit value cast to the 32-bit dtype of
+                            the same kind (`.astype(jnp.int32)`,
+                            `jnp.asarray(x, jnp.float32)`, or passed to
+                            a contract parameter declared 32-bit is
+                            exempt — that narrowing is declared), with
+                            no `jnp.clip(...)` saturation wrapper.
+                            Unclipped int64->int32 on ms timestamps is
+                            exactly the truncation `require_x64()`
+                            exists to prevent.
+  shape-axis-mismatch       a reduction/concat `axis=` literal outside
+                            the operand's known rank.
+  shape-divergent-dtypes    `jnp.where`/`concatenate`/`stack` mixing
+                            two operands of known different dtypes
+                            (python scalars are weak-typed and exempt).
+
+Inference is deliberately conservative: a rule only fires when both
+sides are KNOWN — unknown shapes/dtypes never produce findings.
+Scope: `opentsdb_tpu/ops/` and `opentsdb_tpu/parallel/` by default.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.callgraph import get_callgraph
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_CONTRACT = "shape-contract-mismatch"
+RULE_NARROW = "shape-dtype-narrowing"
+RULE_AXIS = "shape-axis-mismatch"
+RULE_DIVERGENT = "shape-divergent-dtypes"
+
+SHAPE_DIRS = ("opentsdb_tpu/ops/", "opentsdb_tpu/parallel/")
+
+_CONTRACT_RE = re.compile(r"^\s*#\s*shape:\s*(.+?)\s*$")
+_PARAM_RE = re.compile(
+    r"(?P<name>\w+(?:\.\w+)?)\s*\[(?P<dims>[^\]]*)\]\s*(?P<dtype>\w+)")
+_RET_RE = re.compile(r"\[(?P<dims>[^\]]*)\]\s*(?P<dtype>\w+)")
+
+DTYPES = {"i64": "i64", "i32": "i32", "f64": "f64", "f32": "f32",
+          "bool": "bool", "any": None}
+
+_DTYPE_ATTRS = {"int64": "i64", "int32": "i32", "float64": "f64",
+                "float32": "f32", "bool_": "bool", "uint8": "i32",
+                "int16": "i32", "float16": "f32"}
+
+REDUCERS = {"sum", "mean", "max", "min", "prod", "any", "all",
+            "argmax", "argmin", "nanmax", "nanmin", "nansum"}
+SCANS = {"cumsum", "cumprod", "sort", "flip", "diff",
+         "associative_scan"}
+JOINERS = {"where", "concatenate", "stack", "append"}
+
+_WIDER = {"i32": "i64", "f32": "f64"}
+
+
+class Abstract:
+    """(shape, dtype, clipped) lattice value; None = unknown slot.
+    `clipped` marks values already saturated by jnp.clip — narrowing
+    them is deliberate range control, not silent truncation."""
+    __slots__ = ("shape", "dtype", "clipped")
+
+    def __init__(self, shape=None, dtype=None, clipped=False):
+        self.shape = shape          # tuple of dim symbols, or None
+        self.dtype = dtype          # "i64" | ... | None
+        self.clipped = clipped
+
+    def __repr__(self):
+        return "Abstract(%r, %r, clipped=%r)" % (self.shape, self.dtype,
+                                                 self.clipped)
+
+
+UNKNOWN = Abstract()
+
+
+def _promote(a: str | None, b: str | None) -> str | None:
+    if a is None or b is None:
+        return None
+    if a == b:
+        return a
+    order = {"bool": 0, "i32": 1, "i64": 2, "f32": 3, "f64": 4}
+    if a in order and b in order:
+        return a if order[a] >= order[b] else b
+    return None
+
+
+class Contract:
+    __slots__ = ("params", "returns", "qname")
+
+    def __init__(self, qname: str):
+        self.qname = qname
+        self.params: dict[str, Abstract] = {}
+        self.returns: list[Abstract] = []
+
+
+def parse_contract(lines: list[str], def_line: int, qname: str
+                   ) -> Contract | None:
+    """Contract from `# shape:` comment lines directly above the def
+    (scanning upward past decorators and other comments stops at the
+    first blank/code line that is neither)."""
+    specs: list[str] = []
+    i = def_line - 2                      # 0-based line above the def
+    while i >= 0:
+        line = lines[i]
+        m = _CONTRACT_RE.match(line)
+        if m:
+            specs.append(m.group(1))
+            i -= 1
+            continue
+        stripped = line.strip()
+        if stripped.startswith("@") or stripped.startswith("#"):
+            i -= 1
+            continue
+        break
+    if not specs:
+        return None
+    out = Contract(qname)
+    for spec in reversed(specs):
+        if "->" in spec:
+            params_part, ret_part = spec.split("->", 1)
+        else:
+            params_part, ret_part = spec, ""
+        for m in _PARAM_RE.finditer(params_part):
+            dims = tuple(d.strip() for d in m.group("dims").split(",")
+                         if d.strip())
+            dt = DTYPES.get(m.group("dtype"))
+            if m.group("dtype") not in DTYPES:
+                continue
+            out.params[m.group("name")] = Abstract(dims, dt)
+        for m in _RET_RE.finditer(ret_part):
+            dims = tuple(d.strip() for d in m.group("dims").split(",")
+                         if d.strip())
+            dt = DTYPES.get(m.group("dtype"))
+            if m.group("dtype") not in DTYPES:
+                continue
+            out.returns.append(Abstract(dims, dt))
+    return out if (out.params or out.returns) else None
+
+
+def _dtype_of_node(node: ast.expr) -> str | None:
+    """jnp.int64 / np.float32 / bool -> abstract dtype."""
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_ATTRS:
+        return _DTYPE_ATTRS[node.attr]
+    if isinstance(node, ast.Name):
+        if node.id == "bool":
+            return "bool"
+        if node.id in _DTYPE_ATTRS:
+            return _DTYPE_ATTRS[node.id]
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {"int64": "i64", "int32": "i32", "float64": "f64",
+                "float32": "f32", "bool": "bool"}.get(node.value)
+    return None
+
+
+def _comparable(a: str, b: str) -> bool:
+    """Two dim symbols share provenance: both caller-local names, or
+    both derived from the SAME contracted callee's return."""
+    if "@" in a or "@" in b:
+        return ("@" in a and "@" in b
+                and a.split("@", 1)[1] == b.split("@", 1)[1])
+    return True
+
+
+def _np_mod(node: ast.expr) -> bool:
+    return isinstance(node, ast.Name) and node.id in ("np", "jnp",
+                                                      "numpy", "lax")
+
+
+class _FnCheck:
+    """Infer abstract values through one function; check call sites."""
+
+    def __init__(self, fi, graph, contracts, src: SourceFile | None):
+        self.fi = fi
+        self.graph = graph
+        self.contracts = contracts
+        self.src = src
+        self.env: dict[str, Abstract] = {}
+        self.findings: list[Finding] = []
+        self._fresh = 0
+        contract = contracts.get(fi.qname)
+        if contract is not None:
+            for name, av in contract.params.items():
+                self.env[name] = Abstract(av.shape, av.dtype)
+
+    # -- inference -------------------------------------------------------
+
+    def _key(self, e: ast.expr) -> str | None:
+        """Env key for a Name or param-dict subscript (wargs["first"])."""
+        if isinstance(e, ast.Name):
+            return e.id
+        if isinstance(e, ast.Subscript) and isinstance(e.value, ast.Name) \
+                and isinstance(e.slice, ast.Constant) \
+                and isinstance(e.slice.value, str):
+            return "%s.%s" % (e.value.id, e.slice.value)
+        return None
+
+    def infer(self, e: ast.expr) -> Abstract:
+        key = self._key(e)
+        if key is not None and key in self.env:
+            return self.env[key]
+        if isinstance(e, ast.Call):
+            return self._infer_call(e)
+        if isinstance(e, ast.BinOp):
+            left = self.infer(e.left)
+            right = self.infer(e.right)
+            lw = isinstance(e.left, ast.Constant)
+            rw = isinstance(e.right, ast.Constant)
+            if isinstance(e.op, ast.Div):
+                # true division: int operands promote to f64; known
+                # floats promote among themselves (f32/f32 -> f32)
+                if left.dtype is None or right.dtype is None:
+                    dt = None
+                elif left.dtype.startswith("f") \
+                        and right.dtype.startswith("f"):
+                    dt = _promote(left.dtype, right.dtype)
+                else:
+                    dt = "f64"
+            elif lw and not rw:
+                dt = right.dtype          # python scalars are weak
+            elif rw and not lw:
+                dt = left.dtype
+            else:
+                dt = _promote(left.dtype, right.dtype)
+            shape = left.shape if left.shape is not None else right.shape
+            if left.shape is not None and right.shape is not None \
+                    and left.shape != right.shape:
+                shape = None              # broadcast: unknown
+            return Abstract(shape, dt)
+        if isinstance(e, ast.UnaryOp):
+            return self.infer(e.operand)
+        if isinstance(e, ast.Compare):
+            base = self.infer(e.left)
+            return Abstract(base.shape, "bool")
+        if isinstance(e, ast.IfExp):
+            a, b = self.infer(e.body), self.infer(e.orelse)
+            return Abstract(a.shape if a.shape == b.shape else None,
+                            a.dtype if a.dtype == b.dtype else None)
+        if isinstance(e, ast.Subscript):
+            return self._infer_subscript(e)
+        if isinstance(e, ast.Attribute):
+            if e.attr in ("T",):
+                base = self.infer(e.value)
+                if base.shape is not None:
+                    return Abstract(tuple(reversed(base.shape)),
+                                    base.dtype)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _infer_subscript(self, e: ast.Subscript) -> Abstract:
+        base = self.infer(e.value)
+        if base.shape is None:
+            return Abstract(None, base.dtype)
+        idx = e.slice
+        items = list(idx.elts) if isinstance(idx, ast.Tuple) else [idx]
+        dims = list(base.shape)
+        out: list[str] = []
+        pos = 0
+        for it in items:
+            if isinstance(it, ast.Constant) and it.value is None:
+                out.append(self._fresh_dim())
+                continue
+            if pos >= len(dims):
+                return Abstract(None, base.dtype)
+            if isinstance(it, ast.Slice):
+                out.append(dims[pos])     # sliced dim keeps its symbol
+                pos += 1
+            elif isinstance(it, ast.Constant) and isinstance(it.value,
+                                                             int):
+                pos += 1                  # integer index drops the dim
+            else:
+                return Abstract(None, base.dtype)
+        out.extend(dims[pos:])
+        return Abstract(tuple(out), base.dtype)
+
+    def _fresh_dim(self) -> str:
+        self._fresh += 1
+        return "?%d" % self._fresh
+
+    def _shape_from_tuple(self, node: ast.expr) -> tuple | None:
+        """A literal shape tuple (s, w) -> symbolic dims from names."""
+        elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+            else [node]
+        dims = []
+        for el in elts:
+            if isinstance(el, ast.Name):
+                dims.append(el.id)
+            elif isinstance(el, ast.Constant) and isinstance(el.value,
+                                                             int):
+                dims.append(str(el.value))
+            else:
+                dims.append(self._fresh_dim())
+        return tuple(dims)
+
+    def _infer_call(self, call: ast.Call) -> Abstract:
+        f = call.func
+        # x.astype(d)
+        if isinstance(f, ast.Attribute) and f.attr == "astype":
+            base = self.infer(f.value)
+            dt = _dtype_of_node(call.args[0]) if call.args else None
+            self._check_narrowing(call, f.value, base, dt)
+            return Abstract(base.shape, dt, clipped=base.clipped)
+        if isinstance(f, ast.Attribute) and _np_mod(f.value):
+            return self._infer_np_call(call, f)
+        # contracted callee -> declared return
+        for info, is_ctor, _cls in self.graph.resolve(call, self.fi):
+            if info is None or is_ctor:
+                continue
+            contract = self.contracts.get(info.qname)
+            if contract is None:
+                continue
+            subst = self._check_contract_call(call, info, contract)
+            if len(contract.returns) == 1:
+                r = contract.returns[0]
+                return Abstract(self._map_dims(r.shape, subst, info),
+                                r.dtype)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _map_dims(self, dims, subst, info) -> tuple | None:
+        if dims is None:
+            return None
+        return tuple(subst.get(d, "%s@%s" % (d, info.name)) if d != "*"
+                     else self._fresh_dim() for d in dims)
+
+    def _infer_np_call(self, call: ast.Call, f: ast.Attribute) -> Abstract:
+        name = f.attr
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        dt = None
+        if "dtype" in kw:
+            dt = _dtype_of_node(kw["dtype"])
+        if name in ("zeros", "ones", "empty", "full"):
+            if dt is None:
+                dtpos = 2 if name == "full" else 1
+                if len(call.args) > dtpos:
+                    dt = _dtype_of_node(call.args[dtpos])
+            shape = (self._shape_from_tuple(call.args[0])
+                     if call.args else None)
+            return Abstract(shape, dt)
+        if name in ("zeros_like", "ones_like", "full_like", "empty_like"):
+            base = self.infer(call.args[0]) if call.args else UNKNOWN
+            return Abstract(base.shape, dt or base.dtype)
+        if name in ("asarray", "array"):
+            base = self.infer(call.args[0]) if call.args else UNKNOWN
+            if len(call.args) > 1 and dt is None:
+                dt = _dtype_of_node(call.args[1])
+            if dt is not None and call.args:
+                self._check_narrowing(call, call.args[0], base, dt)
+            return Abstract(base.shape, dt or base.dtype)
+        if name == "arange":
+            n = call.args[0] if call.args else None
+            dim = n.id if isinstance(n, ast.Name) else self._fresh_dim()
+            return Abstract((dim,), dt)
+        if name == "clip":
+            base = self.infer(call.args[0]) if call.args else UNKNOWN
+            return Abstract(base.shape, base.dtype, clipped=True)
+        if name in REDUCERS or name in SCANS:
+            base = self.infer(call.args[0]) if call.args else UNKNOWN
+            axis = self._axis_of(call)
+            self._check_axis(call, name, base, axis)
+            if name in SCANS or axis is None:
+                return base
+            if base.shape is not None and kw.get("keepdims") is None:
+                dims = list(base.shape)
+                if -len(dims) <= axis < len(dims):
+                    del dims[axis]
+                    dt2 = ("bool" if name in ("any", "all") else
+                           "i32" if name in ("argmax", "argmin")
+                           else base.dtype)
+                    return Abstract(tuple(dims), dt2)
+            return Abstract(None, base.dtype)
+        if name in JOINERS:
+            return self._infer_joiner(call, name)
+        if name == "searchsorted":
+            return UNKNOWN
+        if name in ("int64", "int32", "float64", "float32"):
+            base = self.infer(call.args[0]) if call.args else UNKNOWN
+            dt = _DTYPE_ATTRS[name]
+            if call.args:
+                self._check_narrowing(call, call.args[0], base, dt)
+            return Abstract(base.shape, dt)
+        return UNKNOWN
+
+    @staticmethod
+    def _axis_of(call: ast.Call) -> int | None:
+        for k in call.keywords:
+            if k.arg == "axis" and isinstance(k.value, ast.Constant) \
+                    and isinstance(k.value.value, int):
+                return k.value.value
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, int):
+            return call.args[1].value
+        return None
+
+    def _infer_joiner(self, call: ast.Call, name: str) -> Abstract:
+        if name == "where":
+            operands = call.args[1:3]
+        else:
+            first = call.args[0] if call.args else None
+            operands = (first.elts if isinstance(first, (ast.Tuple,
+                                                         ast.List))
+                        else [])
+        known = []
+        for op in operands:
+            if isinstance(op, ast.Constant):
+                continue                  # weak python scalar
+            av = self.infer(op)
+            if av.dtype is not None:
+                known.append((op, av))
+        if len(known) >= 2:
+            dts = {av.dtype for _, av in known}
+            if len(dts) > 1:
+                self._emit(call.lineno, RULE_DIVERGENT,
+                           "jnp.%s mixes operands of divergent dtypes "
+                           "(%s) in '%s': the silent promotion is a "
+                           "different numeric contract per branch — "
+                           "align dtypes explicitly"
+                           % (name, "/".join(sorted(dts)), self.fi.name))
+        if known:
+            av = known[0][1]
+            dt = known[0][1].dtype
+            for _, other in known[1:]:
+                dt = _promote(dt, other.dtype)
+            return Abstract(av.shape, dt)
+        return UNKNOWN
+
+    # -- rule checks -----------------------------------------------------
+
+    def _check_narrowing(self, call: ast.Call, operand: ast.expr,
+                         base: Abstract, target: str | None) -> None:
+        if target not in ("i32", "f32") or base.dtype is None:
+            return
+        if base.dtype != _WIDER[target]:
+            return
+        if base.clipped:
+            return                    # already saturated by jnp.clip
+        # jnp.clip(...) directly under the cast saturates deliberately
+        if isinstance(operand, ast.Call) \
+                and isinstance(operand.func, ast.Attribute) \
+                and operand.func.attr == "clip":
+            return
+        self._emit(call.lineno, RULE_NARROW,
+                   "%s value narrowed to %s in '%s' without a jnp.clip "
+                   "saturation guard: out-of-range values wrap silently "
+                   "(ms timestamps truncate) — clip to the target range "
+                   "first, or declare the narrowing in a # shape: "
+                   "contract" % (base.dtype, target, self.fi.name))
+
+    def _check_axis(self, call: ast.Call, name: str, base: Abstract,
+                    axis: int | None) -> None:
+        if axis is None or base.shape is None:
+            return
+        rank = len(base.shape)
+        if not (-rank <= axis < rank):
+            self._emit(call.lineno, RULE_AXIS,
+                       "jnp.%s over axis %d of a rank-%d value "
+                       "[%s] in '%s': axis is out of range"
+                       % (name, axis, rank, ",".join(base.shape),
+                          self.fi.name))
+
+    def _check_contract_call(self, call: ast.Call, info, contract
+                             ) -> dict:
+        """Unify args against the callee contract; returns the dim
+        substitution (callee symbol -> caller symbol)."""
+        params = info.params
+        mapped: list[tuple[str, ast.expr]] = []
+        pos = [p for p in params if p != "self"]
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred) or i >= len(pos):
+                break
+            mapped.append((pos[i], arg))
+        for k in call.keywords:
+            if k.arg in params:
+                mapped.append((k.arg, k.value))
+        subst: dict[str, str] = {}
+        for pname, arg in mapped:
+            decl = contract.params.get(pname)
+            if decl is None:
+                continue
+            av = self.infer(arg)
+            self._unify(call, info, pname, decl, av, arg, subst)
+            # dict-entry sub-contracts: wargs.first etc, checked when
+            # the caller passes a dict built of known values — skipped
+            # here (the callee-side seeding enforces them)
+        return subst
+
+    def _unify(self, call, info, pname, decl: Abstract, av: Abstract,
+               arg: ast.expr, subst: dict) -> None:
+        if decl.dtype is not None and av.dtype is not None \
+                and decl.dtype != av.dtype:
+            if _WIDER.get(decl.dtype) == av.dtype:
+                # declared-32-bit parameter: the narrowing is part of
+                # the contract, not a finding
+                pass
+            else:
+                self._emit(call.lineno, RULE_CONTRACT,
+                           "'%s' passes a %s value where %s.%s declares "
+                           "%s for parameter '%s'"
+                           % (self.fi.name, av.dtype, info.name, pname,
+                              decl.dtype, pname))
+        if decl.shape is None or av.shape is None:
+            return
+        if len(decl.shape) != len(av.shape):
+            self._emit(call.lineno, RULE_CONTRACT,
+                       "'%s' passes a rank-%d value [%s] where %s.%s "
+                       "declares rank-%d [%s] for parameter '%s'"
+                       % (self.fi.name, len(av.shape),
+                          ",".join(av.shape), info.name, pname,
+                          len(decl.shape), ",".join(decl.shape), pname))
+            return
+        for d_sym, a_sym in zip(decl.shape, av.shape):
+            if d_sym == "*" or a_sym.startswith("?"):
+                continue
+            bound = subst.get(d_sym)
+            if bound is None:
+                subst[d_sym] = a_sym
+            elif bound != a_sym and _comparable(bound, a_sym):
+                # (symbols of different provenance — a caller-local size
+                # name vs a contract-derived one — are incomparable;
+                # only same-provenance disagreement is an axis bug)
+                self._emit(call.lineno, RULE_CONTRACT,
+                           "'%s' call to %s binds contract dim '%s' to "
+                           "both '%s' and '%s' — axis semantics "
+                           "disagree with the callee's summary"
+                           % (self.fi.name, info.name, d_sym, bound,
+                              a_sym))
+
+    def _emit(self, line: int, rule: str, message: str) -> None:
+        self.findings.append(Finding(self.fi.path, line, rule, message))
+
+    # -- driver ----------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        # two passes: the first settles the env (names used before their
+        # inference stabilizes), the second emits
+        for _ in range(2):
+            self.findings = []
+            self._walk(self.fi.node.body)
+        seen = set()
+        out = []
+        for f in self.findings:
+            if f not in seen:
+                seen.add(f)
+                out.append(f)
+        return out
+
+    def _walk(self, stmts) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(st.body)       # nested: shared env
+                continue
+            if isinstance(st, ast.Assign):
+                av = self.infer(st.value)
+                for tgt in st.targets:
+                    self._bind(tgt, av, st.value)
+                continue
+            if isinstance(st, ast.AnnAssign) and st.value is not None:
+                self._bind(st.target, self.infer(st.value), st.value)
+                continue
+            if isinstance(st, ast.AugAssign):
+                self.infer(st.value)
+                continue
+            if isinstance(st, ast.Expr):
+                self.infer(st.value)
+                continue
+            if isinstance(st, ast.Return):
+                if st.value is not None:
+                    self.infer(st.value)
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                self.infer(st.test)
+                self._walk(st.body)
+                self._walk(st.orelse)
+                continue
+            if isinstance(st, ast.For):
+                self.infer(st.iter)
+                self._walk(st.body)
+                self._walk(st.orelse)
+                continue
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    self.infer(item.context_expr)
+                self._walk(st.body)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk(st.body)
+                for h in st.handlers:
+                    self._walk(h.body)
+                self._walk(st.orelse)
+                self._walk(st.finalbody)
+                continue
+
+    def _bind(self, tgt, av: Abstract, value: ast.expr) -> None:
+        if isinstance(tgt, ast.Name):
+            self.env[tgt.id] = av
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            # only a contracted multi-return unpacks precisely
+            if isinstance(value, ast.Call):
+                for info, is_ctor, _c in self.graph.resolve(value,
+                                                            self.fi):
+                    if info is None or is_ctor:
+                        continue
+                    contract = self.contracts.get(info.qname)
+                    if contract and len(contract.returns) == len(
+                            tgt.elts):
+                        for el, r in zip(tgt.elts, contract.returns):
+                            if isinstance(el, ast.Name):
+                                self.env[el.id] = Abstract(
+                                    self._map_dims(r.shape, {}, info),
+                                    r.dtype)
+                        return
+            for el in tgt.elts:
+                if isinstance(el, ast.Name):
+                    self.env[el.id] = UNKNOWN
+
+
+def finish(ctx: LintContext) -> list[Finding]:
+    graph = get_callgraph(ctx)
+    bucket = ctx.bucket("shape")
+    dirs = tuple(bucket.get("paths", SHAPE_DIRS))
+    src_by_path = {src.path: src for src in ctx.files}
+    contracts: dict[str, Contract] = {}
+    for fi in graph.funcs.values():
+        src = src_by_path.get(fi.path)
+        if src is None:
+            continue
+        c = parse_contract(src.lines, fi.node.lineno, fi.qname)
+        if c is not None:
+            contracts[fi.qname] = c
+    findings: list[Finding] = []
+    for fi in graph.funcs.values():
+        if ".<nested>." in fi.qname:
+            continue
+        in_scope = fi.path.startswith(dirs) or any(d in fi.path
+                                                   for d in dirs)
+        if not in_scope:
+            continue
+        findings.extend(
+            _FnCheck(fi, graph, contracts, src_by_path.get(fi.path)).run())
+    return sorted(set(findings))
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    return []
+
+
+ANALYZER = Analyzer(
+    "shape_dtype",
+    (RULE_CONTRACT, RULE_NARROW, RULE_AXIS, RULE_DIVERGENT),
+    check, finish)

@@ -1,0 +1,673 @@
+"""Untrusted-input taint: request fields -> allocation-size expressions.
+
+The costliest way a query kills a TSD is not a crash but an allocation:
+a user-controlled range/interval/cardinality sizes a `jnp.zeros`, a
+window-edge vector, or a Python list preallocation, and the host or the
+device OOMs before any budget is consulted.  This analyzer tracks
+request data interprocedurally from the parse layer to the kernels:
+
+  sources     HttpQuery accessors (`get_query_string_param[s]`,
+              `required_query_string_param`, `json_body`), serializer
+              `parse_*_v1` calls, and the telnet `words`/`block`
+              parameters of `execute_telnet*`/`import_telnet_point`.
+  sinks       size arguments of `np`/`jnp` `zeros/full/empty/ones/
+              arange`, list preallocation (`[x] * n`), and `range()`
+              loop bounds — in files under SINK_DIRS (the kernel,
+              storage, and planner layers).
+  sanitizers  `query/limits.py` budget enforcement: a `.charge(...)`
+              call, or an `if` guard comparing against
+              `get_data_points_limit`/`get_byte_limit` that raises —
+              either one, lexically before the sink/call on the route —
+              plus `min(...)` clamps, which launder the clamped value.
+
+A finding fires in the function where request data ENTERS (a source
+call, or a call returning request-derived data) and then reaches a sink
+— directly, or through a callee whose parameter provably flows to a
+sink — with no sanitizer on any hop of that route.  Flow through
+function returns, constructor captures (`TSQuery(start=tainted)` taints
+the instance), attribute loads on tainted objects, and `while`-loop
+control dependence (the `pad_pow2` idiom: the loop bound controls the
+result) is tracked; `if` branches are not treated as implicit flows.
+
+Whole-program: runs in finish() over every scanned file, to a fixpoint
+over per-function summaries (tainted-return labels, params-that-reach-
+sinks, inferred parameter/return class types for method resolution).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.callgraph import get_callgraph
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_TAINT = "taint-unsanitized-alloc"
+
+SOURCE_ATTRS = {
+    "get_query_string_param", "get_query_string_params",
+    "required_query_string_param", "json_body",
+}
+SOURCE_ATTR_PREFIXES = ("parse_put", "parse_query", "parse_suggest",
+                        "parse_annotation", "parse_uid", "parse_histogram")
+TELNET_FUNCS = {"execute_telnet", "import_telnet_point",
+                "execute_telnet_batch"}
+TELNET_PARAMS = {"words", "block"}
+
+ALLOC_FUNCS = {"zeros", "full", "empty", "ones", "arange"}
+ALLOC_MODULES = {"np", "jnp", "numpy"}
+
+SANITIZER_CHARGE = {"charge"}
+SANITIZER_LIMIT_GETTERS = {"get_data_points_limit", "get_byte_limit"}
+# len() is deliberately clean: the length of data the request ALREADY
+# shipped (or the store already holds) is proportional, not amplified —
+# the hazard this analyzer hunts is a small request field exploding into
+# a huge size (range/interval -> millions of windows), which never
+# routes through len().  min() is handled separately: it launders only
+# when some argument is itself clean (an actual cap); min of two
+# request-derived values is still unbounded.
+CLEAN_CALLS = {"isinstance", "hasattr", "id", "bool", "callable",
+               "len"}
+# attribute calls whose results are operator-controlled, not
+# request-controlled: config getters and stats plumbing
+CLEAN_ATTR_CALLS = {"get_int", "get_bool", "get_float", "get_string",
+                    "get_properties", "record", "mark", "monotonic",
+                    "time"}
+PASSTHROUGH_CALLS = {"int", "float", "str", "abs", "max", "sorted",
+                     "list", "tuple", "set", "dict", "sum", "round",
+                     "getattr", "enumerate", "zip", "map", "filter",
+                     "reversed"}
+
+SINK_DIRS = ("opentsdb_tpu/ops/", "opentsdb_tpu/storage/",
+             "opentsdb_tpu/query/", "opentsdb_tpu/parallel/",
+             "opentsdb_tpu/histogram/", "opentsdb_tpu/expression/")
+
+_MAX_FIXPOINT_ROUNDS = 8
+
+RET_ORIGIN = ("r",)          # "return value is request-derived" marker
+
+
+def _is_nested(fi) -> bool:
+    return ".<nested>." in fi.qname
+
+
+class _Summary:
+    __slots__ = ("unsan_params", "return_labels", "return_types",
+                 "param_types")
+
+    def __init__(self):
+        self.unsan_params: set[str] = set()
+        self.return_labels: set = set()      # ("p", name) | RET_ORIGIN
+        self.return_types: set[str] = set()
+        self.param_types: dict[str, set[str]] = {}
+
+    def snapshot(self):
+        return (frozenset(self.unsan_params),
+                frozenset(self.return_labels),
+                frozenset(self.return_types),
+                frozenset((k, frozenset(v))
+                          for k, v in self.param_types.items()))
+
+
+class _FnPass:
+    """One analysis pass over a function body (nested defs inlined)."""
+
+    def __init__(self, fi, graph, summaries, sink_dirs, final: bool,
+                 src_by_path=None):
+        self.fi = fi
+        self.graph = graph
+        self.summaries = summaries
+        self.final = final
+        self.src = (src_by_path or {}).get(fi.path)
+        self.in_sink_file = fi.path.startswith(sink_dirs) or any(
+            d in fi.path for d in sink_dirs)
+        self.labels: dict[str, set] = {}
+        self.types: dict[str, set[str]] = {}
+        self.origins: dict = {}              # label -> (line, desc)
+        self.findings: list[Finding] = []
+        self.summary: _Summary = summaries[fi.qname]
+        self.sanitizer_lines = self._collect_sanitizers()
+        self._seed()
+
+    # -- setup -----------------------------------------------------------
+
+    def _seed(self) -> None:
+        for p in self.fi.params:
+            self.labels[p] = {("p", p)}
+            ptypes = self.summary.param_types.get(p)
+            if ptypes:
+                self.types[p] = set(ptypes)
+        for a in (self.fi.node.args.posonlyargs + self.fi.node.args.args
+                  + self.fi.node.args.kwonlyargs):
+            ann = a.annotation
+            if isinstance(ann, ast.Name):
+                self.types.setdefault(a.arg, set()).add(ann.id)
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value,
+                                                              str):
+                self.types.setdefault(a.arg, set()).add(ann.value)
+        if self.fi.name in TELNET_FUNCS:
+            for p in self.fi.params:
+                if p in TELNET_PARAMS:
+                    lab = ("o", "telnet:" + p)
+                    self.origins[lab] = (self.fi.node.lineno,
+                                         "telnet request field %r" % p)
+                    self.labels[p] = self.labels.get(p, set()) | {lab}
+
+    def _collect_sanitizers(self) -> list[int]:
+        lines = []
+        for node in ast.walk(self.fi.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SANITIZER_CHARGE:
+                lines.append(node.lineno)
+            elif isinstance(node, ast.If) and self._is_limit_guard(node):
+                lines.append(node.lineno)
+        return sorted(lines)
+
+    @staticmethod
+    def _is_limit_guard(node: ast.If) -> bool:
+        """`if <test mentioning get_*_limit(...)>: ... raise ...`"""
+        has_getter = any(
+            isinstance(c, ast.Call) and isinstance(c.func, ast.Attribute)
+            and c.func.attr in SANITIZER_LIMIT_GETTERS
+            for c in ast.walk(node.test))
+        if not has_getter:
+            return False
+        return any(isinstance(s, ast.Raise)
+                   for b in node.body for s in ast.walk(b))
+
+    def _sanitized_before(self, line: int) -> bool:
+        return any(s < line for s in self.sanitizer_lines)
+
+    # -- label / type evaluation ----------------------------------------
+
+    def eval_types(self, e) -> set[str]:
+        if isinstance(e, ast.Name):
+            return self.types.get(e.id, set())
+        if isinstance(e, ast.IfExp):
+            return self.eval_types(e.body) | self.eval_types(e.orelse)
+        if isinstance(e, ast.Call):
+            out: set[str] = set()
+            for info, is_ctor, cls in self._resolve(e):
+                if is_ctor and cls:
+                    out.add(cls)
+                elif info is not None and not _is_nested(info):
+                    out |= self.summaries[info.qname].return_types
+            return out
+        return set()
+
+    def _resolve(self, call: ast.Call):
+        recv_types = None
+        if isinstance(call.func, ast.Attribute):
+            recv_types = self.eval_types(call.func.value)
+        targets = self.graph.resolve(call, self.fi, recv_types=recv_types)
+        return [(i, c, k) for i, c, k in targets
+                if i is None or i.qname in self.summaries or c]
+
+    def _map_args(self, call: ast.Call, info, is_ctor: bool):
+        """[(param_name, arg_expr)] for a resolved target."""
+        if info is None:
+            return []
+        params = info.params
+        recv = None
+        if isinstance(call.func, ast.Attribute) and info.is_method \
+                and not is_ctor:
+            recv = call.func.value
+        out = []
+        pos = iter(params)
+        if params and params[0] == "self":
+            next(pos, None)
+            if recv is not None:
+                out.append(("self", recv))
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                break
+            p = next(pos, None)
+            if p is None:
+                break
+            out.append((p, arg))
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in params:
+                out.append((kw.arg, kw.value))
+        return out
+
+    def eval_labels(self, e) -> set:
+        if e is None:
+            return set()
+        if isinstance(e, ast.Name):
+            return set(self.labels.get(e.id, ()))
+        if isinstance(e, ast.Attribute):
+            if e.attr.isupper():
+                return set()     # CLASS_CONSTANT on a tainted object
+            return self.eval_labels(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.eval_labels(e.value)
+        if isinstance(e, ast.Call):
+            return self._eval_call(e)
+        if isinstance(e, ast.BinOp):
+            return self.eval_labels(e.left) | self.eval_labels(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.eval_labels(e.operand)
+        if isinstance(e, ast.BoolOp):
+            out = set()
+            for v in e.values:
+                out |= self.eval_labels(v)
+            return out
+        if isinstance(e, ast.IfExp):
+            return self.eval_labels(e.body) | self.eval_labels(e.orelse)
+        if isinstance(e, ast.Compare):
+            return set()                      # booleans are not sizes
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for v in e.elts:
+                out |= self.eval_labels(v)
+            return out
+        if isinstance(e, ast.Dict):
+            out = set()
+            for v in list(e.keys) + list(e.values):
+                out |= self.eval_labels(v)
+            return out
+        if isinstance(e, ast.JoinedStr):
+            out = set()
+            for v in e.values:
+                out |= self.eval_labels(v)
+            return out
+        if isinstance(e, ast.FormattedValue):
+            return self.eval_labels(e.value)
+        if isinstance(e, ast.Starred):
+            return self.eval_labels(e.value)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            out = set()
+            for gen in e.generators:
+                out |= self.eval_labels(gen.iter)
+            if isinstance(e, ast.DictComp):
+                out |= self.eval_labels(e.key) | self.eval_labels(e.value)
+            else:
+                out |= self.eval_labels(e.elt)
+            return out
+        if isinstance(e, (ast.Constant, ast.Lambda)):
+            return set()
+        if isinstance(e, ast.NamedExpr):
+            return self.eval_labels(e.value)
+        if isinstance(e, ast.Slice):
+            return (self.eval_labels(e.lower) | self.eval_labels(e.upper)
+                    | self.eval_labels(e.step))
+        return set()
+
+    def _source_origin(self, call: ast.Call):
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        attr = f.attr
+        if attr not in SOURCE_ATTRS and not any(
+                attr.startswith(p) for p in SOURCE_ATTR_PREFIXES):
+            return None
+        arg = ""
+        if call.args and isinstance(call.args[0], ast.Constant):
+            arg = repr(call.args[0].value)
+        return "%s(%s)" % (attr, arg)
+
+    def _eval_call(self, call: ast.Call) -> set:
+        desc = self._source_origin(call)
+        if desc is not None:
+            lab = ("o", "src:%s" % desc)
+            self.origins[lab] = (call.lineno, "request field %s" % desc)
+            return {lab}
+        fname = call.func.id if isinstance(call.func, ast.Name) else None
+        if fname in CLEAN_CALLS:
+            return set()
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in CLEAN_ATTR_CALLS:
+            return set()
+        if fname == "min":
+            # a clamp only if something actually bounds it: any
+            # label-free argument caps the result; min() of exclusively
+            # request-derived values stays unbounded
+            per_arg = [self.eval_labels(a) for a in call.args]
+            if len(per_arg) >= 2 and any(not labs for labs in per_arg):
+                return set()
+            return set().union(*per_arg) if per_arg else set()
+        arg_labels = set()
+        for a in call.args:
+            arg_labels |= self.eval_labels(a)
+        for kw in call.keywords:
+            arg_labels |= self.eval_labels(kw.value)
+        if fname in PASSTHROUGH_CALLS:
+            return arg_labels
+        targets = self._resolve(call)
+        if not targets:
+            # unresolved: a method on tainted data stays tainted
+            # (text.split() of a tainted string); a method on an
+            # UNtainted object selects store-resident data — the args
+            # pick what to return, they don't make the result
+            # attacker-sized — so argument taint does not pass through.
+            # Free calls and module-alias calls keep arg passthrough.
+            if isinstance(call.func, ast.Attribute):
+                base = call.func.value
+                mod = self.graph.modules.get(self.fi.module)
+                if isinstance(base, ast.Name) and mod is not None \
+                        and base.id in mod.imports:
+                    return arg_labels
+                return self.eval_labels(base)
+            return arg_labels
+        out = set()
+        for info, is_ctor, cls in targets:
+            if is_ctor:
+                # tainted constructor args taint the instance
+                out |= arg_labels
+                if isinstance(call.func, ast.Attribute):
+                    out |= self.eval_labels(call.func.value)
+                continue
+            if info is None or _is_nested(info):
+                continue
+            summ = self.summaries[info.qname]
+            mapped = self._map_args(call, info, is_ctor)
+            for lab in summ.return_labels:
+                if lab == RET_ORIGIN:
+                    nlab = ("o", "ret:%s" % info.qname)
+                    self.origins[nlab] = (
+                        call.lineno,
+                        "request-derived result of %s()" % info.name)
+                    out.add(nlab)
+                elif lab[0] == "p":
+                    for p, arg in mapped:
+                        if p == lab[1]:
+                            out |= self.eval_labels(arg)
+        return out
+
+    # -- statement walk --------------------------------------------------
+
+    def run(self) -> None:
+        self.emit = False
+        for _ in range(_MAX_FIXPOINT_ROUNDS):
+            before = {k: set(v) for k, v in self.labels.items()}
+            tbefore = {k: set(v) for k, v in self.types.items()}
+            self._walk(self.fi.node.body, while_labels=set())
+            if before == self.labels and tbefore == self.types:
+                break
+        self.emit = self.final
+        self._walk(self.fi.node.body, while_labels=set())
+        self._update_summary()
+
+    def _assign_name(self, name: str, labs: set, typs: set[str]) -> None:
+        if labs:
+            self.labels[name] = self.labels.get(name, set()) | labs
+        if typs:
+            self.types[name] = self.types.get(name, set()) | typs
+
+    def _assign_target(self, tgt, labs: set, typs: set[str]) -> None:
+        if isinstance(tgt, ast.Name):
+            self._assign_name(tgt.id, labs, typs)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._assign_target(el, labs, set())
+        elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            # storing tainted data INTO an object taints the object
+            base = tgt.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name) and labs:
+                self._assign_name(base.id, labs, set())
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, labs, set())
+
+    def _walk(self, stmts, while_labels: set) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def: inline — closures share this label env
+                self._walk(st.body, while_labels)
+                continue
+            if isinstance(st, ast.ClassDef):
+                continue
+            if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = st.value
+                if value is None:
+                    continue
+                labs = self.eval_labels(value) | while_labels
+                typs = self.eval_types(value)
+                self._check_expr_sinks(value)
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                # the `n = min(n, cap)` clamp idiom is a STRONG update:
+                # the rebound name is laundered — but only when the cap
+                # side is itself label-free (min of two request-derived
+                # values bounds nothing).  Labels otherwise only ever
+                # grow, which is what makes the fixpoint sound.
+                if (len(targets) == 1 and isinstance(targets[0], ast.Name)
+                        and isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Name)
+                        and value.func.id == "min"
+                        and any(isinstance(a, ast.Name)
+                                and a.id == targets[0].id
+                                for a in value.args)
+                        and any(not self.eval_labels(a)
+                                for a in value.args
+                                if not (isinstance(a, ast.Name)
+                                        and a.id == targets[0].id))):
+                    self.labels[targets[0].id] = set()
+                    continue
+                for tgt in targets:
+                    self._assign_target(tgt, labs, typs)
+                continue
+            if isinstance(st, ast.Expr):
+                self.eval_labels(st.value)
+                self._check_expr_sinks(st.value)
+                continue
+            if isinstance(st, ast.Return):
+                if st.value is not None:
+                    labs = self.eval_labels(st.value) | while_labels
+                    self._note_return(labs, self.eval_types(st.value))
+                    self._check_expr_sinks(st.value)
+                continue
+            if isinstance(st, ast.For):
+                labs = self.eval_labels(st.iter) | while_labels
+                self._assign_target(st.target, labs, set())
+                self._check_loop_bound(st)
+                self._check_expr_sinks(st.iter)
+                self._walk(st.body, while_labels)
+                self._walk(st.orelse, while_labels)
+                continue
+            if isinstance(st, ast.While):
+                # control dependence: values computed under a tainted
+                # loop condition are sized by it (the pad_pow2 idiom).
+                # The condition is usually a Compare — whose VALUE is a
+                # clean bool — so the labels come from its operands.
+                cond = self._cond_labels(st.test)
+                self._check_expr_sinks(st.test)
+                self._walk(st.body, while_labels | cond)
+                self._walk(st.orelse, while_labels)
+                continue
+            if isinstance(st, ast.If):
+                self._check_expr_sinks(st.test)
+                self._walk(st.body, while_labels)
+                self._walk(st.orelse, while_labels)
+                continue
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    labs = self.eval_labels(item.context_expr)
+                    self._check_expr_sinks(item.context_expr)
+                    if item.optional_vars is not None:
+                        self._assign_target(item.optional_vars, labs,
+                                            set())
+                self._walk(st.body, while_labels)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk(st.body, while_labels)
+                for h in st.handlers:
+                    self._walk(h.body, while_labels)
+                self._walk(st.orelse, while_labels)
+                self._walk(st.finalbody, while_labels)
+                continue
+            if isinstance(st, (ast.Raise, ast.Assert)):
+                continue
+            # everything else (Pass, Break, Continue, Global, ...)
+
+    def _cond_labels(self, e) -> set:
+        out = set()
+        for node in ast.walk(e):
+            if isinstance(node, ast.Name):
+                out |= set(self.labels.get(node.id, ()))
+        return out
+
+    def _note_return(self, labs: set, typs: set[str]) -> None:
+        for lab in labs:
+            if lab[0] == "p":
+                self.summary.return_labels.add(lab)
+            else:
+                self.summary.return_labels.add(RET_ORIGIN)
+        self.summary.return_types |= typs
+
+    # -- sinks -----------------------------------------------------------
+
+    def _alloc_size_labels(self, call: ast.Call):
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr in ALLOC_FUNCS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ALLOC_MODULES):
+            return None
+        size_exprs = []
+        if f.attr == "arange":
+            size_exprs = list(call.args)
+        elif call.args:
+            size_exprs = [call.args[0]]
+        for kw in call.keywords:
+            if kw.arg == "shape":
+                size_exprs.append(kw.value)
+        labs = set()
+        for e in size_exprs:
+            labs |= self.eval_labels(e)
+        return ("%s.%s allocation" % (f.value.id, f.attr), labs,
+                call.lineno)
+
+    def _check_expr_sinks(self, e) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                if self.in_sink_file:
+                    hit = self._alloc_size_labels(node)
+                    if hit is not None:
+                        self._sink(*hit)
+                self._check_call_edge(node)
+            elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                            ast.Mult) \
+                    and self.in_sink_file:
+                if isinstance(node.left, ast.List):
+                    labs = self.eval_labels(node.right)
+                    self._sink("list preallocation", labs, node.lineno)
+                elif isinstance(node.right, ast.List):
+                    labs = self.eval_labels(node.left)
+                    self._sink("list preallocation", labs, node.lineno)
+
+    def _check_loop_bound(self, st: ast.For) -> None:
+        if not self.in_sink_file:
+            return
+        it = st.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id == "range":
+            labs = set()
+            for a in it.args:
+                labs |= self.eval_labels(a)
+            self._sink("range() loop bound", labs, st.lineno)
+
+    def _sink(self, what: str, labs: set, line: int) -> None:
+        if not labs or self._sanitized_before(line):
+            return
+        if self.src is not None and self.src.suppressed(line, RULE_TAINT):
+            # a justified suppression at the sink (e.g. "store-sized,
+            # bounded by resident data") clears the whole route — the
+            # summary must not keep poisoning callers
+            return
+        for lab in labs:
+            if lab[0] == "p":
+                self.summary.unsan_params.add(lab[1])
+            elif getattr(self, "emit", False):
+                _oline, desc = self.origins.get(lab, (line, "request data"))
+                self.findings.append(Finding(
+                    self.fi.path, line, RULE_TAINT,
+                    "%s in '%s' is sized by %s with no limits sanitizer "
+                    "on the route — charge a QueryBudget or clamp "
+                    "(min/limits.get_*_limit guard) before allocating"
+                    % (what, self.fi.name, desc)))
+
+    def _check_call_edge(self, call: ast.Call) -> None:
+        """Tainted arg passed to a callee whose param reaches a sink."""
+        targets = self._resolve(call)
+        if not targets:
+            return
+        for info, is_ctor, _cls in targets:
+            if info is None or _is_nested(info):
+                continue
+            summ = self.summaries[info.qname]
+            if not summ.unsan_params:
+                continue
+            for p, arg in self._map_args(call, info, is_ctor):
+                if p not in summ.unsan_params:
+                    continue
+                labs = self.eval_labels(arg)
+                if not labs or self._sanitized_before(call.lineno):
+                    continue
+                for lab in labs:
+                    if lab[0] == "p":
+                        self.summary.unsan_params.add(lab[1])
+                    elif getattr(self, "emit", False):
+                        _l, desc = self.origins.get(
+                            lab, (call.lineno, "request data"))
+                        self.findings.append(Finding(
+                            self.fi.path, call.lineno, RULE_TAINT,
+                            "%s flows from '%s' into '%s' parameter "
+                            "'%s', which reaches an allocation-size/"
+                            "loop-bound sink with no limits sanitizer "
+                            "on the route — charge a QueryBudget or "
+                            "clamp before the call"
+                            % (desc, self.fi.name, info.name, p)))
+
+    def _propagate_param_types(self) -> None:
+        for node in ast.walk(self.fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for info, is_ctor, _cls in self._resolve(node):
+                if info is None or is_ctor or _is_nested(info):
+                    continue
+                summ = self.summaries[info.qname]
+                for p, arg in self._map_args(node, info, is_ctor):
+                    typs = self.eval_types(arg)
+                    if typs:
+                        summ.param_types.setdefault(p, set()).update(typs)
+
+    def _update_summary(self) -> None:
+        self._propagate_param_types()
+
+
+def _analysis_functions(graph):
+    return [fi for fi in graph.funcs.values() if not _is_nested(fi)]
+
+
+def finish(ctx: LintContext) -> list[Finding]:
+    graph = get_callgraph(ctx)
+    bucket = ctx.bucket("taint")
+    sink_dirs = tuple(bucket.get("sink_paths", SINK_DIRS))
+    funcs = _analysis_functions(graph)
+    src_by_path = {src.path: src for src in ctx.files}
+    summaries = {fi.qname: _Summary() for fi in funcs}
+    for _ in range(_MAX_FIXPOINT_ROUNDS):
+        before = {q: s.snapshot() for q, s in summaries.items()}
+        for fi in funcs:
+            _FnPass(fi, graph, summaries, sink_dirs, final=False,
+                    src_by_path=src_by_path).run()
+        if before == {q: s.snapshot() for q, s in summaries.items()}:
+            break
+    findings: list[Finding] = []
+    for fi in funcs:
+        fp = _FnPass(fi, graph, summaries, sink_dirs, final=True,
+                     src_by_path=src_by_path)
+        fp.run()
+        findings.extend(fp.findings)
+    # dedupe identical (path, line, rule, message) — the emit walk can
+    # visit an expression more than once
+    return sorted(set(findings))
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    return []
+
+
+ANALYZER = Analyzer("taint", (RULE_TAINT,), check, finish)

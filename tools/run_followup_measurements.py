@@ -53,6 +53,10 @@ CANON = os.path.join(REPO, "BENCH_CONFIGS_r05.json")
 # the Aug 2 window lasted one stage): a retry resumes at the first stage
 # the previous attempt lost instead of re-measuring from the top.
 DONE_STATE = "/tmp/chip_followup.done"
+# Headroom on top of a stage's own run_stage timeout when deciding
+# whether it still fits before SESSION_DEADLINE_UNIX: result merge +
+# state write + process teardown.
+STAGE_WALL_MARGIN_S = 120
 
 
 def _load_done() -> set:
@@ -237,10 +241,18 @@ def main() -> None:
         # Cooperative session budget (tpu_watch.sh): stop STARTING
         # stages near the wall deadline instead of being SIGKILLed
         # mid-dispatch — that kill is the known tunnel-wedge mechanism.
+        # Gated on THIS stage's own run_stage timeout plus margin, not a
+        # flat 600s (ADVICE r5 low): a 3600s bench_prefix started 900s
+        # before the wall passes a flat check and then dies to the outer
+        # watchdog mid-dispatch; a 1200s profile in the same window is
+        # perfectly safe to start.
         wall_deadline = float(os.environ.get("SESSION_DEADLINE_UNIX", 0))
-        if wall_deadline and time.time() > wall_deadline - 600:
+        stage_budget = timeout + STAGE_WALL_MARGIN_S
+        if wall_deadline and time.time() > wall_deadline - stage_budget:
             results.append({"stage": name, "error":
-                            "skipped: session wall budget exhausted"})
+                            "skipped: session wall budget exhausted "
+                            "(stage needs %ds + %ds margin)"
+                            % (timeout, STAGE_WALL_MARGIN_S)})
             any_failed = True
             write_out()
             continue
