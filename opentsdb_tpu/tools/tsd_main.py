@@ -37,8 +37,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_tsdb_from_args(args) -> "TSDB":
-    from opentsdb_tpu.core import TSDB
+def make_config_from_args(args) -> "Config":
     from opentsdb_tpu.utils.config import Config
     config = Config()
     if args.config:
@@ -55,7 +54,62 @@ def make_tsdb_from_args(args) -> "TSDB":
         config.override_config("tsd.network.port", str(args.port))
     if args.bind:
         config.override_config("tsd.network.bind", args.bind)
+    return config
+
+
+def make_tsdb_from_args(args) -> "TSDB":
+    from opentsdb_tpu.core import TSDB
+    config = make_config_from_args(args)
+    # the sanitizer must arm BEFORE the TSDB exists: locks and classes
+    # constructed from here on get the instrumented wrappers
+    maybe_arm_sanitizer(config)
     return TSDB(config)
+
+
+def maybe_arm_sanitizer(config) -> bool:
+    """tsd.sanitizer.enable=true arms tsdbsan (tools/sanitize) for this
+    daemon: instrumented locks, write interception on lock-holding
+    classes, and the deadlock watchdog.  A chaos/testing surface (the
+    --san mode of tools/chaos_soak.py rides it); deployments without
+    the tools/ tree degrade LOUDLY to disarmed."""
+    if not config.get_bool("tsd.sanitizer.enable"):
+        return False
+    try:
+        from tools import sanitize
+    except ImportError:
+        logging.getLogger("tsd.sanitizer").warning(
+            "tsd.sanitizer.enable is set but tools.sanitize is not "
+            "importable (repo root not on sys.path?) — sanitizer "
+            "DISARMED")
+        return False
+    sanitize.install(
+        lockset=config.get_bool("tsd.sanitizer.lockset.enable"),
+        deadlock_watch=config.get_bool("tsd.sanitizer.deadlock.enable"),
+        jax=config.get_bool("tsd.sanitizer.jax.enable"),
+        watchdog_ms=config.get_int("tsd.sanitizer.deadlock.watchdog_ms"))
+    logging.getLogger("tsd.sanitizer").info("tsdbsan armed")
+    return True
+
+
+def write_sanitizer_report(config) -> None:
+    """At shutdown: finalize inversion detection and write the findings
+    artifact when tsd.sanitizer.report.path is set."""
+    path = config.get_string("tsd.sanitizer.report.path")
+    if not path:
+        return
+    try:
+        from tools import sanitize
+        from tools.sanitize import deadlock
+    except ImportError:
+        return
+    if not sanitize.installed():
+        return
+    deadlock.detect_inversions()
+    try:
+        sanitize.REPORTER.write_report(path)
+    except OSError as e:
+        logging.getLogger("tsd.sanitizer").warning(
+            "could not write sanitizer report to %s: %s", path, e)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -100,6 +154,7 @@ def main(argv: list[str] | None = None) -> int:
         asyncio.run(run())
     except KeyboardInterrupt:
         pass
+    write_sanitizer_report(tsdb.config)
     return 0
 
 

@@ -110,6 +110,31 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "str", "", "Fault-injection spec: inline JSON list or @path. "
         "A testing/chaos surface — NEVER arm in production.  Specs are "
         "validated against the registered hook sites at startup."),
+    # -- runtime sanitizer (tools/sanitize, armed by tsd_main) --------- #
+    "tsd.sanitizer.enable": _e(
+        "bool", False, "Arm the tsdbsan runtime sanitizer (instrumented "
+        "locks, write interception, deadlock watchdog) at daemon "
+        "startup.  A testing/chaos surface — adds per-write overhead; "
+        "never arm in production."),
+    "tsd.sanitizer.lockset.enable": _e(
+        "bool", True, "Lockset race detector: verify guarded-by "
+        "annotations at runtime and run Eraser-style lockset "
+        "intersection on unannotated shared attributes."),
+    "tsd.sanitizer.deadlock.enable": _e(
+        "bool", True, "Deadlock watcher: runtime lock-order graph, "
+        "inversion detection, and the live wait-for-cycle watchdog."),
+    "tsd.sanitizer.deadlock.watchdog_ms": _e(
+        "int", "200", "Wait-for-cycle watchdog scan period in ms "
+        "(0 disables the background thread; order-graph recording "
+        "stays on)."),
+    "tsd.sanitizer.jax.enable": _e(
+        "bool", False, "JAX compile/sync accounting in the daemon "
+        "(compile events per kernel; steady-phase gating is driven by "
+        "the test harness, not the daemon)."),
+    "tsd.sanitizer.report.path": _e(
+        "str", "", "Write the sanitizer findings report here at "
+        "daemon shutdown (JSON, or SARIF when the path ends in "
+        ".sarif).  Empty = no report artifact."),
     # -- core ---------------------------------------------------------- #
     "tsd.core.authentication.enable": _e(
         "bool", False, "Require telnet/HTTP authentication."),

@@ -22,6 +22,18 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_pytest_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
+import sys  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# TSDBSAN=1 arms the runtime sanitizer (tools/sanitize) for the whole
+# session: instrumented locks + write interception + deadlock watchdog.
+# The plugin fails the session on error-level findings.
+if os.environ.get("TSDBSAN", "") == "1":
+    pytest_plugins = ["tools.sanitize.plugin"]
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

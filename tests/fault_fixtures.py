@@ -174,5 +174,10 @@ def series_payload(metric: str, tags: dict, dps: dict) -> list[dict]:
 
 def force_cooldown_elapsed(breaker) -> None:
     """Rewind an OPEN breaker's clock so its next allow() is the
-    half-open probe — cooldown transitions without wall-clock sleeps."""
-    breaker.opened_at -= breaker.cooldown_s + 1e-3
+    half-open probe — cooldown transitions without wall-clock sleeps.
+    `opened_at` is guarded-by `_lock`; the responder pool may be
+    fetching (and the breaker transitioning) concurrently, so the
+    rewind takes the lock like every other writer — tsdbsan flagged
+    the previous lockless form (san-unguarded-mutation)."""
+    with breaker._lock:
+        breaker.opened_at -= breaker.cooldown_s + 1e-3

@@ -1,0 +1,48 @@
+# tsdbsan seeded fixture: TRUE NEGATIVES for the deadlock watcher.
+# Sanctioned locking shapes that must come back CLEAN:
+#
+#   * a consistent two-lock order used repeatedly (Outer before Inner,
+#     every time) — edges exist but no cycle;
+#   * two instances of the SAME class acquired nested in a consistent
+#     instance order — the canonical-order peer idiom; a same-label
+#     edge only becomes an inversion when BOTH orders are observed;
+#   * reentrant RLock re-acquired by its owner — not a self-deadlock.
+
+import threading
+
+
+class Outer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class Peer:
+    def __init__(self):
+        self._plock = threading.RLock()
+
+
+def run():
+    outer = Outer()
+    inner = Inner()
+    for _ in range(3):
+        with outer._lock:
+            with inner._lock:
+                pass
+    # peers in one canonical order only
+    first, second = Peer(), Peer()
+    with first._plock:
+        with second._plock:
+            pass
+    with first._plock:
+        with second._plock:
+            pass
+    # reentrant self re-acquire is sanctioned
+    with first._plock:
+        with first._plock:
+            pass
+    return outer, inner, first, second

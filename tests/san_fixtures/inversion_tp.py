@@ -1,0 +1,32 @@
+# tsdbsan seeded-bug fixture: TRUE POSITIVE for the deadlock watcher's
+# order-graph detector.
+#
+# The two `with` blocks below acquire (Left._lock, Right._lock) in BOTH
+# orders — serialized, so nothing actually deadlocks this run, which is
+# exactly the point: the inversion is a latent hazard the order graph
+# catches without needing the fatal interleaving.  The finding lands on
+# the acquire that closes the cycle.
+
+import threading
+
+
+class Left:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class Right:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+def run():
+    left = Left()
+    right = Right()
+    with left._lock:
+        with right._lock:
+            pass
+    with right._lock:
+        with left._lock:  # EXPECT: san-lock-order-inversion
+            pass
+    return left, right

@@ -1,0 +1,56 @@
+# tsdbsan seeded fixture: TRUE NEGATIVES for the lockset detector.
+# Every pattern here is a sanctioned form and must come back CLEAN:
+#
+#   * annotated attribute always mutated under its declared lock;
+#   * unannotated attribute written by several threads but ALWAYS under
+#     the same lock (non-empty lockset — annotate it eventually, but it
+#     is not racing);
+#   * construct-then-hand-off: the worker thread becomes the sole
+#     writer after __init__ — the classic Eraser false positive the
+#     ownership-handoff state machine must stay silent on;
+#   * a deliberately racy write carrying a justified
+#     `# tsdblint: disable=` suppression — the shared suppression
+#     syntax must clear sanitizer findings exactly as it clears lint's.
+
+import threading
+
+
+class DisciplinedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: _lock
+        self.approx = 0     # unannotated, but always written under _lock
+        self.handoff = 0    # written only by the worker after __init__
+        self.noisy = 0      # racy on purpose; suppressed below
+
+    def bump(self):
+        with self._lock:
+            self.total += 1
+            self.approx += 1
+
+    def worker_only(self):
+        self.handoff += 1
+
+    def suppressed_racy(self):
+        # fixture-only: proves tsdbsan honors the shared suppression form
+        self.noisy += 1  # tsdblint: disable=san-lockset-race
+
+
+def run():
+    c = DisciplinedCounter()
+    c.bump()
+    t = threading.Thread(target=c.bump)
+    t.start()
+    t.join()
+    # hand-off: only the worker writes `handoff` post-construction
+    t2 = threading.Thread(target=c.worker_only)
+    t2.start()
+    t2.join()
+    # suppressed race: main + worker + main again, no lock — would be a
+    # san-lockset-race without the inline disable
+    c.suppressed_racy()
+    t3 = threading.Thread(target=c.suppressed_racy)
+    t3.start()
+    t3.join()
+    c.suppressed_racy()
+    return c

@@ -1,0 +1,51 @@
+# tsdbsan seeded-bug fixture: TRUE POSITIVES for the lockset detector.
+#
+# Driven by tests/test_sanitizer.py, which instruments this module,
+# runs `run()`, and asserts the findings land EXACTLY on the
+# `# EXPECT:` lines below (the lint fixture convention).
+#
+# Two seeded bugs:
+#   * `guarded_total` carries a `# guarded-by:` annotation but
+#     `unguarded_bump` mutates it without the lock — the runtime twin
+#     of tsdblint's lock-unguarded-mutation, caught even though the
+#     static analyzer was never shown this file.
+#   * `free_total` has NO annotation and is written by two threads with
+#     no common lock — Eraser lockset intersection goes empty after the
+#     original writer returns post-handoff, which static lint cannot
+#     see at all.
+
+import threading
+
+
+class RacyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.guarded_total = 0  # guarded-by: _lock
+        self.free_total = 0     # deliberately unannotated shared state
+
+    def locked_bump(self):
+        with self._lock:
+            self.guarded_total += 1
+
+    def unguarded_bump(self):
+        self.guarded_total += 1  # EXPECT: san-unguarded-mutation
+
+    def free_bump(self):
+        self.free_total += 1  # EXPECT: san-lockset-race
+
+
+def run():
+    c = RacyCounter()
+    c.locked_bump()
+    # a second thread mutates the annotated attribute with no lock held
+    t = threading.Thread(target=c.unguarded_bump)
+    t.start()
+    t.join()
+    # Eraser: main writes, a worker writes (handoff — still silent),
+    # then main writes AGAIN -> two shared-state writers, empty lockset
+    c.free_bump()
+    t2 = threading.Thread(target=c.free_bump)
+    t2.start()
+    t2.join()
+    c.free_bump()
+    return c

@@ -1,0 +1,27 @@
+# tsdbsan seeded fixture: TRUE NEGATIVE for the JAX compile sanitizer.
+#
+# The sanctioned builder shape: the jit wrapper is constructed once
+# under functools.lru_cache (the fix pattern from parallel/sharded.py),
+# so steady-state calls are pure cache hits — zero compiles, zero
+# findings.
+
+from functools import lru_cache
+
+import jax
+
+
+def _triple(v):
+    return v * 3
+
+
+@lru_cache(maxsize=None)
+def _jitted_triple():
+    return jax.jit(_triple)
+
+
+def cached_kernel(x):
+    return _jitted_triple()(x)
+
+
+def run(x):
+    return cached_kernel(x)

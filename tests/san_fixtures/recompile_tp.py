@@ -1,0 +1,24 @@
+# tsdbsan seeded-bug fixture: TRUE POSITIVE for the JAX compile
+# sanitizer.
+#
+# `per_call_kernel` closes over a FRESH inner function and jits it on
+# every invocation — the exact bug shape tsdblint's jax-jit-per-call
+# rule catches statically (and PR 2 fixed in parallel/sharded.py, where
+# each rollup pass built a fresh shard_map closure).  A fresh function
+# object per call defeats every jit cache, so the kernel re-traces and
+# recompiles in the steady phase; the sanitizer attributes the finding
+# to the triggering call line.
+
+import jax
+
+
+def per_call_kernel(x):
+    def _double(v):              # fresh closure -> fresh jit cache key
+        return v * 2 + 1
+
+    step = jax.jit(_double)
+    return step(x)  # EXPECT: san-recompile-after-warmup
+
+
+def run(x):
+    return per_call_kernel(x)

@@ -1,0 +1,452 @@
+"""tsdbsan unit tests: seeded-bug fixtures, cross-check, SARIF.
+
+Mirrors the lint fixture convention (tests/test_lint_analyzers.py):
+every true-positive fixture line under tests/san_fixtures/ carries an
+`# EXPECT: <rule>` marker and the tests assert the detector fires
+EXACTLY those (line, rule) pairs; true-negative fixtures must come back
+empty.  The corpus seeds one deliberate bug per detector:
+
+    race_tp / race_tn            lockset detector (annotated +
+                                 Eraser-on-unannotated, handoff TN,
+                                 suppression TN)
+    inversion_tp / inversion_tn  order-graph inversion detector
+    recompile_tp / recompile_tn  JAX compile sanitizer (per-call jit
+                                 TP, lru_cache builder TN)
+
+CPU-only (conftest pins JAX_PLATFORMS=cpu); nothing here touches mesh
+or shard_map paths, which fail at HEAD in this environment.
+
+Works standalone AND under a TSDBSAN=1 session: when the pytest plugin
+already installed the sanitizer these tests borrow it, snapshotting and
+restoring the global reporter + order-graph state so deliberate fixture
+bugs never leak into the session's own verdict.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import tools.sanitize as sanitize  # noqa: E402
+from tools.sanitize import deadlock, lockset  # noqa: E402
+from tools.sanitize.jax_san import JaxSanitizer  # noqa: E402
+from tools.sanitize.locks import SanLockBase  # noqa: E402
+from tools.sanitize.report import REPORTER  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "san_fixtures")
+
+_EXPECT = re.compile(r"#\s*EXPECT:\s*([a-z0-9-]+)")
+
+
+@pytest.fixture(scope="module")
+def san():
+    """The installed sanitizer — ours if no TSDBSAN=1 plugin armed it.
+    Global reporter/graph state is snapshotted and restored so the
+    deliberate fixture bugs stay invisible to the enclosing session."""
+    owned = not sanitize.installed()
+    if owned:
+        sanitize.install(extra_lock_prefixes=("san_fixtures",))
+    saved_findings = REPORTER.raw_findings()
+    saved_graph = deadlock.snapshot_state()
+    yield sanitize
+    REPORTER.clear()
+    REPORTER.restore(saved_findings)
+    deadlock.restore_state(saved_graph)
+    if owned:
+        sanitize.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _isolated(san):
+    REPORTER.clear()
+    deadlock.reset()
+    yield
+
+
+def _load_fixture(name: str):
+    """Import tests/san_fixtures/<name>.py as `san_fixtures.<name>`
+    (the dotted prefix the lock-factory scoping matches) and instrument
+    its classes."""
+    modname = "san_fixtures." + name
+    sys.modules.pop(modname, None)
+    path = os.path.join(FIXTURES, name + ".py")
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    sanitize.instrument_module(mod)
+    return mod
+
+
+def _expected(name: str) -> set[tuple[int, str]]:
+    out = set()
+    with open(os.path.join(FIXTURES, name + ".py"),
+              encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = _EXPECT.search(line)
+            if m:
+                out.add((i, m.group(1)))
+    return out
+
+
+def _findings(name: str) -> set[tuple[int, str]]:
+    rel = "tests/san_fixtures/%s.py" % name
+    return {(f.line, f.rule) for f in REPORTER.findings()
+            if f.path == rel}
+
+
+# --------------------------------------------------------------------- #
+# Lockset detector                                                      #
+# --------------------------------------------------------------------- #
+
+class TestLockset:
+    def test_race_tp_fires_exactly_the_expected_lines(self, san):
+        mod = _load_fixture("race_tp")
+        mod.run()
+        expected = _expected("race_tp")
+        assert expected, "race_tp declares no EXPECT markers"
+        got = _findings("race_tp")
+        assert got == expected, (
+            "missed: %s, extra: %s" % (expected - got, got - expected))
+
+    def test_race_tn_stays_clean(self, san):
+        mod = _load_fixture("race_tn")
+        mod.run()
+        assert _findings("race_tn") == set(), [
+            f.render() for f in REPORTER.findings()]
+
+    def test_race_tn_suppression_is_load_bearing(self, san):
+        """The `# tsdblint: disable=san-lockset-race` in race_tn hides
+        a REAL detection — remove the suppression filter and the racy
+        write reports.  Guards against the TN passing because the
+        detector went blind."""
+        mod = _load_fixture("race_tn")
+        mod.run()
+        raw = {(f.line, f.rule)
+               for f in REPORTER.findings(apply_suppressions=False)
+               if f.path == "tests/san_fixtures/race_tn.py"}
+        assert any(rule == "san-lockset-race" for _ln, rule in raw), raw
+
+    def test_fixture_locks_are_instrumented(self, san):
+        mod = _load_fixture("race_tp")
+        c = mod.RacyCounter()
+        assert isinstance(c._lock, SanLockBase)
+        assert c._lock.label == ("RacyCounter", "_lock")
+
+    def test_locks_outside_sanitized_packages_stay_real(self, san):
+        lock = threading.Lock()      # this module is not sanitized
+        assert not isinstance(lock, SanLockBase)
+
+    def test_release_clears_ownership_before_freeing_the_real_lock(
+            self, san):
+        """Regression (review finding): release() used to free the real
+        lock FIRST and update owner/count after — a waiter acquiring in
+        that window had its fresh ownership clobbered, seeding false
+        unguarded-mutation findings under contention.  A stub inner
+        lock observes the wrapper's state at the exact instant the real
+        lock frees: it must already be cleared."""
+        from tools.sanitize.locks import SanLock
+        lock = SanLock()
+        seen_at_release = []
+
+        class StubInner:
+            def acquire(self, blocking=True, timeout=-1):
+                return True
+
+            def release(self):
+                # the moment a real waiter could win the lock
+                seen_at_release.append((lock.owner, lock.count))
+
+        lock.acquire()
+        lock._inner = StubInner()
+        lock.release()
+        assert seen_at_release == [(None, 0)], seen_at_release
+
+    def test_id_reuse_does_not_inherit_stale_eraser_state(self, san):
+        """Regression (review finding): __slots__ classes without
+        __weakref__ (Series!) use the id-keyed state fallback; CPython
+        reuses a freed instance's address, so a new object could
+        inherit a dead one's SHARED Eraser state and report a false
+        race on its very first writes.  instrument_class now purges the
+        id entry at __init__."""
+        from tools.lint.annotations import ClassAnnotations
+        from tools.sanitize.locks import SanLock
+
+        class Slotted:
+            __slots__ = ("_lock", "n")
+
+            def __init__(self):
+                self._lock = SanLock()
+                self.n = 0
+
+        ann = ClassAnnotations("Slotted", "tests/test_sanitizer.py", 1)
+        ann.locks["_lock"] = "Lock"
+        assert lockset.instrument_class(Slotted, ann)
+        try:
+            for _ in range(64):
+                a = Slotted()
+                # drive a's `n` into SHARED state (unreported: only the
+                # worker wrote post-handoff)
+                a.n = 1
+                t = threading.Thread(target=setattr, args=(a, "n", 2))
+                t.start()
+                t.join()
+                dead_id = id(a)
+                del a
+                b = Slotted()
+                if id(b) != dead_id:
+                    del b
+                    continue
+                # address reused: without the purge, b would inherit
+                # a's SHARED/empty-lockset state and this single-thread
+                # write would close the false race
+                REPORTER.clear()
+                b.n = 5
+                racy = [f.render() for f in REPORTER.raw_findings()
+                        if "Slotted.n" in f.message]
+                assert racy == [], racy
+                return
+            pytest.skip("CPython never reused the freed id")
+        finally:
+            lockset.uninstrument_class(Slotted)
+
+
+# --------------------------------------------------------------------- #
+# Deadlock watcher                                                      #
+# --------------------------------------------------------------------- #
+
+class TestDeadlockWatcher:
+    def test_inversion_tp_fires_exactly_the_expected_lines(self, san):
+        mod = _load_fixture("inversion_tp")
+        mod.run()
+        deadlock.detect_inversions()
+        expected = _expected("inversion_tp")
+        assert expected
+        got = _findings("inversion_tp")
+        assert got == expected, (
+            "missed: %s, extra: %s" % (expected - got, got - expected))
+
+    def test_inversion_tn_stays_clean(self, san):
+        mod = _load_fixture("inversion_tn")
+        mod.run()
+        deadlock.detect_inversions()
+        assert _findings("inversion_tn") == set(), [
+            f.render() for f in REPORTER.findings()]
+
+    def test_live_deadlock_wait_for_cycle(self, san):
+        mod = _load_fixture("inversion_tp")
+        left, right = mod.Left(), mod.Right()
+        ev_l, ev_r = threading.Event(), threading.Event()
+
+        def hold_left():
+            with left._lock:
+                ev_l.set()
+                ev_r.wait(2)
+                got = right._lock.acquire(timeout=1.0)
+                if got:
+                    right._lock.release()
+
+        def hold_right():
+            with right._lock:
+                ev_r.set()
+                ev_l.wait(2)
+                got = left._lock.acquire(timeout=1.0)
+                if got:
+                    left._lock.release()
+
+        t1 = threading.Thread(target=hold_left)
+        t2 = threading.Thread(target=hold_right)
+        t1.start()
+        t2.start()
+        ev_l.wait(2)
+        ev_r.wait(2)
+        import time
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            deadlock.scan_waiting_now()
+            if any(f.rule == "san-deadlock"
+                   for f in REPORTER.raw_findings()):
+                break
+            time.sleep(0.02)
+        t1.join()
+        t2.join()
+        rules = {f.rule for f in REPORTER.raw_findings()}
+        assert "san-deadlock" in rules, rules
+
+    def test_nonreentrant_self_reacquire_reports(self, san):
+        mod = _load_fixture("inversion_tp")
+        left = mod.Left()
+        left._lock.acquire()
+        try:
+            assert left._lock.acquire(timeout=0.05) is False
+        finally:
+            left._lock.release()
+        rules = {f.rule for f in REPORTER.raw_findings()}
+        assert "san-deadlock" in rules, rules
+
+
+# --------------------------------------------------------------------- #
+# JAX compile sanitizer                                                 #
+# --------------------------------------------------------------------- #
+
+class TestJaxSanitizer:
+    def _run_phases(self, name):
+        import jax.numpy as jnp
+        mod = _load_fixture(name)
+        jsan = JaxSanitizer()
+        jsan.start()
+        try:
+            x = jnp.ones(16)
+            mod.run(x)           # warmup: compiles are expected
+            jsan.mark_steady()
+            mod.run(x)           # steady: any compile is a finding
+        finally:
+            jsan.stop()
+        return jsan
+
+    def test_per_call_jit_recompiles_in_steady_state(self, san):
+        self._run_phases("recompile_tp")
+        expected = _expected("recompile_tp")
+        assert expected
+        got = _findings("recompile_tp")
+        assert got == expected, (
+            "missed: %s, extra: %s" % (expected - got, got - expected))
+
+    def test_lru_cached_builder_stays_clean(self, san):
+        jsan = self._run_phases("recompile_tn")
+        assert _findings("recompile_tn") == set(), [
+            f.render() for f in REPORTER.findings()]
+        # and the cache genuinely absorbed the steady call
+        assert all(v["steady"] == 0 for v in jsan.compiles.values()), \
+            jsan.compiles
+
+
+# --------------------------------------------------------------------- #
+# Static <-> dynamic cross-check                                        #
+# --------------------------------------------------------------------- #
+
+class TestCrossCheck:
+    def test_static_graph_extraction_is_deterministic(self):
+        a = deadlock.static_edges_with_sites()
+        b = deadlock.static_edges_with_sites()
+        assert a == b
+        assert a, "the package should have at least one static edge"
+
+    def test_diff_classifies_stale_and_gap_edges(self):
+        static = {(("A", "_l"), ("B", "_m")): ("opentsdb_tpu/a.py", 10),
+                  (("B", "_m"), ("C", "_n")): ("opentsdb_tpu/b.py", 20)}
+        observed = {(("B", "_m"), ("C", "_n")): ("x.py", 5),
+                    (("C", "_n"), ("D", "_o")): ("y.py", 7)}
+        from tools.sanitize.report import SanReporter
+        rep = SanReporter()
+        diff = deadlock.cross_check(static_edges=static,
+                                    observed=observed, reporter=rep)
+        assert diff["stale"] == [(("A", "_l"), ("B", "_m"))]
+        assert diff["gaps"] == [(("C", "_n"), ("D", "_o"))]
+        rules = sorted((f.rule, f.path) for f in rep.raw_findings())
+        assert rules == [("san-lint-gap", "y.py"),
+                         ("san-stale-static-edge", "opentsdb_tpu/a.py")]
+        # deterministic: a second pass reproduces the same findings
+        rep2 = SanReporter()
+        deadlock.cross_check(static_edges=static, observed=observed,
+                             reporter=rep2)
+        assert rep2.raw_findings() == rep.raw_findings()
+
+    def test_observed_graph_round_trips_through_disk(self, tmp_path,
+                                                     san):
+        mod = _load_fixture("inversion_tn")
+        mod.run()
+        path = str(tmp_path / "observed.json")
+        deadlock.save_observed(path)
+        loaded = deadlock.load_observed(path)
+        assert loaded == deadlock.observed_edges()
+
+    def test_cross_check_notes_never_gate(self):
+        from tools.sanitize.report import SanReporter, rule_level
+        rep = SanReporter()
+        deadlock.cross_check(
+            static_edges={(("A", "_l"), ("B", "_m")): ("a.py", 1)},
+            observed={}, reporter=rep)
+        assert rep.raw_findings()
+        assert all(rule_level(f.rule) == "note"
+                   for f in rep.raw_findings())
+
+
+# --------------------------------------------------------------------- #
+# SARIF + shared grammar                                                #
+# --------------------------------------------------------------------- #
+
+class TestArtifacts:
+    def test_sarif_output_validates_against_the_same_schema_as_lint(
+            self, san):
+        import jsonschema
+        from tests.test_lint_analyzers import SARIF_SUBSET_SCHEMA
+        mod = _load_fixture("race_tp")
+        mod.run()
+        deadlock.cross_check(
+            static_edges={(("Z", "_l"), ("Q", "_m")): ("z.py", 3)},
+            observed={})
+        doc = REPORTER.to_sarif()
+        jsonschema.validate(doc, SARIF_SUBSET_SCHEMA)
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "tsdbsan"
+        levels = {r["level"] for r in run["results"]}
+        assert "error" in levels and "note" in levels
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"san-lockset-race", "san-deadlock",
+                "san-recompile-after-warmup"} <= rule_ids
+
+    def test_report_json_written(self, tmp_path, san):
+        mod = _load_fixture("race_tp")
+        mod.run()
+        path = str(tmp_path / "findings.json")
+        REPORTER.write_report(path)
+        import json
+        payload = json.loads(open(path).read())
+        assert any(e["rule"] == "san-lockset-race" for e in payload)
+        assert all(set(e) == {"path", "line", "rule", "level", "message"}
+                   for e in payload)
+
+    def test_force_cooldown_helper_holds_the_breaker_lock(self, san):
+        """Regression for the true positive tsdbsan surfaced on the
+        sanitized tier-1 subset: tests/fault_fixtures.py's
+        force_cooldown_elapsed rewound CircuitBreaker.opened_at
+        (guarded-by _lock) WITHOUT the lock while responder threads can
+        transition the breaker concurrently.  This test reproduces the
+        exact multi-thread access shape and asserts the helper now
+        mutates under the lock — it fails pre-fix under TSDBSAN=1."""
+        from opentsdb_tpu.tsd.cluster import CircuitBreaker
+        from tests.fault_fixtures import force_cooldown_elapsed
+        breaker = CircuitBreaker(threshold=1, cooldown_s=30.0)
+        # open it from a worker thread (so the instance is genuinely
+        # shared and the pre-publication exemption does not apply)
+        t = threading.Thread(target=breaker.record_failure)
+        t.start()
+        t.join()
+        assert breaker.state == CircuitBreaker.OPEN
+        force_cooldown_elapsed(breaker)
+        assert breaker.allow()      # the probe path still works
+        offending = [f.render() for f in REPORTER.raw_findings()
+                     if f.rule == "san-unguarded-mutation"
+                     and "opened_at" in f.message]
+        assert offending == [], offending
+
+    def test_lint_and_sanitizer_share_one_annotation_grammar(self):
+        """The satellite contract: both layers parse guarded-by through
+        tools/lint/annotations.py, so the fixture file reads back the
+        same locks/annotations the lint analyzer would see."""
+        from tools.lint.annotations import scan_module_file
+        anns = scan_module_file(os.path.join(FIXTURES, "race_tp.py"))
+        racy = anns["RacyCounter"]
+        assert racy.locks == {"_lock": "Lock"}
+        assert racy.guarded == {"guarded_total": "_lock"}
+        assert "free_total" not in racy.guarded

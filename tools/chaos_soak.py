@@ -56,12 +56,23 @@ def wait_port(port, timeout=60):
     return False
 
 
-def spawn_tsd(port, extra_cfg: dict):
+SAN_REPORTS: list = []      # (role, path) of every armed TSD's report
+
+
+def spawn_tsd(port, extra_cfg: dict, san: bool = False, role: str = "tsd"):
     import tempfile
     conf_dir = tempfile.mkdtemp(prefix="chaos_soak_")
     cfg = os.path.join(conf_dir, "tsd.conf")
     with open(cfg, "w") as fh:
         fh.write("tsd.core.auto_create_metrics = true\n")
+        if san:
+            # --san: the daemon self-instruments (tsdbsan lockset +
+            # deadlock detectors) and dumps its findings at SIGTERM —
+            # fault-injection rounds double as a race check
+            report = os.path.join(conf_dir, "tsdbsan_report.json")
+            SAN_REPORTS.append((role, report))
+            fh.write("tsd.sanitizer.enable = true\n")
+            fh.write("tsd.sanitizer.report.path = %s\n" % report)
         for k, v in extra_cfg.items():
             fh.write("%s = %s\n" % (k, v))
     env = dict(os.environ)
@@ -227,7 +238,7 @@ def classify(payload):
 
 
 def run_phase(mode: str, rounds: int, rng, peer_port: int,
-              recv_port: int) -> dict:
+              recv_port: int, san: bool = False) -> dict:
     proxy = FaultProxy(peer_port)
     recv = spawn_tsd(recv_port, {
         "tsd.network.cluster.peers": "127.0.0.1:%d" % proxy.port,
@@ -236,7 +247,7 @@ def run_phase(mode: str, rounds: int, rng, peer_port: int,
         "tsd.network.cluster.breaker.threshold": "3",
         "tsd.network.cluster.breaker.cooldown_ms": "800",
         "tsd.network.cluster.partial_results": mode,
-    })
+    }, san=san, role="receiver-%s" % mode)
     tally = {"full": 0, "partial": 0, "5xx": 0}
     try:
         seed_host(recv_port, "local", 1)
@@ -283,26 +294,59 @@ def run_phase(mode: str, rounds: int, rng, peer_port: int,
     return tally
 
 
+def check_san_reports() -> int:
+    """Error-level tsdbsan findings across every armed TSD's shutdown
+    report.  Missing report = the daemon died before writing it — also
+    a failure (a crashed sanitized TSD must not read as clean)."""
+    bad = 0
+    for role, path in SAN_REPORTS:
+        if not os.path.exists(path):
+            print("[san] %s: report %s missing — daemon did not shut "
+                  "down cleanly" % (role, path), flush=True)
+            bad += 1
+            continue
+        with open(path) as fh:
+            findings = json.load(fh)
+        errors = [f for f in findings if f.get("level") == "error"]
+        for f in errors:
+            print("[san] %s: %s:%d [%s] %s"
+                  % (role, f["path"], f["line"], f["rule"],
+                     f["message"]), flush=True)
+        bad += len(errors)
+        notes = len(findings) - len(errors)
+        print("[san] %s: %d error(s), %d note(s)"
+              % (role, len(errors), notes), flush=True)
+    return bad
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=25)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--port", type=int, default=14261)
+    ap.add_argument("--san", action="store_true",
+                    help="arm tsdbsan in every spawned TSD and fail on "
+                         "error-level race/inversion findings")
     args = ap.parse_args()
     rng = random.Random(args.seed)
-    peer = spawn_tsd(args.port, {})
+    peer = spawn_tsd(args.port, {}, san=args.san, role="peer")
     try:
         seed_host(args.port, "remote", 2)
         for mode in ("allow", "error"):
             tally = run_phase(mode, args.rounds, rng, args.port,
-                              args.port + 1)
+                              args.port + 1, san=args.san)
             print("[%s] %d rounds OK: %s (healed to full)"
                   % (mode, args.rounds, tally), flush=True)
     finally:
         peer.send_signal(signal.SIGTERM)
         peer.wait()
+    if args.san and check_san_reports():
+        print("chaos soak FAILED: tsdbsan found races/inversions under "
+              "fault injection", flush=True)
+        raise SystemExit(1)
     print("chaos soak PASSED: no 500s in allow mode, no wrong answers "
-          "in error mode", flush=True)
+          "in error mode%s"
+          % (" (tsdbsan clean)" if args.san else ""), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,169 @@
+"""Shared grammar for lock annotations — the single parser both layers use.
+
+`# guarded-by: <lock>` comments and the lock-attribute declaration idiom
+(`self._lock = threading.Lock()`) are contracts consumed twice: statically
+by tools/lint/lock_discipline.py (annotation presence + unguarded
+mutations + lock-order cycles) and dynamically by tools/sanitize/ (the
+tsdbsan lockset race detector verifies at runtime that every annotated
+mutation actually holds its declared lock).  Keeping one grammar here
+means the two layers cannot drift: a comment form the linter accepts is
+exactly the form the sanitizer enforces.
+
+Annotation placement (mirrored by `annotation_for_line`):
+
+  * inline on the declaration line:
+        self.n = 0  # guarded-by: _lock
+  * a standalone comment above a contiguous block of PLAIN declarations:
+        # guarded-by: _lock
+        self.a = 0
+        self.b = {}
+    A declaration carrying its own trailing comment ends the block — a
+    standalone guarded-by comment only reaches declarations that visibly
+    opted in by staying bare, never silently past an annotated/documented
+    neighbor.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+LOCK_CTORS = {"Lock", "RLock"}
+
+_PLAIN_DECL = re.compile(r"self\.[A-Za-z_][A-Za-z0-9_]*\s*(:[^=]+)?=")
+
+
+def lock_ctor_kind(node: ast.expr) -> str | None:
+    """'Lock' / 'RLock' when `node` is threading.Lock()/RLock() (or a
+    bare Lock()/RLock() import)."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_CTORS:
+        name = f.attr
+    elif isinstance(f, ast.Name) and f.id in LOCK_CTORS:
+        name = f.id
+    return name
+
+
+def self_attr(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def annotation_for_line(lines: list[str], lineno: int) -> str | None:
+    """Inline `# guarded-by:` on `lineno` (1-based), or a comment above
+    covering a contiguous block of plain declarations."""
+    m = GUARDED_BY.search(lines[lineno - 1])
+    if m:
+        return m.group(1)
+    i = lineno - 2          # 0-based index of the line above
+    while i >= 0:
+        text = lines[i].strip()
+        if not text:
+            return None
+        if text.startswith("#"):
+            m = GUARDED_BY.search(text)
+            if m:
+                return m.group(1)
+            i -= 1
+            continue
+        # a bare declaration line continues the block; a commented one
+        # (it has its own annotation story) or anything else ends it
+        if "#" not in text and _PLAIN_DECL.match(text):
+            i -= 1
+            continue
+        return None
+    return None
+
+
+class ClassAnnotations:
+    """The annotation-facing view of one class: its lock attributes,
+    guarded-by declarations, first declaration lines, and inferred
+    attribute types (for cross-class lock-order resolution)."""
+
+    def __init__(self, name: str, path: str, lineno: int):
+        self.name = name
+        self.path = path
+        self.lineno = lineno
+        self.locks: dict[str, str] = {}          # lock attr -> Lock|RLock
+        self.annotations: dict[str, tuple[str, int]] = {}  # attr -> (lock, ln)
+        self.init_lines: dict[str, int] = {}     # attr -> first decl line
+        self.attr_types: dict[str, str] = {}     # self.attr -> ClassName
+
+    @property
+    def guarded(self) -> dict[str, str]:
+        """attr -> lock name, line numbers dropped (runtime view)."""
+        return {attr: lock for attr, (lock, _ln) in self.annotations.items()}
+
+
+def scan_class_annotations(lines: list[str], cls: ast.ClassDef, path: str,
+                           into: ClassAnnotations | None = None
+                           ) -> ClassAnnotations:
+    """Annotation passes over one class body: lock attrs, attribute
+    declarations + types, then guarded-by resolution per declaration."""
+    info = into if into is not None else \
+        ClassAnnotations(cls.name, path, cls.lineno)
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pass 1: lock attrs, attr declarations, attr types
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            else:
+                continue
+            attr = self_attr(target)
+            if attr is None:
+                continue
+            info.init_lines.setdefault(attr, node.lineno)
+            if isinstance(node, ast.AnnAssign):
+                # `self.peer: "PeerClass" = peer` — the annotation types
+                # the attribute for cross-class cycle resolution
+                ann = node.annotation
+                if isinstance(ann, ast.Name):
+                    info.attr_types[attr] = ann.id
+                elif isinstance(ann, ast.Constant) \
+                        and isinstance(ann.value, str):
+                    info.attr_types[attr] = ann.value
+            kind = lock_ctor_kind(value)
+            if kind is not None:
+                info.locks[attr] = kind
+            elif isinstance(value, ast.Call):
+                f = value.func
+                cname = f.id if isinstance(f, ast.Name) else \
+                    f.attr if isinstance(f, ast.Attribute) else None
+                if cname is not None:
+                    info.attr_types[attr] = cname
+    # pass 2: annotations on declarations
+    for attr, line in info.init_lines.items():
+        lock = annotation_for_line(lines, line)
+        if lock is not None:
+            info.annotations[attr] = (lock, line)
+    return info
+
+
+def scan_module_text(text: str, path: str) -> dict[str, ClassAnnotations]:
+    """All annotated/lock-holding classes of one module's source text —
+    the runtime (tsdbsan) entry point; raises SyntaxError like
+    ast.parse."""
+    tree = ast.parse(text, filename=path)
+    lines = text.splitlines()
+    out: dict[str, ClassAnnotations] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = scan_class_annotations(lines, node, path)
+    return out
+
+
+def scan_module_file(abspath: str, relpath: str | None = None
+                     ) -> dict[str, ClassAnnotations]:
+    with open(abspath, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    return scan_module_text(text, relpath or abspath)

@@ -32,37 +32,14 @@ rebinding.
 from __future__ import annotations
 
 import ast
-import re
 
+from tools.lint.annotations import (ClassAnnotations, scan_class_annotations,
+                                    self_attr as _self_attr)
 from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
 
 RULE_MISSING = "lock-missing-annotation"
 RULE_UNGUARDED = "lock-unguarded-mutation"
 RULE_CYCLE = "lock-order-cycle"
-
-_GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
-_LOCK_CTORS = {"Lock", "RLock"}
-
-
-def _lock_ctor_kind(node: ast.expr) -> str | None:
-    """'Lock' / 'RLock' when `node` is threading.Lock()/RLock() (or a
-    bare Lock()/RLock() import)."""
-    if not isinstance(node, ast.Call):
-        return None
-    f = node.func
-    name = None
-    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS:
-        name = f.attr
-    elif isinstance(f, ast.Name) and f.id in _LOCK_CTORS:
-        name = f.id
-    return name
-
-
-def _self_attr(node: ast.expr) -> str | None:
-    if isinstance(node, ast.Attribute) and \
-            isinstance(node.value, ast.Name) and node.value.id == "self":
-        return node.attr
-    return None
 
 
 def _mutation_targets(stmt: ast.stmt) -> list[str]:
@@ -91,96 +68,31 @@ def _mutation_targets(stmt: ast.stmt) -> list[str]:
     return out
 
 
-class _ClassInfo:
+class _ClassInfo(ClassAnnotations):
+    """ClassAnnotations (the shared grammar: locks, guarded-by, decl
+    lines, attr types — tools/lint/annotations.py) plus the
+    static-analysis-only state: mutation sites and the under-lock call
+    graph."""
+
     def __init__(self, name: str, path: str, lineno: int):
-        self.name = name
-        self.path = path
-        self.lineno = lineno
-        self.locks: dict[str, str] = {}          # lock attr -> Lock|RLock
-        self.annotations: dict[str, tuple[str, int]] = {}  # attr -> (lock, ln)
-        self.init_lines: dict[str, int] = {}     # attr -> first decl line
+        super().__init__(name, path, lineno)
         # (attr, method, line, frozenset(held locks))
         self.mutations: list[tuple[str, str, int, frozenset]] = []
         # method -> set of lock attrs it acquires (with self.X)
         self.acquires: dict[str, set[str]] = {}
         # (held lock, call node, method) for the cycle graph
         self.calls_under_lock: list[tuple[str, ast.Call, str]] = []
-        self.attr_types: dict[str, str] = {}     # self.attr -> ClassName
-
-
-def _annotation_for_line(src: SourceFile, lineno: int) -> str | None:
-    """Inline `# guarded-by:` on `lineno`, or a comment above covering a
-    contiguous block of PLAIN declarations.  A declaration carrying its
-    own trailing comment ends the block — so a standalone guarded-by
-    comment only reaches declarations that visibly opted in by staying
-    bare, never silently past an annotated/documented neighbor."""
-    m = _GUARDED_BY.search(src.lines[lineno - 1])
-    if m:
-        return m.group(1)
-    i = lineno - 2          # 0-based index of the line above
-    while i >= 0:
-        text = src.lines[i].strip()
-        if not text:
-            return None
-        if text.startswith("#"):
-            m = _GUARDED_BY.search(text)
-            if m:
-                return m.group(1)
-            i -= 1
-            continue
-        # a bare declaration line continues the block; a commented one
-        # (it has its own annotation story) or anything else ends it
-        if "#" not in text and re.match(
-                r"self\.[A-Za-z_][A-Za-z0-9_]*\s*(:[^=]+)?=", text):
-            i -= 1
-            continue
-        return None
-    return None
 
 
 def _scan_class(src: SourceFile, cls: ast.ClassDef) -> _ClassInfo:
     info = _ClassInfo(cls.name, src.path, cls.lineno)
-    methods = [n for n in cls.body
-               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
-    # pass 1: lock attrs, attr declarations, attr types
-    for m in methods:
-        for node in ast.walk(m):
-            if isinstance(node, ast.Assign) and len(node.targets) == 1:
-                target, value = node.targets[0], node.value
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                target, value = node.target, node.value
-            else:
-                continue
-            attr = _self_attr(target)
-            if attr is None:
-                continue
-            info.init_lines.setdefault(attr, node.lineno)
-            if isinstance(node, ast.AnnAssign):
-                # `self.peer: "PeerClass" = peer` — the annotation types
-                # the attribute for cross-class cycle resolution
-                ann = node.annotation
-                if isinstance(ann, ast.Name):
-                    info.attr_types[attr] = ann.id
-                elif isinstance(ann, ast.Constant) \
-                        and isinstance(ann.value, str):
-                    info.attr_types[attr] = ann.value
-            kind = _lock_ctor_kind(value)
-            if kind is not None:
-                info.locks[attr] = kind
-            elif isinstance(value, ast.Call):
-                f = value.func
-                cname = f.id if isinstance(f, ast.Name) else \
-                    f.attr if isinstance(f, ast.Attribute) else None
-                if cname is not None:
-                    info.attr_types[attr] = cname
-    # pass 2: annotations on declarations
-    for attr, line in info.init_lines.items():
-        lock = _annotation_for_line(src, line)
-        if lock is not None:
-            info.annotations[attr] = (lock, line)
+    # passes 1 + 2 (lock attrs, declarations, guarded-by annotations)
+    # are the shared grammar
+    scan_class_annotations(src.lines, cls, src.path, into=info)
     # pass 3: mutations + lock acquisition + calls under lock
-    for m in methods:
-        _walk_with_locks(m, m.body, frozenset(), info)
+    for m in cls.body:
+        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _walk_with_locks(m, m.body, frozenset(), info)
     return info
 
 
@@ -327,6 +239,20 @@ def finish(ctx: LintContext) -> list[Finding]:
                 elif nxt not in path_nodes:
                     stack.append((nxt, path_nodes + (nxt,)))
     return out
+
+
+def static_order_edges(root: str | None = None,
+                       paths: tuple[str, ...] = ("opentsdb_tpu",)
+                       ) -> set[tuple[tuple[str, str], tuple[str, str]]]:
+    """The statically-derived lock-order graph over `paths`:
+    ((HolderClass, held_lock), (TargetClass, acquired_lock)) edges —
+    the node space tsdbsan's deadlock watcher cross-checks its observed
+    runtime graph against (tools/sanitize/deadlock.py)."""
+    from tools.lint.core import REPO_ROOT, LintContext, run_lint
+    ctx = LintContext(root or REPO_ROOT)
+    run_lint(paths, root=root or REPO_ROOT, analyzers=[ANALYZER], ctx=ctx)
+    classes = ctx.bucket("lock").get("classes", {})
+    return {(a, b) for a, b, _path, _line in _cycle_edges(classes)}
 
 
 ANALYZER = Analyzer(

@@ -2,13 +2,26 @@
 # tsdblint pre-commit wrapper: lint only what you touched.
 #
 # Install:   ln -s ../../tools/lint/precommit.sh .git/hooks/pre-commit
-# Run ad hoc: tools/lint/precommit.sh
+# Run ad hoc: tools/lint/precommit.sh [--san] [tsdblint args...]
 #
 # The whole tree is analyzed (the interprocedural analyzers need every
 # function summary) but findings are reported only for files that
 # differ from HEAD — so a dirty checkout never blocks your commit on
 # someone else's debt, and the full-tree pass stays under the tier-1
 # 30s budget (tests/test_lint_analyzers.py pins it).
+#
+# `--san` additionally runs the tsdbsan sanitized tier-1 subset
+# (tools/sanitize/run.py --subset tier1) after a clean lint pass — the
+# dynamic twin of the static gate.  Opt-in: it runs real concurrency
+# tests and takes minutes, not seconds.
 set -e
 REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
-exec python "$REPO_ROOT/tools/lint/run.py" --changed-only "$@"
+RUN_SAN=0
+if [ "$1" = "--san" ]; then
+    RUN_SAN=1
+    shift
+fi
+python "$REPO_ROOT/tools/lint/run.py" --changed-only "$@"
+if [ "$RUN_SAN" = "1" ]; then
+    python "$REPO_ROOT/tools/sanitize/run.py" --subset tier1
+fi

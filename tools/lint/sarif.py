@@ -34,10 +34,25 @@ _RULE_DESCRIPTIONS = {
     "resource-leak": "Acquired resource never reaches close/with/finally",
     "resource-leak-return": "Early return crosses a live resource",
     "parse-error": "File failed to parse",
+    # tsdbsan (tools/sanitize) — the runtime layer shares this emitter
+    "san-unguarded-mutation":
+        "Guarded attribute mutated at runtime without its lock",
+    "san-lockset-race": "Multi-thread writes share no common lock",
+    "san-lock-order-inversion": "Runtime lock acquisition order cycle",
+    "san-deadlock": "Live wait-for cycle between threads",
+    "san-recompile-after-warmup": "Kernel compiled again after warmup",
+    "san-host-sync": "Unsanctioned device->host transfer in steady state",
+    "san-stale-static-edge": "Static lock-order edge never observed",
+    "san-lint-gap": "Runtime lock-order edge invisible to lint",
 }
 
 
-def to_sarif(findings, analyzers) -> dict:
+def to_sarif(findings, analyzers, tool_name: str = "tsdblint",
+             levels: dict | None = None) -> dict:
+    """`levels` maps a Finding fingerprint to a SARIF level; absent
+    entries default to "error" (every lint finding is an error; tsdbsan
+    passes "note" for its cross-check reports)."""
+    levels = levels or {}
     rule_ids = sorted({f.rule for f in findings}
                       | {r for a in analyzers for r in a.rules})
     rules = [{
@@ -49,7 +64,7 @@ def to_sarif(findings, analyzers) -> dict:
     results = [{
         "ruleId": f.rule,
         "ruleIndex": index[f.rule],
-        "level": "error",
+        "level": levels.get(f.fingerprint, "error"),
         "message": {"text": f.message},
         "locations": [{
             "physicalLocation": {
@@ -66,7 +81,7 @@ def to_sarif(findings, analyzers) -> dict:
         "version": SARIF_VERSION,
         "runs": [{
             "tool": {"driver": {
-                "name": "tsdblint",
+                "name": tool_name,
                 "rules": rules,
             }},
             "results": results,

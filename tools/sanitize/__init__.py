@@ -1,0 +1,51 @@
+"""tsdbsan — the runtime sanitizer layer (the dynamic twin of tsdblint).
+
+tools/lint/ proves lock discipline and kernel hygiene *statically*, but
+its `# guarded-by:` annotations and lock-order graph are only as true as
+the annotations.  tsdbsan is the complement that makes those contracts
+trustworthy at test time:
+
+  lockset   an instrumented lock wrapper substituted for
+            threading.Lock/RLock inside opentsdb_tpu plus a
+            write-interception layer on lock-holding classes.  Every
+            annotated attribute mutation is verified to actually hold
+            its declared lock (san-unguarded-mutation), and Eraser-style
+            lockset intersection runs on *unannotated* attributes to
+            surface shared state lint cannot see (san-lockset-race —
+            the finding suggests the missing annotation).
+  deadlock  records the runtime held-locks-at-acquire order graph,
+            detects cycles/inversions (san-lock-order-inversion) and
+            live wait-for cycles via a watchdog (san-deadlock), and
+            cross-checks the observed graph against lock_discipline's
+            static one (san-stale-static-edge / san-lint-gap notes).
+  jax       counts trace/compile events per jitted kernel and
+            device->host transfers; a hot kernel recompiling after
+            warmup (san-recompile-after-warmup) or a host sync outside
+            sanctioned sites (san-host-sync) during steady-state query
+            serving is a finding.
+
+Enable with `TSDBSAN=1` (the pytest plugin in tools/sanitize/plugin.py
+arms automatically via tests/conftest.py), `tools/sanitize/run.py
+--subset tier1` (one-shot CI entry), or `tsd.sanitizer.enable=true` on
+a live daemon.  Findings flow through tools/lint's Finding/SARIF
+machinery and honor the same `# tsdblint: disable=<rule>` suppressions.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENABLE_ENV = "TSDBSAN"
+
+
+def enabled() -> bool:
+    """True when the ambient environment arms the sanitizer."""
+    return os.environ.get(ENABLE_ENV, "") == "1"
+
+
+from tools.sanitize.install import (  # noqa: E402
+    install, installed, instrument_module, uninstall)
+from tools.sanitize.report import REPORTER, SAN_RULES  # noqa: E402
+
+__all__ = ["ENABLE_ENV", "enabled", "install", "installed",
+           "instrument_module", "uninstall", "REPORTER", "SAN_RULES"]

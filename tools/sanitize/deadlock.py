@@ -1,0 +1,345 @@
+"""Deadlock watcher: runtime lock-order graph + live wait-for cycles.
+
+Two complementary detectors over the instrumented locks:
+
+  order graph   every acquire of a labeled lock while holding other
+                labeled locks records `(held.label) -> (acquired.label)`
+                edges in the same `(ClassName, lock_attr)` node space as
+                lock_discipline's static graph.  `detect_inversions()`
+                reports cycles (san-lock-order-inversion) — an inversion
+                is a hazard even when the interleaving that would
+                deadlock never happened this run.  Same-label edges
+                (two instances of one class) only count as an inversion
+                when BOTH instance orders were observed: acquiring
+                peers in a consistent order is the sanctioned idiom.
+  wait-for      a watchdog thread walks thread-waits-for-lock ->
+                lock-owned-by-thread edges; a cycle is an ACTUAL
+                deadlock in progress (san-deadlock).  The watchdog only
+                exists while the sanitizer is installed with the
+                deadlock detector enabled.
+
+`cross_check()` diffs the observed order graph against the static one:
+a static edge never observed is a stale-annotation/uncovered-path
+report (san-stale-static-edge, note level); an observed edge the lint
+cannot derive is a lint gap (san-lint-gap, note level).  Both are
+deterministic given the same run: edges are sorted before reporting.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from tools.sanitize.report import REPORTER, caller_site
+
+Label = tuple[str, str]
+Edge = tuple[Label, Label]
+
+_RealLock = threading.Lock
+
+_state_lock = _RealLock()
+# (labelA -> labelB) -> (path, line) of the first acquire that created it
+_order_edges: dict[Edge, tuple[str, int]] = {}
+# same-label edges: label -> set of observed instance orders (+1 / -1)
+_same_label_orders: dict[Label, dict[int, tuple[str, int]]] = {}
+# thread ident -> SanLock it is blocked acquiring
+_waiting: dict[int, object] = {}
+
+_watchdog: "_Watchdog | None" = None
+_enabled = False
+
+
+def configure(enabled: bool, watchdog_ms: int = 200) -> None:
+    global _enabled, _watchdog
+    _enabled = enabled
+    if enabled and watchdog_ms > 0 and _watchdog is None:
+        _watchdog = _Watchdog(watchdog_ms / 1000.0)
+        _watchdog.start()
+    elif not enabled and _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+
+
+def reset() -> None:
+    with _state_lock:
+        _order_edges.clear()
+        _same_label_orders.clear()
+        _waiting.clear()
+
+
+def snapshot_state() -> tuple:
+    """Copy of the accumulated order-graph state; fixture tests that
+    seed deliberate inversions snapshot/restore around themselves so a
+    TSDBSAN=1 session's real graph survives them."""
+    with _state_lock:
+        return (dict(_order_edges),
+                {k: dict(v) for k, v in _same_label_orders.items()})
+
+
+def restore_state(snapshot: tuple) -> None:
+    order, same = snapshot
+    with _state_lock:
+        _order_edges.clear()
+        _order_edges.update(order)
+        _same_label_orders.clear()
+        for k, v in same.items():
+            _same_label_orders[k] = dict(v)
+
+
+# --------------------------------------------------------------------- #
+# Acquire-time recording (called from SanLockBase.acquire)              #
+# --------------------------------------------------------------------- #
+
+def record_acquire(lock, held) -> None:
+    if not _enabled or lock.label is None:
+        return
+    site = None
+    for h in held:
+        if h is lock or h.label is None:
+            continue
+        if site is None:
+            site = caller_site(skip=2)[:2]
+        if h.label == lock.label:
+            # two instances of the same (class, lock): record which
+            # instance order this acquire exhibits
+            order = 1 if id(h) < id(lock) else -1
+            with _state_lock:
+                _same_label_orders.setdefault(
+                    lock.label, {}).setdefault(order, site)
+        else:
+            with _state_lock:
+                _order_edges.setdefault((h.label, lock.label), site)
+
+
+def report_self_deadlock(lock) -> None:
+    """A non-reentrant Lock re-acquired by its owner: guaranteed
+    self-deadlock.  Reported immediately — the thread is about to hang."""
+    if not _enabled:
+        return
+    path, line, func = caller_site(skip=2)
+    REPORTER.add(path, line, "san-deadlock",
+                 "non-reentrant lock %s re-acquired by its owning thread "
+                 "in '%s' — self-deadlock" % (lock.describe(), func))
+
+
+def register_waiting(lock) -> None:
+    if not _enabled:
+        return
+    with _state_lock:
+        _waiting[threading.get_ident()] = lock
+
+
+def unregister_waiting() -> None:
+    if not _enabled:
+        return
+    with _state_lock:
+        _waiting.pop(threading.get_ident(), None)
+
+
+# --------------------------------------------------------------------- #
+# Detection                                                             #
+# --------------------------------------------------------------------- #
+
+def observed_edges() -> dict[Edge, tuple[str, int]]:
+    with _state_lock:
+        out = dict(_order_edges)
+        for label, orders in _same_label_orders.items():
+            if len(orders) == 2:        # both instance orders seen
+                out[(label, label)] = orders[1]
+    return out
+
+
+def detect_inversions() -> None:
+    """Cycle-check the observed order graph and report each canonical
+    cycle once.  Deterministic: nodes and successors visited sorted."""
+    edges = observed_edges()
+    graph: dict[Label, set[Label]] = {}
+    for a, b in edges:
+        if a == b:
+            path, line = edges[(a, b)]
+            REPORTER.add(
+                path, line, "san-lock-order-inversion",
+                "instances of %s.%s are acquired while holding another "
+                "instance's %s in BOTH orders — lock-order inversion "
+                "between peers (impose a canonical acquisition order)"
+                % (a[0], a[1], a[1]))
+            continue
+        graph.setdefault(a, set()).add(b)
+    seen_cycles: set[tuple] = set()
+    for start in sorted(graph):
+        stack = [(start, (start,))]
+        while stack:
+            node, path_nodes = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    body = path_nodes
+                    k = min(range(len(body)),
+                            key=lambda i: body[i:] + body[:i])
+                    canon = body[k:] + body[:k]
+                    if canon in seen_cycles:
+                        continue
+                    seen_cycles.add(canon)
+                    fpath, fline = edges[(node, start)]
+                    REPORTER.add(
+                        fpath, fline, "san-lock-order-inversion",
+                        "runtime lock-order cycle: " + " -> ".join(
+                            "%s.%s" % n for n in canon + (canon[0],)))
+                elif nxt not in path_nodes:
+                    stack.append((nxt, path_nodes + (nxt,)))
+
+
+class _Watchdog:
+    """Periodically walks thread -> waits-for lock -> owning thread; a
+    cycle means those threads are deadlocked RIGHT NOW."""
+
+    def __init__(self, interval_s: float) -> None:
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        from tools.sanitize.locks import real_thread
+        self._thread = real_thread(target=self._run, daemon=True,
+                                   name="tsdbsan-deadlock-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.scan_once()
+
+    @staticmethod
+    def scan_once() -> None:
+        with _state_lock:
+            waits = dict(_waiting)
+        # thread -> thread edges through lock ownership
+        succ: dict[int, int] = {}
+        for tid, lock in waits.items():
+            owner = getattr(lock, "owner", None)
+            if owner is not None and owner != tid:
+                succ[tid] = owner
+        reported: set[frozenset] = set()
+        for start in sorted(succ):
+            tid = start
+            visited = [start]
+            while tid in succ:
+                tid = succ[tid]
+                if tid == start:
+                    cycle = frozenset(visited)
+                    if cycle in reported:
+                        break
+                    reported.add(cycle)
+                    locks = sorted(waits[t].describe() for t in cycle
+                                   if t in waits)
+                    first = waits.get(start)
+                    path, line = "<runtime>", 0
+                    if first is not None and first.label is not None:
+                        path, line = _label_site(first.label)
+                    REPORTER.add(
+                        path, line, "san-deadlock",
+                        "live deadlock: %d thread(s) in a wait-for "
+                        "cycle over locks [%s]"
+                        % (len(cycle), ", ".join(locks)))
+                    break
+                if tid in visited:
+                    break       # cycle not through start; its own start
+                visited.append(tid)
+
+
+def _label_site(label: Label) -> tuple[str, int]:
+    """Best-effort source anchor for a (Class, lock) label: the first
+    recorded order-edge site touching it, else unknown."""
+    with _state_lock:
+        for (a, b), site in sorted(_order_edges.items()):
+            if a == label or b == label:
+                return site
+        for lbl, orders in sorted(_same_label_orders.items()):
+            if lbl == label:
+                return sorted(orders.values())[0]
+    return "<runtime>", 0
+
+
+def scan_waiting_now() -> None:
+    """One synchronous watchdog pass (tests drive this directly)."""
+    _Watchdog.scan_once()
+
+
+# --------------------------------------------------------------------- #
+# Static <-> dynamic cross-check                                        #
+# --------------------------------------------------------------------- #
+
+def cross_check(static_edges: dict[Edge, tuple[str, int]] | None = None,
+                observed: dict[Edge, tuple[str, int]] | None = None,
+                reporter=None) -> dict[str, list[Edge]]:
+    """Diff the runtime order graph against lock_discipline's static
+    one.  Emits note-level findings (into `reporter`, default the
+    process-global one) and returns the diff for callers that render it
+    themselves."""
+    if static_edges is None:
+        static_edges = static_edges_with_sites()
+    if observed is None:
+        observed = observed_edges()
+    rep = reporter if reporter is not None else REPORTER
+    # same-label single-order observations are sanctioned (consistent
+    # peer ordering) — only both-orders entries made it into observed.
+    stale = sorted(set(static_edges) - set(observed))
+    gaps = sorted(set(observed) - set(static_edges))
+    for edge in stale:
+        path, line = static_edges[edge]
+        rep.add(
+            path, line, "san-stale-static-edge",
+            "static lock-order edge %s.%s -> %s.%s was never observed "
+            "at runtime this session — stale annotation or uncovered "
+            "path" % (edge[0] + edge[1]))
+    for edge in gaps:
+        path, line = observed[edge]
+        rep.add(
+            path, line, "san-lint-gap",
+            "runtime lock-order edge %s.%s -> %s.%s is not derivable "
+            "by lock_discipline — lint gap (annotate the attribute "
+            "types so the static graph sees this call path)"
+            % (edge[0] + edge[1]))
+    return {"stale": stale, "gaps": gaps}
+
+
+def save_observed(path: str) -> None:
+    """Persist the observed graph (pytest sessions write this; run.py
+    cross-checks it against the static graph afterwards)."""
+    import json
+    edges = observed_edges()
+    payload = [{"from": list(a), "to": list(b),
+                "path": site[0], "line": site[1]}
+               for (a, b), site in sorted(edges.items())]
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_observed(path: str) -> dict[Edge, tuple[str, int]]:
+    import json
+    with open(path, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    return {(tuple(e["from"]), tuple(e["to"])): (e["path"], e["line"])
+            for e in payload}
+
+
+# static_order_edges returns a set of edges; the cross-check wants
+# per-edge source anchors.  Resolve them lazily from lock_discipline.
+def static_edges_with_sites(root: str | None = None
+                            ) -> dict[Edge, tuple[str, int]]:
+    from tools.lint.core import REPO_ROOT, LintContext, run_lint
+    from tools.lint import lock_discipline
+    ctx = LintContext(root or REPO_ROOT)
+    run_lint(["opentsdb_tpu"], root=root or REPO_ROOT,
+             analyzers=[lock_discipline.ANALYZER], ctx=ctx)
+    classes = ctx.bucket("lock").get("classes", {})
+    out: dict[Edge, tuple[str, int]] = {}
+    for a, b, path, line in lock_discipline._cycle_edges(classes):
+        out.setdefault((a, b), (path, line))
+    return out

@@ -1,0 +1,191 @@
+"""Instrumented lock wrappers — the substrate every tsdbsan detector
+shares.
+
+`install()` swaps `threading.Lock` / `threading.RLock` for factories
+that hand instrumented wrappers to callers inside the sanitized
+packages (decided by the constructing frame's module, so stdlib and
+third-party locks stay untouched and late imports are covered without
+an import hook for lock creation itself).
+
+Each SanLock knows its owner thread, recursion count, and — once the
+write-interception layer sees it assigned to `self.<attr>` of a
+lock-holding class — its `(ClassName, attr)` label, the node identity
+shared with lock_discipline's static order graph.  A thread-local stack
+of currently-held wrappers feeds the lockset race detector (which locks
+protect this write?) and the deadlock watcher (which edges does this
+acquire create, and who waits for whom?).
+"""
+
+from __future__ import annotations
+
+import threading
+
+# the real factories, captured at import time (install() patches the
+# module attributes; everything in here must keep using the real ones)
+_RealLock = threading.Lock
+_RealRLock = threading.RLock
+real_thread = threading.Thread
+get_ident = threading.get_ident
+
+_tls = threading.local()
+
+
+def held_locks() -> tuple["SanLockBase", ...]:
+    """The instrumented locks the calling thread currently holds,
+    outermost first (reentrant holds appear once per acquire)."""
+    return tuple(getattr(_tls, "held", ()))
+
+
+class SanLockBase:
+    """Wrapper over a real lock: context manager + acquire/release with
+    ownership tracking.  `label` is None until the write-interception
+    layer observes the assignment `self.<attr> = <this lock>` on an
+    instrumented class."""
+
+    kind = "Lock"
+    __slots__ = ("_inner", "label", "owner", "count")
+
+    def __init__(self) -> None:
+        self._inner = self._make_inner()
+        self.label: tuple[str, str] | None = None
+        self.owner: int | None = None
+        self.count = 0
+
+    def _make_inner(self):
+        return _RealLock()
+
+    # -- introspection used by the detectors --
+
+    def held_by_me(self) -> bool:
+        return self.owner == get_ident() and self.count > 0
+
+    def describe(self) -> str:
+        if self.label is not None:
+            return "%s.%s" % self.label
+        return "<unlabeled %s at 0x%x>" % (self.kind, id(self))
+
+    # -- the lock protocol --
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        from tools.sanitize import deadlock
+        me = get_ident()
+        reentrant = self.kind == "RLock" and self.owner == me
+        if not reentrant:
+            deadlock.record_acquire(self, held_locks())
+            if self.kind == "Lock" and self.owner == me and blocking:
+                deadlock.report_self_deadlock(self)
+        got = self._inner.acquire(False)
+        if not got and blocking:
+            if not reentrant:
+                deadlock.register_waiting(self)
+            try:
+                got = self._inner.acquire(True, timeout)
+            finally:
+                if not reentrant:
+                    deadlock.unregister_waiting()
+        if got:
+            if self.owner == me:
+                self.count += 1
+            else:
+                self.owner = me
+                self.count = 1
+            held = getattr(_tls, "held", None)
+            if held is None:
+                held = []
+                _tls.held = held
+            held.append(self)
+        return got
+
+    def release(self) -> None:
+        # bookkeeping FIRST: the instant the real lock frees, a blocked
+        # acquire() may set owner/count for the new holder — updating
+        # after self._inner.release() would clobber the waiter's state
+        # and seed false unguarded-mutation/lockset findings on
+        # correctly-locked code under contention
+        prev_owner, prev_count = self.owner, self.count
+        self.count -= 1
+        if self.count <= 0:
+            self.owner = None
+            self.count = 0
+        held = getattr(_tls, "held", None)
+        removed = False
+        if held is not None:
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    removed = True
+                    break
+        try:
+            self._inner.release()   # raises on foreign release, like real
+        except BaseException:
+            self.owner, self.count = prev_owner, prev_count
+            if removed:
+                held.append(self)
+            raise
+
+    def locked(self) -> bool:
+        return self.owner is not None
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return "<San%s %s owner=%s count=%d>" % (
+            self.kind, self.describe(), self.owner, self.count)
+
+
+class SanLock(SanLockBase):
+    kind = "Lock"
+    __slots__ = ()
+
+
+class SanRLock(SanLockBase):
+    kind = "RLock"
+    __slots__ = ()
+
+    def _make_inner(self):
+        return _RealRLock()
+
+    def _is_owned(self) -> bool:        # Condition(RLock) compatibility
+        return self.held_by_me()
+
+
+_san_prefixes: tuple[str, ...] = ()
+
+
+def _caller_wants_san() -> bool:
+    import sys
+    mod = sys._getframe(2).f_globals.get("__name__", "")
+    return mod.startswith(_san_prefixes)
+
+
+def _factory_lock():
+    if _san_prefixes and _caller_wants_san():
+        return SanLock()
+    return _RealLock()
+
+
+def _factory_rlock():
+    if _san_prefixes and _caller_wants_san():
+        return SanRLock()
+    return _RealRLock()
+
+
+def patch_factories(prefixes: tuple[str, ...]) -> None:
+    """Constructions of threading.Lock()/RLock() from modules whose
+    dotted name starts with one of `prefixes` now yield instrumented
+    wrappers; everything else keeps getting real locks."""
+    global _san_prefixes
+    _san_prefixes = tuple(prefixes)
+    threading.Lock = _factory_lock
+    threading.RLock = _factory_rlock
+
+
+def unpatch_factories() -> None:
+    global _san_prefixes
+    _san_prefixes = ()
+    threading.Lock = _RealLock
+    threading.RLock = _RealRLock

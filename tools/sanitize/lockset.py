@@ -1,0 +1,248 @@
+"""Lockset race detector: write interception on lock-holding classes.
+
+`instrument_class()` swaps `__setattr__` on classes the shared
+annotation parser (tools/lint/annotations.py) identifies as lock-holding.
+Every attribute write is then tracked:
+
+  * assignment of an instrumented lock to a declared lock attribute
+    labels the lock `(ClassName, attr)` — the node identity the
+    deadlock watcher and the static cross-check share — and registers
+    it in the instance's lock table;
+  * a write to a `# guarded-by:`-annotated attribute verifies the
+    declared lock is actually held by the writing thread
+    (san-unguarded-mutation).  Exemptions: `__init__` writing its own
+    `self` (mirroring lint), plus a dynamic one the linter cannot
+    have — writes while the instance has only ever been touched by a
+    single thread (pre-publication construction, factory fill-in).
+    Unlike lint, `*_locked` methods are NOT exempt: the caller-holds-
+    the-lock convention is exactly what the runtime can check, so a
+    `*_locked` method reached without the lock reports;
+  * writes to *unannotated* attributes run Eraser-style lockset
+    intersection (san-lockset-race).  State machine per (instance,
+    attr): VIRGIN -> EXCLUSIVE(first thread; no checking) -> SHARED on
+    the first foreign write (candidate lockset := locks held then).
+    Each further write intersects the lockset with the locks held; a
+    finding fires only when the lockset is empty AND at least two
+    distinct threads wrote in the SHARED state — so the benign
+    construct-then-hand-off pattern stays silent, while true
+    multi-writer sharing with no common lock reports and suggests the
+    missing `# guarded-by:` annotation.
+
+Tracking runs AFTER the real write and never raises into application
+code: a sanitizer bug degrades to a missed finding, not a crashed TSD.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import weakref
+
+from tools.lint.annotations import ClassAnnotations
+from tools.sanitize.locks import SanLockBase, held_locks
+from tools.sanitize.report import REPORTER, rel_path
+
+_RealLock = threading.Lock
+get_ident = threading.get_ident
+
+_EXCLUSIVE = 0
+_SHARED = 1
+
+
+class _AttrState:
+    __slots__ = ("state", "owner", "lockset", "writers", "reported")
+
+    def __init__(self, owner: int) -> None:
+        self.state = _EXCLUSIVE
+        self.owner = owner
+        self.lockset: frozenset | None = None
+        self.writers: set[int] | None = None
+        self.reported = False
+
+
+class _InstState:
+    __slots__ = ("locks", "attrs", "threads")
+
+    def __init__(self) -> None:
+        self.locks: dict[str, SanLockBase] = {}   # lock attr -> wrapper
+        self.attrs: dict[str, _AttrState] = {}
+        self.threads: set[int] = set()
+
+
+_states_lock = _RealLock()
+_weak_states: "weakref.WeakKeyDictionary[object, _InstState]" = \
+    weakref.WeakKeyDictionary()
+_id_states: dict[int, _InstState] = {}     # fallback for non-weakrefables
+_lockset_enabled = True
+
+
+def configure(lockset_enabled: bool) -> None:
+    global _lockset_enabled
+    _lockset_enabled = lockset_enabled
+
+
+def reset() -> None:
+    with _states_lock:
+        _weak_states.clear()
+        _id_states.clear()
+
+
+def _state_for(obj) -> _InstState:
+    with _states_lock:
+        try:
+            st = _weak_states.get(obj)
+            if st is None:
+                st = _InstState()
+                _weak_states[obj] = st
+            return st
+        except TypeError:
+            st = _id_states.get(id(obj))
+            if st is None:
+                st = _InstState()
+                _id_states[id(obj)] = st
+            return st
+
+
+def instance_lock(obj, lock_attr: str) -> SanLockBase | None:
+    """The instrumented lock registered under `lock_attr` for `obj`
+    (None when the instance was built before install)."""
+    return _state_for(obj).locks.get(lock_attr)
+
+
+_MARK = "_tsdbsan_instrumented"
+
+
+def instrument_class(cls: type, ann: ClassAnnotations) -> bool:
+    """Wrap cls.__setattr__ (tracking) and cls.__init__ (stale-state
+    purge for the id-keyed fallback).  Returns False when the class was
+    already instrumented or defines a custom __setattr__ (out of scope
+    — none in this tree)."""
+    if _MARK in cls.__dict__:
+        return False
+    for klass in cls.__mro__:
+        if klass is object:
+            break
+        fn = klass.__dict__.get("__setattr__")
+        if fn is not None and not getattr(fn, "_tsdbsan_wrapper", False):
+            return False        # custom __setattr__: leave it alone
+
+    def _san_setattr(self, name, value, _ann=ann):
+        object.__setattr__(self, name, value)
+        try:
+            _track(self, _ann, name, value)
+        except Exception:       # noqa: BLE001 — never break the app
+            pass
+
+    # __slots__ classes without __weakref__ (Series — the densest
+    # instrumented type) fall back to id-keyed state; CPython reuses a
+    # freed instance's address, so a new object could inherit a dead
+    # one's Eraser state and report false races.  Purging at __init__
+    # makes every construction start VIRGIN.
+    had_own_init = "__init__" in cls.__dict__
+    orig_init = cls.__init__
+
+    def _san_init(self, *args, _orig=orig_init, **kwargs):
+        with _states_lock:
+            _id_states.pop(id(self), None)
+        return _orig(self, *args, **kwargs)
+
+    _san_init._tsdbsan_wrapper = True
+    _san_init._tsdbsan_orig = orig_init
+    _san_init._tsdbsan_had_own = had_own_init
+    cls.__setattr__ = _san_setattr
+    cls.__init__ = _san_init
+    setattr(cls, _MARK, True)
+    return True
+
+
+def uninstrument_class(cls: type) -> None:
+    if _MARK in cls.__dict__:
+        try:
+            del cls.__setattr__
+        except AttributeError:
+            pass
+        init = cls.__dict__.get("__init__")
+        if init is not None and getattr(init, "_tsdbsan_wrapper", False):
+            if init._tsdbsan_had_own:
+                cls.__init__ = init._tsdbsan_orig
+            else:
+                try:
+                    del cls.__init__
+                except AttributeError:
+                    pass
+        delattr(cls, _MARK)
+
+
+def _track(obj, ann: ClassAnnotations, name: str, value) -> None:
+    if name.startswith("__") or name.startswith("_tsdbsan"):
+        return
+    if name in ann.locks:
+        if isinstance(value, SanLockBase):
+            if value.label is None:
+                value.label = (ann.name, name)
+            _state_for(obj).locks[name] = value
+        return
+    if isinstance(value, SanLockBase):
+        return                   # a lock stored under a non-lock name
+    st = _state_for(obj)
+    me = get_ident()
+    st.threads.add(me)
+    guarded = ann.guarded.get(name)
+    if guarded is not None:
+        _check_guarded(obj, ann, st, name, guarded, me)
+    elif _lockset_enabled:
+        _eraser(ann, st, name, me)
+
+
+def _check_guarded(obj, ann: ClassAnnotations, st: _InstState, name: str,
+                   lock_attr: str, me: int) -> None:
+    lock = st.locks.get(lock_attr)
+    if lock is not None and lock.owner == me and lock.count > 0:
+        return                   # declared lock held: the contract holds
+    if len(st.threads) < 2:
+        return                   # pre-publication: single-thread so far
+    if lock is None:
+        return                   # lock predates install; cannot judge
+    # mirror the static exemptions: the writer frame being this object's
+    # __init__ or a *_locked method (caller-holds-the-lock convention is
+    # still checked — the lock above was NOT held, so _locked methods do
+    # report; only __init__ re-entry stays exempt)
+    f = sys._getframe(3)         # _check_guarded <- _track <- setattr <- writer
+    if f.f_code.co_name == "__init__" and f.f_locals.get("self") is obj:
+        return
+    REPORTER.add(
+        rel_path(f.f_code.co_filename), f.f_lineno,
+        "san-unguarded-mutation",
+        "%s.%s (guarded-by %s) was mutated in '%s' without the lock "
+        "held" % (ann.name, name, lock_attr, f.f_code.co_name))
+
+
+def _eraser(ann: ClassAnnotations, st: _InstState, name: str,
+            me: int) -> None:
+    astate = st.attrs.get(name)
+    if astate is None:
+        st.attrs[name] = _AttrState(me)
+        return
+    if astate.state == _EXCLUSIVE:
+        if astate.owner == me:
+            return
+        astate.state = _SHARED
+        astate.lockset = frozenset(
+            lk for lk in held_locks() if lk.count > 0)
+        astate.writers = {me}
+        return
+    held = frozenset(lk for lk in held_locks() if lk.count > 0)
+    astate.lockset = (astate.lockset or frozenset()) & held
+    astate.writers.add(me)
+    if astate.reported or astate.lockset or len(astate.writers) < 2:
+        return
+    astate.reported = True
+    f = sys._getframe(3)         # _eraser <- _track <- setattr <- writer
+    locks_held_names = sorted(lk.describe() for lk in held) or ["none"]
+    class_locks = ", ".join(sorted(ann.locks)) or "none"
+    REPORTER.add(
+        rel_path(f.f_code.co_filename), f.f_lineno, "san-lockset-race",
+        "%s.%s is written by multiple threads with no common lock — "
+        "likely missing '# guarded-by:' annotation (class locks: %s; "
+        "locks at last write: %s)"
+        % (ann.name, name, class_locks, ", ".join(locks_held_names)))

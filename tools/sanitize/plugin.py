@@ -1,0 +1,68 @@
+"""pytest plugin: arm tsdbsan for the whole test session.
+
+Loaded by tests/conftest.py when `TSDBSAN=1` (see the `pytest_plugins`
+hook there).  The lockset and deadlock detectors run for every test;
+the JAX compile/sync sanitizer stays OFF under pytest by default —
+tests compile kernels throughout, so warmup/steady phases are
+meaningless session-wide; the steady-state serving check
+(tests/test_sanitizer_steady.py) and the daemon mode own that detector.
+
+Environment knobs (all optional):
+
+  TSDBSAN=1             arm (read by tests/conftest.py)
+  TSDBSAN_REPORT=path   write findings JSON (or SARIF when the path
+                        ends in .sarif) at session finish
+  TSDBSAN_STATE=path    persist the observed lock-order graph for the
+                        offline static<->dynamic cross-check
+                        (tools/sanitize/run.py --cross-check)
+  TSDBSAN_JAX=1         enable the JAX detector under pytest anyway
+  TSDBSAN_WATCHDOG_MS   deadlock watchdog period (default 200)
+
+Error-level findings fail the session (exit status 3) even when every
+test passed — a green suite with a detected race is not green.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def pytest_configure(config) -> None:
+    from tools import sanitize
+    sanitize.install(
+        lockset=True,
+        deadlock_watch=True,
+        jax=os.environ.get("TSDBSAN_JAX", "") == "1",
+        watchdog_ms=int(os.environ.get("TSDBSAN_WATCHDOG_MS", "200")),
+        extra_lock_prefixes=("san_fixtures",),
+    )
+
+
+def pytest_sessionfinish(session, exitstatus) -> None:
+    from tools.sanitize import deadlock
+    from tools.sanitize.report import REPORTER
+    deadlock.detect_inversions()
+    state_path = os.environ.get("TSDBSAN_STATE", "")
+    if state_path:
+        deadlock.save_observed(state_path)
+    report_path = os.environ.get("TSDBSAN_REPORT", "")
+    if report_path:
+        REPORTER.write_report(report_path)
+    if REPORTER.errors() and exitstatus == 0:
+        session.exitstatus = 3
+
+
+def pytest_terminal_summary(terminalreporter) -> None:
+    from tools.sanitize.report import REPORTER, rule_level
+    findings = REPORTER.findings()
+    if not findings:
+        terminalreporter.write_line("tsdbsan: clean")
+        return
+    terminalreporter.write_sep("=", "tsdbsan findings")
+    for f in findings:
+        terminalreporter.write_line(
+            "%s: %s" % (rule_level(f.rule), f.render()))
+    errors = sum(1 for f in findings if rule_level(f.rule) == "error")
+    if errors:
+        terminalreporter.write_line(
+            "tsdbsan: %d error-level finding(s) — session fails" % errors)

@@ -1,0 +1,188 @@
+"""tsdbsan findings: collection, suppression, SARIF.
+
+Reuses tools/lint's Finding shape (path, line, rule, line-number-free
+message) so sanitizer findings ride the same baseline/SARIF/suppression
+machinery as lint findings.  Rules are leveled: "error" rules gate the
+sanitized run; "note" rules are the static<->dynamic cross-check
+reports, which are informational by design (an unobserved static edge
+usually just means the path was not covered this session).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+
+from tools.lint.core import REPO_ROOT, Finding, SourceFile
+
+# captured before tools/sanitize/locks.py ever patches the factories:
+# the reporter's own lock must always be a REAL lock
+_RealLock = threading.Lock
+
+# rule -> (level, short description).  Levels follow SARIF: "error"
+# findings fail the sanitized run; "note" findings are cross-check
+# reports.
+SAN_RULES: dict[str, tuple[str, str]] = {
+    "san-unguarded-mutation": (
+        "error", "Guarded-by-annotated attribute mutated at runtime "
+                 "without its declared lock held"),
+    "san-lockset-race": (
+        "error", "Unannotated attribute written by multiple threads "
+                 "with no common lock (Eraser lockset)"),
+    "san-lock-order-inversion": (
+        "error", "Runtime lock acquisition order forms a cycle"),
+    "san-deadlock": (
+        "error", "Live wait-for cycle between threads observed"),
+    "san-recompile-after-warmup": (
+        "error", "Jitted kernel compiled again after the warmup phase"),
+    "san-host-sync": (
+        "error", "Device->host transfer outside sanctioned sites "
+                 "during steady state"),
+    "san-stale-static-edge": (
+        "note", "Static lock-order edge never observed at runtime "
+                "(stale annotation or uncovered path)"),
+    "san-lint-gap": (
+        "note", "Runtime lock-order edge not derivable statically "
+                "(lint gap)"),
+}
+
+ERROR_RULES = frozenset(r for r, (lv, _d) in SAN_RULES.items()
+                        if lv == "error")
+
+
+def rule_level(rule: str) -> str:
+    return SAN_RULES.get(rule, ("error", ""))[0]
+
+
+def rel_path(abspath: str, root: str = REPO_ROOT) -> str:
+    try:
+        rel = os.path.relpath(abspath, root)
+    except ValueError:
+        return abspath.replace(os.sep, "/")
+    if rel.startswith(".."):
+        return abspath.replace(os.sep, "/")
+    return rel.replace(os.sep, "/")
+
+
+_SKIP_DIRS = (os.sep + "tools" + os.sep + "sanitize" + os.sep,)
+_SKIP_MODULES = ("threading.py", "logging/__init__.py")
+
+
+def caller_site(skip: int = 0) -> tuple[str, int, str]:
+    """(repo-relative path, line, function) of the nearest stack frame
+    that belongs to the repo and is not sanitizer machinery — the site a
+    runtime finding anchors to."""
+    f = sys._getframe(1 + skip)
+    fallback: tuple[str, int, str] | None = None
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not any(d in fn for d in _SKIP_DIRS) \
+                and not fn.endswith(_SKIP_MODULES):
+            if fallback is None:
+                fallback = (rel_path(fn), f.f_lineno, f.f_code.co_name)
+            if os.path.abspath(fn).startswith(REPO_ROOT + os.sep):
+                return (rel_path(fn), f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return fallback or ("<unknown>", 0, "<unknown>")
+
+
+class SanReporter:
+    """Process-global, thread-safe findings collector.
+
+    Dedup is by (path, rule, message) — the lint fingerprint — so a racy
+    loop reports once, not ten thousand times.  `findings()` applies the
+    shared `# tsdblint: disable=<rule>` suppression syntax by re-reading
+    the flagged source line (a suppressed finding is a visible,
+    reviewable act exactly as it is for lint)."""
+
+    def __init__(self) -> None:
+        self._lock = _RealLock()
+        self._findings: dict[tuple[str, str, str], Finding] = {}
+
+    def add(self, path: str, line: int, rule: str, message: str) -> None:
+        f = Finding(path, line, rule, message)
+        with self._lock:
+            self._findings.setdefault(f.fingerprint, f)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._findings.clear()
+
+    def restore(self, findings: list[Finding]) -> None:
+        """Re-seed previously snapshotted findings (test isolation)."""
+        with self._lock:
+            for f in findings:
+                self._findings.setdefault(f.fingerprint, f)
+
+    def raw_findings(self) -> list[Finding]:
+        with self._lock:
+            out = list(self._findings.values())
+        return sorted(out, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    def findings(self, root: str = REPO_ROOT,
+                 apply_suppressions: bool = True) -> list[Finding]:
+        out = self.raw_findings()
+        if not apply_suppressions:
+            return out
+        cache: dict[str, SourceFile | None] = {}
+        kept = []
+        for f in out:
+            src = _source_for(f.path, root, cache)
+            if src is not None and src.suppressed(f.line, f.rule):
+                continue
+            kept.append(f)
+        return kept
+
+    def errors(self, root: str = REPO_ROOT) -> list[Finding]:
+        return [f for f in self.findings(root)
+                if rule_level(f.rule) == "error"]
+
+    def render(self, root: str = REPO_ROOT) -> str:
+        lines = []
+        for f in self.findings(root):
+            lines.append("%s: %s" % (rule_level(f.rule), f.render()))
+        return "\n".join(lines)
+
+    # -- artifacts --
+
+    def to_sarif(self, root: str = REPO_ROOT) -> dict:
+        from tools.lint.sarif import to_sarif
+        findings = self.findings(root)
+        levels = {f.fingerprint: rule_level(f.rule) for f in findings}
+        return to_sarif(findings, [_SanRuleSet()], tool_name="tsdbsan",
+                        levels=levels)
+
+    def to_json(self, root: str = REPO_ROOT) -> list[dict]:
+        return [{"path": f.path, "line": f.line, "rule": f.rule,
+                 "level": rule_level(f.rule), "message": f.message}
+                for f in self.findings(root)]
+
+    def write_report(self, path: str, root: str = REPO_ROOT) -> None:
+        """JSON findings dump (SARIF when the path ends .sarif)."""
+        payload = self.to_sarif(root) if path.endswith(".sarif") \
+            else self.to_json(root)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+
+class _SanRuleSet:
+    """Analyzer-shaped shim so sarif.to_sarif can list tsdbsan's rules."""
+    name = "tsdbsan"
+    rules = tuple(sorted(SAN_RULES))
+
+
+def _source_for(path: str, root: str,
+                cache: dict[str, SourceFile | None]) -> SourceFile | None:
+    if path not in cache:
+        abspath = os.path.join(root, path)
+        try:
+            cache[path] = SourceFile(abspath, path)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            cache[path] = None
+    return cache[path]
+
+
+REPORTER = SanReporter()
