@@ -43,16 +43,22 @@ class MaintenanceThread(threading.Thread):
             "tsd.storage.wal_sync_interval")
         self.snapshot_interval = cfg.get_int(
             "tsd.storage.snapshot_interval")
+        self.stats_interval = cfg.get_int("tsd.stats.interval")
         self._stop_event = threading.Event()
         self._next_flush = time.monotonic() + self.flush_interval
         self._next_sync = time.monotonic() + max(self.wal_sync_interval, 1)
         self._next_snapshot = time.monotonic() + max(
             self.snapshot_interval, 1)
+        self._next_self_report = time.monotonic() + max(
+            self.stats_interval, 1)
         self.flush_passes = 0
         self.wal_syncs = 0
         self.snapshots = 0
         self.snapshot_errors = 0
         self.device_cache_refreshes = 0
+        self.self_reports = 0
+        self.self_report_errors = 0
+        self.self_report_points = 0
 
     # ------------------------------------------------------------------ #
 
@@ -64,6 +70,7 @@ class MaintenanceThread(threading.Thread):
                 self._maybe_sync_wal(now)
                 self._maybe_snapshot(now)
                 self._maybe_refresh_device_cache()
+                self._maybe_self_report(now)
             except Exception:
                 LOG.exception("maintenance pass failed")
 
@@ -113,6 +120,21 @@ class MaintenanceThread(threading.Thread):
         if cache is not None:
             self.device_cache_refreshes += cache.refresh(self.tsdb.store)
 
+    def _maybe_self_report(self, now: float) -> None:
+        """tsd.stats.interval cadence of the self-report loop
+        (obs/selfreport.py): the daemon ingests its own tsd.* metrics
+        so it is queryable about itself through its own pipeline."""
+        if self.stats_interval <= 0 or now < self._next_self_report:
+            return
+        self._next_self_report = now + self.stats_interval
+        from opentsdb_tpu.obs.selfreport import self_report
+        try:
+            self.self_report_points += self_report(self.tsdb)
+            self.self_reports += 1
+        except Exception:
+            self.self_report_errors += 1
+            LOG.exception("self-report pass failed")
+
     def _maybe_snapshot(self, now: float) -> None:
         if self.snapshot_interval <= 0 or now < self._next_snapshot:
             return
@@ -136,4 +158,7 @@ class MaintenanceThread(threading.Thread):
             "tsd.maintenance.snapshot_errors": self.snapshot_errors,
             "tsd.maintenance.device_cache_refreshes":
                 self.device_cache_refreshes,
+            "tsd.maintenance.self_reports": self.self_reports,
+            "tsd.maintenance.self_report_errors": self.self_report_errors,
+            "tsd.maintenance.self_report_points": self.self_report_points,
         }

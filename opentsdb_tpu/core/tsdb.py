@@ -47,6 +47,12 @@ class TSDB:
         self._query_mesh = _UNSET
         self._query_limits = None
         self.maintenance = None
+        # extra stats sources keyed by owner (RpcManager registers the
+        # ingest/error/server counters); walked by /api/stats AND the
+        # self-report loop through obs.selfreport.collect_all.
+        # Initialized BEFORE initialize_plugins so a plugin may
+        # register its own hook during startup.
+        self.stats_hooks: dict = {}
         self._apply_precision_config()
         self._apply_kernel_modes()
         # chaos/failure-testing hooks (tsd.faults.config; no-op unless

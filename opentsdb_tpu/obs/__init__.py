@@ -1,0 +1,26 @@
+"""tsdbobs: end-to-end query tracing, metrics registry, JAX profiling.
+
+Three layers, one package (docs/observability.md):
+
+  * obs/trace.py     span-tree tracer threaded through rpc_manager ->
+                     QueryRpc -> planner -> cluster fan-out; spans carry
+                     wall + device time and ride /api/stats/query plus
+                     the inline showStats summary.
+  * obs/registry.py  thread-safe counters / gauges / log-bucketed
+                     latency histograms (obs/histogram.py) with a
+                     Prometheus text-exposition endpoint
+                     (/api/stats/prometheus).
+  * obs/jaxprof.py   per-kernel compile accounting (the SHARED
+                     compile-log capture tsdbsan's JaxSanitizer also
+                     subscribes to), device-cache gauges, and costmodel
+                     predicted-vs-actual feedback per query segment.
+
+obs/selfreport.py closes the dogfooding loop: the daemon ingests its own
+tsd.* metrics into its own memstore every tsd.stats.interval seconds, so
+the TSD is queryable about itself through its own pipeline.
+"""
+
+from opentsdb_tpu.obs.histogram import LogHistogram
+from opentsdb_tpu.obs.registry import REGISTRY, MetricsRegistry
+
+__all__ = ["LogHistogram", "REGISTRY", "MetricsRegistry"]

@@ -1,0 +1,143 @@
+"""Log-bucketed latency histogram: mergeable, quantile-estimating.
+
+The summary structure behind the metrics registry's histograms, in the
+spirit of the mergeable low-overhead summaries of Storyboard
+(arXiv:2002.03063): geometric bucket bounds ``lo * growth**i`` make
+rank queries answerable with a RELATIVE error bounded by one bucket's
+growth factor, and two histograms with the same bucket layout merge by
+adding counts — per-thread / per-host summaries fold losslessly.
+
+Differences from stats/histogram.py (the reference-parity
+linear-then-doubling `LatencyHistogram` kept for its Java fidelity):
+pure geometric spacing (constant relative error across the whole
+range), float observations, sum tracking (Prometheus `_sum`), merge,
+and interpolated quantiles.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+# Default layout: 1 microsecond .. ~84 seconds in ms units at 2**(1/4)
+# growth — worst-case quantile error is a factor of ~1.19, and aligned
+# coarsening (merge 4 adjacent buckets) yields clean power-of-two
+# Prometheus bounds.
+DEFAULT_LO = 1e-3
+DEFAULT_GROWTH = 2 ** 0.25
+DEFAULT_BUCKETS = 96
+
+
+class LogHistogram:
+    """Thread-safe log-bucketed histogram.
+
+    Bucket 0 holds values <= ``lo``; bucket i (1..buckets-1) holds
+    (lo*growth**(i-1), lo*growth**i]; the final slot is the +Inf
+    overflow.  ``merge`` requires an identical layout.
+    """
+
+    __slots__ = ("lo", "growth", "buckets", "_log_growth", "_lock",
+                 "counts", "count", "total")
+
+    def __init__(self, lo: float = DEFAULT_LO,
+                 growth: float = DEFAULT_GROWTH,
+                 buckets: int = DEFAULT_BUCKETS):
+        if lo <= 0 or growth <= 1.0 or buckets < 1:
+            raise ValueError("invalid histogram layout: lo=%r growth=%r "
+                             "buckets=%r" % (lo, growth, buckets))
+        self.lo = float(lo)
+        self.growth = float(growth)
+        self.buckets = int(buckets)
+        self._log_growth = math.log(self.growth)
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self.counts = [0] * (self.buckets + 1)
+        self.count = 0  # guarded-by: _lock
+        self.total = 0.0  # guarded-by: _lock
+
+    def _index(self, value: float) -> int:
+        if value <= self.lo:
+            return 0
+        idx = int(math.ceil(math.log(value / self.lo) / self._log_growth
+                            - 1e-9))
+        return min(max(idx, 1), self.buckets)
+
+    def bound(self, index: int) -> float:
+        """Upper bound of bucket `index` (inf for the overflow slot)."""
+        if index >= self.buckets:
+            return math.inf
+        return self.lo * self.growth ** index
+
+    def observe(self, value: float) -> None:
+        if value != value or value < 0:        # NaN / negative
+            raise ValueError("invalid observation: %r" % value)
+        idx = self._index(value)
+        with self._lock:
+            self.counts[idx] += 1
+            self.count += 1
+            self.total += value
+
+    def merge(self, other: "LogHistogram") -> None:
+        if (other.lo, other.growth, other.buckets) != \
+                (self.lo, self.growth, self.buckets):
+            raise ValueError(
+                "cannot merge histograms with different layouts: "
+                "(%g, %g, %d) vs (%g, %g, %d)"
+                % (self.lo, self.growth, self.buckets,
+                   other.lo, other.growth, other.buckets))
+        o_counts, o_count, o_total = other.snapshot()
+        with self._lock:
+            for i, c in enumerate(o_counts):
+                self.counts[i] += c
+            self.count += o_count
+            self.total += o_total
+
+    def snapshot(self) -> tuple[list[int], int, float]:
+        with self._lock:
+            return list(self.counts), self.count, self.total
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 < q <= 1): geometric interpolation
+        inside the holding bucket, so the estimate is within one
+        `growth` factor of any sample at that rank.  NaN when empty;
+        the overflow bucket answers its lower bound (the largest
+        trustworthy value)."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError("invalid quantile: %r" % q)
+        counts, count, _total = self.snapshot()
+        if count == 0:
+            return math.nan
+        rank = max(int(math.ceil(q * count)), 1)
+        seen = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                if i == 0:
+                    return self.lo
+                if i >= self.buckets:
+                    return self.lo * self.growth ** (self.buckets - 1)
+                lower = self.lo * self.growth ** (i - 1)
+                frac = (rank - seen) / c
+                return lower * self.growth ** frac
+            seen += c
+        return self.lo * self.growth ** (self.buckets - 1)
+
+    def cumulative(self, max_buckets: int = 24
+                   ) -> list[tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] coarsened to at most
+        `max_buckets` entries by merging ALIGNED runs of adjacent
+        buckets (plus the +Inf slot) — the Prometheus `_bucket`
+        series.  Coarsening preserves mergeability: two exposed
+        histograms with the same layout coarsen identically."""
+        counts, _count, _total = self.snapshot()
+        step = max(-(-self.buckets // max(max_buckets - 1, 1)), 1)
+        out: list[tuple[float, int]] = []
+        cum = 0
+        for lo_i in range(0, self.buckets, step):
+            hi_i = min(lo_i + step, self.buckets)
+            cum += sum(counts[lo_i:hi_i])
+            out.append((self.bound(hi_i - 1), cum))
+        cum += counts[self.buckets]
+        out.append((math.inf, cum))
+        return out

@@ -1,0 +1,266 @@
+"""JAX profiling hooks: compile accounting, device gauges, costmodel
+predicted-vs-actual feedback.
+
+Compile capture — THE shared source.  `jax_log_compiles` emits one
+"Compiling <kernel> ..." log record per XLA compilation, synchronously
+in the compiling thread.  `CompileLogCapture` owns the single logging
+handler (and the flag save/restore) and fans each kernel name out to
+subscribers; both this module's per-kernel counters AND tsdbsan's
+JaxSanitizer (tools/sanitize/jax_san.py) subscribe to the same capture,
+so the profiler and the sanitizer can never disagree about what
+compiled — one regex, one handler, one event stream.
+
+Costmodel feedback.  ops/costmodel.py predicts per-stage dispatch costs
+from calibrated per-unit constants; until now the predictions were
+consulted (kernel-mode argmin) but never compared to reality.
+`record_segment()` keeps a ring of (shape, predicted, actual) per query
+segment plus running totals in the metrics registry — the raw feedback
+a later calibration PR needs to close the loop.  `stage_breakdown()`
+exposes the same predictions per logical pipeline stage; the tracer
+uses it to apportion a fused dispatch's measured device time across
+downsample/rate/groupby/aggregate children (tagged estimated).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from collections import deque
+
+from opentsdb_tpu.obs.registry import REGISTRY
+
+COMPILING_RE = re.compile(r"Compiling (\S+) with global")
+PXLA_LOGGER = "jax._src.interpreters.pxla"
+
+
+class _CaptureHandler(logging.Handler):
+    def __init__(self, capture: "CompileLogCapture") -> None:
+        super().__init__(level=logging.DEBUG)
+        self._capture = capture
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:       # noqa: BLE001 — a malformed record must
+            # never break the compiling thread; counted, not hidden
+            self._capture.count_parse_error()
+            return
+        m = COMPILING_RE.match(msg)
+        if m:
+            self._capture._emit(m.group(1))
+
+
+class CompileLogCapture:
+    """Refcounted owner of the pxla compile-log handler.
+
+    `subscribe(cb)` installs the handler (and turns jax_log_compiles on)
+    on the first subscriber; `unsubscribe(cb)` restores both when the
+    last one leaves.  Callbacks run synchronously in the compiling
+    thread — the stack still shows who asked for the compile, which is
+    what tsdbsan's attribution depends on.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._subscribers: list = []
+        self._handler: _CaptureHandler | None = None  # guarded-by: _lock
+        self._prev_flag = None  # guarded-by: _lock
+        # unparsable log records (diagnostic)  # guarded-by: _lock
+        self.parse_errors = 0
+
+    def count_parse_error(self) -> None:
+        with self._lock:
+            self.parse_errors += 1
+
+    def subscribe(self, callback) -> None:
+        import jax
+        with self._lock:
+            self._subscribers.append(callback)
+            if self._handler is None:
+                self._prev_flag = jax.config.jax_log_compiles
+                jax.config.update("jax_log_compiles", True)
+                self._handler = _CaptureHandler(self)
+                logging.getLogger(PXLA_LOGGER).addHandler(self._handler)
+
+    def unsubscribe(self, callback) -> None:
+        import jax
+        with self._lock:
+            try:
+                self._subscribers.remove(callback)
+            except ValueError:
+                pass
+            if not self._subscribers and self._handler is not None:
+                logging.getLogger(PXLA_LOGGER).removeHandler(self._handler)
+                self._handler = None
+                if self._prev_flag is not None:
+                    jax.config.update("jax_log_compiles", self._prev_flag)
+                self._prev_flag = None
+
+    def _emit(self, kernel: str) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for cb in subs:
+            cb(kernel)
+
+
+compile_capture = CompileLogCapture()
+
+
+# --------------------------------------------------------------------- #
+# Per-kernel compile counters (the profiler's subscriber)               #
+# --------------------------------------------------------------------- #
+
+class _CompileCounter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._refs = 0
+        self.counts: dict[str, int] = {}  # guarded-by: _lock
+
+    def start(self) -> None:
+        with self._lock:
+            self._refs += 1
+            if self._refs > 1:
+                return
+        compile_capture.subscribe(self._on_compile)
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._refs == 0:
+                return
+            self._refs -= 1
+            if self._refs:
+                return
+        compile_capture.unsubscribe(self._on_compile)
+
+    def _on_compile(self, kernel: str) -> None:
+        with self._lock:
+            self.counts[kernel] = self.counts.get(kernel, 0) + 1
+        REGISTRY.counter(
+            "tsd.jax.compiles",
+            "XLA compilations per jitted kernel").labels(
+                kernel=kernel).inc()
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.counts)
+
+
+_COUNTER = _CompileCounter()
+
+
+def start_compile_counting() -> None:
+    """Arm per-kernel compile counting (refcounted; the daemon arms it
+    when tsd.trace.enable is on)."""
+    _COUNTER.start()
+
+
+def stop_compile_counting() -> None:
+    _COUNTER.stop()
+
+
+def compile_counts() -> dict[str, int]:
+    return _COUNTER.snapshot()
+
+
+# --------------------------------------------------------------------- #
+# Device-cache gauges                                                   #
+# --------------------------------------------------------------------- #
+
+def update_device_gauges(tsdb) -> None:
+    """Mirror the device cache's hit/miss/build/eviction tallies into
+    registry gauges.
+
+    For EMBEDDERS exporting REGISTRY.prometheus_text() directly without
+    a TSD stats walk.  The daemon's /api/stats/prometheus does NOT call
+    this: its extra_records already carry the same values host-tagged,
+    and registering them here would shadow that richer labeling."""
+    cache = getattr(tsdb, "device_cache", None)
+    if cache is None:
+        return
+    for name, value in cache.collect_stats().items():
+        REGISTRY.gauge(name, "Device series cache (HBM) state").set(value)
+
+
+# --------------------------------------------------------------------- #
+# Costmodel predicted-vs-actual                                         #
+# --------------------------------------------------------------------- #
+
+SEGMENT_RING = 256
+
+_seg_lock = threading.Lock()
+# guarded-by: _seg_lock
+_segments: deque = deque(maxlen=SEGMENT_RING)
+
+
+def stage_breakdown(platform: str, s: int, n: int, w: int, g: int,
+                    ds_function: str | None,
+                    has_rate: bool) -> dict[str, float]:
+    """Predicted seconds per logical pipeline stage for one grouped
+    dispatch of shape [s series, n points] -> [w windows, g groups],
+    using the calibrated costmodel with the same argmin mode choices
+    the kernels make.  Approximate by design — this is the PREDICTED
+    side of the predicted-vs-actual ledger, not a timer."""
+    from opentsdb_tpu.ops import costmodel as cm
+    s = max(int(s), 1)
+    n = max(int(n), 1)
+    w = max(int(w), 1)
+    g = max(int(g), 1)
+    e = w + 1
+    elem = cm.costs(platform)["elem_f64"]
+    out: dict[str, float] = {}
+    search = min(cm.predict_search(m, s, n, e, platform)
+                 for m in ("scan", "compare_all", "hier"))
+    if ds_function in ("min", "max", "mimmin", "mimmax"):
+        reduce_cost = min(cm.predict_extreme(m, s, n, e, platform)
+                          for m in ("scan", "segment", "subblock"))
+    else:
+        reduce_cost = min(cm.predict_scan(m, s, n, e, platform)
+                          for m in ("flat", "blocked", "subblock",
+                                    "subblock2"))
+    out["downsample"] = search + reduce_cost
+    if has_rate:
+        out["rate"] = s * w * elem
+    out["groupby"] = min(cm.predict_group(m, s, w, g, platform)
+                         for m in ("segment", "matmul", "sorted"))
+    out["aggregate"] = g * w * elem
+    return out
+
+
+def record_segment(kind: str, s: int, n: int, w: int, g: int,
+                   predicted_s: float, actual_ms: float) -> None:
+    """One executed query segment's predicted-vs-actual device cost.
+    Lands in the in-process ring (`segments()`) and the registry
+    running totals; the ring is the calibration corpus."""
+    with _seg_lock:
+        _segments.append({
+            "kind": kind, "series": int(s), "points": int(n),
+            "windows": int(w), "groups": int(g),
+            "predictedMs": round(predicted_s * 1e3, 4),
+            "actualMs": round(actual_ms, 4),
+        })
+    REGISTRY.counter(
+        "tsd.costmodel.segments",
+        "Query segments with predicted-vs-actual accounting").labels(
+            kind=kind).inc()
+    REGISTRY.counter(
+        "tsd.costmodel.predicted_ms",
+        "Costmodel-predicted device milliseconds, summed").labels(
+            kind=kind).inc(predicted_s * 1e3)
+    REGISTRY.counter(
+        "tsd.costmodel.actual_ms",
+        "Measured device milliseconds, summed").labels(
+            kind=kind).inc(actual_ms)
+
+
+def segments() -> list[dict]:
+    """The predicted-vs-actual ring, oldest first."""
+    with _seg_lock:
+        return list(_segments)
+
+
+def clear_segments() -> None:
+    with _seg_lock:
+        _segments.clear()

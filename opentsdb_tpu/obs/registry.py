@@ -1,0 +1,241 @@
+"""Thread-safe metrics registry + Prometheus text exposition.
+
+The push-style StatsCollector (stats/collector.py) walks subsystems on
+demand; this registry is the PULL-style complement for code that wants
+to instrument itself at the event site — counters, gauges, and
+log-bucketed latency histograms (obs/histogram.py), labeled, with one
+global `REGISTRY` the way the Prometheus client libraries work.
+
+`/api/stats/prometheus` (tsd/admin_rpcs.py) renders the registry in the
+text exposition format (version 0.0.4) and folds in the StatsCollector
+records from the same walk `/api/stats` serves — so device-cache,
+breaker, compaction, and every other existing counter is scrapeable
+without re-instrumenting its source.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+
+from opentsdb_tpu.obs.histogram import LogHistogram
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+KINDS = ("counter", "gauge", "histogram")
+
+
+def sanitize_name(name: str) -> str:
+    """Metric name -> Prometheus name (dots and dashes to underscores)."""
+    out = _NAME_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def sanitize_label(name: str) -> str:
+    out = _LABEL_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(value: float) -> str:
+    if value != value:
+        return "NaN"
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    if isinstance(value, float) and value.is_integer() \
+            and abs(value) < 2 ** 53:
+        return str(int(value))
+    return repr(value) if isinstance(value, float) else str(value)
+
+
+def _label_str(labels: tuple[tuple[str, str], ...],
+               extra: str = "") -> str:
+    parts = ['%s="%s"' % (sanitize_label(k), escape_label_value(v))
+             for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{%s}" % ",".join(parts) if parts else ""
+
+
+class _Value:
+    """One labeled counter/gauge cell."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0  # guarded-by: _lock
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
+class Family:
+    """One metric family: name + kind + help + labeled children."""
+
+    def __init__(self, name: str, kind: str, help_text: str = "",
+                 **hist_kw):
+        if kind not in KINDS:
+            raise ValueError("unknown metric kind: %r" % kind)
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self._hist_kw = hist_kw
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._children: dict[tuple[tuple[str, str], ...], object] = {}
+
+    def labels(self, **labels):
+        """The child cell for a label set (created on first use)."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = (LogHistogram(**self._hist_kw)
+                         if self.kind == "histogram" else _Value())
+                self._children[key] = child
+            return child
+
+    # bare-cell conveniences (the no-label common case)
+    def inc(self, n: float = 1.0) -> None:
+        self.labels().inc(n)
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def children(self) -> list[tuple[tuple[tuple[str, str], ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class MetricsRegistry:
+    """Name -> Family, with kind conflicts rejected loudly."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, Family] = {}  # guarded-by: _lock
+
+    def _family(self, name: str, kind: str, help_text: str,
+                **hist_kw) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = Family(name, kind, help_text, **hist_kw)
+                self._families[name] = fam
+            elif fam.kind != kind:
+                raise ValueError(
+                    "metric %s already registered as a %s (asked for %s)"
+                    % (name, fam.kind, kind))
+            return fam
+
+    def counter(self, name: str, help_text: str = "") -> Family:
+        return self._family(name, "counter", help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Family:
+        return self._family(name, "gauge", help_text)
+
+    def histogram(self, name: str, help_text: str = "",
+                  **hist_kw) -> Family:
+        return self._family(name, "histogram", help_text, **hist_kw)
+
+    def families(self) -> list[Family]:
+        with self._lock:
+            return [self._families[k] for k in sorted(self._families)]
+
+    def reset(self) -> None:
+        """Drop every family (test isolation)."""
+        with self._lock:
+            self._families.clear()
+
+    # -- exposition ---------------------------------------------------- #
+
+    def prometheus_text(self, extra_records: list[dict] | None = None,
+                        hist_buckets: int = 24) -> str:
+        """The full scrape body: every registry family, then every
+        StatsCollector record (as gauges) whose name does not collide
+        with a registry family."""
+        lines: list[str] = []
+        emitted: set[str] = set()
+        for fam in self.families():
+            pname = sanitize_name(fam.name)
+            if pname in emitted:
+                continue
+            emitted.add(pname)
+            sample = pname + ("_total" if fam.kind == "counter" else "")
+            if fam.help:
+                lines.append("# HELP %s %s"
+                             % (sample, fam.help.replace("\n", " ")))
+            lines.append("# TYPE %s %s" % (sample, fam.kind))
+            for labels, child in fam.children():
+                if fam.kind == "histogram":
+                    self._render_histogram(lines, pname, labels, child,
+                                           hist_buckets)
+                else:
+                    lines.append("%s%s %s" % (sample, _label_str(labels),
+                                              _fmt(child.get())))
+        for name, samples in _group_records(extra_records or []):
+            pname = sanitize_name(name)
+            if pname in emitted:
+                continue
+            emitted.add(pname)
+            lines.append("# TYPE %s gauge" % pname)
+            for labels, value in samples:
+                lines.append("%s%s %s" % (pname, _label_str(labels),
+                                          _fmt(value)))
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_histogram(lines: list[str], pname: str,
+                          labels: tuple[tuple[str, str], ...],
+                          hist: LogHistogram, max_buckets: int) -> None:
+        _counts, count, total = hist.snapshot()
+        for bound, cum in hist.cumulative(max_buckets):
+            # 6 significant digits: bounds are exact powers of the
+            # growth factor, whose float repr carries ulp noise
+            le = "+Inf" if bound == math.inf else "%.6g" % bound
+            lines.append("%s_bucket%s %d"
+                         % (pname, _label_str(labels, 'le="%s"' % le),
+                            cum))
+        lines.append("%s_sum%s %s" % (pname, _label_str(labels),
+                                      _fmt(total)))
+        lines.append("%s_count%s %d" % (pname, _label_str(labels), count))
+
+
+def _group_records(records: list[dict]
+                   ) -> list[tuple[str, list[tuple[tuple, float]]]]:
+    """StatsCollector records -> [(metric, [(labels, value)])] with
+    duplicate (metric, labels) keeping the LAST value recorded."""
+    grouped: dict[str, dict[tuple, float]] = {}
+    for r in records:
+        labels = tuple(sorted((k, str(v))
+                              for k, v in (r.get("tags") or {}).items()))
+        grouped.setdefault(r["metric"], {})[labels] = float(r["value"])
+    return [(name, sorted(samples.items()))
+            for name, samples in sorted(grouped.items())]
+
+
+REGISTRY = MetricsRegistry()

@@ -1,0 +1,72 @@
+"""Self-report loop: the TSD ingests its own tsd.* metrics.
+
+The dogfooding design the reference's StatsCollector was built for —
+one collector walk (the SAME walk /api/stats serves: TSDB counters,
+cluster breakers, rollup lanes, plus every registered stats hook) is
+written back into the local memstore through the normal ingest path, so
+a dashboard can query the daemon about itself with ordinary /api/query
+downsample/rate semantics.  tsd.stats.interval (seconds) gates the
+cadence from the maintenance thread; 0 (the default) disables it.
+
+Metric UIDs auto-create for the tsd.* namespace even when
+tsd.core.auto_create_metrics is off: the operator's ingest policy
+governs CLIENT data, and a stats loop that silently dropped every
+record under the default policy would be a dead feature.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from opentsdb_tpu.stats import StatsCollector
+
+LOG = logging.getLogger("tsd.selfreport")
+
+# the UID charset (uid.validate_uid_name / Tags.validateString):
+# anything else in a stats tag (the ':' in a peer host:port, most
+# commonly) maps to '_' so the record still lands
+_UID_ILLEGAL = re.compile(r"[^-_./a-zA-Z0-9À-ヿ]")
+
+
+def _uid_safe(name: str) -> str:
+    return _UID_ILLEGAL.sub("_", name) or "_"
+
+
+def collect_all(tsdb) -> StatsCollector:
+    """The full stats walk: every record /api/stats (and the telnet
+    `stats` command) would serve.  Shared by StatsRpc and the
+    self-report loop so the two surfaces can never diverge."""
+    collector = StatsCollector("tsd", use_host_tag=True)
+    collector.record_map(tsdb.collect_stats())
+    from opentsdb_tpu.tsd.cluster import collect_stats as cluster_stats
+    cluster_stats(tsdb, collector)
+    if tsdb.rollup_store is not None:
+        collector.record_map(tsdb.rollup_store.collect_stats())
+    for hook in getattr(tsdb, "stats_hooks", {}).values():
+        hook(collector)
+    return collector
+
+
+def self_report(tsdb) -> int:
+    """One pass: collect and ingest.  Returns datapoints written (0 in
+    read-only mode — a ro daemon must not write, even about itself)."""
+    if tsdb.mode == "ro":
+        return 0
+    collector = collect_all(tsdb)
+    written = 0
+    for record in collector.records:
+        metric = _uid_safe(record["metric"])
+        tags = {_uid_safe(k): _uid_safe(str(v))
+                for k, v in record["tags"].items()}
+        # pre-create EVERY UID (metric, tagk, tagv) so the
+        # auto_create_* gates — client-data policy — never reject the
+        # daemon's own stats; cached dict hits after the first pass
+        tsdb.metrics.get_or_create_id(metric)
+        for k, v in tags.items():
+            tsdb.tag_names.get_or_create_id(k)
+            tsdb.tag_values.get_or_create_id(v)
+        tsdb.add_point(metric, record["timestamp"], record["value"],
+                       tags)
+        written += 1
+    return written

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.ops.downsample import (
     FixedWindows, EdgeWindows, AllWindow, pad_pow2)
 from opentsdb_tpu.ops.pipeline import (
@@ -331,8 +332,11 @@ class QueryRunner:
                 store = pre if pre is not None else store
         else:
             store = seg.lane
-        series_tags = self._resolve_series(sub, store)
-        groups = self._group(series_tags, sub)
+        with obs_trace.stage("scan", kind=seg.kind) as sp:
+            series_tags = self._resolve_series(sub, store)
+            groups = self._group(series_tags, sub)
+            obs_trace.annotate(sp, series=len(series_tags),
+                               groups=len(groups))
         windows = self._windows_for(sub, query)
         if windows is not None:
             return self._run_segment_grouped(query, sub, seg, groups,
@@ -398,6 +402,12 @@ class QueryRunner:
         if not kept:
             return {}
         budget.check_deadline()
+        # one "pipeline" span covers batch build + the fused dispatch;
+        # begin/end (not a with-block) keeps the 5-path dispatch chain
+        # un-reindented, and an exception simply leaves the span
+        # unfinished inside a request-scoped trace
+        psp = obs_trace.begin("pipeline", aggregator=sub.aggregator,
+                              downsample=seg.ds_function or ds.function)
         # The window plan materializes ONLY after the budget accepted the
         # scan: EdgeWindows.split builds a [W+1] edge vector sized by the
         # query's range/interval (calendar grids over a year at fine
@@ -620,17 +630,70 @@ class QueryRunner:
                     out_ts, out_val, out_mask = run_group_pipeline(
                         spec, ts, val, mask, gid, g_pad, wargs)
 
-        out_ts = np.asarray(out_ts)
-        out_val = np.asarray(out_val)
-        out_mask = np.asarray(out_mask)
-        results: dict[tuple, QueryResult] = {}
-        for i, (group_key, members, _) in enumerate(kept):
-            dps = extract_dps(out_ts, out_val[i], out_mask[i], seg.start_ms,
-                              seg.end_ms, False,
-                              keep_nans=sub.fill_policy != "none")
-            results[tuple(map(str, group_key))] = self._assemble_result(
-                query, sub, members, dps, global_notes)
+        if psp is not None:
+            obs_trace.device_wait(psp, (out_ts, out_val, out_mask))
+            self._trace_pipeline_stages(
+                psp, sub, seg, len(gid),
+                max(max(c) for _, _, c in kept), window_spec.count,
+                len(kept), host_small)
+        obs_trace.end(psp)
+        with obs_trace.stage("extract"):
+            out_ts = np.asarray(out_ts)
+            out_val = np.asarray(out_val)
+            out_mask = np.asarray(out_mask)
+            results: dict[tuple, QueryResult] = {}
+            for i, (group_key, members, _) in enumerate(kept):
+                dps = extract_dps(out_ts, out_val[i], out_mask[i],
+                                  seg.start_ms, seg.end_ms, False,
+                                  keep_nans=sub.fill_policy != "none")
+                results[tuple(map(str, group_key))] = \
+                    self._assemble_result(query, sub, members, dps,
+                                          global_notes)
         return results
+
+    def _trace_pipeline_stages(self, span, sub: TSSubQuery, seg: Segment,
+                               s: int, n: int, w: int, g: int,
+                               host_small: bool = False) -> None:
+        """Logical stage children of the fused dispatch span + the
+        costmodel predicted-vs-actual ledger entry.
+
+        XLA fuses downsample/rate/groupby/aggregate into one kernel, so
+        per-stage device truth does not exist at runtime; the measured
+        device wait is APPORTIONED across the stages by the calibrated
+        costmodel's per-stage predictions and the children say so
+        (`estimated` tag).  The (predicted, actual) pair itself lands
+        in obs.jaxprof's segment ring — the raw feedback a calibration
+        pass needs."""
+        from opentsdb_tpu.obs import jaxprof
+        from opentsdb_tpu.ops.hostlane import execution_platform
+        ds = sub.downsample_spec
+        ds_fn = seg.ds_function or (ds.function if ds is not None else None)
+        # per-SEGMENT platform: the exec_stats hostLane flag is sticky
+        # across a run's segments and would misattribute later
+        # device-dispatched segments as cpu, poisoning the calibration
+        # ring with cpu-predicted vs device-actual pairs
+        platform = "cpu" if host_small else execution_platform()
+        breakdown = jaxprof.stage_breakdown(platform, s, n, w, g, ds_fn,
+                                            bool(sub.rate))
+        total_pred = sum(breakdown.values()) or 1.0
+        for stage_name in ("downsample", "rate", "groupby", "aggregate"):
+            share = breakdown.get(stage_name)
+            if share is None:
+                continue
+            child = span.child(stage_name, estimated=True)
+            child.device_ms = round(span.device_ms * share / total_pred, 3)
+            child.wall_ms = child.device_ms
+        tr = obs_trace.active()
+        if tr is None or not tr.device_time:
+            # wall-only tracing: span.device_ms is 0 by CONFIG, not by
+            # measurement — recording predicted>0/actual=0 pairs would
+            # poison the calibration ring
+            return
+        jaxprof.record_segment(seg.kind, s, n, w, g,
+                               sum(breakdown.values()), span.device_ms)
+        self._bump("deviceTimeMs", round(span.device_ms, 3))
+        self._bump("costmodelPredictedMs",
+                   round(sum(breakdown.values()) * 1e3, 3))
 
     @staticmethod
     def _host_window_ids(windows, tsb):
@@ -845,6 +908,8 @@ class QueryRunner:
         def flush(int_mode: bool, chunk: list) -> None:
             """Dispatch up to _UNION_BATCH_MAX same-shaped groups and
             assemble their results (releases the held batches)."""
+            psp = obs_trace.begin("pipeline", aggregator=sub.aggregator,
+                                  union=True, groups=len(chunk))
             # fast lane per dispatch: the flush's real point count is the
             # summed mask (padding excluded)
             host_small = (host_max > 0 and cpu_device() is not None
@@ -875,6 +940,14 @@ class QueryRunner:
                 bt, bv, bm = (np.asarray(bt), np.asarray(bv),
                               np.asarray(bm))
                 outs = [(bt[i], bv[i], bm[i]) for i in range(len(chunk))]
+            if psp is not None:
+                obs_trace.device_wait(psp, outs)
+                # the union pipeline is one fused aggregate (+rate)
+                # kernel — a single estimated child, full device share
+                child = psp.child("aggregate", estimated=True)
+                child.device_ms = round(psp.device_ms, 3)
+                child.wall_ms = child.device_ms
+            obs_trace.end(psp)
             for (group_key, members, *_), (o_ts, o_val, o_mask) \
                     in zip(chunk, outs):
                 dps = extract_dps(np.asarray(o_ts), np.asarray(o_val),

@@ -32,7 +32,10 @@ class StatsCollector:
         reference's (StatsCollector.record :118)."""
         tags = dict(self._extra_tags)
         if xtratag:
-            if "=" not in xtratag:
+            # exactly one '=': the reference rejects both the bare form
+            # and "a=b=c" (which would silently fold "b=c" into the tag
+            # value and mint an unqueryable tag)
+            if xtratag.count("=") != 1:
                 raise ValueError("invalid xtratag: %s (multiple '=' signs "
                                  "or none)" % xtratag)
             k, v = xtratag.split("=", 1)

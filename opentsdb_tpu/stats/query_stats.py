@@ -38,6 +38,9 @@ class QueryStats:
         self.http_status = 200
         self.exception: str | None = None
         self.stats: dict[str, float] = {}
+        # obs.trace.Trace of the serving request (rendered lazily at
+        # snapshot time so the ring serves the FINISHED tree)
+        self.trace = None
 
     def mark(self, stat: str, value_ms: float | None = None) -> None:
         """Record a milestone duration (QueryStats.markSerializationSuccessful
@@ -73,6 +76,8 @@ class QueryStats:
             "query": self.query,
             "stats": {k: round(v, 3) for k, v in self.stats.items()},
         }
+        if self.trace is not None:
+            out["trace"] = self.trace.to_json()
         if running:
             out["elapsed"] = round(self.elapsed_ms(), 3)
         else:

@@ -107,30 +107,17 @@ class DropCachesRpc(TelnetRpc, HttpRpc):
 class StatsRpc(TelnetRpc, HttpRpc):
     """/api/stats (+/query, /jvm, /threads, /region_clients) + telnet stats."""
 
-    def __init__(self, stats_registry=None, server=None):
+    def __init__(self, stats_registry=None):
         self.stats_registry = stats_registry
-        self.server = server
-        self.rpc_manager = None   # set by RpcManager after construction
 
     def _collect(self, tsdb) -> StatsCollector:
-        collector = StatsCollector(
-            "tsd", use_host_tag=True)
-        collector.record_map(tsdb.collect_stats())
-        # cluster fault-tolerance surface: per-peer breaker state,
-        # retry/failure counters, partial-result tallies (tsd/cluster.py)
-        from opentsdb_tpu.tsd.cluster import collect_stats as cluster_stats
-        cluster_stats(tsdb, collector)
-        if tsdb.rollup_store is not None:
-            collector.record_map(tsdb.rollup_store.collect_stats())
-        if self.rpc_manager is not None:
-            for rpc in getattr(self.rpc_manager, "ingest_rpcs", []):
-                rpc.collect_stats(collector)
-            # error-envelope tallies (http.errors family=4xx/5xx): the
-            # operator-visible counterpart of the uniform error envelope
-            self.rpc_manager.collect_stats(collector)
-        if self.server is not None:
-            self.server.collect_stats(collector)
-        return collector
+        """One stats walk: TSDB counters, cluster breakers, rollup
+        lanes, plus every registered stats hook (the RpcManager's hook
+        covers ingest RPCs, error envelopes, and the server).  Shared
+        with the self-report loop — obs/selfreport.py — so /api/stats
+        and the dogfooded tsd.* series can never diverge."""
+        from opentsdb_tpu.obs.selfreport import collect_all
+        return collect_all(tsdb)
 
     def execute_telnet(self, tsdb, conn, words) -> str:
         return self._collect(tsdb).emit_ascii()
@@ -138,6 +125,19 @@ class StatsRpc(TelnetRpc, HttpRpc):
     def execute_http(self, tsdb, query: HttpQuery) -> None:
         sub = query.api_subpath()
         endpoint = sub[0] if sub else ""
+        if endpoint == "prometheus":
+            # text exposition (version 0.0.4) beside the JSON surface:
+            # registry counters/gauges/latency histograms first, then
+            # every StatsCollector record (device cache, breakers,
+            # compaction, ingest counters) as gauges — the records
+            # already carry the host tag, so nothing re-registers them
+            from opentsdb_tpu.obs.registry import REGISTRY
+            text = REGISTRY.prometheus_text(
+                extra_records=self._collect(tsdb).records)
+            query.send_reply(
+                text,
+                content_type="text/plain; version=0.0.4; charset=utf-8")
+            return
         if endpoint == "query":
             if self.stats_registry is None:
                 raise BadRequestError("Query stats are not enabled",

@@ -73,6 +73,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.utils import faults
 from opentsdb_tpu.utils.retry import RetryPolicy, call_with_retries
 
@@ -312,13 +313,19 @@ def _sub_json(raw: TSQuery, index: int) -> dict:
     return body
 
 
-def _fetch_peer(peer: str, body: dict, timeout_s: float) -> list[dict]:
+def _fetch_peer(peer: str, body: dict, timeout_s: float,
+                trace_id: str | None = None) -> list[dict]:
     faults.check("cluster.peer_fetch", peer=peer)
+    headers = {"Content-Type": "application/json",
+               "X-TSDB-Cluster": "fanout"}
+    if trace_id:
+        # the receiving TSD adopts this id for ITS trace of the raw
+        # fetch — one clustered query, one trace id across every host
+        headers["X-TSDB-Trace-Id"] = trace_id
     req = urllib.request.Request(
         "http://%s/api/query" % peer,
         data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json",
-                 "X-TSDB-Cluster": "fanout"},
+        headers=headers,
         method="POST")
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         data = resp.read()
@@ -345,10 +352,30 @@ class PeerRejectedError(RuntimeError):
 
 
 def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
-                   body: dict) -> list[dict]:
+                   body: dict, span=None,
+                   trace_id: str | None = None) -> list[dict]:
     """One peer fetch under the full fault-tolerance stack: breaker
-    fast-fail, then retries with backoff inside the overall budget."""
+    fast-fail, then retries with backoff inside the overall budget.
+
+    `span` (an obs.trace.Span created by the submitting thread) records
+    the fetch's fate: retry count, final breaker state, and the error
+    when the peer lost — the annotations the degraded response's trace
+    carries so an operator can see WHY a 200 is partial."""
+    try:
+        return _guarded_fetch_inner(state, policy, peer, body, span,
+                                    trace_id)
+    finally:
+        if span is not None:
+            span.tags["breaker"] = state.breaker(peer).state
+            span.finish()
+
+
+def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
+                         peer: str, body: dict, span,
+                         trace_id: str | None) -> list[dict]:
     breaker = state.breaker(peer)
+    if span is not None:
+        span.tags.setdefault("retries", 0)
     start = time.monotonic()
     allowed = breaker.allow()
     if not allowed and breaker.probe_pending():
@@ -370,13 +397,15 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
                 policy, budget_s=max(policy.budget_s - waited, 0.1))
     if not allowed:
         state.count("fetch_failures")
-        raise BreakerOpenError(
+        err = BreakerOpenError(
             "peer %s circuit is open (%d consecutive failures; retry "
             "after cooldown)" % (peer, breaker.consecutive_failures))
+        obs_trace.annotate(span, error=str(err))
+        raise err
 
     def fetch(timeout_s: float) -> list[dict]:
         try:
-            return _fetch_peer(peer, body, timeout_s)
+            return _fetch_peer(peer, body, timeout_s, trace_id)
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500:
                 raise PeerRejectedError(
@@ -384,25 +413,31 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
                     % (peer, e.code)) from e
             raise
 
+    def on_retry(n: int, e: Exception) -> None:
+        state.count("fetch_retries")
+        if span is not None:
+            span.tags["retries"] = span.tags.get("retries", 0) + 1
+        LOG.warning("retrying peer %s (attempt %d failed: %s)",
+                    peer, n, e)
+
     try:
         result = call_with_retries(
             fetch, policy,
             no_retry_on=(PeerRejectedError,),
-            on_retry=lambda n, e: (
-                state.count("fetch_retries"),
-                LOG.warning("retrying peer %s (attempt %d failed: %s)",
-                            peer, n, e)))
-    except PeerRejectedError:
+            on_retry=on_retry)
+    except PeerRejectedError as e:
         # responsive peer: availability-wise a SUCCESS — crucially this
         # settles a half-open probe (otherwise _probing would stay set
         # forever and wedge the breaker half-open with every later
         # fetch busy-waiting on a verdict that never comes)
         breaker.record_success()
         state.count("fetch_failures")
+        obs_trace.annotate(span, error=str(e))
         raise
-    except Exception:
+    except Exception as e:
         breaker.record_failure()
         state.count("fetch_failures")
+        obs_trace.annotate(span, error=str(e))
         raise
     breaker.record_success()
     return result
@@ -482,6 +517,13 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     # extraction phase local_scan + max(peer_fetch) instead of the max)
     jobs = [(peer, i) for peer in peers for i in range(len(raw.queries))]
     pool = futures = None
+    # per-peer child spans are created HERE, on the thread that owns the
+    # trace (children lists are unlocked); the pool threads only finish
+    # and annotate their own span.  The trace id travels with every
+    # fetch so the peers' traces correlate.
+    tr = obs_trace.active()
+    parent = tr.current() if tr is not None else None
+    trace_id = tr.trace_id if tr is not None else None
     if jobs:
         # no context manager: in "error" mode a peer failure must return
         # its error NOW, not after every straggling in-flight fetch
@@ -489,9 +531,13 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
         # drops the queued ones; already-running urllib calls finish in
         # the background)
         pool = ThreadPoolExecutor(max_workers=min(len(jobs), 16))
-        futures = {pool.submit(_guarded_fetch, state, policy, peer,
-                               _sub_json(raw, i)): (peer, i)
-                   for peer, i in jobs}
+        futures = {}
+        for peer, i in jobs:
+            span = (parent.child("peer_fetch", peer=peer, subquery=i)
+                    if parent is not None else None)
+            futures[pool.submit(_guarded_fetch, state, policy, peer,
+                                _sub_json(raw, i), span,
+                                trace_id)] = (peer, i, span)
 
     failed_peers: set[str] = set()
     # local extraction: straight off this host's store/planner (objects,
@@ -500,7 +546,7 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
         for qr in tsdb.new_query_runner().run(raw):
             total += _ingest_series(scratch, qr.metric, qr.tags, qr.dps)
         if futures:
-            for fut, (peer, i) in futures.items():
+            for fut, (peer, i, _span) in futures.items():
                 try:
                     payload = fut.result()
                 except Exception as e:
@@ -525,6 +571,17 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     finally:
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
+        if futures:
+            # the error-mode early exit cancels queued fetches whose
+            # spans were created at submit time — close them out so the
+            # completed ring never renders a forever-climbing wallMs
+            for fut, (_peer, _i, span) in futures.items():
+                if span is not None and span.wall_ms is None:
+                    if fut.cancelled():
+                        span.tags.setdefault(
+                            "error", "cancelled: query aborted before "
+                                     "this fetch ran")
+                    span.finish()
     LOG.debug("cluster fan-out folded %d raw points from %d peers "
               "(%d failed)", total, len(peers), len(failed_peers))
     if failed_peers:

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
+from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.stats.query_stats import QueryStatsRegistry
 from opentsdb_tpu.tsd import admin_rpcs, rpcs
 from opentsdb_tpu.tsd.http import (BadRequestError, HttpQuery, HttpRequest,
@@ -40,6 +43,12 @@ class RpcManager:
         # guarded-by: _err_lock
         self.client_errors = 0          # 4xx envelopes sent
         self.server_errors = 0          # 5xx envelopes sent  # guarded-by: _err_lock
+        # register as a stats source on the TSDB so the self-report
+        # loop (obs/selfreport.py) sees the same ingest/error counters
+        # /api/stats serves; keyed so a replacement manager supersedes
+        if not hasattr(tsdb, "stats_hooks"):
+            tsdb.stats_hooks = {}
+        tsdb.stats_hooks["rpc_manager"] = self._stats_hook
 
     def _count_error(self, status: int) -> None:
         with self._err_lock:
@@ -54,6 +63,16 @@ class RpcManager:
         collector.record("http.errors", client, "family=4xx")
         collector.record("http.errors", server, "family=5xx")
 
+    def _stats_hook(self, collector) -> None:
+        """The self-report view of this manager: ingest RPC counters,
+        error envelopes, and the server's connection stats — exactly
+        what StatsRpc folds in for /api/stats."""
+        for rpc in self.ingest_rpcs:
+            rpc.collect_stats(collector)
+        self.collect_stats(collector)
+        if self.server is not None:
+            self.server.collect_stats(collector)
+
     def _initialize_builtin_rpcs(self) -> None:
         cfg = self.tsdb.config
         mode = self.tsdb.mode             # rw / ro / wo
@@ -64,7 +83,7 @@ class RpcManager:
         telnet = self.telnet_commands
         http = self.http_commands
 
-        stats = admin_rpcs.StatsRpc(self.query_stats, self.server)
+        stats = admin_rpcs.StatsRpc(self.query_stats)
         aggregators = admin_rpcs.ListAggregators()
         dropcaches = admin_rpcs.DropCachesRpc()
         version = admin_rpcs.VersionRpc()
@@ -96,7 +115,6 @@ class RpcManager:
         staticfile = admin_rpcs.StaticFileRpc()
         self.put_rpc = put
         self.ingest_rpcs = [put, rollups, histos]
-        stats.rpc_manager = self
 
         writes = mode in ("rw", "wo")
         reads = mode in ("rw", "ro")
@@ -179,6 +197,44 @@ class RpcManager:
 
     def handle_http(self, request: HttpRequest,
                     remote: str = "unknown") -> "HttpQuery":
+        """Trace + metrics envelope around the route dispatch.
+
+        When tsd.trace.enable is on every request gets a span tree
+        rooted here; an X-TSDB-Trace-Id header (a peer's fan-out, or
+        an operator correlating across TSDs) is adopted as the trace
+        id, so one clustered query is one id across every host."""
+        cfg = self.tsdb.config
+        trace = None
+        if cfg.get_bool("tsd.trace.enable"):
+            trace = obs_trace.Trace(
+                "http", trace_id=request.header(obs_trace.TRACE_HEADER),
+                device_time=cfg.get_bool("tsd.trace.device_time"))
+            trace.root.tags["method"] = request.method
+            trace.root.tags["path"] = request.path
+            obs_trace.activate(trace)
+        start = time.perf_counter()
+        try:
+            query = self._dispatch_http(request, remote)
+        finally:
+            if trace is not None:
+                obs_trace.deactivate()
+                trace.finish()
+        # route label clamped to the registered table: client-chosen
+        # paths must not mint unbounded label cardinality
+        route = query.base_route()
+        if route not in self.http_commands:
+            route = "other"
+        status = query.response.status if query.response is not None else 0
+        REGISTRY.counter(
+            "tsd.http.requests", "HTTP requests served").labels(
+                route=route, status=str(status)).inc()
+        REGISTRY.histogram(
+            "tsd.http.latency_ms", "HTTP request latency (ms)").labels(
+                route=route).observe((time.perf_counter() - start) * 1e3)
+        return query
+
+    def _dispatch_http(self, request: HttpRequest,
+                       remote: str = "unknown") -> "HttpQuery":
         query = HttpQuery(self.tsdb, request, remote)
         if request.method == "OPTIONS":
             # CORS preflight (RpcHandler.java:204-223): 200 + allow headers

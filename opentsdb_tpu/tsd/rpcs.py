@@ -17,6 +17,8 @@ import time
 
 from opentsdb_tpu.models.tsquery import (
     TSQuery, parse_m_subquery, parse_tsuid_subquery)
+from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.storage.memstore import Annotation
 from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
 from opentsdb_tpu.uid import NoSuchUniqueName
@@ -449,6 +451,11 @@ class QueryRpc(HttpRpc):
         ts_query.validate()
         qs = QueryStats(query.remote, ts_query_json(ts_query),
                         query.request.headers)
+        trace = obs_trace.active()
+        if trace is not None:
+            # the span tree rides the completed-query ring
+            # (/api/stats/query) alongside the flat milestone marks
+            qs.trace = trace
         if self.stats_registry is not None:
             try:
                 self.stats_registry.start(qs)
@@ -472,7 +479,10 @@ class QueryRpc(HttpRpc):
             if qs is not None:
                 qs.mark("aggregationTime")
                 qs.stats.update(exec_stats)
-            payload = query.serializer.format_query_v1(ts_query, results)
+            with obs_trace.stage("serialize") as ssp:
+                payload = query.serializer.format_query_v1(ts_query,
+                                                           results)
+                obs_trace.annotate(ssp, results=len(payload))
             from opentsdb_tpu.tsd.cluster import partial_annotation
             partial = partial_annotation(exec_stats)
             if partial:
@@ -489,15 +499,31 @@ class QueryRpc(HttpRpc):
                 }
                 if partial:
                     summary.update(partial)
+                if trace is not None and ts_query.show_stats:
+                    # the span tree inline, as of this instant — the
+                    # serialize span above is closed, the http root is
+                    # still open and renders elapsed-so-far
+                    summary["trace"] = trace.to_json()
                 payload.append({"statsSummary": summary})
             query.send_reply(payload)
+            REGISTRY.counter(
+                "tsd.query.count", "Queries served").labels(
+                    status="200").inc()
+            REGISTRY.histogram(
+                "tsd.query.latency_ms",
+                "End-to-end /api/query latency (ms)").observe(
+                    query.elapsed_ms())
             if qs is not None and self.stats_registry is not None:
                 qs.mark("serializationTime")
                 self.stats_registry.finish(qs, 200)
         except Exception as e:
+            from opentsdb_tpu.tsd.http import error_status
+            status = error_status(e)
+            REGISTRY.counter(
+                "tsd.query.count", "Queries served").labels(
+                    status=str(status)).inc()
             if qs is not None and self.stats_registry is not None:
-                from opentsdb_tpu.tsd.http import error_status
-                self.stats_registry.finish(qs, error_status(e), str(e))
+                self.stats_registry.finish(qs, status, str(e))
             raise
 
     def _delete(self, tsdb, ts_query: TSQuery) -> int:

@@ -81,6 +81,12 @@ class TSDServer:
         install_log_buffer()
         self.rpc_manager = RpcManager(tsdb, server=self,
                                       shutdown_cb=self.request_shutdown)
+        self._compile_counting = tsdb.config.get_bool("tsd.trace.enable")
+        if self._compile_counting:
+            # per-kernel XLA compile counters (tsd.jax.compiles at
+            # /api/stats/prometheus) — the same capture tsdbsan uses
+            from opentsdb_tpu.obs import jaxprof
+            jaxprof.start_compile_counting()
         self.connections_established = 0  # guarded-by: _conn_lock
         self.connections_rejected = 0  # guarded-by: _conn_lock
         self.exceptions_caught = 0
@@ -155,6 +161,10 @@ class TSDServer:
         deadline = loop.time() + 5.0
         while self._inflight_rpcs and loop.time() < deadline:
             await asyncio.sleep(0.02)
+        if self._compile_counting:
+            from opentsdb_tpu.obs import jaxprof
+            jaxprof.stop_compile_counting()
+            self._compile_counting = False
         self.tsdb.shutdown()
         LOG.info("Server shut down")
 

@@ -135,6 +135,22 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "str", "", "Write the sanitizer findings report here at "
         "daemon shutdown (JSON, or SARIF when the path ends in "
         ".sarif).  Empty = no report artifact."),
+    # -- observability (opentsdb_tpu/obs/, docs/observability.md) ------ #
+    "tsd.trace.enable": _e(
+        "bool", True, "Trace query serving: a span tree per request "
+        "(scan/pipeline stages, cluster fan-out with retry/breaker "
+        "annotations) surfaced inline via showStats and in the "
+        "/api/stats/query ring."),
+    "tsd.trace.device_time": _e(
+        "bool", True, "Record per-stage device time on traced requests "
+        "by syncing on stage outputs at stage boundaries "
+        "(block_until_ready; a sanctioned sync site).  False keeps "
+        "spans wall-time-only and dispatches fully asynchronous."),
+    "tsd.stats.interval": _e(
+        "int", "0", "Seconds between self-report passes writing the "
+        "daemon's own tsd.* metrics into its local store through the "
+        "normal ingest path (0 = disabled).  The TSD becomes queryable "
+        "about itself via ordinary /api/query."),
     # -- core ---------------------------------------------------------- #
     "tsd.core.authentication.enable": _e(
         "bool", False, "Require telnet/HTTP authentication."),

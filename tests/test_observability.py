@@ -1,0 +1,394 @@
+"""tsdbobs surface tests: span trees, Prometheus exposition, histogram
+quantiles, the self-report loop, and the stats-collector fixes.
+
+No mesh/shard_map anywhere — those fail at HEAD in this environment, so
+every TSDB here pins tsd.query.mesh.enable=false.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import time
+
+import numpy as np
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.obs.histogram import LogHistogram
+from opentsdb_tpu.obs.registry import (MetricsRegistry, escape_label_value,
+                                       sanitize_name)
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def tsdb():
+    t = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                     "tsd.query.mesh.enable": False}))
+    for host in ("web01", "web02"):
+        for i in range(20):
+            t.add_point("obs.cpu", BASE + i * 10, float(i), {"host": host})
+    return t
+
+
+@pytest.fixture
+def manager(tsdb):
+    return RpcManager(tsdb)
+
+
+def http(manager, method, uri, body=None, headers=None):
+    data = b"" if body is None else (
+        body if isinstance(body, bytes) else json.dumps(body).encode())
+    hdrs = {"content-type": "application/json"}
+    hdrs.update(headers or {})
+    return manager.handle_http(
+        HttpRequest(method=method, uri=uri, body=data, headers=hdrs),
+        remote="127.0.0.1:55").response
+
+
+def span_names(tree: dict) -> set[str]:
+    out = {tree["name"]}
+    for child in tree.get("spans", []):
+        out |= span_names(child)
+    return out
+
+
+def find_spans(tree: dict, name: str) -> list[dict]:
+    out = [tree] if tree.get("name") == name else []
+    for child in tree.get("spans", []):
+        out.extend(find_spans(child, name))
+    return out
+
+
+class TestSpanTree:
+    def _trace_of(self, response) -> dict:
+        payload = json.loads(response.body)
+        summaries = [e for e in payload
+                     if isinstance(e, dict) and "statsSummary" in e]
+        assert summaries, "show_stats must append a statsSummary entry"
+        summary = summaries[0]["statsSummary"]
+        assert "trace" in summary, "traced query must inline its span tree"
+        return summary["trace"]
+
+    def test_e2e_downsample_query_covers_every_stage(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d"
+                 "&m=sum:30s-avg:obs.cpu{host=*}&show_stats"
+                 % (BASE, BASE + 300))
+        assert r.status == 200
+        tree = self._trace_of(r)
+        names = span_names(tree)
+        for stage in ("scan", "pipeline", "downsample", "groupby",
+                      "aggregate", "extract", "serialize"):
+            assert stage in names, "missing %s in %s" % (stage, names)
+        # every span carries wall + device time
+        def walk(node):
+            assert isinstance(node["wallMs"], float)
+            assert isinstance(node["deviceMs"], float)
+            for c in node.get("spans", []):
+                walk(c)
+        walk(tree)
+        # the fused dispatch's stage children are honest about being
+        # costmodel-apportioned
+        for child in find_spans(tree, "downsample"):
+            assert child["tags"]["estimated"] is True
+        assert re.fullmatch(r"[0-9a-f]{16}", tree["traceId"])
+
+    def test_rate_query_gets_a_rate_span(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d"
+                 "&m=sum:30s-avg:rate:obs.cpu&show_stats"
+                 % (BASE, BASE + 300))
+        assert "rate" in span_names(self._trace_of(r))
+
+    def test_union_query_traces_pipeline_and_aggregate(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d&m=sum:obs.cpu&show_stats"
+                 % (BASE, BASE + 300))
+        names = span_names(self._trace_of(r))
+        assert {"scan", "pipeline", "aggregate", "serialize"} <= names
+
+    def test_trace_id_header_is_adopted(self, manager):
+        r = http(manager, "GET",
+                 "/api/query?start=%d&m=sum:obs.cpu&show_stats" % BASE,
+                 headers={"x-tsdb-trace-id": "cafe0123cafe0123"})
+        assert self._trace_of(r)["traceId"] == "cafe0123cafe0123"
+
+    def test_trace_lands_in_query_stats_ring(self, manager):
+        http(manager, "GET",
+             "/api/query?start=%d&m=sum:30s-avg:obs.cpu" % BASE)
+        r = http(manager, "GET", "/api/stats/query")
+        completed = json.loads(r.body)["completed"]
+        assert completed and "trace" in completed[0]
+        assert "scan" in span_names(completed[0]["trace"])
+
+    def test_trace_disabled_serves_without_spans(self, tsdb, manager):
+        tsdb.config.override_config("tsd.trace.enable", False)
+        r = http(manager, "GET",
+                 "/api/query?start=%d&m=sum:obs.cpu&show_stats" % BASE)
+        assert r.status == 200
+        payload = json.loads(r.body)
+        summary = [e for e in payload if "statsSummary" in e][0]
+        assert "trace" not in summary["statsSummary"]
+
+    def test_costmodel_segments_recorded(self, manager):
+        from opentsdb_tpu.obs import jaxprof
+        jaxprof.clear_segments()
+        http(manager, "GET",
+             "/api/query?start=%d&end=%d&m=sum:30s-avg:obs.cpu"
+             % (BASE, BASE + 300))
+        segs = jaxprof.segments()
+        assert segs, "a traced grouped dispatch must record its segment"
+        seg = segs[-1]
+        assert seg["kind"] == "raw" and seg["series"] == 2
+        assert seg["predictedMs"] > 0 and seg["actualMs"] >= 0
+
+
+class TestPrometheus:
+    SAMPLE = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+        r'(\{[a-zA-Z0-9_]+="(\\.|[^"\\])*"(,[a-zA-Z0-9_]+="(\\.|[^"\\])*")*'
+        r"(,le=\"[^\"]+\")?\})? (NaN|[-+]?Inf|[-+0-9.eE]+)$")
+
+    def _scrape(self, manager):
+        # serve a query first so latency histograms hold observations
+        http(manager, "GET",
+             "/api/query?start=%d&m=sum:30s-avg:obs.cpu" % BASE)
+        r = http(manager, "GET", "/api/stats/prometheus")
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.body.decode()
+
+    def test_exposition_is_scrapeable(self, manager):
+        text = self._scrape(manager)
+        assert text.endswith("\n")
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert self.SAMPLE.match(line), "unscrapeable line: %r" % line
+
+    def test_counters_gauges_histograms_present(self, tsdb, manager):
+        from opentsdb_tpu.tsd import cluster
+        cluster._state(tsdb).breaker("10.0.0.1:4242")  # surface breakers
+        text = self._scrape(manager)
+        assert "# TYPE tsd_http_requests_total counter" in text
+        assert "# TYPE tsd_http_latency_ms histogram" in text
+        assert "# TYPE tsd_query_device_cache_hits gauge" in text
+        assert "tsd_cluster_breaker_state" in text
+        assert 'peer="10.0.0.1:4242"' in text
+
+    def test_histogram_triplets_are_consistent(self, manager):
+        text = self._scrape(manager)
+        buckets = [ln for ln in text.splitlines()
+                   if ln.startswith("tsd_query_latency_ms_bucket")]
+        count_line = [ln for ln in text.splitlines()
+                      if ln.startswith("tsd_query_latency_ms_count")]
+        sum_line = [ln for ln in text.splitlines()
+                    if ln.startswith("tsd_query_latency_ms_sum")]
+        assert buckets and count_line and sum_line
+        inf = [ln for ln in buckets if 'le="+Inf"' in ln]
+        assert inf, "+Inf bucket required"
+        count = int(count_line[0].rsplit(" ", 1)[1])
+        assert int(inf[0].rsplit(" ", 1)[1]) == count >= 1
+        # cumulative counts are non-decreasing
+        values = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+        assert values == sorted(values)
+        assert float(sum_line[0].rsplit(" ", 1)[1]) >= 0
+
+    def test_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("odd.metric", "quotes").labels(
+            tag='a"b\\c\nd').inc()
+        text = reg.prometheus_text()
+        assert 'tag="a\\"b\\\\c\\nd"' in text
+        assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+        assert sanitize_name("tsd.uid.cache-hit") == "tsd_uid_cache_hit"
+
+    def test_kind_collision_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("x.y")
+        with pytest.raises(ValueError):
+            reg.gauge("x.y")
+
+    def test_update_device_gauges_for_embedders(self, tsdb):
+        """The registry-only export path (no TSD stats walk)."""
+        from opentsdb_tpu.obs import jaxprof
+        from opentsdb_tpu.obs.registry import REGISTRY
+        jaxprof.update_device_gauges(tsdb)
+        text = REGISTRY.prometheus_text()
+        assert "tsd_query_device_cache_hits" in text
+
+
+class TestLogHistogram:
+    GROWTH = 2 ** 0.25
+
+    def _check(self, values, qs=(0.5, 0.9, 0.99)):
+        h = LogHistogram()
+        for v in values:
+            h.observe(float(v))
+        tol = self.GROWTH * 1.001
+        for q in qs:
+            true = float(np.quantile(values, q, method="inverted_cdf"))
+            est = h.quantile(q)
+            if true <= h.lo:
+                assert est <= h.lo * tol
+                continue
+            assert true / tol <= est <= true * tol, (
+                "q=%s: est %g vs true %g" % (q, est, true))
+
+    def test_lognormal_heavy_tail(self):
+        rng = np.random.default_rng(7)
+        self._check(rng.lognormal(0.0, 2.5, 20_000))
+
+    def test_pareto_power_law(self):
+        rng = np.random.default_rng(11)
+        self._check(rng.pareto(0.7, 20_000) + 1e-2)
+
+    def test_adversarial_bimodal_six_decades_apart(self):
+        rng = np.random.default_rng(13)
+        vals = np.concatenate([
+            rng.uniform(0.002, 0.004, 10_000),
+            rng.uniform(2_000.0, 4_000.0, 101),   # tail just past p99
+        ])
+        rng.shuffle(vals)
+        self._check(vals, qs=(0.5, 0.9, 0.999))
+
+    def test_constant_and_single_value(self):
+        self._check(np.full(1000, 42.0))
+        h = LogHistogram()
+        assert math.isnan(h.quantile(0.5))
+        h.observe(5.0)
+        tol = self.GROWTH * 1.001
+        assert 5.0 / tol <= h.quantile(0.5) <= 5.0 * tol
+
+    def test_merge_equals_single_pass(self):
+        rng = np.random.default_rng(3)
+        vals = rng.lognormal(1.0, 2.0, 8_000)
+        whole = LogHistogram()
+        merged = LogHistogram()
+        shards = [LogHistogram() for _ in range(4)]
+        for i, v in enumerate(vals):
+            whole.observe(float(v))
+            shards[i % 4].observe(float(v))
+        for s in shards:
+            merged.merge(s)
+        m_counts, m_count, m_total = merged.snapshot()
+        w_counts, w_count, w_total = whole.snapshot()
+        assert (m_counts, m_count) == (w_counts, w_count)
+        assert m_total == pytest.approx(w_total)  # fp summation order
+
+    def test_merge_layout_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            LogHistogram().merge(LogHistogram(buckets=12))
+
+    def test_cumulative_is_aligned_and_bounded(self):
+        h = LogHistogram()
+        for v in (0.5, 3.0, 900.0, 1e9):
+            h.observe(v)
+        cum = h.cumulative(max_buckets=16)
+        assert len(cum) <= 17
+        assert cum[-1][0] == math.inf and cum[-1][1] == 4
+        counts = [c for _, c in cum]
+        assert counts == sorted(counts)
+
+
+class TestSelfReport:
+    def test_records_land_in_memstore_and_are_queryable(self, tsdb,
+                                                        manager):
+        from opentsdb_tpu.obs.selfreport import self_report
+        from opentsdb_tpu.tsd import cluster
+        cluster._state(tsdb).breaker("10.0.0.1:4242")  # ':' needs mapping
+        before = tsdb.store.num_series
+        n = self_report(tsdb)
+        assert n > 10
+        assert tsdb.store.num_series > before
+        # queryable through the TSD's own pipeline
+        r = http(manager, "GET",
+                 "/api/query?start=%d&end=%d&m=sum:tsd.datapoints.added"
+                 % (BASE, int(time.time()) + 60))
+        assert r.status == 200
+        series = json.loads(r.body)
+        assert series and series[0]["metric"] == "tsd.datapoints.added"
+        assert list(series[0]["dps"].values())[0] >= 40
+
+    def test_read_only_daemon_skips(self):
+        t = TSDB(Config({"tsd.mode": "ro",
+                         "tsd.query.mesh.enable": False}))
+        from opentsdb_tpu.obs.selfreport import self_report
+        assert self_report(t) == 0
+
+    def test_maintenance_cadence_gated_by_interval(self, tsdb):
+        from opentsdb_tpu.core.maintenance import MaintenanceThread
+        mt = MaintenanceThread(tsdb)      # interval 0: disabled
+        mt._maybe_self_report(mt._next_self_report + 10)
+        assert mt.self_reports == 0
+        tsdb.config.override_config("tsd.stats.interval", 30)
+        mt2 = MaintenanceThread(tsdb)
+        mt2._maybe_self_report(mt2._next_self_report + 1)
+        assert mt2.self_reports == 1 and mt2.self_report_points > 0
+        assert mt2.self_report_errors == 0
+        stats = mt2.collect_stats()
+        assert stats["tsd.maintenance.self_reports"] == 1
+
+    def test_stats_rpc_and_self_report_share_one_walk(self, tsdb,
+                                                      manager):
+        """The dogfooded series must be the records /api/stats serves."""
+        from opentsdb_tpu.obs.selfreport import collect_all
+        names = {r["metric"] for r in collect_all(tsdb).records}
+        # the RpcManager hook's counters are in the shared walk
+        assert "tsd.http.errors" in names
+        assert "tsd.rpc.received" in names
+        via_api = {r["metric"]
+                   for r in json.loads(
+                       http(manager, "GET", "/api/stats").body)}
+        assert via_api == {r["metric"]
+                           for r in collect_all(tsdb).records}
+
+
+class TestCollectorXtratag:
+    def test_multi_equals_rejected(self):
+        from opentsdb_tpu.stats import StatsCollector
+        c = StatsCollector("tsd", use_host_tag=False)
+        with pytest.raises(ValueError, match="multiple '=' signs or none"):
+            c.record("x", 1, "a=b=c")
+
+    def test_no_equals_still_rejected(self):
+        from opentsdb_tpu.stats import StatsCollector
+        c = StatsCollector("tsd", use_host_tag=False)
+        with pytest.raises(ValueError):
+            c.record("x", 1, "ab")
+
+    def test_single_equals_accepted(self):
+        from opentsdb_tpu.stats import StatsCollector
+        c = StatsCollector("tsd", use_host_tag=False)
+        c.record("x", 1, "kind=put")
+        assert c.records[0]["tags"] == {"kind": "put"}
+
+
+class TestCompileCapture:
+    def test_profiler_and_sanitizer_share_the_stream(self):
+        """One compile event reaches BOTH subscribers — the can't-drift
+        contract behind moving the capture into obs/jaxprof.py."""
+        import jax
+        from opentsdb_tpu.obs import jaxprof
+
+        seen: list[str] = []
+        cb = seen.append          # one object: unsubscribe must match
+        jaxprof.compile_capture.subscribe(cb)
+        jaxprof.start_compile_counting()
+        try:
+            before = dict(jaxprof.compile_counts())
+            fresh = jax.jit(lambda x: x * 3 + 1)
+            fresh(jax.numpy.arange(7))
+            assert seen, "capture saw no compile for a fresh jit"
+            grew = [k for k, v in jaxprof.compile_counts().items()
+                    if v > before.get(k, 0)]
+            assert grew, "counter subscriber missed the same event"
+        finally:
+            jaxprof.stop_compile_counting()
+            jaxprof.compile_capture.unsubscribe(cb)

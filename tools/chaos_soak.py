@@ -212,7 +212,10 @@ def seed_host(port, host, value):
 
 
 def query(port):
+    # show_stats: every response carries its span tree so the fault
+    # rounds can assert the degraded trace is annotated (tsdbobs)
     url = ("http://127.0.0.1:%d/api/query?start=%d&end=%d&m=sum:chaos.m"
+           "&show_stats"
            % (port, BASE - 1, BASE + 600))
     try:
         with urllib.request.urlopen(url, timeout=30) as resp:
@@ -237,6 +240,29 @@ def classify(payload):
     return "wrong", dps
 
 
+def degraded_trace_annotated(payload) -> bool:
+    """True when the response's span tree holds a failed peer_fetch
+    span annotated with retry count + breaker state — the trace
+    contract for degraded serving (tsdbobs): a partial 200 must say in
+    its own trace WHICH peer lost and what the fault stack did."""
+    summary = next((e["statsSummary"] for e in payload
+                    if isinstance(e, dict) and "statsSummary" in e), None)
+    if not summary or "trace" not in summary:
+        return False
+
+    def walk(span):
+        yield span
+        for child in span.get("spans", []):
+            yield from walk(child)
+
+    for span in walk(summary["trace"]):
+        tags = span.get("tags", {})
+        if (span.get("name") == "peer_fetch" and tags.get("error")
+                and "retries" in tags and "breaker" in tags):
+            return True
+    return False
+
+
 def run_phase(mode: str, rounds: int, rng, peer_port: int,
               recv_port: int, san: bool = False) -> dict:
     proxy = FaultProxy(peer_port)
@@ -249,6 +275,7 @@ def run_phase(mode: str, rounds: int, rng, peer_port: int,
         "tsd.network.cluster.partial_results": mode,
     }, san=san, role="receiver-%s" % mode)
     tally = {"full": 0, "partial": 0, "5xx": 0}
+    annotated_partials = 0
     try:
         seed_host(recv_port, "local", 1)
         counts = []
@@ -271,7 +298,16 @@ def run_phase(mode: str, rounds: int, rng, peer_port: int,
                       % (mode, i, proxy.fault, kind, dps), flush=True)
                 raise SystemExit(1)
             tally[kind] += 1
+            if kind == "partial" and degraded_trace_annotated(payload):
+                annotated_partials += 1
             counts.append((proxy.fault, kind))
+        if tally["partial"] and annotated_partials != tally["partial"]:
+            print("[%s] only %d of %d partial responses carried an "
+                  "annotated failed peer_fetch span (retries + breaker "
+                  "state) — degraded traces are going dark"
+                  % (mode, annotated_partials, tally["partial"]),
+                  flush=True)
+            raise SystemExit(1)
         # heal check: clean proxy, wait out the breaker cooldown, and
         # the cluster must answer FULL again
         proxy.fault = "ok"

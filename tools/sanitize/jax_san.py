@@ -6,13 +6,16 @@ query path.  tsdblint's jax_hygiene analyzer proves the *shape* of the
 code (no per-call jit construction, no `.item()` on traced values);
 this module proves the *behavior*:
 
-  compile accounting   `jax_log_compiles` is enabled and the pxla
-        "Compiling <kernel> ..." records are captured by a logging
-        handler.  The run has two phases: warmup (compiles are
+  compile accounting   subscribes to the SHARED compile-log capture
+        (opentsdb_tpu/obs/jaxprof.py CompileLogCapture — the same
+        event stream tsdbobs's per-kernel compile counters consume, so
+        the profiler and the sanitizer cannot drift).  The capture owns
+        `jax_log_compiles` and the pxla "Compiling <kernel> ..."
+        logging handler.  The run has two phases: warmup (compiles are
         expected and counted) and steady (entered via `mark_steady()`).
         Any compile event in steady state is a finding
         (san-recompile-after-warmup) attributed to the repo call site
-        that triggered it — the handler runs synchronously in the
+        that triggered it — subscribers run synchronously in the
         compiling thread, so the stack still shows who asked.
   host-sync accounting  ArrayImpl's device->host surfaces (`__array__`,
         `item`, `tolist`, `__float__`, `__int__`, `__bool__`,
@@ -33,15 +36,11 @@ off this module costs nothing.
 
 from __future__ import annotations
 
-import logging
-import re
 import sys
 import threading
 
+from opentsdb_tpu.obs.jaxprof import compile_capture
 from tools.sanitize.report import REPORTER, caller_site
-
-_COMPILING = re.compile(r"Compiling (\S+) with global")
-_PXLA_LOGGER = "jax._src.interpreters.pxla"
 
 # (path suffix, function-name prefix) pairs whose presence anywhere on
 # the stack sanctions a host sync: the serialization boundary and the
@@ -52,6 +51,10 @@ SANCTIONED_SITES: list[tuple[str, str]] = [
     ("opentsdb_tpu/tsd/serializers.py", ""),
     ("opentsdb_tpu/query/planner.py", "_materialize"),
     ("opentsdb_tpu/ops/hostlane.py", ""),
+    # the tracer's device_wait: per-stage device timing is a DELIBERATE
+    # stage-boundary rendezvous (tsd.trace.device_time) — the one sync
+    # the trace path is allowed
+    ("opentsdb_tpu/obs/trace.py", ""),
 ]
 
 _tls = threading.local()
@@ -86,21 +89,6 @@ def _at_sanctioned_site() -> bool:
     return False
 
 
-class _CompileHandler(logging.Handler):
-    def __init__(self, san: "JaxSanitizer") -> None:
-        super().__init__(level=logging.DEBUG)
-        self._san = san
-
-    def emit(self, record: logging.LogRecord) -> None:
-        try:
-            msg = record.getMessage()
-        except Exception:       # noqa: BLE001
-            return
-        m = _COMPILING.match(msg)
-        if m:
-            self._san._on_compile(m.group(1))
-
-
 class JaxSanitizer:
     """One installable instance (tools/sanitize/install.py owns it)."""
 
@@ -109,29 +97,24 @@ class JaxSanitizer:
         self.phase = "warmup"
         self.compiles: dict[str, dict[str, int]] = {}
         self.host_syncs: dict[str, int] = {}
-        self._handler: _CompileHandler | None = None
-        self._log_compiles_prev = None
+        self._subscribed = False
         self._array_patches: list[tuple[type, str, object]] = []
 
     # -- lifecycle --
 
     def start(self) -> None:
-        import jax
         self.phase = "warmup"
-        self._log_compiles_prev = jax.config.jax_log_compiles
-        jax.config.update("jax_log_compiles", True)
-        self._handler = _CompileHandler(self)
-        logging.getLogger(_PXLA_LOGGER).addHandler(self._handler)
+        if not self._subscribed:
+            # the shared capture (obs/jaxprof.py) owns jax_log_compiles
+            # and the pxla handler; this instance just subscribes
+            compile_capture.subscribe(self._on_compile)
+            self._subscribed = True
         self._patch_array_type()
 
     def stop(self) -> None:
-        import jax
-        if self._handler is not None:
-            logging.getLogger(_PXLA_LOGGER).removeHandler(self._handler)
-            self._handler = None
-        if self._log_compiles_prev is not None:
-            jax.config.update("jax_log_compiles", self._log_compiles_prev)
-            self._log_compiles_prev = None
+        if self._subscribed:
+            compile_capture.unsubscribe(self._on_compile)
+            self._subscribed = False
         for cls, name, orig in self._array_patches:
             setattr(cls, name, orig)
         self._array_patches = []

@@ -67,7 +67,11 @@ def rel_path(abspath: str, root: str = REPO_ROOT) -> str:
 
 
 _SKIP_DIRS = (os.sep + "tools" + os.sep + "sanitize" + os.sep,)
-_SKIP_MODULES = ("threading.py", "logging/__init__.py")
+# obs/jaxprof.py hosts the shared compile-log capture the sanitizer
+# subscribes through — its dispatch frames are machinery, not the site
+# that triggered the compile
+_SKIP_MODULES = ("threading.py", "logging/__init__.py",
+                 "obs/jaxprof.py")
 
 
 def caller_site(skip: int = 0) -> tuple[str, int, str]:
