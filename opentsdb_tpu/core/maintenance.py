@@ -59,6 +59,7 @@ class MaintenanceThread(threading.Thread):
         self.self_reports = 0
         self.self_report_errors = 0
         self.self_report_points = 0
+        self.autotune_passes = 0
 
     # ------------------------------------------------------------------ #
 
@@ -71,6 +72,7 @@ class MaintenanceThread(threading.Thread):
                 self._maybe_snapshot(now)
                 self._maybe_refresh_device_cache()
                 self._maybe_self_report(now)
+                self._maybe_autotune(now)
             except Exception:
                 LOG.exception("maintenance pass failed")
 
@@ -135,6 +137,15 @@ class MaintenanceThread(threading.Thread):
             self.self_report_errors += 1
             LOG.exception("self-report pass failed")
 
+    def _maybe_autotune(self, now: float) -> None:
+        """tsd.costmodel.autotune.* cadence: one OnlineCalibrator tick
+        (fit from the segment ring, install live constants, maybe
+        explore — ops/calibrate.py).  The calibrator rate-limits
+        itself; this just forwards the heartbeat."""
+        calibrator = getattr(self.tsdb, "autotuner", None)
+        if calibrator is not None and calibrator.tick(now):
+            self.autotune_passes += 1
+
     def _maybe_snapshot(self, now: float) -> None:
         if self.snapshot_interval <= 0 or now < self._next_snapshot:
             return
@@ -161,4 +172,5 @@ class MaintenanceThread(threading.Thread):
             "tsd.maintenance.self_reports": self.self_reports,
             "tsd.maintenance.self_report_errors": self.self_report_errors,
             "tsd.maintenance.self_report_points": self.self_report_points,
+            "tsd.maintenance.autotune_passes": self.autotune_passes,
         }

@@ -127,6 +127,14 @@ class TSDB:
         self.authentication = None
         self.startup_plugin = None
         self.mode = self.config.get_string("tsd.mode")  # rw / ro / wo
+        # online costmodel calibration (ops/calibrate.py): fits the
+        # kernel-strategy constants from the live segment ring on the
+        # maintenance cadence; ticked by MaintenanceThread, persisted
+        # at shutdown
+        self.autotuner = None
+        if self.config.get_bool("tsd.costmodel.autotune.enable"):
+            from opentsdb_tpu.ops.calibrate import OnlineCalibrator
+            self.autotuner = OnlineCalibrator(self)
         from opentsdb_tpu.plugins import initialize_plugins
         initialize_plugins(self)
         self.start_time = time.time()
@@ -944,6 +952,15 @@ class TSDB:
         if self.maintenance is not None:
             self.maintenance.stop(final_flush=False)
             self.maintenance = None
+        if self.autotuner is not None:
+            # detach FIRST: a maintenance pass that outlived the 5s
+            # join timeout must find no autotuner to tick, or it could
+            # re-force a kernel mode after the restore below (and a
+            # second shutdown() must not re-run persist/teardown)
+            autotuner, self.autotuner = self.autotuner, None
+            # restore any exploration override and persist the fitted
+            # constants so calibration survives the restart
+            autotuner.shutdown()
         self.flush()
         if self.persistence is not None:
             with self._ingest_lock:

@@ -10,15 +10,19 @@ JaxSanitizer (tools/sanitize/jax_san.py) subscribe to the same capture,
 so the profiler and the sanitizer can never disagree about what
 compiled — one regex, one handler, one event stream.
 
-Costmodel feedback.  ops/costmodel.py predicts per-stage dispatch costs
-from calibrated per-unit constants; until now the predictions were
-consulted (kernel-mode argmin) but never compared to reality.
-`record_segment()` keeps a ring of (shape, predicted, actual) per query
-segment plus running totals in the metrics registry — the raw feedback
-a later calibration PR needs to close the loop.  `stage_breakdown()`
-exposes the same predictions per logical pipeline stage; the tracer
-uses it to apportion a fused dispatch's measured device time across
-downsample/rate/groupby/aggregate children (tagged estimated).
+Costmodel feedback — the loop is CLOSED (PR 6).  ops/costmodel.py
+predicts per-stage dispatch costs from calibrated per-unit constants;
+`record_segment()` keeps a ring of (shape, chosen modes, feature
+vector, predicted, actual) per query segment plus running totals in
+the metrics registry.  ops/calibrate.py consumes the ring: it solves
+the per-unit constants by non-negative least squares over the feature
+vectors and installs them as the costmodel's live override layer, so
+a daemon's strategy argmin converges to what its own traffic measures.
+`segment_decisions()` recomputes the per-axis strategy decisions
+through the same choosers the kernels consult (the trace annotates
+them per segment), and `stage_breakdown()` apportions a fused
+dispatch's measured device time across downsample/rate/groupby/
+aggregate children (tagged estimated).
 """
 
 from __future__ import annotations
@@ -195,52 +199,141 @@ _seg_lock = threading.Lock()
 _segments: deque = deque(maxlen=SEGMENT_RING)
 
 
-def stage_breakdown(platform: str, s: int, n: int, w: int, g: int,
-                    ds_function: str | None,
-                    has_rate: bool) -> dict[str, float]:
-    """Predicted seconds per logical pipeline stage for one grouped
-    dispatch of shape [s series, n points] -> [w windows, g groups],
-    using the calibrated costmodel with the same argmin mode choices
-    the kernels make.  Approximate by design — this is the PREDICTED
-    side of the predicted-vs-actual ledger, not a timer."""
+def segment_decisions(platform: str, s: int, n: int, w: int, g: int,
+                      ds_function: str | None,
+                      aggregator: str | None = None) -> dict[str, dict]:
+    """The kernel strategy decisions one grouped dispatch of shape
+    [s series, n points] -> [w windows, g groups] makes, per kernel
+    axis — recomputed through the SAME `_effective_*` choosers the
+    kernels consult at trace time, so the report cannot drift from the
+    dispatched modes.  Keys: 'search', 'scan' OR 'extreme' (by the
+    DOWNSAMPLE function — it picks the windowed-reduce kernel),
+    'group'; values are decision reports (chosen mode, per-candidate
+    predicted ms, source — see downsample.search_decision).
+
+    The group axis's extremes flag comes from the CROSS-SERIES
+    `aggregator` — that is what moment_group_reduce keys its kernel
+    (and the matmul candidacy) on; a `max:10s-avg:` query downsamples
+    with the scan path but group-reduces as an extreme.  When the
+    aggregator is unknown (offline recomputation from a bare shape)
+    the downsample function is the fallback."""
+    from opentsdb_tpu.ops import downsample as ds
+    from opentsdb_tpu.ops import group_agg as ga
+    s = max(int(s), 1)
+    n = max(int(n), 1)
+    w = max(int(w), 1)
+    g = max(int(g), 1)
+    e = w + 1
+    extremes = ds_function in ("min", "max", "mimmin", "mimmax")
+    group_extremes = (aggregator in ("min", "max", "mimmin", "mimmax")
+                      if aggregator is not None else extremes)
+    out = {"search": ds.search_decision(s, n, e, platform)}
+    if extremes:
+        out["extreme"] = ds.extreme_decision(n, w, platform)
+    else:
+        out["scan"] = ds.scan_decision(s, n, e, platform)
+    out["group"] = ga.group_decision(s, w, g, platform,
+                                     extremes=group_extremes)
+    return out
+
+
+def segment_features(platform: str, s: int, n: int, w: int, g: int,
+                     has_rate: bool,
+                     decisions: dict[str, dict]) -> dict[str, float]:
+    """The per-unit-cost feature vector of one dispatch under its CHOSEN
+    modes: unit counts per costmodel term, summed across the pipeline
+    stages.  `dot(features, costmodel.costs(platform))` is the
+    dispatch's predicted seconds; the fitter regresses measured device
+    seconds onto exactly these vectors (ops/calibrate.py)."""
     from opentsdb_tpu.ops import costmodel as cm
     s = max(int(s), 1)
     n = max(int(n), 1)
     w = max(int(w), 1)
     g = max(int(g), 1)
     e = w + 1
+    features: dict[str, float] = {}
+
+    def add(fv: dict[str, float]) -> None:
+        for term, units in fv.items():
+            features[term] = features.get(term, 0.0) + units
+
+    add(cm.features_search(decisions["search"]["mode"], s, n, e))
+    if "extreme" in decisions:
+        add(cm.features_extreme(decisions["extreme"]["mode"], s, n, e))
+    else:
+        add(cm.features_scan(decisions["scan"]["mode"], s, n, e))
+    add(cm.features_group(decisions["group"]["mode"], s, w, g))
+    # rate + final aggregate: elementwise passes over the [*, W] grids
+    add({"elem_f64": float(g * w + (s * w if has_rate else 0))})
+    return features
+
+
+def stage_breakdown(platform: str, s: int, n: int, w: int, g: int,
+                    ds_function: str | None, has_rate: bool,
+                    decisions: dict[str, dict] | None = None
+                    ) -> dict[str, float]:
+    """Predicted seconds per logical pipeline stage for one grouped
+    dispatch, using the calibrated costmodel under the modes the
+    kernels actually chose (`decisions`; recomputed here when absent).
+    Approximate by design — this is the PREDICTED side of the
+    predicted-vs-actual ledger, not a timer."""
+    from opentsdb_tpu.ops import costmodel as cm
+    s = max(int(s), 1)
+    n = max(int(n), 1)
+    w = max(int(w), 1)
+    g = max(int(g), 1)
+    e = w + 1
+    if decisions is None:
+        decisions = segment_decisions(platform, s, n, w, g, ds_function)
     elem = cm.costs(platform)["elem_f64"]
     out: dict[str, float] = {}
-    search = min(cm.predict_search(m, s, n, e, platform)
-                 for m in ("scan", "compare_all", "hier"))
-    if ds_function in ("min", "max", "mimmin", "mimmax"):
-        reduce_cost = min(cm.predict_extreme(m, s, n, e, platform)
-                          for m in ("scan", "segment", "subblock"))
+    search = cm.predict_search(decisions["search"]["mode"], s, n, e,
+                               platform)
+    if "extreme" in decisions:
+        reduce_cost = cm.predict_extreme(decisions["extreme"]["mode"],
+                                         s, n, e, platform)
     else:
-        reduce_cost = min(cm.predict_scan(m, s, n, e, platform)
-                          for m in ("flat", "blocked", "subblock",
-                                    "subblock2"))
+        reduce_cost = cm.predict_scan(decisions["scan"]["mode"],
+                                      s, n, e, platform)
     out["downsample"] = search + reduce_cost
     if has_rate:
         out["rate"] = s * w * elem
-    out["groupby"] = min(cm.predict_group(m, s, w, g, platform)
-                         for m in ("segment", "matmul", "sorted"))
+    out["groupby"] = cm.predict_group(decisions["group"]["mode"],
+                                      s, w, g, platform)
     out["aggregate"] = g * w * elem
     return out
 
 
 def record_segment(kind: str, s: int, n: int, w: int, g: int,
-                   predicted_s: float, actual_ms: float) -> None:
+                   predicted_s: float, actual_ms: float,
+                   platform: str | None = None,
+                   modes: dict[str, str] | None = None,
+                   features: dict[str, float] | None = None,
+                   aggregator: str | None = None) -> None:
     """One executed query segment's predicted-vs-actual device cost.
     Lands in the in-process ring (`segments()`) and the registry
-    running totals; the ring is the calibration corpus."""
+    running totals; the ring is the calibration corpus.  Entries
+    carrying `platform` + `features` (the planner always sends both)
+    are FITTABLE: ops/calibrate.py regresses actualMs onto the feature
+    vector to re-solve the per-unit constants from live traffic."""
+    entry = {
+        "kind": kind, "series": int(s), "points": int(n),
+        "windows": int(w), "groups": int(g),
+        "predictedMs": round(predicted_s * 1e3, 4),
+        "actualMs": round(actual_ms, 4),
+    }
+    if platform is not None:
+        entry["platform"] = platform
+    if aggregator is not None:
+        # the group axis's extremes flag keys on this — the explorer
+        # needs it to recompute the entry's candidate sets faithfully
+        entry["aggregator"] = aggregator
+    if modes is not None:
+        entry["modes"] = dict(modes)
+    if features is not None:
+        entry["features"] = {t: float(u) for t, u in features.items()}
     with _seg_lock:
-        _segments.append({
-            "kind": kind, "series": int(s), "points": int(n),
-            "windows": int(w), "groups": int(g),
-            "predictedMs": round(predicted_s * 1e3, 4),
-            "actualMs": round(actual_ms, 4),
-        })
+        _segments.append(entry)
     REGISTRY.counter(
         "tsd.costmodel.segments",
         "Query segments with predicted-vs-actual accounting").labels(

@@ -38,6 +38,24 @@ The decisions this model reproduces from the r4 chip data:
     reset-scan (~90ms, G-independent); matmul's cost grows linearly in
     G so large-G queries flip to sorted.  CPU keeps segment.
 
+Online calibration (PR 6, docs/costmodel.md).  Every `predict_*` is a
+LINEAR form: a dot product of a per-mode feature vector (unit counts —
+gather rounds, scanned elements, scattered cells; `features_*` below)
+with the per-unit cost table.  That linearity is what makes the model
+fittable from live traffic: obs/jaxprof.py records each executed query
+segment's feature vector next to its measured device time, and
+ops/calibrate.py solves for the per-unit constants by non-negative
+least squares, installing the result here as a LIVE override layer on
+top of the file calibration (`install_live_calibration`).  The three
+layers compose default -> BENCH_CALIBRATION.json -> live fit, and
+`calibration_source()` names the winning layer so every traced query
+can say where its mode decision came from.
+
+A hysteresis band (`set_hysteresis`) makes the argmin sticky per shape
+bucket: once a mode has won a bucket, a challenger must beat it by the
+band's margin to flip the choice — one noisy calibration batch cannot
+thrash modes (and the jit caches behind them) every query.
+
 Reference being outperformed: the per-datapoint iterator stack
 (/root/reference/src/core/AggregationIterator.java:514,
 Downsampler.java:292) has exactly one "mode"; this module exists
@@ -50,6 +68,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 
 # --------------------------------------------------------------------- #
 # Calibrated per-unit costs, seconds.  Anchors (r04b chip session,
@@ -123,145 +142,366 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
     },
 }
 
+# The per-unit cost TERMS — identical key set on every platform (the
+# fitter's design matrix columns; asserted at import so a new term
+# cannot be added to one table and silently stay un-fittable on the
+# other).
+COST_TERMS: tuple[str, ...] = tuple(sorted(DEFAULT_COSTS["tpu"]))
+assert tuple(sorted(DEFAULT_COSTS["cpu"])) == COST_TERMS
+
 _CALIBRATION_FILE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "BENCH_CALIBRATION.json")
 
-_COSTS: dict[str, dict[str, float]] | None = None
+_lock = threading.Lock()
+_COSTS: dict[str, dict[str, float]] | None = None   # guarded-by: _lock
+# live-fit override layer (ops/calibrate.py installs; applied on top of
+# the file layer)  # guarded-by: _lock
+_LIVE: dict[str, dict[str, float]] = {}
+# platforms whose table took BENCH_CALIBRATION.json overrides
+_FILE_PLATFORMS: set[str] = set()    # guarded-by: _lock
+
+
+def _table_key(platform: str) -> str:
+    # Unknown platforms (the axon tunnel reports 'axon') use the TPU
+    # table — this framework's device path IS the TPU path.
+    return "cpu" if platform == "cpu" else "tpu"
+
+
+def _build_table_locked() -> dict[str, dict[str, float]]:
+    table = {p: dict(c) for p, c in DEFAULT_COSTS.items()}
+    _FILE_PLATFORMS.clear()
+    try:
+        with open(_CALIBRATION_FILE) as fh:
+            for plat, over in json.load(fh).items():
+                if plat in table and isinstance(over, dict):
+                    for k, v in over.items():
+                        if k in table[plat]:
+                            table[plat][k] = float(v)
+                            _FILE_PLATFORMS.add(plat)
+    except (OSError, ValueError):
+        pass
+    for plat, over in _LIVE.items():
+        if plat in table:
+            table[plat].update(over)
+    return table
 
 
 def costs(platform: str) -> dict[str, float]:
-    """Per-unit costs for a platform, with BENCH_CALIBRATION.json
-    overrides applied once per process.  Unknown platforms (the axon
-    tunnel reports 'axon') use the TPU table — this framework's device
-    path IS the TPU path."""
+    """Per-unit costs for a platform: defaults, then
+    BENCH_CALIBRATION.json overrides, then the live-fit layer — cached
+    until `reload_calibration()`.  Callers must treat the result as
+    read-only."""
     global _COSTS
-    if _COSTS is None:
-        table = {p: dict(c) for p, c in DEFAULT_COSTS.items()}
-        try:
-            with open(_CALIBRATION_FILE) as fh:
-                for plat, over in json.load(fh).items():
-                    if plat in table and isinstance(over, dict):
-                        for k, v in over.items():
-                            if k in table[plat]:
-                                table[plat][k] = float(v)
-        except (OSError, ValueError):
-            pass
-        _COSTS = table
-    return _COSTS["cpu" if platform == "cpu" else "tpu"]
+    with _lock:
+        if _COSTS is None:
+            _COSTS = _build_table_locked()
+        return _COSTS[_table_key(platform)]
+
+
+def calibration_source(platform: str) -> str:
+    """Which layer last touched this platform's cost table: 'live'
+    (online fitter), 'file' (BENCH_CALIBRATION.json), or 'default'.
+    Traced queries stamp this on every strategy decision."""
+    global _COSTS
+    with _lock:
+        if _COSTS is None:
+            _COSTS = _build_table_locked()
+        key = _table_key(platform)
+        if _LIVE.get(key):
+            return "live"
+        if key in _FILE_PLATFORMS:
+            return "file"
+        return "default"
+
+
+def install_live_calibration(platform: str,
+                             constants: dict[str, float]) -> None:
+    """Install online-fitted per-unit constants for `platform` (merged
+    over any previous live values) and drop every cache that baked the
+    old table in.  Values must be finite and positive and every term
+    must exist — the fitter's guards should make a violation impossible,
+    so one here raises instead of installing a poisoned table."""
+    key = _table_key(platform)
+    clean: dict[str, float] = {}
+    for term, value in constants.items():
+        v = float(value)
+        if term not in DEFAULT_COSTS[key]:
+            raise ValueError("unknown cost term: %r" % term)
+        if not math.isfinite(v) or v <= 0.0:
+            raise ValueError("non-positive/NaN cost for %s: %r"
+                             % (term, value))
+        clean[term] = v
+    with _lock:
+        _LIVE.setdefault(key, {}).update(clean)
+    reload_calibration()
+
+
+def clear_live_calibration() -> None:
+    """Drop the live-fit layer (back to file/default constants)."""
+    with _lock:
+        _LIVE.clear()
+    reload_calibration()
+
+
+def live_calibration(platform: str) -> dict[str, float]:
+    """The currently-installed live overrides for a platform (empty when
+    the fitter has not run)."""
+    with _lock:
+        return dict(_LIVE.get(_table_key(platform), {}))
+
+
+def set_calibration_file(path: str) -> None:
+    """Point the file layer somewhere else (daemon config/tests) and
+    reload."""
+    global _CALIBRATION_FILE
+    _CALIBRATION_FILE = path
+    reload_calibration()
+
+
+def calibration_file() -> str:
+    return _CALIBRATION_FILE
 
 
 def reload_calibration() -> None:
-    """Drop the cached cost table (tests / post-session recalibration).
-    Callers that already traced with the old table must clear jit caches
-    themselves (downsample.set_* helpers do)."""
+    """THE calibration-invalidation entry point: drops the cached cost
+    table, the sticky-choice memory, AND every dependent compiled
+    program (the downsample/group_agg pipelines bake mode choices in at
+    trace time — a reload that left them cached would keep serving
+    stale-mode kernels; that footgun used to be the caller's problem).
+    The hysteresis incumbent memory deliberately SURVIVES a reload:
+    it is what keeps one noisy calibration install from flipping modes
+    — every later choice re-prices the incumbent under the new table
+    and flips only past the band."""
     global _COSTS
-    _COSTS = None
+    with _lock:
+        _COSTS = None
+    from opentsdb_tpu.ops.downsample import _clear_dependent_caches
+    _clear_dependent_caches()
+
+
+# --------------------------------------------------------------------- #
+# Sticky argmin: the hysteresis band                                    #
+# --------------------------------------------------------------------- #
+
+_HYSTERESIS = 0.0
+_MEMO_MAX = 1024
+# last winning mode per (kind, platform, candidates, shape bucket)
+_choice_memo: dict[tuple, str] = {}    # guarded-by: _lock
+
+
+def set_hysteresis(band: float) -> None:
+    """Sticky-argmin band: a challenger mode must predict at least
+    ``band`` (fraction, e.g. 0.15) cheaper than a shape bucket's
+    incumbent before the choice flips.  0 (the default) keeps the pure
+    argmin — exactly the pre-autotune behavior.  Changing the band
+    clears the incumbent memory AND the dependent jit caches (the band
+    changes which mode _choose returns, and compiled programs bake
+    that in — same rule as every other mode-policy toggle)."""
+    global _HYSTERESIS
+    if band < 0.0 or not math.isfinite(band):
+        raise ValueError("hysteresis band must be finite and >= 0")
+    with _lock:
+        if _HYSTERESIS == band:
+            return      # idempotent: no policy change, nothing to drop
+        _HYSTERESIS = band
+        _choice_memo.clear()
+    from opentsdb_tpu.ops.downsample import _clear_dependent_caches
+    _clear_dependent_caches()
+
+
+def hysteresis() -> float:
+    return _HYSTERESIS
+
+
+def _choose(kind: str, mode_costs: dict[str, float], platform: str,
+            bucket: tuple) -> str:
+    """Argmin over mode_costs with the hysteresis band applied."""
+    best = min(mode_costs, key=mode_costs.get)
+    band = _HYSTERESIS
+    if band <= 0.0:
+        return best
+    key = (kind, _table_key(platform), tuple(sorted(mode_costs)), bucket)
+    with _lock:
+        prev = _choice_memo.get(key)
+        if (prev is not None and prev in mode_costs
+                and mode_costs[best] >= mode_costs[prev] / (1.0 + band)):
+            best = prev
+        if len(_choice_memo) >= _MEMO_MAX and key not in _choice_memo:
+            _choice_memo.clear()    # tiny table; wholesale reset is fine
+        _choice_memo[key] = best
+    return best
+
+
+def _bucket(*dims: int) -> tuple:
+    """Power-of-two shape bucket: hysteresis memory is per dispatch
+    SIZE CLASS, not per exact shape (the jit caches bucket the same
+    way via pad_pow2)."""
+    return tuple(max(int(d), 1).bit_length() for d in dims)
 
 
 def _log2(n: int) -> int:
     return max(int(math.ceil(math.log2(max(n, 2)))), 1)
 
 
+def _dot(features: dict[str, float], platform: str) -> float:
+    c = costs(platform)
+    return sum(units * c[term] for term, units in features.items())
+
+
+# --------------------------------------------------------------------- #
+# Feature vectors: unit counts per (kernel axis, mode).                 #
+#                                                                       #
+# predict_* == dot(features_*, costs) BY CONSTRUCTION — the fitter      #
+# (ops/calibrate.py) regresses measured device time onto these same     #
+# vectors, so a fitted constant means exactly what the predictor        #
+# consumes.  Keep every form LINEAR in the constants.                   #
+# --------------------------------------------------------------------- #
+
+_SUB_K = 32     # sub-block lane width, mirrored from ops.downsample
+
+
+def features_search(mode: str, s: int, n: int, e: int
+                    ) -> dict[str, float]:
+    """Unit counts for one edge search: idx[S, E] from [S, N] sorted
+    timestamps."""
+    if mode == "scan":
+        return {"gather_round": float(s * e * _log2(n))}
+    if mode == "compare_all":
+        return {"cmp_cell": float(s * n * e)}
+    if mode == "hier":
+        k = _SUB_K
+        return {"hier_cell": float(s * ((n // k) + k) * e)}
+    raise ValueError("unknown search mode: " + mode)
+
+
+def features_scan(mode: str, s: int, n: int, e: int) -> dict[str, float]:
+    """Unit counts for one windowed-sum pass over [S, N]."""
+    if mode == "flat":
+        return {"scan_f64": float(s * n), "win_gather": float(s * e)}
+    if mode == "blocked":
+        # two-level scan: same element count, measured slightly slower
+        # than flat on both platforms (r3 chip: 0.600 vs 0.568)
+        return {"scan_f64": 1.06 * s * n, "win_gather": 1.06 * s * e}
+    if mode == "subblock":
+        k = _SUB_K
+        return {"elem_f64": float(s * n + s * e * k),  # reduce + remainder
+                "scan_f64": float(s * (n // k)),       # 1/32-length cumsum
+                "win_gather": float(s * e)}
+    if mode == "subblock2":
+        k = _SUB_K
+        # within-block inclusive prefixes (block sums fall out of the
+        # last lane) + ONE element gather per edge — no [S, E, K]
+        # remainder intermediate, but the prefix pass has its own
+        # platform-dependent cost (serial-ish on CPU)
+        return {"sub2_elem": float(s * n),
+                "scan_f64": float(s * (n // k)),
+                "win_gather": float(s * e)}
+    raise ValueError("unknown scan mode: " + mode)
+
+
+def features_extreme(mode: str, s: int, n: int, e: int
+                     ) -> dict[str, float]:
+    """Unit counts for one min/max pass over [S, N]."""
+    if mode == "scan":
+        return {"ext_scan_elem": float(s * n)}
+    if mode == "segment":
+        return {"ext_seg_elem": float(s * n)}
+    if mode == "subblock":
+        k = _SUB_K
+        # sub-block reduces + a 1/32-length reset-scan + per-edge
+        # boundary-lane masked reduces (the term that loses it the
+        # headline shape: measured 0.83 vs scan's 0.52 s/dispatch)
+        return {"elem_f64": float(s * n),
+                "ext_scan_elem": float(s * (n // k)),
+                "ext_boundary_cell": float(s * e * k)}
+    raise ValueError("unknown extreme mode: " + mode)
+
+
+def features_group(mode: str, s: int, w: int, g: int
+                   ) -> dict[str, float]:
+    """Unit counts for one group reduce: [S, W] + gid[S] -> [G, W]."""
+    if mode == "segment":
+        return {"seg_scatter": float(s * w)}
+    if mode == "matmul":
+        return {"mxu_cell": float(g * s * w)}
+    if mode == "sorted":
+        return {"sorted_grid": float(s * w)}
+    if mode == "sorted2":
+        return {"sorted2_grid": float(s * w)}
+    raise ValueError("unknown group mode: " + mode)
+
+
+def cost_features(kind: str, mode: str, s: int, n: int, e: int,
+                  g: int = 1) -> dict[str, float]:
+    """One entry point over the four axes ('search' | 'scan' |
+    'extreme' | 'group').  For 'group', `n` is the grid width W."""
+    if kind == "search":
+        return features_search(mode, s, n, e)
+    if kind == "scan":
+        return features_scan(mode, s, n, e)
+    if kind == "extreme":
+        return features_extreme(mode, s, n, e)
+    if kind == "group":
+        return features_group(mode, s, n, g)
+    raise ValueError("unknown kernel axis: " + kind)
+
+
 # -- edge search: idx[S, E] from [S, N] sorted timestamps -------------- #
 
 def predict_search(mode: str, s: int, n: int, e: int,
                    platform: str) -> float:
-    c = costs(platform)
-    if mode == "scan":
-        return s * e * _log2(n) * c["gather_round"]
-    if mode == "compare_all":
-        return s * n * e * c["cmp_cell"]
-    if mode == "hier":
-        k = 32
-        return s * ((n // k) + k) * e * c["hier_cell"]
-    raise ValueError("unknown search mode: " + mode)
+    return _dot(features_search(mode, s, n, e), platform)
 
 
 def choose_search(s: int, n: int, e: int, platform: str,
                   candidates: list[str]) -> str:
-    return min(candidates,
-               key=lambda m: predict_search(m, s, n, e, platform))
+    return _choose("search",
+                   {m: predict_search(m, s, n, e, platform)
+                    for m in candidates},
+                   platform, _bucket(s, n, e))
 
 
 # -- prefix scan: windowed sums over [S, N] ---------------------------- #
 
 def predict_scan(mode: str, s: int, n: int, e: int,
                  platform: str) -> float:
-    c = costs(platform)
-    if mode == "flat":
-        return s * n * c["scan_f64"] + s * e * c["win_gather"]
-    if mode == "blocked":
-        # two-level scan: same element count, measured slightly slower
-        # than flat on both platforms (r3 chip: 0.600 vs 0.568)
-        return 1.06 * (s * n * c["scan_f64"] + s * e * c["win_gather"])
-    if mode == "subblock":
-        k = 32
-        return (s * n * c["elem_f64"]                 # sub-block reduce
-                + s * (n // k) * c["scan_f64"]        # 1/32-length cumsum
-                + s * e * k * c["elem_f64"]           # boundary remainder
-                + s * e * c["win_gather"])
-    if mode == "subblock2":
-        k = 32
-        # within-block inclusive prefixes (block sums fall out of the
-        # last lane) + ONE element gather per edge — no [S, E, K]
-        # remainder intermediate, but the prefix pass has its own
-        # platform-dependent cost (serial-ish on CPU)
-        return (s * n * c["sub2_elem"]
-                + s * (n // k) * c["scan_f64"]
-                + s * e * c["win_gather"])
-    raise ValueError("unknown scan mode: " + mode)
+    return _dot(features_scan(mode, s, n, e), platform)
 
 
 def choose_scan(s: int, n: int, e: int, platform: str,
                 candidates: list[str]) -> str:
-    return min(candidates,
-               key=lambda m: predict_scan(m, s, n, e, platform))
+    return _choose("scan",
+                   {m: predict_scan(m, s, n, e, platform)
+                    for m in candidates},
+                   platform, _bucket(s, n, e))
 
 
 # -- extreme (min/max) over [S, N] ------------------------------------- #
 
 def predict_extreme(mode: str, s: int, n: int, e: int,
                     platform: str) -> float:
-    c = costs(platform)
-    if mode == "scan":
-        return s * n * c["ext_scan_elem"]
-    if mode == "segment":
-        return s * n * c["ext_seg_elem"]
-    if mode == "subblock":
-        k = 32
-        # sub-block reduces + a 1/32-length reset-scan + per-edge
-        # boundary-lane masked reduces (the term that loses it the
-        # headline shape: measured 0.83 vs scan's 0.52 s/dispatch)
-        return (s * n * c["elem_f64"]
-                + s * (n // k) * c["ext_scan_elem"]
-                + s * e * k * c["ext_boundary_cell"])
-    raise ValueError("unknown extreme mode: " + mode)
+    return _dot(features_extreme(mode, s, n, e), platform)
 
 
 def choose_extreme(s: int, n: int, e: int, platform: str,
                    candidates: list[str]) -> str:
-    return min(candidates,
-               key=lambda m: predict_extreme(m, s, n, e, platform))
+    return _choose("extreme",
+                   {m: predict_extreme(m, s, n, e, platform)
+                    for m in candidates},
+                   platform, _bucket(s, n, e))
 
 
 # -- group reduce: [S, W] + gid[S] -> [G, W] --------------------------- #
 
 def predict_group(mode: str, s: int, w: int, g: int,
                   platform: str) -> float:
-    c = costs(platform)
-    if mode == "segment":
-        return s * w * c["seg_scatter"]
-    if mode == "matmul":
-        return g * s * w * c["mxu_cell"]
-    if mode == "sorted":
-        return s * w * c["sorted_grid"]
-    if mode == "sorted2":
-        return s * w * c["sorted2_grid"]
-    raise ValueError("unknown group mode: " + mode)
+    return _dot(features_group(mode, s, w, g), platform)
 
 
 def choose_group(s: int, w: int, g: int, platform: str,
                  candidates: list[str]) -> str:
-    return min(candidates,
-               key=lambda m: predict_group(m, s, w, g, platform))
+    return _choose("group",
+                   {m: predict_group(m, s, w, g, platform)
+                    for m in candidates},
+                   platform, _bucket(s, w, g))

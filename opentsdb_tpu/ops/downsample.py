@@ -299,6 +299,18 @@ def set_search_mode(mode: str) -> None:
 _VALUE_PRECISION = "double"
 
 
+# bumped on every mode-policy change (all of them funnel through
+# _clear_dependent_caches): the planner snapshots it before a dispatch
+# and drops the calibration-ring entry if it moved mid-query — the
+# recomputed decision report could otherwise pair one mode's measured
+# time with another mode's feature vector
+_MODE_POLICY_EPOCH = 0
+
+
+def mode_policy_epoch() -> int:
+    return _MODE_POLICY_EPOCH
+
+
 def _clear_dependent_caches() -> None:
     """Drop every compiled program that baked in the hot-path toggles.
 
@@ -306,6 +318,8 @@ def _clear_dependent_caches() -> None:
     forever, so flipping a toggle without clearing these would silently
     mix configs between already-seen and new query shapes.
     """
+    global _MODE_POLICY_EPOCH
+    _MODE_POLICY_EPOCH += 1
     from opentsdb_tpu.ops import pipeline, streaming
     for fn in (pipeline._jitted, pipeline._jitted_rollup_avg,
                pipeline._jitted_group, pipeline._jitted_grid_tail,
@@ -675,32 +689,50 @@ def _search_feasible(mode: str, n: int, w_edges: int) -> bool:
     return True
 
 
-def _effective_search_mode(s: int, n: int, w_edges: int) -> str:
+def _search_candidates(n: int, w_edges: int) -> list[str]:
+    return [m for m in ("scan", "compare_all", "hier")
+            if _search_feasible(m, n, w_edges)]
+
+
+def _effective_search_mode(s: int, n: int, w_edges: int,
+                           platform: str | None = None) -> str:
     """The search mode for this shape: 'auto' (default) ranks the
     feasible modes with the calibrated cost model (ops.costmodel);
     an explicit mode (env/setter — measurement sessions) is honored but
     still demoted to "scan" when infeasible for the shape or when the
     trace executes on CPU (see _PLATFORM_MODE_GUARD — the dense forms'
-    compare matrices materialize there)."""
+    compare matrices materialize there).  `platform` defaults to the
+    ambient execution platform; the planner's decision report passes
+    its per-segment platform explicitly."""
     mode = _SEARCH_MODE
     from opentsdb_tpu.ops.hostlane import execution_platform
-    if mode == "auto":
+    if platform is None:
         platform = execution_platform()
+    if mode == "auto":
         if platform == "cpu":
             return "scan"      # dense compares materialize on CPU
         from opentsdb_tpu.ops import costmodel
-        cands = [m for m in ("scan", "compare_all", "hier")
-                 if _search_feasible(m, n, w_edges)]
-        return costmodel.choose_search(s, n, w_edges, platform, cands)
-    if _PLATFORM_MODE_GUARD and mode != "scan":
-        if execution_platform() == "cpu":
-            return "scan"
+        return costmodel.choose_search(s, n, w_edges, platform,
+                                       _search_candidates(n, w_edges))
+    if _PLATFORM_MODE_GUARD and mode != "scan" and platform == "cpu":
+        return "scan"
     if not _search_feasible(mode, n, w_edges):
         return "scan"
     return mode
 
 
-def _effective_scan_mode(s: int, n: int, w_edges: int) -> str:
+def _scan_candidates(n: int, w_edges: int) -> list[str]:
+    sub_ok = n % _SUB_K == 0 and n > _SUB_K
+    cands = ["flat"]
+    if sub_ok and _subblock_edges_fit(n, w_edges):
+        cands.append("subblock")
+    if sub_ok:
+        cands.append("subblock2")
+    return cands
+
+
+def _effective_scan_mode(s: int, n: int, w_edges: int,
+                         platform: str | None = None) -> str:
     """The prefix-scan strategy for this shape: 'auto' ranks the
     feasible modes with the cost model (the sub-block forms need
     K-divisible rows; "subblock" additionally needs the [S, W, K]
@@ -709,21 +741,23 @@ def _effective_scan_mode(s: int, n: int, w_edges: int) -> str:
     mode = _SCAN_MODE
     if mode != "auto":
         return mode
-    sub_ok = n % _SUB_K == 0 and n > _SUB_K
-    cands = ["flat"]
-    if sub_ok and _subblock_edges_fit(n, w_edges):
-        cands.append("subblock")
-    if sub_ok:
-        cands.append("subblock2")
+    cands = _scan_candidates(n, w_edges)
     if len(cands) == 1:
         return "flat"
     from opentsdb_tpu.ops.hostlane import execution_platform
     from opentsdb_tpu.ops import costmodel
-    return costmodel.choose_scan(s, n, w_edges, execution_platform(),
-                                 cands)
+    return costmodel.choose_scan(
+        s, n, w_edges, platform or execution_platform(), cands)
 
 
-def _effective_extreme_mode(n: int, w_padded: int) -> str:
+def _extreme_candidates(n: int, w_padded: int) -> list[str]:
+    sub_ok = (n % _SUB_K == 0 and n > _SUB_K
+              and _subblock_edges_fit(n, w_padded + 1))
+    return ["scan", "segment"] + (["subblock"] if sub_ok else [])
+
+
+def _effective_extreme_mode(n: int, w_padded: int,
+                            platform: str | None = None) -> str:
     """The min/max strategy for this shape: 'auto' ranks scan vs segment
     vs (when eligible) subblock with the cost model; an explicit
     "subblock" falls back to "scan" on ineligible shapes — same rule on
@@ -734,12 +768,90 @@ def _effective_extreme_mode(n: int, w_padded: int) -> str:
     if mode == "auto":
         from opentsdb_tpu.ops.hostlane import execution_platform
         from opentsdb_tpu.ops import costmodel
-        cands = ["scan", "segment"] + (["subblock"] if sub_ok else [])
-        return costmodel.choose_extreme(1, n, w_padded + 1,
-                                        execution_platform(), cands)
+        return costmodel.choose_extreme(
+            1, n, w_padded + 1, platform or execution_platform(),
+            _extreme_candidates(n, w_padded))
     if mode == "subblock" and not sub_ok:
         return "scan"
     return mode
+
+
+def search_decision(s: int, n: int, w_edges: int, platform: str) -> dict:
+    """The edge-search strategy decision for one dispatch shape, as the
+    trace annotates it: chosen mode, per-candidate predicted ms, and
+    where the choice came from.  Recomputes exactly what the kernel's
+    trace-time `_effective_search_mode` picks for this platform."""
+    from opentsdb_tpu.ops import costmodel
+    return _decision_report(
+        "search", _effective_search_mode(s, n, w_edges, platform),
+        _SEARCH_MODE, _search_candidates(n, w_edges), platform,
+        lambda m: costmodel.predict_search(m, s, n, w_edges, platform))
+
+
+def scan_dispatch_mode(smode: str, n: int, w_edges: int) -> str:
+    """The prefix form that ACTUALLY dispatches for an effective scan
+    mode: explicit sub-block/blocked picks fall back to flat on
+    ineligible shapes at the kernel call sites (_window_scan_setup /
+    _edge_prefix_builder) — the decision report and the calibration
+    ring must record the dispatched form, not the configured wish."""
+    sub_ok = n % _SUB_K == 0 and n > _SUB_K
+    if smode == "subblock" and sub_ok and _subblock_edges_fit(n, w_edges):
+        return "subblock"
+    if smode == "subblock2" and sub_ok:
+        return "subblock2"
+    if smode == "blocked" and n % _SCAN_BLOCK == 0 and n > _SCAN_BLOCK:
+        return "blocked"
+    return "flat"
+
+
+def scan_decision(s: int, n: int, w_edges: int, platform: str) -> dict:
+    """The prefix-scan strategy decision for one dispatch shape (see
+    `search_decision`)."""
+    from opentsdb_tpu.ops import costmodel
+    dispatched = scan_dispatch_mode(
+        _effective_scan_mode(s, n, w_edges, platform), n, w_edges)
+    # every form dispatchable at this shape (blocked is explicit-only —
+    # it never wins auto — but it IS a legal dispatch, so the report
+    # prices it rather than flagging a forced 'blocked' as infeasible)
+    cands = _scan_candidates(n, w_edges)
+    if n % _SCAN_BLOCK == 0 and n > _SCAN_BLOCK:
+        cands = cands + ["blocked"]
+    return _decision_report(
+        "scan", dispatched, _SCAN_MODE, cands, platform,
+        lambda m: costmodel.predict_scan(m, s, n, w_edges, platform))
+
+
+def extreme_decision(n: int, w_padded: int, platform: str) -> dict:
+    """The min/max strategy decision for one dispatch shape (see
+    `search_decision`)."""
+    from opentsdb_tpu.ops import costmodel
+    return _decision_report(
+        "extreme", _effective_extreme_mode(n, w_padded, platform),
+        _EXTREME_MODE, _extreme_candidates(n, w_padded), platform,
+        lambda m: costmodel.predict_extreme(m, 1, n, w_padded + 1,
+                                            platform))
+
+
+def _decision_report(axis: str, chosen: str, configured: str,
+                     candidates: list[str], platform: str,
+                     predict) -> dict:
+    """Shared decision-report shape (group_agg uses it too): `source`
+    says whether the mode came from the costmodel argmin ('auto') or an
+    explicit env/config override ('forced'); `calibration` names the
+    cost-table layer the argmin consulted (default/file/live);
+    `feasible` is False only if a mode outside the feasible candidate
+    set would dispatch — the kernels' guards make that unreachable, and
+    the planner counts any violation (tsd.costmodel.infeasible)."""
+    from opentsdb_tpu.ops import costmodel
+    return {
+        "axis": axis,
+        "mode": chosen,
+        "source": "auto" if configured == "auto" else "forced",
+        "calibration": costmodel.calibration_source(platform),
+        "candidates": {m: round(predict(m) * 1e3, 4)
+                       for m in candidates},
+        "feasible": chosen in candidates,
+    }
 
 
 def _edge_search(cts, cedges):
@@ -786,11 +898,12 @@ def _window_scan_setup(ts, val, mask, spec: WindowSpec, wargs: dict):
     ok = mask & ~jnp.isnan(vf)
     cts, cedges = _compact_ts(ts, spec, wargs)
     idx = _edge_search(cts, cedges)
-    smode = _effective_scan_mode(s, n, cedges.shape[0])
-    if (smode == "subblock" and n % _SUB_K == 0 and n > _SUB_K
-            and _subblock_edges_fit(n, cedges.shape[0])):
+    smode = scan_dispatch_mode(_effective_scan_mode(s, n,
+                                                    cedges.shape[0]),
+                               n, cedges.shape[0])
+    if smode == "subblock":
         windowed = _edge_subblock_builder(s, n, idx)
-    elif (smode == "subblock2" and n % _SUB_K == 0 and n > _SUB_K):
+    elif smode == "subblock2":
         # no edges-fit constraint: the remainder reads a same-size
         # prefix array, never an [S, W, K] intermediate
         windowed = _edge_subblock2_builder(s, n, idx)

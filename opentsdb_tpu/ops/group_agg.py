@@ -108,19 +108,7 @@ def _matmul_feasible(s: int, g: int) -> bool:
     return g <= _MATMUL_MAX_GROUPS and s * g * 8 <= _MATMUL_MAX_ONEHOT_BYTES
 
 
-def _effective_group_reduce_mode(s: int, w: int, g: int,
-                                 extremes: bool = False) -> str:
-    """The group-combine strategy for this shape: 'auto' (default) ranks
-    segment/sorted/(feasible) matmul with the calibrated cost model
-    (ops.costmodel — chip anchors: segment scatter 219ms, matmul ~100ms
-    at G=100, sorted ~90ms G-independent on the headline grid; CPU
-    scatters are cheap so segment wins there).  Explicit modes keep the
-    matmul feasibility gate at the call sites."""
-    mode = _GROUP_REDUCE_MODE
-    if mode != "auto":
-        return mode
-    from opentsdb_tpu.ops.hostlane import execution_platform
-    from opentsdb_tpu.ops import costmodel
+def _group_candidates(s: int, g: int, extremes: bool) -> list[str]:
     # "sorted2" is deliberately NOT an auto candidate yet: its cost
     # constant is an estimate until a chip race records it (r5 policy:
     # no unraced mode can be auto-picked by a BASELINE config).
@@ -129,7 +117,48 @@ def _effective_group_reduce_mode(s: int, w: int, g: int,
     # one-hot dot) — auto must rank only the forms that exist for them
     if not extremes and _matmul_feasible(s, g):
         cands.append("matmul")
-    return costmodel.choose_group(s, w, g, execution_platform(), cands)
+    return cands
+
+
+def _effective_group_reduce_mode(s: int, w: int, g: int,
+                                 extremes: bool = False,
+                                 platform: str | None = None) -> str:
+    """The group-combine strategy for this shape: 'auto' (default) ranks
+    segment/sorted/(feasible) matmul with the calibrated cost model
+    (ops.costmodel — chip anchors: segment scatter 219ms, matmul ~100ms
+    at G=100, sorted ~90ms G-independent on the headline grid; CPU
+    scatters are cheap so segment wins there).  Explicit modes keep the
+    matmul feasibility gate at the call sites.  `platform` defaults to
+    the ambient execution platform; the planner's decision report
+    passes its per-segment platform explicitly."""
+    mode = _GROUP_REDUCE_MODE
+    if mode != "auto":
+        return mode
+    from opentsdb_tpu.ops.hostlane import execution_platform
+    from opentsdb_tpu.ops import costmodel
+    return costmodel.choose_group(s, w, g, platform
+                                  or execution_platform(),
+                                  _group_candidates(s, g, extremes))
+
+
+def group_decision(s: int, w: int, g: int, platform: str,
+                   extremes: bool = False) -> dict:
+    """The group-reduce strategy decision for one dispatch shape, as
+    the trace annotates it (same report shape as
+    downsample.search_decision).  An explicit matmul on an infeasible
+    shape dispatches segment at the call sites — the report records the
+    dispatched form."""
+    from opentsdb_tpu.ops import costmodel
+    from opentsdb_tpu.ops.downsample import _decision_report
+    mode = _effective_group_reduce_mode(s, w, g, extremes, platform)
+    if mode == "matmul" and (extremes or not _matmul_feasible(s, g)):
+        mode = "segment"    # the call-site feasibility fallback
+    cands = _group_candidates(s, g, extremes)
+    if _GROUP_REDUCE_MODE == "sorted2":
+        cands = cands + ["sorted2"]     # explicit-only mode: price it
+    return _decision_report(
+        "group", mode, _GROUP_REDUCE_MODE, cands, platform,
+        lambda m: costmodel.predict_group(m, s, w, g, platform))
 
 
 class _SortedGroups:

@@ -408,6 +408,14 @@ class QueryRunner:
         # unfinished inside a request-scoped trace
         psp = obs_trace.begin("pipeline", aggregator=sub.aggregator,
                               downsample=seg.ds_function or ds.function)
+        # snapshot the mode-policy epoch BEFORE the dispatch: if the
+        # autotune loop flips a strategy (exploration start/end, live
+        # install) while this query executes, the post-dispatch
+        # decision recomputation would describe the NEW policy while
+        # the kernel ran the old one — such entries are dropped from
+        # the calibration ring (see _trace_pipeline_stages)
+        from opentsdb_tpu.ops.downsample import mode_policy_epoch
+        policy_epoch = mode_policy_epoch()
         # The window plan materializes ONLY after the budget accepted the
         # scan: EdgeWindows.split builds a [W+1] edge vector sized by the
         # query's range/interval (calendar grids over a year at fine
@@ -635,7 +643,7 @@ class QueryRunner:
             self._trace_pipeline_stages(
                 psp, sub, seg, len(gid),
                 max(max(c) for _, _, c in kept), window_spec.count,
-                len(kept), host_small)
+                len(kept), host_small, policy_epoch)
         obs_trace.end(psp)
         with obs_trace.stage("extract"):
             out_ts = np.asarray(out_ts)
@@ -653,7 +661,8 @@ class QueryRunner:
 
     def _trace_pipeline_stages(self, span, sub: TSSubQuery, seg: Segment,
                                s: int, n: int, w: int, g: int,
-                               host_small: bool = False) -> None:
+                               host_small: bool = False,
+                               policy_epoch: int | None = None) -> None:
         """Logical stage children of the fused dispatch span + the
         costmodel predicted-vs-actual ledger entry.
 
@@ -661,10 +670,14 @@ class QueryRunner:
         per-stage device truth does not exist at runtime; the measured
         device wait is APPORTIONED across the stages by the calibrated
         costmodel's per-stage predictions and the children say so
-        (`estimated` tag).  The (predicted, actual) pair itself lands
-        in obs.jaxprof's segment ring — the raw feedback a calibration
-        pass needs."""
+        (`estimated` tag).  The span is also annotated with every
+        kernel-axis strategy DECISION (chosen mode, per-candidate
+        predicted ms, decision source — defaults / file calibration /
+        live fitter), and the (shape, modes, feature vector, predicted,
+        actual) tuple lands in obs.jaxprof's segment ring — the corpus
+        the online calibrator (ops/calibrate.py) fits from."""
         from opentsdb_tpu.obs import jaxprof
+        from opentsdb_tpu.obs.registry import REGISTRY
         from opentsdb_tpu.ops.hostlane import execution_platform
         ds = sub.downsample_spec
         ds_fn = seg.ds_function or (ds.function if ds is not None else None)
@@ -673,8 +686,32 @@ class QueryRunner:
         # device-dispatched segments as cpu, poisoning the calibration
         # ring with cpu-predicted vs device-actual pairs
         platform = "cpu" if host_small else execution_platform()
+        # DISPATCH shapes: build_batch pads the point axis to pow2 and
+        # the group count dispatches as g_pad — the kernels' mode
+        # choosers see the padded values, so the decision report and
+        # the ring's feature vectors must too (n=1000 would report
+        # 'flat' while the n=1024 kernel picked a sub-block form).
+        # The streamed path still approximates: it dispatches chunk-
+        # sized batches while one entry covers the whole range.
+        n = pad_pow2(max(int(n), 1))
+        g = pad_pow2(max(int(g), 1))
+        decisions = jaxprof.segment_decisions(platform, s, n, w, g,
+                                              ds_fn,
+                                              aggregator=sub.aggregator)
+        obs_trace.annotate(span, costmodel=decisions)
+        for axis, report in decisions.items():
+            if not report["feasible"]:
+                # the kernels' feasibility guards make this unreachable;
+                # a nonzero counter means a guard regressed and an
+                # OOM-class mode is about to dispatch — chaos_soak
+                # --autotune fails the run on it
+                REGISTRY.counter(
+                    "tsd.costmodel.infeasible",
+                    "Strategy decisions outside the feasible candidate "
+                    "set (must stay 0)").labels(axis=axis).inc()
         breakdown = jaxprof.stage_breakdown(platform, s, n, w, g, ds_fn,
-                                            bool(sub.rate))
+                                            bool(sub.rate),
+                                            decisions=decisions)
         total_pred = sum(breakdown.values()) or 1.0
         for stage_name in ("downsample", "rate", "groupby", "aggregate"):
             share = breakdown.get(stage_name)
@@ -689,8 +726,23 @@ class QueryRunner:
             # measurement — recording predicted>0/actual=0 pairs would
             # poison the calibration ring
             return
-        jaxprof.record_segment(seg.kind, s, n, w, g,
-                               sum(breakdown.values()), span.device_ms)
+        from opentsdb_tpu.ops.downsample import mode_policy_epoch
+        if policy_epoch is not None and policy_epoch \
+                != mode_policy_epoch():
+            # the mode policy flipped while this query executed
+            # (autotune exploration/install): the decisions above
+            # describe the NEW policy, the measured time came from the
+            # OLD kernels — the pair would poison the fit.  The span
+            # keeps its (best-effort) annotation; the ring skips it.
+            obs_trace.annotate(span, costmodel_stale=True)
+            return
+        jaxprof.record_segment(
+            seg.kind, s, n, w, g, sum(breakdown.values()), span.device_ms,
+            platform=platform,
+            modes={axis: r["mode"] for axis, r in decisions.items()},
+            features=jaxprof.segment_features(platform, s, n, w, g,
+                                              bool(sub.rate), decisions),
+            aggregator=sub.aggregator)
         self._bump("deviceTimeMs", round(span.device_ms, 3))
         self._bump("costmodelPredictedMs",
                    round(sum(breakdown.values()) * 1e3, 3))

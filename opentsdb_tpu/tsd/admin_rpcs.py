@@ -142,8 +142,14 @@ class StatsRpc(TelnetRpc, HttpRpc):
             if self.stats_registry is None:
                 raise BadRequestError("Query stats are not enabled",
                                       status=404)
+            payload = self.stats_registry.snapshot()
+            # the costmodel predicted-vs-actual segment ring rides the
+            # query-stats payload: a saved /api/stats/query response is
+            # a fittable calibration corpus (tools/fit_costmodel.py)
+            from opentsdb_tpu.obs import jaxprof
+            payload["costmodelSegments"] = jaxprof.segments()
             query.send_reply(query.serializer.format_query_stats_v1(
-                self.stats_registry.snapshot()))
+                payload))
             return
         if endpoint == "threads":
             query.send_reply(self._threads())

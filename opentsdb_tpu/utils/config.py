@@ -151,6 +151,43 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "daemon's own tsd.* metrics into its local store through the "
         "normal ingest path (0 = disabled).  The TSD becomes queryable "
         "about itself via ordinary /api/query."),
+    # -- costmodel autotune (ops/calibrate.py, docs/costmodel.md) ------ #
+    "tsd.costmodel.autotune.enable": _e(
+        "bool", False, "Online costmodel calibration: fit the kernel-"
+        "strategy per-unit constants from the live predicted-vs-actual "
+        "segment ring (obs/jaxprof.py) on the maintenance cadence and "
+        "install them as a live override layer, so choose_* converges "
+        "to what this hardware measures.  Requires traced serving with "
+        "device timing (tsd.trace.enable + tsd.trace.device_time)."),
+    "tsd.costmodel.autotune.interval": _e(
+        "int", "30", "Seconds between calibration fits (and the length "
+        "of an epsilon-exploration interval)."),
+    "tsd.costmodel.autotune.min_samples": _e(
+        "int", "64", "Fittable ring entries required before a fit runs "
+        "— below this the window is too noisy to trust."),
+    "tsd.costmodel.autotune.hysteresis": _e(
+        "float", "0.15", "Sticky-argmin band: a challenger mode must "
+        "predict this fraction cheaper than a shape bucket's incumbent "
+        "before the strategy choice (and its jit caches) flips.  0 "
+        "restores the pure argmin."),
+    "tsd.costmodel.autotune.epsilon": _e(
+        "float", "0", "Probability per calibration pass of forcing one "
+        "losing-but-feasible mode for one interval so the fitter "
+        "observes actuals for strategies the argmin never picks.  Off "
+        "by default: exploration dispatches deliberately-slower "
+        "kernels."),
+    "tsd.costmodel.autotune.max_step": _e(
+        "float", "4", "Bound on how far one fit may move a per-unit "
+        "constant (multiplier clipped into [1/max_step, max_step]); "
+        "convergence stays geometric and one wild batch is bounded."),
+    "tsd.costmodel.autotune.persist": _e(
+        "bool", True, "Merge the live-fitted constants into the "
+        "calibration file at shutdown so calibration survives "
+        "restarts."),
+    "tsd.costmodel.autotune.calibration_file": _e(
+        "str", "", "Calibration file path for both the file override "
+        "layer and shutdown persistence; empty = BENCH_CALIBRATION."
+        "json at the repo root."),
     # -- core ---------------------------------------------------------- #
     "tsd.core.authentication.enable": _e(
         "bool", False, "Require telnet/HTTP authentication."),

@@ -266,3 +266,263 @@ class TestAutoMatchesForcedResults:
             ds.set_scan_mode(prior[0])
             ds.set_search_mode(prior[1])
             ga.set_group_reduce_mode(prior[2])
+
+
+class TestFeatureDecomposition:
+    """predict_* must equal dot(features_*, costs) BY CONSTRUCTION —
+    the online fitter (ops/calibrate.py) regresses measured time onto
+    the feature vectors, so a predictor term the features don't carry
+    would be unfittable (and vice versa)."""
+
+    @pytest.mark.parametrize("plat", ["tpu", "cpu"])
+    @pytest.mark.parametrize("shape", sorted(CONFIG_SHAPES))
+    def test_predict_equals_feature_dot(self, plat, shape):
+        s, n, e, g = CONFIG_SHAPES[shape]
+        c = costmodel.costs(plat)
+
+        def dot(fv):
+            return sum(u * c[t] for t, u in fv.items())
+
+        for m in ("scan", "compare_all", "hier"):
+            assert costmodel.predict_search(m, s, n, e, plat) == \
+                pytest.approx(dot(costmodel.features_search(m, s, n, e)))
+        for m in ("flat", "blocked", "subblock", "subblock2"):
+            assert costmodel.predict_scan(m, s, n, e, plat) == \
+                pytest.approx(dot(costmodel.features_scan(m, s, n, e)))
+        for m in ("scan", "segment", "subblock"):
+            assert costmodel.predict_extreme(m, s, n, e, plat) == \
+                pytest.approx(dot(costmodel.features_extreme(m, s, n,
+                                                             e)))
+        for m in ("segment", "matmul", "sorted", "sorted2"):
+            assert costmodel.predict_group(m, s, e - 1, g, plat) == \
+                pytest.approx(dot(costmodel.features_group(m, s, e - 1,
+                                                           g)))
+
+    def test_every_feature_term_is_a_cost_term(self):
+        s, n, e, g = CONFIG_SHAPES["headline"]
+        vectors = (
+            [costmodel.features_search(m, s, n, e)
+             for m in ("scan", "compare_all", "hier")]
+            + [costmodel.features_scan(m, s, n, e)
+               for m in ("flat", "blocked", "subblock", "subblock2")]
+            + [costmodel.features_extreme(m, s, n, e)
+               for m in ("scan", "segment", "subblock")]
+            + [costmodel.features_group(m, s, e - 1, g)
+               for m in ("segment", "matmul", "sorted", "sorted2")])
+        for fv in vectors:
+            for term in fv:
+                assert term in costmodel.COST_TERMS
+
+    def test_cost_features_entry_point(self):
+        s, n, e, g = CONFIG_SHAPES["headline"]
+        assert costmodel.cost_features("search", "hier", s, n, e) == \
+            costmodel.features_search("hier", s, n, e)
+        assert costmodel.cost_features("group", "sorted", s, 512,
+                                       e, g) == \
+            costmodel.features_group("sorted", s, 512, g)
+        with pytest.raises(ValueError):
+            costmodel.cost_features("nope", "x", s, n, e)
+
+
+class TestArgminFlips:
+    """choose_* must flip where the model says the crossover is."""
+
+    def test_group_matmul_flips_to_sorted_as_g_grows(self):
+        # matmul cost is linear in G (g*s*w*mxu_cell); sorted is
+        # G-independent (s*w*sorted_grid) — the crossover sits at
+        # G* = sorted_grid / mxu_cell
+        c = costmodel.costs("tpu")
+        crossover = c["sorted_grid"] / c["mxu_cell"]
+        lo = max(int(crossover * 0.5), 1)
+        hi = int(crossover * 2)
+        cands = ["segment", "sorted", "matmul"]
+        assert costmodel.choose_group(1024, 512, lo, "tpu",
+                                      cands) == "matmul"
+        assert costmodel.choose_group(1024, 512, hi, "tpu",
+                                      cands) == "sorted"
+
+    def test_search_compare_all_flips_to_scan_as_n_grows(self):
+        # compare_all is O(S*N*E) vs the scan's O(S*E*log2 N): the
+        # crossover sits at N/log2(N) = gather_round/cmp_cell — the
+        # headline N=65536 sits on the compare side, N=2^22 well past
+        cands = ["scan", "compare_all"]
+        assert costmodel.choose_search(1024, 65_536, 514, "tpu",
+                                       cands) == "compare_all"
+        assert costmodel.choose_search(1024, 2 ** 22, 514, "tpu",
+                                       cands) == "scan"
+
+
+class TestLiveCalibrationLayer:
+    """The online fitter's override layer: install -> argmin moves,
+    source tracks the winning layer, clear -> defaults return."""
+
+    def teardown_method(self):
+        costmodel.clear_live_calibration()
+
+    def test_install_flips_argmin_and_source(self):
+        assert costmodel.calibration_source("tpu") == "default"
+        assert costmodel.choose_group(
+            1024, 512, 100, "tpu",
+            ["segment", "sorted", "matmul"]) == "sorted"
+        costmodel.install_live_calibration("tpu", {"seg_scatter": 1e-15})
+        assert costmodel.calibration_source("tpu") == "live"
+        assert costmodel.choose_group(
+            1024, 512, 100, "tpu",
+            ["segment", "sorted", "matmul"]) == "segment"
+        costmodel.clear_live_calibration()
+        assert costmodel.calibration_source("tpu") == "default"
+        assert costmodel.choose_group(
+            1024, 512, 100, "tpu",
+            ["segment", "sorted", "matmul"]) == "sorted"
+
+    def test_live_layer_wins_over_file_layer(self, tmp_path,
+                                             monkeypatch):
+        cal = tmp_path / "BENCH_CALIBRATION.json"
+        cal.write_text(json.dumps({"tpu": {"seg_scatter": 1e-15}}))
+        monkeypatch.setattr(costmodel, "_CALIBRATION_FILE", str(cal))
+        costmodel.reload_calibration()
+        try:
+            assert costmodel.calibration_source("tpu") == "file"
+            assert costmodel.costs("tpu")["seg_scatter"] == 1e-15
+            costmodel.install_live_calibration("tpu",
+                                               {"seg_scatter": 1e-3})
+            assert costmodel.calibration_source("tpu") == "live"
+            assert costmodel.costs("tpu")["seg_scatter"] == 1e-3
+        finally:
+            costmodel.clear_live_calibration()
+            monkeypatch.undo()
+            costmodel.reload_calibration()
+
+    def test_install_rejects_poison(self):
+        with pytest.raises(ValueError):
+            costmodel.install_live_calibration("tpu",
+                                               {"seg_scatter": 0.0})
+        with pytest.raises(ValueError):
+            costmodel.install_live_calibration("tpu",
+                                               {"seg_scatter":
+                                                float("nan")})
+        with pytest.raises(ValueError):
+            costmodel.install_live_calibration("tpu", {"no_term": 1e-9})
+        assert costmodel.calibration_source("tpu") == "default"
+
+
+class TestReloadClearsDependentCaches:
+    """The reload_calibration footgun fix: ONE entry point drops the
+    cost table AND the compiled programs that baked the old modes in
+    (its old docstring admitted callers had to remember the second
+    half themselves)."""
+
+    def test_reload_clears_jit_caches(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(ds, "_clear_dependent_caches",
+                            lambda: calls.append(1))
+        costmodel.reload_calibration()
+        assert calls, "reload_calibration must clear dependent caches"
+
+    def test_install_live_clears_jit_caches(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(ds, "_clear_dependent_caches",
+                            lambda: calls.append(1))
+        costmodel.install_live_calibration("cpu", {"elem_f64": 2e-9})
+        try:
+            assert calls
+        finally:
+            monkeypatch.undo()
+            costmodel.clear_live_calibration()
+
+
+class TestHysteresis:
+    """The sticky argmin: one noisy batch must not flip modes."""
+
+    def teardown_method(self):
+        costmodel.set_hysteresis(0.0)
+        costmodel.clear_live_calibration()
+
+    def test_small_margin_keeps_incumbent(self):
+        costmodel.set_hysteresis(0.25)
+        bucket = costmodel._bucket(1024, 512, 100)
+        first = costmodel._choose("t", {"a": 1.0, "b": 1.2}, "tpu",
+                                  bucket)
+        assert first == "a"
+        # b now nominally cheaper, but within the band: sticks with a
+        assert costmodel._choose("t", {"a": 1.0, "b": 0.9}, "tpu",
+                                 bucket) == "a"
+        # decisively cheaper: flips
+        assert costmodel._choose("t", {"a": 1.0, "b": 0.5}, "tpu",
+                                 bucket) == "b"
+
+    def test_zero_band_is_pure_argmin(self):
+        bucket = costmodel._bucket(1024, 512, 100)
+        assert costmodel._choose("t", {"a": 1.0, "b": 0.99}, "tpu",
+                                 bucket) == "b"
+
+    def test_end_to_end_choice_sticks_through_noise(self):
+        costmodel.set_hysteresis(0.25)
+        cands = ["segment", "sorted", "matmul"]
+        assert costmodel.choose_group(1024, 512, 100, "tpu",
+                                      cands) == "sorted"
+        # a noisy fit nudges matmul 8% under sorted — inside the band,
+        # the incumbent survives
+        c = costmodel.costs("tpu")
+        nudged = c["sorted_grid"] * 512 * 1024 * 0.92 / (100 * 1024
+                                                         * 512)
+        costmodel.install_live_calibration("tpu", {"mxu_cell": nudged})
+        assert costmodel.choose_group(1024, 512, 100, "tpu",
+                                      cands) == "sorted"
+
+
+class TestMixedAggregatorDecisions:
+    """The group axis keys its extremes flag on the CROSS-SERIES
+    aggregator (what moment_group_reduce dispatches on), not the
+    downsample function — a `max:10s-avg:` query downsamples with the
+    scan path but group-reduces as an extreme, where the matmul form
+    does not exist (review finding, PR 6)."""
+
+    def test_max_of_avg_excludes_matmul_from_group_axis(self):
+        from opentsdb_tpu.obs import jaxprof
+        dec = jaxprof.segment_decisions("tpu", 64, 1024, 32, 8, "avg",
+                                        aggregator="max")
+        assert "scan" in dec          # downsample side: the scan path
+        assert "matmul" not in dec["group"]["candidates"]
+        assert dec["group"]["mode"] in ("segment", "sorted")
+
+    def test_sum_of_max_keeps_matmul_candidacy(self):
+        from opentsdb_tpu.obs import jaxprof
+        dec = jaxprof.segment_decisions("tpu", 64, 1024, 32, 8, "max",
+                                        aggregator="sum")
+        assert "extreme" in dec       # downsample side: extreme reduce
+        assert "matmul" in dec["group"]["candidates"]
+
+    def test_aggregator_unknown_falls_back_to_ds_function(self):
+        from opentsdb_tpu.obs import jaxprof
+        dec = jaxprof.segment_decisions("tpu", 64, 1024, 32, 8, "max")
+        assert "matmul" not in dec["group"]["candidates"]
+
+
+class TestModePolicyEpoch:
+    """Every mode-policy change bumps the epoch (the planner snapshots
+    it around a dispatch and drops calibration-ring entries that span a
+    flip — decisions recomputed under the new policy must never pair
+    with device time measured under the old one)."""
+
+    def test_setters_and_reload_bump(self):
+        e0 = ds.mode_policy_epoch()
+        ds.set_scan_mode("flat")
+        try:
+            assert ds.mode_policy_epoch() > e0
+        finally:
+            ds.set_scan_mode("auto")
+        e1 = ds.mode_policy_epoch()
+        costmodel.reload_calibration()
+        assert ds.mode_policy_epoch() > e1
+
+    def test_set_hysteresis_is_idempotent(self):
+        costmodel.set_hysteresis(0.0)
+        e0 = ds.mode_policy_epoch()
+        costmodel.set_hysteresis(0.0)      # unchanged: no policy event
+        assert ds.mode_policy_epoch() == e0
+        costmodel.set_hysteresis(0.2)
+        try:
+            assert ds.mode_policy_epoch() > e0
+        finally:
+            costmodel.set_hysteresis(0.0)
