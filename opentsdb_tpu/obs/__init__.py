@@ -18,9 +18,206 @@ Three layers, one package (docs/observability.md):
 obs/selfreport.py closes the dogfooding loop: the daemon ingests its own
 tsd.* metrics into its own memstore every tsd.stats.interval seconds, so
 the TSD is queryable about itself through its own pipeline.
+
+METRICS_SCHEMA (below) is the declared universe of metric names this
+codebase emits through the registry families or StatsCollector.record —
+tools/lint/metrics_schema.py holds every emission site to it (an
+undeclared name is a lint failure), and docs/metrics.md is generated
+from it via `python tools/lint/run.py --update-doc` (byte-pinned by
+test, same contract as docs/configuration.md).
 """
+
+from __future__ import annotations
+
+from typing import NamedTuple
 
 from opentsdb_tpu.obs.histogram import LogHistogram
 from opentsdb_tpu.obs.registry import REGISTRY, MetricsRegistry
 
-__all__ = ["LogHistogram", "REGISTRY", "MetricsRegistry"]
+__all__ = ["LogHistogram", "REGISTRY", "MetricsRegistry",
+           "METRICS_SCHEMA", "MetricSpec", "generate_metrics_doc"]
+
+
+class MetricSpec(NamedTuple):
+    kind: str            # counter | gauge | histogram
+    labels: tuple        # label keys minted at the emission sites
+    doc: str
+
+
+def _m(kind: str, labels: tuple, doc: str) -> MetricSpec:
+    return MetricSpec(kind, labels, doc)
+
+
+# The declared metric-name universe.  Names are the FULL dotted form
+# (StatsCollector.record's "tsd." prefix included); a `*` segment
+# matches one %-formatted hole at an emission site that builds its name
+# from a template ("%s.errors" % kind declares as "tsd.*.errors").
+# Every StatsCollector record is exposed as a gauge on
+# /api/stats/prometheus, so record-emitted names declare kind "gauge";
+# every record additionally carries the collector's ambient tags
+# (`host`, plus any context tags) on top of the labels listed here.
+METRICS_SCHEMA: dict[str, MetricSpec] = {
+    # -- HTTP / RPC serving (tsd/rpc_manager.py, tsd/rpcs.py) ---------- #
+    "tsd.http.requests": _m(
+        "counter", ("route", "status"),
+        "HTTP requests served, by registered route and status code."),
+    "tsd.http.latency_ms": _m(
+        "histogram", ("route",),
+        "End-to-end HTTP request latency in milliseconds."),
+    "tsd.http.errors": _m(
+        "gauge", ("family",),
+        "HTTP error responses by family (4xx client / 5xx server)."),
+    "tsd.query.count": _m(
+        "counter", ("status",),
+        "/api/query requests served, by response status."),
+    "tsd.query.latency_ms": _m(
+        "histogram", (),
+        "End-to-end /api/query latency in milliseconds."),
+    "tsd.rpc.received": _m(
+        "gauge", ("type",),
+        "RPCs received, by transport/command type."),
+    "tsd.*.errors": _m(
+        "gauge", ("type",),
+        "Per-RPC-kind error tallies (put.errors, rollup.errors, ...) "
+        "by error type."),
+    "tsd.connectionmgr.connections": _m(
+        "gauge", ("type",),
+        "Connection manager totals: established/open/rejected."),
+    "tsd.connectionmgr.exceptions": _m(
+        "gauge", (),
+        "Exceptions caught by the connection manager."),
+    # -- auth (auth/core.py) ------------------------------------------- #
+    "tsd.authentication.telnet.allowed": _m(
+        "gauge", (), "Telnet connections allowed by the auth plugin."),
+    "tsd.authentication.http.allowed": _m(
+        "gauge", (), "HTTP connections allowed by the auth plugin."),
+    "tsd.authorization.queries.allowed": _m(
+        "gauge", (), "Queries allowed by the authorization plugin."),
+    # -- cluster fan-out (tsd/cluster.py) ------------------------------ #
+    "tsd.cluster.fetch.retries": _m(
+        "gauge", (), "Peer-fetch retry attempts."),
+    "tsd.cluster.fetch.failures": _m(
+        "gauge", (), "Peer fetches that exhausted their retries."),
+    "tsd.cluster.queries": _m(
+        "gauge", ("result",),
+        "Clustered queries by outcome (partial / failed)."),
+    "tsd.cluster.breaker.state": _m(
+        "gauge", ("peer",),
+        "Per-peer circuit-breaker state (0 closed, 1 half-open, "
+        "2 open)."),
+    "tsd.cluster.breaker.opens": _m(
+        "gauge", ("peer",), "Circuit-breaker open transitions."),
+    "tsd.cluster.breaker.fast_fails": _m(
+        "gauge", ("peer",),
+        "Requests fast-failed by an open breaker."),
+    # -- JAX / costmodel (obs/jaxprof.py, ops/calibrate.py,             #
+    #    query/planner.py) -------------------------------------------- #
+    "tsd.jax.compiles": _m(
+        "counter", ("kernel",), "XLA compilations per jitted kernel."),
+    "tsd.costmodel.segments": _m(
+        "counter", ("kind",),
+        "Query segments with predicted-vs-actual accounting."),
+    "tsd.costmodel.predicted_ms": _m(
+        "counter", ("kind",),
+        "Costmodel-predicted device milliseconds, summed."),
+    "tsd.costmodel.actual_ms": _m(
+        "counter", ("kind",),
+        "Measured device milliseconds, summed."),
+    "tsd.costmodel.infeasible": _m(
+        "counter", ("axis",),
+        "Strategy decisions outside the feasible candidate set "
+        "(must stay 0 — chaos_soak --autotune gates on it)."),
+    "tsd.costmodel.calibration.fits": _m(
+        "counter", ("platform",), "Online costmodel fits installed."),
+    "tsd.costmodel.calibration.samples": _m(
+        "gauge", ("platform",),
+        "Ring entries consumed by the last fit."),
+    "tsd.costmodel.calibration.residual": _m(
+        "gauge", ("platform",),
+        "Relative residual of the last fit."),
+    "tsd.costmodel.calibration.constant": _m(
+        "gauge", ("platform", "term"),
+        "Live-fitted per-unit cost, seconds."),
+    "tsd.costmodel.calibration.explorations": _m(
+        "counter", ("axis",),
+        "Epsilon-exploration intervals dispatched."),
+    "tsd.costmodel.calibration.*": _m(
+        "gauge", ("term",),
+        "The installed live calibration constants, per platform "
+        "(tsd.costmodel.calibration.cpu / .tpu), term-tagged."),
+    # -- autotune loop counters (ops/calibrate.py collect_stats,        #
+    #    re-emitted through the stats-hook forwarder) ------------------ #
+    "tsd.costmodel.autotune.fits": _m(
+        "gauge", (), "Autotune fits installed since startup."),
+    "tsd.costmodel.autotune.fit_errors": _m(
+        "gauge", (), "Autotune passes that raised (caught + counted)."),
+    "tsd.costmodel.autotune.samples_used": _m(
+        "gauge", (), "Ring entries consumed by the last fit."),
+    "tsd.costmodel.autotune.explorations": _m(
+        "gauge", (), "Epsilon-exploration intervals started."),
+    "tsd.costmodel.autotune.residual": _m(
+        "gauge", (), "Relative residual of the last fit."),
+    "tsd.costmodel.autotune.exploring": _m(
+        "gauge", (), "1 while a losing mode is being explored."),
+    # -- device cache (storage/device_cache.py collect_stats, mirrored  #
+    #    by obs/jaxprof.py update_device_gauges) ----------------------- #
+    "tsd.query.device_cache.hits": _m(
+        "gauge", (), "Device-cache batch gathers served from HBM."),
+    "tsd.query.device_cache.misses": _m(
+        "gauge", (), "Device-cache misses (cold/stale/over-budget)."),
+    "tsd.query.device_cache.builds": _m(
+        "gauge", (), "Device-cache entry builds."),
+    "tsd.query.device_cache.evictions": _m(
+        "gauge", (), "Device-cache LRU evictions."),
+    "tsd.query.device_cache.entries": _m(
+        "gauge", (), "Device-cache resident entries."),
+    "tsd.query.device_cache.bytes": _m(
+        "gauge", (), "Device-cache resident bytes."),
+}
+
+
+def generate_metrics_doc() -> str:
+    """Render docs/metrics.md from METRICS_SCHEMA (one table per
+    top-level prefix).  tests/test_lint_clean.py pins the committed
+    file to this output."""
+    groups: dict[str, list[tuple[str, MetricSpec]]] = {}
+    for name, spec in sorted(METRICS_SCHEMA.items()):
+        segs = name.split(".")
+        if "*" in segs[:2]:
+            # templated names (tsd.*.errors) get their own section
+            # instead of a literal '## `tsd.*.*`' heading
+            prefix = "templated"
+        else:
+            prefix = ".".join(segs[:2])
+        groups.setdefault(prefix, []).append((name, spec))
+    lines = [
+        "# Metrics reference",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand.",
+        "     Regenerate with: python tools/lint/run.py --update-doc",
+        "     Source of truth: opentsdb_tpu/obs/__init__.py "
+        "METRICS_SCHEMA. -->",
+        "",
+        "Every metric name emitted through the obs/registry.py families "
+        "or `StatsCollector.record` is declared here; "
+        "tools/lint/metrics_schema.py fails the build on an undeclared "
+        "name or a kind collision.  A `*` segment stands for a value "
+        "interpolated at the emission site (RPC kind, platform).  "
+        "Record-emitted metrics are exposed as gauges on "
+        "`/api/stats/prometheus` and additionally carry the collector's "
+        "ambient tags (`host`, plus any context tags) on top of the "
+        "labels listed.",
+        "",
+    ]
+    for prefix in sorted(groups):
+        lines.append("## `%s.*`" % prefix)
+        lines.append("")
+        lines.append("| metric | kind | labels | description |")
+        lines.append("|---|---|---|---|")
+        for name, spec in groups[prefix]:
+            lines.append("| `%s` | %s | %s | %s |" % (
+                name, spec.kind,
+                ", ".join("`%s`" % k for k in spec.labels) or "—",
+                spec.doc))
+        lines.append("")
+    return "\n".join(lines)

@@ -128,6 +128,7 @@ class _CompileCounter:
             self._refs += 1
             if self._refs > 1:
                 return
+        # global-install: unsubscribe paired-with: stop
         compile_capture.subscribe(self._on_compile)
 
     def stop(self) -> None:
@@ -185,6 +186,9 @@ def update_device_gauges(tsdb) -> None:
     if cache is None:
         return
     for name, value in cache.collect_stats().items():
+        # forwarder: the names are the device cache's collect_stats()
+        # keys (tsd.query.device_cache.*), declared in METRICS_SCHEMA
+        # and walked, not minted  # tsdblint: disable=metrics-dynamic-name
         REGISTRY.gauge(name, "Device series cache (HBM) state").set(value)
 
 

@@ -335,6 +335,7 @@ AGGREGATORS: dict[str, Aggregator] = _make_registry()
 # cache is bounded: query strings are untrusted, and each distinct N also
 # seeds fresh jit traces downstream — beyond the cap new windows still
 # work, they just construct per call (review r4).
+# cache: dynamic-aggs invalidated-by: none
 _DYNAMIC: dict[str, Aggregator] = {}
 _DYNAMIC_MAX = 128
 

@@ -287,10 +287,12 @@ class OnlineCalibrator:
         self._prior_calibration_file = costmodel.calibration_file()
         self._prior_hysteresis = costmodel.hysteresis()
         if path:
+            # global-install: set_calibration_file paired-with: shutdown
             costmodel.set_calibration_file(path)
         self.calibration_path = path or costmodel.calibration_file()
         # PROCESS-GLOBAL, like _apply_kernel_modes: the sticky-argmin
         # band lives with the module-level choosers
+        # global-install: set_hysteresis paired-with: shutdown
         costmodel.set_hysteresis(cfg.get_float(
             "tsd.costmodel.autotune.hysteresis"))
         self._lock = threading.Lock()
@@ -356,6 +358,7 @@ class OnlineCalibrator:
                 max_step=self.max_step)
             if not fitted:
                 continue
+            # global-install: clear_live_calibration paired-with: shutdown
             costmodel.install_live_calibration(plat, fitted)
             installed += 1
             with self._lock:
@@ -503,6 +506,9 @@ class OnlineCalibrator:
         surface."""
         from opentsdb_tpu.ops import costmodel
         for name, value in self.collect_stats().items():
+            # forwarder: the names are this class's collect_stats()
+            # keys (tsd.costmodel.autotune.*), declared in
+            # METRICS_SCHEMA  # tsdblint: disable=metrics-dynamic-name
             collector.record(name, value)
         for plat in ("tpu", "cpu"):
             for term, value in costmodel.live_calibration(plat).items():

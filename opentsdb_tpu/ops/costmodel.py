@@ -154,11 +154,14 @@ _CALIBRATION_FILE = os.path.join(
         os.path.abspath(__file__)))), "BENCH_CALIBRATION.json")
 
 _lock = threading.Lock()
-_COSTS: dict[str, dict[str, float]] | None = None   # guarded-by: _lock
+# the cached three-layer cost table; every jitted kernel bakes it in at
+# trace time  # guarded-by: _lock  # cache: cost-table invalidated-by: reload_calibration
+_COSTS: dict[str, dict[str, float]] | None = None
 # live-fit override layer (ops/calibrate.py installs; applied on top of
 # the file layer)  # guarded-by: _lock
 _LIVE: dict[str, dict[str, float]] = {}
-# platforms whose table took BENCH_CALIBRATION.json overrides
+# platforms whose table took BENCH_CALIBRATION.json overrides — rebuilt
+# with the table  # cache: cost-table invalidated-by: reload_calibration
 _FILE_PLATFORMS: set[str] = set()    # guarded-by: _lock
 
 
@@ -286,7 +289,10 @@ def reload_calibration() -> None:
 
 _HYSTERESIS = 0.0
 _MEMO_MAX = 1024
-# last winning mode per (kind, platform, candidates, shape bucket)
+# last winning mode per (kind, platform, candidates, shape bucket).
+# Deliberately SURVIVES reload_calibration (see its docstring);
+# set_hysteresis is the one entry point that drops it.
+# cache: choice-memo invalidated-by: set_hysteresis
 _choice_memo: dict[tuple, str] = {}    # guarded-by: _lock
 
 

@@ -323,8 +323,9 @@ def _clear_dependent_caches() -> None:
     from opentsdb_tpu.ops import pipeline, streaming
     for fn in (pipeline._jitted, pipeline._jitted_rollup_avg,
                pipeline._jitted_group, pipeline._jitted_grid_tail,
-               pipeline._jitted_group_rollup_avg, streaming._jitted_update,
-               streaming._jitted_finish):
+               pipeline._jitted_group_rollup_avg,
+               pipeline._jitted_union_batch, streaming._jitted_update,
+               streaming._jitted_update_sliced, streaming._jitted_finish):
         fn.clear_cache()
     try:
         from opentsdb_tpu.parallel import sharded

@@ -329,6 +329,9 @@ def _to_device(arr: np.ndarray):
     return jax.device_put(arr)
 
 
+# compiled gather programs keyed by (padded N, compaction flag) — the
+# closure reads only module constants (PAD_TS / I32_PAD_TS), so there
+# is nothing to invalidate  # cache: gather-programs invalidated-by: none
 _GATHER_CACHE: dict = {}
 
 

@@ -230,19 +230,39 @@ class LogBuffer(logging.Handler):
 
 
 _LOG_BUFFER = LogBuffer()
-_LOG_BUFFER_INSTALLED = False
+# refcount, not a boolean: several servers in one process (tests, the
+# chaos harness) each install on start and uninstall on stop — the
+# handler leaves the root logger only when the LAST one stops, and a
+# stopped server no longer pins log capture for the host program.
+# Servers run on their own threads, so the count is lock-protected.
+_LOG_BUFFER_LOCK = threading.Lock()
+_LOG_BUFFER_INSTALLS = 0  # guarded-by: _LOG_BUFFER_LOCK
 
 
 def install_log_buffer() -> None:
-    """Attach the /logs ring buffer to the root logger.
+    """Attach the /logs ring buffer to the root logger (refcounted).
 
     Called by server startup, NOT at import time — importing the package
-    must not mutate the host program's logging configuration.
+    must not mutate the host program's logging configuration.  Pair with
+    `uninstall_log_buffer()` on shutdown.
     """
-    global _LOG_BUFFER_INSTALLED
-    if not _LOG_BUFFER_INSTALLED:
-        logging.getLogger().addHandler(_LOG_BUFFER)
-        _LOG_BUFFER_INSTALLED = True
+    global _LOG_BUFFER_INSTALLS
+    with _LOG_BUFFER_LOCK:
+        if _LOG_BUFFER_INSTALLS == 0:
+            # global-install: removeHandler paired-with: uninstall_log_buffer
+            logging.getLogger().addHandler(_LOG_BUFFER)
+        _LOG_BUFFER_INSTALLS += 1
+
+
+def uninstall_log_buffer() -> None:
+    """Detach the /logs handler once the last installer stops."""
+    global _LOG_BUFFER_INSTALLS
+    with _LOG_BUFFER_LOCK:
+        if _LOG_BUFFER_INSTALLS == 0:
+            return
+        _LOG_BUFFER_INSTALLS -= 1
+        if _LOG_BUFFER_INSTALLS == 0:
+            logging.getLogger().removeHandler(_LOG_BUFFER)
 
 
 class LogsRpc(HttpRpc):

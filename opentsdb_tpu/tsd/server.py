@@ -77,16 +77,8 @@ class TSDServer:
         self.tsdb = tsdb
         self.port = port
         self.bind = bind
-        from opentsdb_tpu.tsd.admin_rpcs import install_log_buffer
-        install_log_buffer()
         self.rpc_manager = RpcManager(tsdb, server=self,
                                       shutdown_cb=self.request_shutdown)
-        self._compile_counting = tsdb.config.get_bool("tsd.trace.enable")
-        if self._compile_counting:
-            # per-kernel XLA compile counters (tsd.jax.compiles at
-            # /api/stats/prometheus) — the same capture tsdbsan uses
-            from opentsdb_tpu.obs import jaxprof
-            jaxprof.start_compile_counting()
         self.connections_established = 0  # guarded-by: _conn_lock
         self.connections_rejected = 0  # guarded-by: _conn_lock
         self.exceptions_caught = 0
@@ -109,6 +101,30 @@ class TSDServer:
         self._server: asyncio.AbstractServer | None = None
         self._shutdown_event: asyncio.Event | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
+        # Process-global installs come LAST: everything fallible
+        # (RpcManager construction, config reads) has already run, so a
+        # failed construction never arms global state with no instance
+        # left to stop().  _log_buffer_installed is this instance's
+        # share of the refcount — a second stop() (owner finally +
+        # shutdown-event path both reach stop) must not decrement on
+        # behalf of another still-running server.
+        self._compile_counting = tsdb.config.get_bool("tsd.trace.enable")
+        from opentsdb_tpu.tsd.admin_rpcs import install_log_buffer
+        # global-install: uninstall_log_buffer paired-with: stop
+        install_log_buffer()
+        self._log_buffer_installed = True
+        if self._compile_counting:
+            # per-kernel XLA compile counters (tsd.jax.compiles at
+            # /api/stats/prometheus) — the same capture tsdbsan uses
+            from opentsdb_tpu.obs import jaxprof
+            try:
+                # global-install: stop_compile_counting paired-with: stop
+                jaxprof.start_compile_counting()
+            except BaseException:
+                from opentsdb_tpu.tsd.admin_rpcs import uninstall_log_buffer
+                self._log_buffer_installed = False
+                uninstall_log_buffer()
+                raise
 
     # -- lifecycle --
 
@@ -144,29 +160,38 @@ class TSDServer:
         # hostage past the grace period (the supervisor's SIGKILL would
         # land us in exactly the mid-write teardown this drain avoids).
         loop = asyncio.get_running_loop()
-        drain = loop.run_in_executor(
-            None, functools.partial(self._executor.shutdown, wait=True,
-                                    cancel_futures=True))
         try:
-            await asyncio.wait_for(asyncio.shield(drain),
-                                   timeout=DRAIN_GRACE_S)
-        except asyncio.TimeoutError:
-            LOG.warning("responder drain exceeded %ss; proceeding with "
-                        "TSDB teardown (a handler is wedged)",
-                        DRAIN_GRACE_S)
-        # The drain guarantees the WORK finished; the handler coroutines
-        # still need loop time to write their replies.  Yield until the
-        # last dispatched reply hits its socket (bounded — a dead client
-        # can't block shutdown).
-        deadline = loop.time() + 5.0
-        while self._inflight_rpcs and loop.time() < deadline:
-            await asyncio.sleep(0.02)
-        if self._compile_counting:
-            from opentsdb_tpu.obs import jaxprof
-            jaxprof.stop_compile_counting()
-            self._compile_counting = False
-        self.tsdb.shutdown()
-        LOG.info("Server shut down")
+            drain = loop.run_in_executor(
+                None, functools.partial(self._executor.shutdown, wait=True,
+                                        cancel_futures=True))
+            try:
+                await asyncio.wait_for(asyncio.shield(drain),
+                                       timeout=DRAIN_GRACE_S)
+            except asyncio.TimeoutError:
+                LOG.warning("responder drain exceeded %ss; proceeding with "
+                            "TSDB teardown (a handler is wedged)",
+                            DRAIN_GRACE_S)
+            # The drain guarantees the WORK finished; the handler
+            # coroutines still need loop time to write their replies.
+            # Yield until the last dispatched reply hits its socket
+            # (bounded — a dead client can't block shutdown).
+            deadline = loop.time() + 5.0
+            while self._inflight_rpcs and loop.time() < deadline:
+                await asyncio.sleep(0.02)
+        finally:
+            # A cancelled drain must still release the process-global
+            # installs — a CancelledError here would otherwise pin the
+            # /logs handler on the root logger forever.
+            if self._compile_counting:
+                from opentsdb_tpu.obs import jaxprof
+                jaxprof.stop_compile_counting()
+                self._compile_counting = False
+            if self._log_buffer_installed:
+                self._log_buffer_installed = False
+                from opentsdb_tpu.tsd.admin_rpcs import uninstall_log_buffer
+                uninstall_log_buffer()
+            self.tsdb.shutdown()
+            LOG.info("Server shut down")
 
     def request_shutdown(self) -> None:
         """Thread-safe shutdown trigger (diediedie).

@@ -17,7 +17,10 @@ from zoneinfo import ZoneInfo, available_timezones
 
 UTC_ID = "UTC"
 
+# zoneinfo lookups are pure: an entry never changes once built
+# cache: tz-lookup invalidated-by: none
 _TZ_CACHE: dict[str, ZoneInfo] = {}
+# cache: tz-lookup invalidated-by: none
 _AVAILABLE: set[str] | None = None
 
 

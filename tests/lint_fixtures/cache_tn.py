@@ -1,0 +1,94 @@
+"""cache_coherence true negatives: the sanctioned forms stay silent.
+
+Pins: the single-entry-point invalidation idiom (transitive credit),
+the clear-loop token form, direct per-site clears, the `__init__`
+pre-publication exemption, `invalidated-by: none` with a genuinely
+immutable read-set, and backing-store fills/drops staying exempt.
+"""
+
+import functools
+
+import jax
+
+_MODE = "auto"
+_EXTRA = 1.0
+
+
+def _kernel(x):
+    return x * _EXTRA if _MODE == "auto" else x
+
+
+_jitted_kernel = jax.jit(_kernel)
+
+
+@functools.lru_cache(maxsize=4)
+def cached_thing(n):
+    return (_MODE, n)
+
+
+def _clear_all():
+    """The single invalidation entry point (the _clear_dependent_caches
+    shape, including the clear-loop token form)."""
+    for fn in (_jitted_kernel,):
+        fn.clear_cache()
+    cached_thing.cache_clear()
+
+
+def set_mode(mode):
+    # routed through the entry point: transitively credited
+    global _MODE
+    _MODE = mode
+    _clear_all()
+
+
+def set_extra(v):
+    # direct per-site clears are just as coherent
+    global _EXTRA
+    _EXTRA = v
+    _jitted_kernel.clear_cache()
+    cached_thing.cache_clear()
+
+
+class Owner:
+    def __init__(self):
+        # pre-publication construction: exempt by design
+        global _MODE
+        _MODE = "owner"
+
+
+# append-only memo over pure inputs: nothing to invalidate
+# cache: lookup invalidated-by: none
+_LOOKUP = {}
+
+
+def lookup(k):
+    v = _LOOKUP.get(k)
+    if v is None:
+        v = k + 1
+        _LOOKUP[k] = v
+    return v
+
+
+# manual cache with a real invalidator; fills and drops of the backing
+# store are the cache's own business, not read-set mutations
+_CFG_SRC = "file"
+# cache: state invalidated-by: drop_state
+_STATE = None
+
+
+def get_state():
+    global _STATE
+    if _STATE is None:
+        _STATE = {"src": _CFG_SRC}
+    return _STATE
+
+
+def drop_state():
+    global _STATE
+    _STATE = None
+
+
+def set_cfg_src(v):
+    global _CFG_SRC
+    _CFG_SRC = v
+    drop_state()

@@ -1,0 +1,109 @@
+"""cache_coherence true positives: every line marked EXPECT must be
+caught by exactly that rule.
+
+Each scenario uses its own dependency global so the expected findings
+stay independent (a shared mode global would cross-obligate every
+cache here).
+"""
+
+import functools
+
+# -- 1. mutation that never reaches the cache's invalidator ----------- #
+
+_PLAN_MODE = "auto"
+
+
+@functools.lru_cache(maxsize=8)
+def cached_plan(n):
+    return (_PLAN_MODE, n)
+
+
+def set_plan_mode_no_clear(mode):
+    global _PLAN_MODE
+    _PLAN_MODE = mode  # EXPECT: cache-stale-mutation
+
+
+# -- 2. early return crossing an undischarged obligation -------------- #
+
+_LAYOUT = "rowmajor"
+
+
+@functools.lru_cache(maxsize=8)
+def cached_layout(n):
+    return (_LAYOUT, n)
+
+
+def set_layout(mode, dry_run=False):
+    global _LAYOUT
+    _LAYOUT = mode  # EXPECT: cache-stale-mutation
+    if dry_run:
+        return
+    cached_layout.cache_clear()
+
+
+# -- 3. gutted invalidator: registered but no longer drops ------------ #
+
+_TBL_SRC = "default"
+# cache: table invalidated-by: rebuild_table
+_TABLE = None
+
+
+def table():
+    global _TABLE
+    if _TABLE is None:
+        _TABLE = {"src": _TBL_SRC}
+    return _TABLE
+
+
+def rebuild_table():  # EXPECT: cache-invalidator-gutted
+    # the drop (`_TABLE = None`) was "cleaned up"; callers that route
+    # through this entry point now invalidate nothing
+    return table()
+
+
+def set_tbl_src(v):
+    global _TBL_SRC
+    _TBL_SRC = v
+    rebuild_table()
+
+
+# -- 4. declared-immutable cache fed by mutable state ----------------- #
+
+_FROZEN_SRC = 1
+# cache: frozen invalidated-by: none
+_FROZEN = {}
+
+
+def frozen_lookup(k):
+    v = _FROZEN.get(k)
+    if v is None:
+        v = _FROZEN_SRC + k
+        _FROZEN[k] = v
+    return v
+
+
+def bump_frozen_src():
+    global _FROZEN_SRC
+    _FROZEN_SRC += 1  # EXPECT: cache-stale-mutation
+
+
+# -- 5. memo idiom with no declaration -------------------------------- #
+
+_MEMO: dict = {}  # EXPECT: cache-undeclared
+
+
+def memo_get(k):
+    v = _MEMO.get(k)
+    if v is None:
+        v = k * 2
+        _MEMO[k] = v
+    return v
+
+
+# -- 6. annotation pointing at nothing -------------------------------- #
+
+_ORPHAN = {}  # cache: orphan invalidated-by: no_such_function  # EXPECT: cache-bad-annotation
+
+
+def orphan_get(k):
+    return _ORPHAN.get(k)

@@ -1,0 +1,36 @@
+"""Paired-install true negatives: the sanctioned lifecycles."""
+
+
+class GoodDirect:
+    """Install in __init__, uninstall in shutdown — the
+    OnlineCalibrator shape."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        # global-install: remove_hook paired-with: shutdown
+        reg.install_hook(self._on_event)
+
+    def shutdown(self):
+        self.reg.remove_hook(self._on_event)
+
+    def _on_event(self, event):
+        return event
+
+
+class GoodIndirect:
+    """The pairing function is a helper reached from a close path —
+    reachability is transitive."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        # global-install: remove_hook paired-with: _teardown_hooks
+        reg.install_hook(self._on_event)
+
+    def _teardown_hooks(self):
+        self.reg.remove_hook(self._on_event)
+
+    def close(self):
+        self._teardown_hooks()
+
+    def _on_event(self, event):
+        return event

@@ -1,0 +1,44 @@
+"""Paired-install true positives for cache_coherence's install rules."""
+
+
+class BadMissingPair:
+    """The pairing function does not exist at all."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        # global-install: remove_hook paired-with: no_such_close  # EXPECT: install-missing-uninstall
+        reg.install_hook(self._on_event)
+
+    def _on_event(self, event):
+        return event
+
+
+class BadNeverUninstalls:
+    """`close` exists but was 'simplified' and no longer uninstalls."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        # global-install: remove_hook paired-with: close  # EXPECT: install-missing-uninstall
+        reg.install_hook(self._on_event)
+
+    def close(self):
+        self.reg = None
+
+    def _on_event(self, event):
+        return event
+
+
+class BadUnreachable:
+    """The uninstall exists and works — but nothing on any
+    shutdown/close/stop path ever calls it."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        # global-install: remove_hook paired-with: detach_hooks  # EXPECT: install-unreachable-uninstall
+        reg.install_hook(self._on_event)
+
+    def detach_hooks(self):
+        self.reg.remove_hook(self._on_event)
+
+    def _on_event(self, event):
+        return event

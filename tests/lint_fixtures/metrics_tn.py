@@ -1,0 +1,19 @@
+"""metrics_schema true negatives: declared names, matching kinds and
+labels, the %-template wildcard form, and the suppressed sanctioned
+forwarder."""
+
+REGISTRY = None  # stub: the analyzer matches the receiver NAME
+
+
+def emit(collector, route, kind, walked):
+    REGISTRY.counter("tsd.fixture.count",
+                     "Requests by route").labels(route=route).inc()
+    REGISTRY.gauge("tsd.fixture.level").set(3)
+    REGISTRY.histogram("tsd.fixture.latency_ms").observe(1.5)
+    collector.record("fixture.pushed", 2, "kind=%s" % kind)
+    collector.record("fixture.level", 1)
+    collector.record("%s.errors" % kind, 1, "type=storage")
+    for name, value in walked:
+        # sanctioned forwarder: names already declared + walked
+        # tsdblint: disable=metrics-dynamic-name
+        collector.record(name, value)

@@ -1,0 +1,15 @@
+"""metrics_schema true positives (checked against the fixture schema
+injected by tests/test_lint_analyzers.py, not the real
+METRICS_SCHEMA)."""
+
+REGISTRY = None  # stub: the analyzer matches the receiver NAME
+
+
+def emit(collector, route):
+    REGISTRY.counter("tsd.fixture.typo").inc()  # EXPECT: metrics-unknown-name
+    REGISTRY.gauge("tsd.fixture.count").set(1)  # EXPECT: metrics-kind-collision
+    REGISTRY.counter("tsd.fixture." + route).inc()  # EXPECT: metrics-dynamic-name
+    REGISTRY.counter("tsd.fixture.count").labels(method=route).inc()  # EXPECT: metrics-unknown-label
+    collector.record("fixture.unknown", 1)  # EXPECT: metrics-unknown-name
+    collector.record("fixture.count", 1)  # EXPECT: metrics-kind-collision
+    collector.record("fixture.pushed", 1, "peer=x")  # EXPECT: metrics-unknown-label

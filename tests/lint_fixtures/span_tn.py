@@ -1,0 +1,35 @@
+"""Span-leak true negatives: the sanctioned span lifecycles.
+
+Pins the cluster fan-out's create-on-owner/finish-on-pool handoff
+(ownership transfer via the submit argument), the begin/end pair, the
+estimated-child hand-finish idiom, and try/finally."""
+
+
+def handoff_to_pool(parent, pool, job):
+    # created on the owning thread, finished by the pool thread: the
+    # submit argument transfers ownership (tsd/cluster.py fan-out)
+    span = parent.child("peer_fetch")
+    fut = pool.submit(job, span)
+    return fut
+
+
+def begin_end_pair(obs_trace, work):
+    sp = obs_trace.begin("pipeline")
+    work()
+    obs_trace.end(sp)
+
+
+def estimated_child_hand_finish(parent, share):
+    # finish() only fills wall_ms when still None — the explicit store
+    # IS the finish (the planner's apportioned stage children)
+    child = parent.child("downsample", estimated=True)
+    child.device_ms = share
+    child.wall_ms = child.device_ms
+
+
+def finally_finishes(parent, work):
+    sp = parent.child("scan")
+    try:
+        work()
+    finally:
+        sp.finish()

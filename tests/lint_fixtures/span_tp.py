@@ -1,0 +1,24 @@
+"""Span-leak true positives (resource_leak's trace-span acquisition
+kind): a Span started via obs/trace.py must reach finish/with/finally
+or transfer ownership on every non-exceptional path."""
+
+
+def stage_never_finished(obs_trace, work):
+    sp = obs_trace.begin("pipeline")  # EXPECT: resource-leak
+    work()
+
+
+def early_return_leaks_span(obs_trace, work):
+    sp = obs_trace.begin("pipeline")
+    if work is None:
+        return None  # EXPECT: resource-leak-return
+    work()
+    obs_trace.end(sp)
+    return sp
+
+
+def child_never_finished(parent, values):
+    child = parent.child("aggregate")  # EXPECT: resource-leak
+    total = 0
+    for v in values:
+        total += v

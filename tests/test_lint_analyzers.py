@@ -34,6 +34,16 @@ FIXTURE_SCHEMA = {
     "tsd.good.name": "str",     # tsdblint: disable=config-unknown-key
 }
 
+# the miniature metrics schema the metrics fixtures are written against
+# (name -> (kind, labels)); tsd.fixture.* names are fixture-only
+FIXTURE_METRICS = {
+    "tsd.fixture.count": ("counter", ("route",)),       # tsdblint: disable=config-unknown-key
+    "tsd.fixture.level": ("gauge", ()),                 # tsdblint: disable=config-unknown-key
+    "tsd.fixture.latency_ms": ("histogram", ()),        # tsdblint: disable=config-unknown-key
+    "tsd.fixture.pushed": ("gauge", ("kind",)),         # tsdblint: disable=config-unknown-key
+    "tsd.*.errors": ("gauge", ("type",)),               # tsdblint: disable=config-unknown-key
+}
+
 _EXPECT = re.compile(r"#\s*EXPECT:\s*([a-z0-9-]+)")
 
 
@@ -51,6 +61,7 @@ def _lint_fixture(name: str) -> list:
     ctx = LintContext(REPO)
     ctx.bucket("config")["schema"] = dict(FIXTURE_SCHEMA)
     ctx.bucket("config")["compat"] = set()
+    ctx.bucket("metrics")["schema"] = dict(FIXTURE_METRICS)
     # the interprocedural analyzers scope their sinks to the serving
     # layers by default; fixtures opt their own directory in
     ctx.bucket("taint")["sink_paths"] = ("tests/lint_fixtures/",)
@@ -61,9 +72,13 @@ def _lint_fixture(name: str) -> list:
 
 
 TRUE_POSITIVE = ["jax_tp.py", "lock_tp.py", "config_tp.py", "except_tp.py",
-                 "shape_tp.py", "taint_tp.py", "leak_tp.py"]
+                 "shape_tp.py", "taint_tp.py", "leak_tp.py",
+                 "cache_tp.py", "install_tp.py", "span_tp.py",
+                 "metrics_tp.py"]
 TRUE_NEGATIVE = ["jax_tn.py", "lock_tn.py", "config_tn.py", "except_tn.py",
-                 "shape_tn.py", "taint_tn.py", "leak_tn.py"]
+                 "shape_tn.py", "taint_tn.py", "leak_tn.py",
+                 "cache_tn.py", "install_tn.py", "span_tn.py",
+                 "metrics_tn.py"]
 
 
 @pytest.mark.parametrize("name", TRUE_POSITIVE)
@@ -353,10 +368,93 @@ def test_shape_contracts_catch_reintroduced_narrowing(tmp_path):
                for f in findings), [f.render() for f in findings]
 
 
+def test_removing_the_cache_drop_fails_the_tree(tmp_path):
+    """The cache_coherence analyzer's load-bearing checks, pinned on the
+    exact PR 6 bug class:
+
+    (a) deleting the jit-cache clear inside `reload_calibration` — THE
+        single-entry-point invalidator every calibration mutation routes
+        through — must turn those mutation sites
+        (install_live_calibration, set_calibration_file, ...) into
+        findings;
+    (b) deleting the live-layer uninstall inside
+        `OnlineCalibrator.shutdown` must re-fire the paired-install rule
+        at the annotated install site.
+
+    If this test fails, the analyzer has gone blind to the regression it
+    exists to catch."""
+    import shutil
+    from tools.lint import cache_coherence
+
+    # (a) gut reload_calibration's dependent-cache clear
+    dst = tmp_path / "a" / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    cm = dst / "ops" / "costmodel.py"
+    src = cm.read_text()
+    needle = ("    with _lock:\n        _COSTS = None\n"
+              "    from opentsdb_tpu.ops.downsample import "
+              "_clear_dependent_caches\n    _clear_dependent_caches()\n")
+    assert src.count(needle) == 1, \
+        "expected exactly one clear inside reload_calibration"
+    cm.write_text(src.replace(
+        needle, "    with _lock:\n        _COSTS = None\n"))
+    ctx = LintContext(str(tmp_path / "a"))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path / "a"),
+                        analyzers=[cache_coherence.ANALYZER], ctx=ctx)
+    stale = [f for f in findings if f.rule == "cache-stale-mutation"]
+    assert stale, "gutting reload_calibration went undetected"
+    flagged = " ".join(f.message for f in stale)
+    assert "install_live_calibration" in flagged, (
+        "the live-layer install site should be among the stale "
+        "mutations:\n" + "\n".join(f.render() for f in findings))
+
+    # (b) gut OnlineCalibrator.shutdown's live-layer uninstall
+    dst = tmp_path / "b" / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    cal = dst / "ops" / "calibrate.py"
+    src = cal.read_text()
+    needle = "        costmodel.clear_live_calibration()\n"
+    assert needle in src
+    cal.write_text(src.replace(needle, ""))
+    ctx = LintContext(str(tmp_path / "b"))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path / "b"),
+                        analyzers=[cache_coherence.ANALYZER], ctx=ctx)
+    assert any(f.rule == "install-missing-uninstall"
+               and f.path == "opentsdb_tpu/ops/calibrate.py"
+               for f in findings), (
+        "gutting shutdown's clear_live_calibration went undetected:\n"
+        + "\n".join(f.render() for f in findings))
+
+
+def test_gutting_set_hysteresis_cache_clear_fails_the_tree(tmp_path):
+    """set_hysteresis not clearing the jit mode caches was a real PR 6
+    review bug; deleting its `_clear_dependent_caches()` call must
+    re-fire cache-stale-mutation at the band mutation."""
+    import shutil
+    from tools.lint import cache_coherence
+    dst = tmp_path / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    cm = dst / "ops" / "costmodel.py"
+    src = cm.read_text()
+    needle = ("        _choice_memo.clear()\n"
+              "    from opentsdb_tpu.ops.downsample import "
+              "_clear_dependent_caches\n    _clear_dependent_caches()\n")
+    assert needle in src, "expected the clear call inside set_hysteresis"
+    cm.write_text(src.replace(needle, "        _choice_memo.clear()\n"))
+    ctx = LintContext(str(tmp_path))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path),
+                        analyzers=[cache_coherence.ANALYZER], ctx=ctx)
+    hits = [f for f in findings if f.rule == "cache-stale-mutation"
+            and "set_hysteresis" in f.message]
+    assert hits, ("set_hysteresis without the cache clear went "
+                  "undetected:\n" + "\n".join(f.render()
+                                              for f in findings))
+
+
 def test_full_tree_lint_stays_under_the_tier1_budget():
-    """All seven analyzers over the package in under 30s — the bound
+    """All nine analyzers over the package in under 30s — the bound
     that keeps tsdblint viable inside tier-1 (and the pre-commit hook
-    tolerable).  The interprocedural fixpoint dominates; if this starts
+    tolerable).  The interprocedural fixpoints dominate; if this starts
     failing, parallelize the per-file check phase before relaxing the
     bound."""
     import time

@@ -68,6 +68,45 @@ def test_config_doc_is_generated_and_in_sync():
         "python tools/lint/run.py --update-doc")
 
 
+def test_metrics_doc_is_generated_and_in_sync():
+    """Same contract as docs/configuration.md: docs/metrics.md is
+    byte-for-byte the render of METRICS_SCHEMA."""
+    from opentsdb_tpu.obs import generate_metrics_doc
+    doc = os.path.join(REPO, "docs", "metrics.md")
+    assert os.path.exists(doc), \
+        "docs/metrics.md missing — python tools/lint/run.py --update-doc"
+    with open(doc, encoding="utf-8") as fh:
+        committed = fh.read()
+    assert committed == generate_metrics_doc(), (
+        "docs/metrics.md is stale — regenerate with "
+        "python tools/lint/run.py --update-doc")
+
+
+def test_every_metric_emission_is_declared_in_schema():
+    """Acceptance pin: no registry/StatsCollector emission of an
+    undeclared metric name anywhere in the package — filtered to the
+    metrics analyzer's rules so this stays a sharp failure even if some
+    other analyzer regresses first."""
+    findings = [f.render() for f in _package_findings()
+                if f.rule.startswith("metrics-")]
+    assert findings == [], (
+        "metric emissions outside METRICS_SCHEMA:\n"
+        + "\n".join(findings))
+
+
+def test_metrics_schema_kinds_and_labels_are_well_formed():
+    from opentsdb_tpu.obs import METRICS_SCHEMA
+    bad = []
+    for name, spec in METRICS_SCHEMA.items():
+        if spec.kind not in ("counter", "gauge", "histogram"):
+            bad.append("%s: unknown kind %r" % (name, spec.kind))
+        if not isinstance(spec.labels, tuple):
+            bad.append("%s: labels must be a tuple" % name)
+        if not spec.doc:
+            bad.append("%s: missing doc" % name)
+    assert bad == [], bad
+
+
 def test_schema_defaults_parse_as_their_declared_type():
     from opentsdb_tpu.utils.config import CONFIG_SCHEMA
     bad = []

@@ -272,3 +272,66 @@ class TestSegDtypeGuards:
             for k in range(w):
                 assert first[r, k] == r * n + 16 * k
                 assert last[r, k] == r * n + 16 * (k + 1) - 1
+
+
+class TestCacheCoherenceFixes:
+    """PR 7 true positives surfaced by tools/lint/cache_coherence.py."""
+
+    def test_clear_dependent_caches_covers_every_mode_baked_program(
+            self, monkeypatch):
+        """_jitted_union_batch and _jitted_update_sliced bake the same
+        trace-time mode globals as their siblings but were missing from
+        _clear_dependent_caches — a set_segment_chunk_ratio (or any
+        set_*_mode) flip kept serving stale sliced-update/union-batch
+        kernels.  Fails pre-fix: the spies never see clear_cache()."""
+        from opentsdb_tpu.ops import downsample, pipeline, streaming
+
+        cleared = []
+
+        class Spy:
+            def __init__(self, name):
+                self.name = name
+
+            def clear_cache(self):
+                cleared.append(self.name)
+
+        monkeypatch.setattr(pipeline, "_jitted_union_batch",
+                            Spy("union_batch"))
+        monkeypatch.setattr(streaming, "_jitted_update_sliced",
+                            Spy("update_sliced"))
+        downsample._clear_dependent_caches()
+        assert "union_batch" in cleared
+        assert "update_sliced" in cleared
+
+    def test_log_buffer_uninstall_detaches_from_root_logger(self):
+        """The /logs ring-buffer handler used to outlive every server:
+        installed on start, never detached.  Fails pre-fix:
+        uninstall_log_buffer did not exist and the handler stayed on
+        the root logger forever.  The refcount keeps the handler while
+        ANY server still runs."""
+        import logging
+        from opentsdb_tpu.tsd import admin_rpcs
+
+        root = logging.getLogger()
+        saved = admin_rpcs._LOG_BUFFER_INSTALLS
+        if admin_rpcs._LOG_BUFFER in root.handlers:
+            root.removeHandler(admin_rpcs._LOG_BUFFER)
+        admin_rpcs._LOG_BUFFER_INSTALLS = 0
+        try:
+            admin_rpcs.install_log_buffer()
+            admin_rpcs.install_log_buffer()   # a second server
+            assert root.handlers.count(admin_rpcs._LOG_BUFFER) == 1
+            admin_rpcs.uninstall_log_buffer()
+            # first server stopped; the second still needs capture
+            assert admin_rpcs._LOG_BUFFER in root.handlers
+            admin_rpcs.uninstall_log_buffer()
+            assert admin_rpcs._LOG_BUFFER not in root.handlers
+            # over-uninstall must not go negative / raise
+            admin_rpcs.uninstall_log_buffer()
+            assert admin_rpcs._LOG_BUFFER_INSTALLS == 0
+        finally:
+            admin_rpcs._LOG_BUFFER_INSTALLS = 0
+            if admin_rpcs._LOG_BUFFER in root.handlers:
+                root.removeHandler(admin_rpcs._LOG_BUFFER)
+            for _ in range(saved):
+                admin_rpcs.install_log_buffer()

@@ -31,6 +31,49 @@ import re
 GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 LOCK_CTORS = {"Lock", "RLock"}
 
+# --------------------------------------------------------------------- #
+# Cache-coherence grammar (tools/lint/cache_coherence.py)                #
+#                                                                       #
+#   # cache: <name> invalidated-by: <func>                              #
+#       Declares the global on this line (or the line below a           #
+#       standalone comment) as the backing store of a manual cache.     #
+#       <func> is the registered invalidator — a function in the same   #
+#       module (or dotted module.func) that drops the backing store.    #
+#       The special value `none` declares the cache's read-set          #
+#       immutable: it never needs invalidation, and the analyzer        #
+#       verifies nothing mutable can reach it.                          #
+#       Several lines may name the SAME cache: a cache can have more    #
+#       than one backing global (table + bookkeeping set).              #
+#                                                                       #
+#   # global-install: <uninstaller> paired-with: <func>                 #
+#       Marks a process-global install site (a module-level layer,      #
+#       handler, or patched factory armed from instance code).  The     #
+#       paired function <func> (same class, then same module) must      #
+#       call <uninstaller> and be reachable from a                      #
+#       shutdown/close/stop/__exit__ path.  The short form without      #
+#       `: <uninstaller>` only requires the pairing function to exist   #
+#       and be shutdown-reachable.                                      #
+# --------------------------------------------------------------------- #
+
+CACHE_ANN = re.compile(
+    r"#\s*cache:\s*([A-Za-z0-9_.\-]+)\s+invalidated-by:\s*"
+    r"([A-Za-z_][A-Za-z0-9_.]*|none)")
+INSTALL_ANN = re.compile(
+    r"#\s*global-install(?::\s*([A-Za-z_][A-Za-z0-9_.]*))?"
+    r"\s+paired-with:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def cache_annotation(line: str) -> tuple[str, str] | None:
+    """(cache name, invalidator func or 'none') from one source line."""
+    m = CACHE_ANN.search(line)
+    return (m.group(1), m.group(2)) if m else None
+
+
+def install_annotation(line: str) -> tuple[str | None, str] | None:
+    """(uninstaller or None, pairing func) from one source line."""
+    m = INSTALL_ANN.search(line)
+    return (m.group(1), m.group(2)) if m else None
+
 _PLAIN_DECL = re.compile(r"self\.[A-Za-z_][A-Za-z0-9_]*\s*(:[^=]+)?=")
 
 

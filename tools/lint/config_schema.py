@@ -113,8 +113,10 @@ def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
     # module-level tsd.* string constants (CONFIG_KEY / key-table idiom):
     # bare literals and literals inside dict/tuple/list displays.  Call
     # arguments are excluded — logging.getLogger("tsd.rpc") names a
-    # logger, not a key.
-    if not src.path.endswith("utils/config.py"):
+    # logger, not a key.  obs/__init__.py is excluded like config.py:
+    # its METRICS_SCHEMA table declares tsd.* METRIC names (their own
+    # analyzer, metrics_schema), not config keys.
+    if not src.path.endswith(("utils/config.py", "obs/__init__.py")):
         for stmt in src.tree.body:
             if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
                 continue

@@ -134,17 +134,22 @@ def _iter_py_files(paths: Iterable[str], root: str) -> list[str]:
 
 
 def get_analyzers() -> list[Analyzer]:
-    """All seven analyzers (imported lazily so `core` has no circulars).
+    """All nine analyzers (imported lazily so `core` has no circulars).
 
     The PR-2 four are per-file; the v2 three (shape/dtype abstract
     interpretation, request-field taint, resource-leak paths) run over
-    the interprocedural call graph built once per LintContext."""
-    from tools.lint import (config_schema, exception_discipline,
-                            jax_hygiene, lock_discipline, resource_leak,
-                            shape_dtype, taint)
+    the interprocedural call graph built once per LintContext, as does
+    the v3 cache-coherence pass.  metrics_schema is per-file like
+    config_schema."""
+    from tools.lint import (cache_coherence, config_schema,
+                            exception_discipline, jax_hygiene,
+                            lock_discipline, metrics_schema,
+                            resource_leak, shape_dtype, taint)
     return [jax_hygiene.ANALYZER, lock_discipline.ANALYZER,
-            config_schema.ANALYZER, exception_discipline.ANALYZER,
-            shape_dtype.ANALYZER, taint.ANALYZER, resource_leak.ANALYZER]
+            config_schema.ANALYZER, metrics_schema.ANALYZER,
+            exception_discipline.ANALYZER, shape_dtype.ANALYZER,
+            taint.ANALYZER, resource_leak.ANALYZER,
+            cache_coherence.ANALYZER]
 
 
 ALL_ANALYZERS = get_analyzers

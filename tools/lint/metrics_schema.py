@@ -1,0 +1,211 @@
+"""Metrics-name schema checks: every emitted metric must be declared.
+
+`opentsdb_tpu/obs/__init__.py` declares `METRICS_SCHEMA` (name ->
+kind, labels, doc).  This analyzer holds every emission site to it —
+the per-metric mirror of config_schema's key discipline.  Ad-hoc
+metric names rot silently: a typo'd counter scrapes as a NEW series
+forever, a gauge re-registered as a counter 500s the stats endpoint at
+runtime, and a dashboard built on an undeclared name breaks the day
+someone "cleans it up".
+
+Emission sites checked:
+
+  * `REGISTRY.counter/gauge/histogram("name", ...)` — the pull-style
+    obs/registry.py families (the call's attribute IS the kind).
+  * `collector.record("name", ...)` — StatsCollector push records,
+    exposed as gauges on /api/stats/prometheus; the declared name is
+    the full dotted form WITH the collector's "tsd." prefix.
+
+Name resolution: a string literal matches exactly; a %-formatted
+template ("%s.errors" % kind) matches with each hole as a `*` segment
+("tsd.*.errors" must be declared verbatim); anything else is a dynamic
+name (see below).
+
+Rules:
+
+  metrics-unknown-name    the (wildcarded) name is not declared in
+                          METRICS_SCHEMA
+  metrics-kind-collision  the emission kind disagrees with the schema
+                          (a record() against a name declared counter/
+                          histogram, or REGISTRY.gauge on a declared
+                          counter — the registry raises on this at
+                          runtime; catch it before it ships)
+  metrics-dynamic-name    the name is computed (variable, f-string
+                          with no literal backbone) — unverifiable
+                          statically.  Generic forwarders that re-emit
+                          names already walked from collect_stats()
+                          suppress this with a justification comment.
+  metrics-unknown-label   a `.labels(k=...)` chained on the family
+                          call, or a literal `"k=v"` xtratag, uses a
+                          label key the schema does not declare
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_UNKNOWN = "metrics-unknown-name"
+RULE_KIND = "metrics-kind-collision"
+RULE_DYNAMIC = "metrics-dynamic-name"
+RULE_LABEL = "metrics-unknown-label"
+
+FAMILY_KINDS = ("counter", "gauge", "histogram")
+RECORD_RECEIVERS = frozenset({"collector", "stats_collector"})
+RECORD_PREFIX = "tsd."
+
+
+def _load_schema(ctx: LintContext) -> dict:
+    """name -> (kind, labels).  Tests inject via
+    ctx.bucket("metrics")["schema"]."""
+    bucket = ctx.bucket("metrics")
+    if "schema" not in bucket:
+        from opentsdb_tpu.obs import METRICS_SCHEMA
+        bucket["schema"] = {k: (s.kind, tuple(s.labels))
+                            for k, s in METRICS_SCHEMA.items()}
+    return bucket["schema"]
+
+
+def _template_name(node: ast.expr) -> str | None:
+    """Literal name, or a %-format/f-string template with `*` holes;
+    None when the name is fully dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) and \
+            isinstance(node.left, ast.Constant) and \
+            isinstance(node.left.value, str):
+        out = node.left.value
+        for hole in ("%s", "%d", "%r"):
+            out = out.replace(hole, "*")
+        return out
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        out = "".join(parts)
+        return out if out.strip("*") else None
+    return None
+
+
+def _family_call(node: ast.Call) -> str | None:
+    """'counter'/'gauge'/'histogram' when node is REGISTRY.<kind>(...)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in FAMILY_KINDS and \
+            isinstance(f.value, ast.Name) and f.value.id == "REGISTRY":
+        return f.attr
+    return None
+
+
+def _record_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "record"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in RECORD_RECEIVERS)
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    schema = _load_schema(ctx)
+    out: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _family_call(node)
+        if kind is not None and node.args:
+            name = _template_name(node.args[0])
+            if name is None:
+                out.append(Finding(
+                    src.path, node.lineno, RULE_DYNAMIC,
+                    "REGISTRY.%s() with a computed metric name — "
+                    "declare the name in METRICS_SCHEMA and emit a "
+                    "literal (or template), or suppress with a "
+                    "justification at a sanctioned forwarder" % kind))
+                continue
+            decl = schema.get(name)
+            if decl is None:
+                out.append(Finding(
+                    src.path, node.lineno, RULE_UNKNOWN,
+                    "metric '%s' (via REGISTRY.%s) is not declared in "
+                    "METRICS_SCHEMA" % (name, kind)))
+            elif decl[0] != kind:
+                out.append(Finding(
+                    src.path, node.lineno, RULE_KIND,
+                    "REGISTRY.%s() on metric '%s' which METRICS_SCHEMA "
+                    "declares a %s — the registry raises on this kind "
+                    "collision at runtime" % (kind, name, decl[0])))
+            continue
+        # chained .labels(k=...) on a family call
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "labels" and \
+                isinstance(f.value, ast.Call):
+            fam_kind = _family_call(f.value)
+            if fam_kind is not None and f.value.args:
+                name = _template_name(f.value.args[0])
+                decl = schema.get(name) if name else None
+                if decl is not None:
+                    for kw in node.keywords:
+                        if kw.arg is not None and \
+                                kw.arg not in decl[1]:
+                            out.append(Finding(
+                                src.path, node.lineno, RULE_LABEL,
+                                "label '%s' on metric '%s' is not in "
+                                "its declared label set %r"
+                                % (kw.arg, name, list(decl[1]))))
+            continue
+        if _record_call(node) and node.args:
+            name = _template_name(node.args[0])
+            if name is None:
+                out.append(Finding(
+                    src.path, node.lineno, RULE_DYNAMIC,
+                    "collector.record() with a computed metric name — "
+                    "declare the name in METRICS_SCHEMA and emit a "
+                    "literal (or template), or suppress with a "
+                    "justification at a sanctioned forwarder"))
+                continue
+            full = RECORD_PREFIX + name
+            decl = schema.get(full)
+            if decl is None:
+                out.append(Finding(
+                    src.path, node.lineno, RULE_UNKNOWN,
+                    "metric '%s' (via collector.record) is not "
+                    "declared in METRICS_SCHEMA" % full))
+                continue
+            if decl[0] != "gauge":
+                out.append(Finding(
+                    src.path, node.lineno, RULE_KIND,
+                    "collector.record() on metric '%s' which "
+                    "METRICS_SCHEMA declares a %s — records expose as "
+                    "gauges on /api/stats/prometheus" % (full, decl[0])))
+            if len(node.args) >= 3:
+                key = _xtratag_key(node.args[2])
+                if key is not None and key not in decl[1]:
+                    out.append(Finding(
+                        src.path, node.lineno, RULE_LABEL,
+                        "xtratag key '%s' on metric '%s' is not in its "
+                        "declared label set %r"
+                        % (key, full, list(decl[1]))))
+    return out
+
+
+def _xtratag_key(node: ast.expr) -> str | None:
+    """The tag key of a literal/templated "k=v" xtratag argument."""
+    text = None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod) \
+            and isinstance(node.left, ast.Constant) and \
+            isinstance(node.left.value, str):
+        text = node.left.value
+    if text and "=" in text:
+        key = text.split("=", 1)[0]
+        if key and "%" not in key:
+            return key
+    return None
+
+
+ANALYZER = Analyzer(
+    "metrics_schema", (RULE_UNKNOWN, RULE_KIND, RULE_DYNAMIC, RULE_LABEL),
+    check)

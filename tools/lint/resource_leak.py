@@ -30,10 +30,21 @@ Two findings:
                          a LATER line does release — the error path
                          leaks what the happy path closes
 
+Trace spans (obs/trace.py) are an acquisition kind too: a `Span`
+started via `obs_trace.begin(...)` or `parent.child(...)` must reach
+`finish()` (or the explicit hand-finish `span.wall_ms = ...` the
+estimated-children idiom uses), a `with`, or escape to another owner on
+all paths — an unfinished span renders a forever-climbing wallMs at
+every later /api/stats/query scrape until the trace closes it.  The
+cluster fan-out's create-on-owner/finish-on-pool handoff is the
+canonical ownership transfer: the span passes into `pool.submit(...)`
+and the pool thread finishes it.
+
 Scope: `opentsdb_tpu/tsd/`, `opentsdb_tpu/storage/`,
-`opentsdb_tpu/tools/` by default.  Exceptional exits (raise) are out of
-scope by design — that is what `with`/`finally` are for, and flagging
-every raise-crossing would drown the real findings.
+`opentsdb_tpu/tools/`, `opentsdb_tpu/query/`, `opentsdb_tpu/obs/` by
+default.  Exceptional exits (raise) are out of scope by design — that
+is what `with`/`finally` are for, and flagging every raise-crossing
+would drown the real findings.
 """
 
 from __future__ import annotations
@@ -46,7 +57,8 @@ RULE_LEAK = "resource-leak"
 RULE_LEAK_RETURN = "resource-leak-return"
 
 LEAK_DIRS = ("opentsdb_tpu/tsd/", "opentsdb_tpu/storage/",
-             "opentsdb_tpu/tools/")
+             "opentsdb_tpu/tools/", "opentsdb_tpu/query/",
+             "opentsdb_tpu/obs/")
 
 ACQUIRE_NAMES = {"open", "ThreadPoolExecutor", "ProcessPoolExecutor",
                  "Popen"}
@@ -55,9 +67,18 @@ ACQUIRE_ATTRS = {
     ("subprocess", "Popen"), ("gzip", "open"), ("bz2", "open"),
     ("lzma", "open"), ("io", "open"), ("os", "fdopen"),
     ("tempfile", "NamedTemporaryFile"), ("tempfile", "TemporaryFile"),
+    # span starts: obs/trace.py's non-context-manager stage API
+    ("obs_trace", "begin"), ("trace", "begin"),
 }
+# method names that mint a new Span on ANY receiver (Span.child /
+# Trace.current().child — the receiver varies, the contract doesn't)
+SPAN_METHODS = {"child"}
 RELEASERS = {"close", "shutdown", "stop", "terminate", "kill", "wait",
-             "communicate", "release", "join", "quit", "detach"}
+             "communicate", "release", "join", "quit", "detach",
+             "finish"}
+# attribute stores that hand-finish a span (finish() only fills wall_ms
+# when it is still None — an explicit assignment IS the finish)
+HAND_FINISH_ATTRS = {"wall_ms"}
 
 
 def _acquire_kind(call: ast.Call) -> str | None:
@@ -67,6 +88,8 @@ def _acquire_kind(call: ast.Call) -> str | None:
     if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
             and (f.value.id, f.attr) in ACQUIRE_ATTRS:
         return "%s.%s" % (f.value.id, f.attr)
+    if isinstance(f, ast.Attribute) and f.attr in SPAN_METHODS:
+        return "span.%s" % f.attr
     return None
 
 
@@ -100,7 +123,8 @@ class _FnLeaks:
     # -- name usage classification --------------------------------------
 
     def _released(self, st: ast.stmt) -> set[str]:
-        """Names released by `.close()`-style calls anywhere in `st`."""
+        """Names released by `.close()`-style calls anywhere in `st`,
+        plus spans hand-finished by a `span.wall_ms = ...` store."""
         out = set()
         for node in ast.walk(st):
             if isinstance(node, ast.Call) \
@@ -108,6 +132,12 @@ class _FnLeaks:
                     and node.func.attr in RELEASERS \
                     and isinstance(node.func.value, ast.Name):
                 out.add(node.func.value.id)
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and tgt.attr in HAND_FINISH_ATTRS \
+                            and isinstance(tgt.value, ast.Name):
+                        out.add(tgt.value.id)
         return out
 
     def _escaped(self, st: ast.stmt) -> set[str]:

@@ -65,12 +65,15 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.update_doc:
+        from opentsdb_tpu.obs import generate_metrics_doc
         from opentsdb_tpu.utils.config import generate_config_doc
-        doc_path = os.path.join(REPO_ROOT, "docs", "configuration.md")
-        os.makedirs(os.path.dirname(doc_path), exist_ok=True)
-        with open(doc_path, "w", encoding="utf-8") as fh:
-            fh.write(generate_config_doc())
-        print("wrote %s" % os.path.relpath(doc_path, REPO_ROOT))
+        for fname, render in (("configuration.md", generate_config_doc),
+                              ("metrics.md", generate_metrics_doc)):
+            doc_path = os.path.join(REPO_ROOT, "docs", fname)
+            os.makedirs(os.path.dirname(doc_path), exist_ok=True)
+            with open(doc_path, "w", encoding="utf-8") as fh:
+                fh.write(render())
+            print("wrote %s" % os.path.relpath(doc_path, REPO_ROOT))
         return 0
 
     paths = args.paths or DEFAULT_PATHS
