@@ -73,6 +73,33 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
     "tsd.query.latency_ms": _m(
         "histogram", (),
         "End-to-end /api/query latency in milliseconds."),
+    # -- admission control (tsd/admission.py) -------------------------- #
+    "tsd.query.admission.queue_depth": _m(
+        "gauge", ("priority",),
+        "Admission wait-queue depth, by priority class."),
+    "tsd.query.admission.wait_ms": _m(
+        "histogram", ("priority",),
+        "Admission queue wait in milliseconds, by priority class."),
+    "tsd.query.admission.inflight": _m(
+        "gauge", (),
+        "Queries currently holding an admission permit (bounded by "
+        "tsd.query.admission.permits)."),
+    "tsd.query.admission.shed": _m(
+        "counter", ("reason",),
+        "Queries refused by the admission gate (503 + Retry-After), "
+        "by reason: queue_full, max_wait, predicted_cost."),
+    "tsd.query.admission.degraded": _m(
+        "counter", ("reason",),
+        "Queries served degraded by the admission ladder "
+        "(coarsened/truncated, 200 + partialResults)."),
+    "tsd.query.admission.cancelled": _m(
+        "counter", ("reason",),
+        "Queries cancelled cooperatively, by reason: "
+        "client_disconnect, drain_timeout, queued."),
+    "tsd.query.limits.reload_errors": _m(
+        "counter", (),
+        "Query-limit overrides loads that failed (the daemon kept "
+        "the last good config; logged once per distinct error)."),
     "tsd.rpc.received": _m(
         "gauge", ("type",),
         "RPCs received, by transport/command type."),

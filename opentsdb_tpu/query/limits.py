@@ -17,10 +17,15 @@ deadline is checked between group/segment dispatches.
 from __future__ import annotations
 
 import json
+import logging
+import math
 import os
 import re
+import threading
 import time
 from dataclasses import dataclass
+
+LOG = logging.getLogger(__name__)
 
 
 class QueryException(Exception):
@@ -30,6 +35,116 @@ class QueryException(Exception):
         super().__init__(message)
         self.status = status
 
+
+class QueryCancelledException(QueryException):
+    """The request-scoped deadline was cancelled mid-flight: the client
+    disconnected, the server is draining, or the deadline expired and an
+    outside party (the responder loop) flipped the token.  503: the
+    server gave up on purpose, the query itself was not malformed."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status=503)
+
+
+class Deadline:
+    """One request-scoped wall budget + cooperative cancellation token.
+
+    Minted ONCE per request (rpc_manager.handle_http) from
+    ``tsd.query.timeout`` and/or the client's ``X-TSDB-Deadline-Ms``
+    header (whichever is smaller), then threaded through the whole
+    lifecycle: every planner ``QueryBudget`` derives its clock from this
+    object instead of a fresh ``time.monotonic()``, the cluster fan-out
+    clamps its retry budget to ``remaining_ms()`` and forwards the
+    remainder to peers, and the admission gate refuses queries whose
+    predicted cost cannot fit in what's left.
+
+    Cancellation is COOPERATIVE: ``cancel()`` flips the token (client
+    disconnect is detected by the server responder loop; drain timeout
+    by ``TSDServer.stop``), and every existing ``check_deadline()``
+    site — plus the admission-queue wait — observes it via ``check()``.
+    """
+
+    def __init__(self, timeout_ms: float = 0.0,
+                 clock=time.monotonic):
+        self.start = clock()
+        self.timeout_ms = float(timeout_ms)      # <= 0: unbounded
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cancelled = False  # guarded-by: _lock
+        self._cancel_reason = ""  # guarded-by: _lock
+
+    @property
+    def bounded(self) -> bool:
+        return self.timeout_ms > 0
+
+    def elapsed_ms(self) -> float:
+        return (self._clock() - self.start) * 1e3
+
+    def remaining_ms(self) -> float:
+        """Milliseconds left; +inf when unbounded, <= 0 once expired."""
+        if not self.bounded:
+            return math.inf
+        return self.timeout_ms - self.elapsed_ms()
+
+    def expired(self) -> bool:
+        return self.bounded and self.remaining_ms() <= 0.0
+
+    def cancel(self, reason: str) -> bool:
+        """Flip the cancellation token (idempotent; first reason wins).
+        Returns True when this call did the flip."""
+        with self._lock:
+            if self._cancelled:
+                return False
+            self._cancelled = True
+            self._cancel_reason = reason
+        return True
+
+    def is_cancelled(self) -> bool:
+        return self._cancelled
+
+    @property
+    def cancel_reason(self) -> str:
+        return self._cancel_reason
+
+    def check(self) -> None:
+        """Raise if this request should stop doing work NOW: cancelled
+        (503) or past its wall budget (the reference's 413 shape)."""
+        if self._cancelled:
+            raise QueryCancelledException(
+                "Query cancelled: %s" % (self._cancel_reason or "unknown"))
+        if self.expired():
+            raise QueryException(
+                "Sorry, your query timed out. Time limit: %d ms, elapsed: "
+                "%d ms. Please try filtering using more tags or decrease "
+                "your time range." % (self.timeout_ms, self.elapsed_ms()))
+
+
+# --------------------------------------------------------------------- #
+# Ambient request deadline: one per responder thread                    #
+# --------------------------------------------------------------------- #
+
+_tls = threading.local()
+
+
+def activate_deadline(deadline: Deadline) -> None:
+    _tls.deadline = deadline
+
+
+def deactivate_deadline() -> None:
+    _tls.deadline = None
+
+
+def active_deadline() -> Deadline | None:
+    """The current request's deadline, or None outside a request (the
+    library-caller path: QueryRunner.run with no server above it)."""
+    return getattr(_tls, "deadline", None)
+
+
+# Everything a hostile/corrupt overrides file can raise through
+# json.load + LimitOverrideItem construction: I/O, non-JSON bytes
+# (ValueError covers JSONDecodeError and non-UTF-8 decode), a missing
+# "regex" key, non-mapping entries (TypeError), a bad regex.
+_LOAD_ERRORS = (OSError, ValueError, KeyError, TypeError, re.error)
 
 # Charged per datapoint when estimating "bytes fetched from storage":
 # 8B timestamp + 8B value in the columnar chunks (the reference counted
@@ -79,8 +194,16 @@ class QueryLimitOverride:
         self.overrides: list[LimitOverrideItem] = []
         self._mtime = 0.0
         self._next_check = 0.0
+        self.reload_errors = 0
+        self._logged_errors: set[str] = set()
         if self.file_location:
-            self._load_from_file()
+            # A corrupt/unreadable overrides file must not crash TSDB
+            # construction (the hot-reload path already keeps last-good;
+            # construction starts from defaults): log, count, serve.
+            try:
+                self._load_from_file()
+            except _LOAD_ERRORS as e:
+                self._count_reload_error(e, during="construction")
 
     def _load_from_file(self) -> None:
         try:
@@ -102,6 +225,26 @@ class QueryLimitOverride:
         self.overrides = items
         self._mtime = mtime
 
+    def _count_reload_error(self, exc: Exception,
+                            during: str = "reload") -> None:
+        """An overrides file the loader refused: keep serving the
+        current (last-good or default) limits, but leave an operator
+        trail — a counter on every failure, a log line once per
+        DISTINCT error so a bad push is loud without a log flood."""
+        self.reload_errors += 1
+        from opentsdb_tpu.obs.registry import REGISTRY
+        REGISTRY.counter(
+            "tsd.query.limits.reload_errors",
+            "Query-limit overrides loads that failed (kept last "
+            "good)").inc()
+        key = "%s: %s" % (type(exc).__name__, exc)
+        if key not in self._logged_errors:
+            self._logged_errors.add(key)
+            LOG.error(
+                "query limit overrides %s failed on %s (%s); keeping %s",
+                during, self.file_location, key,
+                "last good config" if self.overrides else "defaults")
+
     def maybe_reload(self) -> None:
         """Hot-reload check, rate-limited to the configured interval."""
         if not self.file_location or self.reload_interval <= 0:
@@ -112,8 +255,10 @@ class QueryLimitOverride:
         self._next_check = now + self.reload_interval
         try:
             self._load_from_file()
-        except (OSError, ValueError, KeyError, re.error):
-            pass  # keep serving the last good config (loadFromFile catch)
+        except _LOAD_ERRORS as e:
+            # keep serving the last good config (loadFromFile catch) —
+            # but counted and logged, not silent
+            self._count_reload_error(e)
 
     def get_byte_limit(self, metric: str) -> int:
         if metric:
@@ -139,12 +284,18 @@ class QueryBudget:
     """
 
     def __init__(self, limits: QueryLimitOverride | None, metric: str,
-                 timeout_ms: int):
+                 timeout_ms: int, deadline: Deadline | None = None):
         self.max_data_points = (
             limits.get_data_points_limit(metric) if limits else 0)
         self.max_bytes = limits.get_byte_limit(metric) if limits else 0
         self.timeout_ms = timeout_ms
-        self.start = time.monotonic()
+        # Derived from the REQUEST deadline when one is active: every
+        # sub query of a request shares the clock that started when the
+        # request arrived, instead of each sub query restarting
+        # tsd.query.timeout from planner time.
+        self.deadline = deadline
+        self.start = deadline.start if deadline is not None \
+            else time.monotonic()
         self.data_points = 0
 
     def charge(self, num_points: int) -> None:
@@ -163,6 +314,11 @@ class QueryBudget:
                 % (self.max_bytes / 1024 / 1024))
 
     def check_deadline(self) -> None:
+        if self.deadline is not None:
+            # request-scoped expiry + the cooperative cancellation token
+            # (client disconnect, server drain) — checked at every
+            # existing deadline site for free
+            self.deadline.check()
         if self.timeout_ms <= 0:
             return
         elapsed_ms = (time.monotonic() - self.start) * 1000.0

@@ -1146,13 +1146,19 @@ class QueryRunner:
         return results
 
     def _new_budget(self, sub: TSSubQuery):
-        """Scan budget + deadline for one sub query (QueryLimitOverride)."""
-        from opentsdb_tpu.query.limits import QueryBudget
+        """Scan budget + deadline for one sub query (QueryLimitOverride).
+
+        Derived from the AMBIENT request deadline when one is active
+        (rpc_manager minted it at request arrival): every sub query
+        shares the request's clock and cancellation token instead of
+        restarting tsd.query.timeout at planner time."""
+        from opentsdb_tpu.query.limits import QueryBudget, active_deadline
         tsdb = self.tsdb
         limits = tsdb.query_limits
         limits.maybe_reload()
         return QueryBudget(limits, sub.metric or "",
-                           tsdb.config.get_int("tsd.query.timeout"))
+                           tsdb.config.get_int("tsd.query.timeout"),
+                           deadline=active_deadline())
 
     def run_sub(self, query: TSQuery, sub: TSSubQuery) -> list[QueryResult]:
         budget = self._new_budget(sub)

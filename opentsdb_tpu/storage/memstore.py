@@ -23,6 +23,7 @@ SaltScanner collects them per row (SaltScanner.java:425-448).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import zlib
@@ -537,6 +538,19 @@ class MemStore:
         with self._lock:
             keys = self._by_metric.get(metric, set())
             return [self._series[k] for k in keys]
+
+    def series_count_and_sample(self, metric: int,
+                                limit: int) -> tuple[int, list[Series]]:
+        """Series count + a bounded sample for a metric WITHOUT
+        building the full per-metric list — the pre-admission
+        cost-estimate path (tsd/admission.py) runs on every arrival
+        and must hold the store lock for a bounded allocation, not an
+        O(series-of-metric) copy."""
+        with self._lock:
+            keys = self._by_metric.get(metric, set())
+            sample = [self._series[k]
+                      for k in itertools.islice(keys, limit)]
+            return len(keys), sample
 
     def select(self, metric: int,
                predicate: Callable[[SeriesKey], bool] | None = None) -> list[Series]:

@@ -1,0 +1,558 @@
+"""Admission control for the query path: bounded concurrency, priority
+queues, costmodel-informed shedding, and a degradation ladder.
+
+The 8-thread responder pool (tsd/server.py) queues work unboundedly;
+under saturation the daemon doesn't degrade — it stalls.  This gate
+sits in front of every device-dispatching query (QueryRpc /
+GraphHandler execution) and bounds what the daemon ADMITS, in the
+Enthuse shared-aggregation stance (arXiv:2405.18168): bound what you
+admit, shed what you can't, and make every admitted query finish
+inside its deadline.
+
+Three mechanisms, one `admit()` front door:
+
+  * **Permits** — at most ``tsd.query.admission.permits`` queries
+    dispatch device work concurrently; excess requests wait in a
+    bounded FIFO queue per priority class (``X-TSDB-Priority:
+    interactive|batch``, interactive drains first).  A full queue
+    sheds with 503 + ``Retry-After``.
+  * **Costmodel shedding** — with a bounded request deadline
+    (tsd.query.timeout or the client's ``X-TSDB-Deadline-Ms``), the
+    parsed plan's predicted device cost (PR 6's fitted ``predict_*``
+    via obs.jaxprof.stage_breakdown) plus the expected queue wait is
+    compared against the remaining deadline; a query that cannot
+    finish in time is refused NOW (503 + Retry-After) instead of
+    burning device time and timing out anyway.  When
+    ``tsd.query.degrade=allow``, a degradation ladder runs first:
+    coarsen the downsample interval (x2..x16), then truncate the range
+    toward the present — a degraded 200 carries the ``partialResults``
+    annotation (tsd/cluster.py partial_annotation).
+  * **Cooperative cancellation** — the queue wait observes the request
+    deadline's cancellation token (query/limits.py Deadline): a
+    cancelled or expired query leaves the queue WITHOUT taking a
+    permit; the server responder loop flips the token on client
+    disconnect, and TSDServer.stop flips every in-flight one at drain
+    timeout.
+
+Every decision is traced (an ``admission`` child span with wait ms +
+decision) and counted (queue depth gauge, wait histogram,
+shed/degrade/cancel counters by reason — see METRICS_SCHEMA).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+
+from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.obs.registry import REGISTRY
+from opentsdb_tpu.query.limits import (
+    Deadline, QueryException, active_deadline)
+from opentsdb_tpu.uid import NoSuchUniqueName
+from opentsdb_tpu.utils import faults
+
+# Remaining request budget, in integer milliseconds, forwarded to
+# fan-out peers (tsd/cluster.py) and accepted from clients
+# (rpc_manager.handle_http mints the request Deadline from
+# min(tsd.query.timeout, this header)).
+DEADLINE_HEADER = "x-tsdb-deadline-ms"
+PRIORITY_HEADER = "x-tsdb-priority"
+
+# Priority classes, drain order first to last.  An unknown/absent
+# header value lands in the first class.
+CLASSES = ("interactive", "batch")
+
+# Queue-wait poll granularity: cancellation (client disconnect, drain)
+# flips a token without notifying the gate's condition, so waiters
+# re-check on this cadence even without a release.
+_WAIT_TICK_S = 0.05
+
+
+class ShedError(QueryException):
+    """Admission refused the query: 503 + Retry-After.  The server is
+    overloaded (or the query cannot meet its deadline) — the client
+    should back off and retry, unchanged requests may succeed later."""
+
+    def __init__(self, message: str, retry_after_s: int = 1):
+        super().__init__(message, status=503)
+        self.retry_after_s = max(int(retry_after_s), 1)
+
+
+def count_cancelled(reason: str) -> None:
+    """The cancel counter, one emission site for every flipper (gate
+    queue wait, server disconnect watcher, drain force-cancel)."""
+    REGISTRY.counter(
+        "tsd.query.admission.cancelled",
+        "Queries cancelled cooperatively, by reason").labels(
+            reason=reason).inc()
+
+
+class CancellationHandle:
+    """Server-side cancellation lever for one in-flight request.
+
+    The responder loop creates it BEFORE dispatching (it owns
+    disconnect detection), attaches it to the request, and
+    rpc_manager.handle_http binds the freshly minted Deadline to it —
+    ``cancel()`` works in either order: a flip that lands before the
+    bind is replayed onto the deadline when it arrives.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._deadline: Deadline | None = None  # guarded-by: _lock
+        self._pending_reason: str | None = None  # guarded-by: _lock
+
+    def bind(self, deadline: Deadline) -> None:
+        with self._lock:
+            self._deadline = deadline
+            reason = self._pending_reason
+        if reason is not None:
+            deadline.cancel(reason)
+
+    def cancel(self, reason: str) -> bool:
+        """Flip the bound deadline's token (or stash the reason for the
+        bind).  Returns True when this call did the flip."""
+        with self._lock:
+            deadline = self._deadline
+            if deadline is None:
+                if self._pending_reason is not None:
+                    return False
+                self._pending_reason = reason
+                return True
+        return deadline.cancel(reason)
+
+    def is_cancelled(self) -> bool:
+        with self._lock:
+            if self._deadline is None:
+                return self._pending_reason is not None
+            deadline = self._deadline
+        return deadline.is_cancelled()
+
+
+# --------------------------------------------------------------------- #
+# Plan-shape cost estimation                                            #
+# --------------------------------------------------------------------- #
+
+# Series sampled per sub query when estimating point counts: the
+# estimate must stay O(sample * log points), never O(all series), on
+# the pre-admission path.
+_COST_SAMPLE_SERIES = 64
+
+
+def estimate_plan_cost_ms(tsdb, ts_query) -> float:
+    """Predicted device milliseconds for the parsed plan, from the
+    fitted costmodel (obs.jaxprof.stage_breakdown over the per-axis
+    ``predict_*``).  An ESTIMATE by design: series counts are
+    un-filtered (upper bound), point counts extrapolate from a bounded
+    sample (the per-series window_count is the log-points part; the
+    store hands back a count + bounded sample, never the full
+    per-metric series list), and the group count is approximated —
+    good enough to refuse a query that is orders off its deadline,
+    never a timer.  Returns 0.0 when nothing is predictable (unknown
+    metrics, tsuid subqueries, empty stores)."""
+    from opentsdb_tpu.obs import jaxprof
+    from opentsdb_tpu.ops.downsample import pad_pow2
+    from opentsdb_tpu.ops.hostlane import execution_platform
+
+    platform = execution_platform()
+    fix = tsdb.config.fix_duplicates
+    total_s = 0.0
+    for sub in ts_query.queries:
+        if not sub.metric:
+            continue                    # tsuids: host-local, unpredicted
+        try:
+            metric_uid = tsdb.metrics.get_id(sub.metric)
+        except NoSuchUniqueName:
+            continue
+        s, sample = tsdb.store.series_count_and_sample(
+            metric_uid, _COST_SAMPLE_SERIES)
+        if not s:
+            continue
+        pts = sum(sr.window_count(ts_query.start_time, ts_query.end_time,
+                                  fix) for sr in sample)
+        points = pts * s / len(sample)
+        if points <= 0:
+            continue
+        n = pad_pow2(max(int(math.ceil(points / s)), 1))
+        ds = sub.downsample_spec
+        ds_fn = None
+        w = 1
+        if ds is not None and ds.interval_ms > 0 and not ds.run_all:
+            ds_fn = ds.function
+            w = max(int((ts_query.end_time - ts_query.start_time)
+                        // ds.interval_ms) + 1, 1)
+        # group count: "none" keeps every series; aggregations reduce —
+        # approximated as one group (conservatively LOW, so estimation
+        # errs toward admitting)
+        g = pad_pow2(s if sub.aggregator == "none" else 1)
+        breakdown = jaxprof.stage_breakdown(platform, s, n, w, g, ds_fn,
+                                            bool(sub.rate))
+        total_s += sum(breakdown.values())
+    return total_s * 1e3
+
+
+# --------------------------------------------------------------------- #
+# Degradation ladder                                                    #
+# --------------------------------------------------------------------- #
+
+# Rung 1: coarsen eligible fixed downsample intervals by these factors.
+_COARSEN_FACTORS = (2, 4, 8, 16)
+# Rung 2: truncate the range toward the present, keeping this fraction.
+_TRUNCATE_KEEP = (0.5, 0.25, 0.125)
+
+
+def _coarsenable(sub) -> bool:
+    ds = sub.downsample_spec
+    return (ds is not None and ds.interval_ms > 0
+            and not ds.use_calendar and not ds.run_all)
+
+
+def try_degrade(tsdb, ts_query, budget_ms: float,
+                queue_wait_ms: float) -> dict | None:
+    """Mutate ``ts_query`` down the ladder until its predicted cost
+    fits ``budget_ms - queue_wait_ms``; returns the degradation note
+    for the partialResults annotation, or None when even the last rung
+    doesn't fit.  Deterministic and cheap: each rung re-runs the same
+    plan-shape estimate.  Rungs coarsen from the ORIGINAL interval
+    (not compounding), so the note reports the factor actually
+    applied."""
+    fits_ms = budget_ms - queue_wait_ms
+    coarsen = [sub for sub in ts_query.queries if _coarsenable(sub)]
+    originals = {id(sub): sub.downsample_spec.interval_ms
+                 for sub in coarsen}
+    for factor in _COARSEN_FACTORS:
+        if not coarsen:
+            break
+        for sub in coarsen:
+            sub.downsample_spec.interval_ms = \
+                originals[id(sub)] * factor
+            # the STRING form is what travels to stats/duplicate
+            # detection/peers (TSQuery hash + ts_query_json) and what a
+            # re-validate would re-parse — keep it in lockstep with the
+            # parsed spec (a coarsenable spec always has a "-fn" tail)
+            sub.downsample = "%dms-%s" % (
+                sub.downsample_spec.interval_ms,
+                sub.downsample.split("-", 1)[1])
+        if estimate_plan_cost_ms(tsdb, ts_query) <= fits_ms:
+            return {"coarsenedIntervalFactor": factor,
+                    "coarsenedIntervalMs": max(
+                        sub.downsample_spec.interval_ms
+                        for sub in coarsen)}
+    span_ms = ts_query.end_time - ts_query.start_time
+    for keep in _TRUNCATE_KEEP:
+        new_start = int(ts_query.end_time - span_ms * keep)
+        ts_query.start_time = new_start
+        # the string form travels to fan-out peers (_raw_query) — keep
+        # it in lockstep with the parsed time
+        ts_query.start = str(new_start)
+        if estimate_plan_cost_ms(tsdb, ts_query) <= fits_ms:
+            note = {"truncatedStartMs": new_start,
+                    "truncatedKeepFraction": keep}
+            if coarsen:
+                note["coarsenedIntervalFactor"] = _COARSEN_FACTORS[-1]
+            return note
+    return None
+
+
+# --------------------------------------------------------------------- #
+# The gate                                                              #
+# --------------------------------------------------------------------- #
+
+class Permit:
+    """One admitted query's permit: releases on exit, exactly once."""
+
+    def __init__(self, gate: "AdmissionGate | None"):
+        self._gate = gate
+        self._t0 = time.monotonic()
+        self.degrade_note: dict | None = None
+
+    def __enter__(self) -> "Permit":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def release(self) -> None:
+        gate, self._gate = self._gate, None
+        if gate is not None:
+            gate._release((time.monotonic() - self._t0) * 1e3)
+
+
+class AdmissionGate:
+    """Concurrency permits + bounded per-priority FIFO wait queues.
+
+    One instance per TSDB (``gate_for``), shared by every responder
+    thread.  All mutable state is guarded by ``_lock``; waiters park on
+    a Condition sharing that lock and re-check on a short tick so
+    cancellation flips (which don't notify) are observed promptly.
+    """
+
+    def __init__(self, config):
+        self.enabled = config.get_bool("tsd.query.admission.enable")
+        self.permits = config.get_int("tsd.query.admission.permits")
+        self.queue_limit = config.get_int("tsd.query.admission.queue_limit")
+        self.max_wait_ms = config.get_int("tsd.query.admission.max_wait_ms")
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.in_flight = 0  # guarded-by: _lock
+        # one bounded FIFO of waiter tokens per priority class
+        # guarded-by: _lock
+        self._queues: dict[str, deque] = {c: deque() for c in CLASSES}
+        # EWMA of permit-hold time, the Retry-After basis
+        self._ewma_service_ms = 200.0  # guarded-by: _lock
+        self.admitted = 0  # guarded-by: _lock
+        self.shed = 0  # guarded-by: _lock
+
+    # -- accounting -----------------------------------------------------
+
+    def _gauge_depths_locked(self) -> None:
+        for cls, q in self._queues.items():
+            REGISTRY.gauge(
+                "tsd.query.admission.queue_depth",
+                "Admission wait-queue depth, by priority class").labels(
+                    priority=cls).set(len(q))
+
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def retry_after_s(self) -> int:
+        """Seconds until capacity plausibly frees: the backlog (queued
+        + in flight) worked off at the observed service rate."""
+        with self._lock:
+            backlog = self._depth_locked() + self.in_flight
+            ewma = self._ewma_service_ms
+        lanes = max(self.permits, 1)
+        return max(int(math.ceil(backlog * ewma / lanes / 1e3)), 1)
+
+    def queue_wait_estimate_ms(self) -> float:
+        """Expected wait before a permit frees for a NEW arrival."""
+        with self._lock:
+            if self.in_flight < self.permits and self._depth_locked() == 0:
+                return 0.0
+            backlog = self._depth_locked() + 1
+            ewma = self._ewma_service_ms
+        return backlog * ewma / max(self.permits, 1)
+
+    def _shed(self, reason: str, message: str) -> ShedError:
+        with self._lock:
+            self.shed += 1
+        REGISTRY.counter(
+            "tsd.query.admission.shed",
+            "Queries refused by the admission gate, by reason").labels(
+                reason=reason).inc()
+        return ShedError(message, retry_after_s=self.retry_after_s())
+
+    # -- acquire/release ------------------------------------------------
+
+    def acquire(self, deadline: Deadline | None, priority: str,
+                route: str = "api/query") -> Permit:
+        """Block until a permit is held, or raise: ShedError (queue
+        full / waited past max_wait), QueryException (deadline expired
+        or cancelled while queued — WITHOUT taking a permit)."""
+        faults.check("admission.acquire", route=route)
+        if not self.enabled:
+            return Permit(None)
+        if priority not in self._queues:
+            priority = CLASSES[0]
+        token = object()
+        t0 = time.monotonic()
+        with self._lock:
+            if self.in_flight < self.permits \
+                    and self._depth_locked() == 0:
+                self.in_flight += 1
+                self.admitted += 1
+                self._set_inflight_gauge_locked()
+                self._observe_wait(priority, 0.0)
+                return Permit(self)
+            if self._depth_locked() >= self.queue_limit:
+                # raise outside the lock (the counter path re-locks)
+                full = True
+            else:
+                full = False
+                self._queues[priority].append(token)
+                self._gauge_depths_locked()
+        if full:
+            raise self._shed(
+                "queue_full",
+                "Sorry, the query admission queue is full (%d waiting, "
+                "%d in flight). Please retry later." % (
+                    self.queue_limit, self.permits))
+        return self._wait_in_queue(deadline, priority, token, t0)
+
+    def _wait_in_queue(self, deadline: Deadline | None, priority: str,
+                       token: object, t0: float) -> Permit:
+        while True:
+            expired = raise_shed = False
+            with self._lock:
+                q = self._queues[priority]
+                if self._head_is_locked(priority, token) \
+                        and self.in_flight < self.permits:
+                    q.popleft()
+                    self.in_flight += 1
+                    self.admitted += 1
+                    self._gauge_depths_locked()
+                    self._set_inflight_gauge_locked()
+                    wait_ms = (time.monotonic() - t0) * 1e3
+                    self._observe_wait(priority, wait_ms)
+                    return Permit(self)
+                if deadline is not None and (deadline.is_cancelled()
+                                             or deadline.expired()):
+                    q.remove(token)
+                    self._gauge_depths_locked()
+                    self._cv.notify_all()
+                    expired = True
+                else:
+                    waited_ms = (time.monotonic() - t0) * 1e3
+                    if waited_ms >= self.max_wait_ms > 0:
+                        q.remove(token)
+                        self._gauge_depths_locked()
+                        self._cv.notify_all()
+                        raise_shed = True
+                    else:
+                        self._cv.wait(_WAIT_TICK_S)
+            if expired:
+                if deadline.is_cancelled():
+                    count_cancelled("queued")
+                # raises QueryCancelledException (503) or the timeout
+                # 413 — the query leaves WITHOUT having dispatched
+                deadline.check()
+                raise QueryException("Sorry, your query's deadline "
+                                     "expired while queued.")
+            if raise_shed:
+                raise self._shed(
+                    "max_wait",
+                    "Sorry, no query capacity freed within %d ms. "
+                    "Please retry later." % self.max_wait_ms)
+
+    def _head_is_locked(self, priority: str, token: object) -> bool:
+        """True when `token` is first in drain order: every
+        higher-priority queue empty and token at its queue's head."""
+        for cls in CLASSES:
+            q = self._queues[cls]
+            if cls == priority:
+                return bool(q) and q[0] is token
+            if q:
+                return False
+        return False
+
+    def _release(self, held_ms: float) -> None:
+        with self._lock:
+            self.in_flight -= 1
+            self._ewma_service_ms = (0.8 * self._ewma_service_ms
+                                     + 0.2 * held_ms)
+            self._set_inflight_gauge_locked()
+            self._cv.notify_all()
+
+    def _set_inflight_gauge_locked(self) -> None:
+        REGISTRY.gauge(
+            "tsd.query.admission.inflight",
+            "Queries currently holding an admission permit").set(
+                self.in_flight)
+
+    @staticmethod
+    def _observe_wait(priority: str, wait_ms: float) -> None:
+        REGISTRY.histogram(
+            "tsd.query.admission.wait_ms",
+            "Admission queue wait (ms), by priority class").labels(
+                priority=priority).observe(wait_ms)
+
+
+_GATE_LOCK = threading.Lock()
+
+
+def gate_for(tsdb) -> AdmissionGate:
+    gate = getattr(tsdb, "_admission_gate", None)
+    if gate is None:
+        with _GATE_LOCK:
+            gate = getattr(tsdb, "_admission_gate", None)
+            if gate is None:
+                gate = AdmissionGate(tsdb.config)
+                tsdb._admission_gate = gate
+    return gate
+
+
+# --------------------------------------------------------------------- #
+# The front door                                                        #
+# --------------------------------------------------------------------- #
+
+def admit(tsdb, ts_query, http_query=None,
+          route: str = "api/query") -> Permit:
+    """Admission decision for one parsed, validated query: predict,
+    (maybe) degrade, queue, admit — or raise ShedError (503 +
+    Retry-After) / the deadline's own exception.  Returns the held
+    Permit; ``permit.degrade_note`` is set when the ladder ran.
+
+    The decision is traced as an ``admission`` child span (wait ms,
+    decision, queue depth, predicted vs remaining ms).
+    """
+    gate = gate_for(tsdb)
+    deadline = active_deadline()
+    priority = ""
+    fanout = False
+    if http_query is not None:
+        priority = (http_query.request.header(PRIORITY_HEADER)
+                    or "").strip().lower()
+        # a peer's raw-extraction sub-request must NEVER degrade: the
+        # coordinator merges raw points verbatim and drops any
+        # annotation entry (no "metric" key), so a peer-side
+        # coarsen/truncate would arrive as an unmarked wrong answer.
+        # Shed instead — a 503'd peer lands in the coordinator's own
+        # partial_results machinery, which IS marked.
+        fanout = bool(http_query.request.header("x-tsdb-cluster"))
+    if priority not in CLASSES:
+        priority = CLASSES[0]
+    with obs_trace.stage("admission", route=route,
+                         priority=priority) as span:
+        if deadline is not None:
+            # an ALREADY-dead request (expired before admission, or
+            # disconnect flipped the token mid-parse) raises its own
+            # 413/503 here, not a misleading shed
+            deadline.check()
+        note = None
+        if gate.enabled and deadline is not None and deadline.bounded:
+            predicted_ms = estimate_plan_cost_ms(tsdb, ts_query)
+            queue_ms = gate.queue_wait_estimate_ms()
+            remaining_ms = deadline.remaining_ms()
+            obs_trace.annotate(span, predicted_ms=round(predicted_ms, 3),
+                               queue_wait_estimate_ms=round(queue_ms, 3),
+                               remaining_ms=round(remaining_ms, 3))
+            if predicted_ms + queue_ms > remaining_ms:
+                if _degrade_allowed(tsdb) and not fanout:
+                    note = try_degrade(tsdb, ts_query,
+                                       remaining_ms, queue_ms)
+                if note is None:
+                    obs_trace.annotate(span, decision="shed")
+                    raise gate._shed(
+                        "predicted_cost",
+                        "Sorry, this query's predicted cost (%d ms) "
+                        "cannot fit in its remaining deadline (%d ms "
+                        "after an estimated %d ms queue wait). Please "
+                        "decrease your time range or coarsen the "
+                        "downsample interval." % (
+                            predicted_ms, remaining_ms, queue_ms))
+                REGISTRY.counter(
+                    "tsd.query.admission.degraded",
+                    "Queries served degraded by the admission ladder, "
+                    "by reason").labels(reason="predicted_cost").inc()
+                obs_trace.annotate(span, degraded=note)
+        t0 = time.monotonic()
+        try:
+            permit = gate.acquire(deadline, priority, route=route)
+        except QueryException as e:
+            obs_trace.annotate(
+                span, decision="shed" if isinstance(e, ShedError)
+                else "cancelled",
+                wait_ms=round((time.monotonic() - t0) * 1e3, 3))
+            raise
+        permit.degrade_note = note
+        obs_trace.annotate(
+            span, decision="degraded" if note else "admitted",
+            wait_ms=round((time.monotonic() - t0) * 1e3, 3))
+        return permit
+
+
+def _degrade_allowed(tsdb) -> bool:
+    return tsdb.config.get_string(
+        "tsd.query.degrade").strip().lower() == "allow"

@@ -74,6 +74,7 @@ import numpy as np
 
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
 from opentsdb_tpu.obs import trace as obs_trace
+from opentsdb_tpu.query.limits import QueryException, active_deadline
 from opentsdb_tpu.utils import faults
 from opentsdb_tpu.utils.retry import RetryPolicy, call_with_retries
 
@@ -233,16 +234,20 @@ def _state(tsdb) -> ClusterState:
 
 def partial_annotation(exec_stats: dict) -> dict | None:
     """The degraded-serving annotation every query-shaped endpoint
-    attaches to a 200 that is missing peers (None when the fold was
-    complete).  One definition so the contract can't diverge per
-    endpoint."""
+    attaches to a 200 that is missing peers — or that the admission
+    ladder coarsened/truncated (tsd/admission.py) — None when the
+    answer is the full one.  One definition so the contract can't
+    diverge per endpoint."""
     if not exec_stats.get("partialResults"):
         return None
-    return {
+    out = {
         "partialResults": True,
-        "clusterPeersFailed": exec_stats["clusterPeersFailed"],
+        "clusterPeersFailed": exec_stats.get("clusterPeersFailed", 0),
         "clusterPeers": exec_stats.get("clusterPeers", 0),
     }
+    if exec_stats.get("degraded"):
+        out["degraded"] = exec_stats["degraded"]
+    return out
 
 
 def collect_stats(tsdb, collector) -> None:
@@ -314,7 +319,8 @@ def _sub_json(raw: TSQuery, index: int) -> dict:
 
 
 def _fetch_peer(peer: str, body: dict, timeout_s: float,
-                trace_id: str | None = None) -> list[dict]:
+                trace_id: str | None = None,
+                deadline=None) -> list[dict]:
     faults.check("cluster.peer_fetch", peer=peer)
     headers = {"Content-Type": "application/json",
                "X-TSDB-Cluster": "fanout"}
@@ -322,6 +328,19 @@ def _fetch_peer(peer: str, body: dict, timeout_s: float,
         # the receiving TSD adopts this id for ITS trace of the raw
         # fetch — one clustered query, one trace id across every host
         headers["X-TSDB-Trace-Id"] = trace_id
+    if deadline is not None:
+        # don't even connect when done for — an UNBOUNDED deadline is
+        # still a cancellation token (client disconnect, server drain),
+        # and each retry attempt re-enters here
+        deadline.check()
+        if deadline.bounded:
+            # forward the coordinator's REMAINDER so the peer aborts
+            # its own planning/dispatch once we've given up (it mints
+            # its Deadline from this header —
+            # rpc_manager._mint_deadline)
+            remaining = deadline.remaining_ms()
+            headers["X-TSDB-Deadline-Ms"] = str(max(int(remaining), 1))
+            timeout_s = min(timeout_s, max(remaining / 1e3, 0.05))
     req = urllib.request.Request(
         "http://%s/api/query" % peer,
         data=json.dumps(body).encode(),
@@ -333,9 +352,14 @@ def _fetch_peer(peer: str, body: dict, timeout_s: float,
     return json.loads(data.decode())
 
 
-def _retry_policy(config) -> RetryPolicy:
+def _retry_policy(config, deadline=None) -> RetryPolicy:
     budget_s = max(config.get_int("tsd.network.cluster.timeout_ms"),
                    1000) / 1e3
+    if deadline is not None and deadline.bounded:
+        # the whole retry stack (attempts + backoff sleeps) is clamped
+        # to the request's remainder: a peer fetch must never outlive
+        # the deadline the coordinator is serving under
+        budget_s = max(min(budget_s, deadline.remaining_ms() / 1e3), 0.05)
     attempt_ms = config.get_int(
         "tsd.network.cluster.retry.attempt_timeout_ms")
     return RetryPolicy(
@@ -353,9 +377,11 @@ class PeerRejectedError(RuntimeError):
 
 def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
                    body: dict, span=None,
-                   trace_id: str | None = None) -> list[dict]:
+                   trace_id: str | None = None,
+                   deadline=None) -> list[dict]:
     """One peer fetch under the full fault-tolerance stack: breaker
-    fast-fail, then retries with backoff inside the overall budget.
+    fast-fail, then retries with backoff inside the overall budget
+    (already clamped to the request deadline's remainder).
 
     `span` (an obs.trace.Span created by the submitting thread) records
     the fetch's fate: retry count, final breaker state, and the error
@@ -363,7 +389,7 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
     carries so an operator can see WHY a 200 is partial."""
     try:
         return _guarded_fetch_inner(state, policy, peer, body, span,
-                                    trace_id)
+                                    trace_id, deadline)
     finally:
         if span is not None:
             span.tags["breaker"] = state.breaker(peer).state
@@ -372,7 +398,8 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
 
 def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
                          peer: str, body: dict, span,
-                         trace_id: str | None) -> list[dict]:
+                         trace_id: str | None,
+                         deadline=None) -> list[dict]:
     breaker = state.breaker(peer)
     if span is not None:
         span.tags.setdefault("retries", 0)
@@ -405,7 +432,7 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
 
     def fetch(timeout_s: float) -> list[dict]:
         try:
-            return _fetch_peer(peer, body, timeout_s, trace_id)
+            return _fetch_peer(peer, body, timeout_s, trace_id, deadline)
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500:
                 raise PeerRejectedError(
@@ -423,8 +450,19 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
     try:
         result = call_with_retries(
             fetch, policy,
-            no_retry_on=(PeerRejectedError,),
+            no_retry_on=(PeerRejectedError, QueryException),
             on_retry=on_retry)
+    except QueryException as e:
+        # the COORDINATOR gave up (request deadline expired / cancelled
+        # mid-fetch) — the peer did not fail, so its breaker is not
+        # charged.  Except as the half-open probe: a probe with no
+        # verdict must settle (re-open) or _probing wedges and every
+        # sibling busy-waits on a verdict that never comes.
+        if breaker.state == CircuitBreaker.HALF_OPEN:
+            breaker.record_failure()
+        state.count("fetch_failures")
+        obs_trace.annotate(span, error=str(e))
+        raise
     except PeerRejectedError as e:
         # responsive peer: availability-wise a SUCCESS — crucially this
         # settles a half-open probe (otherwise _probing would stay set
@@ -499,7 +537,10 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
 
     peers = cluster_peers(tsdb.config)
     state = _state(tsdb)
-    policy = _retry_policy(tsdb.config)
+    # the ambient deadline is read HERE, on the handler thread that
+    # owns it — the pool threads below only carry the object
+    deadline = active_deadline()
+    policy = _retry_policy(tsdb.config, deadline)
     allow_partial = (tsdb.config.get_string(
         "tsd.network.cluster.partial_results").strip().lower() == "allow")
     raw = _raw_query(ts_query)
@@ -537,7 +578,7 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
                     if parent is not None else None)
             futures[pool.submit(_guarded_fetch, state, policy, peer,
                                 _sub_json(raw, i), span,
-                                trace_id)] = (peer, i, span)
+                                trace_id, deadline)] = (peer, i, span)
 
     failed_peers: set[str] = set()
     # local extraction: straight off this host's store/planner (objects,

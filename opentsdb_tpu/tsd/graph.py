@@ -47,10 +47,21 @@ class GraphHandler:
         # so a clustered operator's graphs must span the cluster too.
         # Cache consistency holds: clustered-vs-local depends only on
         # static config, so one cache key always maps to one mode.
+        # Same admission gate too: /q dispatches the same device work,
+        # so it takes a permit (and may be shed or degraded) exactly
+        # like /api/query.
+        from opentsdb_tpu.tsd import admission
         from opentsdb_tpu.tsd.cluster import partial_annotation, serve_query
+        from opentsdb_tpu.utils import faults
         exec_stats: dict = {}
-        results = serve_query(tsdb, ts_query, query,
-                              exec_stats=exec_stats)
+        permit = admission.admit(tsdb, ts_query, query, route="q")
+        with permit:
+            faults.check("rpc.slow_handler", route="q")
+            results = serve_query(tsdb, ts_query, query,
+                                  exec_stats=exec_stats)
+        if permit.degrade_note:
+            exec_stats["partialResults"] = True
+            exec_stats["degraded"] = permit.degrade_note
         partial = partial_annotation(exec_stats)
         if mode == "ascii":
             body = self._ascii(results)

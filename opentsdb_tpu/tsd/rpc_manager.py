@@ -9,13 +9,16 @@ and RpcHandler.java dispatch.
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import REGISTRY
+from opentsdb_tpu.query import limits
 from opentsdb_tpu.stats.query_stats import QueryStatsRegistry
 from opentsdb_tpu.tsd import admin_rpcs, rpcs
+from opentsdb_tpu.tsd.admission import DEADLINE_HEADER
 from opentsdb_tpu.tsd.http import (BadRequestError, HttpQuery, HttpRequest,
                                    error_status)
 from opentsdb_tpu.tsd.serializers import serializer_for
@@ -202,7 +205,16 @@ class RpcManager:
         When tsd.trace.enable is on every request gets a span tree
         rooted here; an X-TSDB-Trace-Id header (a peer's fan-out, or
         an operator correlating across TSDs) is adopted as the trace
-        id, so one clustered query is one id across every host."""
+        id, so one clustered query is one id across every host.
+
+        One request-scoped Deadline is minted here — from
+        tsd.query.timeout and/or the client's X-TSDB-Deadline-Ms
+        header (whichever is smaller; a coordinating TSD forwards its
+        remainder so a peer aborts when the coordinator has already
+        given up) — activated as the responder thread's ambient
+        deadline (query/limits.py) for every QueryBudget, retry policy,
+        and admission wait downstream, and bound to the server's
+        cancellation handle so a client disconnect flips its token."""
         cfg = self.tsdb.config
         trace = None
         if cfg.get_bool("tsd.trace.enable"):
@@ -212,10 +224,16 @@ class RpcManager:
             trace.root.tags["method"] = request.method
             trace.root.tags["path"] = request.path
             obs_trace.activate(trace)
+        deadline = self._mint_deadline(request)
+        limits.activate_deadline(deadline)
+        handle = getattr(request, "cancel_handle", None)
+        if handle is not None:
+            handle.bind(deadline)
         start = time.perf_counter()
         try:
             query = self._dispatch_http(request, remote)
         finally:
+            limits.deactivate_deadline()
             if trace is not None:
                 obs_trace.deactivate()
                 trace.finish()
@@ -232,6 +250,26 @@ class RpcManager:
             "tsd.http.latency_ms", "HTTP request latency (ms)").labels(
                 route=route).observe((time.perf_counter() - start) * 1e3)
         return query
+
+    def _mint_deadline(self, request: HttpRequest) -> "limits.Deadline":
+        """min(tsd.query.timeout, X-TSDB-Deadline-Ms); 0/absent on both
+        sides mints an unbounded deadline — still the cancellation
+        token every check site observes."""
+        timeout_ms = float(self.tsdb.config.get_int("tsd.query.timeout"))
+        raw = request.header(DEADLINE_HEADER)
+        if raw:
+            try:
+                client_ms = float(raw)
+            except ValueError:
+                client_ms = 0.0
+            if not math.isfinite(client_ms):
+                # "inf"/"1e309" parse to float inf — a bounded deadline
+                # must stay finite (int(remaining) travels to peers)
+                client_ms = 0.0
+            if client_ms > 0:
+                timeout_ms = (min(timeout_ms, client_ms)
+                              if timeout_ms > 0 else client_ms)
+        return limits.Deadline(max(timeout_ms, 0.0))
 
     def _dispatch_http(self, request: HttpRequest,
                        remote: str = "unknown") -> "HttpQuery":
@@ -292,9 +330,12 @@ class RpcManager:
         except Exception as e:  # uniform error envelope
             status = error_status(e)
             self._count_error(status)
-            if status >= 500:
-                # expected client mistakes (4xx) stay quiet; an
-                # internal failure gets the full trace in the daemon log
+            if status >= 500 and not isinstance(e, limits.QueryException):
+                # expected client mistakes (4xx) stay quiet, and so do
+                # deliberate 5xx query verdicts (admission sheds,
+                # cancellations — they carry their own status and are
+                # counted on their own metrics); an internal failure
+                # gets the full trace in the daemon log
                 LOG.exception("handler for [%s] from %s failed with an "
                               "internal error", request.path, remote)
             query.send_error(e)

@@ -20,9 +20,11 @@ from opentsdb_tpu.models.tsquery import (
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.storage.memstore import Annotation
+from opentsdb_tpu.tsd import admission
 from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
 from opentsdb_tpu.uid import NoSuchUniqueName
 from opentsdb_tpu.stats.query_stats import QueryStats, DuplicateQueryException
+from opentsdb_tpu.utils import faults
 
 LOG = logging.getLogger("tsd.rpcs")
 
@@ -449,6 +451,23 @@ class QueryRpc(HttpRpc):
                     details="Set tsd.http.query.allow_delete=true")
             ts_query.delete = True
         ts_query.validate()
+        # Admission: concurrency permit + costmodel shedding/degrading
+        # BEFORE any stats registration or device work.  May raise
+        # ShedError (503 + Retry-After) or the deadline's own error;
+        # may mutate ts_query down the degradation ladder
+        # (permit.degrade_note annotates the 200 below).
+        permit = admission.admit(tsdb, ts_query, query, route="api/query")
+        with permit:
+            # injectable stall INSIDE the permit: tools/chaos_soak.py
+            # --overload wedges the gate with it to prove the queue
+            # bounds + sheds instead of stalling
+            faults.check("rpc.slow_handler", route="api/query")
+            self._serve_admitted(tsdb, query, ts_query, permit)
+
+    def _serve_admitted(self, tsdb, query: HttpQuery, ts_query: TSQuery,
+                        permit) -> None:
+        """The admitted half of handle_query: stats registration,
+        cluster-aware execution, serialization, response."""
         qs = QueryStats(query.remote, ts_query_json(ts_query),
                         query.request.headers)
         trace = obs_trace.active()
@@ -476,6 +495,13 @@ class QueryRpc(HttpRpc):
                                   exec_stats=exec_stats)
             if ts_query.delete:
                 deleted = self._delete(tsdb, ts_query)
+            if permit.degrade_note:
+                # the ladder coarsened/truncated this query at
+                # admission: the 200 must say so out loud, through the
+                # same partialResults trailer degraded cluster serving
+                # uses (tsd/cluster.py partial_annotation)
+                exec_stats["partialResults"] = True
+                exec_stats["degraded"] = permit.degrade_note
             if qs is not None:
                 qs.mark("aggregationTime")
                 qs.stats.update(exec_stats)

@@ -28,11 +28,10 @@ LOG = logging.getLogger("tsd.server")
 
 MAX_REQUEST_BYTES = 64 * 1024 * 1024   # HttpRequestDecoder aggregator cap
 MAX_TELNET_LINE = 1024 * 1024
-# graceful-shutdown budget for in-flight responder work: generous
-# enough for the longest legitimate request (a full cluster retry
-# budget is 15s), bounded so one wedged handler can't hold the daemon
-# past its supervisor's patience
-DRAIN_GRACE_S = 30.0
+# After the graceful drain window (tsd.network.drain_timeout_ms)
+# expires, force-cancelled handlers get this long to observe their
+# cancellation token and unwind before TSDB teardown proceeds anyway.
+POST_CANCEL_GRACE_S = 5.0
 
 # Telnet put batching peeks at asyncio.StreamReader's buffered bytes to
 # decide whether another complete line can be consumed WITHOUT awaiting
@@ -96,6 +95,17 @@ class TSDServer:
         self.idle_timeout = tsdb.config.get_int(
             "tsd.network.keep_alive_timeout") if tsdb.config.has_property(
             "tsd.network.keep_alive_timeout") else 300
+        # graceful-shutdown budget for in-flight responder work:
+        # generous enough for the longest legitimate request, bounded
+        # so one wedged handler can't hold the daemon past its
+        # supervisor's patience — at expiry every in-flight request's
+        # cancellation token is force-flipped (stop() below)
+        self.drain_grace_s = max(
+            tsdb.config.get_int("tsd.network.drain_timeout_ms"), 0) / 1e3
+        # cancellation handles of in-flight HTTP requests.  Touched
+        # only on the event-loop thread, like _inflight_rpcs; stop()
+        # (also on the loop) force-cancels them at drain expiry.
+        self._active_handles: set = set()
         self._executor = ThreadPoolExecutor(
             max_workers=worker_threads, thread_name_prefix="tsd-responder")
         self._server: asyncio.AbstractServer | None = None
@@ -166,11 +176,30 @@ class TSDServer:
                                         cancel_futures=True))
             try:
                 await asyncio.wait_for(asyncio.shield(drain),
-                                       timeout=DRAIN_GRACE_S)
+                                       timeout=self.drain_grace_s)
             except asyncio.TimeoutError:
-                LOG.warning("responder drain exceeded %ss; proceeding with "
-                            "TSDB teardown (a handler is wedged)",
-                            DRAIN_GRACE_S)
+                # the drain is OUT of patience: force-flip every
+                # in-flight request's cancellation token so cooperative
+                # handlers (budget.check_deadline sites, admission
+                # waits) unwind now, then give them a short bounded
+                # window before tearing the TSDB down regardless
+                from opentsdb_tpu.tsd import admission
+                handles = list(self._active_handles)
+                LOG.warning(
+                    "responder drain exceeded %.1fs; force-cancelling "
+                    "%d in-flight request(s)", self.drain_grace_s,
+                    len(handles))
+                for handle in handles:
+                    if handle.cancel("server drain timeout"):
+                        admission.count_cancelled("drain_timeout")
+                try:
+                    await asyncio.wait_for(asyncio.shield(drain),
+                                           timeout=POST_CANCEL_GRACE_S)
+                except asyncio.TimeoutError:
+                    LOG.warning(
+                        "responder drain still wedged after force-"
+                        "cancel; proceeding with TSDB teardown (a "
+                        "handler ignores its cancellation token)")
             # The drain guarantees the WORK finished; the handler
             # coroutines still need loop time to write their replies.
             # Yield until the last dispatched reply hits its socket
@@ -403,10 +432,46 @@ class TSDServer:
 
             self.http_rpcs += 1
             self._inflight_rpcs += 1
+            from opentsdb_tpu.tsd import admission
+            # cancellation lever: created HERE (the loop owns disconnect
+            # detection), bound to the request's Deadline by
+            # rpc_manager.handle_http on the responder thread
+            handle = admission.CancellationHandle()
+            request.cancel_handle = handle
+            self._active_handles.add(handle)
+            watcher = None
             try:
-                query = await loop.run_in_executor(
+                fut = loop.run_in_executor(
                     self._executor, self.rpc_manager.handle_http, request,
                     remote)
+                if not buffer:
+                    # disconnect watcher: while the handler runs, a read
+                    # on the (otherwise idle) connection detects the
+                    # client going away — EOF flips the cancellation
+                    # token so the query releases its permit without
+                    # dispatching.  Skipped when pipelined bytes are
+                    # already buffered (the client is clearly alive and
+                    # the read would race the next request).
+                    watcher = asyncio.ensure_future(reader.read(65536))
+                    done, _ = await asyncio.wait(
+                        {fut, watcher},
+                        return_when=asyncio.FIRST_COMPLETED)
+                    if watcher.done():
+                        try:
+                            chunk = watcher.result()
+                        except (ConnectionError, OSError):
+                            chunk = b""
+                        watcher = None
+                        if not chunk:
+                            if not fut.done() and handle.cancel(
+                                    "client disconnected"):
+                                admission.count_cancelled(
+                                    "client_disconnect")
+                        else:
+                            # the next pipelined request arrived while
+                            # this one executed: keep its bytes
+                            buffer = chunk
+                query = await fut
                 keep_alive = (request.version != "HTTP/1.0"
                               and (request.header("connection")
                                    or "").lower() != "close")
@@ -414,6 +479,9 @@ class TSDServer:
                 writer.write(response.to_bytes(keep_alive))
                 await writer.drain()
             finally:
+                if watcher is not None:
+                    buffer = await self._drain_watcher(watcher, buffer)
+                self._active_handles.discard(handle)
                 self._inflight_rpcs -= 1
             if not keep_alive:
                 return
@@ -426,6 +494,25 @@ class TSDServer:
                 if not chunk:
                     return
                 buffer = chunk
+
+    @staticmethod
+    async def _drain_watcher(watcher, buffer: bytes) -> bytes:
+        """Retire a still-pending disconnect watcher without losing
+        bytes: a read that completed in the race window between the
+        handler finishing and this cancel holds the next pipelined
+        request — prepend-order is preserved because the watcher only
+        ever starts when `buffer` was empty."""
+        if not watcher.done():
+            watcher.cancel()
+        try:
+            chunk = await watcher
+        except asyncio.CancelledError:
+            return buffer
+        except (ConnectionError, OSError):
+            # the connection died under the watcher; the main loop's
+            # own next read/write surfaces it
+            return buffer
+        return buffer + chunk if chunk else buffer
 
     # -- stats (ConnectionManager.collectStats :89) --
 

@@ -59,6 +59,12 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.network.keep_alive_timeout": _e(
         "int", "300",
         "Idle seconds before an open connection is dropped."),
+    "tsd.network.drain_timeout_ms": _e(
+        "int", "30000",
+        "Graceful-shutdown budget for in-flight responder work; at "
+        "expiry every in-flight request's cancellation token is "
+        "force-flipped so cooperative handlers unwind, then teardown "
+        "proceeds regardless after a short grace."),
     "tsd.network.worker_threads": _e(
         "int", "", "Responder thread count (reference compat; the "
         "daemon takes --worker-threads).", compat=True),
@@ -351,7 +357,37 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "bool", False, "Reference compat multigets salt stance.",
         compat=True),
     "tsd.query.timeout": _e(
-        "int", "0", "Per-query wall-clock timeout in ms (0 = none)."),
+        "int", "0", "Per-query wall-clock timeout in ms (0 = none).  "
+        "Minted ONCE per request (min with the client's "
+        "X-TSDB-Deadline-Ms header) and threaded end-to-end: planner "
+        "sub-queries, cluster retries, and fan-out peers all run "
+        "under the one remainder."),
+    # -- admission control (tsd/admission.py, docs/admission.md) ------- #
+    "tsd.query.admission.enable": _e(
+        "bool", True,
+        "Gate device-dispatching queries (/api/query, /q) behind "
+        "bounded concurrency permits + priority wait queues; excess "
+        "load sheds 503 + Retry-After instead of stalling the "
+        "responder pool."),
+    "tsd.query.admission.permits": _e(
+        "int", "8",
+        "Queries allowed to dispatch device work concurrently; "
+        "arrivals beyond this wait in the admission queue."),
+    "tsd.query.admission.queue_limit": _e(
+        "int", "64",
+        "Bound on TOTAL queued queries across priority classes; a "
+        "full queue sheds new arrivals with 503 + Retry-After."),
+    "tsd.query.admission.max_wait_ms": _e(
+        "int", "5000",
+        "Longest a query may wait for a permit before being shed "
+        "(0 = wait bounded only by the request deadline)."),
+    "tsd.query.degrade": _e(
+        "str", "error",
+        "Stance when a query's predicted cost cannot fit its "
+        "remaining deadline: 'error' sheds with 503; 'allow' runs the "
+        "degradation ladder first (coarsen the downsample interval, "
+        "then truncate the range toward the present) and answers 200 "
+        "with the partialResults annotation."),
     # -- rpc / rollups / plugins --------------------------------------- #
     "tsd.rpc.plugins": _e(
         "str", "", "Reference compat RPC plugin list.", compat=True),

@@ -14,6 +14,12 @@ Sites currently instrumented:
   cluster.peer_body     the decoded peer response body, pre-parse
   wal.append            before a WAL journal write (storage/persist.py)
   wal.fsync             before a WAL fsync
+  admission.acquire     before the admission gate's accounting
+                        (tsd/admission.py) — ``route`` in the context
+  rpc.slow_handler      inside a held admission permit, before query
+                        execution (tsd/rpcs.py, tsd/graph.py) — a
+                        latency fault here wedges the admission queue
+                        deliberately (chaos_soak --overload)
 
 Fault kinds:
 
@@ -61,6 +67,13 @@ KNOWN_SITES: dict[str, frozenset] = {
     "cluster.peer_body": frozenset({"peer"}),
     "wal.append": frozenset(),
     "wal.fsync": frozenset(),
+    # admission-control hazard sites (tsd/admission.py, tsd/rpcs.py):
+    # `admission.acquire` fires before the gate's accounting (a
+    # latency fault delays every arrival; refuse sheds at the door);
+    # `rpc.slow_handler` fires INSIDE a held permit (a latency fault
+    # wedges the queue deliberately — the chaos_soak --overload lever)
+    "admission.acquire": frozenset({"route"}),
+    "rpc.slow_handler": frozenset({"route"}),
 }
 # Body-corruption kinds only make sense at mangle() sites.
 BODY_SITES = frozenset({"cluster.peer_body"})

@@ -51,6 +51,13 @@ from opentsdb_tpu.ops import downsample as _ds  # noqa: E402
 _ds.set_platform_mode_guard(False)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); the "
+        "standing CI soak runs these")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -27,6 +27,10 @@ DISCONNECT = "disconnect"   # 200 headers, half the body, RST
 GARBAGE = "garbage"         # 200 + bytes that are not JSON
 ERROR_500 = "error500"      # well-formed 500 (transient: retried)
 ERROR_400 = "error400"      # well-formed 400 (deterministic: not retried)
+SLOW_BODY = "slow_body"     # 200 headers then the body dribbled slowly —
+                            # a "healthy" peer that cannot finish inside
+                            # the caller's deadline (deadline-propagation
+                            # tests time the abort against the remainder)
 
 
 def refused_port() -> int:
@@ -54,6 +58,12 @@ class FaultyPeer:
         self.mode = OK
         self.script: list[str] = []
         self.requests = 0
+        # lower-cased header dict of every request that arrived, in
+        # order (the deadline-propagation tests assert the coordinator
+        # forwarded X-TSDB-Deadline-Ms with its remainder)
+        self.seen_headers: list[dict] = []
+        # seconds per 1-byte body chunk in SLOW_BODY mode
+        self.slow_body_step_s = 0.2
         self._lock = threading.Lock()
         self._hung: list[socket.socket] = []
         self._closing = False
@@ -105,9 +115,16 @@ class FaultyPeer:
             data += chunk
         head, _, rest = data.partition(b"\r\n\r\n")
         length = 0
+        headers: dict = {}
         for line in head.split(b"\r\n"):
+            if b":" in line:
+                k, v = line.split(b":", 1)
+                headers[k.strip().lower().decode("latin-1")] = \
+                    v.strip().decode("latin-1")
             if line.lower().startswith(b"content-length:"):
                 length = int(line.split(b":", 1)[1])
+        with self._lock:
+            self.seen_headers.append(headers)
         while len(rest) < length:
             chunk = conn.recv(65536)
             if not chunk:
@@ -140,6 +157,19 @@ class FaultyPeer:
                              b"Content-Type: application/json\r\n"
                              b"Content-Length: %d\r\n\r\n%s"
                              % (len(body), body))
+            elif mode == SLOW_BODY:
+                import time
+                body = json.dumps(self.payload).encode()
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"Content-Type: application/json\r\n"
+                             b"Content-Length: %d\r\n\r\n" % len(body))
+                # dribble one byte per step: the response never
+                # finishes inside a tight deadline, but the socket
+                # stays live — only the CLIENT's clamped timeout (the
+                # forwarded remainder) can end this fetch
+                for i in range(len(body)):
+                    conn.sendall(body[i:i + 1])
+                    time.sleep(self.slow_body_step_s)
             elif mode == DISCONNECT:
                 body = json.dumps(self.payload).encode()
                 # advertise the full length, ship half, cut the line

@@ -5,13 +5,35 @@ between them: randomized latency/reset/mid-body-disconnect/garbage
 faults across the query loop, asserting the two mode contracts — no
 500s under partial_results=allow, no wrong answers under the default
 "error" — and that the cluster heals to full answers once faults stop.
+
+Also wraps the admission-gate overload stage (`--overload
+--stages-only`, slow-marked: a real TSD under saturating load keeps
+tier-1 out of its wall budget; the standing CI soak runs it).
 """
 
 import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_overload_contract_holds():
+    """ISSUE 8 acceptance: under saturating load + a slow-handler
+    fault, only 200s (full or degraded+partialResults) or
+    503+Retry-After, in-flight bounded by the permit count, and the
+    daemon heals once the fault lifts."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14267", "--rounds", "4", "--overload",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "[overload]" in proc.stdout
+    assert "healed (shed rate 0)" in proc.stdout
 
 
 def test_cluster_contracts_hold_under_chaos():

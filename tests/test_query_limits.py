@@ -263,6 +263,81 @@ class TestMaybeReload:
         assert lim.overrides is before   # same mtime: same objects
 
 
+def _reload_errors_total() -> float:
+    from opentsdb_tpu.obs.registry import REGISTRY
+    for fam in REGISTRY.families():
+        if fam.name == "tsd.query.limits.reload_errors":
+            return sum(cell.get() for _, cell in fam.children())
+    return 0.0
+
+
+class TestOverrideLoadErrors:
+    """ISSUE 8 satellites: a corrupt/unreadable overrides file must
+    neither crash TSDB construction nor fail silently on hot reload —
+    it is counted (tsd.query.limits.reload_errors) and logged once per
+    distinct error."""
+
+    def test_corrupt_file_does_not_crash_construction(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text("{not json")
+        before = _reload_errors_total()
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.bytes.default": "777"}))
+        # constructed, serving the DEFAULTS, and the failure counted
+        assert lim.get_byte_limit("any.metric") == 777
+        assert lim.overrides == []
+        assert lim.reload_errors == 1
+        assert _reload_errors_total() == before + 1
+
+    def test_unreadable_file_does_not_crash_construction(self, tmp_path):
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(tmp_path)}))  # a dir
+        assert lim.overrides == []
+        assert lim.reload_errors == 1
+
+    def test_bad_entry_shape_does_not_crash_construction(self, tmp_path):
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps(["not-a-mapping"]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path)}))
+        assert lim.overrides == []
+        assert lim.reload_errors == 1
+
+    def test_reload_error_counted_and_logged_once(self, tmp_path, caplog):
+        import logging
+        import os
+        path = tmp_path / "limits.json"
+        path.write_text(json.dumps([{"regex": "a", "dataPointsLimit": 3}]))
+        lim = QueryLimitOverride(_config(**{
+            "tsd.query.limits.overrides.config": str(path),
+            "tsd.query.limits.overrides.interval": "1"}))
+        before = _reload_errors_total()
+        path.write_text("{not json")
+        with caplog.at_level(logging.ERROR, "opentsdb_tpu.query.limits"):
+            for bump in (5, 10):     # same bad bytes, new mtime, twice
+                os.utime(path, (time.time() + bump, time.time() + bump))
+                lim._next_check = 0
+                lim.maybe_reload()
+        assert lim.get_data_points_limit("abc") == 3   # last-good kept
+        assert lim.reload_errors == 2
+        assert _reload_errors_total() == before + 2
+        # one log line per DISTINCT error, not per failure
+        records = [r for r in caplog.records
+                   if "overrides" in r.getMessage()]
+        assert len(records) == 1
+        # a DIFFERENT corruption logs again
+        path.write_text(json.dumps([{"byteLimit": 5}]))  # missing regex
+        os.utime(path, (time.time() + 15, time.time() + 15))
+        with caplog.at_level(logging.ERROR, "opentsdb_tpu.query.limits"):
+            lim._next_check = 0
+            lim.maybe_reload()
+        records = [r for r in caplog.records
+                   if "overrides" in r.getMessage()]
+        assert len(records) == 2
+        assert lim.reload_errors == 3
+
+
 class TestBudgetBeforeWindowPlan:
     """Regression for this PR's taint fix: the window plan (its [W+1]
     edge vector is sized by the query's range/interval) materializes

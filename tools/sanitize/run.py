@@ -42,6 +42,7 @@ if _REPO not in sys.path:
 SUBSET_TIER1 = [
     "tests/test_concurrency.py",
     "tests/test_cluster_serving.py",
+    "tests/test_admission.py",
     "tests/test_tsd_server.py",
     "tests/test_parallel.py",
     "tests/test_native_engine.py",
