@@ -121,6 +121,11 @@ class MaintenanceThread(threading.Thread):
         cache = self.tsdb.device_cache
         if cache is not None:
             self.device_cache_refreshes += cache.refresh(self.tsdb.store)
+        agg = self.tsdb.agg_cache
+        if agg is not None:
+            # hot aggregate blocks earn their device/HBM mirrors here,
+            # off the query path (storage/agg_cache.py promote_pending)
+            agg.promote_pending()
 
     def _maybe_self_report(self, now: float) -> None:
         """tsd.stats.interval cadence of the self-report loop

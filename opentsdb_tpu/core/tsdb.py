@@ -84,6 +84,21 @@ class TSDB:
                     "tsd.query.device_cache.batch_mb") * 2**20)
             if self.config.get_bool("tsd.query.device_cache.enable")
             else None)
+        # partial-aggregate block cache (ROADMAP item 2): overlapping
+        # sliding-window queries reuse per-(series, window) downsample
+        # factors; the memstore write path marks the affected
+        # (metric, sub-window) keys dirty as each write lands
+        # (write-then-mark — see storage/memstore.py)
+        from opentsdb_tpu.storage.agg_cache import AggregateCache
+        self.agg_cache = (AggregateCache(self.config)
+                          if self.config.get_bool("tsd.query.cache.enable")
+                          else None)
+        if self.agg_cache is not None:
+            cache = self.agg_cache
+            store = self.store
+            self.store.add_mutation_listener(
+                lambda metric, lo, hi: cache.note_mutation(
+                    metric, lo, hi, store=store))
         from opentsdb_tpu.rollup import RollupConfig, RollupStore
         self.rollup_config = RollupConfig.from_config(self.config)
         self.rollup_store = (
@@ -910,6 +925,8 @@ class TSDB:
             out.update(self.maintenance.collect_stats())
         if self.device_cache is not None:
             out.update(self.device_cache.collect_stats())
+        if self.agg_cache is not None:
+            out.update(self.agg_cache.collect_stats())
         return out
 
     @staticmethod

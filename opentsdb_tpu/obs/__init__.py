@@ -186,6 +186,49 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "gauge", (), "Relative residual of the last fit."),
     "tsd.costmodel.autotune.exploring": _m(
         "gauge", (), "1 while a losing mode is being explored."),
+    # -- query caches: shared tier-labeled families (tier values:      #
+    #    device_series = storage/device_cache.py HBM columns,          #
+    #    agg_host / agg_device = storage/agg_cache.py partial-         #
+    #    aggregate blocks, agg = tier-less agg-cache events) ---------- #
+    "tsd.query.cache.hits": _m(
+        "counter", ("tier",),
+        "Query-cache hits, by tier."),
+    "tsd.query.cache.misses": _m(
+        "counter", ("tier",),
+        "Query-cache misses, by tier."),
+    "tsd.query.cache.evictions": _m(
+        "counter", ("tier",),
+        "Query-cache evictions, by tier."),
+    "tsd.query.cache.invalidations": _m(
+        "counter", ("tier",),
+        "Query-cache invalidation marks (ingest dirty ranges, "
+        "dropcaches), by tier."),
+    "tsd.query.cache.bytes": _m(
+        "gauge", ("tier",),
+        "Query-cache resident bytes, by tier."),
+    "tsd.query.cache.entries": _m(
+        "gauge", ("tier",),
+        "Query-cache resident entries, by tier."),
+    # -- partial-aggregate cache stats walk (storage/agg_cache.py       #
+    #    collect_stats -> /api/stats + prometheus gauges) -------------- #
+    "tsd.query.agg_cache.hits": _m(
+        "gauge", (), "Aggregate-block cache hits (blocks served)."),
+    "tsd.query.agg_cache.misses": _m(
+        "gauge", (), "Aggregate-block cache misses (blocks computed)."),
+    "tsd.query.agg_cache.evictions": _m(
+        "gauge", (), "Aggregate-block cache evictions (both tiers)."),
+    "tsd.query.agg_cache.invalidations": _m(
+        "gauge", (), "Aggregate-block dirty marks recorded."),
+    "tsd.query.agg_cache.rewrites": _m(
+        "gauge", (), "Plans served via the partial-aggregate rewrite."),
+    "tsd.query.agg_cache.populated": _m(
+        "gauge", (), "Aggregate blocks materialized into the cache."),
+    "tsd.query.agg_cache.entries": _m(
+        "gauge", (), "Aggregate blocks resident (host tier)."),
+    "tsd.query.agg_cache.bytes": _m(
+        "gauge", (), "Aggregate-block host-tier resident bytes."),
+    "tsd.query.agg_cache.device_bytes": _m(
+        "gauge", (), "Aggregate-block device-tier resident bytes."),
     # -- device cache (storage/device_cache.py collect_stats, mirrored  #
     #    by obs/jaxprof.py update_device_gauges) ----------------------- #
     "tsd.query.device_cache.hits": _m(

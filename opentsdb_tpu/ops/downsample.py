@@ -323,6 +323,7 @@ def _clear_dependent_caches() -> None:
     from opentsdb_tpu.ops import pipeline, streaming
     for fn in (pipeline._jitted, pipeline._jitted_rollup_avg,
                pipeline._jitted_group, pipeline._jitted_grid_tail,
+               pipeline._jitted_downsample_grid,
                pipeline._jitted_group_rollup_avg,
                pipeline._jitted_union_batch, streaming._jitted_update,
                streaming._jitted_update_sliced, streaming._jitted_finish):

@@ -178,13 +178,29 @@ def _grid_tail(spec: PipelineSpec, num_groups: int, wts, v, m, gid):
                                 rows_sorted=spec.rows_sorted)
 
 
+def _downsample_grid(step: DownsampleStep, ts, val, mask, wargs):
+    """Downsample only — the block evaluator of the partial-aggregate
+    cache (storage/agg_cache.py): per-(series, window) grids computed
+    block-by-block, with rate/group/aggregate running later on the
+    assembled grid via _grid_tail (they cross block boundaries)."""
+    return downsample(ts, val, mask, step.function, step.window_spec,
+                      wargs, step.fill_policy, step.fill_value)
+
+
 _jitted_group = jax.jit(_group_pipeline, static_argnums=(0, 1))
 _jitted_grid_tail = jax.jit(_grid_tail, static_argnums=(0, 1))
+_jitted_downsample_grid = jax.jit(_downsample_grid, static_argnums=0)
 
 
 def run_grid_tail(spec: PipelineSpec, wts, v, m, gid, num_groups: int):
     """Finish a streamed query: grid [S, W] -> (wts, out[G, W], mask[G, W])."""
     return _jitted_grid_tail(spec, num_groups, wts, v, m, gid)
+
+
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool
+def run_downsample_grid(step: DownsampleStep, ts, val, mask, wargs: dict):
+    """One downsample-only dispatch -> (wts[W], v[S, W], mask[S, W])."""
+    return _jitted_downsample_grid(step, ts, val, mask, wargs)
 
 
 # shape: ts[S,N] any, val[S,N] any, mask[S,N] bool, gid[S] any

@@ -533,7 +533,40 @@ class QueryRunner:
             # lookup can trigger a cold inline [S, N] build (and evict
             # warm entries) for a query that 413s anyway.
             check_grid_budget()
-        if (tsdb.device_cache is not None and store is not None
+        # Partial-aggregate rewrite (storage/agg_cache.py, ROADMAP
+        # item 2): fixed-grid raw downsample plans decompose into
+        # aligned blocks — cached blocks serve from the two-tier store
+        # and only the uncovered delta ranges dispatch.  The costmodel
+        # (and a repeat-count materialization rule) decides rewrite vs
+        # recompute; the decision is annotated on the pipeline span
+        # like every PR 6 strategy decision.  Tried BEFORE the device
+        # series cache: a warm rewrite skips the column gather too.
+        # ONE host-lane decision for this dispatch: the agg cache keys
+        # blocks on the execution platform and the dispatch chain picks
+        # its lane from the same value (host_small below) — a second
+        # derivation could drift and splice cross-platform block bits
+        # into one answer.
+        from opentsdb_tpu.ops.hostlane import (cpu_device,
+                                               execution_platform)
+        lane_small = (not use_mesh and not would_stream
+                      and 0 < total_points <= tsdb.config.get_int(
+                          "tsd.query.host_lane.max_points")
+                      and cpu_device() is not None)
+        agg_plan = None
+        if (tsdb.agg_cache is not None and not would_stream
+                and not use_mesh and seg.kind == "raw"
+                and store is tsdb.store
+                and isinstance(windows, FixedWindows)):
+            agg_platform = "cpu" if lane_small else execution_platform()
+            agg_plan, agg_note = tsdb.agg_cache.plan(
+                store, series_list[0].key.metric, series_list, windows,
+                seg.start_ms, seg.end_ms, ds_fn, ds.fill_policy,
+                ds.fill_value, agg_platform, len(gid),
+                max(max(c) for _, _, c in kept), g_pad,
+                bool(sub.rate), total_points=int(total_points))
+            obs_trace.annotate(psp, agg_cache=agg_note)
+        if (agg_plan is None and tsdb.device_cache is not None
+                and store is not None
                 and seg.kind in ("raw", "rollup")):
             # Cold entries build inline only when the alternative is a full
             # host materialization anyway; when streaming would serve this
@@ -568,17 +601,16 @@ class QueryRunner:
         # threshold the same jitted pipeline runs on the host CPU —
         # the accelerator dispatch floor dominates at this scale.  Never
         # for mesh queries or device-cache hits (data already in HBM).
-        host_small = (cached is None and not use_mesh and not would_stream
-                      and 0 < total_points <= tsdb.config.get_int(
-                          "tsd.query.host_lane.max_points"))
+        host_small = cached is None and lane_small
         if host_small:
-            from opentsdb_tpu.ops.hostlane import cpu_device
-            host_small = cpu_device() is not None
-            if host_small:
-                self.exec_stats["hostLane"] = 1.0
+            self.exec_stats["hostLane"] = 1.0
         from opentsdb_tpu.ops.hostlane import host_lane
 
-        if cached is None and would_stream:
+        if agg_plan is not None:
+            out_ts, out_val, out_mask = self._run_agg_rewrite(
+                spec, agg_plan, series_list, gid, g_pad, windows,
+                window_spec, host_small, budget)
+        elif cached is None and would_stream:
             # Beyond the threshold the batch never materializes: bounded
             # chunks are copied straight out of the store into the device
             # accumulator (SaltScanner overlap analog, VERDICT r1 #4).
@@ -640,10 +672,16 @@ class QueryRunner:
 
         if psp is not None:
             obs_trace.device_wait(psp, (out_ts, out_val, out_mask))
-            self._trace_pipeline_stages(
-                psp, sub, seg, len(gid),
-                max(max(c) for _, _, c in kept), window_spec.count,
-                len(kept), host_small, policy_epoch)
+            if agg_plan is None:
+                # rewritten segments skip the predicted-vs-actual
+                # ledger: the monolithic stage breakdown does not
+                # describe a block-decomposed execution, and pairing
+                # its prediction with a tail-only actual would poison
+                # the calibration ring
+                self._trace_pipeline_stages(
+                    psp, sub, seg, len(gid),
+                    max(max(c) for _, _, c in kept), window_spec.count,
+                    len(kept), host_small, policy_epoch)
         obs_trace.end(psp)
         with obs_trace.stage("extract"):
             out_ts = np.asarray(out_ts)
@@ -763,6 +801,140 @@ class QueryRunner:
         """Full window copies for the sub-threshold (one-batch) paths."""
         return [s.window(seg.start_ms, seg.end_ms, fix)
                 for _, members, _ in kept for s, _t in members]
+
+    @staticmethod
+    def _materialize_agg_piece(v, m, count: int):
+        """Host copies of one computed piece's [S, count] grid slice
+        (`_materialize` prefix: this is a sanctioned device->host
+        result materialization, like the extract stage's)."""
+        return (np.asarray(v)[:, :count], np.asarray(m)[:, :count])
+
+    def _run_agg_rewrite(self, spec, plan, series_list, gid, g_pad,
+                         windows, window_spec, host_small, budget):
+        """Execute a partial-aggregate rewrite (storage/agg_cache.py).
+
+        Cached blocks replay their stored [S, B] downsample grids;
+        uncovered pieces dispatch the SAME downsample-only program a
+        cold run uses (run_downsample_grid) over exactly their
+        sub-range, so a warm answer is bit-identical to a cold one by
+        construction.  The assembled [S, W] grid then runs the shared
+        tail (rate -> group -> aggregate) — the streaming executor's
+        finish program — and freshly computed full blocks are stored
+        back (generation-guarded: a dirty mark that landed since
+        planning discards the insert)."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import mode_policy_epoch
+        from opentsdb_tpu.ops.hostlane import host_lane
+        from opentsdb_tpu.ops.pipeline import (
+            DownsampleStep, build_batch_direct, run_downsample_grid,
+            run_grid_tail)
+        tsdb = self.tsdb
+        fix = tsdb.config.fix_duplicates
+        step0 = spec.downsample
+        epoch = mode_policy_epoch()
+        interval = windows.interval_ms
+        s = len(series_list)
+        pieces_v: list = []
+        pieces_m: list = []
+        with host_lane(host_small):
+            for piece in plan.pieces:
+                if piece.cached is not None:
+                    # cached entries hold their FULL row set; narrow
+                    # to this query's rows unless they already match
+                    # (the exact-repeat hot path serves zero-copy)
+                    v, m = piece.cached
+                    rows = piece.rows
+                    identity = (v.shape[0] == len(rows)
+                                and np.array_equal(
+                                    rows, np.arange(len(rows))))
+                    if not identity and piece.tier == "agg_device":
+                        rdev = jnp.asarray(rows)
+                        v = jnp.take(v, rdev, axis=0)
+                        m = jnp.take(m, rdev, axis=0)
+                    elif not identity:
+                        v = v[rows]
+                        m = m[rows]
+                    pieces_v.append(v)
+                    pieces_m.append(m)
+                    self._bump("aggCacheHitWindows", piece.count)
+                    continue
+                budget.check_deadline()
+                # delta fetch composes with the device series cache:
+                # pinned HBM columns serve the piece's [S, n] batch as
+                # an on-device gather (zero host copy); cold/stale
+                # falls back to the host build.  Either source hands
+                # the SAME values at the same pow2-padded shape to the
+                # same program, so the block's bits do not depend on
+                # which one answered.
+                batch = None
+                if tsdb.device_cache is not None:
+                    batch = tsdb.device_cache.batch_for(
+                        plan.store, plan.metric, series_list,
+                        piece.fetch_lo, piece.fetch_hi, fix,
+                        build=False)
+                if batch is not None:
+                    ts, val, mask = batch
+                else:
+                    ts, val, mask, _ = build_batch_direct(
+                        series_list, piece.fetch_lo, piece.fetch_hi,
+                        fix)
+                sub_win = FixedWindows(interval, piece.first_ms,
+                                       piece.count)
+                wspec, wargs = sub_win.split()
+                sub_step = DownsampleStep(step0.function, wspec,
+                                          step0.fill_policy,
+                                          step0.fill_value)
+                _wts, v, m = run_downsample_grid(sub_step, ts, val,
+                                                 mask, wargs)
+                self._bump("aggCacheComputedWindows", piece.count)
+                if piece.block is not None:
+                    vn, mn = self._materialize_agg_piece(v, m,
+                                                         piece.count)
+                    tsdb.agg_cache.store_block(plan, piece,
+                                               series_list, vn, mn,
+                                               epoch)
+                # edge pieces stay padded here; the host assembly
+                # slices to piece.count after materializing (an eager
+                # jnp slice would dispatch — and recompile — per call)
+                pieces_v.append(v)
+                pieces_m.append(m)
+            w = windows.count
+            wp = window_spec.count
+            # Device concatenation only for the all-cached all-device
+            # repeat (stable piece shapes -> the concat compiles once
+            # per family).  Everything else assembles on the HOST:
+            # sliding windows change the edge pieces' shapes every
+            # refresh, and a jnp.concatenate would recompile per
+            # distinct shape combination (measured ~0.5s/slide) while
+            # np writes cost microseconds; the grid upload itself is
+            # [S, Wp] — tiny next to the point data the cache avoids.
+            device_ok = all(p.cached is not None
+                            and p.tier == "agg_device"
+                            for p in plan.pieces)
+            if device_ok:
+                pad = [jnp.zeros((s, wp - w), jnp.float64)] \
+                    if wp > w else []
+                mpad = [jnp.zeros((s, wp - w), bool)] if wp > w else []
+                v_full = jnp.concatenate(pieces_v + pad, axis=1)
+                m_full = jnp.concatenate(pieces_m + mpad, axis=1)
+            else:
+                v_full = np.zeros((s, wp), np.float64)
+                m_full = np.zeros((s, wp), bool)
+                col = 0
+                for v, m, piece in zip(pieces_v, pieces_m, plan.pieces):
+                    v_full[:, col:col + piece.count], \
+                        m_full[:, col:col + piece.count] = \
+                        self._materialize_agg_piece(v, m, piece.count)
+                    col += piece.count
+            # the monolithic grid's timestamps: first + i * interval
+            # over the padded window count, int64 (window_timestamps)
+            wts = (windows.first_window_ms
+                   + np.arange(wp, dtype=np.int64) * interval)
+            out = run_grid_tail(spec, jnp.asarray(wts), v_full, m_full,
+                                jnp.asarray(gid), g_pad)
+        if plan.cached_windows:
+            self.exec_stats["aggCacheHit"] = 1.0
+        return out
 
     def _stream_grouped(self, spec: PipelineSpec, seg, series_list,
                         max_len: int, gid, g_pad: int, window_spec, wargs,

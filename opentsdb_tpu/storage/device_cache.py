@@ -200,14 +200,58 @@ class DeviceSeriesCache:
             self._tick += 1
             entry.tick = self._tick
             self.hits += 1
+        self._emit_hit()
         return _gather_windows(entry.ts_dev, entry.val_dev,
                                starts, lengths, n, ts_base)
 
     # -- build / refresh -------------------------------------------------
 
+    # tier-labeled prometheus families shared with the partial-
+    # aggregate cache (storage/agg_cache.py): the same
+    # tsd.query.cache.* names, tier="device_series" — so one scrape
+    # shows every cache layer side by side (before this, the tallies
+    # only lived in collect_stats()).
+
+    @staticmethod
+    def _emit_hit() -> None:
+        from opentsdb_tpu.obs.registry import REGISTRY
+        REGISTRY.counter(
+            "tsd.query.cache.hits",
+            "Query-cache hits, by tier").labels(
+                tier="device_series").inc()
+
+    @staticmethod
+    def _emit_miss() -> None:
+        from opentsdb_tpu.obs.registry import REGISTRY
+        REGISTRY.counter(
+            "tsd.query.cache.misses",
+            "Query-cache misses, by tier").labels(
+                tier="device_series").inc()
+
+    @staticmethod
+    def _emit_evictions(n: int) -> None:
+        from opentsdb_tpu.obs.registry import REGISTRY
+        REGISTRY.counter(
+            "tsd.query.cache.evictions",
+            "Query-cache evictions, by tier").labels(
+                tier="device_series").inc(n)
+
+    def _emit_bytes(self) -> None:
+        from opentsdb_tpu.obs.registry import REGISTRY
+        REGISTRY.gauge(
+            "tsd.query.cache.bytes",
+            "Query-cache resident bytes, by tier").labels(
+                tier="device_series").set(self.bytes_used)
+        REGISTRY.gauge(
+            "tsd.query.cache.entries",
+            "Query-cache resident entries, by tier").labels(
+                tier="device_series").set(len(self))
+
     def _count(self, name: str) -> None:
         with self._lock:
             setattr(self, name, getattr(self, name) + 1)
+        if name == "misses":
+            self._emit_miss()
 
     def _mark_stale(self, ekey: tuple, entry: _Entry) -> None:
         with self._lock:
@@ -265,12 +309,17 @@ class DeviceSeriesCache:
                        nbytes=p * _BYTES_PER_POINT)
         ekey = (id(store), metric)
         with self._lock:
+            evicted_before = self.evictions
             self._evict_for_locked(entry.nbytes)
+            evicted = self.evictions - evicted_before
             self._tick += 1
             entry.tick = self._tick
             self._entries[ekey] = entry
             self._stale.pop(ekey, None)
             self.builds += 1
+        if evicted:
+            self._emit_evictions(evicted)
+        self._emit_bytes()
         return entry
 
     def _evict_for_locked(self, incoming_bytes: int) -> None:
@@ -312,6 +361,7 @@ class DeviceSeriesCache:
                     self._entries.pop(ekey, None)
                 for ekey in [k for k in self._stale if k[1] == metric]:
                     self._stale.pop(ekey, None)
+        self._emit_bytes()
 
     def collect_stats(self) -> dict:
         return {

@@ -496,8 +496,36 @@ class MemStore:
         # annotations: tsuid-keyed and global lists  # guarded-by: _lock
         self._annotations: dict[str, list[Annotation]] = {}
         self.datapoints_added = 0
+        # data-mutation listeners, (metric, lo_ms, hi_ms) per write —
+        # the partial-aggregate cache's incremental invalidation hook
+        # (storage/agg_cache.py).  Notified AFTER the write lands
+        # (write-then-mark): by the time the write is acked its mark
+        # exists, so any cached artifact built from a pre-write read
+        # fails its generation check — no acked write is ever served
+        # stale.  (Mark-before-write had a hole: a snapshot taken
+        # after the mark but before the write would carry the mark's
+        # generation and dodge it forever.)
+        # guarded-by: _lock
+        self._mutation_listeners: list = []
 
     # -- write path --
+
+    def add_mutation_listener(self, fn: Callable) -> None:
+        """Register fn(metric_uid, lo_ms | None, hi_ms | None), called
+        after every data mutation lands (None bounds = the whole
+        metric; write-then-mark — see _mutation_listeners)."""
+        with self._lock:
+            self._mutation_listeners.append(fn)
+
+    def notify_mutation(self, metric: int, lo_ms: int | None,
+                        hi_ms: int | None) -> None:
+        """Tell listeners a (metric, time-range) HAS changed — call
+        after the mutation lands (see _mutation_listeners above).
+
+        Also the public entry for out-of-band mutators (the query
+        delete flag, fsck repairs) that bypass add_point/add_batch."""
+        for fn in tuple(self._mutation_listeners):
+            fn(metric, lo_ms, hi_ms)
 
     def get_or_create_series(self, key: SeriesKey) -> Series:
         with self._lock:
@@ -519,6 +547,7 @@ class MemStore:
             series = self._get_or_create_series_locked(key)
             self.datapoints_added += 1
         series.append(ts_ms, value, is_int)
+        self.notify_mutation(key.metric, ts_ms, ts_ms)
         if series.dirty:
             self.compaction_queue.add(series)
 
@@ -529,6 +558,9 @@ class MemStore:
             series = self._get_or_create_series_locked(key)
             self.datapoints_added += len(ts_ms)
         series.append_batch(ts_ms, values, is_int, ival)
+        if len(ts_ms):
+            self.notify_mutation(key.metric, int(np.min(ts_ms)),
+                                 int(np.max(ts_ms)))
         if series.dirty:
             self.compaction_queue.add(series)
 
@@ -646,11 +678,13 @@ class MemStore:
     def delete_series(self, key: SeriesKey) -> bool:
         with self._lock:
             series = self._series.pop(key, None)
-            if series is None:
-                return False
-            keys = self._by_metric.get(key.metric)
-            if keys is not None:
-                keys.discard(key)
-                if not keys:
-                    self._by_metric.pop(key.metric, None)
-            return True
+            if series is not None:
+                keys = self._by_metric.get(key.metric)
+                if keys is not None:
+                    keys.discard(key)
+                    if not keys:
+                        self._by_metric.pop(key.metric, None)
+        if series is None:
+            return False
+        self.notify_mutation(key.metric, None, None)
+        return True

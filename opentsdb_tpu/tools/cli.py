@@ -149,6 +149,7 @@ def cmd_scan(args) -> int:
         if args.delete:
             series.delete_range(int(ts[0]) if len(ts) else 0,
                                 int(ts[-1]) if len(ts) else 0)
+            tsdb.store.notify_mutation(series.key.metric, None, None)
         from opentsdb_tpu.utils import format_ascii_point
         for i in range(len(ts)):
             value = int(iv[i]) if isint[i] else float(fv[i])

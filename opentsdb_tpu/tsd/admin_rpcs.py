@@ -87,6 +87,8 @@ class DropCachesRpc(TelnetRpc, HttpRpc):
         tsdb.store.drop_caches()
         if tsdb.device_cache is not None:
             tsdb.device_cache.invalidate()
+        if tsdb.agg_cache is not None:
+            tsdb.agg_cache.invalidate()
         # UID cachs are authoritative dictionaries here (no backing store),
         # so unlike UniqueId.dropCaches they must NOT be emptied.
 

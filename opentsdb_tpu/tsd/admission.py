@@ -175,7 +175,6 @@ def estimate_plan_cost_ms(tsdb, ts_query) -> float:
         points = pts * s / len(sample)
         if points <= 0:
             continue
-        n = pad_pow2(max(int(math.ceil(points / s)), 1))
         ds = sub.downsample_spec
         ds_fn = None
         w = 1
@@ -183,6 +182,31 @@ def estimate_plan_cost_ms(tsdb, ts_query) -> float:
             ds_fn = ds.function
             w = max(int((ts_query.end_time - ts_query.start_time)
                         // ds.interval_ms) + 1, 1)
+            # Price the REWRITTEN plan, not the original: windows
+            # covered by valid partial-aggregate blocks never
+            # dispatch, so only the uncovered fraction of the scan
+            # costs anything.  The discount mirrors the planner's
+            # rewrite eligibility — a plan the planner can never
+            # rewrite (streaming-sized, mesh-sharded) must keep its
+            # FULL predicted cost, or the shed gate under-prices
+            # exactly the heaviest queries it exists to refuse.
+            rewritable = (
+                getattr(tsdb, "agg_cache", None) is not None
+                and not ds.use_calendar
+                and points <= tsdb.config.get_int(
+                    "tsd.query.streaming.point_threshold")
+                and not (tsdb.query_mesh() is not None
+                         and s >= tsdb.config.get_int(
+                             "tsd.query.mesh.min_series")))
+            if rewritable:
+                coverage = tsdb.agg_cache.coverage(
+                    tsdb.store, metric_uid, ds.interval_ms,
+                    ds.function, ts_query.start_time,
+                    ts_query.end_time)
+                points *= max(1.0 - coverage, 0.0)
+                if points < 1:
+                    continue
+        n = pad_pow2(max(int(math.ceil(points / s)), 1))
         # group count: "none" keeps every series; aggregations reduce —
         # approximated as one group (conservatively LOW, so estimation
         # errs toward admitting)

@@ -574,6 +574,8 @@ class QueryRpc(HttpRpc):
                     for series, _ in runner._resolve_series(sub, store):
                         deleted += series.delete_range(
                             seg.start_ms, seg.end_ms, fix_dups)
+                        store.notify_mutation(series.key.metric,
+                                              seg.start_ms, seg.end_ms)
         return deleted
 
     def parse_query_string(self, tsdb, query: HttpQuery) -> TSQuery:

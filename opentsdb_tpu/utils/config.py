@@ -325,6 +325,39 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.query.device_cache.batch_mb": _e(
         "int", "6144", "Decline cached-batch gathers whose padded "
         "[S, N] expansion exceeds this bound."),
+    "tsd.query.cache.enable": _e(
+        "bool", True, "Cache per-(series, window) partial aggregates "
+        "of fixed-interval downsample plans in aligned blocks and "
+        "rewrite overlapping queries to reuse them, dispatching only "
+        "the uncovered delta ranges (docs/caching.md)."),
+    "tsd.query.cache.mb": _e(
+        "int", "256", "Host-tier byte budget for cached aggregate "
+        "blocks (LRU eviction)."),
+    "tsd.query.cache.device_mb": _e(
+        "int", "64", "Device/HBM-tier byte budget for hot aggregate "
+        "blocks (0 disables the device mirrors)."),
+    "tsd.query.cache.block_windows": _e(
+        "int", "32", "Windows per cached block (rounded up to a power "
+        "of two; blocks align to the absolute window grid so "
+        "overlapping queries share them).  Smaller blocks waste fewer "
+        "edge windows per query, larger ones cost fewer dispatches "
+        "to populate."),
+    "tsd.query.cache.min_repeats": _e(
+        "int", "2", "Plan-family occurrences before a cold plan is "
+        "worth materializing (1 = populate on first sight)."),
+    "tsd.query.cache.promote_hits": _e(
+        "int", "2", "Block hits before a host-tier block earns a "
+        "device/HBM mirror."),
+    "tsd.query.cache.amortize_horizon": _e(
+        "int", "32", "Cold-populate admission: the populate overhead "
+        "(rewrite minus monolithic predicted cost) must be "
+        "recoverable within this many repeat queries' per-hit "
+        "savings; plans whose per-hit saving is non-positive "
+        "(dispatch-floor regime) never cache."),
+    "tsd.query.cache.dispatch_overhead_us": _e(
+        "int", "150", "Per-dispatch overhead (microseconds) the "
+        "rewrite-vs-recompute costmodel decision charges each "
+        "dispatch either side issues."),
     "tsd.query.kernel.scan_mode": _e(
         "str", "", "Prefix-scan strategy: auto|flat|blocked|subblock|"
         "subblock2 (empty keeps the module default / TSDB_SCAN_MODE "

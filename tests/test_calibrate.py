@@ -430,6 +430,11 @@ class TestConvergence:
         tsdb = TSDB(Config({
             "tsd.core.auto_create_metrics": True,
             "tsd.query.mesh.enable": False,
+            # the convergence proof needs every served query in the
+            # calibration ring; partial-aggregate rewrites skip the
+            # predicted-vs-actual ledger by design (their stage
+            # breakdown doesn't describe a block-decomposed execution)
+            "tsd.query.cache.enable": False,
             "tsd.costmodel.autotune.enable": True,
             "tsd.costmodel.autotune.interval": 1,
             "tsd.costmodel.autotune.min_samples": 16,

@@ -36,6 +36,23 @@ def test_overload_contract_holds():
     assert "healed (shed rate 0)" in proc.stdout
 
 
+@pytest.mark.slow
+def test_cache_contract_holds():
+    """ISSUE 9 acceptance: a cache-enabled TSD under mixed repeat/
+    sliding-window load with ingest running answers byte-identical to
+    a cache-disabled control, serves a nonzero agg-tier hit rate on
+    prometheus, and heals (no stale answers) after a WAL-site fault
+    burst."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14271", "--rounds", "6", "--cache",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "zero divergence" in proc.stdout
+    assert "agg-tier hits" in proc.stdout
+
+
 def test_cluster_contracts_hold_under_chaos():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),

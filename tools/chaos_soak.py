@@ -368,6 +368,12 @@ def run_autotune_stage(port: int, rounds: int) -> None:
         # grouped queries probe the mesh; shard_map is absent at HEAD
         # (the known tier-1 mesh failure set), so pin it off here
         "tsd.query.mesh.enable": "false",
+        # the fitter needs ring entries from MONOLITHIC dispatches;
+        # partial-aggregate rewrites skip the predicted-vs-actual
+        # ledger by design (their stage breakdown doesn't describe a
+        # block-decomposed execution) — the --cache stage owns the
+        # cache's own gates
+        "tsd.query.cache.enable": "false",
     }, role="autotune")
     try:
         for host, value in (("a", 1), ("b", 2), ("c", 3)):
@@ -453,6 +459,134 @@ def run_autotune_stage(port: int, rounds: int) -> None:
                       "inf: %r" % (plat, term, v), flush=True)
                 raise SystemExit(1)
     print("[autotune] persisted calibration OK: %s" % calib, flush=True)
+
+
+def run_cache_stage(port: int, rounds: int) -> None:
+    """--cache: the partial-aggregate cache's standing gate.
+
+    A cache-enabled TSD (tuned so the rewrite engages at soak scale)
+    races a cache-disabled control through a mixed repeat/sliding-
+    window query load with ingest running between rounds.  Gates:
+
+      * ZERO answer divergence: every round's payloads must match the
+        control byte-for-byte (integer-valued data, so monolithic and
+        block-decomposed float sums are both exact — a mismatch means
+        a stale window, a wrong block boundary, or a truncated range,
+        never ulp noise);
+      * the cache actually served: tsd_query_cache_hits_total > 0 on
+        /api/stats/prometheus for an agg tier;
+      * healing: the primary boots with a WAL-site fault burst armed
+        (`wal.append` errors, times-limited).  Ingest during the burst
+        may half-land (the point can be in the store with the journal
+        write failed); after the burst both daemons take one
+        idempotent full re-put (last-write-wins, identical values) and
+        every later answer must STILL match — a cache that missed an
+        invalidation during the fault window serves stale and fails
+        here.
+    """
+    import tempfile
+    wal_dir = tempfile.mkdtemp(prefix="chaos_cache_wal_")
+    n_pts = 900
+    shared_cfg = {
+        "tsd.query.mesh.enable": "false",
+        "tsd.storage.fix_duplicates": "true",
+    }
+    prim = spawn_tsd(port, {
+        **shared_cfg,
+        "tsd.query.cache.min_repeats": "1",
+        "tsd.query.cache.block_windows": "8",
+        "tsd.query.cache.dispatch_overhead_us": "0",
+        "tsd.storage.directory": wal_dir,
+        "tsd.faults.config": json.dumps([
+            {"site": "wal.append", "kind": "error", "times": 6},
+        ]),
+    }, role="cache")
+    ctrl = spawn_tsd(port + 1, {
+        **shared_cfg,
+        "tsd.query.cache.enable": "false",
+    }, role="cache-control")
+
+    def points(lo, hi, salt=0, host="a"):
+        # `salt` changes every value: re-puts and between-round
+        # overwrites must DIFFER from what any cached block holds, or
+        # the divergence gate cannot see a missed invalidation
+        return [{"metric": "cache.m", "timestamp": BASE + k,
+                 "value": (k * 7 + salt * 13) % 101,
+                 "tags": {"host": host}} for k in range(lo, hi)]
+
+    def q(p, start, end):
+        url = ("http://127.0.0.1:%d/api/query?start=%d&end=%d"
+               "&m=sum:10s-sum:cache.m" % (p, start, end))
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # burst phase: the primary's first journal writes fault —
+        # puts may 500 with the points half-landed; the control only
+        # receives what provably succeeded
+        burst_failures = 0
+        for lo in range(0, n_pts, 100):
+            batch = points(lo, lo + 100)
+            try:
+                http_put(port, batch)
+            except urllib.error.HTTPError:
+                burst_failures += 1
+                continue
+            http_put(port + 1, batch)
+        # prime the cache DURING the burst window so blocks exist that
+        # a missed invalidation could serve stale
+        for _ in range(3):
+            q(port, BASE, BASE + 600)
+        # heal: one full re-put on BOTH with DIFFERENT values
+        # (last-write-wins) — every cached block from the fault window
+        # MUST be dirtied, or the very first comparison diverges
+        for lo in range(0, n_pts, 100):
+            http_put(port, points(lo, lo + 100, salt=1))
+            http_put(port + 1, points(lo, lo + 100, salt=1))
+        divergences = 0
+        for i in range(max(rounds, 10)):
+            # repeat window + a sliding window, both compared exactly
+            for start, end in ((BASE, BASE + 600),
+                               (BASE + 20 * i, BASE + 600 + 20 * i)):
+                a = q(port, start, end)
+                b = q(port + 1, start, end)
+                if a != b:
+                    divergences += 1
+                    print("[cache] round %d DIVERGED on [%d, %d]:\n"
+                          "  cache:   %r\n  control: %r"
+                          % (i, start, end, a, b), flush=True)
+            # ingest between rounds, INSIDE the repeat window (an
+            # overwrite with round-salted values: the next round's
+            # repeat query serves wrong sums if the cached block
+            # misses the mark) plus fresh tail points
+            mid = points(100 + i * 7, 105 + i * 7, salt=i + 2)
+            extra = points(n_pts + i * 3, n_pts + (i + 1) * 3)
+            for p in (port, port + 1):
+                assert http_put(p, mid)
+                assert http_put(p, extra)
+        if divergences:
+            print("[cache] %d diverged answers vs the cache-disabled "
+                  "control" % divergences, flush=True)
+            raise SystemExit(1)
+        scrape = _prom_scrape(port)
+        agg_hits = sum(
+            v for labels, v in scrape.get(
+                "tsd_query_cache_hits_total", {}).items()
+            if "agg" in labels)
+        if agg_hits <= 0:
+            print("[cache] no agg-tier cache hits on prometheus — the "
+                  "rewrite never engaged (scrape: %r)"
+                  % scrape.get("tsd_query_cache_hits_total"),
+                  flush=True)
+            raise SystemExit(1)
+        print("[cache] %d rounds, zero divergence, %d agg-tier hits, "
+              "%d faulted burst puts healed"
+              % (max(rounds, 10), int(agg_hits), burst_failures),
+              flush=True)
+    finally:
+        for proc in (prim, ctrl):
+            proc.send_signal(signal.SIGTERM)
+            proc.wait()
 
 
 def _prom_scrape(port: int, timeout: float = 10.0) -> dict:
@@ -676,6 +810,13 @@ def main():
                          "with the online fitter (and exploration) "
                          "armed must install finite positive constants "
                          "and never dispatch an infeasible mode")
+    ap.add_argument("--cache", action="store_true",
+                    help="run the partial-aggregate cache stage: a "
+                         "cache-enabled TSD must answer byte-identical "
+                         "to a cache-disabled control under mixed "
+                         "repeat/sliding load with ingest running, "
+                         "show a nonzero agg hit rate, and heal after "
+                         "a WAL-site fault burst")
     ap.add_argument("--overload", action="store_true",
                     help="run the admission-gate overload stage: "
                          "saturating load + an injected slow-handler "
@@ -693,9 +834,12 @@ def main():
         run_overload_stage(args.port + 3, args.rounds)
     if args.autotune:
         run_autotune_stage(args.port + 2, args.rounds)
+    if args.cache:
+        run_cache_stage(args.port + 5, args.rounds)
     if args.stages_only:
-        if not (args.overload or args.autotune):
-            ap.error("--stages-only needs --overload and/or --autotune")
+        if not (args.overload or args.autotune or args.cache):
+            ap.error("--stages-only needs --overload, --autotune "
+                     "and/or --cache")
         print("chaos soak stages PASSED (standard phases skipped: "
               "--stages-only)", flush=True)
         return
