@@ -88,6 +88,20 @@ def _note(msg: str) -> None:
 _emit_lock = threading.Lock()
 _emitted = False
 
+# Per-stage progress stamps (r03-r05 blackout diagnosis aid): every
+# completed stage appends "<name>@+<seconds>"; the skip artifact carries
+# the list, so a 1500s deadline verdict now says WHERE the run wedged —
+# an empty list (or no probe_ok) is "tunnel wedged before the first
+# dispatch", probe_ok without compiled is "compile stuck after probe
+# OK", etc.  BENCH_r0{3,4,5}.json could not distinguish these.
+_STAGES: list = []
+_T0 = time.monotonic()
+
+
+def _stamp(name: str) -> None:
+    _STAGES.append("%s@+%.1fs" % (name, time.monotonic() - _T0))
+    _note("stage: " + _STAGES[-1])
+
 METRIC = ("datapoints aggregated/sec/chip through the production "
           "/api/query pipeline (avg 1h downsample + groupby "
           "100 groups, 67M pts device-resident, per-dispatch-"
@@ -106,10 +120,13 @@ def _emit(obj: dict) -> None:
 
 def _skip(reason: str) -> None:
     """Structured no-measurement artifact (VERDICT r3: a backend failure
-    must never cost the round's provenance by dying with a traceback)."""
+    must never cost the round's provenance by dying with a traceback).
+    Carries the per-stage progress stamps so the skip says where the
+    run died, not just that it died."""
     _note("SKIPPED: " + reason)
     _emit({"metric": METRIC, "value": 0.0, "unit": "datapoints/sec/chip",
-           "vs_baseline": 0.0, "skipped": True, "reason": reason})
+           "vs_baseline": 0.0, "skipped": True, "reason": reason,
+           "stages": list(_STAGES)})
 
 
 def _arm_watchdog(deadline_s: float) -> None:
@@ -154,6 +171,40 @@ def guard_backend_init(timeout_s: float = 240.0) -> None:
     import jax
     jax.devices()
     ev.set()
+
+
+def preflight_probe(deadline_s: float = 240.0) -> None:
+    """Device preflight with its OWN short deadline, run before any
+    expensive batch build or headline compile.
+
+    The r03-r05 bench blackout produced three 1500s "backend
+    unresponsive" verdicts that could not say whether the tunnel was
+    wedged before the FIRST dispatch or a compile hung later; this
+    probe splits that verdict.  It dials the backend, dispatches one
+    trivial kernel, and drains it with the host-fetch sync; a hang
+    emits the skip artifact (with the stage stamps showing how far it
+    got) after ``deadline_s`` — a fraction of the 1500s outer deadline
+    — instead of burning the whole measurement window.
+    """
+    done = threading.Event()
+
+    def fire():
+        if not done.wait(deadline_s):
+            _skip("preflight: device probe did not complete in %.0fs — "
+                  "tunnel wedged before the first dispatch (stages "
+                  "show the last completed step)" % deadline_s)
+            sys.stdout.flush()
+            os._exit(0)
+    threading.Thread(target=fire, daemon=True).start()
+
+    import jax
+    devs = jax.devices()
+    _stamp("probe_devices_%d_%s" % (len(devs), devs[0].platform))
+    import jax.numpy as jnp
+    out = (jnp.zeros(8) + 1.0,)
+    drain(out)
+    _stamp("probe_ok")
+    done.set()
 
 
 S = 1024          # series
@@ -342,19 +393,21 @@ from statistics import median as _median
 def run() -> None:
     import jax
 
+    preflight_probe(float(os.environ.get("BENCH_PROBE_DEADLINE_S",
+                                         "240")))
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
     _note("devices: %d (%s); pipeline dispatches single-device"
           % (n_dev, platform))
     batch = make_batch()
-    _note("batch resident")
+    _stamp("batch_resident")
     spec, wargs, g_pad = build_spec()
     origins = _OriginSequence()
 
     # compile + warm (unique origins too — even warmup never replays)
     warm = dispatch(spec, g_pad, batch, wargs, origins.next())
     drain(warm)
-    _note("compiled")
+    _stamp("compiled")
     # Sync cost measured against the REAL output structure: the drain is
     # one serial tunnel round-trip per leaf, so a tiny one-leaf probe
     # undercounts it by (leaves-1) RTTs and bills the difference as chip
@@ -364,8 +417,10 @@ def run() -> None:
           "(subtracted per sample)"
           % (rtt, len(jax.tree_util.tree_leaves(warm))))
 
+    _stamp("rtt_measured")
     samples, k_final, total_wall = measure_drained(spec, g_pad, batch,
                                                    wargs, origins, rtt)
+    _stamp("measured")
     per_iter = _median(samples)
     _note("drained: %d samples (final k=%d dispatches/sample), "
           "median=%.4fs/dispatch, total wall=%.2fs (min=%.4fs max=%.4fs)"

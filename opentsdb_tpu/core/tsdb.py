@@ -99,6 +99,18 @@ class TSDB:
             self.store.add_mutation_listener(
                 lambda metric, lo, hi: cache.note_mutation(
                     metric, lo, hi, store=store))
+        # bounded partial-aggregate spill pool (ROADMAP item 4): backs
+        # the out-of-core tiled executor (ops/tiling.py) so group-by
+        # plans past the tsd.query.streaming.state_mb wall answer
+        # instead of refusing; closed (files unlinked) at shutdown
+        from opentsdb_tpu.storage.spill import SpillPool
+        self.spill_pool = (
+            SpillPool(
+                self.config.get_int("tsd.query.spill.host_mb") * 2**20,
+                self.config.get_int("tsd.query.spill.disk_mb") * 2**20,
+                directory=self.config.get_string("tsd.query.spill.dir")
+                or None)
+            if self.config.get_bool("tsd.query.spill.enable") else None)
         from opentsdb_tpu.rollup import RollupConfig, RollupStore
         self.rollup_config = RollupConfig.from_config(self.config)
         self.rollup_store = (
@@ -983,6 +995,11 @@ class TSDB:
             with self._ingest_lock:
                 self.persistence.snapshot()
             self.persistence.close()
+        if self.spill_pool is not None:
+            # after the query path is quiesced: drops every entry and
+            # the private tempdir (in-flight tiled queries have their
+            # own per-query release in ops/tiling.py)
+            self.spill_pool.close()
 
 
 def parse_value(value) -> tuple[bool, int | float]:

@@ -209,6 +209,40 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
     "tsd.query.cache.entries": _m(
         "gauge", ("tier",),
         "Query-cache resident entries, by tier."),
+    # -- out-of-core tiled execution (ops/tiling.py,                    #
+    #    storage/spill.py) --------------------------------------------- #
+    "tsd.query.spill.bytes": _m(
+        "gauge", ("tier",),
+        "Spill-pool resident bytes, by tier (host ring / disk "
+        "overflow) — bounded by tsd.query.spill.host_mb/disk_mb."),
+    "tsd.query.spill.entries": _m(
+        "gauge", ("tier",),
+        "Spill-pool resident entries, by tier."),
+    "tsd.query.spill.tiles": _m(
+        "counter", (),
+        "Series tiles executed by the out-of-core tiled path."),
+    "tsd.query.spill.spills": _m(
+        "counter", ("tier",),
+        "Partial grids written to the spill pool, by landing tier."),
+    "tsd.query.spill.reads": _m(
+        "counter", (),
+        "Spill entries read back from the disk tier."),
+    "tsd.query.spill.evictions": _m(
+        "counter", (),
+        "Spill-pool host-ring entries demoted to the disk tier."),
+    "tsd.query.spill.invalidations": _m(
+        "counter", (),
+        "Spill entries released back to the pool (per-query cleanup "
+        "and shutdown)."),
+    "tsd.query.spill.refusals": _m(
+        "counter", ("reason",),
+        "Over-budget plans the tiled path could not serve (still "
+        "413), by reason: disabled, not_streamable, no_fit, "
+        "pool_budget."),
+    "tsd.query.spill.write_errors": _m(
+        "counter", (),
+        "Spill-pool disk writes that failed (disk full / injected "
+        "spill.write fault)."),
     # -- partial-aggregate cache stats walk (storage/agg_cache.py       #
     #    collect_stats -> /api/stats + prometheus gauges) -------------- #
     "tsd.query.agg_cache.hits": _m(

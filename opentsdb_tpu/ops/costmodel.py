@@ -116,6 +116,18 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "ext_scan_elem": 6.0e-9,
         "ext_seg_elem": 1.06e-7,
         "ext_boundary_cell": 4.0e-8,
+        # out-of-core tiling (ops/tiling.py): partial-grid spill-pool
+        # write/read seconds per MB (host memcpy + the disk-overflow
+        # share at the default pool split — the fitter separates the
+        # real mix from live traffic) and the per-dispatch overhead of
+        # a tiled plan's extra launches (chunk folds, finishes,
+        # stripe tails).  ESTIMATES until a chip session records the
+        # tunnel-transfer reality; the tiled decision only ever
+        # arbitrates tiled-vs-refuse, so a bad constant costs admission
+        # accuracy, never a wrong answer.
+        "spill_write_mb": 6.0e-4,
+        "spill_read_mb": 4.0e-4,
+        "tile_dispatch": 1.5e-3,
     },
     "cpu": {
         "gather_round": 2.0e-8,
@@ -139,6 +151,10 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "ext_scan_elem": 4.0e-9,
         "ext_seg_elem": 2.0e-9,
         "ext_boundary_cell": 2.0e-8,
+        # spill pool on the host platform: same memcpy, no tunnel
+        "spill_write_mb": 4.0e-4,
+        "spill_read_mb": 3.0e-4,
+        "tile_dispatch": 3.0e-4,
     },
 }
 
@@ -511,3 +527,33 @@ def choose_group(s: int, w: int, g: int, platform: str,
                    {m: predict_group(m, s, w, g, platform)
                     for m in candidates},
                    platform, _bucket(s, w, g))
+
+
+# -- out-of-core tiled execution (ops/tiling.py) ----------------------- #
+
+def features_tiled(s: int, w: int, g: int, n_tiles: int, n_stripes: int,
+                   spill_bytes: int, dispatches: int) -> dict[str, float]:
+    """Unit counts for the tiled OVERHEAD of one [s, w] -> [g, w] plan:
+    the spill-pool round trip of the full partial grid plus the extra
+    launches a tiled plan issues (per-tile chunk folds + finishes, per-
+    stripe tail dispatches).  The streamed compute itself is priced by
+    the same stage features a resident plan uses (obs.jaxprof) — this
+    vector is strictly the delta, so the fitter can regress the spill
+    constants from (tiled actual - resident prediction) residuals
+    without the compute terms aliasing them.  Linear in the constants
+    by construction: `predict_tiled == dot(features_tiled, costs)`.
+    """
+    mb = spill_bytes / 2.0**20
+    return {"spill_write_mb": mb,
+            "spill_read_mb": mb,
+            "tile_dispatch": float(max(dispatches,
+                                       n_tiles + n_stripes))}
+
+
+def predict_tiled(s: int, w: int, g: int, n_tiles: int, n_stripes: int,
+                  spill_bytes: int, dispatches: int,
+                  platform: str) -> float:
+    """Predicted seconds of tiled-execution OVERHEAD (spill + extra
+    dispatches) on top of the plan's ordinary compute prediction."""
+    return _dot(features_tiled(s, w, g, n_tiles, n_stripes, spill_bytes,
+                               dispatches), platform)

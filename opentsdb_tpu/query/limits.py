@@ -29,11 +29,16 @@ LOG = logging.getLogger(__name__)
 
 
 class QueryException(Exception):
-    """Query failed mid-flight; carries the HTTP status (QueryException.java)."""
+    """Query failed mid-flight; carries the HTTP status (QueryException.java)
+    and an optional structured ``details`` payload for the error
+    envelope (the grid-budget 413s report computed MB / limit /
+    suggested config machine-readably)."""
 
-    def __init__(self, message: str, status: int = 413):
+    def __init__(self, message: str, status: int = 413,
+                 details: dict | None = None):
         super().__init__(message)
         self.status = status
+        self.details = details
 
 
 class QueryCancelledException(QueryException):
@@ -138,6 +143,90 @@ def active_deadline() -> Deadline | None:
     """The current request's deadline, or None outside a request (the
     library-caller path: QueryRunner.run with no server above it)."""
     return getattr(_tls, "deadline", None)
+
+
+# --------------------------------------------------------------------- #
+# Shared device-state grid budget (tsd.query.streaming.state_mb)        #
+# --------------------------------------------------------------------- #
+
+# The three planner enforcement sites (streaming accumulator,
+# materialized downsample grid, histogram bucket grid) each estimate
+# their grid bytes differently BY DESIGN, but the limit read, the
+# over/under decision, and the structured 413 all live here — the
+# copy-pasted refusal prose can never drift again, and the tiled
+# executor consults the same decision to know a plan "would have
+# refused" (ops/tiling.py).
+
+_GRID_MESSAGES = {
+    "streaming": (
+        "Sorry, this query's streaming state (%d series x %d windows%s) "
+        "needs ~%dMB of accelerator memory per chip, over the %dMB "
+        "limit (tsd.query.streaming.state_mb). Please use a coarser "
+        "downsample interval or decrease your time range."),
+    "grid": (
+        "Sorry, this query's downsample grid (%d series x %d windows%s) "
+        "needs ~%dMB of accelerator memory per chip, over the %dMB "
+        "limit (tsd.query.streaming.state_mb). Please use a coarser "
+        "downsample interval or decrease your time range."),
+    "histogram": (
+        "Sorry, this histogram query's bucket grid (%d windows x "
+        "%d buckets%s) needs ~%dMB of accelerator memory, over the "
+        "%dMB limit (tsd.query.streaming.state_mb). Please use a "
+        "coarser downsample interval or decrease your time range."),
+}
+
+
+@dataclass(frozen=True)
+class GridBudgetDecision:
+    """One grid-vs-budget verdict: the bytes a plan's device-resident
+    grid needs against the configured allowance."""
+    kind: str           # "streaming" | "grid" | "histogram"
+    grid_bytes: int
+    state_mb: int       # configured limit; <= 0 disables the guard
+    dim_a: int          # series (rows for histogram)
+    dim_b: int          # windows (buckets for histogram)
+    sketch: bool = False
+
+    @property
+    def over(self) -> bool:
+        return self.state_mb > 0 and self.grid_bytes > self.state_mb * 2**20
+
+    @property
+    def grid_mb(self) -> int:
+        return self.grid_bytes // 2**20
+
+    def exception(self) -> QueryException:
+        """The structured 413: the reference's budget prose plus a
+        machine-readable details payload (computed MB, limit, suggested
+        config) for operators and clients."""
+        from opentsdb_tpu.ops.streaming import SKETCH_K
+        note = " x %d-point sketches" % SKETCH_K if self.sketch else ""
+        return QueryException(
+            _GRID_MESSAGES[self.kind]
+            % (self.dim_a, self.dim_b, note, self.grid_mb, self.state_mb),
+            details={
+                "gridMb": self.grid_mb,
+                "limitMb": self.state_mb,
+                "limitKey": "tsd.query.streaming.state_mb",
+                "kind": self.kind,
+                "suggestion": "use a coarser downsample interval, "
+                              "decrease the time range, or raise "
+                              "tsd.query.streaming.state_mb / enable "
+                              "tsd.query.spill.enable for tiled "
+                              "execution",
+            })
+
+
+def grid_budget(kind: str, state_mb: int, grid_bytes: int, dim_a: int,
+                dim_b: int, sketch: bool = False) -> GridBudgetDecision:
+    """THE shared guard: every state_mb enforcement site builds its
+    decision here.  Callers compute ``grid_bytes`` (their estimates
+    differ by design); raising ``decision.exception()`` yields the one
+    canonical 413."""
+    if kind not in _GRID_MESSAGES:
+        raise ValueError("unknown grid budget kind: %r" % kind)
+    return GridBudgetDecision(kind, int(grid_bytes), int(state_mb),
+                              int(dim_a), int(dim_b), sketch)
 
 
 # Everything a hostile/corrupt overrides file can raise through

@@ -495,20 +495,19 @@ class QueryRunner:
         would_stream = (stream_ok and total_points > tsdb.config.get_int(
             "tsd.query.streaming.point_threshold"))
 
-        def check_grid_budget():
+        def grid_budget_decision():
             # The materialized path has the streaming guard's hazard too:
             # SPARSE series over a huge range with a fine interval build a
             # [S, W] grid regardless of point count (a year at 10s windows
             # is 3M+ columns).  Same knob, same 413 shape; ~3 grid lanes
             # live through a dispatch (values, counts, mask/fill
             # intermediates).  Per-chip when the mesh serves the query —
-            # the streamed path has its own per-chip guard in
-            # _stream_grouped (ADVICE r3 medium) — but rollup_avg never
-            # shards and carries a second count-lane grid, so it is held
-            # to the flat single-chip estimate at double weight.
+            # the streamed path estimates per chip too (ADVICE r3
+            # medium) — but rollup_avg never shards and carries a second
+            # count-lane grid, so it is held to the flat single-chip
+            # estimate at double weight.
+            from opentsdb_tpu.query.limits import grid_budget
             state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
-            if state_mb <= 0:
-                return
             n_chips, lanes = 1, 1
             if seg.kind == "rollup_avg":
                 lanes = 2
@@ -517,22 +516,42 @@ class QueryRunner:
                 n_chips = n_devices(mesh)
             grid_bytes = len(gid) * window_spec.count * 24 * lanes \
                 // n_chips
-            if grid_bytes > state_mb * 2**20:
-                from opentsdb_tpu.query.limits import QueryException
-                raise QueryException(
-                    "Sorry, this query's downsample grid (%d series x %d "
-                    "windows) needs ~%dMB of accelerator memory per chip, "
-                    "over the %dMB limit (tsd.query.streaming.state_mb). "
-                    "Please use a coarser downsample interval or decrease "
-                    "your time range."
-                    % (len(gid), window_spec.count,
-                       grid_bytes // 2**20, state_mb))
+            return grid_budget("grid", state_mb, grid_bytes, len(gid),
+                               window_spec.count)
 
-        if not would_stream:
-            # Destined to materialize: refuse BEFORE the device-cache
-            # lookup can trigger a cold inline [S, N] build (and evict
-            # warm entries) for a query that 413s anyway.
-            check_grid_budget()
+        def streaming_budget_decision():
+            # The accumulator grid is O(S x W x lane bytes); per-chip
+            # when the mesh shards the rows; the sketch lane dominates
+            # when present (see _stream_grouped, which re-checks the
+            # same shared decision as defense in depth).
+            from opentsdb_tpu.ops.streaming import SKETCH_K
+            from opentsdb_tpu.query.limits import grid_budget
+            state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
+            lanes = lanes_for([ds_fn])
+            per_cell = 8 + 8 * len(lanes) \
+                + (4 * SKETCH_K if sketchable else 0)
+            n_chips = 1
+            if use_mesh:
+                from opentsdb_tpu.parallel.sharded import n_devices
+                n_chips = n_devices(mesh)
+            est = len(gid) * window_spec.count * per_cell // n_chips
+            return grid_budget("streaming", state_mb, est, len(gid),
+                               window_spec.count, sketch=sketchable)
+
+        # ONE budget verdict up front — BEFORE the device-cache lookup
+        # can trigger a cold inline [S, N] build (and evict warm
+        # entries) for a plan that cannot execute resident.  An
+        # over-budget plan no longer refuses outright: the tiled
+        # executor (ops/tiling.py, ROADMAP item 4) serves it when the
+        # spill pool and the costmodel-sized tile split allow;
+        # _maybe_tiled raises the shared structured 413 otherwise.
+        tiled_plan = None
+        gbd = (streaming_budget_decision() if would_stream
+               else grid_budget_decision())
+        if gbd.over:
+            tiled_plan = self._maybe_tiled(
+                gbd, seg, len(gid), window_spec, g_pad, ds_fn,
+                sketchable, stream_ok, total_points)
         # Partial-aggregate rewrite (storage/agg_cache.py, ROADMAP
         # item 2): fixed-grid raw downsample plans decompose into
         # aligned blocks — cached blocks serve from the two-tier store
@@ -548,12 +567,14 @@ class QueryRunner:
         # into one answer.
         from opentsdb_tpu.ops.hostlane import (cpu_device,
                                                execution_platform)
-        lane_small = (not use_mesh and not would_stream
+        lane_small = (tiled_plan is None and not use_mesh
+                      and not would_stream
                       and 0 < total_points <= tsdb.config.get_int(
                           "tsd.query.host_lane.max_points")
                       and cpu_device() is not None)
         agg_plan = None
-        if (tsdb.agg_cache is not None and not would_stream
+        if (tiled_plan is None and tsdb.agg_cache is not None
+                and not would_stream
                 and not use_mesh and seg.kind == "raw"
                 and store is tsdb.store
                 and isinstance(windows, FixedWindows)):
@@ -565,7 +586,8 @@ class QueryRunner:
                 max(max(c) for _, _, c in kept), g_pad,
                 bool(sub.rate), total_points=int(total_points))
             obs_trace.annotate(psp, agg_cache=agg_note)
-        if (agg_plan is None and tsdb.device_cache is not None
+        if (tiled_plan is None and agg_plan is None
+                and tsdb.device_cache is not None
                 and store is not None
                 and seg.kind in ("raw", "rollup")):
             # Cold entries build inline only when the alternative is a full
@@ -586,16 +608,20 @@ class QueryRunner:
                 store, series_list[0].key.metric, series_list,
                 seg.start_ms, seg.end_ms, fix, build=not would_stream,
                 ts_base=ts_base)
+            if cached is not None and would_stream \
+                    and grid_budget_decision().over:
+                # a warm hit would divert this streaming query onto the
+                # materialized path, whose [S, W] grid estimate busts
+                # the budget the streaming estimate passed — DECLINE
+                # the diversion and stream (refusing here would 413 a
+                # query the streamed path serves fine)
+                cached = None
             if cached is not None:
                 self.exec_stats["deviceCacheHit"] = 1.0
                 if ts_base is not None:
                     import jax.numpy as jnp
                     wargs = dict(wargs)
                     wargs["ts_base"] = jnp.asarray(ts_base, jnp.int64)
-                if would_stream:
-                    # warm hit diverted a streaming query onto the
-                    # materialized path: it still builds the [S, W] grid
-                    check_grid_budget()
 
         # Small-query fast lane (VERDICT r3 weak #2): below the point
         # threshold the same jitted pipeline runs on the host CPU —
@@ -606,7 +632,22 @@ class QueryRunner:
             self.exec_stats["hostLane"] = 1.0
         from opentsdb_tpu.ops.hostlane import host_lane
 
-        if agg_plan is not None:
+        if tiled_plan is not None:
+            # Out-of-core: series-tiled streaming with partial-grid
+            # spill, window-striped tail replay (ops/tiling.py).  The
+            # decision + pool traffic ride the span's `tiling` tag; the
+            # calibration ring skips tiled executions like rewrites
+            # (the monolithic stage breakdown does not describe them).
+            from opentsdb_tpu.ops import tiling
+            (out_ts, out_val, out_mask), tile_stats = tiling.run_tiled(
+                tsdb, spec, seg, series_list, gid, g_pad, window_spec,
+                wargs, ds_fn, lanes_for([ds_fn]), sketchable, fix,
+                tiled_plan, budget, store=store)
+            obs_trace.annotate(psp, tiling=tile_stats)
+            self.exec_stats["tiledExecution"] = 1.0
+            self._bump("spillBytes", float(tile_stats["spillBytes"]))
+            self._bump("tiledTiles", float(tile_stats["tiles"]))
+        elif agg_plan is not None:
             out_ts, out_val, out_mask = self._run_agg_rewrite(
                 spec, agg_plan, series_list, gid, g_pad, windows,
                 window_spec, host_small, budget)
@@ -672,12 +713,12 @@ class QueryRunner:
 
         if psp is not None:
             obs_trace.device_wait(psp, (out_ts, out_val, out_mask))
-            if agg_plan is None:
-                # rewritten segments skip the predicted-vs-actual
-                # ledger: the monolithic stage breakdown does not
-                # describe a block-decomposed execution, and pairing
-                # its prediction with a tail-only actual would poison
-                # the calibration ring
+            if agg_plan is None and tiled_plan is None:
+                # rewritten AND tiled segments skip the predicted-vs-
+                # actual ledger: the monolithic stage breakdown does
+                # not describe a block-decomposed or tiled execution,
+                # and pairing its prediction with a partial actual
+                # would poison the calibration ring
                 self._trace_pipeline_stages(
                     psp, sub, seg, len(gid),
                     max(max(c) for _, _, c in kept), window_spec.count,
@@ -936,6 +977,36 @@ class QueryRunner:
             self.exec_stats["aggCacheHit"] = 1.0
         return out
 
+    def _maybe_tiled(self, gbd, seg, s: int, window_spec, g_pad: int,
+                     ds_fn: str, sketchable: bool, stream_ok: bool,
+                     total_points: int):
+        """Over-budget plan: size+price a tiled execution, or raise the
+        shared structured 413 the guard would have raised at HEAD.
+
+        Eligibility mirrors the streamed path (the tiled executor
+        streams each tile through the same accumulator): the downsample
+        function must merge associatively or sketch, and the spill pool
+        must be armed and big enough for the full partial grid."""
+        from opentsdb_tpu.ops import tiling
+        from opentsdb_tpu.ops.hostlane import execution_platform
+        tsdb = self.tsdb
+        plan = None
+        if not stream_ok:
+            tiling.count_refusal("not_streamable")
+        else:
+            from opentsdb_tpu.ops.streaming import SKETCH_K
+            lanes = lanes_for([ds_fn])
+            acc_cell = 8 + 8 * len(lanes) \
+                + (4 * SKETCH_K if sketchable else 0)
+            plan = tiling.plan_tiled(
+                tsdb, s=s, w=window_spec.count, g_pad=g_pad,
+                acc_cell_bytes=acc_cell, total_points=int(total_points),
+                platform=execution_platform())
+        if plan is None:
+            self.exec_stats["tiledRefused"] = 1.0
+            raise gbd.exception()
+        return plan
+
     def _stream_grouped(self, spec: PipelineSpec, seg, series_list,
                         max_len: int, gid, g_pad: int, window_spec, wargs,
                         sketch: bool = False):
@@ -973,33 +1044,27 @@ class QueryRunner:
             "tsd.query.mesh.min_series"))
         # The accumulator grid is O(S x W x lane bytes): a fine downsample
         # over a huge range (10s windows x a year -> millions of windows)
-        # would OOM the device mid-query.  Refuse up front with the
-        # reference's budget error shape instead (QueryRpc 413 contract) —
-        # the operator either coarsens the interval or raises the budget.
-        # The limit is PER CHIP: the sharded path splits rows over the
-        # mesh, so its estimate divides by the device count.  The sketch
-        # lane dominates when present (K float32 summary points + the
-        # count lane per cell).
-        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
-        if state_mb > 0:
-            from opentsdb_tpu.ops.streaming import SKETCH_K
-            from opentsdb_tpu.query.limits import QueryException
-            per_cell = 8 + 8 * len(lanes) + (4 * SKETCH_K if sketch else 0)
-            n_chips = 1
-            if use_sharded:
-                from opentsdb_tpu.parallel.sharded import n_devices
-                n_chips = n_devices(mesh)
-            est = s * window_spec.count * per_cell // n_chips
-            if est > state_mb * 2**20:
-                raise QueryException(
-                    "Sorry, this query's streaming state (%d series x %d "
-                    "windows%s) needs ~%dMB of accelerator memory per "
-                    "chip, over the %dMB limit "
-                    "(tsd.query.streaming.state_mb). Please use a coarser "
-                    "downsample interval or decrease your time range."
-                    % (s, window_spec.count,
-                       " x %d-point sketches" % SKETCH_K if sketch else "",
-                       est // 2**20, state_mb))
+        # would OOM the device mid-query.  The caller already routed
+        # over-budget plans to the tiled executor (or raised); this
+        # re-check through the SAME shared guard is defense in depth
+        # for direct callers.  The limit is PER CHIP: the sharded path
+        # splits rows over the mesh, so its estimate divides by the
+        # device count.  The sketch lane dominates when present (K
+        # float32 summary points + the count lane per cell).
+        from opentsdb_tpu.ops.streaming import SKETCH_K
+        from opentsdb_tpu.query.limits import grid_budget
+        per_cell = 8 + 8 * len(lanes) + (4 * SKETCH_K if sketch else 0)
+        n_chips = 1
+        if use_sharded:
+            from opentsdb_tpu.parallel.sharded import n_devices
+            n_chips = n_devices(mesh)
+        gbd = grid_budget(
+            "streaming",
+            tsdb.config.get_int("tsd.query.streaming.state_mb"),
+            s * window_spec.count * per_cell // n_chips,
+            s, window_spec.count, sketch=sketch)
+        if gbd.over:
+            raise gbd.exception()
         # Both accumulators are created AFTER the first chunk is packed:
         # its observed window span sizes the sliced-update window
         # (wider-than-data grids fold each chunk into an O(S*wc) state
@@ -1257,18 +1322,16 @@ class QueryRunner:
         if batch is None:
             return results
         # grid budget: rows x buckets cells of int64 must fit the same
-        # device-state allowance the scalar paths honor
-        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
-        grid_bytes = batch["n_rows"] * batch["n_buckets"] * 8
-        if state_mb > 0 and grid_bytes > state_mb * 2**20:
-            from opentsdb_tpu.query.limits import QueryException
-            raise QueryException(
-                "Sorry, this histogram query's bucket grid (%d windows x "
-                "%d buckets) needs ~%dMB of accelerator memory, over the "
-                "%dMB limit (tsd.query.streaming.state_mb). Please use a "
-                "coarser downsample interval or decrease your time range."
-                % (batch["n_rows"], batch["n_buckets"],
-                   grid_bytes // 2**20, state_mb))
+        # device-state allowance the scalar paths honor (shared guard;
+        # histograms never tile — the bucket scatter is one dispatch)
+        from opentsdb_tpu.query.limits import grid_budget
+        gbd = grid_budget(
+            "histogram",
+            tsdb.config.get_int("tsd.query.streaming.state_mb"),
+            batch["n_rows"] * batch["n_buckets"] * 8,
+            batch["n_rows"], batch["n_buckets"])
+        if gbd.over:
+            raise gbd.exception()
 
         # ONE dispatch for every group (VERDICT r3 #4): scatter entries
         # onto the [rows, B] grid, percentile-extract on device.  Small

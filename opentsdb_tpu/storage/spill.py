@@ -1,0 +1,350 @@
+"""Bounded partial-aggregate spill pool: host-RAM ring + disk overflow.
+
+The out-of-core tiled executor (ops/tiling.py, ROADMAP item 4) finishes
+one series tile at a time and must park each tile's [S_tile, W] partial
+grids somewhere until the window-striped assembly pass replays them —
+"somewhere" is this pool, the spilled-window-aggregation stance of
+arXiv:2007.10385 reduced to two byte-budgeted tiers:
+
+  host tier   numpy arrays in an insertion-ordered ring, budgeted by
+              ``tsd.query.spill.host_mb``.  New entries always land
+              here (the producer just materialized them on the host
+              anyway); when the ring overflows, the NEWEST entries
+              demote to disk.  Newest-first matches the executor's
+              access pattern: entries are written tile-major but
+              replayed STRIPE-major, so the oldest surviving entry
+              (lowest tile, lowest stripe) is among the next to be
+              read while the newest (highest tile, highest stripe) is
+              read last — the assembly pass starts from RAM and takes
+              its disk reads at the tail.
+  disk tier   one ``.npy`` file per array under
+              ``tsd.query.spill.dir`` (a private tempdir when unset),
+              budgeted by ``tsd.query.spill.disk_mb``.  Reads go
+              through ``numpy`` memory-mapping so a window-striped
+              column slice fetches ~its own bytes, not the whole
+              tile grid, bounding the assembly pass's read
+              amplification.
+
+Capacity is a REFUSAL, not an OOM: ``put`` raises ``SpillCapacityError``
+when an entry cannot fit even after demoting everything demotable, and
+``SpillWriteError`` when the disk tier itself fails (disk full — the
+``spill.write`` fault site injects exactly this for
+``tools/chaos_soak.py --spill``).  The executor translates either into
+the query-level 413/503 contract and releases whatever the query had
+already pooled; a failed spill never wedges the pool for later queries.
+
+Ownership contract (tsdblint resource_leak): disk files are opened via
+``open_spill_file`` / ``SpillPool.open_spill`` — a registered
+acquisition kind — and every handle either closes in a ``finally`` or
+transfers ownership to the pool's ``_files`` table, whose entries
+``free``/``close`` unlink.  The pool itself is process-long-lived
+(``TSDB.shutdown`` closes it).
+
+Like the rest of storage/, this module stays importable numpy-only.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+
+import numpy as np
+
+from opentsdb_tpu.utils import faults
+
+LOG = logging.getLogger(__name__)
+
+
+class SpillError(Exception):
+    """Base: the spill pool could not hold or produce an entry."""
+
+
+class SpillCapacityError(SpillError):
+    """Entry exceeds the pool's combined host+disk byte budget."""
+
+
+class SpillWriteError(SpillError):
+    """The disk tier failed mid-write (disk full / injected fault)."""
+
+
+def open_spill_file(path: str, mode: str = "wb"):
+    """Open one spill tier file.  A dedicated acquisition kind under
+    tsdblint's resource_leak analyzer: every handle this returns must
+    reach close/with/finally or transfer ownership to the pool."""
+    return open(path, mode)
+
+
+class SpillPool:
+    """Byte-budgeted two-tier store for numpy array tuples.
+
+    Thread-safe: queries spill concurrently under the admission gate's
+    permit count.  Accounting and the ring/files tables live under one
+    lock; the (potentially slow) disk writes happen OUTSIDE it on the
+    demoting thread, with the entry kept HOST-VISIBLE (and marked
+    non-re-demotable) until its file write completes — a concurrent
+    ``get`` of a mid-demotion key serves the RAM copy and never falls
+    between tiers or reads a half-written file.
+    """
+
+    def __init__(self, host_budget_bytes: int, disk_budget_bytes: int,
+                 directory: str | None = None):
+        self._lock = threading.Lock()
+        self.host_budget = max(int(host_budget_bytes), 0)
+        self.disk_budget = max(int(disk_budget_bytes), 0)
+        self._configured_dir = directory or None
+        self._dir: str | None = None       # guarded-by: _lock (lazy tempdir)
+        self._own_dir = False              # guarded-by: _lock
+        self._next_key = 0                 # guarded-by: _lock
+        # host ring: key -> tuple of arrays (insertion-ordered; oldest
+        # first — dict preserves insertion order)
+        self._host: dict[int, tuple] = {}  # guarded-by: _lock
+        # disk tier: key -> list of file paths (one per array)
+        self._files: dict[int, list] = {}  # guarded-by: _lock
+        self._bytes: dict[int, int] = {}   # guarded-by: _lock (per entry)
+        # keys mid-demotion (host copy still servable; not re-demotable)
+        self._demoting: set[int] = set()   # guarded-by: _lock
+        self.host_bytes = 0                # guarded-by: _lock
+        self.disk_bytes = 0                # guarded-by: _lock
+        self._closed = False               # guarded-by: _lock
+
+    # -- metrics ------------------------------------------------------- #
+
+    def _gauges_locked(self) -> None:
+        from opentsdb_tpu.obs.registry import REGISTRY
+        g = REGISTRY.gauge("tsd.query.spill.bytes",
+                           "Spill-pool resident bytes, by tier")
+        g.labels(tier="host").set(float(self.host_bytes))
+        g.labels(tier="disk").set(float(self.disk_bytes))
+        e = REGISTRY.gauge("tsd.query.spill.entries",
+                           "Spill-pool resident entries, by tier")
+        e.labels(tier="host").set(float(len(self._host)))
+        e.labels(tier="disk").set(float(len(self._files)))
+
+    # -- tier plumbing -------------------------------------------------- #
+
+    def _ensure_dir_locked(self) -> str:
+        if self._dir is None:
+            if self._configured_dir:
+                os.makedirs(self._configured_dir, exist_ok=True)
+                self._dir = self._configured_dir
+            else:
+                self._dir = tempfile.mkdtemp(prefix="tsdb_spill_")
+                self._own_dir = True
+        return self._dir
+
+    def _write_entry(self, directory: str, key: int, arrays: tuple) -> list:
+        """Write one entry's arrays to the disk tier; returns the paths.
+        Raises SpillWriteError (cleaning up its own partial files) on
+        any OS-level failure, including the injected spill.write fault."""
+        paths = []
+        try:
+            for i, a in enumerate(arrays):
+                path = os.path.join(directory, "spill_%d_%d.npy" % (key, i))
+                faults.check("spill.write")
+                fh = open_spill_file(path)
+                try:
+                    np.save(fh, a)
+                finally:
+                    fh.close()
+                paths.append(path)
+        except OSError as e:
+            for p in paths:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            from opentsdb_tpu.obs.registry import REGISTRY
+            REGISTRY.counter(
+                "tsd.query.spill.write_errors",
+                "Spill-pool disk writes that failed (disk full / "
+                "injected fault)").inc()
+            raise SpillWriteError("spill write failed: %s" % e) from e
+        return paths
+
+    def _demote_one(self) -> bool:
+        """Move the NEWEST demotable host entry to disk (see the module
+        docstring for why newest-first fits the stripe-major replay).
+        Returns False when nothing is demotable.  Disk I/O runs outside
+        the lock; the entry STAYS host-visible until its file write
+        completes, so a concurrent ``get`` of the same key never falls
+        between tiers."""
+        with self._lock:
+            key = next((k for k in reversed(self._host)
+                        if k not in self._demoting), None)
+            if key is None:
+                return False
+            arrays = self._host[key]
+            nbytes = self._bytes[key]
+            if self.disk_budget <= 0 \
+                    or self.disk_bytes + nbytes > self.disk_budget:
+                return False
+            directory = self._ensure_dir_locked()
+            self.disk_bytes += nbytes          # reserve before the write
+            self._demoting.add(key)
+        try:
+            paths = self._write_entry(directory, key, arrays)
+        except SpillWriteError:
+            with self._lock:
+                self.disk_bytes -= nbytes
+                self._demoting.discard(key)
+            raise
+        with self._lock:
+            self._demoting.discard(key)
+            if self._host.pop(key, None) is None:
+                # freed concurrently: the disk copy is garbage now
+                self.disk_bytes -= nbytes
+                stale = paths
+            else:
+                self.host_bytes -= nbytes
+                self._files[key] = paths
+                stale = ()
+                from opentsdb_tpu.obs.registry import REGISTRY
+                REGISTRY.counter(
+                    "tsd.query.spill.evictions",
+                    "Spill-pool host-ring entries demoted to the disk "
+                    "tier").inc()
+                # the demoted entry has now LANDED on disk — the other
+                # arm of the tier-labeled landing counter (puts always
+                # land host first)
+                REGISTRY.counter(
+                    "tsd.query.spill.spills",
+                    "Partial grids written to the spill pool, by "
+                    "landing tier").labels(tier="disk").inc()
+            self._gauges_locked()
+        for p in stale:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        return True
+
+    # -- public API ----------------------------------------------------- #
+
+    def put(self, arrays: tuple) -> int:
+        """Pool one entry (a tuple of numpy arrays); returns its key.
+
+        The entry lands in the host ring; older entries demote to disk
+        until the ring fits its budget again.  Raises
+        SpillCapacityError when the combined budgets cannot hold it and
+        SpillWriteError when the disk tier fails."""
+        arrays = tuple(np.ascontiguousarray(a) for a in arrays)
+        nbytes = int(sum(a.nbytes for a in arrays))
+        with self._lock:
+            if self._closed:
+                raise SpillError("spill pool is closed")
+            if nbytes > max(self.host_budget, self.disk_budget):
+                raise SpillCapacityError(
+                    "spill entry of %d bytes exceeds every tier budget "
+                    "(host %d, disk %d)" % (nbytes, self.host_budget,
+                                            self.disk_budget))
+            key = self._next_key
+            self._next_key += 1
+            self._host[key] = arrays
+            self._bytes[key] = nbytes
+            self.host_bytes += nbytes
+            from opentsdb_tpu.obs.registry import REGISTRY
+            REGISTRY.counter(
+                "tsd.query.spill.spills",
+                "Partial grids written to the spill pool, by landing "
+                "tier").labels(tier="host").inc()
+            self._gauges_locked()
+        while True:
+            with self._lock:
+                over = self.host_bytes > self.host_budget
+            if not over:
+                break
+            try:
+                demoted = self._demote_one()
+            except SpillWriteError:
+                # the caller never receives a key for this entry, so it
+                # must not stay pooled (its owner could not free it)
+                self.free(key)
+                raise
+            if not demoted:
+                # nothing (more) demotable: over-budget is now a refusal
+                self.free(key)
+                raise SpillCapacityError(
+                    "spill pool over budget: host %d/%d disk %d/%d bytes"
+                    % (self.host_bytes, self.host_budget,
+                       self.disk_bytes, self.disk_budget))
+        return key
+
+    def get(self, key: int, col_lo: int | None = None,
+            col_hi: int | None = None) -> tuple:
+        """Fetch an entry (optionally a [:, col_lo:col_hi] column slice
+        of every 2-D array — the window-striped read).  Disk-tier reads
+        memory-map, so a stripe slice costs ~its own bytes."""
+        with self._lock:
+            arrays = self._host.get(key)
+            paths = self._files.get(key)
+        if arrays is None and paths is None:
+            raise KeyError("no spill entry %d" % key)
+        out = []
+        if arrays is not None:
+            for a in arrays:
+                if col_lo is not None and a.ndim == 2:
+                    a = a[:, col_lo:col_hi]
+                out.append(a)
+            return tuple(out)
+        from opentsdb_tpu.obs.registry import REGISTRY
+        REGISTRY.counter(
+            "tsd.query.spill.reads",
+            "Spill entries read back from the disk tier").inc()
+        for p in paths:
+            a = np.load(p, mmap_mode="r")
+            if col_lo is not None and a.ndim == 2:
+                a = a[:, col_lo:col_hi]
+            out.append(np.ascontiguousarray(a))
+        return tuple(out)
+
+    def free(self, key: int) -> None:
+        """Release one entry (both tiers); idempotent."""
+        with self._lock:
+            arrays = self._host.pop(key, None)
+            paths = self._files.pop(key, None)
+            nbytes = self._bytes.pop(key, 0)
+            if arrays is not None:
+                self.host_bytes -= nbytes
+            elif paths is not None:
+                self.disk_bytes -= nbytes
+            if arrays is not None or paths is not None:
+                from opentsdb_tpu.obs.registry import REGISTRY
+                REGISTRY.counter(
+                    "tsd.query.spill.invalidations",
+                    "Spill entries released back to the pool").inc()
+                self._gauges_locked()
+        for p in paths or ():
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def release(self, keys) -> None:
+        """Free a batch of keys (the per-query cleanup path)."""
+        for key in keys:
+            self.free(key)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"host_bytes": self.host_bytes,
+                    "disk_bytes": self.disk_bytes,
+                    "host_entries": len(self._host),
+                    "disk_entries": len(self._files)}
+
+    def close(self) -> None:
+        """Drop every entry and the private tempdir (TSDB.shutdown)."""
+        with self._lock:
+            self._closed = True
+            keys = list(self._host) + list(self._files)
+        self.release(keys)
+        with self._lock:
+            own_dir = self._own_dir and self._dir
+            directory, self._dir = self._dir, None
+            self._own_dir = False
+        if own_dir:
+            try:
+                os.rmdir(directory)
+            except OSError:
+                pass

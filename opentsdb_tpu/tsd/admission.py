@@ -214,6 +214,46 @@ def estimate_plan_cost_ms(tsdb, ts_query) -> float:
         breakdown = jaxprof.stage_breakdown(platform, s, n, w, g, ds_fn,
                                             bool(sub.rate))
         total_s += sum(breakdown.values())
+        # Out-of-core plans: a [s, w] state past the streaming budget
+        # no longer refuses — the tiled executor serves it (ROADMAP
+        # item 4) — so the gate must PRICE the tiled plan (compute +
+        # the spill/dispatch overhead of costmodel.predict_tiled)
+        # instead of shedding a query the planner would answer.  The
+        # sizing mirrors ops/tiling.size_tiles against the same
+        # budgets; an unservable plan adds nothing (the planner's
+        # structured 413 is cheaper than any queue wait).
+        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
+        pool = getattr(tsdb, "spill_pool", None)
+        if pool is not None and state_mb > 0 and ds_fn is not None:
+            # the PLANNER's per-cell estimate, not a constant: 16B for
+            # single-lane sums, 264B for sketch percentiles — a flat
+            # 24B would miss spill-heavy sketch plans (under-pricing)
+            # and tax resident single-lane plans (over-shedding)
+            from opentsdb_tpu.ops.streaming import (SKETCH_K,
+                                                    is_sketch_ds,
+                                                    lanes_for)
+            sketch = (is_sketch_ds(ds_fn) and tsdb.config.get_bool(
+                "tsd.query.streaming.sketch_percentiles"))
+            per_cell = 8 + 8 * len(lanes_for([ds_fn])) \
+                + (4 * SKETCH_K if sketch else 0)
+        else:
+            per_cell = 0
+        if (pool is not None and state_mb > 0 and per_cell
+                and s * w * per_cell > state_mb * 2**20):
+            from opentsdb_tpu.ops import costmodel as cm
+            from opentsdb_tpu.ops.tiling import size_tiles
+            chunk_points = max(tsdb.config.get_int(
+                "tsd.query.streaming.chunk_points"), 1)
+            plan = size_tiles(
+                s, w, state_mb * 2**20, per_cell, g,
+                tsdb.config.get_int("tsd.query.spill.max_tiles"),
+                chunks_per_tile=max(int(math.ceil(
+                    points / chunk_points)), 1))
+            if plan is not None and plan.spill_bytes \
+                    <= pool.host_budget + pool.disk_budget:
+                total_s += cm.predict_tiled(
+                    s, w, g, plan.n_tiles, plan.n_stripes,
+                    plan.spill_bytes, plan.dispatches, platform)
     return total_s * 1e3
 
 

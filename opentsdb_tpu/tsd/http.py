@@ -196,7 +196,10 @@ class HttpQuery:
         if isinstance(exc, BadRequestError):
             message, details = exc.message, exc.details
         else:
-            message, details = str(exc) or repr(exc), ""
+            # QueryException carries an optional structured payload
+            # (grid-budget 413s: computed MB, limit, suggested config)
+            message = str(exc) or repr(exc)
+            details = getattr(exc, "details", None) or ""
         err = {"code": status, "message": message}
         if details:
             err["details"] = details

@@ -325,6 +325,24 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.query.device_cache.batch_mb": _e(
         "int", "6144", "Decline cached-batch gathers whose padded "
         "[S, N] expansion exceeds this bound."),
+    "tsd.query.spill.enable": _e(
+        "bool", True, "Serve group-by plans whose [series, windows] "
+        "state exceeds tsd.query.streaming.state_mb via series-tiled "
+        "streaming with partial-aggregate spill (docs/tiling.md) "
+        "instead of refusing with a 413."),
+    "tsd.query.spill.host_mb": _e(
+        "int", "1024", "Host-RAM ring budget for spilled partial "
+        "grids; overflow demotes the oldest entries to disk."),
+    "tsd.query.spill.disk_mb": _e(
+        "int", "16384", "Disk-overflow budget for spilled partial "
+        "grids (0 disables the disk tier; plans whose partials exceed "
+        "host+disk refuse)."),
+    "tsd.query.spill.dir": _e(
+        "str", "", "Directory for disk-tier spill files (empty: a "
+        "private tempdir, removed at shutdown)."),
+    "tsd.query.spill.max_tiles": _e(
+        "int", "1024", "Refuse tiled plans needing more series tiles "
+        "than this (0 = unlimited) — a runaway-shape backstop."),
     "tsd.query.cache.enable": _e(
         "bool", True, "Cache per-(series, window) partial aggregates "
         "of fixed-interval downsample plans in aligned blocks and "

@@ -20,6 +20,9 @@ Sites currently instrumented:
                         execution (tsd/rpcs.py, tsd/graph.py) — a
                         latency fault here wedges the admission queue
                         deliberately (chaos_soak --overload)
+  spill.write           before each spill-pool disk-tier file write
+                        (storage/spill.py) — the disk-full shape
+                        chaos_soak --spill heals through
 
 Fault kinds:
 
@@ -74,6 +77,10 @@ KNOWN_SITES: dict[str, frozenset] = {
     # wedges the queue deliberately — the chaos_soak --overload lever)
     "admission.acquire": frozenset({"route"}),
     "rpc.slow_handler": frozenset({"route"}),
+    # before each spill-pool disk-tier file write (storage/spill.py) —
+    # an "error" fault here is the disk-full shape chaos_soak --spill
+    # heals through
+    "spill.write": frozenset(),
 }
 # Body-corruption kinds only make sense at mangle() sites.
 BODY_SITES = frozenset({"cluster.peer_body"})

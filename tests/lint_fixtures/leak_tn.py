@@ -38,6 +38,23 @@ def closed_before_return(path):
     return data
 
 
+def spill_file_finally(path, arr):
+    from opentsdb_tpu.storage import spill
+    fh = spill.open_spill_file(path)
+    try:
+        fh.write(arr.tobytes())
+    finally:
+        fh.close()
+
+
+def spill_file_ownership_to_pool(path, table, key):
+    # ownership transfer: the pool's files table unlinks it on free()
+    from opentsdb_tpu.storage import spill
+    fh = spill.open_spill_file(path)
+    table[key] = fh
+    return key
+
+
 def ownership_returned(path):
     fh = open(path)
     return fh                           # the caller owns it now

@@ -29,3 +29,9 @@ def executor_never_shut_down(jobs):
 def socket_dropped(host, port):
     conn = socket.create_connection((host, port))  # EXPECT: resource-leak
     conn.sendall(b"version\n")
+
+
+def spill_file_dropped(path, arr):
+    from opentsdb_tpu.storage.spill import open_spill_file
+    fh = open_spill_file(path)                     # EXPECT: resource-leak
+    fh.write(arr.tobytes())

@@ -53,6 +53,24 @@ def test_cache_contract_holds():
     assert "agg-tier hits" in proc.stdout
 
 
+@pytest.mark.slow
+def test_spill_contract_holds():
+    """ISSUE 10 acceptance: a tiled TSD (tiny state budget, disk-backed
+    spill pool) under long-range group-by load with ingest running
+    answers byte-identical to a resident-capable control, keeps the
+    pool bytes bounded on prometheus, engages the disk tier, and heals
+    after an injected spill.write disk-full fault burst."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14279", "--rounds", "4", "--spill",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "zero divergence" in proc.stdout
+    assert "disk" in proc.stdout
+    assert "healed" in proc.stdout
+
+
 def test_cluster_contracts_hold_under_chaos():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),

@@ -761,6 +761,7 @@ class TestStateBudget:
             "tsd.core.auto_create_metrics": True,
             "tsd.query.streaming.point_threshold": "10",
             "tsd.query.device_cache.enable": "false",
+            "tsd.query.spill.enable": "false",
             "tsd.query.streaming.state_mb": "1",
         }))
         base = 1_356_998_400
@@ -794,6 +795,7 @@ class TestStateBudget:
                 "tsd.query.device_cache.enable": "false",
                 "tsd.query.mesh.enable": mesh,
                 "tsd.query.mesh.min_series": 0,
+                "tsd.query.spill.enable": "false",
                 "tsd.query.streaming.state_mb": str(state_mb),
             }))
             for h in range(8):
@@ -831,6 +833,7 @@ class TestStateBudget:
         tsdb = TSDB(Config({
             "tsd.core.auto_create_metrics": True,
             "tsd.query.device_cache.enable": "false",
+            "tsd.query.spill.enable": "false",
             "tsd.query.streaming.state_mb": "2",
         }))
         for i in range(50):     # 50 points: far under any point budget
@@ -862,6 +865,7 @@ class TestStateBudget:
                 "tsd.query.device_cache.enable": "false",
                 "tsd.query.mesh.enable": mesh,
                 "tsd.query.mesh.min_series": 0,
+                "tsd.query.spill.enable": "false",
                 "tsd.query.streaming.state_mb": "8",
             }))
             for h in range(8):
@@ -924,3 +928,60 @@ class TestSegmentChunkMoments:
             np.testing.assert_allclose(np.asarray(got)[m],
                                        np.asarray(want)[m],
                                        rtol=1e-9, atol=1e-9, err_msg=fn)
+
+
+class TestSketchDriftBound:
+    """Direct coverage for the documented ~C/(2K) per-cell rank-drift
+    bound of the mergeable quantile summary (module docstring of
+    ops/streaming.py) under ADVERSARIAL chunking: every chunk folds
+    into the SAME window cell (the "0all"-shaped hazard), and chunks
+    arrive as sorted contiguous value ranges — the ordering that
+    maximizes per-merge re-interpolation error (stationary data's
+    signed-error cancellation is deliberately defeated)."""
+
+    def _drift(self, n_chunks: int, per_chunk: int = 256) -> float:
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import AllWindow
+        from opentsdb_tpu.ops.streaming import (StreamAccumulator,
+                                                lanes_for)
+        n = n_chunks * per_chunk
+        span = n * 1000
+        windows = AllWindow(0, span)
+        spec, wargs = windows.split()
+        acc = StreamAccumulator.create(1, spec, wargs, sketch=True,
+                                       lanes=lanes_for(["p50"]))
+        # values 0..n-1 in time order: chunk c holds the contiguous
+        # ascending run [c*m, (c+1)*m) — every merge splices a disjoint
+        # value range into the accumulated grid
+        for c in range(n_chunks):
+            vals = np.arange(c * per_chunk, (c + 1) * per_chunk,
+                             dtype=np.float64)
+            ts = (vals * 1000).astype(np.int64)
+            acc.update(jnp.asarray(ts[None, :]), jnp.asarray(vals[None, :]),
+                       jnp.ones((1, per_chunk), bool))
+        worst = 0.0
+        for pct in (10.0, 25.0, 50.0, 75.0, 90.0):
+            _, out, mask = acc.finish("p%g" % pct if pct != 50.0
+                                      else "median")
+            assert np.asarray(mask).all()
+            est = float(np.asarray(out).ravel()[0])
+            # population is 0..n-1, so value/n IS the rank fraction
+            true = pct / 100.0 * (n - 1)
+            worst = max(worst, abs(est - true) / n)
+        return worst
+
+    def test_adversarial_chunking_stays_within_documented_bound(self):
+        from opentsdb_tpu.ops.streaming import SKETCH_K
+        for n_chunks in (4, 16):
+            bound = n_chunks / (2.0 * SKETCH_K)
+            drift = self._drift(n_chunks)
+            assert drift <= 1.25 * bound + 1e-3, \
+                "C=%d: rank drift %.4f exceeds ~C/(2K)=%.4f" \
+                % (n_chunks, drift, bound)
+
+    def test_single_chunk_is_rank_exact_within_grid(self):
+        """C=1: no merges at all — the only error is the K-point
+        equi-rank grid's own interpolation, far below one merge's
+        1/(2K) allowance."""
+        from opentsdb_tpu.ops.streaming import SKETCH_K
+        assert self._drift(1) <= 0.5 / SKETCH_K

@@ -589,6 +589,140 @@ def run_cache_stage(port: int, rounds: int) -> None:
             proc.wait()
 
 
+def run_spill_stage(port: int, rounds: int) -> None:
+    """--spill: the out-of-core tiled executor's standing gate.
+
+    A tiled TSD — state budget squeezed so every long-range group-by
+    tiles through the spill pool (host ring deliberately tiny so the
+    disk tier engages) — races a resident-capable control through the
+    same mixed load with ingest running between rounds.  Gates:
+
+      * ZERO byte divergence on shapes both can serve: integer-valued
+        data, so tiled and resident folds are both exact — a mismatch
+        means a lost tile, a mis-assembled stripe, or a stale spill
+        entry, never ulp noise;
+      * the tiled path actually engaged AND spilled: prometheus shows
+        tsd_query_spill_tiles_total > 0 and a nonzero disk-tier
+        spill/eviction count, with resident spill bytes BOUNDED by the
+        configured host+disk budgets at every scrape;
+      * healing after disk-full: the primary boots with an
+        ``spill.write`` error fault armed (times-limited).  While the
+        fault burns, tiled queries may answer the 413/503 spill
+        contract but NEVER 500 and never a wrong answer; once it is
+        exhausted, the very next round must match the control again.
+    """
+    import tempfile
+    spill_dir = tempfile.mkdtemp(prefix="chaos_spill_")
+    n_hosts = 24
+    span = 163_840            # 16384 windows at 10s
+    shared_cfg = {
+        "tsd.query.mesh.enable": "false",
+        "tsd.query.device_cache.enable": "false",
+        "tsd.query.cache.enable": "false",
+        "tsd.query.streaming.point_threshold": "100",
+        # between-round ingest overwrites points with salted values
+        "tsd.storage.fix_duplicates": "true",
+    }
+    prim = spawn_tsd(port, {
+        **shared_cfg,
+        "tsd.query.streaming.state_mb": "1",
+        "tsd.query.spill.enable": "true",
+        "tsd.query.spill.host_mb": "1",
+        "tsd.query.spill.disk_mb": "64",
+        "tsd.query.spill.dir": spill_dir,
+        "tsd.faults.config": json.dumps([
+            {"site": "spill.write", "kind": "error", "times": 3},
+        ]),
+    }, role="spill")
+    ctrl = spawn_tsd(port + 1, {
+        **shared_cfg,
+        "tsd.query.spill.enable": "false",
+        "tsd.query.streaming.state_mb": "6144",
+    }, role="spill-control")
+
+    def points(lo, hi, salt=0):
+        out = []
+        for h in range(n_hosts):
+            out.extend(
+                {"metric": "spill.m", "timestamp": BASE + k * 512 + h,
+                 "value": (k * 7 + h * 13 + salt * 29) % 101,
+                 "tags": {"host": "h%d" % h, "g": "g%d" % (h % 4)}}
+                for k in range(lo, hi))
+        return out
+
+    def q(p, start, end):
+        url = ("http://127.0.0.1:%d/api/query?start=%d&end=%d"
+               "&m=sum:10s-sum:spill.m%%7Bg=*%%7D" % (p, start, end))
+        with urllib.request.urlopen(url, timeout=120) as resp:
+            return json.loads(resp.read())
+
+    try:
+        for lo in range(0, 300, 100):
+            assert http_put(port, points(lo, lo + 100))
+            assert http_put(port + 1, points(lo, lo + 100))
+        # fault burn-down: the armed spill.write faults may 413/503 the
+        # first tiled attempts — never 500, and the control stays up
+        burned = 0
+        for attempt in range(8):
+            try:
+                q(port, BASE, BASE + span)
+                break
+            except urllib.error.HTTPError as e:
+                assert e.code in (413, 503), \
+                    "spill fault produced a %d (want 413/503)" % e.code
+                burned += 1
+        else:
+            raise SystemExit("tiled query never recovered from the "
+                             "spill.write fault burst")
+        divergences = 0
+        budget_bytes = (1 + 64) * 2**20
+        for i in range(max(rounds, 5)):
+            for start, end in ((BASE, BASE + span),
+                               (BASE + 512 * i, BASE + span)):
+                a = q(port, start, end)
+                b = q(port + 1, start, end)
+                if a != b:
+                    divergences += 1
+                    print("[spill] round %d DIVERGED on [%d, %d]"
+                          % (i, start, end), flush=True)
+            scrape = _prom_scrape(port)
+            resident = _prom_sum(scrape, "tsd_query_spill_bytes")
+            if resident > budget_bytes:
+                print("[spill] pool bytes %d exceed the %d budget"
+                      % (resident, budget_bytes), flush=True)
+                raise SystemExit(1)
+            # ingest between rounds, inside the queried window
+            assert http_put(port, points(100 + i, 103 + i, salt=i + 1))
+            assert http_put(port + 1, points(100 + i, 103 + i,
+                                             salt=i + 1))
+        if divergences:
+            print("[spill] %d diverged answers vs the resident control"
+                  % divergences, flush=True)
+            raise SystemExit(1)
+        scrape = _prom_scrape(port)
+        tiles = _prom_sum(scrape, "tsd_query_spill_tiles_total")
+        disk = (_prom_sum(scrape, "tsd_query_spill_evictions_total")
+                + sum(v for labels, v in scrape.get(
+                    "tsd_query_spill_spills_total", {}).items()
+                    if "disk" in labels))
+        if tiles <= 0:
+            print("[spill] tiled path never engaged (tiles=%r)"
+                  % tiles, flush=True)
+            raise SystemExit(1)
+        if disk <= 0:
+            print("[spill] disk tier never engaged (evictions/spills "
+                  "all host)", flush=True)
+            raise SystemExit(1)
+        print("[spill] %d rounds, zero divergence, %d tiles, %d disk "
+              "demotions, %d faulted attempts healed"
+              % (max(rounds, 5), int(tiles), int(disk), burned),
+              flush=True)
+    finally:
+        for proc in (prim, ctrl):
+            proc.send_signal(signal.SIGTERM)
+            proc.wait()
+
+
 def _prom_scrape(port: int, timeout: float = 10.0) -> dict:
     """Parse /api/stats/prometheus into {name: {label_str: value}}."""
     text = urllib.request.urlopen(
@@ -817,6 +951,14 @@ def main():
                          "repeat/sliding load with ingest running, "
                          "show a nonzero agg hit rate, and heal after "
                          "a WAL-site fault burst")
+    ap.add_argument("--spill", action="store_true",
+                    help="run the out-of-core tiling stage: a tiled "
+                         "TSD (tiny state budget, disk-backed spill "
+                         "pool) must answer byte-identical to a "
+                         "resident-capable control under long-range "
+                         "group-by load with ingest running, keep the "
+                         "pool bytes bounded, and heal after an "
+                         "injected spill.write disk-full fault")
     ap.add_argument("--overload", action="store_true",
                     help="run the admission-gate overload stage: "
                          "saturating load + an injected slow-handler "
@@ -836,10 +978,13 @@ def main():
         run_autotune_stage(args.port + 2, args.rounds)
     if args.cache:
         run_cache_stage(args.port + 5, args.rounds)
+    if args.spill:
+        run_spill_stage(args.port + 7, args.rounds)
     if args.stages_only:
-        if not (args.overload or args.autotune or args.cache):
-            ap.error("--stages-only needs --overload, --autotune "
-                     "and/or --cache")
+        if not (args.overload or args.autotune or args.cache
+                or args.spill):
+            ap.error("--stages-only needs --overload, --autotune, "
+                     "--cache and/or --spill")
         print("chaos soak stages PASSED (standard phases skipped: "
               "--stages-only)", flush=True)
         return

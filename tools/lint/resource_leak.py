@@ -61,7 +61,10 @@ LEAK_DIRS = ("opentsdb_tpu/tsd/", "opentsdb_tpu/storage/",
              "opentsdb_tpu/obs/")
 
 ACQUIRE_NAMES = {"open", "ThreadPoolExecutor", "ProcessPoolExecutor",
-                 "Popen"}
+                 "Popen",
+                 # spill-pool tier files (storage/spill.py): every
+                 # handle must close or transfer ownership to the pool
+                 "open_spill_file"}
 ACQUIRE_ATTRS = {
     ("socket", "socket"), ("socket", "create_connection"),
     ("subprocess", "Popen"), ("gzip", "open"), ("bz2", "open"),
@@ -69,6 +72,8 @@ ACQUIRE_ATTRS = {
     ("tempfile", "NamedTemporaryFile"), ("tempfile", "TemporaryFile"),
     # span starts: obs/trace.py's non-context-manager stage API
     ("obs_trace", "begin"), ("trace", "begin"),
+    # spill files opened through the module alias
+    ("spill", "open_spill_file"),
 }
 # method names that mint a new Span on ANY receiver (Span.child /
 # Trace.current().child — the receiver varies, the contract doesn't)
