@@ -16,7 +16,9 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
   query/     tag filters, planner, expressions            (ref: src/query/, src/core/TsdbQuery.java)
   parallel/  device mesh, shard_map pipelines             (ref: src/core/SaltScanner.java fan-out)
   tsd/       HTTP + telnet API surface                    (ref: src/tsd/)
-  rollup/    rollup config/ingest/read                    (ref: src/rollup/)
+  rollup/    rollup config/ingest/read (write-side API)   (ref: src/rollup/)
+             storage/rollup.py holds the internal half:
+             maintenance-built rollup LANES (docs/rollup.md)
   meta/      annotations, TSMeta/UIDMeta                  (ref: src/meta/)
   search/    lookup + search plugin                       (ref: src/search/)
   tree/      hierarchical namespace                       (ref: src/tree/)

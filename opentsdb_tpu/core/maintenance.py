@@ -44,6 +44,7 @@ class MaintenanceThread(threading.Thread):
         self.snapshot_interval = cfg.get_int(
             "tsd.storage.snapshot_interval")
         self.stats_interval = cfg.get_int("tsd.stats.interval")
+        self.rollup_interval = cfg.get_int("tsd.rollup.interval")
         self._stop_event = threading.Event()
         self._next_flush = time.monotonic() + self.flush_interval
         self._next_sync = time.monotonic() + max(self.wal_sync_interval, 1)
@@ -51,7 +52,11 @@ class MaintenanceThread(threading.Thread):
             self.snapshot_interval, 1)
         self._next_self_report = time.monotonic() + max(
             self.stats_interval, 1)
+        self._next_rollup = time.monotonic() + max(
+            self.rollup_interval, 1)
         self.flush_passes = 0
+        self.rollup_passes = 0
+        self.rollup_blocks_built = 0
         self.wal_syncs = 0
         self.snapshots = 0
         self.snapshot_errors = 0
@@ -73,6 +78,7 @@ class MaintenanceThread(threading.Thread):
                 self._maybe_refresh_device_cache()
                 self._maybe_self_report(now)
                 self._maybe_autotune(now)
+                self._maybe_rollup(now)
             except Exception:
                 LOG.exception("maintenance pass failed")
 
@@ -151,6 +157,20 @@ class MaintenanceThread(threading.Thread):
         if calibrator is not None and calibrator.tick(now):
             self.autotune_passes += 1
 
+    def _maybe_rollup(self, now: float) -> None:
+        """tsd.rollup.interval cadence: one rollup-lane maintenance
+        pass (storage/rollup.py refresh — Storyboard selection under
+        the byte budget, then block builds over the demanded ranges,
+        with the spill pool bounding over-wall builds)."""
+        lanes = getattr(self.tsdb, "rollup_lanes", None)
+        if lanes is None or self.rollup_interval <= 0 \
+                or now < self._next_rollup:
+            return
+        self._next_rollup = now + self.rollup_interval
+        built = lanes.refresh(self.tsdb.store)
+        self.rollup_passes += 1
+        self.rollup_blocks_built += built
+
     def _maybe_snapshot(self, now: float) -> None:
         if self.snapshot_interval <= 0 or now < self._next_snapshot:
             return
@@ -178,4 +198,7 @@ class MaintenanceThread(threading.Thread):
             "tsd.maintenance.self_report_errors": self.self_report_errors,
             "tsd.maintenance.self_report_points": self.self_report_points,
             "tsd.maintenance.autotune_passes": self.autotune_passes,
+            "tsd.maintenance.rollup_passes": self.rollup_passes,
+            "tsd.maintenance.rollup_blocks_built":
+                self.rollup_blocks_built,
         }

@@ -111,6 +111,21 @@ class TSDB:
                 directory=self.config.get_string("tsd.query.spill.dir")
                 or None)
             if self.config.get_bool("tsd.query.spill.enable") else None)
+        # rollup lanes (ROADMAP item 2): maintenance-built coarse-
+        # interval aggregate lanes (mergeable sum/count/min/max
+        # partials) serve any fixed-interval query whose interval is a
+        # multiple of a lane EXACTLY, in front of the agg-cache/tiled/
+        # streamed exact paths; ingest-side invalidation rides the same
+        # write-then-mark listener contract as the agg cache
+        from opentsdb_tpu.storage.rollup import RollupLanes
+        self.rollup_lanes = (RollupLanes(self.config)
+                             if self.config.get_bool("tsd.rollup.enable")
+                             else None)
+        if self.rollup_lanes is not None:
+            lanes = self.rollup_lanes
+            self.store.add_mutation_listener(
+                lambda metric, lo, hi: lanes.note_mutation(
+                    metric, lo, hi))
         from opentsdb_tpu.rollup import RollupConfig, RollupStore
         self.rollup_config = RollupConfig.from_config(self.config)
         self.rollup_store = (
@@ -939,6 +954,8 @@ class TSDB:
             out.update(self.device_cache.collect_stats())
         if self.agg_cache is not None:
             out.update(self.agg_cache.collect_stats())
+        if self.rollup_lanes is not None:
+            out.update(self.rollup_lanes.collect_stats())
         return out
 
     @staticmethod

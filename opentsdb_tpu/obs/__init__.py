@@ -263,6 +263,58 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "gauge", (), "Aggregate-block host-tier resident bytes."),
     "tsd.query.agg_cache.device_bytes": _m(
         "gauge", (), "Aggregate-block device-tier resident bytes."),
+    # -- rollup lanes (storage/rollup.py): registry families ----------- #
+    "tsd.rollup.lane.hits": _m(
+        "counter", ("lane",),
+        "Plans answered from a rollup lane, by lane interval."),
+    "tsd.rollup.lane.misses": _m(
+        "counter", ("reason",),
+        "Lane-eligible plans that fell back to the exact paths, by "
+        "reason (cold, striping)."),
+    "tsd.rollup.lane.builds": _m(
+        "counter", ("lane",),
+        "Lane blocks materialized from the memstore by the "
+        "maintenance thread, by lane interval."),
+    "tsd.rollup.lane.build_errors": _m(
+        "counter", (),
+        "Lane block builds that raised (caught + counted; retried "
+        "next pass)."),
+    "tsd.rollup.lane.evictions": _m(
+        "counter", (),
+        "Lane blocks evicted by the tsd.rollup.mb LRU."),
+    "tsd.rollup.lane.invalidations": _m(
+        "counter", (),
+        "Rollup-lane invalidation marks (ingest dirty ranges, "
+        "dropcaches)."),
+    "tsd.rollup.lane.bytes": _m(
+        "gauge", (),
+        "Rollup-lane store resident bytes (tsd.rollup.mb budget)."),
+    "tsd.rollup.lane.blocks": _m(
+        "gauge", (), "Rollup-lane blocks resident."),
+    # -- rollup-lane stats walk (storage/rollup.py collect_stats ->     #
+    #    /api/stats + prometheus gauges) ------------------------------- #
+    "tsd.query.rollup.hits": _m(
+        "gauge", (), "Plans served from rollup lanes."),
+    "tsd.query.rollup.misses": _m(
+        "gauge", (), "Lane-eligible plans that fell back."),
+    "tsd.query.rollup.builds": _m(
+        "gauge", (), "Lane blocks materialized."),
+    "tsd.query.rollup.build_errors": _m(
+        "gauge", (), "Lane block builds that raised."),
+    "tsd.query.rollup.blocks": _m(
+        "gauge", (), "Lane blocks resident."),
+    "tsd.query.rollup.bytes": _m(
+        "gauge", (), "Lane store resident bytes."),
+    "tsd.query.rollup.evictions": _m(
+        "gauge", (), "Lane blocks evicted (byte-budget LRU)."),
+    "tsd.query.rollup.invalidations": _m(
+        "gauge", (), "Lane invalidation marks recorded."),
+    "tsd.query.rollup.served_windows": _m(
+        "gauge", (), "Downsample windows answered from lane cells."),
+    "tsd.query.rollup.demand_entries": _m(
+        "gauge", (),
+        "Tracked (metric, lane) demand candidates (the Storyboard "
+        "selection corpus)."),
     # -- device cache (storage/device_cache.py collect_stats, mirrored  #
     #    by obs/jaxprof.py update_device_gauges) ----------------------- #
     "tsd.query.device_cache.hits": _m(

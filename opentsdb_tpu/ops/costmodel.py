@@ -128,6 +128,14 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "spill_write_mb": 6.0e-4,
         "spill_read_mb": 4.0e-4,
         "tile_dispatch": 1.5e-3,
+        # rollup lanes (storage/rollup.py): host-side lane-cell
+        # assembly+re-reduce seconds per MB of cells touched, and the
+        # per-(series, cell) cost of a maintenance block build (the
+        # Storyboard selection prices build amortization with it).
+        # ESTIMATES until the fitter sees lane traffic; a bad constant
+        # skews which lanes materialize, never an answer.
+        "lane_assemble_mb": 2.5e-4,
+        "lane_build_cell": 2.0e-9,
     },
     "cpu": {
         "gather_round": 2.0e-8,
@@ -155,6 +163,9 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         "spill_write_mb": 4.0e-4,
         "spill_read_mb": 3.0e-4,
         "tile_dispatch": 3.0e-4,
+        # rollup lanes: same host memcpy either platform
+        "lane_assemble_mb": 2.5e-4,
+        "lane_build_cell": 2.0e-9,
     },
 }
 
@@ -557,3 +568,41 @@ def predict_tiled(s: int, w: int, g: int, n_tiles: int, n_stripes: int,
     dispatches) on top of the plan's ordinary compute prediction."""
     return _dot(features_tiled(s, w, g, n_tiles, n_stripes, spill_bytes,
                                dispatches), platform)
+
+
+# -- rollup lanes (storage/rollup.py) ---------------------------------- #
+
+# bytes per lane cell (sum f64 + count i32 + min f64 + max f64),
+# mirrored from storage.rollup.LANE_CELL_BYTES without the import
+# (storage stays numpy-only; a drift is a wrong estimate, not a wrong
+# answer)
+_LANE_CELL_BYTES = 28
+
+
+def features_lane(s: int, w: int, k: int) -> dict[str, float]:
+    """Unit counts for serving one [s series, w windows] grid from a
+    rollup lane: the host assembly + k-cell re-reduce touches
+    s * w * k cells.  The downsample/scan of the raw points — the term
+    a lane hit ELIMINATES — is deliberately absent; the caller adds
+    the tail stages (rate/group/aggregate) from the same
+    stage_breakdown either side pays.  Linear in the constants:
+    ``predict_lane == dot(features_lane, costs)``."""
+    mb = s * w * max(k, 1) * _LANE_CELL_BYTES / 2.0 ** 20
+    return {"lane_assemble_mb": mb}
+
+
+def predict_lane(s: int, w: int, k: int, platform: str) -> float:
+    """Predicted seconds of the lane-serve assembly for [s, w] at k
+    cells per window."""
+    return _dot(features_lane(s, w, k), platform)
+
+
+def features_lane_build(s: int, cells: int) -> dict[str, float]:
+    """Unit counts for one maintenance block build over s series x
+    `cells` lane cells (the Storyboard selection's amortization
+    side)."""
+    return {"lane_build_cell": float(s * max(cells, 1))}
+
+
+def predict_lane_build(s: int, cells: int, platform: str) -> float:
+    return _dot(features_lane_build(s, cells), platform)

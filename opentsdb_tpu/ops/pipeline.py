@@ -187,9 +187,45 @@ def _downsample_grid(step: DownsampleStep, ts, val, mask, wargs):
                       wargs, step.fill_policy, step.fill_value)
 
 
+def _lane_partials(spec: WindowSpec, ts, val, mask, wargs):
+    """Mergeable per-(series, window) partials — the rollup-lane block
+    builder (storage/rollup.py): one dispatch computes the sum, count,
+    min and max of every cell, the four moments every lane-derivable
+    downsample re-reduces from exactly.  Mirrors the segment path of
+    ops.downsample.downsample cell-for-cell (same window ids, same
+    NaN-skip rule, float64 accumulation), so a lane-derived window is
+    bit-identical to the raw kernel's on integer data.  Empty cells
+    hold (0, 0, +inf, -inf) — the mergeable identities — and mask
+    derives as count > 0 at serve time."""
+    s, n = ts.shape
+    w = spec.count
+    num = s * w + 1
+    vf = val.astype(jnp.float64)
+    nwin = wargs["nwin"]
+    from opentsdb_tpu.ops.downsample import window_ids
+    win = window_ids(ts, spec, wargs)
+    valid = mask & (win >= 0) & (win < nwin.astype(win.dtype))
+    rows = jnp.arange(s, dtype=jnp.int64)[:, None]
+    seg = jnp.where(valid, rows * w + jnp.clip(win, 0, w - 1), s * w)
+    seg = seg.reshape(-1)
+    flat = vf.reshape(-1)
+    ok = valid.reshape(-1) & ~jnp.isnan(flat)
+    seg = jnp.where(ok, seg, s * w)
+    counts = jax.ops.segment_sum(ok.astype(jnp.int32), seg,
+                                 num_segments=num)[:-1].reshape(s, w)
+    sums = jax.ops.segment_sum(jnp.where(ok, flat, 0.0), seg,
+                               num_segments=num)[:-1].reshape(s, w)
+    mins = jax.ops.segment_min(jnp.where(ok, flat, jnp.inf), seg,
+                               num_segments=num)[:-1].reshape(s, w)
+    maxs = jax.ops.segment_max(jnp.where(ok, flat, -jnp.inf), seg,
+                               num_segments=num)[:-1].reshape(s, w)
+    return sums, counts, mins, maxs
+
+
 _jitted_group = jax.jit(_group_pipeline, static_argnums=(0, 1))
 _jitted_grid_tail = jax.jit(_grid_tail, static_argnums=(0, 1))
 _jitted_downsample_grid = jax.jit(_downsample_grid, static_argnums=0)
+_jitted_lane_partials = jax.jit(_lane_partials, static_argnums=0)
 
 
 def run_grid_tail(spec: PipelineSpec, wts, v, m, gid, num_groups: int):
@@ -201,6 +237,13 @@ def run_grid_tail(spec: PipelineSpec, wts, v, m, gid, num_groups: int):
 def run_downsample_grid(step: DownsampleStep, ts, val, mask, wargs: dict):
     """One downsample-only dispatch -> (wts[W], v[S, W], mask[S, W])."""
     return _jitted_downsample_grid(step, ts, val, mask, wargs)
+
+
+# shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool
+def run_lane_partials(spec: WindowSpec, ts, val, mask, wargs: dict):
+    """One lane-partials dispatch -> (sum[S, W] f64, count[S, W] i32,
+    min[S, W] f64, max[S, W] f64) — the rollup-lane block builder."""
+    return _jitted_lane_partials(spec, ts, val, mask, wargs)
 
 
 # shape: ts[S,N] any, val[S,N] any, mask[S,N] bool, gid[S] any

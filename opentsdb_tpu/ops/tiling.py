@@ -219,6 +219,66 @@ _jitted_tile_contrib = jax.jit(_tile_contrib, static_argnums=0)
 _jitted_presence = jax.jit(_group_presence, static_argnums=0)
 
 
+# Cross-series aggregators whose group reduce folds tile-by-tile into
+# [G, W] partial moments (sum/count for the additive family, min/max
+# for the extremes) — the same partial-moment decomposition
+# moment_group_reduce's combine_* hooks use across mesh shards.
+# Everything else (dev's two-pass, rank/order aggs) needs all rows at
+# once and keeps the spill-pool stripe replay.
+LANE_FOLDABLE = frozenset({"sum", "zimsum", "count", "avg",
+                           "min", "mimmin", "max", "mimmax"})
+
+
+def _lane_fold(spec, num_groups: int, extreme: bool, wts, v, m, gid):
+    """One tile's [G, W] partial group moments from its finished grid.
+
+    Runs the SAME row-local contribution step as the stripe replay
+    (_tile_contrib: rate + interpolation/participation), then reduces
+    this tile's rows straight to per-(group, window) partials — sum +
+    count (additive) or min/max + count (extremes) plus the
+    actual-value presence the out-mask derives from.  Partials merge
+    across tiles by +/min/max/| and one host-side finish reproduces
+    moment_group_reduce's arithmetic on identical operands, so the
+    fold is exact (bitwise on integer data) while the full [S, W]
+    grid never exists on the device."""
+    from opentsdb_tpu.ops.group_agg import _seg_dtype
+    contrib, participate, actual = _tile_contrib(spec, wts, v, m)
+    s, w = contrib.shape
+    num = num_groups * w
+    dt = _seg_dtype(num + w)
+    cols = jnp.arange(w, dtype=dt)[None, :]
+    seg = (gid.astype(dt)[:, None] * w + cols).reshape(-1)
+    vf = contrib.astype(jnp.float64)
+    flat = vf.reshape(-1)
+    ok2 = (participate & ~jnp.isnan(vf)).reshape(-1)
+    cnt = jax.ops.segment_sum(ok2.astype(jnp.int32), seg,
+                              num_segments=num).reshape(num_groups, w)
+    present = jax.ops.segment_sum(
+        actual.reshape(-1).astype(jnp.int32), seg,
+        num_segments=num).reshape(num_groups, w)
+    if extreme:
+        lo = jax.ops.segment_min(jnp.where(ok2, flat, jnp.inf), seg,
+                                 num_segments=num
+                                 ).reshape(num_groups, w)
+        hi = jax.ops.segment_max(jnp.where(ok2, flat, -jnp.inf), seg,
+                                 num_segments=num
+                                 ).reshape(num_groups, w)
+        return lo, hi, cnt, present
+    tot = jax.ops.segment_sum(jnp.where(ok2, flat, 0.0), seg,
+                              num_segments=num).reshape(num_groups, w)
+    return tot, cnt, present
+
+
+_jitted_lane_fold = jax.jit(_lane_fold, static_argnums=(0, 1, 2))
+
+
+def run_lane_fold(spec, num_groups: int, extreme: bool, wts, v, m,
+                  gid_tile):
+    """One tile's partial group moments (see _lane_fold)."""
+    return _jitted_lane_fold(spec, num_groups, extreme, wts, v, m,
+                             gid_tile)
+
+
 # --------------------------------------------------------------------- #
 # Executor                                                               #
 # --------------------------------------------------------------------- #
@@ -307,13 +367,20 @@ def _stream_tile(tsdb, seg, tile_series, window_spec, wargs, lanes,
 
 def run_tiled(tsdb, spec, seg, series_list, gid, g_pad: int, window_spec,
               wargs, ds_function: str, lanes, sketch: bool, fix: bool,
-              plan: TilePlan, budget, store=None):
+              plan: TilePlan, budget, store=None, tile_grid_fn=None):
     """Execute an over-budget grouped downsample plan tiled.
 
     Returns ((out_ts, out_val[g_pad, W], out_mask[g_pad, W]) as numpy,
     stats dict for the span annotation).  Every spilled entry is
     released on every exit path; a pool failure surfaces as the 413/503
-    query contract, never a leak."""
+    query contract, never a leak.
+
+    ``tile_grid_fn(row_lo, row_hi) -> (wts[W], v[S_tile, W],
+    m[S_tile, W])`` substitutes the tile's finished downsample grid for
+    the streamed build — the rollup-lane executor (storage/rollup.py)
+    serves over-budget plans through the SAME spill + window-striped
+    tail replay with grids derived from lane partials instead of raw
+    points."""
     from opentsdb_tpu.obs.registry import REGISTRY
     from opentsdb_tpu.query.limits import QueryException
     from opentsdb_tpu.storage.spill import SpillError, SpillWriteError
@@ -337,10 +404,14 @@ def run_tiled(tsdb, spec, seg, series_list, gid, g_pad: int, window_spec,
     try:
         for t_i, (lo, hi) in enumerate(tile_bounds):
             budget.check_deadline()
-            (wts, v, m), n_chunks = _stream_tile(
-                tsdb, seg, series_list[lo:hi], window_spec, wargs,
-                lanes, sketch, fix, store, ds_function,
-                step.fill_policy, step.fill_value)
+            if tile_grid_fn is not None:
+                wts, v, m = tile_grid_fn(lo, hi)
+                n_chunks = 1
+            else:
+                (wts, v, m), n_chunks = _stream_tile(
+                    tsdb, seg, series_list[lo:hi], window_spec, wargs,
+                    lanes, sketch, fix, store, ds_function,
+                    step.fill_policy, step.fill_value)
             chunks_total += n_chunks
             contrib, participate, actual = _jitted_tile_contrib(
                 spec, wts, v, m)

@@ -548,7 +548,20 @@ class QueryRunner:
         tiled_plan = None
         gbd = (streaming_budget_decision() if would_stream
                else grid_budget_decision())
-        if gbd.over:
+        # Rollup-lane consult (storage/rollup.py, ROADMAP item 2): THE
+        # shared fast-path hook the PR 9 and PR 10 rollup TODOs both
+        # resolve into — the over-budget (tiled) decision below and
+        # the resident agg-cache/device-cache chain consume ONE
+        # verdict instead of growing two fresh lane branches.  A
+        # fixed-interval plan whose interval is an integer multiple of
+        # a materialized lane and whose downsample function is
+        # lane-derivable answers EXACTLY from the lane's mergeable
+        # partials; everything else falls through unchanged.
+        lane_plan = self._consult_rollup_lanes(
+            psp, seg, sub, windows, window_spec, store, series_list,
+            gid, g_pad, ds_fn, use_mesh, total_points,
+            max(max(c) for _, _, c in kept))
+        if gbd.over and lane_plan is None:
             tiled_plan = self._maybe_tiled(
                 gbd, seg, len(gid), window_spec, g_pad, ds_fn,
                 sketchable, stream_ok, total_points)
@@ -567,13 +580,15 @@ class QueryRunner:
         # into one answer.
         from opentsdb_tpu.ops.hostlane import (cpu_device,
                                                execution_platform)
-        lane_small = (tiled_plan is None and not use_mesh
+        lane_small = (tiled_plan is None and lane_plan is None
+                      and not use_mesh
                       and not would_stream
                       and 0 < total_points <= tsdb.config.get_int(
                           "tsd.query.host_lane.max_points")
                       and cpu_device() is not None)
         agg_plan = None
-        if (tiled_plan is None and tsdb.agg_cache is not None
+        if (tiled_plan is None and lane_plan is None
+                and tsdb.agg_cache is not None
                 and not would_stream
                 and not use_mesh and seg.kind == "raw"
                 and store is tsdb.store
@@ -586,7 +601,8 @@ class QueryRunner:
                 max(max(c) for _, _, c in kept), g_pad,
                 bool(sub.rate), total_points=int(total_points))
             obs_trace.annotate(psp, agg_cache=agg_note)
-        if (tiled_plan is None and agg_plan is None
+        if (tiled_plan is None and lane_plan is None
+                and agg_plan is None
                 and tsdb.device_cache is not None
                 and store is not None
                 and seg.kind in ("raw", "rollup")):
@@ -632,7 +648,21 @@ class QueryRunner:
             self.exec_stats["hostLane"] = 1.0
         from opentsdb_tpu.ops.hostlane import host_lane
 
-        if tiled_plan is not None:
+        if lane_plan is not None:
+            # Standing fast path: serve the downsample grid from the
+            # rollup lane's mergeable partials (storage/rollup.py) —
+            # the raw points are never fetched, never streamed.  Exact
+            # by derivation; annotated on the span's `rollup` tag; the
+            # calibration ring skips lane-served executions like
+            # rewrites/tiled runs (the monolithic stage breakdown does
+            # not describe them).
+            out_ts, out_val, out_mask = self._run_lane_serve(
+                spec, seg, lane_plan, series_list, gid, g_pad, windows,
+                window_spec, budget, fix, psp)
+            self.exec_stats["rollupLane"] = 1.0
+            if lane_plan.striped:
+                self.exec_stats["rollupLaneStriped"] = 1.0
+        elif tiled_plan is not None:
             # Out-of-core: series-tiled streaming with partial-grid
             # spill, window-striped tail replay (ops/tiling.py).  The
             # decision + pool traffic ride the span's `tiling` tag; the
@@ -713,11 +743,13 @@ class QueryRunner:
 
         if psp is not None:
             obs_trace.device_wait(psp, (out_ts, out_val, out_mask))
-            if agg_plan is None and tiled_plan is None:
-                # rewritten AND tiled segments skip the predicted-vs-
-                # actual ledger: the monolithic stage breakdown does
-                # not describe a block-decomposed or tiled execution,
-                # and pairing its prediction with a partial actual
+            if agg_plan is None and tiled_plan is None \
+                    and lane_plan is None:
+                # rewritten, tiled AND lane-served segments skip the
+                # predicted-vs-actual ledger: the monolithic stage
+                # breakdown does not describe a block-decomposed,
+                # tiled, or lane-derived execution, and pairing its
+                # prediction with a partial actual
                 # would poison the calibration ring
                 self._trace_pipeline_stages(
                     psp, sub, seg, len(gid),
@@ -1006,6 +1038,321 @@ class QueryRunner:
             self.exec_stats["tiledRefused"] = 1.0
             raise gbd.exception()
         return plan
+
+    def _consult_rollup_lanes(self, psp, seg, sub, windows, window_spec,
+                              store, series_list, gid, g_pad: int,
+                              ds_fn: str, use_mesh: bool,
+                              total_points: int, n_max: int):
+        """THE shared rollup-lane consult hook (the PR 9 / PR 10 TODO
+        sites resolved): one eligibility gate + one ``RollupLanes.plan``
+        verdict consumed by BOTH fast-path consult points — the
+        over-budget tiled decision and the resident cache chain.
+
+        Returns a LanePlan (possibly striped for over-budget grids) or
+        None; the lane decision is annotated on the pipeline span
+        either way (PR 6 contract)."""
+        tsdb = self.tsdb
+        lanes = getattr(tsdb, "rollup_lanes", None)
+        if (lanes is None or seg.kind != "raw"
+                or store is not tsdb.store or use_mesh
+                or not series_list
+                or not isinstance(windows, FixedWindows)):
+            return None
+        from opentsdb_tpu.ops.hostlane import execution_platform
+        plan, note = lanes.plan(
+            series_list[0].key.metric, series_list, windows,
+            seg.start_ms, seg.end_ms, ds_fn, execution_platform(),
+            len(gid), int(n_max), g_pad, bool(sub.rate),
+            total_points=int(total_points))
+        if plan is not None:
+            # residency: the assembled [S, Wp] grid against the SAME
+            # shared device-state allowance every other path honors
+            # (~3 grid lanes live through the tail dispatch)
+            from opentsdb_tpu.query.limits import grid_budget
+            state_mb = tsdb.config.get_int(
+                "tsd.query.streaming.state_mb")
+            gbd = grid_budget("grid", state_mb,
+                              len(gid) * window_spec.count * 24,
+                              len(gid), window_spec.count)
+            if gbd.over:
+                plan = self._size_lane_stripes(plan, len(gid),
+                                               window_spec, g_pad,
+                                               state_mb,
+                                               sub.aggregator)
+                if plan is None:
+                    note = dict(note, decision="fallback",
+                                reason="striping_unavailable")
+                    lanes.note_striping_fallback()
+            if plan is not None:
+                lanes.note_served(plan)
+        obs_trace.annotate(psp, rollup=note)
+        return plan
+
+    def _size_lane_stripes(self, plan, s: int, window_spec, g_pad: int,
+                           state_mb: int, aggregator: str):
+        """Attach an over-budget serve sizing to a lane plan.
+
+        Moment-decomposable cross-series aggregators fold tile by tile
+        into [G, W] partial moments (no pool needed — only the tile
+        split is sized here); everything else reuses the PR 10
+        spill-pool stripe replay and additionally requires the pool to
+        hold the partials.  None -> the caller falls back to the
+        tiled-exact/413 path."""
+        from opentsdb_tpu.ops import tiling
+        tp = tiling.size_tiles(
+            s, window_spec.count, state_mb * 2 ** 20, 9, g_pad,
+            self.tsdb.config.get_int("tsd.query.spill.max_tiles"),
+            chunks_per_tile=1)
+        if tp is None:
+            return None
+        fold_ok = (aggregator in tiling.LANE_FOLDABLE
+                   and 5 * g_pad * window_spec.count * 8
+                   <= state_mb * 2 ** 20)
+        if not fold_ok:
+            pool = getattr(self.tsdb, "spill_pool", None)
+            if pool is None:
+                return None
+            entry_bytes = tp.tile_rows * tp.stripe_w \
+                * tiling.SPILL_CELL_BYTES
+            if tp.spill_bytes + entry_bytes \
+                    > pool.host_budget + pool.disk_budget:
+                return None
+        plan.striped = True
+        plan.tile_plan = tp
+        plan.decision["striped"] = True
+        return plan
+
+    def _run_lane_serve(self, spec, seg, plan, series_list, gid,
+                        g_pad: int, windows, window_spec,
+                        budget, fix: bool, psp):
+        """Serve a lane-derivable plan from materialized rollup lanes.
+
+        Interior full windows re-reduce from the lane's mergeable
+        partials (storage/rollup.py derive_grid — exact; bitwise vs
+        the raw kernel on integer data); the <= 2 edge windows with
+        partial point populations recompute from raw points via the
+        SAME downsample-only program the agg cache's delta pieces use;
+        the assembled [S, Wp] grid runs the shared tail.  Over-budget
+        grids reuse the PR 10 spill pool's window-striped tail replay
+        with lane-derived tile grids (run_tiled tile_grid_fn)."""
+        import jax.numpy as jnp
+        from opentsdb_tpu.ops.downsample import (FILL_NONE, FILL_SCALAR,
+                                                 FILL_ZERO)
+        from opentsdb_tpu.ops.hostlane import cpu_device, host_lane
+        from opentsdb_tpu.ops.pipeline import (
+            DownsampleStep, build_batch_direct, run_downsample_grid,
+            run_grid_tail)
+        tsdb = self.tsdb
+        ds_step = spec.downsample
+        ds_fn = ds_step.function
+        interval = windows.interval_ms
+        first = windows.first_window_ms
+        w = windows.count
+        wp = window_spec.count
+        s = len(series_list)
+        # windows the lane cannot serve: the <= 2 partial edge windows
+        edges = []
+        if plan.wf_lo > 0:
+            edges.append((0, seg.start_ms,
+                          min(first + interval - 1, seg.end_ms)))
+        if plan.wf_hi < w - 1:
+            edges.append((w - 1, first + (w - 1) * interval,
+                          seg.end_ms))
+        # the grid's padded-column content under this fill policy,
+        # mirroring apply_fill over non-live windows (values under a
+        # False mask are never consumed; matching them keeps the grid
+        # byte-comparable to the monolithic one)
+        if ds_step.fill_policy == FILL_NONE:
+            pad_val = np.nan
+        elif ds_step.fill_policy == FILL_ZERO:
+            pad_val = 0.0
+        elif ds_step.fill_policy == FILL_SCALAR:
+            pad_val = float(ds_step.fill_value)
+        else:
+            pad_val = np.nan
+
+        def edge_cols(row_lo: int, row_hi: int):
+            """[(window idx, vals[rows, 1], mask[rows, 1])] computed
+            fresh from raw points — identical program to a cold run's."""
+            out = []
+            for (w_i, lo_ms, hi_ms) in edges:
+                ts, val, mask, _ = build_batch_direct(
+                    series_list[row_lo:row_hi], lo_ms, hi_ms, fix)
+                sub_win = FixedWindows(interval,
+                                       first + w_i * interval, 1)
+                wspec2, wargs2 = sub_win.split()
+                sub_step = DownsampleStep(ds_fn, wspec2,
+                                          ds_step.fill_policy,
+                                          ds_step.fill_value)
+                _wt, v, m = run_downsample_grid(sub_step, ts, val,
+                                                mask, wargs2)
+                out.append((w_i, np.asarray(v)[:, :1],
+                            np.asarray(m)[:, :1]))
+            return out
+
+        def assemble(row_lo: int, row_hi: int):
+            rows = row_hi - row_lo
+            v = np.full((rows, wp), pad_val, np.float64)
+            m = np.zeros((rows, wp), bool)
+            iv, im = tsdb.rollup_lanes.derive_grid(
+                plan, ds_fn, ds_step.fill_policy, ds_step.fill_value,
+                row_lo, row_hi)
+            v[:, plan.wf_lo:plan.wf_hi + 1] = iv
+            m[:, plan.wf_lo:plan.wf_hi + 1] = im
+            for (w_i, ev, em) in edge_cols(row_lo, row_hi):
+                v[:, w_i:w_i + 1] = ev
+                m[:, w_i:w_i + 1] = em
+            return v, m
+
+        wts = first + np.arange(wp, dtype=np.int64) * interval
+        budget.check_deadline()
+        if not plan.striped:
+            # small-grid fast lane: the serve's work is the [S, Wp]
+            # grid (the raw points are never touched), so host-lane
+            # eligibility keys on CELLS against the same threshold
+            # the point-count paths use
+            lane_host_small = (cpu_device() is not None
+                               and 0 < s * wp <= tsdb.config.get_int(
+                                   "tsd.query.host_lane.max_points"))
+            with host_lane(lane_host_small):
+                v_full, m_full = assemble(0, s)
+                out = run_grid_tail(spec, jnp.asarray(wts), v_full,
+                                    m_full, jnp.asarray(gid), g_pad)
+            if lane_host_small:
+                self.exec_stats["hostLane"] = 1.0
+            return out
+        # over-budget: the full [S, Wp] grid never goes to the device.
+        # Moment-decomposable cross-series aggregators FOLD tile by
+        # tile — each [S_tile, Wp] lane grid runs the row-local
+        # contribution step + a straight-to-[G, W] partial reduce on
+        # device, partials merge by +/min/max/| on the host, and one
+        # finish reproduces moment_group_reduce's arithmetic on
+        # identical operands (the mesh's combine_* decomposition
+        # applied to tiles).  Everything else (dev, rank/order aggs)
+        # keeps the PR 10 spill pool's window-striped tail replay:
+        # contributions are row-local over the FULL width, so tiles
+        # compute them on [S_tile, Wp] grids and the pool re-orders
+        # their stripes for the window-local tail.
+        from opentsdb_tpu.ops import tiling
+        tp = plan.tile_plan
+        agg_name = spec.aggregator
+        # one HOST assembly feeds every striped mode: lane cells are
+        # host-resident anyway, and [S, Wp] at 9 B/cell is smaller
+        # than the lane partials backing it (28 B/cell)
+        v_full, m_full = assemble(0, s)
+        gid_np = np.asarray(gid, np.int64)
+        extreme = agg_name in ("min", "mimmin", "max", "mimmax")
+        foldable = agg_name in tiling.LANE_FOLDABLE
+        # the device fold holds one tile's grid AND the [G, W]
+        # partial-moment outputs on device — it sizes its OWN tiles
+        # against what the budget leaves after the partials (the
+        # replay path's tile sizing reserves stripe space instead).
+        # The host-dense fold below holds NOTHING on device (pure
+        # numpy) and needs no budget at all.
+        budget_bytes = self.tsdb.config.get_int(
+            "tsd.query.streaming.state_mb") * 2 ** 20
+        fold_rows = (budget_bytes - 3 * g_pad * wp * 8) // (wp * 19)
+        fold_dev_ok = foldable and fold_rows >= 1
+        if foldable and spec.rate is None \
+                and bool(np.all(m_full[:, :w])):
+            # DENSE rate-free grid (every interior cell populated —
+            # the regular-cadence common case): grid_contributions is
+            # the identity (contrib == values, participate == mask,
+            # exactly — its own lax.cond fast lane) and there is no
+            # rate pass, so the per-tile device work degenerates to
+            # group-partial sums the host computes directly at memcpy
+            # speed.  Rate queries take the device fold below, whose
+            # _tile_contrib applies rate row-locally per tile.
+            # Arithmetic mirrors moment_group_reduce's finish on
+            # identical operands — bit-identical on integer data; gid
+            # is non-decreasing group runs (rows_sorted), so reduceat
+            # folds each run.
+            ok = m_full & ~np.isnan(v_full)
+            starts = np.flatnonzero(np.diff(gid_np, prepend=-1))
+            kg = len(starts)
+            cnt = np.zeros((g_pad, wp), np.int64)
+            present = np.zeros((g_pad, wp), np.int64)
+            cnt[:kg] = np.add.reduceat(ok.astype(np.int64), starts,
+                                       axis=0)
+            present[:kg] = np.add.reduceat(m_full.astype(np.int64),
+                                           starts, axis=0)
+            if extreme:
+                want_min = agg_name in ("min", "mimmin")
+                ident = np.inf if want_min else -np.inf
+                red = np.minimum.reduceat if want_min \
+                    else np.maximum.reduceat
+                out_val = np.full((g_pad, wp), ident, np.float64)
+                out_val[:kg] = red(np.where(ok, v_full, ident),
+                                   starts, axis=0)
+            elif agg_name == "count":
+                out_val = cnt.astype(np.float64)
+            elif agg_name == "avg":
+                tot = np.zeros((g_pad, wp), np.float64)
+                tot[:kg] = np.add.reduceat(
+                    np.where(ok, v_full, 0.0), starts, axis=0)
+                out_val = tot / np.maximum(cnt, 1)
+            else:
+                out_val = np.zeros((g_pad, wp), np.float64)
+                out_val[:kg] = np.add.reduceat(
+                    np.where(ok, v_full, 0.0), starts, axis=0)
+            if agg_name != "count":
+                out_val = np.where(cnt > 0, out_val, np.nan)
+            obs_trace.annotate(psp, rollup_fold="host_dense")
+            return wts, out_val, present > 0
+        if fold_dev_ok:
+            # holes in the grid: interpolation/participation must run
+            # (row-local, full-width) — fold tile by tile on device
+            # into [G, W] partial moments (the mesh's combine_*
+            # decomposition applied to tiles); merged partials finish
+            # with moment_group_reduce's arithmetic
+            cnt = np.zeros((g_pad, wp), np.int64)
+            present = np.zeros((g_pad, wp), np.int64)
+            tot = np.zeros((g_pad, wp), np.float64)
+            lo_acc = np.full((g_pad, wp), np.inf, np.float64)
+            hi_acc = np.full((g_pad, wp), -np.inf, np.float64)
+            wts_dev = jnp.asarray(wts)
+            fold_rows = min(int(fold_rows), s)
+            for t_lo in range(0, s, fold_rows):
+                t_hi = min(t_lo + fold_rows, s)
+                budget.check_deadline()
+                parts = tiling.run_lane_fold(
+                    spec, g_pad, extreme, wts_dev,
+                    v_full[t_lo:t_hi], m_full[t_lo:t_hi],
+                    jnp.asarray(gid_np[t_lo:t_hi]))
+                if extreme:
+                    plo, phi, pc, pp = (np.asarray(a) for a in parts)
+                    lo_acc = np.minimum(lo_acc, plo)
+                    hi_acc = np.maximum(hi_acc, phi)
+                else:
+                    pt, pc, pp = (np.asarray(a) for a in parts)
+                    tot += pt
+                cnt += pc
+                present += pp
+            safe = np.maximum(cnt, 1)
+            if extreme:
+                out_val = lo_acc if agg_name in ("min", "mimmin") \
+                    else hi_acc
+            elif agg_name == "count":
+                out_val = cnt.astype(np.float64)
+            elif agg_name == "avg":
+                out_val = tot / safe
+            else:
+                out_val = tot
+            if agg_name != "count":
+                out_val = np.where(cnt > 0, out_val, np.nan)
+            obs_trace.annotate(psp, rollup_fold=True)
+            return wts, out_val, present > 0
+
+        def tile_grid(row_lo: int, row_hi: int):
+            return (wts, v_full[row_lo:row_hi], m_full[row_lo:row_hi])
+
+        (out_ts, out_val, out_mask), tile_stats = tiling.run_tiled(
+            tsdb, spec, seg, series_list, gid, g_pad, window_spec,
+            {}, ds_fn, (), False, fix, plan.tile_plan, budget,
+            store=tsdb.store, tile_grid_fn=tile_grid)
+        obs_trace.annotate(psp, tiling=tile_stats)
+        self._bump("spillBytes", float(tile_stats["spillBytes"]))
+        return out_ts, out_val, out_mask
 
     def _stream_grouped(self, spec: PipelineSpec, seg, series_list,
                         max_len: int, gid, g_pad: int, window_spec, wargs,

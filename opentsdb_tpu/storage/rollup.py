@@ -1,0 +1,788 @@
+"""Rollup lanes: multi-resolution pre-aggregation as the standing fast path.
+
+ROADMAP item 2 (the rollup-lanes tentpole; the reference's src/rollup/
+layer re-thought for the columnar rebuild).  The write-side rollup
+store (rollup/store.py) accepts pre-aggregated points an EXTERNAL
+pipeline computed; this module is the missing internal half: a
+maintenance-thread subsystem that materializes coarse-interval
+aggregate lanes (1m/1h/1d, ``tsd.rollup.intervals``) FROM the memstore
+itself, so long-range dashboard queries stop re-reducing months of raw
+points on every load.
+
+The cached unit
+---------------
+
+One **lane block** = ``tsd.rollup.block_windows`` consecutive lane
+cells of one (metric, lane interval), aligned to the ABSOLUTE lane
+grid (block k covers cells [k*B, (k+1)*B) of the epoch-anchored grid),
+holding MERGEABLE PARTIALS per (series, cell): sum, count, min, max.
+Those four moments are closed under window coarsening, so any
+fixed-interval downsample whose interval is an integer multiple of a
+lane and whose function is lane-derivable answers EXACTLY from the
+lane — sum/zimsum re-reduce with sum, count with sum, min/max with
+min/max, and avg derives as (sum of sums) / (sum of counts), the same
+float64 division the raw kernel performs on identical operands.
+Non-derivable functions (percentiles, dev, first/last, moving
+averages) and non-multiple intervals provably fall back to the exact
+agg-cache/tiled/streamed paths; tests/test_rollup_lanes.py pins
+lane-served == exact-fallback BITWISE on integer data for every
+derivable function.
+
+Storyboard placement (arXiv:2002.03063) under ``tsd.rollup.mb``
+---------------------------------------------------------------
+
+Which (metric, lane) pairs to materialize is not static config: every
+eligible consult records a demand observation priced by the FITTED
+costmodel (the monolithic stage breakdown vs the lane-served
+prediction, ``ops.costmodel.predict_lane``), and the maintenance pass
+greedily selects candidates by saving-per-byte until the byte budget
+is spent — precompute-under-budget, with the budget enforced again at
+insert time by LRU eviction.
+
+Invalidation (incremental, on ingest)
+-------------------------------------
+
+Identical contract to the PR 9 agg cache: the memstore write path
+calls ``note_mutation`` AFTER each write lands (write-then-mark), the
+mark ring records (generation, range) per metric, and a block is valid
+only when no mark newer than its build generation overlaps its range —
+an acked write is never served stale (the planner falls back to the
+exact path until the maintenance thread rebuilds the dirty block).
+The ring is bounded; overflow raises the floor generation
+(conservatively invalidates older blocks, never serves stale).
+tsdblint's cache-coherence analyzer owns the contract: the blocks
+table is declared a ``rollup-lanes`` cache whose registered
+invalidator is ``invalidate`` (see the annotation above ``_blocks``)
+and gutting the invalidator fails the tree (pinned by
+tests/test_rollup_lanes.py).
+
+Past the HBM wall
+-----------------
+
+A block build is itself a grouped reduction and can exceed the
+``tsd.query.streaming.state_mb`` device budget (wide metrics x coarse
+lanes); builds then apply PR 10's bounded-working-set stance — the
+series axis splits into budget-sized tiles whose partial lanes land
+straight into the block's host arrays.  SERVING past the wall is
+where the PR 10 spill machinery is genuinely reused: over-budget
+lane grids either fold [G, W] partial moments tile-by-tile (the
+mesh's combine_* decomposition applied to tiles) or, for
+non-mergeable aggregators, replay lane-derived tile grids through
+the spill pool's window-striped tail (ops/tiling.py run_tiled
+``tile_grid_fn``) — see the planner's ``_run_lane_serve``.
+
+This module stays importable numpy-only (device work lives in
+ops/pipeline.py run_lane_partials; obs.jaxprof imports lazily).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from opentsdb_tpu.obs.registry import REGISTRY
+from opentsdb_tpu.utils import datetime_util as DT
+
+_LOG = logging.getLogger("rollup_lanes")
+
+# bytes per lane cell: sum f64 + count i32 + min f64 + max f64
+LANE_CELL_BYTES = 28
+
+# bound on retained (generation, range) dirty marks per metric —
+# overflow raises the floor generation (same stance as agg_cache)
+_MARK_RING = 512
+
+# bound on tracked demand candidates (stalest-first eviction)
+_DEMAND_MAX = 1024
+
+# hard cap on lane blocks one plan/coverage/refresh walk may touch —
+# a request-shaped range must never drive an unbounded loop (the
+# query's own windows are bounded by the budget guards downstream;
+# these walks run BEFORE them)
+_MAX_BLOCK_WALK = 65536
+
+# Downsample functions a lane answers exactly.  Aliases share their
+# canonical reduction: zimsum downsamples as sum, mimmin/mimmax as
+# min/max (ops/downsample.py PREFIX_AGGS / EXTREME_AGGS).
+DERIVABLE_DS = frozenset(
+    {"sum", "zimsum", "count", "avg", "min", "mimmin", "max", "mimmax"})
+
+# host batch-build cost per raw point (same figure the agg cache
+# charges) — what a lane hit SAVES includes never copying the points
+_HOST_BUILD_S_PER_POINT = 5e-9
+
+
+@dataclass
+class _LaneBlock:
+    """One materialized block: [S, B] mergeable partials per cell."""
+    metric: int
+    lane_ms: int
+    rows: dict               # Series object -> row index (identity keyed)
+    sums: np.ndarray         # [S, B] float64 (0.0 in empty cells)
+    counts: np.ndarray       # [S, B] int32 (0 in empty cells)
+    mins: np.ndarray         # [S, B] float64 (+inf in empty cells)
+    maxs: np.ndarray         # [S, B] float64 (-inf in empty cells)
+    gen: int                 # build generation (mark-ring validation)
+    lo_ms: int               # covered range [lo_ms, hi_ms] inclusive
+    hi_ms: int
+    nbytes: int = 0
+    hits: int = 0
+
+
+@dataclass
+class LanePlan:
+    """An executable lane-served decomposition handed to the planner."""
+    metric: int
+    lane: str                # configured lane label ("1h")
+    lane_ms: int
+    k: int                   # lane cells per query window
+    wf_lo: int               # first/last FULL window index in the grid
+    wf_hi: int
+    n_cells: int             # interior cells assembled
+    # (entry, rows[S] int64, c0, c1, dst_off): each block's own
+    # series->row index vector + the column slice it contributes
+    # (blocks built at different times may order rows differently)
+    segments: list = field(default_factory=list)
+    gen0: int = 0
+    decision: dict = field(default_factory=dict)
+    striped: bool = False    # over-budget: window-striped tail replay
+    tile_plan: object = None  # ops.tiling.TilePlan when striped
+
+
+class RollupLanes:
+    """Maintenance-built multi-resolution lane store + plan API."""
+
+    def __init__(self, config):
+        self.config = config
+        labels = [t.strip() for t in config.get_string(
+            "tsd.rollup.intervals").split(",") if t.strip()]
+        # (label, lane_ms), coarsest first — the widest lane that
+        # divides a query interval serves it with the fewest cells
+        self.lanes: list[tuple[str, int]] = sorted(
+            ((lb, DT.parse_duration(lb)) for lb in labels),
+            key=lambda p: -p[1])
+        if not self.lanes:
+            raise ValueError("tsd.rollup.intervals must name at least "
+                             "one lane interval")
+        bw = max(config.get_int("tsd.rollup.block_windows"), 8)
+        p = 8
+        while p < bw:
+            p <<= 1
+        self.block_windows = p
+        self.max_bytes = config.get_int("tsd.rollup.mb") * 2 ** 20
+        self.refresh_blocks = max(
+            config.get_int("tsd.rollup.refresh_blocks"), 1)
+        self.delay_ms = max(config.get_int("tsd.rollup.delay_ms"), 0)
+        self.fix_duplicates = config.fix_duplicates
+        self._lock = threading.Lock()
+        # the materialized lane blocks — THE backing store of this
+        # subsystem; (metric, lane_ms, block_idx) -> _LaneBlock, dict
+        # order = LRU recency (move-to-end on consult)
+        # cache: rollup-lanes invalidated-by: invalidate
+        self._blocks = {}  # guarded-by: _lock
+        # (metric) -> deque[(gen, lo_ms, hi_ms)] dirty marks
+        self._marks: dict[int, deque] = {}  # guarded-by: _lock
+        # metric -> floor generation (mark-ring overflow safety)
+        self._floor: dict[int, int] = {}  # guarded-by: _lock
+        self._gen = 0  # guarded-by: _lock
+        # newest generation any plan/build snapshotted (mark coalescing
+        # stops at it — see agg_cache's identical field)
+        self._planned_gen = 0  # guarded-by: _lock
+        # ingest fast path: until the FIRST build reads store data,
+        # note_mutation returns without the lock (sticky; written only
+        # under _lock, read without it — same reasoning as
+        # agg_cache._maybe_cached)
+        self._armed = False  # guarded-by: _lock (writes; reads race)
+        # (metric, lane_ms) -> demand record {n, saving_s, lo, hi,
+        # series, tick}: the Storyboard selection corpus
+        self._demand: dict[tuple, dict] = {}  # guarded-by: _lock
+        self._tick = 0  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        # stats (walked by TSDB.collect_stats)  # guarded-by: _lock
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+        self.build_errors = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.served_windows = 0
+
+    # -- metrics helpers -------------------------------------------------
+
+    def _set_gauges_locked(self) -> None:
+        REGISTRY.gauge(
+            "tsd.rollup.lane.bytes",
+            "Rollup-lane store resident bytes (tsd.rollup.mb budget)"
+        ).set(float(self._bytes))
+        REGISTRY.gauge(
+            "tsd.rollup.lane.blocks",
+            "Rollup-lane blocks resident").set(float(len(self._blocks)))
+
+    @staticmethod
+    def _count_hit(lane: str) -> None:
+        REGISTRY.counter(
+            "tsd.rollup.lane.hits",
+            "Plans answered from a rollup lane, by lane interval"
+        ).labels(lane=lane).inc()
+
+    @staticmethod
+    def _count_miss(reason: str) -> None:
+        REGISTRY.counter(
+            "tsd.rollup.lane.misses",
+            "Lane-eligible plans that fell back to the exact paths, "
+            "by reason").labels(reason=reason).inc()
+
+    # -- invalidation ----------------------------------------------------
+
+    def note_mutation(self, metric: int, lo_ms: int | None,
+                      hi_ms: int | None, store=None) -> None:
+        """Ingest-side hook (memstore mutation listener), called AFTER
+        the write lands (write-then-mark).  Routes to ``invalidate`` —
+        the registered invalidator the cache-coherence lint holds this
+        store to."""
+        del store
+        if not self._armed:
+            # no build has ever read store data: nothing materialized
+            # can be stale, and the hot ingest path skips the lock.
+            # Sound because this read happens after the caller's write
+            # landed and refresh() arms the flag under the lock BEFORE
+            # its first store read.
+            return
+        self.invalidate(metric=metric, lo_ms=lo_ms, hi_ms=hi_ms)
+
+    def invalidate(self, metric: int | None = None,
+                   lo_ms: int | None = None,
+                   hi_ms: int | None = None) -> None:
+        """THE invalidation entry point (registered in the `# cache:`
+        declaration above ``_blocks``).
+
+        With a metric: record a dirty mark over [lo_ms, hi_ms] (None
+        bounds = open) — blocks overlapping the range fail their
+        generation check from now on and the maintenance pass rebuilds
+        them.  Without a metric: drop everything (/api/dropcaches)."""
+        with self._lock:
+            if metric is None:
+                self.invalidations += 1
+                self._blocks = {}
+                self._marks.clear()
+                self._floor.clear()
+                self._bytes = 0
+                self._gen += 1
+                self._set_gauges_locked()
+            else:
+                lo = -2 ** 62 if lo_ms is None else int(lo_ms)
+                hi = 2 ** 62 if hi_ms is None else int(hi_ms)
+                ring = self._marks.get(metric)
+                if ring is None:
+                    ring = self._marks[metric] = deque(maxlen=_MARK_RING)
+                if ring and ring[-1][0] > self._planned_gen:
+                    # per-point ingest coalesces to one widened mark
+                    # while no plan/build snapshotted in between (same
+                    # argument as agg_cache.invalidate)
+                    g, plo, phi = ring[-1]
+                    ring[-1] = (g, min(plo, lo), max(phi, hi))
+                    return
+                self.invalidations += 1
+                self._gen += 1
+                if len(ring) == _MARK_RING:
+                    self._floor[metric] = max(
+                        self._floor.get(metric, 0), ring[0][0])
+                ring.append((self._gen, lo, hi))
+        REGISTRY.counter(
+            "tsd.rollup.lane.invalidations",
+            "Rollup-lane invalidation marks (ingest dirty ranges, "
+            "dropcaches)").inc()
+
+    def _valid_locked(self, entry: _LaneBlock) -> bool:
+        if entry.gen < self._floor.get(entry.metric, 0):
+            return False
+        ring = self._marks.get(entry.metric)
+        if not ring:
+            return True
+        for gen, lo, hi in reversed(ring):
+            if gen <= entry.gen:
+                break
+            if lo <= entry.hi_ms and hi >= entry.lo_ms:
+                return False
+        return True
+
+    # -- lane selection helpers ------------------------------------------
+
+    def lane_for(self, interval_ms: int,
+                 first_window_ms: int) -> tuple[str, int] | None:
+        """The coarsest configured lane able to serve a fixed grid:
+        its span must divide both the interval and the grid origin
+        (epoch-aligned origins always do when the interval divides)."""
+        if interval_ms <= 0:
+            return None
+        for label, lane_ms in self.lanes:
+            if interval_ms % lane_ms == 0 \
+                    and first_window_ms % lane_ms == 0:
+                return label, lane_ms
+        return None
+
+    @staticmethod
+    def derivable(ds_fn: str | None) -> bool:
+        return ds_fn in DERIVABLE_DS
+
+    # -- planning --------------------------------------------------------
+
+    def plan(self, metric: int, series_list, windows, start_ms: int,
+             end_ms: int, ds_fn: str, platform: str, s: int,
+             n_max: int, g_pad: int, has_rate: bool,
+             total_points: int = 0):
+        """Lane-serve decision for one fixed-grid downsample segment.
+
+        Returns (LanePlan | None, decision dict).  None = fall back to
+        the exact paths; the decision dict always comes back for the
+        trace span (PR 6 contract).  Every eligible consult — hit or
+        miss — records a costmodel-priced demand observation, the
+        Storyboard selection corpus ``refresh()`` shops from."""
+        from opentsdb_tpu.obs import jaxprof
+        from opentsdb_tpu.ops import costmodel as cm
+        from opentsdb_tpu.ops.downsample import pad_pow2
+        interval = windows.interval_ms
+        first = windows.first_window_ms
+        w = windows.count
+        decision = {"decision": "fallback", "reason": "", "lane": "",
+                    "coverage": 0.0}
+        if not self.derivable(ds_fn):
+            decision["reason"] = "not_derivable"
+            return None, decision
+        picked = self.lane_for(interval, first)
+        if picked is None:
+            decision["reason"] = "no_lane_divides"
+            return None, decision
+        label, lane_ms = picked
+        k = interval // lane_ms
+        decision["lane"] = label
+        # interior FULL windows only (edge windows see a partial point
+        # population and always recompute from raw — same rule as the
+        # agg cache)
+        wf_lo = 0 if start_ms <= first else 1
+        last_start = first + (w - 1) * interval
+        wf_hi = w - 1 if last_start + interval - 1 <= end_ms else w - 2
+        if wf_hi < wf_lo:
+            decision["reason"] = "no_full_windows"
+            return None, decision
+        c_lo = (first + wf_lo * interval) // lane_ms
+        c_hi = (first + (wf_hi + 1) * interval) // lane_ms - 1
+        n_cells = c_hi - c_lo + 1
+        bw = self.block_windows
+        b_lo, b_hi = c_lo // bw, c_hi // bw
+        if b_hi - b_lo + 1 > _MAX_BLOCK_WALK:
+            decision["reason"] = "too_many_blocks"
+            return None, decision
+
+        # costmodel economics: what the lane saves vs the monolithic
+        # exact plan (prices the demand record AND the span annotation)
+        wp = pad_pow2(w)
+        np_pad = pad_pow2(max(int(n_max), 1))
+        full_bd = jaxprof.stage_breakdown(platform, s, np_pad, wp, g_pad,
+                                          ds_fn, has_rate)
+        ds_s = full_bd.get("downsample", 0.0)
+        pred_full = sum(full_bd.values()) \
+            + total_points * _HOST_BUILD_S_PER_POINT
+        pred_lane = (sum(full_bd.values()) - ds_s) \
+            + cm.predict_lane(s, wf_hi - wf_lo + 1, k, platform)
+        saving = max(pred_full - pred_lane, 0.0)
+        decision["predictedLaneMs"] = round(pred_lane * 1e3, 3)
+        decision["predictedFullMs"] = round(pred_full * 1e3, 3)
+
+        # pass 1, under the lock: generation snapshot + mark-validity +
+        # LRU bump; refs only (block arrays/row maps are immutable once
+        # stored, so completeness + row-vector work happens outside)
+        candidates: list = []
+        missing = 0
+        with self._lock:
+            gen0 = self._gen
+            self._planned_gen = max(self._planned_gen, gen0)
+            self._note_demand_locked(metric, lane_ms, s, start_ms,
+                                     end_ms, saving)
+            for b in range(b_lo, b_hi + 1):
+                key = (metric, lane_ms, b)
+                entry = self._blocks.get(key)
+                if entry is None or not self._valid_locked(entry):
+                    if entry is not None:
+                        self._drop_locked(key)
+                    missing += 1
+                    continue
+                # LRU recency = dict order (move-to-end)
+                self._blocks.pop(key)
+                self._blocks[key] = entry
+                candidates.append((key, entry, b))
+        # pass 2, outside the lock: row completeness + per-block row
+        # vectors (blocks built at different times may order rows
+        # differently — each segment carries its own index vector)
+        segments: list = []
+        incomplete: list = []
+        for key, entry, b in candidates:
+            if not all(srs in entry.rows for srs in series_list):
+                incomplete.append(key)
+                missing += 1
+                continue
+            rows = np.fromiter((entry.rows[srs] for srs in series_list),
+                               np.int64, count=len(series_list))
+            lo_cell = max(c_lo, b * bw)
+            hi_cell = min(c_hi, (b + 1) * bw - 1)
+            segments.append((entry, rows, lo_cell - b * bw,
+                             hi_cell - b * bw + 1, lo_cell - c_lo))
+        if incomplete:
+            with self._lock:
+                for key in incomplete:
+                    # row-incomplete (a series appeared since the
+                    # build): drop so the next pass rebuilds
+                    self._drop_locked(key)
+        if missing:
+            decision["reason"] = "cold"
+            decision["coverage"] = round(
+                1.0 - missing / (b_hi - b_lo + 1), 4)
+            self._count_miss("cold")
+            with self._lock:
+                self.misses += 1
+            return None, decision
+        decision.update(decision="lane", reason="served", coverage=1.0,
+                        cells=n_cells, blocks=len(segments))
+        # hit accounting happens in note_served() once the planner
+        # COMMITS to the plan — an over-budget plan the striping sizer
+        # voids must not count as a lane hit
+        return LanePlan(metric=metric, lane=label, lane_ms=lane_ms,
+                        k=k, wf_lo=wf_lo, wf_hi=wf_hi, n_cells=n_cells,
+                        segments=segments, gen0=gen0,
+                        decision=decision), decision
+
+    def note_served(self, plan: LanePlan) -> None:
+        """The planner committed to this plan (residency/striping
+        checks passed): count the hit."""
+        with self._lock:
+            self.hits += 1
+            self.served_windows += plan.wf_hi - plan.wf_lo + 1
+        self._count_hit(plan.lane)
+
+    def note_striping_fallback(self) -> None:
+        """An over-budget plan the striping sizer could not serve fell
+        back to the exact paths: count the miss, not a hit."""
+        with self._lock:
+            self.misses += 1
+        self._count_miss("striping")
+
+    def _note_demand_locked(self, metric: int, lane_ms: int, s: int,
+                            lo_ms: int, hi_ms: int,
+                            saving_s: float) -> None:
+        key = (metric, lane_ms)
+        self._tick += 1
+        rec = self._demand.pop(key, None)
+        if rec is None:
+            rec = {"n": 0, "saving_s": 0.0, "lo": lo_ms, "hi": hi_ms,
+                   "series": s}
+        rec["n"] += 1
+        rec["saving_s"] += saving_s
+        rec["lo"] = min(rec["lo"], lo_ms)
+        rec["hi"] = max(rec["hi"], hi_ms)
+        rec["series"] = max(rec["series"], s)
+        rec["tick"] = self._tick
+        self._demand[key] = rec    # move-to-end: stalest-first eviction
+        while len(self._demand) > _DEMAND_MAX:
+            self._demand.pop(next(iter(self._demand)))
+
+    # -- serving: grid derivation ----------------------------------------
+
+    def derive_grid(self, plan: LanePlan, ds_fn: str, fill_policy: str,
+                    fill_value: float, row_lo: int = 0,
+                    row_hi: int | None = None
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """Assemble the interior [rows, windows] downsample grid from
+        the plan's lane cells — numpy, host-side, outside any lock
+        (blocks are immutable once stored).
+
+        Exactness: window w re-reduces its k cells with the function's
+        mergeable form; on integer data every value is bit-identical
+        to what ``ops.downsample.downsample`` computes from the raw
+        points (sums of exactly-representable integers are exact in
+        any association, min/max are selections, and avg divides the
+        same two exact operands).  ``row_lo``/``row_hi`` slice the
+        series axis for the window-striped tiled replay."""
+        first = plan.segments[0][1]
+        s = len(first[row_lo:row_hi])
+        k = plan.k
+        nc = plan.n_cells
+        sums = np.empty((s, nc), np.float64)
+        counts = np.empty((s, nc), np.int64)
+        need_min = ds_fn in ("min", "mimmin")
+        need_max = ds_fn in ("max", "mimmax")
+        mins = np.empty((s, nc), np.float64) if need_min else None
+        maxs = np.empty((s, nc), np.float64) if need_max else None
+        for entry, seg_rows, c0, c1, off in plan.segments:
+            rows = seg_rows[row_lo:row_hi]
+            sums[:, off:off + c1 - c0] = entry.sums[rows, c0:c1]
+            counts[:, off:off + c1 - c0] = entry.counts[rows, c0:c1]
+            if need_min:
+                mins[:, off:off + c1 - c0] = entry.mins[rows, c0:c1]
+            if need_max:
+                maxs[:, off:off + c1 - c0] = entry.maxs[rows, c0:c1]
+        nw = nc // k
+        cnt_w = counts.reshape(s, nw, k).sum(axis=2)
+        mask = cnt_w > 0
+        if ds_fn in ("sum", "zimsum"):
+            vals = sums.reshape(s, nw, k).sum(axis=2)
+        elif ds_fn == "count":
+            vals = cnt_w.astype(np.float64)
+        elif ds_fn == "avg":
+            vals = sums.reshape(s, nw, k).sum(axis=2) \
+                / np.maximum(cnt_w, 1)
+        elif need_min:
+            vals = mins.reshape(s, nw, k).min(axis=2)
+        elif need_max:
+            vals = maxs.reshape(s, nw, k).max(axis=2)
+        else:  # pragma: no cover — plan() rejected it already
+            raise ValueError("not lane-derivable: %s" % ds_fn)
+        # fill semantics mirror ops.downsample.apply_fill over interior
+        # windows (all interior windows are live by construction)
+        from opentsdb_tpu.ops.downsample import (FILL_NAN, FILL_NONE,
+                                                 FILL_NULL, FILL_SCALAR,
+                                                 FILL_ZERO)
+        if fill_policy == FILL_NONE:
+            vals = np.where(mask, vals, np.nan)
+        else:
+            if fill_policy == FILL_ZERO:
+                fill = 0.0
+            elif fill_policy in (FILL_NAN, FILL_NULL):
+                fill = np.nan
+            elif fill_policy == FILL_SCALAR:
+                fill = float(fill_value)
+            else:
+                raise ValueError("Unrecognized fill policy: "
+                                 + fill_policy)
+            vals = np.where(mask, vals, fill)
+            mask = np.ones_like(mask)
+        return vals, mask
+
+    # -- admission-estimate support --------------------------------------
+
+    def coverage(self, metric: int, interval_ms: int, ds_fn: str,
+                 start_ms: int, end_ms: int) -> float:
+        """Fraction of the plan's interior windows servable from valid
+        lane blocks — tsd/admission.py prices the lane-served plan
+        with it so warm dashboards admit where cold ones shed.
+        Approximate: ignores the series-set completeness check."""
+        if not self.derivable(ds_fn) or interval_ms <= 0:
+            return 0.0
+        first = start_ms - start_ms % interval_ms
+        picked = self.lane_for(interval_ms, first)
+        if picked is None:
+            return 0.0
+        _label, lane_ms = picked
+        w = (end_ms - end_ms % interval_ms - first) // interval_ms + 1
+        wf_lo = 0 if start_ms <= first else 1
+        last_start = first + (w - 1) * interval_ms
+        wf_hi = w - 1 if last_start + interval_ms - 1 <= end_ms else w - 2
+        if wf_hi < wf_lo:
+            return 0.0
+        c_lo = (first + wf_lo * interval_ms) // lane_ms
+        c_hi = (first + (wf_hi + 1) * interval_ms) // lane_ms - 1
+        bw = self.block_windows
+        good = 0
+        total = c_hi // bw - c_lo // bw + 1
+        # the walk bound is a request-range clamp: this runs on the
+        # pre-admission path, before any budget guard
+        total = min(total, _MAX_BLOCK_WALK)
+        with self._lock:
+            for i in range(total):
+                entry = self._blocks.get(
+                    (metric, lane_ms, c_lo // bw + i))
+                if entry is not None and self._valid_locked(entry):
+                    good += 1
+        return good / max(total, 1)
+
+    # -- maintenance: Storyboard selection + block builds ----------------
+
+    def refresh(self, store, max_blocks: int | None = None,
+                now_ms: int | None = None) -> int:
+        """One maintenance pass: select (metric, lane) targets by
+        saving-per-byte under ``tsd.rollup.mb``, then (re)build up to
+        ``max_blocks`` missing/invalid blocks over the demanded
+        ranges.  Returns blocks built."""
+        if max_blocks is None:
+            max_blocks = self.refresh_blocks
+        if now_ms is None:
+            now_ms = DT.current_time_millis()
+        with self._lock:
+            demand = sorted(self._demand.items(),
+                            key=lambda kv: -kv[1]["saving_s"])
+        # greedy saving-per-byte selection under the byte budget
+        remaining = self.max_bytes
+        selected: list[tuple] = []
+        scored = []
+        for key, rec in demand:
+            _metric, lane_ms = key
+            cells = max((rec["hi"] - rec["lo"]) // lane_ms + 1, 1)
+            bytes_est = rec["series"] * cells * LANE_CELL_BYTES
+            if bytes_est <= 0:
+                continue
+            # saving_s is already frequency-weighted (one increment
+            # per consult) — dividing by bytes gives saving-per-byte
+            scored.append((rec["saving_s"] / bytes_est,
+                           bytes_est, key, rec))
+        scored.sort(key=lambda t: -t[0])
+        for _score, bytes_est, key, rec in scored:
+            if bytes_est <= remaining:
+                selected.append((key, rec))
+                remaining -= bytes_est
+        built = 0
+        bw = self.block_windows
+        for (metric, lane_ms), rec in selected:
+            label = next((lb for lb, ms in self.lanes
+                          if ms == lane_ms), str(lane_ms))
+            series_list = sorted(
+                store.series_for_metric(metric),
+                key=lambda srs: (srs.key.metric, srs.key.tags))
+            if not series_list:
+                continue
+            span = bw * lane_ms
+            b0 = rec["lo"] // span
+            n_scan = min(rec["hi"] // span - b0 + 1, _MAX_BLOCK_WALK)
+            for b in range(b0, b0 + n_scan):
+                if built >= max_blocks:
+                    return built
+                hi_ms = (b + 1) * span - 1
+                if self.delay_ms and hi_ms > now_ms - self.delay_ms:
+                    # the actively-written head: skip it this pass so
+                    # continuous ingest doesn't rebuild it every tick
+                    continue
+                key = (metric, lane_ms, b)
+                with self._lock:
+                    entry = self._blocks.get(key)
+                    if entry is not None and self._valid_locked(entry) \
+                            and all(srs in entry.rows
+                                    for srs in series_list):
+                        continue
+                try:
+                    if self._build_block(metric, label, lane_ms, b,
+                                         series_list):
+                        built += 1
+                except Exception:
+                    with self._lock:
+                        self.build_errors += 1
+                    REGISTRY.counter(
+                        "tsd.rollup.lane.build_errors",
+                        "Lane block builds that raised (caught + "
+                        "counted; retried next pass)").inc()
+                    _LOG.exception("lane block build failed: %r", key)
+        return built
+
+    def _build_block(self, metric: int, label: str, lane_ms: int,
+                     b: int, series_list) -> bool:
+        """Materialize one [S, B] partials block from the raw store.
+
+        Over-wall builds apply PR 10's bounded-working-set stance to
+        construction: the series axis tiles to the device-state
+        budget, and each tile's partial lanes land straight into the
+        preallocated destination arrays (the block IS the host
+        buffer, so nothing needs to stage anywhere else)."""
+        from opentsdb_tpu.ops.downsample import FixedWindows, pad_pow2
+        from opentsdb_tpu.ops.pipeline import (build_batch_direct,
+                                               run_lane_partials)
+        bw = self.block_windows
+        span = bw * lane_ms
+        lo, hi = b * span, (b + 1) * span - 1
+        s = len(series_list)
+        with self._lock:
+            gen0 = self._gen
+            self._planned_gen = max(self._planned_gen, gen0)
+            # arm the ingest-side mark path BEFORE reading store data
+            self._armed = True
+        fix = self.fix_duplicates
+        counts = [srs.window_count(lo, hi, fix) for srs in series_list]
+        n_max = max(counts, default=0)
+        budget = self.config.get_int(
+            "tsd.query.streaming.state_mb") * 2 ** 20
+        # per-series working bytes: the padded point batch (ts 8 +
+        # val 8 + mask 1) plus the four [*, B] partial lanes
+        per_row = pad_pow2(max(n_max, 1)) * 17 + bw * LANE_CELL_BYTES
+        tile_rows = s if budget <= 0 else max(budget // per_row, 1)
+        tile_rows = min(tile_rows, s)
+        sums = np.zeros((s, bw), np.float64)
+        cnts = np.zeros((s, bw), np.int32)
+        mins = np.full((s, bw), np.inf, np.float64)
+        maxs = np.full((s, bw), -np.inf, np.float64)
+        wspec, wargs = FixedWindows(lane_ms, lo, bw).split()
+        for t_lo in range(0, s, tile_rows):
+            t_hi = min(t_lo + tile_rows, s)
+            ts, val, mask, _ = build_batch_direct(
+                series_list[t_lo:t_hi], lo, hi, fix)
+            tsu, tcn, tmn, tmx = run_lane_partials(
+                wspec, ts, val, mask, wargs)
+            sums[t_lo:t_hi] = np.asarray(tsu)[:, :bw]
+            cnts[t_lo:t_hi] = np.asarray(tcn)[:, :bw]
+            mins[t_lo:t_hi] = np.asarray(tmn)[:, :bw]
+            maxs[t_lo:t_hi] = np.asarray(tmx)[:, :bw]
+        entry = _LaneBlock(
+            metric=metric, lane_ms=lane_ms,
+            rows={srs: i for i, srs in enumerate(series_list)},
+            sums=sums, counts=cnts, mins=mins, maxs=maxs, gen=gen0,
+            lo_ms=lo, hi_ms=hi, nbytes=s * bw * LANE_CELL_BYTES)
+        with self._lock:
+            if not self._valid_locked(entry):
+                # a write landed in range while building: discard; the
+                # next pass rebuilds from post-write data
+                return False
+            if entry.nbytes > self.max_bytes:
+                return False
+            self._evict_for_locked(entry.nbytes)
+            key = (metric, lane_ms, b)
+            if key in self._blocks:
+                self._drop_locked(key)
+            self._blocks[key] = entry
+            self._bytes += entry.nbytes
+            self.builds += 1
+            self._set_gauges_locked()
+        REGISTRY.counter(
+            "tsd.rollup.lane.builds",
+            "Lane blocks materialized from the memstore, by lane "
+            "interval").labels(lane=label).inc()
+        return True
+
+    # -- eviction --------------------------------------------------------
+
+    def _drop_locked(self, key: tuple) -> None:
+        entry = self._blocks.pop(key, None)
+        if entry is not None:
+            self._bytes -= entry.nbytes
+
+    def _evict_for_locked(self, incoming: int) -> None:
+        while self._blocks and \
+                self._bytes + incoming > self.max_bytes:
+            self._drop_locked(next(iter(self._blocks)))
+            self.evictions += 1
+            REGISTRY.counter(
+                "tsd.rollup.lane.evictions",
+                "Lane blocks evicted by the tsd.rollup.mb LRU").inc()
+
+    # -- stats -----------------------------------------------------------
+
+    def collect_stats(self) -> dict:
+        with self._lock:
+            return {
+                "tsd.query.rollup.hits": float(self.hits),
+                "tsd.query.rollup.misses": float(self.misses),
+                "tsd.query.rollup.builds": float(self.builds),
+                "tsd.query.rollup.build_errors": float(
+                    self.build_errors),
+                "tsd.query.rollup.blocks": float(len(self._blocks)),
+                "tsd.query.rollup.bytes": float(self._bytes),
+                "tsd.query.rollup.evictions": float(self.evictions),
+                "tsd.query.rollup.invalidations": float(
+                    self.invalidations),
+                "tsd.query.rollup.served_windows": float(
+                    self.served_windows),
+                "tsd.query.rollup.demand_entries": float(
+                    len(self._demand)),
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._blocks)

@@ -89,6 +89,8 @@ class DropCachesRpc(TelnetRpc, HttpRpc):
             tsdb.device_cache.invalidate()
         if tsdb.agg_cache is not None:
             tsdb.agg_cache.invalidate()
+        if tsdb.rollup_lanes is not None:
+            tsdb.rollup_lanes.invalidate()
         # UID cachs are authoritative dictionaries here (no backing store),
         # so unlike UniqueId.dropCaches they must NOT be emptied.
 

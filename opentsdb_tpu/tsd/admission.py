@@ -182,6 +182,28 @@ def estimate_plan_cost_ms(tsdb, ts_query) -> float:
             ds_fn = ds.function
             w = max(int((ts_query.end_time - ts_query.start_time)
                         // ds.interval_ms) + 1, 1)
+            # Rollup lanes first (storage/rollup.py): a fully
+            # lane-covered plan never fetches, streams, or tiles the
+            # raw points — price the lane assembly + the tail stages
+            # instead, so warm long-range dashboards ADMIT where a
+            # cold raw-priced estimate would shed them.
+            lanes = getattr(tsdb, "rollup_lanes", None)
+            if lanes is not None and not ds.use_calendar:
+                cov = lanes.coverage(metric_uid, ds.interval_ms, ds_fn,
+                                     ts_query.start_time,
+                                     ts_query.end_time)
+                if cov >= 1.0:
+                    from opentsdb_tpu.ops import costmodel as cm
+                    first = ts_query.start_time \
+                        - ts_query.start_time % ds.interval_ms
+                    picked = lanes.lane_for(ds.interval_ms, first)
+                    k = (ds.interval_ms // picked[1]) if picked else 1
+                    g = pad_pow2(s if sub.aggregator == "none" else 1)
+                    total_s += cm.predict_lane(s, w, k, platform)
+                    total_s += sum(jaxprof.stage_breakdown(
+                        platform, s, 8, w, g, ds_fn,
+                        bool(sub.rate)).values())
+                    continue
             # Price the REWRITTEN plan, not the original: windows
             # covered by valid partial-aggregate blocks never
             # dispatch, so only the uncovered fraction of the scan

@@ -445,6 +445,37 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.rpc.telnet.return_errors": _e(
         "bool", True, "Reference compat telnet error stance.",
         compat=True),
+    "tsd.rollup.enable": _e(
+        "bool", False, "Enable rollup lanes: maintenance-built "
+        "multi-resolution pre-aggregation serving any fixed-interval "
+        "query whose interval is an integer multiple of a lane "
+        "exactly from mergeable sum/count/min/max partials "
+        "(docs/rollup.md)."),
+    "tsd.rollup.intervals": _e(
+        "str", "1m,1h,1d", "Comma-separated lane granularities the "
+        "maintenance thread may materialize; the coarsest lane "
+        "dividing a query's interval serves it."),
+    "tsd.rollup.mb": _e(
+        "int", "256", "Byte budget for materialized lane blocks "
+        "(Storyboard-style precompute-under-budget: candidates are "
+        "selected by costmodel-priced saving per byte; LRU eviction "
+        "enforces the budget at insert)."),
+    "tsd.rollup.block_windows": _e(
+        "int", "64", "Lane cells per materialized block (rounded up "
+        "to a power of two; blocks align to the absolute lane "
+        "grid)."),
+    "tsd.rollup.interval": _e(
+        "int", "5", "Seconds between rollup-lane maintenance passes "
+        "(demand selection + block builds; 0 disables the cadence — "
+        "lanes then only build via explicit refresh() calls)."),
+    "tsd.rollup.refresh_blocks": _e(
+        "int", "32", "Maximum lane blocks (re)built per maintenance "
+        "pass — bounds the per-tick build work."),
+    "tsd.rollup.delay_ms": _e(
+        "int", "0", "Skip building lane blocks whose range ends "
+        "within this many ms of now (the actively-written head would "
+        "be invalidated by the next ingest anyway; 0 builds "
+        "everything)."),
     "tsd.rollups.enable": _e("bool", False,
                              "Enable rollup/pre-aggregate ingest and "
                              "query serving."),

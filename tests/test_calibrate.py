@@ -179,12 +179,14 @@ class TestFitConstants:
             assert value == pytest.approx(TRUE_CPU[term], rel=1e-3), \
                 term
         # every cpu-exercisable term is covered by the mix; the spill
-        # terms never appear in ring features (tiled executions are
-        # ring-excluded by design, tests/test_tiling.py — their
+        # and rollup-lane terms never appear in ring features (tiled
+        # and lane-served executions are ring-excluded by design,
+        # tests/test_tiling.py / test_rollup_lanes.py — their
         # constants fit offline / from a future tiled-measurement path)
         assert set(fitted) == set(TRUE_CPU) - {
             "cmp_cell", "hier_cell", "sorted2_grid",
-            "spill_write_mb", "spill_read_mb", "tile_dispatch"}
+            "spill_write_mb", "spill_read_mb", "tile_dispatch",
+            "lane_assemble_mb", "lane_build_cell"}
 
     def test_recovery_survives_jitter(self):
         """+-2% measurement noise: well-constrained terms land near

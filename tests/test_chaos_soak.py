@@ -54,6 +54,23 @@ def test_cache_contract_holds():
 
 
 @pytest.mark.slow
+def test_rollup_contract_holds():
+    """ISSUE 11 acceptance: a lane-enabled TSD under long-range load
+    with ingest overwriting points inside queried windows answers
+    byte-identical to a lane-disabled control, serves a nonzero lane
+    hit rate on prometheus, and heals (no stale answers) after a
+    WAL-site fault burst."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14291", "--rounds", "6", "--rollup",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "zero divergence" in proc.stdout
+    assert "lane hits" in proc.stdout
+
+
+@pytest.mark.slow
 def test_spill_contract_holds():
     """ISSUE 10 acceptance: a tiled TSD (tiny state budget, disk-backed
     spill pool) under long-range group-by load with ingest running

@@ -90,9 +90,20 @@ class TestPasses:
         try:
             stats = tsdb.collect_stats()
             assert "tsd.maintenance.flush_passes" in stats
+            assert "tsd.maintenance.rollup_passes" in stats
             assert "tsd.compaction.queue" in stats
         finally:
             tsdb.shutdown()
+
+    def test_rollup_pass_skips_when_lanes_disabled(self):
+        """The rollup cadence is a no-op without tsd.rollup.enable —
+        no pass is counted, nothing is consulted."""
+        tsdb = _tsdb()
+        assert tsdb.rollup_lanes is None
+        mt = MaintenanceThread(tsdb)
+        mt._next_rollup = 0.0
+        mt._maybe_rollup(1.0)
+        assert mt.rollup_passes == 0
 
 
 class TestThread:

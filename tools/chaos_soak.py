@@ -589,6 +589,128 @@ def run_cache_stage(port: int, rounds: int) -> None:
             proc.wait()
 
 
+def run_rollup_stage(port: int, rounds: int) -> None:
+    """--rollup: the rollup-lane subsystem's standing gate.
+
+    A lane-enabled TSD (1m lanes, 1s maintenance cadence so blocks
+    build between rounds) races a lane-disabled control through a
+    long-range mixed query load with ingest OVERWRITING points inside
+    the queried windows between rounds.  Gates:
+
+      * ZERO answer divergence: every round's payloads match the
+        control byte-for-byte (integer-valued data — lane-derivable
+        re-reduction is exact, so a mismatch means a stale lane block
+        or a wrong cell boundary, never ulp noise);
+      * the lanes actually served: tsd_rollup_lane_hits_total > 0 on
+        /api/stats/prometheus;
+      * healing: the primary boots with a times-limited WAL-site
+        fault burst armed; after the burst both daemons take one
+        idempotent full re-put with CHANGED values — a lane block
+        that missed an invalidation during the fault window serves
+        stale sums and fails the divergence gate.
+    """
+    import tempfile
+    wal_dir = tempfile.mkdtemp(prefix="chaos_rollup_wal_")
+    n_pts = 1800
+    shared_cfg = {
+        "tsd.query.mesh.enable": "false",
+        "tsd.storage.fix_duplicates": "true",
+        # lanes are the ONLY cache under test: the agg cache answers
+        # the same repeat shapes and would mask a lane bug
+        "tsd.query.cache.enable": "false",
+    }
+    prim = spawn_tsd(port, {
+        **shared_cfg,
+        "tsd.rollup.enable": "true",
+        "tsd.rollup.intervals": "1m",
+        "tsd.rollup.block_windows": "8",
+        "tsd.rollup.interval": "1",
+        "tsd.rollup.delay_ms": "0",
+        "tsd.storage.directory": wal_dir,
+        "tsd.faults.config": json.dumps([
+            {"site": "wal.append", "kind": "error", "times": 6},
+        ]),
+    }, role="rollup")
+    ctrl = spawn_tsd(port + 1, shared_cfg, role="rollup-control")
+
+    def points(lo, hi, salt=0, host="a"):
+        # `salt` changes every value: overwrites must DIFFER from
+        # what any lane cell holds, or the divergence gate cannot see
+        # a missed invalidation
+        return [{"metric": "rollup.m", "timestamp": BASE + k,
+                 "value": (k * 7 + salt * 13) % 101,
+                 "tags": {"host": host}} for k in range(lo, hi)]
+
+    def q(p, start, end):
+        url = ("http://127.0.0.1:%d/api/query?start=%d&end=%d"
+               "&m=sum:60s-sum:rollup.m" % (p, start, end))
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    try:
+        # burst phase: the primary's first journal writes fault
+        burst_failures = 0
+        for lo in range(0, n_pts, 200):
+            batch = points(lo, lo + 200)
+            try:
+                http_put(port, batch)
+            except urllib.error.HTTPError:
+                burst_failures += 1
+                continue
+            http_put(port + 1, batch)
+        # prime demand DURING the burst window so lane blocks exist
+        # that a missed invalidation could serve stale, and give the
+        # maintenance cadence a beat to build them
+        for _ in range(3):
+            q(port, BASE, BASE + 1500)
+            time.sleep(0.7)
+        # heal: one full re-put on BOTH with DIFFERENT values — every
+        # lane block from the fault window MUST be dirtied
+        for lo in range(0, n_pts, 200):
+            http_put(port, points(lo, lo + 200, salt=1))
+            http_put(port + 1, points(lo, lo + 200, salt=1))
+        divergences = 0
+        for i in range(max(rounds, 10)):
+            for start, end in ((BASE, BASE + 1500),
+                               (BASE + 60 * i, BASE + 1500 + 60 * i)):
+                a = q(port, start, end)
+                b = q(port + 1, start, end)
+                if a != b:
+                    divergences += 1
+                    print("[rollup] round %d DIVERGED on [%d, %d]:\n"
+                          "  lanes:   %r\n  control: %r"
+                          % (i, start, end, a, b), flush=True)
+            # overwrite INSIDE the queried window with round-salted
+            # values + fresh tail points, then let the maintenance
+            # cadence rebuild the dirtied blocks
+            mid = points(200 + i * 11, 209 + i * 11, salt=i + 2)
+            extra = points(n_pts + i * 3, n_pts + (i + 1) * 3)
+            for p in (port, port + 1):
+                assert http_put(p, mid)
+                assert http_put(p, extra)
+            time.sleep(0.6)
+        if divergences:
+            print("[rollup] %d diverged answers vs the lane-disabled "
+                  "control" % divergences, flush=True)
+            raise SystemExit(1)
+        scrape = _prom_scrape(port)
+        lane_hits = _prom_sum(scrape, "tsd_rollup_lane_hits_total")
+        if lane_hits <= 0:
+            print("[rollup] no lane hits on prometheus — the lanes "
+                  "never served (scrape: %r)"
+                  % scrape.get("tsd_rollup_lane_hits_total"),
+                  flush=True)
+            raise SystemExit(1)
+        print("[rollup] %d rounds, zero divergence, %d lane hits, "
+              "%d faulted burst puts healed"
+              % (max(rounds, 10), int(lane_hits), burst_failures),
+              flush=True)
+    finally:
+        for proc in (prim, ctrl):
+            proc.send_signal(signal.SIGTERM)
+            proc.wait()
+
+
 def run_spill_stage(port: int, rounds: int) -> None:
     """--spill: the out-of-core tiled executor's standing gate.
 
@@ -951,6 +1073,14 @@ def main():
                          "repeat/sliding load with ingest running, "
                          "show a nonzero agg hit rate, and heal after "
                          "a WAL-site fault burst")
+    ap.add_argument("--rollup", action="store_true",
+                    help="run the rollup-lane stage: a lane-enabled "
+                         "TSD must answer byte-identical to a "
+                         "lane-disabled control under long-range "
+                         "load with ingest overwriting points inside "
+                         "queried windows, show a nonzero lane hit "
+                         "rate, and heal after a WAL-site fault "
+                         "burst")
     ap.add_argument("--spill", action="store_true",
                     help="run the out-of-core tiling stage: a tiled "
                          "TSD (tiny state budget, disk-backed spill "
@@ -980,11 +1110,13 @@ def main():
         run_cache_stage(args.port + 5, args.rounds)
     if args.spill:
         run_spill_stage(args.port + 7, args.rounds)
+    if args.rollup:
+        run_rollup_stage(args.port + 9, args.rounds)
     if args.stages_only:
         if not (args.overload or args.autotune or args.cache
-                or args.spill):
+                or args.spill or args.rollup):
             ap.error("--stages-only needs --overload, --autotune, "
-                     "--cache and/or --spill")
+                     "--cache, --spill and/or --rollup")
         print("chaos soak stages PASSED (standard phases skipped: "
               "--stages-only)", flush=True)
         return
