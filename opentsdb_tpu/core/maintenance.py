@@ -65,6 +65,7 @@ class MaintenanceThread(threading.Thread):
         self.self_report_errors = 0
         self.self_report_points = 0
         self.autotune_passes = 0
+        self.health_passes = 0
 
     # ------------------------------------------------------------------ #
 
@@ -79,6 +80,7 @@ class MaintenanceThread(threading.Thread):
                 self._maybe_self_report(now)
                 self._maybe_autotune(now)
                 self._maybe_rollup(now)
+                self._maybe_health(now)
             except Exception:
                 LOG.exception("maintenance pass failed")
 
@@ -171,6 +173,14 @@ class MaintenanceThread(threading.Thread):
         self.rollup_passes += 1
         self.rollup_blocks_built += built
 
+    def _maybe_health(self, now: float) -> None:
+        """tsd.health.interval cadence: one health-engine pass
+        (obs/health.py) judging the window since the previous pass.
+        The engine rate-limits itself; this forwards the heartbeat."""
+        engine = getattr(self.tsdb, "health", None)
+        if engine is not None and engine.tick(now):
+            self.health_passes += 1
+
     def _maybe_snapshot(self, now: float) -> None:
         if self.snapshot_interval <= 0 or now < self._next_snapshot:
             return
@@ -198,6 +208,7 @@ class MaintenanceThread(threading.Thread):
             "tsd.maintenance.self_report_errors": self.self_report_errors,
             "tsd.maintenance.self_report_points": self.self_report_points,
             "tsd.maintenance.autotune_passes": self.autotune_passes,
+            "tsd.maintenance.health_passes": self.health_passes,
             "tsd.maintenance.rollup_passes": self.rollup_passes,
             "tsd.maintenance.rollup_blocks_built":
                 self.rollup_blocks_built,

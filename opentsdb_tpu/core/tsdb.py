@@ -126,6 +126,28 @@ class TSDB:
             self.store.add_mutation_listener(
                 lambda metric, lo, hi: lanes.note_mutation(
                     metric, lo, hi))
+        # flight recorder (obs/flightrec.py): the always-on diagnostics
+        # ring every query-path subsystem feeds — admission verdicts,
+        # cache/rollup consults, spills, autotune flips, breaker
+        # transitions, deadline expiries, recompiles — served at
+        # /api/diag and dumped at shutdown so a wedged session leaves
+        # a black box
+        from opentsdb_tpu.obs.flightrec import FlightRecorder
+        self.flightrec = (FlightRecorder(self.config)
+                          if self.config.get_bool("tsd.diag.enable")
+                          else None)
+        if self.flightrec is not None:
+            # the compile-event feed (flightrec.start) is armed by the
+            # SERVER, not here: subscribing flips jax_log_compiles
+            # process-wide, which a bare library TSDB must not do —
+            # same split as jaxprof.start_compile_counting
+            self.stats_hooks["diag"] = self.flightrec.stats_hook
+            if self.agg_cache is not None:
+                self.agg_cache.recorder = self.flightrec
+            if self.rollup_lanes is not None:
+                self.rollup_lanes.recorder = self.flightrec
+            if self.spill_pool is not None:
+                self.spill_pool.recorder = self.flightrec
         from opentsdb_tpu.rollup import RollupConfig, RollupStore
         self.rollup_config = RollupConfig.from_config(self.config)
         self.rollup_store = (
@@ -177,9 +199,18 @@ class TSDB:
         if self.config.get_bool("tsd.costmodel.autotune.enable"):
             from opentsdb_tpu.ops.calibrate import OnlineCalibrator
             self.autotuner = OnlineCalibrator(self)
+        # health engine (obs/health.py): declared invariants evaluated
+        # on the maintenance cadence into per-subsystem verdicts at
+        # /api/diag/health — the chaos_soak post-heal gate.  Needs
+        # start_time, so it initializes below after the clock is set.
+        self.health = None
         from opentsdb_tpu.plugins import initialize_plugins
         initialize_plugins(self)
         self.start_time = time.time()
+        if self.config.get_bool("tsd.health.enable"):
+            from opentsdb_tpu.obs.health import HealthEngine
+            self.health = HealthEngine(self)
+            self.stats_hooks["health"] = self.health.stats_hook
         self._stats_lock = threading.Lock()
         # Serializes ingest against snapshots: writers hold it briefly per
         # record; snapshot() holds it for its stop-the-world walk so no
@@ -1017,6 +1048,11 @@ class TSDB:
             # the private tempdir (in-flight tiled queries have their
             # own per-query release in ops/tiling.py)
             self.spill_pool.close()
+        if self.flightrec is not None:
+            # LAST, so teardown events above still land in the ring
+            # before the shutdown dump writes the black box; idempotent
+            # (a server stop + an explicit shutdown both reach here)
+            self.flightrec.shutdown()
 
 
 def parse_value(value) -> tuple[bool, int | float]:

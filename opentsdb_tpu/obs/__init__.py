@@ -71,8 +71,14 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "counter", ("status",),
         "/api/query requests served, by response status."),
     "tsd.query.latency_ms": _m(
-        "histogram", (),
-        "End-to-end /api/query latency in milliseconds."),
+        "histogram", ("tenant",),
+        "End-to-end /api/query latency in milliseconds, by clamped "
+        "tenant (X-TSDB-Tenant against the tsd.diag.tenants table)."),
+    "tsd.query.tenant.demand": _m(
+        "counter", ("tenant",),
+        "Queries arriving at the admission gate, by clamped tenant — "
+        "the per-tenant demand telemetry the fair-share scheduler "
+        "(ROADMAP item 1) consumes."),
     # -- admission control (tsd/admission.py) -------------------------- #
     "tsd.query.admission.queue_depth": _m(
         "gauge", ("priority",),
@@ -315,6 +321,34 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "gauge", (),
         "Tracked (metric, lane) demand candidates (the Storyboard "
         "selection corpus)."),
+    # -- flight recorder + health engine (obs/flightrec.py,             #
+    #    obs/health.py, served at /api/diag*) -------------------------- #
+    "tsd.diag.events": _m(
+        "counter", ("kind",),
+        "Flight-recorder events recorded, by event kind (admission, "
+        "plan, tiling, breaker, deadline, compile, autotune, health, "
+        "...)."),
+    "tsd.diag.slow_captures": _m(
+        "counter", (),
+        "Slow/anomalous queries whose span tree + flight-recorder "
+        "slice were retained at /api/diag/slow."),
+    "tsd.health.status": _m(
+        "gauge", ("subsystem",),
+        "Health-engine verdict per subsystem: 0 ok, 1 degraded, "
+        "2 failing (chaos_soak's post-heal gate)."),
+    # -- diagnostics stats walk (flight recorder + health stats hooks   #
+    #    -> /api/stats + the self-report loop) ------------------------- #
+    "tsd.diag.ring.events": _m(
+        "gauge", (), "Flight-recorder events recorded since startup "
+        "(the ring's latest sequence number)."),
+    "tsd.diag.slow.captured": _m(
+        "gauge", (), "Slow-query captures retained since startup."),
+    "tsd.diag.tenant.demand": _m(
+        "gauge", ("tenant",),
+        "Per-tenant demand counters re-walked for /api/stats and the "
+        "self-report loop."),
+    "tsd.health.passes": _m(
+        "gauge", (), "Health-engine evaluation passes completed."),
     # -- device cache (storage/device_cache.py collect_stats, mirrored  #
     #    by obs/jaxprof.py update_device_gauges) ----------------------- #
     "tsd.query.device_cache.hits": _m(

@@ -1,0 +1,316 @@
+"""Health engine: declared invariants -> per-subsystem verdicts.
+
+The flight recorder (obs/flightrec.py) retains WHAT happened; this
+module judges whether it is FINE.  On the maintenance cadence
+(``tsd.health.interval``) the engine evaluates a fixed set of declared
+invariants — each a burn-rate/ratio check over the window since the
+last pass, never a point-in-time glance — and folds each into an
+``ok | degraded | failing`` verdict per subsystem:
+
+  * **admission** — shed burn: queries refused per second over the
+    window vs ``tsd.health.shed_rate``.  A daemon shedding steadily
+    after a burst lifted has NOT healed.
+  * **compile** — steady-state recompiles: XLA compilations per window
+    (via the shared compile counters) past ``tsd.health.recompile_limit``
+    once the daemon is older than ``tsd.health.recompile_warmup``
+    seconds.  Steady-state serving must be compile-clean (the tsdbsan
+    contract, now judged continuously).
+  * **agg_cache** — hit-rate collapse: consults in the window with a
+    hit fraction under ``tsd.health.cache_hit_floor`` (volume-gated:
+    a handful of cold misses is not a collapse).
+  * **costmodel** — predicted-vs-actual drift: the window's summed
+    predicted vs measured device ms off by more than
+    ``tsd.health.costmodel_drift`` x in either direction (volume-gated).
+  * **spill** — pool saturation: resident bytes vs the combined
+    host+disk budget past ``tsd.health.spill_saturation``.
+  * **cluster** — breaker flap: open transitions in the window past
+    ``tsd.health.breaker_flap``, and any breaker currently open is at
+    least degraded.
+
+Verdicts are exported as ``tsd.health.status`` gauges (0 ok /
+1 degraded / 2 failing), served at ``/api/diag/health``, recorded into
+the flight recorder on every level CHANGE, walked into /api/stats and
+the self-report loop via the stats-hook registry, and consumed by
+``tools/chaos_soak.py`` as the post-heal gate: after a fault window
+clears, every subsystem must read ``ok``.
+
+A subsystem that is disabled, cold, or below the volume gate reports
+``ok`` — the engine judges violated invariants, it does not punish
+idleness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from opentsdb_tpu.obs.registry import REGISTRY
+
+LEVELS = ("ok", "degraded", "failing")
+_LEVEL_NUM = {lvl: i for i, lvl in enumerate(LEVELS)}
+
+# Volume gates: below these per-window totals a ratio check abstains.
+_CACHE_MIN_CONSULTS = 16
+_CACHE_FAIL_CONSULTS = 64
+_COSTMODEL_MIN_ACTUAL_MS = 50.0
+
+
+def _worst(a: str, b: str) -> str:
+    return a if _LEVEL_NUM[a] >= _LEVEL_NUM[b] else b
+
+
+def _counter_total(name: str) -> float:
+    """Sum of a registry counter family across label cells (0.0 when
+    the family never registered)."""
+    # forwarder: callers pass names already declared in METRICS_SCHEMA
+    # (tsd.costmodel.predicted_ms/actual_ms); nothing is minted here
+    fam = REGISTRY.counter(name)  # tsdblint: disable=metrics-dynamic-name
+    return sum(cell.get() for _labels, cell in fam.children())
+
+
+class HealthEngine:
+    """Evaluates the declared invariants against one TSDB instance."""
+
+    SUBSYSTEMS = ("admission", "compile", "agg_cache", "costmodel",
+                  "spill", "cluster")
+
+    def __init__(self, tsdb):
+        cfg = tsdb.config
+        self.tsdb = tsdb
+        self.interval = cfg.get_int("tsd.health.interval")
+        self.shed_rate = cfg.get_float("tsd.health.shed_rate")
+        self.recompile_warmup = cfg.get_int("tsd.health.recompile_warmup")
+        self.recompile_limit = cfg.get_int("tsd.health.recompile_limit")
+        self.cache_hit_floor = cfg.get_float("tsd.health.cache_hit_floor")
+        self.costmodel_drift = cfg.get_float("tsd.health.costmodel_drift")
+        self.spill_saturation = cfg.get_float(
+            "tsd.health.spill_saturation")
+        self.breaker_flap = cfg.get_int("tsd.health.breaker_flap")
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._verdicts: dict[str, dict] = {}
+        self.passes = 0  # guarded-by: _lock
+        self._evaluated_ms = 0  # guarded-by: _lock
+        # previous pass's cumulative counters (deltas = the window)
+        # guarded-by: _lock
+        self._last: dict[str, float] = {}
+        self._last_eval_t: float | None = None  # guarded-by: _lock
+        # maintenance-thread cadence state: only that thread's tick
+        # touches it (same discipline as OnlineCalibrator._next_fit)
+        self._next_eval: float | None = None
+
+    # -- cadence --------------------------------------------------------- #
+
+    def tick(self, now: float | None = None) -> bool:
+        """One maintenance heartbeat; evaluates when the interval
+        elapsed.  Returns True when a pass ran."""
+        if now is None:
+            now = time.monotonic()
+        if self.interval <= 0:
+            return False
+        if self._next_eval is None:
+            self._next_eval = now + max(self.interval, 1)
+            return False
+        if now < self._next_eval:
+            return False
+        self._next_eval = now + max(self.interval, 1)
+        self.evaluate()
+        return True
+
+    # -- evaluation ------------------------------------------------------ #
+
+    def evaluate(self) -> dict[str, dict]:
+        """One pass over every invariant.  Window = time since the
+        previous pass (since construction on the first)."""
+        tsdb = self.tsdb
+        now = time.monotonic()
+        with self._lock:
+            last = dict(self._last)
+            last_t = self._last_eval_t
+        window_s = max(now - last_t, 1e-3) if last_t is not None \
+            else max(time.time() - tsdb.start_time, 1e-3)
+        window_s = min(window_s, 3600.0)
+        cur: dict[str, float] = {}
+        verdicts: dict[str, dict] = {}
+
+        def delta(key: str, value: float) -> float:
+            cur[key] = float(value)
+            return max(float(value) - last.get(key, 0.0), 0.0)
+
+        # admission: shed burn rate over the window
+        gate = getattr(tsdb, "_admission_gate", None)
+        shed = delta("shed", gate.shed if gate is not None else 0.0)
+        rate = shed / window_s
+        level = "ok"
+        if rate > self.shed_rate > 0:
+            level = "failing" if rate > 4 * self.shed_rate else "degraded"
+        verdicts["admission"] = {
+            "level": level,
+            "detail": "%.2f sheds/s over %.0fs window (limit %.2f/s)"
+                      % (rate, window_s, self.shed_rate)}
+
+        # compile: steady-state recompiles per window after warmup.
+        # Source is whichever shared-capture subscriber is armed: the
+        # flight recorder's compile events (server-armed regardless of
+        # tracing) or jaxprof's per-kernel counters (tracing on) — max
+        # of two cumulative counts of the same event stream stays
+        # monotone when either is dark.
+        from opentsdb_tpu.obs import jaxprof
+        diag_compiles = REGISTRY.counter(
+            "tsd.diag.events", "Flight-recorder events recorded, "
+            "by event kind").labels(kind="compile").get()
+        compiles = delta("compiles",
+                         max(sum(jaxprof.compile_counts().values()),
+                             diag_compiles))
+        uptime = time.time() - tsdb.start_time
+        level = "ok"
+        if uptime >= self.recompile_warmup > 0:
+            excess = compiles - self.recompile_limit
+            if excess > 0:
+                level = "failing" if excess > 4 else "degraded"
+        verdicts["compile"] = {
+            "level": level,
+            "detail": "%d compiles in window (limit %d; warmup %s)"
+                      % (compiles, self.recompile_limit,
+                         "done" if uptime >= self.recompile_warmup
+                         else "%.0fs left"
+                         % (self.recompile_warmup - uptime))}
+
+        # agg_cache: hit-rate collapse (volume-gated)
+        cache = getattr(tsdb, "agg_cache", None)
+        level, detail = "ok", "cache disabled"
+        if cache is not None:
+            hits = delta("cache_hits", cache.hits)
+            misses = delta("cache_misses", cache.misses)
+            consults = hits + misses
+            detail = "%.0f/%.0f hits/consults in window" \
+                % (hits, consults)
+            if consults >= _CACHE_MIN_CONSULTS \
+                    and hits / consults < self.cache_hit_floor:
+                level = ("failing" if hits == 0
+                         and consults >= _CACHE_FAIL_CONSULTS
+                         else "degraded")
+        verdicts["agg_cache"] = {"level": level, "detail": detail}
+
+        # costmodel: predicted-vs-actual drift.  Volume-gated AND
+        # calibration-gated: an uncalibrated daemon (no autotune loop,
+        # or none of its fits installed yet) predicts from another
+        # platform's constants — orders-of-magnitude "drift" there is
+        # the expected state autotune exists to fix, not ill health.
+        predicted = delta("cm_predicted",
+                          _counter_total("tsd.costmodel.predicted_ms"))
+        actual = delta("cm_actual",
+                       _counter_total("tsd.costmodel.actual_ms"))
+        calibrator = getattr(tsdb, "autotuner", None)
+        fitted = calibrator is not None and calibrator.fits > 0
+        level, detail = "ok", (
+            "insufficient device time in window" if fitted
+            else "uncalibrated (no live fit installed)")
+        if fitted and actual >= _COSTMODEL_MIN_ACTUAL_MS \
+                and predicted > 0:
+            ratio = max(predicted / actual, actual / predicted)
+            detail = "predicted %.0fms vs actual %.0fms (x%.1f drift, " \
+                "limit x%.1f)" % (predicted, actual, ratio,
+                                  self.costmodel_drift)
+            if ratio > self.costmodel_drift > 0:
+                level = "failing" if ratio > 4 * self.costmodel_drift \
+                    else "degraded"
+        verdicts["costmodel"] = {"level": level, "detail": detail}
+
+        # spill: pool saturation
+        pool = getattr(tsdb, "spill_pool", None)
+        level, detail = "ok", "spill pool disabled"
+        if pool is not None:
+            budget = pool.host_budget + pool.disk_budget
+            resident = pool.host_bytes + pool.disk_bytes
+            util = resident / budget if budget > 0 else 0.0
+            detail = "%.0f%% of %.0fMB pool resident" \
+                % (util * 100, budget / 2**20)
+            if util >= 1.0:
+                level = "failing"
+            elif util > self.spill_saturation > 0:
+                level = "degraded"
+        verdicts["spill"] = {"level": level, "detail": detail}
+
+        # cluster: breaker flap + currently-open breakers
+        state = getattr(tsdb, "_cluster_state", None)
+        level, detail = "ok", "no clustered serving yet"
+        if state is not None:
+            breakers = state.breakers()
+            opens = delta("breaker_opens",
+                          sum(b.opens for b in breakers.values()))
+            open_now = [p for p, b in breakers.items()
+                        if b.state != b.CLOSED]
+            detail = "%d open transitions in window; open now: %s" \
+                % (opens, ",".join(sorted(open_now)) or "none")
+            if opens > self.breaker_flap > 0:
+                level = "failing" if opens > 2 * self.breaker_flap \
+                    else "degraded"
+            if open_now:
+                level = _worst(level, "degraded")
+        verdicts["cluster"] = {"level": level, "detail": detail}
+
+        self._publish(verdicts, cur, now)
+        return verdicts
+
+    def _publish(self, verdicts: dict[str, dict], cur: dict[str, float],
+                 now: float) -> None:
+        gauge = REGISTRY.gauge(
+            "tsd.health.status",
+            "Health-engine verdict per subsystem (0 ok, 1 degraded, "
+            "2 failing)")
+        with self._lock:
+            previous = {k: v["level"] for k, v in self._verdicts.items()}
+            self._verdicts = verdicts
+            self._last = cur
+            self._last_eval_t = now
+            self.passes += 1
+            self._evaluated_ms = int(time.time() * 1e3)
+        changed = []
+        for name, verdict in verdicts.items():
+            gauge.labels(subsystem=name).set(
+                _LEVEL_NUM[verdict["level"]])
+            before = previous.get(name, "ok")
+            if verdict["level"] != before:
+                changed.append((name, before, verdict))
+        recorder = getattr(self.tsdb, "flightrec", None)
+        if recorder is not None:
+            for name, before, verdict in changed:
+                recorder.record("health", subsystem=name,
+                                before=before, level=verdict["level"],
+                                detail=verdict["detail"])
+
+    # -- reporting ------------------------------------------------------- #
+
+    def report(self) -> dict:
+        """The /api/diag/health payload.  Evaluates inline when no
+        maintenance pass has run yet, so a freshly-started (or
+        maintenance-less library) daemon still answers with real
+        verdicts instead of an empty shell."""
+        with self._lock:
+            passes = self.passes
+        if passes == 0:
+            self.evaluate()
+        with self._lock:
+            verdicts = {k: dict(v) for k, v in self._verdicts.items()}
+            passes = self.passes
+            evaluated = self._evaluated_ms
+        overall = "ok"
+        for v in verdicts.values():
+            overall = _worst(overall, v["level"])
+        return {"overall": overall, "subsystems": verdicts,
+                "passes": passes, "evaluatedMs": evaluated}
+
+    # -- stats ----------------------------------------------------------- #
+
+    def stats_hook(self, collector) -> None:
+        """The /api/stats + self-report view of the verdicts — the TSD
+        can query its own health history (obs/selfreport.py ingests
+        these through the same walk, ro-skip preserved)."""
+        with self._lock:
+            verdicts = {k: v["level"] for k, v in self._verdicts.items()}
+            passes = self.passes
+        collector.record("health.passes", passes)
+        for name, level in verdicts.items():
+            collector.record("health.status", _LEVEL_NUM[level],
+                             "subsystem=%s" % name)

@@ -37,7 +37,7 @@ class LogHistogram:
     """
 
     __slots__ = ("lo", "growth", "buckets", "_log_growth", "_lock",
-                 "counts", "count", "total")
+                 "counts", "count", "total", "exemplars")
 
     def __init__(self, lo: float = DEFAULT_LO,
                  growth: float = DEFAULT_GROWTH,
@@ -54,6 +54,10 @@ class LogHistogram:
         self.counts = [0] * (self.buckets + 1)
         self.count = 0  # guarded-by: _lock
         self.total = 0.0  # guarded-by: _lock
+        # last exemplar per FINE bucket: {index: (label, value)} —
+        # bounded by the bucket count; populated only when observers
+        # pass one (obs/flightrec.py trace ids)  # guarded-by: _lock
+        self.exemplars: dict[int, tuple[str, float]] = {}
 
     def _index(self, value: float) -> int:
         if value <= self.lo:
@@ -68,7 +72,7 @@ class LogHistogram:
             return math.inf
         return self.lo * self.growth ** index
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         if value != value or value < 0:        # NaN / negative
             raise ValueError("invalid observation: %r" % value)
         idx = self._index(value)
@@ -76,6 +80,8 @@ class LogHistogram:
             self.counts[idx] += 1
             self.count += 1
             self.total += value
+            if exemplar is not None:
+                self.exemplars[idx] = (exemplar, value)
 
     def merge(self, other: "LogHistogram") -> None:
         if (other.lo, other.growth, other.buckets) != \
@@ -140,4 +146,30 @@ class LogHistogram:
             out.append((self.bound(hi_i - 1), cum))
         cum += counts[self.buckets]
         out.append((math.inf, cum))
+        return out
+
+    def exemplar_entries(self, max_buckets: int = 24
+                         ) -> list[tuple[float, str, float]]:
+        """[(coarse_upper_bound, exemplar_label, observed_value)] using
+        the SAME aligned coarsening as `cumulative`, so each exemplar
+        attaches to a bucket bound the scrape actually exposes.  Within
+        a coarse bucket the highest fine bucket's exemplar wins (the
+        tail-most observation is the diagnostic one)."""
+        with self._lock:
+            snap = dict(self.exemplars)
+        if not snap:
+            return []
+        step = max(-(-self.buckets // max(max_buckets - 1, 1)), 1)
+        out: list[tuple[float, str, float]] = []
+        for lo_i in range(0, self.buckets, step):
+            hi_i = min(lo_i + step, self.buckets)
+            best = None
+            for i in range(lo_i, hi_i):
+                if i in snap:
+                    best = snap[i]
+            if best is not None:
+                out.append((self.bound(hi_i - 1), best[0], best[1]))
+        if self.buckets in snap:
+            label, value = snap[self.buckets]
+            out.append((math.inf, label, value))
         return out

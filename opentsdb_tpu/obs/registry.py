@@ -124,8 +124,8 @@ class Family:
     def set(self, v: float) -> None:
         self.labels().set(v)
 
-    def observe(self, v: float) -> None:
-        self.labels().observe(v)
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        self.labels().observe(v, exemplar=exemplar)
 
     def children(self) -> list[tuple[tuple[tuple[str, str], ...], object]]:
         with self._lock:
@@ -174,10 +174,14 @@ class MetricsRegistry:
     # -- exposition ---------------------------------------------------- #
 
     def prometheus_text(self, extra_records: list[dict] | None = None,
-                        hist_buckets: int = 24) -> str:
+                        hist_buckets: int = 24,
+                        exemplars: bool = False) -> str:
         """The full scrape body: every registry family, then every
         StatsCollector record (as gauges) whose name does not collide
-        with a registry family."""
+        with a registry family.  ``exemplars`` additionally emits
+        OpenMetrics-style exemplar COMMENT lines per histogram bucket
+        (`# exemplar: <bucket> {trace_id="..."} <value>`) — comments,
+        so the text stays exposition-format-0.0.4 parseable."""
         lines: list[str] = []
         emitted: set[str] = set()
         for fam in self.families():
@@ -193,7 +197,8 @@ class MetricsRegistry:
             for labels, child in fam.children():
                 if fam.kind == "histogram":
                     self._render_histogram(lines, pname, labels, child,
-                                           hist_buckets)
+                                           hist_buckets,
+                                           exemplars=exemplars)
                 else:
                     lines.append("%s%s %s" % (sample, _label_str(labels),
                                               _fmt(child.get())))
@@ -211,7 +216,8 @@ class MetricsRegistry:
     @staticmethod
     def _render_histogram(lines: list[str], pname: str,
                           labels: tuple[tuple[str, str], ...],
-                          hist: LogHistogram, max_buckets: int) -> None:
+                          hist: LogHistogram, max_buckets: int,
+                          exemplars: bool = False) -> None:
         _counts, count, total = hist.snapshot()
         for bound, cum in hist.cumulative(max_buckets):
             # 6 significant digits: bounds are exact powers of the
@@ -220,6 +226,18 @@ class MetricsRegistry:
             lines.append("%s_bucket%s %d"
                          % (pname, _label_str(labels, 'le="%s"' % le),
                             cum))
+        if exemplars:
+            # OpenMetrics-style exemplars as 0.0.4-safe COMMENT lines:
+            # a strict text-format parser skips anything starting '#',
+            # while an operator (or the scrape-side regex in our own
+            # tests) can join a tail bucket to its flight-recorder
+            # trace id
+            for bound, label, value in hist.exemplar_entries(max_buckets):
+                le = "+Inf" if bound == math.inf else "%.6g" % bound
+                lines.append(
+                    '# exemplar: %s_bucket%s {trace_id="%s"} %s'
+                    % (pname, _label_str(labels, 'le="%s"' % le),
+                       escape_label_value(label), _fmt(value)))
         lines.append("%s_sum%s %s" % (pname, _label_str(labels),
                                       _fmt(total)))
         lines.append("%s_count%s %d" % (pname, _label_str(labels), count))

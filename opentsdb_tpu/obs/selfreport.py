@@ -8,6 +8,14 @@ a dashboard can query the daemon about itself with ordinary /api/query
 downsample/rate semantics.  tsd.stats.interval (seconds) gates the
 cadence from the maintenance thread; 0 (the default) disables it.
 
+Because the walk IS the stats-hook registry, the health engine's
+verdicts (tsd.health.status per subsystem, obs/health.py) and the
+flight recorder's per-tenant demand counters (tsd.diag.tenant.demand,
+obs/flightrec.py) land here too: the TSD can query its own health and
+demand HISTORY — "when did admission start degrading" is an ordinary
+downsample query over tsd.health.status.  Read-only daemons still skip
+the write (the ro gate below), exactly as before.
+
 Metric UIDs auto-create for the tsd.* namespace even when
 tsd.core.auto_create_metrics is off: the operator's ingest policy
 governs CLIENT data, and a stats loop that silently dropped every

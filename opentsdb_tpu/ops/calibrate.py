@@ -385,6 +385,12 @@ class OnlineCalibrator:
             LOG.info("costmodel fit installed for %s: %d samples, "
                      "residual %.3f, %d terms", plat, info["samples"],
                      info["residual"], len(fitted))
+            recorder = getattr(self.tsdb, "flightrec", None)
+            if recorder is not None:
+                recorder.record("autotune", action="fit", platform=plat,
+                                samples=int(info["samples"]),
+                                residual=round(float(info["residual"]),
+                                               4))
         return installed
 
     # -- exploration --------------------------------------------------- #
@@ -438,6 +444,13 @@ class OnlineCalibrator:
                 axis=axis).inc()
         LOG.info("costmodel exploration: forcing %s mode %r for one "
                  "interval", axis, mode)
+        recorder = getattr(self.tsdb, "flightrec", None)
+        if recorder is not None:
+            # a mode flip clears the dependent jit caches — exactly the
+            # event a "why did serving recompile at 14:32" post-mortem
+            # needs retained
+            recorder.record("autotune", action="explore", axis=axis,
+                            mode=mode)
 
     def _end_exploration(self) -> None:
         with self._lock:
@@ -446,6 +459,10 @@ class OnlineCalibrator:
         if active is None:
             return
         _axis_setters()[active["axis"]]("auto")
+        recorder = getattr(self.tsdb, "flightrec", None)
+        if recorder is not None:
+            recorder.record("autotune", action="restore",
+                            axis=active["axis"], mode=active["mode"])
 
     # -- persistence --------------------------------------------------- #
 

@@ -473,11 +473,18 @@ def run_tiled(tsdb, spec, seg, series_list, gid, g_pad: int, window_spec,
             pres = _jitted_presence(g_pad, jnp.asarray(A), gid_dev)
             out_val[:, w0:w1] = np.asarray(ov)[:, :n]
             out_mask[:, w0:w1] = np.asarray(pres)[:, :n]
-        return (wts_full, out_val, out_mask), {
-            "tiles": plan.n_tiles, "stripes": plan.n_stripes,
-            "spillBytes": int(spilled_bytes),
-            "chunks": int(chunks_total),
-            "predictedMs": round(plan.predicted_s * 1e3, 3),
-            "source": plan.source}
+        stats = {"tiles": plan.n_tiles, "stripes": plan.n_stripes,
+                 "spillBytes": int(spilled_bytes),
+                 "chunks": int(chunks_total),
+                 "predictedMs": round(plan.predicted_s * 1e3, 3),
+                 "source": plan.source}
+        recorder = getattr(tsdb, "flightrec", None)
+        if recorder is not None:
+            # retained spill evidence: tile/stripe split + bytes
+            # through the pool (host-ring demotions surface in the
+            # tsd.query.spill.* gauges; the event ties the traffic to
+            # the query's trace id)
+            recorder.record("tiling", series=s, windows=w, **stats)
+        return (wts_full, out_val, out_mask), stats
     finally:
         pool.release(keys)

@@ -51,6 +51,13 @@ class QueryCancelledException(QueryException):
         super().__init__(message, status=503)
 
 
+class QueryDeadlineExpired(QueryException):
+    """The request outlived its wall budget (`Deadline.check`).  Same
+    413 shape/message as the reference timeout — a distinct type so the
+    error envelope (tsd/rpc_manager.py) can record a `deadline` event
+    in the flight recorder without string-matching the message."""
+
+
 class Deadline:
     """One request-scoped wall budget + cooperative cancellation token.
 
@@ -118,7 +125,7 @@ class Deadline:
             raise QueryCancelledException(
                 "Query cancelled: %s" % (self._cancel_reason or "unknown"))
         if self.expired():
-            raise QueryException(
+            raise QueryDeadlineExpired(
                 "Sorry, your query timed out. Time limit: %d ms, elapsed: "
                 "%d ms. Please try filtering using more tags or decrease "
                 "your time range." % (self.timeout_ms, self.elapsed_ms()))
@@ -412,7 +419,11 @@ class QueryBudget:
             return
         elapsed_ms = (time.monotonic() - self.start) * 1000.0
         if elapsed_ms > self.timeout_ms:
-            raise QueryException(
+            # same type as Deadline.check's expiry so the error
+            # envelope records a `deadline` flight-recorder event for
+            # BOTH timeout arms (a budget running without an ambient
+            # Deadline must not be invisible in the black box)
+            raise QueryDeadlineExpired(
                 "Sorry, your query timed out. Time limit: %d ms, elapsed: "
                 "%d ms. Please try filtering using more tags or decrease "
                 "your time range." % (self.timeout_ms, elapsed_ms))

@@ -587,6 +587,7 @@ class QueryRunner:
                           "tsd.query.host_lane.max_points")
                       and cpu_device() is not None)
         agg_plan = None
+        agg_note = None
         if (tiled_plan is None and lane_plan is None
                 and tsdb.agg_cache is not None
                 and not would_stream
@@ -756,6 +757,39 @@ class QueryRunner:
                     max(max(c) for _, _, c in kept), window_spec.count,
                     len(kept), host_small, policy_epoch)
         obs_trace.end(psp)
+        recorder = getattr(tsdb, "flightrec", None)
+        if recorder is not None:
+            # ONE flight-recorder event per executed pipeline: which
+            # path served it and what the fast-path consults decided —
+            # the retained form of the span annotations above, so a
+            # post-mortem reads routing decisions without any client
+            # having asked for showStats
+            if lane_plan is not None:
+                path = "rollup_lane"
+            elif tiled_plan is not None:
+                path = "tiled"
+            elif agg_plan is not None:
+                path = "agg_rewrite"
+            elif cached is None and would_stream:
+                path = "streamed"
+            elif seg.kind == "rollup_avg":
+                path = "rollup_avg"
+            elif use_mesh:
+                path = "mesh"
+            elif host_small:
+                path = "host_lane"
+            else:
+                path = "resident"
+            fields = {"path": path, "metric": sub.metric,
+                      "series": len(gid), "windows": window_spec.count,
+                      "groups": len(kept), "points": int(total_points),
+                      "deviceCacheHit": cached is not None}
+            if tsdb.rollup_lanes is not None:
+                fields["rollup"] = ("hit" if lane_plan is not None
+                                    else "miss")
+            if agg_note is not None:
+                fields["aggCache"] = agg_note
+            recorder.record("plan", **fields)
         with obs_trace.stage("extract"):
             out_ts = np.asarray(out_ts)
             out_val = np.asarray(out_val)

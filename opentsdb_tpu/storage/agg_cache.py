@@ -198,6 +198,10 @@ class AggregateCache:
             "tsd.query.cache.amortize_horizon"), 1)
         self.dispatch_overhead_s = config.get_int(
             "tsd.query.cache.dispatch_overhead_us") * 1e-6
+        # flight recorder (obs/flightrec.py), attached by the TSDB
+        # after construction: mark-ring overflows and device-tier
+        # demotions are retained diagnostics
+        self.recorder = None
         self._lock = threading.Lock()
         # the cached blocks — THE backing store of this cache; dropped
         # wholesale by `invalidate()` (targeted drops are generation-
@@ -313,6 +317,7 @@ class AggregateCache:
         bounds = open) — block entries overlapping the range fail their
         generation check from now on, everything else keeps serving.
         Without a metric: drop everything (/api/dropcaches)."""
+        overflowed = False
         with self._lock:
             if metric is None:
                 self.invalidations += 1
@@ -350,7 +355,13 @@ class AggregateCache:
                     oldest = ring[0]
                     self._floor[key] = max(self._floor.get(key, 0),
                                            oldest[0])
+                    overflowed = True
                 ring.append((self._gen, lo, hi))
+        if overflowed and self.recorder is not None:
+            # diagnosable event: hot ingest outran the mark ring and a
+            # floor generation now hides history for this metric —
+            # warm repeats will recompute until blocks rebuild
+            self.recorder.record("agg_mark_overflow", metric=metric)
         REGISTRY.counter(
             "tsd.query.cache.invalidations",
             "Query-cache invalidation marks (ingest dirty ranges, "

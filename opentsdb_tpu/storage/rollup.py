@@ -178,6 +178,10 @@ class RollupLanes:
             config.get_int("tsd.rollup.refresh_blocks"), 1)
         self.delay_ms = max(config.get_int("tsd.rollup.delay_ms"), 0)
         self.fix_duplicates = config.fix_duplicates
+        # flight recorder (obs/flightrec.py), attached by the TSDB
+        # after construction: maintenance build passes are retained
+        # diagnostics (lane staleness post-mortems start there)
+        self.recorder = None
         self._lock = threading.Lock()
         # the materialized lane blocks — THE backing store of this
         # subsystem; (metric, lane_ms, block_idx) -> _LaneBlock, dict
@@ -610,6 +614,15 @@ class RollupLanes:
             max_blocks = self.refresh_blocks
         if now_ms is None:
             now_ms = DT.current_time_millis()
+        built = self._refresh(store, max_blocks, now_ms)
+        if built and self.recorder is not None:
+            with self._lock:
+                resident = len(self._blocks)
+            self.recorder.record("rollup_build", blocks=built,
+                                 resident=resident)
+        return built
+
+    def _refresh(self, store, max_blocks: int, now_ms: int) -> int:
         with self._lock:
             demand = sorted(self._demand.items(),
                             key=lambda kv: -kv[1]["saving_s"])

@@ -93,6 +93,10 @@ class SpillPool:
         self._lock = threading.Lock()
         self.host_budget = max(int(host_budget_bytes), 0)
         self.disk_budget = max(int(disk_budget_bytes), 0)
+        # flight recorder (obs/flightrec.py), attached by the TSDB
+        # after construction: host->disk demotions are retained
+        # diagnostics (spill pressure is how the HBM wall shows up)
+        self.recorder = None
         self._configured_dir = directory or None
         self._dir: str | None = None       # guarded-by: _lock (lazy tempdir)
         self._own_dir = False              # guarded-by: _lock
@@ -213,6 +217,8 @@ class SpillPool:
                     "Partial grids written to the spill pool, by "
                     "landing tier").labels(tier="disk").inc()
             self._gauges_locked()
+        if not stale and self.recorder is not None:
+            self.recorder.record("spill_demote", bytes=int(nbytes))
         for p in stale:
             try:
                 os.unlink(p)

@@ -134,10 +134,14 @@ class StatsRpc(TelnetRpc, HttpRpc):
             # registry counters/gauges/latency histograms first, then
             # every StatsCollector record (device cache, breakers,
             # compaction, ingest counters) as gauges — the records
-            # already carry the host tag, so nothing re-registers them
+            # already carry the host tag, so nothing re-registers them.
+            # tsd.diag.exemplars additionally links histogram tail
+            # buckets to flight-recorder trace ids via comment lines
+            # (the format stays 0.0.4-parseable).
             from opentsdb_tpu.obs.registry import REGISTRY
             text = REGISTRY.prometheus_text(
-                extra_records=self._collect(tsdb).records)
+                extra_records=self._collect(tsdb).records,
+                exemplars=tsdb.config.get_bool("tsd.diag.exemplars"))
             query.send_reply(
                 text,
                 content_type="text/plain; version=0.0.4; charset=utf-8")
@@ -212,6 +216,58 @@ class StatsRpc(TelnetRpc, HttpRpc):
                     for g in __import__("gc").get_stats()),
             },
         }
+
+
+class DiagRpc(HttpRpc):
+    """/api/diag (+ /slow, /health): the flight-recorder ring, the
+    slow-query store, and the health-engine verdicts
+    (obs/flightrec.py, obs/health.py; docs/observability.md).
+
+      * ``/api/diag``              the event ring, oldest first.
+        ``?since=<seq>`` returns only events newer than that sequence
+        number — poll with the last ``seq`` you saw for an incremental
+        feed.
+      * ``/api/diag/slow``         retained slow/anomalous queries
+        (span tree + costmodel decisions + ring slice), newest first.
+      * ``/api/diag/health``       per-subsystem ok/degraded/failing
+        verdicts (the chaos_soak post-heal gate).
+    """
+
+    def execute_http(self, tsdb, query: HttpQuery) -> None:
+        allowed_methods(query, "GET")
+        sub = query.api_subpath()
+        endpoint = sub[0] if sub else ""
+        if endpoint == "health":
+            engine = getattr(tsdb, "health", None)
+            if engine is None:
+                raise BadRequestError(
+                    "The health engine is disabled", status=404,
+                    details="Set tsd.health.enable=true")
+            query.send_reply(engine.report())
+            return
+        recorder = getattr(tsdb, "flightrec", None)
+        if recorder is None:
+            raise BadRequestError(
+                "The flight recorder is disabled", status=404,
+                details="Set tsd.diag.enable=true")
+        if endpoint == "slow":
+            query.send_reply({"queries": recorder.slow_queries()})
+            return
+        if endpoint:
+            raise BadRequestError(
+                "No such diag endpoint: %s" % endpoint, status=404)
+        raw = query.get_query_string_param("since")
+        try:
+            since = int(raw) if raw else 0
+        except ValueError:
+            raise BadRequestError("'since' must be an integer sequence "
+                                  "number")
+        events = recorder.events(since=since)
+        query.send_reply({
+            "seq": recorder.latest_seq(),
+            "ringSize": recorder.ring_size,
+            "events": events,
+        })
 
 
 class LogBuffer(logging.Handler):

@@ -59,6 +59,9 @@ from opentsdb_tpu.utils import faults
 # min(tsd.query.timeout, this header)).
 DEADLINE_HEADER = "x-tsdb-deadline-ms"
 PRIORITY_HEADER = "x-tsdb-priority"
+# Clamped to the registered/hashed tenant table (obs/flightrec.py
+# clamp_tenant) before it mints any metric label.
+TENANT_HEADER = "x-tsdb-tenant"
 
 # Priority classes, drain order first to last.  An unknown/absent
 # header value lands in the first class.
@@ -353,6 +356,10 @@ class Permit:
         self._gate = gate
         self._t0 = time.monotonic()
         self.degrade_note: dict | None = None
+        # the clamped tenant of the admitted request — set by admit()
+        # so downstream accounting (per-tenant latency histograms,
+        # slow-query captures) reuses ONE clamping decision
+        self.tenant = "default"
 
     def __enter__(self) -> "Permit":
         return self
@@ -573,13 +580,16 @@ def admit(tsdb, ts_query, http_query=None,
     The decision is traced as an ``admission`` child span (wait ms,
     decision, queue depth, predicted vs remaining ms).
     """
+    from opentsdb_tpu.obs.flightrec import clamp_tenant
     gate = gate_for(tsdb)
     deadline = active_deadline()
     priority = ""
     fanout = False
+    tenant_raw = None
     if http_query is not None:
         priority = (http_query.request.header(PRIORITY_HEADER)
                     or "").strip().lower()
+        tenant_raw = http_query.request.header(TENANT_HEADER)
         # a peer's raw-extraction sub-request must NEVER degrade: the
         # coordinator merges raw points verbatim and drops any
         # annotation entry (no "metric" key), so a peer-side
@@ -589,8 +599,17 @@ def admit(tsdb, ts_query, http_query=None,
         fanout = bool(http_query.request.header("x-tsdb-cluster"))
     if priority not in CLASSES:
         priority = CLASSES[0]
-    with obs_trace.stage("admission", route=route,
-                         priority=priority) as span:
+    tenant = clamp_tenant(tsdb.config, tenant_raw)
+    # per-tenant demand: one tick per arriving query, BEFORE the
+    # verdict — the fair-share scheduler (ROADMAP item 1) needs to see
+    # demand it refused, not just demand it served
+    REGISTRY.counter(
+        "tsd.query.tenant.demand",
+        "Queries arriving at admission, by clamped tenant").labels(
+            tenant=tenant).inc()
+    recorder = getattr(tsdb, "flightrec", None)
+    with obs_trace.stage("admission", route=route, priority=priority,
+                         tenant=tenant) as span:
         if deadline is not None:
             # an ALREADY-dead request (expired before admission, or
             # disconnect flipped the token mid-parse) raises its own
@@ -610,6 +629,13 @@ def admit(tsdb, ts_query, http_query=None,
                                        remaining_ms, queue_ms)
                 if note is None:
                     obs_trace.annotate(span, decision="shed")
+                    if recorder is not None:
+                        recorder.record(
+                            "admission", decision="shed",
+                            reason="predicted_cost", route=route,
+                            priority=priority, tenant=tenant,
+                            predictedMs=round(predicted_ms, 3),
+                            remainingMs=round(remaining_ms, 3))
                     raise gate._shed(
                         "predicted_cost",
                         "Sorry, this query's predicted cost (%d ms) "
@@ -627,15 +653,26 @@ def admit(tsdb, ts_query, http_query=None,
         try:
             permit = gate.acquire(deadline, priority, route=route)
         except QueryException as e:
-            obs_trace.annotate(
-                span, decision="shed" if isinstance(e, ShedError)
-                else "cancelled",
-                wait_ms=round((time.monotonic() - t0) * 1e3, 3))
+            wait_ms = round((time.monotonic() - t0) * 1e3, 3)
+            decision = "shed" if isinstance(e, ShedError) else "cancelled"
+            obs_trace.annotate(span, decision=decision, wait_ms=wait_ms)
+            if recorder is not None:
+                recorder.record("admission", decision=decision,
+                                route=route, priority=priority,
+                                tenant=tenant, waitMs=wait_ms)
             raise
         permit.degrade_note = note
-        obs_trace.annotate(
-            span, decision="degraded" if note else "admitted",
-            wait_ms=round((time.monotonic() - t0) * 1e3, 3))
+        permit.tenant = tenant
+        wait_ms = round((time.monotonic() - t0) * 1e3, 3)
+        decision = "degraded" if note else "admitted"
+        obs_trace.annotate(span, decision=decision, wait_ms=wait_ms)
+        if recorder is not None:
+            fields = {"decision": decision, "route": route,
+                      "priority": priority, "tenant": tenant,
+                      "waitMs": wait_ms}
+            if note:
+                fields["degraded"] = note
+            recorder.record("admission", **fields)
         return permit
 
 

@@ -112,10 +112,16 @@ class CircuitBreaker:
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
-    def __init__(self, threshold: int, cooldown_s: float, clock=time.monotonic):
+    def __init__(self, threshold: int, cooldown_s: float, clock=time.monotonic,
+                 listener=None):
         self.threshold = threshold
         self.cooldown_s = cooldown_s
         self._clock = clock
+        # state-transition callback `listener(old, new)`, invoked
+        # OUTSIDE _lock (the flight recorder takes its own lock; a
+        # callback under ours would order the two) — may observe a
+        # state that already moved on, never a torn one
+        self._listener = listener
         self._lock = threading.Lock()
         # guarded-by: _lock
         self.state = self.CLOSED
@@ -126,6 +132,10 @@ class CircuitBreaker:
         self.opens = 0
         # calls refused while open (stats)  # guarded-by: _lock
         self.fast_fails = 0
+
+    def _notify(self, old: str, new: str) -> None:
+        if self._listener is not None and old != new:
+            self._listener(old, new)
 
     def allow(self) -> bool:
         """True if a fetch may proceed now.  While open, the first call
@@ -139,16 +149,20 @@ class CircuitBreaker:
                 if self._clock() - self.opened_at >= self.cooldown_s:
                     self.state = self.HALF_OPEN
                     self._probing = True
-                    return True
-                self.fast_fails += 1
-                return False
+                    transition = (self.OPEN, self.HALF_OPEN)
+                else:
+                    self.fast_fails += 1
+                    return False
             # half-open: exactly one probe in flight.  Not counted as a
             # fast fail — callers may WAIT on the probe's verdict
             # (probe_pending) instead of failing.
-            if self._probing:
+            elif self._probing:
                 return False
-            self._probing = True
-            return True
+            else:
+                self._probing = True
+                return True
+        self._notify(*transition)
+        return True
 
     def probe_pending(self) -> bool:
         """True while a half-open probe is in flight — a sibling fetch
@@ -160,27 +174,34 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            old = self.state
             self.state = self.CLOSED
             self.consecutive_failures = 0
             self._probing = False
+        self._notify(old, self.CLOSED)
 
     def record_failure(self) -> None:
         if self.threshold <= 0:
             return
+        old = new = None
         with self._lock:
             if self.state == self.HALF_OPEN:
                 # failed probe: back to a full cooldown
+                old, new = self.state, self.OPEN
                 self.state = self.OPEN
                 self.opened_at = self._clock()
                 self.opens += 1
                 self._probing = False
-                return
-            self.consecutive_failures += 1
-            if (self.state == self.CLOSED
-                    and self.consecutive_failures >= self.threshold):
-                self.state = self.OPEN
-                self.opened_at = self._clock()
-                self.opens += 1
+            else:
+                self.consecutive_failures += 1
+                if (self.state == self.CLOSED
+                        and self.consecutive_failures >= self.threshold):
+                    old, new = self.state, self.OPEN
+                    self.state = self.OPEN
+                    self.opened_at = self._clock()
+                    self.opens += 1
+        if new is not None:
+            self._notify(old, new)
 
 
 class ClusterState:
@@ -188,11 +209,15 @@ class ClusterState:
     counters /api/stats surfaces.  Lives across queries (attached to the
     TSDB instance by _state below)."""
 
-    def __init__(self, config):
+    def __init__(self, config, recorder=None):
         self.threshold = config.get_int(
             "tsd.network.cluster.breaker.threshold")
         self.cooldown_s = config.get_int(
             "tsd.network.cluster.breaker.cooldown_ms") / 1e3
+        # flight recorder (obs/flightrec.py): breaker transitions are
+        # retained diagnostics — an operator reading /api/diag after a
+        # partial-results burst sees WHICH peer flapped and when
+        self.recorder = recorder
         self._lock = threading.Lock()
         # guarded-by: _lock
         self._breakers: dict[str, CircuitBreaker] = {}
@@ -201,12 +226,22 @@ class ClusterState:
         self.partial_queries = 0  # guarded-by: _lock
         self.failed_queries = 0  # guarded-by: _lock
 
+    def _transition_listener(self, peer: str):
+        recorder = self.recorder
+        if recorder is None:
+            return None
+
+        def on_transition(old: str, new: str) -> None:
+            recorder.record("breaker", peer=peer, before=old, state=new)
+        return on_transition
+
     def breaker(self, peer: str) -> CircuitBreaker:
         with self._lock:
             b = self._breakers.get(peer)
             if b is None:
                 b = self._breakers[peer] = CircuitBreaker(
-                    self.threshold, self.cooldown_s)
+                    self.threshold, self.cooldown_s,
+                    listener=self._transition_listener(peer))
             return b
 
     def count(self, attr: str, n: int = 1) -> None:
@@ -227,7 +262,9 @@ def _state(tsdb) -> ClusterState:
         with _STATE_LOCK:
             state = getattr(tsdb, "_cluster_state", None)
             if state is None:
-                state = ClusterState(tsdb.config)
+                state = ClusterState(tsdb.config,
+                                     recorder=getattr(tsdb, "flightrec",
+                                                      None))
                 tsdb._cluster_state = state
     return state
 
@@ -320,7 +357,8 @@ def _sub_json(raw: TSQuery, index: int) -> dict:
 
 def _fetch_peer(peer: str, body: dict, timeout_s: float,
                 trace_id: str | None = None,
-                deadline=None) -> list[dict]:
+                deadline=None, tenant_header: str | None = None
+                ) -> list[dict]:
     faults.check("cluster.peer_fetch", peer=peer)
     headers = {"Content-Type": "application/json",
                "X-TSDB-Cluster": "fanout"}
@@ -328,6 +366,13 @@ def _fetch_peer(peer: str, body: dict, timeout_s: float,
         # the receiving TSD adopts this id for ITS trace of the raw
         # fetch — one clustered query, one trace id across every host
         headers["X-TSDB-Trace-Id"] = trace_id
+    if tenant_header:
+        # the client's RAW tenant header travels with the fan-out (each
+        # peer clamps against its own registered table, like the
+        # coordinator did) — peer-side per-tenant demand/latency
+        # accounting must attribute the load to the real tenant, not
+        # "default"
+        headers["X-TSDB-Tenant"] = tenant_header
     if deadline is not None:
         # don't even connect when done for — an UNBOUNDED deadline is
         # still a cancellation token (client disconnect, server drain),
@@ -378,7 +423,8 @@ class PeerRejectedError(RuntimeError):
 def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
                    body: dict, span=None,
                    trace_id: str | None = None,
-                   deadline=None) -> list[dict]:
+                   deadline=None,
+                   tenant_header: str | None = None) -> list[dict]:
     """One peer fetch under the full fault-tolerance stack: breaker
     fast-fail, then retries with backoff inside the overall budget
     (already clamped to the request deadline's remainder).
@@ -389,7 +435,7 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
     carries so an operator can see WHY a 200 is partial."""
     try:
         return _guarded_fetch_inner(state, policy, peer, body, span,
-                                    trace_id, deadline)
+                                    trace_id, deadline, tenant_header)
     finally:
         if span is not None:
             span.tags["breaker"] = state.breaker(peer).state
@@ -399,7 +445,8 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
 def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
                          peer: str, body: dict, span,
                          trace_id: str | None,
-                         deadline=None) -> list[dict]:
+                         deadline=None,
+                         tenant_header: str | None = None) -> list[dict]:
     breaker = state.breaker(peer)
     if span is not None:
         span.tags.setdefault("retries", 0)
@@ -432,7 +479,8 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
 
     def fetch(timeout_s: float) -> list[dict]:
         try:
-            return _fetch_peer(peer, body, timeout_s, trace_id, deadline)
+            return _fetch_peer(peer, body, timeout_s, trace_id, deadline,
+                               tenant_header=tenant_header)
         except urllib.error.HTTPError as e:
             if 400 <= e.code < 500:
                 raise PeerRejectedError(
@@ -512,7 +560,11 @@ def serve_query(tsdb, ts_query: TSQuery, http_query=None,
             and (http_query is None or not is_fanout_request(http_query)) \
             and not getattr(ts_query, "delete", False) \
             and all(sub.metric for sub in ts_query.queries):
-        return run_clustered(tsdb, ts_query, exec_stats=exec_stats)
+        from opentsdb_tpu.tsd.admission import TENANT_HEADER
+        tenant_header = (http_query.request.header(TENANT_HEADER)
+                         if http_query is not None else None)
+        return run_clustered(tsdb, ts_query, exec_stats=exec_stats,
+                             tenant_header=tenant_header)
     runner = tsdb.new_query_runner()
     out = runner.run(ts_query)
     if exec_stats is not None:
@@ -520,7 +572,8 @@ def serve_query(tsdb, ts_query: TSQuery, http_query=None,
     return out
 
 
-def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
+def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None,
+                  tenant_header: str | None = None):
     """Fan the query's raw-series extraction across this host and every
     peer, fold everything into a scratch store, run the ORIGINAL query
     against it.  Returns the planner's QueryResult list (drop-in for
@@ -548,9 +601,18 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
     scratch = TSDB(Config({
         "tsd.core.auto_create_metrics": True,
         # serving knobs only — the scratch is a per-query aggregation
-        # buffer, not a daemon
+        # buffer, not a daemon: no flight recorder or health engine of
+        # its own (constructing one per clustered query would be waste,
+        # and its ring would be discarded with the scratch)
         "tsd.query.device_cache.enable": "false",
+        "tsd.diag.enable": "false",
+        "tsd.health.enable": "false",
     }))
+    # the scratch runner's planner events must land in the SERVING
+    # daemon's flight recorder — they carry the request's trace id, so
+    # a clustered query's plan decisions stay reconstructible from the
+    # coordinator's /api/diag ring
+    scratch.flightrec = getattr(tsdb, "flightrec", None)
     total = 0
 
     # peer fetches submit FIRST so they overlap the local extraction
@@ -578,7 +640,8 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None):
                     if parent is not None else None)
             futures[pool.submit(_guarded_fetch, state, policy, peer,
                                 _sub_json(raw, i), span,
-                                trace_id, deadline)] = (peer, i, span)
+                                trace_id, deadline,
+                                tenant_header)] = (peer, i, span)
 
     failed_peers: set[str] = set()
     # local extraction: straight off this host's store/planner (objects,

@@ -109,6 +109,10 @@ class RpcManager:
             http["api/stats"] = stats
             http["api/version"] = version
             http["api/serializers"] = admin_rpcs.SerializersRpc()
+            # flight recorder + health engine (obs/flightrec.py,
+            # obs/health.py): /api/diag, /api/diag/slow,
+            # /api/diag/health — mounted in every mode like /api/stats
+            http["api/diag"] = admin_rpcs.DiagRpc()
 
         put = rpcs.PutDataPointRpc()
         rollups = rpcs.RollupDataPointRpc()
@@ -248,7 +252,10 @@ class RpcManager:
                 route=route, status=str(status)).inc()
         REGISTRY.histogram(
             "tsd.http.latency_ms", "HTTP request latency (ms)").labels(
-                route=route).observe((time.perf_counter() - start) * 1e3)
+                route=route).observe(
+                    (time.perf_counter() - start) * 1e3,
+                    exemplar=trace.trace_id if trace is not None
+                    else None)
         return query
 
     def _mint_deadline(self, request: HttpRequest) -> "limits.Deadline":
@@ -330,6 +337,20 @@ class RpcManager:
         except Exception as e:  # uniform error envelope
             status = error_status(e)
             self._count_error(status)
+            recorder = getattr(self.tsdb, "flightrec", None)
+            if recorder is not None:
+                # deadline expiries/cancellations and 5xx envelopes are
+                # flight-recorder events: a wedge's last moments must
+                # be reconstructible from the ring alone
+                if isinstance(e, limits.QueryDeadlineExpired):
+                    recorder.record("deadline", outcome="expired",
+                                    path=request.path, status=status)
+                elif isinstance(e, limits.QueryCancelledException):
+                    recorder.record("deadline", outcome="cancelled",
+                                    path=request.path, status=status)
+                if status >= 500:
+                    recorder.record("http_error", status=status,
+                                    path=request.path)
             if status >= 500 and not isinstance(e, limits.QueryException):
                 # expected client mistakes (4xx) stay quiet, and so do
                 # deliberate 5xx query verdicts (admission sheds,

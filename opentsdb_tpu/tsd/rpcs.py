@@ -537,8 +537,13 @@ class QueryRpc(HttpRpc):
                     status="200").inc()
             REGISTRY.histogram(
                 "tsd.query.latency_ms",
-                "End-to-end /api/query latency (ms)").observe(
-                    query.elapsed_ms())
+                "End-to-end /api/query latency (ms), by tenant").labels(
+                    tenant=permit.tenant).observe(
+                        query.elapsed_ms(),
+                        exemplar=trace.trace_id if trace is not None
+                        else None)
+            self._maybe_capture_slow(tsdb, query, trace, qs, 200,
+                                     permit.tenant)
             if qs is not None and self.stats_registry is not None:
                 qs.mark("serializationTime")
                 self.stats_registry.finish(qs, 200)
@@ -548,9 +553,25 @@ class QueryRpc(HttpRpc):
             REGISTRY.counter(
                 "tsd.query.count", "Queries served").labels(
                     status=str(status)).inc()
+            self._maybe_capture_slow(tsdb, query, trace, qs, status,
+                                     permit.tenant)
             if qs is not None and self.stats_registry is not None:
                 self.stats_registry.finish(qs, status, str(e))
             raise
+
+    @staticmethod
+    def _maybe_capture_slow(tsdb, query: HttpQuery, trace, qs,
+                            status: int, tenant: str) -> None:
+        """Flight-recorder slow-query capture (obs/flightrec.py): a
+        query past the absolute/rolling-quantile latency threshold
+        retains its span tree + ring slice at /api/diag/slow — no
+        showStats required."""
+        recorder = getattr(tsdb, "flightrec", None)
+        if recorder is None:
+            return
+        recorder.maybe_capture_slow(
+            trace, query.elapsed_ms(), status,
+            qs.query if qs is not None else None, tenant)
 
     def _delete(self, tsdb, ts_query: TSQuery) -> int:
         """Drop the matched datapoints after serving them (delete flag).

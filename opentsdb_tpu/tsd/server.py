@@ -119,22 +119,38 @@ class TSDServer:
         # shutdown-event path both reach stop) must not decrement on
         # behalf of another still-running server.
         self._compile_counting = tsdb.config.get_bool("tsd.trace.enable")
-        from opentsdb_tpu.tsd.admin_rpcs import install_log_buffer
-        # global-install: uninstall_log_buffer paired-with: stop
-        install_log_buffer()
-        self._log_buffer_installed = True
-        if self._compile_counting:
-            # per-kernel XLA compile counters (tsd.jax.compiles at
-            # /api/stats/prometheus) — the same capture tsdbsan uses
-            from opentsdb_tpu.obs import jaxprof
-            try:
+        self._log_buffer_installed = False
+        # staged arming with ONE rollback path: a failure part-way in
+        # must release exactly what already installed, newest first
+        undo: list = []
+        try:
+            from opentsdb_tpu.tsd.admin_rpcs import (install_log_buffer,
+                                                     uninstall_log_buffer)
+            # global-install: uninstall_log_buffer paired-with: stop
+            install_log_buffer()
+            self._log_buffer_installed = True
+            undo.append(uninstall_log_buffer)
+            if self._compile_counting:
+                # per-kernel XLA compile counters (tsd.jax.compiles at
+                # /api/stats/prometheus) — the same capture tsdbsan uses
+                from opentsdb_tpu.obs import jaxprof
                 # global-install: stop_compile_counting paired-with: stop
                 jaxprof.start_compile_counting()
-            except BaseException:
-                from opentsdb_tpu.tsd.admin_rpcs import uninstall_log_buffer
-                self._log_buffer_installed = False
-                uninstall_log_buffer()
-                raise
+                undo.append(jaxprof.stop_compile_counting)
+            if tsdb.flightrec is not None:
+                # steady-state recompile events into the flight
+                # recorder, off the SAME shared capture — armed
+                # REGARDLESS of tsd.trace.enable (the recorder is the
+                # always-on black box; tracing only governs the span
+                # surfaces).  The recorder unsubscribes in its own
+                # shutdown (tsdb.shutdown, reached from stop()).
+                tsdb.flightrec.start()
+        except BaseException:
+            self._compile_counting = False
+            self._log_buffer_installed = False
+            for release in reversed(undo):
+                release()
+            raise
 
     # -- lifecycle --
 

@@ -157,6 +157,84 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "daemon's own tsd.* metrics into its local store through the "
         "normal ingest path (0 = disabled).  The TSD becomes queryable "
         "about itself via ordinary /api/query."),
+    # -- flight recorder + diagnostics (obs/flightrec.py) --------------- #
+    "tsd.diag.enable": _e(
+        "bool", True, "Arm the always-on flight recorder: a bounded "
+        "ring of structured diagnostic events (admission verdicts, "
+        "cache/rollup consults, spills, autotune flips, breaker "
+        "transitions, deadline expiries, recompiles) served at "
+        "/api/diag and dumped at shutdown.  Also gates /api/diag/slow."),
+    "tsd.diag.ring_size": _e(
+        "int", "4096", "Flight-recorder ring capacity in events; "
+        "overflow drops the oldest."),
+    "tsd.diag.dump_path": _e(
+        "str", "", "Write the flight-recorder black box (ring + slow "
+        "captures, JSON) here at shutdown/SIGTERM.  Empty = no dump "
+        "artifact."),
+    "tsd.diag.slow_ms": _e(
+        "int", "0", "Absolute slow-query capture threshold in ms: a "
+        "query at least this slow retains its span tree + "
+        "flight-recorder slice at /api/diag/slow without showStats.  "
+        "0 disables the absolute arm."),
+    "tsd.diag.slow_quantile": _e(
+        "float", "0.99", "Rolling-quantile slow-capture arm: capture "
+        "queries above this quantile of the recorder's own latency "
+        "histogram (active once enough samples accrue).  0 disables."),
+    "tsd.diag.slow_keep": _e(
+        "int", "32", "Bounded slow-query store capacity; overflow "
+        "drops the oldest capture."),
+    "tsd.diag.exemplars": _e(
+        "bool", False, "Emit OpenMetrics-style exemplar COMMENT lines "
+        "(trace ids per histogram bucket) on /api/stats/prometheus, "
+        "linking tail-latency buckets to flight-recorder traces.  The "
+        "text format stays 0.0.4-parseable."),
+    "tsd.diag.tenants": _e(
+        "str", "", "Comma-separated registered tenant names for the "
+        "X-TSDB-Tenant header.  Registered tenants keep their name as "
+        "a metric label; everything else hashes into "
+        "tsd.diag.tenant_buckets buckets (cardinality clamp)."),
+    "tsd.diag.tenant_buckets": _e(
+        "int", "16", "Hash buckets for unregistered tenant header "
+        "values (0 collapses them all to 'other')."),
+    # -- health engine (obs/health.py) ---------------------------------- #
+    "tsd.health.enable": _e(
+        "bool", True, "Evaluate the declared health invariants "
+        "(shed burn, steady-state recompiles, cache hit collapse, "
+        "costmodel drift, spill saturation, breaker flap) into "
+        "per-subsystem ok/degraded/failing verdicts at "
+        "/api/diag/health and tsd.health.* gauges."),
+    "tsd.health.interval": _e(
+        "int", "10", "Seconds between health-engine passes on the "
+        "maintenance cadence (each pass judges the window since the "
+        "previous one)."),
+    "tsd.health.shed_rate": _e(
+        "float", "0.5", "Admission sheds per second over the window "
+        "above which the admission subsystem reads degraded "
+        "(failing at 4x)."),
+    "tsd.health.recompile_warmup": _e(
+        "int", "120", "Seconds after startup before the steady-state "
+        "recompile invariant arms (first-touch compiles are "
+        "legitimate)."),
+    "tsd.health.recompile_limit": _e(
+        "int", "0", "XLA compilations tolerated per window once "
+        "warmed up; beyond it the compile subsystem reads degraded "
+        "(failing past limit+4)."),
+    "tsd.health.cache_hit_floor": _e(
+        "float", "0.05", "Aggregate-cache hit fraction under which a "
+        "busy window (>= 16 consults) reads degraded — the hit-rate-"
+        "collapse invariant."),
+    "tsd.health.costmodel_drift": _e(
+        "float", "40", "Predicted-vs-actual device-ms ratio (either "
+        "direction) above which the costmodel subsystem reads "
+        "degraded (failing at 4x); volume-gated."),
+    "tsd.health.spill_saturation": _e(
+        "float", "0.9", "Spill-pool resident fraction of the combined "
+        "host+disk budget above which the spill subsystem reads "
+        "degraded (failing at 100%)."),
+    "tsd.health.breaker_flap": _e(
+        "int", "3", "Circuit-breaker open transitions per window "
+        "above which the cluster subsystem reads degraded (failing "
+        "at 2x); any breaker currently open is at least degraded."),
     # -- costmodel autotune (ops/calibrate.py, docs/costmodel.md) ------ #
     "tsd.costmodel.autotune.enable": _e(
         "bool", False, "Online costmodel calibration: fit the kernel-"

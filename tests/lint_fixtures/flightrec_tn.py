@@ -1,0 +1,31 @@
+"""Flight-recorder lifecycle true negatives: the sanctioned shapes —
+subscribe paired with a shutdown-reachable unsubscribe (the
+obs/flightrec.py FlightRecorder lifecycle), and a dump that closes its
+handle on every path.  Parsed, never imported."""
+
+
+class GoodRecorder:
+    """Install in start, uninstall in shutdown — the FlightRecorder
+    shape (obs/flightrec.py)."""
+
+    def __init__(self, capture):
+        self.capture = capture
+
+    def start(self):
+        # global-install: unsubscribe paired-with: shutdown
+        self.capture.subscribe(self._on_compile)
+
+    def shutdown(self):
+        self.capture.unsubscribe(self._on_compile)
+        dump_with_close("events.json", [])
+
+    def _on_compile(self, kernel):
+        return kernel
+
+
+def dump_with_close(path, events):
+    """The sanctioned dump: a with-block closes the black box even
+    when the JSON encode raises."""
+    import json
+    with open(path, "w") as fh:
+        json.dump(list(events), fh)

@@ -1,0 +1,53 @@
+"""Flight-recorder lifecycle true positives (ISSUE 12): a recorder
+whose compile-capture subscription pairing rotted, and a shutdown dump
+that leaks its file handle.  Parsed, never imported."""
+
+
+class BadRecorderPairingGutted:
+    """The pairing function exists but was 'simplified' and no longer
+    unsubscribes — the capture handler would outlive the recorder."""
+
+    def __init__(self, capture):
+        self.capture = capture
+        # global-install: unsubscribe paired-with: shutdown  # EXPECT: install-missing-uninstall
+        capture.subscribe(self._on_compile)
+
+    def shutdown(self):
+        self.capture = None
+
+    def _on_compile(self, kernel):
+        return kernel
+
+
+class BadRecorderUnreachableUninstall:
+    """The uninstall exists and works — but no shutdown/close/stop
+    path ever reaches it, so the black box never detaches."""
+
+    def __init__(self, capture):
+        self.capture = capture
+        # global-install: unsubscribe paired-with: detach  # EXPECT: install-unreachable-uninstall
+        capture.subscribe(self._on_compile)
+
+    def detach(self):
+        self.capture.unsubscribe(self._on_compile)
+
+    def _on_compile(self, kernel):
+        return kernel
+
+
+def dump_leaks_handle(path, events):
+    """A shutdown dump that drops its handle: the black box file may
+    be torn/unflushed exactly when it matters (SIGTERM)."""
+    import json
+    fh = open(path, "w")                     # EXPECT: resource-leak
+    fh.write(json.dumps(list(events)))
+
+
+def dump_leaks_on_early_return(path, events, enabled):
+    import json
+    fh = open(path, "w")
+    if not enabled:
+        return None                          # EXPECT: resource-leak-return
+    fh.write(json.dumps(list(events)))
+    fh.close()
+    return path

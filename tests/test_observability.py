@@ -164,6 +164,14 @@ class TestPrometheus:
         assert r.headers["Content-Type"].startswith("text/plain")
         return r.body.decode()
 
+    # OpenMetrics-style exemplar COMMENT lines (tsd.diag.exemplars):
+    # `# exemplar: <bucket sample> {trace_id="..."} <value>` — a
+    # comment, so the 0.0.4 text format stays parseable
+    EXEMPLAR = re.compile(
+        r'^# exemplar: [a-zA-Z_:][a-zA-Z0-9_:]*_bucket'
+        r'\{[^}]*le="[^"]+"\} \{trace_id="[0-9a-f]{16}"\} '
+        r"[-+0-9.eE]+$")
+
     def test_exposition_is_scrapeable(self, manager):
         text = self._scrape(manager)
         assert text.endswith("\n")
@@ -171,6 +179,29 @@ class TestPrometheus:
             if not line or line.startswith("#"):
                 continue
             assert self.SAMPLE.match(line), "unscrapeable line: %r" % line
+
+    def test_exemplars_link_buckets_to_trace_ids(self, tsdb, manager):
+        """tsd.diag.exemplars surfaces per-bucket trace ids as comment
+        lines; every NON-comment line stays 0.0.4-parseable, so a
+        strict scraper sees the exact same sample set."""
+        tsdb.config.override_config("tsd.diag.exemplars", True)
+        text = self._scrape(manager)
+        exemplars = [ln for ln in text.splitlines()
+                     if ln.startswith("# exemplar: ")]
+        assert exemplars, "traced serving must retain bucket exemplars"
+        for ln in exemplars:
+            assert self.EXEMPLAR.match(ln), "malformed exemplar: %r" % ln
+        assert any("tsd_query_latency_ms_bucket" in ln
+                   for ln in exemplars)
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert self.SAMPLE.match(line), "unscrapeable line: %r" % line
+
+    def test_exemplars_off_by_default(self, manager):
+        text = self._scrape(manager)
+        assert not any(ln.startswith("# exemplar") for ln in
+                       text.splitlines())
 
     def test_counters_gauges_histograms_present(self, tsdb, manager):
         from opentsdb_tpu.tsd import cluster
@@ -183,22 +214,41 @@ class TestPrometheus:
         assert 'peer="10.0.0.1:4242"' in text
 
     def test_histogram_triplets_are_consistent(self, manager):
+        # tsd.query.latency_ms is tenant-labeled (ISSUE 12): the
+        # bucket/_sum/_count triplet contract holds PER CELL — other
+        # tests in the session may have minted more tenants into the
+        # process-shared registry
+        from collections import defaultdict
         text = self._scrape(manager)
-        buckets = [ln for ln in text.splitlines()
-                   if ln.startswith("tsd_query_latency_ms_bucket")]
-        count_line = [ln for ln in text.splitlines()
-                      if ln.startswith("tsd_query_latency_ms_count")]
-        sum_line = [ln for ln in text.splitlines()
-                    if ln.startswith("tsd_query_latency_ms_sum")]
-        assert buckets and count_line and sum_line
-        inf = [ln for ln in buckets if 'le="+Inf"' in ln]
-        assert inf, "+Inf bucket required"
-        count = int(count_line[0].rsplit(" ", 1)[1])
-        assert int(inf[0].rsplit(" ", 1)[1]) == count >= 1
-        # cumulative counts are non-decreasing
-        values = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
-        assert values == sorted(values)
-        assert float(sum_line[0].rsplit(" ", 1)[1]) >= 0
+        lines = text.splitlines()
+
+        def cell_key(line):
+            name = line.split(" ")[0]
+            m = re.search(r"\{(.*)\}", name)
+            return tuple(sorted(
+                kv for kv in (m.group(1).split(",") if m else [])
+                if not kv.startswith("le=")))
+
+        buckets: dict = defaultdict(list)
+        counts: dict = {}
+        sums: dict = {}
+        for ln in lines:
+            if ln.startswith("tsd_query_latency_ms_bucket"):
+                buckets[cell_key(ln)].append(ln)
+            elif ln.startswith("tsd_query_latency_ms_count"):
+                counts[cell_key(ln)] = int(ln.rsplit(" ", 1)[1])
+            elif ln.startswith("tsd_query_latency_ms_sum"):
+                sums[cell_key(ln)] = float(ln.rsplit(" ", 1)[1])
+        assert buckets and counts and sums
+        assert set(buckets) == set(counts) == set(sums)
+        for key, blines in buckets.items():
+            inf = [ln for ln in blines if 'le="+Inf"' in ln]
+            assert inf, "+Inf bucket required in %r" % key
+            assert int(inf[0].rsplit(" ", 1)[1]) == counts[key] >= 1
+            # cumulative counts are non-decreasing within the cell
+            values = [int(ln.rsplit(" ", 1)[1]) for ln in blines]
+            assert values == sorted(values)
+            assert sums[key] >= 0
 
     def test_label_escaping(self):
         reg = MetricsRegistry()

@@ -500,6 +500,7 @@ def run_cache_stage(port: int, rounds: int) -> None:
         "tsd.faults.config": json.dumps([
             {"site": "wal.append", "kind": "error", "times": 6},
         ]),
+        "tsd.health.interval": "2",
     }, role="cache")
     ctrl = spawn_tsd(port + 1, {
         **shared_cfg,
@@ -579,6 +580,14 @@ def run_cache_stage(port: int, rounds: int) -> None:
                   % scrape.get("tsd_query_cache_hits_total"),
                   flush=True)
             raise SystemExit(1)
+        # post-heal diagnostics: every subsystem ok (incl. the cache
+        # hit-rate invariant under the round load) AND the WAL fault
+        # burst's 500 envelopes retained in the ring
+        check_diag_gate(port, "cache", [
+            ("http_error 5xx (wal.append burst)",
+             lambda e: e.get("kind") == "http_error"
+             and e.get("status", 0) >= 500),
+        ])
         print("[cache] %d rounds, zero divergence, %d agg-tier hits, "
               "%d faulted burst puts healed"
               % (max(rounds, 10), int(agg_hits), burst_failures),
@@ -630,6 +639,7 @@ def run_rollup_stage(port: int, rounds: int) -> None:
         "tsd.faults.config": json.dumps([
             {"site": "wal.append", "kind": "error", "times": 6},
         ]),
+        "tsd.health.interval": "2",
     }, role="rollup")
     ctrl = spawn_tsd(port + 1, shared_cfg, role="rollup-control")
 
@@ -701,6 +711,16 @@ def run_rollup_stage(port: int, rounds: int) -> None:
                   % scrape.get("tsd_rollup_lane_hits_total"),
                   flush=True)
             raise SystemExit(1)
+        # post-heal diagnostics: health all-ok, the WAL burst's 500s
+        # AND at least one lane-served plan retained in the ring
+        check_diag_gate(port, "rollup", [
+            ("http_error 5xx (wal.append burst)",
+             lambda e: e.get("kind") == "http_error"
+             and e.get("status", 0) >= 500),
+            ("rollup-lane plan",
+             lambda e: e.get("kind") == "plan"
+             and e.get("path") == "rollup_lane"),
+        ])
         print("[rollup] %d rounds, zero divergence, %d lane hits, "
               "%d faulted burst puts healed"
               % (max(rounds, 10), int(lane_hits), burst_failures),
@@ -755,6 +775,7 @@ def run_spill_stage(port: int, rounds: int) -> None:
         "tsd.faults.config": json.dumps([
             {"site": "spill.write", "kind": "error", "times": 3},
         ]),
+        "tsd.health.interval": "2",
     }, role="spill")
     ctrl = spawn_tsd(port + 1, {
         **shared_cfg,
@@ -835,6 +856,12 @@ def run_spill_stage(port: int, rounds: int) -> None:
             print("[spill] disk tier never engaged (evictions/spills "
                   "all host)", flush=True)
             raise SystemExit(1)
+        # post-heal diagnostics: health all-ok (incl. spill saturation
+        # after per-query release) and the tiled executions retained
+        check_diag_gate(port, "spill", [
+            ("tiling event",
+             lambda e: e.get("kind") == "tiling"),
+        ])
         print("[spill] %d rounds, zero divergence, %d tiles, %d disk "
               "demotions, %d faulted attempts healed"
               % (max(rounds, 5), int(tiles), int(disk), burned),
@@ -867,6 +894,51 @@ def _prom_sum(scrape: dict, name: str) -> float:
     return sum(scrape.get(name, {}).values())
 
 
+def check_diag_gate(port: int, stage: str, evidence: list,
+                    timeout_s: float = 60.0) -> None:
+    """Post-heal diagnostics gate (ISSUE 12): /api/diag/health must
+    report EVERY subsystem ok, and the flight recorder's ring must
+    still hold the injected fault's events — a daemon that "healed"
+    while its recorder missed the fault window fails the stage (the
+    black box exists precisely for that window).
+
+    ``evidence`` is [(label, predicate)] over the /api/diag events.
+    """
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            payload = json.loads(urllib.request.urlopen(
+                "http://127.0.0.1:%d/api/diag/health" % port,
+                timeout=10).read())
+        except OSError as e:
+            last = {"error": str(e)}
+            time.sleep(1.0)
+            continue
+        subs = payload.get("subsystems", {})
+        last = {k: v.get("level") for k, v in subs.items()}
+        if subs and all(v.get("level") == "ok" for v in subs.values()):
+            break
+        time.sleep(1.0)
+    else:
+        print("[%s] health gate FAILED: subsystems never all ok "
+              "within %.0fs: %r" % (stage, timeout_s, last), flush=True)
+        raise SystemExit(1)
+    diag = json.loads(urllib.request.urlopen(
+        "http://127.0.0.1:%d/api/diag" % port, timeout=10).read())
+    events = diag.get("events", [])
+    for label, pred in evidence:
+        if not any(pred(e) for e in events):
+            print("[%s] flight recorder MISSED the injected fault: no "
+                  "'%s' event among %d retained (kinds: %r)"
+                  % (stage, label, len(events),
+                     sorted({e.get("kind") for e in events})),
+                  flush=True)
+            raise SystemExit(1)
+    print("[%s] diag gate OK: health all-ok, recorder holds: %s"
+          % (stage, ", ".join(lb for lb, _ in evidence)), flush=True)
+
+
 def run_overload_stage(port: int, rounds: int) -> None:
     """--overload: saturating mixed load against ONE TSD whose
     admission gate is tightly bounded, with an injected slow-handler
@@ -896,6 +968,8 @@ def run_overload_stage(port: int, rounds: int) -> None:
         "tsd.faults.config": fault,
         # grouped queries probe the mesh; shard_map is absent at HEAD
         "tsd.query.mesh.enable": "false",
+        # fast health cadence so the post-heal diag gate converges
+        "tsd.health.interval": "2",
     }, role="overload")
     try:
         for host, value in (("a", 1), ("b", 2)):
@@ -1019,6 +1093,13 @@ def run_overload_stage(port: int, rounds: int) -> None:
             print("[overload] daemon did not heal after the fault "
                   "lifted (still shedding or failing)", flush=True)
             raise SystemExit(1)
+        # post-heal diagnostics: every subsystem ok AND the burst's
+        # sheds retained in the flight recorder
+        check_diag_gate(port, "overload", [
+            ("admission shed",
+             lambda e: e.get("kind") == "admission"
+             and e.get("decision") == "shed"),
+        ])
         print("[overload] %d responses OK: %s, in-flight max %.0f/%d, "
               "admitted p99 %.0fms, healed (shed rate 0)"
               % (len(results), tally, inflight_max[0], permits,
