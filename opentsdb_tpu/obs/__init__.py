@@ -79,6 +79,15 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "Queries arriving at the admission gate, by clamped tenant — "
         "the per-tenant demand telemetry the fair-share scheduler "
         "(ROADMAP item 1) consumes."),
+    "tsd.query.explain.requests": _m(
+        "counter", ("outcome",),
+        "/api/query/explain requests served, by outcome (ok/error).  "
+        "Explain acquires no admission permit and dispatches no "
+        "device work (query/explain.py)."),
+    "tsd.query.explain.latency_ms": _m(
+        "histogram", (),
+        "Explain planning latency in milliseconds — the no-dispatch "
+        "decision walk, including the admission preview."),
     # -- admission control (tsd/admission.py) -------------------------- #
     "tsd.query.admission.queue_depth": _m(
         "gauge", ("priority",),

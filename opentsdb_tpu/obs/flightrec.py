@@ -254,10 +254,15 @@ class FlightRecorder:
                     tenant=tenant)
         return True
 
-    def slow_queries(self) -> list[dict]:
-        """The retained slow captures, newest first."""
+    def slow_queries(self, trace_id: str | None = None) -> list[dict]:
+        """The retained slow captures, newest first; with a trace id,
+        only the captures for that trace (the one-request lookup an
+        explain fingerprint's exemplar resolves through)."""
         with self._lock:
-            return list(self._slow)[::-1]
+            snap = list(self._slow)[::-1]
+        if trace_id:
+            snap = [e for e in snap if e.get("traceId") == trace_id]
+        return snap
 
     # -- shutdown dump ---------------------------------------------------- #
 

@@ -198,9 +198,12 @@ def _table_key(platform: str) -> str:
     return "cpu" if platform == "cpu" else "tpu"
 
 
-def _build_table_locked() -> dict[str, dict[str, float]]:
-    table = {p: dict(c) for p, c in DEFAULT_COSTS.items()}
-    _FILE_PLATFORMS.clear()
+def _apply_file_overrides(table: dict[str, dict[str, float]]) -> set[str]:
+    """Overlay BENCH_CALIBRATION.json onto a defaults table in place;
+    returns the platforms that took at least one override.  ONE parser
+    for the file layer — the serving table build and the what-if
+    repricer (`layer_table`) must never read the file differently."""
+    touched: set[str] = set()
     try:
         with open(_CALIBRATION_FILE) as fh:
             for plat, over in json.load(fh).items():
@@ -208,9 +211,16 @@ def _build_table_locked() -> dict[str, dict[str, float]]:
                     for k, v in over.items():
                         if k in table[plat]:
                             table[plat][k] = float(v)
-                            _FILE_PLATFORMS.add(plat)
+                            touched.add(plat)
     except (OSError, ValueError):
         pass
+    return touched
+
+
+def _build_table_locked() -> dict[str, dict[str, float]]:
+    table = {p: dict(c) for p, c in DEFAULT_COSTS.items()}
+    _FILE_PLATFORMS.clear()
+    _FILE_PLATFORMS.update(_apply_file_overrides(table))
     for plat, over in _LIVE.items():
         if plat in table:
             table[plat].update(over)
@@ -243,6 +253,23 @@ def calibration_source(platform: str) -> str:
         if key in _FILE_PLATFORMS:
             return "file"
         return "default"
+
+
+def layer_table(platform: str, layer: str) -> dict[str, float]:
+    """A COPY of the per-unit cost table as a specific layer would
+    price it — the what-if repricer's view (query/explain.py):
+    'default' = the shipped constants, 'file' = defaults +
+    BENCH_CALIBRATION.json, 'auto' (or anything else) = the live
+    three-layer table ``costs()`` serves.  Never consulted by the
+    serving argmin, and never cached — explain is cold-path."""
+    key = _table_key(platform)
+    if layer == "default":
+        return dict(DEFAULT_COSTS[key])
+    if layer == "file":
+        table = {p: dict(c) for p, c in DEFAULT_COSTS.items()}
+        _apply_file_overrides(table)
+        return table[key]
+    return dict(costs(platform))
 
 
 def install_live_calibration(platform: str,

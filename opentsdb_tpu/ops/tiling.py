@@ -134,21 +134,26 @@ def count_refusal(reason: str) -> None:
 
 
 def plan_tiled(tsdb, *, s: int, w: int, g_pad: int, acc_cell_bytes: int,
-               total_points: int, platform: str) -> TilePlan | None:
+               total_points: int, platform: str,
+               state_mb: int | None = None,
+               observe: bool = True) -> TilePlan | None:
     """Size and price a tiled execution for an over-budget [s, w] plan.
 
     Returns None (with the refusal reason counted under
     ``tsd.query.spill.refusals``) when the pool is disabled, the spill
     bytes exceed the pool's combined budgets, or no tile split fits the
-    device budget."""
+    device budget.  ``observe=False`` (the explain engine's dry-run)
+    suppresses the refusal counters; ``state_mb`` overrides the
+    configured device budget for what-if sizing."""
     from opentsdb_tpu.ops import costmodel as cm
 
-    refuse = count_refusal
+    refuse = count_refusal if observe else (lambda reason: None)
     pool = getattr(tsdb, "spill_pool", None)
     if pool is None:
         refuse("disabled")
         return None
-    state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
+    if state_mb is None:
+        state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
     budget_bytes = state_mb * 2**20
     chunk_points = max(tsdb.config.get_int(
         "tsd.query.streaming.chunk_points"), 1)

@@ -441,42 +441,10 @@ class QueryRunner:
 
         total_points = sum(sum(c) for _, _, c in kept)
         ds_fn = seg.ds_function or ds.function
-        sketchable = (is_sketch_ds(ds_fn) and tsdb.config.get_bool(
-            "tsd.query.streaming.sketch_percentiles"))
-        if sketchable:
-            # Auto-protect (VERDICT r3 #7): a (series, window) cell drifts
-            # ~merges/(2K) of its population in rank; when the densest
-            # cell would absorb more chunk merges than the configured
-            # bound (window span >> chunk span — the "0all over a year"
-            # shape), fall back to the exact path, which the scan budgets
-            # either serve materialized or refuse with the 413 contract.
-            # The estimate is skew-exact (review r4): per series, the
-            # window ids of the streaming CHUNK BOUNDARIES (every
-            # n_chunk-th point, O(points/chunk) to fetch) are counted —
-            # a cell's merge count is that window's boundary multiplicity
-            # + 1, so points concentrated in one window are seen as the
-            # many merges they cause, not averaged away.
-            max_merges = tsdb.config.get_int(
-                "tsd.query.streaming.sketch_max_merges")
-            if max_merges > 0:
-                chunk_points = max(tsdb.config.get_int(
-                    "tsd.query.streaming.chunk_points"), 1)
-                n_chunk = pad_pow2(max(1024,
-                                       chunk_points // max(len(gid), 1)))
-                worst = 0
-                for _, members, counts in kept:
-                    for (s, _t), c in zip(members, counts):
-                        if c <= n_chunk:
-                            continue    # single chunk: no merges at all
-                        tsb = s.window_stride_timestamps(
-                            seg.start_ms, seg.end_ms, n_chunk, fix)
-                        wids = self._host_window_ids(windows, tsb)
-                        if len(wids):
-                            worst = max(worst, int(np.max(
-                                np.unique(wids, return_counts=True)[1])))
-                if worst + 1 > max_merges:
-                    sketchable = False
-                    self.exec_stats["sketchHazardExact"] = 1.0
+        sketchable, hazard = self._sketch_eligible(seg, ds_fn, windows,
+                                                   kept, len(gid), fix)
+        if hazard:
+            self.exec_stats["sketchHazardExact"] = 1.0
         stream_ok = (seg.kind != "rollup_avg"
                      and (ds_fn in STREAMABLE_DS or sketchable))
         self._bump("pointsScanned", total_points)
@@ -484,167 +452,66 @@ class QueryRunner:
         mesh = tsdb.query_mesh()
         use_mesh = (mesh is not None and len(gid) >= tsdb.config.get_int(
             "tsd.query.mesh.min_series"))
-        # Device-cache fast path (BlockCache analog), tried BEFORE the
-        # streaming decision: a metric whose columns are already pinned in
-        # HBM answers materialized in one on-device gather — re-streaming
-        # it from host would pay the full transfer the cache exists to
-        # avoid.  batch_for declines (None) when cold, stale, over its
-        # byte budget, or when the expanded [S, N] batch would not fit.
-        cached = None
+        n_chips = 1
+        if use_mesh:
+            from opentsdb_tpu.parallel.sharded import n_devices
+            n_chips = n_devices(mesh)
         series_list = [s for _, members, _ in kept for s, _t in members]
-        would_stream = (stream_ok and total_points > tsdb.config.get_int(
-            "tsd.query.streaming.point_threshold"))
-
-        def grid_budget_decision():
-            # The materialized path has the streaming guard's hazard too:
-            # SPARSE series over a huge range with a fine interval build a
-            # [S, W] grid regardless of point count (a year at 10s windows
-            # is 3M+ columns).  Same knob, same 413 shape; ~3 grid lanes
-            # live through a dispatch (values, counts, mask/fill
-            # intermediates).  Per-chip when the mesh serves the query —
-            # the streamed path estimates per chip too (ADVICE r3
-            # medium) — but rollup_avg never shards and carries a second
-            # count-lane grid, so it is held to the flat single-chip
-            # estimate at double weight.
-            from opentsdb_tpu.query.limits import grid_budget
-            state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
-            n_chips, lanes = 1, 1
-            if seg.kind == "rollup_avg":
-                lanes = 2
-            elif use_mesh:
-                from opentsdb_tpu.parallel.sharded import n_devices
-                n_chips = n_devices(mesh)
-            grid_bytes = len(gid) * window_spec.count * 24 * lanes \
-                // n_chips
-            return grid_budget("grid", state_mb, grid_bytes, len(gid),
-                               window_spec.count)
-
-        def streaming_budget_decision():
-            # The accumulator grid is O(S x W x lane bytes); per-chip
-            # when the mesh shards the rows; the sketch lane dominates
-            # when present (see _stream_grouped, which re-checks the
-            # same shared decision as defense in depth).
-            from opentsdb_tpu.ops.streaming import SKETCH_K
-            from opentsdb_tpu.query.limits import grid_budget
-            state_mb = tsdb.config.get_int("tsd.query.streaming.state_mb")
-            lanes = lanes_for([ds_fn])
-            per_cell = 8 + 8 * len(lanes) \
-                + (4 * SKETCH_K if sketchable else 0)
-            n_chips = 1
-            if use_mesh:
-                from opentsdb_tpu.parallel.sharded import n_devices
-                n_chips = n_devices(mesh)
-            est = len(gid) * window_spec.count * per_cell // n_chips
-            return grid_budget("streaming", state_mb, est, len(gid),
-                               window_spec.count, sketch=sketchable)
-
-        # ONE budget verdict up front — BEFORE the device-cache lookup
-        # can trigger a cold inline [S, N] build (and evict warm
-        # entries) for a plan that cannot execute resident.  An
-        # over-budget plan no longer refuses outright: the tiled
-        # executor (ops/tiling.py, ROADMAP item 4) serves it when the
-        # spill pool and the costmodel-sized tile split allow;
-        # _maybe_tiled raises the shared structured 413 otherwise.
-        tiled_plan = None
-        gbd = (streaming_budget_decision() if would_stream
-               else grid_budget_decision())
-        # Rollup-lane consult (storage/rollup.py, ROADMAP item 2): THE
-        # shared fast-path hook the PR 9 and PR 10 rollup TODOs both
-        # resolve into — the over-budget (tiled) decision below and
-        # the resident agg-cache/device-cache chain consume ONE
-        # verdict instead of growing two fresh lane branches.  A
-        # fixed-interval plan whose interval is an integer multiple of
-        # a materialized lane and whose downsample function is
-        # lane-derivable answers EXACTLY from the lane's mergeable
-        # partials; everything else falls through unchanged.
-        lane_plan = self._consult_rollup_lanes(
-            psp, seg, sub, windows, window_spec, store, series_list,
-            gid, g_pad, ds_fn, use_mesh, total_points,
-            max(max(c) for _, _, c in kept))
-        if gbd.over and lane_plan is None:
-            tiled_plan = self._maybe_tiled(
-                gbd, seg, len(gid), window_spec, g_pad, ds_fn,
-                sketchable, stream_ok, total_points)
-        # Partial-aggregate rewrite (storage/agg_cache.py, ROADMAP
-        # item 2): fixed-grid raw downsample plans decompose into
-        # aligned blocks — cached blocks serve from the two-tier store
-        # and only the uncovered delta ranges dispatch.  The costmodel
-        # (and a repeat-count materialization rule) decides rewrite vs
-        # recompute; the decision is annotated on the pipeline span
-        # like every PR 6 strategy decision.  Tried BEFORE the device
-        # series cache: a warm rewrite skips the column gather too.
-        # ONE host-lane decision for this dispatch: the agg cache keys
-        # blocks on the execution platform and the dispatch chain picks
-        # its lane from the same value (host_small below) — a second
-        # derivation could drift and splice cross-platform block bits
-        # into one answer.
-        from opentsdb_tpu.ops.hostlane import (cpu_device,
-                                               execution_platform)
-        lane_small = (tiled_plan is None and lane_plan is None
-                      and not use_mesh
-                      and not would_stream
-                      and 0 < total_points <= tsdb.config.get_int(
-                          "tsd.query.host_lane.max_points")
-                      and cpu_device() is not None)
-        agg_plan = None
-        agg_note = None
-        if (tiled_plan is None and lane_plan is None
-                and tsdb.agg_cache is not None
-                and not would_stream
-                and not use_mesh and seg.kind == "raw"
-                and store is tsdb.store
-                and isinstance(windows, FixedWindows)):
-            agg_platform = "cpu" if lane_small else execution_platform()
-            agg_plan, agg_note = tsdb.agg_cache.plan(
-                store, series_list[0].key.metric, series_list, windows,
-                seg.start_ms, seg.end_ms, ds_fn, ds.fill_policy,
-                ds.fill_value, agg_platform, len(gid),
-                max(max(c) for _, _, c in kept), g_pad,
-                bool(sub.rate), total_points=int(total_points))
-            obs_trace.annotate(psp, agg_cache=agg_note)
-        if (tiled_plan is None and lane_plan is None
-                and agg_plan is None
-                and tsdb.device_cache is not None
-                and store is not None
-                and seg.kind in ("raw", "rollup")):
-            # Cold entries build inline only when the alternative is a full
-            # host materialization anyway; when streaming would serve this
-            # query, the cold build is deferred to the maintenance thread
-            # (stream now, hit HBM next time).  `store` is the EXACT store
-            # the series were resolved from (raw store, a rollup lane, or
-            # the pre-agg lane) — entries key on the store object, so each
-            # coexists in the cache.
-            # ts_base: eligible fixed grids get int32 offset timestamps
-            # straight from the gather (the compaction pass leaves the
-            # query dispatch — r4 chip attribution); shard_rows_device
-            # pads with the matching int32 sentinel for mesh re-scatter.
-            from opentsdb_tpu.ops.downsample import precompact_base
-            ts_base = precompact_base(
-                window_spec, getattr(windows, "first_window_ms", None))
-            cached = tsdb.device_cache.batch_for(
-                store, series_list[0].key.metric, series_list,
-                seg.start_ms, seg.end_ms, fix, build=not would_stream,
-                ts_base=ts_base)
-            if cached is not None and would_stream \
-                    and grid_budget_decision().over:
-                # a warm hit would divert this streaming query onto the
-                # materialized path, whose [S, W] grid estimate busts
-                # the budget the streaming estimate passed — DECLINE
-                # the diversion and stream (refusing here would 413 a
-                # query the streamed path serves fine)
-                cached = None
-            if cached is not None:
-                self.exec_stats["deviceCacheHit"] = 1.0
-                if ts_base is not None:
-                    import jax.numpy as jnp
-                    wargs = dict(wargs)
-                    wargs["ts_base"] = jnp.asarray(ts_base, jnp.int64)
-
-        # Small-query fast lane (VERDICT r3 weak #2): below the point
-        # threshold the same jitted pipeline runs on the host CPU —
-        # the accelerator dispatch floor dominates at this scale.  Never
-        # for mesh queries or device-cache hits (data already in HBM).
-        host_small = cached is None and lane_small
+        # ONE routing verdict for the whole fast-path arbitration
+        # (rollup lane -> tiled -> agg rewrite -> device cache ->
+        # streamed/mesh/host-lane/resident), computed by the SAME pure
+        # plan_decision() the EXPLAIN engine consults — eligibility
+        # gates, consult ordering, the shared grid_budget guard, and
+        # the path derivation live once (query/plandecision.py), so
+        # /api/query/explain and the dispatch below cannot drift.  The
+        # decision's stable fingerprint is stamped into the pipeline
+        # span and the flight-recorder plan event.
+        from opentsdb_tpu.ops.downsample import precompact_base
+        from opentsdb_tpu.ops.hostlane import cpu_device, execution_platform
+        from opentsdb_tpu.query import plandecision as pdn
+        ts_base = precompact_base(
+            window_spec, getattr(windows, "first_window_ms", None))
+        n_max = max(max(c) for _, _, c in kept)
+        ctx = pdn.RouteContext(
+            seg_kind=seg.kind, ds_fn=ds_fn, aggregator=sub.aggregator,
+            has_rate=bool(sub.rate), s=len(gid), n_max=int(n_max),
+            wp=window_spec.count, groups=len(kept), g_pad=g_pad,
+            total_points=int(total_points), sketchable=sketchable,
+            stream_ok=stream_ok, use_mesh=use_mesh, n_chips=n_chips,
+            windows_fixed=isinstance(windows, FixedWindows),
+            store_is_raw=store is tsdb.store,
+            has_store=store is not None,
+            platform=execution_platform(),
+            cpu_lane_ok=cpu_device() is not None,
+            state_mb=tsdb.config.get_int("tsd.query.streaming.state_mb"),
+            point_threshold=tsdb.config.get_int(
+                "tsd.query.streaming.point_threshold"),
+            host_lane_max=tsdb.config.get_int(
+                "tsd.query.host_lane.max_points"),
+            ts_base=ts_base)
+        pd = pdn.plan_decision(
+            tsdb, ctx, _ExecConsults(tsdb, ctx, seg, sub, windows,
+                                     store, series_list, fix))
+        if pd.lane_note is not None:
+            obs_trace.annotate(psp, rollup=pd.lane_note)
+        if pd.agg_note is not None:
+            obs_trace.annotate(psp, agg_cache=pd.agg_note)
+        obs_trace.annotate(psp, fingerprint=pd.fingerprint)
+        if pd.path == "refused":
+            # over-budget and untileable: the shared structured 413
+            # (the span is left unfinished inside the request trace,
+            # exactly as the pre-extraction code did)
+            self.exec_stats["tiledRefused"] = 1.0
+            raise pd.refusal.exception()
+        lane_plan, tiled_plan = pd.lane_plan, pd.tiled_plan
+        agg_plan, agg_note, cached = pd.agg_plan, pd.agg_note, pd.cached
+        would_stream, host_small = pd.would_stream, pd.host_small
+        if cached is not None:
+            self.exec_stats["deviceCacheHit"] = 1.0
+            if ts_base is not None:
+                import jax.numpy as jnp
+                wargs = dict(wargs)
+                wargs["ts_base"] = jnp.asarray(ts_base, jnp.int64)
         if host_small:
             self.exec_stats["hostLane"] = 1.0
         from opentsdb_tpu.ops.hostlane import host_lane
@@ -755,7 +622,8 @@ class QueryRunner:
                 self._trace_pipeline_stages(
                     psp, sub, seg, len(gid),
                     max(max(c) for _, _, c in kept), window_spec.count,
-                    len(kept), host_small, policy_epoch)
+                    len(kept), host_small, policy_epoch,
+                    decisions=pd.decisions)
         obs_trace.end(psp)
         recorder = getattr(tsdb, "flightrec", None)
         if recorder is not None:
@@ -763,27 +631,13 @@ class QueryRunner:
             # path served it and what the fast-path consults decided —
             # the retained form of the span annotations above, so a
             # post-mortem reads routing decisions without any client
-            # having asked for showStats
-            if lane_plan is not None:
-                path = "rollup_lane"
-            elif tiled_plan is not None:
-                path = "tiled"
-            elif agg_plan is not None:
-                path = "agg_rewrite"
-            elif cached is None and would_stream:
-                path = "streamed"
-            elif seg.kind == "rollup_avg":
-                path = "rollup_avg"
-            elif use_mesh:
-                path = "mesh"
-            elif host_small:
-                path = "host_lane"
-            else:
-                path = "resident"
-            fields = {"path": path, "metric": sub.metric,
+            # having asked for showStats.  The fingerprint is the
+            # explain-vs-actual parity handle (query/plandecision.py).
+            fields = {"path": pd.path, "metric": sub.metric,
                       "series": len(gid), "windows": window_spec.count,
                       "groups": len(kept), "points": int(total_points),
-                      "deviceCacheHit": cached is not None}
+                      "deviceCacheHit": cached is not None,
+                      "fingerprint": pd.fingerprint}
             if tsdb.rollup_lanes is not None:
                 fields["rollup"] = ("hit" if lane_plan is not None
                                     else "miss")
@@ -807,7 +661,8 @@ class QueryRunner:
     def _trace_pipeline_stages(self, span, sub: TSSubQuery, seg: Segment,
                                s: int, n: int, w: int, g: int,
                                host_small: bool = False,
-                               policy_epoch: int | None = None) -> None:
+                               policy_epoch: int | None = None,
+                               decisions: dict | None = None) -> None:
         """Logical stage children of the fused dispatch span + the
         costmodel predicted-vs-actual ledger entry.
 
@@ -840,9 +695,13 @@ class QueryRunner:
         # sized batches while one entry covers the whole range.
         n = pad_pow2(max(int(n), 1))
         g = pad_pow2(max(int(g), 1))
-        decisions = jaxprof.segment_decisions(platform, s, n, w, g,
-                                              ds_fn,
-                                              aggregator=sub.aggregator)
+        if decisions is None:
+            # direct callers without a PlanDecision in hand; the
+            # grouped executor passes plan_decision()'s reports through
+            # so the span, the fingerprint, and the calibration ring
+            # all describe ONE recomputation
+            decisions = jaxprof.segment_decisions(
+                platform, s, n, w, g, ds_fn, aggregator=sub.aggregator)
         obs_trace.annotate(span, costmodel=decisions)
         for axis, report in decisions.items():
             if not report["feasible"]:
@@ -1043,118 +902,50 @@ class QueryRunner:
             self.exec_stats["aggCacheHit"] = 1.0
         return out
 
-    def _maybe_tiled(self, gbd, seg, s: int, window_spec, g_pad: int,
-                     ds_fn: str, sketchable: bool, stream_ok: bool,
-                     total_points: int):
-        """Over-budget plan: size+price a tiled execution, or raise the
-        shared structured 413 the guard would have raised at HEAD.
+    def _sketch_eligible(self, seg: Segment, ds_fn: str, windows, kept,
+                         n_rows: int, fix: bool) -> tuple[bool, bool]:
+        """(sketchable, hazard_fallback) for one grouped segment —
+        shared by the executor and the explain engine (read-only store
+        walk, no dispatch).
 
-        Eligibility mirrors the streamed path (the tiled executor
-        streams each tile through the same accumulator): the downsample
-        function must merge associatively or sketch, and the spill pool
-        must be armed and big enough for the full partial grid."""
-        from opentsdb_tpu.ops import tiling
-        from opentsdb_tpu.ops.hostlane import execution_platform
+        Auto-protect (VERDICT r3 #7): a (series, window) cell drifts
+        ~merges/(2K) of its population in rank; when the densest cell
+        would absorb more chunk merges than the configured bound
+        (window span >> chunk span — the "0all over a year" shape),
+        fall back to the exact path, which the scan budgets either
+        serve materialized or refuse with the 413 contract.  The
+        estimate is skew-exact (review r4): per series, the window ids
+        of the streaming CHUNK BOUNDARIES (every n_chunk-th point,
+        O(points/chunk) to fetch) are counted — a cell's merge count
+        is that window's boundary multiplicity + 1, so points
+        concentrated in one window are seen as the many merges they
+        cause, not averaged away."""
         tsdb = self.tsdb
-        plan = None
-        if not stream_ok:
-            tiling.count_refusal("not_streamable")
-        else:
-            from opentsdb_tpu.ops.streaming import SKETCH_K
-            lanes = lanes_for([ds_fn])
-            acc_cell = 8 + 8 * len(lanes) \
-                + (4 * SKETCH_K if sketchable else 0)
-            plan = tiling.plan_tiled(
-                tsdb, s=s, w=window_spec.count, g_pad=g_pad,
-                acc_cell_bytes=acc_cell, total_points=int(total_points),
-                platform=execution_platform())
-        if plan is None:
-            self.exec_stats["tiledRefused"] = 1.0
-            raise gbd.exception()
-        return plan
-
-    def _consult_rollup_lanes(self, psp, seg, sub, windows, window_spec,
-                              store, series_list, gid, g_pad: int,
-                              ds_fn: str, use_mesh: bool,
-                              total_points: int, n_max: int):
-        """THE shared rollup-lane consult hook (the PR 9 / PR 10 TODO
-        sites resolved): one eligibility gate + one ``RollupLanes.plan``
-        verdict consumed by BOTH fast-path consult points — the
-        over-budget tiled decision and the resident cache chain.
-
-        Returns a LanePlan (possibly striped for over-budget grids) or
-        None; the lane decision is annotated on the pipeline span
-        either way (PR 6 contract)."""
-        tsdb = self.tsdb
-        lanes = getattr(tsdb, "rollup_lanes", None)
-        if (lanes is None or seg.kind != "raw"
-                or store is not tsdb.store or use_mesh
-                or not series_list
-                or not isinstance(windows, FixedWindows)):
-            return None
-        from opentsdb_tpu.ops.hostlane import execution_platform
-        plan, note = lanes.plan(
-            series_list[0].key.metric, series_list, windows,
-            seg.start_ms, seg.end_ms, ds_fn, execution_platform(),
-            len(gid), int(n_max), g_pad, bool(sub.rate),
-            total_points=int(total_points))
-        if plan is not None:
-            # residency: the assembled [S, Wp] grid against the SAME
-            # shared device-state allowance every other path honors
-            # (~3 grid lanes live through the tail dispatch)
-            from opentsdb_tpu.query.limits import grid_budget
-            state_mb = tsdb.config.get_int(
-                "tsd.query.streaming.state_mb")
-            gbd = grid_budget("grid", state_mb,
-                              len(gid) * window_spec.count * 24,
-                              len(gid), window_spec.count)
-            if gbd.over:
-                plan = self._size_lane_stripes(plan, len(gid),
-                                               window_spec, g_pad,
-                                               state_mb,
-                                               sub.aggregator)
-                if plan is None:
-                    note = dict(note, decision="fallback",
-                                reason="striping_unavailable")
-                    lanes.note_striping_fallback()
-            if plan is not None:
-                lanes.note_served(plan)
-        obs_trace.annotate(psp, rollup=note)
-        return plan
-
-    def _size_lane_stripes(self, plan, s: int, window_spec, g_pad: int,
-                           state_mb: int, aggregator: str):
-        """Attach an over-budget serve sizing to a lane plan.
-
-        Moment-decomposable cross-series aggregators fold tile by tile
-        into [G, W] partial moments (no pool needed — only the tile
-        split is sized here); everything else reuses the PR 10
-        spill-pool stripe replay and additionally requires the pool to
-        hold the partials.  None -> the caller falls back to the
-        tiled-exact/413 path."""
-        from opentsdb_tpu.ops import tiling
-        tp = tiling.size_tiles(
-            s, window_spec.count, state_mb * 2 ** 20, 9, g_pad,
-            self.tsdb.config.get_int("tsd.query.spill.max_tiles"),
-            chunks_per_tile=1)
-        if tp is None:
-            return None
-        fold_ok = (aggregator in tiling.LANE_FOLDABLE
-                   and 5 * g_pad * window_spec.count * 8
-                   <= state_mb * 2 ** 20)
-        if not fold_ok:
-            pool = getattr(self.tsdb, "spill_pool", None)
-            if pool is None:
-                return None
-            entry_bytes = tp.tile_rows * tp.stripe_w \
-                * tiling.SPILL_CELL_BYTES
-            if tp.spill_bytes + entry_bytes \
-                    > pool.host_budget + pool.disk_budget:
-                return None
-        plan.striped = True
-        plan.tile_plan = tp
-        plan.decision["striped"] = True
-        return plan
+        sketchable = (is_sketch_ds(ds_fn) and tsdb.config.get_bool(
+            "tsd.query.streaming.sketch_percentiles"))
+        if not sketchable:
+            return False, False
+        max_merges = tsdb.config.get_int(
+            "tsd.query.streaming.sketch_max_merges")
+        if max_merges <= 0:
+            return True, False
+        chunk_points = max(tsdb.config.get_int(
+            "tsd.query.streaming.chunk_points"), 1)
+        n_chunk = pad_pow2(max(1024, chunk_points // max(n_rows, 1)))
+        worst = 0
+        for _, members, counts in kept:
+            for (s, _t), c in zip(members, counts):
+                if c <= n_chunk:
+                    continue        # single chunk: no merges at all
+                tsb = s.window_stride_timestamps(
+                    seg.start_ms, seg.end_ms, n_chunk, fix)
+                wids = self._host_window_ids(windows, tsb)
+                if len(wids):
+                    worst = max(worst, int(np.max(
+                        np.unique(wids, return_counts=True)[1])))
+        if worst + 1 > max_merges:
+            return False, True
+        return True, False
 
     def _run_lane_serve(self, spec, seg, plan, series_list, gid,
                         g_pad: int, windows, window_spec,
@@ -1813,6 +1604,70 @@ class QueryRunner:
         for sub in query.queries:
             out.extend(self.run_sub(query, sub))
         return out
+
+
+class _ExecConsults:
+    """plan_decision()'s consult provider for the EXECUTOR: each hook
+    does the real, stateful work (demand recording, repeat-count
+    bookkeeping, the device gather) — the explain engine supplies the
+    read-only twin (query/explain.py).  The routing logic itself lives
+    in query/plandecision.py; this class only binds the planner's
+    per-segment context onto the subsystem calls."""
+
+    def __init__(self, tsdb, ctx, seg, sub, windows, store,
+                 series_list, fix):
+        self.tsdb = tsdb
+        self.ctx = ctx
+        self.seg = seg
+        self.sub = sub
+        self.windows = windows
+        self.store = store
+        self.series_list = series_list
+        self.fix = fix
+
+    def _metric(self) -> int:
+        return self.series_list[0].key.metric
+
+    def rollup_plan(self):
+        ctx = self.ctx
+        return self.tsdb.rollup_lanes.plan(
+            self._metric(), self.series_list, self.windows,
+            self.seg.start_ms, self.seg.end_ms, ctx.ds_fn,
+            ctx.platform, ctx.s, ctx.n_max, ctx.g_pad, ctx.has_rate,
+            total_points=ctx.total_points)
+
+    def note_lane_served(self, plan) -> None:
+        self.tsdb.rollup_lanes.note_served(plan)
+
+    def note_lane_fallback(self) -> None:
+        self.tsdb.rollup_lanes.note_striping_fallback()
+
+    def tiled_refusal(self, reason: str) -> None:
+        from opentsdb_tpu.ops import tiling
+        tiling.count_refusal(reason)
+
+    def tiled_plan(self, acc_cell: int):
+        from opentsdb_tpu.ops import tiling
+        ctx = self.ctx
+        return tiling.plan_tiled(
+            self.tsdb, s=ctx.s, w=ctx.wp, g_pad=ctx.g_pad,
+            acc_cell_bytes=acc_cell, total_points=ctx.total_points,
+            platform=ctx.platform)
+
+    def agg_plan(self, platform: str):
+        ctx = self.ctx
+        ds = self.sub.downsample_spec
+        return self.tsdb.agg_cache.plan(
+            self.store, self._metric(), self.series_list, self.windows,
+            self.seg.start_ms, self.seg.end_ms, ctx.ds_fn,
+            ds.fill_policy, ds.fill_value, platform, ctx.s, ctx.n_max,
+            ctx.g_pad, ctx.has_rate, total_points=ctx.total_points)
+
+    def device_batch(self, build: bool, ts_base: int | None):
+        return self.tsdb.device_cache.batch_for(
+            self.store, self._metric(), self.series_list,
+            self.seg.start_ms, self.seg.end_ms, self.fix, build=build,
+            ts_base=ts_base)
 
 
 def _fmt_pct(p: float) -> str:

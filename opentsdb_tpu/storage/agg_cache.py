@@ -387,13 +387,23 @@ class AggregateCache:
              start_ms: int, end_ms: int, ds_fn: str,
              fill_policy: str, fill_value, platform: str,
              s: int, n_max: int, g_pad: int, has_rate: bool,
-             total_points: int = 0):
+             total_points: int = 0, observe: bool = True):
         """Rewrite decision for one fixed-grid downsample segment.
 
         Returns (RewritePlan | None, decision dict).  None means
         recompute monolithically; the decision dict always comes back
         for the trace span (PR 6 contract: strategy decisions are
-        visible per query)."""
+        visible per query).
+
+        ``observe=False`` is the EXPLAIN engine's dry-run arm: the
+        same verdict from the same state, with ZERO bookkeeping — the
+        repeat count is read but not advanced (an explain must not
+        walk a family toward ``min_repeats``), LRU recency and
+        ``_planned_gen`` stay put, stale blocks are left for the real
+        pass to reap, and no hit/miss/rewrite accounting fires.
+        Because the executor's own ``plan()`` prices with the count
+        BEFORE its increment, a dry-run at the same instant computes
+        the identical decision (the explain-vs-actual parity pin)."""
         from opentsdb_tpu.obs import jaxprof
         from opentsdb_tpu.ops.downsample import (mode_policy_epoch,
                                                  pad_pow2)
@@ -435,18 +445,22 @@ class AggregateCache:
         missing: list[PlanPiece] = []
         with self._lock:
             gen0 = self._gen
-            # stop mark-coalescing at this generation: entries built
-            # from this plan must be invalidated by any LATER write
-            self._planned_gen = max(self._planned_gen, gen0)
-            # pop-then-set keeps the dict in recency order, so the
-            # overflow eviction drops the STALEST families — a burst
-            # of one-off ad-hoc families must not wipe the hot
-            # dashboards' repeat counts (that would re-impose
-            # min_repeats on everything at once)
-            repeats = self._repeats.pop(family, 0)
-            self._repeats[family] = repeats + 1
-            while len(self._repeats) > 4096:
-                self._repeats.pop(next(iter(self._repeats)))
+            if observe:
+                # stop mark-coalescing at this generation: entries
+                # built from this plan must be invalidated by any
+                # LATER write
+                self._planned_gen = max(self._planned_gen, gen0)
+                # pop-then-set keeps the dict in recency order, so the
+                # overflow eviction drops the STALEST families — a
+                # burst of one-off ad-hoc families must not wipe the
+                # hot dashboards' repeat counts (that would re-impose
+                # min_repeats on everything at once)
+                repeats = self._repeats.pop(family, 0)
+                self._repeats[family] = repeats + 1
+                while len(self._repeats) > 4096:
+                    self._repeats.pop(next(iter(self._repeats)))
+            else:
+                repeats = self._repeats.get(family, 0)
             for k in range(k_lo, k_hi + 1):
                 piece = PlanPiece(
                     first_ms=k * bw * interval, count=bw,
@@ -459,14 +473,16 @@ class AggregateCache:
                     rows = np.fromiter(
                         (entry.rows[srs] for srs in series_list),
                         np.int64, count=len(series_list))
-                    # LRU recency = dict order (move-to-end): eviction
-                    # pops from the front in O(1) instead of a min()
-                    # scan over every resident block
-                    self._blocks.pop(key)
-                    self._blocks[key] = entry
+                    if observe:
+                        # LRU recency = dict order (move-to-end):
+                        # eviction pops from the front in O(1) instead
+                        # of a min() scan over every resident block
+                        self._blocks.pop(key)
+                        self._blocks[key] = entry
                     if entry.val_dev is not None:
-                        self._dev_tick += 1
-                        entry.dev_tick = self._dev_tick
+                        if observe:
+                            self._dev_tick += 1
+                            entry.dev_tick = self._dev_tick
                         piece.cached = (entry.val_dev, entry.mask_dev)
                         piece.tier = "agg_device"
                     else:
@@ -483,7 +499,7 @@ class AggregateCache:
                     hits.append(piece)
                     hit_entries.append((key, entry))
                 else:
-                    if entry is not None:
+                    if entry is not None and observe:
                         # stale or row-incomplete: drop so the rebuild
                         # below can take its slot
                         self._drop_locked(key)
@@ -517,13 +533,14 @@ class AggregateCache:
             # work) would tax exactly the hot path the cache exists
             # to shrink
             decision.update(decision="rewrite", reason="reuse")
-            for p in hits:
-                self._count_hit(p.tier)
-            with self._lock:
-                self._maybe_cached = True
-                self.rewrites += 1
-                self.hits += len(hits)
-                self._note_serves_locked(hit_entries)
+            if observe:
+                for p in hits:
+                    self._count_hit(p.tier)
+                with self._lock:
+                    self._maybe_cached = True
+                    self.rewrites += 1
+                    self.hits += len(hits)
+                    self._note_serves_locked(hit_entries)
             return RewritePlan(pieces=pieces, gen0=gen0, family=family,
                                store=store, metric=metric,
                                interval_ms=interval, platform=platform,
@@ -597,18 +614,19 @@ class AggregateCache:
         decision["decision"] = "rewrite"
         # hit/miss accounting only for plans that actually serve — a
         # consulted-but-recomputed plan must not inflate the hit rate
-        for p in hits:
-            self._count_hit(p.tier)
-        for _p in missing:
-            self._count_miss("agg_host")
-        with self._lock:
-            # committing to materialize/serve: arm the ingest-side
-            # mark path BEFORE the executor reads any store data
-            self._maybe_cached = True
-            self.rewrites += 1
-            self.hits += len(hits)
-            self.misses += len(missing)
-            self._note_serves_locked(hit_entries)
+        if observe:
+            for p in hits:
+                self._count_hit(p.tier)
+            for _p in missing:
+                self._count_miss("agg_host")
+            with self._lock:
+                # committing to materialize/serve: arm the ingest-side
+                # mark path BEFORE the executor reads any store data
+                self._maybe_cached = True
+                self.rewrites += 1
+                self.hits += len(hits)
+                self.misses += len(missing)
+                self._note_serves_locked(hit_entries)
         return RewritePlan(pieces=pieces, gen0=gen0, family=family,
                            store=store, metric=metric,
                            interval_ms=interval, platform=platform,

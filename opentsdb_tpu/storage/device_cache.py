@@ -204,6 +204,60 @@ class DeviceSeriesCache:
         return _gather_windows(entry.ts_dev, entry.val_dev,
                                starts, lengths, n, ts_base)
 
+    def peek(self, store, metric: int, series_list, start_ms: int,
+             end_ms: int, fix_duplicates: bool = True,
+             build: bool = True, ts_base: int | None = None) -> bool:
+        """Would :meth:`batch_for` return a device batch for this
+        request, as of now — READ-ONLY: no gather dispatch, no cold
+        inline build, no staleness marks, no hit/miss accounting.  The
+        EXPLAIN engine's arm of the routing decision
+        (query/plandecision.py).
+
+        The cold-with-``build`` arm predicts the inline snapshot build
+        from its size/identity preconditions (series set, point
+        budget, byte budget) without snapshotting; duplicate data that
+        would only surface inside ``Series.snapshot`` is approximated
+        by the same per-series ``window_bounds`` probe ``batch_for``
+        itself uses."""
+        ekey = (id(store), metric)
+        with self._lock:
+            entry = self._entries.get(ekey)
+            building = ekey in self._building
+        if entry is None:
+            if not build or building:
+                return False
+            # the _build_guarded preconditions, probed without copying
+            series_objs = store.series_for_metric(metric)
+            if not series_objs:
+                return False
+            total = sum(len(s) for s in series_objs)
+            nbytes = _pad_pow2(max(total, 1), floor=1024) \
+                * _BYTES_PER_POINT
+            if total > self.build_max_points or nbytes > self.max_bytes:
+                return False
+            rows = {s.key: s for s in series_objs}
+            resolve = rows.get
+        else:
+            def resolve(key, _row=entry.row, _objs=entry.series_objs):
+                row = _row.get(key)
+                return None if row is None else _objs[row]
+        max_len = 0
+        for i, series in enumerate(series_list):
+            if resolve(series.key) is not series:
+                return False
+            try:
+                lo, hi, version = series.window_bounds(
+                    start_ms, end_ms, fix_duplicates)
+            except ValueError:
+                return False        # unresolved duplicates: host path
+            if entry is not None \
+                    and version != entry.versions[entry.row[series.key]]:
+                return False
+            max_len = max(max_len, hi - lo)
+        n = _pad_pow2(max(int(max_len), 1))
+        per_point = 13 if ts_base is not None else 17
+        return len(series_list) * n * per_point <= self.batch_max_bytes
+
     # -- build / refresh -------------------------------------------------
 
     # tier-labeled prometheus families shared with the partial-

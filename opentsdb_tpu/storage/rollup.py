@@ -338,14 +338,23 @@ class RollupLanes:
     def plan(self, metric: int, series_list, windows, start_ms: int,
              end_ms: int, ds_fn: str, platform: str, s: int,
              n_max: int, g_pad: int, has_rate: bool,
-             total_points: int = 0):
+             total_points: int = 0, observe: bool = True):
         """Lane-serve decision for one fixed-grid downsample segment.
 
         Returns (LanePlan | None, decision dict).  None = fall back to
         the exact paths; the decision dict always comes back for the
         trace span (PR 6 contract).  Every eligible consult — hit or
         miss — records a costmodel-priced demand observation, the
-        Storyboard selection corpus ``refresh()`` shops from."""
+        Storyboard selection corpus ``refresh()`` shops from.
+
+        ``observe=False`` is the EXPLAIN engine's dry-run arm
+        (query/explain.py): the verdict computation is identical, but
+        nothing is recorded — no demand observation, no LRU recency
+        bump, no hit/miss counters, no ``_planned_gen`` advance, and
+        stale/incomplete blocks are left in place for the real pass to
+        reap — so explaining a query cannot perturb what the
+        maintenance selector builds or what the executor then
+        decides."""
         from opentsdb_tpu.obs import jaxprof
         from opentsdb_tpu.ops import costmodel as cm
         from opentsdb_tpu.ops.downsample import pad_pow2
@@ -404,20 +413,22 @@ class RollupLanes:
         missing = 0
         with self._lock:
             gen0 = self._gen
-            self._planned_gen = max(self._planned_gen, gen0)
-            self._note_demand_locked(metric, lane_ms, s, start_ms,
-                                     end_ms, saving)
+            if observe:
+                self._planned_gen = max(self._planned_gen, gen0)
+                self._note_demand_locked(metric, lane_ms, s, start_ms,
+                                         end_ms, saving)
             for b in range(b_lo, b_hi + 1):
                 key = (metric, lane_ms, b)
                 entry = self._blocks.get(key)
                 if entry is None or not self._valid_locked(entry):
-                    if entry is not None:
+                    if entry is not None and observe:
                         self._drop_locked(key)
                     missing += 1
                     continue
-                # LRU recency = dict order (move-to-end)
-                self._blocks.pop(key)
-                self._blocks[key] = entry
+                if observe:
+                    # LRU recency = dict order (move-to-end)
+                    self._blocks.pop(key)
+                    self._blocks[key] = entry
                 candidates.append((key, entry, b))
         # pass 2, outside the lock: row completeness + per-block row
         # vectors (blocks built at different times may order rows
@@ -435,7 +446,7 @@ class RollupLanes:
             hi_cell = min(c_hi, (b + 1) * bw - 1)
             segments.append((entry, rows, lo_cell - b * bw,
                              hi_cell - b * bw + 1, lo_cell - c_lo))
-        if incomplete:
+        if incomplete and observe:
             with self._lock:
                 for key in incomplete:
                     # row-incomplete (a series appeared since the
@@ -445,9 +456,10 @@ class RollupLanes:
             decision["reason"] = "cold"
             decision["coverage"] = round(
                 1.0 - missing / (b_hi - b_lo + 1), 4)
-            self._count_miss("cold")
-            with self._lock:
-                self.misses += 1
+            if observe:
+                self._count_miss("cold")
+                with self._lock:
+                    self.misses += 1
             return None, decision
         decision.update(decision="lane", reason="served", coverage=1.0,
                         cells=n_cells, blocks=len(segments))

@@ -226,9 +226,13 @@ class DiagRpc(HttpRpc):
       * ``/api/diag``              the event ring, oldest first.
         ``?since=<seq>`` returns only events newer than that sequence
         number — poll with the last ``seq`` you saw for an incremental
-        feed.
+        feed.  ``?trace_id=<id>`` narrows to one request's ring slice
+        (an explain fingerprint's plan event, a latency exemplar, or
+        an X-TSDB-Trace-Id resolve in ONE request instead of paging
+        the whole ring client-side); combinable with ``since``.
       * ``/api/diag/slow``         retained slow/anomalous queries
         (span tree + costmodel decisions + ring slice), newest first.
+        ``?trace_id=<id>`` looks one capture up by its trace id.
       * ``/api/diag/health``       per-subsystem ok/degraded/failing
         verdicts (the chaos_soak post-heal gate).
     """
@@ -250,8 +254,10 @@ class DiagRpc(HttpRpc):
             raise BadRequestError(
                 "The flight recorder is disabled", status=404,
                 details="Set tsd.diag.enable=true")
+        trace_id = query.get_query_string_param("trace_id")
         if endpoint == "slow":
-            query.send_reply({"queries": recorder.slow_queries()})
+            query.send_reply(
+                {"queries": recorder.slow_queries(trace_id=trace_id)})
             return
         if endpoint:
             raise BadRequestError(
@@ -262,12 +268,19 @@ class DiagRpc(HttpRpc):
         except ValueError:
             raise BadRequestError("'since' must be an integer sequence "
                                   "number")
-        events = recorder.events(since=since)
-        query.send_reply({
+        if trace_id:
+            events = [e for e in recorder.events_for_trace(trace_id)
+                      if e["seq"] > since]
+        else:
+            events = recorder.events(since=since)
+        reply = {
             "seq": recorder.latest_seq(),
             "ringSize": recorder.ring_size,
             "events": events,
-        })
+        }
+        if trace_id:
+            reply["traceId"] = trace_id
+        query.send_reply(reply)
 
 
 class LogBuffer(logging.Handler):

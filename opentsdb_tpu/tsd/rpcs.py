@@ -434,6 +434,8 @@ class QueryRpc(HttpRpc):
             return self.handle_gexp(tsdb, query)
         if endpoint == "exp":
             return self.handle_exp(tsdb, query)
+        if endpoint == "explain":
+            return self.handle_explain(tsdb, query)
         return self.handle_query(tsdb, query)
 
     # -- /api/query --
@@ -572,6 +574,67 @@ class QueryRpc(HttpRpc):
         recorder.maybe_capture_slow(
             trace, query.elapsed_ms(), status,
             qs.query if qs is not None else None, tenant)
+
+    # -- /api/query/explain (docs/query_explain.md) --
+
+    def handle_explain(self, tsdb, query: HttpQuery) -> None:
+        """The no-dispatch what-if engine: the full /api/query request
+        shape (+ what-if overrides) in, the complete routing decision
+        tree out — admission preview, rollup/agg-cache/device-cache
+        consult verdicts, grid-budget/tiling decision, per-axis
+        costmodel pricing, and the stable plan fingerprint the
+        executor stamps into flight-recorder ``plan`` events.
+
+        Deliberately NOT behind the admission gate: an overloaded
+        daemon must still be explainable (the ambient request deadline
+        still bounds the planning walk, and the per-sub QueryBudget
+        charges the same scan the executor would)."""
+        allowed_methods(query, "GET", "POST")
+        if not tsdb.config.get_bool("tsd.explain.enable"):
+            raise BadRequestError(
+                "The explain endpoint is disabled", status=404,
+                details="Set tsd.explain.enable=true")
+        from opentsdb_tpu.query import explain as explain_mod
+        if query.method == "POST":
+            ts_query = query.serializer.parse_query_v1()
+            raw_what_if = (query.json_body() or {}).get("whatIf") or {}
+        else:
+            ts_query = self.parse_query_string(tsdb, query)
+            raw_what_if = {}
+            for spec in query.get_query_string_params("what_if"):
+                if "=" not in spec:
+                    raise BadRequestError(
+                        "what_if must be key=value, got %r" % spec)
+                k, v = spec.split("=", 1)
+                raw_what_if[k.strip()] = v
+        ts_query.validate()
+        try:
+            what_if = explain_mod.parse_what_if(raw_what_if)
+        except explain_mod.WhatIfError as e:
+            raise BadRequestError(str(e))
+        start = time.perf_counter()
+        try:
+            with obs_trace.stage("explain") as span:
+                report = explain_mod.explain_query(tsdb, ts_query,
+                                                   what_if)
+                obs_trace.annotate(
+                    span, sub_queries=len(report["subQueries"]),
+                    what_if=bool(what_if.active))
+        except Exception:
+            REGISTRY.counter(
+                "tsd.query.explain.requests",
+                "Explain requests served, by outcome").labels(
+                    outcome="error").inc()
+            raise
+        query.send_reply(report)
+        REGISTRY.counter(
+            "tsd.query.explain.requests",
+            "Explain requests served, by outcome").labels(
+                outcome="ok").inc()
+        REGISTRY.histogram(
+            "tsd.query.explain.latency_ms",
+            "Explain planning latency (ms) — the no-dispatch walk"
+        ).observe((time.perf_counter() - start) * 1e3)
 
     def _delete(self, tsdb, ts_query: TSQuery) -> int:
         """Drop the matched datapoints after serving them (delete flag).

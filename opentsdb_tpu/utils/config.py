@@ -196,6 +196,19 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.diag.tenant_buckets": _e(
         "int", "16", "Hash buckets for unregistered tenant header "
         "values (0 collapses them all to 'other')."),
+    # -- query explain (query/explain.py, docs/query_explain.md) -------- #
+    "tsd.explain.enable": _e(
+        "bool", True, "Mount /api/query/explain: the no-dispatch "
+        "what-if engine returning the complete routing decision tree "
+        "(admission preview, rollup/agg-cache/device-cache consults, "
+        "grid-budget/tiling verdict, per-axis costmodel pricing) plus "
+        "the stable plan fingerprint executed queries stamp into "
+        "flight-recorder plan events."),
+    "tsd.explain.include_candidates": _e(
+        "bool", True, "Include the per-candidate predicted-ms tables "
+        "in explain's costmodel decision reports.  False keeps only "
+        "the chosen mode + provenance (smaller payloads for "
+        "dashboard-driven polling)."),
     # -- health engine (obs/health.py) ---------------------------------- #
     "tsd.health.enable": _e(
         "bool", True, "Evaluate the declared health invariants "

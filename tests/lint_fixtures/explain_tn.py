@@ -1,0 +1,30 @@
+"""Explain-endpoint obligation true negatives: the sanctioned shapes
+the real handler uses (tsd/rpcs.py handle_explain) — the explain span
+as a with-block that closes on success AND on the error path, and
+outcome labels from a fixed vocabulary.  Parsed, never imported."""
+
+REGISTRY = None  # stub: the analyzer matches the receiver NAME
+
+
+def explain_with_block(obs_trace, engine, ts_query):
+    """The handler's shape: stage() is a context manager — the span
+    finishes even when the engine raises."""
+    with obs_trace.stage("explain") as span:
+        report = engine.explain_query(ts_query)
+        obs_trace.annotate(span, sub_queries=len(report))
+    return report
+
+
+def explain_counts_fixed_outcomes(ok):
+    outcome = "ok" if ok else "error"
+    REGISTRY.counter("tsd.fixture.count").labels(
+        route=outcome).inc()
+
+
+def explain_span_hand_finished(obs_trace, engine, ts_query):
+    """begin/end is also sanctioned when every path reaches end()."""
+    span = obs_trace.begin("explain")
+    try:
+        return engine.explain_query(ts_query)
+    finally:
+        obs_trace.end(span)

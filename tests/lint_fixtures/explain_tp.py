@@ -1,0 +1,30 @@
+"""Explain-endpoint obligation true positives (ISSUE 13): the shapes
+the /api/query/explain handler must NOT take — an explain span that
+never finishes (the handler's one span obligation), an outcome metric
+minted from a raw request string, and an explain error path that
+drops the span on the floor.  Parsed, never imported."""
+
+REGISTRY = None  # stub: the analyzer matches the receiver NAME
+
+
+def explain_span_never_finished(obs_trace, engine, ts_query, reply):
+    """A handler that begins the explain span and forgets it: the
+    request trace would keep an open child forever."""
+    span = obs_trace.begin("explain")  # EXPECT: resource-leak
+    reply.send(engine.explain_query(ts_query))
+
+
+def explain_span_leaks_on_disabled_return(obs_trace, engine, ts_query,
+                                          enabled):
+    span = obs_trace.begin("explain")
+    if not enabled:
+        return None  # EXPECT: resource-leak-return
+    report = engine.explain_query(ts_query)
+    obs_trace.end(span)
+    return report
+
+
+def explain_outcome_from_raw_request(route):
+    """Outcome labels must come from a fixed vocabulary, never a
+    client-chosen string — the tenant-clamp rule, applied to explain."""
+    REGISTRY.counter("tsd.fixture." + route).inc()  # EXPECT: metrics-dynamic-name

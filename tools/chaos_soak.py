@@ -588,6 +588,12 @@ def run_cache_stage(port: int, rounds: int) -> None:
              lambda e: e.get("kind") == "http_error"
              and e.get("status", 0) >= 500),
         ])
+        # post-heal explain consistency: the warm rewrite path the
+        # rounds exercised must be what explain predicts NOW
+        check_explain_gate(port, "cache", [
+            ("warm repeat", "start=%d&end=%d&m=sum:10s-sum:cache.m"
+             % (BASE, BASE + n_pts)),
+        ])
         print("[cache] %d rounds, zero divergence, %d agg-tier hits, "
               "%d faulted burst puts healed"
               % (max(rounds, 10), int(agg_hits), burst_failures),
@@ -720,6 +726,12 @@ def run_rollup_stage(port: int, rounds: int) -> None:
             ("rollup-lane plan",
              lambda e: e.get("kind") == "plan"
              and e.get("path") == "rollup_lane"),
+        ])
+        # post-heal explain consistency: the lane-served path must be
+        # what explain predicts after faults + ingest invalidation
+        check_explain_gate(port, "rollup", [
+            ("lane-served", "start=%d&end=%d&m=sum:60s-sum:rollup.m"
+             % (BASE + 60, BASE + n_pts - 120)),
         ])
         print("[rollup] %d rounds, zero divergence, %d lane hits, "
               "%d faulted burst puts healed"
@@ -862,6 +874,13 @@ def run_spill_stage(port: int, rounds: int) -> None:
             ("tiling event",
              lambda e: e.get("kind") == "tiling"),
         ])
+        # post-heal explain consistency: the over-budget plan must
+        # route (and explain) tiled after the disk-full burst healed
+        check_explain_gate(port, "spill", [
+            ("tiled group-by",
+             "start=%d&end=%d&m=sum:10s-sum:spill.m%%7Bg=*%%7D"
+             % (BASE, BASE + span)),
+        ])
         print("[spill] %d rounds, zero divergence, %d tiles, %d disk "
               "demotions, %d faulted attempts healed"
               % (max(rounds, 5), int(tiles), int(disk), burned),
@@ -892,6 +911,80 @@ def _prom_scrape(port: int, timeout: float = 10.0) -> dict:
 
 def _prom_sum(scrape: dict, name: str) -> float:
     return sum(scrape.get(name, {}).values())
+
+
+def check_explain_gate(port: int, stage: str, specs: list) -> None:
+    """Stage-level explain-consistency gate (ISSUE 13): for sampled
+    live queries, the path /api/query/explain predicts must be the
+    path the executor then stamps into its flight-recorder plan event
+    — exercised while the stage's faults are armed/healed, so a
+    consult arm that drifts under fault conditions fails the soak.
+    PATH-level, not fingerprint-level: the stages ingest concurrently,
+    and coverage may legitimately move between the two requests.
+
+    ``specs`` is [(label, query_string_tail)] where the tail is the
+    ``start=...&end=...&m=...`` part of a /api/query URI.  A mismatch
+    retries a couple of times: the maintenance thread may move cache
+    state between the explain and the execute (a legitimate flip, not
+    drift); the SAME mismatch three times running is drift.
+    """
+    for label, qs in specs:
+        for attempt in range(3):
+            try:
+                exp = json.loads(urllib.request.urlopen(
+                    "http://127.0.0.1:%d/api/query/explain?%s"
+                    % (port, qs), timeout=30).read())
+            except urllib.error.HTTPError as e:
+                print("[%s] explain gate: explain itself failed with "
+                      "%d for %s" % (stage, e.code, label), flush=True)
+                raise SystemExit(1)
+            segs = [s for sub in exp.get("subQueries", [])
+                    for s in sub.get("segments", [])]
+            if not segs or "path" not in segs[0]:
+                print("[%s] explain gate: no routed segment for %s: %r"
+                      % (stage, label, exp), flush=True)
+                raise SystemExit(1)
+            predicted = segs[0]["path"]
+            trace_id = "%032x" % random.getrandbits(128)
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/api/query?%s" % (port, qs),
+                headers={"X-TSDB-Trace-Id": trace_id})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    assert resp.status == 200
+                diag = json.loads(urllib.request.urlopen(
+                    "http://127.0.0.1:%d/api/diag?trace_id=%s"
+                    % (port, trace_id), timeout=10).read())
+            except OSError as e:
+                # a straggler shed/restart right after heal is the
+                # transient case the retry loop exists for — burn an
+                # attempt instead of dying on a raw traceback
+                print("[%s] explain gate: execute/diag fetch failed "
+                      "for %s (attempt %d): %s — retrying"
+                      % (stage, label, attempt + 1, e), flush=True)
+                time.sleep(0.5)
+                continue
+            plans = [e for e in diag.get("events", [])
+                     if e.get("kind") == "plan"]
+            if not plans:
+                print("[%s] explain gate: no plan event for trace %s "
+                      "(%s)" % (stage, trace_id, label), flush=True)
+                raise SystemExit(1)
+            executed = plans[0].get("path")
+            if executed == predicted:
+                print("[%s] explain gate OK: %s -> %s"
+                      % (stage, label, predicted), flush=True)
+                break
+            print("[%s] explain gate mismatch for %s (attempt %d): "
+                  "predicted %r, ran %r — retrying"
+                  % (stage, label, attempt + 1, predicted, executed),
+                  flush=True)
+            time.sleep(0.5)
+        else:
+            print("[%s] explain gate FAILED for %s: explain and the "
+                  "executor disagree persistently" % (stage, label),
+                  flush=True)
+            raise SystemExit(1)
 
 
 def check_diag_gate(port: int, stage: str, evidence: list,
@@ -1099,6 +1192,14 @@ def run_overload_stage(port: int, rounds: int) -> None:
             ("admission shed",
              lambda e: e.get("kind") == "admission"
              and e.get("decision") == "shed"),
+        ])
+        # post-heal explain consistency: explain needs no permit, and
+        # its prediction must match the executed path once admitted
+        check_explain_gate(port, "overload", [
+            # downsampled: union plans don't emit plan events, grouped
+            # plans do — the gate needs the fingerprinted path
+            ("post-heal", "start=%d&end=%d&m=sum:30s-avg:chaos.m"
+             % (BASE - 1, BASE + 600)),
         ])
         print("[overload] %d responses OK: %s, in-flight max %.0f/%d, "
               "admitted p99 %.0fms, healed (shed rate 0)"

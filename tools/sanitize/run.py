@@ -44,6 +44,7 @@ SUBSET_TIER1 = [
     "tests/test_cluster_serving.py",
     "tests/test_admission.py",
     "tests/test_flightrec.py",
+    "tests/test_explain.py",
     "tests/test_agg_cache.py",
     "tests/test_rollup_lanes.py",
     "tests/test_tsd_server.py",
