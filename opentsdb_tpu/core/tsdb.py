@@ -148,6 +148,15 @@ class TSDB:
                 self.rollup_lanes.recorder = self.flightrec
             if self.spill_pool is not None:
                 self.spill_pool.recorder = self.flightrec
+        # fused multi-query dispatch (query/batcher.py, ROADMAP item
+        # 1): concurrent dispatch-bound plans (plan_decision path
+        # "batched") coalesce into one stacked [Q, S, N] kernel with
+        # host-side unpack; uncontended queries fall through as solo
+        # dispatches with zero hold
+        from opentsdb_tpu.query.batcher import DispatchBatcher
+        self.dispatch_batcher = (
+            DispatchBatcher(self.config, tsdb=self)
+            if self.config.get_bool("tsd.query.batch.enable") else None)
         from opentsdb_tpu.rollup import RollupConfig, RollupStore
         self.rollup_config = RollupConfig.from_config(self.config)
         self.rollup_store = (
@@ -987,6 +996,8 @@ class TSDB:
             out.update(self.agg_cache.collect_stats())
         if self.rollup_lanes is not None:
             out.update(self.rollup_lanes.collect_stats())
+        if self.dispatch_batcher is not None:
+            out.update(self.dispatch_batcher.collect_stats())
         return out
 
     @staticmethod

@@ -78,7 +78,45 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "counter", ("tenant",),
         "Queries arriving at the admission gate, by clamped tenant — "
         "the per-tenant demand telemetry the fair-share scheduler "
-        "(ROADMAP item 1) consumes."),
+        "(tsd.query.tenant.fair_share) drains against."),
+    "tsd.query.tenant.admitted": _m(
+        "counter", ("tenant",),
+        "Queries admitted through the gate, by clamped tenant — the "
+        "drained half of the demand split (tsd/admission.py weighted "
+        "DRR; auditable at /api/diag)."),
+    "tsd.query.tenant.refused": _m(
+        "counter", ("tenant",),
+        "Queries refused (shed) by the gate, by clamped tenant — the "
+        "refused half of the demand split."),
+    # -- fused multi-query dispatch (query/batcher.py) ------------------ #
+    "tsd.query.batch.queries": _m(
+        "counter", ("outcome",),
+        "Batch-routed queries, by outcome: 'stacked' (member of a "
+        "multi-query dispatch) or 'solo' (no sibling arrived within "
+        "the coalesce window; ordinary single dispatch)."),
+    "tsd.query.batch.dispatches": _m(
+        "counter", (),
+        "Stacked multi-query device dispatches (one launch serving "
+        ">= 2 member queries)."),
+    "tsd.query.batch.q": _m(
+        "histogram", (),
+        "Member queries per stacked dispatch."),
+    "tsd.query.batch.wait_ms": _m(
+        "histogram", (),
+        "Coalesce wait before the stacked/solo dispatch, in "
+        "milliseconds (bounded by tsd.query.batch.hold_ms)."),
+    "tsd.query.batch.stacked_dispatches": _m(
+        "gauge", (),
+        "Stats-walk mirror of the stacked-dispatch total "
+        "(TSDB.collect_stats)."),
+    "tsd.query.batch.stacked_members": _m(
+        "gauge", (),
+        "Stats-walk mirror of member queries served by stacked "
+        "dispatches."),
+    "tsd.query.batch.solo_dispatches": _m(
+        "gauge", (),
+        "Stats-walk mirror of batch-routed queries that dispatched "
+        "solo."),
     "tsd.query.explain.requests": _m(
         "counter", ("outcome",),
         "/api/query/explain requests served, by outcome (ok/error).  "
@@ -356,6 +394,15 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "gauge", ("tenant",),
         "Per-tenant demand counters re-walked for /api/stats and the "
         "self-report loop."),
+    "tsd.diag.tenant.admitted": _m(
+        "gauge", ("tenant",),
+        "Per-tenant admitted counters (the drained half of the "
+        "demand split) re-walked for /api/stats and the self-report "
+        "loop."),
+    "tsd.diag.tenant.refused": _m(
+        "gauge", ("tenant",),
+        "Per-tenant refused counters (the shed half of the demand "
+        "split) re-walked for /api/stats and the self-report loop."),
     "tsd.health.passes": _m(
         "gauge", (), "Health-engine evaluation passes completed."),
     # -- device cache (storage/device_cache.py collect_stats, mirrored  #

@@ -294,10 +294,24 @@ class FlightRecorder:
             captured = self.slow_captured
         collector.record("diag.ring.events", seq)
         collector.record("diag.slow.captured", captured)
-        fam = REGISTRY.counter(
-            "tsd.query.tenant.demand",
-            "Queries arriving at admission, by clamped tenant")
-        for labels, cell in fam.children():
-            tenant = dict(labels).get("tenant", "default")
-            collector.record("diag.tenant.demand", cell.get(),
+        def cells(fam):
+            for labels, cell in fam.children():
+                yield (dict(labels).get("tenant", "default"),
+                       cell.get())
+
+        for tenant, value in cells(REGISTRY.counter(
+                "tsd.query.tenant.demand",
+                "Queries arriving at admission, by clamped tenant")):
+            collector.record("diag.tenant.demand", value,
+                             "tenant=%s" % tenant)
+        for tenant, value in cells(REGISTRY.counter(
+                "tsd.query.tenant.admitted",
+                "Queries admitted through the gate, by clamped "
+                "tenant")):
+            collector.record("diag.tenant.admitted", value,
+                             "tenant=%s" % tenant)
+        for tenant, value in cells(REGISTRY.counter(
+                "tsd.query.tenant.refused",
+                "Queries refused by the gate, by clamped tenant")):
+            collector.record("diag.tenant.refused", value,
                              "tenant=%s" % tenant)

@@ -26,6 +26,13 @@ last pass, never a point-in-time glance — and folds each into an
   * **cluster** — breaker flap: open transitions in the window past
     ``tsd.health.breaker_flap``, and any breaker currently open is at
     least degraded.
+  * **tenant** — cross-tenant starvation: among tenants with
+    meaningful window demand, the max/min admitted-share ratio past
+    ``tsd.health.tenant_share_ratio`` (failing when a demanding
+    tenant was admitted NOTHING while others were served).  Judges
+    the fair-share drain (tsd/admission.py weighted DRR) — a healthy
+    storm sheds the storming tenant's excess, it never zeroes anyone
+    out.
 
 Verdicts are exported as ``tsd.health.status`` gauges (0 ok /
 1 degraded / 2 failing), served at ``/api/diag/health``, recorded into
@@ -53,6 +60,7 @@ _LEVEL_NUM = {lvl: i for i, lvl in enumerate(LEVELS)}
 _CACHE_MIN_CONSULTS = 16
 _CACHE_FAIL_CONSULTS = 64
 _COSTMODEL_MIN_ACTUAL_MS = 50.0
+_TENANT_MIN_DEMAND = 16.0
 
 
 def _worst(a: str, b: str) -> str:
@@ -72,7 +80,7 @@ class HealthEngine:
     """Evaluates the declared invariants against one TSDB instance."""
 
     SUBSYSTEMS = ("admission", "compile", "agg_cache", "costmodel",
-                  "spill", "cluster")
+                  "spill", "cluster", "tenant")
 
     def __init__(self, tsdb):
         cfg = tsdb.config
@@ -86,6 +94,8 @@ class HealthEngine:
         self.spill_saturation = cfg.get_float(
             "tsd.health.spill_saturation")
         self.breaker_flap = cfg.get_int("tsd.health.breaker_flap")
+        self.tenant_share_ratio = cfg.get_float(
+            "tsd.health.tenant_share_ratio")
         self._lock = threading.Lock()
         # guarded-by: _lock
         self._verdicts: dict[str, dict] = {}
@@ -249,6 +259,51 @@ class HealthEngine:
             if open_now:
                 level = _worst(level, "degraded")
         verdicts["cluster"] = {"level": level, "detail": detail}
+
+        # tenant: cross-tenant starvation — among tenants with
+        # meaningful window demand, admitted-share (admitted/demand
+        # deltas) must stay within tsd.health.tenant_share_ratio of
+        # each other; a demanding tenant admitted NOTHING while
+        # another was served is failing.  Every cell's delta is taken
+        # every pass (even below the volume gate) so the window
+        # baselines stay aligned.
+        def _tenant_cells(name: str, doc: str) -> dict[str, float]:
+            fam = REGISTRY.counter(name, doc)  # tsdblint: disable=metrics-dynamic-name
+            return {dict(labels).get("tenant", "default"): cell.get()
+                    for labels, cell in fam.children()}
+
+        demand_cells = _tenant_cells(
+            "tsd.query.tenant.demand",
+            "Queries arriving at admission, by clamped tenant")
+        admit_cells = _tenant_cells(
+            "tsd.query.tenant.admitted",
+            "Queries admitted through the gate, by clamped tenant")
+        d_deltas: dict[str, float] = {}
+        a_deltas: dict[str, float] = {}
+        for t in set(demand_cells) | set(admit_cells):
+            d_deltas[t] = delta("tenant_demand:%s" % t,
+                                demand_cells.get(t, 0.0))
+            a_deltas[t] = delta("tenant_admitted:%s" % t,
+                                admit_cells.get(t, 0.0))
+        shares = {t: a_deltas.get(t, 0.0) / d
+                  for t, d in d_deltas.items()
+                  if d >= _TENANT_MIN_DEMAND}
+        level, detail = "ok", (
+            "%d tenant(s) above the demand gate in window"
+            % len(shares))
+        if len(shares) >= 2:
+            hi_t = max(shares, key=shares.get)
+            lo_t = min(shares, key=shares.get)
+            hi, lo = shares[hi_t], shares[lo_t]
+            detail = ("admitted-share %s=%.2f vs %s=%.2f in window "
+                      "(ratio limit x%.1f)"
+                      % (hi_t, hi, lo_t, lo, self.tenant_share_ratio))
+            if lo <= 0.0 and hi > 0.0:
+                level = "failing"
+            elif self.tenant_share_ratio > 0 \
+                    and hi / max(lo, 1e-9) > self.tenant_share_ratio:
+                level = "degraded"
+        verdicts["tenant"] = {"level": level, "detail": detail}
 
         self._publish(verdicts, cur, now)
         return verdicts

@@ -136,6 +136,17 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         # skews which lanes materialize, never an answer.
         "lane_assemble_mb": 2.5e-4,
         "lane_build_cell": 2.0e-9,
+        # fused multi-query dispatch (query/batcher.py): the per-
+        # dispatch floor a stacked [Q, S, W] launch amortizes away
+        # (tunnel round trip + XLA launch — the quantity the batcher
+        # exists to stop paying Q times), and the per-cell host cost
+        # of stacking a member's [S, N] batch in + unpacking its
+        # [G, W] slice out.  ESTIMATES until the fitter sees batch
+        # traffic; batched runs are EXCLUDED from the calibration ring
+        # (like rewrites/tiled runs), so a bad constant skews the
+        # coalesce-vs-dispatch-now line, never an answer.
+        "stacked_dispatch": 1.5e-3,
+        "stacked_cell": 1.0e-9,
     },
     "cpu": {
         "gather_round": 2.0e-8,
@@ -166,6 +177,12 @@ DEFAULT_COSTS: dict[str, dict[str, float]] = {
         # rollup lanes: same host memcpy either platform
         "lane_assemble_mb": 2.5e-4,
         "lane_build_cell": 2.0e-9,
+        # stacked dispatch: the CPU jit-launch floor is smaller than
+        # the tunnel's but still dwarfs a small query's compute
+        # (~0.3 ms/dispatch measured on this dev box); stacking cells
+        # is host memcpy either platform
+        "stacked_dispatch": 3.0e-4,
+        "stacked_cell": 1.0e-9,
     },
 }
 
@@ -633,3 +650,44 @@ def features_lane_build(s: int, cells: int) -> dict[str, float]:
 
 def predict_lane_build(s: int, cells: int, platform: str) -> float:
     return _dot(features_lane_build(s, cells), platform)
+
+
+# -- fused multi-query dispatch (query/batcher.py) ---------------------- #
+
+def features_stacked(q: int, s: int, n: int, w: int, g: int
+                     ) -> dict[str, float]:
+    """Unit counts for the batching OVERHEAD of one stacked [Q, S, W]
+    dispatch: the single launch floor plus the host-side stack/unpack
+    traffic (each member's [S, N] input cells copied into the stacked
+    batch and its [G, W] output slice copied back out).  The members'
+    compute itself is priced by the same stage features a solo plan
+    uses (obs.jaxprof) — this vector is strictly the delta, so the
+    fitter could regress the stacking constants from residuals without
+    the compute terms aliasing them.  Linear in the constants by
+    construction: ``predict_stacked == dot(features_stacked, costs)``.
+    """
+    return {"stacked_dispatch": 1.0,
+            "stacked_cell": float(q * (s * n + g * w))}
+
+
+def predict_stacked(q: int, s: int, n: int, w: int, g: int,
+                    platform: str) -> float:
+    """Predicted seconds of stacked-execution overhead (one launch
+    floor + q members' stack/unpack traffic)."""
+    return _dot(features_stacked(q, s, n, w, g), platform)
+
+
+def coalesce_worthwhile(compute_s: float, s: int, n: int, w: int,
+                        g: int, platform: str, factor: float) -> bool:
+    """The coalesce-vs-dispatch-now verdict for ONE plan, from the
+    fitted constants (the Factor-Windows cost-based-rewrite framing:
+    price the rewrite, don't hardcode a batch size).  A plan is
+    DISPATCH-BOUND — worth stacking — when its predicted monolithic
+    compute plus its per-member stack/unpack overhead stays within
+    ``factor`` x the per-dispatch floor the stacking amortizes; a
+    compute-bound plan gains nothing from sharing a launch and
+    dispatches now.  Deterministic in (shape, cost table, factor), so
+    the explain engine reaches the same verdict the executor does."""
+    c = costs(platform)
+    member_s = float(s * n + g * w) * c["stacked_cell"]
+    return compute_s + member_s <= factor * c["stacked_dispatch"]

@@ -325,7 +325,9 @@ def _clear_dependent_caches() -> None:
                pipeline._jitted_group, pipeline._jitted_grid_tail,
                pipeline._jitted_downsample_grid,
                pipeline._jitted_group_rollup_avg,
-               pipeline._jitted_union_batch, streaming._jitted_update,
+               pipeline._jitted_union_batch,
+               pipeline._jitted_stacked_group,
+               streaming._jitted_update,
                streaming._jitted_update_sliced, streaming._jitted_finish):
         fn.clear_cache()
     try:

@@ -222,7 +222,31 @@ def _lane_partials(spec: WindowSpec, ts, val, mask, wargs):
     return sums, counts, mins, maxs
 
 
+def _stacked_group_pipeline(spec: PipelineSpec, num_groups: int, ts, val,
+                            mask, gid, wargs):
+    """Q compatible grouped queries in ONE stacked [Q, S, N] dispatch.
+
+    The fused multi-query batcher (query/batcher.py) buckets concurrent
+    small plans by (static spec, padded shapes, mode-policy epoch) and
+    vmaps the SAME _group_pipeline over a leading member axis — each
+    member keeps its own gid row map and its own traced window args
+    (stacked along axis 0), and inside the vmap the kernels trace on
+    the per-member [S, N] shapes, so the mode choosers pick exactly
+    what a solo dispatch of the same member would.  Per-member results
+    come back batched ([Q, W], [Q, G, W], [Q, G, W]) for host-side
+    unpack; on integer data a member's slice is bitwise what its solo
+    dispatch would produce (integer-exact f64 accumulation is
+    reassociation-proof — the same contract the rollup lanes pin).
+    """
+    return jax.vmap(
+        lambda t, v, m, g, w: _group_pipeline(spec, num_groups, t, v,
+                                              m, g, w))(
+        ts, val, mask, gid, wargs)
+
+
 _jitted_group = jax.jit(_group_pipeline, static_argnums=(0, 1))
+_jitted_stacked_group = jax.jit(_stacked_group_pipeline,
+                                static_argnums=(0, 1))
 _jitted_grid_tail = jax.jit(_grid_tail, static_argnums=(0, 1))
 _jitted_downsample_grid = jax.jit(_downsample_grid, static_argnums=0)
 _jitted_lane_partials = jax.jit(_lane_partials, static_argnums=0)
@@ -231,6 +255,18 @@ _jitted_lane_partials = jax.jit(_lane_partials, static_argnums=0)
 def run_grid_tail(spec: PipelineSpec, wts, v, m, gid, num_groups: int):
     """Finish a streamed query: grid [S, W] -> (wts, out[G, W], mask[G, W])."""
     return _jitted_grid_tail(spec, num_groups, wts, v, m, gid)
+
+
+# shape: ts[Q,S,N] any, val[Q,S,N] any, mask[Q,S,N] bool, gid[Q,S] any
+def run_stacked_group_pipeline(spec: PipelineSpec, ts, val, mask, gid,
+                               num_groups: int, wargs: dict):
+    """Q stacked grouped pipelines -> (wts[Q, W], out[Q, G, W],
+    mask[Q, G, W]) — the batcher's one-launch form of
+    run_group_pipeline; `wargs` values carry a leading member axis."""
+    if spec.downsample is None:
+        raise ValueError("grouped pipeline requires a downsample step")
+    return _jitted_stacked_group(spec, num_groups, ts, val, mask, gid,
+                                 wargs)
 
 
 # shape: ts[S,N] any, val[S,N] f64, mask[S,N] bool

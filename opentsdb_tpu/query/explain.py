@@ -520,7 +520,11 @@ def _explain_segment(tsdb, runner, query, sub, seg, what_if: WhatIf,
             "tsd.query.streaming.point_threshold"),
         host_lane_max=tsdb.config.get_int(
             "tsd.query.host_lane.max_points"),
-        ts_base=ts_base)
+        ts_base=ts_base,
+        batch_ok=(getattr(tsdb, "dispatch_batcher", None) is not None
+                  and tsdb.dispatch_batcher.enabled),
+        batch_factor=tsdb.config.get_float(
+            "tsd.query.batch.amortize_factor"))
     pd = pdn.plan_decision(
         tsdb, ctx, _ExplainConsults(tsdb, ctx, what_if, seg, sub,
                                     windows, store, series_list, fix))

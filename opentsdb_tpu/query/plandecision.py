@@ -41,9 +41,13 @@ from opentsdb_tpu.query.limits import GridBudgetDecision, grid_budget
 # Paths whose dispatch runs the monolithic downsample/group kernels —
 # the only paths whose per-axis kernel-mode decisions describe what
 # actually executes (lane/tiled/agg-rewrite paths run their own
-# programs); their fingerprints include the chosen modes.
+# programs); their fingerprints include the chosen modes.  "batched"
+# is monolithic too: the stacked [Q, S, W] kernel vmaps the SAME
+# grouped pipeline, and inside the vmap the mode choosers see the
+# per-member [S, N] shapes a solo dispatch would.
 MONOLITHIC_PATHS = frozenset(
-    {"streamed", "resident", "host_lane", "mesh", "rollup_avg"})
+    {"streamed", "resident", "host_lane", "mesh", "rollup_avg",
+     "batched"})
 
 
 @dataclass(frozen=True)
@@ -77,6 +81,12 @@ class RouteContext:
     point_threshold: int
     host_lane_max: int
     ts_base: int | None
+    # fused multi-query dispatch (query/batcher.py): tsd.query.batch.*
+    # enablement + the coalesce-pricing factor; the executor fills
+    # these from live config, explain from the same keys, so the
+    # `batched` arm cannot drift between them
+    batch_ok: bool = False
+    batch_factor: float = 0.0
 
 
 @dataclass
@@ -310,12 +320,52 @@ def plan_decision(tsdb, ctx: RouteContext, consults) -> PlanDecision:
         agg_platform = "cpu" if lane_small else ctx.platform
         agg_plan, agg_note = consults.agg_plan(agg_platform)
 
+    n_pad = pad_pow2(max(ctx.n_max, 1))
+    g_dec = pad_pow2(max(ctx.groups, 1))
+
+    # Fused multi-query dispatch (query/batcher.py), decided BEFORE
+    # the device-cache consult: a dispatch-bound plan (predicted
+    # compute within batch_factor x the fitted stacked-dispatch floor)
+    # routes through the batcher, which coalesces concurrent
+    # compatible plans into one stacked [Q, S, W] launch — the
+    # per-dispatch floor, not FLOPs, is what caps dashboard-fleet QPS,
+    # so amortizing ONE launch across Q members beats Q per-member
+    # device-cache gathers.  Compute-bound plans price as dispatch-now
+    # and keep the resident/device-cache chain below.  Deterministic
+    # in (shape, cost table, factor): explain reaches the same verdict.
+    batched = False
+    batch_decisions = None
+    price_platform = None
+    if (tiled_plan is None and lane_plan is None and agg_plan is None
+            and ctx.batch_ok and not would_stream and not ctx.use_mesh
+            and ctx.seg_kind == "raw" and ctx.has_store
+            and ctx.ds_fn is not None):
+        from opentsdb_tpu.ops import costmodel as cm
+        price_platform = "cpu" if lane_small else ctx.platform
+        # ONE decision recomputation: these per-axis reports price the
+        # coalesce line here and become pd.decisions below when the
+        # batched arm wins (the batched path's dec_platform equals
+        # price_platform by construction: cached stays None)
+        batch_decisions = jaxprof.segment_decisions(
+            price_platform, ctx.s, n_pad, ctx.wp, g_dec, ctx.ds_fn,
+            aggregator=ctx.aggregator)
+        compute_s = sum(jaxprof.stage_breakdown(
+            price_platform, ctx.s, n_pad, ctx.wp, g_dec, ctx.ds_fn,
+            ctx.has_rate, decisions=batch_decisions).values())
+        batched = cm.coalesce_worthwhile(
+            compute_s, ctx.s, n_pad, ctx.wp, g_dec, price_platform,
+            ctx.batch_factor)
+
     # Device-cache fast path (BlockCache analog): cold entries build
     # inline only when the alternative is a full host materialization
     # anyway; a warm hit that would divert a streaming query onto an
-    # over-budget materialized grid DECLINES the diversion.
+    # over-budget materialized grid DECLINES the diversion.  Batched
+    # plans skip the consult entirely: the stacked launch needs host
+    # arrays to stack, and one shared upload amortizes better than
+    # per-member pinned-column gathers.
     cached = None
     if (tiled_plan is None and lane_plan is None and agg_plan is None
+            and not batched
             and getattr(tsdb, "device_cache", None) is not None
             and ctx.has_store
             and ctx.seg_kind in ("raw", "rollup")):
@@ -331,6 +381,8 @@ def plan_decision(tsdb, ctx: RouteContext, consults) -> PlanDecision:
         path = "tiled"
     elif agg_plan is not None:
         path = "agg_rewrite"
+    elif batched:
+        path = "batched"
     elif cached is None and would_stream:
         path = "streamed"
     elif ctx.seg_kind == "rollup_avg":
@@ -342,20 +394,26 @@ def plan_decision(tsdb, ctx: RouteContext, consults) -> PlanDecision:
     else:
         path = "resident"
 
-    n_pad = pad_pow2(max(ctx.n_max, 1))
-    g_dec = pad_pow2(max(ctx.groups, 1))
     dec_platform = "cpu" if host_small else ctx.platform
     decisions = None
     if path in MONOLITHIC_PATHS:
-        # per-axis kernel-mode decisions through the SAME _effective_*
-        # choosers the kernels consult at trace time (PR 6); computed
-        # only where the monolithic kernels actually dispatch —
-        # lane/agg/tiled paths run their own programs, and pricing 4
-        # axes of candidates would tax the warm fast paths the caches
-        # exist to shrink
-        decisions = jaxprof.segment_decisions(
-            dec_platform, ctx.s, n_pad, ctx.wp, g_dec, ctx.ds_fn,
-            aggregator=ctx.aggregator)
+        if batch_decisions is not None \
+                and dec_platform == price_platform:
+            # the coalesce-pricing recomputation already produced this
+            # platform's reports — reuse them on the batched arm AND
+            # on the batch-declined fallthrough (dec_platform equals
+            # price_platform whenever the device-cache consult missed)
+            decisions = batch_decisions
+        else:
+            # per-axis kernel-mode decisions through the SAME
+            # _effective_* choosers the kernels consult at trace time
+            # (PR 6); computed only where the monolithic kernels
+            # actually dispatch — lane/agg/tiled paths run their own
+            # programs, and pricing 4 axes of candidates would tax the
+            # warm fast paths the caches exist to shrink
+            decisions = jaxprof.segment_decisions(
+                dec_platform, ctx.s, n_pad, ctx.wp, g_dec, ctx.ds_fn,
+                aggregator=ctx.aggregator)
     pd = PlanDecision(
         path=path, would_stream=would_stream, use_mesh=ctx.use_mesh,
         host_small=host_small, lane_small=lane_small, gbd=gbd,

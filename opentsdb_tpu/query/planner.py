@@ -472,6 +472,7 @@ class QueryRunner:
         ts_base = precompact_base(
             window_spec, getattr(windows, "first_window_ms", None))
         n_max = max(max(c) for _, _, c in kept)
+        batcher = getattr(tsdb, "dispatch_batcher", None)
         ctx = pdn.RouteContext(
             seg_kind=seg.kind, ds_fn=ds_fn, aggregator=sub.aggregator,
             has_rate=bool(sub.rate), s=len(gid), n_max=int(n_max),
@@ -488,7 +489,10 @@ class QueryRunner:
                 "tsd.query.streaming.point_threshold"),
             host_lane_max=tsdb.config.get_int(
                 "tsd.query.host_lane.max_points"),
-            ts_base=ts_base)
+            ts_base=ts_base,
+            batch_ok=batcher is not None and batcher.enabled,
+            batch_factor=tsdb.config.get_float(
+                "tsd.query.batch.amortize_factor"))
         pd = pdn.plan_decision(
             tsdb, ctx, _ExecConsults(tsdb, ctx, seg, sub, windows,
                                      store, series_list, fix))
@@ -516,6 +520,7 @@ class QueryRunner:
             self.exec_stats["hostLane"] = 1.0
         from opentsdb_tpu.ops.hostlane import host_lane
 
+        batch_info = None
         if lane_plan is not None:
             # Standing fast path: serve the downsample grid from the
             # rollup lane's mergeable partials (storage/rollup.py) —
@@ -549,6 +554,31 @@ class QueryRunner:
             out_ts, out_val, out_mask = self._run_agg_rewrite(
                 spec, agg_plan, series_list, gid, g_pad, windows,
                 window_spec, host_small, budget)
+        elif pd.path == "batched":
+            # Fused multi-query dispatch (query/batcher.py): this
+            # dispatch-bound plan rendezvouses with concurrent
+            # compatible plans and executes as one stacked [Q, S, N]
+            # kernel with host-side unpack — the per-dispatch floor is
+            # paid once per bucket instead of once per query.  The
+            # calibration ring skips batched executions like rewrites/
+            # tiled runs (a stacked launch's measured time describes
+            # no single member), so the span carries the decisions
+            # directly.
+            from opentsdb_tpu.query.limits import active_deadline
+            ts, val, mask, _ = build_batch_direct(
+                series_list, seg.start_ms, seg.end_ms, fix)
+            (out_ts, out_val, out_mask), batch_info = \
+                tsdb.dispatch_batcher.submit(
+
+                    spec, ts, val, mask, gid, g_pad, wargs,
+                    host_small, policy_epoch,
+                    deadline=active_deadline())
+            obs_trace.annotate(psp, batch=batch_info,
+                               costmodel=pd.decisions)
+            self.exec_stats["batched"] = 1.0
+            if batch_info["stacked"]:
+                self.exec_stats["batchedStacked"] = 1.0
+                self._bump("batchedQ", float(batch_info["q"]))
         elif cached is None and would_stream:
             # Beyond the threshold the batch never materializes: bounded
             # chunks are copied straight out of the store into the device
@@ -612,13 +642,13 @@ class QueryRunner:
         if psp is not None:
             obs_trace.device_wait(psp, (out_ts, out_val, out_mask))
             if agg_plan is None and tiled_plan is None \
-                    and lane_plan is None:
-                # rewritten, tiled AND lane-served segments skip the
-                # predicted-vs-actual ledger: the monolithic stage
-                # breakdown does not describe a block-decomposed,
-                # tiled, or lane-derived execution, and pairing its
-                # prediction with a partial actual
-                # would poison the calibration ring
+                    and lane_plan is None and pd.path != "batched":
+                # rewritten, tiled, lane-served AND batched segments
+                # skip the predicted-vs-actual ledger: the monolithic
+                # stage breakdown does not describe a block-decomposed,
+                # tiled, lane-derived, or stacked-multi-member
+                # execution, and pairing its prediction with a partial
+                # (or shared) actual would poison the calibration ring
                 self._trace_pipeline_stages(
                     psp, sub, seg, len(gid),
                     max(max(c) for _, _, c in kept), window_spec.count,
@@ -643,6 +673,8 @@ class QueryRunner:
                                     else "miss")
             if agg_note is not None:
                 fields["aggCache"] = agg_note
+            if batch_info is not None:
+                fields["batch"] = batch_info
             recorder.record("plan", **fields)
         with obs_trace.stage("extract"):
             out_ts = np.asarray(out_ts)

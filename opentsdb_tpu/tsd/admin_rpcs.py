@@ -280,6 +280,15 @@ class DiagRpc(HttpRpc):
         }
         if trace_id:
             reply["traceId"] = trace_id
+        else:
+            # the fair-share audit view: per-tenant inflight/queued/
+            # deficit plus the drained/refused split of the demand
+            # counter (tsd/admission.py weighted DRR).  Only on the
+            # full-ring view — a trace-scoped fetch is one request's
+            # evidence, not the gate's
+            gate = getattr(tsdb, "_admission_gate", None)
+            if gate is not None:
+                reply["tenants"] = gate.tenant_snapshot()
         query.send_reply(reply)
 
 

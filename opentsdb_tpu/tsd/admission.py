@@ -352,14 +352,19 @@ def try_degrade(tsdb, ts_query, budget_ms: float,
 class Permit:
     """One admitted query's permit: releases on exit, exactly once."""
 
-    def __init__(self, gate: "AdmissionGate | None"):
+    def __init__(self, gate: "AdmissionGate | None",
+                 tenant: str = "default", gate_tenant: str | None = None):
         self._gate = gate
         self._t0 = time.monotonic()
         self.degrade_note: dict | None = None
-        # the clamped tenant of the admitted request — set by admit()
+        # the clamped tenant of the admitted request — set at acquire
         # so downstream accounting (per-tenant latency histograms,
-        # slow-query captures) reuses ONE clamping decision
-        self.tenant = "default"
+        # slow-query captures) reuses ONE clamping decision.  The
+        # gate's OWN inflight bookkeeping releases under the identity
+        # it admitted with (gate_tenant — "default" when fair share
+        # is off), which admit() must never overwrite.
+        self.tenant = tenant
+        self._gate_tenant = gate_tenant or tenant
 
     def __enter__(self) -> "Permit":
         return self
@@ -370,16 +375,48 @@ class Permit:
     def release(self) -> None:
         gate, self._gate = self._gate, None
         if gate is not None:
-            gate._release((time.monotonic() - self._t0) * 1e3)
+            gate._release((time.monotonic() - self._t0) * 1e3,
+                          self._gate_tenant)
+
+
+class _Waiter:
+    """One queued query's token: identity + the DRR bookkeeping the
+    fair-share drain needs (clamped tenant, predicted cost).  `public`
+    is the un-collapsed clamped tenant the permit reports for latency
+    labels (== tenant unless fair share is off)."""
+
+    __slots__ = ("tenant", "priority", "cost_ms", "public")
+
+    def __init__(self, tenant: str, priority: str, cost_ms: float,
+                 public: str | None = None):
+        self.tenant = tenant
+        self.priority = priority
+        self.cost_ms = max(float(cost_ms), 1.0)
+        self.public = public or tenant
 
 
 class AdmissionGate:
-    """Concurrency permits + bounded per-priority FIFO wait queues.
+    """Concurrency permits + bounded per-priority wait queues with
+    weighted deficit-round-robin tenant fair share.
 
     One instance per TSDB (``gate_for``), shared by every responder
     thread.  All mutable state is guarded by ``_lock``; waiters park on
     a Condition sharing that lock and re-check on a short tick so
     cancellation flips (which don't notify) are observed promptly.
+
+    Draining order: priority class first (interactive before batch —
+    the PR 8 contract), then WEIGHTED DEFICIT ROUND ROBIN across the
+    clamped tenants inside a class, each queued entry costing its
+    costmodel-predicted milliseconds (1 ms floor when unpredicted).
+    Every virtual DRR round credits each backlogged tenant
+    ``tsd.query.tenant.quantum_ms`` x its weight of deficit; the
+    tenant able to afford its head entry in the fewest rounds drains
+    next — so one tenant's dashboard storm queues behind its own
+    deficit while other tenants' entries keep draining at their
+    weighted share.  ``tsd.query.tenant.max_inflight`` additionally
+    caps any one tenant's concurrently held permits.  With a single
+    tenant (the default) the drain reduces exactly to the PR 8
+    per-priority FIFO.
     """
 
     def __init__(self, config):
@@ -387,28 +424,70 @@ class AdmissionGate:
         self.permits = config.get_int("tsd.query.admission.permits")
         self.queue_limit = config.get_int("tsd.query.admission.queue_limit")
         self.max_wait_ms = config.get_int("tsd.query.admission.max_wait_ms")
+        self.fair_share = config.get_bool("tsd.query.tenant.fair_share")
+        self.quantum_ms = max(
+            config.get_int("tsd.query.tenant.quantum_ms"), 1)
+        self.tenant_max_inflight = config.get_int(
+            "tsd.query.tenant.max_inflight")
+        self._weights = self._parse_weights(
+            config.get_string("tsd.query.tenant.weights"))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self.in_flight = 0  # guarded-by: _lock
-        # one bounded FIFO of waiter tokens per priority class
+        # per priority class: tenant -> FIFO of _Waiter entries
         # guarded-by: _lock
-        self._queues: dict[str, deque] = {c: deque() for c in CLASSES}
+        self._queues: dict[str, dict[str, deque]] = {
+            c: {} for c in CLASSES}
+        # DRR rotation (tenants with queued work, arrival order) and
+        # deficit counters per class  # guarded-by: _lock
+        self._rr: dict[str, deque] = {c: deque() for c in CLASSES}
+        self._deficit: dict[str, dict[str, float]] = {
+            c: {} for c in CLASSES}
+        # permits currently held per tenant  # guarded-by: _lock
+        self._tenant_inflight: dict[str, int] = {}
         # EWMA of permit-hold time, the Retry-After basis
         self._ewma_service_ms = 200.0  # guarded-by: _lock
         self.admitted = 0  # guarded-by: _lock
         self.shed = 0  # guarded-by: _lock
+        # per-tenant drained/refused split (the fair-share audit trail;
+        # mirrored into the registry counters)  # guarded-by: _lock
+        self.tenant_admitted: dict[str, int] = {}
+        self.tenant_refused: dict[str, int] = {}
+
+    @staticmethod
+    def _parse_weights(spec: str) -> dict[str, float]:
+        """'tenant:weight,...' -> {tenant: weight}; malformed entries
+        are skipped (an operator typo must not take the gate down)."""
+        out: dict[str, float] = {}
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part or ":" not in part:
+                continue
+            name, _, w = part.rpartition(":")
+            try:
+                weight = float(w)
+            except ValueError:
+                continue
+            if name.strip() and weight > 0:
+                out[name.strip()] = weight
+        return out
+
+    def _weight(self, tenant: str) -> float:
+        return self._weights.get(tenant, 1.0)
 
     # -- accounting -----------------------------------------------------
 
     def _gauge_depths_locked(self) -> None:
-        for cls, q in self._queues.items():
+        for cls, tenants in self._queues.items():
             REGISTRY.gauge(
                 "tsd.query.admission.queue_depth",
                 "Admission wait-queue depth, by priority class").labels(
-                    priority=cls).set(len(q))
+                    priority=cls).set(
+                        sum(len(q) for q in tenants.values()))
 
     def _depth_locked(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        return sum(len(q) for tenants in self._queues.values()
+                   for q in tenants.values())
 
     def retry_after_s(self) -> int:
         """Seconds until capacity plausibly frees: the backlog (queued
@@ -428,83 +507,227 @@ class AdmissionGate:
             ewma = self._ewma_service_ms
         return backlog * ewma / max(self.permits, 1)
 
-    def _shed(self, reason: str, message: str) -> ShedError:
+    def _shed(self, reason: str, message: str,
+              tenant: str = "default") -> ShedError:
         with self._lock:
             self.shed += 1
+            self.tenant_refused[tenant] = \
+                self.tenant_refused.get(tenant, 0) + 1
         REGISTRY.counter(
             "tsd.query.admission.shed",
             "Queries refused by the admission gate, by reason").labels(
                 reason=reason).inc()
+        REGISTRY.counter(
+            "tsd.query.tenant.refused",
+            "Queries refused by the admission gate, by clamped tenant "
+            "(the refused half of the demand split)").labels(
+                tenant=tenant).inc()
         return ShedError(message, retry_after_s=self.retry_after_s())
 
     # -- acquire/release ------------------------------------------------
 
+    def _tenant_capped_locked(self, tenant: str) -> bool:
+        cap = self.tenant_max_inflight
+        return cap > 0 and self._tenant_inflight.get(tenant, 0) >= cap
+
+    def _queue_full_locked(self, tenant: str) -> bool:
+        """With fair share on, the queue bound applies PER TENANT: a
+        storming tenant saturates its own backlog and sheds at the
+        door while other tenants still enqueue (total backlog stays
+        bounded — tenant cardinality is clamped by tsd.diag.tenants/
+        tenant_buckets).  Fair share off keeps the PR 8 global bound."""
+        if not self.fair_share:
+            return self._depth_locked() >= self.queue_limit
+        return sum(len(self._queues[cls].get(tenant, ()))
+                   for cls in CLASSES) >= self.queue_limit
+
+    def _admit_locked(self, tenant: str, priority: str, wait_ms: float,
+                      public_tenant: str | None = None) -> Permit:
+        """`tenant` is the gate's DRR identity (collapsed to "default"
+        when fair share is off) and owns the inflight bookkeeping;
+        ACCOUNTING (the drained/refused split, the registry counters)
+        always uses the real clamped tenant, or the demand counter's
+        per-tenant series and the admitted series would disagree and
+        the health engine's starvation invariant would misfire on a
+        fair-share-off daemon."""
+        public = public_tenant or tenant
+        self.in_flight += 1
+        self.admitted += 1
+        self._tenant_inflight[tenant] = \
+            self._tenant_inflight.get(tenant, 0) + 1
+        self.tenant_admitted[public] = \
+            self.tenant_admitted.get(public, 0) + 1
+        self._set_inflight_gauge_locked()
+        self._observe_wait(priority, wait_ms)
+        return Permit(self, tenant=public, gate_tenant=tenant)
+
     def acquire(self, deadline: Deadline | None, priority: str,
-                route: str = "api/query") -> Permit:
+                route: str = "api/query", tenant: str = "default",
+                cost_ms: float = 1.0) -> Permit:
         """Block until a permit is held, or raise: ShedError (queue
         full / waited past max_wait), QueryException (deadline expired
-        or cancelled while queued — WITHOUT taking a permit)."""
+        or cancelled while queued — WITHOUT taking a permit).
+        ``cost_ms`` is the costmodel-predicted device cost the DRR
+        drain charges against the tenant's deficit."""
         faults.check("admission.acquire", route=route)
         if not self.enabled:
-            return Permit(None)
+            return Permit(None, tenant=tenant)
         if priority not in self._queues:
             priority = CLASSES[0]
-        token = object()
+        public_tenant = tenant
+        if not self.fair_share:
+            # fair share off: every query shares one DRR identity, so
+            # the drain below IS the PR 8 per-priority FIFO (the
+            # permit keeps the real clamped tenant for latency labels)
+            tenant = "default"
+        waiter = _Waiter(tenant, priority, cost_ms,
+                         public=public_tenant)
         t0 = time.monotonic()
+        admitted = None
         with self._lock:
-            if self.in_flight < self.permits \
-                    and self._depth_locked() == 0:
-                self.in_flight += 1
-                self.admitted += 1
-                self._set_inflight_gauge_locked()
-                self._observe_wait(priority, 0.0)
-                return Permit(self)
-            if self._depth_locked() >= self.queue_limit:
+            if (self.in_flight < self.permits
+                    and self._depth_locked() == 0
+                    and not self._tenant_capped_locked(tenant)):
+                admitted = self._admit_locked(tenant, priority, 0.0,
+                                              public_tenant)
+            elif self._queue_full_locked(tenant):
                 # raise outside the lock (the counter path re-locks)
                 full = True
             else:
                 full = False
-                self._queues[priority].append(token)
+                self._enqueue_locked(waiter)
                 self._gauge_depths_locked()
+        if admitted is not None:
+            self._count_admitted(public_tenant)
+            return admitted
         if full:
             raise self._shed(
                 "queue_full",
                 "Sorry, the query admission queue is full (%d waiting, "
                 "%d in flight). Please retry later." % (
-                    self.queue_limit, self.permits))
-        return self._wait_in_queue(deadline, priority, token, t0)
+                    self.queue_limit, self.permits),
+                tenant=public_tenant)
+        return self._wait_in_queue(deadline, waiter, t0)
 
-    def _wait_in_queue(self, deadline: Deadline | None, priority: str,
-                       token: object, t0: float) -> Permit:
+    @staticmethod
+    def _count_admitted(tenant: str) -> None:
+        REGISTRY.counter(
+            "tsd.query.tenant.admitted",
+            "Queries admitted through the gate, by clamped tenant "
+            "(the drained half of the demand split)").labels(
+                tenant=tenant).inc()
+
+    def _enqueue_locked(self, waiter: _Waiter) -> None:
+        tenants = self._queues[waiter.priority]
+        q = tenants.get(waiter.tenant)
+        if q is None:
+            q = tenants[waiter.tenant] = deque()
+            self._rr[waiter.priority].append(waiter.tenant)
+            self._deficit[waiter.priority].setdefault(waiter.tenant,
+                                                      0.0)
+        q.append(waiter)
+
+    def _remove_locked(self, waiter: _Waiter) -> None:
+        tenants = self._queues[waiter.priority]
+        q = tenants.get(waiter.tenant)
+        if q is None:
+            return
+        try:
+            q.remove(waiter)
+        except ValueError:
+            return
+        if not q:
+            del tenants[waiter.tenant]
+            try:
+                self._rr[waiter.priority].remove(waiter.tenant)
+            except ValueError:
+                pass
+            self._deficit[waiter.priority].pop(waiter.tenant, None)
+
+    def _pick_locked(self):
+        """The weighted-DRR drain choice: first priority class with
+        eligible work; within it, the tenant whose head entry needs
+        the fewest virtual quantum rounds to afford.  Returns
+        (waiter, rounds) or (None, 0) when nothing is eligible (all
+        queued tenants at their inflight cap)."""
+        for cls in CLASSES:
+            tenants = self._queues[cls]
+            if not tenants:
+                continue
+            deficit = self._deficit[cls]
+            best = None
+            for pos, t in enumerate(self._rr[cls]):
+                q = tenants.get(t)
+                if not q or self._tenant_capped_locked(t):
+                    continue
+                qw = self.quantum_ms * self._weight(t)
+                need = q[0].cost_ms - deficit.get(t, 0.0)
+                rounds = 0 if need <= 0 else int(math.ceil(need / qw))
+                if best is None or (rounds, pos) < (best[0], best[1]):
+                    best = (rounds, pos, t)
+            if best is not None:
+                rounds, _pos, t = best
+                return tenants[t][0], rounds
+            # every queued tenant in this class is capped: lower
+            # classes may still drain (capacity isolation, not a leak
+            # — the capped tenants' permits free into this class
+            # first on release)
+        return None, 0
+
+    def _claim_locked(self, waiter: _Waiter, rounds: int,
+                      t0: float) -> Permit:
+        """Serve `waiter`: run the virtual DRR rounds (crediting every
+        backlogged tenant in the class), charge its cost against its
+        tenant's deficit, and hand over a permit."""
+        cls = waiter.priority
+        deficit = self._deficit[cls]
+        if rounds:
+            for t in self._rr[cls]:
+                if self._queues[cls].get(t):
+                    deficit[t] = deficit.get(t, 0.0) \
+                        + rounds * self.quantum_ms * self._weight(t)
+        deficit[waiter.tenant] = deficit.get(waiter.tenant, 0.0) \
+            - waiter.cost_ms
+        self._remove_locked(waiter)
+        self._gauge_depths_locked()
+        # a claim changes the drain choice: with multiple free permits
+        # the NEXT eligible waiter must re-evaluate now, not on its
+        # 50 ms cancellation tick
+        self._cv.notify_all()
+        wait_ms = (time.monotonic() - t0) * 1e3
+        return self._admit_locked(waiter.tenant, cls, wait_ms,
+                                  waiter.public)
+
+    def _wait_in_queue(self, deadline: Deadline | None, waiter: _Waiter,
+                       t0: float) -> Permit:
+        tenant = waiter.public
         while True:
             expired = raise_shed = False
+            permit = None
             with self._lock:
-                q = self._queues[priority]
-                if self._head_is_locked(priority, token) \
-                        and self.in_flight < self.permits:
-                    q.popleft()
-                    self.in_flight += 1
-                    self.admitted += 1
-                    self._gauge_depths_locked()
-                    self._set_inflight_gauge_locked()
-                    wait_ms = (time.monotonic() - t0) * 1e3
-                    self._observe_wait(priority, wait_ms)
-                    return Permit(self)
-                if deadline is not None and (deadline.is_cancelled()
-                                             or deadline.expired()):
-                    q.remove(token)
-                    self._gauge_depths_locked()
-                    self._cv.notify_all()
-                    expired = True
-                else:
-                    waited_ms = (time.monotonic() - t0) * 1e3
-                    if waited_ms >= self.max_wait_ms > 0:
-                        q.remove(token)
+                if self.in_flight < self.permits:
+                    picked, rounds = self._pick_locked()
+                    if picked is waiter:
+                        permit = self._claim_locked(waiter, rounds, t0)
+                if permit is None:
+                    if deadline is not None and (deadline.is_cancelled()
+                                                 or deadline.expired()):
+                        self._remove_locked(waiter)
                         self._gauge_depths_locked()
                         self._cv.notify_all()
-                        raise_shed = True
+                        expired = True
                     else:
-                        self._cv.wait(_WAIT_TICK_S)
+                        waited_ms = (time.monotonic() - t0) * 1e3
+                        if waited_ms >= self.max_wait_ms > 0:
+                            self._remove_locked(waiter)
+                            self._gauge_depths_locked()
+                            self._cv.notify_all()
+                            raise_shed = True
+                        else:
+                            self._cv.wait(_WAIT_TICK_S)
+            if permit is not None:
+                self._count_admitted(tenant)
+                return permit
             if expired:
                 if deadline.is_cancelled():
                     count_cancelled("queued")
@@ -517,26 +740,66 @@ class AdmissionGate:
                 raise self._shed(
                     "max_wait",
                     "Sorry, no query capacity freed within %d ms. "
-                    "Please retry later." % self.max_wait_ms)
+                    "Please retry later." % self.max_wait_ms,
+                    tenant=tenant)
 
-    def _head_is_locked(self, priority: str, token: object) -> bool:
-        """True when `token` is first in drain order: every
-        higher-priority queue empty and token at its queue's head."""
-        for cls in CLASSES:
-            q = self._queues[cls]
-            if cls == priority:
-                return bool(q) and q[0] is token
-            if q:
-                return False
-        return False
-
-    def _release(self, held_ms: float) -> None:
+    def _release(self, held_ms: float, tenant: str = "default") -> None:
         with self._lock:
             self.in_flight -= 1
+            left = self._tenant_inflight.get(tenant, 0) - 1
+            if left > 0:
+                self._tenant_inflight[tenant] = left
+            else:
+                self._tenant_inflight.pop(tenant, None)
             self._ewma_service_ms = (0.8 * self._ewma_service_ms
                                      + 0.2 * held_ms)
             self._set_inflight_gauge_locked()
             self._cv.notify_all()
+
+    def contended(self) -> bool:
+        """True when an arrival would queue (permits exhausted or a
+        backlog exists) — the state in which DRR costs matter."""
+        with self._lock:
+            return (self.in_flight >= self.permits
+                    or self._depth_locked() > 0)
+
+    def tenant_inflight_of(self, tenant: str) -> int:
+        """Permits this tenant currently holds (the admission span's
+        fair-share annotation)."""
+        with self._lock:
+            return self._tenant_inflight.get(tenant, 0)
+
+    def tenant_snapshot(self) -> dict:
+        """The fair-share audit view served at /api/diag: per-tenant
+        inflight permits, queued backlog, current deficit, weight, and
+        the drained/refused split of the demand counter."""
+        with self._lock:
+            tenants: set[str] = set(self._tenant_inflight)
+            tenants.update(self.tenant_admitted)
+            tenants.update(self.tenant_refused)
+            for cls in CLASSES:
+                tenants.update(self._queues[cls])
+            out = {}
+            for t in sorted(tenants):
+                out[t] = {
+                    "inflight": self._tenant_inflight.get(t, 0),
+                    "queued": sum(
+                        len(self._queues[cls].get(t, ()))
+                        for cls in CLASSES),
+                    "deficitMs": {
+                        cls: round(self._deficit[cls].get(t, 0.0), 3)
+                        for cls in CLASSES
+                        if t in self._deficit[cls]},
+                    "weight": self._weight(t),
+                    "admitted": self.tenant_admitted.get(t, 0),
+                    "refused": self.tenant_refused.get(t, 0),
+                }
+            return {
+                "fairShare": self.fair_share,
+                "quantumMs": self.quantum_ms,
+                "maxInflightPerTenant": self.tenant_max_inflight,
+                "tenants": out,
+            }
 
     def _set_inflight_gauge_locked(self) -> None:
         REGISTRY.gauge(
@@ -616,8 +879,21 @@ def admit(tsdb, ts_query, http_query=None,
             # 413/503 here, not a misleading shed
             deadline.check()
         note = None
+        cost_ms = 1.0
+        if (gate.enabled and gate.fair_share
+                and not (deadline is not None and deadline.bounded)
+                and gate.contended()):
+            # unbounded-deadline requests skip the shed estimate below,
+            # but the DRR drain still needs a real per-query cost while
+            # the gate is CONTENDED — without it, weighted fair share
+            # degrades to query-count round robin and a tenant of huge
+            # scans drains the same share as a tenant of tiny dashboard
+            # panels.  Uncontended gates skip the walk (fast-path
+            # admits never consult the deficit).
+            cost_ms = estimate_plan_cost_ms(tsdb, ts_query)
         if gate.enabled and deadline is not None and deadline.bounded:
             predicted_ms = estimate_plan_cost_ms(tsdb, ts_query)
+            cost_ms = predicted_ms
             queue_ms = gate.queue_wait_estimate_ms()
             remaining_ms = deadline.remaining_ms()
             obs_trace.annotate(span, predicted_ms=round(predicted_ms, 3),
@@ -643,7 +919,8 @@ def admit(tsdb, ts_query, http_query=None,
                         "after an estimated %d ms queue wait). Please "
                         "decrease your time range or coarsen the "
                         "downsample interval." % (
-                            predicted_ms, remaining_ms, queue_ms))
+                            predicted_ms, remaining_ms, queue_ms),
+                        tenant=tenant)
                 REGISTRY.counter(
                     "tsd.query.admission.degraded",
                     "Queries served degraded by the admission ladder, "
@@ -651,7 +928,8 @@ def admit(tsdb, ts_query, http_query=None,
                 obs_trace.annotate(span, degraded=note)
         t0 = time.monotonic()
         try:
-            permit = gate.acquire(deadline, priority, route=route)
+            permit = gate.acquire(deadline, priority, route=route,
+                                  tenant=tenant, cost_ms=cost_ms)
         except QueryException as e:
             wait_ms = round((time.monotonic() - t0) * 1e3, 3)
             decision = "shed" if isinstance(e, ShedError) else "cancelled"
@@ -665,7 +943,9 @@ def admit(tsdb, ts_query, http_query=None,
         permit.tenant = tenant
         wait_ms = round((time.monotonic() - t0) * 1e3, 3)
         decision = "degraded" if note else "admitted"
-        obs_trace.annotate(span, decision=decision, wait_ms=wait_ms)
+        obs_trace.annotate(span, decision=decision, wait_ms=wait_ms,
+                           tenant_inflight=gate.tenant_inflight_of(
+                               permit._gate_tenant))
         if recorder is not None:
             fields = {"decision": decision, "route": route,
                       "priority": priority, "tenant": tenant,

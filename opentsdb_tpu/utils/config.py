@@ -248,6 +248,12 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "int", "3", "Circuit-breaker open transitions per window "
         "above which the cluster subsystem reads degraded (failing "
         "at 2x); any breaker currently open is at least degraded."),
+    "tsd.health.tenant_share_ratio": _e(
+        "float", "10", "Cross-tenant starvation bound: among tenants "
+        "with meaningful window demand, the max/min admitted-share "
+        "ratio above which the tenant subsystem reads degraded "
+        "(failing when a demanding tenant was admitted NOTHING while "
+        "others were served)."),
     # -- costmodel autotune (ops/calibrate.py, docs/costmodel.md) ------ #
     "tsd.costmodel.autotune.enable": _e(
         "bool", False, "Online costmodel calibration: fit the kernel-"
@@ -517,12 +523,69 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "arrivals beyond this wait in the admission queue."),
     "tsd.query.admission.queue_limit": _e(
         "int", "64",
-        "Bound on TOTAL queued queries across priority classes; a "
-        "full queue sheds new arrivals with 503 + Retry-After."),
+        "Bound on queued queries across priority classes; a full "
+        "queue sheds new arrivals with 503 + Retry-After.  With "
+        "tsd.query.tenant.fair_share on, the bound applies PER "
+        "clamped tenant (a storming tenant saturates its own backlog "
+        "without shedding the rest); off, it is the global total."),
     "tsd.query.admission.max_wait_ms": _e(
         "int", "5000",
         "Longest a query may wait for a permit before being shed "
         "(0 = wait bounded only by the request deadline)."),
+    # -- fused multi-query dispatch (query/batcher.py,
+    #    docs/batching.md) ---------------------------------------------- #
+    "tsd.query.batch.enable": _e(
+        "bool", True,
+        "Coalesce concurrent dispatch-bound queries (plan_decision "
+        "path 'batched') into one stacked [Q, S, N] device kernel "
+        "with host-side unpack — the per-dispatch floor is paid once "
+        "per bucket instead of once per query.  Uncontended queries "
+        "dispatch solo with zero hold."),
+    "tsd.query.batch.hold_ms": _e(
+        "int", "2",
+        "Longest a bucket leader holds the coalesce window open for "
+        "joiners.  Applied only while the admission gate shows other "
+        "queries in flight — an idle daemon never pays coalesce "
+        "latency."),
+    "tsd.query.batch.max_q": _e(
+        "int", "16",
+        "Member queries per stacked dispatch; a full bucket seals and "
+        "dispatches immediately."),
+    "tsd.query.batch.max_mb": _e(
+        "int", "64",
+        "Byte bound on one bucket's stacked operands (members' padded "
+        "[S, N] batches); a bucket at the bound seals and dispatches "
+        "immediately."),
+    "tsd.query.batch.amortize_factor": _e(
+        "float", "4.0",
+        "Coalesce-vs-dispatch-now line: a plan routes through the "
+        "batcher when its costmodel-predicted compute plus stack/"
+        "unpack overhead stays within this factor x the fitted "
+        "stacked-dispatch floor (COST_TERMS stacked_dispatch/"
+        "stacked_cell).  Compute-bound plans dispatch now."),
+    # -- per-tenant fair share (tsd/admission.py) ----------------------- #
+    "tsd.query.tenant.fair_share": _e(
+        "bool", True,
+        "Drain the admission queues by weighted deficit round robin "
+        "across clamped tenants (X-TSDB-Tenant via tsd.diag.tenants) "
+        "inside each priority class, so one tenant's dashboard storm "
+        "cannot starve the rest.  Off: every query shares one FIFO "
+        "identity (the PR 8 behavior)."),
+    "tsd.query.tenant.weights": _e(
+        "str", "",
+        "Per-tenant DRR weights as 'tenant:weight,...' (default "
+        "weight 1).  A tenant with weight 2 drains twice the "
+        "predicted-cost share per round."),
+    "tsd.query.tenant.quantum_ms": _e(
+        "int", "50",
+        "Deficit-round-robin quantum: predicted-cost milliseconds "
+        "credited to each backlogged tenant per virtual drain round, "
+        "scaled by its weight."),
+    "tsd.query.tenant.max_inflight": _e(
+        "int", "0",
+        "Cap on admission permits any one tenant may hold "
+        "concurrently (0 = no per-tenant cap; the global permit "
+        "bound still applies)."),
     "tsd.query.degrade": _e(
         "str", "error",
         "Stance when a query's predicted cost cannot fit its "

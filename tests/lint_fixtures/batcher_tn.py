@@ -1,0 +1,46 @@
+"""Batched-dispatch obligation true negatives: the sanctioned shapes
+the real batcher/planner use (query/batcher.py, the planner's batched
+branch) — the member span finished on every path, bucket state
+mutated only under the batcher lock, and fixed-vocabulary outcome
+labels.  Parsed, never imported."""
+
+import threading
+
+REGISTRY = None  # stub: the analyzer matches the receiver NAME
+
+
+def batched_span_finished_on_every_path(obs_trace, batcher, plan):
+    span = obs_trace.begin("pipeline")
+    try:
+        if not batcher.enabled:
+            return None
+        return batcher.submit(plan)
+    finally:
+        obs_trace.end(span)
+
+
+class BucketStateLocked:
+    """The real bucket discipline: every members/nbytes mutation under
+    the one batcher lock (the leader's seal snapshot included)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.members = []  # guarded-by: _lock
+        self.nbytes = 0    # guarded-by: _lock
+
+    def add(self, member, size):
+        with self._lock:
+            self.members.append(member)
+            self.nbytes += size
+
+    def seal(self):
+        with self._lock:
+            live = list(self.members)
+            self.members = []
+            self.nbytes = 0
+        return live
+
+
+def batch_counts_fixed_outcomes(stacked):
+    outcome = "stacked" if stacked else "solo"
+    REGISTRY.counter("tsd.fixture.count").labels(route=outcome).inc()

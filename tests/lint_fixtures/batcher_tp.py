@@ -1,0 +1,41 @@
+"""Batched-dispatch obligation true positives (ISSUE 14): the shapes
+the fused multi-query dispatcher must NOT take — a member's pipeline
+span leaked when the rendezvous bails early, bucket state mutated
+without the batcher lock despite its annotation, and a batch-outcome
+metric minted from a raw string.  Parsed, never imported."""
+
+import threading
+
+REGISTRY = None  # stub: the analyzer matches the receiver NAME
+
+
+def batched_span_leaks_on_declined_submit(obs_trace, batcher, plan):
+    """The planner's batched branch begins the pipeline span before
+    the rendezvous; declining WITHOUT ending it leaks the span."""
+    span = obs_trace.begin("pipeline")
+    if not batcher.enabled:
+        return None  # EXPECT: resource-leak-return
+    out = batcher.submit(plan)
+    obs_trace.end(span)
+    return out
+
+
+class BucketStateUnlocked:
+    """Batcher bucket bookkeeping is guarded-by the batcher lock; a
+    lock-free member append races the leader's seal."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.members = []  # guarded-by: _lock
+        self.nbytes = 0    # guarded-by: _lock
+
+    def add(self, member, size):
+        self.nbytes = self.nbytes + size  # EXPECT: lock-unguarded-mutation
+        with self._lock:
+            self.members.append(member)
+
+
+def batch_outcome_from_member_count(q):
+    """Outcome labels come from a fixed vocabulary ('stacked'/'solo'),
+    never a computed value — cardinality discipline."""
+    REGISTRY.counter("tsd.fixture." + str(q)).inc()  # EXPECT: metrics-dynamic-name

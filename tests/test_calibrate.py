@@ -178,15 +178,17 @@ class TestFitConstants:
         for term, value in fitted.items():
             assert value == pytest.approx(TRUE_CPU[term], rel=1e-3), \
                 term
-        # every cpu-exercisable term is covered by the mix; the spill
-        # and rollup-lane terms never appear in ring features (tiled
-        # and lane-served executions are ring-excluded by design,
-        # tests/test_tiling.py / test_rollup_lanes.py — their
-        # constants fit offline / from a future tiled-measurement path)
+        # every cpu-exercisable term is covered by the mix; the spill,
+        # rollup-lane, and stacked-dispatch terms never appear in ring
+        # features (tiled, lane-served, AND batched executions are
+        # ring-excluded by design, tests/test_tiling.py /
+        # test_rollup_lanes.py / test_batcher.py — their constants fit
+        # offline / from a future dedicated-measurement path)
         assert set(fitted) == set(TRUE_CPU) - {
             "cmp_cell", "hier_cell", "sorted2_grid",
             "spill_write_mb", "spill_read_mb", "tile_dispatch",
-            "lane_assemble_mb", "lane_build_cell"}
+            "lane_assemble_mb", "lane_build_cell",
+            "stacked_dispatch", "stacked_cell"}
 
     def test_recovery_survives_jitter(self):
         """+-2% measurement noise: well-constrained terms land near
@@ -437,10 +439,12 @@ class TestConvergence:
             "tsd.core.auto_create_metrics": True,
             "tsd.query.mesh.enable": False,
             # the convergence proof needs every served query in the
-            # calibration ring; partial-aggregate rewrites skip the
-            # predicted-vs-actual ledger by design (their stage
-            # breakdown doesn't describe a block-decomposed execution)
+            # calibration ring; partial-aggregate rewrites AND batched
+            # executions skip the predicted-vs-actual ledger by design
+            # (their stage breakdown doesn't describe a
+            # block-decomposed or stacked-multi-member execution)
             "tsd.query.cache.enable": False,
+            "tsd.query.batch.enable": False,
             "tsd.costmodel.autotune.enable": True,
             "tsd.costmodel.autotune.interval": 1,
             "tsd.costmodel.autotune.min_samples": 16,

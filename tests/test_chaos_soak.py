@@ -88,6 +88,24 @@ def test_spill_contract_holds():
     assert "healed" in proc.stdout
 
 
+@pytest.mark.slow
+def test_tenants_contract_holds():
+    """ISSUE 14 acceptance: one tenant storming a fair-share gate
+    sheds on its own per-tenant backlog (503 + Retry-After, never a
+    500) while the victim tenant is never shed and its p99 holds
+    within the solo-baseline bound; post-heal, /api/diag/health reads
+    every subsystem ok (including cross-tenant starvation) and the
+    ring retains the storm's shed evidence."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14283", "--rounds", "20", "--tenants",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "fair share held" in proc.stdout
+    assert "victim sheds 0" in proc.stdout
+
+
 def test_cluster_contracts_hold_under_chaos():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),

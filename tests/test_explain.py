@@ -45,7 +45,11 @@ def _manager(**cfg):
     props = {"tsd.core.auto_create_metrics": True,
              "tsd.query.mesh.enable": "false",
              "tsd.rollup.interval": "0",
-             "tsd.stats.interval": "0"}
+             "tsd.stats.interval": "0",
+             # this file pins the PRE-batching routing matrix; the
+             # batched arm's parity + corpus entries live in
+             # tests/test_batcher.py
+             "tsd.query.batch.enable": "false"}
     props.update({k: str(v) for k, v in cfg.items()})
     tsdb = TSDB(Config(props))
     return tsdb, RpcManager(tsdb)

@@ -168,7 +168,7 @@ class TestEndpoints:
         assert status == 200
         status, payload, _ = ask(mgr, "/api/diag")
         assert status == 200
-        assert set(payload) == {"seq", "ringSize", "events"}
+        assert set(payload) == {"seq", "ringSize", "events", "tenants"}
         assert payload["seq"] >= 1
         kinds = {e["kind"] for e in payload["events"]}
         assert {"admission", "plan"} <= kinds
@@ -206,7 +206,7 @@ class TestEndpoints:
         assert payload["overall"] == "ok"
         assert set(payload["subsystems"]) == {
             "admission", "compile", "agg_cache", "costmodel", "spill",
-            "cluster"}
+            "cluster", "tenant"}
         for verdict in payload["subsystems"].values():
             assert verdict["level"] in ("ok", "degraded", "failing")
             assert verdict["detail"]
@@ -330,7 +330,7 @@ class TestProducers:
         plan = plans[-1]
         assert plan["metric"] == "fr.m"
         assert plan["path"] in ("resident", "host_lane", "streamed",
-                                "agg_rewrite")
+                                "agg_rewrite", "batched")
         assert plan["series"] >= 1 and plan["windows"] >= 1
 
 

@@ -74,11 +74,13 @@ def _lint_fixture(name: str) -> list:
 TRUE_POSITIVE = ["jax_tp.py", "lock_tp.py", "config_tp.py", "except_tp.py",
                  "shape_tp.py", "taint_tp.py", "leak_tp.py",
                  "cache_tp.py", "install_tp.py", "span_tp.py",
-                 "metrics_tp.py", "flightrec_tp.py", "explain_tp.py"]
+                 "metrics_tp.py", "flightrec_tp.py", "explain_tp.py",
+                 "batcher_tp.py"]
 TRUE_NEGATIVE = ["jax_tn.py", "lock_tn.py", "config_tn.py", "except_tn.py",
                  "shape_tn.py", "taint_tn.py", "leak_tn.py",
                  "cache_tn.py", "install_tn.py", "span_tn.py",
-                 "metrics_tn.py", "flightrec_tn.py", "explain_tn.py"]
+                 "metrics_tn.py", "flightrec_tn.py", "explain_tn.py",
+                 "batcher_tn.py"]
 
 
 @pytest.mark.parametrize("name", TRUE_POSITIVE)

@@ -29,7 +29,11 @@ BASE = 1_356_998_400
 @pytest.fixture
 def tsdb():
     t = TSDB(Config({"tsd.core.auto_create_metrics": True,
-                     "tsd.query.mesh.enable": False}))
+                     "tsd.query.mesh.enable": False,
+                     # the suite pins the calibration-ring mechanics;
+                     # batched executions are ring-excluded by design
+                     # (tests/test_batcher.py owns that contract)
+                     "tsd.query.batch.enable": False}))
     for host in ("web01", "web02"):
         for i in range(20):
             t.add_point("obs.cpu", BASE + i * 10, float(i), {"host": host})

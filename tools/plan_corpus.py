@@ -45,6 +45,10 @@ _COMMON = {
     "tsd.query.mesh.enable": "false",
     "tsd.rollup.interval": "0",          # no maintenance cadence races
     "tsd.stats.interval": "0",
+    # the legacy profiles pin the PRE-batching routing matrix; the
+    # `batched` arm gets its own profile below so every older entry's
+    # path/fingerprint stays a stable regression anchor
+    "tsd.query.batch.enable": "false",
 }
 
 PROFILES: dict[str, dict] = {
@@ -75,6 +79,14 @@ PROFILES: dict[str, dict] = {
         "tsd.rollup.intervals": "1m,1h",
         "tsd.query.degrade": "allow",
     },
+    # fused multi-query dispatch (query/batcher.py): the `batched`
+    # routing arm + its costmodel-priced dispatch-now decline, with
+    # the device cache out of the way so the declined arm resolves
+    # cleanly
+    "batched": {
+        "tsd.query.batch.enable": "true",
+        "tsd.query.device_cache.enable": "false",
+    },
 }
 
 
@@ -102,6 +114,9 @@ def _build_profile(name: str):
         _feed(tsdb, "corpus.big", 4, 6000, 1)
     elif name in ("tiled", "refused"):
         _feed(tsdb, "corpus.wide", 8, 5760, 30)
+    elif name == "batched":
+        _feed(tsdb, "corpus.small", 3, 64, 15)
+        _feed(tsdb, "corpus.big", 4, 6000, 1)
     elif name == "rollup":
         _feed(tsdb, "corpus.lane", 8, 5760, 15)
         # 7 days at 1m cadence: wide enough that a 60s-interval grid
@@ -160,6 +175,14 @@ ENTRIES = [
      {"assume_rollup": "warm", "state_mb": "1"}, False),
     ("degrade_preview", "rollup", "sum:15s-avg:corpus.lane",
      BASE, BASE + 5760 * 15, {"deadline_ms": "1"}, False),
+    # fused multi-query dispatch: a dispatch-bound small query routes
+    # through the batcher; a compute-heavy shape prices past the
+    # amortize factor and DECLINES to dispatch-now (the cost-based
+    # coalesce line, not a static batch size)
+    ("batched_small", "batched", "sum:30s-avg:corpus.small",
+     BASE, BASE + 64 * 15, {}, False),
+    ("batched_declined_compute_bound", "batched",
+     "sum:2s-avg:corpus.big", BASE, BASE + 6000, {}, False),
 ]
 
 

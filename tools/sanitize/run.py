@@ -43,6 +43,7 @@ SUBSET_TIER1 = [
     "tests/test_concurrency.py",
     "tests/test_cluster_serving.py",
     "tests/test_admission.py",
+    "tests/test_batcher.py",
     "tests/test_flightrec.py",
     "tests/test_explain.py",
     "tests/test_agg_cache.py",
