@@ -231,13 +231,35 @@ class TSDB:
         self.unknown_metrics = 0  # guarded-by: _stats_lock
         # Restore LAST: WAL replay drives the full _apply_* paths, which
         # touch stats/meta/tree state initialized above.
+        # _replaying is a property: the process-wide flag (startup WAL
+        # replay) OR a per-thread flag (replication apply — concurrent
+        # ingest on other threads must keep journaling)
+        self._replay_tls = threading.local()
         self._replaying = False   # WAL replay bypasses the ro-mode gate
+        # sharded ownership + WAL-shipping replication
+        # (tsd/replication.py, docs/replication.md) — constructed
+        # BEFORE the restore below so replayed "rr" records can rebuild
+        # the per-origin catch-up positions
+        self.replication = None
+        if self.config.get_bool("tsd.network.cluster.shard.enable"):
+            from opentsdb_tpu.tsd.replication import ReplicationManager
+            self.replication = ReplicationManager(self)
+            self.stats_hooks["replication"] = self.replication.stats_hook
         self.persistence = None
         storage_dir = self.config.get_string("tsd.storage.directory")
         if storage_dir:
             from opentsdb_tpu.storage.persist import DiskPersistence
             self.persistence = DiskPersistence(self, storage_dir)
             self.persistence.restore()
+
+    @property
+    def _replaying(self) -> bool:
+        return self._replaying_flag or getattr(self._replay_tls, "on",
+                                               False)
+
+    @_replaying.setter
+    def _replaying(self, value: bool) -> None:
+        self._replaying_flag = value
 
     # ------------------------------------------------------------------ #
     # Write path (TSDB.addPoint :1051)                                   #
@@ -330,7 +352,33 @@ class TSDB:
 
     def add_point(self, metric: str, timestamp: int | float, value,
                   tags: dict[str, str]) -> None:
-        """Store one datapoint; value may be int, float, or numeric string."""
+        """Store one datapoint; value may be int, float, or numeric string.
+
+        With sharded replication armed the point first routes to its
+        shard's accepting member (forwarded in one hop when that is a
+        peer); a locally-accepted point journals with its shard id and
+        ships synchronously to the shard's replicas before returning —
+        the ack-path durability contract (tsd/replication.py)."""
+        repl = self.replication
+        if repl is not None and not self._replaying:
+            if repl.should_route() \
+                    and repl.route_point(metric, timestamp, value, tags):
+                return
+            # accepting member (owner, failover member, or the routed
+            # hop's receiver): apply + journal with the shard id, then
+            # ship to the shard's replicas before acking
+            shard = repl.shard_of(metric, tags)
+            entry = None
+            with self._ingest_lock:
+                self._apply_point(metric, timestamp, value, tags)
+                if self.persistence is not None:
+                    rec = {"k": "p", "m": metric, "t": timestamp,
+                           "v": value, "g": dict(tags), "sh": shard}
+                    seq, crc = self.persistence.journal(rec)
+                    entry = (seq, crc, shard, rec)
+            if entry is not None:
+                repl.on_committed([entry])
+            return
         with self._ingest_lock:
             self._apply_point(metric, timestamp, value, tags)
             if self.persistence is not None:
@@ -370,7 +418,23 @@ class TSDB:
         series takes ONE lock + ONE columnar append_batch; the WAL gets
         one record per request.  Returns (success_count,
         [(index, exception), ...]) with indexes into `dps`.
+
+        With sharded replication armed the body partitions by accepting
+        member first (tsd/replication.py ingest_bulk): remote groups
+        forward in one POST each, local groups land per shard so every
+        WAL record carries one shard id and ships to that shard's
+        replicas.
         """
+        repl = self.replication
+        if repl is not None and not self._replaying:
+            return repl.ingest_bulk(dps)
+        return self._add_points_bulk_local(dps)
+
+    def _add_points_bulk_local(self, dps: list[dict], shard: int | None
+                               = None) -> tuple[int, list]:
+        """The locally-accepted bulk path.  ``shard`` (replication only)
+        stamps the journaled record and ships it to the shard's
+        replicas after commit."""
         import numpy as np
 
         if self.mode == "ro" and not self._replaying:
@@ -427,6 +491,7 @@ class TSDB:
                 errors.append((i, e))
         stored: list[dict] = []    # journal only what actually landed
         publish: list = []
+        entry = None
         with self._ingest_lock:
             for key, (tss, fvals, ivals, isints, idxs, raw,
                       pubs) in groups.items():
@@ -450,7 +515,14 @@ class TSDB:
                 publish.extend(pubs)
             if self.persistence is not None and stored \
                     and not self._replaying:
-                self.persistence.journal({"k": "pb", "d": stored})
+                rec = {"k": "pb", "d": stored}
+                if shard is not None:
+                    rec["sh"] = shard
+                seq, crc = self.persistence.journal(rec)
+                if shard is not None:
+                    entry = (seq, crc, shard, rec)
+        if entry is not None and self.replication is not None:
+            self.replication.on_committed([entry])
         for metric, ts_ms, num, tags, key in publish:
             self.rt_publisher.publish_data_point(metric, ts_ms, num, tags,
                                                  key.tsuid())
@@ -493,8 +565,11 @@ class TSDB:
         return success, errors, parsed.spans
 
     def _native_ingest_eligible(self) -> bool:
-        """True when no TSDB feature needs per-point Python hooks."""
+        """True when no TSDB feature needs per-point Python hooks.
+        Sharded replication needs per-point shard routing, so its
+        daemons take the Python bulk path (which partitions by owner)."""
         return (self.write_filter is None and self.rt_publisher is None
+                and self.replication is None
                 and not (self.rollup_store is not None
                          and self.tag_raw_data))
 
@@ -1049,6 +1124,10 @@ class TSDB:
             # restore any exploration override and persist the fitted
             # constants so calibration survives the restart
             autotuner.shutdown()
+        if self.replication is not None:
+            # before the snapshot: no pull may apply (and journal) a
+            # peer record while the WAL is being reset
+            self.replication.stop_puller()
         self.flush()
         if self.persistence is not None:
             with self._ingest_lock:

@@ -190,6 +190,59 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
     "tsd.cluster.breaker.fast_fails": _m(
         "gauge", ("peer",),
         "Requests fast-failed by an open breaker."),
+    # -- sharded replication (tsd/replication.py, docs/replication.md): #
+    #    registry families ---------------------------------------------#
+    "tsd.replication.ship.records": _m(
+        "counter", ("peer",),
+        "WAL records synchronously shipped to a replica on the ingest "
+        "ack path, by replica peer."),
+    "tsd.replication.ship.errors": _m(
+        "counter", ("peer",),
+        "Synchronous ship attempts that failed (the pull cadence "
+        "fills the gap), by replica peer."),
+    "tsd.replication.tail.requests": _m(
+        "counter", (),
+        "/api/replication/tail pages served to catching-up peers."),
+    "tsd.replication.tail.records": _m(
+        "counter", (),
+        "WAL records served through /api/replication/tail."),
+    "tsd.replication.catch_up.records": _m(
+        "counter", ("peer",),
+        "Peer WAL records applied from pulled tails (the catch-up "
+        "path), by origin peer."),
+    "tsd.replication.forwarded": _m(
+        "counter", ("peer",),
+        "Ingest writes forwarded to the shard's accepting member, by "
+        "destination peer."),
+    "tsd.replication.divergence": _m(
+        "counter", ("peer",),
+        "Anti-entropy CRC-chain divergences detected (position reset "
+        "to the last agreed record + re-pull), by peer."),
+    "tsd.replication.inflight_rejected": _m(
+        "counter", (),
+        "Replication ship/tail requests refused by the "
+        "tsd.replication.max_inflight_mb byte gate (503; the sender "
+        "falls back to the pull cadence)."),
+    # -- sharded replication stats walk (ReplicationManager.stats_hook #
+    #    -> /api/stats + the self-report loop) ------------------------- #
+    "tsd.replication.epoch": _m(
+        "gauge", (),
+        "Ownership epoch: bumps on every shard-cover change (failover, "
+        "rejoin); the flight recorder retains the transition."),
+    "tsd.replication.last_seq": _m(
+        "gauge", (), "This node's newest assigned WAL sequence number."),
+    "tsd.replication.under_replicated": _m(
+        "gauge", (),
+        "Shards with fewer healthy members than the replication "
+        "factor (the eighth health invariant's input)."),
+    "tsd.replication.lag": _m(
+        "gauge", (),
+        "Worst replica's unacknowledged backlog in this node's WAL "
+        "stream, records."),
+    "tsd.replication.peer_position": _m(
+        "gauge", ("peer",),
+        "Per-replica acknowledged position in this node's WAL stream "
+        "(ship acks + tail since marks)."),
     # -- JAX / costmodel (obs/jaxprof.py, ops/calibrate.py,             #
     #    query/planner.py) -------------------------------------------- #
     "tsd.jax.compiles": _m(
@@ -370,6 +423,12 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "selection corpus)."),
     # -- flight recorder + health engine (obs/flightrec.py,             #
     #    obs/health.py, served at /api/diag*) -------------------------- #
+    # -- WAL integrity (storage/persist.py) ----------------------------- #
+    "tsd.storage.wal.corrupt_records": _m(
+        "counter", (),
+        "WAL records whose CRC32/frame failed verification at "
+        "replay/tail time (interior corruption; replay stops at the "
+        "last valid record and truncates the hole)."),
     "tsd.diag.events": _m(
         "counter", ("kind",),
         "Flight-recorder events recorded, by event kind (admission, "

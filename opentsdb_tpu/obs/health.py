@@ -33,6 +33,13 @@ last pass, never a point-in-time glance — and folds each into an
     the fair-share drain (tsd/admission.py weighted DRR) — a healthy
     storm sheds the storming tenant's excess, it never zeroes anyone
     out.
+  * **replication** — under-replicated shards / lag burn: any shard
+    with fewer healthy members than the replication factor is at
+    least degraded (one more failure loses data), and growth of the
+    worst replica's unacknowledged WAL backlog past
+    ``tsd.health.replication_lag`` records per window is degraded
+    (failing at 4x) — a replica that stops draining has NOT healed
+    just because ships stop erroring.
 
 Verdicts are exported as ``tsd.health.status`` gauges (0 ok /
 1 degraded / 2 failing), served at ``/api/diag/health``, recorded into
@@ -80,7 +87,7 @@ class HealthEngine:
     """Evaluates the declared invariants against one TSDB instance."""
 
     SUBSYSTEMS = ("admission", "compile", "agg_cache", "costmodel",
-                  "spill", "cluster", "tenant")
+                  "spill", "cluster", "tenant", "replication")
 
     def __init__(self, tsdb):
         cfg = tsdb.config
@@ -96,6 +103,7 @@ class HealthEngine:
         self.breaker_flap = cfg.get_int("tsd.health.breaker_flap")
         self.tenant_share_ratio = cfg.get_float(
             "tsd.health.tenant_share_ratio")
+        self.replication_lag = cfg.get_int("tsd.health.replication_lag")
         self._lock = threading.Lock()
         # guarded-by: _lock
         self._verdicts: dict[str, dict] = {}
@@ -304,6 +312,29 @@ class HealthEngine:
                     and hi / max(lo, 1e-9) > self.tenant_share_ratio:
                 level = "degraded"
         verdicts["tenant"] = {"level": level, "detail": detail}
+
+        # replication: under-replicated shards + lag burn.  The lag
+        # judged is the GROWTH of the worst replica's backlog over the
+        # window — a standing-but-draining backlog after a burst is
+        # healing, a growing one is not.
+        repl = getattr(tsdb, "replication", None)
+        level, detail = "ok", "replication disabled"
+        if repl is not None:
+            snap = repl.health_snapshot()
+            lag_growth = delta("repl_lag_hwm", snap["lag"])
+            detail = ("%d under-replicated shard(s); backlog %d "
+                      "records (+%d in window, limit +%d)"
+                      % (snap["under_replicated"], snap["lag"],
+                         lag_growth, self.replication_lag))
+            if snap["under_replicated"] > 0:
+                level = "degraded"
+            if self.replication_lag > 0 \
+                    and lag_growth > self.replication_lag:
+                level = _worst(
+                    level,
+                    "failing" if lag_growth > 4 * self.replication_lag
+                    else "degraded")
+        verdicts["replication"] = {"level": level, "detail": detail}
 
         self._publish(verdicts, cur, now)
         return verdicts

@@ -394,11 +394,43 @@ def explain_query(tsdb, ts_query, what_if: WhatIf) -> dict:
         "admission": _admission_preview(tsdb, ts_query, what_if),
         "subQueries": [],
     }
+    cluster = _explain_cluster(tsdb)
+    if cluster is not None:
+        out["cluster"] = cluster
     for sub in ts_query.queries:
         out["subQueries"].append(
             _explain_sub(tsdb, runner, ts_query, sub, what_if,
                          include_candidates))
     return out
+
+
+def _explain_cluster(tsdb) -> dict | None:
+    """The shard-scoped fan-out arm: WHICH peers a clustered query
+    would fetch from, and which shards each would serve.  Same pure
+    ``plan_cover`` the executor dispatches on (tsd/replication.py —
+    the plan_decision convention applied to fan-out routing), consumed
+    read-only: no epoch bump, no flight-recorder event, no breaker
+    churn."""
+    from opentsdb_tpu.tsd.cluster import cluster_peers
+    peers = cluster_peers(tsdb.config)
+    if not peers:
+        return None
+    repl = getattr(tsdb, "replication", None)
+    if repl is None:
+        return {"mode": "fanout", "peers": sorted(peers)}
+    from opentsdb_tpu.tsd.replication import plan_cover
+    cover, uncovered = plan_cover(repl.preferences, repl._healthy)
+    return {
+        "mode": "sharded",
+        "epoch": repl.current_epoch(),
+        "rf": repl.rf,
+        "shardCount": repl.shard_count,
+        "fanout": [
+            {"node": node, "shards": len(shards),
+             "role": "self" if node == repl.self_id else "peer"}
+            for node, shards in sorted(cover.items())],
+        "uncoveredShards": sorted(uncovered),
+    }
 
 
 def _explain_sub(tsdb, runner, query, sub, what_if: WhatIf,

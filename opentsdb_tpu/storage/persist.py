@@ -1,18 +1,38 @@
-"""Disk persistence: snapshot + write-ahead journal.
+"""Disk persistence: snapshot + sequenced, CRC-framed write-ahead journal.
 
 The durability role HBase's WAL played for the reference (SURVEY.md §5:
 "durability is HBase's WAL... the TSD keeps no durable state").  With
 `tsd.storage.directory` set, the TSD journals every ingest record to an
-append-only JSONL WAL and can snapshot the full state (UID dictionaries,
+append-only framed WAL and can snapshot the full state (UID dictionaries,
 scalar series columns, rollup lanes, histogram series, annotations,
 uid/ts meta, tree definitions) into the directory; startup restores the
 snapshot then replays the WAL tail.
 
+WAL framing (the replication substrate — tsd/replication.py ships these
+records to replicas and serves them at /api/replication/tail):
+
+    <seq> <crc32-hex8> <payload-json>\n
+
+  * ``seq`` is monotonic per node and NEVER reused — it survives
+    snapshots (the manifest carries ``wal_next_seq``) so a replica's
+    catch-up position stays meaningful across the owner's snapshot
+    cycles.
+  * ``crc32`` covers the payload bytes: a torn or bit-flipped interior
+    record is DETECTED at replay/tail time instead of replayed —
+    counted in ``tsd.storage.wal.corrupt_records``, and replay stops at
+    the last valid record (the divergent tail is truncated; records
+    past a hole are untrusted by construction).
+  * the journal rotates into segments (``wal-<firstseq>.jsonl``,
+    ``tsd.storage.wal.segment_mb`` each) so a replica can catch up
+    from an arbitrary sequence number without the owner rescanning one
+    unbounded file.
+
 Layout under the directory:
-    snapshot.json       everything JSON-able + the series manifest
-    series.npz          columnar arrays, keys s<i>_{ts,val,ival,isint}
-    rollup.npz          same shape per rollup lane series
-    wal.jsonl           journal since the last snapshot
+    snapshot.json        everything JSON-able + the series manifest
+    series.npz           columnar arrays, keys s<i>_{ts,val,ival,isint}
+    rollup.npz           same shape per rollup lane series
+    wal-<seq16>.jsonl    framed journal segments since the last snapshot
+    wal.jsonl            legacy unframed journal (replayed if present)
 """
 
 from __future__ import annotations
@@ -21,9 +41,11 @@ import json
 import logging
 import os
 import threading
+import zlib
 
 import numpy as np
 
+from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.utils import faults
 
 LOG = logging.getLogger("storage.persist")
@@ -32,7 +54,163 @@ SNAPSHOT_JSON = "snapshot.json"
 SERIES_NPZ = "series.npz"
 ROLLUP_NPZ = "rollup.npz"
 SERIES_BIN = "series.tsdb"   # native engine binary snapshot
-WAL_FILE = "wal.jsonl"
+WAL_FILE = "wal.jsonl"       # legacy single-file journal (pre-framing)
+WAL_SEGMENT_PREFIX = "wal-"
+WAL_SEGMENT_SUFFIX = ".jsonl"
+
+
+def record_crc(payload: str) -> int:
+    """The per-record checksum the frame carries (and replication
+    re-verifies on apply): crc32 over the payload bytes."""
+    return zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF
+
+
+def frame_line(seq: int, crc: int, payload: str) -> str:
+    return "%d %08x %s\n" % (seq, crc, payload)
+
+
+def parse_frame(line: str) -> tuple[int, int, str] | None:
+    """(seq, crc, payload) for a framed line; None for the legacy
+    unframed format (a bare JSON object — replayed crc-less)."""
+    if line.startswith("{"):
+        return None
+    seq_s, crc_s, payload = line.split(" ", 2)
+    return int(seq_s), int(crc_s, 16), payload
+
+
+def _corrupt_counter():
+    return REGISTRY.counter(
+        "tsd.storage.wal.corrupt_records",
+        "WAL records whose CRC32/frame failed verification at replay "
+        "(interior corruption; replay stops at the last valid record)")
+
+
+class WalCorruptionError(ValueError):
+    """An interior WAL record failed its CRC or frame parse."""
+
+
+def apply_record(tsdb, rec: dict) -> int:
+    """Apply ONE journal record to a TSDB — the shared dispatch behind
+    WAL replay AND replication apply (tsd/replication.py feeds shipped/
+    tailed owner records through the same code path, so a replica's
+    store is byte-for-byte what a local replay would build).
+
+    Returns the failed-point count (0 = fully applied).  The caller
+    owns the ``tsdb._replaying`` window (replay) or the replication
+    accepting context; this function never re-journals."""
+    kind = rec.get("k")
+    failed = 0
+    try:
+        if kind == "p":
+            tsdb._apply_point(rec["m"], rec["t"], rec["v"], rec["g"])
+        elif kind == "pb":
+            # bulk put record: one WAL line per /api/put body.
+            # Successful points have already landed, so a partial
+            # failure must not mark the whole line lost — count and log
+            # the failed points only.
+            _, errs = tsdb.add_points_bulk(rec["d"])
+            if errs:
+                failed += len(errs)
+                for i, e in errs[:3]:
+                    LOG.error(
+                        "WAL bulk replay dropped point %d of a %d-point "
+                        "record: %s", i, len(rec["d"]), e)
+        elif kind == "pj":
+            # raw /api/put body journaled by the native fast path:
+            # re-parse through the same path (falling back to the python
+            # bulk parser if the library is absent on restore).
+            # Per-point PARSE errors replay deterministically and were
+            # never stored — only storage-type failures count as dropped.
+            body = rec["b"].encode("utf-8")
+            out = tsdb.add_points_bulk_native(body)
+            if out is None:
+                dps = json.loads(rec["b"])
+                if isinstance(dps, dict):
+                    dps = [dps]
+                _, errs = tsdb.add_points_bulk(dps)
+            else:
+                errs = out[1]
+            storage_errs = [
+                (i, e) for i, e in errs
+                if not isinstance(e, (ValueError, TypeError))]
+            if storage_errs:
+                failed += len(storage_errs)
+                for i, e in storage_errs[:3]:
+                    LOG.error("WAL native-put replay dropped point %d: "
+                              "%s", i, e)
+        elif kind == "pt":
+            # raw telnet put-line block from the native batch path.
+            # Natively-refused (FALLBACK) lines were journaled by their
+            # own per-point "p" records at ingest time, so only the
+            # natively-landed lines replay here.  LINE_ERROR lines
+            # replay their deterministic parse error and stored nothing
+            # — only storage-type failures count as dropped.
+            out = tsdb.add_telnet_batch_native(rec["b"].encode())
+            if out is not None:
+                storage_errs = [
+                    (i, e) for i, e in out[1].items()
+                    if not isinstance(e, (ValueError, TypeError))]
+                if storage_errs:
+                    failed += len(storage_errs)
+                    for i, e in storage_errs[:3]:
+                        LOG.error("WAL telnet replay dropped point %d: "
+                                  "%s", i, e)
+            else:
+                # library absent on restore: walk put lines through the
+                # point parser, bypassing add_point (which would
+                # re-journal into the WAL being replayed)
+                from opentsdb_tpu.tsd.rpcs import (
+                    parse_tags, parse_telnet_timestamp)
+                for raw in rec["b"].splitlines():
+                    words = raw.split()
+                    if len(words) < 5 or words[0] != "put":
+                        continue
+                    try:
+                        tsdb._apply_point(
+                            words[1], parse_telnet_timestamp(words[2]),
+                            words[3], parse_tags(words[4:]))
+                    except (ValueError, TypeError):
+                        pass   # deterministic parse error: stored
+                        #        nothing at ingest too
+                    except Exception as e:
+                        failed += 1
+                        LOG.error("WAL telnet replay dropped a line: %s",
+                                  e)
+        elif kind == "r":
+            tsdb._apply_aggregate_point(
+                rec["m"], rec["t"], rec["v"], rec["g"], rec["gb"],
+                rec.get("i"), rec.get("a"), rec.get("ga"))
+        elif kind == "h":
+            tsdb._apply_histogram_json(rec["m"], rec["t"], rec["d"],
+                                       rec["g"])
+        elif kind == "a":
+            from opentsdb_tpu.storage.memstore import Annotation
+            # Direct store write: add_annotation would re-journal into
+            # the WAL currently being replayed.
+            note = Annotation(**rec["n"])
+            tsdb.store.add_annotation(note)
+            if tsdb.search_plugin is not None:
+                tsdb.search_plugin.index_annotation(note)
+        elif kind == "rr":
+            # replicated record: a peer's WAL record applied by
+            # replication (tsd/replication.py), journaled locally so a
+            # replica restart restores both the data and its per-origin
+            # catch-up position.  With replication disabled on restore
+            # the inner record still applies — the data must not vanish
+            # because a config flag flipped.
+            repl = getattr(tsdb, "replication", None)
+            if repl is not None:
+                repl.restore_applied(rec["o"], rec["q"], rec["c"],
+                                     rec.get("sh"), rec["r"])
+            else:
+                failed += apply_record(tsdb, rec["r"])
+    except Exception as e:
+        # Torn tail lines are handled by the framing layer; systematic
+        # apply failures must be visible.
+        failed += 1
+        LOG.error("WAL replay failed for record %r: %s",
+                  str(rec)[:200], e)
+    return failed
 
 
 class DiskPersistence:
@@ -42,7 +220,14 @@ class DiskPersistence:
         os.makedirs(directory, exist_ok=True)
         self._wal_lock = threading.Lock()
         self._wal = None  # guarded-by: _wal_lock
+        self._wal_file_path = None  # guarded-by: _wal_lock
+        self._wal_bytes = 0  # guarded-by: _wal_lock
         self.wal_records = 0  # guarded-by: _wal_lock
+        # next sequence number to assign — monotonic for the node's
+        # lifetime, snapshot resets included  # guarded-by: _wal_lock
+        self._next_seq = 1
+        self._segment_bytes = max(
+            tsdb.config.get_int("tsd.storage.wal.segment_mb"), 1) * 2 ** 20
         # opt-in per-append disk barrier (tsd.storage.wal.fsync): every
         # journaled record is crash-durable before the write acks; off,
         # durability rides the wal_sync_interval cadence
@@ -53,20 +238,129 @@ class DiskPersistence:
     # WAL                                                                #
     # ------------------------------------------------------------------ #
 
-    def _wal_path(self) -> str:
+    def _legacy_path(self) -> str:
         return os.path.join(self.directory, WAL_FILE)
 
-    def journal(self, record: dict) -> None:
-        """Append one ingest record; flushed per write (the WAL contract)."""
-        faults.check("wal.append")
-        line = json.dumps(record, separators=(",", ":"))
+    def _segment_path(self, first_seq: int) -> str:
+        return os.path.join(
+            self.directory,
+            "%s%016d%s" % (WAL_SEGMENT_PREFIX, first_seq,
+                           WAL_SEGMENT_SUFFIX))
+
+    def _segments(self) -> list[tuple[int, str]]:
+        """(first_seq, path) for every framed segment, seq order."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith(WAL_SEGMENT_PREFIX) \
+                    and name.endswith(WAL_SEGMENT_SUFFIX):
+                mid = name[len(WAL_SEGMENT_PREFIX):
+                           -len(WAL_SEGMENT_SUFFIX)]
+                try:
+                    out.append((int(mid), os.path.join(self.directory,
+                                                       name)))
+                except ValueError:
+                    continue
+        out.sort()
+        return out
+
+    @property
+    def last_seq(self) -> int:
         with self._wal_lock:
-            if self._wal is None:
-                self._wal = open(self._wal_path(), "a", buffering=1)
-            self._wal.write(line + "\n")
+            return self._next_seq - 1
+
+    def journal(self, record: dict) -> tuple[int, int]:
+        """Append one ingest record; flushed per write (the WAL
+        contract).  Returns the assigned ``(seq, crc)`` — what
+        replication ships and the tail endpoint serves."""
+        faults.check("wal.append")
+        payload = json.dumps(record, separators=(",", ":"))
+        crc = record_crc(payload)
+        with self._wal_lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            if self._wal is None or self._wal_bytes >= self._segment_bytes:
+                if self._wal is not None:
+                    self._wal.close()
+                self._wal_file_path = self._segment_path(seq)
+                self._wal = open(self._wal_file_path, "a", buffering=1)
+                self._wal_bytes = os.path.getsize(self._wal_file_path)
+            line = frame_line(seq, crc, payload)
+            self._wal.write(line)
+            self._wal_bytes += len(line.encode("utf-8"))
             self.wal_records += 1
             if self._fsync_per_append:
                 os.fsync(self._wal.fileno())
+        return seq, crc
+
+    def read_since(self, since: int, max_bytes: int = 4 * 2 ** 20
+                   ) -> tuple[list[tuple[int, int, str]], int, int]:
+        """Framed records with seq > ``since``, oldest first, bounded by
+        ``max_bytes`` of payload — the /api/replication/tail substrate.
+
+        Returns ``(records, last_seq, first_seq)``: ``last_seq`` is
+        this node's newest assigned sequence number (so a caller can
+        tell a bounded page from a complete tail) and ``first_seq`` the
+        oldest sequence the WAL still holds — a snapshot resets the
+        journal while seqs keep climbing, so a replica positioned below
+        ``first_seq - 1`` must fast-forward instead of waiting forever
+        for records that now live only in the snapshot.  A corrupt
+        interior record ends the page at the last valid record (counted
+        like replay — a replica must never apply bytes past a hole).
+
+        Only the coordinates are read under ``_wal_lock``; the segment
+        scan itself runs lock-free so a multi-MB tail page never stalls
+        ``journal()`` — the ingest ack path.  Rotated segments are
+        immutable, and the ACTIVE segment is read only up to the
+        locked-snapshot byte count (always a line boundary: journal()
+        writes whole lines under the lock), so a mid-append torn line
+        can never masquerade as corruption."""
+        out: list[tuple[int, int, str]] = []
+        budget = max_bytes
+        with self._wal_lock:
+            last_seq = self._next_seq - 1
+            segments = self._segments()
+            first_seq = segments[0][0] if segments else self._next_seq
+            active_path = self._wal_file_path
+            active_len = self._wal_bytes
+        for i, (first, path) in enumerate(segments):
+            nxt = segments[i + 1][0] if i + 1 < len(segments) else None
+            if nxt is not None and nxt <= since + 1:
+                continue        # whole segment at or below the mark
+            limit = active_len if path == active_path else None
+            read = 0
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    for raw in fh:
+                        read += len(raw.encode("utf-8"))
+                        if limit is not None and read > limit:
+                            break   # bytes past the locked snapshot:
+                            #         an append in progress, next page
+                        line = raw.rstrip("\n")
+                        if not line:
+                            continue
+                        try:
+                            frame = parse_frame(line)
+                            if frame is None:
+                                continue    # legacy record: no seq
+                            seq, crc, payload = frame
+                            if record_crc(payload) != crc:
+                                raise WalCorruptionError(path)
+                        except (ValueError, WalCorruptionError):
+                            _corrupt_counter().inc()
+                            LOG.error(
+                                "WAL tail read: corrupt record in "
+                                "%s; serving up to the last valid "
+                                "record", path)
+                            return out, last_seq, first_seq
+                        if seq <= since:
+                            continue
+                        out.append((seq, crc, payload))
+                        budget -= len(payload)
+                        if budget <= 0:
+                            return out, last_seq, first_seq
+            except OSError:
+                continue        # rotated/reset underneath us
+        return out, last_seq, first_seq
 
     def sync_wal(self) -> None:
         """fsync the WAL so acknowledged writes survive an OS crash.
@@ -82,12 +376,19 @@ class DiskPersistence:
                 os.fsync(self._wal.fileno())
 
     def _reset_wal(self) -> None:
+        """Drop every journal file (post-snapshot).  ``_next_seq`` is
+        deliberately NOT reset: sequence numbers are the replication
+        stream's coordinates and must stay monotonic across snapshots."""
         with self._wal_lock:
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
-            path = self._wal_path()
-            if os.path.exists(path):
+                self._wal_file_path = None
+                self._wal_bytes = 0
+            legacy = self._legacy_path()
+            if os.path.exists(legacy):
+                os.remove(legacy)
+            for _first, path in self._segments():
                 os.remove(path)
             self.wal_records = 0
 
@@ -126,159 +427,157 @@ class DiskPersistence:
             os.fsync(fh.fileno())
 
     def replay_wal(self) -> int:
-        """Re-ingest journaled records (startup recovery)."""
-        path = self._wal_path()
-        if not os.path.exists(path):
-            return 0
-        self._trim_torn_tail(path)
+        """Re-ingest journaled records (startup recovery).
+
+        Legacy ``wal.jsonl`` (unframed) replays first, then the framed
+        segments in sequence order.  A framed record that fails its CRC
+        or frame parse is interior corruption: it is counted
+        (``tsd.storage.wal.corrupt_records``), replay STOPS at the last
+        valid record, and the journal is truncated there — records past
+        a hole are untrusted and must not be replayed (nor served to a
+        catching-up replica)."""
         tsdb = self.tsdb
         count = 0
         failed = 0
+        legacy = self._legacy_path()
+        segments = self._segments()
+        if not segments and not os.path.exists(legacy):
+            return 0
+        if segments:
+            self._trim_torn_tail(segments[-1][1])
+        elif os.path.exists(legacy):
+            self._trim_torn_tail(legacy)
+        # seqs must never be reused even when a corrupt tail is being
+        # truncated below — scan the frames for the highest assigned
+        # seq BEFORE any discard decision
+        max_seq = self._scan_max_seq(segments)
         tsdb._replaying = True
         try:
-            count, failed = self._replay_lines(path)
+            if os.path.exists(legacy):
+                c, f, _ = self._replay_lines(legacy, framed=False)
+                count += c
+                failed += f
+            for i, (_first, path) in enumerate(segments):
+                c, f, corrupt = self._replay_lines(path, framed=True)
+                count += c
+                failed += f
+                if corrupt:     # stop at the last valid record; the
+                    #             truncation already happened in
+                    #             _replay_lines — later segments are
+                    #             past the hole and equally untrusted
+                    for _n, later in segments[i + 1:]:
+                        LOG.error(
+                            "WAL replay: discarding segment %s past the "
+                            "corrupt record", later)
+                        os.remove(later)
+                    break
         finally:
             tsdb._replaying = False
+        with self._wal_lock:
+            self._next_seq = max(self._next_seq, max_seq + 1)
         if failed:
             LOG.error("WAL replay dropped %d of %d records; see prior "
                       "errors", failed, count + failed)
         return count
 
-    def _replay_lines(self, path: str) -> tuple[int, int]:
+    @staticmethod
+    def _scan_max_seq(segments: list[tuple[int, str]]) -> int:
+        """Highest sequence number any frame claims, corrupt payloads
+        included — the floor for ``_next_seq`` so a truncated tail can
+        never cause a seq to be minted twice (replica positions and CRC
+        chains key on them)."""
+        max_seq = 0
+        for first, path in segments:
+            max_seq = max(max_seq, first)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    for line in fh:
+                        try:
+                            frame = parse_frame(line.rstrip("\n"))
+                        except ValueError:
+                            continue
+                        if frame is not None:
+                            max_seq = max(max_seq, frame[0])
+            except OSError:
+                continue
+        return max_seq
+
+    def _replay_lines(self, path: str, framed: bool = True
+                      ) -> tuple[int, int, bool]:
+        """Replay one journal file.  Returns ``(count, failed,
+        corrupted)``; ``corrupted`` True means a framed record failed
+        its CRC/frame parse — replay stopped at the last valid record
+        and the file was truncated at the hole (appends must not land
+        after garbage, and a catching-up replica must not be served
+        it)."""
         tsdb = self.tsdb
         count = 0
         failed = 0
         # _trim_torn_tail already removed the genuine crash artifact (a
-        # newline-less torn tail) before this runs, so an unparseable
-        # line here — tail included — is a fully-written record that
-        # got garbled: corruption worth alarming on, counted in the
-        # dropped-records total.  Replay continues either way so one
-        # bad line doesn't take down every later acknowledged write.
+        # newline-less torn tail) before this runs, so a bad CRC or
+        # unparseable line here — tail included — is a fully-written
+        # record that got garbled: corruption worth alarming on.
         lineno = 0
-        with open(path) as fh:
-            for line in fh:
+        offset = 0
+        with open(path, encoding="utf-8") as fh:
+            for raw in fh:
+                line_bytes = len(raw.encode("utf-8"))
                 lineno += 1
-                line = line.strip()
+                line = raw.strip()
                 if not line:
+                    offset += line_bytes
                     continue
+                rec = None
                 try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
+                    frame = parse_frame(line) if framed else None
+                    if frame is not None:
+                        seq, crc, payload = frame
+                        if record_crc(payload) != crc:
+                            raise WalCorruptionError(
+                                "crc mismatch at line %d" % lineno)
+                        rec = json.loads(payload)
+                    else:
+                        if framed and not line.startswith("{"):
+                            raise WalCorruptionError(
+                                "unparseable frame at line %d" % lineno)
+                        rec = json.loads(line)
+                except (ValueError, WalCorruptionError) as e:
+                    if framed:
+                        _corrupt_counter().inc()
+                        LOG.error(
+                            "WAL replay: corrupt record at %s:%d (%s); "
+                            "stopping at the last valid record and "
+                            "truncating the hole", path, lineno, e)
+                        self._truncate_at(path, offset)
+                        return count, failed, True
                     failed += 1
                     LOG.error(
                         "WAL replay: skipped unparseable line %d "
                         "(corruption — crash-torn tails are trimmed "
                         "before replay): %r", lineno, line[:80])
+                    offset += line_bytes
                     continue
-                kind = rec.get("k")
-                try:
-                    if kind == "p":
-                        tsdb._apply_point(rec["m"], rec["t"], rec["v"],
-                                          rec["g"])
-                    elif kind == "pb":
-                        # bulk put record: one WAL line per /api/put body.
-                        # Successful points have already landed, so a
-                        # partial failure must not mark the whole line
-                        # lost — count and log the failed points only.
-                        _, errs = tsdb.add_points_bulk(rec["d"])
-                        if errs:
-                            failed += len(errs)
-                            for i, e in errs[:3]:
-                                LOG.error(
-                                    "WAL bulk replay dropped point %d "
-                                    "of a %d-point record: %s", i,
-                                    len(rec["d"]), e)
-                    elif kind == "pj":
-                        # raw /api/put body journaled by the native fast
-                        # path: re-parse through the same path (falling
-                        # back to the python bulk parser if the library
-                        # is absent on restore).  Per-point PARSE errors
-                        # replay deterministically and were never stored
-                        # — only storage-type failures count as dropped.
-                        body = rec["b"].encode("utf-8")
-                        out = tsdb.add_points_bulk_native(body)
-                        if out is None:
-                            dps = json.loads(rec["b"])
-                            if isinstance(dps, dict):
-                                dps = [dps]
-                            _, errs = tsdb.add_points_bulk(dps)
-                        else:
-                            errs = out[1]
-                        storage_errs = [
-                            (i, e) for i, e in errs
-                            if not isinstance(e, (ValueError, TypeError))]
-                        if storage_errs:
-                            failed += len(storage_errs)
-                            for i, e in storage_errs[:3]:
-                                LOG.error("WAL native-put replay dropped "
-                                          "point %d: %s", i, e)
-                    elif kind == "pt":
-                        # raw telnet put-line block from the native batch
-                        # path.  Natively-refused (FALLBACK) lines were
-                        # journaled by their own per-point "p" records at
-                        # ingest time, so only the natively-landed lines
-                        # replay here.  LINE_ERROR lines replay their
-                        # deterministic parse error and stored nothing —
-                        # only storage-type failures count as dropped.
-                        out = tsdb.add_telnet_batch_native(rec["b"].encode())
-                        if out is not None:
-                            storage_errs = [
-                                (i, e) for i, e in out[1].items()
-                                if not isinstance(e, (ValueError,
-                                                      TypeError))]
-                            if storage_errs:
-                                failed += len(storage_errs)
-                                for i, e in storage_errs[:3]:
-                                    LOG.error("WAL telnet replay dropped "
-                                              "point %d: %s", i, e)
-                        else:
-                            # library absent on restore: walk put lines
-                            # through the point parser, bypassing
-                            # add_point (which would re-journal into the
-                            # WAL being replayed)
-                            from opentsdb_tpu.tsd.rpcs import (
-                                parse_tags, parse_telnet_timestamp)
-                            for raw in rec["b"].splitlines():
-                                words = raw.split()
-                                if len(words) < 5 or words[0] != "put":
-                                    continue
-                                try:
-                                    tsdb._apply_point(
-                                        words[1],
-                                        parse_telnet_timestamp(words[2]),
-                                        words[3], parse_tags(words[4:]))
-                                except (ValueError, TypeError):
-                                    pass   # deterministic parse error:
-                                    #        stored nothing at ingest too
-                                except Exception as e:
-                                    failed += 1
-                                    LOG.error("WAL telnet replay dropped "
-                                              "a line: %s", e)
-                    elif kind == "r":
-                        tsdb._apply_aggregate_point(
-                            rec["m"], rec["t"], rec["v"], rec["g"],
-                            rec["gb"], rec.get("i"), rec.get("a"),
-                            rec.get("ga"))
-                    elif kind == "h":
-                        tsdb._apply_histogram_json(rec["m"], rec["t"],
-                                                   rec["d"], rec["g"])
-                    elif kind == "a":
-                        from opentsdb_tpu.storage.memstore import Annotation
-                        # Direct store write: add_annotation would re-journal
-                        # into the WAL currently being replayed.
-                        note = Annotation(**rec["n"])
-                        tsdb.store.add_annotation(note)
-                        if tsdb.search_plugin is not None:
-                            tsdb.search_plugin.index_annotation(note)
+                offset += line_bytes
+                f = apply_record(tsdb, rec)
+                if f == 0:
                     count += 1
-                except Exception as e:
-                    # Torn tail lines are silent (JSONDecodeError above);
-                    # systematic apply failures must be visible.
-                    failed += 1
-                    if failed <= 10:
-                        LOG.error("WAL replay failed for record %r: %s",
-                                  line[:200], e)
-        return count, failed
+                else:
+                    failed += f
+                if frame is not None and rec.get("k") != "rr" \
+                        and rec.get("sh") is not None \
+                        and getattr(tsdb, "replication", None) is not None:
+                    # rebuild the own-origin CRC chain the live ship
+                    # path maintains (anti-entropy compares it)
+                    tsdb.replication.note_local_replayed(
+                        frame[0], frame[1], rec["sh"])
+        return count, failed, False
+
+    @staticmethod
+    def _truncate_at(path: str, offset: int) -> None:
+        with open(path, "rb+") as fh:
+            fh.truncate(offset)
+            fh.flush()
+            os.fsync(fh.fileno())
 
     # ------------------------------------------------------------------ #
     # Snapshot                                                           #
@@ -288,6 +587,10 @@ class DiskPersistence:
         tsdb = self.tsdb
         manifest: dict = {
             "version": 1,
+            # the WAL seq high-water mark: seqs stay monotonic across
+            # the snapshot's WAL reset (replication positions key on
+            # them)
+            "wal_next_seq": self._next_seq,
             "uids": {
                 "metric": tsdb.metrics.snapshot(),
                 "tagk": tsdb.tag_names.snapshot(),
@@ -462,6 +765,9 @@ class DiskPersistence:
         from opentsdb_tpu.storage.memstore import Annotation, SeriesKey
         from opentsdb_tpu.tree.objects import Tree, TreeRule
         tsdb = self.tsdb
+        with self._wal_lock:
+            self._next_seq = max(self._next_seq,
+                                 int(manifest.get("wal_next_seq", 1)))
         tsdb.metrics.restore(manifest["uids"]["metric"])
         tsdb.tag_names.restore(manifest["uids"]["tagk"])
         tsdb.tag_values.restore(manifest["uids"]["tagv"])

@@ -844,6 +844,15 @@ def admit(tsdb, ts_query, http_query=None,
     decision, queue depth, predicted vs remaining ms).
     """
     from opentsdb_tpu.obs.flightrec import clamp_tenant
+    if route.startswith("api/replication"):
+        # replication traffic is EXEMPT from the query gate by
+        # contract (tsd/replication.py): an overloaded query tier
+        # shedding work must not sever durability.  It is bounded by
+        # its own tsd.replication.max_inflight_mb byte gate instead.
+        # Defensive: the replication RPC never calls admit(), but a
+        # future route must not silently start queueing WAL ships
+        # behind interactive queries.
+        return Permit(None, tenant="replication")
     gate = gate_for(tsdb)
     deadline = active_deadline()
     priority = ""

@@ -75,6 +75,7 @@ import numpy as np
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.query.limits import QueryException, active_deadline
+from opentsdb_tpu.uid import NoSuchUniqueName
 from opentsdb_tpu.utils import faults
 from opentsdb_tpu.utils.retry import RetryPolicy, call_with_retries
 
@@ -357,11 +358,15 @@ def _sub_json(raw: TSQuery, index: int) -> dict:
 
 def _fetch_peer(peer: str, body: dict, timeout_s: float,
                 trace_id: str | None = None,
-                deadline=None, tenant_header: str | None = None
-                ) -> list[dict]:
+                deadline=None, tenant_header: str | None = None,
+                extra_headers: dict | None = None) -> list[dict]:
     faults.check("cluster.peer_fetch", peer=peer)
     headers = {"Content-Type": "application/json",
                "X-TSDB-Cluster": "fanout"}
+    if extra_headers:
+        # sharded serving scopes each peer fetch to its shard cover
+        # (X-TSDB-Shards — tsd/replication.py)
+        headers.update(extra_headers)
     if trace_id:
         # the receiving TSD adopts this id for ITS trace of the raw
         # fetch — one clustered query, one trace id across every host
@@ -420,11 +425,21 @@ class PeerRejectedError(RuntimeError):
     failure (availability is fine; the REQUEST is what it rejects)."""
 
 
+class PeerUnknownNameError(PeerRejectedError):
+    """The peer answered 404 — a name-lookup miss (http.error_status
+    maps NoSuchUniqueName there): it never assigned a UID for the
+    metric, which in a sharded cluster is routine, not a fault.  The
+    sharded arm walks the shard's preference list on this; a shard
+    whose every live member answers 404 holds nothing for the metric
+    (empty contribution), where a plain failure would mean lost data."""
+
+
 def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
                    body: dict, span=None,
                    trace_id: str | None = None,
                    deadline=None,
-                   tenant_header: str | None = None) -> list[dict]:
+                   tenant_header: str | None = None,
+                   extra_headers: dict | None = None) -> list[dict]:
     """One peer fetch under the full fault-tolerance stack: breaker
     fast-fail, then retries with backoff inside the overall budget
     (already clamped to the request deadline's remainder).
@@ -435,7 +450,8 @@ def _guarded_fetch(state: ClusterState, policy: RetryPolicy, peer: str,
     carries so an operator can see WHY a 200 is partial."""
     try:
         return _guarded_fetch_inner(state, policy, peer, body, span,
-                                    trace_id, deadline, tenant_header)
+                                    trace_id, deadline, tenant_header,
+                                    extra_headers)
     finally:
         if span is not None:
             span.tags["breaker"] = state.breaker(peer).state
@@ -446,7 +462,8 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
                          peer: str, body: dict, span,
                          trace_id: str | None,
                          deadline=None,
-                         tenant_header: str | None = None) -> list[dict]:
+                         tenant_header: str | None = None,
+                         extra_headers: dict | None = None) -> list[dict]:
     breaker = state.breaker(peer)
     if span is not None:
         span.tags.setdefault("retries", 0)
@@ -480,8 +497,13 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
     def fetch(timeout_s: float) -> list[dict]:
         try:
             return _fetch_peer(peer, body, timeout_s, trace_id, deadline,
-                               tenant_header=tenant_header)
+                               tenant_header=tenant_header,
+                               extra_headers=extra_headers)
         except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise PeerUnknownNameError(
+                    "peer %s has no UID for the queried name (404)"
+                    % peer) from e
             if 400 <= e.code < 500:
                 raise PeerRejectedError(
                     "peer %s rejected the raw-series fetch: HTTP %d"
@@ -510,6 +532,13 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
             breaker.record_failure()
         state.count("fetch_failures")
         obs_trace.annotate(span, error=str(e))
+        raise
+    except PeerUnknownNameError as e:
+        # routine in sharded serving (the peer holds nothing for the
+        # name): settles the breaker like any responsive answer, and
+        # does NOT count as a fetch failure
+        breaker.record_success()
+        obs_trace.annotate(span, unknown_name=True)
         raise
     except PeerRejectedError as e:
         # responsive peer: availability-wise a SUCCESS — crucially this
@@ -555,7 +584,13 @@ def serve_query(tsdb, ts_query: TSQuery, http_query=None,
     /api/query/exp metric extraction, /api/query/gexp): clustered when
     peers are configured and the request is eligible, local otherwise.
     Eligibility: not a peer's own fan-out (loop guard), not a delete,
-    and every subquery metric-named (tsuids are host-local)."""
+    and every subquery metric-named (tsuids are host-local).
+
+    With sharded replication armed (tsd/replication.py) the clustered
+    arm fans out only to the owning shards' healthy members, and the
+    local arm honors a coordinator's X-TSDB-Shards scope — a node
+    holding both owned and replicated copies serves exactly the shards
+    it was asked for, so the fold never double-counts a series."""
     if cluster_peers(tsdb.config) \
             and (http_query is None or not is_fanout_request(http_query)) \
             and not getattr(ts_query, "delete", False) \
@@ -563,12 +598,308 @@ def serve_query(tsdb, ts_query: TSQuery, http_query=None,
         from opentsdb_tpu.tsd.admission import TENANT_HEADER
         tenant_header = (http_query.request.header(TENANT_HEADER)
                          if http_query is not None else None)
+        if getattr(tsdb, "replication", None) is not None:
+            return run_sharded(tsdb, ts_query, exec_stats=exec_stats,
+                               tenant_header=tenant_header)
         return run_clustered(tsdb, ts_query, exec_stats=exec_stats,
                              tenant_header=tenant_header)
     runner = tsdb.new_query_runner()
     out = runner.run(ts_query)
+    repl = getattr(tsdb, "replication", None)
+    if repl is not None and http_query is not None \
+            and is_fanout_request(http_query):
+        from opentsdb_tpu.tsd.replication import (SHARDS_HEADER,
+                                                  series_shard)
+        raw = http_query.request.headers.get(SHARDS_HEADER)
+        if raw:
+            keep = {int(x) for x in raw.split(",") if x.strip()}
+            out = [qr for qr in out
+                   if series_shard(qr.metric, qr.tags,
+                                   repl.shard_count) in keep]
     if exec_stats is not None:
         exec_stats.update(runner.exec_stats)
+    return out
+
+
+def _scratch_store(tsdb):
+    """The per-query aggregation buffer both clustered arms fold raw
+    series into before running the ORIGINAL query once, locally."""
+    from opentsdb_tpu.core import TSDB
+    from opentsdb_tpu.utils.config import Config
+    scratch = TSDB(Config({
+        "tsd.core.auto_create_metrics": True,
+        # a failover refetch can re-fold a series a half-answered member
+        # already contributed — identical replicated points, resolved
+        # last-write-wins instead of raising
+        "tsd.storage.fix_duplicates": "true",
+        # serving knobs only — the scratch is a per-query aggregation
+        # buffer, not a daemon: no flight recorder or health engine of
+        # its own (constructing one per clustered query would be waste,
+        # and its ring would be discarded with the scratch)
+        "tsd.query.device_cache.enable": "false",
+        "tsd.diag.enable": "false",
+        "tsd.health.enable": "false",
+        # the final fold runs on THIS box: a coordinator whose operator
+        # disabled the mesh (e.g. a JAX without shard_map) must not have
+        # the scratch re-enable it behind their back
+        "tsd.query.mesh.enable": tsdb.config.get_string(
+            "tsd.query.mesh.enable"),
+    }))
+    # the scratch runner's planner events must land in the SERVING
+    # daemon's flight recorder — they carry the request's trace id, so
+    # a clustered query's plan decisions stay reconstructible from the
+    # coordinator's /api/diag ring
+    scratch.flightrec = getattr(tsdb, "flightrec", None)
+    return scratch
+
+
+def _local_raw_series(tsdb, raw: TSQuery, unknown_subs: set | None = None):
+    """This host's raw-series extraction for the fan-out fold, one
+    subquery at a time.  A metric with no local UID contributes nothing
+    instead of failing the extraction: in a cluster — sharded routing
+    especially, where whole series land on other owners — a node
+    routinely coordinates queries over metrics it never ingested.
+    ``unknown_subs``, when given, collects the indexes of subqueries
+    with no local UID so the caller can tell "empty here" from "no
+    such name anywhere"."""
+    runner = tsdb.new_query_runner()
+    runner.exec_stats = {}
+    for i, sub in enumerate(raw.queries):
+        try:
+            yield from runner.run_sub(raw, sub)
+        except NoSuchUniqueName:
+            if unknown_subs is not None:
+                unknown_subs.add(i)
+            continue
+
+
+def _fold_payload(scratch, payload: list[dict]) -> int:
+    """Fold one peer's raw-series response into the scratch store."""
+    total = 0
+    for item in payload:
+        if "metric" not in item:
+            continue        # statsSummary etc.
+        total += _ingest_series(
+            scratch, item["metric"], item.get("tags") or {},
+            ((int(t), v)
+             for t, v in (item.get("dps") or {}).items()))
+    return total
+
+
+def run_sharded(tsdb, ts_query: TSQuery, exec_stats: dict | None = None,
+                tenant_header: str | None = None):
+    """The shard-scoped clustered arm (tsd/replication.py): fan out
+    only to the owning shards' healthy members — each peer fetch
+    carries its shard cover in X-TSDB-Shards, the local extraction is
+    filtered the same way, and a peer that fails mid-query has its
+    shards REFETCHED from the next healthy preference member, so a
+    single peer death serves full (non-partial) results.  Only a shard
+    with no live member left degrades to the partial_results stance."""
+    from opentsdb_tpu.tsd.replication import series_shard
+
+    repl = tsdb.replication
+    state = _state(tsdb)
+    deadline = active_deadline()
+    policy = _retry_policy(tsdb.config, deadline)
+    allow_partial = (tsdb.config.get_string(
+        "tsd.network.cluster.partial_results").strip().lower() == "allow")
+    raw = _raw_query(ts_query)
+    cover, uncovered = repl.query_plan()
+    scratch = _scratch_store(tsdb)
+    total = 0
+    lost_shards: set[int] = set(uncovered)
+    failed_nodes: set[str] = set()
+    local_shards = set(cover.get(repl.self_id, set()))
+    remote = {peer: shards for peer, shards in cover.items()
+              if peer != repl.self_id}
+
+    tr = obs_trace.active()
+    parent = tr.current() if tr is not None else None
+    trace_id = tr.trace_id if tr is not None else None
+
+    def shards_header(shards: set[int]) -> dict:
+        return {"X-TSDB-Shards": ",".join(str(s) for s in
+                                          sorted(shards))}
+
+    local_series: list | None = None
+
+    def ingest_local(shards: set[int]) -> None:
+        # extract once, reuse across failover rounds — each round would
+        # otherwise re-scan the whole local store on the degraded path
+        nonlocal total, local_series
+        if local_series is None:
+            local_series = list(_local_raw_series(tsdb, raw))
+        for qr in local_series:
+            if series_shard(qr.metric, qr.tags,
+                            repl.shard_count) in shards:
+                total += _ingest_series(scratch, qr.metric, qr.tags,
+                                        qr.dps)
+
+    pool = None
+    futures: dict = {}
+    if remote:
+        pool = ThreadPoolExecutor(
+            max_workers=min(len(remote) * len(raw.queries), 16))
+        for peer, shards in remote.items():
+            hdr = shards_header(shards)
+            for i in range(len(raw.queries)):
+                span = (parent.child("peer_fetch", peer=peer,
+                                     subquery=i, shards=len(shards))
+                        if parent is not None else None)
+                futures[pool.submit(
+                    _guarded_fetch, state, policy, peer,
+                    _sub_json(raw, i), span, trace_id, deadline,
+                    tenant_header, hdr)] = (peer, i, span)
+    def local_knows_all() -> bool:
+        for sub in raw.queries:
+            try:
+                tsdb.metrics.get_id(sub.metric)
+            except NoSuchUniqueName:
+                return False
+        return True
+
+    try:
+        # consulted[shard]: members already asked for this shard this
+        # query — failed OR healthy-but-404 — so the preference walk
+        # below never re-asks one
+        consulted: dict[int, set[str]] = {}
+        todo: set[int] = set()
+        if local_shards:
+            # contribute whatever is locally known either way; if SOME
+            # queried metric has no local UID, additionally walk the
+            # covered shards' preference lists like a remote 404 would
+            # — a replica may hold series for a metric this node has
+            # not caught up to (re-folds of the locally-known metrics
+            # resolve as duplicates)
+            ingest_local(local_shards)
+            if not local_knows_all():
+                for shard in local_shards:
+                    consulted.setdefault(shard, set()).add(repl.self_id)
+                    todo.add(shard)
+        if futures:
+            for fut, (peer, i, _span) in futures.items():
+                try:
+                    payload = fut.result()
+                except PeerUnknownNameError:
+                    # healthy peer, no UID for the metric: walk on to
+                    # the shard's next preference member (a replica may
+                    # hold series the assigned member has not caught up
+                    # to); NOT a breaker/partial event
+                    for shard in remote.get(peer, set()):
+                        consulted.setdefault(shard, set()).add(peer)
+                        todo.add(shard)
+                    continue
+                except Exception as e:
+                    if peer not in failed_nodes:
+                        failed_nodes.add(peer)
+                        LOG.warning(
+                            "sharded peer %s failed; refetching its %d "
+                            "shard(s) from replicas: %s",
+                            peer, len(remote.get(peer, ())), e)
+                    for shard in remote.get(peer, set()):
+                        consulted.setdefault(shard, set()).add(peer)
+                        todo.add(shard)
+                    continue
+                total += _fold_payload(scratch, payload)
+        # failover walk: reassign every pending shard to its next
+        # healthy unconsulted preference member (serving continues with
+        # FULL data; a refetch re-folding an already-answered subquery
+        # is safe — the scratch resolves identical duplicate points).
+        # A shard exhausting its members is LOST (partial stance) only
+        # if some consulted member actually failed; members that merely
+        # answered 404 prove the shard holds nothing for the metric.
+        # Breaker charges from the failed fetches feed the next
+        # query_plan's epoch bump.
+        while todo:
+            reassign: dict[str, set[int]] = {}
+            for shard in list(todo):
+                nxt = repl.next_member(
+                    shard, exclude=consulted[shard] | failed_nodes)
+                if nxt is None:
+                    # a healthy member's 404 is authoritative — the
+                    # replica set is caught up on the ack path, so "no
+                    # UID here" proves the shard holds nothing for the
+                    # metric; the shard is lost only when NOT ONE
+                    # member gave a healthy answer
+                    if consulted[shard] <= failed_nodes:
+                        lost_shards.add(shard)
+                    todo.discard(shard)
+                else:
+                    reassign.setdefault(nxt, set()).add(shard)
+            extra_local = reassign.pop(repl.self_id, set())
+            if extra_local:
+                # contribute what this node knows; a metric with no
+                # local UID walks on like a remote 404 would (a replica
+                # may hold series this node has not caught up to)
+                ingest_local(extra_local)
+                if local_knows_all():
+                    todo -= extra_local
+                else:
+                    for shard in extra_local:
+                        consulted[shard].add(repl.self_id)
+            for node, shards in reassign.items():
+                hdr = shards_header(shards)
+                served = True
+                for i in range(len(raw.queries)):
+                    span = (parent.child("peer_fetch", peer=node,
+                                         subquery=i, failover=True,
+                                         shards=len(shards))
+                            if parent is not None else None)
+                    try:
+                        payload = _guarded_fetch(
+                            state, policy, node, _sub_json(raw, i),
+                            span, trace_id, deadline, tenant_header,
+                            hdr)
+                    except PeerUnknownNameError:
+                        served = False
+                        for shard in shards:
+                            consulted[shard].add(node)
+                        break
+                    except Exception as e:
+                        LOG.warning("sharded failover fetch from %s "
+                                    "failed too: %s", node, e)
+                        served = False
+                        failed_nodes.add(node)
+                        for shard in shards:
+                            consulted[shard].add(node)
+                        break
+                    total += _fold_payload(scratch, payload)
+                if served:
+                    todo -= shards
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+        for fut, (_peer, _i, span) in futures.items():
+            if span is not None and span.wall_ms is None:
+                if fut.cancelled():
+                    span.tags.setdefault(
+                        "error", "cancelled: query aborted before "
+                                 "this fetch ran")
+                span.finish()
+    if lost_shards:
+        state.count("failed_queries" if not allow_partial
+                    else "partial_queries")
+        if not allow_partial:
+            raise RuntimeError(
+                "shard(s) %s have no live member (cover epoch %d)"
+                % (sorted(lost_shards), repl.current_epoch()))
+    runner = scratch.new_query_runner()
+    out = runner.run(ts_query)
+    for qr in out:
+        qr.tsuids = []      # scratch-store surrogate uids (see
+        #                     run_clustered)
+    if exec_stats is not None:
+        exec_stats.update(runner.exec_stats)
+        exec_stats["clusterPeers"] = len(remote)
+        exec_stats["clusterRawPoints"] = total
+        exec_stats["shardEpoch"] = repl.current_epoch()
+        exec_stats["shardCover"] = {node: len(shards)
+                                    for node, shards in cover.items()}
+        if failed_nodes:
+            exec_stats["clusterPeersFailed"] = len(failed_nodes)
+        if lost_shards:
+            exec_stats["clusterShardsFailed"] = len(lost_shards)
+            exec_stats["partialResults"] = True
     return out
 
 
@@ -585,9 +916,6 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None,
     tsd.network.cluster.partial_results=error the first one fails the
     query; with "allow" the surviving peers' data still answers and the
     failed-peer count rides out in exec_stats."""
-    from opentsdb_tpu.core import TSDB
-    from opentsdb_tpu.utils.config import Config
-
     peers = cluster_peers(tsdb.config)
     state = _state(tsdb)
     # the ambient deadline is read HERE, on the handler thread that
@@ -597,22 +925,7 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None,
     allow_partial = (tsdb.config.get_string(
         "tsd.network.cluster.partial_results").strip().lower() == "allow")
     raw = _raw_query(ts_query)
-
-    scratch = TSDB(Config({
-        "tsd.core.auto_create_metrics": True,
-        # serving knobs only — the scratch is a per-query aggregation
-        # buffer, not a daemon: no flight recorder or health engine of
-        # its own (constructing one per clustered query would be waste,
-        # and its ring would be discarded with the scratch)
-        "tsd.query.device_cache.enable": "false",
-        "tsd.diag.enable": "false",
-        "tsd.health.enable": "false",
-    }))
-    # the scratch runner's planner events must land in the SERVING
-    # daemon's flight recorder — they carry the request's trace id, so
-    # a clustered query's plan decisions stay reconstructible from the
-    # coordinator's /api/diag ring
-    scratch.flightrec = getattr(tsdb, "flightrec", None)
+    scratch = _scratch_store(tsdb)
     total = 0
 
     # peer fetches submit FIRST so they overlap the local extraction
@@ -644,15 +957,22 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None,
                                 tenant_header)] = (peer, i, span)
 
     failed_peers: set[str] = set()
+    unknown_local: set[int] = set()
+    unknown_peers: dict[int, int] = {}
     # local extraction: straight off this host's store/planner (objects,
     # no JSON round-trip), concurrent with the in-flight peer fetches
     try:
-        for qr in tsdb.new_query_runner().run(raw):
+        for qr in _local_raw_series(tsdb, raw, unknown_local):
             total += _ingest_series(scratch, qr.metric, qr.tags, qr.dps)
         if futures:
             for fut, (peer, i, _span) in futures.items():
                 try:
                     payload = fut.result()
+                except PeerUnknownNameError:
+                    # a healthy name-lookup miss, not a peer failure:
+                    # never marks the answer partial
+                    unknown_peers[i] = unknown_peers.get(i, 0) + 1
+                    continue
                 except Exception as e:
                     if not allow_partial:
                         state.count("failed_queries")
@@ -665,13 +985,17 @@ def run_clustered(tsdb, ts_query: TSQuery, exec_stats: dict | None = None,
                             "cluster peer %s failed; serving partial "
                             "results without it: %s", peer, e)
                     continue
-                for item in payload:
-                    if "metric" not in item:
-                        continue        # statsSummary etc.
-                    total += _ingest_series(
-                        scratch, item["metric"], item.get("tags") or {},
-                        ((int(t), v)
-                         for t, v in (item.get("dps") or {}).items()))
+                total += _fold_payload(scratch, payload)
+        # a name NO reachable node has assigned answers exactly like a
+        # single host: NoSuchUniqueName (HTTP 400 name-lookup error),
+        # not an empty 200 — a typo'd dashboard must stay visible
+        # (a failed peer might have known it — partial stance covers
+        # that; with every peer answering, the verdict is authoritative)
+        if not failed_peers:
+            for i in sorted(unknown_local):
+                if unknown_peers.get(i, 0) == len(peers):
+                    raise NoSuchUniqueName("metric",
+                                           raw.queries[i].metric)
     finally:
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)

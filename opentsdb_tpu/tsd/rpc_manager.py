@@ -113,6 +113,14 @@ class RpcManager:
             # obs/health.py): /api/diag, /api/diag/slow,
             # /api/diag/health — mounted in every mode like /api/stats
             http["api/diag"] = admin_rpcs.DiagRpc()
+            if getattr(self.tsdb, "replication", None) is not None:
+                # WAL-shipping replication wire (tsd/replication.py):
+                # tail/ship/status, mounted in every mode — a ro
+                # replica must still accept ships and serve tails.
+                # Exempt from the query admission gate; bounded by its
+                # own tsd.replication.max_inflight_mb byte gate.
+                from opentsdb_tpu.tsd.replication import ReplicationRpc
+                http["api/replication"] = ReplicationRpc()
 
         put = rpcs.PutDataPointRpc()
         rollups = rpcs.RollupDataPointRpc()

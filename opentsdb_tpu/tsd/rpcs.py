@@ -182,6 +182,17 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
     def execute_http(self, tsdb, query: HttpQuery) -> None:
         self._count("http_requests")
         allowed_methods(query, "POST")
+        if getattr(tsdb, "replication", None) is not None:
+            from opentsdb_tpu.tsd.replication import ReplicationManager
+            if ReplicationManager.is_routed_request(query):
+                # a peer already routed this body here (one hop): this
+                # node is the accepting member — apply locally, never
+                # re-forward (the loop guard)
+                with ReplicationManager.accepting():
+                    return self._execute_put(tsdb, query)
+        return self._execute_put(tsdb, query)
+
+    def _execute_put(self, tsdb, query: HttpQuery) -> None:
         native = self._try_native_put(tsdb, query)
         if native is not None:
             success, errors, spans = native

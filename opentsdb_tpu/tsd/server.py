@@ -160,6 +160,14 @@ class TSDServer:
         self._server = await asyncio.start_server(
             self._handle_connection, self.bind, self.port,
             limit=MAX_TELNET_LINE)
+        repl = getattr(self.tsdb, "replication", None)
+        if repl is not None:
+            # rejoin protocol (tsd/replication.py): catch up from
+            # peers' WAL tails BEFORE re-accepting ownership, then keep
+            # the pull cadence running.  Off the event loop — catch-up
+            # is blocking HTTP against peers.
+            await self._loop.run_in_executor(None, repl.catch_up)
+            repl.start_puller()
         LOG.info("Ready to serve on %s:%d", self.bind, self.port)
 
     async def serve_forever(self) -> None:

@@ -111,6 +111,44 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.network.cluster.breaker.cooldown_ms": _e(
         "int", "5000", "Open -> half-open probe delay; breaker state "
         "surfaces via /api/stats (cluster.breaker.*)."),
+    # -- sharded ownership + replication (tsd/replication.py) ---------- #
+    "tsd.network.cluster.self": _e(
+        "str", "", "host:port identity of THIS node on the shard ring "
+        "(how peers reach it).  Required when shard.enable is true."),
+    "tsd.network.cluster.shard.enable": _e(
+        "bool", False, "Consistent-hash series ownership across the "
+        "cluster: each (metric, tags) series gets an owner + replica "
+        "set, ingest routes to the owner, and queries fan out only to "
+        "the owning shards' healthy members (docs/replication.md)."),
+    "tsd.network.cluster.shard.count": _e(
+        "int", "64", "Logical shards the series key space hashes into; "
+        "the unit of ownership, failover, and anti-entropy comparison."),
+    "tsd.network.cluster.shard.virtual_nodes": _e(
+        "int", "32", "Virtual nodes per peer on the consistent-hash "
+        "ring — evens shard placement and bounds rebalance movement to "
+        "~1/n of the shards when a peer joins or leaves."),
+    "tsd.network.cluster.shard.replicas": _e(
+        "int", "2", "Replication factor: copies of each shard "
+        "(owner included).  1 = unreplicated single-copy serving (the "
+        "pre-replication behavior)."),
+    "tsd.replication.max_inflight_mb": _e(
+        "int", "64", "Byte bound on concurrently-processing "
+        "replication ship/tail bodies.  Replication traffic is exempt "
+        "from the query admission gate; this is its own backpressure "
+        "(excess requests answer 503 and the sender falls back to the "
+        "pull cadence)."),
+    "tsd.replication.pull_interval_ms": _e(
+        "int", "1000", "Replica catch-up cadence: how often each node "
+        "pulls peers' WAL tails (/api/replication/tail) to fill gaps "
+        "the synchronous ship path missed."),
+    "tsd.replication.ship_timeout_ms": _e(
+        "int", "5000", "Per-replica budget for the synchronous WAL "
+        "ship on the ingest ack path; a replica that cannot answer "
+        "within it is served by the pull cadence instead."),
+    "tsd.replication.tail_batch_mb": _e(
+        "int", "4", "Payload bound per /api/replication/tail page; a "
+        "catching-up replica iterates pages until it reaches the "
+        "owner's last sequence number."),
     # -- fault injection (utils/faults.py) ----------------------------- #
     "tsd.faults.config": _e(
         "str", "", "Fault-injection spec: inline JSON list or @path. "
@@ -254,6 +292,12 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "ratio above which the tenant subsystem reads degraded "
         "(failing when a demanding tenant was admitted NOTHING while "
         "others were served)."),
+    "tsd.health.replication_lag": _e(
+        "int", "500", "Replication-lag burn bound: growth of the "
+        "worst replica's unacknowledged WAL backlog (records) per "
+        "window above which the replication subsystem reads degraded "
+        "(failing at 4x); any under-replicated shard is at least "
+        "degraded."),
     # -- costmodel autotune (ops/calibrate.py, docs/costmodel.md) ------ #
     "tsd.costmodel.autotune.enable": _e(
         "bool", False, "Online costmodel calibration: fit the kernel-"
@@ -695,6 +739,11 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
     "tsd.storage.wal_sync_interval": _e(
         "int", "0", "Seconds between WAL fsync passes (0 = disabled; "
         "line buffering still survives process crashes)."),
+    "tsd.storage.wal.segment_mb": _e(
+        "int", "64", "WAL segment rotation size; segments are named by "
+        "their first sequence number so a replica can catch up from an "
+        "arbitrary offset without the owner rescanning one unbounded "
+        "file."),
     "tsd.storage.wal.fsync": _e(
         "bool", False, "fsync the WAL per journaled record: "
         "crash-consistent at ingest cost (default rides the "

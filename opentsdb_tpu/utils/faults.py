@@ -81,6 +81,14 @@ KNOWN_SITES: dict[str, frozenset] = {
     # an "error" fault here is the disk-full shape chaos_soak --spill
     # heals through
     "spill.write": frozenset(),
+    # replication hazard sites (tsd/replication.py): `replication.ship`
+    # fires owner-side before the synchronous WAL ship to a replica
+    # (a refuse/error there forces the pull cadence to fill the gap);
+    # `replication.tail` fires puller-side before a catch-up tail GET
+    # (a latency/refuse there delays rejoin convergence) — both carry
+    # ``peer`` so split-brain-shaped failures target one link
+    "replication.ship": frozenset({"peer"}),
+    "replication.tail": frozenset({"peer"}),
 }
 # Body-corruption kinds only make sense at mangle() sites.
 BODY_SITES = frozenset({"cluster.peer_body"})

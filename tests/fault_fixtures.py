@@ -23,6 +23,12 @@ import threading
 # fault modes a FaultyPeer can serve
 OK = "ok"                   # 200 + canned payload
 TIMEOUT = "timeout"         # accept, read, never answer
+PARTITION = "partition"     # accept the connect, never even READ the
+                            # request, hold the socket — the network-
+                            # partition shape: the peer looks alive at
+                            # the TCP layer but nothing moves (split-
+                            # brain-shaped failures for the replication
+                            # ship/tail tests)
 DISCONNECT = "disconnect"   # 200 headers, half the body, RST
 GARBAGE = "garbage"         # 200 + bytes that are not JSON
 ERROR_500 = "error500"      # well-formed 500 (transient: retried)
@@ -134,6 +140,18 @@ class FaultyPeer:
 
     def _handle(self, conn: socket.socket) -> None:
         try:
+            with self._lock:
+                upcoming = self.script[0] if self.script else self.mode
+                if upcoming == PARTITION:
+                    # the partition holds the socket BEFORE any byte is
+                    # read: the client's connect succeeds, its request
+                    # bytes vanish into the kernel buffer, and nothing
+                    # ever answers — `requests` does NOT grow (no full
+                    # request was delivered)
+                    if self.script:
+                        self.script.pop(0)
+                    self._hung.append(conn)
+                    return              # close() releases it
             if self._read_request(conn) is None:
                 return
             with self._lock:

@@ -1,0 +1,48 @@
+# tsdbsan seeded fixture: TRUE NEGATIVES shaped like the replication
+# manager's DISCIPLINED shared state (tsd/replication.py).  Every
+# pattern here is the sanctioned form the real manager uses and must
+# come back CLEAN:
+#
+#   * annotated position/chain state always mutated under the manager
+#     lock, from both the ship-ack path and the puller thread;
+#   * an unannotated scratch attribute written by several threads but
+#     ALWAYS under the same lock (non-empty lockset);
+#   * the puller-thread handle mutated only before the thread starts
+#     and after it joins (construct-then-hand-off shape).
+
+import threading
+
+
+class DisciplinedShipQueue:
+    """The lock discipline ReplicationManager actually follows."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer_position = 0  # guarded-by: _lock
+        self.chain = 0          # guarded-by: _lock
+        self.inflight = 0       # unannotated, but always under _lock
+        self.rounds = 0         # written only by the puller post-start
+
+    def ack(self, seq):
+        with self._lock:
+            self.peer_position = max(self.peer_position, seq)
+            self.chain = (self.chain * 31 + seq) & 0xFFFFFFFF
+            self.inflight += 1
+
+    def puller_round(self):
+        self.rounds += 1
+        self.ack(self.rounds)
+
+
+def run():
+    q = DisciplinedShipQueue()
+    q.ack(1)
+    # ship-ack from a worker thread, lock held inside ack()
+    t = threading.Thread(target=q.ack, args=(2,))
+    t.start()
+    t.join()
+    # hand-off: only the puller writes `rounds` post-construction
+    t2 = threading.Thread(target=q.puller_round)
+    t2.start()
+    t2.join()
+    return q

@@ -1,0 +1,54 @@
+# tsdbsan seeded-bug fixture: TRUE POSITIVES shaped like the
+# replication manager's shared state (tsd/replication.py).
+#
+# Driven by tests/test_sanitizer.py, which instruments this module,
+# runs `run()`, and asserts the findings land EXACTLY on the
+# `# EXPECT:` lines below (the lint fixture convention).
+#
+# Two seeded bugs, both the shapes replication threading invites:
+#   * `peer_position` carries a `# guarded-by:` annotation (a ship
+#     ack and a tail poll both move it), but the ack path below
+#     mutates it without the lock — the exact race a synchronous
+#     shipper + background puller would have without the manager's
+#     `_lock`.
+#   * `pending_seqs` is unannotated and mutated by the "ship" thread
+#     and the "drain" caller with no common lock — Eraser lockset
+#     intersection goes empty once both writers have run.
+
+import threading
+
+
+class ShipQueue:
+    """A deliberately-racy miniature of the per-peer ship state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer_position = 0  # guarded-by: _lock
+        self.pending_seqs = 0   # deliberately unannotated shared state
+
+    def ack_locked(self, seq):
+        with self._lock:
+            self.peer_position = max(self.peer_position, seq)
+
+    def ack_racy(self, seq):
+        self.peer_position = seq  # EXPECT: san-unguarded-mutation
+
+    def stash(self):
+        self.pending_seqs += 1  # EXPECT: san-lockset-race
+
+
+def run():
+    q = ShipQueue()
+    q.ack_locked(1)
+    # the "ship" thread acks without the lock the annotation demands
+    t = threading.Thread(target=q.ack_racy, args=(2,))
+    t.start()
+    t.join()
+    # Eraser: main stashes, a worker stashes (handoff — still silent),
+    # then main stashes AGAIN -> two shared-state writers, empty lockset
+    q.stash()
+    t2 = threading.Thread(target=q.stash)
+    t2.start()
+    t2.join()
+    q.stash()
+    return q

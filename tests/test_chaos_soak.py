@@ -89,6 +89,25 @@ def test_spill_contract_holds():
 
 
 @pytest.mark.slow
+def test_failover_contract_holds():
+    """ISSUE 15 acceptance: kill -9 of one peer in a 3-node rf=2
+    cluster under mixed ingest/query load loses zero acked writes and
+    serves every query full (non-partial, no 5xx); the rejoined peer
+    converges — pairwise per-(origin, shard) CRC-chain agreement — and
+    post-heal /api/diag/health reads all eight invariants ok with the
+    ownership epoch change retained in the flight recorder."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14301", "--rounds", "6", "--failover",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "0 x 5xx, 0 partial" in proc.stdout
+    assert "CRC chains agree pairwise" in proc.stdout
+    assert "diag gate OK" in proc.stdout
+
+
+@pytest.mark.slow
 def test_tenants_contract_holds():
     """ISSUE 14 acceptance: one tenant storming a fair-share gate
     sheds on its own per-tenant backlog (503 + Retry-After, never a

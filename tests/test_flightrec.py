@@ -206,7 +206,7 @@ class TestEndpoints:
         assert payload["overall"] == "ok"
         assert set(payload["subsystems"]) == {
             "admission", "compile", "agg_cache", "costmodel", "spill",
-            "cluster", "tenant"}
+            "cluster", "tenant", "replication"}
         for verdict in payload["subsystems"].values():
             assert verdict["level"] in ("ok", "degraded", "failing")
             assert verdict["detail"]

@@ -44,6 +44,11 @@ def query_sum(t, metric="p.cpu", end=BASE + 600):
     return t.new_query_runner().run(q)
 
 
+def last_segment(tmp_path):
+    """Newest framed WAL segment (wal-<seq16>.jsonl)."""
+    return sorted((tmp_path / "data").glob("wal-*.jsonl"))[-1]
+
+
 class TestWalReplay:
     def test_replay_without_snapshot(self, tmp_path):
         t1 = make_tsdb(tmp_path)
@@ -115,7 +120,7 @@ class TestWalReplay:
         for i in range(5):
             t1.add_point("p.cpu", BASE + i, i, {"h": "a"})
         t1.persistence.close()
-        wal = tmp_path / "data" / "wal.jsonl"
+        wal = last_segment(tmp_path)
         # truncate INTO the final record (no trailing newline), exactly
         # what a kill -9 between write() and the page landing produces
         raw = wal.read_bytes()
@@ -132,24 +137,31 @@ class TestWalReplay:
         t3 = make_tsdb(tmp_path)
         assert t3.store.total_datapoints == 5
 
-    def test_mid_file_corruption_replays_later_records(self, tmp_path,
-                                                       caplog):
+    def test_mid_file_corruption_stops_at_last_valid_record(self, tmp_path,
+                                                            caplog):
         """A bad line that is NOT the tail is corruption worth alarming
-        on — but the acknowledged records after it must still replay."""
+        on — and with framed records, everything past the hole is
+        untrusted: replay stops at the last valid record instead of
+        skipping the hole and replaying what follows."""
         import logging
         t1 = make_tsdb(tmp_path)
         for i in range(4):
             t1.add_point("p.cpu", BASE + i, i, {"h": "a"})
         t1.persistence.close()
-        wal = tmp_path / "data" / "wal.jsonl"
+        wal = last_segment(tmp_path)
         lines = wal.read_text().splitlines()
         lines[1] = lines[1][: len(lines[1]) // 2]  # corrupt record 2
         wal.write_text("\n".join(lines) + "\n")
         with caplog.at_level(logging.ERROR, logger="storage.persist"):
             t2 = make_tsdb(tmp_path)
-        assert t2.store.total_datapoints == 3      # 1, 3, 4 survive
-        assert any("unparseable line" in r.message
-                   for r in caplog.records)
+        assert t2.store.total_datapoints == 1      # only record 1 survives
+        assert any("corrupt record" in r.message for r in caplog.records)
+        # the hole was truncated: appends resume on a clean boundary and
+        # the next replay sees no corruption
+        t2.add_point("p.cpu", BASE + 99, 99, {"h": "a"})
+        t2.persistence.close()
+        t3 = make_tsdb(tmp_path)
+        assert t3.store.total_datapoints == 2
 
 
 class TestSnapshotRestore:

@@ -12,6 +12,10 @@ empty.  The corpus seeds one deliberate bug per detector:
     inversion_tp / inversion_tn  order-graph inversion detector
     recompile_tp / recompile_tn  JAX compile sanitizer (per-call jit
                                  TP, lru_cache builder TN)
+    replication_tp / replication_tn
+                                 lockset detector over the replication
+                                 manager's shapes: ship-ack vs puller
+                                 position race (tsd/replication.py)
 
 CPU-only (conftest pins JAX_PLATFORMS=cpu); nothing here touches mesh
 or shard_map paths, which fail at HEAD in this environment.
@@ -135,6 +139,24 @@ class TestLockset:
                for f in REPORTER.findings(apply_suppressions=False)
                if f.path == "tests/san_fixtures/race_tn.py"}
         assert any(rule == "san-lockset-race" for _ln, rule in raw), raw
+
+    def test_replication_tp_fires_exactly_the_expected_lines(self, san):
+        """ISSUE 15 fixture pair: the ship-ack/puller shapes of
+        tsd/replication.py, seeded racy — the detector must land on
+        exactly the marked lines."""
+        mod = _load_fixture("replication_tp")
+        mod.run()
+        expected = _expected("replication_tp")
+        assert expected, "replication_tp declares no EXPECT markers"
+        got = _findings("replication_tp")
+        assert got == expected, (
+            "missed: %s, extra: %s" % (expected - got, got - expected))
+
+    def test_replication_tn_stays_clean(self, san):
+        mod = _load_fixture("replication_tn")
+        mod.run()
+        assert _findings("replication_tn") == set(), [
+            f.render() for f in REPORTER.findings()]
 
     def test_fixture_locks_are_instrumented(self, san):
         mod = _load_fixture("race_tp")

@@ -1397,6 +1397,224 @@ def run_tenants_stage(port: int, rounds: int) -> None:
         tsd.wait()
 
 
+def run_failover_stage(port: int, rounds: int) -> None:
+    """--failover: the replicated-sharded-serving contract (ISSUE 15,
+    tsd/replication.py + docs/replication.md) against a REAL 3-node
+    rf=2 cluster under mixed ingest/query load, with a kill -9 of one
+    peer mid-burst:
+
+      * zero acked-write loss: every point that ever answered 204 is
+        served after the kill AND after the heal, from every node;
+      * zero 500s in allow mode and zero partialResults: the shard
+        cover fails over to replicas, so serving continues with FULL
+        data (rf=2 means any single death is survivable);
+      * the killed peer REJOINS (same WAL directory): catch-up from
+        peers' tails converges, per-(origin, shard) CRC chains agree
+        across the cluster (anti-entropy's byte-level evidence);
+      * post-heal /api/diag/health reads all eight invariants ok and
+        the flight recorder retains the ownership epoch changes.
+    """
+    import tempfile
+    ports = [port, port + 1, port + 2]
+    dirs = [tempfile.mkdtemp(prefix="chaos_failover_%d_" % i)
+            for i in range(3)]
+
+    def node_cfg(i: int) -> dict:
+        peers = ",".join("127.0.0.1:%d" % p
+                         for j, p in enumerate(ports) if j != i)
+        return {
+            "tsd.storage.directory": dirs[i],
+            "tsd.storage.fix_duplicates": "true",
+            "tsd.query.mesh.enable": "false",
+            "tsd.network.cluster.peers": peers,
+            "tsd.network.cluster.self": "127.0.0.1:%d" % ports[i],
+            "tsd.network.cluster.shard.enable": "true",
+            "tsd.network.cluster.shard.count": "32",
+            "tsd.network.cluster.shard.replicas": "2",
+            "tsd.network.cluster.partial_results": "allow",
+            "tsd.network.cluster.retry.max_attempts": "1",
+            "tsd.network.cluster.timeout_ms": "4000",
+            "tsd.network.cluster.breaker.threshold": "2",
+            "tsd.network.cluster.breaker.cooldown_ms": "1000",
+            "tsd.replication.pull_interval_ms": "300",
+        }
+
+    procs = [spawn_tsd(ports[i], node_cfg(i), role="fo%d" % i)
+             for i in range(3)]
+    acked: dict = {}            # (metric, host, ts) -> value
+    fails: list = []
+    partials = 0
+    queries = 0
+    victim = 1
+
+    def write_round(r: int, nodes: list) -> None:
+        metric = "fo.m%d" % (r % 6)
+        host = "h%d" % (r % 3)
+        dps = [{"metric": metric, "timestamp": BASE + r,
+                "value": r + 1, "tags": {"host": host}}]
+        for attempt, p in enumerate(nodes + nodes):
+            try:
+                req = urllib.request.Request(
+                    "http://127.0.0.1:%d/api/put" % p,
+                    data=json.dumps(dps).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=20) as resp:
+                    if resp.status in (200, 204):
+                        acked[(metric, host, BASE + r)] = r + 1
+                        return
+            except urllib.error.HTTPError as e:
+                if e.code >= 500:
+                    fails.append(("write", r, e.code))
+                    return
+            except OSError:
+                continue        # dead node: a real client rotates
+        fails.append(("write-unplaced", r, None))
+
+    def query_metric(p: int, metric: str):
+        body = {"start": BASE - 600, "end": BASE + 3600,
+                "queries": [{"aggregator": "none", "metric": metric}]}
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/api/query" % p,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def query_round(r: int, nodes: list) -> None:
+        nonlocal partials, queries
+        metric = "fo.m%d" % (r % 6)
+        if not any(m == metric for m, _h, _t in acked):
+            return
+        p = nodes[r % len(nodes)]
+        try:
+            payload = query_metric(p, metric)
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                fails.append(("query", r, e.code))
+            return
+        except OSError:
+            return              # dead node: a real client rotates
+        queries += 1
+        if any(isinstance(x, dict) and x.get("partialResults")
+               for x in payload):
+            partials += 1
+            fails.append(("partial", r, None))
+
+    try:
+        live = list(ports)
+        total = max(rounds, 6) * 4
+        kill_at = total // 3
+        rejoin_at = 2 * total // 3
+        for r in range(total):
+            if r == kill_at:
+                print("[failover] kill -9 node %d (127.0.0.1:%d) "
+                      "mid-burst after %d acked writes"
+                      % (victim, ports[victim], len(acked)), flush=True)
+                procs[victim].kill()        # SIGKILL: no drain, no
+                procs[victim].wait()        # snapshot, WAL tail only
+                live = [p for p in ports if p != ports[victim]]
+            if r == rejoin_at:
+                print("[failover] rejoining node %d on its original "
+                      "WAL directory" % victim, flush=True)
+                procs[victim] = spawn_tsd(
+                    ports[victim], node_cfg(victim),
+                    role="fo%d-rejoin" % victim)
+                live = list(ports)
+            write_round(r, live)
+            query_round(r, live)
+        if fails:
+            print("[failover] FAILED: %d violations, first: %r"
+                  % (len(fails), fails[:5]), flush=True)
+            raise SystemExit(1)
+
+        # -- zero acked-write loss: EVERY node serves EVERY acked point
+        deadline = time.time() + 60
+        missing = {"boot": True}
+        while time.time() < deadline and missing:
+            missing = {}
+            for p in ports:
+                got = {}
+                for metric in {m for m, _h, _t in acked}:
+                    try:
+                        for item in query_metric(p, metric):
+                            if not isinstance(item, dict) \
+                                    or "metric" not in item:
+                                continue
+                            host = (item.get("tags") or {}).get("host")
+                            for t, v in (item.get("dps") or {}).items():
+                                got[(item["metric"], host, int(t))] = v
+                    except (OSError, urllib.error.HTTPError):
+                        pass
+                lost = {k for k, v in acked.items()
+                        if got.get(k) != v}
+                if lost:
+                    missing[p] = sorted(lost)[:3]
+            if missing:
+                time.sleep(1.0)
+        if missing:
+            print("[failover] FAILED: acked writes missing after heal: "
+                  "%r" % missing, flush=True)
+            raise SystemExit(1)
+        print("[failover] %d acked writes audited on all 3 nodes, "
+              "%d queries, 0 x 5xx, 0 partial" %
+              (len(acked), queries), flush=True)
+
+        # -- anti-entropy evidence: per-(origin, shard) chains agree
+        deadline = time.time() + 60
+        diverged = {"boot": True}
+        while time.time() < deadline and diverged:
+            diverged = {}
+            statuses = {}
+            for p in ports:
+                try:
+                    statuses[p] = json.loads(urllib.request.urlopen(
+                        "http://127.0.0.1:%d/api/replication/status"
+                        % p, timeout=10).read())
+                except OSError as e:
+                    diverged[p] = str(e)
+            chains = {p: s.get("chains", {})
+                      for p, s in statuses.items()}
+            for pa in ports:
+                for pb in ports:
+                    if pb <= pa or pa in diverged or pb in diverged:
+                        continue
+                    for origin in set(chains[pa]) & set(chains[pb]):
+                        a, b = chains[pa][origin], chains[pb][origin]
+                        for shard in set(a) & set(b):
+                            if a[shard] != b[shard]:
+                                diverged[(pa, pb)] = (origin, shard,
+                                                      a[shard],
+                                                      b[shard])
+            if diverged:
+                time.sleep(1.0)
+        if diverged:
+            print("[failover] FAILED: CRC chains diverged after "
+                  "rejoin: %r" % diverged, flush=True)
+            raise SystemExit(1)
+        print("[failover] rejoined peer converged: CRC chains agree "
+              "pairwise across the cluster", flush=True)
+
+        # -- post-heal gate: all eight invariants ok + epoch evidence
+        check_diag_gate(
+            ports[0], "failover",
+            [("replication epoch change",
+              lambda e: e.get("kind") == "replication")],
+            timeout_s=90.0)
+    finally:
+        for proc in procs:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        for proc in procs:
+            try:
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
+
+
 def check_san_reports() -> int:
     """Error-level tsdbsan findings across every armed TSD's shutdown
     report.  Missing report = the daemon died before writing it — also
@@ -1464,6 +1682,14 @@ def main():
                          "fault must produce only 200s or "
                          "503+Retry-After, a bounded in-flight count, "
                          "and full recovery once the fault lifts")
+    ap.add_argument("--failover", action="store_true",
+                    help="run the replicated-sharded-serving stage: a "
+                         "3-node rf=2 cluster under mixed ingest/query "
+                         "load with a kill -9 of one peer mid-burst "
+                         "must lose zero acked writes, serve zero 500s "
+                         "and zero partialResults, converge the "
+                         "rejoined peer's CRC chains, and read all "
+                         "eight health invariants ok post-heal")
     ap.add_argument("--tenants", action="store_true",
                     help="run the fair-share multi-tenant stage: one "
                          "tenant storming must shed on its own "
@@ -1480,6 +1706,8 @@ def main():
     rng = random.Random(args.seed)
     if args.overload:
         run_overload_stage(args.port + 3, args.rounds)
+    if args.failover:
+        run_failover_stage(args.port + 13, args.rounds)
     if args.tenants:
         run_tenants_stage(args.port + 11, args.rounds)
     if args.autotune:
@@ -1492,9 +1720,11 @@ def main():
         run_rollup_stage(args.port + 9, args.rounds)
     if args.stages_only:
         if not (args.overload or args.autotune or args.cache
-                or args.spill or args.rollup or args.tenants):
+                or args.spill or args.rollup or args.tenants
+                or args.failover):
             ap.error("--stages-only needs --overload, --autotune, "
-                     "--cache, --spill, --rollup and/or --tenants")
+                     "--cache, --spill, --rollup, --tenants and/or "
+                     "--failover")
         print("chaos soak stages PASSED (standard phases skipped: "
               "--stages-only)", flush=True)
         return

@@ -49,6 +49,7 @@ SUBSET_TIER1 = [
     "tests/test_agg_cache.py",
     "tests/test_rollup_lanes.py",
     "tests/test_tsd_server.py",
+    "tests/test_replication.py",
     "tests/test_parallel.py",
     "tests/test_native_engine.py",
     "tests/test_sanitizer.py",
