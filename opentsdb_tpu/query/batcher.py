@@ -41,7 +41,8 @@ no single member's feature vector).
 
 Deadlines stay per-member: a member whose deadline expires or cancels
 while waiting leaves the bucket WITHOUT poisoning its siblings — the
-leader drops expired members before stacking, and a member that
+leader drops expired members (its own included: winning the submit
+race does not outrank the deadline) before stacking, and a member that
 expires after sealing simply abandons its slice.  Each member keeps
 its own trace span; the planner annotates it with the batch verdict
 (q, waited ms, stacked vs solo).
@@ -191,6 +192,17 @@ class DispatchBatcher:
                 self._cv.notify_all()
         if leader:
             self._lead(spec, g_pad, bucket, host_small, full, t0)
+            if member.abandoned:
+                # the leader's OWN deadline died while the window held:
+                # it already dispatched for its live followers above,
+                # but its answer would arrive past the deadline — same
+                # exit as a dropped follower (413/503, siblings keep
+                # their results)
+                member.deadline.check()
+                from opentsdb_tpu.query.limits import QueryException
+                raise QueryException(
+                    "Sorry, your query's deadline expired while "
+                    "batched.")
         else:
             self._follow(bucket, member, t0)
         with self._lock:
@@ -255,12 +267,14 @@ class DispatchBatcher:
                     self._cv.wait(min(remaining, _WAIT_TICK_S))
         with self._lock:
             members = [m for m in bucket.members if not m.abandoned]
-            # drop members whose deadline died while the window held
+            # drop members whose deadline died while the window held —
+            # the leader's own member included (it submitted first, but
+            # first-in-line does not outrank the deadline; submit()
+            # raises its 413/503 after this dispatch serves the rest)
             live: list[_Member] = []
             for m in members:
                 d = m.deadline
-                if m is not bucket.members[0] and d is not None \
-                        and (d.is_cancelled() or d.expired()):
+                if d is not None and (d.is_cancelled() or d.expired()):
                     m.abandoned = True
                     m.done = True
                     continue

@@ -84,6 +84,9 @@ class Deadline:
         self._lock = threading.Lock()
         self._cancelled = False  # guarded-by: _lock
         self._cancel_reason = ""  # guarded-by: _lock
+        # the wakeable half of the token: request-path sleeps park on
+        # this instead of time.sleep so cancel() interrupts them
+        self._cancel_event = threading.Event()
 
     @property
     def bounded(self) -> bool:
@@ -109,9 +112,28 @@ class Deadline:
                 return False
             self._cancelled = True
             self._cancel_reason = reason
+        self._cancel_event.set()
         return True
 
     def is_cancelled(self) -> bool:
+        return self._cancelled
+
+    def wait_cancelled(self, timeout_s: float) -> bool:
+        """An interruptible sleep: block up to ``timeout_s`` seconds
+        (clamped to the remaining wall budget when bounded) OR until
+        ``cancel()`` flips the token, whichever comes first.  Returns
+        ``is_cancelled()`` so pollers can tell the wake reasons apart.
+
+        This is the primitive request-path code must use instead of
+        ``time.sleep``: a bare sleep serves out its full delay for a
+        client that already disconnected, while this one releases
+        within the tick that the responder loop cancels the request
+        (tsdblint's deadline_discipline pins the distinction)."""
+        if timeout_s > 0 and not self._cancelled:
+            if self.bounded:
+                timeout_s = min(timeout_s,
+                                max(self.remaining_ms() / 1e3, 0.0))
+            self._cancel_event.wait(timeout_s)
         return self._cancelled
 
     @property

@@ -74,7 +74,8 @@ import numpy as np
 
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
 from opentsdb_tpu.obs import trace as obs_trace
-from opentsdb_tpu.query.limits import QueryException, active_deadline
+from opentsdb_tpu.query.limits import (Deadline, QueryException,
+                                       active_deadline)
 from opentsdb_tpu.uid import NoSuchUniqueName
 from opentsdb_tpu.utils import faults
 from opentsdb_tpu.utils.retry import RetryPolicy, call_with_retries
@@ -255,6 +256,10 @@ class ClusterState:
 
 
 _STATE_LOCK = threading.Lock()
+
+# Probe-verdict poll cadence (_guarded_fetch_inner): each tick parks on
+# the request deadline's cancellation token, never a bare sleep.
+_PROBE_TICK_S = 0.02
 
 
 def _state(tsdb) -> ClusterState:
@@ -472,11 +477,17 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
     if not allowed and breaker.probe_pending():
         # a sibling subquery of this same query is the half-open probe:
         # wait for its verdict instead of fast-failing — the probe's
-        # success must not fail the query that triggered it
-        deadline = start + policy.budget_s
+        # success must not fail the query that triggered it.  The tick
+        # parks on the deadline's cancellation token (a throwaway
+        # unbounded Deadline when the caller passed none) so a client
+        # disconnect releases this wait within one tick instead of
+        # polling out the whole fetch budget
+        dl = deadline if deadline is not None else Deadline()
+        wait_until = start + policy.budget_s
         while (not allowed and breaker.probe_pending()
-               and time.monotonic() < deadline):
-            time.sleep(0.02)
+               and time.monotonic() < wait_until):
+            if dl.wait_cancelled(_PROBE_TICK_S):
+                dl.check()
             allowed = breaker.allow()
         # the wait spent part of THIS fetch's overall budget — the
         # retries below get only the remainder, keeping timeout_ms the
@@ -518,10 +529,14 @@ def _guarded_fetch_inner(state: ClusterState, policy: RetryPolicy,
                     peer, n, e)
 
     try:
+        # deadline passed EXPLICITLY: this runs on a fan-out executor
+        # worker, where the ambient TLS deadline (responder thread) is
+        # not visible — without it the backoff sleeps would be blind to
+        # cancellation again
         result = call_with_retries(
             fetch, policy,
             no_retry_on=(PeerRejectedError, QueryException),
-            on_retry=on_retry)
+            on_retry=on_retry, deadline=deadline)
     except QueryException as e:
         # the COORDINATOR gave up (request deadline expired / cancelled
         # mid-fetch) — the peer did not fail, so its breaker is not

@@ -66,6 +66,7 @@ import urllib.request
 import zlib
 
 from opentsdb_tpu.obs.registry import REGISTRY
+from opentsdb_tpu.query.limits import active_deadline
 from opentsdb_tpu.tsd.http import BadRequestError, HttpQuery
 from opentsdb_tpu.utils import faults
 
@@ -404,7 +405,7 @@ class ReplicationManager:
                              "X-TSDB-Replication": "routed"},
                     method="POST")
                 with urllib.request.urlopen(
-                        req, timeout=self.ship_timeout_s) as resp:
+                        req, timeout=self._request_timeout_s()) as resp:
                     resp.read()
                 breaker.record_success()
                 self._m_forwarded.labels(peer=node).inc()
@@ -507,6 +508,21 @@ class ReplicationManager:
         for node, group in by_peer.items():
             self._ship(node, group)
 
+    def _request_timeout_s(self) -> float:
+        """The bound for one synchronous replication HTTP call: the
+        configured ship timeout, clamped to the ambient request
+        deadline's remainder when one is active.  The ack-path ship
+        (`on_committed` -> `_ship`) and the routed-ingest forward run
+        INSIDE the client's put request — they must never outlive the
+        deadline that request is served under.  Background callers
+        (the puller cadence) see no ambient deadline and keep the
+        plain config bound."""
+        timeout_s = self.ship_timeout_s
+        dl = active_deadline()
+        if dl is not None and dl.bounded:
+            timeout_s = min(timeout_s, max(dl.remaining_ms() / 1e3, 0.05))
+        return timeout_s
+
     def _ship_lock(self, peer: str) -> threading.Lock:
         with self._lock:
             lock = self._ship_locks.get(peer)
@@ -545,7 +561,7 @@ class ReplicationManager:
                     headers={"Content-Type": "application/json"},
                     method="POST")
                 with urllib.request.urlopen(
-                        req, timeout=self.ship_timeout_s) as resp:
+                        req, timeout=self._request_timeout_s()) as resp:
                     ack = json.loads(resp.read().decode("utf-8"))
             breaker.record_success()
             self._m_ship.labels(peer=peer).inc(len(records))
@@ -713,7 +729,7 @@ class ReplicationManager:
                % (peer, since, urllib.parse.quote(self.self_id)))
         req = urllib.request.Request(url, method="GET")
         with urllib.request.urlopen(
-                req, timeout=self.ship_timeout_s) as resp:
+                req, timeout=self._request_timeout_s()) as resp:
             page = json.loads(resp.read().decode("utf-8"))
         records = page.get("records") or []
         first = int(page.get("firstSeq", 1))
@@ -934,7 +950,7 @@ class ReplicationManager:
         url = "http://%s/api/replication/status" % peer
         req = urllib.request.Request(url, method="GET")
         with urllib.request.urlopen(
-                req, timeout=self.ship_timeout_s) as resp:
+                req, timeout=self._request_timeout_s()) as resp:
             theirs = json.loads(resp.read().decode("utf-8"))
         divergent: list[int] = []
         their_chains = theirs.get("chains") or {}

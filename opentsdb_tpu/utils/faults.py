@@ -248,6 +248,9 @@ class FaultInjector:
         if f is None:
             return
         if f.kind == "latency":
+            # a latency fault EXISTS to stall the request path on
+            # purpose (chaos harness only; never armed in production)
+            # blocking: bounded-by the armed spec's own ms budget
             time.sleep(f.spec.get("ms", 100) / 1e3)
             return
         LOG.info("injecting %s at %s (%s)", f.kind, site, ctx)

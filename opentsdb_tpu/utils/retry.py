@@ -55,6 +55,27 @@ class RetryPolicy:
         return self.budget_s
 
 
+def _cancellable_sleep(delay: float, deadline) -> None:
+    """The default backoff sleep: wakeable by the request Deadline's
+    cancellation token.  A bare ``time.sleep(delay)`` here meant a
+    disconnected client's fan-out retries slept out their full backoff
+    while holding an admission permit; parking on the token instead
+    releases within one tick of ``cancel()`` and re-raises through
+    ``Deadline.check`` (503/413) so no further attempt is scheduled.
+    With no deadline anywhere (library callers), plain sleep."""
+    if deadline is None:
+        from opentsdb_tpu.query.limits import active_deadline
+        deadline = active_deadline()
+    if deadline is None:
+        # this arm runs only with NO deadline anywhere (library caller
+        # outside any request): there is no token this sleep could watch
+        # blocking: bounded-by the backoff delay itself (deadline-free path)
+        time.sleep(delay)
+        return
+    deadline.wait_cancelled(delay)
+    deadline.check()
+
+
 def call_with_retries(fn: Callable[[float], object],
                       policy: RetryPolicy,
                       retry_on: Tuple[Type[BaseException], ...]
@@ -63,15 +84,25 @@ def call_with_retries(fn: Callable[[float], object],
                       on_retry: Callable[[int, BaseException], None]
                       | None = None,
                       clock: Callable[[], float] = time.monotonic,
-                      sleep: Callable[[float], None] = time.sleep,
-                      rand: Callable[[], float] = random.random):
+                      sleep: Callable[[float], None] | None = None,
+                      rand: Callable[[], float] = random.random,
+                      deadline=None):
     """Run ``fn(attempt_timeout_s)`` under ``policy``; returns its value
     or raises the last error once attempts/budget are exhausted.
     ``no_retry_on`` wins over ``retry_on``: a deterministic failure
     (e.g. the server rejected the request as malformed) propagates
     immediately — retrying the same request buys the same answer.
     ``on_retry(attempt_number, exc)`` fires before each backoff sleep
-    (telemetry hook — cluster.py counts these into /api/stats)."""
+    (telemetry hook — cluster.py counts these into /api/stats).
+
+    ``deadline`` (a query.limits.Deadline) makes the backoff sleeps
+    cancellation-aware; pass it EXPLICITLY from pool threads — the
+    ambient TLS deadline lives on the responder thread, not on the
+    fan-out executor's workers.  Omitted, the ambient one (if any) is
+    picked up at sleep time.  An injected ``sleep`` wins outright (the
+    fault-injection tests drive the loop deterministically)."""
+    if sleep is None:
+        sleep = lambda d: _cancellable_sleep(d, deadline)  # noqa: E731
     start = clock()
     last_exc: BaseException | None = None
     for attempt in range(1, policy.max_attempts + 1):

@@ -1,0 +1,69 @@
+"""True negatives for deadline_discipline / hold_lock_while_blocking:
+every blocking site derives its bound from a sanctioned source — the
+deadline's remainder, a timeout-named config key, a min() clamp, a
+settimeout'd socket, a reviewed `# blocking: bounded-by` waiver — or
+sits off the request paths entirely (the background puller).
+"""
+
+import socket
+import threading
+import time
+import urllib.request
+from queue import Queue
+
+
+class BoundedHandler:
+    def __init__(self, config):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._work = Queue()
+        # guarded-by: _lock
+        self.served = 0
+        self.timeout_s = config.get_int("tsd.good.timeout_ms") / 1e3
+
+    def execute_http(self, peer, deadline):
+        self._fetch(peer, deadline)
+        self._probe(peer, deadline)
+        self._drain(deadline)
+        self._record()
+
+    def _fetch(self, peer, deadline):
+        # deadline-derived, clamped to the config bound: both sanctioned
+        timeout_s = min(self.timeout_s,
+                        max(deadline.remaining_ms() / 1e3, 0.05))
+        return urllib.request.urlopen(peer, timeout=timeout_s)
+
+    def _probe(self, peer, deadline):
+        sock = socket.create_connection((peer, 4242), self.timeout_s)
+        sock.settimeout(deadline.remaining_ms() / 1e3)
+        sock.sendall(b"ping")
+        sock.close()
+
+    def _drain(self, deadline):
+        if self._lock.acquire(timeout=self.timeout_s):
+            self._lock.release()
+        self._work.get(block=False)
+        self._work.put("tick", timeout=0.5)
+        # the sanctioned request-path sleep: parks on the cancellation
+        # token instead of time.sleep
+        deadline.wait_cancelled(self.timeout_s)
+        # a reviewed waiver the analyzer cannot see through
+        # blocking: bounded-by the chaos harness's own armed ms budget
+        time.sleep(0.01)
+        t = threading.Thread(target=self._record)
+        t.start()
+        t.join(self.timeout_s)
+
+    def _record(self):
+        with self._lock:
+            self.served += 1
+            # Condition.wait releases the lock while waiting — exempt
+            # from hold-lock-while-blocking; its timeout keeps it off
+            # blocking-unbounded
+            self._cond.wait(0.5)
+
+def background_pull(peer):
+    """Not reachable from any request entry: the puller cadence owns
+    its own schedule, so a plain config-free bound is acceptable here
+    and the analyzer must not flag it."""
+    return urllib.request.urlopen(peer)

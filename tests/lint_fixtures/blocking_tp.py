@@ -1,0 +1,63 @@
+"""True positives for the v4 pair: deadline_discipline
+(blocking-unbounded / blocking-sleep) and hold_lock_while_blocking.
+
+`execute_http` is the entry the analyzer keys on by naming convention;
+every helper below is reachable from it, so each marked site must fire
+exactly the named rule.  The `_fetch_race` shape pins the program-point
+property: the FIRST urlopen sits before the min() clamp (an early
+return crosses it unclamped) and reports, while the second — after the
+clamp — stays clean.
+"""
+
+import socket
+import subprocess
+import threading
+import time
+import urllib.request
+from queue import Queue
+
+
+class WedgeHandler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work = Queue()
+        # guarded-by: _lock
+        self.served = 0
+
+    def execute_http(self, peer, fast, timeout_s):
+        self._probe(peer)
+        self._drain()
+        self._spawn_and_wait()
+        body = self._fetch_race(peer, fast, timeout_s)
+        self._audit(peer)
+        return body
+
+    def _probe(self, peer):
+        sock = socket.create_connection((peer, 4242))  # EXPECT: blocking-unbounded
+        sock.sendall(b"ping")  # EXPECT: blocking-unbounded
+        time.sleep(0.05)  # EXPECT: blocking-sleep
+        sock.close()
+
+    def _drain(self):
+        self._lock.acquire()  # EXPECT: blocking-unbounded
+        self._lock.release()
+        self._work.get()  # EXPECT: blocking-unbounded
+        subprocess.run(["sync"])  # EXPECT: blocking-unbounded
+
+    def _spawn_and_wait(self):
+        t = threading.Thread(target=self._drain)
+        t.start()
+        t.join()  # EXPECT: blocking-unbounded
+
+    def _fetch_race(self, peer, fast, timeout_s):
+        if fast:
+            # the pre-clamp program point: timeout_s is still the
+            # caller's unvetted value here
+            return urllib.request.urlopen(peer, timeout=timeout_s)  # EXPECT: blocking-unbounded
+        timeout_s = min(timeout_s, 2.0)
+        return urllib.request.urlopen(peer, timeout=timeout_s)
+
+    def _audit(self, peer):
+        with self._lock:
+            self.served += 1
+            urllib.request.urlopen(peer, timeout=2.0)  # EXPECT: hold-lock-while-blocking

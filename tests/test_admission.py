@@ -117,6 +117,40 @@ class TestDeadline:
         with pytest.raises(QueryCancelledException):
             d.check()
 
+    def test_wait_cancelled_already_cancelled_returns_at_once(self):
+        d = Deadline(0)
+        d.cancel("client disconnected")
+        start = time.monotonic()
+        assert d.wait_cancelled(10.0) is True
+        assert time.monotonic() - start < 1.0
+
+    def test_wait_cancelled_serves_the_timeout_when_nothing_happens(self):
+        d = Deadline(0)                        # unbounded, never flipped
+        start = time.monotonic()
+        assert d.wait_cancelled(0.02) is False
+        assert time.monotonic() - start >= 0.015
+
+    def test_wait_cancelled_clamps_to_the_remaining_budget(self):
+        """Parking for 10s on a deadline with 30ms left must return
+        within the remainder, not the requested timeout."""
+        d = Deadline(30)
+        start = time.monotonic()
+        assert d.wait_cancelled(10.0) is False
+        assert time.monotonic() - start < 5.0
+
+    def test_wait_cancelled_wakes_on_cancel_from_another_thread(self):
+        """The cancellation-token contract the retry backoff and the
+        cluster probe loop build on: cancel() from the responder thread
+        releases a parked waiter within one tick, not after its full
+        timeout."""
+        d = Deadline(0)
+        t = threading.Timer(0.05, lambda: d.cancel("client disconnected"))
+        t.start()
+        start = time.monotonic()
+        assert d.wait_cancelled(10.0) is True
+        assert time.monotonic() - start < 5.0
+        t.join()
+
 
 class TestAmbientDeadline:
     def test_activate_deactivate(self):

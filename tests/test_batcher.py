@@ -268,6 +268,59 @@ class TestDeadlines:
             for a, b in zip(results[i], solos[i]):
                 assert np.array_equal(a, b, equal_nan=True)
 
+    def test_expired_leader_is_dropped_and_still_serves_followers(self):
+        """The race the concurrent test only sometimes lands on, pinned
+        deterministically: the EXPIRED member submits first and becomes
+        the bucket leader.  Winning the submit race must not outrank
+        the deadline — the leader dispatches for its live followers,
+        then raises its own 413/503 instead of serving an answer the
+        deadline already refused."""
+        rng = np.random.default_rng(6)
+        spec, wargs = spec_for("avg", False, 16)
+        members = [member_operands(rng, 2, 128, 16) for _ in range(3)]
+        dead = Deadline(timeout_ms=0.0001)
+        time.sleep(0.01)
+        assert dead.expired()
+        solos = [tuple(np.asarray(x) for x in run_group_pipeline(
+            spec, m[0], m[1], m[2], m[3], 1, wargs))
+            for m in members]
+        batcher = make_batcher(hold_ms=500)
+        epoch = mode_policy_epoch()
+        results = [None] * 3
+        infos = [None] * 3
+
+        def worker(i, dl):
+            ts, val, mask, gid = members[i]
+            try:
+                out, info = batcher.submit(spec, ts, val, mask, gid,
+                                           1, wargs, False, epoch, dl)
+                results[i] = tuple(np.asarray(x) for x in out)
+                infos[i] = info
+            except Exception as e:          # noqa: BLE001 — test capture
+                results[i] = e
+
+        # the dead member first, ALONE, so it owns the bucket as leader
+        t0 = threading.Thread(target=worker, args=(0, dead))
+        t0.start()
+        for _ in range(500):
+            with batcher._lock:
+                if batcher._buckets:
+                    break
+            time.sleep(0.002)
+        with batcher._lock:
+            assert batcher._buckets, "leader never opened a bucket"
+        rest = [threading.Thread(target=worker, args=(i, None))
+                for i in (1, 2)]
+        for t in rest:
+            t.start()
+        for t in [t0] + rest:
+            t.join(60)
+        assert isinstance(results[0], QueryException)
+        for i in (1, 2):
+            assert not isinstance(results[i], Exception), results[i]
+            for a, b in zip(results[i], solos[i]):
+                assert np.array_equal(a, b, equal_nan=True)
+
 
 # --------------------------------------------------------------------- #
 # Fair share (weighted DRR)                                             #

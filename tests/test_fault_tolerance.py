@@ -2,10 +2,16 @@
 (utils/retry.py), circuit breakers (tsd/cluster.py), the fault-injection
 registry (utils/faults.py), and the per-append WAL fsync opt-in.
 
-Everything here is clock-injected — no wall-clock sleeps."""
+Everything here is clock-injected — no wall-clock sleeps — except the
+cancellation classes (TestCancellableBackoff, TestProbeWaitCancellation),
+which exist precisely to prove a real park releases early: they size the
+would-be sleeps in tens of seconds so a regression to ``time.sleep``
+shows up as a conspicuous hang, not flake."""
 
 import json
 import os
+import threading
+import time
 
 import pytest
 
@@ -134,6 +140,159 @@ class TestRetry:
         with pytest.raises(KeyError):
             self._call(fn, policy, retry_on=(OSError,))
         assert len(calls) == 1
+
+
+class TestCancellableBackoff:
+    """The default backoff sleep (retry._cancellable_sleep) parks on the
+    request Deadline's cancellation token.  No injected ``sleep`` here —
+    these tests run the production path: a 30s backoff that a cancel()
+    at ~50ms must release within a tick, raising through Deadline.check
+    so no further attempt is scheduled."""
+
+    def _slow_policy(self):
+        # first attempt fails -> 30s backoff is scheduled (rand pinned
+        # to 1.0); budget_s is large so the `remaining - delay <
+        # min_attempt_s` guard doesn't skip the sleep we want to test
+        return RetryPolicy(max_attempts=3, budget_s=120.0,
+                           base_delay_s=30.0, max_delay_s=30.0)
+
+    def _fail(self, timeout_s):
+        raise OSError("peer down")
+
+    def test_cancel_mid_backoff_releases_within_a_tick(self):
+        from opentsdb_tpu.query.limits import (Deadline,
+                                               QueryCancelledException)
+        dl = Deadline()                       # unbounded but cancellable
+        timer = threading.Timer(
+            0.05, lambda: dl.cancel("client disconnected"))
+        timer.start()
+        start = time.monotonic()
+        try:
+            with pytest.raises(QueryCancelledException,
+                               match="client disconnected"):
+                call_with_retries(self._fail, self._slow_policy(),
+                                  rand=lambda: 1.0, deadline=dl)
+        finally:
+            timer.cancel()
+        assert time.monotonic() - start < 5.0
+
+    def test_ambient_deadline_is_picked_up_at_sleep_time(self):
+        """Pool threads pass ``deadline`` explicitly; responder-thread
+        callers rely on the TLS pickup inside _cancellable_sleep."""
+        from opentsdb_tpu.query.limits import (Deadline, activate_deadline,
+                                               deactivate_deadline,
+                                               QueryCancelledException)
+        dl = Deadline()
+        activate_deadline(dl)
+        timer = threading.Timer(0.05, lambda: dl.cancel("drain"))
+        timer.start()
+        start = time.monotonic()
+        try:
+            with pytest.raises(QueryCancelledException, match="drain"):
+                call_with_retries(self._fail, self._slow_policy(),
+                                  rand=lambda: 1.0)
+        finally:
+            timer.cancel()
+            deactivate_deadline()
+        assert time.monotonic() - start < 5.0
+
+    def test_no_deadline_anywhere_still_backs_off_and_recovers(self):
+        """Library callers outside any request keep plain time.sleep."""
+        calls = []
+
+        def fn(timeout_s):
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("flake")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=3, budget_s=10.0,
+                             base_delay_s=0.01, max_delay_s=0.01)
+        assert call_with_retries(fn, policy, rand=lambda: 1.0) == "ok"
+        assert len(calls) == 2
+
+
+class TestProbeWaitCancellation:
+    """The half-open probe wait in cluster._guarded_fetch_inner parks on
+    the deadline token tick-by-tick: a cancelled request must stop
+    awaiting a sibling probe's verdict within ~one tick instead of
+    polling out the whole fetch budget."""
+
+    def test_cancelled_deadline_releases_the_probe_wait(self):
+        from opentsdb_tpu.query.limits import (Deadline,
+                                               QueryCancelledException)
+        from opentsdb_tpu.tsd.cluster import (ClusterState,
+                                              _guarded_fetch_inner)
+        from opentsdb_tpu.utils.config import Config
+        state = ClusterState(Config({}))
+        b = state.breaker("peer:4242")
+        b.state = b.HALF_OPEN
+        b._probing = True                     # a sibling probe in flight
+        dl = Deadline()
+        policy = RetryPolicy(max_attempts=1, budget_s=30.0)
+        timer = threading.Timer(
+            0.05, lambda: dl.cancel("client disconnected"))
+        timer.start()
+        start = time.monotonic()
+        try:
+            with pytest.raises(QueryCancelledException,
+                               match="client disconnected"):
+                _guarded_fetch_inner(state, policy, "peer:4242", {},
+                                     None, None, dl)
+        finally:
+            timer.cancel()
+        assert time.monotonic() - start < 5.0
+
+
+class TestReplicationTimeoutClamp:
+    """_request_timeout_s bounds every synchronous replication HTTP call
+    by the ambient request deadline's remainder — the clamp the lint
+    gut-pin (tests/test_lint_analyzers.py) proves the tree cannot lose."""
+
+    def _mgr(self, ship_timeout_s=5.0):
+        from opentsdb_tpu.tsd.replication import ReplicationManager
+        mgr = ReplicationManager.__new__(ReplicationManager)
+        mgr.ship_timeout_s = ship_timeout_s
+        return mgr
+
+    def test_no_ambient_deadline_keeps_the_config_bound(self):
+        assert self._mgr()._request_timeout_s() == pytest.approx(5.0)
+
+    def test_unbounded_ambient_deadline_keeps_the_config_bound(self):
+        from opentsdb_tpu.query.limits import (Deadline, activate_deadline,
+                                               deactivate_deadline)
+        activate_deadline(Deadline())
+        try:
+            t = self._mgr()._request_timeout_s()
+        finally:
+            deactivate_deadline()
+        assert t == pytest.approx(5.0)
+
+    def test_bounded_deadline_clamps_the_ship_timeout(self):
+        from opentsdb_tpu.query.limits import (Deadline, activate_deadline,
+                                               deactivate_deadline)
+        activate_deadline(Deadline(timeout_ms=200.0))
+        try:
+            t = self._mgr()._request_timeout_s()
+        finally:
+            deactivate_deadline()
+        assert 0.05 <= t <= 0.2
+
+    def test_expired_deadline_floors_at_a_usable_minimum(self):
+        """The remainder can go negative mid-request; the timeout never
+        does — urlopen(timeout<=0) would raise, turning a late ship
+        into a spurious error instead of a fast bounded one."""
+        from opentsdb_tpu.query.limits import (Deadline, activate_deadline,
+                                               deactivate_deadline)
+        clock = FakeClock()
+        dl = Deadline(timeout_ms=10.0, clock=clock)
+        clock.now += 1.0                      # 990ms past the budget
+        activate_deadline(dl)
+        try:
+            t = self._mgr()._request_timeout_s()
+        finally:
+            deactivate_deadline()
+        assert t == pytest.approx(0.05)
 
 
 class TestCircuitBreakerUnit:

@@ -32,6 +32,7 @@ FIXTURE_SCHEMA = {
     "tsd.good.flag": "bool",    # tsdblint: disable=config-unknown-key
     "tsd.good.count": "int",    # tsdblint: disable=config-unknown-key
     "tsd.good.name": "str",     # tsdblint: disable=config-unknown-key
+    "tsd.good.timeout_ms": "int",   # tsdblint: disable=config-unknown-key
 }
 
 # the miniature metrics schema the metrics fixtures are written against
@@ -67,6 +68,7 @@ def _lint_fixture(name: str) -> list:
     ctx.bucket("taint")["sink_paths"] = ("tests/lint_fixtures/",)
     ctx.bucket("shape")["paths"] = ("tests/lint_fixtures/",)
     ctx.bucket("leak")["paths"] = ("tests/lint_fixtures/",)
+    ctx.bucket("blocking")["paths"] = ("tests/lint_fixtures/",)
     path = os.path.join(FIXTURES, name)
     return run_lint([path], root=REPO, ctx=ctx)
 
@@ -75,12 +77,12 @@ TRUE_POSITIVE = ["jax_tp.py", "lock_tp.py", "config_tp.py", "except_tp.py",
                  "shape_tp.py", "taint_tp.py", "leak_tp.py",
                  "cache_tp.py", "install_tp.py", "span_tp.py",
                  "metrics_tp.py", "flightrec_tp.py", "explain_tp.py",
-                 "batcher_tp.py"]
+                 "batcher_tp.py", "blocking_tp.py"]
 TRUE_NEGATIVE = ["jax_tn.py", "lock_tn.py", "config_tn.py", "except_tn.py",
                  "shape_tn.py", "taint_tn.py", "leak_tn.py",
                  "cache_tn.py", "install_tn.py", "span_tn.py",
                  "metrics_tn.py", "flightrec_tn.py", "explain_tn.py",
-                 "batcher_tn.py"]
+                 "batcher_tn.py", "blocking_tn.py"]
 
 
 @pytest.mark.parametrize("name", TRUE_POSITIVE)
@@ -453,8 +455,66 @@ def test_gutting_set_hysteresis_cache_clear_fails_the_tree(tmp_path):
                                               for f in findings))
 
 
+def test_removing_the_deadline_clamp_fails_the_tree(tmp_path):
+    """The deadline_discipline analyzer's load-bearing checks, pinned on
+    the two routes this PR bounded:
+
+    (a) deleting the remainder clamp in cluster._fetch_peer — THE line
+        that keeps a fan-out peer fetch inside the coordinator's
+        deadline — must turn the urlopen below it into a
+        blocking-unbounded finding;
+    (b) stripping the `timeout=self._request_timeout_s()` kwarg from
+        replication's urlopen calls must flag the ack-path ship
+        (on_committed -> _ship) the same way.
+
+    If this test fails, the analyzer has gone blind to the exact
+    regression it exists to catch."""
+    import shutil
+    from tools.lint import blocking
+
+    # (a) gut the peer-fetch clamp
+    dst = tmp_path / "a" / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    cl = dst / "tsd" / "cluster.py"
+    src = cl.read_text()
+    needle = ("            timeout_s = min(timeout_s, "
+              "max(remaining / 1e3, 0.05))\n")
+    assert src.count(needle) == 1, \
+        "expected exactly one remainder clamp in _fetch_peer"
+    cl.write_text(src.replace(needle, ""))
+    ctx = LintContext(str(tmp_path / "a"))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path / "a"),
+                        analyzers=[blocking.DEADLINE_ANALYZER], ctx=ctx)
+    hits = [f for f in findings if f.rule == "blocking-unbounded"
+            and f.path == "opentsdb_tpu/tsd/cluster.py"
+            and "_fetch_peer" in f.message]
+    assert hits, ("un-clamping the peer fetch went undetected:\n"
+                  + "\n".join(f.render() for f in findings))
+
+    # (b) strip the replication request-timeout kwarg
+    dst = tmp_path / "b" / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    rp = dst / "tsd" / "replication.py"
+    src = rp.read_text()
+    needle = ", timeout=self._request_timeout_s()"
+    assert src.count(needle) >= 4, \
+        "every replication urlopen should clamp through the helper"
+    rp.write_text(src.replace(needle, ""))
+    ctx = LintContext(str(tmp_path / "b"))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path / "b"),
+                        analyzers=[blocking.DEADLINE_ANALYZER], ctx=ctx)
+    ship = [f for f in findings if f.rule == "blocking-unbounded"
+            and f.path == "opentsdb_tpu/tsd/replication.py"
+            and "_ship" in f.message]
+    assert ship, ("un-bounding the ack-path ship went undetected:\n"
+                  + "\n".join(f.render() for f in findings))
+    assert any("on_committed" in f.message for f in ship), (
+        "the ship should be attributed to the on_committed ack route:\n"
+        + "\n".join(f.render() for f in ship))
+
+
 def test_full_tree_lint_stays_under_the_tier1_budget():
-    """All nine analyzers over the package in under 30s — the bound
+    """All eleven analyzers over the package in under 30s — the bound
     that keeps tsdblint viable inside tier-1 (and the pre-commit hook
     tolerable).  The interprocedural fixpoints dominate; if this starts
     failing, parallelize the per-file check phase before relaxing the
@@ -481,6 +541,7 @@ def test_dead_key_fires_despite_own_declaration_literal(tmp_path):
     reader = tmp_path / "reader.py"
     reader.write_text(
         'def read(config):\n'
+        '    config.get_int("tsd.good.timeout_ms")\n'
         '    return config.get_bool("tsd.good.flag")\n')
     ctx = LintContext(str(tmp_path))
     ctx.bucket("config")["schema"] = dict(FIXTURE_SCHEMA)

@@ -17,6 +17,11 @@ empty.  The corpus seeds one deliberate bug per detector:
                                  manager's shapes: ship-ack vs puller
                                  position race (tsd/replication.py)
 
+The blocked-past-deadline watcher (deadlock.record_blocked_wait /
+report_blocked_past_deadline) is staged inline rather than from file
+fixtures: its inputs are real contended acquires under an ambient
+request Deadline, which a test thread pair produces directly.
+
 CPU-only (conftest pins JAX_PLATFORMS=cpu); nothing here touches mesh
 or shard_map paths, which fail at HEAD in this environment.
 
@@ -314,6 +319,137 @@ class TestDeadlockWatcher:
             left._lock.release()
         rules = {f.rule for f in REPORTER.raw_findings()}
         assert "san-deadlock" in rules, rules
+
+
+# --------------------------------------------------------------------- #
+# Blocked-past-deadline watcher (ISSUE 17 satellite)                    #
+# --------------------------------------------------------------------- #
+
+class TestBlockedPastDeadline:
+    """A blocked instrumented acquire whose wait outlasts the ambient
+    request Deadline's remainder must surface as a note-level
+    san-blocked-past-deadline finding, cross-referenced against
+    deadline_discipline's static request-path set and tagged by any
+    `# blocking: bounded-by` waiver on the acquire line."""
+
+    def _stage(self, lock, do_acquire, timeout_ms=10.0, hold_s=0.1):
+        """Contend `lock`: a holder thread owns it for `hold_s` while
+        the calling thread runs `do_acquire()` under a bounded ambient
+        Deadline that expires mid-wait."""
+        import time
+        from opentsdb_tpu.query.limits import (Deadline,
+                                               activate_deadline,
+                                               deactivate_deadline)
+        held = threading.Event()
+
+        def holder():
+            lock.acquire()
+            held.set()
+            time.sleep(hold_s)
+            lock.release()
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert held.wait(2)
+        activate_deadline(Deadline(timeout_ms=timeout_ms))
+        try:
+            got = do_acquire()
+        finally:
+            deactivate_deadline()
+        assert got, "the holder never released within the timeout"
+        lock.release()
+        t.join()
+
+    def test_blocked_acquire_past_deadline_reports_note(self, san):
+        from tools.sanitize.locks import SanLock
+        from tools.sanitize.report import SanReporter, rule_level
+        lock = SanLock()
+        lock.label = ("BlockedFixture", "_lock")
+        self._stage(lock, lambda: lock.acquire(timeout=2.0))
+        events = deadlock.blocked_waits()
+        assert len(events) == 1, events
+        (path, line, func, name), waited = next(iter(events.items()))
+        assert path == "tests/test_sanitizer.py"
+        assert name == "BlockedFixture._lock"
+        assert waited >= 0.01
+        # not on any static request path -> the lint-gap-shaped tag
+        rep = SanReporter()
+        emitted = deadlock.report_blocked_past_deadline(
+            reporter=rep, static_paths=set())
+        assert emitted == [(path, line, func, name)]
+        (f,) = rep.raw_findings()
+        assert f.rule == "san-blocked-past-deadline"
+        assert rule_level(f.rule) == "note"
+        assert "NOT in the static request-path set" in f.message
+        # the same event against a static set that covers the site
+        rep2 = SanReporter()
+        deadlock.report_blocked_past_deadline(
+            reporter=rep2, static_paths={(path, func)})
+        (f2,) = rep2.raw_findings()
+        assert "static request-path set — the route is covered" \
+            in f2.message
+
+    def test_waived_acquire_reports_the_bounded_by_reason(self, san):
+        from tools.sanitize.locks import SanLock
+        from tools.sanitize.report import SanReporter
+        lock = SanLock()
+        self._stage(
+            lock,
+            lambda: lock.acquire(timeout=2.0))  # blocking: bounded-by test hold window
+        rep = SanReporter()
+        deadlock.report_blocked_past_deadline(reporter=rep,
+                                              static_paths=set())
+        (f,) = rep.raw_findings()
+        assert "bounded-by test hold window" in f.message
+        assert "an unlabeled Lock" in f.message
+
+    def test_unexpired_deadline_records_nothing(self, san):
+        from tools.sanitize.locks import SanLock
+        lock = SanLock()
+        # a 10s budget comfortably outlives the 100ms hold
+        self._stage(lock, lambda: lock.acquire(timeout=2.0),
+                    timeout_ms=10_000.0)
+        assert deadlock.blocked_waits() == {}
+        assert deadlock.report_blocked_past_deadline() == []
+
+    def test_no_ambient_deadline_records_nothing(self, san):
+        import time
+        from tools.sanitize.locks import SanLock
+        lock = SanLock()
+        held = threading.Event()
+
+        def holder():
+            lock.acquire()
+            held.set()
+            time.sleep(0.05)
+            lock.release()
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert held.wait(2)
+        assert lock.acquire(timeout=2.0)
+        lock.release()
+        t.join()
+        assert deadlock.blocked_waits() == {}
+
+    def test_snapshot_restore_round_trips_blocked_waits(self, san):
+        key = ("x.py", 12, "f", "C._lock")
+        with deadlock._state_lock:
+            deadlock._blocked_waits[key] = 0.25
+        snap = deadlock.snapshot_state()
+        deadlock.reset()
+        assert deadlock.blocked_waits() == {}
+        deadlock.restore_state(snap)
+        assert deadlock.blocked_waits() == {key: 0.25}
+
+    def test_static_request_path_set_is_cached_and_plausible(self, san):
+        a = deadlock.static_request_paths_cached()
+        b = deadlock.static_request_paths_cached()
+        assert a is b, "second call must reuse the cached set"
+        # the fan-out fetch and the ack-path ship are the two routes the
+        # lint gut-pin tests un-bound; both must be in the static set
+        assert ("opentsdb_tpu/tsd/cluster.py", "_fetch_peer") in a
+        assert ("opentsdb_tpu/tsd/replication.py", "_ship") in a
 
 
 # --------------------------------------------------------------------- #

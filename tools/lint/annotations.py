@@ -62,6 +62,29 @@ INSTALL_ANN = re.compile(
     r"#\s*global-install(?::\s*([A-Za-z_][A-Za-z0-9_.]*))?"
     r"\s+paired-with:\s*([A-Za-z_][A-Za-z0-9_.]*)")
 
+# --------------------------------------------------------------------- #
+# Blocking-call grammar (tools/lint/blocking.py)                        #
+#                                                                       #
+#   # blocking: bounded-by <reason>                                     #
+#       Declares the blocking call on this line (or the line below a    #
+#       standalone comment) as deliberately bounded by something the    #
+#       analyzer cannot see — a fault-injection latency spec, a         #
+#       maintenance thread that owns its own cadence, an OS-level       #
+#       socket default set elsewhere.  <reason> is free text but must   #
+#       be non-empty: the annotation is a reviewed waiver, and a bare   #
+#       "# blocking: bounded-by" that justifies nothing stays a        #
+#       finding.  The same grammar is read at runtime by tsdbsan's      #
+#       blocked-past-deadline watcher to tag waived sites.              #
+# --------------------------------------------------------------------- #
+
+BLOCKING_ANN = re.compile(r"#\s*blocking:\s*bounded-by\s+(\S.*)")
+
+
+def blocking_annotation(line: str) -> str | None:
+    """The bounded-by reason from one source line, or None."""
+    m = BLOCKING_ANN.search(line)
+    return m.group(1).strip() if m else None
+
 
 def cache_annotation(line: str) -> tuple[str, str] | None:
     """(cache name, invalidator func or 'none') from one source line."""

@@ -1,0 +1,717 @@
+"""Deadline & blocking-call discipline: request-path liveness analysis.
+
+PR 8 gave every request one ``Deadline``; PR 15 put a synchronous
+network ship on the ingest ack path.  The contract that makes those
+safe is a liveness property no test can pin exhaustively: a blocking
+primitive reachable from a request-serving entry point must derive its
+bound from the deadline's remainder (or a config timeout key, or a
+``min()`` clamp over one of those), and must never block while holding
+a lock another request contends.  Two analyzers enforce it over the
+PR 3 call graph:
+
+  deadline_discipline
+    blocking-unbounded   a cataloged blocking primitive (HTTP client
+                         call, socket connect/recv without settimeout,
+                         lock.acquire() with no timeout, blocking
+                         queue.get/put, unbounded Thread.join, Event/
+                         Condition wait without timeout, subprocess
+                         wait) reachable from a request-serving entry
+                         point whose bound does NOT evaluate to a
+                         sanctioned source.
+    blocking-sleep       `time.sleep` on a request path — even a short
+                         constant sleep cannot observe the deadline's
+                         cancellation token; use
+                         `Deadline.wait_cancelled` or a bounded
+                         condition wait instead.
+
+  hold_lock_while_blocking
+    hold-lock-while-blocking   a cataloged blocking call executed
+                         inside `with self.<lock>:` where <lock> is
+                         named by at least one `# guarded-by:`
+                         annotation, on a request path — the class of
+                         bug where one wedged peer freezes every
+                         request contending the same lock.
+                         `Condition.wait` is exempt (it releases the
+                         lock while waiting).
+
+Sanctioned bound sources (recognition mirrors taint's sanitizers —
+optimistic: a site is clean when ANY assignment path bounds it, and
+the statement walk is resource_leak-style so an early return that
+crosses the site BEFORE the clamp still reports):
+
+  * a numeric literal or module-level numeric constant
+  * `deadline.remaining_ms()` / `.remaining` — deadline-derived
+  * a config getter whose key names a timeout-ish quantity
+    (`cfg.get_int("tsd.replication.ship_timeout_ms")`)
+  * an instance attribute initialized from one of the above
+  * `min(...)` with at least one bounded arm; `max(...)`/arithmetic
+    over all-bounded operands; a repo function whose every return
+    evaluates bounded
+
+Justified sites the analyzer cannot see through carry a
+`# blocking: bounded-by <reason>` annotation (grammar shared with
+tsdbsan in tools/lint/annotations.py); suppressions, SARIF, baseline
+and --changed-only all inherit from the runner.
+
+Entry points — the request-serving surface: any method named like an
+rpc handler (`execute_http`, `handle_telnet`, ...), everything in the
+planner/batcher/cluster/admission modules, and the replication
+ship-before-ack route (`on_committed` / `ingest_bulk` /
+`route_point`).  The puller/catch-up side of replication is a
+background cadence, not a request path.  Fixture/test scopes override
+all of these through `ctx.bucket("blocking")`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.lint.annotations import (ClassAnnotations, blocking_annotation,
+                                    scan_class_annotations,
+                                    self_attr as _self_attr)
+from tools.lint.callgraph import get_callgraph, module_name
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_UNBOUNDED = "blocking-unbounded"
+RULE_SLEEP = "blocking-sleep"
+RULE_HOLD = "hold-lock-while-blocking"
+
+BLOCKING_DIRS = ("opentsdb_tpu/",)
+
+# Request-serving entry points, three ways (all bucket-overridable):
+# by method NAME (rpc dispatch `handler.execute_http(...)` is beyond
+# devirtualization — too many implementers — so the handler surface is
+# identified by its naming convention), by whole-module prefix, and by
+# exact qname for the replication ack route.
+ENTRY_METHODS = frozenset({
+    "execute_http", "execute_telnet", "execute_telnet_batch",
+    "handle_http", "handle_telnet", "handle_telnet_batch",
+})
+ENTRY_PREFIXES = (
+    "opentsdb_tpu.query.planner.",
+    "opentsdb_tpu.query.batcher.",
+    "opentsdb_tpu.tsd.cluster.",
+    "opentsdb_tpu.tsd.admission.",
+)
+ENTRY_QNAMES = frozenset({
+    "opentsdb_tpu.tsd.replication.ReplicationManager.route_point",
+    "opentsdb_tpu.tsd.replication.ReplicationManager.ingest_bulk",
+    "opentsdb_tpu.tsd.replication.ReplicationManager.on_committed",
+})
+
+# Receiver constructor name -> blocking-relevant type tag.
+_CTOR_TAGS = {
+    "Lock": "lock", "RLock": "lock", "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "Queue": "queue", "SimpleQueue": "queue", "LifoQueue": "queue",
+    "PriorityQueue": "queue", "JoinableQueue": "queue",
+    "Thread": "thread", "Timer": "thread",
+    "Condition": "condition", "Event": "event", "Barrier": "event",
+    "Popen": "popen",
+    "socket": "socket", "create_connection": "socket",
+}
+
+# Socket methods that block on the peer once connected.
+_SOCKET_BLOCKERS = frozenset({"connect", "recv", "recv_into", "sendall",
+                              "send", "accept", "makefile", "recvfrom"})
+
+# Deadline-derived bound methods (opentsdb_tpu/query/limits.py).
+_DEADLINE_METHODS = frozenset({"remaining_ms", "remaining_s", "remaining",
+                               "wait_cancelled"})
+
+# Config keys that name a wall-clock quantity.  A getter call with a
+# matching literal key is a sanctioned bound source (the config schema
+# analyzer separately guarantees the key exists).
+_TIMEOUT_KEY = re.compile(
+    r"timeout|interval|deadline|budget|delay|tick|ttl|period|_ms$|_s$")
+_CONFIG_GETTERS = frozenset({"get_int", "get_float"})
+
+_SLEEP_HINT = ("it cannot observe the request deadline's cancellation "
+               "token; use Deadline.wait_cancelled / a bounded condition "
+               "wait, or annotate '# blocking: bounded-by <reason>'")
+_UNBOUNDED_HINT = ("derives no bound from the deadline's remainder, a "
+                   "config timeout key, or a min() clamp; pass a bounded "
+                   "timeout or annotate '# blocking: bounded-by <reason>'")
+
+
+class _Site:
+    """One cataloged blocking call: where, what, how bounded."""
+
+    __slots__ = ("line", "kind", "label", "bounded", "held", "annotated")
+
+    def __init__(self, line: int, kind: str, label: str, bounded: bool,
+                 held: frozenset, annotated: bool):
+        self.line = line
+        self.kind = kind            # sleep | http | socket | lock | ...
+        self.label = label          # human label for the message
+        self.bounded = bounded
+        self.held = held            # lock attrs held at the call
+        self.annotated = annotated
+
+
+class _FnScan:
+    """Blocking sites + outgoing call edges of one function, collected
+    by a resource_leak-style statement walk: the bound environment at
+    each site is the one at that PROGRAM POINT, so an early return past
+    the clamp leaves the pre-clamp (unbounded) verdict in place."""
+
+    def __init__(self, fi, src: SourceFile, analysis: "_Analysis",
+                 cls: ClassAnnotations | None, is_thread_class: bool):
+        self.fi = fi
+        self.src = src
+        self.an = analysis
+        self.cls = cls
+        self.is_thread_class = is_thread_class
+        self.sites: list[_Site] = []
+        self.callees: set[str] = set()
+        self.env: dict[str, bool] = {}       # local name -> bounded
+        self.local_types: dict[str, str] = {}  # local name -> type tag
+        self.sock_timeout: set[str] = set()  # socket names settimeout'd
+
+    # -- entry ------------------------------------------------------------
+
+    def run(self) -> None:
+        self._walk(self.fi.node.body, frozenset())
+
+    # -- receiver typing --------------------------------------------------
+
+    def _recv_tag(self, expr) -> str | None:
+        if isinstance(expr, ast.Name):
+            return self.local_types.get(expr.id)
+        attr = _self_attr(expr)
+        if attr is not None and self.cls is not None:
+            if attr in self.cls.locks:
+                return "lock"
+            ctor = self.cls.attr_types.get(attr)
+            if ctor is not None:
+                return _CTOR_TAGS.get(ctor)
+        return None
+
+    @staticmethod
+    def _ctor_tag(expr) -> str | None:
+        if not isinstance(expr, ast.Call):
+            return None
+        f = expr.func
+        name = f.id if isinstance(f, ast.Name) else \
+            f.attr if isinstance(f, ast.Attribute) else None
+        return _CTOR_TAGS.get(name) if name else None
+
+    # -- bound evaluation -------------------------------------------------
+
+    def _bounded(self, expr) -> bool:
+        return self.an.eval_bound(expr, self.env, self.cls, self.fi)
+
+    def _arg(self, call: ast.Call, kw: str, pos: int | None):
+        for k in call.keywords:
+            if k.arg == kw:
+                return k.value
+        if pos is not None and len(call.args) > pos:
+            a = call.args[pos]
+            return a.value if isinstance(a, ast.Starred) else a
+        return None
+
+    @staticmethod
+    def _is_false(expr) -> bool:
+        return isinstance(expr, ast.Constant) and expr.value is False
+
+    # -- the catalog ------------------------------------------------------
+
+    def _match(self, call: ast.Call, held: frozenset) -> None:
+        f = call.func
+        mod = self.an.graph.modules.get(self.fi.module)
+        imports = mod.imports if mod is not None else {}
+        kind = label = None
+        bound = None          # the timeout expression, if any
+        nonblocking = False
+        if isinstance(f, ast.Name):
+            tgt = imports.get(f.id, "")
+            if f.id == "sleep" and tgt == "time.sleep":
+                kind, label = "sleep", "time.sleep"
+            elif f.id == "urlopen" or tgt.endswith(".urlopen"):
+                kind, label = "http", "HTTP call"
+                bound = self._arg(call, "timeout", 2)
+            elif f.id == "create_connection" \
+                    or tgt == "socket.create_connection":
+                kind, label = "socket", "socket connect"
+                bound = self._arg(call, "timeout", 1)
+        elif isinstance(f, ast.Attribute):
+            base = f.value
+            dotted = None
+            if isinstance(base, ast.Name):
+                dotted = imports.get(base.id, base.id)
+            elif isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name):
+                dotted = "%s.%s" % (base.value.id, base.attr)
+            if dotted == "time" and f.attr == "sleep":
+                kind, label = "sleep", "time.sleep"
+            elif f.attr == "urlopen" and dotted in (
+                    "urllib.request", "request", "urllib2"):
+                kind, label = "http", "HTTP call"
+                bound = self._arg(call, "timeout", 2)
+            elif dotted == "socket" and f.attr == "create_connection":
+                kind, label = "socket", "socket connect"
+                bound = self._arg(call, "timeout", 1)
+            elif dotted == "subprocess" and f.attr in (
+                    "run", "call", "check_call", "check_output"):
+                kind, label = "subprocess", "subprocess %s" % f.attr
+                bound = self._arg(call, "timeout", None)
+            else:
+                tag = self._recv_tag(base)
+                if tag == "socket" and f.attr == "settimeout":
+                    a = self._arg(call, "value", 0)
+                    if isinstance(base, ast.Name) and a is not None \
+                            and self._bounded(a):
+                        self.sock_timeout.add(base.id)
+                    return
+                if tag == "socket" and f.attr in _SOCKET_BLOCKERS:
+                    kind, label = "socket", "socket.%s" % f.attr
+                    name = base.id if isinstance(base, ast.Name) else None
+                    if name in self.sock_timeout:
+                        bound = ast.Constant(value=1)    # settimeout'd
+                elif tag == "lock" and f.attr == "acquire":
+                    kind, label = "lock", "lock.acquire"
+                    blocking = self._arg(call, "blocking", 0)
+                    if blocking is not None and self._is_false(blocking):
+                        nonblocking = True
+                    bound = self._arg(call, "timeout", 1)
+                elif tag == "queue" and f.attr == "get":
+                    kind, label = "queue", "queue.get"
+                    blk = self._arg(call, "block", 0)
+                    if blk is not None and self._is_false(blk):
+                        nonblocking = True
+                    bound = self._arg(call, "timeout", 1)
+                elif tag == "queue" and f.attr == "put":
+                    kind, label = "queue", "queue.put"
+                    blk = self._arg(call, "block", 1)
+                    if blk is not None and self._is_false(blk):
+                        nonblocking = True
+                    bound = self._arg(call, "timeout", 2)
+                elif tag == "thread" and f.attr == "join":
+                    kind, label = "thread", "Thread.join"
+                    bound = self._arg(call, "timeout", 0)
+                elif tag == "condition" and f.attr in ("wait", "wait_for"):
+                    kind, label = "condition", "Condition.%s" % f.attr
+                    bound = self._arg(call, "timeout",
+                                      0 if f.attr == "wait" else 1)
+                elif tag == "event" and f.attr == "wait":
+                    kind, label = "event", "Event.wait"
+                    bound = self._arg(call, "timeout", 0)
+                elif tag == "popen" and f.attr in ("wait", "communicate"):
+                    kind, label = "popen", "Popen.%s" % f.attr
+                    bound = self._arg(call, "timeout",
+                                      0 if f.attr == "wait" else 1)
+                elif self.is_thread_class and f.attr == "join" \
+                        and isinstance(base, ast.Name) \
+                        and base.id == "self":
+                    kind, label = "thread", "Thread.join"
+                    bound = self._arg(call, "timeout", 0)
+        if kind is None or nonblocking:
+            return
+        bounded = bound is not None and self._bounded(bound)
+        line = call.lineno
+        ann = (blocking_annotation(self.src.lines[line - 1])
+               if line <= len(self.src.lines) else None)
+        if ann is None and line >= 2:
+            ann = blocking_annotation(self.src.lines[line - 2])
+        self.sites.append(_Site(line, kind, label, bounded, held,
+                                ann is not None))
+
+    # -- call edges -------------------------------------------------------
+
+    def _edges(self, call: ast.Call) -> None:
+        recv_types = None
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            attr = _self_attr(f.value)
+            if attr is not None and self.cls is not None:
+                t = self.cls.attr_types.get(attr)
+                if t is not None:
+                    recv_types = {t}
+        for info, _ctor, _cls in self.an.graph.resolve(
+                call, self.fi, recv_types=recv_types):
+            if info is not None and ".<nested>." not in info.qname:
+                self.callees.add(info.qname)
+
+    # -- statement walk ---------------------------------------------------
+
+    def _scan_expr(self, node, held: frozenset) -> None:
+        """Catalog + edges over every call in an expression/leaf stmt."""
+        if node is None:
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._match(sub, held)
+                self._edges(sub)
+
+    def _walk(self, stmts, held: frozenset) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a closure: its body runs later, but its call sites
+                # belong to this function's request path (it is handed
+                # to call_with_retries / an executor and invoked on
+                # behalf of this request).  Fresh locals, no held locks.
+                saved = (self.env, self.local_types, self.sock_timeout)
+                self.env, self.local_types = dict(self.env), dict(
+                    self.local_types)
+                self.sock_timeout = set(self.sock_timeout)
+                self._walk(st.body, frozenset())
+                self.env, self.local_types, self.sock_timeout = saved
+                continue
+            if isinstance(st, ast.ClassDef):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in st.items:
+                    self._scan_expr(item.context_expr, held)
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and self.cls is not None \
+                            and attr in self.cls.locks:
+                        acquired.add(attr)
+                self._walk(st.body, held | frozenset(acquired))
+                continue
+            if isinstance(st, ast.If):
+                self._scan_expr(st.test, held)
+                before = dict(self.env)
+                self._walk(st.body, held)
+                after_body = self.env
+                self.env = dict(before)
+                self._walk(st.orelse, held)
+                # optimistic join: one bounding path sanctions the name
+                for name, ok in after_body.items():
+                    if ok:
+                        self.env[name] = True
+                continue
+            if isinstance(st, (ast.While, ast.For)):
+                self._scan_expr(getattr(st, "test", None), held)
+                self._scan_expr(getattr(st, "iter", None), held)
+                self._walk(st.body, held)
+                self._walk(st.orelse, held)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk(st.body, held)
+                for h in st.handlers:
+                    self._walk(h.body, held)
+                self._walk(st.orelse, held)
+                self._walk(st.finalbody, held)
+                continue
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                self._scan_expr(st.value, held)
+                name = st.targets[0].id
+                tag = self._ctor_tag(st.value)
+                if tag is not None:
+                    self.local_types[name] = tag
+                self.env[name] = self._bounded(st.value)
+                continue
+            self._scan_expr(st, held)
+
+
+class _Analysis:
+    """The shared whole-program pass both analyzers read."""
+
+    def __init__(self, ctx: LintContext):
+        bucket = ctx.bucket("blocking")
+        self.graph = get_callgraph(ctx)
+        self.dirs = tuple(bucket.get("paths", BLOCKING_DIRS))
+        self.entry_methods = frozenset(
+            bucket.get("entry_methods", ENTRY_METHODS))
+        self.entry_prefixes = tuple(
+            bucket.get("entry_prefixes", ENTRY_PREFIXES))
+        self.entry_qnames = frozenset(
+            bucket.get("entry_qnames", ENTRY_QNAMES))
+        self.module_consts: dict[str, dict[str, bool]] = {}
+        self.attr_bounds: dict[tuple[str, str], dict[str, bool]] = {}
+        self.classes: dict[tuple[str, str], ClassAnnotations] = {}
+        self.scans: dict[str, _FnScan] = {}
+        self.fn_summary: dict[str, bool] = {}   # qname -> returns bounded
+        self._summarizing: set[str] = set()
+
+    # -- scope ------------------------------------------------------------
+
+    def in_scope(self, path: str) -> bool:
+        return path.startswith(self.dirs) or \
+            any(d in path for d in self.dirs)
+
+    # -- bound evaluation (the taint-sanitizer mirror) --------------------
+
+    def eval_bound(self, expr, env: dict, cls: ClassAnnotations | None,
+                   fi) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Constant):
+            return isinstance(expr.value, (int, float)) \
+                and not isinstance(expr.value, bool)
+        if isinstance(expr, ast.Name):
+            if expr.id in env:
+                return env[expr.id]
+            return self.module_consts.get(fi.module, {}).get(
+                expr.id, False)
+        if isinstance(expr, ast.Attribute):
+            attr = _self_attr(expr)
+            if attr is not None and cls is not None:
+                return self.attr_bounds.get(
+                    (cls.path, cls.name), {}).get(attr, False)
+            return False
+        if isinstance(expr, ast.BinOp):
+            return self.eval_bound(expr.left, env, cls, fi) and \
+                self.eval_bound(expr.right, env, cls, fi)
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval_bound(expr.operand, env, cls, fi)
+        if isinstance(expr, ast.IfExp):
+            return self.eval_bound(expr.body, env, cls, fi) or \
+                self.eval_bound(expr.orelse, env, cls, fi)
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, env, cls, fi)
+        return False
+
+    def _eval_call(self, call: ast.Call, env, cls, fi) -> bool:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            # deadline.remaining_ms() and kin: THE sanctioned source
+            if f.attr in _DEADLINE_METHODS:
+                return True
+            if f.attr in _CONFIG_GETTERS and call.args:
+                key = call.args[0]
+                if isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str) \
+                        and _TIMEOUT_KEY.search(key.value):
+                    return True
+        if isinstance(f, ast.Name):
+            # min(): a clamp — ANY bounded arm launders the whole
+            # expression (mirrors taint's sanitizer recognition);
+            # max()/sum(): bounded only when every arm is
+            if f.id == "min" and call.args:
+                return any(self.eval_bound(a, env, cls, fi)
+                           for a in call.args)
+            if f.id in ("max", "sum") and call.args:
+                return all(self.eval_bound(a, env, cls, fi)
+                           for a in call.args)
+            if f.id in ("int", "float", "abs", "round") and call.args:
+                return self.eval_bound(call.args[0], env, cls, fi)
+        # a repo function whose every return is bounded (one-level
+        # summary with a cycle guard; e.g. a `_request_timeout_s()`
+        # helper that clamps a config attr to the deadline remainder)
+        for info, is_ctor, _cls in self.graph.resolve(call, fi):
+            if info is not None and not is_ctor \
+                    and self._returns_bounded(info):
+                return True
+        return False
+
+    def _returns_bounded(self, fi) -> bool:
+        q = fi.qname
+        if q in self.fn_summary:
+            return self.fn_summary[q]
+        if q in self._summarizing:
+            return False
+        self._summarizing.add(q)
+        try:
+            cls = self.classes.get((fi.path, fi.klass)) if fi.klass \
+                else None
+            # linear optimistic pre-pass over the function's own
+            # single-name assignments, then every return must be bounded
+            env: dict[str, bool] = {}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    name = node.targets[0].id
+                    if self.eval_bound(node.value, env, cls, fi):
+                        env[name] = True
+            returns = [n for n in ast.walk(fi.node)
+                       if isinstance(n, ast.Return) and n.value is not None]
+            ok = bool(returns) and all(
+                self.eval_bound(r.value, env, cls, fi) for r in returns)
+        finally:
+            self._summarizing.discard(q)
+        self.fn_summary[q] = ok
+        return ok
+
+    # -- the pass ---------------------------------------------------------
+
+    def run(self, ctx: LintContext) -> None:
+        in_scope = [s for s in ctx.files if self.in_scope(s.path)]
+        for src in in_scope:
+            consts = self.module_consts.setdefault(
+                module_name(src.path), {})
+            for node in src.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, (int, float)) \
+                        and not isinstance(node.value.value, bool):
+                    consts[node.targets[0].id] = True
+        # class annotations + attribute bound provenance (two passes so
+        # `self.y = self.x * 2` chains resolve)
+        thread_classes: set[tuple[str, str]] = set()
+        for src in in_scope:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                info = scan_class_annotations(src.lines, node, src.path)
+                self.classes[(src.path, node.name)] = info
+                for b in node.bases:
+                    bname = b.id if isinstance(b, ast.Name) else \
+                        b.attr if isinstance(b, ast.Attribute) else None
+                    if bname == "Thread":
+                        thread_classes.add((src.path, node.name))
+        for src in in_scope:
+            mod = self.graph.modules.get(module_name(src.path))
+            if mod is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._attr_bound_pass(src, node, mod)
+        # per-function scans
+        for src in in_scope:
+            mod = self.graph.modules.get(module_name(src.path))
+            if mod is None:
+                continue
+            fns = list(mod.functions.values())
+            for cname, methods in mod.classes.items():
+                fns.extend(methods.values())
+            for fi in fns:
+                cls = self.classes.get((src.path, fi.klass)) \
+                    if fi.klass else None
+                scan = _FnScan(fi, src, self, cls,
+                               (src.path, fi.klass) in thread_classes)
+                scan.run()
+                self.scans[fi.qname] = scan
+
+    def _attr_bound_pass(self, src: SourceFile, node: ast.ClassDef,
+                         mod) -> None:
+        info = self.classes[(src.path, node.name)]
+        bounds = self.attr_bounds.setdefault((src.path, node.name), {})
+        any_fi = next(iter(mod.classes.get(node.name, {}).values()), None)
+        if any_fi is None:
+            return
+        for _round in (0, 1):
+            for m in node.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                env: dict[str, bool] = {}
+                for sub in ast.walk(m):
+                    if not (isinstance(sub, ast.Assign)
+                            and len(sub.targets) == 1):
+                        continue
+                    tgt, val = sub.targets[0], sub.value
+                    if isinstance(tgt, ast.Name):
+                        if self.eval_bound(val, env, info, any_fi):
+                            env[tgt.id] = True
+                        continue
+                    attr = _self_attr(tgt)
+                    if attr is not None and self.eval_bound(
+                            val, env, info, any_fi):
+                        bounds[attr] = True
+
+    # -- reachability -----------------------------------------------------
+
+    def is_entry(self, qname: str, name: str) -> bool:
+        return qname in self.entry_qnames or name in self.entry_methods \
+            or qname.startswith(self.entry_prefixes)
+
+    def request_paths(self) -> dict[str, str]:
+        """qname -> the entry point it is reachable from (BFS, sorted
+        for deterministic attribution)."""
+        via: dict[str, str] = {}
+        queue: list[str] = []
+        for q in sorted(self.scans):
+            fi = self.scans[q].fi
+            if self.is_entry(q, fi.name):
+                via[q] = q
+                queue.append(q)
+        while queue:
+            q = queue.pop(0)
+            for callee in sorted(self.scans[q].callees):
+                if callee in self.scans and callee not in via:
+                    via[callee] = via[q]
+                    queue.append(callee)
+        return via
+
+
+def _analysis(ctx: LintContext) -> dict:
+    bucket = ctx.bucket("blocking")
+    if "deadline_findings" in bucket:
+        return bucket
+    an = _Analysis(ctx)
+    an.run(ctx)
+    via = an.request_paths()
+    deadline: list[Finding] = []
+    hold: list[Finding] = []
+    request_sites: set[tuple[str, str]] = set()
+    seen: set[tuple] = set()
+    for qname in sorted(via):
+        scan = an.scans[qname]
+        fi = scan.fi
+        request_sites.add((fi.path, fi.name))
+        entry = an.scans[via[qname]].fi.name
+        cls = an.classes.get((fi.path, fi.klass)) if fi.klass else None
+        relevant = frozenset(cls.guarded.values()) if cls else frozenset()
+        for site in scan.sites:
+            if site.annotated:
+                continue
+            key = (fi.path, site.line, site.kind, site.label)
+            if key in seen:
+                continue
+            seen.add(key)
+            if site.kind == "sleep":
+                deadline.append(Finding(
+                    fi.path, site.line, RULE_SLEEP,
+                    "time.sleep in '%s' is on a request-serving path "
+                    "(reachable from '%s') — %s"
+                    % (fi.name, entry, _SLEEP_HINT)))
+            elif not site.bounded:
+                deadline.append(Finding(
+                    fi.path, site.line, RULE_UNBOUNDED,
+                    "%s in '%s' on a request-serving path (reachable "
+                    "from '%s') %s"
+                    % (site.label, fi.name, entry, _UNBOUNDED_HINT)))
+            if site.kind != "condition" and (site.held & relevant):
+                lock = sorted(site.held & relevant)[0]
+                hold.append(Finding(
+                    fi.path, site.line, RULE_HOLD,
+                    "%s in '%s' runs while holding lock '%s' on a "
+                    "request-serving path (reachable from '%s') — a "
+                    "stalled peer wedges every request contending this "
+                    "lock; move the call outside the critical section "
+                    "or use a per-resource lock"
+                    % (site.label, fi.name, lock, entry)))
+    bucket["deadline_findings"] = deadline
+    bucket["hold_findings"] = hold
+    bucket["request_sites"] = request_sites
+    return bucket
+
+
+def check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    return []
+
+
+def finish_deadline(ctx: LintContext) -> list[Finding]:
+    return list(_analysis(ctx)["deadline_findings"])
+
+
+def finish_hold(ctx: LintContext) -> list[Finding]:
+    return list(_analysis(ctx)["hold_findings"])
+
+
+def static_request_paths(root: str | None = None,
+                         paths: tuple[str, ...] = ("opentsdb_tpu",)
+                         ) -> set[tuple[str, str]]:
+    """(repo-relative path, function name) pairs on request-serving
+    paths — the static set tsdbsan's blocked-past-deadline watcher
+    cross-references its runtime observations against
+    (tools/sanitize/deadlock.py), mirroring static_order_edges."""
+    from tools.lint.core import REPO_ROOT, run_lint
+    ctx = LintContext(root or REPO_ROOT)
+    run_lint(paths, root=root or REPO_ROOT,
+             analyzers=[DEADLINE_ANALYZER], ctx=ctx)
+    return set(ctx.bucket("blocking").get("request_sites", set()))
+
+
+DEADLINE_ANALYZER = Analyzer(
+    "deadline_discipline", (RULE_UNBOUNDED, RULE_SLEEP),
+    check, finish_deadline)
+HOLD_LOCK_ANALYZER = Analyzer(
+    "hold_lock_while_blocking", (RULE_HOLD,), check, finish_hold)

@@ -134,14 +134,15 @@ def _iter_py_files(paths: Iterable[str], root: str) -> list[str]:
 
 
 def get_analyzers() -> list[Analyzer]:
-    """All nine analyzers (imported lazily so `core` has no circulars).
+    """All eleven analyzers (imported lazily so `core` has no circulars).
 
     The PR-2 four are per-file; the v2 three (shape/dtype abstract
     interpretation, request-field taint, resource-leak paths) run over
-    the interprocedural call graph built once per LintContext, as does
-    the v3 cache-coherence pass.  metrics_schema is per-file like
-    config_schema."""
-    from tools.lint import (cache_coherence, config_schema,
+    the interprocedural call graph built once per LintContext, as do
+    the v3 cache-coherence pass and the v4 pair (deadline discipline +
+    hold-lock-while-blocking, tools/lint/blocking.py).  metrics_schema
+    is per-file like config_schema."""
+    from tools.lint import (blocking, cache_coherence, config_schema,
                             exception_discipline, jax_hygiene,
                             lock_discipline, metrics_schema,
                             resource_leak, shape_dtype, taint)
@@ -149,7 +150,8 @@ def get_analyzers() -> list[Analyzer]:
             config_schema.ANALYZER, metrics_schema.ANALYZER,
             exception_discipline.ANALYZER, shape_dtype.ANALYZER,
             taint.ANALYZER, resource_leak.ANALYZER,
-            cache_coherence.ANALYZER]
+            cache_coherence.ANALYZER, blocking.DEADLINE_ANALYZER,
+            blocking.HOLD_LOCK_ANALYZER]
 
 
 ALL_ANALYZERS = get_analyzers

@@ -23,6 +23,17 @@ a static edge never observed is a stale-annotation/uncovered-path
 report (san-stale-static-edge, note level); an observed edge the lint
 cannot derive is a lint gap (san-lint-gap, note level).  Both are
 deterministic given the same run: edges are sorted before reporting.
+
+A third static<->dynamic bridge rides the same machinery: every
+BLOCKED instrumented acquire is timed (SanLockBase.acquire ->
+`record_blocked_wait`), and when the waiting thread carried a bounded
+ambient request Deadline that expired DURING the wait, the site is
+remembered.  `report_blocked_past_deadline()` emits those as
+san-blocked-past-deadline notes, cross-referenced against
+deadline_discipline's static request-path set
+(tools/lint/blocking.static_request_paths) — the same pattern as the
+order-graph's stale-edge/lint-gap notes — and tags sites the source
+waived with `# blocking: bounded-by <reason>`.
 """
 
 from __future__ import annotations
@@ -44,6 +55,9 @@ _order_edges: dict[Edge, tuple[str, int]] = {}
 _same_label_orders: dict[Label, dict[int, tuple[str, int]]] = {}
 # thread ident -> SanLock it is blocked acquiring
 _waiting: dict[int, object] = {}
+# (path, line, func, lock name) -> longest blocked wait (seconds) that
+# outlasted the ambient deadline's remainder at that site
+_blocked_waits: dict[tuple[str, int, str, str], float] = {}
 
 _watchdog: "_Watchdog | None" = None
 _enabled = False
@@ -65,25 +79,29 @@ def reset() -> None:
         _order_edges.clear()
         _same_label_orders.clear()
         _waiting.clear()
+        _blocked_waits.clear()
 
 
 def snapshot_state() -> tuple:
-    """Copy of the accumulated order-graph state; fixture tests that
-    seed deliberate inversions snapshot/restore around themselves so a
-    TSDBSAN=1 session's real graph survives them."""
+    """Copy of the accumulated order-graph + blocked-wait state; fixture
+    tests that seed deliberate inversions snapshot/restore around
+    themselves so a TSDBSAN=1 session's real graph survives them."""
     with _state_lock:
         return (dict(_order_edges),
-                {k: dict(v) for k, v in _same_label_orders.items()})
+                {k: dict(v) for k, v in _same_label_orders.items()},
+                dict(_blocked_waits))
 
 
 def restore_state(snapshot: tuple) -> None:
-    order, same = snapshot
+    order, same, blocked = snapshot
     with _state_lock:
         _order_edges.clear()
         _order_edges.update(order)
         _same_label_orders.clear()
         for k, v in same.items():
             _same_label_orders[k] = dict(v)
+        _blocked_waits.clear()
+        _blocked_waits.update(blocked)
 
 
 # --------------------------------------------------------------------- #
@@ -134,6 +152,33 @@ def unregister_waiting() -> None:
         return
     with _state_lock:
         _waiting.pop(threading.get_ident(), None)
+
+
+def record_blocked_wait(lock, waited_s: float) -> None:
+    """Called from SanLockBase.acquire after a BLOCKED acquire path
+    returns: when this thread carries a bounded ambient request
+    Deadline that is expired NOW, the wait outlasted whatever remainder
+    the deadline had when the wait began (remaining_before = remaining
+    now + waited) — remember the site for the note-level
+    blocked-past-deadline report."""
+    if not _enabled or waited_s < 0.001:
+        return
+    try:
+        from opentsdb_tpu.query.limits import active_deadline
+    except ImportError:                  # sanitizer used standalone
+        return
+    dl = active_deadline()
+    if dl is None or not dl.bounded or dl.remaining_ms() >= 0:
+        return
+    if lock.label is not None:
+        name = "%s.%s" % lock.label
+    else:
+        name = "an unlabeled %s" % lock.kind
+    path, line, func = caller_site(skip=2)
+    key = (path, line, func, name)
+    with _state_lock:
+        if waited_s > _blocked_waits.get(key, 0.0):
+            _blocked_waits[key] = waited_s
 
 
 # --------------------------------------------------------------------- #
@@ -304,6 +349,87 @@ def cross_check(static_edges: dict[Edge, tuple[str, int]] | None = None,
             "types so the static graph sees this call path)"
             % (edge[0] + edge[1]))
     return {"stale": stale, "gaps": gaps}
+
+
+def blocked_waits() -> dict[tuple[str, int, str, str], float]:
+    with _state_lock:
+        return dict(_blocked_waits)
+
+
+def report_blocked_past_deadline(reporter=None,
+                                 static_paths: set[tuple[str, str]]
+                                 | None = None,
+                                 root: str | None = None) -> list:
+    """Emit a san-blocked-past-deadline note for every recorded blocked
+    acquire that outlasted its ambient deadline, cross-referenced
+    against deadline_discipline's static request-path set (same
+    static<->dynamic pattern as the stale-edge/lint-gap notes).  Sites
+    the source waives with `# blocking: bounded-by <reason>` are tagged
+    with the reason instead of a coverage verdict.  The static lint
+    only runs when there is something to report (it costs a tree walk).
+    Returns the emitted Finding keys, sorted."""
+    events = blocked_waits()
+    if not events:
+        return []
+    rep = reporter if reporter is not None else REPORTER
+    if static_paths is None:
+        static_paths = static_request_paths_cached(root)
+    out = []
+    for (path, line, func, lockname) in sorted(events):
+        reason = _blocking_waiver(path, line, root)
+        if reason is not None:
+            tag = ("site waived in source: bounded-by %s — confirm the "
+                   "waiver still holds under this deadline" % reason)
+        elif (path, func) in static_paths:
+            tag = ("on deadline_discipline's static request-path set — "
+                   "the route is covered; tighten the acquire bound or "
+                   "shed load before the critical section")
+        else:
+            tag = ("NOT in the static request-path set — uncovered "
+                   "route or a non-request thread carrying a deadline "
+                   "(possible lint gap)")
+        rep.add(path, line, "san-blocked-past-deadline",
+                "blocked acquire of %s in '%s' kept waiting past the "
+                "ambient request deadline's remainder (%s)"
+                % (lockname, func, tag))
+        out.append((path, line, func, lockname))
+    return out
+
+
+_static_paths_cache: set[tuple[str, str]] | None = None
+
+
+def static_request_paths_cached(root: str | None = None
+                                ) -> set[tuple[str, str]]:
+    """deadline_discipline's (path, function) request-path set, resolved
+    lazily from the lint layer and cached for the process (the
+    underlying pass walks the whole package)."""
+    global _static_paths_cache
+    if _static_paths_cache is None:
+        from tools.lint.blocking import static_request_paths
+        _static_paths_cache = static_request_paths(root)
+    return _static_paths_cache
+
+
+def _blocking_waiver(path: str, line: int,
+                     root: str | None = None) -> str | None:
+    """The `# blocking: bounded-by <reason>` waiver covering `line` of
+    `path` (the site line or the line directly above — the same
+    placement the lint grammar honors), or None."""
+    from tools.lint.annotations import blocking_annotation
+    from tools.lint.core import REPO_ROOT
+    abspath = os.path.join(root or REPO_ROOT, path)
+    try:
+        with open(abspath, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return None
+    for at in (line, line - 1):
+        if 1 <= at <= len(lines):
+            reason = blocking_annotation(lines[at - 1])
+            if reason is not None:
+                return reason
+    return None
 
 
 def save_observed(path: str) -> None:

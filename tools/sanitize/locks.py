@@ -19,6 +19,7 @@ acquire create, and who waits for whom?).
 from __future__ import annotations
 
 import threading
+import time
 
 # the real factories, captured at import time (install() patches the
 # module attributes; everything in here must keep using the real ones)
@@ -78,11 +79,17 @@ class SanLockBase:
         if not got and blocking:
             if not reentrant:
                 deadlock.register_waiting(self)
+            waited_from = time.monotonic()
             try:
                 got = self._inner.acquire(True, timeout)
             finally:
                 if not reentrant:
                     deadlock.unregister_waiting()
+                # the blocked-past-deadline watcher wants the time this
+                # thread spent parked, timeout or not — a failed timed
+                # acquire still stalled the request for its full timeout
+                deadlock.record_blocked_wait(
+                    self, time.monotonic() - waited_from)
         if got:
             if self.owner == me:
                 self.count += 1

@@ -42,6 +42,10 @@ def pytest_sessionfinish(session, exitstatus) -> None:
     from tools.sanitize import deadlock
     from tools.sanitize.report import REPORTER
     deadlock.detect_inversions()
+    # note-level: acquires that outwaited their ambient request
+    # deadline, cross-referenced against the static request-path set
+    # (no-op — and no lint tree walk — when nothing was recorded)
+    deadlock.report_blocked_past_deadline()
     state_path = os.environ.get("TSDBSAN_STATE", "")
     if state_path:
         deadlock.save_observed(state_path)

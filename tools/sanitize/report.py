@@ -46,6 +46,9 @@ SAN_RULES: dict[str, tuple[str, str]] = {
     "san-lint-gap": (
         "note", "Runtime lock-order edge not derivable statically "
                 "(lint gap)"),
+    "san-blocked-past-deadline": (
+        "note", "Instrumented lock acquire kept waiting past the "
+                "ambient request deadline's remainder"),
 }
 
 ERROR_RULES = frozenset(r for r, (lv, _d) in SAN_RULES.items()

@@ -11,8 +11,9 @@ bearing test files) under `TSDBSAN=1` in a child pytest, collects the
 findings report + the observed lock-order graph, then cross-checks the
 observed graph against lock_discipline's static one.  Exit status:
 
-    0  zero error-level sanitizer findings (cross-check notes and
-       pre-existing test failures do not fail the run)
+    0  zero error-level sanitizer findings (cross-check notes —
+       san-stale-static-edge / san-lint-gap / san-blocked-past-deadline
+       — and pre-existing test failures do not fail the run)
     1  error-level findings (races / inversions / deadlocks / ...)
     2  usage or harness error
 
