@@ -374,15 +374,16 @@ class TSDB:
                 if self.persistence is not None:
                     rec = {"k": "p", "m": metric, "t": timestamp,
                            "v": value, "g": dict(tags), "sh": shard}
-                    seq, crc = self.persistence.journal(rec)
+                    seq, crc = self.persistence.journal(rec)  # order-event: wal-append
                     entry = (seq, crc, shard, rec)
             if entry is not None:
+                # order: wal-append before replica-ship
                 repl.on_committed([entry])
             return
         with self._ingest_lock:
             self._apply_point(metric, timestamp, value, tags)
             if self.persistence is not None:
-                self.persistence.journal({"k": "p", "m": metric,
+                self.persistence.journal({"k": "p", "m": metric,  # order-event: wal-append
                                           "t": timestamp, "v": value,
                                           "g": dict(tags)})
 
@@ -518,10 +519,11 @@ class TSDB:
                 rec = {"k": "pb", "d": stored}
                 if shard is not None:
                     rec["sh"] = shard
-                seq, crc = self.persistence.journal(rec)
+                seq, crc = self.persistence.journal(rec)  # order-event: wal-append
                 if shard is not None:
                     entry = (seq, crc, shard, rec)
         if entry is not None and self.replication is not None:
+            # order: wal-append before replica-ship
             self.replication.on_committed([entry])
         for metric, ts_ms, num, tags, key in publish:
             self.rt_publisher.publish_data_point(metric, ts_ms, num, tags,
@@ -641,7 +643,7 @@ class TSDB:
             if journal_record is not None and success > 0:
                 # inside the ingest lock: a snapshot cannot slip between
                 # the appends above and this journal line
-                self.persistence.journal(journal_record)
+                self.persistence.journal(journal_record)  # order-event: wal-append
         errors.sort(key=lambda t: t[0])
         return success, errors
 
@@ -1132,17 +1134,19 @@ class TSDB:
         if self.persistence is not None:
             with self._ingest_lock:
                 self.persistence.snapshot()
-            self.persistence.close()
+            self.persistence.close()                 # order-event: wal-close
         if self.spill_pool is not None:
             # after the query path is quiesced: drops every entry and
             # the private tempdir (in-flight tiled queries have their
             # own per-query release in ops/tiling.py)
-            self.spill_pool.close()
+            self.spill_pool.close()                  # order-event: spill-close
         if self.flightrec is not None:
             # LAST, so teardown events above still land in the ring
             # before the shutdown dump writes the black box; idempotent
             # (a server stop + an explicit shutdown both reach here)
-            self.flightrec.shutdown()
+            # order: wal-close before flightrec-shutdown
+            # order: spill-close before flightrec-shutdown
+            self.flightrec.shutdown()                # order-event: flightrec-shutdown
 
 
 def parse_value(value) -> tuple[bool, int | float]:

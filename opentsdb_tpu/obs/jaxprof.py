@@ -81,12 +81,23 @@ class CompileLogCapture:
     def subscribe(self, callback) -> None:
         import jax
         with self._lock:
-            self._subscribers.append(callback)
             if self._handler is None:
-                self._prev_flag = jax.config.jax_log_compiles
+                # all fallible work BEFORE the first state write: a
+                # raise after `_prev_flag` was set but before
+                # `_handler` would make the next subscribe() re-save
+                # the already-overridden flag, so unsubscribe() could
+                # never restore the user's original setting
+                handler = _CaptureHandler(self)
+                prev = jax.config.jax_log_compiles
                 jax.config.update("jax_log_compiles", True)
-                self._handler = _CaptureHandler(self)
-                logging.getLogger(PXLA_LOGGER).addHandler(self._handler)
+                self._prev_flag = prev
+                self._handler = handler
+                logging.getLogger(PXLA_LOGGER).addHandler(handler)
+            # registering the callback is the commit point: a failed
+            # install must not leave a subscriber the caller never got
+            # a working subscription for (it would pin the flag
+            # override past the last real unsubscribe)
+            self._subscribers.append(callback)
 
     def unsubscribe(self, callback) -> None:
         import jax

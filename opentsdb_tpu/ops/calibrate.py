@@ -289,30 +289,38 @@ class OnlineCalibrator:
         if path:
             # global-install: set_calibration_file paired-with: shutdown
             costmodel.set_calibration_file(path)
-        self.calibration_path = path or costmodel.calibration_file()
-        # PROCESS-GLOBAL, like _apply_kernel_modes: the sticky-argmin
-        # band lives with the module-level choosers
-        # global-install: set_hysteresis paired-with: shutdown
-        costmodel.set_hysteresis(cfg.get_float(
-            "tsd.costmodel.autotune.hysteresis"))
-        self._lock = threading.Lock()
-        self._rng = random.Random(_EXPLORE_SEED)
-        # guarded-by: _lock
-        self.fits = 0
-        self.fit_errors = 0  # guarded-by: _lock
-        self.samples_used = 0  # guarded-by: _lock
-        self.explorations = 0  # guarded-by: _lock
-        self.last_residual = 0.0  # guarded-by: _lock
-        # active exploration: {"axis": ..., "mode": ...} while a losing
-        # mode is forced  # guarded-by: _lock
-        self.exploring: dict | None = None
+        try:
+            self.calibration_path = path or costmodel.calibration_file()
+            # PROCESS-GLOBAL, like _apply_kernel_modes: the sticky-argmin
+            # band lives with the module-level choosers
+            # global-install: set_hysteresis paired-with: shutdown
+            costmodel.set_hysteresis(cfg.get_float(
+                "tsd.costmodel.autotune.hysteresis"))
+            self._lock = threading.Lock()
+            self._rng = random.Random(_EXPLORE_SEED)
+            # guarded-by: _lock
+            self.fits = 0
+            self.fit_errors = 0  # guarded-by: _lock
+            self.samples_used = 0  # guarded-by: _lock
+            self.explorations = 0  # guarded-by: _lock
+            self.last_residual = 0.0  # guarded-by: _lock
+            # active exploration: {"axis": ..., "mode": ...} while a
+            # losing mode is forced  # guarded-by: _lock
+            self.exploring: dict | None = None
 
-        # NOT under _lock: only the maintenance thread's tick touches
-        # it.  Armed by the first heartbeat (one full interval after
-        # startup) rather than here: tick() accepts an injected clock,
-        # and a monotonic-anchored init would never fire under one.
-        self._next_fit: float | None = None
-        tsdb.stats_hooks["costmodel_autotune"] = self._stats_hook
+            # NOT under _lock: only the maintenance thread's tick
+            # touches it.  Armed by the first heartbeat (one full
+            # interval after startup) rather than here: tick() accepts
+            # an injected clock, and a monotonic-anchored init would
+            # never fire under one.
+            self._next_fit: float | None = None
+            tsdb.stats_hooks["costmodel_autotune"] = self._stats_hook
+        except BaseException:
+            # a failed construction leaves no instance whose shutdown()
+            # could restore the process-global redirect — undo it here
+            costmodel.set_calibration_file(self._prior_calibration_file)
+            costmodel.set_hysteresis(self._prior_hysteresis)
+            raise
 
     # -- cadence ------------------------------------------------------- #
 

@@ -319,7 +319,12 @@ def _clear_dependent_caches() -> None:
     mix configs between already-seen and new query shapes.
     """
     global _MODE_POLICY_EPOCH
-    _MODE_POLICY_EPOCH += 1
+    # the epoch must move BEFORE any compiled program is dropped: a
+    # planner that snapshots the epoch mid-splice sees it already
+    # bumped and discards its calibration entry, instead of pairing a
+    # stale program's timing with the new policy (checked contract)
+    # order: epoch-bump before jit-cache-splice
+    _MODE_POLICY_EPOCH += 1                          # order-event: epoch-bump
     from opentsdb_tpu.ops import pipeline, streaming
     for fn in (pipeline._jitted, pipeline._jitted_rollup_avg,
                pipeline._jitted_group, pipeline._jitted_grid_tail,
@@ -329,10 +334,10 @@ def _clear_dependent_caches() -> None:
                pipeline._jitted_stacked_group,
                streaming._jitted_update,
                streaming._jitted_update_sliced, streaming._jitted_finish):
-        fn.clear_cache()
+        fn.clear_cache()                             # order-event: jit-cache-splice
     try:
         from opentsdb_tpu.parallel import sharded
-        sharded.sharded_query_pipeline.cache_clear()
+        sharded.sharded_query_pipeline.cache_clear()  # order-event: jit-cache-splice
         sharded._stream_update_fn.cache_clear()
         sharded._stream_update_sliced_fn.cache_clear()
         sharded._stream_finish_fn.cache_clear()

@@ -150,6 +150,10 @@ class Series:
             # Float-typed arrays may still carry integer points; the int
             # column must hold their exact values wherever isint is set.
             ival = np.where(isint, values.astype(np.int64), 0)
+        # pure input-only work stays outside the lock — and outside the
+        # write transition: a raise here must not interleave the column
+        # writes below (failure_atomicity's all-writes-after-fallible)
+        incoming_sorted = bool(m == 1 or bool(np.all(np.diff(ts_ms) > 0)))
         with self._lock:
             need = self._n + m
             if need > len(self._ts):
@@ -158,7 +162,6 @@ class Series:
             self._val[self._n:need] = values
             self._ival[self._n:need] = ival
             self._isint[self._n:need] = isint
-            incoming_sorted = bool(m == 1 or bool(np.all(np.diff(ts_ms) > 0)))
             if self._sorted and (not incoming_sorted or
                                  (self._n and ts_ms[0] <= self._ts[self._n - 1])):
                 self._sorted = False
@@ -369,6 +372,9 @@ class Series:
         exactly as stored so no int<->float round trip occurs.
         """
         n = len(ts)
+        # sortedness depends only on the incoming column: compute it
+        # before the lock so the locked section is pure writes
+        sorted_flag = bool(n <= 1 or bool(np.all(np.diff(ts) > 0)))
         with self._lock:
             if n > len(self._ts):
                 self._grow_locked(n)
@@ -377,8 +383,7 @@ class Series:
             self._ival[:n] = ival
             self._isint[:n] = isint
             self._n = n
-            self._sorted = bool(n <= 1
-                                or bool(np.all(np.diff(ts) > 0)))
+            self._sorted = sorted_flag
             self._version += 1
 
     def arrays(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -504,7 +509,10 @@ class MemStore:
         # fails its generation check — no acked write is ever served
         # stale.  (Mark-before-write had a hole: a snapshot taken
         # after the mark but before the write would carry the mark's
-        # generation and dodge it forever.)
+        # generation and dodge it forever.)  The ordering is a checked
+        # contract: tools/lint/ordering.py fails the tree if any path
+        # reaches a mark with its write undischarged.
+        # order: memstore-write before memstore-mark
         # guarded-by: _lock
         self._mutation_listeners: list = []
 
@@ -546,8 +554,8 @@ class MemStore:
         with self._lock:
             series = self._get_or_create_series_locked(key)
             self.datapoints_added += 1
-        series.append(ts_ms, value, is_int)
-        self.notify_mutation(key.metric, ts_ms, ts_ms)
+        series.append(ts_ms, value, is_int)          # order-event: memstore-write
+        self.notify_mutation(key.metric, ts_ms, ts_ms)  # order-event: memstore-mark
         if series.dirty:
             self.compaction_queue.add(series)
 
@@ -557,9 +565,9 @@ class MemStore:
         with self._lock:
             series = self._get_or_create_series_locked(key)
             self.datapoints_added += len(ts_ms)
-        series.append_batch(ts_ms, values, is_int, ival)
+        series.append_batch(ts_ms, values, is_int, ival)  # order-event: memstore-write
         if len(ts_ms):
-            self.notify_mutation(key.metric, int(np.min(ts_ms)),
+            self.notify_mutation(key.metric, int(np.min(ts_ms)),  # order-event: memstore-mark
                                  int(np.max(ts_ms)))
         if series.dirty:
             self.compaction_queue.add(series)
@@ -677,7 +685,7 @@ class MemStore:
 
     def delete_series(self, key: SeriesKey) -> bool:
         with self._lock:
-            series = self._series.pop(key, None)
+            series = self._series.pop(key, None)     # order-event: memstore-write
             if series is not None:
                 keys = self._by_metric.get(key.metric)
                 if keys is not None:
@@ -686,5 +694,5 @@ class MemStore:
                         self._by_metric.pop(key.metric, None)
         if series is None:
             return False
-        self.notify_mutation(key.metric, None, None)
+        self.notify_mutation(key.metric, None, None)  # order-event: memstore-mark
         return True

@@ -224,8 +224,8 @@ class DiskPersistence:
         self._wal_bytes = 0  # guarded-by: _wal_lock
         self.wal_records = 0  # guarded-by: _wal_lock
         # next sequence number to assign — monotonic for the node's
-        # lifetime, snapshot resets included  # guarded-by: _wal_lock
-        self._next_seq = 1
+        # lifetime, snapshot resets included
+        self._next_seq = 1  # guarded-by: _wal_lock
         self._segment_bytes = max(
             tsdb.config.get_int("tsd.storage.wal.segment_mb"), 1) * 2 ** 20
         # opt-in per-append disk barrier (tsd.storage.wal.fsync): every
@@ -278,18 +278,28 @@ class DiskPersistence:
         with self._wal_lock:
             seq = self._next_seq
             self._next_seq += 1
-            if self._wal is None or self._wal_bytes >= self._segment_bytes:
-                if self._wal is not None:
-                    self._wal.close()
-                self._wal_file_path = self._segment_path(seq)
-                self._wal = open(self._wal_file_path, "a", buffering=1)
-                self._wal_bytes = os.path.getsize(self._wal_file_path)
-            line = frame_line(seq, crc, payload)
-            self._wal.write(line)
-            self._wal_bytes += len(line.encode("utf-8"))
-            self.wal_records += 1
-            if self._fsync_per_append:
-                os.fsync(self._wal.fileno())
+            try:
+                if self._wal is None or \
+                        self._wal_bytes >= self._segment_bytes:
+                    if self._wal is not None:
+                        old, self._wal = self._wal, None
+                        old.close()
+                    self._wal_file_path = self._segment_path(seq)
+                    self._wal = open(self._wal_file_path, "a",
+                                     buffering=1)
+                    self._wal_bytes = os.path.getsize(self._wal_file_path)
+                line = frame_line(seq, crc, payload)
+                self._wal.write(line)
+                self._wal_bytes += len(line.encode("utf-8"))
+                self.wal_records += 1
+                if self._fsync_per_append:
+                    os.fsync(self._wal.fileno())
+            except BaseException:
+                # un-assign: nothing reached the log under this seq, so
+                # give it back — a burned sequence number would read as
+                # a permanent gap to every replica tailing this WAL
+                self._next_seq = seq
+                raise
         return seq, crc
 
     def read_since(self, since: int, max_bytes: int = 4 * 2 ** 20

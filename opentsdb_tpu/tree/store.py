@@ -32,8 +32,12 @@ class TreeStore:
             if tree_id > MAX_TREES:
                 raise ValueError("Exhausted all possible tree IDs")
             tree.tree_id = tree_id
+            # construct the root branch BEFORE touching either map: a
+            # raise between the two writes would register the tree with
+            # no root, wedging every later branch walk for this id
+            root = Branch(tree_id, ())
             self._trees[tree_id] = tree
-            self._branches[(tree_id, ())] = Branch(tree_id, ())
+            self._branches[(tree_id, ())] = root
             return tree_id
 
     def get_tree(self, tree_id: int) -> Tree | None:

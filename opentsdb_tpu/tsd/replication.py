@@ -506,7 +506,7 @@ class ReplicationManager:
                 if node != self.self_id:
                     by_peer.setdefault(node, []).append(entry)
         for node, group in by_peer.items():
-            self._ship(node, group)
+            self._ship(node, group)                  # order-event: replica-ship
 
     def _request_timeout_s(self) -> float:
         """The bound for one synchronous replication HTTP call: the
@@ -811,11 +811,13 @@ class ReplicationManager:
                 LOG.debug("anti-entropy pass against %s failed: %s",
                           peer, e)
 
+    # order: catch-up-pull before rejoin-ready
     def catch_up(self, max_rounds: int = 64) -> None:
         """Rejoin protocol: pull every reachable peer's tail until this
         node reaches their last sequence numbers, THEN mark ready (and
         with it, re-accept ownership).  Unreachable peers don't block —
-        a full cluster cold start must come up."""
+        a full cluster cold start must come up.  The pull-before-ready
+        ordering is a checked contract (tools/lint/ordering.py)."""
         with self._lock:
             self.ready = False
         try:
@@ -823,7 +825,7 @@ class ReplicationManager:
                 behind = False
                 for peer in self.peers:
                     try:
-                        pos, last = self.pull_from(peer)
+                        pos, last = self.pull_from(peer)  # order-event: catch-up-pull
                         if pos < last:
                             behind = True
                     except Exception as e:
@@ -834,7 +836,7 @@ class ReplicationManager:
                     break
         finally:
             with self._lock:
-                self.ready = True
+                self.ready = True                    # order-event: rejoin-ready
         self._record_epoch_event("catch_up_complete")
 
     # pull rounds between anti-entropy passes: cheap (one status GET +

@@ -272,6 +272,11 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
         success, errors = self.ingest_points(tsdb, dps)
         self._respond_put(tsdb, query, success, errors, lambda i: dps[i])
 
+    # The ack-path durability contract (PR 15), checked at the tree
+    # level by tools/lint/ordering.py: by the time either ack statement
+    # below runs, the accepted points must have journaled and shipped.
+    # order: wal-append before ingest-ack
+    # order: replica-ship before ingest-ack
     def _respond_put(self, tsdb, query: HttpQuery, success: int,
                      errors: list, dp_at) -> None:
         """Shared response tail: per-error counters + SEH spillway +
@@ -303,13 +308,13 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
                     "One or more data points had errors",
                     details="Please see the TSD logs or append \"details\" "
                             "to the put request")
-            query.send_status_only(204)
+            query.send_status_only(204)              # order-event: ingest-ack
             return
         summary = {"success": success, "failed": failed}
         if show_details:
             summary["errors"] = details
         status = 200 if failed == 0 else 400
-        query.send_reply(query.serializer.format_put_v1(summary),
+        query.send_reply(query.serializer.format_put_v1(summary),  # order-event: ingest-ack
                          status=status)
 
     def collect_stats(self, collector) -> None:
@@ -470,7 +475,12 @@ class QueryRpc(HttpRpc):
         # may mutate ts_query down the degradation ladder
         # (permit.degrade_note annotates the 200 below).
         permit = admission.admit(tsdb, ts_query, query, route="api/query")
-        with permit:
+        # The permit must outlive the response write: releasing it first
+        # would let the next queued query start while this one still
+        # owns the serializer/socket (checked contract; the with-exit IS
+        # the release event).
+        # order: response-write before permit-release
+        with permit:                                 # order-event: permit-release
             # injectable stall INSIDE the permit: tools/chaos_soak.py
             # --overload wedges the gate with it to prove the queue
             # bounds + sheds instead of stalling
@@ -544,7 +554,7 @@ class QueryRpc(HttpRpc):
                     # still open and renders elapsed-so-far
                     summary["trace"] = trace.to_json()
                 payload.append({"statsSummary": summary})
-            query.send_reply(payload)
+            query.send_reply(payload)                # order-event: response-write
             REGISTRY.counter(
                 "tsd.query.count", "Queries served").labels(
                     status="200").inc()

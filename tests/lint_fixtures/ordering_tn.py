@@ -1,0 +1,85 @@
+"""Ordering & failure-atomicity true negatives — correct orderings,
+rolled-back transitions, and protected installs stay silent."""
+
+import threading
+
+# order: tn-write before tn-mark
+
+
+class MarkedStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}   # guarded-by: _lock
+        self._marks = 0   # guarded-by: _lock
+
+    def write(self, key, value):
+        with self._lock:
+            self._data[key] = value   # order-event: tn-write
+
+    def mark(self):
+        with self._lock:
+            self._marks += 1          # order-event: tn-mark
+
+    def put(self, key, value):
+        self.write(key, value)
+        self.mark()
+
+    def remark(self):
+        # sequences only the mark side: the contract binds functions
+        # that order BOTH events, not every site that emits one
+        self.mark()
+
+
+class RolledBackSession:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"   # guarded-by: _lock
+        self._epoch = 0        # guarded-by: _lock
+
+    def advance(self, loader):
+        # fallible work hoisted before the first write: a raise here
+        # leaves the transition untouched
+        payload = loader.fetch()
+        with self._lock:
+            self._state = "loading"
+            self._epoch += 1
+        return payload
+
+    def advance_guarded(self, loader):
+        with self._lock:
+            prev = self._state
+            self._state = "loading"
+            try:
+                loader.push(self._epoch)
+                self._epoch += 1
+            except Exception:
+                self._state = prev
+                raise
+        return prev
+
+    def branch_local(self, fresh):
+        # writes in opposite branches can never interleave on a path
+        with self._lock:
+            if fresh:
+                self._state = "fresh"
+            else:
+                self._epoch += 1
+
+
+class ProtectedPlugin:
+    def __init__(self, reg, config):
+        self.reg = reg
+        # global-install: remove_hook paired-with: shutdown
+        reg.install_hook(self._on_event)
+        try:
+            self.limit = config.parse_limit()
+        except Exception:
+            # a failed construction uninstalls before re-raising
+            reg.remove_hook(self._on_event)
+            raise
+
+    def shutdown(self):
+        self.reg.remove_hook(self._on_event)
+
+    def _on_event(self, event):
+        return event

@@ -1,0 +1,60 @@
+"""Ordering & failure-atomicity true positives for tools/lint/ordering.py.
+
+One case per rule: a happens-before contract violated by a reordered
+sequencer, a multi-write guarded transition torn by an interleaved
+fallible call, and a global install armed before later fallible
+__init__ work with no rollback.
+"""
+
+import threading
+
+# order: fx-write before fx-mark
+
+
+class MarkedStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data = {}   # guarded-by: _lock
+        self._marks = 0   # guarded-by: _lock
+
+    def write(self, key, value):
+        with self._lock:
+            self._data[key] = value   # order-event: fx-write
+
+    def mark(self):
+        with self._lock:
+            self._marks += 1          # order-event: fx-mark
+
+    def put(self, key, value):
+        # readers chase the mark: publishing it before the write makes
+        # them re-read and serve the PREVIOUS value as fresh
+        self.mark()                   # EXPECT: order-violation
+        self.write(key, value)
+
+
+class TornSession:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"   # guarded-by: _lock
+        self._epoch = 0        # guarded-by: _lock
+
+    def advance(self, loader):
+        with self._lock:
+            self._state = "loading"
+            payload = loader.fetch()   # EXPECT: atomicity-torn-on-raise
+            self._epoch += 1
+        return payload
+
+
+class LeakyPlugin:
+    def __init__(self, reg, config):
+        self.reg = reg
+        # global-install: remove_hook paired-with: shutdown
+        reg.install_hook(self._on_event)   # EXPECT: install-leak-on-raise
+        self.limit = config.parse_limit()
+
+    def shutdown(self):
+        self.reg.remove_hook(self._on_event)
+
+    def _on_event(self, event):
+        return event

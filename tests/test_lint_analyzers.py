@@ -4,8 +4,8 @@ Every true-positive fixture line carries an `# EXPECT: <rule>` marker;
 the tests assert the analyzer fires EXACTLY those (line, rule) pairs —
 a fixture violation caught by the wrong rule, a missed line, or an
 extra finding all fail.  True-negative fixtures must come back empty.
-All four analyzers run over every fixture, so each corpus also proves
-the other three stay silent on it.
+All thirteen analyzers run over every fixture, so each corpus also
+proves the other twelve stay silent on it.
 """
 
 from __future__ import annotations
@@ -69,6 +69,7 @@ def _lint_fixture(name: str) -> list:
     ctx.bucket("shape")["paths"] = ("tests/lint_fixtures/",)
     ctx.bucket("leak")["paths"] = ("tests/lint_fixtures/",)
     ctx.bucket("blocking")["paths"] = ("tests/lint_fixtures/",)
+    ctx.bucket("ordering")["paths"] = ("tests/lint_fixtures/",)
     path = os.path.join(FIXTURES, name)
     return run_lint([path], root=REPO, ctx=ctx)
 
@@ -77,12 +78,12 @@ TRUE_POSITIVE = ["jax_tp.py", "lock_tp.py", "config_tp.py", "except_tp.py",
                  "shape_tp.py", "taint_tp.py", "leak_tp.py",
                  "cache_tp.py", "install_tp.py", "span_tp.py",
                  "metrics_tp.py", "flightrec_tp.py", "explain_tp.py",
-                 "batcher_tp.py", "blocking_tp.py"]
+                 "batcher_tp.py", "blocking_tp.py", "ordering_tp.py"]
 TRUE_NEGATIVE = ["jax_tn.py", "lock_tn.py", "config_tn.py", "except_tn.py",
                  "shape_tn.py", "taint_tn.py", "leak_tn.py",
                  "cache_tn.py", "install_tn.py", "span_tn.py",
                  "metrics_tn.py", "flightrec_tn.py", "explain_tn.py",
-                 "batcher_tn.py", "blocking_tn.py"]
+                 "batcher_tn.py", "blocking_tn.py", "ordering_tn.py"]
 
 
 @pytest.mark.parametrize("name", TRUE_POSITIVE)
@@ -513,8 +514,72 @@ def test_removing_the_deadline_clamp_fails_the_tree(tmp_path):
         + "\n".join(f.render() for f in ship))
 
 
+def test_swapping_write_and_mark_fails_the_tree(tmp_path):
+    """The order_contract analyzer's load-bearing check, pinned on the
+    PR 9 bug class: memstore.add_point must append the point BEFORE
+    publishing the mutation mark — swapped, cache readers chase the
+    mark, re-read, and serve the previous contents as fresh.  If this
+    test fails, the analyzer has gone blind to the exact regression it
+    exists to catch."""
+    import shutil
+    from tools.lint import ordering
+    dst = tmp_path / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    ms = dst / "storage" / "memstore.py"
+    src = ms.read_text()
+    write_line = ("        series.append(ts_ms, value, is_int)"
+                  "          # order-event: memstore-write\n")
+    mark_line = ("        self.notify_mutation(key.metric, ts_ms, ts_ms)"
+                 "  # order-event: memstore-mark\n")
+    needle = write_line + mark_line
+    assert src.count(needle) == 1, \
+        "expected the tagged write/mark pair in add_point"
+    ms.write_text(src.replace(needle, mark_line + write_line))
+    ctx = LintContext(str(tmp_path))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path),
+                        analyzers=[ordering.ORDER_ANALYZER], ctx=ctx)
+    hits = [f for f in findings if f.rule == "order-violation"
+            and f.path == "opentsdb_tpu/storage/memstore.py"
+            and "memstore-write" in f.message]
+    assert hits, ("swapping write and mark went undetected:\n"
+                  + "\n".join(f.render() for f in findings))
+
+
+def test_moving_ship_after_ack_fails_the_tree(tmp_path):
+    """The PR 15 durability invariant as a checked contract: the bulk
+    put route must ship to replicas (and journal) BEFORE acking the
+    client — responding first un-does replicated sharded serving's
+    no-ack-before-ship guarantee.  The reorder is transitive (neither
+    moved line carries a tag; the events arrive through ingest_points
+    and _respond_put), so this also pins the call-graph emission."""
+    import shutil
+    from tools.lint import ordering
+    dst = tmp_path / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    rp = dst / "tsd" / "rpcs.py"
+    src = rp.read_text()
+    ingest_line = ("        success, errors = "
+                   "self.ingest_points(tsdb, dps)\n")
+    ack_line = ("        self._respond_put(tsdb, query, success, "
+                "errors, lambda i: dps[i])\n")
+    needle = ingest_line + ack_line
+    assert src.count(needle) == 1, \
+        "expected the ingest-then-ack pair in process_data_points"
+    rp.write_text(src.replace(
+        needle,
+        ack_line.replace("success, errors,", "[], [],") + ingest_line))
+    ctx = LintContext(str(tmp_path))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path),
+                        analyzers=[ordering.ORDER_ANALYZER], ctx=ctx)
+    hits = [f for f in findings if f.rule == "order-violation"
+            and f.path == "opentsdb_tpu/tsd/rpcs.py"
+            and "replica-ship" in f.message]
+    assert hits, ("acking before the ship went undetected:\n"
+                  + "\n".join(f.render() for f in findings))
+
+
 def test_full_tree_lint_stays_under_the_tier1_budget():
-    """All eleven analyzers over the package in under 30s — the bound
+    """All thirteen analyzers over the package in under 30s — the bound
     that keeps tsdblint viable inside tier-1 (and the pre-commit hook
     tolerable).  The interprocedural fixpoints dominate; if this starts
     failing, parallelize the per-file check phase before relaxing the

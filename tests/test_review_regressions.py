@@ -335,3 +335,159 @@ class TestCacheCoherenceFixes:
                 root.removeHandler(admin_rpcs._LOG_BUFFER)
             for _ in range(saved):
                 admin_rpcs.install_log_buffer()
+
+
+class TestOrderingAtomicityTruePositives:
+    """PR 18 true positives surfaced by tools/lint/ordering.py."""
+
+    def test_failed_wal_append_does_not_burn_a_sequence_number(
+            self, tmp_path, monkeypatch):
+        """A raise mid-journal() used to leave ``_next_seq`` bumped with
+        nothing on disk — a permanent gap to every replica tailing the
+        WAL.  Fails pre-fix: the retry lands on before+2."""
+        from opentsdb_tpu.core import TSDB
+        from opentsdb_tpu.storage import persist
+        from opentsdb_tpu.utils.config import Config
+
+        t = TSDB(Config({
+            "tsd.core.auto_create_metrics": True,
+            "tsd.storage.directory": str(tmp_path / "data")}))
+        p = t.persistence
+        p.journal({"kind": "probe", "i": 1})
+        before = p.last_seq
+
+        real = persist.frame_line
+
+        def boom(seq, crc, payload):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(persist, "frame_line", boom)
+        with pytest.raises(OSError):
+            p.journal({"kind": "probe", "i": 2})
+        assert p.last_seq == before      # seq handed back, no gap
+        monkeypatch.setattr(persist, "frame_line", real)
+        seq, _ = p.journal({"kind": "probe", "i": 3})
+        assert seq == before + 1         # contiguous for the tail
+
+    def test_failed_subscribe_preserves_the_compile_log_flag(
+            self, monkeypatch):
+        """A raise between subscribe()'s ``_prev_flag`` and ``_handler``
+        writes made the NEXT subscribe re-save the already-overridden
+        flag, so unsubscribe could never restore the user's setting.
+        Fails pre-fix: _prev_flag holds the stale save and the jax flag
+        is left flipped."""
+        import jax
+        from opentsdb_tpu.obs import jaxprof as jp
+
+        cap = jp.CompileLogCapture()
+        prior = jax.config.jax_log_compiles
+
+        def boom(owner):
+            raise RuntimeError("handler construction failed")
+
+        try:
+            monkeypatch.setattr(jp, "_CaptureHandler", boom)
+            with pytest.raises(RuntimeError):
+                cap.subscribe(lambda kernel: None)
+            assert cap._handler is None
+            assert cap._prev_flag is None          # nothing half-saved
+            assert jax.config.jax_log_compiles == prior
+            monkeypatch.undo()
+            cb = lambda kernel: None
+            cap.subscribe(cb)
+            try:
+                assert cap._prev_flag == prior     # true original saved
+            finally:
+                cap.unsubscribe(cb)
+            assert jax.config.jax_log_compiles == prior
+        finally:
+            jax.config.update("jax_log_compiles", prior)
+
+    def test_failed_root_branch_leaves_no_half_registered_tree(
+            self, monkeypatch):
+        """create_tree wrote ``_trees`` before constructing the root
+        Branch; a raise there registered a tree with no root, wedging
+        every later branch walk for that id.  Fails pre-fix: the
+        aborted id stays in the store."""
+        from opentsdb_tpu.tree import Tree, TreeStore
+        from opentsdb_tpu.tree import store as tree_store_mod
+
+        st = TreeStore()
+
+        def boom(tree_id, path):
+            raise RuntimeError("branch allocation failed")
+
+        monkeypatch.setattr(tree_store_mod, "Branch", boom)
+        with pytest.raises(RuntimeError):
+            st.create_tree(Tree(name="t"))
+        assert st.all_trees() == []
+        monkeypatch.undo()
+        tid = st.create_tree(Tree(name="t"))
+        assert tid == 1
+        assert st.get_branch(tid, ()) is not None
+
+    def test_raise_in_sortedness_probe_cannot_scribble_columns(
+            self, monkeypatch):
+        """append_batch computed the incoming-sortedness probe BETWEEN
+        the column writes and the ``_n`` commit; a raise there left the
+        backing arrays scribbled past the commit point.  Fails pre-fix:
+        the probe slot holds the aborted batch's timestamp."""
+        from opentsdb_tpu.storage import memstore
+
+        s = memstore.Series(memstore.SeriesKey.make(1, {}))
+        probe = int(s._ts[0])
+
+        def boom(arr):
+            raise FloatingPointError("probe failed")
+
+        monkeypatch.setattr(memstore.np, "diff", boom)
+        with pytest.raises(FloatingPointError):
+            s.append_batch(np.array([10_000, 20_000], dtype=np.int64),
+                           np.array([1.0, 2.0]), False)
+        assert len(s) == 0 and s._version == 0
+        assert int(s._ts[0]) == probe      # columns untouched
+        monkeypatch.undo()
+        s.append_batch(np.array([10_000, 20_000], dtype=np.int64),
+                       np.array([1.0, 2.0]), False)
+        assert len(s) == 2 and s._sorted
+
+    def test_failed_calibrator_construction_restores_global_installs(
+            self, tmp_path):
+        """OnlineCalibrator.__init__ armed the process-global
+        calibration-file redirect, then ran fallible config reads; a
+        raise there leaked the redirect with no instance whose
+        shutdown() could undo it.  Fails pre-fix: calibration_file()
+        still points at this constructor's path."""
+        from opentsdb_tpu.ops import calibrate, costmodel
+
+        prior_file = costmodel.calibration_file()
+        prior_hyst = costmodel.hysteresis()
+        cal_path = str(tmp_path / "cal.json")
+
+        class Cfg:
+            def get_int(self, key):
+                return 1
+
+            def get_bool(self, key):
+                return False
+
+            def get_string(self, key):
+                return cal_path
+
+            def get_float(self, key):
+                if key.endswith("hysteresis"):
+                    raise ValueError("could not parse hysteresis")
+                return 0.25
+
+        class FakeTsdb:
+            config = Cfg()
+            stats_hooks: dict = {}
+
+        try:
+            with pytest.raises(ValueError):
+                calibrate.OnlineCalibrator(FakeTsdb())
+            assert costmodel.calibration_file() == prior_file
+            assert costmodel.hysteresis() == prior_hyst
+        finally:
+            costmodel.set_calibration_file(prior_file)
+            costmodel.set_hysteresis(prior_hyst)

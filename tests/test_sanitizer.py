@@ -46,7 +46,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 import tools.sanitize as sanitize  # noqa: E402
-from tools.sanitize import deadlock, lockset  # noqa: E402
+from tools.sanitize import deadlock, lockset, order  # noqa: E402
 from tools.sanitize.jax_san import JaxSanitizer  # noqa: E402
 from tools.sanitize.locks import SanLockBase  # noqa: E402
 from tools.sanitize.report import REPORTER  # noqa: E402
@@ -66,10 +66,12 @@ def san():
         sanitize.install(extra_lock_prefixes=("san_fixtures",))
     saved_findings = REPORTER.raw_findings()
     saved_graph = deadlock.snapshot_state()
+    saved_streams = order.snapshot_state()
     yield sanitize
     REPORTER.clear()
     REPORTER.restore(saved_findings)
     deadlock.restore_state(saved_graph)
+    order.restore_state(saved_streams)
     if owned:
         sanitize.uninstall()
 
@@ -78,6 +80,7 @@ def san():
 def _isolated(san):
     REPORTER.clear()
     deadlock.reset()
+    order.reset()
     yield
 
 
@@ -537,6 +540,136 @@ class TestCrossCheck:
         assert rep.raw_findings()
         assert all(rule_level(f.rule) == "note"
                    for f in rep.raw_findings())
+
+
+# --------------------------------------------------------------------- #
+# Runtime ordering recorder                                             #
+# --------------------------------------------------------------------- #
+
+class TestOrderRecorder:
+    """tools/sanitize/order.py: per-stream event logs, patch-table
+    instrumentation, snapshot/restore isolation, and the
+    static<->dynamic happens-before cross-check."""
+
+    @staticmethod
+    def _my_stream() -> str:
+        return "thread:%d" % threading.get_ident()
+
+    def test_streams_key_by_trace_when_one_is_active(self, san):
+        from opentsdb_tpu.obs import trace as obs_trace
+        t = obs_trace.Trace("order-unit")
+        obs_trace.activate(t)
+        try:
+            order.record("x-a")
+        finally:
+            obs_trace.deactivate()
+        order.record("x-b")
+        got = order.streams()
+        assert "x-a" in got["trace:" + t.trace_id]
+        assert "x-b" in got[self._my_stream()]
+        assert "x-b" not in got["trace:" + t.trace_id]
+
+    def test_first_occurrence_rank_survives_repeats(self, san):
+        order.record("x-b")
+        order.record("x-a")
+        order.record("x-b")     # a repeat must not move the rank
+        ev = order.streams()[self._my_stream()]
+        assert ev["x-b"][0] < ev["x-a"][0]
+
+    def test_snapshot_restore_round_trips_the_streams(self, san):
+        order.record("x-a")
+        order.record("x-b")
+        snap = order.snapshot_state()
+        before = order.streams()
+        order.reset()
+        order.record("x-c")
+        assert order.streams() != before
+        order.restore_state(snap)
+        assert order.streams() == before
+
+    def test_inverted_stream_is_a_violation_note(self, san):
+        from tools.sanitize.report import SanReporter, rule_level
+        order.record("x-b")
+        order.record("x-a")
+        table = {"contracts": {("x-a", "x-b")}, "events": {"x-a", "x-b"}}
+        rep = SanReporter()
+        diff = order.cross_check(static_table=table, reporter=rep)
+        assert [v[1:] for v in diff["violations"]] == [("x-a", "x-b")]
+        (f,) = rep.raw_findings()
+        assert f.rule == "san-order-violation"
+        assert rule_level(f.rule) == "note"
+        assert "'x-b' before 'x-a'" in f.message
+        # deterministic: a second pass reproduces the same findings
+        rep2 = SanReporter()
+        order.cross_check(static_table=table, reporter=rep2)
+        assert rep2.raw_findings() == rep.raw_findings()
+
+    def test_contract_order_and_one_sided_streams_stay_silent(self, san):
+        from tools.sanitize.report import SanReporter
+        order.record("x-a")
+        order.record("x-b")     # declared order — clean
+        order.record("x-only")  # no contract names it
+        table = {"contracts": {("x-a", "x-b")},
+                 "events": {"x-a", "x-b"}}
+        rep = SanReporter()
+        diff = order.cross_check(static_table=table, reporter=rep)
+        assert diff == {"violations": [], "gaps": []}
+        assert rep.raw_findings() == []
+
+    def test_unobserved_instrumented_event_is_a_gap(self, san):
+        from tools.sanitize.report import SanReporter, rule_level
+        order.record("memstore-write")
+        table = {"contracts": {("memstore-write", "memstore-mark")},
+                 "events": {"memstore-write", "memstore-mark"}}
+        rep = SanReporter()
+        diff = order.cross_check(static_table=table, reporter=rep)
+        assert diff["gaps"] == ["memstore-mark"]
+        assert diff["violations"] == []
+        (f,) = rep.raw_findings()
+        assert f.rule == "san-order-gap"
+        assert rule_level(f.rule) == "note"
+        assert "memstore-mark" in f.message
+
+    def test_uninstrumented_contract_events_never_gap(self, san):
+        # catch-up-pull has no runtime probe: a normal session never
+        # takes the rejoin path, so its absence must stay silent
+        from tools.sanitize.report import SanReporter
+        order.record("memstore-write")
+        table = {"contracts": {("catch-up-pull", "rejoin-ready")},
+                 "events": {"catch-up-pull", "rejoin-ready"}}
+        rep = SanReporter()
+        diff = order.cross_check(static_table=table, reporter=rep)
+        assert diff == {"violations": [], "gaps": []}
+        assert rep.raw_findings() == []
+
+    def test_empty_session_cross_checks_without_a_tree_walk(self, san):
+        from tools.sanitize.report import SanReporter
+        rep = SanReporter()
+        # static_table=None with nothing recorded must return empty
+        # WITHOUT resolving the static table (no lint tree walk)
+        diff = order.cross_check(static_table=None, reporter=rep)
+        assert diff == {"violations": [], "gaps": []}
+        assert rep.raw_findings() == []
+
+    def test_instrumented_series_append_records_the_write_event(
+            self, san):
+        from opentsdb_tpu.storage import memstore
+        assert getattr(memstore.Series.append, "_tsdbsan_order", False), \
+            "install() should have wrapped the memstore-write probe"
+        s = memstore.Series(memstore.SeriesKey.make(1, {2: 3}))
+        s.append(1000, 1.5, False)
+        ev = order.streams()[self._my_stream()]
+        assert "memstore-write" in ev
+        assert ev["memstore-write"][1] == "tests/test_sanitizer.py"
+
+    def test_static_table_matches_the_lints_contract_set(self, san):
+        table = order.static_table_cached()
+        assert ("memstore-write", "memstore-mark") in table["contracts"]
+        assert ("wal-append", "ingest-ack") in table["contracts"]
+        # every instrumented event is a real tagged event in the tree
+        missing = order.instrumented_events() - table["events"]
+        assert not missing, \
+            "probes without a tagged site drifted: %s" % sorted(missing)
 
 
 # --------------------------------------------------------------------- #

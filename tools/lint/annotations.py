@@ -79,11 +79,58 @@ INSTALL_ANN = re.compile(
 
 BLOCKING_ANN = re.compile(r"#\s*blocking:\s*bounded-by\s+(\S.*)")
 
+# --------------------------------------------------------------------- #
+# Ordering & failure-atomicity grammar (tools/lint/ordering.py)          #
+#                                                                       #
+#   # order-event: <name>                                               #
+#       Tags the statement on this line (or the line below a            #
+#       standalone comment) as an occurrence of the named               #
+#       happens-before event.  On a `with` statement the event fires    #
+#       at block EXIT (a permit released when the context closes).      #
+#       Several sites may share one event name: any of them             #
+#       discharges the contract.                                        #
+#                                                                       #
+#   # order: <a> before <b>                                             #
+#       Declares the happens-before contract: in any function that      #
+#       sequences both events, every path reaching a <b> site must      #
+#       have crossed an <a> site first.  Contracts are global — they    #
+#       may be declared once, next to whichever side owns the           #
+#       invariant.  The same grammar seeds tsdbsan's runtime            #
+#       order-event recorder (tools/sanitize/order.py).                 #
+#                                                                       #
+#   # atomic: <group>                                                   #
+#       Names the attribute declared on this line (or below a           #
+#       standalone comment) as part of a multi-write transition group:  #
+#       failure_atomicity verifies the group's writes cannot be torn    #
+#       by a raise even outside a lock region.                          #
+# --------------------------------------------------------------------- #
+
+ORDER_EVENT = re.compile(r"#\s*order-event:\s*([A-Za-z0-9_.\-]+)")
+ORDER_CONTRACT = re.compile(
+    r"#\s*order:\s*([A-Za-z0-9_.\-]+)\s+before\s+([A-Za-z0-9_.\-]+)")
+ATOMIC_ANN = re.compile(r"#\s*atomic:\s*([A-Za-z0-9_.\-]+)")
+
 
 def blocking_annotation(line: str) -> str | None:
     """The bounded-by reason from one source line, or None."""
     m = BLOCKING_ANN.search(line)
     return m.group(1).strip() if m else None
+
+
+def order_events(line: str) -> list[str]:
+    """Every `# order-event:` name on one source line (usually 0 or 1)."""
+    return ORDER_EVENT.findall(line)
+
+
+def order_contracts(line: str) -> list[tuple[str, str]]:
+    """Every `# order: a before b` pair declared on one source line."""
+    return ORDER_CONTRACT.findall(line)
+
+
+def atomic_annotation(line: str) -> str | None:
+    """The `# atomic:` group name from one source line, or None."""
+    m = ATOMIC_ANN.search(line)
+    return m.group(1) if m else None
 
 
 def cache_annotation(line: str) -> tuple[str, str] | None:

@@ -21,6 +21,7 @@ import ast
 import dataclasses
 import json
 import os
+import time
 from typing import Callable, Iterable
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -134,24 +135,27 @@ def _iter_py_files(paths: Iterable[str], root: str) -> list[str]:
 
 
 def get_analyzers() -> list[Analyzer]:
-    """All eleven analyzers (imported lazily so `core` has no circulars).
+    """All thirteen analyzers (imported lazily so `core` has no
+    circulars).
 
     The PR-2 four are per-file; the v2 three (shape/dtype abstract
     interpretation, request-field taint, resource-leak paths) run over
     the interprocedural call graph built once per LintContext, as do
-    the v3 cache-coherence pass and the v4 pair (deadline discipline +
-    hold-lock-while-blocking, tools/lint/blocking.py).  metrics_schema
-    is per-file like config_schema."""
+    the v3 cache-coherence pass, the v4 pair (deadline discipline +
+    hold-lock-while-blocking, tools/lint/blocking.py), and the v5
+    order-contract pass (tools/lint/ordering.py).  metrics_schema is
+    per-file like config_schema, as is v5's failure_atomicity."""
     from tools.lint import (blocking, cache_coherence, config_schema,
                             exception_discipline, jax_hygiene,
-                            lock_discipline, metrics_schema,
+                            lock_discipline, metrics_schema, ordering,
                             resource_leak, shape_dtype, taint)
     return [jax_hygiene.ANALYZER, lock_discipline.ANALYZER,
             config_schema.ANALYZER, metrics_schema.ANALYZER,
             exception_discipline.ANALYZER, shape_dtype.ANALYZER,
             taint.ANALYZER, resource_leak.ANALYZER,
             cache_coherence.ANALYZER, blocking.DEADLINE_ANALYZER,
-            blocking.HOLD_LOCK_ANALYZER]
+            blocking.HOLD_LOCK_ANALYZER, ordering.ORDER_ANALYZER,
+            ordering.ATOMICITY_ANALYZER]
 
 
 ALL_ANALYZERS = get_analyzers
@@ -167,6 +171,7 @@ def run_lint(paths: Iterable[str], root: str = REPO_ROOT,
         analyzers = get_analyzers()
     if ctx is None:
         ctx = LintContext(root)
+    timings = ctx.bucket("timings")
     findings: list[Finding] = []
     for abspath in _iter_py_files(paths, root):
         rel = os.path.relpath(abspath, root)
@@ -178,14 +183,22 @@ def run_lint(paths: Iterable[str], root: str = REPO_ROOT,
             continue
         ctx.files.append(src)
         for analyzer in analyzers:
-            for f in analyzer.check(src, ctx):
+            t0 = time.perf_counter()
+            checked = analyzer.check(src, ctx)
+            timings[analyzer.name] = timings.get(analyzer.name, 0.0) \
+                + (time.perf_counter() - t0)
+            for f in checked:
                 if not src.suppressed(f.line, f.rule):
                     findings.append(f)
     by_path = {src.path: src for src in ctx.files}
     for analyzer in analyzers:
         if analyzer.finish is None:
             continue
-        for f in analyzer.finish(ctx):
+        t0 = time.perf_counter()
+        finished = analyzer.finish(ctx)
+        timings[analyzer.name] = timings.get(analyzer.name, 0.0) \
+            + (time.perf_counter() - t0)
+        for f in finished:
             src = by_path.get(f.path)
             if src is not None and src.suppressed(f.line, f.rule):
                 continue

@@ -9,6 +9,7 @@
     python tools/lint/run.py --update-baseline    # grandfather findings
     python tools/lint/run.py --no-baseline        # raw findings
     python tools/lint/run.py --update-doc         # regen docs/configuration.md
+    python tools/lint/run.py --timings            # per-analyzer wall time
     python tools/lint/run.py path/to/file.py ...  # specific targets
 
 `--changed-only` still ANALYZES the whole tree (the interprocedural
@@ -34,7 +35,8 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from tools.lint.core import (  # noqa: E402
-    REPO_ROOT, apply_baseline, load_baseline, run_lint, save_baseline)
+    REPO_ROOT, LintContext, apply_baseline, load_baseline, run_lint,
+    save_baseline)
 
 DEFAULT_PATHS = ["opentsdb_tpu"]
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -59,6 +61,10 @@ def main(argv: list[str] | None = None) -> int:
                     dest="changed_only",
                     help="report only findings in files changed vs HEAD "
                          "(whole tree is still analyzed)")
+    ap.add_argument("--timings", action="store_true",
+                    help="print the per-analyzer wall-time breakdown "
+                         "(with --json: {\"findings\": ..., "
+                         "\"timings\": ...})")
     ap.add_argument("--update-doc", action="store_true",
                     help="regenerate docs/configuration.md from "
                          "CONFIG_SCHEMA and exit")
@@ -77,7 +83,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     paths = args.paths or DEFAULT_PATHS
-    findings = run_lint(paths)
+    ctx = LintContext(REPO_ROOT)
+    findings = run_lint(paths, ctx=ctx)
+    timings = dict(sorted(ctx.bucket("timings").items(),
+                          key=lambda kv: -kv[1])) if args.timings else None
 
     if args.update_baseline:
         save_baseline(findings, args.baseline)
@@ -96,10 +105,18 @@ def main(argv: list[str] | None = None) -> int:
         from tools.lint.core import get_analyzers
         from tools.lint.sarif import to_sarif
         print(json.dumps(to_sarif(findings, get_analyzers()), indent=1))
+        if timings is not None:
+            _print_timings(timings, stream=sys.stderr)
     elif args.as_json:
-        print(json.dumps([{"path": f.path, "line": f.line, "rule": f.rule,
-                           "message": f.message} for f in findings],
-                         indent=1))
+        payload = [{"path": f.path, "line": f.line, "rule": f.rule,
+                    "message": f.message} for f in findings]
+        if timings is not None:
+            # a bare `--json` stays a bare list (stable machine
+            # interface); --timings opts into the wrapped object
+            print(json.dumps({"findings": payload, "timings": timings},
+                             indent=1))
+        else:
+            print(json.dumps(payload, indent=1))
     else:
         for f in findings:
             print(f.render())
@@ -107,7 +124,16 @@ def main(argv: list[str] | None = None) -> int:
             print("\n%d finding(s)" % len(findings))
         else:
             print("tsdblint: clean")
+        if timings is not None:
+            _print_timings(timings, stream=sys.stdout)
     return 1 if findings else 0
+
+
+def _print_timings(timings: dict, stream) -> None:
+    total = sum(timings.values())
+    print("\nper-analyzer wall time (%.2fs total):" % total, file=stream)
+    for name, secs in timings.items():
+        print("  %-28s %7.3fs" % (name, secs), file=stream)
 
 
 def _changed_files() -> set[str]:

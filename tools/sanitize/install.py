@@ -12,7 +12,10 @@
      lazy imports (the parallel/ mesh path, plugins) are covered
      without importing anything eagerly (importing parallel/ on a
      machine without shard_map must not become the sanitizer's fault);
-  4. the deadlock watchdog starts (tools/sanitize/deadlock);
+  4. the deadlock watchdog starts (tools/sanitize/deadlock) and the
+     runtime ordering recorder arms (tools/sanitize/order) — the same
+     module scan wraps the patch-table methods that realise tagged
+     order events;
   5. optionally the JAX compile/sync sanitizer attaches
      (tools/sanitize/jax_san) — off by default under pytest, where
      compiles happen throughout; the steady-state serving check and
@@ -49,10 +52,12 @@ def install(lockset: bool = True, deadlock_watch: bool = True,
     if _installed is not None:
         return
     from tools.sanitize import deadlock, jax_san, locks, lockset as ls
+    from tools.sanitize import order
     lock_prefixes = tuple(packages) + tuple(extra_lock_prefixes)
     locks.patch_factories(lock_prefixes)
     ls.configure(lockset_enabled=lockset)
     deadlock.configure(enabled=deadlock_watch, watchdog_ms=watchdog_ms)
+    order.configure(enabled=True)
     instrumented: list[type] = []
     for modname in sorted(sys.modules):
         if _in_packages(modname, packages):
@@ -76,6 +81,7 @@ def uninstall() -> None:
     if _installed is None:
         return
     from tools.sanitize import deadlock, locks, lockset as ls
+    from tools.sanitize import order
     state, _installed = _installed, None
     try:
         sys.meta_path.remove(state["hook"])
@@ -86,6 +92,8 @@ def uninstall() -> None:
     if state["jax"] is not None:
         state["jax"].stop()
     deadlock.configure(enabled=False)
+    order.configure(enabled=False)
+    order.unpatch_all()
     locks.unpatch_factories()
 
 
@@ -98,9 +106,11 @@ def reset_state() -> None:
     """Drop accumulated detector state (not the patches): fixture tests
     isolate scenarios with this."""
     from tools.sanitize import deadlock, lockset as ls
+    from tools.sanitize import order
     from tools.sanitize.report import REPORTER
     deadlock.reset()
     ls.reset()
+    order.reset()
     REPORTER.clear()
     if _installed and _installed["jax"] is not None:
         _installed["jax"].reset()
@@ -117,6 +127,8 @@ def instrument_module(mod) -> list[type]:
     tests can instrument tests/san_fixtures modules explicitly."""
     from tools.lint.annotations import scan_module_file
     from tools.sanitize import lockset as ls
+    from tools.sanitize import order
+    order.instrument_module(mod)
     path = getattr(mod, "__file__", None)
     if not path or not path.endswith(".py") or not os.path.exists(path):
         return []

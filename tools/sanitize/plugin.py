@@ -1,7 +1,8 @@
 """pytest plugin: arm tsdbsan for the whole test session.
 
 Loaded by tests/conftest.py when `TSDBSAN=1` (see the `pytest_plugins`
-hook there).  The lockset and deadlock detectors run for every test;
+hook there).  The lockset, deadlock, and ordering detectors run for
+every test;
 the JAX compile/sync sanitizer stays OFF under pytest by default —
 tests compile kernels throughout, so warmup/steady phases are
 meaningless session-wide; the steady-state serving check
@@ -39,13 +40,16 @@ def pytest_configure(config) -> None:
 
 
 def pytest_sessionfinish(session, exitstatus) -> None:
-    from tools.sanitize import deadlock
+    from tools.sanitize import deadlock, order
     from tools.sanitize.report import REPORTER
     deadlock.detect_inversions()
     # note-level: acquires that outwaited their ambient request
     # deadline, cross-referenced against the static request-path set
     # (no-op — and no lint tree walk — when nothing was recorded)
     deadlock.report_blocked_past_deadline()
+    # note-level: recorded event streams vs the declared happens-before
+    # contracts (same no-op guarantee when nothing was recorded)
+    order.cross_check()
     state_path = os.environ.get("TSDBSAN_STATE", "")
     if state_path:
         deadlock.save_observed(state_path)

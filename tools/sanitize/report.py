@@ -49,6 +49,12 @@ SAN_RULES: dict[str, tuple[str, str]] = {
     "san-blocked-past-deadline": (
         "note", "Instrumented lock acquire kept waiting past the "
                 "ambient request deadline's remainder"),
+    "san-order-violation": (
+        "note", "Declared happens-before contract violated by a "
+                "recorded runtime event stream"),
+    "san-order-gap": (
+        "note", "Contracted order event instrumented but never "
+                "observed this session"),
 }
 
 ERROR_RULES = frozenset(r for r, (lv, _d) in SAN_RULES.items()
