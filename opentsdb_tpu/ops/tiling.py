@@ -133,6 +133,7 @@ def count_refusal(reason: str) -> None:
         "413), by reason").labels(reason=reason).inc()
 
 
+# effects: observe-gated(observe)
 def plan_tiled(tsdb, *, s: int, w: int, g_pad: int, acc_cell_bytes: int,
                total_points: int, platform: str,
                state_mb: int | None = None,

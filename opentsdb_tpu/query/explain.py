@@ -108,6 +108,7 @@ class WhatIf:
         return out
 
 
+# effects: pure
 def parse_what_if(raw: dict) -> WhatIf:
     """The what-if grammar above; raises :class:`WhatIfError` on an
     unknown key or a value outside the grammar."""
@@ -183,6 +184,7 @@ class _ExplainConsults:
 
     # -- rollup ---------------------------------------------------------
 
+    # effects: reads-only
     def rollup_plan(self):
         wi = self.what_if
         assume = wi.assume_rollup
@@ -219,17 +221,21 @@ class _ExplainConsults:
             ctx.platform, ctx.s, ctx.n_max, ctx.g_pad, ctx.has_rate,
             total_points=ctx.total_points, observe=False)
 
+    # effects: pure
     def note_lane_served(self, plan) -> None:
         pass
 
+    # effects: pure
     def note_lane_fallback(self) -> None:
         pass
 
     # -- tiled ----------------------------------------------------------
 
+    # effects: pure
     def tiled_refusal(self, reason: str) -> None:
         pass
 
+    # effects: reads-only
     def tiled_plan(self, acc_cell: int):
         from opentsdb_tpu.ops import tiling
         ctx = self.ctx
@@ -241,6 +247,7 @@ class _ExplainConsults:
 
     # -- agg cache -------------------------------------------------------
 
+    # effects: reads-only
     def agg_plan(self, platform: str):
         assume = self.what_if.assume_agg_cache
         w = self.windows.count
@@ -264,6 +271,7 @@ class _ExplainConsults:
 
     # -- device cache ----------------------------------------------------
 
+    # effects: reads-only
     def device_batch(self, build: bool, ts_base: int | None):
         assume = self.what_if.assume_device_cache
         if assume == "cold":

@@ -123,6 +123,7 @@ def acc_cell_bytes(ds_fn: str | None, sketchable: bool) -> int:
         + (4 * SKETCH_K if sketchable else 0)
 
 
+# effects: pure
 def grid_budget_for(state_mb: int, s: int, wp: int, seg_kind: str,
                     n_chips: int) -> GridBudgetDecision:
     """The materialized-grid budget decision (the planner's
@@ -136,6 +137,7 @@ def grid_budget_for(state_mb: int, s: int, wp: int, seg_kind: str,
     return grid_budget("grid", state_mb, grid_bytes, s, wp)
 
 
+# effects: pure
 def streaming_budget_for(state_mb: int, s: int, wp: int,
                          ds_fn: str | None, sketchable: bool,
                          n_chips: int) -> GridBudgetDecision:
@@ -181,6 +183,7 @@ def size_lane_stripes(tsdb, plan, s: int, wp: int, g_pad: int,
     return plan
 
 
+# effects: pure
 def _fingerprint(fields: dict) -> str:
     """Stable hash over the discrete routing facts — canonical JSON,
     first 16 hex chars of sha256.  Deliberately excludes every raw

@@ -383,6 +383,7 @@ class AggregateCache:
 
     # -- planning --------------------------------------------------------
 
+    # effects: observe-gated(observe)
     def plan(self, store, metric: int, series_list, windows,
              start_ms: int, end_ms: int, ds_fn: str,
              fill_policy: str, fill_value, platform: str,

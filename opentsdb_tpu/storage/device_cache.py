@@ -204,6 +204,7 @@ class DeviceSeriesCache:
         return _gather_windows(entry.ts_dev, entry.val_dev,
                                starts, lengths, n, ts_base)
 
+    # effects: reads-only
     def peek(self, store, metric: int, series_list, start_ms: int,
              end_ms: int, fix_duplicates: bool = True,
              build: bool = True, ts_base: int | None = None) -> bool:

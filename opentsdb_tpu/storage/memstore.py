@@ -179,6 +179,7 @@ class Series:
         with self._lock:
             self._normalize_locked(fix_duplicates)
 
+    # effects: canonicalize
     def _normalize_locked(self, fix_duplicates: bool) -> None:
         # _sorted means strictly increasing (append flags <=-ties as dirty),
         # so a sorted series has no duplicates either — nothing to do.

@@ -316,6 +316,7 @@ class RollupLanes:
 
     # -- lane selection helpers ------------------------------------------
 
+    # effects: pure
     def lane_for(self, interval_ms: int,
                  first_window_ms: int) -> tuple[str, int] | None:
         """The coarsest configured lane able to serve a fixed grid:
@@ -329,12 +330,14 @@ class RollupLanes:
                 return label, lane_ms
         return None
 
+    # effects: pure
     @staticmethod
     def derivable(ds_fn: str | None) -> bool:
         return ds_fn in DERIVABLE_DS
 
     # -- planning --------------------------------------------------------
 
+    # effects: observe-gated(observe)
     def plan(self, metric: int, series_list, windows, start_ms: int,
              end_ms: int, ds_fn: str, platform: str, s: int,
              n_max: int, g_pad: int, has_rate: bool,

@@ -1,0 +1,83 @@
+"""Effect & purity true negatives for tools/lint/effects.py: every
+pattern here is the sanctioned form of an effects_tp.py hazard and must
+stay silent under all fifteen analyzers.  Parsed, never imported."""
+
+import threading
+
+
+class GatedLanes:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._demand = {}   # guarded-by: _lock
+        self._plans = {}    # guarded-by: _lock
+
+    # effects: observe-gated(observe)
+    def plan(self, key, observe):
+        with self._lock:
+            if observe:
+                self._demand[key] = self._demand.get(key, 0) + 1
+            return self._plans.get(key)
+
+    # effects: observe-gated(observe)
+    def plan_early(self, key, observe):
+        # early-out domination: everything after the `if not observe`
+        # return runs only in the observing arm
+        if not observe:
+            return self._peek(key)
+        self._note(key, observe)
+        return self._peek(key)
+
+    def _note(self, key, observe):
+        # helper's own gate maps through the call argument above
+        if observe:
+            with self._lock:
+                self._demand[key] = self._demand.get(key, 0) + 1
+
+    def _peek(self, key):
+        with self._lock:
+            return self._plans.get(key)
+
+
+class BoundsCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}   # guarded-by: _lock
+
+    # effects: reads-only
+    def peek(self, key):
+        with self._lock:
+            return self._items.get(key)
+
+    # effects: reads-only
+    def dry_consult(self, lanes, key):
+        # literal False at the call site drops the callee's
+        # observe-gated effects: the dry-run arm really is read-only
+        return lanes.plan(key, False)
+
+
+# effects: pure
+def lane_width(start, end, cadence):
+    return max(1, (end - start) // cadence)
+
+
+class Buf:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals = []      # guarded-by: _lock
+        self._dirty = False  # guarded-by: _lock
+
+    # value-preserving re-canonicalization: writes confined to the
+    # function's own class are the verified claim, not an exemption
+    # effects: canonicalize
+    def _normalize(self):
+        with self._lock:
+            self._vals.sort()
+            self._dirty = False
+
+    # effects: reads-only
+    def bounds(self):
+        self._normalize()
+        with self._lock:
+            if not self._vals:
+                return None
+            return (self._vals[0], self._vals[-1])

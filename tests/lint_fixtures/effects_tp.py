@@ -1,0 +1,87 @@
+"""Effect & purity true positives for tools/lint/effects.py.
+
+One case per rule: an observe-gated accounting write leaked out of its
+gate, a counter bump inside a `pure` function, a mutator call inside a
+`reads-only` method, a malformed annotation, and the explain/permit
+entry subtrees reaching a device dispatch and an admission permit
+(the entry qnames are wired in by the test's effects-bucket override).
+Parsed, never imported.
+"""
+
+import threading
+
+import jax.numpy as jnp
+
+
+class LeakyLanes:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._demand = {}   # guarded-by: _lock
+        self._plans = {}    # guarded-by: _lock
+
+    # the demand observation moved OUT of the `if observe:` arm — the
+    # exact regression effect-observe-leak exists to catch
+    # effects: observe-gated(observe)
+    def plan(self, key, observe):   # EXPECT: effect-observe-leak
+        with self._lock:
+            self._demand[key] = self._demand.get(key, 0) + 1
+            return self._plans.get(key)
+
+    # the grammar requires the gate parameter: observe-gated without
+    # one is unenforceable and must be rejected, not guessed
+    # effects: observe-gated    # EXPECT: effect-bad-annotation
+    def plan_dry(self, key):
+        return self._plans.get(key)
+
+
+class _Reg:
+    def gauge(self, name):
+        return self
+
+    def set(self, value):
+        return None
+
+
+REGISTRY = _Reg()
+
+
+# a registry bump is accounting, not computation: `pure` forbids it
+# effects: pure
+def lane_cost(width):               # EXPECT: effect-violation
+    REGISTRY.gauge("tsd.fixture.level").set(float(width))
+    return width * 2
+
+
+class PeekCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}   # guarded-by: _lock
+
+    # pop() evicts — a consult that promises reads-only must not
+    # restructure the cache it peeks at
+    # effects: reads-only
+    def peek(self, key):            # EXPECT: effect-violation
+        with self._lock:
+            return self._items.pop(key, None)
+
+
+def explain_entry(query):
+    return _score(query)
+
+
+def _score(query):
+    # device dispatch two edges under the explain entry: reachability
+    # reports the SITE, not the entry
+    return jnp.ones(3)              # EXPECT: dispatch-reachable
+
+
+class FixturePermit:
+    def acquire(self, cost):
+        return True
+
+
+def permit_entry(query):
+    gate = FixturePermit()
+    # .acquire on a non-lock receiver is an admission permit: the
+    # explain surface must never consume serving capacity
+    return gate.acquire(1.0)        # EXPECT: permit-reachable

@@ -4,8 +4,8 @@ Every true-positive fixture line carries an `# EXPECT: <rule>` marker;
 the tests assert the analyzer fires EXACTLY those (line, rule) pairs —
 a fixture violation caught by the wrong rule, a missed line, or an
 extra finding all fail.  True-negative fixtures must come back empty.
-All thirteen analyzers run over every fixture, so each corpus also
-proves the other twelve stay silent on it.
+All fifteen analyzers run over every fixture, so each corpus also
+proves the other fourteen stay silent on it.
 """
 
 from __future__ import annotations
@@ -70,6 +70,10 @@ def _lint_fixture(name: str) -> list:
     ctx.bucket("leak")["paths"] = ("tests/lint_fixtures/",)
     ctx.bucket("blocking")["paths"] = ("tests/lint_fixtures/",)
     ctx.bucket("ordering")["paths"] = ("tests/lint_fixtures/",)
+    ctx.bucket("effects")["paths"] = ("tests/lint_fixtures/",)
+    ctx.bucket("effects")["entry_qnames"] = (
+        "tests.lint_fixtures.effects_tp.explain_entry",
+        "tests.lint_fixtures.effects_tp.permit_entry")
     path = os.path.join(FIXTURES, name)
     return run_lint([path], root=REPO, ctx=ctx)
 
@@ -78,12 +82,14 @@ TRUE_POSITIVE = ["jax_tp.py", "lock_tp.py", "config_tp.py", "except_tp.py",
                  "shape_tp.py", "taint_tp.py", "leak_tp.py",
                  "cache_tp.py", "install_tp.py", "span_tp.py",
                  "metrics_tp.py", "flightrec_tp.py", "explain_tp.py",
-                 "batcher_tp.py", "blocking_tp.py", "ordering_tp.py"]
+                 "batcher_tp.py", "blocking_tp.py", "ordering_tp.py",
+                 "effects_tp.py"]
 TRUE_NEGATIVE = ["jax_tn.py", "lock_tn.py", "config_tn.py", "except_tn.py",
                  "shape_tn.py", "taint_tn.py", "leak_tn.py",
                  "cache_tn.py", "install_tn.py", "span_tn.py",
                  "metrics_tn.py", "flightrec_tn.py", "explain_tn.py",
-                 "batcher_tn.py", "blocking_tn.py", "ordering_tn.py"]
+                 "batcher_tn.py", "blocking_tn.py", "ordering_tn.py",
+                 "effects_tn.py"]
 
 
 @pytest.mark.parametrize("name", TRUE_POSITIVE)
@@ -578,8 +584,100 @@ def test_moving_ship_after_ack_fails_the_tree(tmp_path):
                   + "\n".join(f.render() for f in findings))
 
 
+def test_moving_demand_observation_out_of_the_gate_fails_the_tree(
+        tmp_path):
+    """The effect_contract analyzer's load-bearing check, pinned on the
+    exact regression the observe gate exists for: RollupLanes.plan
+    declares `# effects: observe-gated(observe)`, so forcing its
+    demand/planned-gen accounting arm unconditional (a dry-run explain
+    consult would then perturb real lane demand) must re-fire
+    effect-observe-leak.  If this test fails, the analyzer has gone
+    blind to the regression it exists to catch."""
+    import shutil
+    from tools.lint import effects
+    dst = tmp_path / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    ru = dst / "storage" / "rollup.py"
+    src = ru.read_text()
+    needle = "            gen0 = self._gen\n            if observe:\n"
+    assert src.count(needle) == 1, \
+        "expected the gated accounting arm in RollupLanes.plan"
+    ru.write_text(src.replace(
+        needle, "            gen0 = self._gen\n            if True:\n"))
+    ctx = LintContext(str(tmp_path))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path),
+                        analyzers=[effects.EFFECT_ANALYZER], ctx=ctx)
+    hits = [f for f in findings if f.rule == "effect-observe-leak"
+            and f.path == "opentsdb_tpu/storage/rollup.py"
+            and "RollupLanes.plan" in f.message]
+    assert hits, ("un-gating the demand observation went undetected:\n"
+                  + "\n".join(f.render() for f in findings))
+
+
+def test_injected_dispatch_under_handle_explain_fails_the_tree(
+        tmp_path):
+    """The dispatch_purity analyzer's load-bearing check: a `jnp` call
+    injected ANYWHERE under the /api/query/explain entry — here
+    directly in handle_explain, a function nobody annotated — must
+    re-fire dispatch-reachable.  The contracts guard the annotated
+    consult arms; this reachability walk is what makes the whole
+    subtree dispatch-free by construction."""
+    import shutil
+    from tools.lint import effects
+    dst = tmp_path / "opentsdb_tpu"
+    shutil.copytree(os.path.join(REPO, "opentsdb_tpu"), dst)
+    rp = dst / "tsd" / "rpcs.py"
+    src = rp.read_text()
+    needle = ("        ts_query.validate()\n"
+              "        try:\n"
+              "            what_if = "
+              "explain_mod.parse_what_if(raw_what_if)\n")
+    assert src.count(needle) == 1, \
+        "expected the validate-then-parse sequence in handle_explain"
+    rp.write_text(src.replace(
+        needle,
+        "        ts_query.validate()\n"
+        "        jnp.zeros((1,))\n"
+        "        try:\n"
+        "            what_if = "
+        "explain_mod.parse_what_if(raw_what_if)\n"))
+    ctx = LintContext(str(tmp_path))
+    findings = run_lint(["opentsdb_tpu"], root=str(tmp_path),
+                        analyzers=[effects.PURITY_ANALYZER], ctx=ctx)
+    hits = [f for f in findings if f.rule == "dispatch-reachable"
+            and f.path == "opentsdb_tpu/tsd/rpcs.py"]
+    assert hits, ("an injected dispatch under handle_explain went "
+                  "undetected:\n"
+                  + "\n".join(f.render() for f in findings))
+    assert any("handle_explain" in f.message for f in hits), (
+        "the finding should name the explain entry:\n"
+        + "\n".join(f.render() for f in hits))
+
+
+def test_only_flag_restricts_the_run_to_the_named_analyzers(capsys):
+    from tools.lint import run as run_mod
+    fixture = os.path.join("tests", "lint_fixtures", "jax_tp.py")
+    # a disjoint analyzer pair over the jax fixture: clean
+    rc = run_mod.main(["--only", "effect_contract,dispatch_purity",
+                       "--no-baseline", fixture])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+    # the fixture's own analyzer named: findings come back, and
+    # --timings composes
+    rc = run_mod.main(["--only", "jax_hygiene", "--timings",
+                       "--no-baseline", fixture])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "jax-host-sync" in out
+    assert "jax_hygiene" in out          # the per-analyzer split
+    assert "lock_discipline" not in out  # nothing else ran
+    # unknown names are a usage error, not a silent no-op
+    rc = run_mod.main(["--only", "nope", "--no-baseline", fixture])
+    assert rc == 2
+
+
 def test_full_tree_lint_stays_under_the_tier1_budget():
-    """All thirteen analyzers over the package in under 30s — the bound
+    """All fifteen analyzers over the package in under 30s — the bound
     that keeps tsdblint viable inside tier-1 (and the pre-commit hook
     tolerable).  The interprocedural fixpoints dominate; if this starts
     failing, parallelize the per-file check phase before relaxing the

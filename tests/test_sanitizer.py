@@ -46,7 +46,7 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 import tools.sanitize as sanitize  # noqa: E402
-from tools.sanitize import deadlock, lockset, order  # noqa: E402
+from tools.sanitize import deadlock, effects, lockset, order  # noqa: E402
 from tools.sanitize.jax_san import JaxSanitizer  # noqa: E402
 from tools.sanitize.locks import SanLockBase  # noqa: E402
 from tools.sanitize.report import REPORTER  # noqa: E402
@@ -67,11 +67,13 @@ def san():
     saved_findings = REPORTER.raw_findings()
     saved_graph = deadlock.snapshot_state()
     saved_streams = order.snapshot_state()
+    saved_effects = effects.snapshot_state()
     yield sanitize
     REPORTER.clear()
     REPORTER.restore(saved_findings)
     deadlock.restore_state(saved_graph)
     order.restore_state(saved_streams)
+    effects.restore_state(saved_effects)
     if owned:
         sanitize.uninstall()
 
@@ -81,6 +83,7 @@ def _isolated(san):
     REPORTER.clear()
     deadlock.reset()
     order.reset()
+    effects.reset()
     yield
 
 
@@ -670,6 +673,173 @@ class TestOrderRecorder:
         missing = order.instrumented_events() - table["events"]
         assert not missing, \
             "probes without a tagged site drifted: %s" % sorted(missing)
+
+
+# --------------------------------------------------------------------- #
+# Explain effect sentinel                                               #
+# --------------------------------------------------------------------- #
+
+class TestEffectSentinel:
+    """tools/sanitize/effects.py: the dynamic half of effect_contract.
+    Explain-tagged requests arm write/dispatch/permit recording; events
+    are diffed against the static `# effects:` contract table at finish.
+    """
+
+    @staticmethod
+    def _armed_call(fn, *args, **kwargs):
+        """Run fn under the same arming wrapper explain_query gets."""
+        return effects._arming_wrap(fn)(*args, **kwargs)
+
+    def test_install_wraps_the_arming_point_and_the_gateways(self, san):
+        from opentsdb_tpu.ops import pipeline
+        from opentsdb_tpu.query import explain as explain_mod
+        from opentsdb_tpu.tsd import admission
+        assert getattr(explain_mod.explain_query, "_tsdbsan_effects",
+                       False), "install() should wrap explain_query"
+        assert getattr(pipeline.run_pipeline, "_tsdbsan_effects", False)
+        assert getattr(admission.AdmissionGate.acquire,
+                       "_tsdbsan_effects", False)
+
+    def test_unarmed_execution_records_nothing(self, san):
+        sentinel = effects._sentinel_wrap(lambda: 7, "dispatch", "x.f")
+        assert sentinel() == 7
+        assert not effects.armed()
+        assert effects.events() == {}
+
+    def test_armed_gateway_entry_is_recorded_once(self, san):
+        sentinel = effects._sentinel_wrap(lambda: 7, "dispatch", "x.f")
+
+        def consult():
+            assert effects.armed()
+            sentinel()
+            sentinel()          # dedup: one event per (kind, detail)
+            return sentinel()
+
+        assert self._armed_call(consult) == 7
+        assert not effects.armed()   # disarmed on the way out
+        ev = effects.events()
+        assert set(ev) == {("dispatch", "x.f")}
+        path, line = ev[("dispatch", "x.f")]
+        assert path == "tests/test_sanitizer.py" and line > 0
+
+    def test_armed_write_to_instrumented_class_is_recorded(self, san):
+        mod = _load_fixture("race_tn")
+        c = mod.DisciplinedCounter()
+        self._armed_call(c.bump)
+        assert ("write", "DisciplinedCounter.total") in effects.events()
+
+    def test_cross_check_filters_writes_by_the_watched_set(self, san):
+        from tools.sanitize.report import SanReporter, rule_level
+        mod = _load_fixture("race_tn")
+        c = mod.DisciplinedCounter()
+        self._armed_call(c.bump)
+        table = {"contracts": {}, "watched_classes": ["SomethingElse"]}
+        rep = SanReporter()
+        # the store is sanctioned (class not under a read-only contract)
+        assert effects.cross_check(static_table=table, reporter=rep) \
+            == {"violations": []}
+        assert rep.raw_findings() == []
+        # same event against a table that watches the class: violation
+        rep2 = SanReporter()
+        table["watched_classes"] = ["DisciplinedCounter"]
+        diff = effects.cross_check(static_table=table, reporter=rep2)
+        assert sorted(diff["violations"]) == [
+            ("write", "DisciplinedCounter.approx"),
+            ("write", "DisciplinedCounter.total")]
+        found = rep2.raw_findings()
+        assert {f.rule for f in found} == {"san-effect-violation"}
+        assert rule_level("san-effect-violation") == "note"
+        assert any("DisciplinedCounter.total" in f.message
+                   for f in found)
+
+    def test_dispatch_and_permit_always_violate(self, san):
+        from tools.sanitize.report import SanReporter
+        gw = effects._sentinel_wrap(lambda: None, "dispatch",
+                                    "pipeline.run_pipeline")
+        permit = effects._sentinel_wrap(lambda: True, "permit",
+                                        "AdmissionGate.acquire")
+
+        def consult():
+            gw()
+            permit()
+
+        self._armed_call(consult)
+        rep = SanReporter()
+        diff = effects.cross_check(
+            static_table={"contracts": {}, "watched_classes": []},
+            reporter=rep)
+        assert sorted(diff["violations"]) == [
+            ("dispatch", "pipeline.run_pipeline"),
+            ("permit", "AdmissionGate.acquire")]
+        msgs = {f.message for f in rep.raw_findings()}
+        assert any("dispatch gateway" in m for m in msgs)
+        assert any("admission permit" in m for m in msgs)
+
+    def test_empty_session_cross_checks_without_a_tree_walk(self, san):
+        from tools.sanitize.report import SanReporter
+        rep = SanReporter()
+        # static_table=None with nothing recorded must return empty
+        # WITHOUT resolving the static table (no lint tree walk)
+        assert effects.cross_check(static_table=None, reporter=rep) \
+            == {"violations": []}
+        assert rep.raw_findings() == []
+
+    def test_snapshot_restore_round_trips_the_events(self, san):
+        sentinel = effects._sentinel_wrap(lambda: 0, "dispatch", "x.f")
+        self._armed_call(sentinel)
+        snap = effects.snapshot_state()
+        before = effects.events()
+        effects.reset()
+        assert effects.events() == {}
+        effects.restore_state(snap)
+        assert effects.events() == before
+
+    def test_static_table_matches_the_lints_contract_set(self, san):
+        table = effects.static_table_cached()
+        assert set(table["watched_classes"]) == {
+            "AggregateCache", "DeviceSeriesCache", "RollupLanes",
+            "_ExplainConsults"}
+        contracts = table["contracts"]
+        assert contracts[
+            "opentsdb_tpu.storage.rollup.RollupLanes.plan"] == \
+            ("observe-gated", "observe")
+        assert contracts[
+            "opentsdb_tpu.storage.device_cache.DeviceSeriesCache.peek"] \
+            == ("reads-only", None)
+        # canonicalize classes are deliberately NOT watched: Series
+        # canonicalization during an explain consult is sanctioned
+        assert "Series" not in table["watched_classes"]
+
+    def test_real_explain_request_arms_and_cross_checks_clean(
+            self, san):
+        # end-to-end: a real /api/query/explain request through the
+        # RPC layer must run ARMED (rpcs reaches explain_query via the
+        # module attribute, so the wrapper is live) and the session
+        # cross-check against the real static table must stay clean —
+        # the acceptance run the dynamic twin exists for
+        from tests.test_explain import BASE, _manager, ask, feed
+        from tools.sanitize.report import SanReporter
+        tsdb, mgr = _manager()
+        feed(tsdb, "sys.san.explain", series=1, points=50)
+        armed_seen = []
+        orig_runner = tsdb.new_query_runner
+
+        def probing(*a, **k):
+            armed_seen.append(effects.armed())
+            return orig_runner(*a, **k)
+
+        tsdb.new_query_runner = probing
+        uri = "/api/query/explain?start=%d&end=%d&m=sum:sys.san.explain" \
+            % (BASE, BASE + 50 * 15)
+        status, rep, _ = ask(mgr, uri)
+        assert status == 200, rep
+        assert armed_seen == [True], \
+            "the consult should have run under the arming wrapper"
+        assert not effects.armed()
+        rep2 = SanReporter()
+        diff = effects.cross_check(reporter=rep2)
+        assert diff == {"violations": []}
+        assert rep2.raw_findings() == []
 
 
 # --------------------------------------------------------------------- #

@@ -110,6 +110,46 @@ ORDER_CONTRACT = re.compile(
     r"#\s*order:\s*([A-Za-z0-9_.\-]+)\s+before\s+([A-Za-z0-9_.\-]+)")
 ATOMIC_ANN = re.compile(r"#\s*atomic:\s*([A-Za-z0-9_.\-]+)")
 
+# --------------------------------------------------------------------- #
+# Effect & purity grammar (tools/lint/effects.py)                       #
+#                                                                       #
+#   # effects: pure                                                     #
+#       The function (def on this line, or directly below the comment)  #
+#       has NO effects: no attribute/global writes, no lock             #
+#       acquisitions, no device dispatch, no registry counter or        #
+#       histogram bumps, no admission-permit acquisition — directly or  #
+#       through anything it calls.                                      #
+#                                                                       #
+#   # effects: reads-only                                               #
+#       Lock acquisitions are allowed (consistent reads need the        #
+#       lock); everything else is forbidden.  The contract of every     #
+#       consult arm the EXPLAIN engine calls unconditionally.           #
+#                                                                       #
+#   # effects: observe-gated(<param>)                                   #
+#       Lock acquisitions are allowed; accounting effects (attribute/   #
+#       global writes, counter bumps) are allowed ONLY when dominated   #
+#       by a truthiness check of the named boolean parameter — the      #
+#       `observe=False` dry-run arm must be effect-free.  Device        #
+#       dispatch and permit acquisition stay forbidden outright.       #
+#                                                                       #
+#   # effects: canonicalize                                             #
+#       The function mutates ONLY its own instance's attributes, as a   #
+#       value-preserving re-canonicalization (Series normalization:     #
+#       sort + last-write-wins dedup).  The contract is itself          #
+#       verified — writes outside the receiver's class, counters,      #
+#       dispatch and permits all violate it — and callers may then     #
+#       treat calls to it as reads (assume/guarantee).                  #
+#                                                                       #
+#   The same grammar feeds tsdbsan's explain-sentinel (tools/sanitize/  #
+#   effects.py): the static contract table tells the runtime which      #
+#   classes' writes are forbidden while an explain request is armed.    #
+# --------------------------------------------------------------------- #
+
+EFFECTS_ANN = re.compile(
+    r"#\s*effects:\s*"
+    r"(pure|reads-only|observe-gated|canonicalize)"
+    r"(?:\s*\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*\))?")
+
 
 def blocking_annotation(line: str) -> str | None:
     """The bounded-by reason from one source line, or None."""
@@ -131,6 +171,15 @@ def atomic_annotation(line: str) -> str | None:
     """The `# atomic:` group name from one source line, or None."""
     m = ATOMIC_ANN.search(line)
     return m.group(1) if m else None
+
+
+def effects_annotation(line: str) -> tuple[str, str | None] | None:
+    """(contract, gate param or None) from one source line, or None.
+    Grammar validity (a gate only on observe-gated, the gate naming a
+    real parameter) is the analyzer's job — this returns what was
+    written so malformed contracts can be reported, not ignored."""
+    m = EFFECTS_ANN.search(line)
+    return (m.group(1), m.group(2)) if m else None
 
 
 def cache_annotation(line: str) -> tuple[str, str] | None:

@@ -1,0 +1,85 @@
+"""Shared per-run AST index for the interprocedural analyzers.
+
+Before v6 every whole-program pass re-derived the same facts on its own:
+blocking's deadline analysis, ordering's contract verifier, and the v6
+effect inference each walked every in-scope file calling
+`scan_class_annotations` per class and re-collecting module-level
+constants.  With fifteen analyzers against a 30-second full-tree pin,
+that duplication is the first thing to stop paying for.
+
+The index is built ONCE per LintContext (same lifetime discipline as
+`get_callgraph`) and memoized in the context bucket keyed by the file
+count, so fixture runs with their own tiny contexts get their own tiny
+index.  Per-file class annotations are additionally memoized one file at
+a time (`class_annotations`), which the per-file check phases — where
+the file list is still streaming in — can use without invalidating the
+whole-tree cache.
+
+Contents:
+
+  * classes        (path, class name) -> ClassAnnotations
+  * class_annotations(ctx, src)       per-file {class -> ClassAnnotations}
+  * module_consts  module -> {NAME: True} for module-level numeric
+                   constant assignments (blocking's bound evaluation)
+  * thread_classes {(path, class name)} for classes deriving Thread
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.annotations import ClassAnnotations, scan_class_annotations
+from tools.lint.callgraph import module_name
+
+
+class AstIndex:
+    def __init__(self, ctx):
+        self.classes: dict[tuple[str, str], ClassAnnotations] = {}
+        self.module_consts: dict[str, dict[str, bool]] = {}
+        self.thread_classes: set[tuple[str, str]] = set()
+        for src in ctx.files:
+            per_file = class_annotations(ctx, src)
+            consts = self.module_consts.setdefault(
+                module_name(src.path), {})
+            for node in src.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, (int, float)) \
+                        and not isinstance(node.value.value, bool):
+                    consts[node.targets[0].id] = True
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                self.classes[(src.path, node.name)] = per_file[node.name]
+                for b in node.bases:
+                    bname = b.id if isinstance(b, ast.Name) else \
+                        b.attr if isinstance(b, ast.Attribute) else None
+                    if bname == "Thread":
+                        self.thread_classes.add((src.path, node.name))
+
+
+def class_annotations(ctx, src) -> dict[str, ClassAnnotations]:
+    """{class name -> ClassAnnotations} for one parsed file, memoized on
+    the context so check-phase and finish-phase consumers share one
+    scan."""
+    bucket = ctx.bucket("astindex")
+    per_file = bucket.setdefault("per_file", {})
+    cached = per_file.get(src.path)
+    if cached is None:
+        cached = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                cached[node.name] = scan_class_annotations(
+                    src.lines, node, src.path)
+        per_file[src.path] = cached
+    return cached
+
+
+def get_ast_index(ctx) -> AstIndex:
+    bucket = ctx.bucket("astindex")
+    if "index" not in bucket or bucket.get("nfiles") != len(ctx.files):
+        bucket["index"] = AstIndex(ctx)
+        bucket["nfiles"] = len(ctx.files)
+    return bucket["index"]

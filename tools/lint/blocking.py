@@ -68,8 +68,8 @@ import ast
 import re
 
 from tools.lint.annotations import (ClassAnnotations, blocking_annotation,
-                                    scan_class_annotations,
                                     self_attr as _self_attr)
+from tools.lint.astindex import get_ast_index
 from tools.lint.callgraph import get_callgraph, module_name
 from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
 
@@ -531,31 +531,14 @@ class _Analysis:
 
     def run(self, ctx: LintContext) -> None:
         in_scope = [s for s in ctx.files if self.in_scope(s.path)]
-        for src in in_scope:
-            consts = self.module_consts.setdefault(
-                module_name(src.path), {})
-            for node in src.tree.body:
-                if isinstance(node, ast.Assign) \
-                        and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name) \
-                        and isinstance(node.value, ast.Constant) \
-                        and isinstance(node.value.value, (int, float)) \
-                        and not isinstance(node.value.value, bool):
-                    consts[node.targets[0].id] = True
-        # class annotations + attribute bound provenance (two passes so
-        # `self.y = self.x * 2` chains resolve)
-        thread_classes: set[tuple[str, str]] = set()
-        for src in in_scope:
-            for node in ast.walk(src.tree):
-                if not isinstance(node, ast.ClassDef):
-                    continue
-                info = scan_class_annotations(src.lines, node, src.path)
-                self.classes[(src.path, node.name)] = info
-                for b in node.bases:
-                    bname = b.id if isinstance(b, ast.Name) else \
-                        b.attr if isinstance(b, ast.Attribute) else None
-                    if bname == "Thread":
-                        thread_classes.add((src.path, node.name))
+        # module constants, class annotations, and Thread subclasses all
+        # come from the shared per-run index (built once, used by every
+        # interprocedural analyzer); attribute bound provenance stays
+        # local — it is deadline-specific
+        index = get_ast_index(ctx)
+        self.module_consts = index.module_consts
+        self.classes = index.classes
+        thread_classes = index.thread_classes
         for src in in_scope:
             mod = self.graph.modules.get(module_name(src.path))
             if mod is None:
@@ -646,7 +629,10 @@ def _analysis(ctx: LintContext) -> dict:
         scan = an.scans[qname]
         fi = scan.fi
         request_sites.add((fi.path, fi.name))
-        entry = an.scans[via[qname]].fi.name
+        entry_fi = an.scans[via[qname]].fi
+        entry = entry_fi.name
+        entry_rel = ((entry_fi.path, entry_fi.node.lineno,
+                      "request-serving entry '%s'" % entry_fi.qname),)
         cls = an.classes.get((fi.path, fi.klass)) if fi.klass else None
         relevant = frozenset(cls.guarded.values()) if cls else frozenset()
         for site in scan.sites:
@@ -661,13 +647,15 @@ def _analysis(ctx: LintContext) -> dict:
                     fi.path, site.line, RULE_SLEEP,
                     "time.sleep in '%s' is on a request-serving path "
                     "(reachable from '%s') — %s"
-                    % (fi.name, entry, _SLEEP_HINT)))
+                    % (fi.name, entry, _SLEEP_HINT),
+                    related=entry_rel))
             elif not site.bounded:
                 deadline.append(Finding(
                     fi.path, site.line, RULE_UNBOUNDED,
                     "%s in '%s' on a request-serving path (reachable "
                     "from '%s') %s"
-                    % (site.label, fi.name, entry, _UNBOUNDED_HINT)))
+                    % (site.label, fi.name, entry, _UNBOUNDED_HINT),
+                    related=entry_rel))
             if site.kind != "condition" and (site.held & relevant):
                 lock = sorted(site.held & relevant)[0]
                 hold.append(Finding(
@@ -677,7 +665,8 @@ def _analysis(ctx: LintContext) -> dict:
                     "stalled peer wedges every request contending this "
                     "lock; move the call outside the critical section "
                     "or use a per-resource lock"
-                    % (site.label, fi.name, lock, entry)))
+                    % (site.label, fi.name, lock, entry),
+                    related=entry_rel))
     bucket["deadline_findings"] = deadline
     bucket["hold_findings"] = hold
     bucket["request_sites"] = request_sites

@@ -42,6 +42,9 @@ BUILTIN_METHODS = frozenset({
     "insert", "clear", "setdefault", "read", "write", "readlines",
     "close", "open", "run", "send", "recv", "next", "flush", "reverse",
     "title", "search", "match", "group", "groups", "mark",
+    # ndarray/jax-array reducers and casts: `keep.sum()` on a numpy
+    # mask must not resolve to a scanned class's sum() method.
+    "sum", "mean", "astype", "reshape", "tolist", "item",
 })
 
 

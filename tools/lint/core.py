@@ -40,6 +40,10 @@ class Finding:
     line: int       # 1-based; 0 for whole-file findings
     rule: str
     message: str
+    # Interprocedural route to the finding: ((path, line, note), ...).
+    # Excluded from identity — the baseline and suppression story is
+    # unchanged; SARIF renders these as relatedLocations.
+    related: tuple = dataclasses.field(default=(), compare=False)
 
     @property
     def fingerprint(self) -> tuple[str, str, str]:
@@ -135,18 +139,20 @@ def _iter_py_files(paths: Iterable[str], root: str) -> list[str]:
 
 
 def get_analyzers() -> list[Analyzer]:
-    """All thirteen analyzers (imported lazily so `core` has no
+    """All fifteen analyzers (imported lazily so `core` has no
     circulars).
 
     The PR-2 four are per-file; the v2 three (shape/dtype abstract
     interpretation, request-field taint, resource-leak paths) run over
     the interprocedural call graph built once per LintContext, as do
     the v3 cache-coherence pass, the v4 pair (deadline discipline +
-    hold-lock-while-blocking, tools/lint/blocking.py), and the v5
-    order-contract pass (tools/lint/ordering.py).  metrics_schema is
-    per-file like config_schema, as is v5's failure_atomicity."""
+    hold-lock-while-blocking, tools/lint/blocking.py), the v5
+    order-contract pass (tools/lint/ordering.py), and the v6 pair
+    (effect contracts + explain dispatch purity, tools/lint/effects.py).
+    metrics_schema is per-file like config_schema, as is v5's
+    failure_atomicity."""
     from tools.lint import (blocking, cache_coherence, config_schema,
-                            exception_discipline, jax_hygiene,
+                            effects, exception_discipline, jax_hygiene,
                             lock_discipline, metrics_schema, ordering,
                             resource_leak, shape_dtype, taint)
     return [jax_hygiene.ANALYZER, lock_discipline.ANALYZER,
@@ -155,7 +161,8 @@ def get_analyzers() -> list[Analyzer]:
             taint.ANALYZER, resource_leak.ANALYZER,
             cache_coherence.ANALYZER, blocking.DEADLINE_ANALYZER,
             blocking.HOLD_LOCK_ANALYZER, ordering.ORDER_ANALYZER,
-            ordering.ATOMICITY_ANALYZER]
+            ordering.ATOMICITY_ANALYZER, effects.EFFECT_ANALYZER,
+            effects.PURITY_ANALYZER]
 
 
 ALL_ANALYZERS = get_analyzers

@@ -1,0 +1,998 @@
+"""Effect & purity contracts: static verification of the read-only
+consult surface (tsdblint v6).
+
+Two interprocedural analyzers share one whole-program pass over the
+PR 3 call graph:
+
+`effect_contract` — infers a per-function EFFECT SUMMARY to a fixpoint
+over call edges and checks it against the `# effects:` grammar
+(tools/lint/annotations.py).  Modeled effect classes:
+
+    write      assignment/augmented-assignment/delete of a `self`
+               attribute (including mutator-method calls — pop, update,
+               clear, append, move_to_end... — on a self attribute), and
+               rebinding of a `global`-declared module name.  A global
+               rebound only under its own emptiness check
+               (`if _CACHE is None:`) is a lazy-init memoization store
+               and is sanctioned.  `__init__` writing its own instance
+               is construction, not mutation (same exemption as
+               lock_discipline and tsdbsan).
+    counter    a call chain rooted at the `REGISTRY` name ending in
+               inc/dec/observe/set — prometheus counter/histogram/gauge
+               bumps (flight-recorder and jaxprof accounting reach this
+               class transitively through their own bodies).
+    lock       `with self._lock:` on a declared lock attribute (shared
+               ClassAnnotations), or `.acquire()` on one.
+    dispatch   a call rooted at the `jax`/`jnp` names, a call resolving
+               to the dispatch-gateway set (the exact functions
+               test_explain.py booby-traps), or a call of a module-level
+               `X = jax.jit(...)` binding.
+    permit     `.acquire(...)` on anything that is NOT a declared lock
+               attribute (admission permits block on capacity — an
+               explain or pure route must never take one), or any call
+               resolving into AdmissionGate.acquire.
+
+Summaries carry per-effect GATE SETS: an effect incurred under
+`if observe:` (or after an `if not observe: return` guard, or through a
+`refuse = real_fn if observe else (lambda...)` alias) is gated by
+`observe`.  At a call site the callee's gates map through the argument:
+passing a literal False drops the gated effects (the dry-run arm),
+passing one of the caller's own parameters re-gates them on it, and
+anything else conservatively promotes them to unconditional.  The
+fixpoint is union-only over a finite effect alphabet, so it converges.
+
+Contracts:  `pure` forbids everything; `reads-only` allows locks only;
+`observe-gated(p)` additionally allows write/counter effects gated by
+`p` (a leak of an ungated accounting effect is the dedicated
+`effect-observe-leak` rule — the one that fires when someone moves a
+demand observation out of the `if observe:` arm); `canonicalize`
+allows writes confined to the function's own class (Series
+normalization) and is how a value-preserving re-canonicalization is
+treated as a read by callers — the claim is itself verified here, not
+trusted.
+
+`dispatch_purity` — tree-level reachability: from the /api/query/explain
+entry (`QueryRpc.handle_explain`) and every `# effects: pure` function,
+walk ONLY unambiguous call edges (ordering's rule: an ambiguous
+devirtualization must not invent reachability) and report any dispatch
+(`dispatch-reachable`) or permit acquisition (`permit-reachable`) site
+in the closure.  This is deliberately redundant with `effect_contract`
+— the contracts guard the annotated arms under full union resolution,
+the reachability walk guards the whole explain subtree — so injecting a
+`jnp` call or a `permit.acquire` anywhere under handle_explain fails
+lint even if no annotated function is touched.
+
+tsdbsan's explain-sentinel (tools/sanitize/effects.py) is the dynamic
+twin: `static_effect_table()` exports the contract table + watched
+classes the runtime cross-checks armed-request events against.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.annotations import effects_annotation
+from tools.lint.astindex import get_ast_index
+from tools.lint.callgraph import get_callgraph, module_name
+from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
+
+RULE_VIOLATION = "effect-violation"
+RULE_LEAK = "effect-observe-leak"
+RULE_BAD = "effect-bad-annotation"
+RULE_DISPATCH = "dispatch-reachable"
+RULE_PERMIT = "permit-reachable"
+
+EFFECT_DIRS = ("opentsdb_tpu/",)
+
+# The /api/query/explain entry: everything reachable from here through
+# unambiguous call edges must be dispatch- and permit-free.
+ENTRY_QNAMES = ("opentsdb_tpu.tsd.rpcs.QueryRpc.handle_explain",)
+
+# The exact gateway set tests/test_explain.py booby-traps: every device
+# dispatch in the query path funnels through one of these.
+DISPATCH_GATEWAYS = frozenset({
+    "opentsdb_tpu.ops.pipeline.run_pipeline",
+    "opentsdb_tpu.ops.pipeline.run_group_pipeline",
+    "opentsdb_tpu.ops.pipeline.run_union_batch_pipeline",
+    "opentsdb_tpu.ops.pipeline.run_grid_tail",
+    "opentsdb_tpu.ops.pipeline.run_downsample_grid",
+    "opentsdb_tpu.ops.pipeline.build_batch",
+    "opentsdb_tpu.ops.pipeline.build_batch_direct",
+    "opentsdb_tpu.ops.tiling.run_tiled",
+    "opentsdb_tpu.storage.device_cache._gather_windows",
+    "opentsdb_tpu.ops.streaming.StreamAccumulator.create",
+})
+
+PERMIT_QNAMES = frozenset({
+    "opentsdb_tpu.tsd.admission.AdmissionGate.acquire",
+})
+
+_JAX_ROOTS = frozenset({"jax", "jnp"})
+
+# `jax.*` calls that interrogate device topology or configure the
+# runtime rather than dispatching compute.  The explain path is allowed
+# to ask WHICH backend will serve a plan (platform pricing needs it) —
+# it must never hand the backend work.  `jnp.*` is always compute.
+_JAX_METADATA = frozenset({
+    "devices", "local_devices", "device_count", "local_device_count",
+    "default_backend", "process_index", "process_count",
+})
+_JAX_INFRA_NS = frozenset({"config", "distributed"})
+_COUNTER_TAILS = frozenset({"inc", "dec", "observe", "set"})
+_MUTATORS = frozenset({
+    "pop", "popitem", "clear", "update", "setdefault", "append",
+    "appendleft", "extend", "extendleft", "add", "remove", "discard",
+    "insert", "sort", "reverse", "move_to_end",
+})
+
+_SANCTIONED = {"write", "counter"}      # gateable accounting classes
+
+
+# --------------------------------------------------------------------- #
+# Effect summaries                                                      #
+# --------------------------------------------------------------------- #
+#
+# A summary maps (kind, detail) -> _Eff.  `gates` is the set of boolean
+# parameter names that must ALL be truthy for the effect to fire — an
+# empty set means unconditional.  Merging two occurrences intersects
+# the gates (the effect fires if either occurrence does), which only
+# shrinks — together with the grow-only effect set this makes the
+# interprocedural fixpoint monotone.
+
+class _Eff:
+    __slots__ = ("gates", "site", "origin", "via")
+
+    def __init__(self, gates: frozenset, site: tuple,
+                 origin: tuple, via: str | None = None):
+        self.gates = gates
+        self.site = site            # (path, line) where incurred locally
+        self.origin = origin        # (path, line) of the primitive effect
+        self.via = via              # callee qname it arrived through
+
+    def merged(self, other: "_Eff") -> "_Eff":
+        gates = self.gates & other.gates
+        keep = self if len(self.gates) <= len(other.gates) else other
+        if gates == keep.gates:
+            return keep
+        return _Eff(gates, keep.site, keep.origin, keep.via)
+
+
+class _CallSite:
+    __slots__ = ("call", "targets", "gates", "force_gates")
+
+    def __init__(self, call: ast.Call, targets: list, gates: frozenset,
+                 force_gates: frozenset | None = None):
+        self.call = call
+        self.targets = targets      # list[FuncInfo]
+        self.gates = gates          # ambient gates at the call site
+        self.force_gates = force_gates  # gated-callable alias (IfExp)
+
+
+def _root_name(expr) -> str | None:
+    """The leftmost Name of an attribute/call chain, or None."""
+    while True:
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            expr = expr.func
+        elif isinstance(expr, ast.Subscript):
+            expr = expr.value
+        elif isinstance(expr, ast.Name):
+            return expr.id
+        else:
+            return None
+
+
+def _self_attr(expr) -> str | None:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _self_attr_target(target) -> str | None:
+    """The self attribute a write target lands on, seeing through
+    subscripts (`self._blocks[key] = ...` writes `_blocks`)."""
+    while isinstance(target, ast.Subscript):
+        target = target.value
+    return _self_attr(target)
+
+
+class _FnScan:
+    """Direct effects + call sites of one function body."""
+
+    def __init__(self, an: "_Analysis", fi, src: SourceFile, cls):
+        self.an = an
+        self.fi = fi
+        self.src = src
+        self.cls = cls              # ClassAnnotations or None
+        self.effects: dict[tuple[str, str], _Eff] = {}
+        self.calls: list[_CallSite] = []
+        self.globals: set[str] = set()
+        self.aliases: dict[str, tuple[frozenset, ast.expr]] = {}
+        a = fi.node.args
+        self.params = frozenset(
+            p.arg for p in a.posonlyargs + a.args + a.kwonlyargs)
+        self.is_init = fi.name == "__init__"
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Global):
+                self.globals.update(node.names)
+        self.visit_block(fi.node.body, frozenset(), frozenset())
+
+    # -- recording --------------------------------------------------------
+
+    def add(self, kind: str, detail: str, gates: frozenset,
+            line: int) -> None:
+        key = (kind, detail)
+        eff = _Eff(gates, (self.src.path, line), (self.src.path, line))
+        cur = self.effects.get(key)
+        self.effects[key] = eff if cur is None else cur.merged(eff)
+
+    def _write_detail(self, attr: str) -> str:
+        owner = self.fi.klass or module_name(self.src.path)
+        return "%s.%s" % (owner, attr)
+
+    # -- statement walk ---------------------------------------------------
+
+    def visit_block(self, stmts, gates: frozenset,
+                    sanctioned: frozenset) -> None:
+        """`gates` = observe-style parameter guards dominating this
+        block; `sanctioned` = global names whose lazy-init store is
+        currently allowed (inside their own `is None` check)."""
+        gates_now = gates
+        for st in stmts:
+            self.visit_stmt(st, gates_now, sanctioned)
+            # `if not observe: return` dominates the rest of the block
+            g = self._early_out_gate(st)
+            if g is not None:
+                gates_now = gates_now | {g}
+            # `if _LOADED: return ...` on a global flag: the rest of
+            # the block runs once per process — its global stores are
+            # lazy-init memoization, not effects
+            if self._once_only_guard(st):
+                sanctioned = sanctioned | self.globals
+
+    def _early_out_gate(self, st) -> str | None:
+        if not isinstance(st, ast.If) or st.orelse:
+            return None
+        t = st.test
+        if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not) \
+                and isinstance(t.operand, ast.Name) \
+                and t.operand.id in self.params \
+                and st.body and isinstance(st.body[-1],
+                                           (ast.Return, ast.Raise,
+                                            ast.Continue)):
+            return t.operand.id
+        return None
+
+    def _once_only_guard(self, st) -> bool:
+        if not isinstance(st, ast.If) or st.orelse:
+            return False
+        if not (st.body and isinstance(st.body[-1], ast.Return)):
+            return False
+        t = st.test
+        if isinstance(t, ast.Name):
+            return t.id in self.globals
+        return isinstance(t, ast.Compare) and len(t.ops) == 1 \
+            and isinstance(t.ops[0], ast.IsNot) \
+            and isinstance(t.left, ast.Name) \
+            and t.left.id in self.globals \
+            and isinstance(t.comparators[0], ast.Constant) \
+            and t.comparators[0].value is None
+
+    def _test_gates(self, test) -> frozenset:
+        """Parameter names a positive branch of `test` is gated by."""
+        names: set[str] = set()
+        exprs = test.values if isinstance(test, ast.BoolOp) and \
+            isinstance(test.op, ast.And) else [test]
+        for e in exprs:
+            if isinstance(e, ast.Name) and e.id in self.params:
+                names.add(e.id)
+        return frozenset(names)
+
+    def _lazy_init_names(self, test) -> frozenset:
+        """Global names whose rebinding under this test is a sanctioned
+        lazy-init store: `if G is None:` / `if not G:` / `if G is None
+        or ...`."""
+        names: set[str] = set()
+        exprs = test.values if isinstance(test, ast.BoolOp) else [test]
+        for e in exprs:
+            if isinstance(e, ast.Compare) and len(e.ops) == 1 \
+                    and isinstance(e.ops[0], ast.Is) \
+                    and isinstance(e.left, ast.Name) \
+                    and isinstance(e.comparators[0], ast.Constant) \
+                    and e.comparators[0].value is None:
+                names.add(e.left.id)
+            elif isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.Not) \
+                    and isinstance(e.operand, ast.Name):
+                names.add(e.operand.id)
+        return frozenset(names & self.globals)
+
+    def visit_stmt(self, st, gates: frozenset,
+                   sanctioned: frozenset) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return                  # nested defs contribute when called
+        if isinstance(st, ast.If):
+            pos = gates | self._test_gates(st.test)
+            body_sanction = sanctioned | self._lazy_init_names(st.test)
+            self.visit_block(st.body, pos, body_sanction)
+            self.visit_block(st.orelse, gates, sanctioned)
+            self.scan_exprs([st.test], gates, sanctioned)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and self._is_lock_attr(attr):
+                    self.add("lock", self._write_detail(attr),
+                             frozenset(), item.context_expr.lineno)
+                else:
+                    self.scan_exprs([item.context_expr], gates,
+                                    sanctioned)
+            self.visit_block(st.body, gates, sanctioned)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            ctrl = getattr(st, "iter", None) or getattr(st, "test", None)
+            self.scan_exprs([ctrl], gates, sanctioned)
+            self.visit_block(st.body, gates, sanctioned)
+            self.visit_block(st.orelse, gates, sanctioned)
+            return
+        if isinstance(st, ast.Try):
+            self.visit_block(st.body, gates, sanctioned)
+            for h in st.handlers:
+                self.visit_block(h.body, gates, sanctioned)
+            self.visit_block(st.orelse, gates, sanctioned)
+            self.visit_block(st.finalbody, gates, sanctioned)
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._visit_assign(st, gates, sanctioned)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                attr = _self_attr_target(t)
+                if attr is not None and not self.is_init:
+                    self.add("write", self._write_detail(attr), gates,
+                             st.lineno)
+            return
+        self.scan_exprs([st], gates, sanctioned)
+
+    def _visit_assign(self, st, gates: frozenset,
+                      sanctioned: frozenset) -> None:
+        targets = st.targets if isinstance(st, ast.Assign) else \
+            [st.target]
+        for t in targets:
+            parts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                else [t]
+            for p in parts:
+                attr = _self_attr_target(p)
+                if attr is not None:
+                    if not (self.is_init or self._is_lock_decl(st)):
+                        self.add("write", self._write_detail(attr),
+                                 gates, st.lineno)
+                elif isinstance(p, ast.Name) and p.id in self.globals \
+                        and p.id not in sanctioned:
+                    self.add("write", "%s.%s"
+                             % (module_name(self.src.path), p.id),
+                             gates, st.lineno)
+        value = getattr(st, "value", None)
+        # `refuse = count_refusal if observe else (lambda...)`: calls of
+        # the alias are gated by the test parameter
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and isinstance(value, ast.IfExp):
+            g = self._test_gates(value.test)
+            if g and isinstance(value.body, (ast.Name, ast.Attribute)):
+                self.aliases[st.targets[0].id] = (gates | g, value.body)
+                self.scan_exprs([value.orelse], gates, sanctioned)
+                return
+        self.scan_exprs([value], gates, sanctioned)
+
+    @staticmethod
+    def _is_lock_decl(st) -> bool:
+        value = getattr(st, "value", None)
+        return isinstance(value, ast.Call) and \
+            _root_name(value.func) in ("threading",) or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in ("Lock", "RLock"))
+
+    def _is_lock_attr(self, attr: str) -> bool:
+        if self.cls is not None and attr in self.cls.locks:
+            return True
+        return "lock" in attr.lower()
+
+    # -- expression scan (calls) ------------------------------------------
+
+    def scan_exprs(self, exprs, gates: frozenset,
+                   sanctioned: frozenset) -> None:
+        for e in exprs:
+            if e is None:
+                continue
+            for node in ast.walk(e):
+                if isinstance(node, (ast.Lambda,)):
+                    continue
+                if isinstance(node, ast.Call):
+                    self._visit_call(node, gates)
+
+    def _visit_call(self, call: ast.Call, gates: frozenset) -> None:
+        f = call.func
+        root = _root_name(f)
+        if isinstance(f, ast.Attribute):
+            if root in _JAX_ROOTS:
+                if not self._jax_metadata(f, root):
+                    self.add("dispatch", "%s.%s" % (root, f.attr),
+                             gates, call.lineno)
+                return
+            if f.attr in _COUNTER_TAILS and root == "REGISTRY":
+                self.add("counter", self._metric_name(call), gates,
+                         call.lineno)
+                return
+            if f.attr == "acquire":
+                attr = _self_attr(f.value)
+                if attr is not None and self._is_lock_attr(attr):
+                    self.add("lock", self._write_detail(attr), gates,
+                             call.lineno)
+                elif root is not None and "lock" in root.lower():
+                    self.add("lock", root, gates, call.lineno)
+                else:
+                    self.add("permit",
+                             ast.unparse(f.value)
+                             if hasattr(ast, "unparse") else "acquire",
+                             gates, call.lineno)
+                return
+            attr = _self_attr(f.value)
+            if attr is not None and f.attr in _MUTATORS \
+                    and not self.is_init:
+                self.add("write", self._write_detail(attr), gates,
+                         call.lineno)
+                return
+            # mutator on a deeper self chain: self._x[y].append(...)
+            deep = _self_attr_target(f.value)
+            if deep is not None and f.attr in _MUTATORS \
+                    and not self.is_init:
+                self.add("write", self._write_detail(deep), gates,
+                         call.lineno)
+                return
+        if isinstance(f, ast.Name):
+            alias = self.aliases.get(f.id)
+            if alias is not None:
+                force, target = alias
+                fake = ast.Call(func=target, args=call.args,
+                                keywords=call.keywords)
+                ast.copy_location(fake, call)
+                targets = [i for i, _c, _n in
+                           self.an.graph.resolve(fake, self.fi)
+                           if i is not None]
+                if targets:
+                    self.calls.append(_CallSite(call, targets,
+                                                gates, force))
+                return
+            if self.an.is_jit_binding(self.fi.module, f.id):
+                self.add("dispatch", "jit:%s" % f.id, gates,
+                         call.lineno)
+                return
+        targets = [i for i, _c, _n in
+                   self.an.graph.resolve(call, self.fi)
+                   if i is not None]
+        if targets:
+            for info in targets:
+                if info.qname in self.an.gateways:
+                    self.add("dispatch", info.qname, gates, call.lineno)
+                if info.qname in self.an.permit_qnames:
+                    self.add("permit", info.qname, gates, call.lineno)
+            self.calls.append(_CallSite(call, targets, gates))
+
+    @staticmethod
+    def _jax_metadata(f: ast.Attribute, root: str) -> bool:
+        if root != "jax":
+            return False
+        if f.attr in _JAX_METADATA:
+            return True
+        chain = []
+        node = f
+        while isinstance(node, ast.Attribute):
+            chain.append(node.attr)
+            node = node.value
+        # jax.config.update / jax.distributed.initialize: runtime
+        # configuration, not compute
+        return len(chain) >= 2 and chain[-1] in _JAX_INFRA_NS
+
+    def _metric_name(self, call: ast.Call) -> str:
+        for node in ast.walk(call):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("counter", "histogram",
+                                           "gauge") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                return node.args[0].value
+        return "REGISTRY"
+
+
+# --------------------------------------------------------------------- #
+# Whole-program pass                                                    #
+# --------------------------------------------------------------------- #
+
+_MAX_ROUNDS = 30
+
+
+class _Analysis:
+    def __init__(self, ctx: LintContext):
+        bucket = ctx.bucket("effects")
+        self.graph = get_callgraph(ctx)
+        self.index = get_ast_index(ctx)
+        self.dirs = tuple(bucket.get("paths", EFFECT_DIRS))
+        self.entry_qnames = tuple(
+            bucket.get("entry_qnames", ENTRY_QNAMES))
+        self.gateways = frozenset(
+            bucket.get("gateways", DISPATCH_GATEWAYS))
+        self.permit_qnames = frozenset(
+            bucket.get("permit_qnames", PERMIT_QNAMES))
+        self.scans: dict[str, _FnScan] = {}
+        self.summaries: dict[str, dict] = {}
+        self.contracts: dict[str, tuple] = {}  # qname -> (contract, gate,
+        #                                        fi, src, def line)
+        self.bad: list[tuple] = []             # (fi, src, line, why)
+        self._jit: dict[str, set[str]] = {}
+        self.run(ctx)
+
+    def in_scope(self, path: str) -> bool:
+        return path.startswith(self.dirs) or \
+            any(d in path for d in self.dirs)
+
+    def is_jit_binding(self, module: str, name: str) -> bool:
+        return name in self._jit.get(module, ())
+
+    # -- annotation discovery ---------------------------------------------
+
+    def _contract_for(self, fi, src: SourceFile):
+        """The `# effects:` annotation attached to a def: inline on the
+        def line, or on comment lines directly above it (decorators
+        may sit in between)."""
+        line = fi.node.lineno
+        found = effects_annotation(src.lines[line - 1]) \
+            if line <= len(src.lines) else None
+        at = line
+        if found is None:
+            i = min(line, *[d.lineno for d in fi.node.decorator_list]) \
+                if fi.node.decorator_list else line
+            i -= 2                  # 0-based index of the line above
+            while i >= 0:
+                text = src.lines[i].strip()
+                if text.startswith("@"):
+                    i -= 1
+                    continue
+                if text.startswith("#"):
+                    found = effects_annotation(text)
+                    if found is not None:
+                        at = i + 1
+                        break
+                    i -= 1
+                    continue
+                break
+        return found, at
+
+    # -- the pass ---------------------------------------------------------
+
+    def run(self, ctx: LintContext) -> None:
+        in_scope = [s for s in ctx.files if self.in_scope(s.path)]
+        by_path = {s.path: s for s in in_scope}
+        for src in in_scope:
+            mod = module_name(src.path)
+            jit = self._jit.setdefault(mod, set())
+            for node in src.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call) \
+                        and _root_name(node.value.func) in _JAX_ROOTS \
+                        and isinstance(node.value.func, ast.Attribute) \
+                        and node.value.func.attr == "jit":
+                    jit.add(node.targets[0].id)
+        # function scans (top-level + methods + one level of nesting)
+        for src in in_scope:
+            mod = self.graph.modules.get(module_name(src.path))
+            if mod is None:
+                continue
+            fns = list(mod.functions.values())
+            for methods in mod.classes.values():
+                fns.extend(methods.values())
+            for fi in fns:
+                for nested in fi.nested.values():
+                    self._scan(nested, src)
+                self._scan(fi, src)
+        # contract discovery
+        for q, scan in self.scans.items():
+            if ".<nested>." in q:
+                continue
+            fi, src = scan.fi, scan.src
+            found, at = self._contract_for(fi, src)
+            if found is None:
+                continue
+            contract, gate = found
+            if contract == "observe-gated":
+                if gate is None:
+                    self.bad.append((fi, src, at,
+                                     "observe-gated needs a parameter, "
+                                     "e.g. observe-gated(observe)"))
+                    continue
+                if gate not in scan.params:
+                    self.bad.append((fi, src, at,
+                                     "gate parameter '%s' is not a "
+                                     "parameter of this function"
+                                     % gate))
+                    continue
+            elif gate is not None:
+                self.bad.append((fi, src, at,
+                                 "'%s' takes no gate parameter"
+                                 % contract))
+                continue
+            self.contracts[q] = (contract, gate, fi, src, at)
+        # interprocedural fixpoint
+        for q, scan in self.scans.items():
+            self.summaries[q] = dict(scan.effects)
+        for _ in range(_MAX_ROUNDS):
+            if not self._propagate_round():
+                break
+
+    def _scan(self, fi, src: SourceFile) -> None:
+        cls = self.index.classes.get((src.path, fi.klass)) \
+            if fi.klass else None
+        self.scans[fi.qname] = _FnScan(self, fi, src, cls)
+
+    def _propagate_round(self) -> bool:
+        changed = False
+        for q, scan in self.scans.items():
+            summary = self.summaries[q]
+            for site in scan.calls:
+                for info in site.targets:
+                    if self.contracts.get(info.qname, ("",))[0] \
+                            == "canonicalize":
+                        continue    # verified value-preserving: a read
+                    callee = self.summaries.get(info.qname)
+                    if not callee:
+                        continue
+                    if self._merge_call(summary, scan, site, info,
+                                        callee):
+                        changed = True
+        return changed
+
+    def _merge_call(self, summary, scan: _FnScan, site: _CallSite,
+                    info, callee: dict) -> bool:
+        mapping = self._gate_mapping(site, info)
+        changed = False
+        for key, eff in callee.items():
+            gates: set[str] = set(site.gates)
+            if site.force_gates:
+                gates |= site.force_gates
+            dropped = False
+            for g in eff.gates:
+                mapped = mapping.get(g, None)
+                if mapped is _DROP:
+                    dropped = True
+                    break
+                if mapped is not None:
+                    gates.update(mapped)
+                # mapped None: promoted — contributes no gate
+            if dropped:
+                continue
+            new = _Eff(frozenset(gates),
+                       (scan.src.path, site.call.lineno),
+                       eff.origin, eff.via or info.qname)
+            cur = summary.get(key)
+            merged = new if cur is None else cur.merged(new)
+            if cur is None or merged.gates != cur.gates:
+                summary[key] = merged
+                changed = True
+        return changed
+
+    def _gate_mapping(self, site: _CallSite, info) -> dict:
+        """callee gate param -> _DROP | set of caller params | None
+        (promote)."""
+        call, params = site.call, info.params
+        offset = 0
+        if params and params[0] == "self" and (
+                isinstance(call.func, ast.Attribute)
+                or info.name == "__init__"):
+            offset = 1              # positional args align past `self`
+        out: dict = {}
+
+        def classify(expr):
+            if isinstance(expr, ast.Constant):
+                return _DROP if not expr.value else None
+            if isinstance(expr, ast.Name):
+                return {expr.id}
+            return None
+
+        kw_params = set(params) | {a.arg for a in
+                                   info.node.args.kwonlyargs}
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in kw_params:
+                out[kw.arg] = classify(kw.value)
+        for i, arg in enumerate(call.args):
+            pi = i + offset
+            if pi < len(params) and params[pi] not in out:
+                out[params[pi]] = classify(arg)
+        # unsupplied params fall back to their default
+        args = info.node.args
+        if args.defaults:
+            named = [a.arg for a in args.posonlyargs + args.args]
+            tail = named[len(named) - len(args.defaults):]
+            for p, d in zip(tail, args.defaults):
+                if p not in out and isinstance(d, ast.Constant) \
+                        and not d.value:
+                    out[p] = _DROP
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if a.arg not in out and isinstance(d, ast.Constant) \
+                    and not d.value:
+                out[a.arg] = _DROP
+        return out
+
+
+class _Drop:
+    pass
+
+
+_DROP = _Drop()
+
+
+def _analysis(ctx: LintContext) -> _Analysis:
+    bucket = ctx.bucket("effects")
+    if "analysis" not in bucket \
+            or bucket.get("nfiles") != len(ctx.files):
+        bucket["analysis"] = _Analysis(ctx)
+        bucket["nfiles"] = len(ctx.files)
+    return bucket["analysis"]
+
+
+# --------------------------------------------------------------------- #
+# effect_contract: contract checking                                    #
+# --------------------------------------------------------------------- #
+
+def _related(an: _Analysis, eff: _Eff) -> tuple:
+    """Related locations for one effect: the local site it was incurred
+    at, the callee it arrived through, and the primitive origin."""
+    out = [(eff.site[0], eff.site[1], "effect incurred here")]
+    if eff.via is not None:
+        info = an.graph.funcs.get(eff.via)
+        if info is not None:
+            out.append((info.path, info.node.lineno,
+                        "via '%s'" % eff.via))
+    if eff.origin != eff.site:
+        out.append((eff.origin[0], eff.origin[1], "primitive effect"))
+    return tuple(out)
+
+
+def _check_contracts(ctx: LintContext) -> list[Finding]:
+    an = _analysis(ctx)
+    findings: list[Finding] = []
+    for fi, src, line, why in an.bad:
+        findings.append(Finding(src.path, line, RULE_BAD,
+                                "malformed '# effects:' contract on "
+                                "'%s': %s" % (fi.qname, why)))
+    for q, (contract, gate, fi, src, _at) in sorted(an.contracts.items()):
+        summary = an.summaries.get(q, {})
+        for (kind, detail), eff in sorted(summary.items()):
+            via = " (via '%s')" % eff.via if eff.via else ""
+            rel = _related(an, eff)
+            if contract == "pure":
+                findings.append(Finding(
+                    src.path, fi.node.lineno, RULE_VIOLATION,
+                    "'%s' declares '# effects: pure' but has a %s "
+                    "effect on '%s'%s" % (q, kind, detail, via),
+                    related=rel))
+                continue
+            if kind == "lock" and contract in ("reads-only",
+                                               "observe-gated",
+                                               "canonicalize"):
+                continue
+            if contract == "reads-only":
+                findings.append(Finding(
+                    src.path, fi.node.lineno, RULE_VIOLATION,
+                    "'%s' declares '# effects: reads-only' but has a "
+                    "%s effect on '%s'%s" % (q, kind, detail, via),
+                    related=rel))
+                continue
+            if contract == "canonicalize":
+                own = fi.klass is not None and \
+                    detail.startswith(fi.klass + ".")
+                if kind == "write" and own:
+                    continue
+                findings.append(Finding(
+                    src.path, fi.node.lineno, RULE_VIOLATION,
+                    "'%s' declares '# effects: canonicalize' but has "
+                    "a %s effect on '%s'%s — canonicalization may "
+                    "only rewrite its own instance"
+                    % (q, kind, detail, via), related=rel))
+                continue
+            # observe-gated(gate)
+            if kind in _SANCTIONED:
+                if gate in eff.gates:
+                    continue
+                findings.append(Finding(
+                    src.path, fi.node.lineno, RULE_LEAK,
+                    "'%s' declares '# effects: observe-gated(%s)' but "
+                    "the %s effect on '%s' is not dominated by a "
+                    "check of '%s'%s — the observe=False dry-run arm "
+                    "would still mutate"
+                    % (q, gate, kind, detail, gate, via), related=rel))
+            else:
+                findings.append(Finding(
+                    src.path, fi.node.lineno, RULE_VIOLATION,
+                    "'%s' declares '# effects: observe-gated(%s)' but "
+                    "has a %s effect on '%s'%s — only gated "
+                    "accounting is sanctioned, never %s"
+                    % (q, gate, kind, detail, via, kind), related=rel))
+    return findings
+
+
+# --------------------------------------------------------------------- #
+# dispatch_purity: tree-level reachability                              #
+# --------------------------------------------------------------------- #
+
+def _unique_callees(an: _Analysis, scan: _FnScan) -> list:
+    """Unambiguous call targets only (ordering's rule): a call that
+    devirtualizes to several candidates creates no reachability."""
+    out = []
+    for site in scan.calls:
+        qnames = {i.qname for i in site.targets}
+        if len(qnames) == 1:
+            out.append(site.targets[0])
+    return out
+
+
+def _check_purity(ctx: LintContext) -> list[Finding]:
+    an = _analysis(ctx)
+    entries: list[str] = [q for q in an.entry_qnames if q in an.scans]
+    for q, (contract, _g, _fi, _src, _at) in an.contracts.items():
+        if contract == "pure" and q not in entries:
+            entries.append(q)
+    findings: list[Finding] = []
+    reported: set[tuple] = set()
+    for entry in sorted(entries):
+        seen: set[str] = set()
+        # qname -> (caller qname | None) for route reconstruction
+        parent: dict[str, str | None] = {entry: None}
+        queue = [entry]
+        while queue:
+            q = queue.pop(0)
+            if q in seen:
+                continue
+            seen.add(q)
+            scan = an.scans.get(q)
+            if scan is None:
+                continue
+            for (kind, detail), eff in sorted(scan.effects.items()):
+                if kind not in ("dispatch", "permit"):
+                    continue
+                rule = RULE_DISPATCH if kind == "dispatch" \
+                    else RULE_PERMIT
+                key = (entry, q, kind, detail)
+                if key in reported:
+                    continue
+                reported.add(key)
+                chain = _route(parent, q)
+                rel = tuple(
+                    (an.scans[p].src.path, an.scans[p].fi.node.lineno,
+                     "reached through '%s'" % p)
+                    for p in chain if p in an.scans)
+                what = "a device dispatch" if kind == "dispatch" \
+                    else "an admission-permit acquisition"
+                findings.append(Finding(
+                    eff.site[0], eff.site[1], rule,
+                    "%s ('%s') in '%s' is reachable from the "
+                    "dispatch-free entry '%s' (route: %s)"
+                    % (what, detail, q, entry, " -> ".join(chain)),
+                    related=rel))
+            for info in _unique_callees(an, scan):
+                if info.qname not in seen:
+                    parent.setdefault(info.qname, q)
+                    queue.append(info.qname)
+    return findings
+
+
+def _route(parent: dict, q: str) -> list[str]:
+    chain = [q]
+    while parent.get(chain[-1]) is not None:
+        chain.append(parent[chain[-1]])
+    return list(reversed(chain))
+
+
+# --------------------------------------------------------------------- #
+# tsdbsan export                                                        #
+# --------------------------------------------------------------------- #
+
+def static_effect_table() -> dict:
+    """{qname -> (contract, gate)} + the watched class set for the
+    runtime explain-sentinel, from a fast standalone regex+AST scan of
+    the default effect dirs (NOT a lint run — mirrors
+    ordering.static_order_table)."""
+    import os
+
+    from tools.lint.core import REPO_ROOT
+    contracts: dict[str, tuple] = {}
+    watched: set[str] = set()
+    for d in EFFECT_DIRS:
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(REPO_ROOT, d)):
+            dirnames[:] = [n for n in dirnames if n != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                abspath = os.path.join(dirpath, fn)
+                rel = os.path.relpath(abspath, REPO_ROOT).replace(
+                    os.sep, "/")
+                try:
+                    with open(abspath, "r", encoding="utf-8") as fh:
+                        text = fh.read()
+                except OSError:
+                    continue
+                if "# effects:" not in text:
+                    continue
+                try:
+                    tree = ast.parse(text, filename=rel)
+                except SyntaxError:
+                    continue
+                lines = text.splitlines()
+                mod = module_name(rel)
+                _table_from_tree(tree, lines, mod, contracts, watched)
+    return {"contracts": contracts, "watched_classes": sorted(watched)}
+
+
+def _table_from_tree(tree, lines, mod, contracts, watched) -> None:
+    def visit(body, scope):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                visit(node.body, scope + [node.name])
+                continue
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            found = _def_annotation(lines, node)
+            if found is None:
+                continue
+            contract, gate = found
+            qname = ".".join([mod] + scope + [node.name])
+            contracts[qname] = (contract, gate)
+            if scope and contract in ("reads-only", "observe-gated"):
+                watched.add(scope[-1])
+    visit(tree.body, [])
+
+
+def _def_annotation(lines, node):
+    if node.lineno <= len(lines):
+        found = effects_annotation(lines[node.lineno - 1])
+        if found is not None:
+            return found
+    i = min([node.lineno] + [d.lineno for d in node.decorator_list]) - 2
+    while i >= 0:
+        text = lines[i].strip()
+        if text.startswith("@"):
+            i -= 1
+            continue
+        if text.startswith("#"):
+            found = effects_annotation(text)
+            if found is not None:
+                return found
+            i -= 1
+            continue
+        break
+    return None
+
+
+# --------------------------------------------------------------------- #
+# Analyzers                                                             #
+# --------------------------------------------------------------------- #
+
+def _no_check(src: SourceFile, ctx: LintContext) -> list[Finding]:
+    return []
+
+
+EFFECT_ANALYZER = Analyzer(
+    "effect_contract", (RULE_VIOLATION, RULE_LEAK, RULE_BAD),
+    _no_check, _check_contracts)
+
+PURITY_ANALYZER = Analyzer(
+    "dispatch_purity", (RULE_DISPATCH, RULE_PERMIT),
+    _no_check, _check_purity)

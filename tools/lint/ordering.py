@@ -95,8 +95,9 @@ import ast
 
 from tools.lint.annotations import (ClassAnnotations, atomic_annotation,
                                     install_annotation, order_contracts,
-                                    order_events, scan_class_annotations,
+                                    order_events,
                                     self_attr as _self_attr)
+from tools.lint.astindex import class_annotations, get_ast_index
 from tools.lint.callgraph import get_callgraph, module_name
 from tools.lint.core import Analyzer, Finding, LintContext, SourceFile
 
@@ -152,6 +153,7 @@ class _OrderAnalysis:
         self.graph = get_callgraph(ctx)
         self.dirs = tuple(bucket.get("paths", ORDERING_DIRS))
         self.contracts: list[tuple[str, str]] = []
+        self.contract_sites: dict[tuple[str, str], tuple[str, int]] = {}
         self.events: set[str] = set()
         self.fns: dict[str, tuple] = {}        # qname -> (fi, src, cls)
         self.fn_emits: dict[str, frozenset] = {}
@@ -211,18 +213,15 @@ class _OrderAnalysis:
         in_scope = [s for s in ctx.files if self.in_scope(s.path)]
         seen: set[tuple[str, str]] = set()
         for src in in_scope:
-            for line in src.lines:
+            for lineno, line in enumerate(src.lines, start=1):
                 for pair in order_contracts(line):
                     if pair not in seen:
                         seen.add(pair)
                         self.contracts.append(pair)
+                        self.contract_sites[pair] = (src.path, lineno)
                 for name in order_events(line):
                     self.events.add(name)
-        for src in in_scope:
-            for node in ast.walk(src.tree):
-                if isinstance(node, ast.ClassDef):
-                    self._classes[(src.path, node.name)] = \
-                        scan_class_annotations(src.lines, node, src.path)
+        self._classes = get_ast_index(ctx).classes
         # collect functions + direct tags + edges
         direct: dict[str, set[str]] = {}
         edges: dict[str, set[str]] = {}
@@ -339,6 +338,10 @@ class _OrderAnalysis:
             walker = _OrderWalk(self, fi, src, cls, active)
             walker.run()
             for line, (a, b) in walker.violations:
+                decl = self.contract_sites.get((a, b))
+                related = ((decl[0], decl[1],
+                            "contract '%s before %s' declared here"
+                            % (a, b)),) if decl else ()
                 findings.append(Finding(
                     fi.path, line, RULE_ORDER,
                     "event '%s' can be reached before '%s' in '%s' — "
@@ -346,7 +349,7 @@ class _OrderAnalysis:
                     "%s'; reorder so '%s' is discharged on every path "
                     "that crosses '%s' (or move the '# order-event' "
                     "tags with the code if the invariant moved)"
-                    % (b, a, fi.name, a, b, a, b)))
+                    % (b, a, fi.name, a, b, a, b), related=related))
         return findings
 
 
@@ -697,10 +700,11 @@ def _check_atomicity(src: SourceFile, ctx: LintContext) -> list[Finding]:
     if not (src.path.startswith(dirs) or any(d in src.path for d in dirs)):
         return []
     findings: list[Finding] = []
+    per_file = class_annotations(ctx, src)
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.ClassDef):
             continue
-        cls = scan_class_annotations(src.lines, node, src.path)
+        cls = per_file[node.name]
         groups: dict[str, set] = {}
         for attr, line in cls.init_lines.items():
             g = atomic_annotation(src.lines[line - 1]) if \

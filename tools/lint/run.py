@@ -10,6 +10,8 @@
     python tools/lint/run.py --no-baseline        # raw findings
     python tools/lint/run.py --update-doc         # regen docs/configuration.md
     python tools/lint/run.py --timings            # per-analyzer wall time
+    python tools/lint/run.py --only effect_contract,dispatch_purity
+                                                  # dev-loop subset
     python tools/lint/run.py path/to/file.py ...  # specific targets
 
 `--changed-only` still ANALYZES the whole tree (the interprocedural
@@ -65,6 +67,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the per-analyzer wall-time breakdown "
                          "(with --json: {\"findings\": ..., "
                          "\"timings\": ...})")
+    ap.add_argument("--only", default=None, metavar="ANALYZER[,ANALYZER]",
+                    help="run only the named analyzers (dev loop; "
+                         "composes with --changed-only/--timings). "
+                         "The pre-commit gate always runs all of them.")
     ap.add_argument("--update-doc", action="store_true",
                     help="regenerate docs/configuration.md from "
                          "CONFIG_SCHEMA and exit")
@@ -83,8 +89,20 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     paths = args.paths or DEFAULT_PATHS
+    analyzers = None
+    if args.only:
+        from tools.lint.core import get_analyzers
+        wanted = [n.strip() for n in args.only.split(",") if n.strip()]
+        by_name = {a.name: a for a in get_analyzers()}
+        unknown = [n for n in wanted if n not in by_name]
+        if unknown:
+            print("tsdblint: unknown analyzer(s): %s (known: %s)"
+                  % (", ".join(unknown), ", ".join(sorted(by_name))),
+                  file=sys.stderr)
+            return 2
+        analyzers = [by_name[n] for n in wanted]
     ctx = LintContext(REPO_ROOT)
-    findings = run_lint(paths, ctx=ctx)
+    findings = run_lint(paths, ctx=ctx, analyzers=analyzers)
     timings = dict(sorted(ctx.bucket("timings").items(),
                           key=lambda kv: -kv[1])) if args.timings else None
 

@@ -33,6 +33,15 @@ _RULE_DESCRIPTIONS = {
         "Request field sizes an allocation with no limits sanitizer",
     "resource-leak": "Acquired resource never reaches close/with/finally",
     "resource-leak-return": "Early return crosses a live resource",
+    "effect-violation":
+        "Transitive effects exceed the declared # effects: contract",
+    "effect-observe-leak":
+        "Accounting effect not dominated by the observe gate",
+    "effect-bad-annotation": "Malformed # effects: contract",
+    "dispatch-reachable":
+        "Device dispatch reachable from a dispatch-free entry",
+    "permit-reachable":
+        "Admission permit acquisition reachable from a read-only entry",
     "parse-error": "File failed to parse",
     # tsdbsan (tools/sanitize) — the runtime layer shares this emitter
     "san-unguarded-mutation":
@@ -44,6 +53,9 @@ _RULE_DESCRIPTIONS = {
     "san-host-sync": "Unsanctioned device->host transfer in steady state",
     "san-stale-static-edge": "Static lock-order edge never observed",
     "san-lint-gap": "Runtime lock-order edge invisible to lint",
+    "san-effect-violation":
+        "Runtime effect on an explain-tagged request outside the "
+        "static contract",
 }
 
 
@@ -61,21 +73,35 @@ def to_sarif(findings, analyzers, tool_name: str = "tsdblint",
             "text": _RULE_DESCRIPTIONS.get(rid, rid)},
     } for rid in rule_ids]
     index = {rid: i for i, rid in enumerate(rule_ids)}
-    results = [{
-        "ruleId": f.rule,
-        "ruleIndex": index[f.rule],
-        "level": levels.get(f.fingerprint, "error"),
-        "message": {"text": f.message},
-        "locations": [{
-            "physicalLocation": {
-                # repo-relative URI, no originalUriBaseIds: the consumer
-                # (code-scanning upload, SARIF viewer workspace root)
-                # resolves against its own checkout
-                "artifactLocation": {"uri": f.path},
-                "region": {"startLine": max(f.line, 1)},
-            },
-        }],
-    } for f in findings]
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": levels.get(f.fingerprint, "error"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    # repo-relative URI, no originalUriBaseIds: the
+                    # consumer (code-scanning upload, SARIF viewer
+                    # workspace root) resolves against its own checkout
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+        }
+        related = getattr(f, "related", ())
+        if related:
+            # the interprocedural route to the sink (call chain, effect
+            # origin) — viewers show the path, not just the last line
+            result["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": path},
+                    "region": {"startLine": max(line, 1)},
+                },
+                "message": {"text": note},
+            } for path, line, note in related]
+        results.append(result)
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,

@@ -580,13 +580,16 @@ class _FnPass:
             if lab[0] == "p":
                 self.summary.unsan_params.add(lab[1])
             elif getattr(self, "emit", False):
-                _oline, desc = self.origins.get(lab, (line, "request data"))
+                oline, desc = self.origins.get(lab, (line, "request data"))
+                related = ((self.fi.path, oline,
+                            "tainted value originates here"),) \
+                    if oline != line else ()
                 self.findings.append(Finding(
                     self.fi.path, line, RULE_TAINT,
                     "%s in '%s' is sized by %s with no limits sanitizer "
                     "on the route — charge a QueryBudget or clamp "
                     "(min/limits.get_*_limit guard) before allocating"
-                    % (what, self.fi.name, desc)))
+                    % (what, self.fi.name, desc), related=related))
 
     def _check_call_edge(self, call: ast.Call) -> None:
         """Tainted arg passed to a callee whose param reaches a sink."""
@@ -609,8 +612,17 @@ class _FnPass:
                     if lab[0] == "p":
                         self.summary.unsan_params.add(lab[1])
                     elif getattr(self, "emit", False):
-                        _l, desc = self.origins.get(
+                        oline, desc = self.origins.get(
                             lab, (call.lineno, "request data"))
+                        related = []
+                        if oline != call.lineno:
+                            related.append(
+                                (self.fi.path, oline,
+                                 "tainted value originates here"))
+                        related.append(
+                            (info.path, info.node.lineno,
+                             "unsanitized parameter '%s' of '%s'"
+                             % (p, info.qname)))
                         self.findings.append(Finding(
                             self.fi.path, call.lineno, RULE_TAINT,
                             "%s flows from '%s' into '%s' parameter "
@@ -618,7 +630,8 @@ class _FnPass:
                             "loop-bound sink with no limits sanitizer "
                             "on the route — charge a QueryBudget or "
                             "clamp before the call"
-                            % (desc, self.fi.name, info.name, p)))
+                            % (desc, self.fi.name, info.name, p),
+                            related=tuple(related)))
 
     def _propagate_param_types(self) -> None:
         for node in ast.walk(self.fi.node):

@@ -1,0 +1,262 @@
+"""Explain-sentinel: the dynamic half of effect_contract.
+
+The static analyzer (tools/lint/effects.py) proves the `# effects:`
+contracts over the call tree; this module watches REAL explain-tagged
+requests.  While a thread is inside `explain_query` (the whole
+/api/query/explain consult surface) the sentinel is ARMED:
+
+  * the lockset write-interception layer (the `__setattr__` wrapper
+    every guarded class already carries) forwards each attribute store
+    here via `note_write` — a cheap dict insert, no tree walk;
+  * the booby-trapped dispatch gateways (the exact set
+    tests/test_explain.py pins flat) and `AdmissionGate.acquire` are
+    wrapped as sentinels via the same PATCH_TABLE mechanism the order
+    recorder uses.
+
+Events are recorded deduplicated by (kind, detail) and cross-checked
+against the static contract table at session finish
+(`static_effect_table()` — contracts + the classes whose read-only
+promise the lint verified).  The filter runs THERE, not on the write
+path: a sanctioned store (a QueryBudget charge, a Series
+canonicalization — `canonicalize` classes are deliberately absent from
+the watched set) costs one dict lookup while armed and nothing at
+finish, and a session that armed nothing returns without walking the
+tree.
+
+  san-effect-violation   an armed request wrote a watched class's
+                         attribute, dispatched through a gateway, or
+                         acquired an admission permit — an effect on
+                         the read-only consult surface the static
+                         verifier did not derive (monkey-patching,
+                         reflection, or a call path outside the lint's
+                         scope).  Note level: the static analyzer
+                         gates; the runtime check reports.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tools.sanitize.report import REPORTER, caller_site
+
+_RealLock = threading.Lock
+
+_state_lock = _RealLock()
+# (kind, detail) -> (path, line); kind in {"write", "dispatch", "permit"}
+_events: dict[tuple[str, str], tuple[str, int]] = {}
+
+_enabled = False
+_static_table: dict | None = None
+
+_armed = threading.local()
+
+# module -> ((holder, attr-or-None, kind, detail), ...).  holder None =
+# a module-level function; else (class name, method name).  These are
+# the dispatch gateways the explain tests booby-trap, plus the
+# admission permit — entering one while armed IS the finding.
+PATCH_TABLE: dict[str, tuple] = {
+    "opentsdb_tpu.ops.pipeline": tuple(
+        (None, fn, "dispatch", "pipeline.%s" % fn)
+        for fn in ("run_pipeline", "run_group_pipeline",
+                   "run_union_batch_pipeline", "run_grid_tail",
+                   "run_downsample_grid", "build_batch",
+                   "build_batch_direct")),
+    "opentsdb_tpu.ops.tiling": (
+        (None, "run_tiled", "dispatch", "tiling.run_tiled"),),
+    "opentsdb_tpu.storage.device_cache": (
+        (None, "_gather_windows", "dispatch",
+         "device_cache._gather_windows"),),
+    "opentsdb_tpu.ops.streaming": (
+        (("StreamAccumulator", "create"), None, "dispatch",
+         "StreamAccumulator.create"),),
+    "opentsdb_tpu.tsd.admission": (
+        (("AdmissionGate", "acquire"), None, "permit",
+         "AdmissionGate.acquire"),),
+}
+
+_ARM_MODULE = "opentsdb_tpu.query.explain"
+_ARM_FUNCTION = "explain_query"
+
+# (owner object, attr name, original) for unpatch_all()
+_patched: list[tuple[object, str, object]] = []
+
+
+def configure(enabled: bool) -> None:
+    global _enabled
+    _enabled = enabled
+
+
+def reset() -> None:
+    with _state_lock:
+        _events.clear()
+
+
+def snapshot_state() -> dict:
+    with _state_lock:
+        return dict(_events)
+
+
+def restore_state(snapshot: dict) -> None:
+    with _state_lock:
+        _events.clear()
+        _events.update(snapshot)
+
+
+# --------------------------------------------------------------------- #
+# Arming + recording                                                    #
+# --------------------------------------------------------------------- #
+
+def armed() -> bool:
+    return _enabled and getattr(_armed, "depth", 0) > 0
+
+
+def _record(kind: str, detail: str, skip: int = 0) -> None:
+    key = (kind, detail)
+    with _state_lock:
+        known = key in _events
+    if known:
+        return
+    path, line, _fn = caller_site(skip + 1)
+    with _state_lock:
+        _events.setdefault(key, (path, line))
+
+
+def note_write(cls_name: str, attr: str) -> None:
+    """Called by the lockset __setattr__ layer for every tracked store.
+    The armed() guard is the caller's fast path; here we only dedup and
+    anchor.  Filtering against the watched-class set happens at
+    cross_check — this must stay O(1) per store."""
+    _record("write", "%s.%s" % (cls_name, attr), skip=1)
+
+
+def events() -> dict[tuple[str, str], tuple[str, int]]:
+    with _state_lock:
+        return dict(_events)
+
+
+# --------------------------------------------------------------------- #
+# Instrumentation                                                       #
+# --------------------------------------------------------------------- #
+
+def instrument_module(mod) -> int:
+    """Wrap this module's sentinel entries (idempotent): the arming
+    wrapper on `explain_query`, dispatch gateways, and the admission
+    permit.  Returns the number of objects newly wrapped."""
+    name = getattr(mod, "__name__", "")
+    wrapped = 0
+    if name == _ARM_MODULE:
+        orig = mod.__dict__.get(_ARM_FUNCTION)
+        if callable(orig) and not getattr(orig, "_tsdbsan_effects",
+                                          False):
+            setattr(mod, _ARM_FUNCTION, _arming_wrap(orig))
+            _patched.append((mod, _ARM_FUNCTION, orig))
+            wrapped += 1
+    for holder, meth, kind, detail in PATCH_TABLE.get(name, ()):
+        if holder is None:
+            owner, attr = mod, meth
+            orig = mod.__dict__.get(meth)
+        else:
+            cls_name, attr = holder
+            owner = getattr(mod, cls_name, None)
+            if not isinstance(owner, type):
+                continue
+            orig = owner.__dict__.get(attr)
+            # classmethod/staticmethod wrappers: sentinel the inner
+            # callable, re-wrap on the way back in
+            if isinstance(orig, (classmethod, staticmethod)):
+                inner = orig.__func__
+                if getattr(inner, "_tsdbsan_effects", False):
+                    continue
+                probe = _sentinel_wrap(inner, kind, detail)
+                setattr(owner, attr, type(orig)(probe))
+                _patched.append((owner, attr, orig))
+                wrapped += 1
+                continue
+        if not callable(orig) or getattr(orig, "_tsdbsan_effects",
+                                         False):
+            continue
+        setattr(owner, attr, _sentinel_wrap(orig, kind, detail))
+        _patched.append((owner, attr, orig))
+        wrapped += 1
+    return wrapped
+
+
+def _arming_wrap(orig):
+    def wrapper(*args, **kwargs):
+        _armed.depth = getattr(_armed, "depth", 0) + 1
+        try:
+            return orig(*args, **kwargs)
+        finally:
+            _armed.depth -= 1
+    wrapper._tsdbsan_effects = True
+    wrapper.__name__ = getattr(orig, "__name__", _ARM_FUNCTION)
+    wrapper.__doc__ = getattr(orig, "__doc__", None)
+    return wrapper
+
+
+def _sentinel_wrap(orig, kind: str, detail: str):
+    def wrapper(*args, **kwargs):
+        if armed():
+            _record(kind, detail)
+        return orig(*args, **kwargs)
+    wrapper._tsdbsan_effects = True
+    wrapper.__name__ = getattr(orig, "__name__", detail)
+    wrapper.__doc__ = getattr(orig, "__doc__", None)
+    return wrapper
+
+
+def unpatch_all() -> None:
+    while _patched:
+        owner, attr, orig = _patched.pop()
+        setattr(owner, attr, orig)
+
+
+# --------------------------------------------------------------------- #
+# Static <-> dynamic cross-check                                        #
+# --------------------------------------------------------------------- #
+
+def static_table_cached() -> dict:
+    global _static_table
+    if _static_table is None:
+        from tools.lint.effects import static_effect_table
+        _static_table = static_effect_table()
+    return _static_table
+
+
+def cross_check(static_table: dict | None = None,
+                reporter=None) -> dict[str, list]:
+    """Diff armed-request events against the static contract table.
+    A session that armed nothing returns empty WITHOUT walking the
+    tree."""
+    local = events()
+    if not local:
+        return {"violations": []}
+    if static_table is None:
+        static_table = static_table_cached()
+    rep = reporter if reporter is not None else REPORTER
+    watched = set(static_table.get("watched_classes", ()))
+    violations: list[tuple[str, str]] = []
+    for (kind, detail), (path, line) in sorted(local.items()):
+        if kind == "write":
+            cls_name = detail.split(".", 1)[0]
+            if cls_name not in watched:
+                continue    # sanctioned store (budget charge,
+                #             canonicalization, non-contract class)
+            rep.add(path, line, "san-effect-violation",
+                    "an explain-tagged request wrote '%s' at runtime — "
+                    "the consult surface's read-only contract "
+                    "(verified statically by effect_contract) was "
+                    "violated on a real execution" % detail)
+        elif kind == "dispatch":
+            rep.add(path, line, "san-effect-violation",
+                    "an explain-tagged request entered dispatch "
+                    "gateway '%s' at runtime — the explain route must "
+                    "never hand the backend work (dispatch_purity "
+                    "verifies this statically)" % detail)
+        else:
+            rep.add(path, line, "san-effect-violation",
+                    "an explain-tagged request acquired admission "
+                    "permit '%s' at runtime — explain must never "
+                    "consume serving capacity" % detail)
+        violations.append((kind, detail))
+    return {"violations": violations}

@@ -15,7 +15,9 @@
   4. the deadlock watchdog starts (tools/sanitize/deadlock) and the
      runtime ordering recorder arms (tools/sanitize/order) — the same
      module scan wraps the patch-table methods that realise tagged
-     order events;
+     order events — as does the explain effect sentinel
+     (tools/sanitize/effects): dispatch gateways, the admission
+     permit, and the `explain_query` arming wrapper;
   5. optionally the JAX compile/sync sanitizer attaches
      (tools/sanitize/jax_san) — off by default under pytest, where
      compiles happen throughout; the steady-state serving check and
@@ -51,13 +53,15 @@ def install(lockset: bool = True, deadlock_watch: bool = True,
     global _installed
     if _installed is not None:
         return
-    from tools.sanitize import deadlock, jax_san, locks, lockset as ls
+    from tools.sanitize import deadlock, effects, jax_san, locks
+    from tools.sanitize import lockset as ls
     from tools.sanitize import order
     lock_prefixes = tuple(packages) + tuple(extra_lock_prefixes)
     locks.patch_factories(lock_prefixes)
     ls.configure(lockset_enabled=lockset)
     deadlock.configure(enabled=deadlock_watch, watchdog_ms=watchdog_ms)
     order.configure(enabled=True)
+    effects.configure(enabled=True)
     instrumented: list[type] = []
     for modname in sorted(sys.modules):
         if _in_packages(modname, packages):
@@ -80,7 +84,8 @@ def uninstall() -> None:
     global _installed
     if _installed is None:
         return
-    from tools.sanitize import deadlock, locks, lockset as ls
+    from tools.sanitize import deadlock, effects, locks
+    from tools.sanitize import lockset as ls
     from tools.sanitize import order
     state, _installed = _installed, None
     try:
@@ -94,6 +99,8 @@ def uninstall() -> None:
     deadlock.configure(enabled=False)
     order.configure(enabled=False)
     order.unpatch_all()
+    effects.configure(enabled=False)
+    effects.unpatch_all()
     locks.unpatch_factories()
 
 
@@ -105,12 +112,13 @@ def jax_sanitizer():
 def reset_state() -> None:
     """Drop accumulated detector state (not the patches): fixture tests
     isolate scenarios with this."""
-    from tools.sanitize import deadlock, lockset as ls
+    from tools.sanitize import deadlock, effects, lockset as ls
     from tools.sanitize import order
     from tools.sanitize.report import REPORTER
     deadlock.reset()
     ls.reset()
     order.reset()
+    effects.reset()
     REPORTER.clear()
     if _installed and _installed["jax"] is not None:
         _installed["jax"].reset()
@@ -126,9 +134,11 @@ def instrument_module(mod) -> list[type]:
     parser and instrument its lock-holding classes.  Public so fixture
     tests can instrument tests/san_fixtures modules explicitly."""
     from tools.lint.annotations import scan_module_file
+    from tools.sanitize import effects
     from tools.sanitize import lockset as ls
     from tools.sanitize import order
     order.instrument_module(mod)
+    effects.instrument_module(mod)
     path = getattr(mod, "__file__", None)
     if not path or not path.endswith(".py") or not os.path.exists(path):
         return []

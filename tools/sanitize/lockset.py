@@ -39,6 +39,7 @@ import threading
 import weakref
 
 from tools.lint.annotations import ClassAnnotations
+from tools.sanitize import effects
 from tools.sanitize.locks import SanLockBase, held_locks
 from tools.sanitize.report import REPORTER, rel_path
 
@@ -184,6 +185,10 @@ def _track(obj, ann: ClassAnnotations, name: str, value) -> None:
         return
     if isinstance(value, SanLockBase):
         return                   # a lock stored under a non-lock name
+    if effects.armed():
+        # explain-sentinel: record the store while an explain-tagged
+        # request is live; the read-only cross-check filters at finish
+        effects.note_write(ann.name, name)
     st = _state_for(obj)
     me = get_ident()
     st.threads.add(me)

@@ -40,7 +40,7 @@ def pytest_configure(config) -> None:
 
 
 def pytest_sessionfinish(session, exitstatus) -> None:
-    from tools.sanitize import deadlock, order
+    from tools.sanitize import deadlock, effects, order
     from tools.sanitize.report import REPORTER
     deadlock.detect_inversions()
     # note-level: acquires that outwaited their ambient request
@@ -50,6 +50,9 @@ def pytest_sessionfinish(session, exitstatus) -> None:
     # note-level: recorded event streams vs the declared happens-before
     # contracts (same no-op guarantee when nothing was recorded)
     order.cross_check()
+    # note-level: armed explain-request events vs the static # effects:
+    # contract table (same no-op guarantee)
+    effects.cross_check()
     state_path = os.environ.get("TSDBSAN_STATE", "")
     if state_path:
         deadlock.save_observed(state_path)

@@ -55,6 +55,9 @@ SAN_RULES: dict[str, tuple[str, str]] = {
     "san-order-gap": (
         "note", "Contracted order event instrumented but never "
                 "observed this session"),
+    "san-effect-violation": (
+        "note", "Explain-tagged request had a runtime effect outside "
+                "the static # effects: contract"),
 }
 
 ERROR_RULES = frozenset(r for r, (lv, _d) in SAN_RULES.items()
