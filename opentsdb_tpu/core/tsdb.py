@@ -148,6 +148,17 @@ class TSDB:
                 self.rollup_lanes.recorder = self.flightrec
             if self.spill_pool is not None:
                 self.spill_pool.recorder = self.flightrec
+        # always-on latency attribution (obs/latattr.py): per-phase
+        # stamps the RPC layer attaches to EVERY request fold into
+        # bounded profiles keyed by (route, plan fingerprint, tenant),
+        # served at /api/diag/latency — where the milliseconds went,
+        # with tracing off
+        from opentsdb_tpu.obs.latattr import LatencyAttribution
+        self.latattr = (LatencyAttribution(self.config)
+                        if self.config.get_bool("tsd.latattr.enable")
+                        else None)
+        if self.latattr is not None:
+            self.stats_hooks["latattr"] = self.latattr.stats_hook
         # fused multi-query dispatch (query/batcher.py, ROADMAP item
         # 1): concurrent dispatch-bound plans (plan_decision path
         # "batched") coalesce into one stacked [Q, S, N] kernel with

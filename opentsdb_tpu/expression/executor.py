@@ -416,8 +416,12 @@ class QueryExecutor:
 
 def handle_exp_query(tsdb, query) -> None:
     """POST /api/query/exp (QueryRpc.handleExpressionQuery :330)."""
+    from opentsdb_tpu.obs import latattr
     from opentsdb_tpu.tsd.rpcs import allowed_methods
     allowed_methods(query, "POST")
     pojo = PojoQuery.parse(query.json_body())
+    latattr.mark("parse")
     executor = QueryExecutor(tsdb, pojo, http_query=query)
-    query.send_reply(executor.execute())
+    payload = executor.execute()
+    latattr.mark("serialize")
+    query.send_reply(payload)

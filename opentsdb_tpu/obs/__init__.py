@@ -438,10 +438,37 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "counter", (),
         "Slow/anomalous queries whose span tree + flight-recorder "
         "slice were retained at /api/diag/slow."),
+    "tsd.diag.dropped": _m(
+        "counter", ("kind",),
+        "Flight-recorder events dropped on ring overflow, by the "
+        "evicted event's kind — evidence lost before any reader saw "
+        "it (the health engine's diag subsystem judges the rate)."),
     "tsd.health.status": _m(
         "gauge", ("subsystem",),
         "Health-engine verdict per subsystem: 0 ok, 1 degraded, "
         "2 failing (chaos_soak's post-heal gate)."),
+    # -- latency attribution (obs/latattr.py, served at                  #
+    #    /api/diag/latency) -------------------------------------------- #
+    "tsd.latattr.requests": _m(
+        "counter", (),
+        "Requests folded into the always-on latency-attribution "
+        "profiles (every HTTP request, tracing on or off)."),
+    "tsd.latattr.phase_ms": _m(
+        "counter", ("phase",),
+        "Cumulative milliseconds attributed to each fixed request "
+        "phase (parse, admission_wait, plan, batch_rendezvous, "
+        "dispatch, device_wait, serialize, flush) across all "
+        "requests."),
+    "tsd.latattr.profiles": _m(
+        "gauge", (),
+        "Distinct (route, plan fingerprint, tenant) latency-"
+        "attribution profiles live (bounded by "
+        "tsd.latattr.max_profiles)."),
+    "tsd.latattr.profile_overflow": _m(
+        "counter", (),
+        "Requests folded into the overflow profile because the "
+        "profile table was already at tsd.latattr.max_profiles "
+        "distinct keys."),
     # -- diagnostics stats walk (flight recorder + health stats hooks   #
     #    -> /api/stats + the self-report loop) ------------------------- #
     "tsd.diag.ring.events": _m(
@@ -449,6 +476,20 @@ METRICS_SCHEMA: dict[str, MetricSpec] = {
         "(the ring's latest sequence number)."),
     "tsd.diag.slow.captured": _m(
         "gauge", (), "Slow-query captures retained since startup."),
+    "tsd.diag.ring.dropped": _m(
+        "gauge", (), "Flight-recorder events dropped on ring overflow "
+        "since startup (all kinds), re-walked for /api/stats and the "
+        "self-report loop."),
+    "tsd.latattr.observed": _m(
+        "gauge", (), "Latency-attribution requests folded since "
+        "startup, re-walked for /api/stats and the self-report loop."),
+    "tsd.latattr.live_profiles": _m(
+        "gauge", (), "Distinct latency-attribution profiles live, "
+        "re-walked for /api/stats and the self-report loop."),
+    "tsd.latattr.ms": _m(
+        "gauge", ("phase",),
+        "Cumulative per-phase attributed milliseconds, re-walked for "
+        "/api/stats and the self-report loop."),
     "tsd.diag.tenant.demand": _m(
         "gauge", ("tenant",),
         "Per-tenant demand counters re-walked for /api/stats and the "

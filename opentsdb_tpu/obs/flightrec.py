@@ -52,6 +52,7 @@ import time
 import zlib
 from collections import deque
 
+from opentsdb_tpu.obs import latattr
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.histogram import LogHistogram
 from opentsdb_tpu.obs.registry import REGISTRY
@@ -119,6 +120,17 @@ class FlightRecorder:
             "tsd.diag.events", "Flight-recorder events recorded, "
             "by event kind")
         self._cells: dict[str, object] = {}  # guarded-by: _lock
+        # ring-overflow accounting: events evicted oldest-first, by the
+        # EVICTED event's kind — a silent ring wrap hides exactly the
+        # fault window the recorder exists for, so the drops themselves
+        # are evidence (/api/diag "dropped", tsd.diag.dropped, and the
+        # health engine's sustained-drop-rate invariant)
+        self._dropped: dict[str, int] = {}  # guarded-by: _lock
+        self._dropped_total = 0  # guarded-by: _lock
+        self._drop_family = REGISTRY.counter(
+            "tsd.diag.dropped", "Flight-recorder events dropped on "
+            "ring overflow, by the evicted event's kind")
+        self._drop_cells: dict[str, object] = {}  # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------ #
 
@@ -174,22 +186,44 @@ class FlightRecorder:
         event = {"kind": kind, "tMs": int(time.time() * 1e3)}
         if trace_id:
             event["traceId"] = trace_id
+        phase = latattr.phase_in_flight()
+        if phase is not None:
+            # the request phase in flight when this event was recorded
+            # (obs/latattr.py) — "which phase was the daemon in when
+            # the breaker opened" without needing a trace
+            event["phase"] = phase
         if fields:
             event.update(fields)
+        drop_cell = None
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq
+            if len(self._events) == self.ring_size:
+                evicted = self._events[0]["kind"]
+                self._dropped[evicted] = self._dropped.get(evicted, 0) + 1
+                self._dropped_total += 1
+                drop_cell = self._drop_cells.get(evicted)
+                if drop_cell is None:
+                    drop_cell = self._drop_cells[evicted] = \
+                        self._drop_family.labels(kind=evicted)
             self._events.append(event)
             cell = self._cells.get(kind)
             if cell is None:
                 cell = self._cells[kind] = \
                     self._event_family.labels(kind=kind)
         cell.inc()
+        if drop_cell is not None:
+            drop_cell.inc()
         return event["seq"]
 
     def latest_seq(self) -> int:
         with self._lock:
             return self._seq
+
+    def dropped(self) -> tuple[dict[str, int], int]:
+        """(per-kind dropped-oldest tallies, total) since start."""
+        with self._lock:
+            return dict(self._dropped), self._dropped_total
 
     def events(self, since: int = 0) -> list[dict]:
         """Ring snapshot, oldest first; ``since`` returns only events
@@ -273,6 +307,8 @@ class FlightRecorder:
                 "dumpedMs": int(time.time() * 1e3),
                 "seq": self._seq,
                 "ringSize": self.ring_size,
+                "dropped": dict(self._dropped),
+                "droppedTotal": self._dropped_total,
                 "events": list(self._events),
                 "slowQueries": list(self._slow),
             }
@@ -292,7 +328,9 @@ class FlightRecorder:
         with self._lock:
             seq = self._seq
             captured = self.slow_captured
+            dropped_total = self._dropped_total
         collector.record("diag.ring.events", seq)
+        collector.record("diag.ring.dropped", dropped_total)
         collector.record("diag.slow.captured", captured)
         def cells(fam):
             for labels, cell in fam.children():

@@ -40,6 +40,19 @@ last pass, never a point-in-time glance — and folds each into an
     ``tsd.health.replication_lag`` records per window is degraded
     (failing at 4x) — a replica that stops draining has NOT healed
     just because ships stop erroring.
+  * **latency** — phase-share burn: the serialize phase's share of
+    the window's total attributed request time (obs/latattr.py
+    always-on phase stamps) past ``tsd.health.phase_share``
+    (volume-gated).  Serialize time is pure host-side overhead — a
+    daemon spending a growing fraction of every request JSON-encoding
+    replies is burning its latency budget outside the device, the
+    precise regression tsdbsan's serialize pin guards at test time,
+    now judged continuously in production.
+  * **diag** — evidence loss: flight-recorder ring overflow (events
+    evicted before any reader saw them) past
+    ``tsd.health.diag_drop_rate`` drops/second over the window.  A
+    steadily-overflowing ring means the next incident's history is
+    already gone.
 
 Verdicts are exported as ``tsd.health.status`` gauges (0 ok /
 1 degraded / 2 failing), served at ``/api/diag/health``, recorded into
@@ -68,6 +81,8 @@ _CACHE_MIN_CONSULTS = 16
 _CACHE_FAIL_CONSULTS = 64
 _COSTMODEL_MIN_ACTUAL_MS = 50.0
 _TENANT_MIN_DEMAND = 16.0
+_LATENCY_MIN_REQUESTS = 32.0
+_LATENCY_MIN_TOTAL_MS = 50.0
 
 
 def _worst(a: str, b: str) -> str:
@@ -87,7 +102,8 @@ class HealthEngine:
     """Evaluates the declared invariants against one TSDB instance."""
 
     SUBSYSTEMS = ("admission", "compile", "agg_cache", "costmodel",
-                  "spill", "cluster", "tenant", "replication")
+                  "spill", "cluster", "tenant", "replication",
+                  "latency", "diag")
 
     def __init__(self, tsdb):
         cfg = tsdb.config
@@ -104,6 +120,8 @@ class HealthEngine:
         self.tenant_share_ratio = cfg.get_float(
             "tsd.health.tenant_share_ratio")
         self.replication_lag = cfg.get_int("tsd.health.replication_lag")
+        self.phase_share = cfg.get_float("tsd.health.phase_share")
+        self.diag_drop_rate = cfg.get_float("tsd.health.diag_drop_rate")
         self._lock = threading.Lock()
         # guarded-by: _lock
         self._verdicts: dict[str, dict] = {}
@@ -335,6 +353,47 @@ class HealthEngine:
                     "failing" if lag_growth > 4 * self.replication_lag
                     else "degraded")
         verdicts["replication"] = {"level": level, "detail": detail}
+
+        # latency: phase-share burn — serialize's share of the
+        # window's total attributed ms (obs/latattr.py).  Every phase
+        # counter's delta is taken every pass so window baselines stay
+        # aligned even while the volume gate abstains.
+        latattr_engine = getattr(tsdb, "latattr", None)
+        level, detail = "ok", "latency attribution disabled"
+        if latattr_engine is not None:
+            totals = latattr_engine.phase_totals()
+            requests = delta("latattr_requests", totals["requests"])
+            phase_win = {p: delta("latattr_ms:%s" % p, ms)
+                         for p, ms in totals.items() if p != "requests"}
+            total_ms = sum(phase_win.values())
+            serialize_ms = phase_win.get("serialize", 0.0)
+            detail = "%.0f request(s), %.0fms attributed in window" \
+                % (requests, total_ms)
+            if requests >= _LATENCY_MIN_REQUESTS \
+                    and total_ms >= _LATENCY_MIN_TOTAL_MS:
+                share = serialize_ms / total_ms
+                detail = ("serialize %.0f%% of %.0fms attributed over "
+                          "%.0f requests (budget %.0f%%)"
+                          % (share * 100, total_ms, requests,
+                             self.phase_share * 100))
+                if share > self.phase_share > 0:
+                    level = "failing" if share > 2 * self.phase_share \
+                        else "degraded"
+        verdicts["latency"] = {"level": level, "detail": detail}
+
+        # diag: evidence loss — ring-overflow drop rate over the window
+        recorder = getattr(tsdb, "flightrec", None)
+        level, detail = "ok", "flight recorder disabled"
+        if recorder is not None:
+            _by_kind, dropped_total = recorder.dropped()
+            drops = delta("diag_dropped", dropped_total)
+            drop_rate = drops / window_s
+            detail = "%.2f ring drops/s over %.0fs window (limit %.2f/s)" \
+                % (drop_rate, window_s, self.diag_drop_rate)
+            if drop_rate > self.diag_drop_rate > 0:
+                level = "failing" if drop_rate > 4 * self.diag_drop_rate \
+                    else "degraded"
+        verdicts["diag"] = {"level": level, "detail": detail}
 
         self._publish(verdicts, cur, now)
         return verdicts

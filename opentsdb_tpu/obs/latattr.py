@@ -1,0 +1,312 @@
+"""Always-on latency attribution: phase stamps + keyed profiles.
+
+Tracing (obs/trace.py) answers "what is THIS query doing" for requests
+that opted in; the flight recorder retains discrete events.  Neither
+retains *aggregate* phase evidence — after the fact, nothing says where
+the serving tier's milliseconds go at p50 vs p99.  This module does,
+for EVERY request, tracing on or off:
+
+* ``PhaseStamps`` — a per-request recorder the RPC layer attaches to
+  every HTTP request.  Producers along the serving path call
+  ``latattr.mark("plan")`` at phase boundaries; each mark attributes
+  the monotonic time since the previous mark to that phase.  A mark is
+  two perf_counter reads and a dict add — no locks, no registry, no
+  allocation beyond the first mark of a phase — so the always-on cost
+  stays under the tests/test_latattr.py overhead pin.
+
+* ``LatencyAttribution`` — the aggregation engine.  Finished stamps
+  fold into bounded streaming per-phase ``LogHistogram``s keyed by
+  (route arm, plan fingerprint, clamped tenant), with exemplar trace
+  ids linking tail buckets to retained slow-query captures
+  (/api/diag/slow).  Served at ``GET /api/diag/latency`` with
+  ``?since=`` incremental polling and ``?fingerprint=``/``?tenant=``
+  filters (tsd/admin_rpcs.py).
+
+The phase set is FIXED — every request reports the full ordered tuple
+exactly once, with unexercised phases zero-filled — so two captures
+diff phase-by-phase without key reconciliation (tools/latency_report.py
+builds the "where did the milliseconds move" table from exactly this
+property).
+
+Attribution model: time between two marks belongs to the LATER mark's
+phase, and repeated marks accumulate (a multi-segment query folds every
+segment's dispatch into one "dispatch" figure).  The trailing "flush"
+mark in RpcManager.handle_http absorbs the unstamped handler tail
+(response buffering, envelope metrics) — for routes that stamp nothing
+(diag, stats), the whole handler lands there.  For batched dispatches
+the rendezvous wait includes the leader's shared dispatch, so
+"batch_rendezvous" carries the batching cost and the member's own
+"dispatch" delta is ~0.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from opentsdb_tpu.obs.histogram import LogHistogram
+from opentsdb_tpu.obs.registry import REGISTRY
+
+# The fixed request phases, in serving order.  parse: request decode +
+# query validation.  admission_wait: the admission gate (queueing).
+# plan: series resolution + plan decision.  batch_rendezvous: the
+# cross-request dispatch batcher (zero when unbatched).  dispatch:
+# device dispatch + host compute.  device_wait: device->host result
+# extraction.  serialize: response formatting.  flush: the handler
+# tail after serialization (reply buffering, envelope metrics).
+PHASES = ("parse", "admission_wait", "plan", "batch_rendezvous",
+          "dispatch", "device_wait", "serialize", "flush")
+
+# Profile-table overflow sentinel: once tsd.latattr.max_profiles
+# distinct (route, fingerprint, tenant) keys exist, further keys fold
+# here — the table is bounded no matter what fingerprints the query
+# mix mints.
+OVERFLOW_KEY = ("overflow", "-", "-")
+
+
+class PhaseStamps:
+    """Per-request phase recorder.  Owned and touched by the request's
+    handler thread only (the batcher's rendezvous and the admission
+    wait both block that same thread), so no lock."""
+
+    __slots__ = ("t0", "_prev", "deltas", "phase", "route",
+                 "fingerprint", "tenant", "trace_id")
+
+    def __init__(self, trace_id: str | None = None):
+        now = time.perf_counter()
+        self.t0 = now
+        self._prev = now
+        self.deltas: dict[str, float] = {}      # phase -> seconds
+        self.phase = "recv"                     # last completed mark
+        self.route = "other"
+        self.fingerprint: str | None = None     # set by the planner
+        self.tenant: str | None = None          # set by admission
+        self.trace_id = trace_id
+
+    def mark(self, phase: str) -> None:
+        """Attribute time since the previous mark to ``phase``."""
+        now = time.perf_counter()
+        self.deltas[phase] = (self.deltas.get(phase, 0.0)
+                              + (now - self._prev))
+        self._prev = now
+        self.phase = phase
+
+    def phase_ms(self) -> dict[str, float]:
+        """The full ordered phase set in milliseconds, zero-filled."""
+        return {p: self.deltas.get(p, 0.0) * 1e3 for p in PHASES}
+
+    def total_ms(self) -> float:
+        return (self._prev - self.t0) * 1e3
+
+
+# --------------------------------------------------------------------- #
+# Ambient stamps: one per handler thread (mirrors obs/trace.py)         #
+# --------------------------------------------------------------------- #
+
+_tls = threading.local()
+
+
+def activate(stamps: PhaseStamps) -> None:
+    _tls.stamps = stamps
+
+
+def deactivate() -> None:
+    _tls.stamps = None
+
+
+def active() -> PhaseStamps | None:
+    return getattr(_tls, "stamps", None)
+
+
+def mark(phase: str) -> None:
+    """Phase boundary in the ambient request; free when none active."""
+    st = getattr(_tls, "stamps", None)
+    if st is not None:
+        st.mark(phase)
+
+
+def set_fingerprint(fingerprint: str) -> None:
+    st = getattr(_tls, "stamps", None)
+    if st is not None and st.fingerprint is None:
+        # first plan decision wins: a multi-segment query keys its
+        # profile by the segment that planned first
+        st.fingerprint = fingerprint
+
+
+def set_tenant(tenant: str) -> None:
+    st = getattr(_tls, "stamps", None)
+    if st is not None:
+        st.tenant = tenant
+
+
+def phase_in_flight() -> str | None:
+    """The last completed phase of the ambient request, for the flight
+    recorder's events ("recv" before any mark; None outside one)."""
+    st = getattr(_tls, "stamps", None)
+    return st.phase if st is not None else None
+
+
+class _Profile:
+    """One (route, fingerprint, tenant) key's streaming summary."""
+
+    __slots__ = ("key", "count", "last_seq", "hists")
+
+    def __init__(self, key: tuple[str, str, str]):
+        self.key = key
+        self.count = 0
+        self.last_seq = 0
+        self.hists = {p: LogHistogram() for p in PHASES}
+
+    def to_json(self) -> dict:
+        route, fingerprint, tenant = self.key
+        phases: dict[str, dict] = {}
+        exemplars: dict[str, list] = {}
+        for p in PHASES:
+            h = self.hists[p]
+            _counts, count, total = h.snapshot()
+            phases[p] = {"count": count, "totalMs": total,
+                         "p50Ms": _finite(h.quantile(0.5)),
+                         "p99Ms": _finite(h.quantile(0.99))}
+            tail = [{"traceId": label, "ms": value}
+                    for _bound, label, value in h.exemplar_entries()]
+            if tail:
+                # the tail-most exemplars are the diagnostic ones
+                exemplars[p] = tail[-3:]
+        out = {"route": route, "fingerprint": fingerprint,
+               "tenant": tenant, "count": self.count,
+               "lastSeq": self.last_seq, "phases": phases}
+        if exemplars:
+            out["exemplars"] = exemplars
+        return out
+
+
+def _finite(value: float) -> float:
+    return value if value == value else 0.0      # NaN (empty) -> 0
+
+
+class LatencyAttribution:
+    """Folds finished PhaseStamps into bounded keyed profiles plus a
+    global per-phase summary, and serves both as one JSON report."""
+
+    def __init__(self, config):
+        self.max_profiles = max(
+            config.get_int("tsd.latattr.max_profiles"), 1)
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._profiles: dict[tuple[str, str, str], _Profile] = {}
+        self._seq = 0          # guarded-by: _lock
+        self._requests = 0     # guarded-by: _lock
+        self._overflow = 0     # guarded-by: _lock
+        # cumulative per-phase milliseconds — the health engine's
+        # phase-share window deltas read this  # guarded-by: _lock
+        self._phase_total_ms = {p: 0.0 for p in PHASES}
+        # global per-phase histograms (LogHistogram locks itself)
+        self._overall = {p: LogHistogram() for p in PHASES}
+        self._requests_cell = REGISTRY.counter(
+            "tsd.latattr.requests",
+            "Requests folded into the latency-attribution profiles")
+        self._overflow_cell = REGISTRY.counter(
+            "tsd.latattr.profile_overflow",
+            "Requests folded into the overflow profile because "
+            "tsd.latattr.max_profiles distinct keys already exist")
+        self._profiles_gauge = REGISTRY.gauge(
+            "tsd.latattr.profiles",
+            "Distinct (route, fingerprint, tenant) profiles live")
+        phase_fam = REGISTRY.counter(
+            "tsd.latattr.phase_ms",
+            "Cumulative milliseconds attributed to each request phase")
+        self._phase_cells = {p: phase_fam.labels(phase=p)
+                             for p in PHASES}
+
+    def observe(self, stamps: PhaseStamps) -> None:
+        """Fold one finished request.  Called by RpcManager.handle_http
+        after the trailing flush mark, on the handler thread."""
+        deltas = stamps.phase_ms()
+        key = (stamps.route, stamps.fingerprint or "-",
+               stamps.tenant or "default")
+        overflowed = False
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._requests += 1
+            profile = self._profiles.get(key)
+            if profile is None:
+                if len(self._profiles) >= self.max_profiles \
+                        and key != OVERFLOW_KEY:
+                    overflowed = True
+                    self._overflow += 1
+                    key = OVERFLOW_KEY
+                    profile = self._profiles.get(key)
+                if profile is None:
+                    profile = _Profile(key)
+                    self._profiles[key] = profile
+            profile.count += 1
+            profile.last_seq = seq
+            for p in PHASES:
+                self._phase_total_ms[p] += deltas[p]
+            live = len(self._profiles)
+        exemplar = stamps.trace_id
+        for p in PHASES:
+            profile.hists[p].observe(deltas[p], exemplar=exemplar)
+            self._overall[p].observe(deltas[p])
+            self._phase_cells[p].inc(deltas[p])
+        self._requests_cell.inc()
+        if overflowed:
+            self._overflow_cell.inc()
+        self._profiles_gauge.set(live)
+
+    def phase_totals(self) -> dict:
+        """Cumulative per-phase ms + request count, for the health
+        engine's windowed phase-share invariant."""
+        with self._lock:
+            out = dict(self._phase_total_ms)
+            out["requests"] = float(self._requests)
+            return out
+
+    def report(self, since: int = 0, fingerprint: str | None = None,
+               tenant: str | None = None) -> dict:
+        """The /api/diag/latency payload.  ``since`` keeps only
+        profiles touched after that sequence number (poll with the
+        last ``seq`` you saw); the filters match profile keys exactly.
+        Histograms are cumulative since daemon start — differential
+        views belong to tools/latency_report.py."""
+        with self._lock:
+            seq = self._seq
+            requests = self._requests
+            overflow = self._overflow
+            profiles = list(self._profiles.values())
+        selected = []
+        for profile in profiles:
+            _route, key_fp, key_tenant = profile.key
+            if profile.last_seq <= since:
+                continue
+            if fingerprint is not None and key_fp != fingerprint:
+                continue
+            if tenant is not None and key_tenant != tenant:
+                continue
+            selected.append(profile)
+        selected.sort(key=lambda pr: (-pr.count, pr.key))
+        overall: dict[str, dict] = {}
+        for p in PHASES:
+            h = self._overall[p]
+            _counts, count, total = h.snapshot()
+            overall[p] = {"count": count, "totalMs": total,
+                          "p50Ms": _finite(h.quantile(0.5)),
+                          "p99Ms": _finite(h.quantile(0.99))}
+        return {"seq": seq, "requests": requests,
+                "phases": list(PHASES),
+                "profileOverflow": overflow,
+                "overall": overall,
+                "profiles": [pr.to_json() for pr in selected]}
+
+    def stats_hook(self, collector) -> None:
+        """tsdb.stats_hooks entry: fold summary gauges into the
+        standard stats walk (self-report + /api/stats)."""
+        with self._lock:
+            requests = self._requests
+            live = len(self._profiles)
+            totals = dict(self._phase_total_ms)
+        collector.record("latattr.observed", requests)
+        collector.record("latattr.live_profiles", live)
+        for p in PHASES:
+            collector.record("latattr.ms", totals[p], "phase=%s" % p)

@@ -59,6 +59,7 @@ import time
 
 import numpy as np
 
+from opentsdb_tpu.obs import latattr
 from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.ops.pipeline import (run_group_pipeline,
                                        run_stacked_group_pipeline)
@@ -210,6 +211,11 @@ class DispatchBatcher:
                 raise member.error
             result = member.result
         waited_ms = (time.monotonic() - t0) * 1e3
+        # attribution boundary: the coalesce wait (which for followers
+        # includes the leader's shared dispatch) is batch time; the
+        # planner's own "dispatch" mark right after submit() returns
+        # then reads ~0 for stacked members
+        latattr.mark("batch_rendezvous")
         q = result[3]
         outcome = "stacked" if q > 1 else "solo"
         REGISTRY.counter(

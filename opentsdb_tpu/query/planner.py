@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from opentsdb_tpu.models.tsquery import TSQuery, TSSubQuery
+from opentsdb_tpu.obs import latattr
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.ops.downsample import (
     FixedWindows, EdgeWindows, AllWindow, pad_pow2)
@@ -501,6 +502,11 @@ class QueryRunner:
         if pd.agg_note is not None:
             obs_trace.annotate(psp, agg_cache=pd.agg_note)
         obs_trace.annotate(psp, fingerprint=pd.fingerprint)
+        # phase boundary: scan + batch shaping + the routing verdict
+        # all land in "plan"; the fingerprint keys this request's
+        # latency-attribution profile (first segment wins)
+        latattr.mark("plan")
+        latattr.set_fingerprint(pd.fingerprint)
         if pd.path == "refused":
             # over-budget and untileable: the shared structured 413
             # (the span is left unfinished inside the request trace,
@@ -639,6 +645,10 @@ class QueryRunner:
                     out_ts, out_val, out_mask = run_group_pipeline(
                         spec, ts, val, mask, gid, g_pad, wargs)
 
+        # the arm above returned (dispatch enqueued; results may still
+        # be device-resident) — the true sync lands in device_wait at
+        # the asarray boundary below
+        latattr.mark("dispatch")
         if psp is not None:
             obs_trace.device_wait(psp, (out_ts, out_val, out_mask))
             if agg_plan is None and tiled_plan is None \
@@ -680,6 +690,10 @@ class QueryRunner:
             out_ts = np.asarray(out_ts)
             out_val = np.asarray(out_val)
             out_mask = np.asarray(out_mask)
+            # device->host materialization is where an async dispatch
+            # actually blocks (tracing syncs earlier via device_wait,
+            # in which case this delta is ~0)
+            latattr.mark("device_wait")
             results: dict[tuple, QueryResult] = {}
             for i, (group_key, members, _) in enumerate(kept):
                 dps = extract_dps(out_ts, out_val[i], out_mask[i],

@@ -235,12 +235,35 @@ class DiagRpc(HttpRpc):
         ``?trace_id=<id>`` looks one capture up by its trace id.
       * ``/api/diag/health``       per-subsystem ok/degraded/failing
         verdicts (the chaos_soak post-heal gate).
+      * ``/api/diag/latency``      always-on per-phase latency
+        attribution (obs/latattr.py): streaming histograms keyed by
+        (route, plan fingerprint, tenant) — populated with tracing
+        OFF.  ``?since=<seq>`` returns only profiles touched after
+        that sequence number; ``?fingerprint=`` / ``?tenant=`` narrow
+        to one key.
     """
 
     def execute_http(self, tsdb, query: HttpQuery) -> None:
         allowed_methods(query, "GET")
         sub = query.api_subpath()
         endpoint = sub[0] if sub else ""
+        if endpoint == "latency":
+            engine = getattr(tsdb, "latattr", None)
+            if engine is None:
+                raise BadRequestError(
+                    "Latency attribution is disabled", status=404,
+                    details="Set tsd.latattr.enable=true")
+            raw = query.get_query_string_param("since")
+            try:
+                since = int(raw) if raw else 0
+            except ValueError:
+                raise BadRequestError("'since' must be an integer "
+                                      "sequence number")
+            query.send_reply(engine.report(
+                since=since,
+                fingerprint=query.get_query_string_param("fingerprint"),
+                tenant=query.get_query_string_param("tenant")))
+            return
         if endpoint == "health":
             engine = getattr(tsdb, "health", None)
             if engine is None:
@@ -273,10 +296,17 @@ class DiagRpc(HttpRpc):
                       if e["seq"] > since]
         else:
             events = recorder.events(since=since)
+        dropped, dropped_total = recorder.dropped()
         reply = {
             "seq": recorder.latest_seq(),
             "ringSize": recorder.ring_size,
             "events": events,
+            # overflow accounting: events evicted from the ring before
+            # anyone read them, tallied by the evicted event's kind —
+            # a sustained climb means the ring is too small for the
+            # event rate and diagnoses are losing history
+            "dropped": dropped,
+            "droppedTotal": dropped_total,
         }
         if trace_id:
             reply["traceId"] = trace_id

@@ -46,6 +46,7 @@ import threading
 import time
 from collections import deque
 
+from opentsdb_tpu.obs import latattr
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.query.limits import (
@@ -872,6 +873,10 @@ def admit(tsdb, ts_query, http_query=None,
     if priority not in CLASSES:
         priority = CLASSES[0]
     tenant = clamp_tenant(tsdb.config, tenant_raw)
+    # key the request's latency-attribution profile by the same
+    # clamped tenant the metrics use — set before the verdict so shed
+    # requests profile under their tenant too
+    latattr.set_tenant(tenant)
     # per-tenant demand: one tick per arriving query, BEFORE the
     # verdict — the fair-share scheduler (ROADMAP item 1) needs to see
     # demand it refused, not just demand it served
@@ -941,6 +946,7 @@ def admit(tsdb, ts_query, http_query=None,
                                   tenant=tenant, cost_ms=cost_ms)
         except QueryException as e:
             wait_ms = round((time.monotonic() - t0) * 1e3, 3)
+            latattr.mark("admission_wait")
             decision = "shed" if isinstance(e, ShedError) else "cancelled"
             obs_trace.annotate(span, decision=decision, wait_ms=wait_ms)
             if recorder is not None:
@@ -951,6 +957,9 @@ def admit(tsdb, ts_query, http_query=None,
         permit.degrade_note = note
         permit.tenant = tenant
         wait_ms = round((time.monotonic() - t0) * 1e3, 3)
+        # everything since the parse mark — cost estimation, the
+        # degradation ladder, and the gate wait itself — is admission
+        latattr.mark("admission_wait")
         decision = "degraded" if note else "admitted"
         obs_trace.annotate(span, decision=decision, wait_ms=wait_ms,
                            tenant_inflight=gate.tenant_inflight_of(

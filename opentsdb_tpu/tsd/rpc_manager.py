@@ -13,6 +13,7 @@ import math
 import threading
 import time
 
+from opentsdb_tpu.obs import latattr
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.query import limits
@@ -241,11 +242,22 @@ class RpcManager:
         handle = getattr(request, "cancel_handle", None)
         if handle is not None:
             handle.bind(deadline)
+        # always-on latency attribution (obs/latattr.py): stamps on
+        # EVERY request, independent of tsd.trace.enable — the engine
+        # is per-TSDB so library/test managers without one just carry
+        # inert ambient stamps
+        stamps = None
+        if getattr(self.tsdb, "latattr", None) is not None:
+            stamps = latattr.PhaseStamps(
+                trace_id=trace.trace_id if trace is not None else None)
+            latattr.activate(stamps)
         start = time.perf_counter()
         try:
             query = self._dispatch_http(request, remote)
         finally:
             limits.deactivate_deadline()
+            if stamps is not None:
+                latattr.deactivate()
             if trace is not None:
                 obs_trace.deactivate()
                 trace.finish()
@@ -254,6 +266,13 @@ class RpcManager:
         route = query.base_route()
         if route not in self.http_commands:
             route = "other"
+        if stamps is not None:
+            # the trailing mark absorbs the handler tail (reply
+            # buffering, error envelope) so the phase deltas sum to
+            # the handler wall time
+            stamps.mark("flush")
+            stamps.route = route
+            self.tsdb.latattr.observe(stamps)
         status = query.response.status if query.response is not None else 0
         REGISTRY.counter(
             "tsd.http.requests", "HTTP requests served").labels(

@@ -17,6 +17,7 @@ import time
 
 from opentsdb_tpu.models.tsquery import (
     TSQuery, parse_m_subquery, parse_tsuid_subquery)
+from opentsdb_tpu.obs import latattr
 from opentsdb_tpu.obs import trace as obs_trace
 from opentsdb_tpu.obs.registry import REGISTRY
 from opentsdb_tpu.storage.memstore import Annotation
@@ -195,6 +196,9 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
     def _execute_put(self, tsdb, query: HttpQuery) -> None:
         native = self._try_native_put(tsdb, query)
         if native is not None:
+            # the native parser fuses decode + columnar ingest: the
+            # write path's device-equivalent work counts as dispatch
+            latattr.mark("dispatch")
             success, errors, spans = native
             if success == 0 and not errors:
                 raise BadRequestError("No datapoints found in content")
@@ -220,6 +224,7 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
             self._respond_put(tsdb, query, success, errors, dp_at)
             return
         dps = query.serializer.parse_put_v1()
+        latattr.mark("parse")
         self.process_data_points(tsdb, query, dps)
 
     def _try_native_put(self, tsdb, query: HttpQuery):
@@ -270,6 +275,7 @@ class PutDataPointRpc(TelnetRpc, HttpRpc):
         if not dps:
             raise BadRequestError("No datapoints found in content")
         success, errors = self.ingest_points(tsdb, dps)
+        latattr.mark("dispatch")
         self._respond_put(tsdb, query, success, errors, lambda i: dps[i])
 
     # The ack-path durability contract (PR 15), checked at the tree
@@ -469,6 +475,7 @@ class QueryRpc(HttpRpc):
                     details="Set tsd.http.query.allow_delete=true")
             ts_query.delete = True
         ts_query.validate()
+        latattr.mark("parse")
         # Admission: concurrency permit + costmodel shedding/degrading
         # BEFORE any stats registration or device work.  May raise
         # ShedError (503 + Retry-After) or the deadline's own error;
@@ -532,6 +539,7 @@ class QueryRpc(HttpRpc):
                 payload = query.serializer.format_query_v1(ts_query,
                                                            results)
                 obs_trace.annotate(ssp, results=len(payload))
+            latattr.mark("serialize")
             from opentsdb_tpu.tsd.cluster import partial_annotation
             partial = partial_annotation(exec_stats)
             if partial:
@@ -629,6 +637,7 @@ class QueryRpc(HttpRpc):
                 k, v = spec.split("=", 1)
                 raw_what_if[k.strip()] = v
         ts_query.validate()
+        latattr.mark("parse")
         try:
             what_if = explain_mod.parse_what_if(raw_what_if)
         except explain_mod.WhatIfError as e:
@@ -641,6 +650,8 @@ class QueryRpc(HttpRpc):
                 obs_trace.annotate(
                     span, sub_queries=len(report["subQueries"]),
                     what_if=bool(what_if.active))
+            # the whole no-dispatch planning walk is "plan" time
+            latattr.mark("plan")
         except Exception:
             REGISTRY.counter(
                 "tsd.query.explain.requests",

@@ -209,6 +209,21 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "str", "", "Write the flight-recorder black box (ring + slow "
         "captures, JSON) here at shutdown/SIGTERM.  Empty = no dump "
         "artifact."),
+    # -- latency attribution (obs/latattr.py) --------------------------- #
+    "tsd.latattr.enable": _e(
+        "bool", True, "Always-on latency attribution: the RPC layer "
+        "stamps every request at fixed phases (parse, admission wait, "
+        "plan, batch rendezvous, dispatch, device wait, serialize, "
+        "flush) and folds the deltas into bounded streaming histograms "
+        "keyed by (route, plan fingerprint, clamped tenant), served at "
+        "/api/diag/latency.  Independent of tracing — answers 'where "
+        "did the milliseconds go' with tsd.trace.enable off."),
+    "tsd.latattr.max_profiles": _e(
+        "int", "256", "Bound on distinct (route, fingerprint, tenant) "
+        "latency-attribution profiles held in memory; requests beyond "
+        "it collapse into a single overflow profile (counted by "
+        "tsd.latattr.profile_overflow) so cardinality storms cannot "
+        "grow the table."),
     "tsd.diag.slow_ms": _e(
         "int", "0", "Absolute slow-query capture threshold in ms: a "
         "query at least this slow retains its span tree + "
@@ -298,6 +313,19 @@ CONFIG_SCHEMA: dict[str, ConfigEntry] = {
         "window above which the replication subsystem reads degraded "
         "(failing at 4x); any under-replicated shard is at least "
         "degraded."),
+    "tsd.health.phase_share": _e(
+        "float", "0.5", "Phase-share burn budget: the serialize "
+        "phase's share of the window's total attributed request time "
+        "(obs/latattr.py) above which the latency subsystem reads "
+        "degraded (failing at 2x).  Serialize is pure host-side "
+        "overhead — the continuous production form of tsdbsan's "
+        "serialize pin."),
+    "tsd.health.diag_drop_rate": _e(
+        "float", "50", "Evidence-loss bound: flight-recorder ring "
+        "overflow drops per second over the window above which the "
+        "diag subsystem reads degraded (failing at 4x) — a steadily "
+        "overflowing ring means the next incident's history is "
+        "already gone."),
     # -- costmodel autotune (ops/calibrate.py, docs/costmodel.md) ------ #
     "tsd.costmodel.autotune.enable": _e(
         "bool", False, "Online costmodel calibration: fit the kernel-"

@@ -94,7 +94,7 @@ def test_failover_contract_holds():
     cluster under mixed ingest/query load loses zero acked writes and
     serves every query full (non-partial, no 5xx); the rejoined peer
     converges — pairwise per-(origin, shard) CRC-chain agreement — and
-    post-heal /api/diag/health reads all eight invariants ok with the
+    post-heal /api/diag/health reads every invariant ok with the
     ownership epoch change retained in the flight recorder."""
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
@@ -123,6 +123,22 @@ def test_tenants_contract_holds():
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
     assert "fair share held" in proc.stdout
     assert "victim sheds 0" in proc.stdout
+
+
+@pytest.mark.slow
+def test_latattr_contract_holds():
+    """ISSUE 20 acceptance: with a slow-handler latency fault armed,
+    /api/diag/latency never 5xxs mid-fault, every profile reports the
+    full non-negative phase set, and the slow requests' tail exemplar
+    trace ids resolve to retained slow-query captures."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--port", "14311", "--rounds", "8", "--latattr",
+         "--stages-only"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "attribution sane under fault" in proc.stdout
+    assert "polls clean" in proc.stdout
 
 
 def test_cluster_contracts_hold_under_chaos():

@@ -547,3 +547,68 @@ class TestClusterMechanics:
         res = json.loads(body.decode() if isinstance(body, bytes)
                          else body)
         assert res and res[0]["dps"]          # local series answered
+
+
+class TestShardedFailoverTraceId:
+    """One coherent trace id across a sharded failover: the preferred
+    replica is killed mid-request and the preference-walk retry on the
+    next member must carry the SAME X-TSDB-Trace-Id — an operator's
+    /api/diag?trace_id= and slow-capture lookups see one request end
+    to end, not a fresh id per attempt."""
+
+    def test_trace_id_survives_the_preference_walk(self, tmp_path):
+        from tests import fault_fixtures as ff
+        from tests.fault_fixtures import FaultyPeer, series_payload
+        peer_a = FaultyPeer()                 # dies mid-response below
+        peer_b = FaultyPeer()                 # serves the failover
+        try:
+            tsdb = TSDB(Config({
+                "tsd.core.auto_create_metrics": True,
+                "tsd.query.mesh.enable": "false",
+                "tsd.storage.directory": str(tmp_path / "walk"),
+                "tsd.network.cluster.peers":
+                    "%s,%s" % (peer_a.address, peer_b.address),
+                # in the ring but never dialed: every fetch under test
+                # goes to the two fault peers
+                "tsd.network.cluster.self":
+                    "127.0.0.1:%d" % ff.refused_port(),
+                "tsd.network.cluster.shard.enable": True,
+                "tsd.network.cluster.shard.replicas": 2,
+                "tsd.network.cluster.retry.max_attempts": 1,
+                "tsd.network.cluster.timeout_ms": 3000,
+            }))
+            mgr = RpcManager(tsdb)
+            repl = tsdb.replication
+            # a metric whose shard prefers peer_a THEN peer_b — the
+            # exact walk under test — deterministic given the ring
+            for i in range(10_000):
+                metric = "clu.walk.%d" % i
+                shard = repl.shard_of(metric, {"host": "remote"})
+                if list(repl.preferences[shard]) \
+                        == [peer_a.address, peer_b.address]:
+                    break
+            else:
+                raise AssertionError("no peer_a-then-peer_b metric")
+            peer_a.mode = ff.DISCONNECT       # 200 headers, half body, RST
+            peer_b.payload = series_payload(
+                metric, {"host": "remote"},
+                {str((BASE + 5) * 1000): 23.0})
+            status, payload = ask(
+                mgr, "/api/query?start=%d&end=%d&m=sum:%s"
+                % (BASE - 60, BASE + 1200, metric),
+                headers={"x-tsdb-trace-id": "walk-trace-1"})
+            # the walk made the query whole: 200, peer_b's data, NOT
+            # partial
+            assert status == 200
+            assert _partial_trailer(payload) is None
+            dps = [e for e in payload if "metric" in e][0]["dps"]
+            assert set(dps.values()) == {23.0}
+            # both attempts — the killed one and the retry — carried
+            # the one adopted trace id
+            assert peer_a.requests >= 1 and peer_b.requests >= 1
+            ids_a = {h.get("x-tsdb-trace-id") for h in peer_a.seen_headers}
+            ids_b = {h.get("x-tsdb-trace-id") for h in peer_b.seen_headers}
+            assert ids_a == ids_b == {"walk-trace-1"}
+        finally:
+            peer_a.close()
+            peer_b.close()

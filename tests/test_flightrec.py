@@ -168,7 +168,8 @@ class TestEndpoints:
         assert status == 200
         status, payload, _ = ask(mgr, "/api/diag")
         assert status == 200
-        assert set(payload) == {"seq", "ringSize", "events", "tenants"}
+        assert set(payload) == {"seq", "ringSize", "events", "tenants",
+                                "dropped", "droppedTotal"}
         assert payload["seq"] >= 1
         kinds = {e["kind"] for e in payload["events"]}
         assert {"admission", "plan"} <= kinds
@@ -206,7 +207,7 @@ class TestEndpoints:
         assert payload["overall"] == "ok"
         assert set(payload["subsystems"]) == {
             "admission", "compile", "agg_cache", "costmodel", "spill",
-            "cluster", "tenant", "replication"}
+            "cluster", "tenant", "replication", "latency", "diag"}
         for verdict in payload["subsystems"].values():
             assert verdict["level"] in ("ok", "degraded", "failing")
             assert verdict["detail"]

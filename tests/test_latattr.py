@@ -1,0 +1,319 @@
+"""Always-on latency attribution (obs/latattr.py): phase-stamp
+completeness, monotonicity, bounded profiles, the /api/diag/latency
+report, flight-recorder drop accounting, and the overhead pin.
+
+The contract under test: EVERY HTTP request — tracing on or off —
+reports the full ordered phase set exactly once, with non-negative
+per-phase deltas, folded into profiles keyed by (route, plan
+fingerprint, tenant).  The always-on cost of stamping must stay under
+3% of stamps-off serving (the tsdbsan discipline applied to latattr:
+attribution nobody can afford to leave on attributes nothing).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from opentsdb_tpu.core import TSDB
+from opentsdb_tpu.obs import latattr
+from opentsdb_tpu.obs.latattr import (
+    PHASES, OVERFLOW_KEY, LatencyAttribution, PhaseStamps)
+from opentsdb_tpu.tsd.http import HttpRequest
+from opentsdb_tpu.tsd.rpc_manager import RpcManager
+from opentsdb_tpu.utils.config import Config
+
+BASE = 1_356_998_400
+
+
+@pytest.fixture
+def served():
+    tsdb = TSDB(Config({"tsd.core.auto_create_metrics": True,
+                        "tsd.query.mesh.enable": False}))
+    for host in ("web01", "web02"):
+        for i in range(200):
+            tsdb.add_point("la.cpu", BASE + i * 10, float(i),
+                           {"host": host})
+    return tsdb, RpcManager(tsdb)
+
+
+def ask(manager, uri, method="GET", body=None, headers=None):
+    return manager.handle_http(
+        HttpRequest(method=method, uri=uri, body=body,
+                    headers=headers or {}),
+        remote="127.0.0.1:9").response
+
+
+def latency_report(manager, qs=""):
+    response = ask(manager, "/api/diag/latency" + qs)
+    assert response.status == 200
+    return json.loads(response.body)
+
+
+QUERY_URI = ("/api/query?start=%d&end=%d&m=sum:30s-avg:la.cpu{host=*}"
+             % (BASE, BASE + 2_000))
+EXPLAIN_URI = ("/api/query/explain?start=%d&end=%d&m=sum:la.cpu"
+               % (BASE, BASE + 2_000))
+EXP_BODY = json.dumps({
+    "time": {"start": str(BASE), "end": str(BASE + 2_000),
+             "aggregator": "sum"},
+    "filters": [{"id": "f1", "tags": [
+        {"tagk": "host", "type": "wildcard", "filter": "*",
+         "groupBy": True}]}],
+    "metrics": [{"id": "a", "metric": "la.cpu", "filter": "f1"}],
+    "expressions": [{"id": "e", "expr": "a * 2"}],
+}).encode()
+PUT_BODY = json.dumps([{"metric": "la.cpu", "timestamp": BASE + 9_000,
+                        "value": 1.5, "tags": {"host": "web09"}}]
+                      ).encode()
+
+
+class TestPhaseStamps:
+    def test_marks_accumulate_into_the_later_phase(self):
+        stamps = PhaseStamps()
+        stamps.mark("parse")
+        stamps.mark("plan")
+        stamps.mark("plan")            # multi-segment: deltas add up
+        ms = stamps.phase_ms()
+        assert list(ms) == list(PHASES)
+        assert all(v >= 0.0 for v in ms.values())
+        assert ms["dispatch"] == 0.0   # unexercised phases zero-fill
+        assert stamps.total_ms() >= sum(ms.values()) - 1e-6
+
+    def test_ambient_stamps_follow_the_handler_thread(self):
+        assert latattr.active() is None
+        latattr.mark("plan")           # free no-op outside a request
+        stamps = PhaseStamps(trace_id="t-1")
+        latattr.activate(stamps)
+        try:
+            assert latattr.phase_in_flight() == "recv"
+            latattr.mark("parse")
+            assert latattr.phase_in_flight() == "parse"
+            latattr.set_tenant("acme")
+            latattr.set_fingerprint("pf-1")
+            latattr.set_fingerprint("pf-2")   # first plan wins
+        finally:
+            latattr.deactivate()
+        assert stamps.tenant == "acme"
+        assert stamps.fingerprint == "pf-1"
+        assert latattr.phase_in_flight() is None
+
+
+class TestCompleteness:
+    """Every RPC route emits the full ordered phase set exactly once
+    per request — the property latency_report.py's diffs rest on."""
+
+    ROUTES = [
+        ("api/query", "GET", QUERY_URI, None),
+        ("api/query", "GET", EXPLAIN_URI, None),     # explain sub-route
+        ("api/query", "POST", "/api/query/exp", EXP_BODY),
+        ("api/put", "POST", "/api/put", PUT_BODY),
+        ("api/diag", "GET", "/api/diag", None),
+    ]
+
+    def test_every_route_reports_the_full_phase_set_once(self, served):
+        tsdb, manager = served
+        for _route, method, uri, body in self.ROUTES:
+            response = ask(manager, uri, method=method, body=body)
+            assert response.status in (200, 204), (uri, response.status)
+        report = latency_report(manager)
+        # one fold per request: the 5 driven above + the report fetch
+        # itself is NOT yet folded when its reply is built
+        assert report["requests"] == len(self.ROUTES)
+        assert report["phases"] == list(PHASES)
+        assert sum(p["count"] for p in report["profiles"]) \
+            == report["requests"]
+        for profile in report["profiles"]:
+            assert list(profile["phases"]) == list(PHASES), profile
+            for phase, summary in profile["phases"].items():
+                assert summary["count"] == profile["count"], \
+                    (profile["route"], phase)
+                assert summary["totalMs"] >= 0.0
+                assert summary["p99Ms"] >= summary["p50Ms"] >= 0.0
+        routes = {p["route"] for p in report["profiles"]}
+        assert routes == {"api/query", "api/put", "api/diag"}
+
+    def test_query_phases_land_where_the_work_happened(self, served):
+        tsdb, manager = served
+        assert ask(manager, QUERY_URI).status == 200
+        report = latency_report(manager)
+        (profile,) = [p for p in report["profiles"]
+                      if p["route"] == "api/query"]
+        assert profile["fingerprint"].startswith("pf-")
+        assert profile["tenant"] == "default"
+        for phase in ("parse", "plan", "serialize"):
+            assert profile["phases"][phase]["totalMs"] > 0.0, phase
+        wall = sum(v["totalMs"] for v in profile["phases"].values())
+        assert wall > 0.0
+
+    def test_histograms_populate_with_tracing_off(self, served):
+        tsdb, manager = served
+        tsdb.config.override_config("tsd.trace.enable", False)
+        assert ask(manager, QUERY_URI).status == 200
+        report = latency_report(manager)
+        assert report["requests"] == 1
+        (profile,) = [p for p in report["profiles"]
+                      if p["route"] == "api/query"]
+        assert profile["phases"]["plan"]["totalMs"] > 0.0
+        # no trace minted -> no exemplars, but the numbers are there
+        assert "exemplars" not in profile
+
+    def test_exemplars_link_traced_requests(self, served):
+        tsdb, manager = served
+        response = ask(manager, QUERY_URI,
+                       headers={"x-tsdb-trace-id": "la-exemplar-1"})
+        assert response.status == 200
+        report = latency_report(manager)
+        (profile,) = [p for p in report["profiles"]
+                      if p["route"] == "api/query"]
+        traced = {e["traceId"]
+                  for tail in profile["exemplars"].values()
+                  for e in tail}
+        assert traced == {"la-exemplar-1"}
+
+
+class TestReport:
+    def test_since_and_filters(self, served):
+        tsdb, manager = served
+        assert ask(manager, QUERY_URI).status == 200
+        report = latency_report(manager)
+        seq = report["seq"]
+        incremental = latency_report(manager, "?since=%d" % seq)
+        assert all(p["lastSeq"] > seq
+                   for p in incremental["profiles"])
+        assert {p["route"] for p in incremental["profiles"]} \
+            == {"api/diag"}   # only the report fetch itself is newer
+        fingerprint = [p["fingerprint"] for p in report["profiles"]
+                       if p["fingerprint"] != "-"][0]
+        narrowed = latency_report(
+            manager, "?fingerprint=%s" % fingerprint)["profiles"]
+        assert narrowed and all(p["fingerprint"] == fingerprint
+                                for p in narrowed)
+        assert latency_report(manager, "?tenant=absent")["profiles"] \
+            == []
+
+    def test_bad_since_is_a_400(self, served):
+        _tsdb, manager = served
+        assert ask(manager, "/api/diag/latency?since=zap").status == 400
+
+    def test_disabled_engine_is_a_404(self, served):
+        tsdb, manager = served
+        tsdb.latattr = None
+        assert ask(manager, "/api/diag/latency").status == 404
+
+
+class TestBoundedProfiles:
+    def _stamps(self, route, fingerprint):
+        stamps = PhaseStamps()
+        stamps.mark("parse")
+        stamps.route = route
+        stamps.fingerprint = fingerprint
+        return stamps
+
+    def test_overflow_collapses_into_one_profile(self):
+        engine = LatencyAttribution(
+            Config({"tsd.latattr.max_profiles": 2}))
+        for i in range(5):
+            engine.observe(self._stamps("api/query", "pf-%d" % i))
+        report = engine.report()
+        assert report["requests"] == 5
+        assert report["profileOverflow"] == 3
+        keys = {(p["route"], p["fingerprint"], p["tenant"])
+                for p in report["profiles"]}
+        assert OVERFLOW_KEY in keys
+        assert len(keys) == 3          # 2 real + the overflow bucket
+        (overflow,) = [p for p in report["profiles"]
+                       if p["route"] == OVERFLOW_KEY[0]]
+        assert overflow["count"] == 3
+
+    def test_phase_totals_feed_the_health_window(self):
+        engine = LatencyAttribution(Config({}))
+        engine.observe(self._stamps("api/query", "pf-a"))
+        totals = engine.phase_totals()
+        assert totals["requests"] == 1.0
+        assert totals["parse"] >= 0.0
+        assert set(totals) == set(PHASES) | {"requests"}
+
+
+class TestRingDropAccounting:
+    def test_overflow_is_counted_per_evicted_kind(self):
+        tsdb = TSDB(Config({"tsd.diag.ring_size": 16}))
+        recorder = tsdb.flightrec
+        for _ in range(16):                     # exactly fills the ring
+            recorder.record("admission", verdict="ok")
+        assert recorder.dropped() == ({}, 0)    # full, nothing dropped
+        for _ in range(3):
+            recorder.record("breaker", state="open")
+        by_kind, total = recorder.dropped()
+        assert by_kind == {"admission": 3}
+        assert total == 3
+
+    def test_diag_endpoint_exposes_the_drop_tallies(self, served):
+        tsdb, manager = served
+        tsdb.flightrec.ring_size = 2
+        tsdb.flightrec._events = __import__("collections").deque(
+            tsdb.flightrec._events, maxlen=2)
+        for _ in range(5):
+            tsdb.flightrec.record("autotune", flip="x")
+        response = ask(manager, "/api/diag")
+        payload = json.loads(response.body)
+        assert payload["droppedTotal"] >= 3
+        assert payload["dropped"].get("autotune", 0) >= 3
+
+    def test_events_carry_the_phase_in_flight(self, served):
+        tsdb, manager = served
+        assert ask(manager, QUERY_URI).status == 200
+        events = tsdb.flightrec.events()
+        plan_events = [e for e in events if e["kind"] == "plan"]
+        assert plan_events
+        for event in plan_events:
+            # recorded right after the dispatch arm returned
+            assert event["phase"] in PHASES
+
+
+MAX_RATIO = 1.03
+NOISE_FLOOR_S = 0.25
+QUERIES_PER_BATCH = 30
+BATCHES = 4
+WARMUP = 5
+
+
+def _batch(manager) -> float:
+    start = time.perf_counter()
+    for _ in range(QUERIES_PER_BATCH):
+        response = ask(manager, QUERY_URI)
+        assert response.status == 200
+    return time.perf_counter() - start
+
+
+def test_always_on_stamps_stay_within_3pct_of_stamps_off(served):
+    """The ISSUE's overhead pin: attribution on EVERY request must cost
+    under 3% of stamps-off serving.  Same discipline as
+    tests/test_obs_overhead.py — warm both arms, alternate batches,
+    compare minima with an absolute noise floor — measured against the
+    leanest baseline (tracing off), where the stamps' relative cost is
+    largest."""
+    tsdb, manager = served
+    tsdb.config.override_config("tsd.trace.enable", False)
+    engine = tsdb.latattr
+    assert engine is not None
+    for arm in (None, engine, None, engine):
+        tsdb.latattr = arm
+        for _ in range(WARMUP):
+            assert ask(manager, QUERY_URI).status == 200
+    plain = []
+    stamped = []
+    for _ in range(BATCHES):            # alternate: shared noise cancels
+        tsdb.latattr = None
+        plain.append(_batch(manager))
+        tsdb.latattr = engine
+        stamped.append(_batch(manager))
+    best_plain = min(plain)
+    best_stamped = min(stamped)
+    budget = MAX_RATIO * max(best_plain, NOISE_FLOOR_S)
+    assert best_stamped < budget, (
+        "stamped serving took %.3fs vs %.3fs stamps-off per %d-query "
+        "batch (budget %.3fs) — always-on attribution blew the 3%% pin"
+        % (best_stamped, best_plain, QUERIES_PER_BATCH, budget))

@@ -566,14 +566,16 @@ def test_moving_ship_after_ack_fails_the_tree(tmp_path):
     src = rp.read_text()
     ingest_line = ("        success, errors = "
                    "self.ingest_points(tsdb, dps)\n")
+    mark_line = '        latattr.mark("dispatch")\n'
     ack_line = ("        self._respond_put(tsdb, query, success, "
                 "errors, lambda i: dps[i])\n")
-    needle = ingest_line + ack_line
+    needle = ingest_line + mark_line + ack_line
     assert src.count(needle) == 1, \
         "expected the ingest-then-ack pair in process_data_points"
     rp.write_text(src.replace(
         needle,
-        ack_line.replace("success, errors,", "[], [],") + ingest_line))
+        ack_line.replace("success, errors,", "[], [],")
+        + ingest_line + mark_line))
     ctx = LintContext(str(tmp_path))
     findings = run_lint(["opentsdb_tpu"], root=str(tmp_path),
                         analyzers=[ordering.ORDER_ANALYZER], ctx=ctx)
@@ -629,6 +631,7 @@ def test_injected_dispatch_under_handle_explain_fails_the_tree(
     rp = dst / "tsd" / "rpcs.py"
     src = rp.read_text()
     needle = ("        ts_query.validate()\n"
+              '        latattr.mark("parse")\n'
               "        try:\n"
               "            what_if = "
               "explain_mod.parse_what_if(raw_what_if)\n")
@@ -637,6 +640,7 @@ def test_injected_dispatch_under_handle_explain_fails_the_tree(
     rp.write_text(src.replace(
         needle,
         "        ts_query.validate()\n"
+        '        latattr.mark("parse")\n'
         "        jnp.zeros((1,))\n"
         "        try:\n"
         "            what_if = "

@@ -635,7 +635,7 @@ class TestReplicationWire:
         health = a.get("/api/diag/health")
         assert "replication" in health["subsystems"]
         assert health["subsystems"]["replication"]["level"] == "ok"
-        assert len(health["subsystems"]) == 8
+        assert len(health["subsystems"]) == 10
 
 
 class TestFaultSites:

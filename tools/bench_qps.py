@@ -142,6 +142,18 @@ def seed(port: int) -> None:
             http_put(port, batch)
 
 
+def diag_latency(port: int) -> dict | None:
+    """One /api/diag/latency capture (obs/latattr.py) — None when the
+    daemon predates attribution or has it disabled."""
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/api/diag/latency" % port,
+                timeout=10) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.HTTPError, OSError, ValueError):
+        return None
+
+
 def scrape(port: int) -> dict:
     text = urllib.request.urlopen(
         "http://127.0.0.1:%d/api/stats/prometheus" % port,
@@ -219,9 +231,11 @@ def run_phase(port: int, clients: int, seconds: float,
         t.start()
     time.sleep(warmup_s)                 # compiles + caches settle
     before = scrape(port)
+    lat_before = diag_latency(port)
     t0 = time.time()
     time.sleep(seconds)
     after = scrape(port)
+    lat_after = diag_latency(port)
     elapsed = time.time() - t0
     stop[0] = True
     for t in threads:
@@ -235,7 +249,14 @@ def run_phase(port: int, clients: int, seconds: float,
 
     served = (total(after, "tsd_query_count_total", 'status="200"')
               - total(before, "tsd_query_count_total", 'status="200"'))
+    # where the window's milliseconds went, phase by phase — the
+    # always-on attribution's timed-window delta
+    # (tools/latency_report.py diffs two of these into the
+    # "where did the milliseconds move" table)
+    from tools.latency_report import window_delta
+    decomposition = window_delta(lat_before, lat_after)
     return {
+        "phaseDecomposition": decomposition,
         "servedQueries": int(served),
         "elapsedS": round(elapsed, 3),
         "qps": round(served / elapsed, 2),

@@ -1210,6 +1210,151 @@ def run_overload_stage(port: int, rounds: int) -> None:
         tsd.wait()
 
 
+# The fixed latency-attribution phase set (obs/latattr.py PHASES) —
+# the stage pins the report's ordered keys against it
+LATATTR_PHASES = ["parse", "admission_wait", "plan", "batch_rendezvous",
+                  "dispatch", "device_wait", "serialize", "flush"]
+
+
+def run_latattr_stage(port: int, rounds: int) -> None:
+    """--latattr: attribution sanity under fault injection (ISSUE 20).
+
+    A TSD with a slow-handler latency fault armed serves a traced query
+    burst while a poller hammers /api/diag/latency the whole time.  The
+    attribution contract:
+
+      * /api/diag/latency NEVER answers 5xx mid-fault, and the folded
+        request count never moves backwards between polls;
+      * every profile reports the full ordered phase set with
+        non-negative counts/totals/quantiles (no negative or missing
+        phase deltas, fault or no fault);
+      * the faulted (slow) requests' tail exemplar trace ids resolve
+        to retained slow-query captures (/api/diag/slow?trace_id=).
+    """
+    fault_ms = 400
+    fault = json.dumps([{"site": "rpc.slow_handler", "kind": "latency",
+                         "ms": fault_ms, "times": max(rounds // 2, 3)}])
+    tsd = spawn_tsd(port, {
+        "tsd.query.mesh.enable": "false",
+        "tsd.faults.config": fault,
+        # the faulted requests cross this and get captured
+        "tsd.diag.slow_ms": str(fault_ms // 2),
+        "tsd.health.interval": "2",
+    }, role="latattr")
+    try:
+        seed_host(port, "a", 1)
+        status, _ = query(port)                       # warm compile
+        violations: list = []
+        poll_count = [0]
+        stop = [False]
+
+        def poller():
+            last_requests = -1
+            while not stop[0]:
+                try:
+                    with urllib.request.urlopen(
+                            "http://127.0.0.1:%d/api/diag/latency"
+                            % port, timeout=10) as resp:
+                        payload = json.loads(resp.read())
+                        poll_count[0] += 1
+                        if resp.status != 200:
+                            violations.append(
+                                "poll status %d" % resp.status)
+                        if payload["requests"] < last_requests:
+                            violations.append(
+                                "requests went backwards: %d -> %d"
+                                % (last_requests, payload["requests"]))
+                        last_requests = payload["requests"]
+                except urllib.error.HTTPError as e:
+                    poll_count[0] += 1
+                    violations.append("poll -> HTTP %d mid-fault"
+                                      % e.code)
+                except OSError:
+                    pass                  # daemon busy; not a 5xx
+                time.sleep(0.05)
+
+        poller_t = threading.Thread(target=poller, daemon=True)
+        poller_t.start()
+        statuses = []
+        for i in range(rounds):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/api/query?start=%d&end=%d"
+                "&m=sum:chaos.m" % (port, BASE - 1, BASE + 600),
+                headers={"X-TSDB-Trace-Id": "latattr-%03d" % i})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    statuses.append(resp.status)
+            except urllib.error.HTTPError as e:
+                statuses.append(e.code)
+        stop[0] = True
+        poller_t.join(5)
+        if statuses.count(200) == 0:
+            print("[latattr] no query ever answered 200", flush=True)
+            raise SystemExit(1)
+        if not poll_count[0]:
+            print("[latattr] the mid-fault poller never completed a "
+                  "poll", flush=True)
+            raise SystemExit(1)
+        if violations:
+            print("[latattr] mid-fault polling violations: %r"
+                  % violations[:10], flush=True)
+            raise SystemExit(1)
+
+        # final report: full ordered phase set, non-negative everywhere
+        report = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/api/diag/latency" % port,
+            timeout=10).read())
+        if report["phases"] != LATATTR_PHASES:
+            print("[latattr] phase set drifted: %r" % report["phases"],
+                  flush=True)
+            raise SystemExit(1)
+        exemplar_ids: set = set()
+        for profile in report["profiles"]:
+            if list(profile["phases"]) != LATATTR_PHASES:
+                print("[latattr] profile %r missing phases: %r"
+                      % (profile["route"], list(profile["phases"])),
+                      flush=True)
+                raise SystemExit(1)
+            for phase, summary in profile["phases"].items():
+                for field in ("count", "totalMs", "p50Ms", "p99Ms"):
+                    if summary[field] < 0:
+                        print("[latattr] NEGATIVE %s on %s/%s: %r"
+                              % (field, profile["route"], phase,
+                                 summary), flush=True)
+                        raise SystemExit(1)
+            for tail in profile.get("exemplars", {}).values():
+                exemplar_ids.update(e["traceId"] for e in tail)
+
+        # the slow (faulted) requests' exemplars resolve to retained
+        # captures: tail trace ids and the slow store must intersect,
+        # and the lookup endpoint must produce the capture
+        slow = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/api/diag/slow" % port,
+            timeout=10).read())
+        slow_ids = {q.get("traceId") for q in slow.get("queries", [])}
+        resolved = sorted(exemplar_ids & slow_ids)
+        if not resolved:
+            print("[latattr] no exemplar trace id resolves to a slow "
+                  "capture (exemplars %d, captures %d)"
+                  % (len(exemplar_ids), len(slow_ids)), flush=True)
+            raise SystemExit(1)
+        lookup = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/api/diag/slow?trace_id=%s"
+            % (port, resolved[0]), timeout=10).read())
+        if not lookup.get("queries"):
+            print("[latattr] slow lookup for exemplar %s came back "
+                  "empty" % resolved[0], flush=True)
+            raise SystemExit(1)
+        check_diag_gate(port, "latattr", [])
+        print("[latattr] attribution sane under fault: %d polls clean, "
+              "%d/%d queries 200, %d exemplar(s) resolve to captures"
+              % (poll_count[0], statuses.count(200), len(statuses),
+                 len(resolved)), flush=True)
+    finally:
+        tsd.send_signal(signal.SIGTERM)
+        tsd.wait()
+
+
 def run_tenants_stage(port: int, rounds: int) -> None:
     """--tenants: two tenants behind the fair-share gate (ISSUE 14),
     one storming.  The multi-tenant contract (ROADMAP item 1):
@@ -1411,7 +1556,7 @@ def run_failover_stage(port: int, rounds: int) -> None:
       * the killed peer REJOINS (same WAL directory): catch-up from
         peers' tails converges, per-(origin, shard) CRC chains agree
         across the cluster (anti-entropy's byte-level evidence);
-      * post-heal /api/diag/health reads all eight invariants ok and
+      * post-heal /api/diag/health reads every invariant ok and
         the flight recorder retains the ownership epoch changes.
     """
     import tempfile
@@ -1596,7 +1741,7 @@ def run_failover_stage(port: int, rounds: int) -> None:
         print("[failover] rejoined peer converged: CRC chains agree "
               "pairwise across the cluster", flush=True)
 
-        # -- post-heal gate: all eight invariants ok + epoch evidence
+        # -- post-heal gate: every invariant ok + epoch evidence
         check_diag_gate(
             ports[0], "failover",
             [("replication epoch change",
@@ -1688,8 +1833,8 @@ def main():
                          "load with a kill -9 of one peer mid-burst "
                          "must lose zero acked writes, serve zero 500s "
                          "and zero partialResults, converge the "
-                         "rejoined peer's CRC chains, and read all "
-                         "eight health invariants ok post-heal")
+                         "rejoined peer's CRC chains, and read every "
+                         "health invariant ok post-heal")
     ap.add_argument("--tenants", action="store_true",
                     help="run the fair-share multi-tenant stage: one "
                          "tenant storming must shed on its own "
@@ -1697,6 +1842,13 @@ def main():
                          "within its solo baseline bound; zero 500s; "
                          "heals after the storm with the shed "
                          "evidence retained in the flight recorder")
+    ap.add_argument("--latattr", action="store_true",
+                    help="run the latency-attribution sanity stage: "
+                         "with a slow-handler fault armed, "
+                         "/api/diag/latency must never 5xx, every "
+                         "profile must report the full non-negative "
+                         "phase set, and tail exemplar trace ids must "
+                         "resolve to retained slow-query captures")
     ap.add_argument("--stages-only", action="store_true",
                     help="run only the requested stage(s) "
                          "(--overload/--autotune), skipping the "
@@ -1710,6 +1862,8 @@ def main():
         run_failover_stage(args.port + 13, args.rounds)
     if args.tenants:
         run_tenants_stage(args.port + 11, args.rounds)
+    if args.latattr:
+        run_latattr_stage(args.port + 15, args.rounds)
     if args.autotune:
         run_autotune_stage(args.port + 2, args.rounds)
     if args.cache:
@@ -1721,10 +1875,10 @@ def main():
     if args.stages_only:
         if not (args.overload or args.autotune or args.cache
                 or args.spill or args.rollup or args.tenants
-                or args.failover):
+                or args.failover or args.latattr):
             ap.error("--stages-only needs --overload, --autotune, "
-                     "--cache, --spill, --rollup, --tenants and/or "
-                     "--failover")
+                     "--cache, --spill, --rollup, --tenants, "
+                     "--latattr and/or --failover")
         print("chaos soak stages PASSED (standard phases skipped: "
               "--stages-only)", flush=True)
         return
